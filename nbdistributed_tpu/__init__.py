@@ -1,0 +1,36 @@
+"""nbdistributed_tpu — interactive distributed JAX on TPU from a notebook.
+
+TPU-native rebuild of the capabilities of ``nbdistributed`` (reference:
+__init__.py, magic.py, communication.py, process_manager.py, worker.py):
+one notebook kernel coordinates N JAX worker processes (one per TPU chip
+or host); every cell executes remotely on all or selected ranks with REPL
+semantics — streamed per-rank stdout, last-expression echo, persistent
+namespaces — while collectives are XLA programs over ICI/DCN instead of
+NCCL/Gloo.
+
+Usage in a notebook::
+
+    %load_ext nbdistributed_tpu
+    %dist_init -n 8
+    # every subsequent cell runs on all 8 workers
+"""
+
+__version__ = "0.1.0"
+
+
+def load_ipython_extension(ipython):
+    """``%load_ext nbdistributed_tpu`` hook (reference: __init__.py:7-18)."""
+    from .magics.magic import DistributedMagics
+
+    DistributedMagics.reset_class_state()
+    magics = DistributedMagics(ipython)
+    ipython.register_magics(magics)
+    magics.on_extension_loaded()
+
+
+def unload_ipython_extension(ipython):
+    """``%unload_ext`` hook — tears down any running cluster
+    (reference: __init__.py:21-25)."""
+    from .magics.magic import DistributedMagics
+
+    DistributedMagics.shutdown_all()

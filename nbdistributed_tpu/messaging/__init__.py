@@ -1,0 +1,16 @@
+"""Control-plane messaging: wire codec, sockets transport, coordinator.
+
+Layer L2 of the architecture (SURVEY §1) — coordinator↔worker request/
+response with streaming push, rebuilt from the reference's ZMQ+pickle
+design (reference: communication.py) on plain TCP with a safe codec.
+"""
+
+from .codec import COORDINATOR_RANK, CodecError, Message, decode, encode
+from .coordinator import CommunicationManager, WorkerDied
+from .transport import CoordinatorListener, TransportError, WorkerChannel
+
+__all__ = [
+    "COORDINATOR_RANK", "CodecError", "Message", "decode", "encode",
+    "CommunicationManager", "WorkerDied",
+    "CoordinatorListener", "TransportError", "WorkerChannel",
+]

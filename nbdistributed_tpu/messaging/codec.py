@@ -1,0 +1,210 @@
+"""Wire codec for the control plane.
+
+The reference serializes every control-plane message with blind ``pickle``
+(reference: communication.py:249, worker.py:203), which is both a trust
+boundary problem and awkward for tensors.  This codec replaces it with a
+length-delimited binary frame whose header is JSON and whose payload is a
+sequence of raw binary buffers (ndarrays carry explicit dtype/shape
+metadata, so JAX/NumPy arrays cross the wire zero-copy-ish and safely).
+Arbitrary Python objects are still supported — via an explicit, flagged
+pickle encoding that can be disabled per-channel (``allow_pickle=False``)
+without losing any of the framework's own message types, which are all
+JSON + buffers.
+
+Frame layout (all integers little-endian):
+
+    magic   4 bytes  b"NBD1"
+    hlen    u32      header length in bytes
+    plen    u64      payload length in bytes
+    header  hlen     UTF-8 JSON object
+    payload plen     concatenated buffers, in header["bufs"] order
+
+Header schema::
+
+    {
+      "id":   str,      # correlation id (uuid4 hex)
+      "type": str,      # message type, e.g. "execute", "response"
+      "rank": int,      # sender rank; -1 = coordinator
+      "ts":   float,    # sender wall-clock
+      "data": ...,      # JSON-able body (absent if enc == "pickle")
+      "enc":  "json" | "pickle",
+      "bufs": [{"name": str, "kind": "ndarray"|"bytes",
+                "dtype": str, "shape": [int...], "len": int}, ...]
+    }
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import struct
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+MAGIC = b"NBD1"
+_HEADER_FMT = "<4sIQ"
+HEADER_SIZE = struct.calcsize(_HEADER_FMT)  # 16 bytes
+
+# Coordinator sentinel rank (reference: communication.py:44 uses -1 too).
+COORDINATOR_RANK = -1
+
+
+class CodecError(Exception):
+    """Raised on malformed frames or disallowed encodings."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype-from-string that understands ml_dtypes extras (bfloat16 etc.)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class Message:
+    """Control-plane message envelope.
+
+    Mirrors the role of the reference's ``Message`` dataclass
+    (reference: communication.py:30-62) with two upgrades: binary buffer
+    attachments and an explicit encoding tag instead of ambient pickle.
+    """
+
+    msg_type: str
+    data: Any = None
+    rank: int = COORDINATOR_RANK
+    msg_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    timestamp: float = field(default_factory=time.time)
+    bufs: dict[str, Any] = field(default_factory=dict)  # name -> ndarray | bytes
+
+    def reply(self, msg_type: str = "response", data: Any = None,
+              rank: int = COORDINATOR_RANK,
+              bufs: dict[str, Any] | None = None) -> "Message":
+        """Build a response correlated to this message (echoes msg_id,
+        the pattern at reference: worker.py:224-233)."""
+        return Message(msg_type=msg_type, data=data, rank=rank,
+                       msg_id=self.msg_id, bufs=bufs or {})
+
+
+def _json_default(_obj: Any):
+    raise TypeError("not JSON-serializable")
+
+
+def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
+    """Serialize a Message to one wire frame."""
+    bufs: list[tuple[str, str, str, list[int], bytes]] = []
+    for name, value in msg.bufs.items():
+        if isinstance(value, (bytes, bytearray, memoryview)):
+            raw = bytes(value)
+            bufs.append((name, "bytes", "", [], raw))
+        else:
+            arr = np.asarray(value)
+            if not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr)
+            bufs.append((name, "ndarray", arr.dtype.name, list(arr.shape),
+                         arr.tobytes()))
+
+    header: dict[str, Any] = {
+        "id": msg.msg_id,
+        "type": msg.msg_type,
+        "rank": msg.rank,
+        "ts": msg.timestamp,
+    }
+
+    header["data"] = msg.data
+    header["enc"] = "json"
+    header["bufs"] = [
+        {"name": n, "kind": k, "dtype": d, "shape": s, "len": len(raw)}
+        for (n, k, d, s, raw) in bufs
+    ]
+    try:
+        hbytes = json.dumps(header, default=_json_default).encode("utf-8")
+    except TypeError:
+        if not allow_pickle:
+            raise CodecError(
+                f"message data of type {type(msg.data).__name__} is not "
+                "JSON-serializable and pickle is disabled on this channel")
+        del header["data"]
+        header["enc"] = "pickle"
+        pickled = pickle.dumps(msg.data, protocol=pickle.HIGHEST_PROTOCOL)
+        bufs.append(("__pickle__", "bytes", "", [], pickled))
+        header["bufs"].append({"name": "__pickle__", "kind": "bytes",
+                               "dtype": "", "shape": [], "len": len(pickled)})
+        hbytes = json.dumps(header).encode("utf-8")
+    payload = b"".join(raw for (_, _, _, _, raw) in bufs)
+    out = io.BytesIO()
+    out.write(struct.pack(_HEADER_FMT, MAGIC, len(hbytes), len(payload)))
+    out.write(hbytes)
+    out.write(payload)
+    return out.getvalue()
+
+
+def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
+    """Deserialize one wire frame produced by :func:`encode`."""
+    frame = memoryview(frame)
+    if len(frame) < HEADER_SIZE:
+        raise CodecError("short frame")
+    magic, hlen, plen = struct.unpack_from(_HEADER_FMT, frame, 0)
+    if magic != MAGIC:
+        raise CodecError(f"bad magic {magic!r}")
+    if len(frame) != HEADER_SIZE + hlen + plen:
+        raise CodecError("frame length mismatch")
+    try:
+        header = json.loads(bytes(frame[HEADER_SIZE:HEADER_SIZE + hlen]))
+    except json.JSONDecodeError as e:
+        raise CodecError(f"bad header: {e}") from e
+
+    payload = frame[HEADER_SIZE + hlen:]
+    bufs: dict[str, Any] = {}
+    off = 0
+    pickled: bytes | None = None
+    for desc in header.get("bufs", []):
+        raw = payload[off:off + desc["len"]]
+        off += desc["len"]
+        if desc["name"] == "__pickle__":
+            pickled = bytes(raw)
+            continue
+        if desc["kind"] == "ndarray":
+            arr = np.frombuffer(raw, dtype=_np_dtype(desc["dtype"]))
+            bufs[desc["name"]] = arr.reshape(desc["shape"])
+        else:
+            bufs[desc["name"]] = bytes(raw)
+
+    enc = header.get("enc", "json")
+    if enc == "pickle":
+        if not allow_pickle:
+            raise CodecError("received pickle-encoded message on a channel "
+                             "with pickle disabled")
+        if pickled is None:
+            raise CodecError("pickle-encoded message missing payload")
+        data = pickle.loads(pickled)
+    else:
+        data = header.get("data")
+
+    return Message(
+        msg_type=header["type"],
+        data=data,
+        rank=header["rank"],
+        msg_id=header["id"],
+        timestamp=header["ts"],
+        bufs=bufs,
+    )
+
+
+def frame_ready(buf: bytes | bytearray | memoryview) -> int:
+    """Return total frame size if ``buf`` starts with a complete frame,
+    else 0.  Used by incremental socket readers."""
+    if len(buf) < HEADER_SIZE:
+        return 0
+    magic, hlen, plen = struct.unpack_from(_HEADER_FMT, memoryview(buf), 0)
+    if magic != MAGIC:
+        raise CodecError(f"bad magic {magic!r}")
+    total = HEADER_SIZE + hlen + plen
+    return total if len(buf) >= total else 0

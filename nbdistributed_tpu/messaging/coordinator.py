@@ -1,0 +1,229 @@
+"""Coordinator-side control-plane manager.
+
+Equivalent of the reference's ``CommunicationManager``
+(reference: communication.py:65-389), rebuilt on the sockets transport with
+three structural fixes called out in SURVEY §7:
+
+1. **Per-request expectation sets.**  The reference's completion Event only
+   fires at full world size, forcing the subset path (``send_to_ranks``) to
+   busy-poll every 10 ms (reference: communication.py:348-359).  Here every
+   request carries its own expected-rank set and its own Event, so targeted
+   and broadcast requests share one wait path with no polling.
+2. **Fail-fast on worker death.**  With ``timeout=None`` the reference
+   blocks forever if a worker dies mid-request
+   (reference: communication.py:263-269).  The transport's disconnect
+   callback (and the process manager's child monitor, via
+   :meth:`mark_worker_dead`) abort all pending requests that still expect
+   the dead rank.
+3. **Readiness handshake.**  ``wait_for_workers`` observes HELLO
+   attachments, replacing the spawn-then-``sleep(2)`` race
+   (reference: process_manager.py:136-137).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from .codec import Message
+from .transport import CoordinatorListener, TransportError
+
+
+class WorkerDied(RuntimeError):
+    """A worker exited/disconnected while a request was pending on it."""
+
+
+class _Pending:
+    __slots__ = ("expect", "responses", "event", "failure")
+
+    def __init__(self, expect: set[int]):
+        self.expect = set(expect)
+        self.responses: dict[int, Message] = {}
+        self.event = threading.Event()
+        self.failure: Exception | None = None
+
+
+class CommunicationManager:
+    """Owns the control-plane listener and request/response correlation."""
+
+    def __init__(self, num_workers: int, *, host: str = "127.0.0.1",
+                 port: int = 0, timeout: float | None = None,
+                 allow_pickle: bool = True):
+        self.num_workers = num_workers
+        self.default_timeout = timeout  # None = wait forever (training mode)
+        self._listener = CoordinatorListener(host=host, port=port,
+                                             allow_pickle=allow_pickle)
+        self.port = self._listener.port
+        self._lock = threading.Lock()
+        self._pending: dict[str, _Pending] = {}
+        self._connected: set[int] = set()
+        self._dead: set[int] = set()
+        self._ready = threading.Event()
+        self._last_seen: dict[int, float] = {}
+        self._output_callback: Callable[[int, dict], None] | None = None
+        self._notify_callbacks: list[Callable[[int, Message], None]] = []
+        self._listener.on_message = self._on_message
+        self._listener.on_connect = self._on_connect
+        self._listener.on_disconnect = self._on_disconnect
+        self._listener.start()
+
+    # ------------------------------------------------------------------
+    # wiring
+
+    def set_output_callback(self, cb: Callable[[int, dict], None]) -> None:
+        """Register the streaming-output sink (reference:
+        communication.py:137-144).  Called from the IO thread — keep fast."""
+        self._output_callback = cb
+
+    def add_notify_callback(self, cb: Callable[[int, Message], None]) -> None:
+        """Register a sink for unsolicited non-stream messages
+        (heartbeats, profiler events, timeline marks)."""
+        self._notify_callbacks.append(cb)
+
+    # ------------------------------------------------------------------
+    # readiness / liveness
+
+    def wait_for_workers(self, timeout: float = 60.0) -> None:
+        """Block until all ``num_workers`` ranks have attached."""
+        if not self._ready.wait(timeout):
+            missing = sorted(set(range(self.num_workers)) - self._connected)
+            raise TimeoutError(
+                f"workers {missing} did not attach to the control plane "
+                f"within {timeout:.0f}s")
+
+    def connected_ranks(self) -> list[int]:
+        with self._lock:
+            return sorted(self._connected)
+
+    def last_seen(self, rank: int) -> float | None:
+        with self._lock:
+            return self._last_seen.get(rank)
+
+    def mark_worker_dead(self, rank: int) -> None:
+        """Called by the process monitor when a worker process exits.
+        Aborts every pending request still expecting this rank."""
+        with self._lock:
+            self._dead.add(rank)
+            pendings = [p for p in self._pending.values() if rank in p.expect
+                        and rank not in p.responses]
+        for p in pendings:
+            p.failure = WorkerDied(f"worker {rank} died while a request "
+                                   "was pending")
+            p.event.set()
+
+    # ------------------------------------------------------------------
+    # request/response
+
+    def send_to_all(self, msg_type: str, data: Any = None, *,
+                    bufs: dict | None = None,
+                    timeout: float | None = ...) -> dict[int, Message]:
+        return self.send_to_ranks(list(range(self.num_workers)), msg_type,
+                                  data, bufs=bufs, timeout=timeout)
+
+    def send_to_rank(self, rank: int, msg_type: str, data: Any = None, *,
+                     bufs: dict | None = None,
+                     timeout: float | None = ...) -> Message:
+        return self.send_to_ranks([rank], msg_type, data, bufs=bufs,
+                                  timeout=timeout)[rank]
+
+    def send_to_ranks(self, ranks: list[int], msg_type: str,
+                      data: Any = None, *, bufs: dict | None = None,
+                      timeout: float | None = ...) -> dict[int, Message]:
+        """Send one request to ``ranks`` and collect their responses.
+
+        ``timeout=...`` (unset) uses the manager default; ``None`` waits
+        forever — but still aborts if an expected worker dies.
+        """
+        if timeout is ...:
+            timeout = self.default_timeout
+        msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        pending = _Pending(set(ranks))
+        with self._lock:
+            already_dead = pending.expect & self._dead
+            self._pending[msg.msg_id] = pending
+        if already_dead:
+            with self._lock:
+                del self._pending[msg.msg_id]
+            raise WorkerDied(f"workers {sorted(already_dead)} are dead")
+        try:
+            self._listener.send_to_ranks(list(ranks), msg)
+            if not pending.event.wait(timeout):
+                with self._lock:  # IO thread inserts under the same lock
+                    got = set(pending.responses)
+                missing = sorted(pending.expect - got)
+                raise TimeoutError(
+                    f"no response from ranks {missing} within {timeout}s "
+                    f"for '{msg_type}'")
+            if pending.failure is not None:
+                raise pending.failure
+            with self._lock:
+                return dict(pending.responses)
+        finally:
+            with self._lock:
+                self._pending.pop(msg.msg_id, None)
+
+    def post(self, ranks: list[int], msg_type: str, data: Any = None, *,
+             bufs: dict | None = None) -> None:
+        """Fire-and-forget send (no response expected) — used for
+        shutdown-style messages where the reference tolerates silence
+        (reference: worker.py:205-206 sends no shutdown response)."""
+        msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        try:
+            self._listener.send_to_ranks(list(ranks), msg)
+        except TransportError:
+            pass
+
+    # ------------------------------------------------------------------
+    # IO-thread callbacks
+
+    def _on_connect(self, rank: int) -> None:
+        with self._lock:
+            self._connected.add(rank)
+            self._dead.discard(rank)
+            self._last_seen[rank] = time.time()
+            all_in = len(self._connected) >= self.num_workers
+        if all_in:
+            self._ready.set()
+
+    def _on_disconnect(self, rank: int) -> None:
+        with self._lock:
+            self._connected.discard(rank)
+        self.mark_worker_dead(rank)
+
+    def _on_message(self, rank: int, msg: Message) -> None:
+        with self._lock:
+            self._last_seen[rank] = time.time()
+        if msg.msg_type == "stream_output":
+            # Routed straight to the display callback, never queued
+            # (reference: communication.py:174-184).
+            cb = self._output_callback
+            if cb is not None:
+                try:
+                    cb(rank, msg.data)
+                except Exception:
+                    pass
+            return
+        if msg.msg_type == "response":
+            with self._lock:
+                pending = self._pending.get(msg.msg_id)
+                if pending is None:
+                    return  # late response to a timed-out request
+                pending.responses[rank] = msg
+                complete = set(pending.responses) >= pending.expect
+            if complete:
+                pending.event.set()
+            return
+        if msg.msg_type == "ping":
+            return  # liveness only; already recorded last_seen
+        for cb in self._notify_callbacks:
+            try:
+                cb(rank, msg)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Tear down the listener (reference: communication.py:372-389)."""
+        self._listener.close()

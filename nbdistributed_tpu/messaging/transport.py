@@ -1,0 +1,337 @@
+"""TCP transport for the control plane.
+
+The reference uses ZMQ ROUTER (coordinator) / DEALER (worker) sockets with
+identity strings ``worker_{rank}`` (reference: communication.py:124-125,
+worker.py:154-157).  This module provides the same topology on plain
+sockets: a :class:`CoordinatorListener` accepts one connection per worker
+and routes frames by the rank announced in an initial HELLO frame, and a
+:class:`WorkerChannel` is the worker-side dial-out.
+
+Differences from the reference, by design:
+
+* **Explicit readiness**: the HELLO handshake makes worker attachment an
+  observable event, replacing the reference's ``sleep(2)`` + ZMQ late-join
+  buffering (reference: process_manager.py:136-150, SURVEY §7 "hard parts").
+* **Single poller, no busy loop**: the coordinator reader thread blocks in
+  ``selector.select()`` instead of polling every 100 ms
+  (reference: communication.py:170), so round-trip latency is wire-bound.
+* **Disconnect notifications**: worker socket death is surfaced via
+  ``on_disconnect`` so pending requests can fail fast instead of hanging
+  forever in no-timeout mode (reference: communication.py:263-269).
+
+A C++ fast-path transport with the same interface can be slotted in via
+:mod:`nbdistributed_tpu.messaging.native` when built (see native/).
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import struct
+import threading
+from typing import Callable
+
+from .codec import CodecError, Message, decode, encode, frame_ready
+
+_HELLO_TYPE = "__hello__"
+
+
+class TransportError(Exception):
+    pass
+
+
+def _set_keepalive(sock: socket.socket) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+
+
+class _ConnState:
+    """Per-connection incremental read buffer + locked writer."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.wlock = threading.Lock()
+        self.rank: int | None = None  # set after HELLO
+
+    def send_frame(self, frame: bytes) -> None:
+        """Write the whole frame even on a non-blocking socket.
+
+        Coordinator-side sockets are non-blocking (the IO thread selects
+        on them for reads), so a plain ``sendall`` of a frame larger than
+        the kernel buffer would raise mid-write and tear the stream.
+        Writes happen on caller threads, so blocking in ``select`` for
+        writability here is safe.
+        """
+        import select as _select
+
+        view = memoryview(frame)
+        with self.wlock:
+            while view:
+                try:
+                    n = self.sock.send(view)
+                except (BlockingIOError, InterruptedError):
+                    _select.select([], [self.sock], [], 1.0)
+                    continue
+                view = view[n:]
+
+    def feed(self, data: bytes) -> list[bytes]:
+        """Append received bytes; return complete frames."""
+        self.rbuf.extend(data)
+        frames: list[bytes] = []
+        while True:
+            n = frame_ready(self.rbuf)
+            if not n:
+                return frames
+            frames.append(bytes(self.rbuf[:n]))
+            del self.rbuf[:n]
+
+
+class CoordinatorListener:
+    """Accepts worker connections and routes frames by rank.
+
+    ZMQ-ROUTER analog (reference: communication.py:95-135) with explicit
+    connection tracking.  All callbacks run on the single reader thread;
+    they must not block.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 allow_pickle: bool = True):
+        self._allow_pickle = allow_pickle
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(128)
+        self.host, self.port = self._server.getsockname()
+        self._sel = selectors.DefaultSelector()
+        self._conns: dict[int, _ConnState] = {}  # rank -> conn
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.on_message: Callable[[int, Message], None] = lambda r, m: None
+        self.on_connect: Callable[[int], None] = lambda r: None
+        self.on_disconnect: Callable[[int], None] = lambda r: None
+        # wake-up pipe so close() interrupts select()
+        self._wake_r, self._wake_w = socket.socketpair()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._server.setblocking(False)
+        self._sel.register(self._server, selectors.EVENT_READ, ("accept", None))
+        self._sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        self._thread = threading.Thread(target=self._loop,
+                                        name="nbd-coordinator-io", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=2)
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        for s in (self._server, self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- sending -----------------------------------------------------------
+
+    def connected_ranks(self) -> list[int]:
+        with self._lock:
+            return sorted(self._conns)
+
+    def send_to_rank(self, rank: int, msg: Message) -> None:
+        with self._lock:
+            conn = self._conns.get(rank)
+        if conn is None:
+            raise TransportError(f"rank {rank} is not connected")
+        conn.send_frame(encode(msg, allow_pickle=self._allow_pickle))
+
+    def send_to_ranks(self, ranks: list[int], msg: Message) -> None:
+        frame = encode(msg, allow_pickle=self._allow_pickle)
+        missing = []
+        with self._lock:
+            conns = [(r, self._conns.get(r)) for r in ranks]
+        for r, conn in conns:
+            if conn is None:
+                missing.append(r)
+            else:
+                conn.send_frame(frame)
+        if missing:
+            raise TransportError(f"ranks {missing} are not connected")
+
+    # -- reader loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        unidentified: dict[socket.socket, _ConnState] = {}
+        while self._running:
+            try:
+                events = self._sel.select(timeout=1.0)
+            except OSError:
+                if not self._running:
+                    return
+                raise
+            for key, _ in events:
+                tag, conn = key.data
+                if tag == "wake":
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                elif tag == "accept":
+                    try:
+                        sock, _addr = self._server.accept()
+                    except OSError:
+                        continue
+                    _set_keepalive(sock)
+                    sock.setblocking(False)
+                    st = _ConnState(sock)
+                    unidentified[sock] = st
+                    self._sel.register(sock, selectors.EVENT_READ, ("conn", st))
+                else:
+                    self._service(conn, unidentified)
+
+    def _service(self, conn: _ConnState, unidentified: dict) -> None:
+        try:
+            data = conn.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self._drop(conn, unidentified)
+            return
+        try:
+            frames = conn.feed(data)
+        except CodecError:
+            self._drop(conn, unidentified)
+            return
+        for frame in frames:
+            try:
+                msg = decode(frame, allow_pickle=self._allow_pickle)
+            except CodecError:
+                continue
+            if conn.rank is None:
+                if msg.msg_type != _HELLO_TYPE:
+                    continue  # protocol violation; wait for hello
+                conn.rank = msg.rank
+                unidentified.pop(conn.sock, None)
+                with self._lock:
+                    old = self._conns.get(conn.rank)
+                    self._conns[conn.rank] = conn
+                if old is not None:
+                    # Replaced by a reconnect: detach the stale socket from
+                    # the selector too, and mark it non-current so a late
+                    # EOF on it does not fire on_disconnect for a live rank.
+                    old.rank = None
+                    try:
+                        self._sel.unregister(old.sock)
+                    except (KeyError, ValueError):
+                        pass
+                    try:
+                        old.sock.close()
+                    except OSError:
+                        pass
+                self.on_connect(conn.rank)
+            else:
+                self.on_message(conn.rank, msg)
+
+    def _drop(self, conn: _ConnState, unidentified: dict) -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        unidentified.pop(conn.sock, None)
+        if conn.rank is not None:
+            with self._lock:
+                is_current = self._conns.get(conn.rank) is conn
+                if is_current:
+                    del self._conns[conn.rank]
+            # Only report disconnect for the rank's *current* connection —
+            # a late EOF on a connection already replaced by a reconnect
+            # must not mark the live worker dead.
+            if is_current:
+                self.on_disconnect(conn.rank)
+
+
+class WorkerChannel:
+    """Worker-side control-plane connection (ZMQ-DEALER analog,
+    reference: worker.py:154-157).
+
+    ``recv()`` is blocking and intended for the worker's serial message
+    loop (reference: worker.py:200-246); ``send()`` is thread-safe so the
+    stdout streamer and heartbeat thread can push concurrently
+    (reference: worker.py:43 uses a lock for the same reason).
+    """
+
+    def __init__(self, host: str, port: int, rank: int, *,
+                 allow_pickle: bool = True, connect_timeout: float = 30.0):
+        self.rank = rank
+        self._allow_pickle = allow_pickle
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        _set_keepalive(self._sock)
+        self._wlock = threading.Lock()
+        self._rbuf = bytearray()
+        self.send(Message(msg_type=_HELLO_TYPE, rank=rank))
+
+    def send(self, msg: Message) -> None:
+        frame = encode(msg, allow_pickle=self._allow_pickle)
+        with self._wlock:
+            self._sock.sendall(frame)
+
+    def recv(self, timeout: float | None = None) -> Message:
+        """Block until one complete frame arrives; raise TransportError on
+        EOF (coordinator gone), TimeoutError on timeout.
+
+        The timeout is implemented with ``select`` rather than
+        ``settimeout`` so the socket object's blocking mode is never
+        mutated — concurrent ``send()`` from the stdout-streamer or
+        heartbeat thread must not inherit a read deadline mid-write.
+        """
+        import select as _select
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            n = frame_ready(self._rbuf)
+            if n:
+                frame = bytes(self._rbuf[:n])
+                del self._rbuf[:n]
+                return decode(frame, allow_pickle=self._allow_pickle)
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("recv timed out")
+                readable, _, _ = _select.select([self._sock], [], [],
+                                                remaining)
+                if not readable:
+                    raise TimeoutError("recv timed out")
+            data = self._sock.recv(1 << 20)
+            if not data:
+                raise TransportError("coordinator closed connection")
+            self._rbuf.extend(data)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

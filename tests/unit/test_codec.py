@@ -1,0 +1,103 @@
+"""Unit tests for the wire codec (nbdistributed_tpu/messaging/codec.py)."""
+
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.messaging.codec import (
+    CodecError, Message, decode, encode, frame_ready)
+
+
+def roundtrip(msg, **kw):
+    return decode(encode(msg, **kw), **kw)
+
+
+def test_json_roundtrip():
+    m = Message(msg_type="execute", data={"code": "x = 1"}, rank=-1)
+    out = roundtrip(m)
+    assert out.msg_type == "execute"
+    assert out.data == {"code": "x = 1"}
+    assert out.rank == -1
+    assert out.msg_id == m.msg_id
+
+
+def test_ndarray_buffer_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    m = Message(msg_type="var", data={"name": "w"}, bufs={"w": arr})
+    out = roundtrip(m)
+    np.testing.assert_array_equal(out.bufs["w"], arr)
+    assert out.bufs["w"].dtype == np.float32
+
+
+def test_bfloat16_buffer_roundtrip():
+    import ml_dtypes
+    arr = np.ones((4, 4), dtype=ml_dtypes.bfloat16)
+    m = Message(msg_type="var", bufs={"w": arr})
+    out = roundtrip(m)
+    assert out.bufs["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        out.bufs["w"].astype(np.float32), arr.astype(np.float32))
+
+
+def test_bytes_buffer_roundtrip():
+    m = Message(msg_type="blob", bufs={"b": b"\x00\x01\xff"})
+    assert roundtrip(m).bufs["b"] == b"\x00\x01\xff"
+
+
+class Custom:
+    def __eq__(self, other):
+        return isinstance(other, Custom)
+
+    def __hash__(self):
+        return 0
+
+
+def test_pickle_fallback_flagged():
+    m = Message(msg_type="set_var", data={"name": "o", "value": Custom()})
+    out = roundtrip(m, allow_pickle=True)
+    assert out.data["value"] == Custom()
+
+
+def test_pickle_disabled_raises_on_encode():
+    m = Message(msg_type="set_var", data=object())
+    with pytest.raises(CodecError):
+        encode(m, allow_pickle=False)
+
+
+def test_pickle_disabled_raises_on_decode():
+    m = Message(msg_type="set_var", data=object())
+    frame = encode(m, allow_pickle=True)
+    with pytest.raises(CodecError):
+        decode(frame, allow_pickle=False)
+
+
+def test_reply_correlates_msg_id():
+    req = Message(msg_type="execute", data="code")
+    resp = req.reply(data={"status": "ok"}, rank=3)
+    assert resp.msg_id == req.msg_id
+    assert resp.msg_type == "response"
+    assert resp.rank == 3
+
+
+def test_frame_ready_incremental():
+    m = Message(msg_type="x", data=[1, 2, 3])
+    frame = encode(m)
+    for cut in (0, 4, 10, len(frame) - 1):
+        assert frame_ready(frame[:cut]) == 0
+    assert frame_ready(frame) == len(frame)
+    assert frame_ready(frame + b"extra") == len(frame)
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(CodecError):
+        frame_ready(b"EVIL" + b"\x00" * 20)
+    with pytest.raises(CodecError):
+        decode(b"EVIL" + b"\x00" * 20)
+
+
+def test_multiple_buffers_order_preserved():
+    a = np.zeros(3, np.int64)
+    b = np.ones((2, 2), np.float64)
+    out = roundtrip(Message(msg_type="vars", bufs={"a": a, "b": b, "c": b"z"}))
+    np.testing.assert_array_equal(out.bufs["a"], a)
+    np.testing.assert_array_equal(out.bufs["b"], b)
+    assert out.bufs["c"] == b"z"

@@ -1,0 +1,153 @@
+"""Unit tests for request/response correlation in CommunicationManager.
+
+Exercises the coordinator against scripted in-process worker channels —
+no JAX, no subprocesses (the reference never had tests at this layer at
+all; SURVEY §4).
+"""
+
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.messaging import (
+    CommunicationManager, Message, WorkerChannel, WorkerDied)
+
+
+class ScriptedWorker:
+    """Minimal worker loop: answers every request via a handler fn."""
+
+    def __init__(self, port, rank, handler):
+        self.chan = WorkerChannel("127.0.0.1", port, rank=rank)
+        self.rank = rank
+        self.handler = handler
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                msg = self.chan.recv()
+            except Exception:
+                return
+            if msg.msg_type == "__stop__":
+                return
+            out = self.handler(self.rank, msg)
+            if out is not None:
+                self.chan.send(msg.reply(data=out, rank=self.rank))
+
+    def close(self):
+        self.chan.close()
+
+
+@pytest.fixture
+def world():
+    mgr = CommunicationManager(num_workers=3, timeout=10)
+    workers = [ScriptedWorker(mgr.port, r, lambda rank, m: {"echo": m.data,
+                                                            "rank": rank})
+               for r in range(3)]
+    mgr.wait_for_workers(timeout=10)
+    yield mgr, workers
+    for w in workers:
+        w.close()
+    mgr.shutdown()
+
+
+def test_broadcast_collects_all(world):
+    mgr, _ = world
+    out = mgr.send_to_all("execute", "code")
+    assert sorted(out) == [0, 1, 2]
+    assert out[1].data == {"echo": "code", "rank": 1}
+
+
+def test_subset_request_no_fullworld_wait(world):
+    """Targeted requests complete from subset responses alone (the
+    reference busy-polled here, communication.py:348-359)."""
+    mgr, _ = world
+    t0 = time.time()
+    out = mgr.send_to_ranks([0, 2], "execute", "x")
+    assert sorted(out) == [0, 2]
+    assert time.time() - t0 < 5
+
+
+def test_single_rank(world):
+    mgr, _ = world
+    msg = mgr.send_to_rank(1, "status")
+    assert msg.data["rank"] == 1
+
+
+def test_timeout_lists_missing_ranks():
+    mgr = CommunicationManager(num_workers=2, timeout=0.3)
+    # rank 0 answers, rank 1 stays silent
+    w0 = ScriptedWorker(mgr.port, 0, lambda r, m: {"ok": True})
+    w1 = ScriptedWorker(mgr.port, 1, lambda r, m: None)
+    mgr.wait_for_workers(timeout=10)
+    with pytest.raises(TimeoutError, match=r"\[1\]"):
+        mgr.send_to_all("execute", "x")
+    w0.close(); w1.close(); mgr.shutdown()
+
+
+def test_worker_death_aborts_pending_request():
+    """No-timeout mode must not hang when a worker dies (the reference
+    hangs forever: communication.py:263-269)."""
+    mgr = CommunicationManager(num_workers=2, timeout=None)
+    w0 = ScriptedWorker(mgr.port, 0, lambda r, m: {"ok": True})
+    slow_release = threading.Event()
+    def slow_handler(r, m):
+        slow_release.wait(30)
+        return {"ok": True}
+    w1 = ScriptedWorker(mgr.port, 1, slow_handler)
+    mgr.wait_for_workers(timeout=10)
+
+    def kill_soon():
+        time.sleep(0.3)
+        w1.close()  # socket drop == process death from coordinator's view
+    threading.Thread(target=kill_soon, daemon=True).start()
+    t0 = time.time()
+    with pytest.raises(WorkerDied):
+        mgr.send_to_all("execute", "x")
+    assert time.time() - t0 < 10
+    slow_release.set()
+    w0.close(); mgr.shutdown()
+
+
+def test_request_to_known_dead_worker_fails_fast():
+    mgr = CommunicationManager(num_workers=1, timeout=None)
+    w0 = ScriptedWorker(mgr.port, 0, lambda r, m: {"ok": True})
+    mgr.wait_for_workers(timeout=10)
+    w0.close()
+    deadline = time.time() + 5
+    while 0 in mgr.connected_ranks() and time.time() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(WorkerDied):
+        mgr.send_to_all("execute", "x")
+    mgr.shutdown()
+
+
+def test_stream_output_routed_to_callback(world):
+    mgr, workers = world
+    got = []
+    mgr.set_output_callback(lambda rank, data: got.append((rank, data)))
+    workers[2].chan.send(Message(
+        msg_type="stream_output", rank=2,
+        data={"text": "hello\n", "stream": "stdout"}))
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    assert got == [(2, {"text": "hello\n", "stream": "stdout"})]
+
+
+def test_late_response_after_timeout_is_dropped():
+    mgr = CommunicationManager(num_workers=1, timeout=0.2)
+    delay = 0.6
+    def slow(r, m):
+        time.sleep(delay)
+        return {"late": True}
+    w0 = ScriptedWorker(mgr.port, 0, slow)
+    mgr.wait_for_workers(timeout=10)
+    with pytest.raises(TimeoutError):
+        mgr.send_to_all("execute", "x")
+    time.sleep(delay)  # late reply arrives, must be silently dropped
+    out = mgr.send_to_all("execute", "y", timeout=5)
+    assert out[0].data == {"late": True}
+    w0.close(); mgr.shutdown()

@@ -1,0 +1,108 @@
+"""Unit tests for the TCP transport (coordinator listener + worker channel)."""
+
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.messaging.codec import Message
+from nbdistributed_tpu.messaging.transport import (
+    CoordinatorListener, TransportError, WorkerChannel)
+
+
+@pytest.fixture
+def listener():
+    lst = CoordinatorListener()
+    received = []
+    connected = []
+    disconnected = []
+    lst.on_message = lambda r, m: received.append((r, m))
+    lst.on_connect = connected.append
+    lst.on_disconnect = disconnected.append
+    lst.start()
+    lst.received, lst.connected, lst.disconnected = (
+        received, connected, disconnected)
+    yield lst
+    lst.close()
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_hello_identifies_rank(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=7)
+    assert wait_until(lambda: listener.connected == [7])
+    assert listener.connected_ranks() == [7]
+    ch.close()
+    assert wait_until(lambda: listener.disconnected == [7])
+
+
+def test_bidirectional_messages(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: 0 in listener.connected)
+    ch.send(Message(msg_type="response", data={"out": "hi"}, rank=0))
+    assert wait_until(lambda: len(listener.received) == 1)
+    rank, msg = listener.received[0]
+    assert rank == 0 and msg.data == {"out": "hi"}
+
+    listener.send_to_rank(0, Message(msg_type="execute", data="1+1"))
+    got = ch.recv(timeout=5)
+    assert got.msg_type == "execute" and got.data == "1+1"
+    ch.close()
+
+
+def test_send_to_unknown_rank_raises(listener):
+    with pytest.raises(TransportError):
+        listener.send_to_rank(99, Message(msg_type="x"))
+
+
+def test_multiple_workers_routing(listener):
+    chans = [WorkerChannel("127.0.0.1", listener.port, rank=r)
+             for r in range(4)]
+    assert wait_until(lambda: len(listener.connected) == 4)
+    listener.send_to_ranks([1, 3], Message(msg_type="go"))
+    assert chans[1].recv(timeout=5).msg_type == "go"
+    assert chans[3].recv(timeout=5).msg_type == "go"
+    # ranks 0 and 2 got nothing
+    with pytest.raises(TimeoutError):
+        chans[0].recv(timeout=0.2)
+    for c in chans:
+        c.close()
+
+
+def test_large_frame(listener):
+    import numpy as np
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: 0 in listener.connected)
+    big = np.random.default_rng(0).standard_normal((512, 512)).astype("float32")
+    ch.send(Message(msg_type="response", rank=0, bufs={"t": big}))
+    assert wait_until(lambda: len(listener.received) == 1)
+    _, msg = listener.received[0]
+    np.testing.assert_array_equal(msg.bufs["t"], big)
+    ch.close()
+
+
+def test_concurrent_sends_no_interleave(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: 0 in listener.connected)
+    n_threads, per = 8, 25
+    def blast(tid):
+        for i in range(per):
+            ch.send(Message(msg_type="response", rank=0,
+                            data={"tid": tid, "i": i}))
+    threads = [threading.Thread(target=blast, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert wait_until(lambda: len(listener.received) == n_threads * per)
+    seen = {(m.data["tid"], m.data["i"]) for _, m in listener.received}
+    assert len(seen) == n_threads * per
+    ch.close()
