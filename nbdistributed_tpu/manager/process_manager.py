@@ -1,0 +1,220 @@
+"""Worker process lifecycle: spawn, monitor, tiered kill.
+
+Rebuild of the reference's ``ProcessManager`` (reference:
+process_manager.py:23-374) with the startup race fixed: instead of
+``sleep(2)`` + hope (reference: process_manager.py:136-137), readiness is
+the worker's control-plane HELLO, observed via
+``CommunicationManager.wait_for_workers`` while this module concurrently
+watches for early child death and surfaces captured stdio on failure
+(reference collects stdio the same way: process_manager.py:138-150).
+
+A monitor thread reports any child death to the communication manager so
+pending requests fail fast instead of hanging (SURVEY §5.3 notes the
+reference hangs forever on a dead worker in no-timeout mode).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from . import topology
+
+
+def find_free_port() -> int:
+    """Bind-to-zero port discovery (reference: process_manager.py:154-175)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _ChildIO:
+    """Drains a child's merged stdout/stderr into a bounded ring buffer so
+    early-death diagnostics are available without risking pipe stalls."""
+
+    def __init__(self, proc: subprocess.Popen, rank: int):
+        self.lines: deque[str] = deque(maxlen=400)
+        self._thread = threading.Thread(
+            target=self._drain, args=(proc,),
+            name=f"nbd-worker-{rank}-io", daemon=True)
+        self._thread.start()
+
+    def _drain(self, proc: subprocess.Popen) -> None:
+        try:
+            for line in proc.stdout:  # type: ignore[union-attr]
+                self.lines.append(line.decode("utf-8", "replace")
+                                  if isinstance(line, bytes) else line)
+        except ValueError:
+            pass  # stream closed during shutdown
+
+    def tail(self, n: int = 40) -> str:
+        return "".join(list(self.lines)[-n:])
+
+
+class ProcessManager:
+    def __init__(self):
+        self.processes: dict[int, subprocess.Popen] = {}
+        self.io: dict[int, _ChildIO] = {}
+        self.backend: str | None = None
+        self.world_size = 0
+        self.dist_port: int | None = None
+        self._monitor_thread: threading.Thread | None = None
+        self._monitor_stop = threading.Event()
+        self._death_callbacks: list[Callable[[int, int | None], None]] = []
+        self._reported_dead: set[int] = set()
+
+    # ------------------------------------------------------------------
+
+    def add_death_callback(self, cb: Callable[[int, int | None], None]) -> None:
+        """cb(rank, returncode) — invoked once per dead worker by the
+        monitor thread."""
+        self._death_callbacks.append(cb)
+
+    def start_workers(self, num_workers: int, control_port: int, *,
+                      backend: str = "auto", coordinator_host: str = "127.0.0.1",
+                      chips_per_worker: int = 1,
+                      extra_env: dict | None = None) -> None:
+        """Spawn ``num_workers`` worker processes.
+
+        The caller (magic layer) pairs this with
+        ``CommunicationManager.wait_for_workers``; use
+        :meth:`check_startup_failure` inside that wait loop to convert an
+        early child death into a diagnostic error instead of a timeout.
+        """
+        if self.processes:
+            raise RuntimeError("workers already running; shutdown first")
+        if backend == "auto":
+            backend = topology.detect_backend()
+        self.backend = backend
+        self.world_size = num_workers
+        self.dist_port = find_free_port() if num_workers > 1 else None
+
+        for rank in range(num_workers):
+            env = topology.worker_env(rank, num_workers, backend,
+                                      chips_per_worker=chips_per_worker)
+            if extra_env:
+                env.update(extra_env)
+            cmd = [sys.executable, "-m", "nbdistributed_tpu.runtime.worker",
+                   "--rank", str(rank), "--world-size", str(num_workers),
+                   "--coordinator-host", coordinator_host,
+                   "--control-port", str(control_port),
+                   "--backend", backend]
+            if self.dist_port is not None:
+                cmd += ["--dist-port", str(self.dist_port)]
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True,  # own pgid for group kill
+                cwd=os.getcwd())
+            self.processes[rank] = proc
+            self.io[rank] = _ChildIO(proc, rank)
+
+        self._monitor_stop.clear()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, name="nbd-child-monitor", daemon=True)
+        self._monitor_thread.start()
+
+    # ------------------------------------------------------------------
+
+    def _monitor(self) -> None:
+        """Watch children; report deaths (reference's is_running prunes
+        as a side effect instead: process_manager.py:229-258)."""
+        while not self._monitor_stop.wait(0.25):
+            for rank, proc in list(self.processes.items()):
+                rc = proc.poll()
+                if rc is not None and rank not in self._reported_dead:
+                    self._reported_dead.add(rank)
+                    for cb in self._death_callbacks:
+                        try:
+                            cb(rank, rc)
+                        except Exception:
+                            pass
+
+    def check_startup_failure(self) -> None:
+        """Raise with captured stdio if any worker died during bring-up
+        (reference: process_manager.py:138-150)."""
+        for rank, proc in self.processes.items():
+            rc = proc.poll()
+            if rc is not None:
+                raise RuntimeError(
+                    f"worker {rank} exited with code {rc} during startup.\n"
+                    f"--- worker {rank} output ---\n{self.io[rank].tail()}")
+
+    def is_running(self) -> bool:
+        return any(p.poll() is None for p in self.processes.values())
+
+    def alive_ranks(self) -> list[int]:
+        return sorted(r for r, p in self.processes.items()
+                      if p.poll() is None)
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self, *, term_grace_s: float = 3.0,
+                 kill_grace_s: float = 2.0) -> None:
+        """SIGTERM → wait → SIGKILL → wait, per process group
+        (reference: process_manager.py:177-227)."""
+        self._monitor_stop.set()
+        procs = list(self.processes.items())
+        for _rank, proc in procs:
+            if proc.poll() is None:
+                self._signal_group(proc, signal.SIGTERM)
+        self._wait_all(procs, term_grace_s)
+        for _rank, proc in procs:
+            if proc.poll() is None:
+                self._signal_group(proc, signal.SIGKILL)
+        remaining = self._wait_all(procs, kill_grace_s)
+        for rank, proc in remaining:
+            print(f"warning: worker {rank} (pid {proc.pid}) survived "
+                  "SIGKILL", file=sys.stderr)
+        for _rank, proc in procs:
+            if proc.stdout:
+                try:
+                    proc.stdout.close()
+                except OSError:
+                    pass
+        self.processes.clear()
+        self.io.clear()
+        self._reported_dead.clear()
+        self.world_size = 0
+
+    @staticmethod
+    def _signal_group(proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    @staticmethod
+    def _wait_all(procs, grace_s: float):
+        deadline = time.time() + grace_s
+        pending = [(r, p) for r, p in procs if p.poll() is None]
+        while pending and time.time() < deadline:
+            time.sleep(0.05)
+            pending = [(r, p) for r, p in pending if p.poll() is None]
+        return pending
+
+    # ------------------------------------------------------------------
+
+    def get_status(self) -> dict[int, dict]:
+        """Process-level status (reference: process_manager.py:260-295);
+        live device details come from the workers over the control plane
+        via the magic layer's %dist_status."""
+        out = {}
+        for rank, proc in self.processes.items():
+            rc = proc.poll()
+            out[rank] = {
+                "pid": proc.pid,
+                "running": rc is None,
+                "returncode": rc,
+                "backend": self.backend,
+            }
+        return out

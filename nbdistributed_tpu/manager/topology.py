@@ -1,0 +1,97 @@
+"""Per-rank environment construction: backend + TPU topology assignment.
+
+The reference assigns one CUDA GPU per rank via an explicit id list with
+modulo recycling (reference: process_manager.py:107-112) and lets the
+worker pin it (reference: worker.py:135-144).  On TPU the analog is chip
+*partitioning*: a single host's chips are split among worker processes
+with the TPU runtime's process-bounds environment, so each worker's JAX
+sees only its own chip(s) and ``jax.distributed`` stitches them into one
+world over ICI.
+
+Also owns the CPU-backend env used by tests/CI — the analog of the
+reference's CUDA→Gloo fallback (reference: worker.py:146-149): cross-
+process gloo collectives give a real multi-process world on any box.
+"""
+
+from __future__ import annotations
+
+import os
+
+# v5e single-host chip grids by chip count (x, y); z is always 1 on v5e.
+_V5E_GRIDS = {1: (1, 1), 2: (1, 2), 4: (2, 2), 8: (2, 4)}
+
+
+def cpu_worker_env(base: dict | None = None) -> dict:
+    """Env for a CPU-backend worker: force the CPU platform and gloo
+    cross-process collectives; neutralize the container's TPU
+    sitecustomize (which would otherwise grab the axon TPU platform in
+    every python process)."""
+    env = dict(base if base is not None else os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+    return env
+
+
+def tpu_worker_env(rank: int, world_size: int, *,
+                   chips_per_worker: int = 1,
+                   tpu_process_base_port: int = 8476,
+                   base: dict | None = None) -> dict:
+    """Env for a TPU worker owning ``chips_per_worker`` chips of a
+    single-host slice (v5e-8 style).
+
+    Uses the TPU runtime's standard multi-process-per-host contract:
+    ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` carve the
+    chip grid, ``TPU_VISIBLE_CHIPS`` pins this worker's chips, and
+    ``TPU_PROCESS_ADDRESSES`` lists every worker's TPU-runtime port.
+    Multi-host pods need per-host launch instead (SURVEY §5.8 notes the
+    reference has the same single-node assumption at worker.py:129).
+    """
+    env = dict(base if base is not None else os.environ)
+    total_chips = world_size * chips_per_worker
+    if chips_per_worker == 1:
+        grid = _V5E_GRIDS.get(total_chips)
+        if grid is None:
+            raise ValueError(
+                f"unsupported single-host chip count {total_chips}; "
+                f"supported: {sorted(_V5E_GRIDS)}")
+        px, py = grid
+        env["TPU_PROCESS_BOUNDS"] = f"{px},{py},1"
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        env["TPU_VISIBLE_CHIPS"] = str(rank)
+    else:
+        # One worker spanning several chips (e.g. 2 workers x 4 chips).
+        env["TPU_PROCESS_BOUNDS"] = f"1,{world_size},1"
+        cx, cy = _V5E_GRIDS.get(chips_per_worker, (1, chips_per_worker))
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{cx},{cy},1"
+        first = rank * chips_per_worker
+        env["TPU_VISIBLE_CHIPS"] = ",".join(
+            str(first + i) for i in range(chips_per_worker))
+    env["TPU_PROCESS_ADDRESSES"] = ",".join(
+        f"localhost:{tpu_process_base_port + r}" for r in range(world_size))
+    env["TPU_PROCESS_PORT"] = str(tpu_process_base_port + rank)
+    env["CLOUD_TPU_TASK_ID"] = str(rank)
+    return env
+
+
+def worker_env(rank: int, world_size: int, backend: str, *,
+               chips_per_worker: int = 1, base: dict | None = None) -> dict:
+    if backend == "cpu":
+        return cpu_worker_env(base)
+    if backend == "tpu":
+        return tpu_worker_env(rank, world_size,
+                              chips_per_worker=chips_per_worker, base=base)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def detect_backend() -> str:
+    """'tpu' if this host has TPU chips, else 'cpu'.  Checked without
+    initializing JAX in the coordinator (device probes are the workers'
+    job): the TPU runtime's device nodes are the cheap signal."""
+    for probe in ("/dev/accel0", "/dev/vfio/0"):
+        if os.path.exists(probe):
+            return "tpu"
+    if os.environ.get("PALLAS_AXON_POOL_IPS"):  # axon-tunneled TPU
+        return "tpu"
+    return "cpu"

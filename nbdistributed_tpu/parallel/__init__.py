@@ -1,0 +1,2 @@
+"""Parallelism library: interactive collectives, mesh helpers, and the
+DP/TP/SP building blocks seeded into worker namespaces (SURVEY §2.3)."""

@@ -1,0 +1,6 @@
+"""Worker runtime (layer L1, SURVEY §1): REPL executor, namespace
+introspection, per-rank worker process."""
+
+from .executor import execute_cell
+
+__all__ = ["execute_cell"]

@@ -1,0 +1,145 @@
+"""REPL cell executor: the 3-path AST strategy.
+
+Reimplements the execution semantics of the reference's
+``_execute_code_streaming`` (reference: worker.py:248-387) as a pure,
+unit-testable function:
+
+(a) the whole cell parses as a single expression  -> ``eval`` it;
+(b) it parses as statements whose last node is an ``ast.Expr``
+    -> ``exec`` everything but the last, then ``eval`` the last
+    (reference: worker.py:319-333);
+(c) otherwise -> plain ``exec`` (reference: worker.py:365-373).
+
+A non-None final value is ``repr()``-ed, pushed through the stream hook
+with stream kind ``"result"`` (reference: worker.py:291-304) and included
+in the returned output. Objects never leave the worker from this path —
+strings only (reference: worker.py:313-314). The namespace dict is the
+exec globals, so state persists across cells (reference: worker.py:284).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import sys
+import time
+import traceback
+from typing import Any, Callable
+
+StreamFn = Callable[[str, str], None]  # (text, stream_kind) -> None
+
+
+class _StreamingStdout(io.TextIOBase):
+    """stdout replacement that pushes output through ``stream_fn`` and
+    mirrors into a buffer for the final response (reference:
+    worker.py:30-69).
+
+    Unlike the reference — which ships one control-plane message per
+    ``write()`` call, so ``print('a', 1)`` costs four sends (SURVEY §3.2
+    flags this as a hot loop) — pushes are line-buffered: complete lines
+    stream immediately, partial tails on ``drain()``.
+    """
+
+    def __init__(self, stream_fn: StreamFn):
+        self._stream_fn = stream_fn
+        self._buffer = io.StringIO()
+        self._pending = ""
+
+    def write(self, text: str) -> int:
+        self._buffer.write(text)
+        self._pending += text
+        if "\n" in self._pending:
+            lines, _, tail = self._pending.rpartition("\n")
+            self._pending = tail
+            if lines.strip():
+                self._push(lines + "\n")
+        return len(text)
+
+    def _push(self, text: str) -> None:
+        try:
+            self._stream_fn(text, "stdout")
+        except Exception:
+            pass  # a failing push must not kill user code
+
+    def drain(self) -> None:
+        """Flush any partial trailing line (called at cell end)."""
+        if self._pending.strip():
+            self._push(self._pending)
+        self._pending = ""
+
+    def flush(self) -> None:  # reference: worker.py:65-66
+        pass
+
+    def getvalue(self) -> str:
+        return self._buffer.getvalue()
+
+    def writable(self) -> bool:
+        return True
+
+
+def execute_cell(code: str, namespace: dict, stream_fn: StreamFn | None = None,
+                 *, rank: int = 0, filename: str = "<cell>") -> dict[str, Any]:
+    """Execute one cell in ``namespace`` with REPL semantics.
+
+    Returns ``{"output", "status": "success", "rank", "duration_s"}`` or
+    ``{"error", "traceback", "rank", "duration_s"}``.  Unlike the
+    reference, the duration is *measured* on the worker (SURVEY §5.1
+    calls out the reference's durations as keyword-based guesses).
+    """
+    stream_fn = stream_fn or (lambda text, kind: None)
+    old_stdout = sys.stdout
+    streaming = _StreamingStdout(stream_fn)
+    sys.stdout = streaming
+    t0 = time.perf_counter()
+    result_value: Any = None
+    has_result = False
+    try:
+        try:
+            # Path (a): whole cell is a single expression.
+            expr = compile(code, filename, "eval")
+        except SyntaxError:
+            tree = ast.parse(code, filename)
+            if tree.body and isinstance(tree.body[-1], ast.Expr):
+                # Path (b): statements ending in an expression.
+                last = tree.body.pop()
+                if tree.body:
+                    exec(compile(tree, filename, "exec"), namespace)
+                expr_ast = ast.Expression(last.value)
+                ast.copy_location(expr_ast, last)
+                result_value = eval(compile(expr_ast, filename, "eval"),
+                                    namespace)
+                has_result = True
+            else:
+                # Path (c): plain statements.
+                exec(compile(tree, filename, "exec"), namespace)
+        else:
+            result_value = eval(expr, namespace)
+            has_result = True
+
+        streaming.drain()
+        output = streaming.getvalue()
+        if has_result and result_value is not None:
+            text = repr(result_value)
+            try:
+                stream_fn(text, "result")
+            except Exception:
+                pass
+            if output and not output.endswith("\n"):
+                output += "\n"
+            output += text
+        return {
+            "output": output,
+            "status": "success",
+            "rank": rank,
+            "duration_s": time.perf_counter() - t0,
+        }
+    except Exception as e:
+        streaming.drain()
+        return {
+            "error": str(e),
+            "traceback": traceback.format_exc(),
+            "rank": rank,
+            "duration_s": time.perf_counter() - t0,
+        }
+    finally:
+        sys.stdout = old_stdout

@@ -4,7 +4,8 @@ The container's sitecustomize pre-imports JAX with the axon TPU platform
 in every Python process, so plain env vars in this file are too late for
 platform selection — but backends initialize lazily, so a config update
 before the first device query still wins.  Subprocess workers spawned by
-integration tests get a scrubbed env instead (see helpers below).
+integration tests get a scrubbed env via
+``nbdistributed_tpu.manager.topology.cpu_worker_env`` instead.
 """
 
 import os
@@ -22,16 +23,3 @@ except Exception:
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__ + "/.."))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
-
-
-def cpu_worker_env(extra: dict | None = None) -> dict:
-    """Environment for spawned worker subprocesses: CPU backend, no axon
-    TPU registration, gloo cross-process collectives."""
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables axon sitecustomize
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
-    env.pop("XLA_FLAGS", None)  # one device per worker process
-    if extra:
-        env.update(extra)
-    return env

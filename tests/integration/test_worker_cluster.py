@@ -1,0 +1,172 @@
+"""End-to-end integration: real worker subprocesses on the CPU backend.
+
+This is the test tier the reference only declared in packaging but never
+shipped (SURVEY §4): spawn N actual worker processes, form a real
+``jax.distributed`` world with cross-process gloo collectives (the
+CUDA→Gloo fallback analog, reference: worker.py:146-149), and drive the
+full control plane: execute, streaming, variables, sync, status, death.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager
+from nbdistributed_tpu.messaging import CommunicationManager, WorkerDied
+
+pytestmark = [pytest.mark.integration]
+
+WORLD = 2
+ATTACH_TIMEOUT = 120  # worker startup imports jax (~5s) + rendezvous
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    comm = CommunicationManager(num_workers=WORLD, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(WORLD, comm.port, backend="cpu")
+        deadline = time.time() + ATTACH_TIMEOUT
+        while True:
+            try:
+                comm.wait_for_workers(timeout=2)
+                break
+            except TimeoutError:
+                pm.check_startup_failure()
+                if time.time() > deadline:
+                    raise
+    except Exception:
+        pm.shutdown()
+        comm.shutdown()
+        raise
+    yield comm, pm
+    comm.post(list(range(WORLD)), "shutdown")
+    time.sleep(0.5)
+    pm.shutdown()
+    comm.shutdown()
+
+
+def outputs(responses):
+    return {r: m.data.get("output") for r, m in responses.items()}
+
+
+def test_execute_on_all_ranks(cluster):
+    comm, _ = cluster
+    out = outputs(comm.send_to_all("execute", "rank * 10 + 1"))
+    assert out == {0: "1", 1: "11"}
+
+
+def test_namespace_persists(cluster):
+    comm, _ = cluster
+    comm.send_to_all("execute", "stash = rank + 100")
+    out = outputs(comm.send_to_all("execute", "stash"))
+    assert out == {0: "100", 1: "101"}
+
+
+def test_world_formed(cluster):
+    comm, _ = cluster
+    out = outputs(comm.send_to_all("execute", "jax.device_count()"))
+    assert out == {0: str(WORLD), 1: str(WORLD)}
+
+
+def test_cross_process_all_reduce(cluster):
+    comm, _ = cluster
+    out = outputs(comm.send_to_all(
+        "execute",
+        "r = all_reduce(jnp.ones(4) * (rank + 1))\nfloat(r[0])",
+        timeout=180))
+    # ranks contribute 1s and 2s -> everyone sees 3.0
+    assert out == {0: "3.0", 1: "3.0"}
+
+
+def test_cross_process_all_gather(cluster):
+    comm, _ = cluster
+    out = outputs(comm.send_to_all(
+        "execute", "g = all_gather(jnp.float32(rank))\ng.shape[0]",
+        timeout=180))
+    assert out == {0: str(WORLD), 1: str(WORLD)}
+
+
+def test_broadcast_from_root(cluster):
+    comm, _ = cluster
+    comm.send_to_ranks([0], "execute", "payload = jnp.arange(3.0) + 7")
+    comm.send_to_ranks([1], "execute", "payload = jnp.zeros(3)")
+    out = outputs(comm.send_to_all(
+        "execute", "payload = broadcast(payload, root=0)\nfloat(payload[0])",
+        timeout=180))
+    assert out == {0: "7.0", 1: "7.0"}
+
+
+def test_streaming_output_arrives_during_execution(cluster):
+    comm, _ = cluster
+    got = []
+    comm.set_output_callback(lambda rank, d: got.append((rank, d)))
+    comm.send_to_all("execute",
+                     "import time\nfor i in range(3):\n"
+                     "    print('tick', i)\n    time.sleep(0.05)")
+    texts = [d["text"].strip() for _, d in got if d["stream"] == "stdout"]
+    assert texts.count("tick 0") == WORLD
+    assert texts.count("tick 2") == WORLD
+    comm.set_output_callback(lambda rank, d: None)
+
+
+def test_get_var_array_roundtrip(cluster):
+    comm, _ = cluster
+    comm.send_to_all("execute", "w = jnp.arange(6.0).reshape(2, 3) * (rank+1)")
+    resp = comm.send_to_rank(1, "get_var", "w")
+    assert resp.data["array"] and resp.data["shape"] == [2, 3]
+    np.testing.assert_allclose(
+        resp.bufs["value"], np.arange(6.0).reshape(2, 3) * 2)
+
+
+def test_set_var_pushes_array(cluster):
+    comm, _ = cluster
+    comm.send_to_all("set_var", {"name": "injected"},
+                     bufs={"value": np.full((2, 2), 5.0, np.float32)})
+    out = outputs(comm.send_to_all("execute", "float(injected.sum())"))
+    assert out == {0: "20.0", 1: "20.0"}
+
+
+def test_get_var_missing_name(cluster):
+    comm, _ = cluster
+    resp = comm.send_to_rank(0, "get_var", "no_such_name")
+    assert "error" in resp.data
+
+
+def test_sync_barrier(cluster):
+    comm, _ = cluster
+    resp = comm.send_to_all("sync", timeout=120)
+    assert all(m.data["status"] == "synced" for m in resp.values())
+
+
+def test_status_probe(cluster):
+    comm, _ = cluster
+    resp = comm.send_to_rank(0, "get_status")
+    st = resp.data
+    assert st["rank"] == 0
+    assert st["world_size"] == WORLD
+    assert st["backend"] == "cpu"
+    assert st["global_device_count"] == WORLD
+
+
+def test_namespace_info(cluster):
+    comm, _ = cluster
+    comm.send_to_all("execute", "probe_arr = jnp.zeros((3, 4))")
+    resp = comm.send_to_rank(0, "get_namespace_info")
+    info = resp.data["namespace_info"]
+    assert info["probe_arr"]["kind"] == "array"
+    assert info["probe_arr"]["shape"] == [3, 4]
+    assert info["rank"]["kind"] == "scalar"
+    assert info["all_reduce"]["kind"] == "callable"
+
+
+def test_error_cell_reports_per_rank(cluster):
+    comm, _ = cluster
+    resp = comm.send_to_all("execute", "1 / 0")
+    for m in resp.values():
+        assert "ZeroDivisionError" in m.data["traceback"]
+    # workers stay healthy afterwards
+    out = outputs(comm.send_to_all("execute", "'alive'"))
+    assert out == {0: "'alive'", 1: "'alive'"}

@@ -1,0 +1,96 @@
+"""Collectives on the in-process 8-device virtual CPU mesh (conftest
+forces --xla_force_host_platform_device_count=8; the multi-process path
+is covered by the integration tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.parallel import collectives
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    collectives.clear_mesh_cache()
+    yield
+    collectives.clear_mesh_cache()
+
+
+def test_world_is_eight_devices():
+    assert jax.device_count() == 8
+    assert collectives.device_world() == 8
+
+
+def test_all_reduce_sum_rank_semantics():
+    """One process = identity result, but the XLA collective path must
+    actually run (8 local devices -> mesh path, then de-duplication)."""
+    out = collectives.all_reduce(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), np.ones((4,)))
+    assert collectives._reduce_fn.cache_info().currsize >= 1
+
+
+def test_all_reduce_integer_sum_exact():
+    out = collectives.all_reduce(jnp.arange(4, dtype=jnp.int32))
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4))
+
+
+def test_all_reduce_ops():
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(
+        np.asarray(collectives.all_reduce(x, "mean")), np.arange(4.0))
+    np.testing.assert_allclose(
+        np.asarray(collectives.all_reduce(x, "max")), np.arange(4.0))
+
+
+def test_all_reduce_bad_op():
+    with pytest.raises(ValueError):
+        collectives.all_reduce(jnp.ones(2), "median")
+
+
+def test_all_gather_one_row_per_rank():
+    out = collectives.all_gather(jnp.arange(3.0))
+    assert out.shape == (1, 3)  # one process -> one row
+    np.testing.assert_allclose(np.asarray(out)[0], np.arange(3.0))
+    assert collectives._gather_fn.cache_info().currsize >= 1
+
+
+def test_broadcast_single_process_identity():
+    x = jnp.arange(5.0)
+    np.testing.assert_allclose(np.asarray(collectives.broadcast(x)),
+                               np.asarray(x))
+
+
+def test_barrier_single_process_noop():
+    collectives.barrier()  # must not raise or hang
+
+
+def test_reduce_scatter_single_process_identity():
+    x = jnp.arange(8.0)
+    np.testing.assert_allclose(
+        np.asarray(collectives.reduce_scatter(x)), np.asarray(x))
+
+
+def test_dist_namespace_facade():
+    d = collectives.DistNamespace()
+    assert d.get_rank() == 0
+    assert d.get_world_size() == 1
+    assert "rank 0" in repr(d)
+    out = d.all_reduce(jnp.ones(2))
+    np.testing.assert_allclose(np.asarray(out), np.ones((2,)))
+
+
+def test_all_reduce_matmul_sized():
+    x = jnp.ones((100, 100))
+    out = collectives.all_reduce(x)
+    np.testing.assert_allclose(np.asarray(out), np.ones((100, 100)))
+
+
+def test_repeated_calls_hit_jit_cache():
+    collectives.all_reduce(jnp.ones(4))
+    before = collectives._reduce_fn.cache_info()
+    collectives.all_reduce(jnp.ones(4))
+    after = collectives._reduce_fn.cache_info()
+    assert after.currsize == before.currsize  # no new traced function
+    assert after.hits > before.hits

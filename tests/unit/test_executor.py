@@ -1,0 +1,112 @@
+"""Unit tests for REPL executor semantics (reference: worker.py:248-387
+defines the contract; SURVEY §4 calls for porting these semantics exactly)."""
+
+import sys
+
+from nbdistributed_tpu.runtime.executor import execute_cell
+
+
+def run(code, ns=None, streams=None):
+    ns = ns if ns is not None else {}
+    out = execute_cell(code, ns,
+                       (lambda t, k: streams.append((k, t)))
+                       if streams is not None else None)
+    return out, ns
+
+
+def test_single_expression_echo():
+    out, _ = run("1 + 1")
+    assert out["status"] == "success"
+    assert out["output"] == "2"
+
+
+def test_statements_then_expression():
+    out, ns = run("x = 10\ny = x * 2\ny + 1")
+    assert out["output"] == "21"
+    assert ns["x"] == 10 and ns["y"] == 20
+
+
+def test_plain_statements_no_echo():
+    out, ns = run("x = 5")
+    assert out["output"] == ""
+    assert ns["x"] == 5
+
+
+def test_none_result_not_echoed():
+    out, _ = run("print('hi')\nNone")
+    assert out["output"].strip() == "hi"
+
+
+def test_namespace_persists_across_cells():
+    ns = {}
+    run("a = 1", ns)
+    run("b = a + 1", ns)
+    out, _ = run("a + b", ns)
+    assert out["output"] == "3"
+
+
+def test_print_streams_immediately_and_in_order():
+    streams = []
+    out, _ = run("print('first')\nprint('second')\n'result!'", streams=streams)
+    kinds = [k for k, _ in streams]
+    texts = [t.strip() for _, t in streams if t.strip()]
+    assert texts == ["first", "second", "'result!'"]
+    assert kinds[-1] == "result"
+    assert "first" in out["output"] and out["output"].endswith("'result!'")
+
+
+def test_blank_writes_not_streamed():
+    streams = []
+    run("print()", streams=streams)
+    assert all(t.strip() for _, t in streams)
+
+
+def test_error_returns_traceback_and_restores_stdout():
+    before = sys.stdout
+    out, _ = run("1 / 0")
+    assert sys.stdout is before
+    assert "ZeroDivisionError" in out["traceback"]
+    assert out["error"]
+
+
+def test_syntax_error_reported():
+    out, _ = run("def broken(:")
+    assert "SyntaxError" in out["traceback"]
+
+
+def test_stdout_restored_after_success():
+    before = sys.stdout
+    run("print('x')")
+    assert sys.stdout is before
+
+
+def test_multiline_function_definition_and_call():
+    ns = {}
+    run("def f(a):\n    return a * 3", ns)
+    out, _ = run("f(7)", ns)
+    assert out["output"] == "21"
+
+
+def test_duration_measured():
+    out, _ = run("import time\ntime.sleep(0.05)")
+    assert out["duration_s"] >= 0.05
+
+
+def test_exception_mid_stream_keeps_prior_output():
+    streams = []
+    out, _ = run("print('before')\nraise ValueError('boom')",
+                 streams=streams)
+    assert any("before" in t for _, t in streams)
+    assert out["error"] == "boom"
+
+
+def test_loop_prints_stream_per_iteration():
+    streams = []
+    run("for i in range(3):\n    print(i)", streams=streams)
+    texts = [t.strip() for _, t in streams if t.strip()]
+    assert texts == ["0", "1", "2"]
+
+
+def test_last_expression_object_reprs():
+    out, _ = run("class Q:\n    def __repr__(self):\n        return '<Q!>'\nQ()")
+    assert out["output"] == "<Q!>"
