@@ -1,0 +1,2 @@
+"""User/API layer (L4, SURVEY §1): IPython magics, auto-dispatch input
+transformer, streaming display, IDE proxies, measured timelines."""

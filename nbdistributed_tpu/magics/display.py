@@ -1,0 +1,91 @@
+"""Incremental rank-grouped streaming display.
+
+Rebuilds the reference's streaming print pipeline (reference:
+magic.py:538-607 callback+filters, magic.py:1088-1097 poll loop): the
+control plane's IO thread feeds per-rank buffers; the cell's main thread
+drains them periodically, printing ``🔹 Rank N:`` sections as output
+arrives.  Draining from the main thread keeps output attached to the
+right notebook cell — IPython display routing is thread-affine.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+# Noise lines some frontends inject; the reference filters similarly
+# (reference: magic.py:558-573).
+_NOISE_SNIPPETS = (
+    "<IPython.core.display.Javascript object>",
+    "window.require",
+)
+
+
+class StreamDisplay:
+    """Per-cell collector of streamed worker output with incremental,
+    rank-grouped printing."""
+
+    def __init__(self, print_fn: Callable[[str], None] | None = None):
+        self._lock = threading.Lock()
+        self._chunks: list[tuple[int, str, str]] = []  # (rank, text, kind)
+        self._drained = 0
+        self._last_rank: int | None = None
+        self._at_line_start = True
+        self._print = print_fn or (lambda s: print(s, end=""))
+
+    # -- feed side (IO thread) ----------------------------------------
+
+    def feed(self, rank: int, data: dict) -> None:
+        text = data.get("text", "")
+        if not text.strip():
+            return
+        if any(s in text for s in _NOISE_SNIPPETS):
+            return
+        with self._lock:
+            self._chunks.append((rank, text, data.get("stream", "stdout")))
+
+    # -- drain side (main thread) -------------------------------------
+
+    def drain(self) -> bool:
+        """Print everything new; returns True if anything was printed."""
+        with self._lock:
+            new = self._chunks[self._drained:]
+            self._drained = len(self._chunks)
+        for rank, text, _kind in new:
+            if rank != self._last_rank:
+                if not self._at_line_start:
+                    self._print("\n")
+                self._print(f"🔹 Rank {rank}:\n")
+                self._last_rank = rank
+            # Text passes through verbatim — partial lines (progress
+            # bars, \r rewrites) must not be force-terminated.
+            self._print(text)
+            self._at_line_start = text.endswith(("\n", "\r"))
+        return bool(new)
+
+    def finalize(self) -> None:
+        """Terminate a trailing partial line at cell end."""
+        if not self._at_line_start:
+            self._print("\n")
+            self._at_line_start = True
+
+    def error_chunks(self) -> list[tuple[int, str]]:
+        with self._lock:
+            return [(r, t) for r, t, k in self._chunks if k == "stderr"]
+
+
+def print_rank_errors(responses: dict, print_fn=None) -> int:
+    """Print per-rank error reports after a distributed cell; stdout has
+    already streamed, so only failures need echoing (reference:
+    magic.py:1100-1115).  Returns the number of failed ranks."""
+    p = print_fn or (lambda s: print(s, end=""))
+    failures = 0
+    for rank in sorted(responses):
+        data = responses[rank].data
+        if isinstance(data, dict) and data.get("error"):
+            failures += 1
+            p(f"❌ Rank {rank}: {data['error']}\n")
+            tb = data.get("traceback")
+            if tb:
+                p(tb if tb.endswith("\n") else tb + "\n")
+    return failures

@@ -1,0 +1,604 @@
+"""IPython magics: the user/API layer (L4, SURVEY §1).
+
+Rebuilds the reference's magic surface with the same names and semantics
+(reference: magic.py:71-83 lists them): ``%dist_init``, ``%%distributed``,
+``%%rank``, ``%sync``, ``%dist_status``, ``%dist_mode``,
+``%dist_shutdown``, ``%dist_reset``, ``%dist_debug``, ``%dist_sync_ide``,
+``%timeline_*``, plus the auto-distributed input transformer that makes
+plain cells run on all workers (reference: magic.py:609-645).
+
+TPU-era additions beyond parity: ``%dist_profile`` (jax.profiler over all
+workers), ``%dist_pull``/``%dist_push`` (the reference wired get_var/
+set_var in the worker but never exposed them: SURVEY §2.1 #9), and a
+static collective-hazard warning when ``%%rank`` subsets run collective-
+bearing code (SURVEY §5.2 — a mesh-deadlock guard the reference lacks).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from IPython.core.magic import Magics, cell_magic, line_magic, magics_class
+from IPython.core.magic_arguments import (argument, magic_arguments,
+                                          parse_argstring)
+
+from ..manager import ProcessManager
+from ..messaging import CommunicationManager, WorkerDied
+from . import display as display_mod
+from . import proxies, rankspec
+from .timeline import Timeline
+
+_COLLECTIVE_TOKENS = re.compile(
+    r"\b(all_reduce|all_gather|broadcast|reduce_scatter|barrier|psum|pmean|"
+    r"pmax|pmin|ppermute|all_to_all|sync_global_devices|shard_map)\b")
+
+_BANNER = """\
+✅ {n} workers ready (backend={backend}, attach {secs:.1f}s).
+
+Every cell now runs on ALL workers. Namespace on each worker:
+  rank, world_size     — this worker's rank / total workers
+  jax, jnp, np         — preloaded libraries
+  devices, device      — global device list / this worker's device
+  Mesh, P, shard_map   — sharding toolkit (PartitionSpec as P)
+  dist                 — torch.distributed-style facade
+  all_reduce, all_gather, broadcast, barrier, reduce_scatter
+                       — eager collectives over ICI/DCN
+
+Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_status ·
+%dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
+%dist_profile start/stop · %timeline_show · %dist_shutdown
+"""
+
+
+@magics_class
+class DistributedMagics(Magics):
+    # Class-level singletons so re-registration survives %load_ext cycles
+    # (reference: magic.py:95-98).
+    _comm: CommunicationManager | None = None
+    _pm: ProcessManager | None = None
+    _world: int = 0
+    _auto_active: bool = False
+    _timeline: Timeline = Timeline()
+    _active_display = None
+    _display_lock = threading.Lock()
+    _instance = None
+    _proxy_registry: dict = {}
+
+    def __init__(self, shell):
+        super().__init__(shell)
+        DistributedMagics._instance = self
+
+    # ==================================================================
+    # state helpers
+
+    @classmethod
+    def reset_class_state(cls) -> None:
+        cls._comm = None
+        cls._pm = None
+        cls._world = 0
+        cls._auto_active = False
+        cls._timeline = Timeline()
+        cls._active_display = None
+        cls._proxy_registry = {}
+
+    def on_extension_loaded(self) -> None:
+        print("nbdistributed_tpu loaded. Start workers with: "
+              "%dist_init -n <N>")
+
+    def _running(self) -> bool:
+        return (self._comm is not None and self._pm is not None
+                and self._pm.is_running())
+
+    def _require_cluster(self) -> bool:
+        if not self._running():
+            print("❌ No distributed cluster. Run %dist_init first.")
+            return False
+        return True
+
+    # ==================================================================
+    # streaming plumbing
+
+    def _feed_stream(self, rank: int, data: dict) -> None:
+        """Output callback (IO thread).  Routes to the active cell's
+        display, or prints directly for output that arrives outside any
+        request (e.g. prints from background threads on workers)."""
+        with DistributedMagics._display_lock:
+            disp = DistributedMagics._active_display
+        if disp is not None:
+            disp.feed(rank, data)
+        else:
+            text = data.get("text", "")
+            if text.strip():
+                print(f"[rank {rank}] {text}", end=""
+                      if text.endswith("\n") else "\n")
+
+    def _run_on_ranks(self, code: str, ranks: list[int], kind: str):
+        """Send an execute request and stream output while waiting
+        (reference: magic.py:1042-1129 runs the send in a helper thread
+        and polls buffers from the main thread; same structure, 30 ms
+        cadence instead of 100 ms)."""
+        comm = self._comm
+        assert comm is not None
+        disp = display_mod.StreamDisplay()
+        rec = self._timeline.start(code, ranks, kind=kind)
+        with DistributedMagics._display_lock:
+            DistributedMagics._active_display = disp
+        result: dict = {}
+        error: list[Exception] = []
+
+        def _send():
+            try:
+                result.update(comm.send_to_ranks(ranks, "execute", code))
+            except Exception as e:
+                error.append(e)
+
+        worker_thread = threading.Thread(target=_send, daemon=True)
+        worker_thread.start()
+        try:
+            while worker_thread.is_alive():
+                worker_thread.join(timeout=0.03)
+                disp.drain()
+            disp.drain()
+            disp.finalize()
+        finally:
+            with DistributedMagics._display_lock:
+                DistributedMagics._active_display = None
+        self._timeline.finish(rec, result or None)
+        if error:
+            e = error[0]
+            if isinstance(e, WorkerDied):
+                print(f"💀 {e}")
+                print("   Run %dist_status for details; %dist_reset to "
+                      "rebuild the cluster.")
+            elif isinstance(e, TimeoutError):
+                print(f"⏱️ {e}")
+            else:
+                print(f"❌ {type(e).__name__}: {e}")
+            return None
+        display_mod.print_rank_errors(result)
+        return result
+
+    # ==================================================================
+    # %dist_init
+
+    @magic_arguments()
+    @argument("-n", "--num-workers", type=int, default=2,
+              help="number of worker processes (one per TPU chip)")
+    @argument("--backend", default="auto", choices=["auto", "cpu", "tpu"],
+              help="accelerator backend; cpu uses cross-process gloo")
+    @argument("-t", "--timeout", type=float, default=None,
+              help="per-request timeout in seconds (default: none — "
+                   "training mode)")
+    @argument("--chips-per-worker", type=int, default=1,
+              help="TPU chips owned by each worker process")
+    @argument("--attach-timeout", type=float, default=180.0,
+              help="seconds to wait for workers to come up")
+    @line_magic
+    def dist_init(self, line):
+        """Start N workers and route subsequent cells to them
+        (reference: magic.py:397-536)."""
+        args = parse_argstring(self.dist_init, line)
+        if self._running():
+            print(f"⚠️ {self._world} workers already running. "
+                  "%dist_shutdown first.")
+            return
+        t0 = time.time()
+        comm = CommunicationManager(num_workers=args.num_workers,
+                                    timeout=args.timeout)
+        pm = ProcessManager()
+        pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+        pm.add_death_callback(self._announce_death)
+        try:
+            print(f"🚀 Spawning {args.num_workers} workers "
+                  f"(backend={args.backend})...")
+            pm.start_workers(args.num_workers, comm.port,
+                             backend=args.backend,
+                             chips_per_worker=args.chips_per_worker)
+            deadline = time.time() + args.attach_timeout
+            while True:
+                try:
+                    comm.wait_for_workers(timeout=2)
+                    break
+                except TimeoutError:
+                    pm.check_startup_failure()
+                    if time.time() > deadline:
+                        raise
+                    print(f"   ... waiting ({len(comm.connected_ranks())}/"
+                          f"{args.num_workers} attached)")
+        except Exception as e:
+            print(f"❌ Worker startup failed: {e}")
+            pm.shutdown()
+            comm.shutdown()
+            return
+        comm.set_output_callback(self._feed_stream)
+        DistributedMagics._comm = comm
+        DistributedMagics._pm = pm
+        DistributedMagics._world = args.num_workers
+        self._enable_auto_mode()
+        print(_BANNER.format(n=args.num_workers,
+                             backend=pm.backend,
+                             secs=time.time() - t0))
+
+    def _announce_death(self, rank: int, rc: int | None) -> None:
+        # Runs on the monitor thread; a print is best-effort context.
+        print(f"\n💀 worker {rank} exited (code {rc}). "
+              "%dist_status / %dist_reset")
+
+    # ==================================================================
+    # execution magics
+
+    @cell_magic
+    def distributed(self, line, cell):
+        """Run the cell on every worker (reference: magic.py:1042-1129)."""
+        if not self._require_cluster():
+            return
+        result = self._run_on_ranks(cell, list(range(self._world)),
+                                    kind="distributed")
+        if result is not None:
+            self._sync_ide_quietly()
+
+    @cell_magic
+    def rank(self, line, cell):
+        """Run the cell on selected ranks: ``%%rank [0,2]`` / ``[0-2]``
+        (reference: magic.py:1476-1565)."""
+        if not self._require_cluster():
+            return
+        try:
+            ranks = rankspec.parse_ranks(line, self._world)
+        except rankspec.RankSpecError as e:
+            print(f"❌ {e}")
+            return
+        if len(ranks) < self._world and _COLLECTIVE_TOKENS.search(cell):
+            print(f"⚠️ Cell names a collective but targets only ranks "
+                  f"{ranks} of {self._world}. A collective run by a "
+                  "subset deadlocks the mesh; %sync can realign after "
+                  "errors.")
+        self._run_on_ranks(cell, ranks, kind="rank")
+
+    @line_magic
+    def sync(self, line):
+        """Barrier across all workers (reference: magic.py:1567-1587)."""
+        if not self._require_cluster():
+            return
+        try:
+            self._comm.send_to_all("sync", timeout=120)
+            print(f"✅ All {self._world} workers synchronized")
+        except Exception as e:
+            print(f"❌ sync failed: {e}")
+
+    # ==================================================================
+    # auto-distributed mode (input transformer)
+
+    def _auto_transformer(self, lines: list[str]) -> list[str]:
+        """Prepend %%distributed to plain cells (reference:
+        magic.py:709-741).  Skips magics, shell escapes, help syntax and
+        comment-only cells."""
+        if not DistributedMagics._auto_active or not lines:
+            return lines
+        stripped = [ln.strip() for ln in lines]
+        first = next((s for s in stripped if s), "")
+        if not first:
+            return lines
+        if first.startswith(("%", "!", "?")) or first.endswith("?"):
+            return lines
+        if all(s.startswith("#") or not s for s in stripped):
+            return lines
+        return ["%%distributed\n"] + lines
+
+    def _enable_auto_mode(self) -> None:
+        shell = self.shell
+        if self._auto_transformer not in shell.input_transformers_cleanup:
+            shell.input_transformers_cleanup.append(self._auto_transformer)
+        DistributedMagics._auto_active = True
+
+    def _disable_auto_mode(self) -> None:
+        shell = self.shell
+        try:
+            shell.input_transformers_cleanup.remove(self._auto_transformer)
+        except ValueError:
+            pass
+        DistributedMagics._auto_active = False
+
+    @magic_arguments()
+    @argument("-e", "--enable", action="store_true")
+    @argument("-d", "--disable", action="store_true")
+    @line_magic
+    def dist_mode(self, line):
+        """Toggle auto-distribution of plain cells
+        (reference: magic.py:1626-1677)."""
+        args = parse_argstring(self.dist_mode, line)
+        if args.enable and args.disable:
+            print("❌ choose one of -e / -d")
+            return
+        if args.enable:
+            if not self._require_cluster():
+                return
+            self._enable_auto_mode()
+            print("✅ Auto-distributed mode ON — plain cells run on all "
+                  "workers")
+        elif args.disable:
+            self._disable_auto_mode()
+            print("✅ Auto-distributed mode OFF — cells run locally; use "
+                  "%%distributed / %%rank explicitly")
+        else:
+            state = "ON" if DistributedMagics._auto_active else "OFF"
+            print(f"Auto-distributed mode: {state}")
+
+    # ==================================================================
+    # status / debug
+
+    @line_magic
+    def dist_status(self, line):
+        """Cluster tree report (reference: magic.py:743-809)."""
+        if self._pm is None:
+            print("❌ No cluster. %dist_init to start one.")
+            return
+        proc_status = self._pm.get_status()
+        live: dict[int, dict] = {}
+        alive = self._pm.alive_ranks()
+        if self._comm is not None and alive:
+            try:
+                resp = self._comm.send_to_ranks(alive, "get_status",
+                                                timeout=5)
+                live = {r: m.data for r, m in resp.items()}
+            except Exception:
+                pass  # degrade to process-level info (reference does too)
+        mode = "ON" if self._auto_active else "OFF"
+        print(f"🌐 Cluster: {self._world} workers · backend="
+              f"{self._pm.backend} · auto-mode {mode}")
+        for rank_id in sorted(proc_status):
+            p = proc_status[rank_id]
+            state = "● running" if p["running"] else \
+                f"✖ exited ({p['returncode']})"
+            line_txt = f"├─ Rank {rank_id}: pid {p['pid']} {state}"
+            if rank_id in live:
+                st = live[rank_id]
+                devs = st.get("devices", [])
+                if devs:
+                    d = devs[0]
+                    line_txt += f" · {d['platform']}:{d['id']} ({d['kind']})"
+                    mem = d.get("memory_gb") or {}
+                    if mem.get("in_use") is not None:
+                        line_txt += (f" · mem {mem['in_use']:.2f}"
+                                     f"/{mem.get('limit') or 0:.2f} GB")
+                line_txt += (f" · {st['global_device_count']} global "
+                             f"devices")
+            if self._comm is not None:
+                seen = self._comm.last_seen(rank_id)
+                if seen is not None:
+                    line_txt += f" · seen {time.time() - seen:.1f}s ago"
+            print(line_txt)
+
+    @line_magic
+    def dist_debug(self, line):
+        """Internals dump (reference: magic.py:1589-1624)."""
+        print(f"comm manager : {self._comm}")
+        if self._comm:
+            print(f"  port       : {self._comm.port}")
+            print(f"  connected  : {self._comm.connected_ranks()}")
+        print(f"process mgr  : {self._pm}")
+        if self._pm:
+            print(f"  backend    : {self._pm.backend}")
+            print(f"  dist port  : {self._pm.dist_port}")
+            print(f"  status     : {self._pm.get_status()}")
+        print(f"world size   : {self._world}")
+        print(f"auto mode    : {self._auto_active}")
+        print(f"timeline     : {len(self._timeline.records)} records")
+
+    # ==================================================================
+    # variable transfer (latent in the reference: SURVEY §2.1 #9)
+
+    @magic_arguments()
+    @argument("name", help="worker variable name")
+    @argument("--rank", type=int, default=0, help="rank to pull from")
+    @argument("--as", dest="as_name", default=None,
+              help="kernel name to bind (default: same name)")
+    @line_magic
+    def dist_pull(self, line):
+        """Copy a variable from one worker into the kernel namespace."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_pull, line)
+        try:
+            resp = self._comm.send_to_rank(args.rank, "get_var", args.name,
+                                           timeout=60)
+        except Exception as e:
+            print(f"❌ pull failed: {e}")
+            return
+        if resp.data.get("error"):
+            print(f"❌ {resp.data['error']}")
+            return
+        target = args.as_name or args.name
+        if resp.data.get("array"):
+            value = resp.bufs["value"]
+            self.shell.user_ns[target] = value
+            print(f"✅ {target} = array{tuple(resp.data['shape'])} "
+                  f"{resp.data['dtype']} (from rank {args.rank})")
+        else:
+            self.shell.user_ns[target] = resp.data.get("value")
+            print(f"✅ {target} = {self.shell.user_ns[target]!r} "
+                  f"(from rank {args.rank})")
+
+    @magic_arguments()
+    @argument("name", help="kernel variable name")
+    @argument("--ranks", default=None,
+              help="target spec like [0,2]; default all")
+    @line_magic
+    def dist_push(self, line):
+        """Copy a kernel variable to workers' namespaces."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_push, line)
+        if args.name not in self.shell.user_ns:
+            print(f"❌ {args.name!r} is not defined in the kernel")
+            return
+        value = self.shell.user_ns[args.name]
+        ranks = list(range(self._world))
+        if args.ranks:
+            try:
+                ranks = rankspec.parse_ranks(args.ranks, self._world)
+            except rankspec.RankSpecError as e:
+                print(f"❌ {e}")
+                return
+        import numpy as np
+        try:
+            if isinstance(value, np.ndarray) or type(value).__module__ \
+                    .startswith("jax"):
+                arr = np.asarray(value)
+                self._comm.send_to_ranks(ranks, "set_var",
+                                         {"name": args.name},
+                                         bufs={"value": arr}, timeout=60)
+            else:
+                self._comm.send_to_ranks(ranks, "set_var",
+                                         {"name": args.name,
+                                          "value": value}, timeout=60)
+        except Exception as e:
+            print(f"❌ push failed: {e}")
+            return
+        print(f"✅ pushed {args.name} to ranks {ranks}")
+
+    # ==================================================================
+    # IDE sync
+
+    def _sync_ide_quietly(self) -> None:
+        try:
+            self._sync_ide(verbose=False)
+        except Exception:
+            pass
+
+    def _sync_ide(self, verbose: bool = True) -> None:
+        resp = self._comm.send_to_ranks([0], "get_namespace_info",
+                                        timeout=30)
+        info = resp[0].data.get("namespace_info", {})
+        n = proxies.sync_namespace(self.shell.user_ns, info,
+                                   DistributedMagics._proxy_registry)
+        if verbose:
+            print(f"✅ synced {n} names from rank 0 into the kernel "
+                  "namespace (proxies)")
+
+    @line_magic
+    def dist_sync_ide(self, line):
+        """Refresh kernel-side proxies for worker variables
+        (reference: magic.py:1756-1776)."""
+        if not self._require_cluster():
+            return
+        try:
+            self._sync_ide(verbose=True)
+        except Exception as e:
+            print(f"❌ IDE sync failed: {e}")
+
+    # ==================================================================
+    # profiling (TPU-idiomatic; SURVEY §5.1 suggested %dist_profile)
+
+    @magic_arguments()
+    @argument("action", choices=["start", "stop"])
+    @argument("--log-dir", default="/tmp/nbd_profile",
+              help="per-worker trace dir (suffixed with the rank)")
+    @line_magic
+    def dist_profile(self, line):
+        """jax.profiler traces on every worker; view in TensorBoard/
+        Perfetto."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_profile, line)
+        try:
+            # One broadcast; each worker suffixes its own rank directory.
+            self._comm.send_to_all(
+                "profile", {"action": args.action,
+                            "log_dir": args.log_dir}, timeout=60)
+        except Exception as e:
+            print(f"❌ profile {args.action} failed: {e}")
+            return
+        if args.action == "start":
+            print(f"🔬 profiling started → {args.log_dir}/rank*/")
+        else:
+            print(f"🔬 profiling stopped; traces in {args.log_dir}/rank*/")
+
+    # ==================================================================
+    # timeline magics (reference: magic.py:1778-1870)
+
+    @line_magic
+    def timeline_show(self, line):
+        print(self._timeline.summary())
+
+    @magic_arguments()
+    @argument("path", nargs="?", default="nbd_timeline.json")
+    @line_magic
+    def timeline_save(self, line):
+        args = parse_argstring(self.timeline_save, line)
+        n = self._timeline.save(args.path)
+        print(f"✅ saved {n} cell records → {args.path}")
+
+    @line_magic
+    def timeline_clear(self, line):
+        self._timeline.clear()
+        print("✅ timeline cleared")
+
+    # ==================================================================
+    # shutdown / reset (tiered, reference: magic.py:810-1040)
+
+    @classmethod
+    def shutdown_all(cls) -> None:
+        """Polite tier: control-plane shutdown broadcast, then process
+        teardown (reference: magic.py:1005-1036)."""
+        if cls._pm is not None:
+            cls._pm.quiesce()  # planned exits are not deaths
+        if cls._comm is not None:
+            try:
+                cls._comm.post(cls._comm.connected_ranks(), "shutdown")
+                time.sleep(0.3)
+            except Exception:
+                pass
+            try:
+                cls._comm.shutdown()
+            except Exception:
+                pass
+        if cls._pm is not None:
+            try:
+                cls._pm.shutdown()
+            except Exception:
+                pass
+        inst = cls._instance
+        if inst is not None:
+            try:
+                inst._disable_auto_mode()
+            except Exception:
+                cls._auto_active = False
+            try:
+                # Raising stubs and stale mirrors must not outlive the
+                # cluster they point at.
+                proxies.remove_proxies(inst.shell.user_ns,
+                                       cls._proxy_registry)
+            except Exception:
+                pass
+        cls._comm = None
+        cls._pm = None
+        cls._world = 0
+
+    @classmethod
+    def _nuclear_shutdown(cls) -> None:
+        """Last-resort sweep for orphaned workers (reference:
+        magic.py:878-961 pkills by pattern; same idea, our module name)."""
+        import subprocess
+        subprocess.run(["pkill", "-9", "-f",
+                        "nbdistributed_tpu.runtime.worker"],
+                       capture_output=True)
+
+    @line_magic
+    def dist_shutdown(self, line):
+        """Stop all workers (reference: magic.py:810-837)."""
+        had = self._world
+        self.shutdown_all()
+        self._nuclear_shutdown()
+        print(f"✅ shut down {had} workers" if had else "✅ nothing to "
+              "shut down")
+
+    @line_magic
+    def dist_reset(self, line):
+        """Full reset for a fresh start (reference: magic.py:963-1003)."""
+        self.shutdown_all()
+        self._nuclear_shutdown()
+        DistributedMagics._timeline = Timeline()
+        print("✅ reset complete — %dist_init to start a new cluster")

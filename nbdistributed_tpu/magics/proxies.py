@@ -1,0 +1,165 @@
+"""IDE namespace proxies: mirror worker names into the kernel namespace.
+
+After distributed cells, the kernel's ``user_ns`` gets lightweight
+stand-ins for rank 0's names so editor autocomplete / type hints work
+(reference: magic.py:1131-1314).  JAX-native redesign:
+
+* arrays    -> ``jax.ShapeDtypeStruct`` — honest shape/dtype carriers
+               that cost nothing (the reference allocated real
+               ``torch.zeros``, magic.py:1186-1199);
+* callables -> closure-built stubs carrying the remote signature in
+               their docstring and raising on call — the reference
+               ``exec``-ed generated source in the kernel
+               (magic.py:1262-1286), a scar SURVEY §7 says to avoid;
+* modules   -> real import when available, else a placeholder module;
+* scalars   -> literal values reconstructed from their repr;
+* classes   -> empty dynamic types.
+
+Every proxy is tagged via ``__nbd_proxy__`` so re-syncs can tell proxies
+from user-assigned kernel variables and never clobber the latter.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import types
+from typing import Any
+
+PROXY_TAG = "__nbd_proxy__"
+
+# Names seeded by the worker runtime itself (runtime/worker.py
+# _seed_namespace); mirroring them into the kernel would shadow the
+# coordinator's own meaning of ``jax`` or leave stale ``rank``/``dist``
+# values behind after shutdown.  Only *user-created* names get proxies.
+_SKIP_NAMES = {"jax", "jnp", "np", "Mesh", "NamedSharding", "P",
+               "PartitionSpec", "shard_map", "__builtins__",
+               "rank", "world_size", "process_index", "devices",
+               "local_devices", "device", "dist", "all_reduce",
+               "all_gather", "broadcast", "barrier", "reduce_scatter"}
+
+
+def make_proxy(name: str, desc: dict) -> tuple[Any, bool]:
+    """Build a proxy object for one namespace descriptor (from
+    ``introspect.describe_namespace``).  Returns (proxy, ok)."""
+    kind = desc.get("kind")
+    try:
+        if kind == "array":
+            import jax
+            import numpy as np
+            proxy = jax.ShapeDtypeStruct(
+                tuple(desc["shape"]), np.dtype(_canonical(desc["dtype"])))
+            return proxy, True
+        if kind == "scalar":
+            return ast.literal_eval(desc["repr"]), True
+        if kind == "module":
+            try:
+                return importlib.import_module(desc["name"]), True
+            except ImportError:
+                mod = types.ModuleType(desc["name"])
+                mod.__doc__ = f"placeholder for remote module {desc['name']}"
+                setattr(mod, PROXY_TAG, True)
+                return mod, True
+        if kind == "callable":
+            return _callable_stub(name, desc), True
+        if kind == "class":
+            cls = type(desc["name"], (), {
+                "__module__": desc.get("module", "remote"),
+                PROXY_TAG: True,
+                "__doc__": f"proxy for remote class {desc['name']}"})
+            return cls, True
+        if kind in ("container", "object", "mesh", "pspec"):
+            return _ObjectProxy(name, desc), True
+    except Exception:
+        pass
+    return None, False
+
+
+def _canonical(dtype: str) -> str:
+    # bfloat16 has no numpy name; fall back to float32 for the proxy.
+    return "float32" if dtype == "bfloat16" else dtype
+
+
+def _callable_stub(name: str, desc: dict):
+    signature = desc.get("signature", "(...)")
+    doc = desc.get("doc", "")
+
+    def stub(*_args, **_kwargs):
+        raise RuntimeError(
+            f"{name}{signature} exists on the workers, not in the kernel. "
+            f"Run it in a distributed cell.")
+
+    stub.__name__ = name
+    stub.__qualname__ = name
+    stub.__doc__ = (f"[remote] {name}{signature}\n\n{doc}" if doc
+                    else f"[remote] {name}{signature}")
+    setattr(stub, PROXY_TAG, True)
+    return stub
+
+
+class _ObjectProxy:
+    """Repr-carrying stand-in for remote objects/containers."""
+
+    def __init__(self, name: str, desc: dict):
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_desc", dict(desc))
+        object.__setattr__(self, PROXY_TAG, True)
+
+    def __repr__(self):
+        d = self._desc
+        if d["kind"] == "container":
+            return (f"<remote {d.get('type', 'container')} "
+                    f"len={d.get('len', '?')} on workers>")
+        return d.get("repr") or f"<remote {d.get('type', 'object')}>"
+
+
+_MISSING = object()
+
+
+def sync_namespace(user_ns: dict, namespace_info: dict[str, dict],
+                   registry: dict[str, Any]) -> int:
+    """Install proxies for worker names into ``user_ns``.
+
+    Mirrors rank 0's view (reference pulls rank 0 only: magic.py:1144-1152).
+    ``registry`` records exactly which objects this module installed
+    (name -> proxy), so ownership is tracked by identity rather than by
+    sniffing types: a kernel variable the user assigned — even one that
+    happens to be a ``jax.ShapeDtypeStruct`` — is never touched, and a
+    user overwriting a proxy permanently reclaims the name.  Proxies
+    whose remote name vanished are removed.  Returns the number of names
+    synced.
+
+    Known edge: interned scalars (small ints, short strings) can make a
+    user's value identical-by-identity to an installed proxy value; such
+    a name keeps refreshing from the workers.
+    """
+    synced = 0
+    for name, desc in namespace_info.items():
+        if name in _SKIP_NAMES or name.startswith("_"):
+            continue
+        existing = user_ns.get(name, _MISSING)
+        if existing is not _MISSING:
+            owned = name in registry and registry[name] is existing
+            if not owned:
+                registry.pop(name, None)  # the user holds this name now
+                continue
+        proxy, ok = make_proxy(name, desc)
+        if ok:
+            user_ns[name] = proxy
+            registry[name] = proxy
+            synced += 1
+    for stale in list(registry):
+        if stale not in namespace_info:
+            if user_ns.get(stale, _MISSING) is registry[stale]:
+                user_ns.pop(stale, None)
+            del registry[stale]
+    return synced
+
+
+def remove_proxies(user_ns: dict, registry: dict[str, Any]) -> None:
+    """Drop every still-owned proxy (used at cluster shutdown so raising
+    stubs and stale mirrors don't outlive the workers)."""
+    for name, proxy in list(registry.items()):
+        if user_ns.get(name, _MISSING) is proxy:
+            user_ns.pop(name, None)
+    registry.clear()

@@ -1,0 +1,49 @@
+"""Rank-spec grammar for ``%%rank`` targeting.
+
+Same surface grammar as the reference (reference: magic.py:1679-1715):
+``[0,2]`` picks ranks, ``[0-2]`` is an inclusive range, and the two mix
+(``[0, 2-4, 7]``).  Out-of-range ranks are *reported* — the reference
+silently filtered them (reference: magic.py:1697-1715), which turns a
+typo'd rank list into a silent no-op on those ranks.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SPEC_RE = re.compile(r"^\s*\[([^\]]*)\]\s*$")
+
+
+class RankSpecError(ValueError):
+    pass
+
+
+def parse_ranks(spec: str, world_size: int) -> list[int]:
+    """Parse ``[0,1]`` / ``[0-2]`` / mixed specs into a sorted list of
+    unique valid ranks.  Raises :class:`RankSpecError` on malformed specs
+    or ranks outside ``[0, world_size)``."""
+    m = _SPEC_RE.match(spec)
+    if not m:
+        raise RankSpecError(
+            f"invalid rank spec {spec!r}: expected e.g. [0,1] or [0-2]")
+    body = m.group(1).strip()
+    if not body:
+        raise RankSpecError("empty rank spec []")
+    ranks: set[int] = set()
+    for part in body.split(","):
+        part = part.strip()
+        rm = re.fullmatch(r"(\d+)\s*-\s*(\d+)", part)
+        if rm:
+            lo, hi = int(rm.group(1)), int(rm.group(2))
+            if lo > hi:
+                raise RankSpecError(f"descending range {part!r}")
+            ranks.update(range(lo, hi + 1))
+        elif re.fullmatch(r"\d+", part):
+            ranks.add(int(part))
+        else:
+            raise RankSpecError(f"invalid rank spec element {part!r}")
+    bad = sorted(r for r in ranks if r >= world_size)
+    if bad:
+        raise RankSpecError(
+            f"ranks {bad} out of range for world size {world_size}")
+    return sorted(ranks)

@@ -1,0 +1,83 @@
+"""Measured cell-execution timeline.
+
+The reference's timeline subsystem (reference: magic.py:32-60 dataclasses,
+magic.py:109-396 hooks, magic.py:1316-1474 recording) tracked every cell
+but *estimated* per-line durations from keywords (magic.py:1394-1423 —
+import=5ms, torch=3ms...) and persisted via injected browser JavaScript
+that only worked in the classic notebook (magic.py:196-233).
+
+This rebuild keeps the surface (``%timeline_*`` magics, per-cell records)
+but records only measured quantities: coordinator wall-clock per cell and
+the per-rank ``duration_s`` the workers measure around user code
+(executor.execute_cell).  Persistence is a plain JSON file — frontend-
+agnostic, diffable, and loadable for replay.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class CellRecord:
+    """One distributed cell execution (reference: CellExecution,
+    magic.py:44-60 — minus the estimated per-line events)."""
+
+    index: int
+    code: str
+    target_ranks: list[int]
+    started_at: float
+    wall_s: float = 0.0
+    rank_duration_s: dict[int, float] = field(default_factory=dict)
+    rank_status: dict[int, str] = field(default_factory=dict)
+    kind: str = "distributed"  # distributed | rank | sync | local
+
+
+class Timeline:
+    def __init__(self):
+        self.records: list[CellRecord] = []
+
+    def start(self, code: str, target_ranks: list[int],
+              kind: str = "distributed") -> CellRecord:
+        rec = CellRecord(index=len(self.records), code=code,
+                         target_ranks=list(target_ranks),
+                         started_at=time.time(), kind=kind)
+        self.records.append(rec)
+        return rec
+
+    def finish(self, rec: CellRecord, responses: dict | None) -> None:
+        rec.wall_s = time.time() - rec.started_at
+        for rank, msg in (responses or {}).items():
+            data = msg.data if hasattr(msg, "data") else msg
+            if isinstance(data, dict):
+                if "duration_s" in data:
+                    rec.rank_duration_s[rank] = round(data["duration_s"], 6)
+                rec.rank_status[rank] = ("error" if data.get("error")
+                                         else "success")
+
+    def clear(self) -> None:
+        self.records.clear()
+
+    def save(self, path: str) -> int:
+        payload = [asdict(r) for r in self.records]
+        with open(path, "w") as f:
+            json.dump({"version": 1, "records": payload}, f, indent=1)
+        return len(payload)
+
+    def summary(self) -> str:
+        if not self.records:
+            return "timeline: no distributed cells recorded"
+        lines = ["idx  kind         wall_s   ranks  max_rank_s  status"]
+        for r in self.records:
+            worst = max(r.rank_duration_s.values(), default=0.0)
+            status = ("error" if "error" in r.rank_status.values()
+                      else "ok" if r.rank_status else "-")
+            preview = r.code.strip().splitlines()[0][:38] if r.code.strip() \
+                else ""
+            lines.append(
+                f"{r.index:<4d} {r.kind:<12s} {r.wall_s:<8.3f} "
+                f"{len(r.target_ranks):<6d} {worst:<11.4f} {status:<7s}"
+                f" {preview}")
+        return "\n".join(lines)

@@ -136,6 +136,13 @@ class ProcessManager:
                         except Exception:
                             pass
 
+    def quiesce(self) -> None:
+        """Stop death monitoring ahead of an intentional shutdown so
+        planned worker exits are not reported as failures."""
+        self._monitor_stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=1)
+
     def check_startup_failure(self) -> None:
         """Raise with captured stdio if any worker died during bring-up
         (reference: process_manager.py:138-150)."""
@@ -159,7 +166,8 @@ class ProcessManager:
                  kill_grace_s: float = 2.0) -> None:
         """SIGTERM → wait → SIGKILL → wait, per process group
         (reference: process_manager.py:177-227)."""
-        self._monitor_stop.set()
+        self.quiesce()  # stop + join the monitor so no shutdown path
+        # reports these intentional exits as worker deaths
         procs = list(self.processes.items())
         for _rank, proc in procs:
             if proc.poll() is None:

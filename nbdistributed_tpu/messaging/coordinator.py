@@ -137,6 +137,8 @@ class CommunicationManager:
         """
         if timeout is ...:
             timeout = self.default_timeout
+        if not ranks:
+            return {}  # an empty expectation would otherwise never complete
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
         pending = _Pending(set(ranks))
         with self._lock:

@@ -48,11 +48,13 @@ class _StreamingStdout(io.TextIOBase):
     def write(self, text: str) -> int:
         self._buffer.write(text)
         self._pending += text
-        if "\n" in self._pending:
-            lines, _, tail = self._pending.rpartition("\n")
-            self._pending = tail
+        # \r flushes too so carriage-return progress bars stream live.
+        cut = max(self._pending.rfind("\n"), self._pending.rfind("\r"))
+        if cut >= 0:
+            lines, self._pending = (self._pending[:cut + 1],
+                                    self._pending[cut + 1:])
             if lines.strip():
-                self._push(lines + "\n")
+                self._push(lines)
         return len(text)
 
     def _push(self, text: str) -> None:

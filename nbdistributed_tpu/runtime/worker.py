@@ -201,12 +201,14 @@ class DistributedWorker:
     def _handle_profile(self, msg: Message) -> Message:
         import jax
         action = msg.data.get("action")
+        log_dir = f"{msg.data.get('log_dir', '/tmp/nbd_profile')}" \
+                  f"/rank{self.rank}"
         if action == "start":
-            jax.profiler.start_trace(msg.data["log_dir"])
-            return msg.reply(data={"status": "profiling"}, rank=self.rank)
+            jax.profiler.start_trace(log_dir)
+            return msg.reply(data={"status": "profiling",
+                                   "log_dir": log_dir}, rank=self.rank)
         jax.profiler.stop_trace()
-        return msg.reply(data={"status": "stopped",
-                               "log_dir": msg.data.get("log_dir")},
+        return msg.reply(data={"status": "stopped", "log_dir": log_dir},
                          rank=self.rank)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,144 @@
+"""Notebook-surface integration: drive the magics through a real IPython
+shell with real worker subprocesses — the acceptance scenario the
+reference only demonstrated in its demo notebook (SURVEY §2.1 #21).
+"""
+
+import pytest
+
+pytestmark = [pytest.mark.integration]
+
+
+@pytest.fixture(scope="module")
+def ip():
+    from IPython.testing.globalipapp import start_ipython
+
+    shell = start_ipython()
+    shell.run_line_magic("load_ext", "nbdistributed_tpu")
+    shell.run_line_magic(
+        "dist_init", "-n 2 --backend cpu --attach-timeout 180 -t 120")
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is not None, "cluster failed to start"
+    yield shell
+    shell.run_line_magic("dist_shutdown", "")
+
+
+def run(ip, code):
+    result = ip.run_cell(code)
+    return result
+
+
+def test_plain_cell_auto_distributes(ip, capsys):
+    run(ip, "auto_val = rank * 5 + 1\nauto_val")
+    out = capsys.readouterr().out
+    assert "Rank 0" in out and "1" in out
+    assert "Rank 1" in out and "6" in out
+
+
+def test_rank_magic_targets_subset(ip, capsys):
+    run(ip, "%%rank [1]\n'only-one'")
+    out = capsys.readouterr().out
+    assert "Rank 1" in out and "only-one" in out
+    assert "Rank 0:" not in out
+
+
+def test_rank_magic_bad_spec_reports(ip, capsys):
+    run(ip, "%%rank [9]\n1+1")
+    out = capsys.readouterr().out
+    assert "out of range" in out
+
+
+def test_collective_subset_warning(ip, capsys):
+    # Reference a collective without calling it: actually running one on
+    # a subset would genuinely deadlock the mesh — which is the hazard
+    # this warning exists for.
+    run(ip, "%%rank [0]\nalias = all_reduce")
+    out = capsys.readouterr().out
+    assert "deadlock" in out.lower()
+
+
+def test_sync_magic(ip, capsys):
+    ip.run_line_magic("sync", "")
+    out = capsys.readouterr().out
+    assert "synchronized" in out
+
+
+def test_status_magic(ip, capsys):
+    ip.run_line_magic("dist_status", "")
+    out = capsys.readouterr().out
+    assert "Rank 0" in out and "Rank 1" in out
+    assert "running" in out
+    assert "backend=cpu" in out
+
+
+def test_error_reported_per_rank(ip, capsys):
+    run(ip, "if rank == 1:\n    raise ValueError('r1 only')")
+    out = capsys.readouterr().out
+    assert "Rank 1" in out and "r1 only" in out
+
+
+def test_dist_pull_array(ip, capsys):
+    run(ip, "pull_me = jnp.arange(4.0) * (rank + 1)")
+    capsys.readouterr()
+    ip.run_line_magic("dist_pull", "pull_me --rank 1 --as pulled")
+    out = capsys.readouterr().out
+    assert "✅" in out
+    import numpy as np
+    np.testing.assert_allclose(ip.user_ns["pulled"],
+                               np.arange(4.0) * 2)
+
+
+def test_dist_push_array(ip, capsys):
+    import numpy as np
+    ip.user_ns["pushed"] = np.full((3,), 9.0, np.float32)
+    ip.run_line_magic("dist_push", "pushed")
+    capsys.readouterr()
+    run(ip, "float(pushed.sum())")
+    out = capsys.readouterr().out
+    assert "27.0" in out
+
+
+def test_ide_proxies_after_distributed_cell(ip):
+    run(ip, "proxy_target = jnp.zeros((5, 6))")
+    import jax
+    assert isinstance(ip.user_ns.get("proxy_target"), jax.ShapeDtypeStruct)
+    assert ip.user_ns["proxy_target"].shape == (5, 6)
+
+
+def test_dist_mode_toggle_runs_locally(ip, capsys):
+    ip.run_line_magic("dist_mode", "-d")
+    capsys.readouterr()
+    run(ip, "local_only = 'kernel'\nprint('ran locally')")
+    out = capsys.readouterr().out
+    assert "ran locally" in out
+    assert "Rank" not in out
+    assert ip.user_ns["local_only"] == "kernel"
+    ip.run_line_magic("dist_mode", "-e")
+    capsys.readouterr()
+
+
+def test_magic_cells_not_auto_wrapped(ip, capsys):
+    run(ip, "%dist_debug")
+    out = capsys.readouterr().out
+    assert "world size" in out
+
+
+def test_timeline_records_distributed_cells(ip, capsys):
+    run(ip, "tl_probe = 1")
+    capsys.readouterr()
+    ip.run_line_magic("timeline_show", "")
+    out = capsys.readouterr().out
+    assert "tl_probe" in out
+    assert "distributed" in out
+
+
+def test_timeline_save(ip, capsys, tmp_path):
+    path = tmp_path / "tl.json"
+    ip.run_line_magic("timeline_save", str(path))
+    out = capsys.readouterr().out
+    assert "saved" in out and path.exists()
+
+
+def test_namespace_info_magic_surface(ip, capsys):
+    ip.run_line_magic("dist_sync_ide", "")
+    out = capsys.readouterr().out
+    assert "synced" in out

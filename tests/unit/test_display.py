@@ -1,0 +1,57 @@
+"""Streaming display + error reporting tests."""
+
+from nbdistributed_tpu.magics.display import StreamDisplay, print_rank_errors
+from nbdistributed_tpu.messaging import Message
+
+
+def collect():
+    out = []
+    return out, lambda s: out.append(s)
+
+
+def test_rank_headers_group_consecutive_output():
+    out, p = collect()
+    d = StreamDisplay(print_fn=p)
+    d.feed(0, {"text": "a\n", "stream": "stdout"})
+    d.feed(0, {"text": "b\n", "stream": "stdout"})
+    d.feed(1, {"text": "c\n", "stream": "stdout"})
+    d.drain()
+    assert "".join(out) == "🔹 Rank 0:\na\nb\n🔹 Rank 1:\nc\n"
+
+
+def test_incremental_drain_no_duplicates():
+    out, p = collect()
+    d = StreamDisplay(print_fn=p)
+    d.feed(0, {"text": "first\n", "stream": "stdout"})
+    assert d.drain() is True
+    assert d.drain() is False
+    d.feed(0, {"text": "second\n", "stream": "stdout"})
+    d.drain()
+    joined = "".join(out)
+    assert joined.count("first") == 1 and joined.count("second") == 1
+    assert joined.count("Rank 0") == 1  # same rank -> one header
+
+
+def test_blank_and_noise_filtered():
+    out, p = collect()
+    d = StreamDisplay(print_fn=p)
+    d.feed(0, {"text": "   \n", "stream": "stdout"})
+    d.feed(0, {"text": "<IPython.core.display.Javascript object>\n",
+               "stream": "stdout"})
+    d.drain()
+    assert out == []
+
+
+def test_print_rank_errors_only_failures():
+    out, p = collect()
+    responses = {
+        0: Message(msg_type="response", rank=0,
+                   data={"output": "4", "status": "success"}),
+        1: Message(msg_type="response", rank=1,
+                   data={"error": "boom", "traceback": "Trace...\n"}),
+    }
+    failed = print_rank_errors(responses, print_fn=p)
+    joined = "".join(out)
+    assert failed == 1
+    assert "Rank 1" in joined and "boom" in joined
+    assert "Rank 0" not in joined

@@ -1,0 +1,150 @@
+"""IDE proxy generation tests (reference behavior: magic.py:1131-1314)."""
+
+import jax
+import pytest
+
+from nbdistributed_tpu.magics import proxies
+
+
+def test_array_proxy_is_shape_dtype_struct():
+    p, ok = proxies.make_proxy("w", {"kind": "array", "shape": [2, 3],
+                                     "dtype": "float32"})
+    assert ok
+    assert isinstance(p, jax.ShapeDtypeStruct)
+    assert p.shape == (2, 3) and str(p.dtype) == "float32"
+
+
+def test_bfloat16_array_proxy_falls_back():
+    p, ok = proxies.make_proxy("w", {"kind": "array", "shape": [4],
+                                     "dtype": "bfloat16"})
+    assert ok and p.shape == (4,)
+
+
+def test_scalar_proxy_reconstructs_value():
+    p, ok = proxies.make_proxy("x", {"kind": "scalar", "type": "int",
+                                     "repr": "42"})
+    assert ok and p == 42
+
+
+def test_callable_stub_raises_with_guidance():
+    desc = {"kind": "callable", "signature": "(a, b=1)", "doc": "adds",
+            "name": "f"}
+    stub, ok = proxies.make_proxy("f", desc)
+    assert ok
+    assert "(a, b=1)" in stub.__doc__
+    with pytest.raises(RuntimeError, match="workers"):
+        stub(1, 2)
+
+
+def test_module_proxy_real_import():
+    p, ok = proxies.make_proxy("json", {"kind": "module", "name": "json"})
+    import json as real_json
+    assert ok and p is real_json
+
+
+def test_module_proxy_placeholder_for_missing():
+    p, ok = proxies.make_proxy("ghost", {"kind": "module",
+                                         "name": "no_such_module_xyz"})
+    assert ok and p.__name__ == "no_such_module_xyz"
+
+
+def test_class_proxy():
+    p, ok = proxies.make_proxy("Net", {"kind": "class", "name": "Net",
+                                       "module": "models"})
+    assert ok and isinstance(p, type) and p.__name__ == "Net"
+
+
+def test_container_proxy_repr():
+    p, ok = proxies.make_proxy("xs", {"kind": "container", "type": "list",
+                                      "len": 7})
+    assert ok and "list" in repr(p) and "7" in repr(p)
+
+
+def test_sync_respects_user_variables():
+    user_ns = {"mine": "precious"}
+    reg = {}
+    info = {"mine": {"kind": "scalar", "type": "int", "repr": "1"},
+            "theirs": {"kind": "scalar", "type": "int", "repr": "2"}}
+    n = proxies.sync_namespace(user_ns, info, reg)
+    assert user_ns["mine"] == "precious"  # never clobbered
+    assert user_ns["theirs"] == 2
+    assert n == 1
+
+
+def test_sync_user_created_shapedtypestruct_untouched():
+    """A user's own ShapeDtypeStruct must survive syncs — ownership is
+    identity-tracked, not type-sniffed."""
+    import jax
+    import numpy as np
+    spec = jax.ShapeDtypeStruct((8,), np.float32)
+    user_ns = {"spec": spec}
+    reg = {}
+    proxies.sync_namespace(user_ns, {}, reg)
+    assert user_ns["spec"] is spec
+    proxies.sync_namespace(
+        user_ns, {"spec": {"kind": "array", "shape": [2],
+                           "dtype": "float32"}}, reg)
+    assert user_ns["spec"] is spec  # still the user's object
+
+
+def test_sync_removes_stale_proxies():
+    user_ns = {}
+    reg = {}
+    proxies.sync_namespace(user_ns, {"tmp": {"kind": "array", "shape": [1],
+                                             "dtype": "float32"}}, reg)
+    assert "tmp" in user_ns
+    proxies.sync_namespace(user_ns, {}, reg)
+    assert "tmp" not in user_ns and reg == {}
+
+
+def test_sync_refreshes_owned_proxies():
+    user_ns = {}
+    reg = {}
+    proxies.sync_namespace(
+        user_ns, {"w": {"kind": "array", "shape": [2], "dtype": "float32"}},
+        reg)
+    proxies.sync_namespace(
+        user_ns, {"w": {"kind": "array", "shape": [9], "dtype": "float32"}},
+        reg)
+    assert user_ns["w"].shape == (9,)  # owned proxies track remote changes
+
+
+def test_sync_user_overwrite_reclaims_name():
+    user_ns = {}
+    reg = {}
+    proxies.sync_namespace(
+        user_ns, {"w": {"kind": "array", "shape": [2], "dtype": "float32"}},
+        reg)
+    user_ns["w"] = "user took this name"
+    proxies.sync_namespace(
+        user_ns, {"w": {"kind": "array", "shape": [9], "dtype": "float32"}},
+        reg)
+    assert user_ns["w"] == "user took this name"
+    assert "w" not in reg
+
+
+def test_sync_skips_seeded_and_private_names():
+    user_ns = {}
+    reg = {}
+    info = {"jax": {"kind": "module", "name": "jax"},
+            "rank": {"kind": "scalar", "type": "int", "repr": "0"},
+            "all_reduce": {"kind": "callable", "signature": "(x)",
+                           "name": "all_reduce"},
+            "_hidden": {"kind": "scalar", "type": "int", "repr": "1"},
+            "ok": {"kind": "scalar", "type": "int", "repr": "3"}}
+    n = proxies.sync_namespace(user_ns, info, reg)
+    assert n == 1
+    assert set(user_ns) == {"ok"}
+
+
+def test_remove_proxies_clears_owned_only():
+    user_ns = {}
+    reg = {}
+    proxies.sync_namespace(
+        user_ns, {"w": {"kind": "array", "shape": [2], "dtype": "float32"},
+                  "f": {"kind": "callable", "signature": "()", "name": "f"}},
+        reg)
+    user_ns["w"] = "reclaimed"
+    proxies.remove_proxies(user_ns, reg)
+    assert user_ns == {"w": "reclaimed"}
+    assert reg == {}

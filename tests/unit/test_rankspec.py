@@ -1,0 +1,44 @@
+"""Rank-spec grammar tests (reference grammar: magic.py:1679-1715)."""
+
+import pytest
+
+from nbdistributed_tpu.magics.rankspec import RankSpecError, parse_ranks
+
+
+def test_simple_list():
+    assert parse_ranks("[0,1]", 4) == [0, 1]
+
+
+def test_range():
+    assert parse_ranks("[0-2]", 4) == [0, 1, 2]
+
+
+def test_mixed_and_spaces():
+    assert parse_ranks("[0, 2-3, 1]", 8) == [0, 1, 2, 3]
+
+
+def test_duplicates_collapse():
+    assert parse_ranks("[1,1,1-2]", 4) == [1, 2]
+
+
+def test_single():
+    assert parse_ranks("[3]", 4) == [3]
+
+
+def test_out_of_range_is_error_not_silent():
+    # The reference silently filtered these (magic.py:1697-1715); we
+    # surface the typo instead.
+    with pytest.raises(RankSpecError, match=r"\[5\]"):
+        parse_ranks("[1,5]", 4)
+
+
+def test_descending_range_rejected():
+    with pytest.raises(RankSpecError):
+        parse_ranks("[3-1]", 8)
+
+
+@pytest.mark.parametrize("bad", ["", "0,1", "[", "[]", "[a]", "[1;2]",
+                                 "[-1]", "[1.5]"])
+def test_malformed_specs_rejected(bad):
+    with pytest.raises(RankSpecError):
+        parse_ranks(bad, 8)

@@ -1,0 +1,54 @@
+"""Timeline recording tests — measured, not estimated (SURVEY §5.1)."""
+
+import json
+
+from nbdistributed_tpu.magics.timeline import Timeline
+from nbdistributed_tpu.messaging import Message
+
+
+def fake_responses():
+    return {
+        0: Message(msg_type="response", rank=0,
+                   data={"output": "1", "status": "success",
+                         "duration_s": 0.25}),
+        1: Message(msg_type="response", rank=1,
+                   data={"error": "boom", "duration_s": 0.1}),
+    }
+
+
+def test_record_lifecycle():
+    tl = Timeline()
+    rec = tl.start("x = 1", [0, 1])
+    tl.finish(rec, fake_responses())
+    assert rec.wall_s >= 0
+    assert rec.rank_duration_s == {0: 0.25, 1: 0.1}
+    assert rec.rank_status == {0: "success", 1: "error"}
+
+
+def test_summary_lists_cells():
+    tl = Timeline()
+    tl.finish(tl.start("first_cell()", [0]), None)
+    tl.finish(tl.start("second_cell()", [0, 1]), fake_responses())
+    s = tl.summary()
+    assert "first_cell" in s and "second_cell" in s
+    assert "error" in s
+
+
+def test_save_roundtrip(tmp_path):
+    tl = Timeline()
+    tl.finish(tl.start("x", [0]), fake_responses())
+    path = tmp_path / "tl.json"
+    n = tl.save(str(path))
+    assert n == 1
+    loaded = json.loads(path.read_text())
+    assert loaded["version"] == 1
+    assert loaded["records"][0]["code"] == "x"
+    assert loaded["records"][0]["rank_duration_s"]["0"] == 0.25
+
+
+def test_clear():
+    tl = Timeline()
+    tl.start("x", [0])
+    tl.clear()
+    assert tl.records == []
+    assert "no distributed cells" in tl.summary()
