@@ -1,0 +1,157 @@
+"""Benchmark: DDP train-step throughput driven cell-by-cell through the
+full framework stack (BASELINE.json config #3: "4-rank DDP
+nn.Linear(1024,1024) SGD loop driven cell-by-cell via %%distributed").
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What it measures: the coordinator spawns workers (one per available
+accelerator — on a 1-chip host, one TPU worker), sends each training
+step as its own ``execute`` cell over the control plane, and measures
+end-to-end steps/second — i.e. compute + the interactive framework's
+full per-cell overhead.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so
+the comparison point is the reference's *architectural* per-cell floor:
+its coordinator polls the display buffer and the ZMQ socket at 100 ms
+each, bounding any cell-by-cell loop at ~0.2 s/cell + compute
+(SURVEY §3.2 "latency floor ~200 ms per cell").  vs_baseline =
+our_steps_per_s / (1 / (0.2 + measured_compute_s)).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nbdistributed_tpu.manager import ProcessManager, topology
+from nbdistributed_tpu.messaging import CommunicationManager
+
+STEPS = 60
+WARMUP = 5
+
+SETUP = """
+import jax, jax.numpy as jnp, optax
+key = jax.random.PRNGKey(rank)
+W = jax.random.normal(key, (1024, 1024), jnp.float32) * 0.02
+b = jnp.zeros((1024,), jnp.float32)
+opt = optax.sgd(1e-3)
+state = opt.init((W, b))
+x = jax.random.normal(jax.random.PRNGKey(100 + rank), (256, 1024))
+y = jax.random.normal(jax.random.PRNGKey(200 + rank), (256, 1024))
+
+def loss_fn(params, x, y):
+    W, b = params
+    pred = x @ W + b
+    return jnp.mean((pred - y) ** 2)
+
+@jax.jit
+def local_step(params, state, x, y):
+    l, g = jax.value_and_grad(loss_fn)(params, x, y)
+    if world_size > 1:
+        g = jax.tree.map(lambda t: all_reduce(t, "mean"), g)
+    u, state = opt.update(g, state, params)
+    return optax.apply_updates(params, u), state, l
+
+params = (W, b)
+params, state, _ = local_step(params, state, x, y)  # compile
+jax.block_until_ready(params)
+'ready'
+"""
+
+STEP_CELL = """
+params, state, loss_val = local_step(params, state, x, y)
+jax.block_until_ready(params)
+float(loss_val)
+"""
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    backend = topology.detect_backend()
+    # World size: NBD_BENCH_WORLD env overrides; default is one worker
+    # per TPU chip on this host (the bench host has 1), or 2 CPU/gloo
+    # workers so the DDP all_reduce branch is a real cross-process
+    # collective.
+    default_world = "1" if backend == "tpu" else "2"
+    world = int(os.environ.get("NBD_BENCH_WORLD", default_world))
+    log(f"[bench] backend={backend} world={world}")
+
+    comm = CommunicationManager(num_workers=world, timeout=300)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    try:
+        pm.start_workers(world, comm.port, backend=backend)
+        deadline = time.time() + 240
+        while True:
+            try:
+                comm.wait_for_workers(timeout=2)
+                break
+            except TimeoutError:
+                pm.check_startup_failure()
+                if time.time() > deadline:
+                    raise
+        log("[bench] workers attached; running setup cell")
+        resp = comm.send_to_all("execute", SETUP, timeout=600)
+        for r, m in resp.items():
+            if m.data.get("error"):
+                log(f"[bench] setup failed on rank {r}: "
+                    f"{m.data['traceback']}")
+                return 1
+
+        for _ in range(WARMUP):
+            comm.send_to_all("execute", STEP_CELL, timeout=600)
+
+        # compute = worker-side measured duration (excludes the control
+        # plane), collected from the same steps we time end-to-end
+        durations = []
+        t0 = time.time()
+        for i in range(STEPS):
+            resp = comm.send_to_all("execute", STEP_CELL, timeout=600)
+            for r, m in resp.items():
+                if m.data.get("error"):
+                    log(f"[bench] step {i} failed on rank {r}")
+                    return 1
+            durations.append(max(m.data["duration_s"]
+                                 for m in resp.values()))
+        elapsed = time.time() - t0
+        steps_per_s = STEPS / elapsed
+        durations.sort()
+        compute = durations[len(durations) // 2]
+        overhead_ms = (elapsed / STEPS - compute) * 1000
+
+        # Reference architectural floor: 100ms display poll + 100ms ZMQ
+        # poll per cell (SURVEY §3.2) on top of the same compute.
+        ref_floor_steps_per_s = 1.0 / (0.2 + compute)
+        vs_baseline = steps_per_s / ref_floor_steps_per_s
+
+        log(f"[bench] {STEPS} cell-steps in {elapsed:.2f}s; "
+            f"compute={compute*1000:.2f}ms/step, "
+            f"framework overhead={overhead_ms:.2f}ms/step")
+        print(json.dumps({
+            "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"
+                      f"_x{world}",
+            "value": round(steps_per_s, 2),
+            "unit": "steps/s",
+            "vs_baseline": round(vs_baseline, 2),
+        }), flush=True)
+        return 0
+    finally:
+        try:
+            comm.post(list(range(world)), "shutdown")
+            time.sleep(0.3)
+        except Exception:
+            pass
+        pm.shutdown()
+        comm.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

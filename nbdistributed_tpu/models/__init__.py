@@ -1,0 +1,11 @@
+"""Model families: TPU-first Llama-style transformers (configs from the
+tiny demo scale up to Llama-2-7B, matching BASELINE.json's acceptance
+configs)."""
+
+from .transformer import (TransformerConfig, forward, init_params,
+                          llama2_7b_config, loss_fn, make_train_step,
+                          param_shardings, smol_135m_config, tiny_config)
+
+__all__ = ["TransformerConfig", "forward", "init_params",
+           "llama2_7b_config", "loss_fn", "make_train_step",
+           "param_shardings", "smol_135m_config", "tiny_config"]

@@ -1,0 +1,234 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+The reference framework ships no models (users bring HF torch models in
+cells — its demo runs SmolLM2-135M: 00_accelerate.ipynb cell 10); a
+TPU-native framework needs a first-party model family for its
+benchmarks and acceptance configs (BASELINE.json: tiny transformer DDP,
+Llama-2-7B tensor-parallel forward).  Design:
+
+* pure-JAX pytree params (no framework dependency on flax), bfloat16
+  activations, fp32 RMSNorm accumulation — MXU-friendly;
+* rotary embeddings, grouped-query attention (flash kernel from
+  :mod:`nbdistributed_tpu.ops`), SwiGLU MLP — the Llama recipe;
+* explicit ``PartitionSpec`` rules per parameter for dp/tp meshes
+  (Megatron-style column/row splits expressed as shardings — XLA
+  inserts the all-reduces the reference's users typed by hand,
+  README.md:115-125);
+* ``lax.scan`` over layers for O(1) compile scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        emb = self.vocab_size * self.d_model
+        attn = (self.d_model * self.n_heads * self.head_dim
+                + 2 * self.d_model * self.n_kv_heads * self.head_dim
+                + self.n_heads * self.head_dim * self.d_model)
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return emb * 2 + self.n_layers * (attn + mlp + norms) + self.d_model
+
+
+# Preset configs.  llama2_7b matches the acceptance config in
+# BASELINE.json ("8-rank Llama-2-7B forward"); tiny is the test/demo
+# scale (SmolLM2-135M-like role in the reference's notebook).
+def tiny_config(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=384,
+                             max_seq_len=256, **kw)
+
+
+def smol_135m_config(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=49152, d_model=576, n_layers=30,
+                             n_heads=9, n_kv_heads=3, d_ff=1536,
+                             max_seq_len=2048, **kw)
+
+
+def llama2_7b_config(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=32, d_ff=11008,
+                             max_seq_len=4096, **kw)
+
+
+# ----------------------------------------------------------------------
+# parameters
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    """Layer-stacked parameter pytree: per-layer arrays carry a leading
+    (n_layers,) axis so the forward can ``lax.scan`` over them."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    D, H, Hkv, Dh, F, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cfg.d_ff, cfg.n_layers)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "embed": normal(k_emb, (cfg.vocab_size, D), 1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": normal(ks[0], (L, D, H * Dh), D),
+            "wk": normal(ks[1], (L, D, Hkv * Dh), D),
+            "wv": normal(ks[2], (L, D, Hkv * Dh), D),
+            "wo": normal(ks[3], (L, H * Dh, D), H * Dh),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "w_gate": normal(ks[4], (L, D, F), D),
+            "w_up": normal(ks[5], (L, D, F), D),
+            "w_down": normal(ks[6], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": normal(k_out, (D, cfg.vocab_size), D),
+    }
+    return params
+
+
+def param_shardings(cfg: TransformerConfig) -> dict:
+    """Megatron-style tensor-parallel sharding rules over mesh axis
+    ``tp`` (columns of qkv/gate/up; rows of o/down — so each layer needs
+    exactly one all-reduce per block, inserted by XLA)."""
+    return {
+        "embed": P(None, "tp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# ----------------------------------------------------------------------
+# forward
+
+def _rms_norm(x, weight, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding.  x: (B, S, H, D); positions: (B, S)."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B,S,half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_block(x, layer, cfg: TransformerConfig, positions):
+    B, S, D = x.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(B, S, H, Dh)
+    k = (h @ layer["wk"]).reshape(B, S, Hkv, Dh)
+    v = (h @ layer["wv"]).reshape(B, S, Hkv, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.use_flash:
+        o = flash_attention(q, k, v, True)
+    else:
+        from ..ops import attention_reference
+        o = attention_reference(q, k, v, causal=True)
+    return x + o.reshape(B, S, H * Dh) @ layer["wo"]
+
+
+def _mlp_block(x, layer, cfg: TransformerConfig):
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"]
+
+
+def forward(params: dict, tokens, cfg: TransformerConfig,
+            positions=None):
+    """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer_step(x, layer):
+        x = _attention_block(x, layer, cfg, positions)
+        x = _mlp_block(x, layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: TransformerConfig):
+    """Next-token cross-entropy.  batch: {tokens (B,S)}; predicts
+    tokens[:, 1:] from tokens[:, :-1]."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+# ----------------------------------------------------------------------
+# training step
+
+def make_train_step(cfg: TransformerConfig, optimizer):
+    """Returns ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)`` — shard params/batch and jit with shardings to scale it over
+    any dp/tp mesh (XLA inserts gradient all-reduces for dp and
+    activation collectives for tp)."""
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+            params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def num_tokens_per_step(batch_shape) -> int:
+    return int(np.prod(batch_shape))

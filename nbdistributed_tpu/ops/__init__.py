@@ -1,0 +1,5 @@
+"""TPU compute ops: Pallas kernels with reference fallbacks."""
+
+from .attention import attention_reference, flash_attention
+
+__all__ = ["attention_reference", "flash_attention"]

@@ -1,0 +1,41 @@
+"""Data-parallel training: the DDP capability, the XLA way.
+
+The reference demonstrates DDP through user-space HF Accelerate in its
+notebook (00_accelerate.ipynb cells 36-40) and hand-written all_reduce
+loops (README.md:97-111).  TPU-native DDP needs no wrapper class at all:
+replicate params, shard the batch on the ``dp`` mesh axis, and jit — the
+gradient all-reduce is inserted by XLA from the sharding lattice.  This
+module packages that recipe.
+"""
+
+from __future__ import annotations
+
+from . import mesh as mesh_mod
+
+
+def make_ddp_step(loss_fn, optimizer, mesh, *, dp_axis: str = "dp",
+                  donate: bool = True):
+    """Build a jitted DDP train step.
+
+    ``loss_fn(params, batch) -> scalar``.  Params/opt state are
+    replicated; the batch arrives sharded on ``dp_axis``; XLA turns the
+    replicated-gradient requirement into an ICI all-reduce.
+
+    DDP is the all-replicated special case of the tensor-parallel step
+    builder — one step body to maintain (grad clipping, loss scaling,
+    etc. land in one place).
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)``.
+    """
+    from . import tensor_parallel
+    return tensor_parallel.make_tp_train_step(
+        loss_fn, optimizer, mesh, param_rules=None, dp_axis=dp_axis,
+        donate=donate)
+
+
+def ddp_init(params, opt_state, mesh):
+    """Replicate params + optimizer state across the mesh (the
+    ``accelerator.prepare`` analog)."""
+    return (mesh_mod.replicate(params, mesh),
+            mesh_mod.replicate(opt_state, mesh))

@@ -1,0 +1,72 @@
+"""Named-axis mesh construction for dp/tp/sp topologies.
+
+The reference's topology knob was ``--gpu-ids`` (reference:
+process_manager.py:107-112); TPU-native topology is a logical mesh over
+the global device set with named axes that sharding rules refer to
+(SURVEY §5.6 maps the flag surface).  These helpers are seeded into
+worker namespaces and used by the model/parallel stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_mesh(axis_sizes: dict[str, int] | None = None,
+              devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    ``axis_sizes`` maps axis name -> size, in layout-major order, e.g.
+    ``{"dp": 2, "tp": 4}``.  A size of -1 means "whatever is left"
+    (at most one axis).  Default: 1-D data-parallel mesh over all
+    devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"dp": n}
+    sizes = dict(axis_sizes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes[wild[0]] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices but {n} are available")
+    arr = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def shard_batch(batch, mesh, axis: str = "dp"):
+    """Place a host-local batch pytree onto the mesh, sharded on the
+    leading dimension over ``axis`` (replicated over other axes)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    spec = P(axis)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: multihost_utils.host_local_array_to_global_array(
+                np.asarray(x), mesh, spec), batch)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree, mesh):
+    """Replicate a pytree across the whole mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding),
+                                  tree)

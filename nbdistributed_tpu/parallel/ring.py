@@ -1,0 +1,90 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Long-context training shards the *sequence* axis across devices (the
+reference has no sequence-parallel story at all: SURVEY §5.7).  Ring
+attention keeps the O(S^2) score matrix virtual: each device holds one
+sequence chunk of Q locally and streams K/V chunks around the ring via
+``jax.lax.ppermute`` (ICI neighbor exchange), folding each visiting
+chunk into an online-softmax accumulator — so communication overlaps
+compute blockwise and peak memory stays O(S/n · S/n) per step.
+
+This is the shard_map/ppermute formulation the scaling-book recipe
+prescribes; the same math as the flash kernel's inner loop
+(ops/attention.py), lifted from k-blocks to ring hops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_fn(mesh, axis: str, causal: bool, scale: float):
+    """Jitted ring kernel, cached per (mesh, axis, causal, scale) so
+    repeated training-loop calls hit the jit cache instead of retracing."""
+    n = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+    inner = functools.partial(_ring_inner, axis=axis, n=n, causal=causal,
+                              scale=scale)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = "sp",
+                   causal: bool = True, scale: float | None = None):
+    """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
+    sequence dimension.
+
+    q/k/v: (B, S, H, D) global arrays whose S dimension is sharded over
+    ``mesh[axis]``; returns attention output with the same sharding.
+    n_kv_heads must equal n_heads here (expand GQA before sharding).
+    """
+    D = q.shape[-1]
+    scale = scale if scale is not None else float(1.0 / np.sqrt(D))
+    return _ring_fn(mesh, axis, causal, scale)(q, k, v)
+
+
+def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+    B, Sq, H, Dh = q.shape
+    my = jax.lax.axis_index(axis)
+    qf = q.astype(jnp.float32) * scale
+    acc = jnp.zeros((B, Sq, H, Dh), jnp.float32)
+    m = jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(step, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (my - step) % n  # which chunk we currently hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            qi = (my * Sq
+                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0))
+            ki = (src * Sq
+                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1))
+            s = jnp.where((ki <= qi)[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # (B,H,Sq,Sk)
+        corr = jnp.exp(m - m_new)                    # (B,H,Sq,1)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr.transpose(0, 2, 1, 3) + pv
+        # Rotate K/V to the next device; overlapped with the next
+        # step's compute by XLA's async collective scheduling.
+        k_next = jax.lax.ppermute(k_cur, axis, perm)
+        v_next = jax.lax.ppermute(v_cur, axis, perm)
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc, m, l, k, v))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

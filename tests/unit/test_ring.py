@@ -1,0 +1,46 @@
+"""Ring attention vs full attention on the 8-device virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.ops import attention_reference
+from nbdistributed_tpu.parallel import mesh as mesh_mod
+from nbdistributed_tpu.parallel.ring import ring_attention
+
+
+def rand(shape, key):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return mesh_mod.make_mesh({"sp": 8})
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_full_attention(sp_mesh, causal):
+    B, S, H, D = 2, 64, 2, 16  # S shards into 8 chunks of 8
+    q, k, v = (rand((B, S, H, D), i) for i in range(3))
+    out = ring_attention(q, k, v, sp_mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_output_stays_sequence_sharded(sp_mesh):
+    B, S, H, D = 1, 64, 2, 16
+    q, k, v = (rand((B, S, H, D), i + 3) for i in range(3))
+    out = ring_attention(q, k, v, sp_mesh)
+    assert len(out.sharding.device_set) == 8
+
+
+def test_ring_long_sequence(sp_mesh):
+    """Longer-than-VMEM-friendly sequence: the point of the exercise."""
+    B, S, H, D = 1, 512, 2, 32
+    q, k, v = (rand((B, S, H, D), i + 7) for i in range(3))
+    out = ring_attention(q, k, v, sp_mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
