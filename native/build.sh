@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native control-plane transport.
+set -e
+cd "$(dirname "$0")"
+g++ -std=c++17 -O2 -shared -fPIC -pthread \
+    -o libnbdtransport.so nbd_transport.cpp
+echo "built $(pwd)/libnbdtransport.so"

@@ -1,0 +1,381 @@
+// nbd_transport: native control-plane listener for nbdistributed_tpu.
+//
+// First-party C++ equivalent of the role libzmq (C) plays in the
+// reference (reference: pyproject.toml:32 pulls pyzmq; the coordinator
+// ROUTER socket lives at communication.py:124-125).  The coordinator's
+// fan-in is the control plane's hot point, so it is implemented here as
+// an epoll event loop with wire-format framing done in native code; the
+// Python layer pops ready events (connect/disconnect/whole frames) from
+// a thread-safe queue via ctypes — no Python-callback reentrancy, no
+// per-byte GIL traffic.
+//
+// Protocol (shared with the pure-Python listener in
+// nbdistributed_tpu/messaging/transport.py):
+//   connection preamble: "NBDW" + int32 rank (little-endian)
+//   frames:              "NBD1" + u32 header_len + u64 payload_len + body
+//
+// Build: native/build.sh  (g++ -O2 -shared -fPIC)
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr char kPreambleMagic[4] = {'N', 'B', 'D', 'W'};
+constexpr char kFrameMagic[4] = {'N', 'B', 'D', '1'};
+constexpr size_t kPreambleSize = 8;
+constexpr size_t kFrameHeaderSize = 16;  // magic + u32 hlen + u64 plen
+// Per-field sanity bounds, checked BEFORE summing so the total cannot
+// overflow (hlen <= 2^30, plen <= 2^40: total < 2^41 << 2^64).  The
+// payload bound is far above any real control-plane frame, matching the
+// Python listener's effectively-unbounded behavior.
+constexpr uint32_t kMaxHeaderLen = 1u << 30;
+constexpr uint64_t kMaxPayloadLen = 1ull << 40;
+
+enum EventType : int32_t {
+  kEventMessage = 0,
+  kEventConnect = 1,
+  kEventDisconnect = 2,
+};
+
+struct Event {
+  int32_t type;
+  int32_t rank;
+  std::vector<uint8_t> frame;
+};
+
+struct Conn {
+  int fd = -1;
+  int32_t rank = -1;  // -1 until preamble parsed
+  std::vector<uint8_t> rbuf;
+  std::mutex wlock;
+
+  // The fd is closed only when the last shared_ptr drops: a concurrent
+  // Send() holding the Conn keeps the fd number from being reused by a
+  // fresh accept while it is mid-write.  Drop paths call ::shutdown
+  // first, so such writes fail with EPIPE instead of corrupting a new
+  // connection's stream.
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+class Listener {
+ public:
+  int Init(const char* host, int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) < 0)
+      return -1;
+    if (::listen(listen_fd_, 128) < 0) return -1;
+    socklen_t len = sizeof(addr);
+    if (::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+                      &len) < 0)
+      return -1;
+    bound_port_ = ntohs(addr.sin_port);
+
+    epfd_ = ::epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = ::eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    if (epfd_ < 0 || wake_fd_ < 0) return -1;
+    AddEpoll(listen_fd_);
+    AddEpoll(wake_fd_);
+    running_ = true;
+    loop_ = std::thread([this] { Loop(); });
+    return bound_port_;
+  }
+
+  void Close() {
+    if (!running_.exchange(false)) return;
+    Wake();
+    if (loop_.joinable()) loop_.join();
+    for (auto& kv : conns_by_fd_) ::shutdown(kv.first, SHUT_RDWR);
+    conns_by_fd_.clear();   // destructors close fds once senders finish
+    conns_by_rank_.clear();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    if (wake_fd_ >= 0) ::close(wake_fd_);
+    if (epfd_ >= 0) ::close(epfd_);
+    listen_fd_ = wake_fd_ = epfd_ = -1;
+    queue_cv_.notify_all();
+  }
+
+  // Blocks up to timeout_ms for the next event.  Returns 1 and fills the
+  // out params on success, 0 on timeout, -1 if closed.  The returned
+  // frame pointer stays valid until the next Poll call on this handle.
+  int Poll(int timeout_ms, int32_t* type, int32_t* rank,
+           const uint8_t** data, uint64_t* size) {
+    std::unique_lock<std::mutex> lk(queue_mu_);
+    if (!queue_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                            [this] { return !queue_.empty() || !running_; }))
+      return 0;
+    if (queue_.empty()) return running_ ? 0 : -1;
+    current_ = std::move(queue_.front());
+    queue_.pop_front();
+    *type = current_.type;
+    *rank = current_.rank;
+    *data = current_.frame.data();
+    *size = current_.frame.size();
+    return 1;
+  }
+
+  // Thread-safe full-frame send to one rank.  0 on success.
+  int Send(int32_t rank, const uint8_t* data, uint64_t size) {
+    std::shared_ptr<Conn> conn;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = conns_by_rank_.find(rank);
+      if (it == conns_by_rank_.end()) return -1;
+      conn = it->second;
+    }
+    std::lock_guard<std::mutex> wg(conn->wlock);
+    uint64_t sent = 0;
+    while (sent < size) {
+      ssize_t n = ::send(conn->fd, data + sent, size - sent, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          // Writer threads may block; the socket is blocking-mode for
+          // writes (only reads go through epoll), so this is rare.
+          continue;
+        }
+        return -1;
+      }
+      sent += static_cast<uint64_t>(n);
+    }
+    return 0;
+  }
+
+  int Ranks(int32_t* out, int max) {
+    std::lock_guard<std::mutex> g(mu_);
+    int n = 0;
+    for (auto& kv : conns_by_rank_) {
+      if (n >= max) break;
+      out[n++] = kv.first;
+    }
+    return n;
+  }
+
+  int port() const { return bound_port_; }
+
+ private:
+  void AddEpoll(int fd) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    ::epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void Wake() {
+    uint64_t one = 1;
+    [[maybe_unused]] ssize_t r = ::write(wake_fd_, &one, sizeof(one));
+  }
+
+  void Push(Event ev) {
+    {
+      std::lock_guard<std::mutex> g(queue_mu_);
+      queue_.push_back(std::move(ev));
+    }
+    queue_cv_.notify_one();
+  }
+
+  void Loop() {
+    epoll_event events[64];
+    while (running_.load()) {
+      int n = ::epoll_wait(epfd_, events, 64, 500);
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == wake_fd_) {
+          uint64_t drain;
+          while (::read(wake_fd_, &drain, sizeof(drain)) > 0) {
+          }
+        } else if (fd == listen_fd_) {
+          Accept();
+        } else {
+          Service(fd);
+        }
+      }
+    }
+  }
+
+  void Accept() {
+    // Level-triggered epoll on a blocking listen socket: one accept per
+    // readiness event; remaining backlog re-triggers immediately.
+    int fd = ::accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+    if (fd < 0) return;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Conn>();
+    conn->fd = fd;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      conns_by_fd_[fd] = conn;
+    }
+    AddEpoll(fd);
+  }
+
+  void Service(int fd) {
+    std::shared_ptr<Conn> conn;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = conns_by_fd_.find(fd);
+      if (it == conns_by_fd_.end()) return;
+      conn = it->second;
+    }
+    uint8_t buf[1 << 16];
+    ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EINTR)) return;
+      Drop(conn);
+      return;
+    }
+    auto& rb = conn->rbuf;
+    rb.insert(rb.end(), buf, buf + n);
+
+    if (conn->rank < 0) {
+      if (rb.size() < kPreambleSize) return;
+      if (std::memcmp(rb.data(), kPreambleMagic, 4) != 0) {
+        Drop(conn);
+        return;
+      }
+      int32_t rank;
+      std::memcpy(&rank, rb.data() + 4, 4);
+      rb.erase(rb.begin(), rb.begin() + kPreambleSize);
+      conn->rank = rank;
+      std::shared_ptr<Conn> old;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = conns_by_rank_.find(rank);
+        if (it != conns_by_rank_.end()) old = it->second;
+        conns_by_rank_[rank] = conn;
+      }
+      if (old) {
+        // Reconnect replaced the rank's connection; silently retire the
+        // old socket (no disconnect event — the rank is still live).
+        old->rank = -1;
+        RemoveFd(old);
+      }
+      Push({kEventConnect, rank, {}});
+    }
+
+    while (true) {
+      if (rb.size() < kFrameHeaderSize) break;
+      if (std::memcmp(rb.data(), kFrameMagic, 4) != 0) {
+        Drop(conn);
+        return;
+      }
+      uint32_t hlen;
+      uint64_t plen;
+      std::memcpy(&hlen, rb.data() + 4, 4);
+      std::memcpy(&plen, rb.data() + 8, 8);
+      if (hlen > kMaxHeaderLen || plen > kMaxPayloadLen) {
+        Drop(conn);
+        return;
+      }
+      uint64_t total = kFrameHeaderSize + hlen + plen;
+      if (rb.size() < total) break;
+      Event ev{kEventMessage, conn->rank, {}};
+      ev.frame.assign(rb.begin(), rb.begin() + total);
+      rb.erase(rb.begin(), rb.begin() + total);
+      Push(std::move(ev));
+    }
+  }
+
+  void RemoveFd(const std::shared_ptr<Conn>& conn) {
+    ::epoll_ctl(epfd_, EPOLL_CTL_DEL, conn->fd, nullptr);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      conns_by_fd_.erase(conn->fd);
+    }
+    // Half-close now so in-flight Send()s fail fast; the fd itself is
+    // closed by ~Conn when the last reference (possibly a sender's)
+    // drops — never while another thread could still write to it.
+    ::shutdown(conn->fd, SHUT_RDWR);
+  }
+
+  void Drop(const std::shared_ptr<Conn>& conn) {
+    int32_t rank = conn->rank;
+    bool current = false;
+    if (rank >= 0) {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = conns_by_rank_.find(rank);
+      if (it != conns_by_rank_.end() && it->second == conn) {
+        conns_by_rank_.erase(it);
+        current = true;
+      }
+    }
+    RemoveFd(conn);
+    if (current) Push({kEventDisconnect, rank, {}});
+  }
+
+  int listen_fd_ = -1, epfd_ = -1, wake_fd_ = -1, bound_port_ = 0;
+  std::atomic<bool> running_{false};
+  std::thread loop_;
+  std::mutex mu_;  // guards conns_by_fd_ / conns_by_rank_
+  std::map<int, std::shared_ptr<Conn>> conns_by_fd_;
+  std::map<int32_t, std::shared_ptr<Conn>> conns_by_rank_;
+
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+  std::deque<Event> queue_;
+  Event current_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* nbd_listener_create(const char* host, int port, int* out_port) {
+  auto* l = new Listener();
+  int p = l->Init(host, port);
+  if (p < 0) {
+    delete l;
+    return nullptr;
+  }
+  if (out_port) *out_port = p;
+  return l;
+}
+
+int nbd_listener_poll(void* h, int timeout_ms, int32_t* type, int32_t* rank,
+                      const uint8_t** data, uint64_t* size) {
+  return static_cast<Listener*>(h)->Poll(timeout_ms, type, rank, data, size);
+}
+
+int nbd_listener_send(void* h, int32_t rank, const uint8_t* data,
+                      uint64_t size) {
+  return static_cast<Listener*>(h)->Send(rank, data, size);
+}
+
+int nbd_listener_ranks(void* h, int32_t* out, int max) {
+  return static_cast<Listener*>(h)->Ranks(out, max);
+}
+
+void nbd_listener_close(void* h) {
+  auto* l = static_cast<Listener*>(h);
+  l->Close();
+  delete l;
+}
+
+}  // extern "C"

@@ -27,7 +27,8 @@ import time
 from typing import Any, Callable
 
 from .codec import Message
-from .transport import CoordinatorListener, TransportError
+from .native import make_listener
+from .transport import TransportError
 
 
 class WorkerDied(RuntimeError):
@@ -52,8 +53,10 @@ class CommunicationManager:
                  allow_pickle: bool = True):
         self.num_workers = num_workers
         self.default_timeout = timeout  # None = wait forever (training mode)
-        self._listener = CoordinatorListener(host=host, port=port,
-                                             allow_pickle=allow_pickle)
+        # Native C++ listener when built (see messaging/native.py), the
+        # pure-Python selector listener otherwise — same protocol.
+        self._listener = make_listener(host=host, port=port,
+                                       allow_pickle=allow_pickle)
         self.port = self._listener.port
         self._lock = threading.Lock()
         self._pending: dict[str, _Pending] = {}

@@ -1,0 +1,181 @@
+"""ctypes bindings for the native (C++) control-plane listener.
+
+Loads ``native/libnbdtransport.so`` (built by ``native/build.sh``) and
+wraps it in :class:`NativeCoordinatorListener`, interface-compatible with
+the pure-Python :class:`~nbdistributed_tpu.messaging.transport.
+CoordinatorListener`.  Selection:
+
+* ``NBD_NATIVE=0`` forces pure Python;
+* ``NBD_NATIVE=1`` requires the native lib (raises if unbuilt);
+* unset: native if the library is present, else Python.
+
+The C side owns sockets, epoll, framing, and identity routing; a single
+Python dispatch thread pops whole events (connect / disconnect /
+complete frames) and runs the same callbacks the Python listener does —
+no C→Python reentrancy, and the GIL is released for the duration of
+every native call.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from .codec import CodecError, decode, encode
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "libnbdtransport.so")
+
+_EVENT_MESSAGE, _EVENT_CONNECT, _EVENT_DISCONNECT = 0, 1, 2
+
+_lib = None
+
+
+def load_library():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.nbd_listener_create.restype = ctypes.c_void_p
+    lib.nbd_listener_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
+    lib.nbd_listener_poll.restype = ctypes.c_int
+    lib.nbd_listener_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.nbd_listener_send.restype = ctypes.c_int
+    lib.nbd_listener_send.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                      ctypes.c_char_p, ctypes.c_uint64]
+    lib.nbd_listener_ranks.restype = ctypes.c_int
+    lib.nbd_listener_ranks.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int32),
+                                       ctypes.c_int]
+    lib.nbd_listener_close.restype = None
+    lib.nbd_listener_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    if os.environ.get("NBD_NATIVE") == "0":
+        return False
+    try:
+        load_library()
+        return True
+    except OSError:
+        if os.environ.get("NBD_NATIVE") == "1":
+            raise
+        return False
+
+
+class NativeCoordinatorListener:
+    """Drop-in replacement for the Python CoordinatorListener backed by
+    the C++ epoll listener."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 allow_pickle: bool = True):
+        self._allow_pickle = allow_pickle
+        self._lib = load_library()
+        out_port = ctypes.c_int(0)
+        self._handle = self._lib.nbd_listener_create(
+            host.encode(), port, ctypes.byref(out_port))
+        if not self._handle:
+            raise OSError(f"native listener failed to bind {host}:{port}")
+        self.host, self.port = host, out_port.value
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.on_message = lambda r, m: None
+        self.on_connect = lambda r: None
+        self.on_disconnect = lambda r: None
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._dispatch,
+                                        name="nbd-native-dispatch",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.nbd_listener_close(handle)
+
+    def connected_ranks(self) -> list[int]:
+        if not self._handle:
+            return []
+        buf = (ctypes.c_int32 * 4096)()
+        n = self._lib.nbd_listener_ranks(self._handle, buf, 4096)
+        return sorted(buf[i] for i in range(n))
+
+    def send_to_rank(self, rank: int, msg) -> None:
+        frame = encode(msg, allow_pickle=self._allow_pickle)
+        self._send_frame(rank, frame)
+
+    def send_to_ranks(self, ranks: list[int], msg) -> None:
+        from .transport import TransportError
+        frame = encode(msg, allow_pickle=self._allow_pickle)
+        missing = [r for r in ranks if self._try_send(r, frame) != 0]
+        if missing:
+            raise TransportError(f"ranks {missing} are not connected")
+
+    def _send_frame(self, rank: int, frame: bytes) -> None:
+        from .transport import TransportError
+        if self._try_send(rank, frame) != 0:
+            raise TransportError(f"rank {rank} is not connected")
+
+    def _try_send(self, rank: int, frame: bytes) -> int:
+        if not self._handle:
+            return -1
+        return self._lib.nbd_listener_send(self._handle, rank, frame,
+                                           len(frame))
+
+    def _dispatch(self) -> None:
+        etype = ctypes.c_int32()
+        rank = ctypes.c_int32()
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        while self._running and self._handle:
+            rc = self._lib.nbd_listener_poll(
+                self._handle, 200, ctypes.byref(etype), ctypes.byref(rank),
+                ctypes.byref(data), ctypes.byref(size))
+            if rc < 0:
+                return
+            if rc == 0:
+                continue
+            try:
+                if etype.value == _EVENT_CONNECT:
+                    self.on_connect(rank.value)
+                elif etype.value == _EVENT_DISCONNECT:
+                    self.on_disconnect(rank.value)
+                else:
+                    frame = ctypes.string_at(data, size.value)
+                    try:
+                        msg = decode(frame,
+                                     allow_pickle=self._allow_pickle)
+                    except CodecError:
+                        continue
+                    self.on_message(rank.value, msg)
+            except Exception:
+                # Callbacks must not kill the dispatch thread, but a
+                # swallowed bug here would surface only as a hang —
+                # make it loud (the Python listener would crash its IO
+                # thread loudly in the same situation).
+                import traceback
+                traceback.print_exc()
+
+
+def make_listener(host: str = "127.0.0.1", port: int = 0, *,
+                  allow_pickle: bool = True):
+    """Listener factory honoring NBD_NATIVE (see module docstring)."""
+    if available():
+        return NativeCoordinatorListener(host, port,
+                                         allow_pickle=allow_pickle)
+    from .transport import CoordinatorListener
+    return CoordinatorListener(host, port, allow_pickle=allow_pickle)

@@ -33,7 +33,23 @@ from typing import Callable
 
 from .codec import CodecError, Message, decode, encode, frame_ready
 
-_HELLO_TYPE = "__hello__"
+# Connection preamble: worker announces its rank in a fixed 8-byte
+# header (magic + u32 rank, little-endian) before any frames — the
+# identity handshake ZMQ did with socket identities
+# (reference: worker.py:154-157), kept trivially parseable so the
+# native C++ listener and this Python listener speak one protocol.
+PREAMBLE_MAGIC = b"NBDW"
+PREAMBLE_SIZE = 8
+
+
+def make_preamble(rank: int) -> bytes:
+    return PREAMBLE_MAGIC + struct.pack("<i", rank)
+
+
+def parse_preamble(buf: bytes) -> int:
+    if buf[:4] != PREAMBLE_MAGIC:
+        raise CodecError(f"bad preamble {buf[:4]!r}")
+    return struct.unpack_from("<i", buf, 4)[0]
 
 
 class TransportError(Exception):
@@ -52,7 +68,7 @@ class _ConnState:
         self.sock = sock
         self.rbuf = bytearray()
         self.wlock = threading.Lock()
-        self.rank: int | None = None  # set after HELLO
+        self.rank: int | None = None  # set after the preamble
 
     def send_frame(self, frame: bytes) -> None:
         """Write the whole frame even on a non-blocking socket.
@@ -76,8 +92,14 @@ class _ConnState:
                 view = view[n:]
 
     def feed(self, data: bytes) -> list[bytes]:
-        """Append received bytes; return complete frames."""
+        """Append received bytes; return complete frames.  Consumes the
+        connection preamble first (setting ``self.rank``)."""
         self.rbuf.extend(data)
+        if self.rank is None:
+            if len(self.rbuf) < PREAMBLE_SIZE:
+                return []
+            self.rank = parse_preamble(bytes(self.rbuf[:PREAMBLE_SIZE]))
+            del self.rbuf[:PREAMBLE_SIZE]
         frames: list[bytes] = []
         while True:
             n = frame_ready(self.rbuf)
@@ -214,40 +236,37 @@ class CoordinatorListener:
         if not data:
             self._drop(conn, unidentified)
             return
+        was_unidentified = conn.rank is None
         try:
             frames = conn.feed(data)
         except CodecError:
             self._drop(conn, unidentified)
             return
+        if was_unidentified and conn.rank is not None:
+            unidentified.pop(conn.sock, None)
+            with self._lock:
+                old = self._conns.get(conn.rank)
+                self._conns[conn.rank] = conn
+            if old is not None:
+                # Replaced by a reconnect: detach the stale socket from
+                # the selector too, and mark it non-current so a late
+                # EOF on it does not fire on_disconnect for a live rank.
+                old.rank = None
+                try:
+                    self._sel.unregister(old.sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    old.sock.close()
+                except OSError:
+                    pass
+            self.on_connect(conn.rank)
         for frame in frames:
             try:
                 msg = decode(frame, allow_pickle=self._allow_pickle)
             except CodecError:
                 continue
-            if conn.rank is None:
-                if msg.msg_type != _HELLO_TYPE:
-                    continue  # protocol violation; wait for hello
-                conn.rank = msg.rank
-                unidentified.pop(conn.sock, None)
-                with self._lock:
-                    old = self._conns.get(conn.rank)
-                    self._conns[conn.rank] = conn
-                if old is not None:
-                    # Replaced by a reconnect: detach the stale socket from
-                    # the selector too, and mark it non-current so a late
-                    # EOF on it does not fire on_disconnect for a live rank.
-                    old.rank = None
-                    try:
-                        self._sel.unregister(old.sock)
-                    except (KeyError, ValueError):
-                        pass
-                    try:
-                        old.sock.close()
-                    except OSError:
-                        pass
-                self.on_connect(conn.rank)
-            else:
-                self.on_message(conn.rank, msg)
+            self.on_message(conn.rank, msg)
 
     def _drop(self, conn: _ConnState, unidentified: dict) -> None:
         try:
@@ -291,7 +310,8 @@ class WorkerChannel:
         _set_keepalive(self._sock)
         self._wlock = threading.Lock()
         self._rbuf = bytearray()
-        self.send(Message(msg_type=_HELLO_TYPE, rank=rank))
+        with self._wlock:
+            self._sock.sendall(make_preamble(rank))
 
     def send(self, msg: Message) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)

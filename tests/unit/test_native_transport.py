@@ -1,0 +1,121 @@
+"""The native C++ listener must be behaviorally identical to the Python
+listener — same protocol, same callbacks, same routing."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.messaging import native as native_mod
+from nbdistributed_tpu.messaging.codec import Message
+from nbdistributed_tpu.messaging.transport import (
+    CoordinatorListener, TransportError, WorkerChannel)
+
+IMPLS = ["python", "native"] if native_mod.available() else ["python"]
+
+
+@pytest.fixture(params=IMPLS)
+def listener(request):
+    if request.param == "native":
+        lst = native_mod.NativeCoordinatorListener()
+    else:
+        lst = CoordinatorListener()
+    received, connected, disconnected = [], [], []
+    lst.on_message = lambda r, m: received.append((r, m))
+    lst.on_connect = connected.append
+    lst.on_disconnect = disconnected.append
+    lst.start()
+    lst.received, lst.connected, lst.disconnected = (
+        received, connected, disconnected)
+    yield lst
+    lst.close()
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_native_lib_builds_and_loads():
+    assert native_mod.available(), \
+        "native transport must build in this environment (run native/build.sh)"
+
+
+def test_preamble_identifies_rank(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=5)
+    assert wait_until(lambda: listener.connected == [5])
+    assert listener.connected_ranks() == [5]
+    ch.close()
+    assert wait_until(lambda: listener.disconnected == [5])
+
+
+def test_roundtrip_and_routing(listener):
+    chans = [WorkerChannel("127.0.0.1", listener.port, rank=r)
+             for r in range(3)]
+    assert wait_until(lambda: len(listener.connected) == 3)
+    chans[2].send(Message(msg_type="response", rank=2, data={"v": 42}))
+    assert wait_until(lambda: len(listener.received) == 1)
+    r, msg = listener.received[0]
+    assert r == 2 and msg.data == {"v": 42}
+
+    listener.send_to_ranks([0, 2], Message(msg_type="go"))
+    assert chans[0].recv(timeout=5).msg_type == "go"
+    assert chans[2].recv(timeout=5).msg_type == "go"
+    with pytest.raises(TimeoutError):
+        chans[1].recv(timeout=0.2)
+    for c in chans:
+        c.close()
+
+
+def test_send_to_missing_rank_raises(listener):
+    with pytest.raises(TransportError):
+        listener.send_to_rank(77, Message(msg_type="x"))
+
+
+def test_large_binary_frame(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: 0 in listener.connected)
+    big = np.random.default_rng(1).standard_normal((1024, 1024)) \
+        .astype("float32")  # 4 MB
+    ch.send(Message(msg_type="response", rank=0, bufs={"t": big}))
+    assert wait_until(lambda: len(listener.received) == 1, timeout=15)
+    np.testing.assert_array_equal(listener.received[0][1].bufs["t"], big)
+    ch.close()
+
+
+def test_concurrent_worker_sends(listener):
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: 0 in listener.connected)
+    n_threads, per = 6, 30
+    def blast(tid):
+        for i in range(per):
+            ch.send(Message(msg_type="response", rank=0,
+                            data={"tid": tid, "i": i}))
+    threads = [threading.Thread(target=blast, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert wait_until(lambda: len(listener.received) == n_threads * per)
+    seen = {(m.data["tid"], m.data["i"]) for _, m in listener.received}
+    assert len(seen) == n_threads * per
+    ch.close()
+
+
+def test_reconnect_same_rank_no_false_death(listener):
+    ch1 = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: listener.connected.count(0) == 1)
+    ch2 = WorkerChannel("127.0.0.1", listener.port, rank=0)
+    assert wait_until(lambda: listener.connected.count(0) == 2)
+    ch1.close()  # old connection dies AFTER replacement
+    time.sleep(0.3)
+    assert listener.disconnected == []  # rank is still live via ch2
+    listener.send_to_rank(0, Message(msg_type="hi"))
+    assert ch2.recv(timeout=5).msg_type == "hi"
+    ch2.close()
