@@ -33,10 +33,31 @@ _EVENT_MESSAGE, _EVENT_CONNECT, _EVENT_DISCONNECT = 0, 1, 2
 _lib = None
 
 
+def _build_library() -> None:
+    """Compile the native listener on first use in a fresh checkout.
+
+    The .so is a build artifact (not committed); build.sh is a one-file
+    g++ invocation, so building lazily keeps `pip install -e . && pytest`
+    working without a separate build step.
+    """
+    src_dir = os.path.dirname(_LIB_PATH)
+    script = os.path.join(src_dir, "build.sh")
+    if not os.path.exists(script):
+        return
+    import subprocess
+    subprocess.run(["sh", script], check=True, capture_output=True,
+                   timeout=120)
+
+
 def load_library():
     global _lib
     if _lib is not None:
         return _lib
+    if not os.path.exists(_LIB_PATH):
+        try:
+            _build_library()
+        except Exception:
+            pass
     lib = ctypes.CDLL(_LIB_PATH)
     lib.nbd_listener_create.restype = ctypes.c_void_p
     lib.nbd_listener_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
