@@ -49,13 +49,30 @@ def loss_fn(params, x, y):
     pred = x @ W + b
     return jnp.mean((pred - y) ** 2)
 
-@jax.jit
-def local_step(params, state, x, y):
-    l, g = jax.value_and_grad(loss_fn)(params, x, y)
-    if world_size > 1:
+if world_size > 1:
+    # DDP: jit the two halves and all-reduce grads eagerly in between
+    # (eager collectives cannot be traced into jit).
+    @jax.jit
+    def local_grads(params, x, y):
+        return jax.value_and_grad(loss_fn)(params, x, y)
+
+    @jax.jit
+    def apply_grads(params, state, g):
+        u, state = opt.update(g, state, params)
+        return optax.apply_updates(params, u), state
+
+    def local_step(params, state, x, y):
+        l, g = local_grads(params, x, y)
         g = jax.tree.map(lambda t: all_reduce(t, "mean"), g)
-    u, state = opt.update(g, state, params)
-    return optax.apply_updates(params, u), state, l
+        params, state = apply_grads(params, state, g)
+        return params, state, l
+else:
+    # Single worker: one fused XLA program, no collective needed.
+    @jax.jit
+    def local_step(params, state, x, y):
+        l, g = jax.value_and_grad(loss_fn)(params, x, y)
+        u, state = opt.update(g, state, params)
+        return optax.apply_updates(params, u), state, l
 
 params = (W, b)
 params, state, _ = local_step(params, state, x, y)  # compile

@@ -14,6 +14,14 @@ passes a host-local value of identical shape; the result is the reduced /
 gathered value as seen by this process.  All functions also work in a
 single-process world (they become cheap identities), so the same notebook
 runs on 1 chip or a pod.
+
+These collectives are **eager**: in a multi-device world they cannot be
+traced into ``jit``/``grad`` (they move host-local values into a global
+XLA program) and raise a TypeError explaining the two supported
+patterns — all-reduce eagerly between jitted halves, or ``shard_map`` +
+``jax.lax.psum`` for in-program collectives.  The single-process/
+single-device identity path still traces fine, so 1-chip notebooks can
+jit straight through them.
 """
 
 from __future__ import annotations
@@ -61,6 +69,23 @@ def _to_global(x, mesh):
     local = jnp.broadcast_to(x[None], (jax.local_device_count(),) + x.shape)
     return multihost_utils.host_local_array_to_global_array(
         np.asarray(local), mesh, P("proc"))
+
+
+def _reject_tracer(x, what: str):
+    """Eager collectives move host-local values into a global array,
+    which cannot happen mid-trace.  Without this guard the user sees
+    XLA's opaque ``__array__() was called on traced array`` — turn it
+    into an actionable error instead."""
+    import jax.core
+
+    if isinstance(x, jax.core.Tracer):
+        raise TypeError(
+            f"{what} is an eager collective and cannot be called inside "
+            "jit/grad/vmap tracing. Either call it outside the jitted "
+            "function (e.g. jit the local grad step, all-reduce the "
+            "grads eagerly, then jit the optimizer update), or express "
+            "the collective inside the program with jax.shard_map + "
+            "jax.lax.psum over a mesh axis.")
 
 
 _REDUCERS = {"sum": "psum", "mean": "pmean", "max": "pmax", "min": "pmin"}
@@ -118,7 +143,8 @@ def all_reduce(x, op: str = "sum"):
     if op not in _REDUCERS:
         raise ValueError(f"op must be one of {sorted(_REDUCERS)}")
     if jax.process_count() == 1 and jax.local_device_count() == 1:
-        return jnp.asarray(x)
+        return jnp.asarray(x)  # identity — works even under tracing
+    _reject_tracer(x, "all_reduce")
 
     mesh = _proc_mesh()
     garr = _to_global(x, mesh)
@@ -143,6 +169,7 @@ def all_gather(x):
 
     if jax.process_count() == 1 and jax.local_device_count() == 1:
         return jnp.asarray(x)[None]
+    _reject_tracer(x, "all_gather")
 
     mesh = _proc_mesh()
     garr = _to_global(x, mesh)
@@ -163,7 +190,8 @@ def broadcast(x, root: int = 0):
     import jax.numpy as jnp
 
     if jax.process_count() == 1:
-        return jnp.asarray(x)
+        return jnp.asarray(x)  # identity — works even under tracing
+    _reject_tracer(x, "broadcast")
     x = jnp.asarray(x)
     contribution = x if rank() == root else jnp.zeros_like(x)
     return all_reduce(contribution, op="sum")
@@ -187,7 +215,8 @@ def reduce_scatter(x, op: str = "sum"):
 
     n = jax.process_count()
     if n == 1:
-        return jnp.asarray(x)
+        return jnp.asarray(x)  # identity — works even under tracing
+    _reject_tracer(x, "reduce_scatter")
     reduced = all_reduce(x, op=op)
     chunks = jnp.split(jnp.asarray(reduced), n, axis=0)
     return chunks[rank()]

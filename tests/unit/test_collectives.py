@@ -94,3 +94,20 @@ def test_repeated_calls_hit_jit_cache():
     after = collectives._reduce_fn.cache_info()
     assert after.currsize == before.currsize  # no new traced function
     assert after.hits > before.hits
+
+
+def test_collectives_reject_jit_tracing():
+    import jax
+
+    @jax.jit
+    def bad(x):
+        return collectives.all_reduce(x)
+
+    with pytest.raises(TypeError, match="eager collective"):
+        bad(jnp.ones(4))
+    with pytest.raises(TypeError, match="shard_map"):
+        jax.jit(lambda x: collectives.all_gather(x))(jnp.ones(2))
+    # broadcast in a 1-process world is an identity and must still
+    # trace fine (single-chip notebooks jit through collectives).
+    out = jax.jit(lambda x: collectives.broadcast(x))(jnp.ones(2))
+    np.testing.assert_allclose(np.asarray(out), np.ones(2))
