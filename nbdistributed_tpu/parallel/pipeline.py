@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.3: "Absent"; its
+users could only hand-roll stages with ``%%rank`` groups and point-to-
+point sends).  This module is the TPU-idiomatic version: stages are a
+*mesh axis*, not processes — stage parameters live sharded over the
+``pp`` axis, the whole schedule is one XLA program under ``shard_map``,
+and activations hop stage-to-stage with ``lax.ppermute`` over ICI.  The
+schedule is a ``lax.scan`` (compiler-friendly control flow: one trace,
+no Python loop over steps), so compile time is O(1) in the number of
+microbatches.
+
+Semantics: ``stage_fn`` is applied ``n_stages`` times in sequence, so
+
+    pipeline_forward(f, params, x, ...) ==  f(p[S-1], ... f(p[0], x))
+
+(the unit tests assert equality with the sequential loop to float
+tolerance — reduction order differs, so bitwise identity is not
+guaranteed).  The usual GPipe bubble applies: utilisation is
+``n_micro / (n_micro + n_stages - 1)`` — raise ``n_microbatches`` to
+amortise it.  Differentiable end-to-end: ``ppermute``'s transpose is the
+reverse permute, so ``jax.grad`` through a pipelined loss just works.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_stage_params(stage_params, mesh, axis: str = "pp"):
+    """Place stage-stacked parameters (every leaf carries a leading
+    ``n_stages`` axis) so each pipeline stage holds only its own slice."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), stage_params)
+
+
+def pipeline_forward(stage_fn, stage_params, x, mesh, *, axis: str = "pp",
+                     n_microbatches: int | None = None):
+    """Run ``x`` through ``n_stages`` sequential applications of
+    ``stage_fn``, pipelined over the ``axis`` mesh axis.
+
+    Args:
+      stage_fn: ``(params_one_stage, activation) -> activation`` with the
+        activation shape preserved (homogeneous stages, e.g. transformer
+        blocks).
+      stage_params: pytree whose leaves have leading dim ``n_stages``,
+        sharded over ``axis`` (see :func:`shard_stage_params`).
+      x: the global batch, leading dim divisible by ``n_microbatches``.
+      n_microbatches: defaults to ``n_stages``.  More microbatches →
+        smaller pipeline bubble.
+
+    Returns the output batch, replicated over ``axis``.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = n_microbatches if n_microbatches is not None else n_stages
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(
+            f"batch {batch} not divisible by {n_micro} microbatches")
+    xs = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    n_steps = n_micro + n_stages - 1
+    multi_stage = n_stages > 1
+
+    def spmd(params, xs):
+        stage = jax.lax.axis_index(axis)
+        # shard_map leaves a length-1 stage axis on local shards.
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+
+        def step(recv, t):
+            # Stage 0 consumes the next microbatch while it exists (the
+            # clamp only feeds don't-care work into drain steps whose
+            # outputs are never collected); other stages consume what
+            # the previous stage sent last step.
+            x_in = jnp.where(stage == 0,
+                             xs[jnp.minimum(t, n_micro - 1)], recv)
+            y = stage_fn(local, x_in)
+            out = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+            if multi_stage:
+                recv = jax.lax.ppermute(
+                    y, axis,
+                    [(i, i + 1) for i in range(n_stages - 1)])
+            return recv, out
+
+        _, outs = jax.lax.scan(step, jnp.zeros_like(xs[0]),
+                               jnp.arange(n_steps))
+        # Only the last stage produced real outputs; sum-replicate them
+        # so every stage returns the full result.
+        return jax.lax.psum(outs, axis)
+
+    outs = jax.shard_map(
+        spmd, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=False)(stage_params, xs)
+    # Microbatch m exits the last stage at step m + n_stages - 1.
+    return outs[n_stages - 1:].reshape(batch, *x.shape[1:])
+
+
+def make_pipeline_loss(stage_fn, loss_tail, mesh, *, axis: str = "pp",
+                       n_microbatches: int | None = None):
+    """Compose a pipelined forward with a loss head.
+
+    ``loss_tail(final_activation, batch) -> scalar``.  The returned
+    ``loss(stage_params, x, batch)`` differentiates end-to-end (the
+    backward pass pipelines in reverse through the transposed
+    ppermutes).
+    """
+
+    @jax.jit
+    def loss(stage_params, x, batch):
+        y = pipeline_forward(stage_fn, stage_params, x, mesh, axis=axis,
+                             n_microbatches=n_microbatches)
+        return loss_tail(y, batch)
+
+    return loss

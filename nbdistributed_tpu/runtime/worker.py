@@ -84,7 +84,8 @@ class DistributedWorker:
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        from ..parallel import collectives
+        from ..parallel import collectives, mesh as mesh_mod, pipeline
+        from ..parallel.ring import ring_attention
 
         dist = collectives.DistNamespace()
         ns = {
@@ -108,6 +109,11 @@ class DistributedWorker:
             "broadcast": collectives.broadcast,
             "barrier": collectives.barrier,
             "reduce_scatter": collectives.reduce_scatter,
+            "make_mesh": mesh_mod.make_mesh,
+            "shard_batch": mesh_mod.shard_batch,
+            "ring_attention": ring_attention,
+            "pipeline_forward": pipeline.pipeline_forward,
+            "shard_stage_params": pipeline.shard_stage_params,
             "__rank__": self.rank,
             "__world_size__": self.world_size,
             "__builtins__": __builtins__,

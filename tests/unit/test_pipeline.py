@@ -1,0 +1,113 @@
+"""Pipeline parallelism: exactness vs the sequential stage loop, grad
+flow, and bubble accounting (stretch beyond the reference, which has no
+PP at all — SURVEY §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.parallel import pipeline
+from nbdistributed_tpu.parallel.mesh import make_mesh
+
+pytestmark = [pytest.mark.unit]
+
+N_STAGES = 4
+D = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"pp": N_STAGES},
+                     devices=jax.devices()[:N_STAGES])
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _params(key):
+    kw, kb = jax.random.split(key)
+    return {"w": jax.random.normal(kw, (N_STAGES, D, D)) * 0.3,
+            "b": jax.random.normal(kb, (N_STAGES, D)) * 0.1}
+
+
+def _sequential(params, x):
+    for s in range(N_STAGES):
+        x = _stage_fn(jax.tree.map(lambda a: a[s], params), x)
+    return x
+
+
+def test_pipeline_matches_sequential(mesh):
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
+    sharded = pipeline.shard_stage_params(params, mesh)
+    got = pipeline.pipeline_forward(_stage_fn, sharded, x, mesh)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_sequential(params, x)),
+                               rtol=1e-6)
+
+
+def test_pipeline_more_microbatches(mesh):
+    params = _params(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, D))
+    sharded = pipeline.shard_stage_params(params, mesh)
+    got = pipeline.pipeline_forward(_stage_fn, sharded, x, mesh,
+                                    n_microbatches=8)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_sequential(params, x)),
+                               rtol=1e-6)
+
+
+def test_pipeline_batch_not_divisible(mesh):
+    params = pipeline.shard_stage_params(_params(jax.random.PRNGKey(4)),
+                                         mesh)
+    x = jnp.zeros((6, D))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline.pipeline_forward(_stage_fn, params, x, mesh)
+
+
+def test_pipeline_grads_match_sequential(mesh):
+    params = _params(jax.random.PRNGKey(5))
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, D))
+    y = jax.random.normal(jax.random.PRNGKey(7), (8, D))
+
+    def loss_pipe(p):
+        sharded = pipeline.shard_stage_params(p, mesh)
+        out = pipeline.pipeline_forward(_stage_fn, sharded, x, mesh)
+        return jnp.mean((out - y) ** 2)
+
+    def loss_seq(p):
+        return jnp.mean((_sequential(p, x) - y) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        g_pipe, g_seq)
+
+
+def test_make_pipeline_loss_trains(mesh):
+    params = _params(jax.random.PRNGKey(8))
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, D))
+    y = jax.random.normal(jax.random.PRNGKey(10), (8, D))
+
+    loss = pipeline.make_pipeline_loss(
+        _stage_fn, lambda out, tgt: jnp.mean((out - tgt) ** 2), mesh)
+    sharded = pipeline.shard_stage_params(params, mesh)
+    l0 = loss(sharded, x, y)
+    g = jax.grad(loss)(sharded, x, y)
+    stepped = jax.tree.map(lambda p, gg: p - 0.1 * gg, sharded, g)
+    l1 = loss(stepped, x, y)
+    assert float(l1) < float(l0)
+
+
+def test_single_stage_mesh_degenerates():
+    mesh1 = make_mesh({"pp": 1}, devices=jax.devices()[:1])
+    params = _params(jax.random.PRNGKey(11))
+    one = jax.tree.map(lambda a: a[:1], params)
+    x = jax.random.normal(jax.random.PRNGKey(12), (4, D))
+    got = pipeline.pipeline_forward(_stage_fn, one, x, mesh1)
+    want = _stage_fn(jax.tree.map(lambda a: a[0], params), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
