@@ -46,8 +46,8 @@ Every cell now runs on ALL workers. Namespace on each worker:
   all_reduce, all_gather, broadcast, barrier, reduce_scatter
                        — eager collectives over ICI/DCN
   make_mesh, shard_batch, ring_attention,
-  pipeline_forward, shard_stage_params
-                       — mesh/SP/PP building blocks
+  pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
+                       — mesh/SP/PP/EP building blocks
 
 Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_status ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·

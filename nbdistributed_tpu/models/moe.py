@@ -1,0 +1,139 @@
+"""Mixture-of-experts decoder transformer (Mixtral-style), TPU-first.
+
+Beyond-parity model family (the reference ships no models and no MoE —
+SURVEY §2.3); reuses the dense family's attention/RMSNorm/rotary stack
+(:mod:`nbdistributed_tpu.models.transformer`) and swaps the SwiGLU MLP
+for the expert-parallel MoE layer
+(:mod:`nbdistributed_tpu.parallel.expert`).  Layers are stacked on a
+leading (n_layers,) axis and scanned, with the load-balance aux loss
+accumulated through the scan carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
+from ..utils import fan_in_normal
+from .transformer import TransformerConfig, _attention_block, _rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    lb_coef: float = 0.01
+
+    def num_params(self) -> int:
+        emb = self.vocab_size * self.d_model
+        attn = (self.d_model * self.n_heads * self.head_dim
+                + 2 * self.d_model * self.n_kv_heads * self.head_dim
+                + self.n_heads * self.head_dim * self.d_model)
+        router = self.d_model * self.n_experts
+        experts = self.n_experts * 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return (emb * 2 + self.d_model
+                + self.n_layers * (attn + router + experts + norms))
+
+
+def tiny_moe_config(**kw) -> MoEConfig:
+    return MoEConfig(vocab_size=512, d_model=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, d_ff=256,
+                     max_seq_len=256, n_experts=4, top_k=2, **kw)
+
+
+def mixtral_8x7b_config(**kw) -> MoEConfig:
+    return MoEConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                     n_heads=32, n_kv_heads=8, d_ff=14336,
+                     max_seq_len=4096, n_experts=8, top_k=2, **kw)
+
+
+def init_moe_model(key, cfg: MoEConfig) -> dict:
+    """Parameter pytree; per-layer arrays carry a leading (n_layers,)
+    axis (attention identical to the dense family, MLP -> experts)."""
+    k_emb, k_attn, k_moe, k_out = jax.random.split(key, 4)
+    D, H, Hkv, Dh, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                        cfg.head_dim, cfg.n_layers)
+
+    def normal(k, shape, fan_in):
+        return fan_in_normal(k, shape, fan_in, cfg.dtype)
+
+    ks = jax.random.split(k_attn, 4)
+    moe = jax.vmap(lambda k: init_moe_params(
+        k, D, cfg.d_ff, cfg.n_experts, cfg.dtype))(
+            jax.random.split(k_moe, L))
+    return {
+        "embed": normal(k_emb, (cfg.vocab_size, D), 1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": normal(ks[0], (L, D, H * Dh), D),
+            "wk": normal(ks[1], (L, D, Hkv * Dh), D),
+            "wv": normal(ks[2], (L, D, Hkv * Dh), D),
+            "wo": normal(ks[3], (L, H * Dh, D), H * Dh),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "moe": moe,
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": normal(k_out, (D, cfg.vocab_size), D),
+    }
+
+
+def moe_model_shardings(cfg: MoEConfig, ep_axis: str = "ep",
+                        tp_axis: str | None = "tp") -> dict:
+    """Sharding rules: attention tensor-parallel over ``tp`` (as in the
+    dense family), experts over ``ep``."""
+    return {
+        "embed": P(None, tp_axis),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, tp_axis),
+            "wk": P(None, None, tp_axis),
+            "wv": P(None, None, tp_axis),
+            "wo": P(None, tp_axis, None),
+            "mlp_norm": P(None, None),
+            "moe": moe_param_shardings(ep_axis, None, leading=(None,)),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, tp_axis),
+    }
+
+
+def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
+                ep_axis: str = "ep", positions=None):
+    """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer_step(carry, layer):
+        x, aux = carry
+        x = _attention_block(x, layer, cfg, positions)
+        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
+                               capacity_factor=cfg.capacity_factor,
+                               mesh=mesh, ep_axis=ep_axis)
+        return (x + y, aux + layer_aux), None
+
+    (x, aux), _ = jax.lax.scan(layer_step, (x, jnp.float32(0.0)),
+                               params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux / cfg.n_layers
+
+
+def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
+                ep_axis: str = "ep"):
+    """Next-token cross-entropy + load-balance auxiliary."""
+    tokens = batch["tokens"]
+    logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh=mesh,
+                              ep_axis=ep_axis)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll) + cfg.lb_coef * aux

@@ -90,8 +90,8 @@ def init_params(key, cfg: TransformerConfig) -> dict:
                            cfg.head_dim, cfg.d_ff, cfg.n_layers)
 
     def normal(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32)
-                / np.sqrt(fan_in)).astype(cfg.dtype)
+        from ..utils import fan_in_normal
+        return fan_in_normal(key, shape, fan_in, cfg.dtype)
 
     ks = jax.random.split(k_layers, 7)
     params = {

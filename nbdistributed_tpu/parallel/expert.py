@@ -1,0 +1,145 @@
+"""Expert parallelism: capacity-based MoE dispatch over an ``ep`` mesh
+axis.
+
+The reference has no MoE/expert-parallel support (SURVEY §2.3: "Expert
+parallel (EP/MoE) — Absent"); this module goes beyond parity with a
+TPU-first design.  Instead of per-token gather/scatter (dynamic shapes
+XLA cannot tile), routing is expressed as dense one-hot dispatch/combine
+einsums with a fixed per-expert capacity — the GShard/Switch recipe:
+
+* every shape is static, so the whole layer lives inside one ``jit``;
+* expert weights carry a leading ``(n_experts,)`` axis sharded over the
+  ``ep`` mesh axis, and a sharding constraint on the dispatched
+  activations ``(E, C, D)`` makes GSPMD compile the token exchange as an
+  ``all_to_all`` over ICI — the hand-written NCCL alltoall of
+  GPU MoE stacks falls out of the sharding lattice instead;
+* over-capacity tokens are dropped (they pass through the residual),
+  bounding memory and keeping the MXU batched.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.bfloat16) -> dict:
+    """Router + stacked SwiGLU expert weights (leading E axis)."""
+    from ..utils import fan_in_normal
+
+    kr, kg, ku, kd = jax.random.split(key, 4)
+
+    def normal(k, shape, fan_in):
+        return fan_in_normal(k, shape, fan_in, dtype)
+
+    E, D, F = n_experts, d_model, d_ff
+    return {
+        # fp32 router: gating is numerically delicate and tiny.
+        "router": jax.random.normal(kr, (D, E), jnp.float32) * 0.02,
+        "w_gate": normal(kg, (E, D, F), D),
+        "w_up": normal(ku, (E, D, F), D),
+        "w_down": normal(kd, (E, F, D), F),
+    }
+
+
+def moe_param_shardings(ep_axis: str = "ep", tp_axis: str | None = None,
+                        leading=()) -> dict:
+    """PartitionSpec rules for :func:`init_moe_params` trees.  Experts
+    shard over ``ep_axis``; optionally the ffn dim also shards over
+    ``tp_axis`` (combined ep×tp).  ``leading`` prefixes extra axes (the
+    models stack a (n_layers,) axis in front)."""
+    lead = tuple(leading)
+    return {
+        "router": P(*lead, None, None),
+        "w_gate": P(*lead, ep_axis, None, tp_axis),
+        "w_up": P(*lead, ep_axis, None, tp_axis),
+        "w_down": P(*lead, ep_axis, tp_axis, None),
+    }
+
+
+def compute_capacity(num_tokens: int, n_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """Per-expert token capacity C; multiple of 8 for TPU-friendly
+    (8,128) tiling of the (E, C, D) dispatched activations."""
+    cap = int(capacity_factor * top_k * num_tokens / n_experts)
+    return max(8, -(-cap // 8) * 8)
+
+
+def top_k_routing(logits, top_k: int):
+    """Normalized top-k gates.  logits (T, E) fp32 ->
+    gates (T, k), expert_idx (T, k), probs (T, E)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, expert_idx = jax.lax.top_k(probs, top_k)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, expert_idx, probs
+
+
+def make_dispatch(gates, expert_idx, n_experts: int, capacity: int):
+    """Dense dispatch/combine tensors from routing decisions.
+
+    Position of each (token, choice) inside its expert's capacity buffer
+    is a cumulative count in choice-major order, so every token's first
+    choice outranks any token's second choice — the Switch priority
+    rule.  Returns ``dispatch`` (T, E, C) {0,1} and ``combine``
+    (T, E, C) = dispatch * gate.
+    """
+    T, k = expert_idx.shape
+    onehot = jax.nn.one_hot(expert_idx, n_experts,
+                            dtype=jnp.float32)        # (T, k, E)
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, n_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat             # (k*T, E)
+    pos = pos.reshape(k, T, n_experts).transpose(1, 0, 2)  # (T, k, E)
+    keep = onehot * (pos < capacity)                  # drop over-capacity
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)          # (T, k, E, C)
+    slot = slot * keep[..., None]
+    dispatch = jnp.sum(slot, axis=1)                  # (T, E, C)
+    combine = jnp.sum(slot * gates[:, :, None, None], axis=1)
+    return dispatch, combine
+
+
+def load_balance_loss(probs, expert_idx, n_experts: int):
+    """Switch-style auxiliary loss: n_experts * Σ_e f_e · P_e, where
+    f_e = fraction of tokens whose FIRST choice is e and P_e = mean
+    router probability of e.  Minimized (=1) at uniform routing."""
+    first = jax.nn.one_hot(expert_idx[:, 0], n_experts, dtype=jnp.float32)
+    f = jnp.mean(first, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_ffn(x, params: dict, *, top_k: int = 2,
+            capacity_factor: float = 1.25, mesh=None,
+            ep_axis: str = "ep"):
+    """Mixture-of-experts SwiGLU feed-forward.
+
+    x: (..., D) -> (same shape, aux_loss scalar).  When ``mesh`` (with an
+    ``ep`` axis) is given, the dispatched activations are sharding-
+    constrained so GSPMD places each expert's (C, D) block on its ``ep``
+    shard — compiling dispatch/combine into all_to_all collectives.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xt = x.reshape(-1, D)
+    T = xt.shape[0]
+    E = params["router"].shape[-1]
+    C = compute_capacity(T, E, top_k, capacity_factor)
+
+    logits = xt.astype(jnp.float32) @ params["router"]
+    gates, expert_idx, probs = top_k_routing(logits, top_k)
+    aux = load_balance_loss(probs, expert_idx, E)
+    dispatch, combine = make_dispatch(gates, expert_idx, E, C)
+
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    if mesh is not None and ep_axis in mesh.shape:
+        sh = NamedSharding(mesh, P(ep_axis, None, None))
+        xe = jax.lax.with_sharding_constraint(xe, sh)
+    h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, params["w_gate"]))
+         * jnp.einsum("ecd,edf->ecf", xe, params["w_up"]))
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w_down"])
+    if mesh is not None and ep_axis in mesh.shape:
+        ye = jax.lax.with_sharding_constraint(ye, sh)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+    return y.reshape(orig_shape), aux

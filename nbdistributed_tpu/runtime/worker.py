@@ -84,7 +84,8 @@ class DistributedWorker:
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        from ..parallel import collectives, mesh as mesh_mod, pipeline
+        from ..parallel import collectives, expert, mesh as mesh_mod, \
+            pipeline
         from ..parallel.ring import ring_attention
 
         dist = collectives.DistNamespace()
@@ -114,6 +115,8 @@ class DistributedWorker:
             "ring_attention": ring_attention,
             "pipeline_forward": pipeline.pipeline_forward,
             "shard_stage_params": pipeline.shard_stage_params,
+            "moe_ffn": expert.moe_ffn,
+            "init_moe_params": expert.init_moe_params,
             "__rank__": self.rank,
             "__world_size__": self.world_size,
             "__builtins__": __builtins__,

@@ -1,0 +1,15 @@
+"""Shared small utilities used across the model/parallel stack."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def fan_in_normal(key, shape, fan_in, dtype):
+    """Gaussian init scaled by 1/sqrt(fan_in), cast to ``dtype`` —
+    the one initializer every model family uses."""
+    import jax.numpy as jnp
+
+    return (jax.random.normal(key, shape, jnp.float32)
+            / np.sqrt(fan_in)).astype(dtype)
