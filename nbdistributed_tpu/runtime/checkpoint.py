@@ -1,0 +1,252 @@
+"""Checkpoint / restore of named worker-namespace entries.
+
+The reference has **no** checkpoint subsystem (SURVEY §5.4): users call
+``torch.save`` by hand in cells.  This module is the TPU-native upgrade
+SURVEY §5.4 sketches — a first-class ``%dist_checkpoint`` / ``%dist_restore``
+surface that snapshots arbitrary pytrees (model params, optax opt states,
+plain arrays, scalars) out of each rank's persistent namespace.
+
+Design: **per-rank, coordination-free.**  Each rank writes
+``{path}/rank_{r}/`` independently.  This is deliberate, not a fallback:
+
+- namespace values are rank-local by construction (each worker process
+  owns its own REPL state), so there is no global pytree to assemble;
+- a checkpoint must be takeable from a ``%%rank`` subset and restorable
+  into a *differently sized* world (each rank simply reads its own dir),
+  and must not hang when a rank has died mid-session;
+- orbax's multiprocess commit protocol is the opposite trade: it
+  barriers the whole world and rejects host-local ``jax.Array`` values
+  outright in multi-process settings ("Cannot serialize host local
+  jax.Array in multi-host setting", orbax 0.11 ``jax_array_handlers``),
+  which is exactly the shape interactive per-rank state has.
+
+On-disk layout (``{path}/rank_{r}/``):
+
+- ``manifest.json`` — format version, rank/world size, and for every
+  saved name its leaf layout: per-leaf ``kind`` (``jax``/``np``/``obj``),
+  dtype string and shape for arrays;
+- ``arrays.npz`` — one uint8 entry ``{name}.{i}`` per array leaf holding
+  the raw bytes (raw-bytes + manifest dtype, because npz itself mangles
+  extended dtypes like bfloat16 into opaque void fields);
+- ``aux.pkl`` — pickled treedefs plus any non-array leaves.  Pickle here
+  is the same trust model as ``torch.load``: you restore only files you
+  (or your job) wrote.  The *wire* protocol stays pickle-free.
+
+Arrays restore as ``jax.Array`` or numpy leaves matching what was
+saved; dtype (incl. bfloat16) and shape are exact.  Device *placement*
+is not persisted: restored ``jax.Array`` leaves land on the default
+device (the manifest records each leaf's original sharding string for
+inspection), so multi-device-per-worker sessions re-apply shardings
+afterwards, e.g. ``params = apply_shardings(params, mesh, rules)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+from typing import Any
+
+FORMAT_VERSION = 1
+
+
+def _rank_dir(path: str, rank: int) -> str:
+    return os.path.join(os.path.expanduser(path), f"rank_{rank}")
+
+
+def _leaf_entries(value: Any):
+    """Flatten ``value``; returns (leaves, treedef)."""
+    import jax
+
+    return jax.tree_util.tree_flatten(value)
+
+
+def _byte_serializable(dtype) -> bool:
+    """True when raw bytes + ``str(dtype)`` can round-trip the array.
+    Structured/void/object dtypes can't (``jnp.dtype("[('a','<i4')]")``
+    is unparseable) — those go through the pickle path instead."""
+    import jax.numpy as jnp
+
+    if dtype.hasobject or dtype.names is not None or dtype.kind == "V":
+        return False
+    try:
+        return jnp.dtype(str(dtype)) == dtype
+    except TypeError:
+        return False
+
+
+def _as_bytes(host):
+    """Zero-extra-copy uint8 view of an array's bytes (contiguous
+    arrays view in place; strided ones pay the one unavoidable copy)."""
+    import numpy as np
+
+    return np.ascontiguousarray(host).reshape(-1).view(np.uint8)
+
+
+def save(path: str, namespace: dict, names: list[str], *, rank: int = 0,
+         world_size: int = 1) -> dict:
+    """Snapshot ``names`` out of ``namespace`` into ``{path}/rank_{rank}``.
+
+    Returns a summary dict: per name, leaf count and array bytes.
+    """
+    import jax
+    import numpy as np
+
+    missing = [n for n in names if n not in namespace]
+    if missing:
+        raise KeyError(f"names not defined on rank {rank}: {missing}")
+
+    d = _rank_dir(path, rank)
+    # Stage into a sibling tmp dir and swap in only once fully written —
+    # a failed or interrupted save must never corrupt an existing good
+    # checkpoint (and the manifest always matches the arrays beside it).
+    tmp = d + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+
+    manifest: dict = {"version": FORMAT_VERSION, "rank": rank,
+                      "world_size": world_size, "entries": {}}
+    arrays: dict[str, np.ndarray] = {}
+    treedefs: dict[str, Any] = {}
+    objects: dict[str, Any] = {}
+    summary: dict[str, dict] = {}
+
+    for name in names:
+        leaves, treedef = _leaf_entries(namespace[name])
+        treedefs[name] = treedef
+        leaf_meta = []
+        nbytes = 0
+        for i, leaf in enumerate(leaves):
+            key = f"{name}.{i}"
+            if isinstance(leaf, jax.Array):
+                if not leaf.is_fully_addressable:
+                    raise ValueError(
+                        f"{name!r} leaf {i} spans devices this process "
+                        "cannot address (globally sharded array). "
+                        "Per-rank checkpoints hold rank-local state; "
+                        "gather it first (e.g. x = all_gather(x)) or "
+                        "checkpoint from a single-process mesh.")
+                host = np.asarray(jax.device_get(leaf))
+                arrays[key] = _as_bytes(host)
+                leaf_meta.append({"kind": "jax", "dtype": str(host.dtype),
+                                  "shape": list(host.shape),
+                                  "sharding": str(leaf.sharding)})
+                nbytes += host.nbytes
+            elif isinstance(leaf, np.ndarray) and \
+                    _byte_serializable(leaf.dtype):
+                arrays[key] = _as_bytes(leaf)
+                leaf_meta.append({"kind": "np", "dtype": str(leaf.dtype),
+                                  "shape": list(leaf.shape)})
+                nbytes += leaf.nbytes
+            else:
+                # Non-array leaves, plus object/structured-dtype ndarrays
+                # whose dtypes can't round-trip through the byte path.
+                objects[key] = leaf
+                leaf_meta.append({"kind": "obj"})
+        manifest["entries"][name] = {"leaves": leaf_meta}
+        summary[name] = {"leaves": len(leaves), "bytes": nbytes}
+
+    # Stream the zip straight to disk — peak memory stays at the uint8
+    # views, not checkpoint-size buffers.
+    with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+        np.savez(f, **arrays)
+    with open(os.path.join(tmp, "aux.pkl"), "wb") as f:
+        pickle.dump({"treedefs": treedefs, "objects": objects}, f)
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    old = d + ".old"
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.exists(d):
+        os.rename(d, old)
+    os.rename(tmp, d)
+    shutil.rmtree(old, ignore_errors=True)
+    return summary
+
+
+def _decode_array(raw, meta, *, to_device: bool):
+    import jax.numpy as jnp
+    import numpy as np
+
+    dtype = jnp.dtype(meta["dtype"])  # jnp.dtype knows bfloat16 & friends
+    # npz gives a fresh writable C-contiguous uint8 array; reinterpret
+    # in place (no copy) — jnp.asarray below copies to device anyway.
+    host = raw.view(dtype).reshape(meta["shape"])
+    return jnp.asarray(host) if to_device else host
+
+
+def restore(path: str, namespace: dict, names: list[str] | None = None, *,
+            rank: int = 0) -> dict:
+    """Load entries from ``{path}/rank_{rank}`` back into ``namespace``.
+
+    ``names=None`` restores everything in the manifest.  Returns the same
+    per-name summary shape as :func:`save`.
+    """
+    d = _rank_dir(path, rank)
+    mpath = os.path.join(d, "manifest.json")
+    if not os.path.exists(mpath):
+        raise FileNotFoundError(
+            f"no checkpoint for rank {rank} at {path!r} "
+            f"(missing {mpath})")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("version") != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version "
+                         f"{manifest.get('version')!r}")
+    import jax
+    import numpy as np
+
+    with open(os.path.join(d, "aux.pkl"), "rb") as f:
+        aux = pickle.load(f)
+
+    entries = manifest["entries"]
+    if names is None:
+        names = list(entries)
+    missing = [n for n in names if n not in entries]
+    if missing:
+        raise KeyError(f"names not in checkpoint: {missing} "
+                       f"(has {sorted(entries)})")
+
+    summary: dict[str, dict] = {}
+    with np.load(os.path.join(d, "arrays.npz")) as npz:
+        for name in names:
+            leaf_meta = entries[name]["leaves"]
+            leaves = []
+            nbytes = 0
+            for i, meta in enumerate(leaf_meta):
+                key = f"{name}.{i}"
+                if meta["kind"] == "obj":
+                    leaves.append(aux["objects"][key])
+                else:
+                    arr = _decode_array(npz[key], meta,
+                                        to_device=meta["kind"] == "jax")
+                    leaves.append(arr)
+                    nbytes += arr.nbytes
+            namespace[name] = jax.tree_util.tree_unflatten(
+                aux["treedefs"][name], leaves)
+            summary[name] = {"leaves": len(leaf_meta), "bytes": nbytes}
+    return summary
+
+
+def info(path: str) -> dict:
+    """Describe a checkpoint directory: which ranks, which names."""
+    root = os.path.expanduser(path)
+    out: dict = {"path": root, "ranks": {}}
+    if not os.path.isdir(root):
+        return out
+    for entry in sorted(os.listdir(root)):
+        # Exact rank_<digits> only — skips rank_N.tmp/.old staging dirs
+        # left by an interrupted save.
+        if not re.fullmatch(r"rank_\d+", entry):
+            continue
+        mpath = os.path.join(root, entry, "manifest.json")
+        if not os.path.exists(mpath):
+            continue
+        with open(mpath) as f:
+            manifest = json.load(f)
+        out["ranks"][int(entry.split("_", 1)[1])] = {
+            "world_size": manifest.get("world_size"),
+            "names": sorted(manifest.get("entries", {})),
+        }
+    return out

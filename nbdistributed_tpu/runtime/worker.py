@@ -207,6 +207,31 @@ class DistributedWorker:
                 self.namespace), "status": "success"},
             rank=self.rank)
 
+    def _handle_checkpoint(self, msg: Message) -> Message:
+        """Save/restore named namespace entries (SURVEY §5.4 upgrade —
+        the reference has no checkpoint subsystem at all)."""
+        from . import checkpoint
+
+        action = msg.data.get("action")
+        path = msg.data["path"]
+        names = msg.data.get("names")
+        if action == "save":
+            if not names:
+                return msg.reply(
+                    data={"error": "checkpoint save requires a non-empty "
+                                   "list of names"}, rank=self.rank)
+            summary = checkpoint.save(path, self.namespace, names,
+                                      rank=self.rank,
+                                      world_size=self.world_size)
+        elif action == "restore":
+            summary = checkpoint.restore(path, self.namespace, names,
+                                         rank=self.rank)
+        else:
+            return msg.reply(data={"error": f"unknown checkpoint action "
+                                            f"{action!r}"}, rank=self.rank)
+        return msg.reply(data={"status": action, "summary": summary},
+                         rank=self.rank)
+
     def _handle_profile(self, msg: Message) -> Message:
         import jax
         action = msg.data.get("action")
@@ -233,6 +258,7 @@ class DistributedWorker:
             "get_status": self._handle_get_status,
             "get_namespace_info": self._handle_get_namespace_info,
             "profile": self._handle_profile,
+            "checkpoint": self._handle_checkpoint,
         }
         while not self._shutdown.is_set():
             try:

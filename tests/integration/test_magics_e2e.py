@@ -142,3 +142,27 @@ def test_namespace_info_magic_surface(ip, capsys):
     ip.run_line_magic("dist_sync_ide", "")
     out = capsys.readouterr().out
     assert "synced" in out
+
+
+def test_checkpoint_and_restore_magics(ip, capsys, tmp_path):
+    path = tmp_path / "magic_ck"
+    run(ip, "ckm_v = jnp.arange(4.0) + rank")
+    capsys.readouterr()
+    ip.run_line_magic("dist_checkpoint", f"{path} ckm_v")
+    out = capsys.readouterr().out
+    assert "2 ranks saved" in out and "ckm_v (1 leaves)" in out
+    run(ip, "ckm_v = 'clobbered'")
+    capsys.readouterr()
+    ip.run_line_magic("dist_restore", str(path))
+    out = capsys.readouterr().out
+    assert "2 ranks restored" in out
+    run(ip, "float(ckm_v[3])")
+    out = capsys.readouterr().out
+    assert "3.0" in out and "4.0" in out
+
+
+def test_checkpoint_missing_var_reports_per_rank(ip, capsys, tmp_path):
+    ip.run_line_magic("dist_checkpoint",
+                      f"{tmp_path / 'ck_missing'} not_a_var")
+    out = capsys.readouterr().out
+    assert "❌" in out and "not_a_var" in out

@@ -170,3 +170,35 @@ def test_error_cell_reports_per_rank(cluster):
     # workers stay healthy afterwards
     out = outputs(comm.send_to_all("execute", "'alive'"))
     assert out == {0: "'alive'", 1: "'alive'"}
+
+
+def test_checkpoint_save_restore_roundtrip(cluster, tmp_path):
+    comm, _ = cluster
+    path = str(tmp_path / "ck")
+    comm.send_to_all("execute",
+                     "ck_w = jnp.ones((2, 3)) * (rank + 1)\n"
+                     "ck_step = 40 + rank")
+    resp = comm.send_to_all("checkpoint", {"action": "save", "path": path,
+                                           "names": ["ck_w", "ck_step"]})
+    for m in resp.values():
+        assert m.data["status"] == "save", m.data
+        assert m.data["summary"]["ck_w"]["bytes"] == 24
+    # clobber, then restore and verify per-rank values came back
+    comm.send_to_all("execute", "ck_w = None; ck_step = None")
+    resp = comm.send_to_all("checkpoint",
+                            {"action": "restore", "path": path,
+                             "names": None})
+    for m in resp.values():
+        assert m.data["status"] == "restore", m.data
+    out = outputs(comm.send_to_all(
+        "execute", "(float(ck_w[0, 0]), ck_step)"))
+    assert out == {0: "(1.0, 40)", 1: "(2.0, 41)"}
+
+
+def test_checkpoint_missing_name_errors_cleanly(cluster, tmp_path):
+    comm, _ = cluster
+    resp = comm.send_to_all(
+        "checkpoint", {"action": "save", "path": str(tmp_path / "ck2"),
+                       "names": ["no_such_var"]})
+    for m in resp.values():
+        assert "no_such_var" in m.data["error"]

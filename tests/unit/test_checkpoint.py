@@ -1,0 +1,178 @@
+"""Unit tests for the checkpoint subsystem (SURVEY §5.4 upgrade).
+
+Pure-logic tier: save/restore round-trips on a local namespace dict,
+no worker processes involved.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from nbdistributed_tpu.runtime import checkpoint
+
+
+def roundtrip(tmp_path, ns, names, restore_names=None):
+    checkpoint.save(str(tmp_path / "ck"), ns, names, rank=0, world_size=1)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out, restore_names, rank=0)
+    return out
+
+
+def test_array_roundtrip_exact(tmp_path):
+    ns = {"x": jnp.arange(12.0).reshape(3, 4)}
+    out = roundtrip(tmp_path, ns, ["x"])
+    assert isinstance(out["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out["x"]),
+                                  np.asarray(ns["x"]))
+
+
+def test_bfloat16_dtype_survives(tmp_path):
+    ns = {"w": jnp.asarray([1.5, -2.0, 3.25], jnp.bfloat16)}
+    out = roundtrip(tmp_path, ns, ["w"])
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.asarray(ns["w"], np.float32))
+
+
+def test_numpy_stays_numpy_jax_stays_jax(tmp_path):
+    ns = {"a": np.arange(3, dtype=np.int64), "b": jnp.ones(2)}
+    out = roundtrip(tmp_path, ns, ["a", "b"])
+    assert type(out["a"]) is np.ndarray and out["a"].dtype == np.int64
+    assert isinstance(out["b"], jax.Array)
+
+
+def test_pytree_with_optax_state(tmp_path):
+    params = {"dense": {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}}
+    opt = optax.adamw(1e-3)
+    state = opt.init(params)
+    ns = {"params": params, "opt_state": state, "step": 17,
+          "note": "hello"}
+    out = roundtrip(tmp_path, ns, ["params", "opt_state", "step", "note"])
+    assert out["step"] == 17 and out["note"] == "hello"
+    # NamedTuple structure (ScaleByAdamState etc.) must reconstruct.
+    assert type(out["opt_state"]) is type(state)
+    leaves_in = jax.tree_util.tree_leaves(state)
+    leaves_out = jax.tree_util.tree_leaves(out["opt_state"])
+    for a, b in zip(leaves_in, leaves_out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_object_dtype_ndarray_roundtrips_via_pickle_path(tmp_path):
+    ns = {"o": np.array([{"a": 1}, None, "s"], dtype=object)}
+    out = roundtrip(tmp_path, ns, ["o"])
+    assert out["o"].dtype == object
+    assert list(out["o"]) == [{"a": 1}, None, "s"]
+
+
+def test_restored_numpy_array_is_writable(tmp_path):
+    ns = {"a": np.arange(4.0)}
+    out = roundtrip(tmp_path, ns, ["a"])
+    out["a"][0] = 99.0
+    assert out["a"][0] == 99.0
+
+
+def test_non_contiguous_array_saves_correctly(tmp_path):
+    base = np.arange(12.0).reshape(3, 4)
+    ns = {"t": base.T}  # strided view
+    out = roundtrip(tmp_path, ns, ["t"])
+    np.testing.assert_array_equal(out["t"], base.T)
+
+
+def test_restore_subset_of_names(tmp_path):
+    ns = {"x": jnp.ones(2), "y": jnp.zeros(2)}
+    out = roundtrip(tmp_path, ns, ["x", "y"], restore_names=["y"])
+    assert set(out) == {"y"}
+
+
+def test_missing_name_on_save_raises(tmp_path):
+    with pytest.raises(KeyError, match="nope"):
+        checkpoint.save(str(tmp_path / "ck"), {"x": 1}, ["nope"], rank=0)
+
+
+def test_missing_name_on_restore_raises(tmp_path):
+    ns = {"x": 1}
+    checkpoint.save(str(tmp_path / "ck"), ns, ["x"], rank=0)
+    with pytest.raises(KeyError, match="ghost"):
+        checkpoint.restore(str(tmp_path / "ck"), {}, ["ghost"], rank=0)
+
+
+def test_missing_rank_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore(str(tmp_path / "ck"), {}, rank=3)
+
+
+def test_per_rank_dirs_are_independent(tmp_path):
+    for r in range(2):
+        checkpoint.save(str(tmp_path / "ck"), {"v": jnp.full(2, r)},
+                        ["v"], rank=r, world_size=2)
+    out0, out1 = {}, {}
+    checkpoint.restore(str(tmp_path / "ck"), out0, rank=0)
+    checkpoint.restore(str(tmp_path / "ck"), out1, rank=1)
+    assert float(out0["v"][0]) == 0.0 and float(out1["v"][0]) == 1.0
+
+
+def test_resave_overwrites_cleanly(tmp_path):
+    ns1 = {"x": jnp.ones(2), "extra": jnp.zeros(3)}
+    checkpoint.save(str(tmp_path / "ck"), ns1, ["x", "extra"], rank=0)
+    checkpoint.save(str(tmp_path / "ck"), {"x": jnp.full(2, 7.0)},
+                    ["x"], rank=0)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out, rank=0)
+    # Second save fully replaces the dir: no stale 'extra' entry.
+    assert set(out) == {"x"}
+    assert float(out["x"][0]) == 7.0
+
+
+def test_failed_save_preserves_previous_checkpoint(tmp_path):
+    checkpoint.save(str(tmp_path / "ck"), {"x": jnp.ones(2)}, ["x"],
+                    rank=0)
+    with pytest.raises(Exception):
+        # Lambdas don't pickle → the staged tmp dir fails mid-write.
+        checkpoint.save(str(tmp_path / "ck"), {"x": lambda: None},
+                        ["x"], rank=0)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out, rank=0)
+    assert float(out["x"][0]) == 1.0
+
+
+def test_jax_leaf_manifest_records_sharding(tmp_path):
+    import json
+    checkpoint.save(str(tmp_path / "ck"), {"x": jnp.ones(2)}, ["x"],
+                    rank=0)
+    with open(tmp_path / "ck" / "rank_0" / "manifest.json") as f:
+        manifest = json.load(f)
+    leaf = manifest["entries"]["x"]["leaves"][0]
+    assert leaf["kind"] == "jax" and "sharding" in leaf
+
+
+def test_structured_dtype_roundtrips_via_pickle_path(tmp_path):
+    rec = np.zeros(3, dtype=[("a", "<i4"), ("b", "<f8")])
+    rec["a"] = [1, 2, 3]
+    out = roundtrip(tmp_path, {"rec": rec}, ["rec"])
+    assert out["rec"].dtype == rec.dtype
+    np.testing.assert_array_equal(out["rec"]["a"], rec["a"])
+
+
+def test_info_skips_staging_dirs(tmp_path):
+    checkpoint.save(str(tmp_path / "ck"), {"x": jnp.ones(1)}, ["x"],
+                    rank=0)
+    # Simulate an interrupted save's leftovers.
+    import shutil
+    shutil.copytree(tmp_path / "ck" / "rank_0",
+                    tmp_path / "ck" / "rank_0.tmp")
+    shutil.copytree(tmp_path / "ck" / "rank_0",
+                    tmp_path / "ck" / "rank_1.old")
+    meta = checkpoint.info(str(tmp_path / "ck"))
+    assert sorted(meta["ranks"]) == [0]
+
+
+def test_info_lists_ranks_and_names(tmp_path):
+    for r in range(2):
+        checkpoint.save(str(tmp_path / "ck"), {"p": jnp.ones(1), "s": 2},
+                        ["p", "s"], rank=r, world_size=2)
+    meta = checkpoint.info(str(tmp_path / "ck"))
+    assert sorted(meta["ranks"]) == [0, 1]
+    assert meta["ranks"][0]["names"] == ["p", "s"]
+    assert meta["ranks"][0]["world_size"] == 2

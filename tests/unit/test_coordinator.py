@@ -34,7 +34,10 @@ class ScriptedWorker:
                 return
             out = self.handler(self.rank, msg)
             if out is not None:
-                self.chan.send(msg.reply(data=out, rank=self.rank))
+                try:
+                    self.chan.send(msg.reply(data=out, rank=self.rank))
+                except Exception:
+                    return  # channel closed by test teardown mid-reply
 
     def close(self):
         self.chan.close()
