@@ -45,7 +45,7 @@ Every cell now runs on ALL workers. Namespace on each worker:
   dist                 — torch.distributed-style facade
   all_reduce, all_gather, broadcast, barrier, reduce_scatter
                        — eager collectives over ICI/DCN
-  make_mesh, shard_batch, ring_attention,
+  make_mesh, shard_batch, ring_attention, ulysses_attention,
   pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
                        — mesh/SP/PP/EP building blocks
 

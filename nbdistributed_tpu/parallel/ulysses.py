@@ -1,0 +1,90 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy beside ring attention (ring.py): instead
+of streaming K/V chunks around the ring, two ``all_to_all`` collectives
+re-shard the activations from sequence-sharded to *head*-sharded and
+back, so every device runs ordinary full-sequence attention on its slice
+of heads (DeepSpeed-Ulysses pattern; the reference has no sequence
+parallelism at all, SURVEY §5.7).
+
+Trade-off vs ring: communication is 2 all-to-alls of the activations
+(O(B·S·H·D / n) per device, one shot each way, ideal on ICI's all-to-all
+bandwidth) instead of n ppermute hops, and the inner attention is a
+plain local kernel — so it composes directly with the Pallas flash
+kernel (ops/attention.py).  The constraint is that the head count must
+be divisible by the mesh axis size, which ring does not require.
+
+Layouts inside ``shard_map`` (local views, mesh axis size n):
+
+    (B, S/n, H, D)  --all_to_all(split H, concat S)-->  (B, S, H/n, D)
+        ... full-sequence attention over H/n heads ...
+    (B, S, H/n, D)  --all_to_all(split S, concat H)-->  (B, S/n, H, D)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=None)
+def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
+                use_flash: bool):
+    n = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+    inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
+                              scale=scale, use_flash=use_flash)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+
+
+def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
+                      causal: bool = True, scale: float | None = None,
+                      use_flash: bool = False):
+    """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``,
+    computed head-parallel after an all-to-all re-shard.
+
+    q/k/v: (B, S, H, D) global arrays, S sharded over ``mesh[axis]``;
+    returns output with the same sharding.  Requires ``H % n == 0`` and
+    equal q/kv head counts (expand GQA before sharding, as with
+    ring_attention).  ``use_flash=True`` runs the Pallas flash kernel as
+    the local attention (TPU path); default is the XLA reference.
+    """
+    n = mesh.shape[axis]
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs head count divisible by the "
+            f"{axis!r} axis: H={H}, n={n}. Use ring_attention for "
+            "head counts that don't split.")
+    if k.shape[2] != H or v.shape[2] != H:
+        raise ValueError(
+            f"q/k/v head counts must match (got {H}, {k.shape[2]}, "
+            f"{v.shape[2]}); expand GQA heads before sharding.")
+    D = q.shape[-1]
+    scale = scale if scale is not None else float(1.0 / np.sqrt(D))
+    return _ulysses_fn(mesh, axis, causal, scale, use_flash)(q, k, v)
+
+
+def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,
+                   use_flash: bool):
+    from ..ops import attention_reference, flash_attention
+
+    # seq-sharded -> head-sharded: gather the full sequence, keep H/n.
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    attn = flash_attention if use_flash else attention_reference
+    out = attn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out.astype(q.dtype))

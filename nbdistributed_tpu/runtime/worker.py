@@ -87,6 +87,7 @@ class DistributedWorker:
         from ..parallel import collectives, expert, mesh as mesh_mod, \
             pipeline
         from ..parallel.ring import ring_attention
+        from ..parallel.ulysses import ulysses_attention
 
         dist = collectives.DistNamespace()
         ns = {
@@ -113,6 +114,7 @@ class DistributedWorker:
             "make_mesh": mesh_mod.make_mesh,
             "shard_batch": mesh_mod.shard_batch,
             "ring_attention": ring_attention,
+            "ulysses_attention": ulysses_attention,
             "pipeline_forward": pipeline.pipeline_forward,
             "shard_stage_params": pipeline.shard_stage_params,
             "moe_ffn": expert.moe_ffn,
