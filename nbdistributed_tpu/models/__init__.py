@@ -2,6 +2,8 @@
 tiny demo scale up to Llama-2-7B, matching BASELINE.json's acceptance
 configs)."""
 
+from .generate import (forward_with_cache, generate, init_kv_cache,
+                       kv_cache_shardings, make_generate_fn)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
@@ -14,4 +16,6 @@ __all__ = ["TransformerConfig", "forward", "init_params",
            "param_shardings", "smol_135m_config", "tiny_config",
            "MoEConfig", "init_moe_model", "mixtral_8x7b_config",
            "moe_forward", "moe_loss_fn", "moe_model_shardings",
-           "tiny_moe_config"]
+           "tiny_moe_config",
+           "forward_with_cache", "generate", "init_kv_cache",
+           "kv_cache_shardings", "make_generate_fn"]

@@ -1,0 +1,201 @@
+"""Autoregressive generation with a KV cache.
+
+The reference framework has no inference path of its own (its users call
+HF ``model.generate`` in cells); a first-party TPU decode loop is part
+of making the model family usable interactively.  Design for XLA:
+
+* static shapes everywhere — the cache is a fixed ``max_len`` ring of
+  zeros, new K/V written by ``lax.dynamic_update_slice``; attention
+  masks against global positions instead of slicing a traced length;
+* the whole decode loop is one ``lax.scan`` (one compile, no Python
+  per-token dispatch); prefill is one batched forward over the prompt;
+* grouped-query attention against the cache without materializing
+  repeated KV heads (grouped einsum, fp32 accumulation);
+* tensor-parallel ready: :func:`kv_cache_shardings` shards the cache
+  over KV heads on the ``tp`` axis, matching
+  :func:`~nbdistributed_tpu.models.transformer.param_shardings`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .transformer import TransformerConfig, _mlp_block, _rms_norm, _rope
+
+_NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------------
+# cache
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
+                  mesh=None, rules: dict | None = None):
+    """Zeroed (L, B, max_len, Hkv, Dh) K and V buffers.
+
+    With ``mesh``, the buffers are laid out by ``rules`` (default:
+    :func:`kv_cache_shardings` restricted to the axes the mesh has) so
+    the decode loop keeps the cache sharded like the parameters."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    cache = {"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+    if mesh is not None:
+        if rules is None:
+            rules = kv_cache_shardings(
+                dp_axis="dp" if "dp" in mesh.shape else None,
+                tp_axis="tp" if "tp" in mesh.shape else None)
+        cache = {name: jax.device_put(
+            buf, NamedSharding(mesh, rules[name]))
+            for name, buf in cache.items()}
+    return cache
+
+
+def kv_cache_shardings(dp_axis: str | None = "dp",
+                       tp_axis: str | None = "tp"):
+    """PartitionSpec for the cache: batch over dp, KV heads over tp."""
+    spec = P(None, dp_axis, None, tp_axis, None)
+    return {"k": spec, "v": spec}
+
+
+# ----------------------------------------------------------------------
+# cache-aware forward
+
+def _cached_attention(q, kc, vc, positions, scale):
+    """GQA attention of new-token queries against the full cache.
+
+    q: (B, S, H, Dh) — S new tokens; kc/vc: (B, T, Hkv, Dh) — the whole
+    cache buffer; positions: (B, S) global positions of the queries.
+    Valid keys are exactly cache slots t <= position (later slots are
+    unwritten zeros and masked out by the same comparison).
+    """
+    B, S, H, Dh = q.shape
+    T, Hkv = kc.shape[1], kc.shape[2]
+    group = H // Hkv
+    qg = (q.astype(jnp.float32) * scale).reshape(B, S, Hkv, group, Dh)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, kc.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, None, :] <= positions[:, :, None]  # (B,S,T)
+    s = jnp.where(mask[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgst,btkd->bskgd", p, vc.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o.reshape(B, S, H * Dh).astype(q.dtype)
+
+
+def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
+                       cfg: TransformerConfig, *,
+                       last_only: bool = False):
+    """Run ``tokens`` (B, S) through the model, reading/writing the KV
+    cache at offset ``cache_len`` (traced scalar ok).
+
+    Returns (logits fp32, updated cache): (B, S, vocab), or (B, 1,
+    vocab) with ``last_only`` — prefill for generation needs only the
+    final position, which skips S-1 of the (d_model × vocab) lm_head
+    matmul.  Covers both prefill (S = prompt length, cache_len = 0)
+    and decode (S = 1).
+    """
+    B, S = tokens.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = cache_len + jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    scale = 1.0 / float(cfg.head_dim) ** 0.5
+
+    def layer_step(x, inputs):
+        layer, kc, vc = inputs
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope((h @ layer["wq"]).reshape(B, S, H, Dh), positions,
+                  cfg.rope_theta)
+        k = _rope((h @ layer["wk"]).reshape(B, S, Hkv, Dh), positions,
+                  cfg.rope_theta)
+        v = (h @ layer["wv"]).reshape(B, S, Hkv, Dh)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, cache_len, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, cache_len, 0, 0))
+        o = _cached_attention(q, kc, vc, positions, scale)
+        x = x + o @ layer["wo"]
+        x = _mlp_block(x, layer, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    if last_only:
+        x = x[:, -1:]
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+# ----------------------------------------------------------------------
+# sampling + the decode loop
+
+def _sample(logits, temperature: float, key):
+    """logits: (B, vocab) -> (B,) int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate(params: dict, prompt, cfg: TransformerConfig,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             key=None, max_len: int | None = None, mesh=None):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0).
+
+    Greedy when ``temperature == 0`` (default), else categorical
+    sampling with ``key`` (required).  With ``mesh``, the KV cache is
+    created sharded (batch over ``dp``, KV heads over ``tp`` — pass
+    tensor-parallel params sharded by ``param_shardings``).  Returns
+    (B, S0+max_new_tokens) tokens.  Jit-compatible: wrap in ``jax.jit``
+    with ``static_argnums``/closure for cfg and max_new_tokens, or use
+    :func:`make_generate_fn`.
+    """
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got "
+                         f"{max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
+    if temperature != 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    B, S0 = prompt.shape
+    T = max_len if max_len is not None else S0 + max_new_tokens
+    if T < S0 + max_new_tokens:
+        raise ValueError(f"max_len {T} < prompt {S0} + new "
+                         f"{max_new_tokens}")
+    cache = init_kv_cache(cfg, B, T, mesh=mesh)
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg,
+                                       last_only=True)
+    key, k0 = jax.random.split(key)
+    tok = _sample(logits[:, -1], temperature, k0)
+
+    def step(carry, i):
+        cache, tok, key = carry
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, S0 + i, cfg)
+        key, ks = jax.random.split(key)
+        nxt = _sample(logits[:, -1], temperature, ks)
+        return (cache, nxt, key), tok
+
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, tok, key), jnp.arange(max_new_tokens - 1))
+    out = jnp.moveaxis(toks, 0, 1) if max_new_tokens > 1 \
+        else jnp.zeros((B, 0), jnp.int32)
+    return jnp.concatenate([prompt, out, last[:, None]], axis=1)
+
+
+def make_generate_fn(cfg: TransformerConfig, max_new_tokens: int, *,
+                     temperature: float = 0.0, max_len: int | None = None,
+                     mesh=None):
+    """A jitted ``(params, prompt, key) -> tokens`` closure."""
+
+    def fn(params, prompt, key=None):
+        return generate(params, prompt, cfg, max_new_tokens,
+                        temperature=temperature, key=key, max_len=max_len,
+                        mesh=mesh)
+
+    return jax.jit(fn)
