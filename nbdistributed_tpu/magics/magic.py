@@ -179,6 +179,11 @@ class DistributedMagics(Magics):
               help="TPU chips owned by each worker process")
     @argument("--attach-timeout", type=float, default=180.0,
               help="seconds to wait for workers to come up")
+    @argument("--hosts", default=None,
+              help="multi-host spec 'h1,h2:2,local' (one worker per TPU "
+                   "host); requires --coordinator-addr for remote hosts")
+    @argument("--coordinator-addr", default="127.0.0.1",
+              help="address of this kernel reachable from every host")
     @line_magic
     def dist_init(self, line):
         """Start N workers and route subsequent cells to them
@@ -189,17 +194,39 @@ class DistributedMagics(Magics):
                   "%dist_shutdown first.")
             return
         t0 = time.time()
-        comm = CommunicationManager(num_workers=args.num_workers,
+        num_workers = args.num_workers
+        host_specs = None
+        if args.hosts:
+            if args.chips_per_worker != 1:
+                print("❌ --chips-per-worker is a single-host option; "
+                      "host plans run one worker per TPU host.")
+                return
+            from ..manager import multihost
+            try:
+                host_specs = multihost.parse_hosts(args.hosts)
+            except ValueError as e:
+                print(f"❌ {e}")
+                return
+            num_workers = sum(h.workers for h in host_specs)
+        comm = CommunicationManager(num_workers=num_workers,
                                     timeout=args.timeout)
         pm = ProcessManager()
         pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
         pm.add_death_callback(self._announce_death)
         try:
-            print(f"🚀 Spawning {args.num_workers} workers "
-                  f"(backend={args.backend})...")
-            pm.start_workers(args.num_workers, comm.port,
-                             backend=args.backend,
-                             chips_per_worker=args.chips_per_worker)
+            print(f"🚀 Spawning {num_workers} workers "
+                  f"(backend={args.backend}"
+                  + (f", hosts={args.hosts}" if args.hosts else "")
+                  + ")...")
+            if host_specs is not None:
+                pm.start_workers_multihost(
+                    host_specs, comm.port,
+                    coordinator_host=args.coordinator_addr,
+                    backend=args.backend)
+            else:
+                pm.start_workers(num_workers, comm.port,
+                                 backend=args.backend,
+                                 chips_per_worker=args.chips_per_worker)
             deadline = time.time() + args.attach_timeout
             while True:
                 try:
@@ -210,7 +237,7 @@ class DistributedMagics(Magics):
                     if time.time() > deadline:
                         raise
                     print(f"   ... waiting ({len(comm.connected_ranks())}/"
-                          f"{args.num_workers} attached)")
+                          f"{num_workers} attached)")
         except Exception as e:
             print(f"❌ Worker startup failed: {e}")
             pm.shutdown()
@@ -219,9 +246,9 @@ class DistributedMagics(Magics):
         comm.set_output_callback(self._feed_stream)
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
-        DistributedMagics._world = args.num_workers
+        DistributedMagics._world = num_workers
         self._enable_auto_mode()
-        print(_BANNER.format(n=args.num_workers,
+        print(_BANNER.format(n=num_workers,
                              backend=pm.backend,
                              secs=time.time() - t0))
 

@@ -81,7 +81,7 @@ class ProcessManager:
                       backend: str = "auto", coordinator_host: str = "127.0.0.1",
                       chips_per_worker: int = 1,
                       extra_env: dict | None = None) -> None:
-        """Spawn ``num_workers`` worker processes.
+        """Spawn ``num_workers`` worker processes on this host.
 
         The caller (magic layer) pairs this with
         ``CommunicationManager.wait_for_workers``; use
@@ -108,13 +108,60 @@ class ProcessManager:
                    "--backend", backend]
             if self.dist_port is not None:
                 cmd += ["--dist-port", str(self.dist_port)]
-            proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                env=env, start_new_session=True,  # own pgid for group kill
-                cwd=os.getcwd())
-            self.processes[rank] = proc
-            self.io[rank] = _ChildIO(proc, rank)
+            self._spawn(rank, cmd, env)
+        self._start_monitor()
 
+    def start_workers_multihost(self, hosts, control_port: int, *,
+                                coordinator_host: str,
+                                backend: str = "auto",
+                                ssh: str = "ssh") -> int:
+        """Launch workers across hosts per a
+        :func:`~nbdistributed_tpu.manager.multihost.make_launch_plan`.
+
+        ``hosts``: a spec string (``"h1,h2:2,local"``) or list of
+        ``HostSpec``.  Entries with host ``"local"`` spawn directly;
+        remote entries spawn an ssh proxy process whose stdio/kill
+        semantics match a local child's.  Returns the world size.
+        """
+        from . import multihost
+
+        if self.processes:
+            raise RuntimeError("workers already running; shutdown first")
+        specs = multihost.parse_hosts(hosts) if isinstance(hosts, str) \
+            else list(hosts)
+        if backend == "auto":
+            backend = topology.detect_backend()
+        self.backend = backend
+        self.world_size = sum(h.workers for h in specs)
+        self.dist_port = find_free_port() if self.world_size > 1 else None
+        plan = multihost.make_launch_plan(
+            specs, coordinator_host=coordinator_host,
+            control_port=control_port, dist_port=self.dist_port,
+            backend=backend)
+        for launch in plan:
+            if launch.host == "local":
+                # Direct spawn: local base env (incl. the cpu backend's
+                # sitecustomize neutralization) + the plan's overrides.
+                env = topology.cpu_worker_env() if backend == "cpu" \
+                    else dict(os.environ)
+                env.update(dict(launch.env))
+                self._spawn(launch.rank, list(launch.argv), env)
+            else:
+                self._spawn(launch.rank,
+                            multihost.ssh_argv(launch, ssh=ssh),
+                            dict(os.environ))
+        self._start_monitor()
+        return self.world_size
+
+    def _spawn(self, rank: int, cmd: list[str], env: dict) -> None:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, start_new_session=True,  # own pgid for group kill
+            cwd=os.getcwd())
+        self.processes[rank] = proc
+        self.io[rank] = _ChildIO(proc, rank)
+
+    def _start_monitor(self) -> None:
         self._monitor_stop.clear()
         self._monitor_thread = threading.Thread(
             target=self._monitor, name="nbd-child-monitor", daemon=True)

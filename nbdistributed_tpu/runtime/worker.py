@@ -38,10 +38,14 @@ HEARTBEAT_INTERVAL_S = 2.0
 class DistributedWorker:
     def __init__(self, rank: int, world_size: int, coordinator_host: str,
                  control_port: int, dist_port: int | None = None,
-                 backend: str | None = None):
+                 backend: str | None = None,
+                 dist_host: str | None = None):
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
+        # Control plane dials the kernel; the jax.distributed rendezvous
+        # dials rank 0's host (they differ on all-remote host plans).
+        dist_host = dist_host or coordinator_host
 
         # --- data plane: JAX runtime init (reference: worker.py:145-151) --
         if backend == "cpu":
@@ -55,7 +59,7 @@ class DistributedWorker:
             print(f"[worker {rank}] joining jax.distributed world "
                   f"({world_size} processes)...", flush=True)
             jax.distributed.initialize(
-                coordinator_address=f"{coordinator_host}:{dist_port}",
+                coordinator_address=f"{dist_host}:{dist_port}",
                 num_processes=world_size,
                 process_id=rank)
         import jax  # noqa: F811 — backend resolves here
@@ -309,6 +313,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--dist-port", type=int, default=None,
                    help="jax.distributed coordinator port (omit for "
                         "single-process worlds)")
+    p.add_argument("--dist-host", default=None,
+                   help="jax.distributed coordinator host = rank 0's "
+                        "host (default: --coordinator-host)")
     p.add_argument("--backend", default=None, choices=[None, "cpu", "tpu"],
                    help="force a JAX platform (cpu for tests/CI)")
     args = p.parse_args(argv)
@@ -317,7 +324,7 @@ def main(argv: list[str] | None = None) -> int:
         rank=args.rank, world_size=args.world_size,
         coordinator_host=args.coordinator_host,
         control_port=args.control_port, dist_port=args.dist_port,
-        backend=args.backend)
+        backend=args.backend, dist_host=args.dist_host)
     try:
         worker.run()
     finally:

@@ -202,3 +202,35 @@ def test_checkpoint_missing_name_errors_cleanly(cluster, tmp_path):
                        "names": ["no_such_var"]})
     for m in resp.values():
         assert "no_such_var" in m.data["error"]
+
+
+def test_multihost_local_plan_runs_real_workers():
+    """Drive the multi-host code path end-to-end with 'local' hosts:
+    the plan's argv/env must bring up a real 2-process world."""
+    comm = CommunicationManager(num_workers=2, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        world = pm.start_workers_multihost(
+            "local:2", comm.port, coordinator_host="127.0.0.1",
+            backend="cpu")
+        assert world == 2
+        deadline = time.time() + ATTACH_TIMEOUT
+        while True:
+            try:
+                comm.wait_for_workers(timeout=2)
+                break
+            except TimeoutError:
+                pm.check_startup_failure()
+                if time.time() > deadline:
+                    raise
+        out = outputs(comm.send_to_all("execute", "rank + 40"))
+        assert out == {0: "40", 1: "41"}
+        out = outputs(comm.send_to_all(
+            "execute", "float(all_reduce(jnp.ones(2))[0])", timeout=180))
+        assert out == {0: "2.0", 1: "2.0"}
+    finally:
+        comm.post([0, 1], "shutdown")
+        time.sleep(0.5)
+        pm.shutdown()
+        comm.shutdown()

@@ -1,0 +1,98 @@
+"""Multi-host launch plans: pure-logic tier (no processes)."""
+
+import sys
+
+import pytest
+
+from nbdistributed_tpu.manager import multihost
+from nbdistributed_tpu.manager.multihost import (HostSpec, make_launch_plan,
+                                                 parse_hosts, ssh_argv)
+
+
+def test_parse_hosts_forms():
+    assert parse_hosts("h1,h2:4,local:2") == [
+        HostSpec("h1", 1), HostSpec("h2", 4), HostSpec("local", 2)]
+
+
+@pytest.mark.parametrize("bad", ["", ":3", "h1:x", "h1:0", "h1:-2"])
+def test_parse_hosts_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_hosts(bad)
+
+
+def test_plan_assigns_ranks_host_major():
+    plan = make_launch_plan(
+        [HostSpec("a", 2), HostSpec("b", 1)], coordinator_host="10.0.0.9",
+        control_port=7000, dist_port=7001, backend="cpu")
+    assert [(l.rank, l.host) for l in plan] == [(0, "a"), (1, "a"),
+                                                (2, "b")]
+    for l in plan:
+        argv = list(l.argv)
+        assert argv[:3] == [sys.executable, "-m",
+                            "nbdistributed_tpu.runtime.worker"]
+        assert argv[argv.index("--rank") + 1] == str(l.rank)
+        assert argv[argv.index("--world-size") + 1] == "3"
+        assert argv[argv.index("--coordinator-host") + 1] == "10.0.0.9"
+        assert argv[argv.index("--dist-port") + 1] == "7001"
+
+
+def test_plan_rejects_loopback_coordinator_with_remote_hosts():
+    with pytest.raises(ValueError, match="loopback"):
+        make_launch_plan([HostSpec("remote1")],
+                         coordinator_host="127.0.0.1", control_port=1,
+                         dist_port=2, backend="tpu")
+
+
+def test_plan_allows_loopback_for_all_local():
+    plan = make_launch_plan([HostSpec("local", 2)],
+                            coordinator_host="127.0.0.1", control_port=1,
+                            dist_port=2, backend="cpu")
+    assert len(plan) == 2
+    assert dict(plan[0].env)["JAX_PLATFORMS"] == "cpu"
+
+
+@pytest.mark.parametrize("host", ["podhost", "local"])
+def test_tpu_plan_rejects_multiple_workers_per_host(host):
+    with pytest.raises(ValueError, match="one worker per host"):
+        make_launch_plan([HostSpec(host, 4)],
+                         coordinator_host="10.0.0.9", control_port=1,
+                         dist_port=2, backend="tpu")
+
+
+def test_tpu_plan_ships_no_carving_env():
+    plan = make_launch_plan([HostSpec("h1"), HostSpec("h2")],
+                            coordinator_host="10.0.0.9", control_port=1,
+                            dist_port=2, backend="tpu")
+    assert all(l.env == () for l in plan)
+
+
+def test_dist_host_is_rank0_host_for_remote_plans():
+    """jax.distributed's coordination service runs in rank 0's process,
+    so the rendezvous address must be rank 0's host — not the kernel."""
+    plan = make_launch_plan([HostSpec("tpu-w-0"), HostSpec("tpu-w-1")],
+                            coordinator_host="10.0.0.9", control_port=1,
+                            dist_port=2, backend="tpu")
+    for l in plan:
+        argv = list(l.argv)
+        assert argv[argv.index("--dist-host") + 1] == "tpu-w-0"
+        assert argv[argv.index("--coordinator-host") + 1] == "10.0.0.9"
+
+
+def test_dist_host_is_coordinator_when_rank0_local():
+    plan = make_launch_plan([HostSpec("local"), HostSpec("tpu-w-1")],
+                            coordinator_host="10.0.0.9", control_port=1,
+                            dist_port=2, backend="tpu")
+    argv = list(plan[0].argv)
+    assert argv[argv.index("--dist-host") + 1] == "10.0.0.9"
+
+
+def test_ssh_argv_quotes_and_targets_host():
+    plan = make_launch_plan([HostSpec("tpu-w-3")],
+                            coordinator_host="10.0.0.9", control_port=70,
+                            dist_port=None, backend="cpu")
+    argv = ssh_argv(plan[0])
+    assert argv[0] == "ssh" and "tpu-w-3" in argv
+    remote = argv[-1]
+    assert remote.startswith("exec env ")
+    assert "JAX_PLATFORMS=cpu" in remote
+    assert "--rank 0" in remote and "--control-port 70" in remote
