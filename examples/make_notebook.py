@@ -149,6 +149,37 @@ eval_batch = {"tokens": jax.random.randint(jax.random.PRNGKey(999),
 eval_loss = float(loss_fn(params, eval_batch, cfg))
 print(f"rank {rank}: eval loss {eval_loss:.4f}")""")
 
+md("""## Checkpoint / restore
+
+`%dist_checkpoint` snapshots named namespace pytrees from every rank
+(atomic per-rank dirs, bfloat16-exact); `%dist_restore` loads them
+back — the save/resume loop for long interactive sessions.""")
+
+code("%dist_checkpoint /tmp/nbd_demo_ckpt params opt_state")
+
+code("""\
+# Clobber the params, then restore them.
+params = None""")
+
+code("%dist_restore /tmp/nbd_demo_ckpt")
+
+code("""\
+# Restored params give the exact same eval loss.
+print(f"rank {rank}: eval after restore "
+      f"{float(loss_fn(params, eval_batch, cfg)):.4f}")""")
+
+md("""## Generation
+
+The model family includes a static-shape KV-cache decode loop (one
+`lax.scan`, greedy or sampled) — here greedy continuations of a toy
+prompt on every rank.""")
+
+code("""\
+from nbdistributed_tpu.models import generate
+prompt = jnp.ones((1, 4), jnp.int32) * (rank + 1)
+out_tokens = generate(params, prompt, cfg, max_new_tokens=8)
+print(f"rank {rank}: {out_tokens[0].tolist()}")""")
+
 md("## Cluster status, timeline, shutdown")
 
 code("%dist_status")
