@@ -155,6 +155,12 @@ md("""## Checkpoint / restore
 (atomic per-rank dirs, bfloat16-exact); `%dist_restore` loads them
 back — the save/resume loop for long interactive sessions.""")
 
+code("""\
+# Fresh checkpoint dir: a stale one from an earlier run must never be
+# silently restored below.
+import shutil
+shutil.rmtree("/tmp/nbd_demo_ckpt", ignore_errors=True)""")
+
 code("%dist_checkpoint /tmp/nbd_demo_ckpt params opt_state")
 
 code("""\
@@ -164,9 +170,11 @@ params = None""")
 code("%dist_restore /tmp/nbd_demo_ckpt")
 
 code("""\
-# Restored params give the exact same eval loss.
-print(f"rank {rank}: eval after restore "
-      f"{float(loss_fn(params, eval_batch, cfg)):.4f}")""")
+# Restored params must give the exact same eval loss — a silent save
+# failure above would surface here as an assertion error.
+restored_loss = float(loss_fn(params, eval_batch, cfg))
+assert restored_loss == eval_loss, (restored_loss, eval_loss)
+print(f"rank {rank}: eval after restore {restored_loss:.4f} (exact)")""")
 
 md("""## Generation
 

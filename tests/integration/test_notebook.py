@@ -92,3 +92,14 @@ def test_notebook_broadcast_matches(executed_nb):
     sums = re.findall(r"Rank (\d):\s*\n(-?\d+\.\d+)", text)
     assert sorted(r for r, _ in sums) == ["0", "1"], text
     assert len({v for _, v in sums}) == 1, text
+
+
+def test_notebook_no_worker_errors(executed_nb):
+    text = _all_text(executed_nb)
+    assert "❌" not in text and "Traceback" not in text, text[-2000:]
+
+
+def test_notebook_checkpoint_restore_exact(executed_nb):
+    text = _all_text(executed_nb)
+    assert "ranks saved" in text and "ranks restored" in text
+    assert "(exact)" in text
