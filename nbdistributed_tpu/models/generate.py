@@ -1,8 +1,11 @@
-"""Autoregressive generation with a KV cache.
+"""Autoregressive generation with a KV cache — both model families.
 
 The reference framework has no inference path of its own (its users call
 HF ``model.generate`` in cells); a first-party TPU decode loop is part
-of making the model family usable interactively.  Design for XLA:
+of making the model families usable interactively.  The attention stack
+is shared between the dense and MoE transformers, so one cached forward
+serves both (the feed-forward branch dispatches on the config type).
+Design for XLA:
 
 * static shapes everywhere — the cache is a fixed ``max_len`` ring of
   zeros, new K/V written by ``lax.dynamic_update_slice``; attention
@@ -85,11 +88,31 @@ def _cached_attention(q, kc, vc, positions, scale):
     return o.reshape(B, S, H * Dh).astype(q.dtype)
 
 
+def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str):
+    """The per-layer feed-forward branch: dense SwiGLU, or the MoE
+    layer when the config is a :class:`~.moe.MoEConfig` (sharing
+    ``moe._moe_mlp_block`` so the two paths can never diverge)."""
+    from .moe import MoEConfig, _moe_mlp_block
+
+    if isinstance(cfg, MoEConfig):
+        def mlp(x, layer):
+            x, _aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
+            return x
+
+        return mlp
+    return lambda x, layer: _mlp_block(x, layer, cfg)
+
+
 def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                        cfg: TransformerConfig, *,
-                       last_only: bool = False):
+                       last_only: bool = False, mesh=None,
+                       ep_axis: str = "ep"):
     """Run ``tokens`` (B, S) through the model, reading/writing the KV
     cache at offset ``cache_len`` (traced scalar ok).
+
+    Works for both model families: the attention stack is shared and
+    the feed-forward branch dispatches on the config (dense SwiGLU vs
+    expert-parallel MoE — ``mesh`` routes the expert all-to-alls).
 
     Returns (logits fp32, updated cache): (B, S, vocab), or (B, 1,
     vocab) with ``last_only`` — prefill for generation needs only the
@@ -102,6 +125,7 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     positions = cache_len + jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
     scale = 1.0 / float(cfg.head_dim) ** 0.5
+    mlp = _make_mlp_fn(cfg, mesh, ep_axis)
 
     def layer_step(x, inputs):
         layer, kc, vc = inputs
@@ -117,7 +141,7 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                                           (0, cache_len, 0, 0))
         o = _cached_attention(q, kc, vc, positions, scale)
         x = x + o @ layer["wo"]
-        x = _mlp_block(x, layer, cfg)
+        x = mlp(x, layer)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -142,7 +166,8 @@ def _sample(logits, temperature: float, key):
 
 def generate(params: dict, prompt, cfg: TransformerConfig,
              max_new_tokens: int, *, temperature: float = 0.0,
-             key=None, max_len: int | None = None, mesh=None):
+             key=None, max_len: int | None = None, mesh=None,
+             ep_axis: str = "ep"):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0).
 
     Greedy when ``temperature == 0`` (default), else categorical
@@ -169,14 +194,16 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
                          f"{max_new_tokens}")
     cache = init_kv_cache(cfg, B, T, mesh=mesh)
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg,
-                                       last_only=True)
+                                       last_only=True, mesh=mesh,
+                                       ep_axis=ep_axis)
     key, k0 = jax.random.split(key)
     tok = _sample(logits[:, -1], temperature, k0)
 
     def step(carry, i):
         cache, tok, key = carry
         logits, cache = forward_with_cache(
-            params, tok[:, None], cache, S0 + i, cfg)
+            params, tok[:, None], cache, S0 + i, cfg, mesh=mesh,
+            ep_axis=ep_axis)
         key, ks = jax.random.split(key)
         nxt = _sample(logits[:, -1], temperature, ks)
         return (cache, nxt, key), tok
@@ -190,12 +217,12 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
 
 def make_generate_fn(cfg: TransformerConfig, max_new_tokens: int, *,
                      temperature: float = 0.0, max_len: int | None = None,
-                     mesh=None):
+                     mesh=None, ep_axis: str = "ep"):
     """A jitted ``(params, prompt, key) -> tokens`` closure."""
 
     def fn(params, prompt, key=None):
         return generate(params, prompt, cfg, max_new_tokens,
                         temperature=temperature, key=key, max_len=max_len,
-                        mesh=mesh)
+                        mesh=mesh, ep_axis=ep_axis)
 
     return jax.jit(fn)

@@ -103,6 +103,17 @@ def moe_model_shardings(cfg: MoEConfig, ep_axis: str = "ep",
     }
 
 
+def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str):
+    """The MoE feed-forward residual block (the expert analog of
+    ``transformer._mlp_block``) — the single definition shared by the
+    training forward and the cached generation path."""
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
+                           capacity_factor=cfg.capacity_factor,
+                           mesh=mesh, ep_axis=ep_axis)
+    return x + y, layer_aux
+
+
 def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
                 ep_axis: str = "ep", positions=None):
     """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar)."""
@@ -114,11 +125,8 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
     def layer_step(carry, layer):
         x, aux = carry
         x = _attention_block(x, layer, cfg, positions)
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
-                               capacity_factor=cfg.capacity_factor,
-                               mesh=mesh, ep_axis=ep_axis)
-        return (x + y, aux + layer_aux), None
+        x, layer_aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
+        return (x, aux + layer_aux), None
 
     (x, aux), _ = jax.lax.scan(layer_step, (x, jnp.float32(0.0)),
                                params["layers"])

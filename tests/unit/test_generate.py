@@ -134,6 +134,27 @@ def test_zero_new_tokens_returns_prompt(setup):
         generate(params, prompt, cfg, -1)
 
 
+def test_moe_cached_greedy_matches_full_reforward():
+    """The MoE family decodes through the same cached forward; lossless
+    capacity (factor 2 >= n_experts/top_k) makes batched prefill and
+    step-wise decode route identically, so tokens must match exactly."""
+    from nbdistributed_tpu.models import (init_moe_model, moe_forward,
+                                          tiny_moe_config)
+
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          capacity_factor=2.0)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0,
+                                cfg.vocab_size)
+    got = generate(params, prompt, cfg, max_new_tokens=8)
+    toks = prompt
+    for _ in range(8):
+        logits, _aux = moe_forward(params, toks, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
+
+
 def test_cache_sharding_spec_shape(setup):
     cfg, _ = setup
     spec = kv_cache_shardings()
