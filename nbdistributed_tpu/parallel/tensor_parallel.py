@@ -32,19 +32,22 @@ def sharding_tree(mesh, rules):
 
 
 def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
-                       dp_axis: str = "dp", donate: bool = True):
+                       dp_axis: str = "dp", donate: bool = True,
+                       opt_state_sh=None):
     """Combined dp×tp train step: params sharded by ``param_rules``
     (tp axes; ``None`` = fully replicated, i.e. pure DDP), batch sharded
-    on ``dp_axis``, optimizer state sharded like the params (ZeRO-style
-    for free — optax states mirror the param tree)."""
+    on ``dp_axis``.
+
+    Optimizer-state sharding: with ``opt_state_sh=None`` the state
+    passes through (optax states are zeros_like the params, so
+    initializing from already-sharded params gives param-sharded state
+    for free); passing an explicit ``NamedSharding`` pytree pins it —
+    :mod:`~nbdistributed_tpu.parallel.zero` uses this to add the ZeRO-1
+    dp axis, with this one step definition serving both."""
     repl = NamedSharding(mesh, P())
     param_sh = sharding_tree(mesh, param_rules) if param_rules is not None \
         else repl
     batch_sh = NamedSharding(mesh, P(dp_axis))
-
-    # opt_state passes through with in_shardings=None: optax states are
-    # zeros_like the params, so initializing them from already-sharded
-    # params gives param-sharded optimizer state (ZeRO-ish) for free.
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -54,6 +57,6 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
 
     return jax.jit(
         step,
-        in_shardings=(param_sh, None, batch_sh),
-        out_shardings=(param_sh, None, repl),
+        in_shardings=(param_sh, opt_state_sh, batch_sh),
+        out_shardings=(param_sh, opt_state_sh, repl),
         donate_argnums=(0, 1) if donate else ())
