@@ -76,6 +76,10 @@ def make_zero1_train_step(loss_fn, optimizer, mesh, param_rules, params,
     """
     from .tensor_parallel import make_tp_train_step
 
+    if param_rules is None:
+        # Pure DDP: fully replicated params (the canonical ZeRO-1 case).
+        param_rules = jax.tree_util.tree_map(
+            lambda p: P(*[None] * getattr(p, "ndim", 0)), params)
     param_sh = sharding_tree(mesh, param_rules)
     state_sh = zero1_state_shardings(optimizer, params, param_rules,
                                      mesh, dp_axis=dp_axis,

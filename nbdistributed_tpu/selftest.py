@@ -1,0 +1,114 @@
+"""Runnable end-to-end self-test: ``python -m nbdistributed_tpu.selftest``.
+
+The reference *declared* a console-script integration entry
+(``jupyter-dist-test`` → ``nbdistributed.tests.test_integration:main``,
+pyproject.toml:50-51) but the module is absent from its snapshot
+(SURVEY §4).  This is that artifact, real: bring up a 2-worker CPU/gloo
+cluster through the public API, drive the core capabilities, print a
+check-by-check report, exit nonzero on any failure.  Useful as a smoke
+test of an installation (``nbd-selftest``) without pytest or a notebook.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import time
+
+
+def main() -> int:
+    from nbdistributed_tpu.manager import ProcessManager
+    from nbdistributed_tpu.messaging import CommunicationManager
+
+    checks: list[tuple[str, bool, str]] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append((name, ok, detail))
+        print(f"  {'✅' if ok else '❌'} {name}"
+              + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+    print("nbdistributed_tpu self-test: 2 workers, cpu/gloo backend",
+          flush=True)
+    comm = CommunicationManager(num_workers=2, timeout=120)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    try:
+        pm.start_workers(2, comm.port, backend="cpu")
+        deadline = time.time() + 180
+        while True:
+            try:
+                comm.wait_for_workers(timeout=2)
+                break
+            except TimeoutError:
+                pm.check_startup_failure()
+                if time.time() > deadline:
+                    raise
+        check("worker bring-up + readiness handshake", True)
+
+        out = {r: m.data.get("output")
+               for r, m in comm.send_to_all("execute", "rank * 2").items()}
+        check("remote execution with REPL echo", out == {0: "0", 1: "2"},
+              repr(out))
+
+        out = {r: m.data.get("output") for r, m in comm.send_to_all(
+            "execute", "jax.device_count()").items()}
+        check("jax.distributed world formed", out == {0: "2", 1: "2"},
+              repr(out))
+
+        out = {r: m.data.get("output") for r, m in comm.send_to_all(
+            "execute", "float(all_reduce(jnp.ones(3) * (rank + 1))[0])",
+            timeout=180).items()}
+        check("cross-process all_reduce", out == {0: "3.0", 1: "3.0"},
+              repr(out))
+
+        comm.send_to_all("execute", "st_v = jnp.arange(4.0) + rank")
+        with tempfile.TemporaryDirectory() as d:
+            r1 = comm.send_to_all(
+                "checkpoint", {"action": "save", "path": d,
+                               "names": ["st_v"]})
+            comm.send_to_all("execute", "st_v = None")
+            r2 = comm.send_to_all(
+                "checkpoint", {"action": "restore", "path": d,
+                               "names": None})
+            out = {r: m.data.get("output") for r, m in comm.send_to_all(
+                "execute", "float(st_v[0])").items()}
+            ok = (all(m.data.get("status") == "save" for m in r1.values())
+                  and all(m.data.get("status") == "restore"
+                          for m in r2.values())
+                  and out == {0: "0.0", 1: "1.0"})
+            check("checkpoint save/restore round-trip", ok, repr(out))
+
+        resp = comm.send_to_all("sync", timeout=60)
+        check("barrier sync", all(m.data.get("status") == "synced"
+                                  for m in resp.values()))
+
+        resp = comm.send_to_all("get_status", timeout=60)
+        check("status probe", all("platform" in m.data or "rank" in m.data
+                                  for m in resp.values()))
+
+        resp = comm.send_to_all("execute", "1 / 0")
+        ok = all("ZeroDivisionError" in (m.data.get("traceback") or "")
+                 for m in resp.values())
+        out = {r: m.data.get("output") for r, m in comm.send_to_all(
+            "execute", "'alive'").items()}
+        check("error isolation (workers survive exceptions)",
+              ok and out == {0: "'alive'", 1: "'alive'"}, repr(out))
+    except Exception as e:
+        check("harness", False, f"{type(e).__name__}: {e}")
+    finally:
+        try:
+            comm.post([0, 1], "shutdown")
+            time.sleep(0.3)
+        except Exception:
+            pass
+        pm.shutdown()
+        comm.shutdown()
+
+    failed = [c for c in checks if not c[1]]
+    print(f"\n{len(checks) - len(failed)}/{len(checks)} checks passed",
+          flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,24 @@ def test_zero1_state_is_dp_sharded(setup):
     assert any("dp" in sp for sp in specs), specs
 
 
+def test_zero1_pure_ddp_rules_none(setup):
+    """param_rules=None (the canonical ZeRO-1 use: pure data parallel,
+    replicated params) must work like make_tp_train_step's None."""
+    cfg, params, opt, batch = setup
+    mesh = mesh_mod.make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    step, init = make_zero1_train_step(
+        lambda p, b: loss_fn(p, b, cfg), opt, mesh, None, params,
+        donate=False)
+    p = jax.device_put(params, jax.sharding.NamedSharding(mesh, P()))
+    s = init(p)
+    b = mesh_mod.shard_batch(dict(batch), mesh)
+    p, s, l = step(p, s, b)
+    assert np.isfinite(float(l))
+    assert any("dp" in str(leaf.sharding.spec)
+               for leaf in jax.tree_util.tree_leaves(s)
+               if hasattr(leaf, "sharding"))
+
+
 def test_zero1_composes_with_tp(setup):
     cfg, params, opt, batch = setup
     mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2},
