@@ -119,15 +119,8 @@ def run(backend: str, world: int) -> int:
         comm = CommunicationManager(num_workers=world, timeout=300)
         pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
         pm.start_workers(world, comm.port, backend=backend)
-        deadline = time.time() + 240
-        while True:
-            try:
-                comm.wait_for_workers(timeout=2)
-                break
-            except TimeoutError:
-                pm.check_startup_failure()
-                if time.time() > deadline:
-                    raise
+        from nbdistributed_tpu.manager import wait_until_ready
+        wait_until_ready(comm, pm, 240)
         log("[bench] workers attached; running setup cell")
         resp = comm.send_to_all("execute", SETUP, timeout=600)
         for r, m in resp.items():

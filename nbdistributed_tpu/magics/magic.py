@@ -227,17 +227,12 @@ class DistributedMagics(Magics):
                 pm.start_workers(num_workers, comm.port,
                                  backend=args.backend,
                                  chips_per_worker=args.chips_per_worker)
-            deadline = time.time() + args.attach_timeout
-            while True:
-                try:
-                    comm.wait_for_workers(timeout=2)
-                    break
-                except TimeoutError:
-                    pm.check_startup_failure()
-                    if time.time() > deadline:
-                        raise
-                    print(f"   ... waiting ({len(comm.connected_ranks())}/"
-                          f"{num_workers} attached)")
+            from ..manager import wait_until_ready
+            wait_until_ready(
+                comm, pm, args.attach_timeout,
+                on_wait=lambda: print(
+                    f"   ... waiting ({len(comm.connected_ranks())}/"
+                    f"{num_workers} attached)"))
         except Exception as e:
             print(f"❌ Worker startup failed: {e}")
             pm.shutdown()

@@ -1,8 +1,10 @@
 """Orchestration layer (L3, SURVEY §1): worker process lifecycle and
 per-rank backend/topology environment."""
 
-from .process_manager import ProcessManager, find_free_port
+from .process_manager import (ProcessManager, find_free_port,
+                              wait_until_ready)
 from .topology import cpu_worker_env, detect_backend, tpu_worker_env, worker_env
 
-__all__ = ["ProcessManager", "find_free_port", "cpu_worker_env",
-           "detect_backend", "tpu_worker_env", "worker_env"]
+__all__ = ["ProcessManager", "find_free_port", "wait_until_ready",
+           "cpu_worker_env", "detect_backend", "tpu_worker_env",
+           "worker_env"]

@@ -28,6 +28,29 @@ from typing import Callable
 from . import topology
 
 
+def wait_until_ready(comm, pm, timeout_s: float, *, poll_s: float = 2.0,
+                     on_wait=None) -> None:
+    """Block until every worker has attached to the control plane.
+
+    Converts an early worker death into a diagnostic RuntimeError (with
+    the dead child's stdio) instead of a timeout; raises TimeoutError
+    at the deadline.  ``on_wait()`` runs after each poll interval
+    (progress display).  The one bring-up loop shared by the magic
+    layer, bench, selftest, and the integration tests.
+    """
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            comm.wait_for_workers(timeout=poll_s)
+            return
+        except TimeoutError:
+            pm.check_startup_failure()
+            if time.time() > deadline:
+                raise
+            if on_wait is not None:
+                on_wait()
+
+
 def find_free_port() -> int:
     """Bind-to-zero port discovery (reference: process_manager.py:154-175)."""
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:

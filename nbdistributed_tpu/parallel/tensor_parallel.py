@@ -12,6 +12,7 @@ row-parallel matmul → one all-reduce) from the sharding lattice.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -33,7 +34,7 @@ def sharding_tree(mesh, rules):
 
 def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
                        dp_axis: str = "dp", donate: bool = True,
-                       opt_state_sh=None):
+                       opt_state_sh=None, accum_steps: int = 1):
     """Combined dp×tp train step: params sharded by ``param_rules``
     (tp axes; ``None`` = fully replicated, i.e. pure DDP), batch sharded
     on ``dp_axis``.
@@ -43,14 +44,64 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
     initializing from already-sharded params gives param-sharded state
     for free); passing an explicit ``NamedSharding`` pytree pins it —
     :mod:`~nbdistributed_tpu.parallel.zero` uses this to add the ZeRO-1
-    dp axis, with this one step definition serving both."""
+    dp axis, with this one step definition serving both.
+
+    ``accum_steps > 1`` splits the batch's leading axis into that many
+    microbatches inside the compiled step (``lax.scan``, fp32 gradient
+    accumulator) — same numerics as the full batch for mean losses,
+    activation memory divided by ``accum_steps``."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     repl = NamedSharding(mesh, P())
     param_sh = sharding_tree(mesh, param_rules) if param_rules is not None \
         else repl
     batch_sh = NamedSharding(mesh, P(dp_axis))
 
+    def grads_of(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        d = mesh.shape[dp_axis]
+
+        def split(x):
+            B = x.shape[0]
+            if B % (d * accum_steps):
+                raise ValueError(
+                    f"batch leading dim {B} not divisible by "
+                    f"dp({d}) * accum_steps({accum_steps})")
+            # Microbatch i = the i-th contiguous chunk of every
+            # device's local shard, so the split is a device-local
+            # reshape (a naive (accum, B/accum) reshape would need an
+            # all-to-all to re-lay the dp shards every step).  Mean
+            # losses are permutation-invariant, so numerics match the
+            # full batch.
+            mb = (x.reshape(d, accum_steps, B // (d * accum_steps),
+                            *x.shape[1:])
+                  .swapaxes(0, 1)
+                  .reshape(accum_steps, B // accum_steps, *x.shape[1:]))
+            return jax.lax.with_sharding_constraint(
+                mb, NamedSharding(
+                    mesh, P(None, dp_axis, *[None] * (x.ndim - 1))))
+
+        micro = jax.tree_util.tree_map(split, batch)
+
+        def body(carry, mb):
+            gsum, lsum = carry
+            l, g = jax.value_and_grad(loss_fn)(params, mb)
+            gsum = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), gsum, g)
+            return (gsum, lsum + l), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (gsum, lsum), _ = jax.lax.scan(body, (zeros, jnp.float32(0.0)),
+                                       micro)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / accum_steps).astype(p.dtype), gsum, params)
+        return lsum / accum_steps, grads
+
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = grads_of(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -17,7 +17,7 @@ import time
 
 
 def main() -> int:
-    from nbdistributed_tpu.manager import ProcessManager
+    from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
     from nbdistributed_tpu.messaging import CommunicationManager
 
     checks: list[tuple[str, bool, str]] = []
@@ -34,15 +34,7 @@ def main() -> int:
     pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
     try:
         pm.start_workers(2, comm.port, backend="cpu")
-        deadline = time.time() + 180
-        while True:
-            try:
-                comm.wait_for_workers(timeout=2)
-                break
-            except TimeoutError:
-                pm.check_startup_failure()
-                if time.time() > deadline:
-                    raise
+        wait_until_ready(comm, pm, 180)
         check("worker bring-up + readiness handshake", True)
 
         out = {r: m.data.get("output")

@@ -12,7 +12,7 @@ import time
 import numpy as np
 import pytest
 
-from nbdistributed_tpu.manager import ProcessManager
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
 from nbdistributed_tpu.messaging import CommunicationManager, WorkerDied
 
 pytestmark = [pytest.mark.integration]
@@ -28,15 +28,7 @@ def cluster():
     pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
     try:
         pm.start_workers(WORLD, comm.port, backend="cpu")
-        deadline = time.time() + ATTACH_TIMEOUT
-        while True:
-            try:
-                comm.wait_for_workers(timeout=2)
-                break
-            except TimeoutError:
-                pm.check_startup_failure()
-                if time.time() > deadline:
-                    raise
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
     except Exception:
         pm.shutdown()
         comm.shutdown()
@@ -215,15 +207,7 @@ def test_multihost_local_plan_runs_real_workers():
             "local:2", comm.port, coordinator_host="127.0.0.1",
             backend="cpu")
         assert world == 2
-        deadline = time.time() + ATTACH_TIMEOUT
-        while True:
-            try:
-                comm.wait_for_workers(timeout=2)
-                break
-            except TimeoutError:
-                pm.check_startup_failure()
-                if time.time() > deadline:
-                    raise
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
         out = outputs(comm.send_to_all("execute", "rank + 40"))
         assert out == {0: "40", 1: "41"}
         out = outputs(comm.send_to_all(

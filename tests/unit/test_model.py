@@ -134,3 +134,62 @@ def test_mesh_builder_rejects_bad_shapes():
         mesh_mod.make_mesh({"dp": 3})
     with pytest.raises(ValueError):
         mesh_mod.make_mesh({"dp": -1, "tp": -1})
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=2 must give the same update as the full batch (mean
+    loss over equal microbatches == full-batch mean)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.parallel import tensor_parallel
+
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-3)
+    mesh = mesh_mod.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    rules = jax.tree_util.tree_map(
+        lambda spec: P(*[None for _ in spec]), param_shardings(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    loss = lambda p, b: loss_fn(p, b, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+
+    outs = {}
+    for accum in (1, 2, 4):
+        step = tensor_parallel.make_tp_train_step(
+            loss, opt, mesh, rules, donate=False, accum_steps=accum)
+        p = tensor_parallel.apply_shardings(params, mesh, rules)
+        s = opt.init(p)
+        b = mesh_mod.shard_batch({"tokens": tokens}, mesh)
+        p, s, l = step(p, s, b)
+        outs[accum] = (p, float(l))
+    for accum in (2, 4):
+        np.testing.assert_allclose(outs[accum][1], outs[1][1], rtol=1e-6)
+        # fp32 summation order differs (microbatch accumulation vs one
+        # batched reduction), so allow reduction-order noise.
+        for a, b_ in zip(jax.tree_util.tree_leaves(outs[accum][0]),
+                         jax.tree_util.tree_leaves(outs[1][0])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=1e-5, rtol=1e-5)
+
+
+def test_gradient_accumulation_rejects_indivisible():
+    import optax
+    import pytest
+    from jax.sharding import PartitionSpec as P
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.parallel import tensor_parallel
+
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = mesh_mod.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    step = tensor_parallel.make_tp_train_step(
+        lambda p, b: loss_fn(p, b, cfg), optax.sgd(1e-3), mesh, None,
+        donate=False, accum_steps=3)
+    p = jax.device_put(params,
+                       jax.sharding.NamedSharding(mesh, P()))
+    s = optax.sgd(1e-3).init(p)
+    tokens = jnp.zeros((8, 17), jnp.int32)  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        step(p, s, mesh_mod.shard_batch({"tokens": tokens}, mesh))
