@@ -31,8 +31,9 @@ from . import proxies, rankspec
 from .timeline import Timeline
 
 _COLLECTIVE_TOKENS = re.compile(
-    r"\b(all_reduce|all_gather|broadcast|reduce_scatter|barrier|psum|pmean|"
-    r"pmax|pmin|ppermute|all_to_all|sync_global_devices|shard_map)\b")
+    r"\b(all_reduce_quantized|all_reduce|all_gather|broadcast|"
+    r"reduce_scatter|barrier|psum|pmean|pmax|pmin|ppermute|all_to_all|"
+    r"sync_global_devices|shard_map)\b")
 
 _BANNER = """\
 ✅ {n} workers ready (backend={backend}, attach {secs:.1f}s).
@@ -43,8 +44,8 @@ Every cell now runs on ALL workers. Namespace on each worker:
   devices, device      — global device list / this worker's device
   Mesh, P, shard_map   — sharding toolkit (PartitionSpec as P)
   dist                 — torch.distributed-style facade
-  all_reduce, all_gather, broadcast, barrier, reduce_scatter
-                       — eager collectives over ICI/DCN
+  all_reduce, all_gather, broadcast, barrier, reduce_scatter,
+  all_reduce_quantized — eager collectives over ICI/DCN
   make_mesh, shard_batch, ring_attention, ulysses_attention,
   pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
                        — mesh/SP/PP/EP building blocks
@@ -419,14 +420,37 @@ class DistributedMagics(Magics):
     @magic_arguments()
     @argument("name", help="worker variable name")
     @argument("--rank", type=int, default=0, help="rank to pull from")
+    @argument("--all", dest="all_ranks", action="store_true",
+              help="pull from every rank into a {rank: value} dict")
     @argument("--as", dest="as_name", default=None,
               help="kernel name to bind (default: same name)")
     @line_magic
     def dist_pull(self, line):
-        """Copy a variable from one worker into the kernel namespace."""
+        """Copy a variable from worker(s) into the kernel namespace."""
         if not self._require_cluster():
             return
         args = parse_argstring(self.dist_pull, line)
+        target = args.as_name or args.name
+        if args.all_ranks:
+            try:
+                resps = self._comm.send_to_all("get_var", args.name,
+                                               timeout=60)
+            except Exception as e:
+                print(f"❌ pull failed: {e}")
+                return
+            errors = {r: m.data["error"] for r, m in resps.items()
+                      if m.data.get("error")}
+            if errors:
+                for r, e in sorted(errors.items()):
+                    print(f"❌ rank {r}: {e}")
+                return
+            self.shell.user_ns[target] = {
+                r: (m.bufs["value"] if m.data.get("array")
+                    else m.data.get("value"))
+                for r, m in resps.items()}
+            print(f"✅ {target} = {{rank: value}} from "
+                  f"{sorted(resps)} ranks")
+            return
         try:
             resp = self._comm.send_to_rank(args.rank, "get_var", args.name,
                                            timeout=60)
@@ -436,7 +460,6 @@ class DistributedMagics(Magics):
         if resp.data.get("error"):
             print(f"❌ {resp.data['error']}")
             return
-        target = args.as_name or args.name
         if resp.data.get("array"):
             value = resp.bufs["value"]
             self.shell.user_ns[target] = value

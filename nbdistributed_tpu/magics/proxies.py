@@ -36,7 +36,8 @@ _SKIP_NAMES = {"jax", "jnp", "np", "Mesh", "NamedSharding", "P",
                "PartitionSpec", "shard_map", "__builtins__",
                "rank", "world_size", "process_index", "devices",
                "local_devices", "device", "dist", "all_reduce",
-               "all_gather", "broadcast", "barrier", "reduce_scatter"}
+               "all_gather", "broadcast", "barrier", "reduce_scatter",
+               "all_reduce_quantized"}
 
 
 def make_proxy(name: str, desc: dict) -> tuple[Any, bool]:

@@ -207,9 +207,31 @@ def barrier(name: str = "nbd_barrier"):
     multihost_utils.sync_global_devices(name)
 
 
+@functools.lru_cache(maxsize=None)
+def _reduce_scatter_fn(mesh):
+    """True reduce-scatter (psum_scatter): each device receives its
+    reduced chunk — half the wire traffic of all-reduce + local slice."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    def f(a):
+        return jax.lax.psum_scatter(a[0], "proc", scatter_dimension=0,
+                                    tiled=True)
+
+    return f
+
+
 def reduce_scatter(x, op: str = "sum"):
     """Reduce across processes, then return this process's equal chunk of
-    the leading axis (``dist.reduce_scatter`` analog)."""
+    the leading axis (``dist.reduce_scatter`` analog).
+
+    For ``op="sum"`` with one device per process this is a real XLA
+    reduce-scatter (psum_scatter — no full all-reduce on the wire);
+    other ops / multi-device processes fall back to all-reduce+slice.
+    """
     jax = _jax()
     import jax.numpy as jnp
 
@@ -217,9 +239,89 @@ def reduce_scatter(x, op: str = "sum"):
     if n == 1:
         return jnp.asarray(x)  # identity — works even under tracing
     _reject_tracer(x, "reduce_scatter")
+    x = jnp.asarray(x)
+    if x.shape[0] % n:
+        raise ValueError(f"leading dim {x.shape[0]} not divisible by "
+                         f"{n} processes")
+    if op == "sum" and jax.local_device_count() == 1:
+        mesh = _proc_mesh()
+        garr = _to_global(x, mesh)
+        return _reduce_scatter_fn(mesh)(garr).addressable_data(0)
     reduced = all_reduce(x, op=op)
     chunks = jnp.split(jnp.asarray(reduced), n, axis=0)
     return chunks[rank()]
+
+
+@functools.lru_cache(maxsize=None)
+def _quantized_all_reduce_fn(mesh, block: int):
+    """EQuARX-style quantized all-reduce (Dryden et al. /
+    arXiv:2506.17615 pattern, built from XLA collectives): fp32
+    reduce-scatter, then each device block-quantizes its reduced shard
+    to int8 (per-block absmax scales) and the expensive all-gather
+    phase moves int8 + scales instead of fp32 — ~1.6x less wire
+    traffic overall, more at lower bits.  One compiled program."""
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P(), check_vma=False)
+    def f(a):
+        shard = jax.lax.psum_scatter(a[0], "proc", scatter_dimension=0,
+                                     tiled=True)               # (m,) fp32
+        blocks = shard.reshape(-1, block)
+        absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+        qg = jax.lax.all_gather(q, "proc", tiled=True)
+        sg = jax.lax.all_gather(scale.astype(jnp.float32), "proc",
+                                tiled=True)
+        return (qg.astype(jnp.float32) * sg).reshape(-1)
+
+    return f
+
+
+def all_reduce_quantized(x, op: str = "sum", *, block: int = 256):
+    """Approximate all-reduce with int8-quantized gather phase.
+
+    Same contract as :func:`all_reduce` (sum/mean) but the result is
+    quantized to 8 bits blockwise after the reduction — relative error
+    bounded by ~1/254 per block — in exchange for moving ~1.6× fewer
+    bytes (the technique of EQuARX, arXiv:2506.17615, composed here
+    from XLA's own collectives).  Intended for DCN-bound gradient
+    exchange; use :func:`all_reduce` when exactness matters.
+    """
+    jax = _jax()
+    import jax.numpy as jnp
+
+    if op not in ("sum", "mean"):
+        raise ValueError("all_reduce_quantized supports op sum|mean")
+    if jax.process_count() == 1 and jax.local_device_count() == 1:
+        return jnp.asarray(x)
+    _reject_tracer(x, "all_reduce_quantized")
+    x = jnp.asarray(x)
+    orig_shape, orig_dtype = x.shape, x.dtype
+
+    mesh = _proc_mesh()
+    n_dev = mesh.devices.size
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % (n_dev * block)
+    flat = jnp.pad(flat, (0, pad))
+    out = _quantized_all_reduce_fn(mesh, block)(
+        _to_global(flat, mesh)).addressable_data(0)
+    local = jax.local_device_count()
+    if local > 1:
+        out = out / local  # per-process duplicate copies, as in all_reduce
+    if op == "mean":
+        out = out / world_size()
+    if pad:
+        out = out[:-pad]
+    if jnp.issubdtype(orig_dtype, jnp.integer):
+        # Truncation would bias quantization noise toward zero (e.g. a
+        # true 3 dequantizing to 2.996 must not become 2).
+        out = jnp.round(out)
+    return out.reshape(orig_shape).astype(orig_dtype)
 
 
 class DistNamespace:
@@ -252,3 +354,5 @@ def clear_mesh_cache() -> None:
     _proc_mesh.cache_clear()
     _reduce_fn.cache_clear()
     _gather_fn.cache_clear()
+    _reduce_scatter_fn.cache_clear()
+    _quantized_all_reduce_fn.cache_clear()

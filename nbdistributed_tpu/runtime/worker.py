@@ -115,6 +115,7 @@ class DistributedWorker:
             "broadcast": collectives.broadcast,
             "barrier": collectives.barrier,
             "reduce_scatter": collectives.reduce_scatter,
+            "all_reduce_quantized": collectives.all_reduce_quantized,
             "make_mesh": mesh_mod.make_mesh,
             "shard_batch": mesh_mod.shard_batch,
             "ring_attention": ring_attention,
