@@ -50,7 +50,8 @@ Every cell now runs on ALL workers. Namespace on each worker:
   pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
                        — mesh/SP/PP/EP building blocks
 
-Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_status ·
+Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
+%dist_status ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_profile start/stop ·
 %timeline_show · %dist_shutdown
@@ -142,9 +143,26 @@ class DistributedMagics(Magics):
         worker_thread = threading.Thread(target=_send, daemon=True)
         worker_thread.start()
         try:
-            while worker_thread.is_alive():
-                worker_thread.join(timeout=0.03)
-                disp.drain()
+            try:
+                while worker_thread.is_alive():
+                    worker_thread.join(timeout=0.03)
+                    disp.drain()
+            except KeyboardInterrupt:
+                # Jupyter's interrupt button SIGINTs the kernel while we
+                # block here; forward it to the workers (their cells
+                # abort with KeyboardInterrupt replies) and keep
+                # collecting those replies.  A second Ctrl-C abandons
+                # the wait.
+                print("\n🛑 interrupt: signaling workers "
+                      f"{self._pm.interrupt()} — waiting for aborted-"
+                      "cell replies (Ctrl-C again to stop waiting)")
+                try:
+                    while worker_thread.is_alive():
+                        worker_thread.join(timeout=0.03)
+                        disp.drain()
+                except KeyboardInterrupt:
+                    print("🛑 not waiting for worker replies; "
+                          "%sync to realign later")
             disp.drain()
             disp.finalize()
         finally:
@@ -283,6 +301,40 @@ class DistributedMagics(Magics):
                   "subset deadlocks the mesh; %sync can realign after "
                   "errors.")
         self._run_on_ranks(cell, ranks, kind="rank")
+
+    @magic_arguments()
+    @argument("--ranks", default=None,
+              help="target spec like [0,2]; default all")
+    @line_magic
+    def dist_interrupt(self, line):
+        """SIGINT worker process(es) so the running cell aborts with a
+        KeyboardInterrupt error and the workers stay alive.
+
+        While a distributed cell is executing, the kernel itself is
+        busy — use Jupyter's interrupt button (Ctrl-C) instead, which
+        this framework forwards to the workers automatically; this
+        magic is for targeted/after-the-fact signaling.  Limits: a cell
+        blocked *inside* a native collective/compile aborts only when
+        that native call returns, and interrupting a subset of ranks
+        mid-collective leaves the others blocked (run a full interrupt,
+        then %sync).  The reference's only remedy for a stuck cell is
+        destroying the cluster (%dist_reset)."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_interrupt, line)
+        ranks = None
+        if args.ranks:
+            try:
+                ranks = rankspec.parse_ranks(args.ranks, self._world)
+            except rankspec.RankSpecError as e:
+                print(f"❌ {e}")
+                return
+        signaled = self._pm.interrupt(ranks)
+        print(f"🛑 interrupt sent to ranks {signaled}")
+        if ranks is not None and len(signaled) < self._world:
+            print("⚠️ subset interrupt: if the cell was running a "
+                  "collective, the un-signaled ranks stay blocked in "
+                  "it — interrupt all ranks, then %sync.")
 
     @line_magic
     def sync(self, line):

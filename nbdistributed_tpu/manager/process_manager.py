@@ -223,6 +223,22 @@ class ProcessManager:
                     f"worker {rank} exited with code {rc} during startup.\n"
                     f"--- worker {rank} output ---\n{self.io[rank].tail()}")
 
+    def interrupt(self, ranks: list[int] | None = None) -> list[int]:
+        """SIGINT the worker process(es) — Jupyter-style cell interrupt.
+        The executing cell aborts with a KeyboardInterrupt error
+        response; the worker survives.  Returns the ranks signaled."""
+        signaled = []
+        for rank, proc in sorted(self.processes.items()):
+            if ranks is not None and rank not in ranks:
+                continue
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGINT)
+                    signaled.append(rank)
+                except Exception:
+                    pass
+        return signaled
+
     def is_running(self) -> bool:
         return any(p.poll() is None for p in self.processes.values())
 

@@ -135,6 +135,17 @@ def execute_cell(code: str, namespace: dict, stream_fn: StreamFn | None = None,
             "rank": rank,
             "duration_s": time.perf_counter() - t0,
         }
+    except KeyboardInterrupt:
+        # %dist_interrupt delivers SIGINT (Jupyter-style): the cell
+        # aborts with an error response, the worker stays alive.
+        streaming.drain()
+        return {
+            "error": "KeyboardInterrupt (cell interrupted by "
+                     "%dist_interrupt)",
+            "traceback": traceback.format_exc(),
+            "rank": rank,
+            "duration_s": time.perf_counter() - t0,
+        }
     except Exception as e:
         streaming.drain()
         return {

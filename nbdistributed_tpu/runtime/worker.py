@@ -24,6 +24,7 @@ Startup order (deliberate, SURVEY §7 "hard parts"):
 from __future__ import annotations
 
 import argparse
+import signal as signal_mod
 import sys
 import threading
 import time
@@ -142,11 +143,27 @@ class DistributedWorker:
             except Exception:
                 return  # channel gone; main loop will notice
 
+    def _send_masked(self, msg: Message) -> None:
+        """Send with SIGINT blocked (main thread only — Python delivers
+        signals there): a %dist_interrupt landing mid-``sendall`` would
+        otherwise abandon a half-written frame and corrupt the control-
+        plane stream.  The pending signal is delivered on unmask, where
+        the run loop's KeyboardInterrupt handling catches it."""
+        if threading.current_thread() is threading.main_thread():
+            old = signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
+                                             {signal_mod.SIGINT})
+            try:
+                self.channel.send(msg)
+            finally:
+                signal_mod.pthread_sigmask(signal_mod.SIG_SETMASK, old)
+        else:
+            self.channel.send(msg)
+
     def _stream(self, text: str, stream: str) -> None:
         """Push stdout/result text to the coordinator immediately
         (reference: worker.py:45-63)."""
         try:
-            self.channel.send(Message(
+            self._send_masked(Message(
                 msg_type="stream_output", rank=self.rank,
                 data={"text": text, "stream": stream}))
         except Exception:
@@ -268,28 +285,43 @@ class DistributedWorker:
             "checkpoint": self._handle_checkpoint,
         }
         while not self._shutdown.is_set():
+            # KeyboardInterrupt (= %dist_interrupt / Ctrl-C forwarding)
+            # may land at ANY bytecode of this loop, not just inside a
+            # cell; the outer except keeps the worker alive wherever it
+            # strikes.  Sends are SIGINT-masked (_send_masked) so a
+            # frame can never be torn mid-write.
             try:
-                msg = self.channel.recv()
-            except TransportError:
-                break  # coordinator gone
-            if msg.msg_type == "shutdown":
-                break  # no response, by protocol (reference: worker.py:205)
-            handler = handlers.get(msg.msg_type)
-            try:
-                if handler is None:
+                try:
+                    msg = self.channel.recv()
+                except TransportError:
+                    break  # coordinator gone
+                if msg.msg_type == "shutdown":
+                    break  # no response, by protocol (worker.py:205)
+                handler = handlers.get(msg.msg_type)
+                try:
+                    if handler is None:
+                        reply = msg.reply(
+                            data={"error": f"unknown message type "
+                                           f"{msg.msg_type!r}"},
+                            rank=self.rank)
+                    else:
+                        reply = handler(msg)
+                except KeyboardInterrupt:
+                    # Interrupt racing a non-execute handler: report and
+                    # keep serving (execute handles its own, executor).
+                    reply = msg.reply(data={"error": "KeyboardInterrupt"},
+                                      rank=self.rank)
+                except Exception as e:
                     reply = msg.reply(
-                        data={"error": f"unknown message type "
-                                       f"{msg.msg_type!r}"}, rank=self.rank)
-                else:
-                    reply = handler(msg)
-            except Exception as e:
-                reply = msg.reply(data={"error": str(e),
-                                        "traceback": traceback.format_exc()},
-                                  rank=self.rank)
-            try:
-                self.channel.send(reply)
-            except Exception:
-                break
+                        data={"error": str(e),
+                              "traceback": traceback.format_exc()},
+                        rank=self.rank)
+                try:
+                    self._send_masked(reply)
+                except Exception:
+                    break
+            except KeyboardInterrupt:
+                continue  # idle interrupt: nothing to abort
 
     def shutdown(self) -> None:
         """Teardown (reference: worker.py:569-580)."""

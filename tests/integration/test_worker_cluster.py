@@ -251,3 +251,37 @@ def test_reduce_scatter_fallback_op_max(cluster):
         "[float(v) for v in rm]", timeout=180))
     # elementwise max over ranks = [0,2,4,6]; rank r gets chunk r
     assert out == {0: "[0.0, 2.0]", 1: "[4.0, 6.0]"}
+
+
+def test_interrupt_aborts_cell_workers_survive(cluster):
+    """%dist_interrupt semantics: SIGINT aborts the running cell with a
+    KeyboardInterrupt error response; the workers keep serving."""
+    import threading
+
+    comm, pm = cluster
+    result = {}
+
+    def _send():
+        result.update(comm.send_to_all(
+            "execute", "import time\nfor _ in range(600):\n"
+                       "    time.sleep(0.1)", timeout=120))
+
+    t = threading.Thread(target=_send, daemon=True)
+    t.start()
+    time.sleep(1.0)  # let the cell start running
+    signaled = pm.interrupt()
+    assert signaled == [0, 1]
+    t.join(timeout=30)
+    assert not t.is_alive(), "interrupt did not abort the cell"
+    for m in result.values():
+        assert "KeyboardInterrupt" in m.data["error"]
+    out = outputs(comm.send_to_all("execute", "'still here'"))
+    assert out == {0: "'still here'", 1: "'still here'"}
+
+
+def test_interrupt_while_idle_is_harmless(cluster):
+    comm, pm = cluster
+    pm.interrupt()
+    time.sleep(0.5)
+    out = outputs(comm.send_to_all("execute", "1 + 1"))
+    assert out == {0: "2", 1: "2"}
