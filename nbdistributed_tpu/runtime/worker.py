@@ -284,44 +284,63 @@ class DistributedWorker:
             "profile": self._handle_profile,
             "checkpoint": self._handle_checkpoint,
         }
-        while not self._shutdown.is_set():
-            # KeyboardInterrupt (= %dist_interrupt / Ctrl-C forwarding)
-            # may land at ANY bytecode of this loop, not just inside a
-            # cell; the outer except keeps the worker alive wherever it
-            # strikes.  Sends are SIGINT-masked (_send_masked) so a
-            # frame can never be torn mid-write.
+        # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
+        # Ctrl-C) must only ever fire inside the two *interruptible*
+        # sections — the idle recv (aborts nothing, loop continues) and
+        # the handler body (user code; execute converts it to an error
+        # reply).  Everywhere else — dispatch bookkeeping, reply
+        # construction, the reply send — the signal stays masked and
+        # pending, so a request can never lose its reply and a frame
+        # can never be torn mid-write.  (A dropped reply would hang the
+        # coordinator forever in the default timeout=None mode.)
+        is_main = threading.current_thread() is threading.main_thread()
+        if is_main:
+            signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
+                                       {signal_mod.SIGINT})
+
+        def unmasked(fn, *a):
+            if not is_main:
+                return fn(*a)
+            signal_mod.pthread_sigmask(signal_mod.SIG_UNBLOCK,
+                                       {signal_mod.SIGINT})
             try:
-                try:
-                    msg = self.channel.recv()
-                except TransportError:
-                    break  # coordinator gone
-                if msg.msg_type == "shutdown":
-                    break  # no response, by protocol (worker.py:205)
-                handler = handlers.get(msg.msg_type)
-                try:
-                    if handler is None:
-                        reply = msg.reply(
-                            data={"error": f"unknown message type "
-                                           f"{msg.msg_type!r}"},
-                            rank=self.rank)
-                    else:
-                        reply = handler(msg)
-                except KeyboardInterrupt:
-                    # Interrupt racing a non-execute handler: report and
-                    # keep serving (execute handles its own, executor).
-                    reply = msg.reply(data={"error": "KeyboardInterrupt"},
-                                      rank=self.rank)
-                except Exception as e:
-                    reply = msg.reply(
-                        data={"error": str(e),
-                              "traceback": traceback.format_exc()},
-                        rank=self.rank)
-                try:
-                    self._send_masked(reply)
-                except Exception:
-                    break
+                return fn(*a)
+            finally:
+                signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
+                                           {signal_mod.SIGINT})
+
+        while not self._shutdown.is_set():
+            try:
+                msg = unmasked(self.channel.recv)
+            except TransportError:
+                break  # coordinator gone
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
+            if msg.msg_type == "shutdown":
+                break  # no response, by protocol (reference: worker.py:205)
+            handler = handlers.get(msg.msg_type)
+            try:
+                if handler is None:
+                    reply = msg.reply(
+                        data={"error": f"unknown message type "
+                                       f"{msg.msg_type!r}"},
+                        rank=self.rank)
+                else:
+                    reply = unmasked(handler, msg)
+            except KeyboardInterrupt:
+                # Interrupt racing a non-execute handler: report and
+                # keep serving (execute handles its own, in executor).
+                reply = msg.reply(data={"error": "KeyboardInterrupt"},
+                                  rank=self.rank)
+            except Exception as e:
+                reply = msg.reply(
+                    data={"error": str(e),
+                          "traceback": traceback.format_exc()},
+                    rank=self.rank)
+            try:
+                self.channel.send(reply)  # masked: no torn frames
+            except Exception:
+                break
 
     def shutdown(self) -> None:
         """Teardown (reference: worker.py:569-580)."""

@@ -103,3 +103,46 @@ def test_notebook_checkpoint_restore_exact(executed_nb):
     text = _all_text(executed_nb)
     assert "ranks saved" in text and "ranks restored" in text
     assert "(exact)" in text
+
+
+@pytest.fixture(scope="module")
+def executed_parallelism_nb():
+    nbclient = pytest.importorskip("nbclient")
+    import nbformat
+
+    path = os.path.join(REPO_ROOT, "examples", "01_parallelism.ipynb")
+    nb = nbformat.read(path, as_version=4)
+    # Kernel must import the repo checkout (same contract as
+    # executed_nb above); the notebook forces its own cpu/8-device env.
+    env_patch = {"PYTHONPATH": REPO_ROOT + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")}
+    old = {k: os.environ.get(k) for k in env_patch}
+    os.environ.update(env_patch)
+    try:
+        client = nbclient.NotebookClient(
+            nb, timeout=600, kernel_name="python3",
+            resources={"metadata": {"path": REPO_ROOT}})
+        client.execute()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return nb
+
+
+def test_parallelism_notebook_runs_clean(executed_parallelism_nb):
+    errors = [out for cell in executed_parallelism_nb.cells
+              for out in cell.get("outputs", [])
+              if out.get("output_type") == "error"]
+    assert not errors, errors
+
+
+def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
+    text = _all_text(executed_parallelism_nb)
+    assert "ring" in text and "ulysses" in text
+    assert "pipeline max |err|" in text
+    assert "MoE loss over dp×ep mesh" in text
+    assert "moment sharding" in text and "dp" in text
+    assert "generated:" in text
