@@ -139,7 +139,18 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                                           (0, cache_len, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, cache_len, 0, 0))
-        o = _cached_attention(q, kc, vc, positions, scale)
+        if S == 1 and cfg.use_flash and mesh is None:
+            # Decode hot path: fused Pallas kernel streams the cache
+            # once with the masked online softmax (ops/decode.py).
+            # Mesh runs stay on the einsum path: GSPMD can partition
+            # it over the tp/dp cache sharding, which a raw
+            # pallas_call would force it to replicate.
+            from ..ops.decode import flash_decode_attention
+            o = flash_decode_attention(
+                q[:, 0], kc, vc, positions[:, 0],
+                scale=scale).reshape(B, 1, H * Dh)
+        else:
+            o = _cached_attention(q, kc, vc, positions, scale)
         x = x + o @ layer["wo"]
         x = mlp(x, layer)
         return x, (kc, vc)

@@ -1,5 +1,7 @@
 """TPU compute ops: Pallas kernels with reference fallbacks."""
 
 from .attention import attention_reference, flash_attention
+from .decode import flash_decode_attention
 
-__all__ = ["attention_reference", "flash_attention"]
+__all__ = ["attention_reference", "flash_attention",
+           "flash_decode_attention"]

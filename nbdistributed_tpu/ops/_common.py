@@ -1,0 +1,15 @@
+"""Shared kernel policy/constants for the Pallas ops (one definition —
+the attention and decode kernels must mask and backend-switch
+identically)."""
+
+from __future__ import annotations
+
+import jax
+
+NEG_INF = -1e30  # softmax mask value (finite: -inf breaks exp(-inf-m))
+
+
+def use_interpret() -> bool:
+    """Pallas interpreter mode off-TPU, so every backend runs the same
+    kernel code path."""
+    return jax.default_backend() != "tpu"

@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_NEG_INF = -1e30
+from ._common import NEG_INF as _NEG_INF
+from ._common import use_interpret as _shared_use_interpret
 
 
 # ----------------------------------------------------------------------
@@ -180,7 +181,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    return _shared_use_interpret()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

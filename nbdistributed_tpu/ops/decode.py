@@ -1,0 +1,138 @@
+"""Pallas flash-decode: single-token attention against the KV cache.
+
+The decode step's hot op is bandwidth-bound: every generated token
+reads the whole (B, T, Hkv, D) cache once.  This kernel fuses the
+masked online-softmax into that single streaming pass — no (B, H, T)
+score tensor ever hits HBM — with one program per (batch, kv-head)
+whose query block is the GQA *group* (all H/Hkv query heads sharing
+that KV head), so the per-block matmuls are (group, D) @ (D, block_k):
+the same shape decode GQA is compute-bound on.
+
+Same recurrence as the prefill flash kernel (attention.py), lifted to
+the cache layout + per-batch valid-length masking (cache slots
+t <= pos[b] attend; later slots are unwritten).  On non-TPU backends
+the kernel runs in interpreter mode, so tests exercise the identical
+code path everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._common import NEG_INF as _NEG_INF
+from ._common import use_interpret as _use_interpret
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *,
+                   block_k: int, seq_k: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    q = q_ref[0, 0].astype(jnp.float32) * scale        # (group, D)
+    valid = pos_ref[b] + 1                              # keys [0, valid)
+
+    group = q.shape[0]
+    acc = jnp.zeros((group, q.shape[-1]), jnp.float32)
+    m = jnp.full((group, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((group, 1), jnp.float32)
+
+    # Only blocks intersecting [0, valid) contribute; block starts are
+    # clamped in the body, so the count uses the unclamped grid.
+    num_iters = jnp.minimum(
+        jax.lax.div(valid + block_k - 1, block_k),
+        jax.lax.div(seq_k + block_k - 1, block_k))
+
+    def body(kb, carry):
+        acc, m, l = carry
+        # The final block of a non-block-multiple cache reads the
+        # overlapping window [seq_k - block_k, seq_k) — always in
+        # bounds — and masks out the keys the previous block already
+        # folded in, so any T works at full block width.
+        start = jnp.minimum(kb * block_k, seq_k - block_k)
+        k_blk = k_ref[0, pl.ds(start, block_k), 0].astype(
+            jnp.float32)                                # (Bk, D)
+        v_blk = v_ref[0, pl.ds(start, block_k), 0].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (group, Bk)
+        ki = (start
+              + jax.lax.broadcasted_iota(jnp.int32, (group, block_k), 1))
+        keep = (ki < valid) & (ki >= kb * block_k)
+        s = jnp.where(keep, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, num_iters, body, (acc, m, l))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_k", "scale", "interpret"))
+def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
+                 interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Hkv, group, D = q.shape
+    T = kc.shape[1]
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               seq_k=T, scale=scale)
+    # pos rides as a prefetched scalar array (SMEM on real TPU) —
+    # the kernel indexes it by the batch program id.
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, D),
+                             lambda b, h, pos: (b, h, 0, 0)),   # q
+                pl.BlockSpec((1, T, 1, D),
+                             lambda b, h, pos: (b, 0, h, 0)),   # k cache
+                pl.BlockSpec((1, T, 1, D),
+                             lambda b, h, pos: (b, 0, h, 0)),   # v cache
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, D),
+                                   lambda b, h, pos: (b, h, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),
+        interpret=interpret,
+    )(pos, q, kc, vc)
+
+
+def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
+                           block_k: int = 128):
+    """Fused decode attention: one new token per sequence against the
+    cache.
+
+    q: (B, H, D) — this step's queries (S = 1 squeezed);
+    kc/vc: (B, T, Hkv, D) cache buffers (slots beyond ``pos`` unwritten);
+    pos: (B,) int32 — the global position of the new token per
+    sequence (cache slots ``t <= pos[b]`` attend).
+    Returns (B, H, D).  Any cache length works at full block width —
+    a non-multiple tail is handled by an overlapping, masked final
+    block read inside the kernel.
+    """
+    B, H, D = q.shape
+    T, Hkv = kc.shape[1], kc.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    group = H // Hkv
+    scale = scale if scale is not None else float(1.0 / np.sqrt(D))
+    block_k = min(block_k, T)
+    qg = q.reshape(B, Hkv, group, D)
+    out = _decode_call(qg, kc, vc, jnp.asarray(pos, jnp.int32),
+                       block_k=block_k, scale=float(scale),
+                       interpret=_use_interpret())
+    return out.reshape(B, H, D)
