@@ -450,6 +450,34 @@ class DistributedMagics(Magics):
                     line_txt += f" · seen {time.time() - seen:.1f}s ago"
             print(line_txt)
 
+    @magic_arguments()
+    @argument("--ranks", default=None,
+              help="target spec like [0,2]; default all")
+    @argument("-n", "--lines", type=int, default=20,
+              help="tail length per rank")
+    @line_magic
+    def dist_logs(self, line):
+        """Tail the raw process stdio of worker(s) — output that
+        bypassed the streaming path (native-library prints, XLA/absl
+        logs, crash output captured before the control plane came up).
+        """
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_logs, line)
+        args.lines = max(1, args.lines)  # tail(0/-n) would mis-slice
+        ranks = sorted(self._pm.io)
+        if args.ranks:
+            try:
+                ranks = rankspec.parse_ranks(args.ranks, self._world)
+            except rankspec.RankSpecError as e:
+                print(f"❌ {e}")
+                return
+        for r in ranks:
+            io = self._pm.io.get(r)
+            text = io.tail(args.lines) if io else ""
+            print(f"── rank {r} stdio (last {args.lines} lines) ──")
+            print(text if text.strip() else "(empty)")
+
     @line_magic
     def dist_debug(self, line):
         """Internals dump (reference: magic.py:1589-1624)."""

@@ -180,8 +180,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
     return out[:, :Sq] if Sq_pad != Sq else out
 
 
-def _use_interpret() -> bool:
-    return _shared_use_interpret()
+_use_interpret = _shared_use_interpret
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

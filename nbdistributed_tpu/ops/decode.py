@@ -27,54 +27,53 @@ from ._common import NEG_INF as _NEG_INF
 from ._common import use_interpret as _use_interpret
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *,
-                   block_k: int, seq_k: int, scale: float):
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_s, m_s, l_s, *, block_k: int, seq_k: int,
+                   scale: float, num_kb: int):
+    """One grid step = one (batch, kv-head, k-block).  The k axis rides
+    the grid (sequential on-core), so only a (block_k, D) window of the
+    cache is ever staged in VMEM — context length is bounded by HBM,
+    not VMEM — with the online-softmax state carried in scratch."""
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
-    q = q_ref[0, 0].astype(jnp.float32) * scale        # (group, D)
+    kb = pl.program_id(2)
     valid = pos_ref[b] + 1                              # keys [0, valid)
 
-    group = q.shape[0]
-    acc = jnp.zeros((group, q.shape[-1]), jnp.float32)
-    m = jnp.full((group, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((group, 1), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
 
-    # Only blocks intersecting [0, valid) contribute; block starts are
-    # clamped in the body, so the count uses the unclamped grid.
-    num_iters = jnp.minimum(
-        jax.lax.div(valid + block_k - 1, block_k),
-        jax.lax.div(seq_k + block_k - 1, block_k))
-
-    def body(kb, carry):
-        acc, m, l = carry
-        # The final block of a non-block-multiple cache reads the
-        # overlapping window [seq_k - block_k, seq_k) — always in
-        # bounds — and masks out the keys the previous block already
-        # folded in, so any T works at full block width.
-        start = jnp.minimum(kb * block_k, seq_k - block_k)
-        k_blk = k_ref[0, pl.ds(start, block_k), 0].astype(
-            jnp.float32)                                # (Bk, D)
-        v_blk = v_ref[0, pl.ds(start, block_k), 0].astype(
-            jnp.float32)
+    @pl.when(kb * block_k < valid)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
+        k_blk = k_ref[0, :, 0].astype(jnp.float32)      # (Bk, D)
+        v_blk = v_ref[0, :, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # (group, Bk)
-        ki = (start
-              + jax.lax.broadcasted_iota(jnp.int32, (group, block_k), 1))
-        keep = (ki < valid) & (ki >= kb * block_k)
-        s = jnp.where(keep, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        ki = (kb * block_k
+              + jax.lax.broadcasted_iota(jnp.int32,
+                                         (q.shape[0], block_k), 1))
+        # < valid also masks the padded tail of a non-multiple T
+        # (valid <= seq_k always).
+        s = jnp.where(ki < valid, s, _NEG_INF)
+        m_prev, l_prev = m_s[...], l_s[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_s[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_s[...] = m_new
 
-    acc, m, l = jax.lax.fori_loop(0, num_iters, body, (acc, m, l))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_s[...]
+                       / jnp.maximum(l_s[...], 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit,
@@ -86,25 +85,32 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
 
     B, Hkv, group, D = q.shape
     T = kc.shape[1]
+    num_kb = -(-T // block_k)
     kernel = functools.partial(_decode_kernel, block_k=block_k,
-                               seq_k=T, scale=scale)
+                               seq_k=T, scale=scale, num_kb=num_kb)
     # pos rides as a prefetched scalar array (SMEM on real TPU) —
-    # the kernel indexes it by the batch program id.
+    # the kernel indexes it by the batch program id.  The k axis is the
+    # innermost grid dim: sequential on-core, scratch carries state.
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, Hkv),
+            grid=(B, Hkv, num_kb),
             in_specs=[
                 pl.BlockSpec((1, 1, group, D),
-                             lambda b, h, pos: (b, h, 0, 0)),   # q
-                pl.BlockSpec((1, T, 1, D),
-                             lambda b, h, pos: (b, 0, h, 0)),   # k cache
-                pl.BlockSpec((1, T, 1, D),
-                             lambda b, h, pos: (b, 0, h, 0)),   # v cache
+                             lambda b, h, kb, pos: (b, h, 0, 0)),  # q
+                pl.BlockSpec((1, block_k, 1, D),
+                             lambda b, h, kb, pos: (b, kb, h, 0)),  # k
+                pl.BlockSpec((1, block_k, 1, D),
+                             lambda b, h, kb, pos: (b, kb, h, 0)),  # v
             ],
             out_specs=pl.BlockSpec((1, 1, group, D),
-                                   lambda b, h, pos: (b, h, 0, 0)),
+                                   lambda b, h, kb, pos: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, D), jnp.float32),   # acc
+                pltpu.VMEM((group, 1), jnp.float32),   # running max
+                pltpu.VMEM((group, 1), jnp.float32),   # normalizer
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),
         interpret=interpret,

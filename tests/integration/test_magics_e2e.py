@@ -166,3 +166,32 @@ def test_checkpoint_missing_var_reports_per_rank(ip, capsys, tmp_path):
                       f"{tmp_path / 'ck_missing'} not_a_var")
     out = capsys.readouterr().out
     assert "❌" in out and "not_a_var" in out
+
+
+def test_dist_logs_shows_worker_stdio(ip, capsys):
+    # sys.stderr writes bypass the streaming stdout path and land in
+    # the process pipe the manager drains.
+    run(ip, "import sys; sys.stderr.write('raw-stderr-marker\\n')")
+    import time
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    pm = DistributedMagics._pm
+    deadline = time.time() + 10
+    while time.time() < deadline:  # poll the drain thread, no fixed sleep
+        if "raw-stderr-marker" in pm.io[0].tail(400):
+            break
+        time.sleep(0.05)
+    capsys.readouterr()
+    ip.run_line_magic("dist_logs", "")
+    out = capsys.readouterr().out
+    assert "rank 0 stdio" in out and "rank 1 stdio" in out
+    assert "raw-stderr-marker" in out
+
+
+def test_dist_interrupt_magic_idle(ip, capsys):
+    ip.run_line_magic("dist_interrupt", "")
+    out = capsys.readouterr().out
+    assert "interrupt sent to ranks [0, 1]" in out
+    run(ip, "'post-interrupt-alive'")
+    out = capsys.readouterr().out
+    assert "post-interrupt-alive" in out

@@ -60,10 +60,21 @@ def test_decode_rejects_indivisible_heads():
                                jnp.zeros((1,), jnp.int32))
 
 
-def test_generation_uses_kernel_and_matches_einsum_path():
+def test_generation_uses_kernel_and_matches_einsum_path(monkeypatch):
     """use_flash=True routes decode through the Pallas kernel; tokens
-    must match the einsum path exactly (greedy, fp32)."""
+    must match the einsum path exactly (greedy, fp32).  A spy pins the
+    routing so the comparison can't pass vacuously."""
     from nbdistributed_tpu.models import generate, init_params, tiny_config
+    from nbdistributed_tpu.ops import decode as decode_mod
+
+    calls = []
+    real = decode_mod.flash_decode_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(decode_mod, "flash_decode_attention", spy)
 
     cfg_ein = tiny_config(dtype=jnp.float32, use_flash=False)
     cfg_flash = tiny_config(dtype=jnp.float32, use_flash=True)
@@ -71,5 +82,7 @@ def test_generation_uses_kernel_and_matches_einsum_path():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
                                 cfg_ein.vocab_size)
     a = generate(params, prompt, cfg_ein, max_new_tokens=8)
+    assert not calls, "einsum config must not touch the kernel"
     b = generate(params, prompt, cfg_flash, max_new_tokens=8)
+    assert calls, "use_flash config must route decode through the kernel"
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
