@@ -51,6 +51,16 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
         k_blk = k_ref[0, :, 0].astype(jnp.float32)      # (Bk, D)
         v_blk = v_ref[0, :, 0].astype(jnp.float32)
+        # A final block that extends past seq_k is padded by Pallas
+        # with undefined data (NaN in interpret mode, garbage memory on
+        # hardware).  The score mask below already discards those
+        # columns of s, but the p @ v matmul would still compute
+        # 0 * NaN = NaN through the padded v rows — so zero the
+        # out-of-bounds rows explicitly before they enter any matmul.
+        kpad = (kb * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0))
+        in_bounds = kpad < seq_k                        # (Bk, 1)
+        v_blk = jnp.where(in_bounds, v_blk, 0.0)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # (group, Bk)
@@ -58,7 +68,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
               + jax.lax.broadcasted_iota(jnp.int32,
                                          (q.shape[0], block_k), 1))
         # < valid also masks the padded tail of a non-multiple T
-        # (valid <= seq_k always).
+        # (valid <= seq_k always) — including any NaN columns of s
+        # from padded k rows (jnp.where does not propagate the
+        # unselected branch).
         s = jnp.where(ki < valid, s, _NEG_INF)
         m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))

@@ -28,7 +28,11 @@ def reference(q, kc, vc, pos):
                                    # not a block multiple (the old gcd
                                    # fallback collapsed these to 1-wide
                                    # blocks)
-                                   (129, [128, 60]), (200, [199, 130])])
+                                   (129, [128, 60]), (200, [199, 130]),
+                                   # T = block_k + 1 with pos at both
+                                   # extremes: first slot only, and the
+                                   # lone slot owned by the final block
+                                   (129, [0, 128])])
 def test_decode_matches_reference(T, pos):
     B, H, Hkv, D = 2, 8, 4, 16
     kc = jax.random.normal(jax.random.PRNGKey(0), (B, T, Hkv, D))
