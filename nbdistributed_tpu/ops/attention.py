@@ -5,14 +5,14 @@ whatever users type into cells), but a TPU-native framework's hot op is
 attention, so this module provides:
 
 * :func:`flash_attention` — blockwise online-softmax attention as a
-  Pallas TPU kernel (forward), tiled for the MXU (128-lane blocks),
-  with a custom VJP whose backward recomputes through the reference
-  path.  No O(S^2) residuals are *saved across* the forward, but the
-  recomputing backward itself materializes the (B,H,S,S) score matrix —
-  training memory is O(S^2) in the backward until a blockwise Pallas
-  backward lands; the kernel's memory advantage is forward/inference.
+  Pallas TPU kernel, tiled for the MXU (128-lane blocks), with a
+  blockwise Pallas backward (separate dQ and dK/dV kernels driven by
+  the saved per-row logsumexp): no (B,H,S,S) score tensor is ever
+  materialized in either direction, so training memory is O(S)
+  end-to-end — the flash-attention trade in both passes.
 * :func:`attention_reference` — pure-jnp attention, numerically exact,
-  used for the backward pass, for CPU execution, and as the test oracle.
+  used for CPU execution and as the test oracle (including grad
+  checks against the Pallas backward).
 
 Supports causal masking and grouped-query attention (n_kv_heads <
 n_heads).  Layout: (batch, seq, heads, head_dim) — the native layout for
@@ -63,15 +63,17 @@ def attention_reference(q, k, v, *, causal: bool = True,
 # ----------------------------------------------------------------------
 # Pallas forward kernel
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
-                  seq_k_valid: int, causal: bool, scale: float,
-                  block_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  seq_k: int, seq_k_valid: int, causal: bool,
+                  scale: float, block_q: int):
     """One (batch*head, q-block) program: stream K/V blocks with the
     online-softmax recurrence (running max m, normalizer l, accumulator).
 
     ``seq_k`` is the (block-padded) buffer length; ``seq_k_valid`` the
     real key count — keys at or beyond it are masked out, so inputs of
     any length are handled exactly (the wrapper pads to block multiples).
+    Besides the output block, writes the per-row logsumexp (m + log l)
+    — the only residual the blockwise backward needs.
     """
     from jax.experimental import pallas as pl
 
@@ -121,11 +123,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
         return acc_new, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(0, num_iters, body, (acc, m, l))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _fold_heads(x, S_pad):
+    """(B, S, H, D) → (B*H, S_pad, D), zero-padding the seq axis.  The
+    per-(batch, head) layout gives every kernel program contiguous
+    (seq, head_dim) MXU tiles."""
+    B, S, H, D = x.shape
+    if S_pad != S:
+        x = jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+
+
+def _unfold_heads(x, B, H, S):
+    """(B*H, S_pad, D) → (B, S, H, D), dropping seq padding."""
+    x = x.reshape(B, H, x.shape[1], -1).transpose(0, 2, 1, 3)
+    return x[:, :S]
 
 
 def _flash_forward(q, k, v, *, causal: bool, scale: float,
                    block_q: int, block_k: int, interpret: bool):
+    """Returns (out (B,Sq,H,D), lse (B*H, Sq_pad) float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -138,28 +159,24 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
     # earlier rows), padded query rows are sliced off below.
     Sq_pad = -(-Sq // block_q) * block_q
     Sk_pad = -(-Sk // block_k) * block_k
-    if Sq_pad != Sq:
-        q = jnp.pad(q, ((0, 0), (0, Sq_pad - Sq), (0, 0), (0, 0)))
-    if Sk_pad != Sk:
-        k = jnp.pad(k, ((0, 0), (0, Sk_pad - Sk), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, Sk_pad - Sk), (0, 0), (0, 0)))
 
-    # Kernel operates per (batch*head): fold B and H together and move
-    # seq next-to-last so blocks are (seq, head_dim) MXU tiles.
-    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq_pad, D)
+    qt = _fold_heads(q, Sq_pad)
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
+    kt = _fold_heads(k, Sk_pad)
+    vt = _fold_heads(v, Sk_pad)
 
     grid = (B * H, Sq_pad // block_q)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=Sk_pad, seq_k_valid=Sk,
         causal=causal, scale=scale, block_q=block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq_pad), jnp.float32),
+        ],
         grid_spec=pl.GridSpec(
             grid=grid,
             in_specs=[
@@ -170,14 +187,243 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
                 pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
                              memory_space=pltpu.VMEM),
             ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
+                             memory_space=pltpu.VMEM),
+            ],
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return _unfold_heads(out, B, H, Sq), lse
+
+
+# ----------------------------------------------------------------------
+# Pallas backward kernels (FlashAttention-2 style)
+#
+# With the saved logsumexp L_i the softmax row is reconstructible
+# blockwise as p = exp(s - L), so the backward is two streaming passes
+# that never materialize (Sq, Sk):
+#   delta_i = sum_d dO_id * O_id                    (tiny, plain XLA)
+#   dV_j    = sum_i p_ij dO_i
+#   dS_ij   = p_ij (dO_i . V_j - delta_i)
+#   dQ_i    = scale * sum_j dS_ij K_j
+#   dK_j    = scale * sum_i dS_ij Q_i
+# The dQ kernel grids over q-blocks streaming K/V; the dK/dV kernel
+# grids over k-blocks streaming Q/dO (starting at the diagonal block
+# when causal — earlier q rows cannot attend to this k block).
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                         dq_ref, *, block_k: int, seq_k: int,
+                         seq_k_valid: int, causal: bool, scale: float,
+                         block_q: int):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
+    do = do_ref[0].astype(jnp.float32)                # (Bq, D)
+    lse = lse_ref[0][:, None]                         # (Bq, 1)
+    delta = dta_ref[0][:, None]                       # (Bq, 1)
+    q_idx = pl.program_id(1)
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        last_block = jax.lax.div((q_idx + 1) * block_q - 1, block_k) + 1
+        num_iters = jnp.minimum(num_k_blocks, last_block)
+    else:
+        num_iters = num_k_blocks
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, Bk)
+        qi = (q_idx * block_q
+              + jax.lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 0))
+        ki = (kb * block_k
+              + jax.lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 1))
+        keep = ki < seq_k_valid
+        if causal:
+            keep = keep & (ki <= qi)
+        s = jnp.where(keep, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # (Bq, Bk)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, Bk)
+        ds = p * (dp - delta)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    dq = jax.lax.fori_loop(
+        0, num_iters, body,
+        jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
+                          seq_q_valid: int, seq_k_valid: int,
+                          causal: bool, scale: float, block_k: int):
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)              # (Bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_idx = pl.program_id(1)
+
+    num_q_blocks = pl.cdiv(seq_q, block_q)
+    if causal:
+        # q rows before this k block's first key never attend to it.
+        first_block = jax.lax.div(k_idx * block_k, block_q)
+    else:
+        first_block = 0
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = (q_ref[0, pl.ds(qb * block_q, block_q)]
+                 .astype(jnp.float32) * scale)        # (Bq, D)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q)].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = dta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, Bk)
+        qi = (qb * block_q
+              + jax.lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 0))
+        ki = (k_idx * block_k
+              + jax.lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 1))
+        # Padded q rows carry a meaningless lse — mask them here so
+        # they contribute nothing to dk/dv.
+        keep = (ki < seq_k_valid) & (qi < seq_q_valid)
+        if causal:
+            keep = keep & (ki <= qi)
+        s = jnp.where(keep, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # (Bq, Bk)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bk, D)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, Bk)
+        ds = p * (dp - delta)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bk, D)
+        return dk_new, dv_new
+
+    zero = jnp.zeros((block_k, k_blk.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_block, num_q_blocks, body,
+                               (zero, zero))
+    # q_blk was pre-scaled, so dk = sum ds^T q_blk already carries one
+    # factor of scale — exactly the d(s)/d(k) = scale * q chain term.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Sq, H, D = q.shape
+    _, Sk, Hkv, _ = k.shape
+    group = H // Hkv
+    Sq_pad = -(-Sq // block_q) * block_q
+    Sk_pad = -(-Sk // block_k) * block_k
+
+    qt = _fold_heads(q, Sq_pad)
+    got = _fold_heads(g, Sq_pad)
+    ot = _fold_heads(o, Sq_pad)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    kt = _fold_heads(k, Sk_pad)
+    vt = _fold_heads(v, Sk_pad)
+
+    # delta_i = rowsum(dO * O): one elementwise pass XLA fuses; padded
+    # rows give 0.
+    delta = jnp.sum(got.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)                          # (B*H, Sq_pad)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, seq_k=Sk_pad,
+        seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
+        grid_spec=pl.GridSpec(
+            grid=(B * H, Sq_pad // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
+                             memory_space=pltpu.VMEM),     # q
+                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),     # k
+                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),     # v
+                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
+                             memory_space=pltpu.VMEM),     # dO
+                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
+                             memory_space=pltpu.VMEM),     # lse
+                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
+                             memory_space=pltpu.VMEM),     # delta
+            ],
             out_specs=pl.BlockSpec((1, block_q, D),
                                    lambda bh, qb: (bh, qb, 0),
                                    memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(qt, kt, vt)
-    out = out.reshape(B, H, Sq_pad, D).transpose(0, 2, 1, 3)
-    return out[:, :Sq] if Sq_pad != Sq else out
+    )(qt, kt, vt, got, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, seq_q=Sq_pad,
+        seq_q_valid=Sq, seq_k_valid=Sk, causal=causal, scale=scale,
+        block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk_pad, D), v.dtype),
+        ],
+        grid_spec=pl.GridSpec(
+            grid=(B * H, Sk_pad // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
+                             memory_space=pltpu.VMEM),     # k
+                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
+                             memory_space=pltpu.VMEM),     # v
+                pl.BlockSpec((1, Sq_pad, D), lambda bh, kb: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),     # q
+                pl.BlockSpec((1, Sq_pad, D), lambda bh, kb: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),     # dO
+                pl.BlockSpec((1, Sq_pad), lambda bh, kb: (bh, 0),
+                             memory_space=pltpu.VMEM),     # lse
+                pl.BlockSpec((1, Sq_pad), lambda bh, kb: (bh, 0),
+                             memory_space=pltpu.VMEM),     # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+        ),
+        interpret=interpret,
+    )(kt, vt, qt, got, lse, delta)
+
+    dq = _unfold_heads(dq, B, H, Sq)
+    dk = _unfold_heads(dk, B, H, Sk)
+    dv = _unfold_heads(dv, B, H, Sk)
+    if group > 1:
+        # GQA: each kv head served `group` query heads — sum their
+        # contributions (forward repeated k/v along the head axis).
+        dk = dk.reshape(B, Sk, Hkv, group, D).sum(axis=3)
+        dv = dv.reshape(B, Sk, Hkv, group, D).sum(axis=3)
+    return dq, dk, dv
 
 
 _use_interpret = _shared_use_interpret
@@ -200,27 +446,30 @@ def _resolved_scale(scale, D):
     return scale if scale is not None else 1.0 / np.sqrt(D)
 
 
+def _block_sizes(block_q, block_k, Sq, Sk):
+    return min(block_q, Sq), min(block_k, Sk)
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     D = q.shape[-1]
-    Sq = q.shape[1]
-    bq = min(block_q, Sq)
-    bk = min(block_k, k.shape[1])
-    out = _flash_forward(q, k, v, causal=causal,
-                         scale=_resolved_scale(scale, D),
-                         block_q=bq, block_k=bk,
-                         interpret=_use_interpret())
-    return out, (q, k, v)
+    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
+    out, lse = _flash_forward(q, k, v, causal=causal,
+                              scale=_resolved_scale(scale, D),
+                              block_q=bq, block_k=bk,
+                              interpret=_use_interpret())
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
-    """Backward by recomputation through the reference path — the
-    flash-attention trade: no O(S^2) tensors survive the forward."""
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=causal,
-            scale=_resolved_scale(scale, q.shape[-1])), q, k, v)
-    return vjp(g)
+    """Blockwise Pallas backward: reconstructs each score block from
+    the saved logsumexp, so no O(S^2) tensor exists in the backward
+    either."""
+    q, k, v, out, lse = residuals
+    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
+    return _flash_backward(q, k, v, out, lse, g, causal=causal,
+                           scale=_resolved_scale(scale, q.shape[-1]),
+                           block_q=bq, block_k=bk,
+                           interpret=_use_interpret())
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
