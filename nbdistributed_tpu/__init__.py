@@ -33,4 +33,5 @@ def unload_ipython_extension(ipython):
     (reference: __init__.py:21-25)."""
     from .magics.magic import DistributedMagics
 
+    DistributedMagics.unregister_cell_hooks()
     DistributedMagics.shutdown_all()

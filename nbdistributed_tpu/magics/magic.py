@@ -72,9 +72,62 @@ class DistributedMagics(Magics):
     _instance = None
     _proxy_registry: dict = {}
 
+    _cell_hooks: tuple | None = None
+
     def __init__(self, shell):
         super().__init__(shell)
         DistributedMagics._instance = self
+        self._register_cell_hooks()
+
+    # ==================================================================
+    # whole-session timeline hooks
+    #
+    # The reference registers pre/post_run_cell at load so *every* cell
+    # — local and distributed — lands in the timeline (reference:
+    # magic.py:123-130, 647-707).  Distributed cells get their richer
+    # record from _run_on_ranks; these hooks add kind="local" records
+    # for everything else (plain local cells, magics, auto-mode off).
+
+    def _register_cell_hooks(self) -> None:
+        cls = DistributedMagics
+        if cls._cell_hooks is not None:
+            # A previous %load_ext cycle left its bound methods
+            # registered — drop them or every cell records twice.
+            cls.unregister_cell_hooks()
+        if self.shell is None:
+            return
+        self.shell.events.register("pre_run_cell", self._pre_run_cell)
+        self.shell.events.register("post_run_cell", self._post_run_cell)
+        cls._cell_hooks = (self._pre_run_cell, self._post_run_cell,
+                           self.shell)
+
+    @classmethod
+    def unregister_cell_hooks(cls) -> None:
+        if cls._cell_hooks is None:
+            return
+        pre, post, shell = cls._cell_hooks
+        cls._cell_hooks = None
+        for name, cb in (("pre_run_cell", pre), ("post_run_cell", post)):
+            try:
+                shell.events.unregister(name, cb)
+            except ValueError:
+                pass
+
+    def _pre_run_cell(self, info) -> None:
+        self._cell_t0 = time.time()
+        self._cell_raw = getattr(info, "raw_cell", "") or ""
+        self._cell_recs_before = len(DistributedMagics._timeline.records)
+
+    def _post_run_cell(self, result) -> None:
+        t0 = getattr(self, "_cell_t0", None)
+        if t0 is None:
+            return
+        self._cell_t0 = None
+        tl = DistributedMagics._timeline
+        if len(tl.records) > self._cell_recs_before:
+            return  # the cell was distributed — already recorded richer
+        tl.record_local(self._cell_raw, t0, time.time() - t0,
+                        ok=bool(getattr(result, "success", True)))
 
     # ==================================================================
     # state helpers
@@ -736,6 +789,12 @@ class DistributedMagics(Magics):
     def timeline_clear(self, line):
         self._timeline.clear()
         print("✅ timeline cleared")
+
+    @line_magic
+    def timeline_debug(self, line):
+        """Dump every record's raw internals (reference:
+        %timeline_debug, magic.py:1778-1870)."""
+        print(self._timeline.debug_dump())
 
     # ==================================================================
     # shutdown / reset (tiered, reference: magic.py:810-1040)

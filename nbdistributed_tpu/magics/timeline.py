@@ -57,8 +57,29 @@ class Timeline:
                 rec.rank_status[rank] = ("error" if data.get("error")
                                          else "success")
 
+    def record_local(self, code: str, started_at: float, wall_s: float,
+                     ok: bool = True) -> CellRecord:
+        """Append a completed *local* cell (ran in the kernel, not on
+        workers).  Fed by the IPython pre/post_run_cell hooks so the
+        timeline covers every cell of the session, like the reference's
+        (reference: magic.py:123-130, 647-707)."""
+        rec = CellRecord(index=len(self.records), code=code,
+                         target_ranks=[], started_at=started_at,
+                         wall_s=round(wall_s, 6), kind="local")
+        rec.rank_status = {} if ok else {-1: "error"}
+        self.records.append(rec)
+        return rec
+
     def clear(self) -> None:
         self.records.clear()
+
+    def debug_dump(self) -> str:
+        """Raw per-record internals (reference: %timeline_debug,
+        magic.py:1778-1870)."""
+        out = [f"timeline: {len(self.records)} records"]
+        for r in self.records:
+            out.append(json.dumps(asdict(r), indent=2, default=str))
+        return "\n".join(out)
 
     def save(self, path: str) -> int:
         payload = [asdict(r) for r in self.records]

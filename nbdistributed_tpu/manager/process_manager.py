@@ -38,7 +38,8 @@ def wait_until_ready(comm, pm, timeout_s: float, *, poll_s: float = 2.0,
     (progress display).  The one bring-up loop shared by the magic
     layer, bench, selftest, and the integration tests.
     """
-    deadline = time.time() + timeout_s
+    t0 = time.time()
+    deadline = t0 + timeout_s
     while True:
         try:
             comm.wait_for_workers(timeout=poll_s)
@@ -46,7 +47,16 @@ def wait_until_ready(comm, pm, timeout_s: float, *, poll_s: float = 2.0,
         except TimeoutError:
             pm.check_startup_failure()
             if time.time() > deadline:
-                raise
+                # Re-raise with the *elapsed/budget* picture — the
+                # inner error only knows the last poll interval, which
+                # once produced "did not attach within 2s" after a
+                # 240 s wait.
+                missing = sorted(set(range(comm.num_workers))
+                                 - set(comm.connected_ranks()))
+                raise TimeoutError(
+                    f"workers {missing} did not attach to the control "
+                    f"plane within {time.time() - t0:.0f}s (budget "
+                    f"{timeout_s:.0f}s)") from None
             if on_wait is not None:
                 on_wait()
 
@@ -115,6 +125,11 @@ class ProcessManager:
             raise RuntimeError("workers already running; shutdown first")
         if backend == "auto":
             backend = topology.detect_backend()
+        if backend == "tpu":
+            # Fail fast, before any child exists, when the topology
+            # can't fit this host's chips (reference validates GPU ids
+            # against device_count pre-spawn: magic.py:454-488).
+            topology.validate_tpu_request(num_workers, chips_per_worker)
         self.backend = backend
         self.world_size = num_workers
         self.dist_port = find_free_port() if num_workers > 1 else None

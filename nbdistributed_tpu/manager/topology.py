@@ -85,6 +85,54 @@ def worker_env(rank: int, world_size: int, backend: str, *,
     raise ValueError(f"unknown backend {backend!r}")
 
 
+def available_tpu_chips() -> int | None:
+    """Best-effort count of this host's TPU chips, without initializing
+    JAX (device probes belong to the workers).  Returns None when the
+    count is unknowable cheaply.
+
+    The reference validates its GPU-id list against
+    ``torch.cuda.device_count()`` before spawning (reference:
+    magic.py:454-488); this is the TPU analog — device nodes first,
+    then the axon tunnel's pool list.
+    """
+    import glob
+
+    accel = glob.glob("/dev/accel[0-9]*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS")
+    if pool:
+        return len([p for p in pool.split(",") if p.strip()])
+    return None
+
+
+def validate_tpu_request(world_size: int, chips_per_worker: int) -> None:
+    """Fail fast (before any spawn) when the requested topology cannot
+    fit this host's chips — N workers dying inside the TPU runtime is a
+    much worse error message."""
+    need = world_size * chips_per_worker
+    have = available_tpu_chips()
+    if have is not None and need > have:
+        # Suggest the largest world size that both fits the host AND
+        # lands on a supported grid — advice the next attempt can
+        # actually follow.
+        fits = [w for w in range(have // chips_per_worker, 0, -1)
+                if w * chips_per_worker in _V5E_GRIDS]
+        hint = (f"Use -n {fits[0]}" if fits
+                else "No supported topology fits; use --backend cpu")
+        raise ValueError(
+            f"requested {world_size} worker(s) × {chips_per_worker} "
+            f"chip(s) = {need} TPU chips, but this host has {have}. "
+            f"{hint} (or --backend cpu for a CPU world).")
+    if need not in _V5E_GRIDS:
+        raise ValueError(
+            f"unsupported single-host chip count {need}; supported: "
+            f"{sorted(_V5E_GRIDS)}")
+
+
 def detect_backend() -> str:
     """'tpu' if this host has TPU chips, else 'cpu'.  Checked without
     initializing JAX in the coordinator (device probes are the workers'

@@ -88,6 +88,40 @@ def _cached_attention(q, kc, vc, positions, scale):
     return o.reshape(B, S, H * Dh).astype(q.dtype)
 
 
+def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale):
+    """Run the Pallas decode kernel under GSPMD via shard_map: batch
+    over ``dp``, heads over ``tp`` (other mesh axes replicated).
+
+    The GQA grouping survives head sharding because q-head block
+    [t·H/tp, (t+1)·H/tp) maps exactly onto kv-head block
+    [t·Hkv/tp, (t+1)·Hkv/tp) — each shard keeps the full group ratio,
+    so the local kernel call is the global computation.
+
+    q: (B, H, Dh); kc/vc: (B, T, Hkv, Dh); pos: (B,).
+    """
+    from ..ops.decode import flash_decode_attention
+
+    dp = "dp" if "dp" in mesh.shape else None
+    tp = "tp" if "tp" in mesh.shape else None
+    qspec = P(dp, tp, None)
+    cspec = P(dp, None, tp, None)
+
+    def inner(q, kc, vc, pos):
+        return flash_decode_attention(q, kc, vc, pos, scale=scale)
+
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(qspec, cspec, cspec, P(dp)),
+        out_specs=qspec, check_vma=False)(q, kc, vc, pos)
+
+
+def _can_flash_decode_on_mesh(mesh, B, H, Hkv):
+    """The sharded kernel needs each shard to hold whole head groups
+    and whole batch rows."""
+    tp_n = mesh.shape.get("tp", 1)
+    dp_n = mesh.shape.get("dp", 1)
+    return H % tp_n == 0 and Hkv % tp_n == 0 and B % dp_n == 0
+
+
 def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str):
     """The per-layer feed-forward branch: dense SwiGLU, or the MoE
     layer when the config is a :class:`~.moe.MoEConfig` (sharing
@@ -142,13 +176,19 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
         if S == 1 and cfg.use_flash and mesh is None:
             # Decode hot path: fused Pallas kernel streams the cache
             # once with the masked online softmax (ops/decode.py).
-            # Mesh runs stay on the einsum path: GSPMD can partition
-            # it over the tp/dp cache sharding, which a raw
-            # pallas_call would force it to replicate.
             from ..ops.decode import flash_decode_attention
             o = flash_decode_attention(
                 q[:, 0], kc, vc, positions[:, 0],
                 scale=scale).reshape(B, 1, H * Dh)
+        elif (S == 1 and cfg.use_flash
+              and _can_flash_decode_on_mesh(mesh, B, H, Hkv)):
+            # Same kernel under GSPMD: shard_map carves the batch over
+            # dp and the (already tp-sharded) heads over tp, so the
+            # kernel runs on local shards instead of forcing GSPMD to
+            # replicate a raw pallas_call.
+            o = _flash_decode_on_mesh(
+                q[:, 0], kc, vc, positions[:, 0], mesh,
+                scale).reshape(B, 1, H * Dh)
         else:
             o = _cached_attention(q, kc, vc, positions, scale)
         x = x + o @ layer["wo"]

@@ -61,17 +61,63 @@ def attention_reference(q, k, v, *, causal: bool = True,
 
 
 # ----------------------------------------------------------------------
+# Shared in-kernel masking / causal block-range helpers
+#
+# One definition for the offset-causal math used by the forward and both
+# backward kernels — forward and backward must never disagree on which
+# (qi, ki) pairs attend.
+
+def _causal_k_iters(q_off, k_off, q_idx, block_q, block_k, num_k_blocks):
+    """How many leading k-blocks a causal q-block can see: the largest
+    key this block's last row may attend is q_off - k_off + last row."""
+    qmax = q_off - k_off + (q_idx + 1) * block_q - 1
+    return jnp.clip(jax.lax.div(qmax, block_k) + 1, 0, num_k_blocks)
+
+
+def _causal_first_q_block(k_idx, q_off, k_off, block_q, block_k,
+                          num_q_blocks):
+    """First q-block whose rows can attend this k-block: rows before
+    the block's first (offset) key never see it."""
+    first_qi = jnp.maximum(k_idx * block_k + k_off - q_off, 0)
+    return jnp.minimum(jax.lax.div(first_qi, block_q), num_q_blocks)
+
+
+def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
+               seq_k_valid, causal, seq_q_valid=None):
+    """(block_q, block_k) bool: which score entries are real — inside
+    the valid key range, (optionally) inside the valid query range, and
+    at-or-below the offset causal diagonal."""
+    qi = (q_idx * block_q
+          + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+    ki = (kb * block_k
+          + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    keep = ki < seq_k_valid
+    if seq_q_valid is not None:
+        keep = keep & (qi < seq_q_valid)
+    if causal:
+        keep = keep & (ki + k_off <= qi + q_off)
+    return keep
+
+
+# ----------------------------------------------------------------------
 # Pallas forward kernel
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  seq_k: int, seq_k_valid: int, causal: bool,
-                  scale: float, block_q: int):
+def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                  block_k: int, seq_k: int, seq_k_valid: int,
+                  causal: bool, scale: float, block_q: int):
     """One (batch*head, q-block) program: stream K/V blocks with the
     online-softmax recurrence (running max m, normalizer l, accumulator).
 
     ``seq_k`` is the (block-padded) buffer length; ``seq_k_valid`` the
     real key count — keys at or beyond it are masked out, so inputs of
     any length are handled exactly (the wrapper pads to block multiples).
+    ``offs_ref`` holds (q_offset, k_offset): global positions of this
+    chunk's first query/key row, so causal masking works when the
+    inputs are one chunk of a larger sequence (ring attention hops);
+    both are 0 for ordinary whole-sequence calls.  Rows whose keys are
+    entirely masked self-heal through the online recurrence (their
+    garbage acc/l is wiped by corr = exp(-inf) at the first real block)
+    and surface lse ~ -inf, which the ring hop-combine weights to zero.
     Besides the output block, writes the per-row logsumexp (m + log l)
     — the only residual the blockwise backward needs.
     """
@@ -79,6 +125,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
     q_idx = pl.program_id(1)
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
@@ -86,10 +133,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
-        # Blocks strictly above the diagonal contribute nothing.
-        last_block = jax.lax.div(
-            (q_idx + 1) * block_q - 1, block_k) + 1
-        num_iters = jnp.minimum(num_k_blocks, last_block)
+        num_iters = _causal_k_iters(q_off, k_off, q_idx, block_q,
+                                    block_k, num_k_blocks)
     else:
         num_iters = num_k_blocks
 
@@ -103,15 +148,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
         if causal or mask_keys:
-            qi = (q_idx * block_q
-                  + jax.lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 0))
-            ki = (kb * block_k
-                  + jax.lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 1))
-            keep = ki < seq_k_valid
-            if causal:
-                keep = keep & (ki <= qi)
+            keep = _keep_mask(q_idx, kb, block_q=block_q,
+                              block_k=block_k, q_off=q_off, k_off=k_off,
+                              seq_k_valid=seq_k_valid, causal=causal)
             s = jnp.where(keep, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                        # (Bq, Bk)
@@ -144,9 +183,23 @@ def _unfold_heads(x, B, H, S):
     return x[:, :S]
 
 
+def _offsets_array(offsets):
+    if offsets is None:
+        return jnp.zeros((2,), jnp.int32)
+    q_off, k_off = offsets
+    return jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+
+
 def _flash_forward(q, k, v, *, causal: bool, scale: float,
-                   block_q: int, block_k: int, interpret: bool):
-    """Returns (out (B,Sq,H,D), lse (B*H, Sq_pad) float32)."""
+                   block_q: int, block_k: int, interpret: bool,
+                   offsets=None):
+    """Returns (out (B,Sq,H,D), lse (B*H, Sq_pad) float32).
+
+    ``offsets`` — optional (q_offset, k_offset) traced scalars giving
+    the global position of row 0 of q and of k/v, for chunk-of-a-
+    larger-sequence calls (ring attention).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -177,25 +230,26 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
             jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Sq_pad), jnp.float32),
         ],
-        grid_spec=pl.GridSpec(
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, qb, offs: (bh, qb, 0)),
+                pl.BlockSpec((1, Sk_pad, D),
+                             lambda bh, qb, offs: (bh, 0, 0)),
+                pl.BlockSpec((1, Sk_pad, D),
+                             lambda bh, qb, offs: (bh, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
-                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, qb, offs: (bh, qb, 0)),
+                pl.BlockSpec((1, block_q),
+                             lambda bh, qb, offs: (bh, qb)),
             ],
         ),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(_offsets_array(offsets), qt, kt, vt)
     return _unfold_heads(out, B, H, Sq), lse
 
 
@@ -214,8 +268,8 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
 # grids over k-blocks streaming Q/dO (starting at the diagonal block
 # when causal — earlier q rows cannot attend to this k block).
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
-                         dq_ref, *, block_k: int, seq_k: int,
+def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         dta_ref, dq_ref, *, block_k: int, seq_k: int,
                          seq_k_valid: int, causal: bool, scale: float,
                          block_q: int):
     from jax.experimental import pallas as pl
@@ -225,11 +279,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
     lse = lse_ref[0][:, None]                         # (Bq, 1)
     delta = dta_ref[0][:, None]                       # (Bq, 1)
     q_idx = pl.program_id(1)
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
-        last_block = jax.lax.div((q_idx + 1) * block_q - 1, block_k) + 1
-        num_iters = jnp.minimum(num_k_blocks, last_block)
+        num_iters = _causal_k_iters(q_off, k_off, q_idx, block_q,
+                                    block_k, num_k_blocks)
     else:
         num_iters = num_k_blocks
 
@@ -239,15 +294,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
-        qi = (q_idx * block_q
-              + jax.lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 0))
-        ki = (kb * block_k
-              + jax.lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 1))
-        keep = ki < seq_k_valid
-        if causal:
-            keep = keep & (ki <= qi)
+        keep = _keep_mask(q_idx, kb, block_q=block_q, block_k=block_k,
+                          q_off=q_off, k_off=k_off,
+                          seq_k_valid=seq_k_valid, causal=causal)
         s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)                          # (Bq, Bk)
         dp = jax.lax.dot_general(
@@ -263,20 +312,22 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
-                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
-                          seq_q_valid: int, seq_k_valid: int,
+def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
+                          dta_ref, dk_ref, dv_ref, *, block_q: int,
+                          seq_q: int, seq_q_valid: int, seq_k_valid: int,
                           causal: bool, scale: float, block_k: int):
     from jax.experimental import pallas as pl
 
     k_blk = k_ref[0].astype(jnp.float32)              # (Bk, D)
     v_blk = v_ref[0].astype(jnp.float32)
     k_idx = pl.program_id(1)
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     num_q_blocks = pl.cdiv(seq_q, block_q)
     if causal:
-        # q rows before this k block's first key never attend to it.
-        first_block = jax.lax.div(k_idx * block_k, block_q)
+        first_block = _causal_first_q_block(k_idx, q_off, k_off,
+                                            block_q, block_k,
+                                            num_q_blocks)
     else:
         first_block = 0
 
@@ -291,17 +342,12 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
-        qi = (qb * block_q
-              + jax.lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 0))
-        ki = (k_idx * block_k
-              + jax.lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 1))
-        # Padded q rows carry a meaningless lse — mask them here so
-        # they contribute nothing to dk/dv.
-        keep = (ki < seq_k_valid) & (qi < seq_q_valid)
-        if causal:
-            keep = keep & (ki <= qi)
+        # seq_q_valid: padded q rows carry a meaningless lse — mask
+        # them here so they contribute nothing to dk/dv.
+        keep = _keep_mask(qb, k_idx, block_q=block_q, block_k=block_k,
+                          q_off=q_off, k_off=k_off,
+                          seq_k_valid=seq_k_valid, causal=causal,
+                          seq_q_valid=seq_q_valid)
         s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)                          # (Bq, Bk)
         dv_new = dv_acc + jax.lax.dot_general(
@@ -325,59 +371,80 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
-                    block_q: int, block_k: int, interpret: bool):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    B, Sq, H, D = q.shape
-    _, Sk, Hkv, _ = k.shape
-    group = H // Hkv
-    Sq_pad = -(-Sq // block_q) * block_q
-    Sk_pad = -(-Sk // block_k) * block_k
-
+def _flash_bwd_prep(q, o, g, block_q: int):
+    """Fold the hop-invariant backward inputs once: q/dO in kernel
+    layout plus delta_i = rowsum(dO * O) (one elementwise pass XLA
+    fuses; padded rows give 0).  Split out so ring attention can hoist
+    this out of its per-hop loop instead of redoing it n times."""
+    Sq_pad = -(-q.shape[1] // block_q) * block_q
     qt = _fold_heads(q, Sq_pad)
     got = _fold_heads(g, Sq_pad)
     ot = _fold_heads(o, Sq_pad)
+    delta = jnp.sum(got.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)                          # (B*H, Sq_pad)
+    return qt, got, delta
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool,
+                    offsets=None):
+    qt, got, delta = _flash_bwd_prep(q, o, g, block_q)
+    return _flash_backward_folded(
+        qt, got, delta, lse, k, v, B=q.shape[0], Sq=q.shape[1],
+        H=q.shape[2], q_dtype=q.dtype, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        offsets=offsets)
+
+
+def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
+                           H: int, q_dtype, causal: bool, scale: float,
+                           block_q: int, block_k: int, interpret: bool,
+                           offsets=None):
+    """The two backward pallas_calls over pre-folded q/dO/delta (see
+    :func:`_flash_bwd_prep`); k/v arrive raw (B, Sk, Hkv, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, Sk, Hkv, D = k.shape
+    group = H // Hkv
+    Sq_pad = qt.shape[1]
+    Sk_pad = -(-Sk // block_k) * block_k
+
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
     kt = _fold_heads(k, Sk_pad)
     vt = _fold_heads(v, Sk_pad)
-
-    # delta_i = rowsum(dO * O): one elementwise pass XLA fuses; padded
-    # rows give 0.
-    delta = jnp.sum(got.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)                          # (B*H, Sq_pad)
+    offs = _offsets_array(offsets)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, seq_k=Sk_pad,
         seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q)
     dq = pl.pallas_call(
         dq_kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
-        grid_spec=pl.GridSpec(
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq_pad, D), q_dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=(B * H, Sq_pad // block_q),
             in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
-                             memory_space=pltpu.VMEM),     # q
-                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),     # k
-                pl.BlockSpec((1, Sk_pad, D), lambda bh, qb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),     # v
-                pl.BlockSpec((1, block_q, D), lambda bh, qb: (bh, qb, 0),
-                             memory_space=pltpu.VMEM),     # dO
-                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
-                             memory_space=pltpu.VMEM),     # lse
-                pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb),
-                             memory_space=pltpu.VMEM),     # delta
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, qb, offs: (bh, qb, 0)),  # q
+                pl.BlockSpec((1, Sk_pad, D),
+                             lambda bh, qb, offs: (bh, 0, 0)),   # k
+                pl.BlockSpec((1, Sk_pad, D),
+                             lambda bh, qb, offs: (bh, 0, 0)),   # v
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, qb, offs: (bh, qb, 0)),  # dO
+                pl.BlockSpec((1, block_q),
+                             lambda bh, qb, offs: (bh, qb)),     # lse
+                pl.BlockSpec((1, block_q),
+                             lambda bh, qb, offs: (bh, qb)),     # delta
             ],
             out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda bh, qb: (bh, qb, 0),
-                                   memory_space=pltpu.VMEM),
+                                   lambda bh, qb, offs: (bh, qb, 0)),
         ),
         interpret=interpret,
-    )(qt, kt, vt, got, lse, delta)
+    )(offs, qt, kt, vt, got, lse, delta)
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=Sq_pad,
@@ -389,31 +456,32 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
             jax.ShapeDtypeStruct((B * H, Sk_pad, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, Sk_pad, D), v.dtype),
         ],
-        grid_spec=pl.GridSpec(
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=(B * H, Sk_pad // block_k),
             in_specs=[
-                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
-                             memory_space=pltpu.VMEM),     # k
-                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
-                             memory_space=pltpu.VMEM),     # v
-                pl.BlockSpec((1, Sq_pad, D), lambda bh, kb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),     # q
-                pl.BlockSpec((1, Sq_pad, D), lambda bh, kb: (bh, 0, 0),
-                             memory_space=pltpu.VMEM),     # dO
-                pl.BlockSpec((1, Sq_pad), lambda bh, kb: (bh, 0),
-                             memory_space=pltpu.VMEM),     # lse
-                pl.BlockSpec((1, Sq_pad), lambda bh, kb: (bh, 0),
-                             memory_space=pltpu.VMEM),     # delta
+                pl.BlockSpec((1, block_k, D),
+                             lambda bh, kb, offs: (bh, kb, 0)),  # k
+                pl.BlockSpec((1, block_k, D),
+                             lambda bh, kb, offs: (bh, kb, 0)),  # v
+                pl.BlockSpec((1, Sq_pad, D),
+                             lambda bh, kb, offs: (bh, 0, 0)),   # q
+                pl.BlockSpec((1, Sq_pad, D),
+                             lambda bh, kb, offs: (bh, 0, 0)),   # dO
+                pl.BlockSpec((1, Sq_pad),
+                             lambda bh, kb, offs: (bh, 0)),      # lse
+                pl.BlockSpec((1, Sq_pad),
+                             lambda bh, kb, offs: (bh, 0)),      # delta
             ],
             out_specs=[
-                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0),
-                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, D),
+                             lambda bh, kb, offs: (bh, kb, 0)),
+                pl.BlockSpec((1, block_k, D),
+                             lambda bh, kb, offs: (bh, kb, 0)),
             ],
         ),
         interpret=interpret,
-    )(kt, vt, qt, got, lse, delta)
+    )(offs, kt, vt, qt, got, lse, delta)
 
     dq = _unfold_heads(dq, B, H, Sq)
     dk = _unfold_heads(dk, B, H, Sk)

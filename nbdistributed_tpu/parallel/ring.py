@@ -6,11 +6,29 @@ attention keeps the O(S^2) score matrix virtual: each device holds one
 sequence chunk of Q locally and streams K/V chunks around the ring via
 ``jax.lax.ppermute`` (ICI neighbor exchange), folding each visiting
 chunk into an online-softmax accumulator — so communication overlaps
-compute blockwise and peak memory stays O(S/n · S/n) per step.
+compute blockwise and peak memory stays sub-quadratic per step.
+
+GQA is native: K/V ride the ring at ``n_kv_heads`` — the hop traffic
+and the rotating VMEM/HBM footprint are ``H/Hkv``× smaller than
+pre-expanding, exactly where sequence parallelism is supposed to save
+memory.  The query heads are grouped against their KV head inside the
+local attention (grouped einsum, or the Pallas kernel's native GQA).
+
+Two inner paths:
+
+* ``use_flash=False`` (default, any backend): grouped-einsum online
+  softmax — differentiable through plain autodiff.
+* ``use_flash=True`` (the TPU path): every hop runs the Pallas flash
+  kernel (ops/attention.py) with chunk offsets for cross-chunk causal
+  masking; hop results are folded by their logsumexp.  The custom VJP
+  re-rings K/V through the blockwise Pallas backward — a ring hop is
+  just a k-block at scale, and k-blocks are independent given the
+  global (lse, delta) — so no (Sq, Sk) tensor exists in either
+  direction, per hop or globally.
 
 This is the shard_map/ppermute formulation the scaling-book recipe
-prescribes; the same math as the flash kernel's inner loop
-(ops/attention.py), lifted from k-blocks to ring hops.
+prescribes; the same math as the flash kernel's inner loop, lifted
+from k-blocks to ring hops.
 """
 
 from __future__ import annotations
@@ -26,59 +44,83 @@ _NEG_INF = -1e30
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_fn(mesh, axis: str, causal: bool, scale: float):
-    """Jitted ring kernel, cached per (mesh, axis, causal, scale) so
-    repeated training-loop calls hit the jit cache instead of retracing."""
+def _ring_fn(mesh, axis: str, causal: bool, scale: float,
+             use_flash: bool):
+    """Jitted ring kernel, cached per (mesh, axis, causal, scale, path)
+    so repeated training-loop calls hit the jit cache instead of
+    retracing."""
     n = mesh.shape[axis]
     spec = P(None, axis, None, None)
-    inner = functools.partial(_ring_inner, axis=axis, n=n, causal=causal,
-                              scale=scale)
+    if use_flash:
+        inner = _make_ring_flash(axis, n, causal, scale)
+    else:
+        inner = functools.partial(_ring_inner, axis=axis, n=n,
+                                  causal=causal, scale=scale)
     return jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = "sp",
-                   causal: bool = True, scale: float | None = None):
+                   causal: bool = True, scale: float | None = None,
+                   use_flash: bool = False):
     """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
     sequence dimension.
 
-    q/k/v: (B, S, H, D) global arrays whose S dimension is sharded over
-    ``mesh[axis]``; returns attention output with the same sharding.
-    n_kv_heads must equal n_heads here (expand GQA before sharding).
+    q: (B, S, H, D) and k/v: (B, S, Hkv, D) global arrays whose S
+    dimension is sharded over ``mesh[axis]``; returns attention output
+    with the same sharding.  ``H % Hkv == 0`` (grouped-query) — K/V are
+    NOT expanded: they circulate the ring at Hkv heads.
+    ``use_flash=True`` runs the Pallas flash kernel per hop (forward
+    and backward); the default grouped-einsum path works on any
+    backend.
     """
-    D = q.shape[-1]
+    H, D = q.shape[2], q.shape[-1]
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if v.shape[2] != Hkv:
+        raise ValueError(f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ring_fn(mesh, axis, causal, scale)(q, k, v)
+    return _ring_fn(mesh, axis, causal, scale, use_flash)(q, k, v)
 
 
 def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+    """Grouped-einsum online-softmax ring (local view inside shard_map).
+
+    q: (B, Sq, H, D) local chunk; k/v: (B, Sk, Hkv, D) rotating chunks.
+    """
     B, Sq, H, Dh = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
     my = jax.lax.axis_index(axis)
-    qf = q.astype(jnp.float32) * scale
-    acc = jnp.zeros((B, Sq, H, Dh), jnp.float32)
-    m = jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    qf = (q.astype(jnp.float32) * scale).reshape(B, Sq, Hkv, g, Dh)
+    acc = jnp.zeros((B, Sq, Hkv, g, Dh), jnp.float32)
+    m = jnp.full((B, Hkv, g, Sq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, Hkv, g, Sq, 1), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(step, carry):
         acc, m, l, k_cur, v_cur = carry
         src = (my - step) % n  # which chunk we currently hold
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+        Sk = k_cur.shape[1]
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qf,
+                       k_cur.astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         if causal:
             qi = (my * Sq
-                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0))
-            ki = (src * Sq
-                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1))
-            s = jnp.where((ki <= qi)[None, None], s, _NEG_INF)
+                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0))
+            ki = (src * Sk
+                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1))
+            s = jnp.where((ki <= qi)[None, None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                       # (B,H,Sq,Sk)
-        corr = jnp.exp(m - m_new)                    # (B,H,Sq,1)
+        p = jnp.exp(s - m_new)                       # (B,Hkv,g,Sq,Sk)
+        corr = jnp.exp(m - m_new)                    # (B,Hkv,g,Sq,1)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+        pv = jnp.einsum("bkgqs,bskd->bqkgd", p,
+                        v_cur.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
-        acc_new = acc * corr.transpose(0, 2, 1, 3) + pv
+        acc_new = acc * corr.transpose(0, 3, 1, 2, 4) + pv
         # Rotate K/V to the next device; overlapped with the next
         # step's compute by XLA's async collective scheduling.
         k_next = jax.lax.ppermute(k_cur, axis, perm)
@@ -86,5 +128,102 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
         return acc_new, m_new, l_new, k_next, v_next
 
     acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc, m, l, k, v))
-    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
-    return out.astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2, 4)
+    return out.reshape(B, Sq, H, Dh).astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# Flash (Pallas) inner path
+
+def _hop_weights(w, B, H, Sq):
+    """(B*H, Sq_pad) fold-layout weights -> (B, Sq, H, 1)."""
+    return (w.reshape(B, H, -1)[:, :, :Sq]
+            .transpose(0, 2, 1)[..., None])
+
+
+def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
+                     block_q: int = 128, block_k: int = 128):
+    """Builds the shard_map inner for the Pallas ring with exact
+    gradients: forward folds per-hop (out, lse) pairs; backward re-rings
+    K/V through the blockwise dq/dkv kernels using the saved global
+    logsumexp (hops are independent given (lse, delta), exactly like
+    k-blocks inside one kernel call)."""
+    from ..ops.attention import (_block_sizes, _flash_backward_folded,
+                                 _flash_bwd_prep, _flash_forward,
+                                 _use_interpret)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _rf_fwd(q, k, v)[0]
+
+    def _rf_fwd(q, k, v):
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        bq, bk = _block_sizes(block_q, block_k, Sq, Sk)
+        interp = _use_interpret()
+        my = jax.lax.axis_index(axis)
+        Sq_pad = -(-Sq // bq) * bq
+        O = jnp.zeros((B, Sq, H, D), jnp.float32)
+        L = jnp.full((B * H, Sq_pad), _NEG_INF, jnp.float32)
+
+        def body(step, carry):
+            O, L, k_cur, v_cur = carry
+            src = (my - step) % n
+            # step 0 is always the diagonal chunk (src == my), so L is
+            # real from the first fold and fully-masked later hops
+            # (lse ~ -inf) get weight exp(-inf - L) = 0.
+            o_j, lse_j = _flash_forward(
+                q, k_cur, v_cur, causal=causal, scale=scale,
+                block_q=bq, block_k=bk, interpret=interp,
+                offsets=(my * Sq, src * Sk))
+            L_new = jnp.logaddexp(L, lse_j)
+            w_old = _hop_weights(jnp.exp(L - L_new), B, H, Sq)
+            w_j = _hop_weights(jnp.exp(lse_j - L_new), B, H, Sq)
+            O = O * w_old + o_j.astype(jnp.float32) * w_j
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            return O, L_new, k_next, v_next
+
+        O, L, k, v = jax.lax.fori_loop(0, n, body, (O, L, k, v))
+        out = O.astype(q.dtype)
+        return out, (q, k, v, out, L)
+
+    def _rf_bwd(res, g):
+        q, k, v, out, L = res
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        bq, bk = _block_sizes(block_q, block_k, Sq, Sk)
+        interp = _use_interpret()
+        my = jax.lax.axis_index(axis)
+        # Hop-invariant work — the q/dO folds and the delta reduction —
+        # happens once, not n times (only k/v change per hop).
+        qt, got, delta = _flash_bwd_prep(q, out, g, bq)
+        dq0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+        dk0 = jnp.zeros(k.shape, jnp.float32)
+        dv0 = jnp.zeros(v.shape, jnp.float32)
+
+        def body(step, carry):
+            dq, k_cur, v_cur, dk_cur, dv_cur = carry
+            src = (my - step) % n
+            dq_j, dk_j, dv_j = _flash_backward_folded(
+                qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq, H=H,
+                q_dtype=q.dtype, causal=causal, scale=scale,
+                block_q=bq, block_k=bk, interpret=interp,
+                offsets=(my * Sq, src * Sk))
+            dq = dq + dq_j.astype(jnp.float32)
+            # dk/dv accumulators rotate WITH their chunk: after n hops
+            # every chunk has collected contributions from all devices
+            # and is back home.
+            dk_cur = dk_cur + dk_j.astype(dk_cur.dtype)
+            dv_cur = dv_cur + dv_j.astype(dv_cur.dtype)
+            rot = lambda x: jax.lax.ppermute(x, axis, perm)
+            return dq, rot(k_cur), rot(v_cur), rot(dk_cur), rot(dv_cur)
+
+        dq, _, _, dk, dv = jax.lax.fori_loop(
+            0, n, body, (dq0, k, v, dk0, dv0))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    rf.defvjp(_rf_fwd, _rf_bwd)
+    return rf

@@ -11,13 +11,17 @@ Trade-off vs ring: communication is 2 all-to-alls of the activations
 (O(B·S·H·D / n) per device, one shot each way, ideal on ICI's all-to-all
 bandwidth) instead of n ppermute hops, and the inner attention is a
 plain local kernel — so it composes directly with the Pallas flash
-kernel (ops/attention.py).  The constraint is that the head count must
-be divisible by the mesh axis size, which ring does not require.
+kernel (ops/attention.py).  The constraint is that the head counts
+(H *and* Hkv) must be divisible by the mesh axis size, which ring does
+not require.  GQA is native: K/V all-to-all at Hkv heads (H/Hkv× less
+traffic than pre-expanding), and the local attention keeps the group
+ratio.
 
-Layouts inside ``shard_map`` (local views, mesh axis size n):
+Layouts inside ``shard_map`` (local views, mesh axis size n; K/V the
+same with H -> Hkv):
 
     (B, S/n, H, D)  --all_to_all(split H, concat S)-->  (B, S, H/n, D)
-        ... full-sequence attention over H/n heads ...
+        ... full-sequence GQA attention over H/n q heads ...
     (B, S, H/n, D)  --all_to_all(split S, concat H)-->  (B, S/n, H, D)
 """
 
@@ -49,23 +53,29 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
     """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``,
     computed head-parallel after an all-to-all re-shard.
 
-    q/k/v: (B, S, H, D) global arrays, S sharded over ``mesh[axis]``;
-    returns output with the same sharding.  Requires ``H % n == 0`` and
-    equal q/kv head counts (expand GQA before sharding, as with
-    ring_attention).  ``use_flash=True`` runs the Pallas flash kernel as
-    the local attention (TPU path); default is the XLA reference.
+    q: (B, S, H, D) and k/v: (B, S, Hkv, D) global arrays, S sharded
+    over ``mesh[axis]``; returns output with the same sharding.
+    Requires ``H % n == 0`` and ``Hkv % n == 0`` — K/V are NOT
+    expanded: their all-to-alls move ``H/Hkv``× less data than
+    pre-expanding would, and the local attention runs GQA natively
+    (each device holds H/n query heads against Hkv/n KV heads, the
+    same group ratio).  ``use_flash=True`` runs the Pallas flash
+    kernel as the local attention (TPU path; forward and blockwise
+    backward); default is the XLA reference.
     """
     n = mesh.shape[axis]
-    H = q.shape[2]
-    if H % n != 0:
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % n != 0 or Hkv % n != 0:
         raise ValueError(
-            f"ulysses_attention needs head count divisible by the "
-            f"{axis!r} axis: H={H}, n={n}. Use ring_attention for "
-            "head counts that don't split.")
-    if k.shape[2] != H or v.shape[2] != H:
+            f"ulysses_attention needs both head counts divisible by "
+            f"the {axis!r} axis: H={H}, Hkv={Hkv}, n={n}. Use "
+            "ring_attention for head counts that don't split.")
+    if H % Hkv != 0:
         raise ValueError(
-            f"q/k/v head counts must match (got {H}, {k.shape[2]}, "
-            f"{v.shape[2]}); expand GQA heads before sharding.")
+            f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if v.shape[2] != Hkv:
+        raise ValueError(
+            f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
     D = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
     return _ulysses_fn(mesh, axis, causal, scale, use_flash)(q, k, v)

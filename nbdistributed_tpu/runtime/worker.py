@@ -372,6 +372,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="force a JAX platform (cpu for tests/CI)")
     args = p.parse_args(argv)
 
+    # Mask SIGINT for the whole init phase.  The HELLO (readiness
+    # signal) goes out during __init__, so a %dist_interrupt can arrive
+    # while this process is still seeding its namespace — before run()
+    # establishes the masked/unmasked interrupt discipline.  Masking
+    # here makes such an early interrupt *pending* until the first
+    # unmasked idle recv, where it aborts nothing and the loop
+    # continues — instead of killing a half-initialized worker.
+    if threading.current_thread() is threading.main_thread():
+        signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
+                                   {signal_mod.SIGINT})
+
     worker = DistributedWorker(
         rank=args.rank, world_size=args.world_size,
         coordinator_host=args.coordinator_host,

@@ -90,3 +90,41 @@ def test_generation_uses_kernel_and_matches_einsum_path(monkeypatch):
     b = generate(params, prompt, cfg_flash, max_new_tokens=8)
     assert calls, "use_flash config must route decode through the kernel"
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decode_kernel_on_tp_mesh(monkeypatch):
+    """The Pallas decode kernel runs under GSPMD on a 4-way tp mesh
+    (shard_map over batch/dp and heads/tp): tokens must match the
+    einsum mesh path exactly, and the spy pins the kernel routing."""
+    from nbdistributed_tpu.models import generate, init_params, tiny_config
+    from nbdistributed_tpu.models.transformer import param_shardings
+    from nbdistributed_tpu.ops import decode as decode_mod
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.parallel import tensor_parallel
+
+    calls = []
+    real = decode_mod.flash_decode_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(decode_mod, "flash_decode_attention", spy)
+
+    mesh = mesh_mod.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    base = tiny_config(dtype=jnp.float32, use_flash=False)
+    mk = lambda flash: type(base)(**{**base.__dict__,
+                                     "n_heads": 8, "n_kv_heads": 4,
+                                     "use_flash": flash})
+    cfg_ein, cfg_flash = mk(False), mk(True)
+    params = tensor_parallel.apply_shardings(
+        init_params(jax.random.PRNGKey(0), cfg_ein), mesh,
+        param_shardings(cfg_ein))
+    prompt = jnp.array([[5, 9, 2], [7, 1, 3]], jnp.int32)
+
+    te = generate(params, prompt, cfg_ein, max_new_tokens=10, mesh=mesh)
+    assert not calls, "einsum path must not touch the kernel"
+    tf = generate(params, prompt, cfg_flash, max_new_tokens=10,
+                  mesh=mesh)
+    assert calls, "flash path must route through the Pallas kernel"
+    np.testing.assert_array_equal(np.asarray(te), np.asarray(tf))

@@ -52,3 +52,57 @@ def test_clear():
     tl.clear()
     assert tl.records == []
     assert "no distributed cells" in tl.summary()
+
+
+def test_record_local_and_debug_dump():
+    tl = Timeline()
+    tl.record_local("x = 1", started_at=123.0, wall_s=0.002)
+    tl.record_local("boom()", started_at=124.0, wall_s=0.001, ok=False)
+    assert [r.kind for r in tl.records] == ["local", "local"]
+    assert tl.records[1].rank_status == {-1: "error"}
+    dump = tl.debug_dump()
+    assert "2 records" in dump and "boom()" in dump
+
+
+def test_hooks_record_every_cell(capsys):
+    """The IPython pre/post_run_cell hooks give the timeline full-
+    session coverage: local cells get kind="local" records, cells that
+    produced a distributed record are not double-counted, and the hooks
+    unregister cleanly (reference: magic.py:123-130, 647-707)."""
+    from IPython import get_ipython
+    from IPython.testing.globalipapp import start_ipython
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    # start_ipython() only returns the shell on its *first* call in a
+    # process; later callers (e.g. after the magics e2e suite) get None.
+    shell = start_ipython() or get_ipython()
+    shell.run_line_magic("load_ext", "nbdistributed_tpu")
+    try:
+        tl = DistributedMagics._timeline
+        tl.clear()
+        shell.run_cell("x_local = 41 + 1")
+        assert [r.kind for r in tl.records] == ["local"]
+        assert "x_local" in tl.records[0].code
+        # A cell that created a distributed record must not also add a
+        # local one (the distributed record is the richer of the two).
+        shell.run_cell(
+            "from nbdistributed_tpu.magics.magic import "
+            "DistributedMagics as _D\n"
+            "_r = _D._timeline.start('fake', [0])\n"
+            "_D._timeline.finish(_r, None)")
+        assert [r.kind for r in tl.records] == ["local", "distributed"]
+        # Failed local cells record an error status.
+        shell.run_cell("raise ValueError('nope')")
+        assert tl.records[-1].kind == "local"
+        assert tl.records[-1].rank_status == {-1: "error"}
+        # %timeline_debug prints raw internals including local cells.
+        capsys.readouterr()
+        shell.run_line_magic("timeline_debug", "")
+        out = capsys.readouterr().out
+        assert "x_local" in out and '"kind": "local"' in out
+    finally:
+        DistributedMagics.unregister_cell_hooks()
+    n = len(tl.records)
+    shell.run_cell("y_after = 1")
+    assert len(tl.records) == n, "hooks must be gone after unregister"

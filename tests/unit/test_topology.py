@@ -1,0 +1,100 @@
+"""Pre-spawn resource validation + bring-up timeout diagnostics.
+
+The reference validates its GPU-id list against torch.cuda before any
+spawn (reference: magic.py:454-488); these tests cover the TPU analog
+(chip-count probe vs the requested topology) and the elapsed/budget
+timeout message (a 240 s wait once reported "did not attach within 2s"
+— the poll interval)."""
+
+import pytest
+
+from nbdistributed_tpu.manager import topology
+from nbdistributed_tpu.manager.process_manager import wait_until_ready
+
+
+def test_available_chips_from_axon_pool(monkeypatch):
+    monkeypatch.setattr(
+        "glob.glob", lambda pat: [])
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert topology.available_tpu_chips() == 1
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1,10.0.0.2, ")
+    assert topology.available_tpu_chips() == 2
+
+
+def test_available_chips_from_device_nodes(monkeypatch):
+    monkeypatch.setattr(
+        "glob.glob",
+        lambda pat: [f"/dev/accel{i}" for i in range(4)]
+        if "accel" in pat else [])
+    assert topology.available_tpu_chips() == 4
+
+
+def test_available_chips_unknown(monkeypatch):
+    monkeypatch.setattr("glob.glob", lambda pat: [])
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert topology.available_tpu_chips() is None
+
+
+def test_validate_rejects_oversubscription(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 1)
+    with pytest.raises(ValueError) as e:
+        topology.validate_tpu_request(8, 1)
+    msg = str(e.value)
+    assert "8" in msg and "has 1" in msg and "-n 1" in msg
+
+
+def test_validate_accounts_chips_per_worker(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 4)
+    with pytest.raises(ValueError, match="= 8 TPU chips"):
+        topology.validate_tpu_request(2, 4)
+    topology.validate_tpu_request(1, 4)  # fits: no raise
+
+
+def test_validate_passes_when_unknown(monkeypatch):
+    """No probe signal -> trust the user (workers will report)."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: None)
+    topology.validate_tpu_request(8, 1)
+
+
+def test_validate_rejects_unsupported_grid(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    with pytest.raises(ValueError, match="unsupported"):
+        topology.validate_tpu_request(3, 1)
+
+
+def test_start_workers_tpu_fails_fast_before_spawn(monkeypatch):
+    """%dist_init -n 8 on a 1-chip host must fail in <1s with an
+    actionable message and zero children spawned."""
+    from nbdistributed_tpu.manager import ProcessManager
+
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 1)
+    pm = ProcessManager()
+    with pytest.raises(ValueError, match="host has 1"):
+        pm.start_workers(8, 55555, backend="tpu")
+    assert not pm.processes
+
+
+class _FakeComm:
+    num_workers = 4
+
+    def connected_ranks(self):
+        return [0, 2]
+
+    def wait_for_workers(self, timeout):
+        import time
+        time.sleep(min(timeout, 0.01))
+        raise TimeoutError(f"within {timeout:.0f}s")  # inner message
+
+
+class _FakePM:
+    def check_startup_failure(self):
+        pass
+
+
+def test_wait_until_ready_reports_elapsed_and_budget():
+    with pytest.raises(TimeoutError) as e:
+        wait_until_ready(_FakeComm(), _FakePM(), 0.05, poll_s=0.01)
+    msg = str(e.value)
+    assert "budget 0s" in msg or "budget" in msg
+    assert "[1, 3]" in msg, f"should name missing ranks: {msg}"
+    assert "within 0s" in msg  # elapsed, not the 0.01s poll interval
