@@ -49,6 +49,7 @@ Every cell now runs on ALL workers. Namespace on each worker:
   make_mesh, shard_batch, ring_attention, ulysses_attention,
   pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
                        — mesh/SP/PP/EP building blocks
+  load_hf_pretrained   — HF Llama-family checkpoint → JAX pytree
 
 Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·
@@ -280,8 +281,22 @@ class DistributedMagics(Magics):
                 print(f"❌ {e}")
                 return
             num_workers = sum(h.workers for h in host_specs)
+        # Remote hosts must be able to dial the control plane: bind all
+        # interfaces when the plan leaves this machine (default stays
+        # loopback-only) — and require a per-cluster shared secret on
+        # that bind: this port executes code, so an unauthenticated
+        # non-loopback listener would be remote code execution for
+        # anyone who can reach it.
+        bind_host, auth_token = "127.0.0.1", None
+        if host_specs is not None and any(h.host != "local"
+                                          for h in host_specs):
+            import secrets
+            bind_host = "0.0.0.0"
+            auth_token = secrets.token_hex(16)
         comm = CommunicationManager(num_workers=num_workers,
-                                    timeout=args.timeout)
+                                    host=bind_host,
+                                    timeout=args.timeout,
+                                    auth_token=auth_token)
         pm = ProcessManager()
         pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
         pm.add_death_callback(self._announce_death)
@@ -294,7 +309,7 @@ class DistributedMagics(Magics):
                 pm.start_workers_multihost(
                     host_specs, comm.port,
                     coordinator_host=args.coordinator_addr,
-                    backend=args.backend)
+                    backend=args.backend, auth_token=auth_token)
             else:
                 pm.start_workers(num_workers, comm.port,
                                  backend=args.backend,

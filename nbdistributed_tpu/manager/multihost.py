@@ -118,8 +118,15 @@ def make_launch_plan(hosts: list[HostSpec], *, coordinator_host: str,
                          "--dist-host", dist_host]
             env: dict[str, str] = {}
             if backend == "cpu":
+                # Deterministic worker env regardless of what the
+                # remote login shell (or, via the ssh proxy in tests,
+                # the coordinator) exports: exactly one CPU device per
+                # process, gloo across processes, no accelerator
+                # plugin.  Empty string = unset for all three.
                 env = {"JAX_PLATFORMS": "cpu",
-                       "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo"}
+                       "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+                       "XLA_FLAGS": "",
+                       "PALLAS_AXON_POOL_IPS": ""}
             # backend == "tpu", one worker per host: no carving env —
             # the worker owns every local chip and jax.distributed
             # handles cross-host wiring.
@@ -137,6 +144,13 @@ def ssh_argv(launch: WorkerLaunch, *, ssh: str = "ssh",
     ``exec env K=V ... python -m ...`` under ssh, so killing the local
     ssh process signals the remote worker (ssh forwards the session
     teardown) and remote stdio streams back through the proxy's pipe.
+
+    Caveat: the env rides the remote command line, so values (including
+    NBD_AUTH_TOKEN, the control-plane shared secret) are visible to
+    `ps` on the remote host for the worker's lifetime.  The token only
+    gates the coordinator's listener — acceptable on single-tenant
+    workers; shared remote hosts want an ssh-config-level SendEnv
+    channel instead.
     """
     remote = "exec env " + " ".join(
         f"{k}={shlex.quote(v)}" for k, v in launch.env)

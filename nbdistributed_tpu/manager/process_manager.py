@@ -152,7 +152,8 @@ class ProcessManager:
     def start_workers_multihost(self, hosts, control_port: int, *,
                                 coordinator_host: str,
                                 backend: str = "auto",
-                                ssh: str = "ssh") -> int:
+                                ssh: str = "ssh",
+                                auth_token: str | None = None) -> int:
         """Launch workers across hosts per a
         :func:`~nbdistributed_tpu.manager.multihost.make_launch_plan`.
 
@@ -176,6 +177,16 @@ class ProcessManager:
             specs, coordinator_host=coordinator_host,
             control_port=control_port, dist_port=self.dist_port,
             backend=backend)
+        if auth_token:
+            # Ship the control-plane shared secret in every worker's
+            # env (rides the ssh remote command for remote entries —
+            # visible to local `ps` on that host; see multihost.ssh_argv).
+            import dataclasses as _dc
+            plan = [_dc.replace(
+                l, env=tuple(sorted({**dict(l.env),
+                                     "NBD_AUTH_TOKEN": auth_token}
+                                    .items())))
+                for l in plan]
         for launch in plan:
             if launch.host == "local":
                 # Direct spawn: local base env (incl. the cpu backend's

@@ -50,13 +50,15 @@ class CommunicationManager:
 
     def __init__(self, num_workers: int, *, host: str = "127.0.0.1",
                  port: int = 0, timeout: float | None = None,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = True, auth_token: str | None = None):
         self.num_workers = num_workers
         self.default_timeout = timeout  # None = wait forever (training mode)
+        self.auth_token = auth_token
         # Native C++ listener when built (see messaging/native.py), the
         # pure-Python selector listener otherwise — same protocol.
         self._listener = make_listener(host=host, port=port,
-                                       allow_pickle=allow_pickle)
+                                       allow_pickle=allow_pickle,
+                                       auth_token=auth_token)
         self.port = self._listener.port
         self._lock = threading.Lock()
         self._pending: dict[str, _Pending] = {}

@@ -193,10 +193,17 @@ class NativeCoordinatorListener:
 
 
 def make_listener(host: str = "127.0.0.1", port: int = 0, *,
-                  allow_pickle: bool = True):
-    """Listener factory honoring NBD_NATIVE (see module docstring)."""
-    if available():
+                  allow_pickle: bool = True, auth_token: str | None = None):
+    """Listener factory honoring NBD_NATIVE (see module docstring).
+
+    Auth-token worlds always use the Python listener: the C++ listener
+    does not implement the shared-secret handshake, and silently
+    accepting unauthenticated peers on a non-loopback bind would defeat
+    the token's purpose.
+    """
+    if available() and auth_token is None:
         return NativeCoordinatorListener(host, port,
                                          allow_pickle=allow_pickle)
     from .transport import CoordinatorListener
-    return CoordinatorListener(host, port, allow_pickle=allow_pickle)
+    return CoordinatorListener(host, port, allow_pickle=allow_pickle,
+                               auth_token=auth_token)

@@ -69,6 +69,7 @@ class _ConnState:
         self.rbuf = bytearray()
         self.wlock = threading.Lock()
         self.rank: int | None = None  # set after the preamble
+        self.registered = False       # preamble (+ auth if required) done
 
     def send_frame(self, frame: bytes) -> None:
         """Write the whole frame even on a non-blocking socket.
@@ -118,8 +119,15 @@ class CoordinatorListener:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = True, auth_token: str | None = None):
         self._allow_pickle = allow_pickle
+        # Shared-secret handshake: when set, a connection is not
+        # registered (and no frame reaches on_message) until its first
+        # frame is a valid {"type": "auth", "data": {"token": ...}} —
+        # decoded with pickle force-disabled, so an unauthenticated
+        # peer can never reach the pickle path.  Required for non-
+        # loopback binds (multihost): the control plane executes code.
+        self._auth_token = auth_token
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -236,37 +244,60 @@ class CoordinatorListener:
         if not data:
             self._drop(conn, unidentified)
             return
-        was_unidentified = conn.rank is None
         try:
             frames = conn.feed(data)
         except CodecError:
             self._drop(conn, unidentified)
             return
-        if was_unidentified and conn.rank is not None:
-            unidentified.pop(conn.sock, None)
-            with self._lock:
-                old = self._conns.get(conn.rank)
-                self._conns[conn.rank] = conn
-            if old is not None:
-                # Replaced by a reconnect: detach the stale socket from
-                # the selector too, and mark it non-current so a late
-                # EOF on it does not fire on_disconnect for a live rank.
-                old.rank = None
+        if conn.rank is not None and not conn.registered:
+            if self._auth_token is not None:
+                if not frames:
+                    return  # preamble seen; wait for the auth frame
+                first = frames.pop(0)
                 try:
-                    self._sel.unregister(old.sock)
-                except (KeyError, ValueError):
-                    pass
-                try:
-                    old.sock.close()
-                except OSError:
-                    pass
-            self.on_connect(conn.rank)
+                    # Pickle force-disabled pre-auth: an untrusted peer
+                    # must never reach the pickle decoder.
+                    msg = decode(first, allow_pickle=False)
+                except CodecError:
+                    self._drop(conn, unidentified)
+                    return
+                import hmac
+                token = ""
+                if msg.msg_type == "auth" and isinstance(msg.data, dict):
+                    token = str(msg.data.get("token", ""))
+                if not hmac.compare_digest(token, self._auth_token):
+                    self._drop(conn, unidentified)
+                    return
+            self._register(conn, unidentified)
+        if not conn.registered:
+            return
         for frame in frames:
             try:
                 msg = decode(frame, allow_pickle=self._allow_pickle)
             except CodecError:
                 continue
             self.on_message(conn.rank, msg)
+
+    def _register(self, conn: "_ConnState", unidentified: dict) -> None:
+        conn.registered = True
+        unidentified.pop(conn.sock, None)
+        with self._lock:
+            old = self._conns.get(conn.rank)
+            self._conns[conn.rank] = conn
+        if old is not None:
+            # Replaced by a reconnect: detach the stale socket from
+            # the selector too, and mark it non-current so a late
+            # EOF on it does not fire on_disconnect for a live rank.
+            old.rank = None
+            try:
+                self._sel.unregister(old.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                old.sock.close()
+            except OSError:
+                pass
+        self.on_connect(conn.rank)
 
     def _drop(self, conn: _ConnState, unidentified: dict) -> None:
         try:
@@ -301,7 +332,8 @@ class WorkerChannel:
     """
 
     def __init__(self, host: str, port: int, rank: int, *,
-                 allow_pickle: bool = True, connect_timeout: float = 30.0):
+                 allow_pickle: bool = True, connect_timeout: float = 30.0,
+                 auth_token: str | None = None):
         self.rank = rank
         self._allow_pickle = allow_pickle
         self._sock = socket.create_connection((host, port),
@@ -312,6 +344,11 @@ class WorkerChannel:
         self._rbuf = bytearray()
         with self._wlock:
             self._sock.sendall(make_preamble(rank))
+        if auth_token is not None:
+            # First frame after the preamble: the shared-secret auth
+            # the coordinator requires on non-loopback binds.
+            self.send(Message(msg_type="auth",
+                              data={"token": auth_token}, rank=rank))
 
     def send(self, msg: Message) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)

@@ -4,6 +4,7 @@ configs)."""
 
 from .generate import (forward_with_cache, generate, init_kv_cache,
                        kv_cache_shardings, make_generate_fn)
+from .hf import config_from_hf, load_hf_pretrained, params_from_hf
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
@@ -18,4 +19,5 @@ __all__ = ["TransformerConfig", "forward", "init_params",
            "moe_forward", "moe_loss_fn", "moe_model_shardings",
            "tiny_moe_config",
            "forward_with_cache", "generate", "init_kv_cache",
-           "kv_cache_shardings", "make_generate_fn"]
+           "kv_cache_shardings", "make_generate_fn",
+           "config_from_hf", "load_hf_pretrained", "params_from_hf"]

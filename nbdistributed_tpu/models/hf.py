@@ -1,0 +1,156 @@
+"""HuggingFace interop: load Llama-family checkpoints into this
+framework's transformer.
+
+The reference's whole demo workflow is HF-centric (its notebook loads
+SmolLM2-135M with ``transformers`` and trains it through Accelerate —
+reference: 00_accelerate.ipynb cells 10, 28), so a user switching to
+this framework needs their HF checkpoints to come along.  This module
+converts any Llama-architecture ``transformers`` model (Llama 1/2/3,
+SmolLM2, TinyLlama, ...) into the layer-stacked pytree that
+:func:`~nbdistributed_tpu.models.transformer.forward` consumes — after
+which every TPU path here applies: tp/dp sharding via
+:func:`param_shardings`, flash attention, the KV-cache generate loop,
+checkpointing.
+
+Conventions verified against ``transformers`` (tests/unit/test_hf.py
+checks logits parity against the torch forward):
+
+* torch ``nn.Linear`` stores (out_features, in_features); our params
+  right-multiply, so every projection transposes.
+* Head ordering: HF's q/k/v rows are [head0 x Dh, head1 x Dh, ...] —
+  transposing preserves our ``reshape(B, S, H, Dh)`` grouping.
+* RoPE: HF's rotate-half with cos/sin repeated over both halves is
+  algebraically identical to our half-split form (same
+  theta^(-2i/head_dim) frequencies).
+* ``tie_word_embeddings`` (SmolLM2 does) -> ``lm_head = embed.T``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+
+def config_from_hf(hf_config) -> TransformerConfig:
+    """Map a ``transformers`` Llama-family config onto
+    :class:`TransformerConfig`.  Rejects rope-scaling variants this
+    forward does not implement rather than silently mis-rotating."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        rope_type = (scaling.get("rope_type")
+                     or scaling.get("type") or "?")
+        if rope_type != "default":
+            raise ValueError(
+                f"rope_scaling type {rope_type!r} is not supported "
+                "(plain rotary only); use a base-rope checkpoint")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError("attention_bias=True checkpoints are not "
+                         "supported (Llama family uses bias-free "
+                         "projections)")
+    if getattr(hf_config, "mlp_bias", False):
+        raise ValueError("mlp_bias=True checkpoints are not supported")
+    head_dim = getattr(hf_config, "head_dim", None)
+    expect = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim is not None and head_dim != expect:
+        raise ValueError(
+            f"head_dim {head_dim} != hidden_size/n_heads {expect}: "
+            "decoupled head_dim is not supported")
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+    )
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor (any dtype/device) -> float32 numpy."""
+    return t.detach().to("cpu").float().numpy()
+
+
+def params_from_hf(model, cfg: TransformerConfig | None = None, *,
+                   dtype: Any = jnp.bfloat16) -> tuple[dict, Any]:
+    """Convert a ``transformers`` ``LlamaForCausalLM``-shaped model (or
+    anything with the same ``state_dict()`` naming) into this
+    framework's pytree.
+
+    Returns ``(params, cfg)`` with weights cast to ``dtype`` (norms
+    stay fp32, matching :func:`init_params`).  The conversion stacks
+    per-layer tensors along a leading (n_layers,) axis for the
+    ``lax.scan`` forward.
+    """
+    if cfg is None:
+        cfg = config_from_hf(model.config)
+    cfg = TransformerConfig(**{**cfg.__dict__, "dtype": dtype})
+    sd = model.state_dict()
+    L = cfg.n_layers
+
+    def linear(name: str) -> np.ndarray:
+        # (out, in) torch layout -> (in, out) right-multiply layout.
+        return _np(sd[name]).T
+
+    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        arrs = [linear(fmt.format(i)) if transpose
+                else _np(sd[fmt.format(i)]) for i in range(L)]
+        return jnp.asarray(np.stack(arrs))
+
+    embed = _np(sd["model.embed_tokens.weight"])          # (V, D)
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T             # (D, V)
+    else:
+        lm_head = embed.T                                  # tied
+    params = {
+        "embed": jnp.asarray(embed, dtype),
+        "layers": {
+            "attn_norm": stack(
+                "model.layers.{}.input_layernorm.weight", False
+            ).astype(jnp.float32),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight",
+                        True).astype(dtype),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight",
+                        True).astype(dtype),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight",
+                        True).astype(dtype),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight",
+                        True).astype(dtype),
+            "mlp_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight", False
+            ).astype(jnp.float32),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight",
+                            True).astype(dtype),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight",
+                          True).astype(dtype),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight",
+                            True).astype(dtype),
+        },
+        "final_norm": jnp.asarray(_np(sd["model.norm.weight"]),
+                                  jnp.float32),
+        "lm_head": jnp.asarray(lm_head, dtype),
+    }
+    return params, cfg
+
+
+def load_hf_pretrained(name_or_path: str, *,
+                       dtype: Any = jnp.bfloat16) -> tuple[dict, Any]:
+    """``from_pretrained`` (local path or cached hub name, torch CPU)
+    -> (params, cfg).  The heavyweight torch model is freed before
+    returning."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        name_or_path, dtype=torch.float32, low_cpu_mem_usage=True)
+    try:
+        return params_from_hf(model, dtype=dtype)
+    finally:
+        del model

@@ -43,6 +43,12 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     use_flash: bool = True
+    # Rematerialize each layer in the backward pass (jax.checkpoint):
+    # activation memory drops from O(L·S·D) to O(S·D) + one extra
+    # forward of compute — the standard long-context training trade on
+    # HBM-bound TPUs.  Composes with sequence parallelism (ring/Ulysses
+    # shard S; remat shrinks the per-layer residual footprint).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -189,10 +195,15 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
 
-    def layer_step(x, layer):
+    def one_layer(x, layer):
         x = _attention_block(x, layer, cfg, positions)
-        x = _mlp_block(x, layer, cfg)
-        return x, None
+        return _mlp_block(x, layer, cfg)
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer)
+
+    def layer_step(x, layer):
+        return one_layer(x, layer), None
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)

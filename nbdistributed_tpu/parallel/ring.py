@@ -8,11 +8,14 @@ sequence chunk of Q locally and streams K/V chunks around the ring via
 chunk into an online-softmax accumulator — so communication overlaps
 compute blockwise and peak memory stays sub-quadratic per step.
 
-GQA is native: K/V ride the ring at ``n_kv_heads`` — the hop traffic
-and the rotating VMEM/HBM footprint are ``H/Hkv``× smaller than
-pre-expanding, exactly where sequence parallelism is supposed to save
-memory.  The query heads are grouped against their KV head inside the
-local attention (grouped einsum, or the Pallas kernel's native GQA).
+GQA is native on the wire: K/V ride the ring at ``n_kv_heads`` — the
+hop (ppermute) traffic is ``H/Hkv``× smaller than pre-expanding.  The
+einsum path also computes GQA natively (grouped einsum, no expanded
+K/V anywhere); the flash path currently expands the *visiting* chunk
+to H heads inside each per-hop kernel call (a local HBM copy of the
+(B, S/n, Hkv, D) chunk, group× — small relative to Q/O at long S/n,
+but not free; a kv-head-grid kernel like ops/decode.py's would remove
+it).
 
 Two inner paths:
 

@@ -24,6 +24,7 @@ Startup order (deliberate, SURVEY §7 "hard parts"):
 from __future__ import annotations
 
 import argparse
+import os
 import signal as signal_mod
 import sys
 import threading
@@ -32,6 +33,13 @@ import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
 from . import executor, introspect
+
+
+def _load_hf_pretrained_lazy(name_or_path, **kw):
+    """Seeded-namespace shim: defers the heavyweight torch/transformers
+    import to the first call (workers must start fast)."""
+    from ..models.hf import load_hf_pretrained
+    return load_hf_pretrained(name_or_path, **kw)
 
 HEARTBEAT_INTERVAL_S = 2.0
 
@@ -75,8 +83,11 @@ class DistributedWorker:
         self._seed_namespace()
 
         # --- control plane (reference: worker.py:154-157) ----------------
-        self.channel = WorkerChannel(coordinator_host, control_port,
-                                     rank=rank)
+        # NBD_AUTH_TOKEN: shared secret required by non-loopback
+        # coordinators (multihost); shipped via the worker env.
+        self.channel = WorkerChannel(
+            coordinator_host, control_port, rank=rank,
+            auth_token=os.environ.get("NBD_AUTH_TOKEN") or None)
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            name="nbd-heartbeat", daemon=True)
         self._hb_thread.start()
@@ -125,6 +136,7 @@ class DistributedWorker:
             "shard_stage_params": pipeline.shard_stage_params,
             "moe_ffn": expert.moe_ffn,
             "init_moe_params": expert.init_moe_params,
+            "load_hf_pretrained": _load_hf_pretrained_lazy,
             "__rank__": self.rank,
             "__world_size__": self.world_size,
             "__builtins__": __builtins__,

@@ -193,3 +193,28 @@ def test_gradient_accumulation_rejects_indivisible():
     tokens = jnp.zeros((8, 17), jnp.int32)  # 8 % 3 != 0
     with pytest.raises(ValueError, match="not divisible"):
         step(p, s, mesh_mod.shard_batch({"tokens": tokens}, mesh))
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint changes memory, never math: loss and grads must
+    be bitwise-comparable between remat on/off (fp32, same inputs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nbdistributed_tpu.models import init_params, loss_fn, tiny_config
+
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    cfg_r = type(cfg)(**{**cfg.__dict__, "remat": True})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    l0, g0 = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)

@@ -106,3 +106,97 @@ def test_concurrent_sends_no_interleave(listener):
     seen = {(m.data["tid"], m.data["i"]) for _, m in listener.received}
     assert len(seen) == n_threads * per
     ch.close()
+
+
+class TestAuthToken:
+    """Shared-secret handshake for non-loopback binds: the control
+    plane executes code, so nothing may reach dispatch — least of all
+    the pickle decoder — before the token is verified."""
+
+    def _listener(self, token):
+        from nbdistributed_tpu.messaging.transport import (
+            CoordinatorListener)
+        lis = CoordinatorListener("127.0.0.1", 0, auth_token=token)
+        connected, messages = [], []
+        lis.on_connect = connected.append
+        lis.on_message = lambda r, m: messages.append((r, m))
+        lis.start()
+        return lis, connected, messages
+
+    def test_correct_token_attaches_and_routes(self):
+        from nbdistributed_tpu.messaging.transport import (Message,
+                                                           WorkerChannel)
+        lis, connected, messages = self._listener("sekrit")
+        try:
+            ch = WorkerChannel("127.0.0.1", lis.port, rank=0,
+                               auth_token="sekrit")
+            ch.send(Message(msg_type="hello", data={"x": 1}, rank=0))
+            deadline = time.time() + 5
+            while time.time() < deadline and not messages:
+                time.sleep(0.01)
+            assert connected == [0]
+            assert messages and messages[0][1].msg_type == "hello"
+            ch.close()
+        finally:
+            lis.close()
+
+    @pytest.mark.parametrize("token", [None, "wrong"])
+    def test_missing_or_wrong_token_never_attaches(self, token):
+        import socket as socket_mod
+
+        from nbdistributed_tpu.messaging.transport import (Message,
+                                                           WorkerChannel)
+        lis, connected, messages = self._listener("sekrit")
+        try:
+            try:
+                ch = WorkerChannel("127.0.0.1", lis.port, rank=0,
+                                   auth_token=token)
+                ch.send(Message(msg_type="execute", data="1+1", rank=0))
+            except (OSError, socket_mod.error):
+                pass  # coordinator may close the socket mid-send
+            time.sleep(0.5)
+            assert connected == []
+            assert messages == []
+        finally:
+            lis.close()
+
+    def test_pickle_never_deserialized_before_auth(self, tmp_path):
+        """A malicious peer sends a pickle-encoded frame as its first
+        message; the payload's __reduce__ would create a file.  The
+        pre-auth decode path must refuse pickle entirely."""
+        import socket as socket_mod
+        import struct
+
+        from nbdistributed_tpu.messaging.transport import make_preamble
+
+        marker = tmp_path / "pwned"
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(marker), "w"))
+
+        import pickle
+
+        evil = pickle.dumps(Evil())
+        header = {
+            "id": "x" * 32, "type": "auth", "rank": 0, "ts": 0.0,
+            "enc": "pickle",
+            "bufs": [{"name": "__pickle__", "kind": "bytes",
+                      "dtype": "", "shape": [], "len": len(evil)}],
+        }
+        import json as json_mod
+        hb = json_mod.dumps(header).encode()
+        frame = (struct.pack("<4sIQ", b"NBD1", len(hb), len(evil))
+                 + hb + evil)
+
+        lis, connected, messages = self._listener("sekrit")
+        try:
+            s = socket_mod.create_connection(("127.0.0.1", lis.port),
+                                             timeout=5)
+            s.sendall(make_preamble(0) + frame)
+            time.sleep(0.5)
+            assert not marker.exists(), "pickle ran before auth!"
+            assert connected == [] and messages == []
+            s.close()
+        finally:
+            lis.close()
