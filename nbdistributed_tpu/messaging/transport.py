@@ -232,7 +232,16 @@ class CoordinatorListener:
                     unidentified[sock] = st
                     self._sel.register(sock, selectors.EVENT_READ, ("conn", st))
                 else:
-                    self._service(conn, unidentified)
+                    # One misbehaving connection must never kill the
+                    # selector thread (that would deafen the whole
+                    # control plane): any unexpected error drops just
+                    # that connection.
+                    try:
+                        self._service(conn, unidentified)
+                    except Exception:
+                        import traceback as _tb
+                        _tb.print_exc()
+                        self._drop(conn, unidentified)
 
     def _service(self, conn: _ConnState, unidentified: dict) -> None:
         try:
@@ -265,7 +274,11 @@ class CoordinatorListener:
                 token = ""
                 if msg.msg_type == "auth" and isinstance(msg.data, dict):
                     token = str(msg.data.get("token", ""))
-                if not hmac.compare_digest(token, self._auth_token):
+                # Compare as bytes: compare_digest raises TypeError on
+                # non-ASCII *str* inputs — an attacker-reachable crash.
+                if not hmac.compare_digest(
+                        token.encode("utf-8", "surrogatepass"),
+                        self._auth_token.encode("utf-8")):
                     self._drop(conn, unidentified)
                     return
             self._register(conn, unidentified)

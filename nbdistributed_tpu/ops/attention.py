@@ -105,8 +105,13 @@ def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                   block_k: int, seq_k: int, seq_k_valid: int,
                   causal: bool, scale: float, block_q: int):
-    """One (batch*head, q-block) program: stream K/V blocks with the
+    """One (batch*kv-head, q-block) program: stream K/V blocks with the
     online-softmax recurrence (running max m, normalizer l, accumulator).
+
+    GQA is native: the program's q block carries all ``group = H/Hkv``
+    query heads sharing this KV head as a leading batch dim — K/V are
+    staged once per group (never expanded to H heads), and every matmul
+    is a batched ``dot_general`` over that dim.
 
     ``seq_k`` is the (block-padded) buffer length; ``seq_k_valid`` the
     real key count — keys at or beyond it are masked out, so inputs of
@@ -123,13 +128,14 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     """
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
+    q = q_ref[0].astype(jnp.float32) * scale          # (G, Bq, D)
+    G, _, D = q.shape
     q_idx = pl.program_id(1)
     q_off, k_off = offs_ref[0], offs_ref[1]
 
-    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((G, block_q, D), jnp.float32)
+    m = jnp.full((G, block_q, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((G, block_q, 1), jnp.float32)
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
@@ -145,36 +151,58 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (Bq, Bk)
+            q, k_blk, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
         if causal or mask_keys:
             keep = _keep_mask(q_idx, kb, block_q=block_q,
                               block_k=block_k, q_off=q_off, k_off=k_off,
                               seq_k_valid=seq_k_valid, causal=causal)
-            s = jnp.where(keep, s, _NEG_INF)
+            s = jnp.where(keep[None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                        # (Bq, Bk)
+        p = jnp.exp(s - m_new)                        # (G, Bq, Bk)
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * correction + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p, v_blk, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, Bq, D)
         return acc_new, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(0, num_iters, body, (acc, m, l))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0] = (m + jnp.log(l_safe))[..., 0]
 
 
 def _fold_heads(x, S_pad):
-    """(B, S, H, D) → (B*H, S_pad, D), zero-padding the seq axis.  The
-    per-(batch, head) layout gives every kernel program contiguous
-    (seq, head_dim) MXU tiles."""
+    """(B, S, Hkv, D) → (B*Hkv, S_pad, D), zero-padding the seq axis.
+    The per-(batch, kv-head) layout gives every kernel program
+    contiguous (seq, head_dim) MXU tiles."""
     B, S, H, D = x.shape
     if S_pad != S:
         x = jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
     return x.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+
+
+def _fold_q_gqa(x, Hkv: int, S_pad: int):
+    """(B, S, H, D) → (B*Hkv, group, S_pad, D): query heads grouped
+    under the KV head they attend (head h ↔ kv head h // group), so a
+    kernel program over (batch, kv-head) sees its whole group as a
+    leading batch dim."""
+    B, S, H, D = x.shape
+    group = H // Hkv
+    if S_pad != S:
+        x = jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+    return (x.reshape(B, S_pad, Hkv, group, D)
+            .transpose(0, 2, 3, 1, 4)
+            .reshape(B * Hkv, group, S_pad, D))
+
+
+def _unfold_q_gqa(x, B, Hkv, S):
+    """(B*Hkv, group, S_pad, D) → (B, S, H, D), dropping seq padding."""
+    _, group, S_pad, D = x.shape
+    return (x.reshape(B, Hkv, group, S_pad, D)
+            .transpose(0, 3, 1, 2, 4)
+            .reshape(B, S_pad, Hkv * group, D)[:, :S])
 
 
 def _unfold_heads(x, B, H, S):
@@ -194,8 +222,10 @@ def _offsets_array(offsets):
 def _flash_forward(q, k, v, *, causal: bool, scale: float,
                    block_q: int, block_k: int, interpret: bool,
                    offsets=None):
-    """Returns (out (B,Sq,H,D), lse (B*H, Sq_pad) float32).
+    """Returns (out (B,Sq,H,D), lse (B*Hkv, group, Sq_pad) float32).
 
+    K/V are staged at their native Hkv heads — the GQA group rides the
+    q block as a batch dim, so no repeated-KV buffer ever exists.
     ``offsets`` — optional (q_offset, k_offset) traced scalars giving
     the global position of row 0 of q and of k/v, for chunk-of-a-
     larger-sequence calls (ring attention).
@@ -213,44 +243,41 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
     Sq_pad = -(-Sq // block_q) * block_q
     Sk_pad = -(-Sk // block_k) * block_k
 
-    qt = _fold_heads(q, Sq_pad)
-    if group > 1:
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-    kt = _fold_heads(k, Sk_pad)
+    qt = _fold_q_gqa(q, Hkv, Sq_pad)      # (B*Hkv, G, Sq_pad, D)
+    kt = _fold_heads(k, Sk_pad)           # (B*Hkv, Sk_pad, D)
     vt = _fold_heads(v, Sk_pad)
 
-    grid = (B * H, Sq_pad // block_q)
+    grid = (B * Hkv, Sq_pad // block_q)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=Sk_pad, seq_k_valid=Sk,
         causal=causal, scale=scale, block_q=block_q)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sq_pad, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((B * Hkv, group, Sq_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, group, Sq_pad), jnp.float32),
         ],
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q, D),
-                             lambda bh, qb, offs: (bh, qb, 0)),
+                pl.BlockSpec((1, group, block_q, D),
+                             lambda bh, qb, offs: (bh, 0, qb, 0)),
                 pl.BlockSpec((1, Sk_pad, D),
                              lambda bh, qb, offs: (bh, 0, 0)),
                 pl.BlockSpec((1, Sk_pad, D),
                              lambda bh, qb, offs: (bh, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, D),
-                             lambda bh, qb, offs: (bh, qb, 0)),
-                pl.BlockSpec((1, block_q),
-                             lambda bh, qb, offs: (bh, qb)),
+                pl.BlockSpec((1, group, block_q, D),
+                             lambda bh, qb, offs: (bh, 0, qb, 0)),
+                pl.BlockSpec((1, group, block_q),
+                             lambda bh, qb, offs: (bh, 0, qb)),
             ],
         ),
         interpret=interpret,
     )(_offsets_array(offsets), qt, kt, vt)
-    return _unfold_heads(out, B, H, Sq), lse
+    return _unfold_q_gqa(out, B, Hkv, Sq), lse
 
 
 # ----------------------------------------------------------------------
@@ -274,10 +301,10 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          block_q: int):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
-    do = do_ref[0].astype(jnp.float32)                # (Bq, D)
-    lse = lse_ref[0][:, None]                         # (Bq, 1)
-    delta = dta_ref[0][:, None]                       # (Bq, 1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (G, Bq, D)
+    do = do_ref[0].astype(jnp.float32)                # (G, Bq, D)
+    lse = lse_ref[0][..., None]                       # (G, Bq, 1)
+    delta = dta_ref[0][..., None]                     # (G, Bq, 1)
     q_idx = pl.program_id(1)
     q_off, k_off = offs_ref[0], offs_ref[1]
 
@@ -292,36 +319,48 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (Bq, Bk)
+            q, k_blk, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
         keep = _keep_mask(q_idx, kb, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
                           seq_k_valid=seq_k_valid, causal=causal)
-        s = jnp.where(keep, s, _NEG_INF)
-        p = jnp.exp(s - lse)                          # (Bq, Bk)
+        s = jnp.where(keep[None], s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # (G, Bq, Bk)
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (Bq, Bk)
+            do, v_blk, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
         ds = p * (dp - delta)
         return dq_acc + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            ds, k_blk, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, Bq, D)
     dq = jax.lax.fori_loop(
         0, num_iters, body,
-        jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        jnp.zeros(q.shape, jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
-                          dta_ref, dk_ref, dv_ref, *, block_q: int,
-                          seq_q: int, seq_q_valid: int, seq_k_valid: int,
-                          causal: bool, scale: float, block_k: int):
+                          dta_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                          block_q: int, seq_q: int, seq_q_valid: int,
+                          seq_k_valid: int, causal: bool, scale: float,
+                          block_k: int, group: int):
+    """dK/dV for one k-block.  The GQA group rides the *grid* (innermost
+    dim, sequential on-core): each step stages only one head's
+    (Sq_pad, D) q/dO plane — the same per-program VMEM footprint as an
+    MHA kernel — and accumulates this k-block's dk/dv across the group
+    in fp32 scratch, writing out on the last head."""
     from jax.experimental import pallas as pl
 
     k_blk = k_ref[0].astype(jnp.float32)              # (Bk, D)
     v_blk = v_ref[0].astype(jnp.float32)
     k_idx = pl.program_id(1)
+    g = pl.program_id(2)
     q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(g == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
 
     num_q_blocks = pl.cdiv(seq_q, block_q)
     if causal:
@@ -333,12 +372,12 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
 
     def body(qb, carry):
         dk_acc, dv_acc = carry
-        q_blk = (q_ref[0, pl.ds(qb * block_q, block_q)]
+        q_blk = (q_ref[0, 0, pl.ds(qb * block_q, block_q)]
                  .astype(jnp.float32) * scale)        # (Bq, D)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q)].astype(
+        do_blk = do_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(
             jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = dta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = dta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
@@ -365,55 +404,59 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
     zero = jnp.zeros((block_k, k_blk.shape[-1]), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_block, num_q_blocks, body,
                                (zero, zero))
-    # q_blk was pre-scaled, so dk = sum ds^T q_blk already carries one
-    # factor of scale — exactly the d(s)/d(k) = scale * q chain term.
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_s[...] += dk
+    dv_s[...] += dv
+
+    @pl.when(g == group - 1)
+    def _finalize():
+        # q_blk was pre-scaled, so dk already carries the
+        # d(s)/d(k) = scale * q chain term.
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_prep(q, o, g, block_q: int):
-    """Fold the hop-invariant backward inputs once: q/dO in kernel
-    layout plus delta_i = rowsum(dO * O) (one elementwise pass XLA
-    fuses; padded rows give 0).  Split out so ring attention can hoist
-    this out of its per-hop loop instead of redoing it n times."""
+def _flash_bwd_prep(q, o, g, block_q: int, Hkv: int):
+    """Fold the hop-invariant backward inputs once: q/dO in the grouped
+    kernel layout plus delta_i = rowsum(dO * O) (one elementwise pass
+    XLA fuses; padded rows give 0).  Split out so ring attention can
+    hoist this out of its per-hop loop instead of redoing it n times."""
     Sq_pad = -(-q.shape[1] // block_q) * block_q
-    qt = _fold_heads(q, Sq_pad)
-    got = _fold_heads(g, Sq_pad)
-    ot = _fold_heads(o, Sq_pad)
+    qt = _fold_q_gqa(q, Hkv, Sq_pad)      # (B*Hkv, G, Sq_pad, D)
+    got = _fold_q_gqa(g, Hkv, Sq_pad)
+    ot = _fold_q_gqa(o, Hkv, Sq_pad)
     delta = jnp.sum(got.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)                          # (B*H, Sq_pad)
+                    axis=-1)              # (B*Hkv, G, Sq_pad)
     return qt, got, delta
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool,
                     offsets=None):
-    qt, got, delta = _flash_bwd_prep(q, o, g, block_q)
+    qt, got, delta = _flash_bwd_prep(q, o, g, block_q, k.shape[2])
     return _flash_backward_folded(
         qt, got, delta, lse, k, v, B=q.shape[0], Sq=q.shape[1],
-        H=q.shape[2], q_dtype=q.dtype, causal=causal, scale=scale,
+        q_dtype=q.dtype, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
         offsets=offsets)
 
 
 def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
-                           H: int, q_dtype, causal: bool, scale: float,
+                           q_dtype, causal: bool, scale: float,
                            block_q: int, block_k: int, interpret: bool,
                            offsets=None):
     """The two backward pallas_calls over pre-folded q/dO/delta (see
-    :func:`_flash_bwd_prep`); k/v arrive raw (B, Sk, Hkv, D)."""
+    :func:`_flash_bwd_prep`); k/v arrive raw (B, Sk, Hkv, D) and stay
+    at Hkv heads throughout — the dK/dV kernel's contractions sum the
+    GQA group inside the matmul."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     _, Sk, Hkv, D = k.shape
-    group = H // Hkv
-    Sq_pad = qt.shape[1]
+    group = qt.shape[1]
+    Sq_pad = qt.shape[2]
     Sk_pad = -(-Sk // block_k) * block_k
 
-    if group > 1:
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-    kt = _fold_heads(k, Sk_pad)
+    kt = _fold_heads(k, Sk_pad)           # (B*Hkv, Sk_pad, D)
     vt = _fold_heads(v, Sk_pad)
     offs = _offsets_array(offsets)
 
@@ -422,26 +465,27 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
         seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q)
     dq = pl.pallas_call(
         dq_kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq_pad, D), q_dtype),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, group, Sq_pad, D),
+                                       q_dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B * H, Sq_pad // block_q),
+            grid=(B * Hkv, Sq_pad // block_q),
             in_specs=[
-                pl.BlockSpec((1, block_q, D),
-                             lambda bh, qb, offs: (bh, qb, 0)),  # q
+                pl.BlockSpec((1, group, block_q, D),
+                             lambda bh, qb, offs: (bh, 0, qb, 0)),  # q
                 pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),   # k
+                             lambda bh, qb, offs: (bh, 0, 0)),      # k
                 pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),   # v
-                pl.BlockSpec((1, block_q, D),
-                             lambda bh, qb, offs: (bh, qb, 0)),  # dO
-                pl.BlockSpec((1, block_q),
-                             lambda bh, qb, offs: (bh, qb)),     # lse
-                pl.BlockSpec((1, block_q),
-                             lambda bh, qb, offs: (bh, qb)),     # delta
+                             lambda bh, qb, offs: (bh, 0, 0)),      # v
+                pl.BlockSpec((1, group, block_q, D),
+                             lambda bh, qb, offs: (bh, 0, qb, 0)),  # dO
+                pl.BlockSpec((1, group, block_q),
+                             lambda bh, qb, offs: (bh, 0, qb)),     # lse
+                pl.BlockSpec((1, group, block_q),
+                             lambda bh, qb, offs: (bh, 0, qb)),     # dta
             ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda bh, qb, offs: (bh, qb, 0)),
+            out_specs=pl.BlockSpec((1, group, block_q, D),
+                                   lambda bh, qb, offs: (bh, 0, qb, 0)),
         ),
         interpret=interpret,
     )(offs, qt, kt, vt, got, lse, delta)
@@ -449,48 +493,50 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=Sq_pad,
         seq_q_valid=Sq, seq_k_valid=Sk, causal=causal, scale=scale,
-        block_k=block_k)
+        block_k=block_k, group=group)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sk_pad, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Sk_pad, D), v.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Sk_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Sk_pad, D), v.dtype),
         ],
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B * H, Sk_pad // block_k),
+            # Group innermost: sequential on-core, so the fp32 scratch
+            # accumulators carry this k-block's dk/dv across the
+            # group's heads; q/dO stage one (Sq_pad, D) plane at a time.
+            grid=(B * Hkv, Sk_pad // block_k, group),
             in_specs=[
                 pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, offs: (bh, kb, 0)),  # k
+                             lambda bh, kb, g, offs: (bh, kb, 0)),   # k
                 pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, offs: (bh, kb, 0)),  # v
-                pl.BlockSpec((1, Sq_pad, D),
-                             lambda bh, kb, offs: (bh, 0, 0)),   # q
-                pl.BlockSpec((1, Sq_pad, D),
-                             lambda bh, kb, offs: (bh, 0, 0)),   # dO
-                pl.BlockSpec((1, Sq_pad),
-                             lambda bh, kb, offs: (bh, 0)),      # lse
-                pl.BlockSpec((1, Sq_pad),
-                             lambda bh, kb, offs: (bh, 0)),      # delta
+                             lambda bh, kb, g, offs: (bh, kb, 0)),   # v
+                pl.BlockSpec((1, 1, Sq_pad, D),
+                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # q
+                pl.BlockSpec((1, 1, Sq_pad, D),
+                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dO
+                pl.BlockSpec((1, 1, Sq_pad),
+                             lambda bh, kb, g, offs: (bh, g, 0)),    # lse
+                pl.BlockSpec((1, 1, Sq_pad),
+                             lambda bh, kb, g, offs: (bh, g, 0)),    # dta
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, offs: (bh, kb, 0)),
+                             lambda bh, kb, g, offs: (bh, kb, 0)),
                 pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, offs: (bh, kb, 0)),
+                             lambda bh, kb, g, offs: (bh, kb, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),   # dk
+                pltpu.VMEM((block_k, D), jnp.float32),   # dv
             ],
         ),
         interpret=interpret,
     )(offs, kt, vt, qt, got, lse, delta)
 
-    dq = _unfold_heads(dq, B, H, Sq)
-    dk = _unfold_heads(dk, B, H, Sk)
-    dv = _unfold_heads(dv, B, H, Sk)
-    if group > 1:
-        # GQA: each kv head served `group` query heads — sum their
-        # contributions (forward repeated k/v along the head axis).
-        dk = dk.reshape(B, Sk, Hkv, group, D).sum(axis=3)
-        dv = dv.reshape(B, Sk, Hkv, group, D).sum(axis=3)
+    dq = _unfold_q_gqa(dq, B, Hkv, Sq)
+    dk = _unfold_heads(dk, B, Hkv, Sk)
+    dv = _unfold_heads(dv, B, Hkv, Sk)
     return dq, dk, dv
 
 

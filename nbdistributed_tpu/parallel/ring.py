@@ -8,14 +8,12 @@ sequence chunk of Q locally and streams K/V chunks around the ring via
 chunk into an online-softmax accumulator — so communication overlaps
 compute blockwise and peak memory stays sub-quadratic per step.
 
-GQA is native on the wire: K/V ride the ring at ``n_kv_heads`` — the
-hop (ppermute) traffic is ``H/Hkv``× smaller than pre-expanding.  The
-einsum path also computes GQA natively (grouped einsum, no expanded
-K/V anywhere); the flash path currently expands the *visiting* chunk
-to H heads inside each per-hop kernel call (a local HBM copy of the
-(B, S/n, Hkv, D) chunk, group× — small relative to Q/O at long S/n,
-but not free; a kv-head-grid kernel like ops/decode.py's would remove
-it).
+GQA is native end-to-end: K/V ride the ring at ``n_kv_heads`` (hop
+traffic ``H/Hkv``× smaller than pre-expanding) AND stay at Hkv inside
+the local attention — the einsum path groups the query heads in the
+einsums, and the Pallas kernels grid over (batch, kv-head) with the
+group as a batch dim of the q block, so no expanded K/V buffer exists
+anywhere, on the wire or in HBM.
 
 Two inner paths:
 
@@ -138,10 +136,14 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
 # ----------------------------------------------------------------------
 # Flash (Pallas) inner path
 
-def _hop_weights(w, B, H, Sq):
-    """(B*H, Sq_pad) fold-layout weights -> (B, Sq, H, 1)."""
-    return (w.reshape(B, H, -1)[:, :, :Sq]
-            .transpose(0, 2, 1)[..., None])
+def _hop_weights(w, B, Sq):
+    """(B*Hkv, group, Sq_pad) fold-layout weights -> (B, Sq, H, 1)
+    (head h = kv_head * group + g, matching _fold_q_gqa)."""
+    BHkv, group, Sq_pad = w.shape
+    Hkv = BHkv // B
+    return (w.reshape(B, Hkv, group, Sq_pad)
+            .transpose(0, 3, 1, 2)
+            .reshape(B, Sq_pad, Hkv * group)[:, :Sq, :, None])
 
 
 def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
@@ -163,13 +165,13 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
 
     def _rf_fwd(q, k, v):
         B, Sq, H, D = q.shape
-        Sk = k.shape[1]
+        Sk, Hkv = k.shape[1], k.shape[2]
         bq, bk = _block_sizes(block_q, block_k, Sq, Sk)
         interp = _use_interpret()
         my = jax.lax.axis_index(axis)
         Sq_pad = -(-Sq // bq) * bq
         O = jnp.zeros((B, Sq, H, D), jnp.float32)
-        L = jnp.full((B * H, Sq_pad), _NEG_INF, jnp.float32)
+        L = jnp.full((B * Hkv, H // Hkv, Sq_pad), _NEG_INF, jnp.float32)
 
         def body(step, carry):
             O, L, k_cur, v_cur = carry
@@ -182,8 +184,8 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
                 block_q=bq, block_k=bk, interpret=interp,
                 offsets=(my * Sq, src * Sk))
             L_new = jnp.logaddexp(L, lse_j)
-            w_old = _hop_weights(jnp.exp(L - L_new), B, H, Sq)
-            w_j = _hop_weights(jnp.exp(lse_j - L_new), B, H, Sq)
+            w_old = _hop_weights(jnp.exp(L - L_new), B, Sq)
+            w_j = _hop_weights(jnp.exp(lse_j - L_new), B, Sq)
             O = O * w_old + o_j.astype(jnp.float32) * w_j
             k_next = jax.lax.ppermute(k_cur, axis, perm)
             v_next = jax.lax.ppermute(v_cur, axis, perm)
@@ -202,7 +204,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
         my = jax.lax.axis_index(axis)
         # Hop-invariant work — the q/dO folds and the delta reduction —
         # happens once, not n times (only k/v change per hop).
-        qt, got, delta = _flash_bwd_prep(q, out, g, bq)
+        qt, got, delta = _flash_bwd_prep(q, out, g, bq, k.shape[2])
         dq0 = jnp.zeros((B, Sq, H, D), jnp.float32)
         dk0 = jnp.zeros(k.shape, jnp.float32)
         dv0 = jnp.zeros(v.shape, jnp.float32)
@@ -211,7 +213,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
             dq, k_cur, v_cur, dk_cur, dv_cur = carry
             src = (my - step) % n
             dq_j, dk_j, dv_j = _flash_backward_folded(
-                qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq, H=H,
+                qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq,
                 q_dtype=q.dtype, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
                 offsets=(my * Sq, src * Sk))
