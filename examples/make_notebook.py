@@ -127,13 +127,18 @@ def ddp_step(params, opt_state, batch):
 print("world size:", world_size)""")
 
 code("""\
-# Per-rank shard of a synthetic dataset (each rank draws its own slice).
-data_key = jax.random.PRNGKey(100 + rank)
-tokens = jax.random.randint(data_key, (8, 64), 0, cfg.vocab_size)
-batch = {"tokens": tokens}""")
+# Deterministic per-rank data sharding (the seeded batch_iterator):
+# every rank builds the SAME shuffled permutation and takes its own
+# rows of each global batch — the Accelerate-dataloader role, without
+# a dataloader.
+full_data = {"tokens": np.random.default_rng(0).integers(
+    0, cfg.vocab_size, size=(64, 65)).astype("int32")}
+batches = batch_iterator(full_data, batch_size=8, rank=rank,
+                         world_size=world_size, seed=0, epochs=None)""")
 
 code("""\
 for step in range(5):
+    batch = {k: jnp.asarray(v) for k, v in next(batches).items()}
     params, opt_state, loss = ddp_step(params, opt_state, batch)
     if rank == 0:
         print(f"step {step}: loss {float(loss):.4f}")""")
@@ -187,6 +192,37 @@ from nbdistributed_tpu.models import generate
 prompt = jnp.ones((1, 4), jnp.int32) * (rank + 1)
 out_tokens = generate(params, prompt, cfg, max_new_tokens=8)
 print(f"rank {rank}: {out_tokens[0].tolist()}")""")
+
+md("""## Bring your HuggingFace checkpoint
+
+Any Llama-architecture `transformers` model converts into this
+framework's pytree — after which the whole TPU path applies (sharding
+rules, flash kernels, the generate loop above). Here a tiny randomly
+initialized HF Llama proves the round trip inside the notebook: the
+converted model's greedy continuation must match HF's own
+`generate`.""")
+
+code("""\
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM
+from nbdistributed_tpu.models import params_from_hf, generate
+
+torch.manual_seed(0)
+hf_model = LlamaForCausalLM(LlamaConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=256)).eval()
+hf_prompt = torch.tensor([[5, 9, 2, 44]])
+with torch.no_grad():
+    hf_tokens = hf_model.generate(hf_prompt, max_new_tokens=6,
+                                  do_sample=False)[0].tolist()
+
+jx_params, jx_cfg = params_from_hf(hf_model, dtype=jnp.float32)
+jx_cfg = type(jx_cfg)(**{**jx_cfg.__dict__, "use_flash": False})
+jx_tokens = generate(jx_params, jnp.asarray([[5, 9, 2, 44]], jnp.int32),
+                     jx_cfg, max_new_tokens=6)[0].tolist()
+assert jx_tokens == hf_tokens, (jx_tokens, hf_tokens)
+print(f"rank {rank}: HF and converted tokens match: {jx_tokens}")""")
 
 md("## Cluster status, timeline, shutdown")
 

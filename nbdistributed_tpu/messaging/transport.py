@@ -289,7 +289,14 @@ class CoordinatorListener:
                 msg = decode(frame, allow_pickle=self._allow_pickle)
             except CodecError:
                 continue
-            self.on_message(conn.rank, msg)
+            # A handler bug on ONE message must neither kill the
+            # selector thread nor cost the rank its (healthy)
+            # connection — log and move to the next frame.
+            try:
+                self.on_message(conn.rank, msg)
+            except Exception:
+                import traceback as _tb
+                _tb.print_exc()
 
     def _register(self, conn: "_ConnState", unidentified: dict) -> None:
         conn.registered = True

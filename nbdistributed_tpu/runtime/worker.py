@@ -104,6 +104,7 @@ class DistributedWorker:
             pipeline
         from ..parallel.ring import ring_attention
         from ..parallel.ulysses import ulysses_attention
+        from ..utils import data as data_mod
 
         dist = collectives.DistNamespace()
         ns = {
@@ -137,6 +138,8 @@ class DistributedWorker:
             "moe_ffn": expert.moe_ffn,
             "init_moe_params": expert.init_moe_params,
             "load_hf_pretrained": _load_hf_pretrained_lazy,
+            "batch_iterator": data_mod.batch_iterator,
+            "shard_arrays": data_mod.shard_arrays,
             "__rank__": self.rank,
             "__world_size__": self.world_size,
             "__builtins__": __builtins__,

@@ -13,3 +13,10 @@ def fan_in_normal(key, shape, fan_in, dtype):
 
     return (jax.random.normal(key, shape, jnp.float32)
             / np.sqrt(fan_in)).astype(dtype)
+
+
+from .data import (batch_iterator, interleave_shards, rank_slice,
+                   shard_arrays)
+
+__all__ = ["fan_in_normal", "batch_iterator", "interleave_shards",
+           "rank_slice", "shard_arrays"]

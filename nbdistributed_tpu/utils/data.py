@@ -1,0 +1,111 @@
+"""Per-rank data sharding for interactive DDP training.
+
+The reference's demo delegates data distribution to HF Accelerate
+(``accelerator.prepare(dataloader)`` shards batches across ranks —
+reference: 00_accelerate.ipynb cells 28-36); this module is the
+framework-native equivalent for cell-driven training: deterministic,
+rank-local views of a host-resident dataset, shaped for jit (static
+batch shapes, drop-remainder) and for dp meshes (``shard_batch``
+composes on top for in-process meshes).
+
+Everything here is plain host-side slicing — no torch, no dataloader
+processes.  On TPU the input pipeline's job is simply to hand XLA a
+static-shape array per step; anything fancier (prefetch threads,
+tokenization) belongs in user code or upstream libraries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+
+def rank_slice(n: int, rank: int, world_size: int) -> slice:
+    """Contiguous near-equal split of ``n`` items: the first ``n %
+    world_size`` ranks get one extra item.  Deterministic and
+    partition-exact (the slices tile [0, n))."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world of {world_size}")
+    base, extra = divmod(n, world_size)
+    start = rank * base + min(rank, extra)
+    return slice(start, start + base + (1 if rank < extra else 0))
+
+
+def _check_aligned(arrays: dict[str, np.ndarray]) -> int:
+    keys = list(arrays)
+    n = len(arrays[keys[0]])
+    for k in keys:
+        if len(arrays[k]) != n:
+            raise ValueError(
+                f"leading-axis mismatch: {keys[0]}={n}, "
+                f"{k}={len(arrays[k])}")
+    return n
+
+
+def shard_arrays(batch: dict[str, Any], rank: int,
+                 world_size: int) -> dict[str, Any]:
+    """Slice every leading axis of a dict-of-arrays by rank."""
+    arrays = {k: np.asarray(v) for k, v in batch.items()}
+    sl = rank_slice(_check_aligned(arrays), rank, world_size)
+    return {k: v[sl] for k, v in arrays.items()}
+
+
+def batch_iterator(data: dict[str, Any], *, batch_size: int, rank: int,
+                   world_size: int, seed: int | None = 0,
+                   drop_remainder: bool = True,
+                   epochs: int | None = 1) -> Iterator[dict[str, Any]]:
+    """Deterministic per-rank minibatch stream over a dict-of-arrays.
+
+    Every rank must construct this with the SAME ``seed`` — the
+    permutation is generated identically everywhere and each rank takes
+    its own stride through it (global batch = world_size ×
+    ``batch_size``, rank r takes rows [r·bs, (r+1)·bs) of each global
+    batch).  ``drop_remainder=True`` keeps shapes static for jit: a
+    trailing global batch smaller than world_size × batch_size is
+    dropped.  With ``drop_remainder=False`` the trailing batch is split
+    near-equally across ranks (ragged shapes → one extra jit trace) —
+    and is dropped entirely when it has fewer rows than ranks, so every
+    rank always yields the SAME number of batches: a rank-dependent
+    count would deadlock the first collective of the step some ranks
+    never run.  ``epochs=None`` streams forever (reshuffling each
+    epoch).  All validation happens at call time, not first ``next()``.
+    """
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world of {world_size}")
+    keys = list(data)
+    arrays = {k: np.asarray(v) for k, v in data.items()}
+    n = _check_aligned(arrays)
+    global_bs = batch_size * world_size
+    if n < global_bs and (drop_remainder or n < world_size):
+        raise ValueError(
+            f"{n} examples < one global batch ({global_bs}); lower "
+            f"batch_size or world size")
+
+    def gen():
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            if seed is None:
+                perm = np.arange(n)
+            else:
+                perm = np.random.default_rng(seed + epoch).permutation(n)
+            for start in range(0, n - n % global_bs, global_bs):
+                gidx = perm[start:start + global_bs]
+                ridx = gidx[rank * batch_size:(rank + 1) * batch_size]
+                yield {k: arrays[k][ridx] for k in keys}
+            tail = n % global_bs
+            if not drop_remainder and tail >= world_size:
+                gidx = perm[n - tail:]
+                ridx = gidx[rank_slice(tail, rank, world_size)]
+                yield {k: arrays[k][ridx] for k in keys}
+            epoch += 1
+
+    return gen()
+
+
+def interleave_shards(shards: Sequence[dict[str, Any]]) -> dict[str, Any]:
+    """Reassemble per-rank batches into the global batch (test/eval
+    helper; inverse of one step of :func:`batch_iterator`)."""
+    keys = list(shards[0])
+    return {k: np.concatenate([np.asarray(s[k]) for s in shards])
+            for k in keys}

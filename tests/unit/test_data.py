@@ -1,0 +1,136 @@
+"""Per-rank data sharding: partition-exactness, determinism across
+ranks, static shapes, epoch reshuffling."""
+
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.utils.data import (batch_iterator,
+                                          interleave_shards, rank_slice,
+                                          shard_arrays)
+
+
+def test_rank_slices_tile_exactly():
+    for n in (0, 1, 7, 8, 9, 100):
+        for ws in (1, 2, 3, 8):
+            covered = []
+            for r in range(ws):
+                sl = rank_slice(n, r, ws)
+                covered.extend(range(n)[sl])
+            assert covered == list(range(n)), (n, ws)
+
+
+def test_rank_slice_rejects_bad_rank():
+    with pytest.raises(ValueError):
+        rank_slice(10, 3, 2)
+
+
+def test_shard_arrays():
+    batch = {"x": np.arange(10), "y": np.arange(10) * 2}
+    parts = [shard_arrays(batch, r, 3) for r in range(3)]
+    assert [len(p["x"]) for p in parts] == [4, 3, 3]
+    np.testing.assert_array_equal(
+        np.concatenate([p["x"] for p in parts]), batch["x"])
+
+
+def test_batch_iterator_partitions_each_global_batch():
+    """Ranks constructed with the same seed must take disjoint,
+    jointly-exhaustive rows of each shuffled global batch."""
+    n, ws, bs = 64, 4, 4
+    data = {"x": np.arange(n), "y": np.arange(n) + 1000}
+    streams = [list(batch_iterator(data, batch_size=bs, rank=r,
+                                   world_size=ws, seed=7))
+               for r in range(ws)]
+    n_steps = n // (ws * bs)
+    assert all(len(s) == n_steps for s in streams)
+    seen = []
+    for step in range(n_steps):
+        glob = interleave_shards([streams[r][step] for r in range(ws)])
+        assert glob["x"].shape == (ws * bs,)
+        np.testing.assert_array_equal(glob["y"], glob["x"] + 1000)
+        seen.extend(glob["x"].tolist())
+    assert sorted(seen) == list(range(n))  # one epoch, every example once
+
+
+def test_batch_iterator_static_shapes_drop_remainder():
+    data = {"x": np.arange(70)}
+    batches = list(batch_iterator(data, batch_size=4, rank=0,
+                                  world_size=4, seed=0))
+    assert all(b["x"].shape == (4,) for b in batches)
+    assert len(batches) == 70 // 16
+
+
+def test_batch_iterator_reshuffles_across_epochs():
+    data = {"x": np.arange(32)}
+    twice = list(batch_iterator(data, batch_size=4, rank=0,
+                                world_size=2, seed=3, epochs=2))
+    ep1 = np.concatenate([b["x"] for b in twice[:4]])
+    ep2 = np.concatenate([b["x"] for b in twice[4:]])
+    assert not np.array_equal(ep1, ep2)  # different permutations
+
+
+def test_batch_iterator_no_shuffle_is_sequential():
+    data = {"x": np.arange(16)}
+    got = list(batch_iterator(data, batch_size=2, rank=1, world_size=2,
+                              seed=None))
+    np.testing.assert_array_equal(got[0]["x"], [2, 3])
+    np.testing.assert_array_equal(got[1]["x"], [6, 7])
+
+
+def test_batch_iterator_rejects_mismatched_leading_axes():
+    with pytest.raises(ValueError, match="mismatch"):
+        next(batch_iterator({"a": np.zeros(8), "b": np.zeros(7)},
+                            batch_size=2, rank=0, world_size=2))
+
+
+def test_batch_iterator_rejects_tiny_dataset():
+    with pytest.raises(ValueError, match="global batch"):
+        next(batch_iterator({"a": np.zeros(3)}, batch_size=2, rank=0,
+                            world_size=2))
+
+
+def test_batch_iterator_rejects_bad_rank_eagerly():
+    with pytest.raises(ValueError, match="outside world"):
+        batch_iterator({"x": np.arange(64)}, batch_size=8, rank=8,
+                       world_size=8, epochs=None)
+
+
+def test_batch_iterator_validation_is_eager():
+    """Errors must surface at the construction cell, not at the first
+    next() in some later training-loop cell."""
+    with pytest.raises(ValueError, match="global batch"):
+        batch_iterator({"a": np.zeros(3)}, batch_size=2, rank=0,
+                       world_size=2)
+
+
+def test_no_drop_remainder_equal_batch_counts():
+    """drop_remainder=False must yield the SAME number of batches on
+    every rank (a rank-dependent count deadlocks DDP collectives); the
+    trailing global batch is split near-equally."""
+    n, ws, bs = 70, 4, 4
+    data = {"x": np.arange(n)}
+    streams = [list(batch_iterator(data, batch_size=bs, rank=r,
+                                   world_size=ws, seed=1,
+                                   drop_remainder=False))
+               for r in range(ws)]
+    counts = [len(s) for s in streams]
+    assert len(set(counts)) == 1, counts
+    # every example appears exactly once across ranks and steps
+    seen = sorted(int(x) for s in streams for b in s
+                  for x in b["x"])
+    assert seen == list(range(n))
+
+
+def test_no_drop_remainder_tiny_tail_dropped_everywhere():
+    """A tail smaller than world_size cannot be split to all ranks —
+    it is dropped on EVERY rank (again: equal counts)."""
+    n, ws, bs = 18, 4, 4  # tail of 2 < 4 ranks
+    streams = [list(batch_iterator({"x": np.arange(n)}, batch_size=bs,
+                                   rank=r, world_size=ws, seed=1,
+                                   drop_remainder=False))
+               for r in range(ws)]
+    assert [len(s) for s in streams] == [1] * ws
+
+
+def test_shard_arrays_rejects_misaligned():
+    with pytest.raises(ValueError, match="mismatch"):
+        shard_arrays({"x": np.arange(10), "y": np.arange(8)}, 0, 2)
