@@ -39,8 +39,14 @@
 namespace {
 
 constexpr char kPreambleMagic[4] = {'N', 'B', 'D', 'W'};
+constexpr char kAuthPreambleMagic[4] = {'N', 'B', 'D', 'A'};
 constexpr char kFrameMagic[4] = {'N', 'B', 'D', '1'};
 constexpr size_t kPreambleSize = 8;
+// "NBDA" + i32 rank + sha256(token) digest: the authenticated variant
+// required on non-loopback binds (see transport.py — the two
+// listeners share one protocol).
+constexpr size_t kAuthPreambleSize = 40;
+constexpr size_t kDigestSize = 32;
 constexpr size_t kFrameHeaderSize = 16;  // magic + u32 hlen + u64 plen
 // Per-field sanity bounds, checked BEFORE summing so the total cannot
 // overflow (hlen <= 2^30, plen <= 2^40: total < 2^41 << 2^64).  The
@@ -79,6 +85,12 @@ struct Conn {
 
 class Listener {
  public:
+  // Must be called before Init (the epoll loop starts inside Init).
+  void SetAuthDigest(const uint8_t* digest) {
+    std::memcpy(auth_digest_, digest, kDigestSize);
+    auth_required_ = true;
+  }
+
   int Init(const char* host, int port) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (listen_fd_ < 0) return -1;
@@ -255,14 +267,35 @@ class Listener {
     rb.insert(rb.end(), buf, buf + n);
 
     if (conn->rank < 0) {
-      if (rb.size() < kPreambleSize) return;
-      if (std::memcmp(rb.data(), kPreambleMagic, 4) != 0) {
+      if (rb.size() < 4) return;
+      size_t need;
+      bool authed_preamble;
+      if (std::memcmp(rb.data(), kAuthPreambleMagic, 4) == 0) {
+        need = kAuthPreambleSize;
+        authed_preamble = true;
+      } else if (std::memcmp(rb.data(), kPreambleMagic, 4) == 0) {
+        need = kPreambleSize;
+        authed_preamble = false;
+      } else {
         Drop(conn);
         return;
       }
+      if (rb.size() < need) return;
+      if (auth_required_) {
+        // Constant-time digest compare: no early-out byte loop.
+        uint8_t diff = authed_preamble ? 0 : 1;
+        if (authed_preamble) {
+          for (size_t i = 0; i < kDigestSize; ++i)
+            diff |= static_cast<uint8_t>(rb[8 + i] ^ auth_digest_[i]);
+        }
+        if (diff != 0) {
+          Drop(conn);
+          return;
+        }
+      }
       int32_t rank;
       std::memcpy(&rank, rb.data() + 4, 4);
-      rb.erase(rb.begin(), rb.begin() + kPreambleSize);
+      rb.erase(rb.begin(), rb.begin() + need);
       conn->rank = rank;
       std::shared_ptr<Conn> old;
       {
@@ -331,6 +364,8 @@ class Listener {
   }
 
   int listen_fd_ = -1, epfd_ = -1, wake_fd_ = -1, bound_port_ = 0;
+  uint8_t auth_digest_[kDigestSize] = {};
+  bool auth_required_ = false;
   std::atomic<bool> running_{false};
   std::thread loop_;
   std::mutex mu_;  // guards conns_by_fd_ / conns_by_rank_
@@ -347,8 +382,12 @@ class Listener {
 
 extern "C" {
 
-void* nbd_listener_create(const char* host, int port, int* out_port) {
+// Authenticated variant: digest = sha256(token), 32 bytes; null
+// digest = no auth required.
+void* nbd_listener_create_auth(const char* host, int port,
+                               const uint8_t* digest, int* out_port) {
   auto* l = new Listener();
+  if (digest) l->SetAuthDigest(digest);
   int p = l->Init(host, port);
   if (p < 0) {
     delete l;
@@ -356,6 +395,10 @@ void* nbd_listener_create(const char* host, int port, int* out_port) {
   }
   if (out_port) *out_port = p;
   return l;
+}
+
+void* nbd_listener_create(const char* host, int port, int* out_port) {
+  return nbd_listener_create_auth(host, port, nullptr, out_port);
 }
 
 int nbd_listener_poll(void* h, int timeout_ms, int32_t* type, int32_t* rank,

@@ -62,6 +62,13 @@ def load_library():
     lib.nbd_listener_create.restype = ctypes.c_void_p
     lib.nbd_listener_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_int)]
+    try:
+        lib.nbd_listener_create_auth.restype = ctypes.c_void_p
+        lib.nbd_listener_create_auth.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int)]
+    except AttributeError:
+        pass  # stale pre-auth .so; make_listener falls back for auth
     lib.nbd_listener_poll.restype = ctypes.c_int
     lib.nbd_listener_poll.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
@@ -98,12 +105,23 @@ class NativeCoordinatorListener:
     the C++ epoll listener."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = True, auth_token: str | None = None):
         self._allow_pickle = allow_pickle
         self._lib = load_library()
         out_port = ctypes.c_int(0)
-        self._handle = self._lib.nbd_listener_create(
-            host.encode(), port, ctypes.byref(out_port))
+        if auth_token is not None:
+            if not hasattr(self._lib, "nbd_listener_create_auth"):
+                raise OSError(
+                    "native listener library predates the "
+                    "authenticated preamble; rebuild with "
+                    "native/build.sh")
+            from .transport import token_digest
+            self._handle = self._lib.nbd_listener_create_auth(
+                host.encode(), port, token_digest(auth_token),
+                ctypes.byref(out_port))
+        else:
+            self._handle = self._lib.nbd_listener_create(
+                host.encode(), port, ctypes.byref(out_port))
         if not self._handle:
             raise OSError(f"native listener failed to bind {host}:{port}")
         self.host, self.port = host, out_port.value
@@ -196,14 +214,28 @@ def make_listener(host: str = "127.0.0.1", port: int = 0, *,
                   allow_pickle: bool = True, auth_token: str | None = None):
     """Listener factory honoring NBD_NATIVE (see module docstring).
 
-    Auth-token worlds always use the Python listener: the C++ listener
-    does not implement the shared-secret handshake, and silently
-    accepting unauthenticated peers on a non-loopback bind would defeat
-    the token's purpose.
+    Both listeners implement the shared-secret preamble.  An auth
+    world on a stale .so (no create_auth export) falls back to Python
+    with a loud warning — or raises under NBD_NATIVE=1, which promises
+    the native listener — never by silently accepting unauthenticated
+    peers.
     """
-    if available() and auth_token is None:
-        return NativeCoordinatorListener(host, port,
-                                         allow_pickle=allow_pickle)
+    if available():
+        stale_for_auth = (auth_token is not None
+                          and not hasattr(load_library(),
+                                          "nbd_listener_create_auth"))
+        if not stale_for_auth:
+            return NativeCoordinatorListener(host, port,
+                                             allow_pickle=allow_pickle,
+                                             auth_token=auth_token)
+        if os.environ.get("NBD_NATIVE") == "1":
+            raise OSError(
+                "NBD_NATIVE=1 but libnbdtransport.so predates the "
+                "authenticated preamble; rebuild with native/build.sh")
+        import sys
+        print("[nbd] native listener predates the authenticated "
+              "preamble; using the Python listener (rebuild with "
+              "native/build.sh)", file=sys.stderr)
     from .transport import CoordinatorListener
     return CoordinatorListener(host, port, allow_pickle=allow_pickle,
                                auth_token=auth_token)

@@ -33,23 +33,34 @@ from typing import Callable
 
 from .codec import CodecError, Message, decode, encode, frame_ready
 
-# Connection preamble: worker announces its rank in a fixed 8-byte
-# header (magic + u32 rank, little-endian) before any frames — the
-# identity handshake ZMQ did with socket identities
-# (reference: worker.py:154-157), kept trivially parseable so the
-# native C++ listener and this Python listener speak one protocol.
+# Connection preamble: worker announces its rank in a fixed header
+# before any frames — the identity handshake ZMQ did with socket
+# identities (reference: worker.py:154-157), kept trivially parseable
+# so the native C++ listener and this Python listener speak one
+# protocol.  Two variants:
+#   "NBDW" + i32 rank                      (8 bytes, loopback worlds)
+#   "NBDA" + i32 rank + sha256(token)      (40 bytes, authenticated:
+#                                           non-loopback/multihost)
+# The digest form keeps the preamble fixed-size for any token length
+# and never puts the secret itself on the wire.
 PREAMBLE_MAGIC = b"NBDW"
+AUTH_PREAMBLE_MAGIC = b"NBDA"
 PREAMBLE_SIZE = 8
+AUTH_PREAMBLE_SIZE = 40
 
 
-def make_preamble(rank: int) -> bytes:
-    return PREAMBLE_MAGIC + struct.pack("<i", rank)
+def token_digest(auth_token: str) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(auth_token.encode("utf-8",
+                                            "surrogatepass")).digest()
 
 
-def parse_preamble(buf: bytes) -> int:
-    if buf[:4] != PREAMBLE_MAGIC:
-        raise CodecError(f"bad preamble {buf[:4]!r}")
-    return struct.unpack_from("<i", buf, 4)[0]
+def make_preamble(rank: int, auth_token: str | None = None) -> bytes:
+    if auth_token is None:
+        return PREAMBLE_MAGIC + struct.pack("<i", rank)
+    return (AUTH_PREAMBLE_MAGIC + struct.pack("<i", rank)
+            + token_digest(auth_token))
 
 
 class TransportError(Exception):
@@ -62,14 +73,23 @@ def _set_keepalive(sock: socket.socket) -> None:
 
 
 class _ConnState:
-    """Per-connection incremental read buffer + locked writer."""
+    """Per-connection incremental read buffer + locked writer.
 
-    def __init__(self, sock: socket.socket):
+    ``auth_digest``: when set, only the "NBDA" preamble carrying this
+    sha256(token) digest identifies the connection — anything else is a
+    CodecError and the listener drops the peer before any frame is
+    decoded (so an unauthenticated peer can never reach the codec,
+    least of all its pickle path).
+    """
+
+    def __init__(self, sock: socket.socket,
+                 auth_digest: bytes | None = None):
         self.sock = sock
         self.rbuf = bytearray()
         self.wlock = threading.Lock()
-        self.rank: int | None = None  # set after the preamble
-        self.registered = False       # preamble (+ auth if required) done
+        self.rank: int | None = None  # set after the (validated) preamble
+        self.registered = False
+        self.auth_digest = auth_digest
 
     def send_frame(self, frame: bytes) -> None:
         """Write the whole frame even on a non-blocking socket.
@@ -94,13 +114,29 @@ class _ConnState:
 
     def feed(self, data: bytes) -> list[bytes]:
         """Append received bytes; return complete frames.  Consumes the
-        connection preamble first (setting ``self.rank``)."""
+        connection preamble first (setting ``self.rank``), enforcing
+        the auth digest when this listener requires one."""
         self.rbuf.extend(data)
         if self.rank is None:
-            if len(self.rbuf) < PREAMBLE_SIZE:
+            if len(self.rbuf) < 4:
                 return []
-            self.rank = parse_preamble(bytes(self.rbuf[:PREAMBLE_SIZE]))
-            del self.rbuf[:PREAMBLE_SIZE]
+            magic = bytes(self.rbuf[:4])
+            if magic == AUTH_PREAMBLE_MAGIC:
+                need = AUTH_PREAMBLE_SIZE
+            elif magic == PREAMBLE_MAGIC:
+                need = PREAMBLE_SIZE
+            else:
+                raise CodecError(f"bad preamble {magic!r}")
+            if len(self.rbuf) < need:
+                return []
+            if self.auth_digest is not None:
+                import hmac
+                if magic != AUTH_PREAMBLE_MAGIC or not hmac.compare_digest(
+                        bytes(self.rbuf[8:AUTH_PREAMBLE_SIZE]),
+                        self.auth_digest):
+                    raise CodecError("auth digest mismatch")
+            self.rank = struct.unpack_from("<i", self.rbuf, 4)[0]
+            del self.rbuf[:need]
         frames: list[bytes] = []
         while True:
             n = frame_ready(self.rbuf)
@@ -121,13 +157,14 @@ class CoordinatorListener:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  allow_pickle: bool = True, auth_token: str | None = None):
         self._allow_pickle = allow_pickle
-        # Shared-secret handshake: when set, a connection is not
-        # registered (and no frame reaches on_message) until its first
-        # frame is a valid {"type": "auth", "data": {"token": ...}} —
-        # decoded with pickle force-disabled, so an unauthenticated
-        # peer can never reach the pickle path.  Required for non-
-        # loopback binds (multihost): the control plane executes code.
-        self._auth_token = auth_token
+        # Shared-secret handshake: when set, only the "NBDA" preamble
+        # carrying sha256(token) identifies a connection — enforced in
+        # _ConnState.feed before any frame exists, so an
+        # unauthenticated peer can never reach the codec (least of all
+        # its pickle path).  Required for non-loopback binds
+        # (multihost): the control plane executes code.
+        self._auth_digest = (token_digest(auth_token)
+                             if auth_token is not None else None)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -228,7 +265,7 @@ class CoordinatorListener:
                         continue
                     _set_keepalive(sock)
                     sock.setblocking(False)
-                    st = _ConnState(sock)
+                    st = _ConnState(sock, auth_digest=self._auth_digest)
                     unidentified[sock] = st
                     self._sel.register(sock, selectors.EVENT_READ, ("conn", st))
                 else:
@@ -254,33 +291,11 @@ class CoordinatorListener:
             self._drop(conn, unidentified)
             return
         try:
-            frames = conn.feed(data)
+            frames = conn.feed(data)  # enforces the auth preamble
         except CodecError:
             self._drop(conn, unidentified)
             return
         if conn.rank is not None and not conn.registered:
-            if self._auth_token is not None:
-                if not frames:
-                    return  # preamble seen; wait for the auth frame
-                first = frames.pop(0)
-                try:
-                    # Pickle force-disabled pre-auth: an untrusted peer
-                    # must never reach the pickle decoder.
-                    msg = decode(first, allow_pickle=False)
-                except CodecError:
-                    self._drop(conn, unidentified)
-                    return
-                import hmac
-                token = ""
-                if msg.msg_type == "auth" and isinstance(msg.data, dict):
-                    token = str(msg.data.get("token", ""))
-                # Compare as bytes: compare_digest raises TypeError on
-                # non-ASCII *str* inputs — an attacker-reachable crash.
-                if not hmac.compare_digest(
-                        token.encode("utf-8", "surrogatepass"),
-                        self._auth_token.encode("utf-8")):
-                    self._drop(conn, unidentified)
-                    return
             self._register(conn, unidentified)
         if not conn.registered:
             return
@@ -363,12 +378,9 @@ class WorkerChannel:
         self._wlock = threading.Lock()
         self._rbuf = bytearray()
         with self._wlock:
-            self._sock.sendall(make_preamble(rank))
-        if auth_token is not None:
-            # First frame after the preamble: the shared-secret auth
-            # the coordinator requires on non-loopback binds.
-            self.send(Message(msg_type="auth",
-                              data={"token": auth_token}, rank=rank))
+            # The authenticated preamble variant when the coordinator
+            # requires the shared secret (non-loopback binds).
+            self._sock.sendall(make_preamble(rank, auth_token))
 
     def send(self, msg: Message) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)

@@ -108,25 +108,41 @@ def test_concurrent_sends_no_interleave(listener):
     ch.close()
 
 
+from nbdistributed_tpu.messaging import native as _native_mod
+
+_AUTH_IMPLS = (["python", "native"] if _native_mod.available()
+               else ["python"])
+
+
+@pytest.fixture(params=_AUTH_IMPLS)
+def auth_impl(request):
+    return request.param
+
+
 class TestAuthToken:
     """Shared-secret handshake for non-loopback binds: the control
     plane executes code, so nothing may reach dispatch — least of all
-    the pickle decoder — before the token is verified."""
+    the pickle decoder — before the preamble digest is verified.  Both
+    listener implementations must enforce it identically."""
 
-    def _listener(self, token):
-        from nbdistributed_tpu.messaging.transport import (
-            CoordinatorListener)
-        lis = CoordinatorListener("127.0.0.1", 0, auth_token=token)
+    def _listener(self, token, impl="python"):
+        if impl == "native":
+            lis = _native_mod.NativeCoordinatorListener(
+                "127.0.0.1", 0, auth_token=token)
+        else:
+            from nbdistributed_tpu.messaging.transport import (
+                CoordinatorListener)
+            lis = CoordinatorListener("127.0.0.1", 0, auth_token=token)
         connected, messages = [], []
         lis.on_connect = connected.append
         lis.on_message = lambda r, m: messages.append((r, m))
         lis.start()
         return lis, connected, messages
 
-    def test_correct_token_attaches_and_routes(self):
+    def test_correct_token_attaches_and_routes(self, auth_impl):
         from nbdistributed_tpu.messaging.transport import (Message,
                                                            WorkerChannel)
-        lis, connected, messages = self._listener("sekrit")
+        lis, connected, messages = self._listener("sekrit", auth_impl)
         try:
             ch = WorkerChannel("127.0.0.1", lis.port, rank=0,
                                auth_token="sekrit")
@@ -141,12 +157,13 @@ class TestAuthToken:
             lis.close()
 
     @pytest.mark.parametrize("token", [None, "wrong"])
-    def test_missing_or_wrong_token_never_attaches(self, token):
+    def test_missing_or_wrong_token_never_attaches(self, token,
+                                                    auth_impl):
         import socket as socket_mod
 
         from nbdistributed_tpu.messaging.transport import (Message,
                                                            WorkerChannel)
-        lis, connected, messages = self._listener("sekrit")
+        lis, connected, messages = self._listener("sekrit", auth_impl)
         try:
             try:
                 ch = WorkerChannel("127.0.0.1", lis.port, rank=0,
@@ -160,7 +177,8 @@ class TestAuthToken:
         finally:
             lis.close()
 
-    def test_pickle_never_deserialized_before_auth(self, tmp_path):
+    def test_pickle_never_deserialized_before_auth(self, tmp_path,
+                                                    auth_impl):
         """A malicious peer sends a pickle-encoded frame as its first
         message; the payload's __reduce__ would create a file.  The
         pre-auth decode path must refuse pickle entirely."""
@@ -189,7 +207,7 @@ class TestAuthToken:
         frame = (struct.pack("<4sIQ", b"NBD1", len(hb), len(evil))
                  + hb + evil)
 
-        lis, connected, messages = self._listener("sekrit")
+        lis, connected, messages = self._listener("sekrit", auth_impl)
         try:
             s = socket_mod.create_connection(("127.0.0.1", lis.port),
                                              timeout=5)
