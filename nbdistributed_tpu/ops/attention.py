@@ -109,9 +109,12 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     online-softmax recurrence (running max m, normalizer l, accumulator).
 
     GQA is native: the program's q block carries all ``group = H/Hkv``
-    query heads sharing this KV head as a leading batch dim — K/V are
-    staged once per group (never expanded to H heads), and every matmul
-    is a batched ``dot_general`` over that dim.
+    query heads sharing this KV head — K/V are staged once per group
+    (never expanded to H heads).  The group is processed by a *static
+    Python unroll* with rank-2 dots, NOT a batched rank-3 dot_general:
+    rank-2 is the only dot shape Mosaic reliably lowers (JAX's own TPU
+    flash kernel holds to the same rule) — do not reintroduce batched
+    dots here.
 
     ``seq_k`` is the (block-padded) buffer length; ``seq_k_valid`` the
     real key count — keys at or beyond it are masked out, so inputs of
@@ -128,14 +131,19 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     """
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (G, Bq, D)
-    G, _, D = q.shape
+    G, D = q_ref.shape[1], q_ref.shape[3]
     q_idx = pl.program_id(1)
     q_off, k_off = offs_ref[0], offs_ref[1]
-
-    acc = jnp.zeros((G, block_q, D), jnp.float32)
-    m = jnp.full((G, block_q, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((G, block_q, 1), jnp.float32)
+    # Per-group state as tuples of 2D arrays and a static Python loop
+    # over the (small, static) group: every matmul stays rank-2 —
+    # the only dot shape Mosaic is guaranteed to lower (JAX's own TPU
+    # flash kernel holds to the same rule).
+    qs = tuple(q_ref[0, g].astype(jnp.float32) * scale
+               for g in range(G))                     # G x (Bq, D)
+    accs = tuple(jnp.zeros((block_q, D), jnp.float32) for _ in range(G))
+    ms = tuple(jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+               for _ in range(G))
+    ls = tuple(jnp.zeros((block_q, 1), jnp.float32) for _ in range(G))
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
@@ -147,30 +155,37 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     mask_keys = seq_k_valid < seq_k
 
     def body(kb, carry):
-        acc, m, l = carry
+        accs, ms, ls = carry
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
         if causal or mask_keys:
             keep = _keep_mask(q_idx, kb, block_q=block_q,
                               block_k=block_k, q_off=q_off, k_off=k_off,
                               seq_k_valid=seq_k_valid, causal=causal)
-            s = jnp.where(keep[None], s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                        # (G, Bq, Bk)
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jax.lax.dot_general(
-            p, v_blk, (((2,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (G, Bq, D)
-        return acc_new, m_new, l_new
+        new_acc, new_m, new_l = [], [], []
+        for g in range(G):
+            s = jax.lax.dot_general(
+                qs[g], k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)   # (Bq, Bk)
+            if causal or mask_keys:
+                s = jnp.where(keep, s, _NEG_INF)
+            m_new = jnp.maximum(ms[g],
+                                jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                    # (Bq, Bk)
+            corr = jnp.exp(ms[g] - m_new)
+            new_l.append(ls[g] * corr
+                         + jnp.sum(p, axis=-1, keepdims=True))
+            new_acc.append(accs[g] * corr + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            new_m.append(m_new)
+        return tuple(new_acc), tuple(new_m), tuple(new_l)
 
-    acc, m, l = jax.lax.fori_loop(0, num_iters, body, (acc, m, l))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[..., 0]
+    accs, ms, ls = jax.lax.fori_loop(0, num_iters, body, (accs, ms, ls))
+    for g in range(G):
+        l_safe = jnp.maximum(ls[g], 1e-30)
+        o_ref[0, g] = (accs[g] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, g] = (ms[g] + jnp.log(l_safe))[:, 0]
 
 
 def _fold_heads(x, S_pad):
@@ -301,12 +316,15 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          block_q: int):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (G, Bq, D)
-    do = do_ref[0].astype(jnp.float32)                # (G, Bq, D)
-    lse = lse_ref[0][..., None]                       # (G, Bq, 1)
-    delta = dta_ref[0][..., None]                     # (G, Bq, 1)
+    G, D = q_ref.shape[1], q_ref.shape[3]
     q_idx = pl.program_id(1)
     q_off, k_off = offs_ref[0], offs_ref[1]
+    # Static per-group unroll, rank-2 dots only (see _flash_kernel).
+    qs = tuple(q_ref[0, g].astype(jnp.float32) * scale
+               for g in range(G))
+    dos = tuple(do_ref[0, g].astype(jnp.float32) for g in range(G))
+    lses = tuple(lse_ref[0, g][:, None] for g in range(G))
+    deltas = tuple(dta_ref[0, g][:, None] for g in range(G))
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
@@ -315,28 +333,33 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     else:
         num_iters = num_k_blocks
 
-    def body(kb, dq_acc):
+    def body(kb, dq_accs):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
         keep = _keep_mask(q_idx, kb, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
                           seq_k_valid=seq_k_valid, causal=causal)
-        s = jnp.where(keep[None], s, _NEG_INF)
-        p = jnp.exp(s - lse)                          # (G, Bq, Bk)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (G, Bq, Bk)
-        ds = p * (dp - delta)
-        return dq_acc + jax.lax.dot_general(
-            ds, k_blk, (((2,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (G, Bq, D)
-    dq = jax.lax.fori_loop(
+        out = []
+        for g in range(G):
+            s = jax.lax.dot_general(
+                qs[g], k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)   # (Bq, Bk)
+            s = jnp.where(keep, s, _NEG_INF)
+            p = jnp.exp(s - lses[g])                  # (Bq, Bk)
+            dp = jax.lax.dot_general(
+                dos[g], v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)   # (Bq, Bk)
+            ds = p * (dp - deltas[g])
+            out.append(dq_accs[g] + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        return tuple(out)
+
+    dqs = jax.lax.fori_loop(
         0, num_iters, body,
-        jnp.zeros(q.shape, jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        tuple(jnp.zeros((block_q, D), jnp.float32) for _ in range(G)))
+    for g in range(G):
+        dq_ref[0, g] = (dqs[g] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
