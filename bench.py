@@ -174,6 +174,35 @@ _json.dumps({{
 }})
 """
 
+# Flash kernel vs XLA reference attention (round-1's 1.74x measured
+# manually; this makes the number reproducible from bench artifacts).
+FLASH_CELL = """
+import json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.ops import attention_reference as _ref
+from nbdistributed_tpu.ops import flash_attention as _flash
+_B, _S, _H, _Hkv, _D = 4, 2048, 8, 2, 128
+_q = _jax.random.normal(_jax.random.PRNGKey(0), (_B, _S, _H, _D),
+                        _jnp.bfloat16)
+_k = _jax.random.normal(_jax.random.PRNGKey(1), (_B, _S, _Hkv, _D),
+                        _jnp.bfloat16)
+_v = _jax.random.normal(_jax.random.PRNGKey(2), (_B, _S, _Hkv, _D),
+                        _jnp.bfloat16)
+_ff = _jax.jit(lambda q, k, v: _flash(q, k, v, True))
+_fr = _jax.jit(lambda q, k, v: _ref(q, k, v, causal=True))
+_out = {}
+for _name, _f in (("flash", _ff), ("xla_ref", _fr)):
+    _jax.block_until_ready(_f(_q, _k, _v))
+    _t0 = _time.time()
+    for _ in range(20):
+        _o = _f(_q, _k, _v)
+    _jax.block_until_ready(_o)
+    _out[_name + "_ms"] = round((_time.time() - _t0) / 20 * 1e3, 3)
+_out["speedup"] = round(_out["xla_ref_ms"] / _out["flash_ms"], 3)
+_out["shape"] = "B4 S2048 H8 Hkv2 D128 bf16 causal"
+_json.dumps(_out)
+"""
+
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
 # measurement on a 1-process world (labeled as such).
 ALLREDUCE_CELL = """
@@ -330,6 +359,26 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                     log(f"[bench] smol135m: {mfu}")
         except Exception as e:
             log(f"[bench] MFU measurement skipped: {e}")
+
+        if backend == "tpu":
+            # The kernel-vs-XLA comparison is only meaningful where
+            # the kernel actually compiles (interpret mode on CPU is
+            # orders slower by construction).
+            try:
+                log("[bench] flash vs XLA reference attention")
+                resp = comm.send_to_ranks([0], "execute", FLASH_CELL,
+                                          timeout=900)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] flash cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    fa = parse_result_json(m)
+                    if fa is not None:
+                        extra["flash_attn"] = fa
+                        log(f"[bench] flash_attn: {fa}")
+            except Exception as e:
+                log(f"[bench] flash comparison skipped: {e}")
 
         try:
             # ---- all_reduce bandwidth sweep -------------------------
