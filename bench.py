@@ -199,7 +199,8 @@ for _name, _f in (("flash", _ff), ("xla_ref", _fr)):
     _jax.block_until_ready(_o)
     _out[_name + "_ms"] = round((_time.time() - _t0) / 20 * 1e3, 3)
 _out["speedup"] = round(_out["xla_ref_ms"] / _out["flash_ms"], 3)
-_out["shape"] = "B4 S2048 H8 Hkv2 D128 bf16 causal"
+_out["shape"] = (f"B{_B} S{_S} H{_H} Hkv{_Hkv} D{_D} "
+                 f"{_q.dtype.name} causal")
 _json.dumps(_out)
 """
 
@@ -331,7 +332,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
 
         extra: dict = {"overhead_ms_per_cell": round(overhead_ms, 3)}
 
-        # The two context measurements below are best-effort: a
+        # The context measurements below are best-effort: a
         # coordinator-side TimeoutError/WorkerDied there must not
         # discard the already-measured primary metric (the whole point
         # of the fallback ladder is that a JSON line always comes out).
