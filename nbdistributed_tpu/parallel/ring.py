@@ -46,13 +46,15 @@ _NEG_INF = -1e30
 
 @functools.lru_cache(maxsize=None)
 def _ring_fn(mesh, axis: str, causal: bool, scale: float,
-             use_flash: bool):
+             use_flash: bool, schedule: str):
     """Jitted ring kernel, cached per (mesh, axis, causal, scale, path)
     so repeated training-loop calls hit the jit cache instead of
     retracing."""
     n = mesh.shape[axis]
     spec = P(None, axis, None, None)
-    if use_flash:
+    if schedule == "zigzag":
+        inner = _make_ring_flash_zigzag(axis, n, scale)
+    elif use_flash:
         inner = _make_ring_flash(axis, n, causal, scale)
     else:
         inner = functools.partial(_ring_inner, axis=axis, n=n,
@@ -64,7 +66,7 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
 
 def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                    causal: bool = True, scale: float | None = None,
-                   use_flash: bool = False):
+                   use_flash: bool = False, schedule: str = "plain"):
     """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
     sequence dimension.
 
@@ -75,6 +77,18 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
     ``use_flash=True`` runs the Pallas flash kernel per hop (forward
     and backward); the default grouped-einsum path works on any
     backend.
+
+    ``schedule="zigzag"`` is the load-balanced causal schedule: inputs
+    must be in zigzag order (:func:`zigzag_shard` — device d holds
+    global chunks d and 2n-1-d), and the output comes back in the same
+    order (:func:`zigzag_unshard` restores it).  With plain chunking,
+    causality idles device 0 on every hop but the first while device
+    n-1 computes on all of them — the ring's wall-clock is the
+    *unmasked* cost.  Zigzag gives every device ~2 half-chunk blocks
+    of real work per hop, halving causal ring step time at scale.
+    Requires ``causal=True`` and ``use_flash=True`` (only the Pallas
+    path actually *skips* masked blocks; a masked einsum computes them
+    anyway), and S divisible by 2n.
     """
     H, D = q.shape[2], q.shape[-1]
     Hkv = k.shape[2]
@@ -82,8 +96,54 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
     if v.shape[2] != Hkv:
         raise ValueError(f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
+    if schedule not in ("plain", "zigzag"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "zigzag":
+        n = mesh.shape[axis]
+        if not causal:
+            raise ValueError("zigzag is a causal-balance schedule; "
+                             "use schedule='plain' for non-causal")
+        if not use_flash:
+            raise ValueError(
+                "zigzag requires use_flash=True: only the Pallas path "
+                "skips masked blocks (a masked einsum computes them "
+                "anyway, so zigzag would buy nothing)")
+        if q.shape[1] % (2 * n):
+            raise ValueError(f"zigzag needs S divisible by 2n="
+                             f"{2 * n}, got S={q.shape[1]}")
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ring_fn(mesh, axis, causal, scale, use_flash)(q, k, v)
+    return _ring_fn(mesh, axis, causal, scale, use_flash, schedule)(
+        q, k, v)
+
+
+def zigzag_order(S: int, n: int):
+    """Permutation putting a (B, S, ...) sequence into zigzag layout:
+    position p of the reordered sequence holds original index
+    ``order[p]``.  Sharding the result contiguously over n devices
+    gives device d the original chunks d and 2n-1-d."""
+    if S % (2 * n):
+        raise ValueError(f"S={S} not divisible by 2n={2 * n}")
+    C = S // (2 * n)
+    idx = []
+    for d in range(n):
+        idx.extend(range(d * C, (d + 1) * C))
+        idx.extend(range((2 * n - 1 - d) * C, (2 * n - d) * C))
+    return np.asarray(idx)
+
+
+def zigzag_shard(x, n: int, axis: int = 1):
+    """Reorder a global array's sequence axis into zigzag layout (do
+    this once on the data, before sequence-sharding it)."""
+    return jnp.take(x, jnp.asarray(zigzag_order(x.shape[axis], n)),
+                    axis=axis)
+
+
+def zigzag_unshard(x, n: int, axis: int = 1):
+    """Inverse of :func:`zigzag_shard`."""
+    order = zigzag_order(x.shape[axis], n)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
 
 
 def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
@@ -136,6 +196,16 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
 # ----------------------------------------------------------------------
 # Flash (Pallas) inner path
 
+def _fold_hop(O, L, o_j, lse_j, B, Sq):
+    """One online-softmax fold of a hop contribution (o_j, lse_j) into
+    the running (O, L) — the numerically delicate core shared by the
+    plain and zigzag schedules."""
+    L_new = jnp.logaddexp(L, lse_j)
+    w_old = _hop_weights(jnp.exp(L - L_new), B, Sq)
+    w_j = _hop_weights(jnp.exp(lse_j - L_new), B, Sq)
+    return O * w_old + o_j.astype(jnp.float32) * w_j, L_new
+
+
 def _hop_weights(w, B, Sq):
     """(B*Hkv, group, Sq_pad) fold-layout weights -> (B, Sq, H, 1)
     (head h = kv_head * group + g, matching _fold_q_gqa)."""
@@ -183,13 +253,10 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
                 q, k_cur, v_cur, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
                 offsets=(my * Sq, src * Sk))
-            L_new = jnp.logaddexp(L, lse_j)
-            w_old = _hop_weights(jnp.exp(L - L_new), B, Sq)
-            w_j = _hop_weights(jnp.exp(lse_j - L_new), B, Sq)
-            O = O * w_old + o_j.astype(jnp.float32) * w_j
+            O, L = _fold_hop(O, L, o_j, lse_j, B, Sq)
             k_next = jax.lax.ppermute(k_cur, axis, perm)
             v_next = jax.lax.ppermute(v_cur, axis, perm)
-            return O, L_new, k_next, v_next
+            return O, L, k_next, v_next
 
         O, L, k, v = jax.lax.fori_loop(0, n, body, (O, L, k, v))
         out = O.astype(q.dtype)
@@ -228,6 +295,127 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
 
         dq, _, _, dk, dv = jax.lax.fori_loop(
             0, n, body, (dq0, k, v, dk0, dv0))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    rf.defvjp(_rf_fwd, _rf_bwd)
+    return rf
+
+
+def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
+                            block_q: int = 128, block_k: int = 128):
+    """Zigzag causal ring (local view: the two half-chunks d and
+    2n-1-d, concatenated).  Every hop runs four half-pair Pallas calls
+    with exact global offsets; causal block-skip inside the kernel
+    makes the never-attending pairs near-free, so per-hop work is ~2
+    half-blocks on EVERY device — the load-balanced schedule.  Exact
+    gradients via the same per-pair blockwise backward, with dk/dv
+    half-accumulators riding the ring home."""
+    from ..ops.attention import (_block_sizes, _flash_backward_folded,
+                                 _flash_bwd_prep, _flash_forward,
+                                 _use_interpret)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def _offs(idx, C):
+        """Global offsets of owner ``idx``'s two half-chunks."""
+        return (idx * C, (2 * n - 1 - idx) * C)
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _rf_fwd(q, k, v)[0]
+
+    def _rf_fwd(q, k, v):
+        B, Sq, H, D = q.shape
+        Hkv = k.shape[2]
+        C = Sq // 2
+        G = H // Hkv
+        bq, bk = _block_sizes(block_q, block_k, C, C)
+        interp = _use_interpret()
+        my = jax.lax.axis_index(axis)
+        C_pad = -(-C // bq) * bq
+        q_offs = _offs(my, C)
+        qh = (q[:, :C], q[:, C:])
+        O = [jnp.zeros((B, C, H, D), jnp.float32) for _ in range(2)]
+        L = [jnp.full((B * Hkv, G, C_pad), _NEG_INF, jnp.float32)
+             for _ in range(2)]
+
+        def body(step, carry):
+            Oa, La, Ob, Lb, k_cur, v_cur = carry
+            src = (my - step) % n
+            k_offs = _offs(src, C)
+            Os, Ls = [Oa, Ob], [La, Lb]
+            # Step 0 folds real data first for both q halves: (qa, ka)
+            # is qa's diagonal and (qb, ka) is fully unmasked, so each
+            # L[qi] is finite from its first fold (fully-masked pairs
+            # surface lse ~ -inf and weight to zero, as in the plain
+            # schedule).
+            for qi in range(2):
+                for ki in range(2):
+                    o_j, lse_j = _flash_forward(
+                        qh[qi], k_cur[:, ki * C:(ki + 1) * C],
+                        v_cur[:, ki * C:(ki + 1) * C],
+                        causal=True, scale=scale, block_q=bq,
+                        block_k=bk, interpret=interp,
+                        offsets=(q_offs[qi], k_offs[ki]))
+                    Os[qi], Ls[qi] = _fold_hop(Os[qi], Ls[qi], o_j,
+                                               lse_j, B, C)
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            return Os[0], Ls[0], Os[1], Ls[1], k_next, v_next
+
+        Oa, La, Ob, Lb, k, v = jax.lax.fori_loop(
+            0, n, body, (O[0], L[0], O[1], L[1], k, v))
+        out = jnp.concatenate([Oa, Ob], axis=1).astype(q.dtype)
+        return out, (q, k, v, out, La, Lb)
+
+    def _rf_bwd(res, g):
+        q, k, v, out, La, Lb = res
+        B, Sq, H, D = q.shape
+        Hkv = k.shape[2]
+        C = Sq // 2
+        bq, bk = _block_sizes(block_q, block_k, C, C)
+        interp = _use_interpret()
+        my = jax.lax.axis_index(axis)
+        q_offs = _offs(my, C)
+        Ls = (La, Lb)
+        # Hoisted per-half backward prep (hop-invariant).
+        prep = [_flash_bwd_prep(q[:, h * C:(h + 1) * C],
+                                out[:, h * C:(h + 1) * C],
+                                g[:, h * C:(h + 1) * C], bq, Hkv)
+                for h in range(2)]
+        dq0 = [jnp.zeros((B, C, H, D), jnp.float32) for _ in range(2)]
+        dk0 = jnp.zeros(k.shape, jnp.float32)
+        dv0 = jnp.zeros(v.shape, jnp.float32)
+
+        def body(step, carry):
+            dqa, dqb, k_cur, v_cur, dk_cur, dv_cur = carry
+            src = (my - step) % n
+            k_offs = _offs(src, C)
+            dqs = [dqa, dqb]
+            for qi in range(2):
+                qt, got, delta = prep[qi]
+                for ki in range(2):
+                    dq_j, dk_j, dv_j = _flash_backward_folded(
+                        qt, got, delta, Ls[qi],
+                        k_cur[:, ki * C:(ki + 1) * C],
+                        v_cur[:, ki * C:(ki + 1) * C],
+                        B=B, Sq=C, q_dtype=q.dtype, causal=True,
+                        scale=scale, block_q=bq, block_k=bk,
+                        interpret=interp,
+                        offsets=(q_offs[qi], k_offs[ki]))
+                    dqs[qi] = dqs[qi] + dq_j.astype(jnp.float32)
+                    sl = slice(ki * C, (ki + 1) * C)
+                    dk_cur = dk_cur.at[:, sl].add(
+                        dk_j.astype(jnp.float32))
+                    dv_cur = dv_cur.at[:, sl].add(
+                        dv_j.astype(jnp.float32))
+            rot = lambda x: jax.lax.ppermute(x, axis, perm)
+            return (dqs[0], dqs[1], rot(k_cur), rot(v_cur),
+                    rot(dk_cur), rot(dv_cur))
+
+        dqa, dqb, _, _, dk, dv = jax.lax.fori_loop(
+            0, n, body, (dq0[0], dq0[1], k, v, dk0, dv0))
+        dq = jnp.concatenate([dqa, dqb], axis=1)
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     rf.defvjp(_rf_fwd, _rf_bwd)

@@ -102,7 +102,8 @@ class DistributedWorker:
 
         from ..parallel import collectives, expert, mesh as mesh_mod, \
             pipeline
-        from ..parallel.ring import ring_attention
+        from ..parallel.ring import (ring_attention, zigzag_shard,
+                                     zigzag_unshard)
         from ..parallel.ulysses import ulysses_attention
         from ..utils import data as data_mod
 
@@ -132,6 +133,8 @@ class DistributedWorker:
             "make_mesh": mesh_mod.make_mesh,
             "shard_batch": mesh_mod.shard_batch,
             "ring_attention": ring_attention,
+            "zigzag_shard": zigzag_shard,
+            "zigzag_unshard": zigzag_unshard,
             "ulysses_attention": ulysses_attention,
             "pipeline_forward": pipeline.pipeline_forward,
             "shard_stage_params": pipeline.shard_stage_params,

@@ -97,3 +97,77 @@ def test_ring_gradients_match_reference(sp_mesh, use_flash):
                                    atol=2e-4, rtol=2e-4,
                                    err_msg=f"d{name} mismatch "
                                            f"(use_flash={use_flash})")
+
+
+def test_zigzag_order_is_permutation():
+    from nbdistributed_tpu.parallel.ring import zigzag_order
+    order = zigzag_order(64, 8)
+    assert sorted(order.tolist()) == list(range(64))
+    # device 0's shard = first 8 entries = chunks 0 and 15
+    assert order[:8].tolist() == [0, 1, 2, 3, 60, 61, 62, 63]
+
+
+def test_zigzag_shard_roundtrip():
+    from nbdistributed_tpu.parallel.ring import (zigzag_shard,
+                                                 zigzag_unshard)
+    x = jnp.arange(2 * 64 * 3).reshape(2, 64, 3)
+    np.testing.assert_array_equal(
+        np.asarray(zigzag_unshard(zigzag_shard(x, 8), 8)), np.asarray(x))
+
+
+@pytest.mark.parametrize("H,Hkv", [(2, 2), (4, 2)])
+def test_zigzag_matches_full_attention(sp_mesh, H, Hkv):
+    """Zigzag-scheduled causal ring == full attention after undoing the
+    zigzag ordering (the load-balanced schedule must stay exact)."""
+    from nbdistributed_tpu.parallel.ring import (zigzag_shard,
+                                                 zigzag_unshard)
+    B, S, D, n = 1, 64, 16, 8
+    q = rand((B, S, H, D), 50)
+    k = rand((B, S, Hkv, D), 51)
+    v = rand((B, S, Hkv, D), 52)
+    out_zz = ring_attention(zigzag_shard(q, n), zigzag_shard(k, n),
+                            zigzag_shard(v, n), sp_mesh, causal=True,
+                            use_flash=True, schedule="zigzag")
+    out = zigzag_unshard(out_zz, n)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_zigzag_gradients_match_reference(sp_mesh):
+    from nbdistributed_tpu.parallel.ring import (zigzag_shard,
+                                                 zigzag_unshard)
+    B, S, H, Hkv, D, n = 1, 64, 4, 2, 16, 8
+    q = rand((B, S, H, D), 60)
+    k = rand((B, S, Hkv, D), 61)
+    v = rand((B, S, Hkv, D), 62)
+
+    def loss_zz(q, k, v):
+        out = ring_attention(zigzag_shard(q, n), zigzag_shard(k, n),
+                             zigzag_shard(v, n), sp_mesh, causal=True,
+                             use_flash=True, schedule="zigzag")
+        return jnp.sum(zigzag_unshard(out, n) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gz = jax.grad(loss_zz, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gz, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_zigzag_rejects_bad_configs(sp_mesh):
+    q = rand((1, 64, 2, 16), 0)
+    with pytest.raises(ValueError, match="use_flash"):
+        ring_attention(q, q, q, sp_mesh, causal=True, use_flash=False,
+                       schedule="zigzag")
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, q, q, sp_mesh, causal=False, use_flash=True,
+                       schedule="zigzag")
+    q65 = rand((1, 40, 2, 16), 0)
+    with pytest.raises(ValueError, match="divisible"):
+        ring_attention(q65, q65, q65, sp_mesh, causal=True,
+                       use_flash=True, schedule="zigzag")
