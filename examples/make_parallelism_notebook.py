@@ -124,6 +124,23 @@ for name, fn in [("ring", ring_attention), ("ulysses", ulysses_attention)]:
     err = float(jnp.max(jnp.abs(out - ref)))
     print(f"{name:8s} S={S} sharded 8-way: max |err| vs full attention = {err:.2e}")""")
 
+md("""### Zigzag — the load-balanced causal ring
+
+With plain chunking, causality means device 0 idles on every hop after
+the first while device n-1 computes on all of them. The zigzag
+schedule gives device d global chunks d **and** 2n-1-d, so every
+device does equal real work per hop (the Pallas kernel skips the
+masked blocks). Reorder once with `zigzag_shard`, train in that
+layout, undo with `zigzag_unshard`.""")
+
+code("""\
+from nbdistributed_tpu.parallel.ring import zigzag_shard, zigzag_unshard
+out_zz = ring_attention(zigzag_shard(q, 8), zigzag_shard(k, 8),
+                        zigzag_shard(v, 8), sp_mesh, causal=True,
+                        use_flash=True, schedule="zigzag")
+err = float(jnp.max(jnp.abs(zigzag_unshard(out_zz, 8) - ref)))
+print(f"zigzag   S={S} sharded 8-way: max |err| vs full attention = {err:.2e}")""")
+
 md("""## Pipeline parallelism — GPipe over a `pp` axis
 
 Stages live on different devices; microbatches stream through

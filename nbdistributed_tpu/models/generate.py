@@ -65,7 +65,7 @@ def kv_cache_shardings(dp_axis: str | None = "dp",
 # ----------------------------------------------------------------------
 # cache-aware forward
 
-def _cached_attention(q, kc, vc, positions, scale):
+def _cached_attention(q, kc, vc, positions, scale, window=None):
     """GQA attention of new-token queries against the full cache.
 
     q: (B, S, H, Dh) — S new tokens; kc/vc: (B, T, Hkv, Dh) — the whole
@@ -81,6 +81,9 @@ def _cached_attention(q, kc, vc, positions, scale):
                    preferred_element_type=jnp.float32)
     t_idx = jnp.arange(T)
     mask = t_idx[None, None, :] <= positions[:, :, None]  # (B,S,T)
+    if window is not None:
+        mask = mask & (t_idx[None, None, :]
+                       > positions[:, :, None] - window)
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgst,btkd->bskgd", p, vc.astype(jnp.float32),
@@ -88,7 +91,7 @@ def _cached_attention(q, kc, vc, positions, scale):
     return o.reshape(B, S, H * Dh).astype(q.dtype)
 
 
-def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale):
+def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None):
     """Run the Pallas decode kernel under GSPMD via shard_map: batch
     over ``dp``, heads over ``tp`` (other mesh axes replicated).
 
@@ -107,7 +110,8 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale):
     cspec = P(dp, None, tp, None)
 
     def inner(q, kc, vc, pos):
-        return flash_decode_attention(q, kc, vc, pos, scale=scale)
+        return flash_decode_attention(q, kc, vc, pos, scale=scale,
+                                      window=window)
 
     return jax.shard_map(
         inner, mesh=mesh, in_specs=(qspec, cspec, cspec, P(dp)),
@@ -173,14 +177,15 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                                           (0, cache_len, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, cache_len, 0, 0))
+        window = getattr(cfg, "sliding_window", None)
         if S == 1 and cfg.use_flash and mesh is None:
             # Decode hot path: fused Pallas kernel streams the cache
             # once with the masked online softmax (ops/decode.py).
             from ..ops.decode import flash_decode_attention
             o = flash_decode_attention(
-                q[:, 0], kc, vc, positions[:, 0],
-                scale=scale).reshape(B, 1, H * Dh)
-        elif (S == 1 and cfg.use_flash
+                q[:, 0], kc, vc, positions[:, 0], scale=scale,
+                window=window).reshape(B, 1, H * Dh)
+        elif (S == 1 and cfg.use_flash and mesh is not None
               and _can_flash_decode_on_mesh(mesh, B, H, Hkv)):
             # Same kernel under GSPMD: shard_map carves the batch over
             # dp and the (already tp-sharded) heads over tp, so the
@@ -188,9 +193,10 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
             # replicate a raw pallas_call.
             o = _flash_decode_on_mesh(
                 q[:, 0], kc, vc, positions[:, 0], mesh,
-                scale).reshape(B, 1, H * Dh)
+                scale, window).reshape(B, 1, H * Dh)
         else:
-            o = _cached_attention(q, kc, vc, positions, scale)
+            o = _cached_attention(q, kc, vc, positions, scale,
+                                  window=window)
         x = x + o @ layer["wo"]
         x = mlp(x, layer)
         return x, (kc, vc)

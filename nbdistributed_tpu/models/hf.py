@@ -59,7 +59,13 @@ def config_from_hf(hf_config) -> TransformerConfig:
         raise ValueError(
             f"head_dim {head_dim} != hidden_size/n_heads {expect}: "
             "decoupled head_dim is not supported")
+    # Some HF configs (e.g. Qwen2) carry sliding_window but gate it
+    # off with use_sliding_window=False.
+    window = getattr(hf_config, "sliding_window", None)
+    if not getattr(hf_config, "use_sliding_window", True):
+        window = None
     return TransformerConfig(
+        sliding_window=window,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,

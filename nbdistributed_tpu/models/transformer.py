@@ -43,6 +43,9 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     use_flash: bool = True
+    # Mistral-style sliding-window attention: each position attends at
+    # most the previous `sliding_window` tokens.  None = full causal.
+    sliding_window: int | None = None
     # Rematerialize each layer in the backward pass (jax.checkpoint):
     # activation memory drops from O(L·S·D) to O(S·D) + one extra
     # forward of compute — the standard long-context training trade on
@@ -77,6 +80,17 @@ def smol_135m_config(**kw) -> TransformerConfig:
     return TransformerConfig(vocab_size=49152, d_model=576, n_layers=30,
                              n_heads=9, n_kv_heads=3, d_ff=1536,
                              max_seq_len=2048, **kw)
+
+
+def mistral_7b_config(**kw) -> TransformerConfig:
+    """Mistral-7B-v0.1: the sliding-window release (4096-token window,
+    rope theta 1e4, 32k positions).  v0.2/v0.3 dropped the window and
+    raised theta to 1e6 — convert those via config_from_hf instead of
+    this preset."""
+    return TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, d_ff=14336,
+                             max_seq_len=32768, sliding_window=4096,
+                             rope_theta=10000.0, **kw)
 
 
 def llama2_7b_config(**kw) -> TransformerConfig:
@@ -174,10 +188,12 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions):
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if cfg.use_flash:
-        o = flash_attention(q, k, v, True)
+        o = flash_attention(q, k, v, True, None, 128, 128,
+                            cfg.sliding_window)
     else:
         from ..ops import attention_reference
-        o = attention_reference(q, k, v, causal=True)
+        o = attention_reference(q, k, v, causal=True,
+                                window=cfg.sliding_window)
     return x + o.reshape(B, S, H * Dh) @ layer["wo"]
 
 

@@ -35,13 +35,20 @@ from ._common import use_interpret as _shared_use_interpret
 # Reference implementation (oracle + backward + CPU path)
 
 def attention_reference(q, k, v, *, causal: bool = True,
-                        scale: float | None = None):
+                        scale: float | None = None,
+                        window: int | None = None):
     """Exact attention.  q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) with
-    H % Hkv == 0 (grouped-query)."""
+    H % Hkv == 0 (grouped-query).  ``window``: sliding-window size —
+    query row i attends keys in [i - window + 1, i] (Mistral-style;
+    requires ``causal=True``)."""
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if window is not None and not causal:
+        raise ValueError("sliding window implies causal attention")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     group = H // Hkv
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
 
@@ -55,7 +62,10 @@ def attention_reference(q, k, v, *, causal: bool = True,
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+        keep = ki <= qi
+        if window is not None:
+            keep = keep & (ki > qi - window)
+        logits = jnp.where(keep, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -82,11 +92,28 @@ def _causal_first_q_block(k_idx, q_off, k_off, block_q, block_k,
     return jnp.minimum(jax.lax.div(first_qi, block_q), num_q_blocks)
 
 
+def _window_first_k_block(q_off, k_off, q_idx, block_q, block_k,
+                          window, num_k_blocks):
+    """With a sliding window, the earliest key this q block's first
+    row can see is its position - window + 1."""
+    lo = q_off - k_off + q_idx * block_q - window + 1
+    return jnp.clip(jax.lax.div(lo, block_k), 0, num_k_blocks)
+
+
+def _window_last_q_block(k_idx, q_off, k_off, block_q, block_k,
+                         window, num_q_blocks):
+    """With a sliding window, the last q row that can see this
+    k-block's final key sits window - 1 rows after it."""
+    hi_qi = (k_idx * block_k + block_k - 1) + k_off - q_off + window - 1
+    return jnp.clip(jax.lax.div(hi_qi, block_q) + 1, 0, num_q_blocks)
+
+
 def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
-               seq_k_valid, causal, seq_q_valid=None):
+               seq_k_valid, causal, seq_q_valid=None, window=None):
     """(block_q, block_k) bool: which score entries are real — inside
-    the valid key range, (optionally) inside the valid query range, and
-    at-or-below the offset causal diagonal."""
+    the valid key range, (optionally) inside the valid query range,
+    at-or-below the offset causal diagonal, and (optionally) within
+    the sliding window."""
     qi = (q_idx * block_q
           + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
     ki = (kb * block_k
@@ -96,6 +123,8 @@ def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
         keep = keep & (qi < seq_q_valid)
     if causal:
         keep = keep & (ki + k_off <= qi + q_off)
+        if window is not None:
+            keep = keep & (ki + k_off > qi + q_off - window)
     return keep
 
 
@@ -104,7 +133,8 @@ def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
 
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                   block_k: int, seq_k: int, seq_k_valid: int,
-                  causal: bool, scale: float, block_q: int):
+                  causal: bool, scale: float, block_q: int,
+                  window: int | None = None):
     """One (batch*kv-head, q-block) program: stream K/V blocks with the
     online-softmax recurrence (running max m, normalizer l, accumulator).
 
@@ -146,9 +176,14 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     ls = tuple(jnp.zeros((block_q, 1), jnp.float32) for _ in range(G))
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
+    first_iter = 0
     if causal:
         num_iters = _causal_k_iters(q_off, k_off, q_idx, block_q,
                                     block_k, num_k_blocks)
+        if window is not None:
+            first_iter = _window_first_k_block(q_off, k_off, q_idx,
+                                               block_q, block_k,
+                                               window, num_k_blocks)
     else:
         num_iters = num_k_blocks
 
@@ -161,7 +196,8 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         if causal or mask_keys:
             keep = _keep_mask(q_idx, kb, block_q=block_q,
                               block_k=block_k, q_off=q_off, k_off=k_off,
-                              seq_k_valid=seq_k_valid, causal=causal)
+                              seq_k_valid=seq_k_valid, causal=causal,
+                              window=window)
         new_acc, new_m, new_l = [], [], []
         for g in range(G):
             s = jax.lax.dot_general(
@@ -181,7 +217,8 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             new_m.append(m_new)
         return tuple(new_acc), tuple(new_m), tuple(new_l)
 
-    accs, ms, ls = jax.lax.fori_loop(0, num_iters, body, (accs, ms, ls))
+    accs, ms, ls = jax.lax.fori_loop(first_iter, num_iters, body,
+                                     (accs, ms, ls))
     for g in range(G):
         l_safe = jnp.maximum(ls[g], 1e-30)
         o_ref[0, g] = (accs[g] / l_safe).astype(o_ref.dtype)
@@ -236,7 +273,7 @@ def _offsets_array(offsets):
 
 def _flash_forward(q, k, v, *, causal: bool, scale: float,
                    block_q: int, block_k: int, interpret: bool,
-                   offsets=None):
+                   offsets=None, window: int | None = None):
     """Returns (out (B,Sq,H,D), lse (B*Hkv, group, Sq_pad) float32).
 
     K/V are staged at their native Hkv heads — the GQA group rides the
@@ -265,7 +302,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
     grid = (B * Hkv, Sq_pad // block_q)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=Sk_pad, seq_k_valid=Sk,
-        causal=causal, scale=scale, block_q=block_q)
+        causal=causal, scale=scale, block_q=block_q, window=window)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -313,7 +350,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
 def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          dta_ref, dq_ref, *, block_k: int, seq_k: int,
                          seq_k_valid: int, causal: bool, scale: float,
-                         block_q: int):
+                         block_q: int, window: int | None = None):
     from jax.experimental import pallas as pl
 
     G, D = q_ref.shape[1], q_ref.shape[3]
@@ -327,9 +364,14 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     deltas = tuple(dta_ref[0, g][:, None] for g in range(G))
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
+    first_iter = 0
     if causal:
         num_iters = _causal_k_iters(q_off, k_off, q_idx, block_q,
                                     block_k, num_k_blocks)
+        if window is not None:
+            first_iter = _window_first_k_block(q_off, k_off, q_idx,
+                                               block_q, block_k,
+                                               window, num_k_blocks)
     else:
         num_iters = num_k_blocks
 
@@ -338,7 +380,8 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         keep = _keep_mask(q_idx, kb, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
-                          seq_k_valid=seq_k_valid, causal=causal)
+                          seq_k_valid=seq_k_valid, causal=causal,
+                          window=window)
         out = []
         for g in range(G):
             s = jax.lax.dot_general(
@@ -356,7 +399,7 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         return tuple(out)
 
     dqs = jax.lax.fori_loop(
-        0, num_iters, body,
+        first_iter, num_iters, body,
         tuple(jnp.zeros((block_q, D), jnp.float32) for _ in range(G)))
     for g in range(G):
         dq_ref[0, g] = (dqs[g] * scale).astype(dq_ref.dtype)
@@ -366,7 +409,8 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
                           dta_ref, dk_ref, dv_ref, dk_s, dv_s, *,
                           block_q: int, seq_q: int, seq_q_valid: int,
                           seq_k_valid: int, causal: bool, scale: float,
-                          block_k: int, group: int):
+                          block_k: int, group: int,
+                          window: int | None = None):
     """dK/dV for one k-block.  The GQA group rides the *grid* (innermost
     dim, sequential on-core): each step stages only one head's
     (Sq_pad, D) q/dO plane — the same per-program VMEM footprint as an
@@ -386,10 +430,15 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
         dv_s[...] = jnp.zeros_like(dv_s)
 
     num_q_blocks = pl.cdiv(seq_q, block_q)
+    last_block = num_q_blocks
     if causal:
         first_block = _causal_first_q_block(k_idx, q_off, k_off,
                                             block_q, block_k,
                                             num_q_blocks)
+        if window is not None:
+            last_block = _window_last_q_block(k_idx, q_off, k_off,
+                                              block_q, block_k,
+                                              window, num_q_blocks)
     else:
         first_block = 0
 
@@ -409,7 +458,7 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
         keep = _keep_mask(qb, k_idx, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
                           seq_k_valid=seq_k_valid, causal=causal,
-                          seq_q_valid=seq_q_valid)
+                          seq_q_valid=seq_q_valid, window=window)
         s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)                          # (Bq, Bk)
         dv_new = dv_acc + jax.lax.dot_general(
@@ -425,7 +474,7 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
         return dk_new, dv_new
 
     zero = jnp.zeros((block_k, k_blk.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_block, num_q_blocks, body,
+    dk, dv = jax.lax.fori_loop(first_block, last_block, body,
                                (zero, zero))
     dk_s[...] += dk
     dv_s[...] += dv
@@ -454,19 +503,19 @@ def _flash_bwd_prep(q, o, g, block_q: int, Hkv: int):
 
 def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool,
-                    offsets=None):
+                    offsets=None, window: int | None = None):
     qt, got, delta = _flash_bwd_prep(q, o, g, block_q, k.shape[2])
     return _flash_backward_folded(
         qt, got, delta, lse, k, v, B=q.shape[0], Sq=q.shape[1],
         q_dtype=q.dtype, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        offsets=offsets)
+        offsets=offsets, window=window)
 
 
 def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
                            q_dtype, causal: bool, scale: float,
                            block_q: int, block_k: int, interpret: bool,
-                           offsets=None):
+                           offsets=None, window: int | None = None):
     """The two backward pallas_calls over pre-folded q/dO/delta (see
     :func:`_flash_bwd_prep`); k/v arrive raw (B, Sk, Hkv, D) and stay
     at Hkv heads throughout — the dK/dV kernel's contractions sum the
@@ -485,7 +534,8 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, seq_k=Sk_pad,
-        seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q)
+        seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q,
+        window=window)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((B * Hkv, group, Sq_pad, D),
@@ -516,7 +566,7 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=Sq_pad,
         seq_q_valid=Sq, seq_k_valid=Sk, causal=causal, scale=scale,
-        block_k=block_k, group=group)
+        block_k=block_k, group=group, window=window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
@@ -566,17 +616,20 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
 _use_interpret = _shared_use_interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,
                     scale: float | None = None, block_q: int = 128,
-                    block_k: int = 128):
+                    block_k: int = 128, window: int | None = None):
     """Flash attention: fused, O(S) memory forward.
 
-    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).  On non-TPU backends the
-    Pallas kernel runs in interpreter mode (slow but exact), so tests
-    exercise the same code path everywhere.
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).  ``window``: sliding-window
+    size (Mistral-style, causal only) — both passes prune k/q blocks
+    outside the band, so compute is O(S * window) instead of O(S^2/2).
+    On non-TPU backends the Pallas kernel runs in interpreter mode
+    (slow but exact), so tests exercise the same code path everywhere.
     """
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                      window)[0]
 
 
 def _resolved_scale(scale, D):
@@ -587,17 +640,22 @@ def _block_sizes(block_q, block_k, Sq, Sk):
     return min(block_q, Sq), min(block_k, Sk)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
+    if window is not None and not causal:
+        raise ValueError("sliding window implies causal attention")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     D = q.shape[-1]
     bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
     out, lse = _flash_forward(q, k, v, causal=causal,
                               scale=_resolved_scale(scale, D),
                               block_q=bq, block_k=bk,
-                              interpret=_use_interpret())
+                              interpret=_use_interpret(),
+                              window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, window, residuals, g):
     """Blockwise Pallas backward: reconstructs each score block from
     the saved logsumexp, so no O(S^2) tensor exists in the backward
     either."""
@@ -606,7 +664,7 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
     return _flash_backward(q, k, v, out, lse, g, causal=causal,
                            scale=_resolved_scale(scale, q.shape[-1]),
                            block_q=bq, block_k=bk,
-                           interpret=_use_interpret())
+                           interpret=_use_interpret(), window=window)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

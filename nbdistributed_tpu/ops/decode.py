@@ -29,7 +29,8 @@ from ._common import use_interpret as _use_interpret
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_s, m_s, l_s, *, block_k: int, seq_k: int,
-                   scale: float, num_kb: int):
+                   scale: float, num_kb: int,
+                   window: int | None = None):
     """One grid step = one (batch, kv-head, k-block).  The k axis rides
     the grid (sequential on-core), so only a (block_k, D) window of the
     cache is ever staged in VMEM — context length is bounded by HBM,
@@ -39,6 +40,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     b = pl.program_id(0)
     kb = pl.program_id(2)
     valid = pos_ref[b] + 1                              # keys [0, valid)
+    # Sliding window: only keys in [valid - window, valid) attend;
+    # blocks entirely below the window are skipped like blocks past
+    # the valid length.
+    lo = valid - window if window is not None else 0
 
     @pl.when(kb == 0)
     def _init():
@@ -46,7 +51,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         m_s[...] = jnp.full_like(m_s, _NEG_INF)
         l_s[...] = jnp.zeros_like(l_s)
 
-    @pl.when(kb * block_k < valid)
+    @pl.when((kb * block_k < valid)
+             & ((kb + 1) * block_k > lo))
     def _block():
         q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
         k_blk = k_ref[0, :, 0].astype(jnp.float32)      # (Bk, D)
@@ -71,7 +77,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         # (valid <= seq_k always) — including any NaN columns of s
         # from padded k rows (jnp.where does not propagate the
         # unselected branch).
-        s = jnp.where(ki < valid, s, _NEG_INF)
+        s = jnp.where((ki < valid) & (ki >= lo), s, _NEG_INF)
         m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -89,9 +95,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_k", "scale", "interpret"))
+                   static_argnames=("block_k", "scale", "interpret",
+                                    "window"))
 def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
-                 interpret: bool):
+                 interpret: bool, window: int | None = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -99,7 +106,8 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
     T = kc.shape[1]
     num_kb = -(-T // block_k)
     kernel = functools.partial(_decode_kernel, block_k=block_k,
-                               seq_k=T, scale=scale, num_kb=num_kb)
+                               seq_k=T, scale=scale, num_kb=num_kb,
+                               window=window)
     # pos rides as a prefetched scalar array (SMEM on real TPU) —
     # the kernel indexes it by the batch program id.  The k axis is the
     # innermost grid dim: sequential on-core, scratch carries state.
@@ -130,14 +138,17 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
 
 
 def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
-                           block_k: int = 128):
+                           block_k: int = 128,
+                           window: int | None = None):
     """Fused decode attention: one new token per sequence against the
     cache.
 
     q: (B, H, D) — this step's queries (S = 1 squeezed);
     kc/vc: (B, T, Hkv, D) cache buffers (slots beyond ``pos`` unwritten);
     pos: (B,) int32 — the global position of the new token per
-    sequence (cache slots ``t <= pos[b]`` attend).
+    sequence (cache slots ``t <= pos[b]`` attend); ``window`` further
+    restricts to the last ``window`` positions (sliding-window
+    models) with out-of-band blocks skipped, not just masked.
     Returns (B, H, D).  Any cache length works at full block width —
     a non-multiple tail is handled by an overlapping, masked final
     block read inside the kernel.
@@ -150,7 +161,9 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
     block_k = min(block_k, T)
     qg = q.reshape(B, Hkv, group, D)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     out = _decode_call(qg, kc, vc, jnp.asarray(pos, jnp.int32),
                        block_k=block_k, scale=float(scale),
-                       interpret=_use_interpret())
+                       interpret=_use_interpret(), window=window)
     return out.reshape(B, H, D)

@@ -155,3 +155,71 @@ def test_flash_bwd_ragged_and_cross_lengths(Sq, Sk):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
             err_msg=f"d{name} mismatch (Sq={Sq}, Sk={Sk})")
+
+
+class TestSlidingWindow:
+    """Mistral-style sliding-window attention: both passes prune
+    out-of-band blocks and must stay exact vs the windowed oracle."""
+
+    def _oracle(self, q, k, v, window):
+        """Windowed softmax attention from first principles."""
+        B, S, H, D = q.shape
+        Hkv = k.shape[2]
+        kk = jnp.repeat(k, H // Hkv, axis=2)
+        vv = jnp.repeat(v, H // Hkv, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(D)
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        keep = (ki <= qi) & (ki > qi - window)
+        logits = jnp.where(keep, logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+    @pytest.mark.parametrize("window", [16, 64, 100])
+    def test_reference_matches_oracle(self, window):
+        B, S, H, D = 1, 128, 2, 32
+        q, k, v = (rand((B, S, H, D), i + 70) for i in range(3))
+        got = attention_reference(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._oracle(q, k, v, window)),
+            atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("window,S", [(16, 128), (64, 200), (128, 256)])
+    def test_flash_matches_reference(self, window, S):
+        """Windows crossing block boundaries, non-multiple lengths."""
+        B, H, Hkv, D = 1, 4, 2, 32
+        q = rand((B, S, H, D), 80)
+        k = rand((B, S, Hkv, D), 81)
+        v = rand((B, S, Hkv, D), 82)
+        got = flash_attention(q, k, v, True, None, 64, 64, window)
+        ref = attention_reference(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_window_gradients(self):
+        B, S, H, Hkv, D, W = 1, 128, 4, 2, 32, 48
+        q = rand((B, S, H, D), 90)
+        k = rand((B, S, Hkv, D), 91)
+        v = rand((B, S, Hkv, D), 92)
+
+        def loss_f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 64, 64, W) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(attention_reference(
+                q, k, v, causal=True, window=W) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_window_requires_causal(self):
+        q = rand((1, 32, 2, 16), 0)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, False, None, 32, 32, 16)
+        with pytest.raises(ValueError, match="causal"):
+            attention_reference(q, q, q, causal=False, window=16)
