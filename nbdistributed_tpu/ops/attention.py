@@ -448,8 +448,12 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
                  .astype(jnp.float32) * scale)        # (Bq, D)
         do_blk = do_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = dta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        # lse/delta arrive with a trailing unit dim (see the caller:
+        # Mosaic requires the last two block dims be (8k, 128k) or
+        # equal to the array dims — (1, Sq_pad) with group > 1 is
+        # neither, (Sq_pad, 1) matching the array is).
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]   # (Bq, 1)
+        delta = dta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
@@ -588,10 +592,14 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
                              lambda bh, kb, g, offs: (bh, g, 0, 0)),  # q
                 pl.BlockSpec((1, 1, Sq_pad, D),
                              lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dO
-                pl.BlockSpec((1, 1, Sq_pad),
-                             lambda bh, kb, g, offs: (bh, g, 0)),    # lse
-                pl.BlockSpec((1, 1, Sq_pad),
-                             lambda bh, kb, g, offs: (bh, g, 0)),    # dta
+                # lse/delta get a trailing unit dim so the last two
+                # block dims (Sq_pad, 1) equal the array dims — the
+                # (1, 1, Sq_pad) layout fails Mosaic's block-shape
+                # rule whenever group is not 1 or a multiple of 8.
+                pl.BlockSpec((1, 1, Sq_pad, 1),
+                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # lse
+                pl.BlockSpec((1, 1, Sq_pad, 1),
+                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dta
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, D),
@@ -605,7 +613,7 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
             ],
         ),
         interpret=interpret,
-    )(offs, kt, vt, qt, got, lse, delta)
+    )(offs, kt, vt, qt, got, lse[..., None], delta[..., None])
 
     dq = _unfold_q_gqa(dq, B, Hkv, Sq)
     dk = _unfold_heads(dk, B, Hkv, Sk)
