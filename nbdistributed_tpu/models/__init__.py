@@ -5,6 +5,9 @@ configs)."""
 from .generate import (forward_with_cache, generate, init_kv_cache,
                        kv_cache_shardings, make_generate_fn)
 from .hf import config_from_hf, load_hf_pretrained, params_from_hf
+from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
+                   lora_num_params, lora_shardings,
+                   make_lora_train_step)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
@@ -22,4 +25,6 @@ __all__ = ["TransformerConfig", "forward", "init_params",
            "tiny_moe_config",
            "forward_with_cache", "generate", "init_kv_cache",
            "kv_cache_shardings", "make_generate_fn",
-           "config_from_hf", "load_hf_pretrained", "params_from_hf"]
+           "config_from_hf", "load_hf_pretrained", "params_from_hf",
+           "ALL_TARGETS", "ATTN_TARGETS", "lora_init", "lora_merge",
+           "lora_num_params", "lora_shardings", "make_lora_train_step"]

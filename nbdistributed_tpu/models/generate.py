@@ -213,27 +213,52 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
 # ----------------------------------------------------------------------
 # sampling + the decode loop
 
-def _sample(logits, temperature: float, key):
-    """logits: (B, vocab) -> (B,) int32."""
+def _sample(logits, temperature: float, key, top_k: int | None = None,
+            top_p: float | None = None):
+    """logits: (B, vocab) -> (B,) int32.
+
+    Greedy at ``temperature == 0``; otherwise categorical over the
+    temperature-scaled logits, optionally truncated to the ``top_k``
+    most likely tokens and/or the smallest ``top_p`` nucleus (Holtzman
+    et al. 2019).  Both filters are static-shape (sort + mask, no
+    data-dependent shapes) so the whole sampler jits and scans."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        # Mask everything below the k-th largest logit per row.
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]          # (B, 1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # Nucleus: keep the smallest prefix of the sorted distribution
+        # with cumulative probability >= top_p.  The shifted cumsum
+        # keeps every token whose *preceding* mass is < top_p, so the
+        # top-1 token always survives.
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1,
+                             keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(params: dict, prompt, cfg: TransformerConfig,
              max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: int | None = None, top_p: float | None = None,
              key=None, max_len: int | None = None, mesh=None,
              ep_axis: str = "ep"):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0).
 
     Greedy when ``temperature == 0`` (default), else categorical
-    sampling with ``key`` (required).  With ``mesh``, the KV cache is
-    created sharded (batch over ``dp``, KV heads over ``tp`` — pass
-    tensor-parallel params sharded by ``param_shardings``).  Returns
-    (B, S0+max_new_tokens) tokens.  Jit-compatible: wrap in ``jax.jit``
-    with ``static_argnums``/closure for cfg and max_new_tokens, or use
-    :func:`make_generate_fn`.
+    sampling with ``key`` (required), optionally truncated by ``top_k``
+    and/or nucleus ``top_p`` (see :func:`_sample`).  With ``mesh``, the
+    KV cache is created sharded (batch over ``dp``, KV heads over
+    ``tp`` — pass tensor-parallel params sharded by
+    ``param_shardings``).  Returns (B, S0+max_new_tokens) tokens.
+    Jit-compatible: wrap in ``jax.jit`` with ``static_argnums``/closure
+    for cfg and max_new_tokens, or use :func:`make_generate_fn`.
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got "
@@ -242,6 +267,10 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
         return prompt
     if temperature != 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.PRNGKey(0)
     B, S0 = prompt.shape
@@ -254,7 +283,7 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
                                        last_only=True, mesh=mesh,
                                        ep_axis=ep_axis)
     key, k0 = jax.random.split(key)
-    tok = _sample(logits[:, -1], temperature, k0)
+    tok = _sample(logits[:, -1], temperature, k0, top_k, top_p)
 
     def step(carry, i):
         cache, tok, key = carry
@@ -262,7 +291,7 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
             params, tok[:, None], cache, S0 + i, cfg, mesh=mesh,
             ep_axis=ep_axis)
         key, ks = jax.random.split(key)
-        nxt = _sample(logits[:, -1], temperature, ks)
+        nxt = _sample(logits[:, -1], temperature, ks, top_k, top_p)
         return (cache, nxt, key), tok
 
     (_, last, _), toks = jax.lax.scan(
@@ -273,13 +302,16 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
 
 
 def make_generate_fn(cfg: TransformerConfig, max_new_tokens: int, *,
-                     temperature: float = 0.0, max_len: int | None = None,
+                     temperature: float = 0.0, top_k: int | None = None,
+                     top_p: float | None = None,
+                     max_len: int | None = None,
                      mesh=None, ep_axis: str = "ep"):
     """A jitted ``(params, prompt, key) -> tokens`` closure."""
 
     def fn(params, prompt, key=None):
         return generate(params, prompt, cfg, max_new_tokens,
-                        temperature=temperature, key=key, max_len=max_len,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, key=key, max_len=max_len,
                         mesh=mesh, ep_axis=ep_axis)
 
     return jax.jit(fn)

@@ -1,0 +1,141 @@
+"""LoRA fine-tuning for the transformer family (Hu et al. 2021,
+arXiv:2106.09685).
+
+The reference framework has no fine-tuning subsystem (its notebook
+demonstrates full-parameter DDP training via HF Accelerate,
+/root/reference/00_accelerate.ipynb cells 36-40); LoRA is the
+beyond-parity equivalent for the common interactive workflow — adapt a
+7B-class checkpoint on hardware whose HBM cannot hold its optimizer
+state.  Design is TPU-first and reuses the whole existing stack:
+
+* Adapters are a *separate* pytree mirroring the targeted weights:
+  ``{"layers": {name: {"a": (L, d_in, r), "b": (L, r, d_out)}}}`` with
+  ``a ~ N(0, 1/d_in)`` and ``b = 0`` — the adapted model starts exactly
+  at the base model.
+* :func:`lora_merge` adds ``(a @ b) * alpha/r`` onto the frozen base
+  weights *inside* the differentiated function, so
+  ``jax.value_and_grad`` over the adapter pytree gets its gradients by
+  ordinary autodiff through the merge — no surgery on the forward, and
+  every config knob (flash kernel, remat, sliding window) and every
+  parallelism rule (dp/tp shardings, ring/Ulysses) applies unchanged.
+  XLA fuses the rank-r matmul + add into the surrounding computation;
+  the merged weights are scan-stacked like the base ones.
+* Sharding: adapters follow the base weight's Megatron split —
+  column-split weights shard ``b``'s output dim on ``tp``; row-split
+  weights shard ``a``'s input dim.  The rank-r inner axis is always
+  replicated (r is far below a single chip's tile, splitting it would
+  only add collectives).
+* Optimizer state (adamw m/v) exists only for adapter leaves: for
+  llama2-7b at r=16 that is ~0.6% of the full-model optimizer state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import (TransformerConfig, apply_optimizer_updates,
+                          layer_weight_dims, loss_fn)
+
+# Classic LoRA targets the attention projections; "all-linear" adds the
+# SwiGLU MLP weights (QLoRA-style).
+ATTN_TARGETS = ("wq", "wk", "wv", "wo")
+ALL_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+# Which structural split each layer weight uses under tensor
+# parallelism (see transformer.param_shardings): "col" = output dim on
+# tp, "row" = input dim on tp.
+_SPLIT = {"wq": "col", "wk": "col", "wv": "col", "w_gate": "col",
+          "w_up": "col", "wo": "row", "w_down": "row"}
+
+
+def _check_targets(targets):
+    bad = [t for t in targets if t not in _SPLIT]
+    if bad:
+        raise ValueError(f"unknown LoRA targets {bad}; valid: "
+                         f"{sorted(_SPLIT)}")
+
+
+def lora_init(key, cfg: TransformerConfig, rank: int,
+              targets=ATTN_TARGETS, dtype=None) -> dict:
+    """Adapter pytree for ``targets`` (subset of the per-layer weight
+    names).  ``a`` is fan-in-scaled gaussian, ``b`` zeros — the merged
+    model is exactly the base model at step 0."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    _check_targets(targets)
+    dtype = dtype if dtype is not None else cfg.dtype
+    L = cfg.n_layers
+    dims = layer_weight_dims(cfg)
+    layers = {}
+    for name, k in zip(targets, jax.random.split(key, len(targets))):
+        d_in, d_out = dims[name]
+        layers[name] = {
+            "a": (jax.random.normal(k, (L, d_in, rank), jnp.float32)
+                  / jnp.sqrt(d_in)).astype(dtype),
+            "b": jnp.zeros((L, rank, d_out), dtype),
+        }
+    return {"layers": layers}
+
+
+def lora_merge(params: dict, lora: dict, *, alpha: float = 16.0) -> dict:
+    """Base params with ``(a @ b) * alpha/r`` added to each targeted
+    weight.  Differentiable in ``lora``; the base stays frozen by
+    construction when only ``lora`` is a differentiated argument."""
+    merged_layers = dict(params["layers"])
+    for name, ab in lora["layers"].items():
+        rank = ab["a"].shape[-1]
+        scale = alpha / rank
+        base = params["layers"][name]
+        delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32),
+                           ab["b"].astype(jnp.float32)) * scale
+        merged_layers[name] = (base.astype(jnp.float32)
+                               + delta).astype(base.dtype)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+def lora_shardings(cfg: TransformerConfig, lora_or_targets) -> dict:
+    """``PartitionSpec`` rules for the adapter pytree, derived from the
+    base weight's Megatron split (column-split → shard ``b``'s output
+    dim; row-split → shard ``a``'s input dim; rank axis replicated)."""
+    targets = (tuple(lora_or_targets["layers"])
+               if isinstance(lora_or_targets, dict) else
+               tuple(lora_or_targets))
+    _check_targets(targets)
+    layers = {}
+    for name in targets:
+        if _SPLIT[name] == "col":
+            layers[name] = {"a": P(None, None, None),
+                            "b": P(None, None, "tp")}
+        else:
+            layers[name] = {"a": P(None, "tp", None),
+                            "b": P(None, None, None)}
+    return {"layers": layers}
+
+
+def lora_num_params(lora: dict) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora))
+
+
+def make_lora_train_step(cfg: TransformerConfig, optimizer, *,
+                         alpha: float = 16.0):
+    """Returns ``step(base_params, lora, opt_state, batch) ->
+    (lora, opt_state, loss)``.  Only the adapter pytree is
+    differentiated and updated; optimizer state exists only for adapter
+    leaves.  Shard ``base_params`` with ``param_shardings`` and ``lora``
+    with :func:`lora_shardings`, then jit over any dp/tp mesh exactly
+    like the full train step."""
+
+    def step(base_params, lora, opt_state, batch):
+        def adapted_loss(l):
+            return loss_fn(lora_merge(base_params, l, alpha=alpha),
+                           batch, cfg)
+
+        loss, grads = jax.value_and_grad(adapted_loss)(lora)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        return apply_optimizer_updates(lora, updates), opt_state, loss
+
+    return step

@@ -102,35 +102,40 @@ def llama2_7b_config(**kw) -> TransformerConfig:
 # ----------------------------------------------------------------------
 # parameters
 
+def layer_weight_dims(cfg: TransformerConfig) -> dict:
+    """(d_in, d_out) of every per-layer weight matrix — the single
+    source of truth shared by :func:`init_params` and the LoRA adapter
+    factory (lora.lora_init)."""
+    D, H, Hkv, Dh, F = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                        cfg.head_dim, cfg.d_ff)
+    return {"wq": (D, H * Dh), "wk": (D, Hkv * Dh), "wv": (D, Hkv * Dh),
+            "wo": (H * Dh, D), "w_gate": (D, F), "w_up": (D, F),
+            "w_down": (F, D)}
+
+
 def init_params(key, cfg: TransformerConfig) -> dict:
     """Layer-stacked parameter pytree: per-layer arrays carry a leading
     (n_layers,) axis so the forward can ``lax.scan`` over them."""
     k_emb, k_layers, k_out = jax.random.split(key, 3)
-    D, H, Hkv, Dh, F, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
-                           cfg.head_dim, cfg.d_ff, cfg.n_layers)
+    D, L = cfg.d_model, cfg.n_layers
+    dims = layer_weight_dims(cfg)
 
     def normal(key, shape, fan_in):
         from ..utils import fan_in_normal
         return fan_in_normal(key, shape, fan_in, cfg.dtype)
 
-    ks = jax.random.split(k_layers, 7)
-    params = {
+    names = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    ks = dict(zip(names, jax.random.split(k_layers, len(names))))
+    layers = {name: normal(ks[name], (L,) + dims[name], dims[name][0])
+              for name in names}
+    layers["attn_norm"] = jnp.ones((L, D), jnp.float32)
+    layers["mlp_norm"] = jnp.ones((L, D), jnp.float32)
+    return {
         "embed": normal(k_emb, (cfg.vocab_size, D), 1.0),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), jnp.float32),
-            "wq": normal(ks[0], (L, D, H * Dh), D),
-            "wk": normal(ks[1], (L, D, Hkv * Dh), D),
-            "wv": normal(ks[2], (L, D, Hkv * Dh), D),
-            "wo": normal(ks[3], (L, H * Dh, D), H * Dh),
-            "mlp_norm": jnp.ones((L, D), jnp.float32),
-            "w_gate": normal(ks[4], (L, D, F), D),
-            "w_up": normal(ks[5], (L, D, F), D),
-            "w_down": normal(ks[6], (L, F, D), F),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), jnp.float32),
         "lm_head": normal(k_out, (D, cfg.vocab_size), D),
     }
-    return params
 
 
 def param_shardings(cfg: TransformerConfig) -> dict:
@@ -240,6 +245,15 @@ def loss_fn(params, batch, cfg: TransformerConfig):
 # ----------------------------------------------------------------------
 # training step
 
+def apply_optimizer_updates(params, updates):
+    """Apply optax updates with fp32 accumulation, casting back to each
+    leaf's storage dtype — the one mixed-precision update convention,
+    shared by the full and LoRA train steps."""
+    return jax.tree_util.tree_map(
+        lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+        params, updates)
+
+
 def make_train_step(cfg: TransformerConfig, optimizer):
     """Returns ``step(params, opt_state, batch) -> (params, opt_state,
     loss)`` — shard params/batch and jit with shardings to scale it over
@@ -249,9 +263,7 @@ def make_train_step(cfg: TransformerConfig, optimizer):
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
-            params, updates)
+        params = apply_optimizer_updates(params, updates)
         return params, opt_state, loss
 
     return step

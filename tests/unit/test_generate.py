@@ -160,3 +160,76 @@ def test_cache_sharding_spec_shape(setup):
     spec = kv_cache_shardings()
     cache = init_kv_cache(cfg, 2, 16)
     assert len(spec["k"]) == cache["k"].ndim
+
+def test_top_k_restricts_support(setup):
+    """With top_k=1, sampling at any temperature must equal greedy."""
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 5), 0,
+                                cfg.vocab_size)
+    greedy = generate(params, prompt, cfg, 8)
+    sampled = generate(params, prompt, cfg, 8, temperature=1.5,
+                       top_k=1, key=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_top_k_unit_sampler_support():
+    """Directly check _sample only ever emits tokens inside the top-k
+    set of each row."""
+    from nbdistributed_tpu.models.generate import _sample
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+    topk_sets = np.argsort(np.asarray(logits), axis=-1)[:, -8:]
+    for seed in range(5):
+        tok = _sample(logits, 1.0, jax.random.PRNGKey(seed), 8, None)
+        for b in range(4):
+            assert int(tok[b]) in topk_sets[b]
+
+
+def test_top_p_keeps_top_token_and_restricts():
+    """Nucleus sampling with a tiny top_p degenerates to greedy; with
+    top_p=1.0 it must match unfiltered categorical exactly."""
+    from nbdistributed_tpu.models.generate import _sample
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, 64)) * 3
+    key = jax.random.PRNGKey(2)
+    # Tiny nucleus -> only the argmax survives.
+    tok = _sample(logits, 1.0, key, None, 1e-6)
+    np.testing.assert_array_equal(np.asarray(tok),
+                                  np.argmax(np.asarray(logits), axis=-1))
+    # Full nucleus -> identical distribution (same key) as no filter.
+    a = _sample(logits, 0.7, key, None, 1.0)
+    b = _sample(logits, 0.7, key, None, None)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_p_excludes_tail():
+    """A spiked distribution with two dominant tokens: top_p=0.9 must
+    never sample outside those two."""
+    from nbdistributed_tpu.models.generate import _sample
+    logits = np.full((1, 32), -10.0, np.float32)
+    logits[0, 3] = 5.0
+    logits[0, 17] = 4.5
+    logits = jnp.asarray(logits)
+    for seed in range(20):
+        tok = _sample(logits, 1.0, jax.random.PRNGKey(seed), None, 0.9)
+        assert int(tok[0]) in (3, 17)
+
+
+def test_generate_validates_sampler_args(setup):
+    cfg, params = setup
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, cfg, 2, temperature=1.0, top_k=0,
+                 key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, prompt, cfg, 2, temperature=1.0, top_p=0.0,
+                 key=jax.random.PRNGKey(0))
+
+
+def test_jitted_top_k_top_p(setup):
+    """The truncated sampler must scan/jit (static shapes)."""
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 4), 0,
+                                cfg.vocab_size)
+    fn = make_generate_fn(cfg, 6, temperature=0.9, top_k=10, top_p=0.95)
+    out = fn(params, prompt, jax.random.PRNGKey(11))
+    assert out.shape == (2, 10)
+    assert int(jnp.max(out)) < cfg.vocab_size and int(jnp.min(out)) >= 0

@@ -1,0 +1,123 @@
+"""LoRA adapters: identity at init, frozen base, loss descent, and
+tensor-parallel sharding exactness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding
+
+from nbdistributed_tpu.models import (ALL_TARGETS, forward, init_params,
+                                      lora_init, lora_merge,
+                                      lora_num_params, lora_shardings,
+                                      loss_fn, make_lora_train_step,
+                                      param_shardings, tiny_config)
+from nbdistributed_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    return cfg, params, tokens
+
+
+def test_zero_init_is_identity(setup):
+    """b = 0 at init, so the merged model equals the base exactly."""
+    cfg, params, tokens = setup
+    lora = lora_init(jax.random.PRNGKey(2), cfg, rank=4)
+    merged = lora_merge(params, lora)
+    np.testing.assert_array_equal(
+        np.asarray(forward(merged, tokens, cfg)),
+        np.asarray(forward(params, tokens, cfg)))
+
+
+def test_merge_applies_scaled_delta(setup):
+    """Merged weight must be base + a@b * alpha/r for each target."""
+    cfg, params, _ = setup
+    lora = lora_init(jax.random.PRNGKey(3), cfg, rank=2,
+                     targets=("wq", "w_down"))
+    lora["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.PRNGKey(4), lora["layers"]["wq"]["b"].shape)
+    merged = lora_merge(params, lora, alpha=8.0)
+    ab = lora["layers"]["wq"]
+    want = params["layers"]["wq"] + jnp.einsum(
+        "lir,lro->lio", ab["a"], ab["b"]) * (8.0 / 2)
+    np.testing.assert_allclose(np.asarray(merged["layers"]["wq"]),
+                               np.asarray(want), atol=1e-5, rtol=1e-5)
+    # Untouched weights are the same objects, not copies.
+    assert merged["layers"]["wk"] is params["layers"]["wk"]
+    assert merged["lm_head"] is params["lm_head"]
+
+
+def test_train_step_descends_and_freezes_base(setup):
+    cfg, params, tokens = setup
+    lora = lora_init(jax.random.PRNGKey(5), cfg, rank=4,
+                     targets=ALL_TARGETS)
+    opt = optax.adamw(1e-2)
+    step = jax.jit(make_lora_train_step(cfg, opt))
+    state = opt.init(lora)
+    batch = {"tokens": tokens}
+    base_before = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    l0 = loss_fn(lora_merge(params, lora), batch, cfg)
+    for _ in range(10):
+        lora, state, loss = step(params, lora, state, batch)
+    l1 = loss_fn(lora_merge(params, lora), batch, cfg)
+    assert float(l1) < float(l0), (float(l0), float(l1))
+    # The base pytree is untouched (passed in, never updated).
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        params, base_before)
+    # b must have moved away from zero.
+    assert float(jnp.abs(lora["layers"]["wq"]["b"]).max()) > 0
+
+
+def test_adapter_is_small(setup):
+    cfg, params, _ = setup
+    lora = lora_init(jax.random.PRNGKey(6), cfg, rank=2)
+    n_base = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert lora_num_params(lora) < n_base * 0.1
+
+
+def test_bad_args(setup):
+    cfg, _, _ = setup
+    with pytest.raises(ValueError, match="rank"):
+        lora_init(jax.random.PRNGKey(0), cfg, rank=0)
+    with pytest.raises(ValueError, match="unknown LoRA targets"):
+        lora_init(jax.random.PRNGKey(0), cfg, rank=2,
+                  targets=("wq", "nope"))
+    with pytest.raises(ValueError, match="unknown LoRA targets"):
+        lora_shardings(cfg, ("nope",))
+
+
+def test_tensor_parallel_lora_matches_replicated(setup):
+    """One LoRA train step on a 4-way tp mesh must match the
+    unsharded step bit-for-bit up to reduction order."""
+    cfg, params, tokens = setup
+    lora = lora_init(jax.random.PRNGKey(7), cfg, rank=4,
+                     targets=ALL_TARGETS)
+    opt = optax.sgd(1e-2)
+    step = make_lora_train_step(cfg, opt)
+    batch = {"tokens": tokens}
+
+    state = opt.init(lora)
+    ref_lora, _, ref_loss = jax.jit(step)(params, lora, state, batch)
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_shardings(cfg))
+    lshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), lora_shardings(cfg, lora))
+    params_s = jax.device_put(params, pshard)
+    lora_s = jax.device_put(lora, lshard)
+    state_s = opt.init(lora_s)
+    got_lora, _, got_loss = jax.jit(step)(params_s, lora_s, state_s,
+                                          batch)
+    assert np.isclose(float(got_loss), float(ref_loss), atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4),
+        got_lora, ref_lora)
