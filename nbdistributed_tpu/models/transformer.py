@@ -183,7 +183,50 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _attention_block(x, layer, cfg: TransformerConfig, positions):
+@dataclasses.dataclass(frozen=True)
+class SeqParallel:
+    """Route the model's attention through sequence parallelism.
+
+    The rest of the network (embeddings, norms, MLP, lm_head) is
+    position-wise, so GSPMD keeps it sequence-sharded for free once the
+    batch's S axis is sharded over ``mesh[axis]``; attention is the one
+    op that mixes positions, and this spec swaps it for the ring
+    (``method="ring"``, any head count, K/V circulate at Hkv heads) or
+    Ulysses (``method="ulysses"``, needs per-tp-shard head counts
+    divisible by the axis size) implementation from the parallel
+    library.  Zigzag-order ring training stays a library-level tool
+    (it permutes the sequence axis, which would also permute the
+    loss's next-token shift).
+
+    ``dp_axis``/``tp_axis`` name the mesh axes the batch and head dims
+    ride (they extend the attention shard_map specs, so dp/tp
+    composition keeps attention local instead of all-gathering); each
+    is used only if present in ``mesh`` — the defaults compose with
+    the standard dp×sp×tp mesh with no ceremony.  ``use_flash=None``
+    (default) follows ``cfg.use_flash``, so a CPU-oriented config
+    doesn't silently pick the Pallas path.
+    """
+    mesh: Any
+    axis: str = "sp"
+    method: str = "ring"
+    use_flash: bool | None = None
+    dp_axis: str | None = "dp"
+    tp_axis: str | None = "tp"
+
+    def __post_init__(self):
+        if self.method not in ("ring", "ulysses"):
+            raise ValueError(f"unknown SeqParallel method "
+                             f"{self.method!r}; use 'ring' or 'ulysses'")
+
+    def _resolved_axes(self):
+        """(batch_axis, head_axis), dropping names absent from mesh."""
+        names = set(self.mesh.shape)
+        return (self.dp_axis if self.dp_axis in names else None,
+                self.tp_axis if self.tp_axis in names else None)
+
+
+def _attention_block(x, layer, cfg: TransformerConfig, positions,
+                     sp: SeqParallel | None = None):
     B, S, D = x.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -192,7 +235,27 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions):
     v = (h @ layer["wv"]).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if cfg.use_flash:
+    if sp is not None:
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "sliding-window attention under sequence parallelism "
+                "is not wired yet (the ring would need window-aware "
+                "hop pruning)")
+        flash = cfg.use_flash if sp.use_flash is None else sp.use_flash
+        batch_axis, head_axis = sp._resolved_axes()
+        if sp.method == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+            o = ulysses_attention(q, k, v, sp.mesh, axis=sp.axis,
+                                  causal=True, use_flash=flash,
+                                  batch_axis=batch_axis,
+                                  head_axis=head_axis)
+        else:
+            from ..parallel.ring import ring_attention
+            o = ring_attention(q, k, v, sp.mesh, axis=sp.axis,
+                               causal=True, use_flash=flash,
+                               batch_axis=batch_axis,
+                               head_axis=head_axis)
+    elif cfg.use_flash:
         o = flash_attention(q, k, v, True, None, 128, 128,
                             cfg.sliding_window)
     else:
@@ -209,15 +272,19 @@ def _mlp_block(x, layer, cfg: TransformerConfig):
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,
-            positions=None):
-    """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32."""
+            positions=None, *, sp: SeqParallel | None = None):
+    """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32.
+
+    With ``sp``, attention runs sequence-parallel (see
+    :class:`SeqParallel`); shard the batch's S axis over
+    ``sp.mesh[sp.axis]`` and jit as usual."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
 
     def one_layer(x, layer):
-        x = _attention_block(x, layer, cfg, positions)
+        x = _attention_block(x, layer, cfg, positions, sp)
         return _mlp_block(x, layer, cfg)
 
     if cfg.remat:
@@ -231,11 +298,20 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def loss_fn(params, batch, cfg: TransformerConfig):
+def loss_fn(params, batch, cfg: TransformerConfig,
+            sp: SeqParallel | None = None):
     """Next-token cross-entropy.  batch: {tokens (B,S)}; predicts
-    tokens[:, 1:] from tokens[:, :-1]."""
+    tokens[:, 1:] from the logits at positions 0..S-2.
+
+    The forward runs on the full S tokens and the *logits* are
+    shifted, not the inputs: under causal attention position i's
+    logits depend only on tokens <= i, so this is mathematically
+    identical to forwarding tokens[:, :-1] — but it keeps the model's
+    sequence length equal to the batch's (typically a power of two, so
+    no kernel padding, and divisible by a sequence-parallel axis,
+    which S-1 never is)."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens, cfg, sp=sp)[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -254,14 +330,18 @@ def apply_optimizer_updates(params, updates):
         params, updates)
 
 
-def make_train_step(cfg: TransformerConfig, optimizer):
+def make_train_step(cfg: TransformerConfig, optimizer,
+                    sp: SeqParallel | None = None):
     """Returns ``step(params, opt_state, batch) -> (params, opt_state,
     loss)`` — shard params/batch and jit with shardings to scale it over
     any dp/tp mesh (XLA inserts gradient all-reduces for dp and
-    activation collectives for tp)."""
+    activation collectives for tp).  ``sp`` additionally runs attention
+    sequence-parallel for long-context batches (shard the batch's S
+    axis over ``sp.mesh[sp.axis]``)."""
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg,
+                                                  sp)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = apply_optimizer_updates(params, updates)
         return params, opt_state, loss

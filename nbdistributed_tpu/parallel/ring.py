@@ -46,12 +46,18 @@ _NEG_INF = -1e30
 
 @functools.lru_cache(maxsize=None)
 def _ring_fn(mesh, axis: str, causal: bool, scale: float,
-             use_flash: bool, schedule: str):
+             use_flash: bool, schedule: str,
+             batch_axis: str | None = None,
+             head_axis: str | None = None):
     """Jitted ring kernel, cached per (mesh, axis, causal, scale, path)
     so repeated training-loop calls hit the jit cache instead of
-    retracing."""
+    retracing.  ``batch_axis``/``head_axis`` put the embarrassingly
+    parallel batch and head dims on their mesh axes (dp/tp) — the ring
+    math never mixes them, so the inner is unchanged; without them the
+    shard_map would declare B and H replicated and GSPMD would
+    all-gather dp/tp-sharded activations at every call."""
     n = mesh.shape[axis]
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, head_axis, None)
     if schedule == "zigzag":
         inner = _make_ring_flash_zigzag(axis, n, scale)
     elif use_flash:
@@ -66,7 +72,9 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
 
 def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                    causal: bool = True, scale: float | None = None,
-                   use_flash: bool = False, schedule: str = "plain"):
+                   use_flash: bool = False, schedule: str = "plain",
+                   batch_axis: str | None = None,
+                   head_axis: str | None = None):
     """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
     sequence dimension.
 
@@ -89,11 +97,25 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
     Requires ``causal=True`` and ``use_flash=True`` (only the Pallas
     path actually *skips* masked blocks; a masked einsum computes them
     anyway), and S divisible by 2n.
+
+    ``batch_axis``/``head_axis``: mesh axes the batch and head dims are
+    sharded over (dp/tp composition) — batch and heads are
+    embarrassingly parallel through the ring, so these just extend the
+    shard_map specs; omitting them when activations ARE dp/tp-sharded
+    makes GSPMD all-gather and compute attention replicated.
+    ``head_axis`` needs ``Hkv`` divisible by that axis (each shard then
+    keeps whole GQA groups: q heads [t·H/tp, (t+1)·H/tp) attend exactly
+    kv heads [t·Hkv/tp, (t+1)·Hkv/tp)).
     """
     H, D = q.shape[2], q.shape[-1]
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if head_axis is not None and Hkv % mesh.shape[head_axis]:
+        raise ValueError(
+            f"head_axis {head_axis!r} (size {mesh.shape[head_axis]}) "
+            f"must divide n_kv_heads {Hkv} so each shard keeps whole "
+            f"GQA groups")
     if v.shape[2] != Hkv:
         raise ValueError(f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
     if schedule not in ("plain", "zigzag"):
@@ -112,8 +134,8 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
             raise ValueError(f"zigzag needs S divisible by 2n="
                              f"{2 * n}, got S={q.shape[1]}")
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ring_fn(mesh, axis, causal, scale, use_flash, schedule)(
-        q, k, v)
+    return _ring_fn(mesh, axis, causal, scale, use_flash, schedule,
+                    batch_axis, head_axis)(q, k, v)
 
 
 def zigzag_order(S: int, n: int):

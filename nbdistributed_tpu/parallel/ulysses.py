@@ -37,9 +37,9 @@ from jax.sharding import PartitionSpec as P
 
 @functools.lru_cache(maxsize=None)
 def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
-                use_flash: bool):
-    n = mesh.shape[axis]
-    spec = P(None, axis, None, None)
+                use_flash: bool, batch_axis: str | None = None,
+                head_axis: str | None = None):
+    spec = P(batch_axis, axis, head_axis, None)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
                               scale=scale, use_flash=use_flash)
     return jax.jit(jax.shard_map(
@@ -49,7 +49,9 @@ def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
 
 def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
                       causal: bool = True, scale: float | None = None,
-                      use_flash: bool = False):
+                      use_flash: bool = False,
+                      batch_axis: str | None = None,
+                      head_axis: str | None = None):
     """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``,
     computed head-parallel after an all-to-all re-shard.
 
@@ -62,14 +64,27 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
     same group ratio).  ``use_flash=True`` runs the Pallas flash
     kernel as the local attention (TPU path; forward and blockwise
     backward); default is the XLA reference.
+
+    ``batch_axis``/``head_axis``: mesh axes the batch and head dims are
+    sharded over (dp/tp composition).  With ``head_axis`` the per-shard
+    head counts ``H/tp`` and ``Hkv/tp`` are what the sequence
+    all-to-alls split, so both must still be divisible by the ``axis``
+    size; omitting these when activations ARE dp/tp-sharded makes GSPMD
+    all-gather and compute attention replicated.
     """
     n = mesh.shape[axis]
     H, Hkv = q.shape[2], k.shape[2]
-    if H % n != 0 or Hkv % n != 0:
+    t = mesh.shape[head_axis] if head_axis is not None else 1
+    if head_axis is not None and (H % t or Hkv % t):
         raise ValueError(
-            f"ulysses_attention needs both head counts divisible by "
-            f"the {axis!r} axis: H={H}, Hkv={Hkv}, n={n}. Use "
-            "ring_attention for head counts that don't split.")
+            f"head_axis {head_axis!r} (size {t}) must divide both "
+            f"H={H} and Hkv={Hkv}")
+    if (H // t) % n != 0 or (Hkv // t) % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs both per-shard head counts "
+            f"divisible by the {axis!r} axis: H/t={H // t}, "
+            f"Hkv/t={Hkv // t}, n={n}. Use ring_attention for head "
+            "counts that don't split.")
     if H % Hkv != 0:
         raise ValueError(
             f"n_heads {H} not divisible by n_kv_heads {Hkv}")
@@ -78,7 +93,8 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
             f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
     D = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ulysses_fn(mesh, axis, causal, scale, use_flash)(q, k, v)
+    return _ulysses_fn(mesh, axis, causal, scale, use_flash,
+                       batch_axis, head_axis)(q, k, v)
 
 
 def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,

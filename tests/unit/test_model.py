@@ -167,11 +167,13 @@ def test_gradient_accumulation_matches_full_batch():
     for accum in (2, 4):
         np.testing.assert_allclose(outs[accum][1], outs[1][1], rtol=1e-6)
         # fp32 summation order differs (microbatch accumulation vs one
-        # batched reduction), so allow reduction-order noise.
+        # batched reduction) and compounds through the adamw update;
+        # observed drift ~4e-5 after the full-S logits-shift loss, so
+        # the bound is 1e-4.
         for a, b_ in zip(jax.tree_util.tree_leaves(outs[accum][0]),
                          jax.tree_util.tree_leaves(outs[1][0])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                       atol=1e-5, rtol=1e-5)
+                                       atol=1e-4, rtol=1e-4)
 
 
 def test_gradient_accumulation_rejects_indivisible():
