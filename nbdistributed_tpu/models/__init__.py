@@ -8,6 +8,9 @@ from .hf import config_from_hf, load_hf_pretrained, params_from_hf
 from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
                    lora_num_params, lora_shardings,
                    make_lora_train_step)
+from .quant import (dequantize_weight, is_quantized, quantization_error,
+                    quantize_params, quantize_weight,
+                    quantized_shardings)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
@@ -28,4 +31,6 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward", "init_params",
            "kv_cache_shardings", "make_generate_fn",
            "config_from_hf", "load_hf_pretrained", "params_from_hf",
            "ALL_TARGETS", "ATTN_TARGETS", "lora_init", "lora_merge",
-           "lora_num_params", "lora_shardings", "make_lora_train_step"]
+           "lora_num_params", "lora_shardings", "make_lora_train_step",
+           "dequantize_weight", "is_quantized", "quantization_error",
+           "quantize_params", "quantize_weight", "quantized_shardings"]

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from .transformer import TransformerConfig, _mlp_block, _rms_norm, _rope
+from .transformer import (TransformerConfig, _mlp_block, _rms_norm,
+                          _rope, qlinear)
 
 _NEG_INF = -1e30
 
@@ -168,11 +169,11 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     def layer_step(x, inputs):
         layer, kc, vc = inputs
         h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = _rope((h @ layer["wq"]).reshape(B, S, H, Dh), positions,
-                  cfg.rope_theta)
-        k = _rope((h @ layer["wk"]).reshape(B, S, Hkv, Dh), positions,
-                  cfg.rope_theta)
-        v = (h @ layer["wv"]).reshape(B, S, Hkv, Dh)
+        q = _rope(qlinear(h, layer["wq"]).reshape(B, S, H, Dh),
+                  positions, cfg.rope_theta)
+        k = _rope(qlinear(h, layer["wk"]).reshape(B, S, Hkv, Dh),
+                  positions, cfg.rope_theta)
+        v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                           (0, cache_len, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
@@ -197,7 +198,7 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
         else:
             o = _cached_attention(q, kc, vc, positions, scale,
                                   window=window)
-        x = x + o @ layer["wo"]
+        x = x + qlinear(o, layer["wo"])
         x = mlp(x, layer)
         return x, (kc, vc)
 
@@ -206,7 +207,7 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     if last_only:
         x = x[:, -1:]
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qlinear(x, params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 
 

@@ -1,0 +1,124 @@
+"""Int8 weight-only quantization for inference.
+
+The reference has no quantization story (users bring torch models); on
+TPU, single-batch decode is HBM-bandwidth-bound — every step streams
+every weight matrix once — so storing weights int8 halves the dominant
+traffic and roughly doubles decode throughput headroom.
+
+Design (TPU-first):
+
+* **Symmetric per-output-channel** scales: ``W ≈ q8 * s`` with
+  ``s[o] = max|W[:, o]| / 127``.  Because ``s`` is constant along the
+  contraction dim, it commutes with the matmul:
+  ``x @ (q8 * s) == (x @ q8) * s`` — so the kernel-visible weight is
+  the *raw int8 array* (half the HBM bytes) and the rescale is one
+  cheap per-column multiply on the much smaller activation.  XLA fuses
+  the int8→bf16 convert into the dot's operand read (VMEM), so no
+  dequantized copy ever exists in HBM.
+* Quantized leaves keep the pytree structure: a targeted weight becomes
+  ``{"q8": int8 (..., d_in, d_out), "s": fp32 (..., 1, d_out)}``.
+  ``lax.scan`` over stacked layers slices both members along L like any
+  other pytree subtree, and sharding rules map onto the same Megatron
+  splits (``quantized_shardings``).
+* The matmul sites in the model dispatch through
+  :func:`transformer.qlinear`, so the same forward / KV-cache decode
+  path serves fp and quantized params; training stays full-precision
+  (quantize after training / loading).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import TransformerConfig
+
+# Weights worth quantizing: all the big matmuls.  Norm gains stay fp32,
+# the embedding stays fp (it is a gather, not a matmul; its lm_head tie
+# is separate here).
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w, *, axis: int = -2) -> dict:
+    """Symmetric per-output-channel int8 quantization of one weight.
+
+    ``axis`` is the contraction (d_in) axis reduced over when choosing
+    scales; the last axis is the output-channel axis the scales follow.
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q8 = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "s": s}
+
+
+def dequantize_weight(qw: dict, dtype=jnp.float32):
+    return (qw["q8"].astype(jnp.float32) * qw["s"]).astype(dtype)
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, dict) and "q8" in leaf and "s" in leaf
+
+
+def quantize_params(params: dict, targets=DEFAULT_TARGETS,
+                    quantize_lm_head: bool = True) -> dict:
+    """Params pytree with the targeted per-layer weights (and optionally
+    ``lm_head``) replaced by int8 ``{"q8", "s"}`` leaves.  Everything
+    else (embed, norms) is passed through by reference."""
+    layers = dict(params["layers"])
+    for name in targets:
+        if name not in layers:
+            raise ValueError(f"unknown quantization target {name!r}; "
+                             f"layer weights: {sorted(params['layers'])}")
+        layers[name] = quantize_weight(layers[name])
+    out = dict(params)
+    out["layers"] = layers
+    if quantize_lm_head:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
+def quantized_shardings(cfg: TransformerConfig, rules: dict,
+                        targets=DEFAULT_TARGETS,
+                        quantize_lm_head: bool = True) -> dict:
+    """Map tensor-parallel rules onto a quantized pytree: ``q8`` keeps
+    the weight's spec; ``s`` (shaped (..., 1, d_out)) keeps the spec's
+    leading/output entries, with the contraction entry pinned to None
+    (its axis is size 1).  ``targets``/``quantize_lm_head`` must match
+    what was passed to :func:`quantize_params`, or device_put will die
+    on a pytree structure mismatch far from the mistake."""
+
+    def _q_spec(spec: P) -> dict:
+        s_spec = P(*spec[:-2], None, spec[-1])
+        return {"q8": spec, "s": s_spec}
+
+    layers = dict(rules["layers"])
+    for name in targets:
+        if name not in layers:
+            raise ValueError(f"unknown quantization target {name!r}; "
+                             f"layer weights: {sorted(rules['layers'])}")
+        layers[name] = _q_spec(layers[name])
+    out = dict(rules)
+    out["layers"] = layers
+    if quantize_lm_head:
+        out["lm_head"] = _q_spec(rules["lm_head"])
+    return out
+
+
+def quantization_error(params: dict, qparams: dict) -> dict:
+    """Per-weight relative Frobenius error of the quantization — a
+    quick fidelity report (int8 per-channel is typically ~0.2-0.5%)."""
+    report = {}
+
+    def _rel(w, qw):
+        wf = w.astype(jnp.float32)
+        err = dequantize_weight(qw) - wf
+        return float(jnp.linalg.norm(err) / jnp.linalg.norm(wf))
+
+    for name, leaf in qparams["layers"].items():
+        if is_quantized(leaf):
+            report[name] = _rel(params["layers"][name], leaf)
+    if is_quantized(qparams.get("lm_head")):
+        report["lm_head"] = _rel(params["lm_head"], qparams["lm_head"])
+    return report

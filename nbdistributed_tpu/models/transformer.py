@@ -163,6 +163,19 @@ def param_shardings(cfg: TransformerConfig) -> dict:
 # ----------------------------------------------------------------------
 # forward
 
+def qlinear(x, w):
+    """``x @ w`` where ``w`` is a plain array or an int8 weight-only
+    quantized leaf ``{"q8", "s"}`` (see models/quant.py).  Per-output-
+    channel scales commute with the matmul, so the dot consumes the raw
+    int8 array (half the HBM traffic — the convert to x.dtype fuses
+    into the operand read; int8 magnitudes are exact in bf16) and the
+    rescale is one fused per-column multiply in fp32."""
+    if isinstance(w, dict) and "q8" in w and "s" in w:
+        y = x @ w["q8"].astype(x.dtype)
+        return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    return x @ w
+
+
 def _rms_norm(x, weight, eps):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -230,9 +243,9 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
     B, S, D = x.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(B, S, H, Dh)
-    k = (h @ layer["wk"]).reshape(B, S, Hkv, Dh)
-    v = (h @ layer["wv"]).reshape(B, S, Hkv, Dh)
+    q = qlinear(h, layer["wq"]).reshape(B, S, H, Dh)
+    k = qlinear(h, layer["wk"]).reshape(B, S, Hkv, Dh)
+    v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if sp is not None:
@@ -262,13 +275,14 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
         from ..ops import attention_reference
         o = attention_reference(q, k, v, causal=True,
                                 window=cfg.sliding_window)
-    return x + o.reshape(B, S, H * Dh) @ layer["wo"]
+    return x + qlinear(o.reshape(B, S, H * Dh), layer["wo"])
 
 
 def _mlp_block(x, layer, cfg: TransformerConfig):
     h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-    return x + gated @ layer["w_down"]
+    gated = (jax.nn.silu(qlinear(h, layer["w_gate"]))
+             * qlinear(h, layer["w_up"]))
+    return x + qlinear(gated, layer["w_down"])
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,
@@ -295,7 +309,7 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return qlinear(x, params["lm_head"]).astype(jnp.float32)
 
 
 def loss_fn(params, batch, cfg: TransformerConfig,

@@ -1,0 +1,116 @@
+"""Int8 weight-only quantization: reconstruction fidelity, forward
+agreement, KV-cache generation, and tensor-parallel sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from nbdistributed_tpu.models import (dequantize_weight, forward,
+                                      generate, init_params,
+                                      is_quantized, param_shardings,
+                                      quantization_error,
+                                      quantize_params, quantize_weight,
+                                      quantized_shardings, tiny_config)
+from nbdistributed_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    return cfg, params, tokens
+
+
+def test_quantize_roundtrip_error_bounded():
+    """Per-channel symmetric int8: reconstruction error <= s/2 per
+    element, i.e. <= max|col| / 254."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32)) * 3.0
+    qw = quantize_weight(w)
+    assert qw["q8"].dtype == jnp.int8
+    back = dequantize_weight(qw)
+    bound = np.max(np.abs(np.asarray(w)), axis=0, keepdims=True) / 254.0
+    assert np.all(np.abs(np.asarray(back - w)) <= bound + 1e-7)
+
+
+def test_scale_commutes_with_matmul():
+    """x @ dequant(W) == (x @ q8) * s — the identity the fast path
+    relies on."""
+    w = jax.random.normal(jax.random.PRNGKey(3), (32, 16))
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 32))
+    qw = quantize_weight(w)
+    ref = x @ dequantize_weight(qw)
+    fast = (x @ qw["q8"].astype(x.dtype)) * qw["s"][0]
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_quantized_forward_close_to_fp(setup):
+    cfg, params, tokens = setup
+    qparams = quantize_params(params)
+    ref = np.asarray(forward(params, tokens, cfg))
+    got = np.asarray(forward(qparams, tokens, cfg))
+    # Weight-only int8 shifts logits slightly; the distribution must
+    # stay essentially the same: tight normalized error + top-1
+    # agreement on nearly all positions.
+    nmse = float(np.mean((got - ref) ** 2) / np.mean(ref ** 2))
+    assert nmse < 1e-3, nmse
+    top1_match = np.mean(got.argmax(-1) == ref.argmax(-1))
+    assert top1_match > 0.9, top1_match
+    errs = quantization_error(params, qparams)
+    assert set(errs) == {"wq", "wk", "wv", "wo", "w_gate", "w_up",
+                         "w_down", "lm_head"}
+    assert all(e < 0.02 for e in errs.values()), errs
+
+
+def test_quantized_generation_runs_and_matches_its_forward(setup):
+    """The KV-cache decode loop accepts quantized params and is
+    consistent with the quantized full re-forward (same argmax chain)."""
+    cfg, params, tokens = setup
+    qparams = quantize_params(params)
+    prompt = tokens[:, :5]
+    got = generate(qparams, prompt, cfg, max_new_tokens=8)
+    # Reference: greedy re-forward decoding with the same qparams.
+    toks = prompt
+    for _ in range(8):
+        logits = forward(qparams, toks, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
+
+
+def test_quantized_tensor_parallel_matches_unsharded(setup):
+    cfg, params, tokens = setup
+    qparams = quantize_params(params)
+    ref = np.asarray(forward(qparams, tokens, cfg))
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    rules = quantized_shardings(cfg, param_shardings(cfg))
+    from jax.sharding import PartitionSpec as P
+    q_s = jax.device_put(qparams, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), rules,
+        is_leaf=lambda x: isinstance(x, P)))
+    got = np.asarray(jax.jit(lambda p, t: forward(p, t, cfg))(q_s,
+                                                              tokens))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_quantize_params_validates_targets(setup):
+    cfg, params, _ = setup
+    with pytest.raises(ValueError, match="unknown quantization target"):
+        quantize_params(params, targets=("nope",))
+
+
+def test_memory_halved(setup):
+    cfg, params, _ = setup
+    qparams = quantize_params(params)
+
+    def nbytes(t):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(t))
+
+    # Layer weights went fp32 -> int8 (+small scales): big shrink even
+    # with embed/norms left fp.
+    assert nbytes(qparams) < 0.45 * nbytes(params)
