@@ -209,6 +209,38 @@ _out["shape"] = (f"B{_B} S{_S} H{_H} Hkv{_Hkv} D{_D} "
 _json.dumps(_out)
 """
 
+# Single-batch decode throughput, fp vs int8 weight-only: decode is
+# HBM-bound (every step streams every weight), so int8 should approach
+# 2x.  The generate loop is data-chained step to step, so wall-clock /
+# tokens is an honest per-token time even over an async dispatch path.
+DECODE_CELL = """
+import json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.models import (init_params as _init,
+                                      make_generate_fn as _mkgen,
+                                      quantize_params as _quant,
+                                      smol_135m_config as _cfg_fn)
+_cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
+_p = _init(_jax.random.PRNGKey(0), _cfg)
+_qp = _quant(_p)
+_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
+                              _cfg.vocab_size)
+_N = 64
+_gen = _mkgen(_cfg, _N, max_len=128)
+_out = {}
+for _name, _params in (("bf16", _p), ("int8", _qp)):
+    _jax.block_until_ready(_gen(_params, _prompt))
+    _t0 = _time.time()
+    _toks = _gen(_params, _prompt)
+    _jax.block_until_ready(_toks)
+    _dt = _time.time() - _t0
+    _out[_name + "_tok_per_s"] = round(_N / _dt, 1)
+    _out[_name + "_ms_per_tok"] = round(_dt / _N * 1e3, 2)
+_out["int8_speedup"] = round(_out["int8_tok_per_s"]
+                             / _out["bf16_tok_per_s"], 2)
+_json.dumps(_out)
+"""
+
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
 # measurement on a 1-process world (labeled as such).
 ALLREDUCE_CELL = """
@@ -385,6 +417,22 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                         log(f"[bench] flash_attn: {fa}")
             except Exception as e:
                 log(f"[bench] flash comparison skipped: {e}")
+
+            try:
+                log("[bench] decode throughput bf16 vs int8 (smol-135M)")
+                resp = comm.send_to_ranks([0], "execute", DECODE_CELL,
+                                          timeout=1200)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] decode cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    dc = parse_result_json(m)
+                    if dc is not None:
+                        extra["decode"] = dc
+                        log(f"[bench] decode: {dc}")
+            except Exception as e:
+                log(f"[bench] decode comparison skipped: {e}")
 
         try:
             # ---- all_reduce bandwidth sweep -------------------------

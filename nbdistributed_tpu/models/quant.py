@@ -28,11 +28,10 @@ Design (TPU-first):
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .transformer import TransformerConfig
+from .transformer import is_quantized  # noqa: F401  (re-export)
 
 # Weights worth quantizing: all the big matmuls.  Norm gains stay fp32,
 # the embedding stays fp (it is a gather, not a matmul; its lm_head tie
@@ -57,10 +56,6 @@ def dequantize_weight(qw: dict, dtype=jnp.float32):
     return (qw["q8"].astype(jnp.float32) * qw["s"]).astype(dtype)
 
 
-def is_quantized(leaf) -> bool:
-    return isinstance(leaf, dict) and "q8" in leaf and "s" in leaf
-
-
 def quantize_params(params: dict, targets=DEFAULT_TARGETS,
                     quantize_lm_head: bool = True) -> dict:
     """Params pytree with the targeted per-layer weights (and optionally
@@ -79,8 +74,7 @@ def quantize_params(params: dict, targets=DEFAULT_TARGETS,
     return out
 
 
-def quantized_shardings(cfg: TransformerConfig, rules: dict,
-                        targets=DEFAULT_TARGETS,
+def quantized_shardings(rules: dict, targets=DEFAULT_TARGETS,
                         quantize_lm_head: bool = True) -> dict:
     """Map tensor-parallel rules onto a quantized pytree: ``q8`` keeps
     the weight's spec; ``s`` (shaped (..., 1, d_out)) keeps the spec's

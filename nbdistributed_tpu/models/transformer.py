@@ -163,6 +163,13 @@ def param_shardings(cfg: TransformerConfig) -> dict:
 # ----------------------------------------------------------------------
 # forward
 
+def is_quantized(leaf) -> bool:
+    """True for an int8 weight-only quantized leaf ``{"q8", "s"}``
+    (produced by models/quant.py; defined here so qlinear and quant.py
+    share one predicate without an import cycle)."""
+    return isinstance(leaf, dict) and "q8" in leaf and "s" in leaf
+
+
 def qlinear(x, w):
     """``x @ w`` where ``w`` is a plain array or an int8 weight-only
     quantized leaf ``{"q8", "s"}`` (see models/quant.py).  Per-output-
@@ -170,7 +177,7 @@ def qlinear(x, w):
     int8 array (half the HBM traffic — the convert to x.dtype fuses
     into the operand read; int8 magnitudes are exact in bf16) and the
     rescale is one fused per-column multiply in fp32."""
-    if isinstance(w, dict) and "q8" in w and "s" in w:
+    if is_quantized(w):
         y = x @ w["q8"].astype(x.dtype)
         return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
     return x @ w

@@ -145,4 +145,7 @@ def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
     assert "pipeline max |err|" in text
     assert "MoE loss over dp×ep mesh" in text
     assert "moment sharding" in text and "dp" in text
-    assert "generated:" in text
+    assert "greedy:" in text and "top-k/p:" in text
+    assert "ring-attention train step over dp×sp×tp" in text
+    assert "int8 vs bf16 top-1 agreement" in text
+    assert "LoRA:" in text and "adapter params" in text

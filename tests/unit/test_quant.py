@@ -87,7 +87,7 @@ def test_quantized_tensor_parallel_matches_unsharded(setup):
     qparams = quantize_params(params)
     ref = np.asarray(forward(qparams, tokens, cfg))
     mesh = make_mesh({"dp": 2, "tp": 4})
-    rules = quantized_shardings(cfg, param_shardings(cfg))
+    rules = quantized_shardings(param_shardings(cfg))
     from jax.sharding import PartitionSpec as P
     q_s = jax.device_put(qparams, jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), rules,
