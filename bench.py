@@ -227,11 +227,13 @@ _prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
                               _cfg.vocab_size)
 _N = 64
 _gen = _mkgen(_cfg, _N, max_len=128)
+_gen_q8kv = _mkgen(_cfg, _N, max_len=128, kv_quantized=True)
 _out = {}
-for _name, _params in (("bf16", _p), ("int8", _qp)):
-    _jax.block_until_ready(_gen(_params, _prompt))
+for _name, _params, _g in (("bf16", _p, _gen), ("int8", _qp, _gen),
+                           ("int8_kv8", _qp, _gen_q8kv)):
+    _jax.block_until_ready(_g(_params, _prompt))
     _t0 = _time.time()
-    _toks = _gen(_params, _prompt)
+    _toks = _g(_params, _prompt)
     _jax.block_until_ready(_toks)
     _dt = _time.time() - _t0
     _out[_name + "_tok_per_s"] = round(_N / _dt, 1)

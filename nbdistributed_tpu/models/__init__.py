@@ -9,7 +9,8 @@ from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
                    lora_num_params, lora_shardings,
                    make_lora_train_step)
 from .quant import (dequantize_weight, is_quantized, quantization_error,
-                    quantize_params, quantize_weight,
+                    quantize_moe_params, quantize_params,
+                    quantize_weight, quantized_moe_shardings,
                     quantized_shardings)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
@@ -33,4 +34,5 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward", "init_params",
            "ALL_TARGETS", "ATTN_TARGETS", "lora_init", "lora_merge",
            "lora_num_params", "lora_shardings", "make_lora_train_step",
            "dequantize_weight", "is_quantized", "quantization_error",
-           "quantize_params", "quantize_weight", "quantized_shardings"]
+           "quantize_moe_params", "quantize_params", "quantize_weight",
+           "quantized_moe_shardings", "quantized_shardings"]

@@ -36,20 +36,35 @@ _NEG_INF = -1e30
 # cache
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
-                  mesh=None, rules: dict | None = None):
+                  mesh=None, rules: dict | None = None,
+                  quantized: bool = False):
     """Zeroed (L, B, max_len, Hkv, Dh) K and V buffers.
 
     With ``mesh``, the buffers are laid out by ``rules`` (default:
     :func:`kv_cache_shardings` restricted to the axes the mesh has) so
-    the decode loop keeps the cache sharded like the parameters."""
+    the decode loop keeps the cache sharded like the parameters.
+
+    ``quantized=True`` stores the cache **int8** with per-(token,
+    kv-head) fp32 scales (``k_s``/``v_s``, (L, B, Hkv, T, 1)): at long
+    context the cache — not the weights — dominates decode HBM traffic,
+    and the scales commute through both attention matmuls (see
+    ops/decode.py), so the kernel streams half the bytes."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
-    cache = {"k": jnp.zeros(shape, cfg.dtype),
-             "v": jnp.zeros(shape, cfg.dtype)}
+    if quantized:
+        sshape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, 1)
+        cache = {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_s": jnp.zeros(sshape, jnp.float32),
+                 "v_s": jnp.zeros(sshape, jnp.float32)}
+    else:
+        cache = {"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
     if mesh is not None:
         if rules is None:
             rules = kv_cache_shardings(
                 dp_axis="dp" if "dp" in mesh.shape else None,
-                tp_axis="tp" if "tp" in mesh.shape else None)
+                tp_axis="tp" if "tp" in mesh.shape else None,
+                quantized=quantized)
         cache = {name: jax.device_put(
             buf, NamedSharding(mesh, rules[name]))
             for name, buf in cache.items()}
@@ -57,10 +72,36 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
 
 
 def kv_cache_shardings(dp_axis: str | None = "dp",
-                       tp_axis: str | None = "tp"):
+                       tp_axis: str | None = "tp",
+                       quantized: bool = False):
     """PartitionSpec for the cache: batch over dp, KV heads over tp."""
     spec = P(None, dp_axis, None, tp_axis, None)
-    return {"k": spec, "v": spec}
+    rules = {"k": spec, "v": spec}
+    if quantized:
+        sspec = P(None, dp_axis, tp_axis, None, None)
+        rules["k_s"] = sspec
+        rules["v_s"] = sspec
+    return rules
+
+
+def _quantize_kv(x):
+    """Per-(token, kv-head) symmetric int8 for a new K or V slab.
+
+    x: (B, S, Hkv, D) -> (q8 int8 same shape, scales (B, Hkv, S, 1)
+    fp32 — the (B, Hkv, T, 1) cache layout the decode kernel's scale
+    blocks require)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)   # (B,S,Hkv,1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q8 = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q8, s[..., 0].transpose(0, 2, 1)[..., None]
+
+
+def _dequantize_kv(q8, s):
+    """Inverse of :func:`_quantize_kv`: int8 (B, T, Hkv, D) + scales in
+    the (B, Hkv, T, 1) cache layout -> fp32 (B, T, Hkv, D).  The layout
+    permutation lives here and in _quantize_kv only."""
+    return q8.astype(jnp.float32) * s[..., 0].transpose(0, 2, 1)[..., None]
 
 
 # ----------------------------------------------------------------------
@@ -92,7 +133,8 @@ def _cached_attention(q, kc, vc, positions, scale, window=None):
     return o.reshape(B, S, H * Dh).astype(q.dtype)
 
 
-def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None):
+def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None,
+                          k_s=None, v_s=None):
     """Run the Pallas decode kernel under GSPMD via shard_map: batch
     over ``dp``, heads over ``tp`` (other mesh axes replicated).
 
@@ -101,7 +143,8 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None):
     [t·Hkv/tp, (t+1)·Hkv/tp) — each shard keeps the full group ratio,
     so the local kernel call is the global computation.
 
-    q: (B, H, Dh); kc/vc: (B, T, Hkv, Dh); pos: (B,).
+    q: (B, H, Dh); kc/vc: (B, T, Hkv, Dh); pos: (B,); optional int8
+    cache scales k_s/v_s: (B, Hkv, T, 1).
     """
     from ..ops.decode import flash_decode_attention
 
@@ -109,14 +152,19 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None):
     tp = "tp" if "tp" in mesh.shape else None
     qspec = P(dp, tp, None)
     cspec = P(dp, None, tp, None)
+    sspec = P(dp, tp, None, None)
 
-    def inner(q, kc, vc, pos):
+    def inner(q, kc, vc, pos, *scales):
+        ks, vs = scales if scales else (None, None)
         return flash_decode_attention(q, kc, vc, pos, scale=scale,
-                                      window=window)
+                                      window=window, k_s=ks, v_s=vs)
 
-    return jax.shard_map(
-        inner, mesh=mesh, in_specs=(qspec, cspec, cspec, P(dp)),
-        out_specs=qspec, check_vma=False)(q, kc, vc, pos)
+    quant = k_s is not None
+    in_specs = ((qspec, cspec, cspec, P(dp))
+                + ((sspec, sspec) if quant else ()))
+    args = (q, kc, vc, pos) + ((k_s, v_s) if quant else ())
+    return jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
+                         out_specs=qspec, check_vma=False)(*args)
 
 
 def _can_flash_decode_on_mesh(mesh, B, H, Hkv):
@@ -165,27 +213,45 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     x = params["embed"][tokens].astype(cfg.dtype)
     scale = 1.0 / float(cfg.head_dim) ** 0.5
     mlp = _make_mlp_fn(cfg, mesh, ep_axis)
+    kv_quantized = "k_s" in cache
 
     def layer_step(x, inputs):
-        layer, kc, vc = inputs
+        if kv_quantized:
+            layer, kc, vc, ks, vs = inputs
+        else:
+            (layer, kc, vc), ks, vs = inputs, None, None
         h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = _rope(qlinear(h, layer["wq"]).reshape(B, S, H, Dh),
                   positions, cfg.rope_theta)
         k = _rope(qlinear(h, layer["wk"]).reshape(B, S, Hkv, Dh),
                   positions, cfg.rope_theta)
         v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                          (0, cache_len, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                          (0, cache_len, 0, 0))
+        if kv_quantized:
+            k8, k_sc = _quantize_kv(k)
+            v8, v_sc = _quantize_kv(v)
+            kc = jax.lax.dynamic_update_slice(kc, k8,
+                                              (0, cache_len, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v8,
+                                              (0, cache_len, 0, 0))
+            ks = jax.lax.dynamic_update_slice(ks, k_sc,
+                                              (0, 0, cache_len, 0))
+            vs = jax.lax.dynamic_update_slice(vs, v_sc,
+                                              (0, 0, cache_len, 0))
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (0, cache_len, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (0, cache_len, 0, 0))
         window = getattr(cfg, "sliding_window", None)
         if S == 1 and cfg.use_flash and mesh is None:
             # Decode hot path: fused Pallas kernel streams the cache
-            # once with the masked online softmax (ops/decode.py).
+            # once with the masked online softmax (ops/decode.py); an
+            # int8 cache streams at half width with its scales
+            # commuted through the matmuls.
             from ..ops.decode import flash_decode_attention
             o = flash_decode_attention(
                 q[:, 0], kc, vc, positions[:, 0], scale=scale,
-                window=window).reshape(B, 1, H * Dh)
+                window=window, k_s=ks, v_s=vs).reshape(B, 1, H * Dh)
         elif (S == 1 and cfg.use_flash and mesh is not None
               and _can_flash_decode_on_mesh(mesh, B, H, Hkv)):
             # Same kernel under GSPMD: shard_map carves the batch over
@@ -194,21 +260,36 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
             # replicate a raw pallas_call.
             o = _flash_decode_on_mesh(
                 q[:, 0], kc, vc, positions[:, 0], mesh,
-                scale, window).reshape(B, 1, H * Dh)
+                scale, window, ks, vs).reshape(B, 1, H * Dh)
         else:
-            o = _cached_attention(q, kc, vc, positions, scale,
+            if kv_quantized:
+                # Compat/prefill path: dequantize for the einsum.
+                kc_a = _dequantize_kv(kc, ks)
+                vc_a = _dequantize_kv(vc, vs)
+            else:
+                kc_a, vc_a = kc, vc
+            o = _cached_attention(q, kc_a, vc_a, positions, scale,
                                   window=window)
         x = x + qlinear(o, layer["wo"])
         x = mlp(x, layer)
-        return x, (kc, vc)
+        new_cache = ((kc, vc, ks, vs) if kv_quantized else (kc, vc))
+        return x, new_cache
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    if kv_quantized:
+        xs = (params["layers"], cache["k"], cache["v"],
+              cache["k_s"], cache["v_s"])
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer_step, x, xs)
+        new = {"k": k_new, "v": v_new, "k_s": ks_new, "v_s": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        new = {"k": k_new, "v": v_new}
     if last_only:
         x = x[:, -1:]
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = qlinear(x, params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, new
 
 
 # ----------------------------------------------------------------------
@@ -249,7 +330,7 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: int | None = None, top_p: float | None = None,
              key=None, max_len: int | None = None, mesh=None,
-             ep_axis: str = "ep"):
+             ep_axis: str = "ep", kv_quantized: bool = False):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0).
 
     Greedy when ``temperature == 0`` (default), else categorical
@@ -279,7 +360,8 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
     if T < S0 + max_new_tokens:
         raise ValueError(f"max_len {T} < prompt {S0} + new "
                          f"{max_new_tokens}")
-    cache = init_kv_cache(cfg, B, T, mesh=mesh)
+    cache = init_kv_cache(cfg, B, T, mesh=mesh,
+                          quantized=kv_quantized)
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg,
                                        last_only=True, mesh=mesh,
                                        ep_axis=ep_axis)
@@ -306,13 +388,15 @@ def make_generate_fn(cfg: TransformerConfig, max_new_tokens: int, *,
                      temperature: float = 0.0, top_k: int | None = None,
                      top_p: float | None = None,
                      max_len: int | None = None,
-                     mesh=None, ep_axis: str = "ep"):
+                     mesh=None, ep_axis: str = "ep",
+                     kv_quantized: bool = False):
     """A jitted ``(params, prompt, key) -> tokens`` closure."""
 
     def fn(params, prompt, key=None):
         return generate(params, prompt, cfg, max_new_tokens,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, key=key, max_len=max_len,
-                        mesh=mesh, ep_axis=ep_axis)
+                        mesh=mesh, ep_axis=ep_axis,
+                        kv_quantized=kv_quantized)
 
     return jax.jit(fn)

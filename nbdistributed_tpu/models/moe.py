@@ -19,7 +19,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
 from ..utils import fan_in_normal
-from .transformer import TransformerConfig, _attention_block, _rms_norm
+from .transformer import (TransformerConfig, _attention_block, _rms_norm,
+                          qlinear)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,7 +132,7 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
     (x, aux), _ = jax.lax.scan(layer_step, (x, jnp.float32(0.0)),
                                params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qlinear(x, params["lm_head"]).astype(jnp.float32)
     return logits, aux / cfg.n_layers
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .lora import ATTN_TARGETS  # one definition, shared with LoRA
 from .transformer import is_quantized  # noqa: F401  (re-export)
 
 # Weights worth quantizing: all the big matmuls.  Norm gains stay fp32,
@@ -74,19 +75,19 @@ def quantize_params(params: dict, targets=DEFAULT_TARGETS,
     return out
 
 
+def _q_spec(spec: P) -> dict:
+    """Spec pair for a quantized leaf: ``q8`` keeps the weight's spec;
+    ``s`` (shaped (..., 1, d_out)) keeps the leading/output entries
+    with the contraction entry pinned to None (its axis is size 1)."""
+    return {"q8": spec, "s": P(*spec[:-2], None, spec[-1])}
+
+
 def quantized_shardings(rules: dict, targets=DEFAULT_TARGETS,
                         quantize_lm_head: bool = True) -> dict:
-    """Map tensor-parallel rules onto a quantized pytree: ``q8`` keeps
-    the weight's spec; ``s`` (shaped (..., 1, d_out)) keeps the spec's
-    leading/output entries, with the contraction entry pinned to None
-    (its axis is size 1).  ``targets``/``quantize_lm_head`` must match
-    what was passed to :func:`quantize_params`, or device_put will die
-    on a pytree structure mismatch far from the mistake."""
-
-    def _q_spec(spec: P) -> dict:
-        s_spec = P(*spec[:-2], None, spec[-1])
-        return {"q8": spec, "s": s_spec}
-
+    """Map tensor-parallel rules onto a quantized pytree (see
+    :func:`_q_spec`).  ``targets``/``quantize_lm_head`` must match what
+    was passed to :func:`quantize_params`, or device_put will die on a
+    pytree structure mismatch far from the mistake."""
     layers = dict(rules["layers"])
     for name in targets:
         if name not in layers:
@@ -97,6 +98,39 @@ def quantized_shardings(rules: dict, targets=DEFAULT_TARGETS,
     out["layers"] = layers
     if quantize_lm_head:
         out["lm_head"] = _q_spec(rules["lm_head"])
+    return out
+
+
+EXPERT_TARGETS = ("w_gate", "w_up", "w_down")
+
+
+def quantize_moe_params(params: dict,
+                        quantize_lm_head: bool = True) -> dict:
+    """MoE-family variant: attention projections + the expert SwiGLU
+    weights (the bulk of a Mixtral-class model's bytes) go int8; the
+    router stays fp32 (tiny, and routing is precision-sensitive).
+    ``parallel.expert.moe_ffn`` dispatches on the quantized leaves the
+    same way ``qlinear`` does."""
+    out = quantize_params(params, targets=ATTN_TARGETS,
+                          quantize_lm_head=quantize_lm_head)
+    moe = dict(out["layers"]["moe"])
+    for name in EXPERT_TARGETS:
+        moe[name] = quantize_weight(moe[name])
+    out["layers"]["moe"] = moe
+    return out
+
+
+def quantized_moe_shardings(rules: dict,
+                            quantize_lm_head: bool = True) -> dict:
+    """Sharding rules matching :func:`quantize_moe_params` (same
+    structural transform as :func:`quantized_shardings`, applied to the
+    attention weights and the ``moe`` expert subtree)."""
+    out = quantized_shardings(rules, targets=ATTN_TARGETS,
+                              quantize_lm_head=quantize_lm_head)
+    moe = dict(out["layers"]["moe"])
+    for name in EXPERT_TARGETS:
+        moe[name] = _q_spec(moe[name])
+    out["layers"]["moe"] = moe
     return out
 
 

@@ -30,11 +30,19 @@ from ._common import use_interpret as _use_interpret
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_s, m_s, l_s, *, block_k: int, seq_k: int,
                    scale: float, num_kb: int,
-                   window: int | None = None):
+                   window: int | None = None,
+                   ks_ref=None, vs_ref=None):
     """One grid step = one (batch, kv-head, k-block).  The k axis rides
     the grid (sequential on-core), so only a (block_k, D) window of the
     cache is ever staged in VMEM — context length is bounded by HBM,
-    not VMEM — with the online-softmax state carried in scratch."""
+    not VMEM — with the online-softmax state carried in scratch.
+
+    With ``ks_ref``/``vs_ref`` (per-token scale blocks, (Bk, 1)), the
+    cache arrives int8 and the scales commute through both matmuls:
+    ``q . (q8_k * s_k)`` rescales the score columns, and
+    ``p @ (q8_v * s_v)`` folds ``s_v`` into ``p`` — the cache streams
+    from HBM at half width, the math is exact given the quantization.
+    """
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
@@ -70,6 +78,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # (group, Bk)
+        if ks_ref is not None:
+            # Per-token K scales rescale the score columns.
+            s = s * ks_ref[0, 0, :, 0][None, :]
         ki = (kb * block_k
               + jax.lax.broadcasted_iota(jnp.int32,
                                          (q.shape[0], block_k), 1))
@@ -82,9 +93,19 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
+        # The softmax normalizer sums the UNSCALED probabilities; only
+        # the V contraction takes the per-token V scale.
         l_s[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if vs_ref is not None:
+            # Zero out-of-bounds scale rows for the same reason as
+            # v_blk above: p is 0 there, but 0 * NaN/garbage = NaN.
+            vs = jnp.where(in_bounds[:, 0],
+                           vs_ref[0, 0, :, 0], 0.0)[None, :]
+            pv = p * vs
+        else:
+            pv = p
         acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            pv, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_s[...] = m_new
 
@@ -98,32 +119,57 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    static_argnames=("block_k", "scale", "interpret",
                                     "window"))
 def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
-                 interpret: bool, window: int | None = None):
+                 interpret: bool, window: int | None = None,
+                 k_s=None, v_s=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Hkv, group, D = q.shape
     T = kc.shape[1]
     num_kb = -(-T // block_k)
-    kernel = functools.partial(_decode_kernel, block_k=block_k,
-                               seq_k=T, scale=scale, num_kb=num_kb,
-                               window=window)
+    quantized = k_s is not None
+
+    def _kernel(pos_ref, *refs):
+        if quantized:
+            q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, a, m, l = refs
+        else:
+            (q_ref, k_ref, v_ref, o_ref, a, m, l), ks_ref, vs_ref = \
+                refs, None, None
+        _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, a, m, l,
+                       block_k=block_k, seq_k=T, scale=scale,
+                       num_kb=num_kb, window=window, ks_ref=ks_ref,
+                       vs_ref=vs_ref)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, group, D),
+                     lambda b, h, kb, pos: (b, h, 0, 0)),  # q
+        pl.BlockSpec((1, block_k, 1, D),
+                     lambda b, h, kb, pos: (b, kb, h, 0)),  # k
+        pl.BlockSpec((1, block_k, 1, D),
+                     lambda b, h, kb, pos: (b, kb, h, 0)),  # v
+    ]
+    args = [pos, q, kc, vc]
+    if quantized:
+        # Scales live as (B, Hkv, T, 1): the (1, 1, block_k, 1) block's
+        # last two dims (block_k, 1) satisfy Mosaic's (8k | equal)
+        # rule, which the (B, T, Hkv) layout cannot.
+        in_specs += [
+            pl.BlockSpec((1, 1, block_k, 1),
+                         lambda b, h, kb, pos: (b, h, kb, 0)),  # k_s
+            pl.BlockSpec((1, 1, block_k, 1),
+                         lambda b, h, kb, pos: (b, h, kb, 0)),  # v_s
+        ]
+        args += [k_s, v_s]
+
     # pos rides as a prefetched scalar array (SMEM on real TPU) —
     # the kernel indexes it by the batch program id.  The k axis is the
     # innermost grid dim: sequential on-core, scratch carries state.
     return pl.pallas_call(
-        kernel,
+        _kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, num_kb),
-            in_specs=[
-                pl.BlockSpec((1, 1, group, D),
-                             lambda b, h, kb, pos: (b, h, 0, 0)),  # q
-                pl.BlockSpec((1, block_k, 1, D),
-                             lambda b, h, kb, pos: (b, kb, h, 0)),  # k
-                pl.BlockSpec((1, block_k, 1, D),
-                             lambda b, h, kb, pos: (b, kb, h, 0)),  # v
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, group, D),
                                    lambda b, h, kb, pos: (b, h, 0, 0)),
             scratch_shapes=[
@@ -134,12 +180,13 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),
         interpret=interpret,
-    )(pos, q, kc, vc)
+    )(*args)
 
 
 def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
                            block_k: int = 128,
-                           window: int | None = None):
+                           window: int | None = None,
+                           k_s=None, v_s=None):
     """Fused decode attention: one new token per sequence against the
     cache.
 
@@ -152,11 +199,19 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     Returns (B, H, D).  Any cache length works at full block width —
     a non-multiple tail is handled by an overlapping, masked final
     block read inside the kernel.
+
+    ``k_s``/``v_s`` (both or neither, (B, Hkv, T, 1) fp32): per-token
+    per-kv-head scales for an **int8 cache** — kc/vc arrive int8 and
+    stream from HBM at half width; the scales commute through the two
+    matmuls inside the kernel (see models/quant.py for the cache
+    quantizer).
     """
     B, H, D = q.shape
     T, Hkv = kc.shape[1], kc.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if (k_s is None) != (v_s is None):
+        raise ValueError("pass both k_s and v_s, or neither")
     group = H // Hkv
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
     block_k = min(block_k, T)
@@ -165,5 +220,6 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
         raise ValueError(f"window must be >= 1, got {window}")
     out = _decode_call(qg, kc, vc, jnp.asarray(pos, jnp.int32),
                        block_k=block_k, scale=float(scale),
-                       interpret=_use_interpret(), window=window)
+                       interpret=_use_interpret(), window=window,
+                       k_s=k_s, v_s=v_s)
     return out.reshape(B, H, D)

@@ -110,6 +110,20 @@ def load_balance_loss(probs, expert_idx, n_experts: int):
     return n_experts * jnp.sum(f * p)
 
 
+def _expert_linear(xe, w, spec: str):
+    """Per-expert einsum where ``w`` is a plain array or an int8
+    weight-only quantized leaf ``{"q8", "s"}`` (models/quant.py).  The
+    scales are per (expert, output-channel) — constant along the
+    contraction dim — so they commute with the einsum exactly as in
+    ``transformer.qlinear``: the dot reads raw int8 and the rescale is
+    one fused multiply on the (E, C, out) activation."""
+    from ..models.transformer import is_quantized
+    if is_quantized(w):
+        y = jnp.einsum(spec, xe, w["q8"].astype(xe.dtype))
+        return (y.astype(jnp.float32) * w["s"]).astype(xe.dtype)
+    return jnp.einsum(spec, xe, w)
+
+
 def moe_ffn(x, params: dict, *, top_k: int = 2,
             capacity_factor: float = 1.25, mesh=None,
             ep_axis: str = "ep"):
@@ -136,9 +150,9 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     if mesh is not None and ep_axis in mesh.shape:
         sh = NamedSharding(mesh, P(ep_axis, None, None))
         xe = jax.lax.with_sharding_constraint(xe, sh)
-    h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, params["w_gate"]))
-         * jnp.einsum("ecd,edf->ecf", xe, params["w_up"]))
-    ye = jnp.einsum("ecf,efd->ecd", h, params["w_down"])
+    h = (jax.nn.silu(_expert_linear(xe, params["w_gate"], "ecd,edf->ecf"))
+         * _expert_linear(xe, params["w_up"], "ecd,edf->ecf"))
+    ye = _expert_linear(h, params["w_down"], "ecf,efd->ecd")
     if mesh is not None and ep_axis in mesh.shape:
         ye = jax.lax.with_sharding_constraint(ye, sh)
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)

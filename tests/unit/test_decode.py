@@ -183,3 +183,46 @@ def test_windowed_generation_flash_matches_einsum(monkeypatch):
     tf = generate(params, prompt, mk(True), max_new_tokens=40)
     assert calls and all(w == 24 for w in calls)
     np.testing.assert_array_equal(np.asarray(te), np.asarray(tf))
+
+
+def test_decode_kernel_int8_cache_matches_dequantized_oracle():
+    """The in-kernel scale commute must equal attention over the
+    dequantized cache (same math, different association order)."""
+    import jax
+    import jax.numpy as jnp
+    from nbdistributed_tpu.models.generate import (_cached_attention,
+                                                   _dequantize_kv,
+                                                   _quantize_kv)
+    from nbdistributed_tpu.ops.decode import flash_decode_attention
+
+    B, T, H, Hkv, D = 2, 129, 8, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+    pos = jnp.asarray([T - 1, 77], jnp.int32)
+
+    k8, k_s = _quantize_kv(k)
+    v8, v_s = _quantize_kv(v)
+    got = flash_decode_attention(q, k8, v8, pos, k_s=k_s, v_s=v_s)
+
+    # Oracle: dequantize, then exact masked attention.
+    kd = _dequantize_kv(k8, k_s)
+    vd = _dequantize_kv(v8, v_s)
+    scale = 1.0 / np.sqrt(D)
+    ref = _cached_attention(q[:, None], kd, vd, pos[:, None],
+                            scale).reshape(B, H, D)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_int8_requires_both_scales():
+    import jax.numpy as jnp
+    import pytest
+    from nbdistributed_tpu.ops.decode import flash_decode_attention
+    q = jnp.zeros((1, 4, 8))
+    kc = jnp.zeros((1, 16, 2, 8), jnp.int8)
+    s = jnp.zeros((1, 2, 16, 1))
+    with pytest.raises(ValueError, match="both k_s and v_s"):
+        flash_decode_attention(q, kc, kc, jnp.zeros((1,), jnp.int32),
+                               k_s=s)

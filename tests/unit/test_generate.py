@@ -233,3 +233,50 @@ def test_jitted_top_k_top_p(setup):
     out = fn(params, prompt, jax.random.PRNGKey(11))
     assert out.shape == (2, 10)
     assert int(jnp.max(out)) < cfg.vocab_size and int(jnp.min(out)) >= 0
+
+
+def test_kv_quantized_generation_close_to_fp(setup):
+    """Int8-cache generation: single-step logits close to the fp cache
+    path, full generation runs, and both caches agree on the argmax
+    chain for a short horizon."""
+    from nbdistributed_tpu.models import forward_with_cache, init_kv_cache
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(20), (2, 9), 0,
+                                cfg.vocab_size)
+    # Prefill logits: quantized cache vs fp cache.
+    c_fp = init_kv_cache(cfg, 2, 32)
+    c_q8 = init_kv_cache(cfg, 2, 32, quantized=True)
+    assert c_q8["k"].dtype == jnp.int8 and "k_s" in c_q8
+    lf, _ = forward_with_cache(params, prompt, c_fp, 0, cfg)
+    lq, cq = forward_with_cache(params, prompt, c_q8, 0, cfg)
+    nmse = float(jnp.mean((lq - lf) ** 2) / jnp.mean(lf ** 2))
+    assert nmse < 1e-3, nmse
+    # One decode step off the quantized cache.
+    nxt = jnp.argmax(lq[:, -1:], axis=-1).astype(jnp.int32)
+    l2, _ = forward_with_cache(params, nxt, cq, 9, cfg)
+    assert l2.shape == (2, 1, cfg.vocab_size)
+    # Full generation with the quantized cache.
+    got = generate(params, prompt, cfg, max_new_tokens=8,
+                   kv_quantized=True)
+    ref = generate(params, prompt, cfg, max_new_tokens=8)
+    assert got.shape == ref.shape
+    agree = float(jnp.mean((got[:, 9:] == ref[:, 9:]).astype(jnp.float32)))
+    assert agree > 0.7, agree
+
+
+def test_kv_quantized_on_tp_mesh(setup):
+    """Quantized cache + tp-sharded params through the mesh decode path."""
+    from nbdistributed_tpu.models import param_shardings
+    from nbdistributed_tpu.parallel.mesh import make_mesh
+    from jax.sharding import NamedSharding
+    cfg, params = setup
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+    p_s = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_shardings(cfg)))
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (2, 6), 0,
+                                cfg.vocab_size)
+    got = generate(p_s, prompt, cfg, max_new_tokens=6, mesh=mesh,
+                   kv_quantized=True)
+    ref = generate(params, prompt, cfg, max_new_tokens=6,
+                   kv_quantized=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

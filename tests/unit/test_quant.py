@@ -114,3 +114,39 @@ def test_memory_halved(setup):
     # Layer weights went fp32 -> int8 (+small scales): big shrink even
     # with embed/norms left fp.
     assert nbytes(qparams) < 0.45 * nbytes(params)
+
+
+def test_quantized_moe_forward_and_ep_mesh():
+    """MoE family: expert + attention weights int8, forward close to fp,
+    and exact across an ep mesh vs the same quantized model unsharded."""
+    from nbdistributed_tpu.models import (init_moe_model, moe_forward,
+                                          moe_model_shardings,
+                                          quantize_moe_params,
+                                          quantized_moe_shardings,
+                                          tiny_moe_config)
+
+    mcfg = tiny_moe_config(dtype=jnp.float32, use_flash=False)
+    mp = init_moe_model(jax.random.PRNGKey(0), mcfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                mcfg.vocab_size)
+    ref, _ = moe_forward(mp, tokens, mcfg)
+    qp = quantize_moe_params(mp)
+    got, _ = moe_forward(qp, tokens, mcfg)
+    # Routing can flip for borderline tokens under weight quantization
+    # (different experts -> genuinely different outputs for those few
+    # tokens), so the MoE bound is looser than the dense family's.
+    nmse = float(np.mean((np.asarray(got) - np.asarray(ref)) ** 2)
+                 / np.mean(np.asarray(ref) ** 2))
+    assert nmse < 1e-2, nmse
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    rules = quantized_moe_shardings(
+        moe_model_shardings(mcfg, tp_axis=None))
+    from jax.sharding import PartitionSpec as P
+    qp_s = jax.device_put(qp, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), rules,
+        is_leaf=lambda x: isinstance(x, P)))
+    got_s, _ = jax.jit(
+        lambda p, t: moe_forward(p, t, mcfg, mesh=mesh))(qp_s, tokens)
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(got),
+                               atol=2e-4, rtol=2e-4)
