@@ -8,6 +8,7 @@ from .hf import config_from_hf, load_hf_pretrained, params_from_hf
 from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
                    lora_num_params, lora_shardings,
                    make_lora_train_step)
+from .speculative import speculative_generate
 from .quant import (dequantize_weight, is_quantized, quantization_error,
                     quantize_moe_params, quantize_params,
                     quantize_weight, quantized_moe_shardings,
@@ -35,4 +36,5 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward", "init_params",
            "lora_num_params", "lora_shardings", "make_lora_train_step",
            "dequantize_weight", "is_quantized", "quantization_error",
            "quantize_moe_params", "quantize_params", "quantize_weight",
-           "quantized_moe_shardings", "quantized_shardings"]
+           "quantized_moe_shardings", "quantized_shardings",
+           "speculative_generate"]

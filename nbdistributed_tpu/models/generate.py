@@ -89,12 +89,11 @@ def _quantize_kv(x):
 
     x: (B, S, Hkv, D) -> (q8 int8 same shape, scales (B, Hkv, S, 1)
     fp32 — the (B, Hkv, T, 1) cache layout the decode kernel's scale
-    blocks require)."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)   # (B,S,Hkv,1)
-    s = jnp.maximum(amax, 1e-8) / 127.0
-    q8 = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
-    return q8, s[..., 0].transpose(0, 2, 1)[..., None]
+    blocks require).  The int8 core is quant.quantize_weight (one
+    scheme for weights and cache); only the layout transpose is local."""
+    from .quant import quantize_weight
+    qw = quantize_weight(x, axis=-1)
+    return qw["q8"], qw["s"][..., 0].transpose(0, 2, 1)[..., None]
 
 
 def _dequantize_kv(q8, s):

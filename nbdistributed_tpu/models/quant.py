@@ -144,9 +144,15 @@ def quantization_error(params: dict, qparams: dict) -> dict:
         err = dequantize_weight(qw) - wf
         return float(jnp.linalg.norm(err) / jnp.linalg.norm(wf))
 
-    for name, leaf in qparams["layers"].items():
-        if is_quantized(leaf):
-            report[name] = _rel(params["layers"][name], leaf)
+    def _walk(prefix, ref_tree, q_tree):
+        for name, leaf in q_tree.items():
+            if is_quantized(leaf):
+                report[prefix + name] = _rel(ref_tree[name], leaf)
+            elif isinstance(leaf, dict):
+                # Nested weight groups (the MoE 'moe' subtree).
+                _walk(prefix + name + ".", ref_tree[name], leaf)
+
+    _walk("", params["layers"], qparams["layers"])
     if is_quantized(qparams.get("lm_head")):
         report["lm_head"] = _rel(params["lm_head"], qparams["lm_head"])
     return report

@@ -1,0 +1,234 @@
+"""Speculative decoding (Leviathan et al. 2022, arXiv:2211.17192).
+
+A small draft model proposes ``gamma`` tokens autoregressively; the
+target model scores all of them in ONE batched forward (prefill-shaped
+work, MXU-friendly), and the longest valid prefix is accepted.  Decode
+latency is bounded by target-model *forwards per accepted token*, which
+drops from 1 to ~1/(mean accepted + 1) — the standard single-stream
+inference win, and TPU-native here because both the proposal loop and
+the verify pass reuse the static-shape KV-cache machinery
+(models/generate.py: fixed-length caches, position-masked attention).
+
+Rollback is free by construction: attention masks cache slots by
+position (``t <= pos``), so rejecting tokens just moves the logical
+cache length back — stale slots are overwritten before they can ever
+be read.
+
+Greedy mode reproduces the target model's own greedy decode (verified
+bit-identical against :func:`~.generate.generate` in the fp32 tests) —
+with the usual batched-vs-stepwise numerics caveat: the verify pass
+scores gamma+1 tokens in one forward while ``generate`` decodes S=1 at
+a time, so in bf16 a near-tied top-2 logit can round differently and
+flip an argmax.  Sampled mode implements
+the modified rejection scheme: accept draft token d_i with probability
+``min(1, p_t(d_i)/p_d(d_i))``; on the first rejection resample from
+``normalize(max(0, p_t - p_d))``; if all gamma survive, sample the
+bonus token from the target's next-position distribution.  The output
+distribution equals sampling from the target alone.
+
+Batch is 1 per call (per-row acceptance lengths would need per-row
+cache pointers); vmap/pmap over calls for batches of streams.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .generate import forward_with_cache, init_kv_cache
+from .transformer import TransformerConfig
+
+
+def _greedy_tok(logits):
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def speculative_generate(params: dict, draft_params: dict,
+                         prompt, cfg: TransformerConfig,
+                         draft_cfg: TransformerConfig,
+                         max_new_tokens: int, *, gamma: int = 4,
+                         temperature: float = 0.0, key=None,
+                         max_len: int | None = None):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (1, S0)
+    with draft-proposed, target-verified decoding.
+
+    Both models must share the vocabulary.  Greedy when
+    ``temperature == 0`` — output reproduces the target's own greedy
+    decode (see the module docstring for the batched-vs-stepwise
+    numerics caveat); otherwise the rejection-sampling scheme preserves
+    the target's sampling distribution (``key`` required).
+
+    Returns (tokens (1, S0 + max_new_tokens), mean_accepted) — the
+    second value is the average number of draft tokens accepted per
+    verify round (max ``gamma``), the quantity that sets the speedup.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative_generate is single-stream (batch 1); got "
+            f"batch {prompt.shape[0]}. vmap over calls for more.")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    if temperature != 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    S0 = prompt.shape[1]
+    # The token buffer over-allocates one whole round (gamma + 1) so a
+    # final round can write past the target count; the result is
+    # sliced to exactly max_new_tokens.
+    buf_len = S0 + max_new_tokens + gamma + 1
+    T = max_len if max_len is not None else buf_len
+    if T < buf_len:
+        raise ValueError(f"max_len {T} < required {buf_len} "
+                         f"(prompt + max_new_tokens + gamma + 1)")
+    cache_t = init_kv_cache(cfg, 1, T)
+    cache_d = init_kv_cache(draft_cfg, 1, T)
+
+    # Prefill both models on the prompt; the target's last-position
+    # logits seed the first accepted token.
+    logits_t, cache_t = forward_with_cache(params, prompt, cache_t, 0,
+                                           cfg, last_only=True)
+    _, cache_d = forward_with_cache(draft_params, prompt, cache_d, 0,
+                                    draft_cfg, last_only=True)
+
+    key, k0 = jax.random.split(key)
+    first = _sample_1(logits_t[:, -1], temperature, k0)
+
+    toks = jnp.zeros((1, buf_len), jnp.int32)
+    toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
+    toks = toks.at[0, S0].set(first[0])
+
+    # Carried state: token buffer, #generated (>=1 after the seed),
+    # both caches with their logical lengths (prompt is in both), rng,
+    # and the accept-count accumulators.  The caches MUST ride the
+    # loop carry — accepted tokens' K/V written in round r are read in
+    # every later round.
+    state = (toks, jnp.int32(1), cache_t, jnp.int32(S0),
+             cache_d, jnp.int32(S0), key, jnp.float32(0.0),
+             jnp.int32(0))
+
+    def cond(state):
+        return state[1] < max_new_tokens
+
+    def body(state):
+        (toks, n, cache_t, len_t, cache_d, len_d, key, acc_sum,
+         rounds) = state
+        pos_last = S0 + n - 1          # buffer index of newest token
+
+        # --- draft proposes gamma tokens from its own cache --------
+        # Step i feeds the previous token, so the draft cache receives
+        # [newest, d_1..d_{gamma-1}] — it lags one token, exactly like
+        # the target's verify write pattern below, which is why both
+        # pointers advance by n_acc + 1.
+        def draft_step(carry, i):
+            cache_d, len_d, tok, key = carry
+            lg, cache_d = forward_with_cache(
+                draft_params, tok[None, None], cache_d, len_d,
+                draft_cfg)
+            key, ks = jax.random.split(key)
+            nxt = _sample_1(lg[:, -1], temperature, ks)[0]
+            return (cache_d, len_d + 1, nxt, key), (nxt, lg[0, -1])
+
+        last_tok = jax.lax.dynamic_index_in_dim(
+            toks[0], pos_last, keepdims=False)
+        (cache_d, _, _, key), (drafts, draft_logits) = \
+            jax.lax.scan(draft_step, (cache_d, len_d, last_tok, key),
+                         jnp.arange(gamma))
+        # drafts: (gamma,) int32; draft_logits: (gamma, V)
+        # The scan wrote K/V for [newest, d_1..d_{gamma-1}] — d_gamma's
+        # K/V is still missing, and the n_acc == gamma round needs it
+        # (the pointer then advances past its slot).  One more write
+        # (logits discarded) keeps the lag-one invariant for every
+        # n_acc; the slot is stale-and-masked when d_gamma is rejected.
+        _, cache_d = forward_with_cache(
+            draft_params, drafts[-1][None, None], cache_d,
+            len_d + gamma, draft_cfg)
+
+        # --- target verifies the newest token + all proposals ------
+        verify_in = jnp.concatenate(
+            [last_tok[None], drafts])[None]          # (1, gamma+1)
+        logits_v, cache_t = forward_with_cache(
+            params, verify_in, cache_t, len_t, cfg)  # (1, g+1, V)
+
+        key, kacc, kfix = jax.random.split(key, 3)
+        n_acc, next_tok = _accept(
+            drafts, draft_logits, logits_v[0], temperature, kacc, kfix)
+
+        # --- commit ------------------------------------------------
+        # Write all gamma+1 candidate slots; only the first n_acc + 1
+        # are real — the counter never reaches the stale tail before a
+        # later round overwrites it.
+        upd = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+        upd = upd.at[n_acc].set(next_tok)
+        toks = jax.lax.dynamic_update_slice(toks, upd[None],
+                                            (0, pos_last + 1))
+        n = n + n_acc + 1
+        # Both caches now hold exactly the accepted tokens' K/V below
+        # the new pointers (each lags one token and re-feeds the
+        # newest token first); slots past the pointers are stale and
+        # position-masked until overwritten.
+        len_t = len_t + n_acc + 1
+        len_d = len_d + n_acc + 1
+        return (toks, n, cache_t, len_t, cache_d, len_d, key,
+                acc_sum + n_acc.astype(jnp.float32), rounds + 1)
+
+    toks, n, _, _, _, _, _, acc_sum, rounds = jax.lax.while_loop(
+        cond, body, state)
+    out = jax.lax.dynamic_slice(
+        toks, (0, 0), (1, S0 + max_new_tokens))
+    mean_acc = acc_sum / jnp.maximum(rounds.astype(jnp.float32), 1.0)
+    return out, mean_acc
+
+
+def _sample_1(logits, temperature: float, key):
+    """(1, V) or (V,) logits -> scalar-per-row int32 token."""
+    if temperature == 0.0:
+        return _greedy_tok(jnp.atleast_2d(logits))
+    return jax.random.categorical(
+        key, jnp.atleast_2d(logits) / temperature, axis=-1).astype(
+            jnp.int32)
+
+
+def _accept(drafts, draft_logits, verify_logits, temperature: float,
+            kacc, kfix):
+    """Acceptance rule for one round.
+
+    drafts: (g,) proposed tokens; draft_logits: (g, V) the draft's
+    logits at each proposal; verify_logits: (g+1, V) the target's
+    logits at [newest, d_1..d_g] — position i scores d_{i+1}.
+    Returns (n_acc in [0, g], next token after the accepted prefix).
+    """
+    g = drafts.shape[0]
+    if temperature == 0.0:
+        # Greedy: accept while the target's argmax equals the draft.
+        tgt = _greedy_tok(verify_logits)             # (g+1,)
+        match = tgt[:g] == drafts
+        n_acc = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((1,), bool)])).astype(jnp.int32)
+        # next token: target's argmax at the divergence position
+        # (== bonus position when everything matched).
+        return n_acc, tgt[n_acc]
+
+    pt = jax.nn.softmax(verify_logits / temperature, axis=-1)  # (g+1,V)
+    pd = jax.nn.softmax(draft_logits / temperature, axis=-1)   # (g,V)
+    pt_i = jnp.take_along_axis(pt[:g], drafts[:, None], axis=-1)[:, 0]
+    pd_i = jnp.take_along_axis(pd, drafts[:, None], axis=-1)[:, 0]
+    u = jax.random.uniform(kacc, (g,))
+    ok = u < jnp.minimum(1.0, pt_i / jnp.maximum(pd_i, 1e-20))
+    n_acc = jnp.argmin(jnp.concatenate(
+        [ok, jnp.zeros((1,), bool)])).astype(jnp.int32)
+
+    # Residual distribution at the rejection position; at the bonus
+    # position (all accepted) the residual is just p_t itself.
+    pt_at = pt[n_acc]
+    pd_at = jnp.where(n_acc < g, pd[jnp.minimum(n_acc, g - 1)], 0.0)
+    resid = jnp.maximum(pt_at - pd_at, 0.0)
+    resid = resid / jnp.maximum(jnp.sum(resid), 1e-20)
+    nxt = jax.random.choice(kfix, resid.shape[-1], p=resid)
+    return n_acc, nxt.astype(jnp.int32)

@@ -1,0 +1,91 @@
+"""Speculative decoding: greedy exactness vs the target's own decode,
+self-draft full acceptance, sampled-mode determinism, validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.models import (TransformerConfig, generate,
+                                      init_params, speculative_generate,
+                                      tiny_config)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    draft_cfg = TransformerConfig(
+        vocab_size=cfg.vocab_size, d_model=64, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, dtype=jnp.float32,
+        use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    draft = init_params(jax.random.PRNGKey(1), draft_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 7), 0,
+                                cfg.vocab_size)
+    return cfg, draft_cfg, params, draft, prompt
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_greedy_exact_vs_target_decode(setup, gamma):
+    """Greedy speculative output must be bit-identical to the target's
+    own greedy decode, for any draft and any gamma."""
+    cfg, draft_cfg, params, draft, prompt = setup
+    ref = generate(params, prompt, cfg, max_new_tokens=12)
+    got, mean_acc = speculative_generate(
+        params, draft, prompt, cfg, draft_cfg, 12, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert 0.0 <= float(mean_acc) <= gamma
+
+
+def test_self_draft_accepts_everything(setup):
+    """Draft == target: every greedy proposal matches, so every round
+    accepts all gamma tokens and output equals target greedy."""
+    cfg, _, params, _, prompt = setup
+    ref = generate(params, prompt, cfg, max_new_tokens=10)
+    got, mean_acc = speculative_generate(
+        params, params, prompt, cfg, cfg, 10, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # Every full round accepts all 4; only a final partial round can
+    # drag the mean below 4 — it must stay well above 0.
+    assert float(mean_acc) == 4.0
+
+
+def test_sampled_mode_deterministic_and_in_vocab(setup):
+    cfg, draft_cfg, params, draft, prompt = setup
+    key = jax.random.PRNGKey(9)
+    a, _ = speculative_generate(params, draft, prompt, cfg, draft_cfg,
+                                10, gamma=3, temperature=0.8, key=key)
+    b, _ = speculative_generate(params, draft, prompt, cfg, draft_cfg,
+                                10, gamma=3, temperature=0.8, key=key)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (1, 17)
+    assert int(jnp.max(a)) < cfg.vocab_size and int(jnp.min(a)) >= 0
+
+
+def test_jits(setup):
+    cfg, draft_cfg, params, draft, prompt = setup
+    fn = jax.jit(lambda p, d, t: speculative_generate(
+        p, d, t, cfg, draft_cfg, 8, gamma=2))
+    got, _ = fn(params, draft, prompt)
+    ref = generate(params, prompt, cfg, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_validation(setup):
+    cfg, draft_cfg, params, draft, prompt = setup
+    with pytest.raises(ValueError, match="single-stream"):
+        speculative_generate(params, draft,
+                             jnp.zeros((2, 4), jnp.int32), cfg,
+                             draft_cfg, 4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(params, draft, prompt, cfg, draft_cfg, 4,
+                             gamma=0)
+    with pytest.raises(ValueError, match="PRNG key"):
+        speculative_generate(params, draft, prompt, cfg, draft_cfg, 4,
+                             temperature=0.5)
+    bad_cfg = TransformerConfig(vocab_size=99, d_model=64, n_layers=1,
+                                n_heads=2, n_kv_heads=2, d_ff=128)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(params, init_params(jax.random.PRNGKey(3),
+                                                 bad_cfg),
+                             prompt, cfg, bad_cfg, 4)
