@@ -1,0 +1,131 @@
+"""Pipeline-parallel training for the transformer family.
+
+Integrates the generic GPipe schedule (parallel/pipeline.py:
+``pipeline_forward`` — stages as a mesh axis, microbatches hopping via
+``ppermute`` under one ``lax.scan``) with the real model: the L
+scan-stacked decoder layers are re-chunked into ``n_stages`` contiguous
+stage slices, each stage applies its L/n_stages layers, and the
+embedding / final norm / lm_head / loss stay outside the pipelined
+region (they are position-wise or single matmuls — GSPMD handles them
+as usual).  The backward pipelines in reverse through the transposed
+ppermutes, so ``jax.grad`` of the pipelined loss is the whole training
+story — no separate backward schedule to write.
+
+The reference has no pipeline parallelism at all (SURVEY §2.3); this is
+the model-integrated completion of the library-level strategy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.pipeline import pipeline_forward, shard_stage_params
+from .transformer import (TransformerConfig, _attention_block,
+                          _mlp_block, _rms_norm, apply_optimizer_updates,
+                          qlinear, shifted_xent)
+
+
+def pp_stage_params(params: dict, n_stages: int) -> dict:
+    """Re-chunk the (L, ...) layer stack into (n_stages, L/n_stages,
+    ...) stage slices (``layers_pp``); everything else passes through.
+    Shard ``layers_pp`` over the ``pp`` axis with
+    :func:`~nbdistributed_tpu.parallel.pipeline.shard_stage_params`."""
+    L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible into {n_stages} "
+                         f"pipeline stages")
+    out = dict(params)
+    out["layers_pp"] = jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]),
+        out.pop("layers"))
+    return out
+
+
+def pp_unstage_params(params_pp: dict) -> dict:
+    """Inverse of :func:`pp_stage_params`."""
+    out = dict(params_pp)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
+        out.pop("layers_pp"))
+    return out
+
+
+def _stage_fn(cfg: TransformerConfig, positions):
+    """One pipeline stage = scan over this stage's layer slice."""
+
+    def one_layer(x, layer):
+        x = _attention_block(x, layer, cfg, positions)
+        return _mlp_block(x, layer, cfg)
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer)
+
+    def stage(stage_layers, x):
+        return jax.lax.scan(lambda x, l: (one_layer(x, l), None),
+                            x, stage_layers)[0]
+
+    return stage
+
+
+def pp_loss_fn(params_pp: dict, batch, cfg: TransformerConfig, mesh,
+               *, pp_axis: str = "pp",
+               n_microbatches: int | None = None):
+    """Next-token cross-entropy with the layer stack pipelined over
+    ``mesh[pp_axis]``.  Same logits-shift tail as
+    ``transformer.loss_fn`` (shared ``shifted_xent``); batch rows are
+    the microbatch unit, so ``n_microbatches`` (default: n_stages)
+    must divide the batch size."""
+    tokens = batch["tokens"]
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params_pp["embed"][tokens].astype(cfg.dtype)
+    # Microbatches slice the batch dim, so each microbatch's positions
+    # are the same broadcast arange — safe to close over per-microbatch
+    # shape (B/n_micro, S).
+    n_stages = mesh.shape[pp_axis]
+    n_micro = n_microbatches if n_microbatches is not None else n_stages
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by {n_micro} "
+                         f"microbatches")
+    mb_positions = positions[: B // n_micro]
+    y = pipeline_forward(_stage_fn(cfg, mb_positions),
+                         params_pp["layers_pp"], x, mesh, axis=pp_axis,
+                         n_microbatches=n_micro)
+    y = _rms_norm(y, params_pp["final_norm"], cfg.norm_eps)
+    logits = qlinear(y, params_pp["lm_head"]).astype(jnp.float32)
+    return shifted_xent(logits, tokens)
+
+
+def make_pp_train_step(cfg: TransformerConfig, optimizer, mesh, *,
+                       pp_axis: str = "pp",
+                       n_microbatches: int | None = None):
+    """Returns ``step(params_pp, opt_state, batch) -> (params_pp,
+    opt_state, loss)`` with the layer stack pipelined.  Prepare params
+    with :func:`pp_stage_params` + ``shard_stage_params`` on
+    ``layers_pp`` (embed/norms/lm_head replicate); jit as usual."""
+
+    def step(params_pp, opt_state, batch):
+        loss, grads = jax.value_and_grad(pp_loss_fn)(
+            params_pp, batch, cfg, mesh, pp_axis=pp_axis,
+            n_microbatches=n_microbatches)
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              params_pp)
+        return (apply_optimizer_updates(params_pp, updates), opt_state,
+                loss)
+
+    return step
+
+
+def pp_apply_shardings(params_pp: dict, mesh, *, pp_axis: str = "pp"):
+    """Place ``layers_pp`` stage-sharded over ``pp_axis`` and replicate
+    the rest — the standard layout for :func:`make_pp_train_step`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = dict(params_pp)
+    out["layers_pp"] = shard_stage_params(params_pp["layers_pp"], mesh,
+                                          axis=pp_axis)
+    rep = NamedSharding(mesh, P())
+    for name in ("embed", "final_norm", "lm_head"):
+        out[name] = jax.device_put(params_pp[name], rep)
+    return out

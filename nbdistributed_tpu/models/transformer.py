@@ -319,6 +319,16 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
     return qlinear(x, params["lm_head"]).astype(jnp.float32)
 
 
+def shifted_xent(logits, tokens):
+    """The logits-shift next-token cross-entropy tail: logits (B, S, V)
+    from a full-S forward predict tokens[:, 1:] from positions 0..S-2.
+    The single definition shared by the plain, SP, and pipelined
+    losses — change it here and every path follows."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)
+    return jnp.mean(nll)
+
+
 def loss_fn(params, batch, cfg: TransformerConfig,
             sp: SeqParallel | None = None):
     """Next-token cross-entropy.  batch: {tokens (B,S)}; predicts
@@ -332,11 +342,7 @@ def loss_fn(params, batch, cfg: TransformerConfig,
     no kernel padding, and divisible by a sequence-parallel axis,
     which S-1 never is)."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, sp=sp)[:, :-1]
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return shifted_xent(forward(params, tokens, cfg, sp=sp), tokens)
 
 
 # ----------------------------------------------------------------------

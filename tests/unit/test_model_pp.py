@@ -1,0 +1,92 @@
+"""Model-level pipeline parallelism: the pipelined transformer train
+step must match the plain train step numerically."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from nbdistributed_tpu.models import (init_params, loss_fn,
+                                      make_pp_train_step,
+                                      make_train_step,
+                                      pp_apply_shardings, pp_loss_fn,
+                                      pp_stage_params,
+                                      pp_unstage_params, tiny_config)
+from nbdistributed_tpu.parallel import mesh as mesh_mod
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # 4 layers so they chunk into 4 (or 2) pipeline stages.
+    cfg = dataclasses.replace(tiny_config(dtype=jnp.float32,
+                                          use_flash=False), n_layers=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    return cfg, params, tokens
+
+
+def test_stage_roundtrip(setup):
+    cfg, params, _ = setup
+    pp = pp_stage_params(params, 2)
+    assert pp["layers_pp"]["wq"].shape[:2] == (2, 2)
+    back = pp_unstage_params(pp)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        back, params)
+    with pytest.raises(ValueError, match="divisible"):
+        pp_stage_params(params, 3)
+
+
+def test_pp_loss_matches_plain(setup):
+    cfg, params, tokens = setup
+    batch = {"tokens": tokens}
+    ref = float(loss_fn(params, batch, cfg))
+    mesh = mesh_mod.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    pp = pp_apply_shardings(pp_stage_params(params, 4), mesh)
+    got = float(jax.jit(
+        lambda p, b: pp_loss_fn(p, b, cfg, mesh))(pp, batch))
+    assert np.isclose(got, ref, atol=1e-5), (got, ref)
+
+
+def test_pp_train_step_matches_plain(setup):
+    cfg, params, tokens = setup
+    opt = optax.sgd(1e-2)
+    batch = {"tokens": tokens}
+    ref_p, _, ref_loss = jax.jit(make_train_step(cfg, opt))(
+        params, opt.init(params), batch)
+
+    mesh = mesh_mod.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    pp = pp_apply_shardings(pp_stage_params(params, 4), mesh)
+    step = jax.jit(make_pp_train_step(cfg, opt, mesh))
+    got_pp, _, got_loss = step(pp, opt.init(pp), batch)
+    assert np.isclose(float(got_loss), float(ref_loss), atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4),
+        pp_unstage_params(got_pp), ref_p)
+
+
+def test_pp_more_microbatches(setup):
+    """More microbatches than stages (smaller bubble) stays exact."""
+    cfg, params, tokens = setup
+    batch = {"tokens": tokens}
+    ref = float(loss_fn(params, batch, cfg))
+    mesh = mesh_mod.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    pp = pp_apply_shardings(pp_stage_params(params, 2), mesh)
+    got = float(jax.jit(lambda p, b: pp_loss_fn(
+        p, b, cfg, mesh, n_microbatches=4))(pp, batch))
+    assert np.isclose(got, ref, atol=1e-5), (got, ref)
+
+
+def test_pp_batch_divisibility(setup):
+    cfg, params, _ = setup
+    mesh = mesh_mod.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    pp = pp_stage_params(params, 4)
+    bad = {"tokens": jnp.zeros((3, 16), jnp.int32)}
+    with pytest.raises(ValueError, match="microbatches"):
+        pp_loss_fn(pp, bad, cfg, mesh)
