@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
 from ..utils import fan_in_normal
 from .transformer import (TransformerConfig, _attention_block, _rms_norm,
-                          qlinear)
+                          qlinear, shifted_xent)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,8 +116,13 @@ def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str):
 
 
 def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
-                ep_axis: str = "ep", positions=None):
-    """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar)."""
+                ep_axis: str = "ep", positions=None, sp=None):
+    """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar).
+
+    ``sp`` (a ``transformer.SeqParallel``) routes attention through
+    ring/Ulysses sequence parallelism, exactly as in the dense family —
+    the MoE dispatch is token-wise, so GSPMD keeps it sequence-sharded
+    for free.  Composes with ``mesh``/``ep_axis`` expert placement."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -125,7 +130,7 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
 
     def layer_step(carry, layer):
         x, aux = carry
-        x = _attention_block(x, layer, cfg, positions)
+        x = _attention_block(x, layer, cfg, positions, sp)
         x, layer_aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
         return (x, aux + layer_aux), None
 
@@ -137,12 +142,19 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
 
 
 def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
-                ep_axis: str = "ep"):
-    """Next-token cross-entropy + load-balance auxiliary."""
+                ep_axis: str = "ep", sp=None):
+    """Next-token cross-entropy + load-balance auxiliary.  Same
+    logits-shift convention as the dense family (shared
+    ``shifted_xent``): the forward runs on all S tokens, keeping S
+    divisible by a sequence-parallel axis.  For the dense model this
+    is mathematically identical to forwarding tokens[:, :-1]; for MoE
+    it is identical at lossless expert capacity (capacity_factor >=
+    n_experts/top_k — no token is ever dropped, so the extra final
+    position cannot evict anyone), and under *tight* capacity the
+    last-position tokens compete for expert slots like any others —
+    a small, benign change to the dropped-token set vs the input-shift
+    convention."""
     tokens = batch["tokens"]
-    logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh=mesh,
-                              ep_axis=ep_axis)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll) + cfg.lb_coef * aux
+    logits, aux = moe_forward(params, tokens, cfg, mesh=mesh,
+                              ep_axis=ep_axis, sp=sp)
+    return shifted_xent(logits, tokens) + cfg.lb_coef * aux

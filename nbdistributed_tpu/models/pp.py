@@ -21,8 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.pipeline import pipeline_forward, shard_stage_params
-from .transformer import (TransformerConfig, _attention_block,
-                          _mlp_block, _rms_norm, apply_optimizer_updates,
+from .transformer import (TransformerConfig, _rms_norm,
+                          apply_optimizer_updates, make_layer_fn,
                           qlinear, shifted_xent)
 
 
@@ -52,14 +52,9 @@ def pp_unstage_params(params_pp: dict) -> dict:
 
 
 def _stage_fn(cfg: TransformerConfig, positions):
-    """One pipeline stage = scan over this stage's layer slice."""
-
-    def one_layer(x, layer):
-        x = _attention_block(x, layer, cfg, positions)
-        return _mlp_block(x, layer, cfg)
-
-    if cfg.remat:
-        one_layer = jax.checkpoint(one_layer)
+    """One pipeline stage = scan over this stage's layer slice (the
+    per-layer recipe is transformer.make_layer_fn — one definition)."""
+    one_layer = make_layer_fn(cfg, positions)
 
     def stage(stage_layers, x):
         return jax.lax.scan(lambda x, l: (one_layer(x, l), None),

@@ -292,6 +292,20 @@ def _mlp_block(x, layer, cfg: TransformerConfig):
     return x + qlinear(gated, layer["w_down"])
 
 
+def make_layer_fn(cfg: TransformerConfig, positions,
+                  sp: SeqParallel | None = None):
+    """The per-layer recipe (attention block + MLP block, optionally
+    rematerialized) — one definition shared by the plain forward and
+    the pipelined stages (models/pp.py), so a change to the layer
+    structure cannot silently diverge between them."""
+
+    def one_layer(x, layer):
+        x = _attention_block(x, layer, cfg, positions, sp)
+        return _mlp_block(x, layer, cfg)
+
+    return jax.checkpoint(one_layer) if cfg.remat else one_layer
+
+
 def forward(params: dict, tokens, cfg: TransformerConfig,
             positions=None, *, sp: SeqParallel | None = None):
     """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32.
@@ -303,13 +317,7 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
-
-    def one_layer(x, layer):
-        x = _attention_block(x, layer, cfg, positions, sp)
-        return _mlp_block(x, layer, cfg)
-
-    if cfg.remat:
-        one_layer = jax.checkpoint(one_layer)
+    one_layer = make_layer_fn(cfg, positions, sp)
 
     def layer_step(x, layer):
         return one_layer(x, layer), None
