@@ -179,8 +179,12 @@ _json.dumps({{
 }})
 """
 
-# Flash kernel vs XLA reference attention (round-1's 1.74x measured
-# manually; this makes the number reproducible from bench artifacts).
+# Flash kernel vs XLA reference attention.  Timing is CHAINED: each
+# iteration's q depends on the previous output, all inside one scan
+# program, and per-call time is the (long - short) chain difference —
+# the only pattern that survives the axon tunnel's async-ack/caching
+# behavior (a plain dispatch loop + block_until_ready measured 0.03 ms
+# for a 35-GFLOP attention, 5x past the chip's peak).
 FLASH_CELL = """
 import json as _json, time as _time
 import jax as _jax, jax.numpy as _jnp
@@ -193,19 +197,28 @@ _k = _jax.random.normal(_jax.random.PRNGKey(1), (_B, _S, _Hkv, _D),
                         _jnp.bfloat16)
 _v = _jax.random.normal(_jax.random.PRNGKey(2), (_B, _S, _Hkv, _D),
                         _jnp.bfloat16)
-_ff = _jax.jit(lambda q, k, v: _flash(q, k, v, True))
-_fr = _jax.jit(lambda q, k, v: _ref(q, k, v, causal=True))
+
+def _chain_ms(f, n1=2, n2=18):
+    def _t(n):
+        def body(q, _):
+            # The 1e-3 perturbation forces a real data dependency
+            # (bf16-visible), so no step can be elided or reordered.
+            return _q + f(q, _k, _v) * 1e-3, None
+        g = _jax.jit(lambda q: _jax.lax.scan(body, q, None, length=n)[0])
+        float(g(_q).sum())            # compile + one run
+        _t0 = _time.time()
+        float(g(_q).sum())            # host fetch forces completion
+        return _time.time() - _t0
+    return (_t(n2) - _t(n1)) / (n2 - n1) * 1e3
+
 _out = {}
-for _name, _f in (("flash", _ff), ("xla_ref", _fr)):
-    _jax.block_until_ready(_f(_q, _k, _v))
-    _t0 = _time.time()
-    for _ in range(20):
-        _o = _f(_q, _k, _v)
-    _jax.block_until_ready(_o)
-    _out[_name + "_ms"] = round((_time.time() - _t0) / 20 * 1e3, 3)
+_out["flash_ms"] = round(_chain_ms(
+    lambda q, k, v: _flash(q, k, v, True)), 3)
+_out["xla_ref_ms"] = round(_chain_ms(
+    lambda q, k, v: _ref(q, k, v, causal=True)), 3)
 _out["speedup"] = round(_out["xla_ref_ms"] / _out["flash_ms"], 3)
 _out["shape"] = (f"B{_B} S{_S} H{_H} Hkv{_Hkv} D{_D} "
-                 f"{_q.dtype.name} causal")
+                 f"{_q.dtype.name} causal, chained timing")
 _json.dumps(_out)
 """
 

@@ -121,18 +121,20 @@ def lora_num_params(lora: dict) -> int:
 
 
 def make_lora_train_step(cfg: TransformerConfig, optimizer, *,
-                         alpha: float = 16.0):
+                         alpha: float = 16.0, sp=None):
     """Returns ``step(base_params, lora, opt_state, batch) ->
     (lora, opt_state, loss)``.  Only the adapter pytree is
     differentiated and updated; optimizer state exists only for adapter
     leaves.  Shard ``base_params`` with ``param_shardings`` and ``lora``
     with :func:`lora_shardings`, then jit over any dp/tp mesh exactly
-    like the full train step."""
+    like the full train step.  ``sp`` (a ``SeqParallel``) additionally
+    runs attention sequence-parallel — long-context LoRA fine-tuning
+    composes for free because the merge happens before the forward."""
 
     def step(base_params, lora, opt_state, batch):
         def adapted_loss(l):
             return loss_fn(lora_merge(base_params, l, alpha=alpha),
-                           batch, cfg)
+                           batch, cfg, sp)
 
         loss, grads = jax.value_and_grad(adapted_loss)(lora)
         updates, opt_state = optimizer.update(grads, opt_state, lora)

@@ -146,14 +146,15 @@ def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
     """Next-token cross-entropy + load-balance auxiliary.  Same
     logits-shift convention as the dense family (shared
     ``shifted_xent``): the forward runs on all S tokens, keeping S
-    divisible by a sequence-parallel axis.  For the dense model this
-    is mathematically identical to forwarding tokens[:, :-1]; for MoE
-    it is identical at lossless expert capacity (capacity_factor >=
-    n_experts/top_k — no token is ever dropped, so the extra final
-    position cannot evict anyone), and under *tight* capacity the
-    last-position tokens compete for expert slots like any others —
-    a small, benign change to the dropped-token set vs the input-shift
-    convention."""
+    divisible by a sequence-parallel axis.  Vs the old input-shift
+    convention: the xent term is identical for the dense model always
+    and for MoE at lossless capacity (capacity_factor >=
+    n_experts/top_k — the extra final position cannot evict anyone);
+    under tight capacity the final tokens compete for expert slots
+    like any others.  The load-balance *aux* term is never bit-equal —
+    it now averages router stats over T = B*S tokens instead of
+    B*(S-1) (and capacity itself scales with T) — a deliberate, tiny
+    objective change, not an oversight."""
     tokens = batch["tokens"]
     logits, aux = moe_forward(params, tokens, cfg, mesh=mesh,
                               ep_axis=ep_axis, sp=sp)

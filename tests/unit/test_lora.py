@@ -121,3 +121,33 @@ def test_tensor_parallel_lora_matches_replicated(setup):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4),
         got_lora, ref_lora)
+
+
+def test_lora_composes_with_seq_parallel(setup):
+    """LoRA train step with ring-attention SP must match the plain
+    LoRA step (the merge happens before the forward, so SP sees an
+    ordinary parameter pytree)."""
+    from jax.sharding import PartitionSpec as P
+    from nbdistributed_tpu.models import SeqParallel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg, params, tokens = setup
+    lora = lora_init(jax.random.PRNGKey(8), cfg, rank=4,
+                     targets=ALL_TARGETS)
+    opt = optax.sgd(1e-2)
+    batch = {"tokens": tokens}
+    ref_lora, _, ref_loss = jax.jit(make_lora_train_step(cfg, opt))(
+        params, lora, opt.init(lora), batch)
+
+    mesh = make_mesh({"sp": 4, "tp": 2})
+    sp = SeqParallel(mesh=mesh, method="ring", use_flash=False)
+    step = jax.jit(make_lora_train_step(cfg, opt, sp=sp))
+    tok_s = jax.device_put(tokens, NamedSharding(mesh, P(None, "sp")))
+    got_lora, _, got_loss = step(params, lora, opt.init(lora),
+                                 {"tokens": tok_s})
+    assert np.isclose(float(got_loss), float(ref_loss), atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4),
+        got_lora, ref_lora)
