@@ -201,13 +201,17 @@ _v = _jax.random.normal(_jax.random.PRNGKey(2), (_B, _S, _Hkv, _D),
 def _chain_ms(f, n1=2, n2=18):
     def _t(n):
         def body(q, _):
-            # The 1e-3 perturbation forces a real data dependency
-            # (bf16-visible), so no step can be elided or reordered.
-            return _q + f(q, _k, _v) * 1e-3, None
+            # Accumulate on the CARRY with a bf16-visible factor
+            # (1/64 > ulp at magnitude 1), so every scan step sees
+            # genuinely different values — a real data dependency no
+            # scheduler can elide.
+            return q + f(q, _k, _v) * 0.015625, None
         g = _jax.jit(lambda q: _jax.lax.scan(body, q, None, length=n)[0])
         float(g(_q).sum())            # compile + one run
         _t0 = _time.time()
-        float(g(_q).sum())            # host fetch forces completion
+        # Timed call uses a DIFFERENT input than the warmup so a
+        # program+input-level result cache can never serve it.
+        float(g(_q * 1.03125).sum())  # host fetch forces completion
         return _time.time() - _t0
     return (_t(n2) - _t(n1)) / (n2 - n1) * 1e3
 

@@ -85,6 +85,37 @@ def main() -> int:
             "execute", "'alive'").items()}
         check("error isolation (workers survive exceptions)",
               ok and out == {0: "'alive'", 1: "'alive'"}, repr(out))
+
+        # Model/kernel stack on rank 0: flash kernel exactness vs the
+        # XLA reference (real Mosaic lowering on a TPU install,
+        # interpret mode on CPU), then an int8 sampled decode.
+        model_cell = """
+import jax as _j, jax.numpy as _jn
+from nbdistributed_tpu.ops import attention_reference, flash_attention
+from nbdistributed_tpu.models import (tiny_config, init_params,
+                                      generate, quantize_params)
+_ks = _j.random.split(_j.random.PRNGKey(0), 3)
+_q = _j.random.normal(_ks[0], (1, 96, 4, 32))
+_k = _j.random.normal(_ks[1], (1, 96, 2, 32))
+_v = _j.random.normal(_ks[2], (1, 96, 2, 32))
+_err = float(_jn.max(_jn.abs(
+    flash_attention(_q, _k, _v, True)
+    - attention_reference(_q, _k, _v, causal=True))))
+_cfg = tiny_config(dtype=_jn.float32, use_flash=False)
+_p = quantize_params(init_params(_j.random.PRNGKey(0), _cfg))
+_t = generate(_p, _jn.zeros((1, 4), _jn.int32), _cfg, 4,
+              temperature=0.8, top_k=8, key=_j.random.PRNGKey(1),
+              kv_quantized=True)
+(_err < 2e-5, int(_t.shape[1]) == 8, int(_t.max()) < _cfg.vocab_size)
+"""
+        # Keep this under the 300 s cap tests/integration/
+        # test_selftest.py puts on the whole selftest subprocess, so a
+        # hung cell fails as a reported check, not a TimeoutExpired.
+        r0 = comm.send_to_ranks([0], "execute", model_cell,
+                                timeout=240)[0]
+        check("model stack (flash kernel exact, int8 sampled decode)",
+              r0.data.get("output") == "(True, True, True)",
+              repr(r0.data.get("error") or r0.data.get("output")))
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

@@ -15,4 +15,4 @@ def test_selftest_passes():
         [sys.executable, "-m", "nbdistributed_tpu.selftest"],
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "8/8 checks passed" in proc.stdout
+    assert "9/9 checks passed" in proc.stdout
