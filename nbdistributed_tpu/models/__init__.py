@@ -4,7 +4,9 @@ configs)."""
 
 from .generate import (forward_with_cache, generate, init_kv_cache,
                        kv_cache_shardings, make_generate_fn)
-from .hf import config_from_hf, load_hf_pretrained, params_from_hf
+from .hf import (config_from_hf, load_hf_pretrained,
+                 moe_config_from_hf, moe_params_from_hf,
+                 params_from_hf)
 from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
                    lora_num_params, lora_shardings,
                    make_lora_train_step)
@@ -34,6 +36,7 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward", "init_params",
            "forward_with_cache", "generate", "init_kv_cache",
            "kv_cache_shardings", "make_generate_fn",
            "config_from_hf", "load_hf_pretrained", "params_from_hf",
+           "moe_config_from_hf", "moe_params_from_hf",
            "ALL_TARGETS", "ATTN_TARGETS", "lora_init", "lora_merge",
            "lora_num_params", "lora_shardings", "make_lora_train_step",
            "dequantize_weight", "is_quantized", "quantization_error",

@@ -84,6 +84,46 @@ def _np(t) -> np.ndarray:
     return t.detach().to("cpu").float().numpy()
 
 
+def _stack(sd, fmt: str, L: int, transpose: bool) -> jnp.ndarray:
+    arrs = [_np(sd[fmt.format(i)]).T if transpose
+            else _np(sd[fmt.format(i)]) for i in range(L)]
+    return jnp.asarray(np.stack(arrs))
+
+
+def _attn_and_embed(sd, L: int, dtype):
+    """The conversion both families share: embed, (possibly tied)
+    lm_head, attention projections, and the two per-layer norms —
+    one definition so a naming/tying fix reaches dense and MoE alike."""
+    embed = _np(sd["model.embed_tokens.weight"])          # (V, D)
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T             # (D, V)
+    else:
+        lm_head = embed.T                                  # tied
+    layers = {
+        "attn_norm": _stack(
+            sd, "model.layers.{}.input_layernorm.weight", L, False
+        ).astype(jnp.float32),
+        "wq": _stack(sd, "model.layers.{}.self_attn.q_proj.weight",
+                     L, True).astype(dtype),
+        "wk": _stack(sd, "model.layers.{}.self_attn.k_proj.weight",
+                     L, True).astype(dtype),
+        "wv": _stack(sd, "model.layers.{}.self_attn.v_proj.weight",
+                     L, True).astype(dtype),
+        "wo": _stack(sd, "model.layers.{}.self_attn.o_proj.weight",
+                     L, True).astype(dtype),
+        "mlp_norm": _stack(
+            sd, "model.layers.{}.post_attention_layernorm.weight", L,
+            False).astype(jnp.float32),
+    }
+    return {
+        "embed": jnp.asarray(embed, dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(_np(sd["model.norm.weight"]),
+                                  jnp.float32),
+        "lm_head": jnp.asarray(lm_head, dtype),
+    }
+
+
 def params_from_hf(model, cfg: TransformerConfig | None = None, *,
                    dtype: Any = jnp.bfloat16) -> tuple[dict, Any]:
     """Convert a ``transformers`` ``LlamaForCausalLM``-shaped model (or
@@ -100,48 +140,74 @@ def params_from_hf(model, cfg: TransformerConfig | None = None, *,
     cfg = TransformerConfig(**{**cfg.__dict__, "dtype": dtype})
     sd = model.state_dict()
     L = cfg.n_layers
+    params = _attn_and_embed(sd, L, dtype)
+    params["layers"].update({
+        "w_gate": _stack(sd, "model.layers.{}.mlp.gate_proj.weight",
+                         L, True).astype(dtype),
+        "w_up": _stack(sd, "model.layers.{}.mlp.up_proj.weight",
+                       L, True).astype(dtype),
+        "w_down": _stack(sd, "model.layers.{}.mlp.down_proj.weight",
+                         L, True).astype(dtype),
+    })
+    return params, cfg
 
-    def linear(name: str) -> np.ndarray:
-        # (out, in) torch layout -> (in, out) right-multiply layout.
-        return _np(sd[name]).T
 
-    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
-        arrs = [linear(fmt.format(i)) if transpose
-                else _np(sd[fmt.format(i)]) for i in range(L)]
-        return jnp.asarray(np.stack(arrs))
+def moe_config_from_hf(hf_config, *,
+                       capacity_factor: float | None = None):
+    """Map a ``transformers`` Mixtral-family config onto
+    :class:`~nbdistributed_tpu.models.moe.MoEConfig`.
 
-    embed = _np(sd["model.embed_tokens.weight"])          # (V, D)
-    if "lm_head.weight" in sd:
-        lm_head = _np(sd["lm_head.weight"]).T             # (D, V)
-    else:
-        lm_head = embed.T                                  # tied
-    params = {
-        "embed": jnp.asarray(embed, dtype),
-        "layers": {
-            "attn_norm": stack(
-                "model.layers.{}.input_layernorm.weight", False
-            ).astype(jnp.float32),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight",
-                        True).astype(dtype),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight",
-                        True).astype(dtype),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight",
-                        True).astype(dtype),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight",
-                        True).astype(dtype),
-            "mlp_norm": stack(
-                "model.layers.{}.post_attention_layernorm.weight", False
-            ).astype(jnp.float32),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight",
-                            True).astype(dtype),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight",
-                          True).astype(dtype),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight",
-                            True).astype(dtype),
-        },
-        "final_norm": jnp.asarray(_np(sd["model.norm.weight"]),
-                                  jnp.float32),
-        "lm_head": jnp.asarray(lm_head, dtype),
+    HF Mixtral routes without capacity limits; this framework's
+    dispatch is capacity-bounded, so the default ``capacity_factor``
+    is the *lossless* value ``n_experts / top_k`` (no token ever
+    dropped — logits match the torch forward).  Pass a tighter factor
+    to trade exactness for bounded expert memory."""
+    from .moe import MoEConfig
+
+    E = hf_config.num_local_experts
+    k = hf_config.num_experts_per_tok
+    base = config_from_hf(hf_config)
+    if capacity_factor is None:
+        capacity_factor = E / k
+    return MoEConfig(**{**base.__dict__, "n_experts": E, "top_k": k,
+                        "capacity_factor": capacity_factor,
+                        "lb_coef": float(getattr(
+                            hf_config, "router_aux_loss_coef", 0.01))})
+
+
+def moe_params_from_hf(model, *, dtype: Any = jnp.bfloat16,
+                       capacity_factor: float | None = None):
+    """Convert a ``transformers`` ``MixtralForCausalLM``-shaped model
+    into the MoE-family pytree (attention exactly as the dense
+    conversion; router fp32 transposed; per-expert w1/w3/w2 →
+    w_gate/w_up/w_down stacked on a leading E axis).  Returns
+    ``(params, cfg)``."""
+    cfg = moe_config_from_hf(model.config,
+                             capacity_factor=capacity_factor)
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": dtype})
+    sd = model.state_dict()
+    L, E = cfg.n_layers, cfg.n_experts
+
+    def stack_experts(w: str):
+        # (L, E, in, out) from per-expert torch (out, in) tensors —
+        # cast to the target dtype PER LAYER so the fp32 transient is
+        # one (E, in, out) slab, not the whole L*E expert stack (at
+        # Mixtral-8x7B scale the difference is ~100 GB of host RAM).
+        per_layer = [jnp.asarray(np.stack([
+            _np(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}"
+                   f".{w}.weight"]).T for e in range(E)]), dtype)
+            for i in range(L)]
+        return jnp.stack(per_layer)
+
+    params = _attn_and_embed(sd, L, dtype)
+    params["layers"]["moe"] = {
+        # Router stays fp32 (gating is numerically delicate).
+        "router": jnp.asarray(np.stack([
+            _np(sd[f"model.layers.{i}.block_sparse_moe.gate"
+                   f".weight"]).T for i in range(L)]), jnp.float32),
+        "w_gate": stack_experts("w1"),
+        "w_up": stack_experts("w3"),
+        "w_down": stack_experts("w2"),
     }
     return params, cfg
 
@@ -149,14 +215,20 @@ def params_from_hf(model, cfg: TransformerConfig | None = None, *,
 def load_hf_pretrained(name_or_path: str, *,
                        dtype: Any = jnp.bfloat16) -> tuple[dict, Any]:
     """``from_pretrained`` (local path or cached hub name, torch CPU)
-    -> (params, cfg).  The heavyweight torch model is freed before
-    returning."""
-    import torch
+    -> (params, cfg).  Dispatches on architecture: Mixtral-family
+    checkpoints convert through :func:`moe_params_from_hf`, Llama
+    family through :func:`params_from_hf`.  The heavyweight torch
+    model is freed before returning."""
     from transformers import AutoModelForCausalLM
 
+    # Load in the checkpoint's own dtype: forcing fp32 would double a
+    # Mixtral-class model's host footprint before conversion (the
+    # per-tensor fp32 hop happens inside _np, one tensor at a time).
     model = AutoModelForCausalLM.from_pretrained(
-        name_or_path, dtype=torch.float32, low_cpu_mem_usage=True)
+        name_or_path, dtype="auto", low_cpu_mem_usage=True)
     try:
+        if getattr(model.config, "num_local_experts", None):
+            return moe_params_from_hf(model, dtype=dtype)
         return params_from_hf(model, dtype=dtype)
     finally:
         del model
