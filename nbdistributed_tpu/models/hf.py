@@ -84,10 +84,15 @@ def _np(t) -> np.ndarray:
     return t.detach().to("cpu").float().numpy()
 
 
-def _stack(sd, fmt: str, L: int, transpose: bool) -> jnp.ndarray:
-    arrs = [_np(sd[fmt.format(i)]).T if transpose
-            else _np(sd[fmt.format(i)]) for i in range(L)]
-    return jnp.asarray(np.stack(arrs))
+def _stack(sd, fmt: str, L: int, transpose: bool,
+           dtype=jnp.float32) -> jnp.ndarray:
+    """Stack L per-layer tensors, casting each layer to ``dtype``
+    before stacking so the fp32 transient is one layer, not the whole
+    (L, ...) stack (matters at Mixtral/Llama-7B scale)."""
+    arrs = [jnp.asarray(_np(sd[fmt.format(i)]).T if transpose
+                        else _np(sd[fmt.format(i)]), dtype)
+            for i in range(L)]
+    return jnp.stack(arrs)
 
 
 def _attn_and_embed(sd, L: int, dtype):
@@ -101,19 +106,18 @@ def _attn_and_embed(sd, L: int, dtype):
         lm_head = embed.T                                  # tied
     layers = {
         "attn_norm": _stack(
-            sd, "model.layers.{}.input_layernorm.weight", L, False
-        ).astype(jnp.float32),
+            sd, "model.layers.{}.input_layernorm.weight", L, False),
         "wq": _stack(sd, "model.layers.{}.self_attn.q_proj.weight",
-                     L, True).astype(dtype),
+                     L, True, dtype),
         "wk": _stack(sd, "model.layers.{}.self_attn.k_proj.weight",
-                     L, True).astype(dtype),
+                     L, True, dtype),
         "wv": _stack(sd, "model.layers.{}.self_attn.v_proj.weight",
-                     L, True).astype(dtype),
+                     L, True, dtype),
         "wo": _stack(sd, "model.layers.{}.self_attn.o_proj.weight",
-                     L, True).astype(dtype),
+                     L, True, dtype),
         "mlp_norm": _stack(
             sd, "model.layers.{}.post_attention_layernorm.weight", L,
-            False).astype(jnp.float32),
+            False),
     }
     return {
         "embed": jnp.asarray(embed, dtype),
@@ -143,11 +147,11 @@ def params_from_hf(model, cfg: TransformerConfig | None = None, *,
     params = _attn_and_embed(sd, L, dtype)
     params["layers"].update({
         "w_gate": _stack(sd, "model.layers.{}.mlp.gate_proj.weight",
-                         L, True).astype(dtype),
+                         L, True, dtype),
         "w_up": _stack(sd, "model.layers.{}.mlp.up_proj.weight",
-                       L, True).astype(dtype),
+                       L, True, dtype),
         "w_down": _stack(sd, "model.layers.{}.mlp.down_proj.weight",
-                         L, True).astype(dtype),
+                         L, True, dtype),
     })
     return params, cfg
 
@@ -201,10 +205,11 @@ def moe_params_from_hf(model, *, dtype: Any = jnp.bfloat16,
 
     params = _attn_and_embed(sd, L, dtype)
     params["layers"]["moe"] = {
-        # Router stays fp32 (gating is numerically delicate).
-        "router": jnp.asarray(np.stack([
-            _np(sd[f"model.layers.{i}.block_sparse_moe.gate"
-                   f".weight"]).T for i in range(L)]), jnp.float32),
+        # Router stays fp32 (gating is numerically delicate; _stack's
+        # default dtype).
+        "router": _stack(
+            sd, "model.layers.{}.block_sparse_moe.gate.weight", L,
+            True),
         "w_gate": stack_experts("w1"),
         "w_up": stack_experts("w3"),
         "w_down": stack_experts("w2"),

@@ -48,7 +48,8 @@ def speculative_generate(params: dict, draft_params: dict,
                          draft_cfg: TransformerConfig,
                          max_new_tokens: int, *, gamma: int = 4,
                          temperature: float = 0.0, key=None,
-                         max_len: int | None = None):
+                         max_len: int | None = None,
+                         kv_quantized: bool = False):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (1, S0)
     with draft-proposed, target-verified decoding.
 
@@ -87,8 +88,10 @@ def speculative_generate(params: dict, draft_params: dict,
     if T < buf_len:
         raise ValueError(f"max_len {T} < required {buf_len} "
                          f"(prompt + max_new_tokens + gamma + 1)")
-    cache_t = init_kv_cache(cfg, 1, T)
-    cache_d = init_kv_cache(draft_cfg, 1, T)
+    # int8 caches compose transparently: forward_with_cache dispatches
+    # on the cache keys, and rollback-by-pointer works identically.
+    cache_t = init_kv_cache(cfg, 1, T, quantized=kv_quantized)
+    cache_d = init_kv_cache(draft_cfg, 1, T, quantized=kv_quantized)
 
     # Prefill both models on the prompt; the target's last-position
     # logits seed the first accepted token.

@@ -109,3 +109,38 @@ def interleave_shards(shards: Sequence[dict[str, Any]]) -> dict[str, Any]:
     keys = list(shards[0])
     return {k: np.concatenate([np.asarray(s[k]) for s in shards])
             for k in keys}
+
+
+def pack_tokens(docs: Sequence[Sequence[int]], seq_len: int, *,
+                eos_id: int | None = None,
+                drop_remainder: bool = True) -> np.ndarray:
+    """Pack variable-length token documents into fixed (N, seq_len)
+    windows — the standard LM-pretraining prep: concatenate all docs
+    (optionally ``eos_id``-separated) and chunk the stream.
+
+    Static output shapes are the TPU contract: every window is exactly
+    ``seq_len`` tokens; a trailing partial window is dropped (default)
+    or right-padded with ``eos_id`` (requires one).  Feed windows of
+    ``seq_len = model_S`` straight into the logits-shift loss
+    (``models.transformer.loss_fn`` predicts positions 1..S-1 from
+    0..S-2 — no +1 fencepost to manage).
+    """
+    if seq_len < 2:
+        raise ValueError(f"seq_len must be >= 2, got {seq_len}")
+    parts: list[np.ndarray] = []
+    for d in docs:
+        parts.append(np.asarray(d, np.int32).ravel())
+        if eos_id is not None:
+            parts.append(np.asarray([eos_id], np.int32))
+    stream = (np.concatenate(parts) if parts
+              else np.zeros((0,), np.int32))
+    n_full, tail = divmod(len(stream), seq_len)
+    if tail and not drop_remainder:
+        if eos_id is None:
+            raise ValueError(
+                "drop_remainder=False needs eos_id to pad the "
+                "trailing window")
+        pad = np.full((seq_len - tail,), eos_id, np.int32)
+        stream = np.concatenate([stream, pad])
+        n_full += 1
+    return stream[: n_full * seq_len].reshape(n_full, seq_len)

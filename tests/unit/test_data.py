@@ -134,3 +134,28 @@ def test_no_drop_remainder_tiny_tail_dropped_everywhere():
 def test_shard_arrays_rejects_misaligned():
     with pytest.raises(ValueError, match="mismatch"):
         shard_arrays({"x": np.arange(10), "y": np.arange(8)}, 0, 2)
+
+
+def test_pack_tokens_basic():
+    from nbdistributed_tpu.utils.data import pack_tokens
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    out = pack_tokens(docs, 4, eos_id=0)
+    # stream: 1 2 3 0 4 5 0 6 7 8 9 0 -> windows of 4
+    assert out.shape == (3, 4)
+    assert out.tolist() == [[1, 2, 3, 0], [4, 5, 0, 6], [7, 8, 9, 0]]
+
+
+def test_pack_tokens_padding_and_validation():
+    import numpy as np
+    import pytest
+    from nbdistributed_tpu.utils.data import pack_tokens
+    out = pack_tokens([[1, 2, 3, 4, 5]], 4, eos_id=9,
+                      drop_remainder=False)
+    assert out.tolist() == [[1, 2, 3, 4], [5, 9, 9, 9]]
+    out = pack_tokens([[1, 2, 3, 4, 5]], 4)     # tail dropped
+    assert out.tolist() == [[1, 2, 3, 4]]
+    with pytest.raises(ValueError, match="seq_len"):
+        pack_tokens([[1]], 1)
+    with pytest.raises(ValueError, match="eos_id"):
+        pack_tokens([[1, 2, 3]], 2, drop_remainder=False)
+    assert pack_tokens([], 4).shape == (0, 4)
