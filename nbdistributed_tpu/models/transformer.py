@@ -256,11 +256,6 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if sp is not None:
-        if cfg.sliding_window is not None:
-            raise NotImplementedError(
-                "sliding-window attention under sequence parallelism "
-                "is not wired yet (the ring would need window-aware "
-                "hop pruning)")
         flash = cfg.use_flash if sp.use_flash is None else sp.use_flash
         batch_axis, head_axis = sp._resolved_axes()
         if sp.method == "ulysses":
@@ -268,13 +263,15 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
             o = ulysses_attention(q, k, v, sp.mesh, axis=sp.axis,
                                   causal=True, use_flash=flash,
                                   batch_axis=batch_axis,
-                                  head_axis=head_axis)
+                                  head_axis=head_axis,
+                                  window=cfg.sliding_window)
         else:
             from ..parallel.ring import ring_attention
             o = ring_attention(q, k, v, sp.mesh, axis=sp.axis,
                                causal=True, use_flash=flash,
                                batch_axis=batch_axis,
-                               head_axis=head_axis)
+                               head_axis=head_axis,
+                               window=cfg.sliding_window)
     elif cfg.use_flash:
         o = flash_attention(q, k, v, True, None, 128, 128,
                             cfg.sliding_window)

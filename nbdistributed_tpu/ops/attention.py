@@ -34,6 +34,17 @@ from ._common import use_interpret as _shared_use_interpret
 # ----------------------------------------------------------------------
 # Reference implementation (oracle + backward + CPU path)
 
+def check_window(window, causal: bool) -> None:
+    """The one window-argument validator, shared by every attention
+    entry point (reference, flash, ring, Ulysses)."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("sliding window implies causal attention")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def attention_reference(q, k, v, *, causal: bool = True,
                         scale: float | None = None,
                         window: int | None = None):
@@ -45,10 +56,7 @@ def attention_reference(q, k, v, *, causal: bool = True,
     _, Sk, Hkv, _ = k.shape
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
-    if window is not None and not causal:
-        raise ValueError("sliding window implies causal attention")
-    if window is not None and window < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    check_window(window, causal)
     group = H // Hkv
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
 
@@ -649,10 +657,7 @@ def _block_sizes(block_q, block_k, Sq, Sk):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
-    if window is not None and not causal:
-        raise ValueError("sliding window implies causal attention")
-    if window is not None and window < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    check_window(window, causal)
     D = q.shape[-1]
     bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
     out, lse = _flash_forward(q, k, v, causal=causal,

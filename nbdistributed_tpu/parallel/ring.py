@@ -48,7 +48,8 @@ _NEG_INF = -1e30
 def _ring_fn(mesh, axis: str, causal: bool, scale: float,
              use_flash: bool, schedule: str,
              batch_axis: str | None = None,
-             head_axis: str | None = None):
+             head_axis: str | None = None,
+             window: int | None = None):
     """Jitted ring kernel, cached per (mesh, axis, causal, scale, path)
     so repeated training-loop calls hit the jit cache instead of
     retracing.  ``batch_axis``/``head_axis`` put the embarrassingly
@@ -59,12 +60,13 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
     n = mesh.shape[axis]
     spec = P(batch_axis, axis, head_axis, None)
     if schedule == "zigzag":
-        inner = _make_ring_flash_zigzag(axis, n, scale)
+        inner = _make_ring_flash_zigzag(axis, n, scale, window=window)
     elif use_flash:
-        inner = _make_ring_flash(axis, n, causal, scale)
+        inner = _make_ring_flash(axis, n, causal, scale, window=window)
     else:
         inner = functools.partial(_ring_inner, axis=axis, n=n,
-                                  causal=causal, scale=scale)
+                                  causal=causal, scale=scale,
+                                  window=window)
     return jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
@@ -74,7 +76,8 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                    causal: bool = True, scale: float | None = None,
                    use_flash: bool = False, schedule: str = "plain",
                    batch_axis: str | None = None,
-                   head_axis: str | None = None):
+                   head_axis: str | None = None,
+                   window: int | None = None):
     """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
     sequence dimension.
 
@@ -133,9 +136,11 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         if q.shape[1] % (2 * n):
             raise ValueError(f"zigzag needs S divisible by 2n="
                              f"{2 * n}, got S={q.shape[1]}")
+    from ..ops.attention import check_window
+    check_window(window, causal)
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
     return _ring_fn(mesh, axis, causal, scale, use_flash, schedule,
-                    batch_axis, head_axis)(q, k, v)
+                    batch_axis, head_axis, window)(q, k, v)
 
 
 def zigzag_order(S: int, n: int):
@@ -168,7 +173,8 @@ def zigzag_unshard(x, n: int, axis: int = 1):
     return jnp.take(x, jnp.asarray(inv), axis=axis)
 
 
-def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
+                scale: float, window: int | None = None):
     """Grouped-einsum online-softmax ring (local view inside shard_map).
 
     q: (B, Sq, H, D) local chunk; k/v: (B, Sk, Hkv, D) rotating chunks.
@@ -195,7 +201,10 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
                   + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0))
             ki = (src * Sk
                   + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1))
-            s = jnp.where((ki <= qi)[None, None, None], s, _NEG_INF)
+            keep = ki <= qi
+            if window is not None:
+                keep = keep & (ki > qi - window)
+            s = jnp.where(keep[None, None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                       # (B,Hkv,g,Sq,Sk)
         corr = jnp.exp(m - m_new)                    # (B,Hkv,g,Sq,1)
@@ -239,7 +248,8 @@ def _hop_weights(w, B, Sq):
 
 
 def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
-                     block_q: int = 128, block_k: int = 128):
+                     block_q: int = 128, block_k: int = 128,
+                     window: int | None = None):
     """Builds the shard_map inner for the Pallas ring with exact
     gradients: forward folds per-hop (out, lse) pairs; backward re-rings
     K/V through the blockwise dq/dkv kernels using the saved global
@@ -274,7 +284,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
             o_j, lse_j = _flash_forward(
                 q, k_cur, v_cur, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
-                offsets=(my * Sq, src * Sk))
+                offsets=(my * Sq, src * Sk), window=window)
             O, L = _fold_hop(O, L, o_j, lse_j, B, Sq)
             k_next = jax.lax.ppermute(k_cur, axis, perm)
             v_next = jax.lax.ppermute(v_cur, axis, perm)
@@ -305,7 +315,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
                 qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq,
                 q_dtype=q.dtype, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
-                offsets=(my * Sq, src * Sk))
+                offsets=(my * Sq, src * Sk), window=window)
             dq = dq + dq_j.astype(jnp.float32)
             # dk/dv accumulators rotate WITH their chunk: after n hops
             # every chunk has collected contributions from all devices
@@ -324,7 +334,8 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
 
 
 def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
-                            block_q: int = 128, block_k: int = 128):
+                            block_q: int = 128, block_k: int = 128,
+                            window: int | None = None):
     """Zigzag causal ring (local view: the two half-chunks d and
     2n-1-d, concatenated).  Every hop runs four half-pair Pallas calls
     with exact global offsets; causal block-skip inside the kernel
@@ -378,7 +389,8 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         v_cur[:, ki * C:(ki + 1) * C],
                         causal=True, scale=scale, block_q=bq,
                         block_k=bk, interpret=interp,
-                        offsets=(q_offs[qi], k_offs[ki]))
+                        offsets=(q_offs[qi], k_offs[ki]),
+                        window=window)
                     Os[qi], Ls[qi] = _fold_hop(Os[qi], Ls[qi], o_j,
                                                lse_j, B, C)
             k_next = jax.lax.ppermute(k_cur, axis, perm)
@@ -424,7 +436,8 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         B=B, Sq=C, q_dtype=q.dtype, causal=True,
                         scale=scale, block_q=bq, block_k=bk,
                         interpret=interp,
-                        offsets=(q_offs[qi], k_offs[ki]))
+                        offsets=(q_offs[qi], k_offs[ki]),
+                        window=window)
                     dqs[qi] = dqs[qi] + dq_j.astype(jnp.float32)
                     sl = slice(ki * C, (ki + 1) * C)
                     dk_cur = dk_cur.at[:, sl].add(

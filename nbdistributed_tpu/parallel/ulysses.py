@@ -38,10 +38,12 @@ from jax.sharding import PartitionSpec as P
 @functools.lru_cache(maxsize=None)
 def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
                 use_flash: bool, batch_axis: str | None = None,
-                head_axis: str | None = None):
+                head_axis: str | None = None,
+                window: int | None = None):
     spec = P(batch_axis, axis, head_axis, None)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
-                              scale=scale, use_flash=use_flash)
+                              scale=scale, use_flash=use_flash,
+                              window=window)
     return jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
@@ -51,7 +53,8 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
                       causal: bool = True, scale: float | None = None,
                       use_flash: bool = False,
                       batch_axis: str | None = None,
-                      head_axis: str | None = None):
+                      head_axis: str | None = None,
+                      window: int | None = None):
     """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``,
     computed head-parallel after an all-to-all re-shard.
 
@@ -91,14 +94,16 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
     if v.shape[2] != Hkv:
         raise ValueError(
             f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
+    from ..ops.attention import check_window
+    check_window(window, causal)
     D = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
     return _ulysses_fn(mesh, axis, causal, scale, use_flash,
-                       batch_axis, head_axis)(q, k, v)
+                       batch_axis, head_axis, window)(q, k, v)
 
 
 def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,
-                   use_flash: bool):
+                   use_flash: bool, window: int | None = None):
     from ..ops import attention_reference, flash_attention
 
     # seq-sharded -> head-sharded: gather the full sequence, keep H/n.
@@ -110,7 +115,14 @@ def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,
         return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
+    # After the all-to-all each device holds the FULL sequence on its
+    # head slice, so the sliding window is just the local kernels'
+    # ordinary window argument.
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    attn = flash_attention if use_flash else attention_reference
-    out = attn(qh, kh, vh, causal=causal, scale=scale)
+    if use_flash:
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                              window=window)
+    else:
+        out = attention_reference(qh, kh, vh, causal=causal,
+                                  scale=scale, window=window)
     return heads_to_seq(out.astype(q.dtype))

@@ -77,14 +77,23 @@ def test_sp_train_step_matches_plain(setup):
         got_p, ref_p)
 
 
-def test_sp_rejects_sliding_window(setup):
-    cfg, params, tokens = setup
+def test_sp_sliding_window_matches_plain(setup):
+    """Sliding-window attention under SP (ring and Ulysses) must match
+    the plain sliding-window model."""
     import dataclasses
-    cfg_w = dataclasses.replace(cfg, sliding_window=8)
-    mesh = mesh_mod.make_mesh({"sp": 4, "tp": 1}, devices=jax.devices()[:4])
-    sp = SeqParallel(mesh=mesh, method="ring", use_flash=False)
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        forward(params, tokens, cfg_w, sp=sp)
+    cfg, params, tokens = setup
+    cfg_w = dataclasses.replace(cfg, sliding_window=7)
+    ref = forward(params, tokens, cfg_w)
+    for method, n_sp in (("ring", 4), ("ulysses", 2)):
+        mesh = mesh_mod.make_mesh({"sp": n_sp, "tp": 1},
+                                  devices=jax.devices()[:n_sp])
+        sp = SeqParallel(mesh=mesh, method=method, use_flash=False)
+        tok_s, p_s = _sharded(mesh, tokens, params, cfg_w)
+        got = jax.jit(lambda p, t: forward(p, t, cfg_w, sp=sp))(p_s,
+                                                                tok_s)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg=method)
 
 
 def test_sp_bad_method():
