@@ -260,6 +260,41 @@ _out["int8_speedup"] = round(_out["int8_tok_per_s"]
 _json.dumps(_out)
 """
 
+# Speculative decoding with a self-draft: acceptance is always gamma
+# (upper bound), so the row isolates the MECHANICS — how much of the
+# per-token cost the batched verify amortizes when acceptance is high.
+# A real small draft lands between this and plain decode.
+SPEC_CELL = """
+import json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.models import (generate as _gen,
+                                      init_params as _init,
+                                      smol_135m_config as _cfg_fn,
+                                      speculative_generate as _spec)
+_cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
+_p = _init(_jax.random.PRNGKey(0), _cfg)
+_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
+                              _cfg.vocab_size)
+_N, _G = 64, 4
+_sg = _jax.jit(lambda p, t: _spec(p, p, t, _cfg, _cfg, _N, gamma=_G))
+_pg = _jax.jit(lambda p, t: _gen(p, t, _cfg, _N))
+_out = {}
+_spec_r = None
+for _name, _f in (("plain", _pg), ("spec_selfdraft", _sg)):
+    _r = _f(_p, _prompt)
+    _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
+    _t0 = _time.time()
+    _r = _f(_p, _prompt)
+    _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
+    _dt = _time.time() - _t0
+    _out[_name + "_tok_per_s"] = round(_N / _dt, 1)
+    if isinstance(_r, tuple):
+        _spec_r = _r
+_out["gamma"] = _G
+_out["mean_accepted"] = round(float(_spec_r[1]), 2)
+_json.dumps(_out)
+"""
+
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
 # measurement on a 1-process world (labeled as such).
 ALLREDUCE_CELL = """
@@ -453,6 +488,23 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             except Exception as e:
                 log(f"[bench] decode comparison skipped: {e}")
 
+            try:
+                log("[bench] speculative decode (self-draft upper "
+                    "bound, smol-135M)")
+                resp = comm.send_to_ranks([0], "execute", SPEC_CELL,
+                                          timeout=1200)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] spec cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    sp = parse_result_json(m)
+                    if sp is not None:
+                        extra["speculative"] = sp
+                        log(f"[bench] speculative: {sp}")
+            except Exception as e:
+                log(f"[bench] speculative comparison skipped: {e}")
+
         try:
             # ---- all_reduce bandwidth sweep -------------------------
             log("[bench] all_reduce bandwidth sweep")
@@ -470,14 +522,42 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         except Exception as e:
             log(f"[bench] allreduce sweep skipped: {e}")
 
-        print(json.dumps({
+        result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"
                       f"_x{world}",
             "value": round(steps_per_s, 2),
             "unit": "steps/s",
             "vs_baseline": round(vs_baseline, 2),
             "extra": extra,
-        }), flush=True)
+        }
+        if backend == "tpu":
+            # Persist the successful on-chip run: the axon tunnel flaps
+            # for hours, so a later (fallback) run can still attach the
+            # last measured TPU numbers, honestly timestamped.
+            try:
+                path = os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "BENCH_TPU_LAST.json")
+                with open(path + ".tmp", "w") as f:
+                    json.dump({"measured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                        "result": result}, f, indent=1)
+                os.replace(path + ".tmp", path)   # atomic
+            except OSError as e:
+                log(f"[bench] could not persist TPU snapshot: {e}")
+        else:
+            # CPU fallback: attach the last live on-chip measurement
+            # (clearly labeled with its timestamp) so a tunnel outage
+            # at bench time doesn't erase the round's TPU evidence.
+            try:
+                with open(os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)),
+                        "BENCH_TPU_LAST.json")) as f:
+                    result["extra"]["last_live_tpu_run"] = json.load(f)
+            except (OSError, ValueError):
+                # Missing or corrupt snapshot must never sink an
+                # otherwise-successful fallback run.
+                pass
+        print(json.dumps(result), flush=True)
         return 0
     except Exception:
         import traceback

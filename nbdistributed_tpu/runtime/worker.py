@@ -143,6 +143,7 @@ class DistributedWorker:
             "load_hf_pretrained": _load_hf_pretrained_lazy,
             "batch_iterator": data_mod.batch_iterator,
             "shard_arrays": data_mod.shard_arrays,
+            "pack_tokens": data_mod.pack_tokens,
             "__rank__": self.rank,
             "__world_size__": self.world_size,
             "__builtins__": __builtins__,
