@@ -20,13 +20,15 @@ from .quant import (dequantize_weight, is_quantized, quantization_error,
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
-from .transformer import (SeqParallel, TransformerConfig, forward,
+from .transformer import (SeqParallel, TransformerConfig,
+                          fsdp_param_shardings, forward,
                           init_params, llama2_7b_config, loss_fn,
                           make_train_step, mistral_7b_config,
                           param_shardings, smol_135m_config,
                           tiny_config)
 
-__all__ = ["SeqParallel", "TransformerConfig", "forward", "init_params",
+__all__ = ["SeqParallel", "TransformerConfig", "forward",
+           "fsdp_param_shardings", "init_params",
            "llama2_7b_config", "loss_fn", "make_train_step",
            "mistral_7b_config",
            "param_shardings", "smol_135m_config", "tiny_config",
