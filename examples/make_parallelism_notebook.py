@@ -89,6 +89,31 @@ params, zstate, loss = zstep(params, zstate, batch)
 mu = jax.tree_util.tree_leaves(zstate)[0]
 print(f"loss {float(loss):.4f}; moment sharding: {mu.sharding.spec}")""")
 
+md("""### FSDP / ZeRO-3 — weight sharding via GSPMD rules
+
+`fsdp_param_shardings` shards every large weight over `dp` (2-D HSDP
+with `tp_axis`); params, grads, and optimizer state shrink by the dp
+size while XLA compiles the all-gather/reduce-scatter schedule torch
+FSDP writes by hand. Same train step, same numerics.""")
+
+code("""\
+from jax.sharding import NamedSharding
+from nbdistributed_tpu.models import fsdp_param_shardings, make_train_step
+
+fsdp_mesh = mesh_mod.make_mesh({"dp": 4}, devices=jax.devices()[:4])
+frules = fsdp_param_shardings(cfg)
+fparams = jax.device_put(params, jax.tree_util.tree_map(
+    lambda s: NamedSharding(fsdp_mesh, s), frules))
+wq = fparams["layers"]["wq"]
+print("wq bytes/device:", wq.addressable_shards[0].data.nbytes,
+      "of", wq.nbytes, "(sharded 4-way)")
+fstep = jax.jit(make_train_step(cfg, opt))
+ftok = jax.device_put(
+    jax.random.randint(jax.random.PRNGKey(13), (4, 32), 0, cfg.vocab_size),
+    NamedSharding(fsdp_mesh, P("dp")))
+_, _, floss = fstep(fparams, opt.init(fparams), {"tokens": ftok})
+print(f"FSDP train step: loss {float(floss):.4f}")""")
+
 md("""## Gradient accumulation
 
 `accum_steps=N` scans microbatches inside the compiled step (fp32

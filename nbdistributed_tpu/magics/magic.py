@@ -403,6 +403,19 @@ class DistributedMagics(Magics):
             print("⚠️ subset interrupt: if the cell was running a "
                   "collective, the un-signaled ranks stay blocked in "
                   "it — interrupt all ranks, then %sync.")
+        # SIGINT delivery is asynchronous: a signal aimed at an *idle*
+        # worker can land inside the NEXT cell and abort it instead.
+        # Absorb that race with a sacrificial probe cell — it either
+        # returns normally (signal was consumed by the idle recv) or
+        # eats the late KeyboardInterrupt itself; both outcomes leave
+        # the worker clean for the user's next real cell.  Short
+        # timeout: a worker stuck in a native call can't serve the
+        # probe, and the magic must not stall the kernel.
+        try:
+            self._comm.send_to_ranks(signaled, "execute",
+                                     "'interrupt-probe'", timeout=2)
+        except Exception:
+            pass  # a busy/aborting worker answers the probe late; fine
 
     @line_magic
     def sync(self, line):

@@ -327,6 +327,17 @@ class DistributedWorker:
             finally:
                 signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
                                            {signal_mod.SIGINT})
+                # A SIGINT that tripped during the window may not have
+                # raised yet — CPython runs the deferred handler at an
+                # arbitrary later bytecode, and re-blocking the pthread
+                # mask does NOT cancel an already-tripped flag.  Flush
+                # it HERE (sleep(0) runs PyErr_CheckSignals), so the
+                # KeyboardInterrupt surfaces inside this call frame,
+                # where every call site catches it — never later, in
+                # dispatch bookkeeping or mid reply send.  Signals
+                # arriving while masked stay OS-pending (not tripped)
+                # and deliver inside the next window, as designed.
+                time.sleep(0)
 
         while not self._shutdown.is_set():
             try:
@@ -335,6 +346,9 @@ class DistributedWorker:
                 break  # coordinator gone
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
+            # unmasked() flushed any tripped SIGINT before returning,
+            # so from here to the reply send no KeyboardInterrupt can
+            # surface: the flag is clear and OS delivery is blocked.
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
             handler = handlers.get(msg.msg_type)
@@ -357,7 +371,7 @@ class DistributedWorker:
                           "traceback": traceback.format_exc()},
                     rank=self.rank)
             try:
-                self.channel.send(reply)  # masked: no torn frames
+                self.channel.send(reply)  # masked + flushed: atomic
             except Exception:
                 break
 

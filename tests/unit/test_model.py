@@ -265,8 +265,7 @@ def _run_fsdp_case(mesh_axes, tp_axis, optimizer, key0, key1):
     genuinely sharded across all devices of the mesh."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from nbdistributed_tpu.models import (fsdp_param_shardings,
-                                          init_params, make_train_step,
-                                          tiny_config)
+                                          make_train_step)
 
     cfg = tiny_config(dtype=jnp.float32, use_flash=False)
     params = init_params(jax.random.PRNGKey(key0), cfg)
