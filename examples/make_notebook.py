@@ -13,11 +13,12 @@ C = []
 
 
 def md(src):
-    C.append(nbf.v4.new_markdown_cell(src))
+    # Deterministic ids: regeneration diffs show only real changes.
+    C.append(nbf.v4.new_markdown_cell(src, id=f"cell-{len(C)}"))
 
 
 def code(src):
-    C.append(nbf.v4.new_code_cell(src))
+    C.append(nbf.v4.new_code_cell(src, id=f"cell-{len(C)}"))
 
 
 md("""# Interactive distributed JAX on TPU — quick start

@@ -3,7 +3,8 @@ tiny demo scale up to Llama-2-7B, matching BASELINE.json's acceptance
 configs)."""
 
 from .generate import (forward_with_cache, generate, init_kv_cache,
-                       kv_cache_shardings, make_generate_fn)
+                       kv_cache_shardings, make_generate_fn,
+                       prefill_chunked)
 from .hf import (config_from_hf, load_hf_pretrained,
                  moe_config_from_hf, moe_params_from_hf,
                  params_from_hf)
@@ -36,7 +37,7 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "moe_forward", "moe_loss_fn", "moe_model_shardings",
            "tiny_moe_config",
            "forward_with_cache", "generate", "init_kv_cache",
-           "kv_cache_shardings", "make_generate_fn",
+           "kv_cache_shardings", "make_generate_fn", "prefill_chunked",
            "config_from_hf", "load_hf_pretrained", "params_from_hf",
            "moe_config_from_hf", "moe_params_from_hf",
            "ALL_TARGETS", "ATTN_TARGETS", "lora_init", "lora_merge",

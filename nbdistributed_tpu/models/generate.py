@@ -399,3 +399,37 @@ def make_generate_fn(cfg: TransformerConfig, max_new_tokens: int, *,
                         kv_quantized=kv_quantized)
 
     return jax.jit(fn)
+
+
+def prefill_chunked(params: dict, tokens, cache: dict,
+                    cfg: TransformerConfig, *, chunk: int,
+                    mesh=None, ep_axis: str = "ep"):
+    """Prefill a long prompt in fixed-size chunks: peak activation
+    memory during prefill drops from O(S_prompt) to O(chunk) while the
+    KV cache fills identically (causal attention makes chunked and
+    single-shot prefill mathematically the same computation).
+
+    tokens: (B, S) with S divisible by ``chunk``.  Returns
+    (last_logits (B, 1, V), cache) — the same contract ``last_only``
+    prefill has, ready for the decode loop.  Wrap in ``jax.jit``
+    (the chunk loop is a ``lax.scan``: one compile at chunk shape).
+    """
+    B, S = tokens.shape
+    if S % chunk:
+        raise ValueError(f"prompt length {S} not divisible by chunk "
+                         f"{chunk}")
+    n_chunks = S // chunk
+    chunks = tokens.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def step(carry, inp):
+        cache, _ = carry
+        i, tok = inp
+        logits, cache = forward_with_cache(
+            params, tok, cache, i * chunk, cfg, last_only=True,
+            mesh=mesh, ep_axis=ep_axis)
+        return (cache, logits), None
+
+    zero_logits = jnp.zeros((B, 1, cfg.vocab_size), jnp.float32)
+    (cache, last_logits), _ = jax.lax.scan(
+        step, (cache, zero_logits), (jnp.arange(n_chunks), chunks))
+    return last_logits, cache

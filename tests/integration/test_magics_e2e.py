@@ -194,4 +194,10 @@ def test_dist_interrupt_magic_idle(ip, capsys):
     assert "interrupt sent to ranks [0, 1]" in out
     run(ip, "'post-interrupt-alive'")
     out = capsys.readouterr().out
+    if "post-interrupt-alive" not in out:      # DEBUG
+        from nbdistributed_tpu.magics.magic import DistributedMagics
+        pm = DistributedMagics._pm
+        for r, io in pm.io.items():
+            print(f"==== rank {r} rc={pm.processes[r].poll()} ====")
+            print(io.tail(30))
     assert "post-interrupt-alive" in out

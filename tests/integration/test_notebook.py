@@ -149,5 +149,6 @@ def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
     assert "ring-attention train step over dp×sp×tp" in text
     assert "int8 vs bf16 top-1 agreement" in text
     assert "LoRA:" in text and "adapter params" in text
+    assert "FSDP train step: loss" in text and "sharded 4-way" in text
     assert "speculative == target greedy: True" in text
     assert "self-draft mean accepted/round: 3.00" in text

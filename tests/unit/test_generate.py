@@ -280,3 +280,35 @@ def test_kv_quantized_on_tp_mesh(setup):
     ref = generate(params, prompt, cfg, max_new_tokens=6,
                    kv_quantized=True)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_chunked_prefill_matches_single_shot(setup):
+    """Chunked prefill must fill the cache identically to one-shot
+    prefill and produce the same last-position logits — for fp and
+    int8 caches."""
+    from nbdistributed_tpu.models import (forward_with_cache,
+                                          init_kv_cache,
+                                          prefill_chunked)
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(30), (2, 12), 0,
+                                cfg.vocab_size)
+    for quantized in (False, True):
+        c1 = init_kv_cache(cfg, 2, 24, quantized=quantized)
+        ref_logits, ref_cache = forward_with_cache(
+            params, prompt, c1, 0, cfg, last_only=True)
+        c2 = init_kv_cache(cfg, 2, 24, quantized=quantized)
+        got_logits, got_cache = jax.jit(
+            lambda p, t, c: prefill_chunked(p, t, c, cfg, chunk=4)
+        )(params, prompt, c2)
+        np.testing.assert_allclose(np.asarray(got_logits),
+                                   np.asarray(ref_logits),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"quantized={quantized}")
+        for k in ref_cache:
+            np.testing.assert_allclose(
+                np.asarray(got_cache[k]).astype(np.float32),
+                np.asarray(ref_cache[k]).astype(np.float32),
+                atol=1e-5, rtol=1e-5, err_msg=f"{k} q={quantized}")
+    with pytest.raises(ValueError, match="divisible"):
+        prefill_chunked(params, prompt,
+                        init_kv_cache(cfg, 2, 24), cfg, chunk=5)
