@@ -341,14 +341,20 @@ class DistributedWorker:
 
         while not self._shutdown.is_set():
             try:
-                msg = unmasked(self.channel.recv)
+                # The channel itself scopes SIGINT to its select wait
+                # (bytes can never be lost to an interrupt mid-read —
+                # see WorkerChannel.recv); KI surfaces only here.
+                msg = self.channel.recv(interruptible=True)
             except TransportError:
                 break  # coordinator gone
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
-            # unmasked() flushed any tripped SIGINT before returning,
-            # so from here to the reply send no KeyboardInterrupt can
-            # surface: the flag is clear and OS delivery is blocked.
+            # WorkerChannel.recv(interruptible=True) scoped SIGINT to
+            # its select wait and flushed any tripped flag before
+            # returning, so from here to the reply send no
+            # KeyboardInterrupt can surface: the flag is clear and OS
+            # delivery is blocked (the handler call re-opens a window
+            # via unmasked(), which flushes the same way).
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
             handler = handlers.get(msg.msg_type)

@@ -188,16 +188,24 @@ def test_dist_logs_shows_worker_stdio(ip, capsys):
     assert "raw-stderr-marker" in out
 
 
+def _dump_worker_stdio():
+    """Failure diagnostics: print each worker's captured stdio and
+    returncode (how the byte-loss interrupt race was root-caused)."""
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    pm = DistributedMagics._pm
+    if pm is None:
+        return
+    for r, io in pm.io.items():
+        print(f"==== rank {r} rc={pm.processes[r].poll()} ====")
+        print(io.tail(30))
+
+
 def test_dist_interrupt_magic_idle(ip, capsys):
     ip.run_line_magic("dist_interrupt", "")
     out = capsys.readouterr().out
     assert "interrupt sent to ranks [0, 1]" in out
     run(ip, "'post-interrupt-alive'")
     out = capsys.readouterr().out
-    if "post-interrupt-alive" not in out:      # DEBUG
-        from nbdistributed_tpu.magics.magic import DistributedMagics
-        pm = DistributedMagics._pm
-        for r, io in pm.io.items():
-            print(f"==== rank {r} rc={pm.processes[r].poll()} ====")
-            print(io.tail(30))
+    if "post-interrupt-alive" not in out:
+        _dump_worker_stdio()
     assert "post-interrupt-alive" in out
