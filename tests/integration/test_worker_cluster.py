@@ -285,3 +285,29 @@ def test_interrupt_while_idle_is_harmless(cluster):
     time.sleep(0.5)
     out = outputs(comm.send_to_all("execute", "1 + 1"))
     assert out == {0: "2", 1: "2"}
+
+
+def test_interrupt_storm_no_deaths_no_byte_loss(cluster):
+    """Regression for the two interrupt races fixed in round 2: (a) a
+    deferred KeyboardInterrupt surfacing outside the designed windows
+    killed the worker or dropped a reply; (b) a KI between sock.recv
+    and the buffer append lost bytes, desynced the stream, and made the
+    coordinator declare a live worker dead.  Rapid idle interrupts
+    interleaved with cells hammer exactly those windows."""
+    comm, pm = cluster
+    for i in range(25):
+        pm.interrupt(None)
+        # The probe must always get a reply per rank: either it ran
+        # normally or the late signal aborted it as a clean
+        # KeyboardInterrupt error.  A timeout here IS the dropped-
+        # reply bug this test exists to catch — never swallow it.
+        probe = comm.send_to_ranks(list(range(WORLD)), "execute",
+                                   "'probe'", timeout=10)
+        for r, m in probe.items():
+            ok = (m.data.get("output") == "'probe'"
+                  or "KeyboardInterrupt" in (m.data.get("error") or ""))
+            assert ok, (i, r, m.data)
+        out = outputs(comm.send_to_all("execute", f"{i} * 2",
+                                       timeout=20))
+        assert out == {r: str(i * 2) for r in range(WORLD)}, (i, out)
+    assert pm.alive_ranks() == list(range(WORLD))
