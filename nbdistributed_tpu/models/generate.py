@@ -65,6 +65,13 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
                 dp_axis="dp" if "dp" in mesh.shape else None,
                 tp_axis="tp" if "tp" in mesh.shape else None,
                 quantized=quantized)
+        missing = set(cache) - set(rules)
+        if missing:
+            hint = (" — a quantized cache needs scale specs too (see "
+                    "kv_cache_shardings(quantized=True))"
+                    if missing & {"k_s", "v_s"} else "")
+            raise ValueError(f"cache sharding rules missing specs for "
+                             f"{sorted(missing)}{hint}")
         cache = {name: jax.device_put(
             buf, NamedSharding(mesh, rules[name]))
             for name, buf in cache.items()}
@@ -346,10 +353,14 @@ def generate(params: dict, prompt, cfg: TransformerConfig,
                          f"{max_new_tokens}")
     if max_new_tokens == 0:
         return prompt
+    if prompt.shape[1] == 0:
+        raise ValueError("cannot generate from an empty prompt "
+                         "(S == 0)")
     if temperature != 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size="
+                         f"{cfg.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
@@ -415,6 +426,10 @@ def prefill_chunked(params: dict, tokens, cache: dict,
     (the chunk loop is a ``lax.scan``: one compile at chunk shape).
     """
     B, S = tokens.shape
+    if S == 0:
+        raise ValueError("cannot prefill an empty prompt (S == 0): the "
+                         "zero-length scan would return all-zero "
+                         "logits and seed decode with token 0")
     if S % chunk:
         raise ValueError(f"prompt length {S} not divisible by chunk "
                          f"{chunk}")

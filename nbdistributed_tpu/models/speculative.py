@@ -67,6 +67,9 @@ def speculative_generate(params: dict, draft_params: dict,
         raise ValueError(
             f"speculative_generate is single-stream (batch 1); got "
             f"batch {prompt.shape[0]}. vmap over calls for more.")
+    if prompt.shape[1] == 0:
+        raise ValueError("cannot generate from an empty prompt "
+                         "(S == 0)")
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError("target and draft must share a vocabulary")
     if gamma < 1:

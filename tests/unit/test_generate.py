@@ -222,6 +222,38 @@ def test_generate_validates_sampler_args(setup):
     with pytest.raises(ValueError, match="top_p"):
         generate(params, prompt, cfg, 2, temperature=1.0, top_p=0.0,
                  key=jax.random.PRNGKey(0))
+    # top_k above the vocabulary must fail at the argument, not as an
+    # opaque lax.top_k trace error (ADVICE r2).
+    with pytest.raises(ValueError, match="vocab_size"):
+        generate(params, prompt, cfg, 2, temperature=1.0,
+                 top_k=cfg.vocab_size + 1, key=jax.random.PRNGKey(0))
+
+
+def test_empty_prompt_prefill_raises(setup):
+    """prefill_chunked(S=0) must not silently return the zero init
+    logits (which would seed decode with token 0) — ADVICE r2."""
+    from nbdistributed_tpu.models import init_kv_cache, prefill_chunked
+    cfg, params = setup
+    cache = init_kv_cache(cfg, 1, 8)
+    with pytest.raises(ValueError, match="empty prompt"):
+        prefill_chunked(params, jnp.zeros((1, 0), jnp.int32), cache,
+                        cfg, chunk=4)
+    with pytest.raises(ValueError, match="empty prompt"):
+        generate(params, jnp.zeros((1, 0), jnp.int32), cfg, 3)
+
+
+def test_quantized_cache_with_stale_rules_raises(setup):
+    """A caller-supplied rules dict that predates quantization (only
+    k/v specs) must fail with a named error, not a KeyError — ADVICE
+    r2."""
+    from nbdistributed_tpu.parallel.mesh import make_mesh
+    cfg, _ = setup
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    stale = kv_cache_shardings(dp_axis=None, tp_axis="tp",
+                               quantized=False)
+    with pytest.raises(ValueError, match="k_s"):
+        init_kv_cache(cfg, 2, 16, mesh=mesh, rules=stale,
+                      quantized=True)
 
 
 def test_jitted_top_k_top_p(setup):
