@@ -388,7 +388,7 @@ class WorkerChannel:
             self._sock.sendall(frame)
 
     def recv(self, timeout: float | None = None, *,
-             interruptible: bool = False) -> Message:
+             gate=None) -> Message:
         """Block until one complete frame arrives; raise TransportError on
         EOF (coordinator gone), TimeoutError on timeout.
 
@@ -397,21 +397,24 @@ class WorkerChannel:
         mutated — concurrent ``send()`` from the stdout-streamer or
         heartbeat thread must not inherit a read deadline mid-write.
 
-        ``interruptible=True`` (worker main-thread loop): SIGINT may
-        raise KeyboardInterrupt ONLY during the ``select`` wait, where
-        no byte has been consumed — received bytes always reach
-        ``_rbuf`` (partial frames persist across calls), so an
-        interrupt can never desync the stream.  A KI that fires between
-        ``sock.recv`` returning and the buffer append would otherwise
-        silently drop those bytes: the next frame parse then reads
-        garbage, the worker tears the connection down, and the
-        coordinator declares a perfectly alive worker dead.
+        ``gate`` (worker main-thread loop): an
+        :class:`~nbdistributed_tpu.runtime.interrupt.InterruptGate`
+        scoping SIGINT to the ``select`` wait, where no byte has been
+        consumed — received bytes always reach ``_rbuf`` (partial
+        frames persist across calls), so an interrupt can never desync
+        the stream.  A KI between ``sock.recv`` returning and the
+        buffer append would otherwise silently drop those bytes: the
+        next frame parse then reads garbage, the worker tears the
+        connection down, and the coordinator declares a perfectly alive
+        worker dead.  Outside the gate's window the handler records the
+        signal as pending (PEP 475 then restarts the interrupted
+        syscall), so byte consumption is atomic with respect to
+        interrupts no matter which OS thread received the signal.
         """
         import select as _select
         import time as _time
 
-        use_mask = (interruptible and threading.current_thread()
-                    is threading.main_thread())
+        use_gate = gate is not None and gate.main_thread()
         deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
             n = frame_ready(self._rbuf)
@@ -425,27 +428,13 @@ class WorkerChannel:
                     raise TimeoutError("recv timed out")
             else:
                 remaining = None
-            if use_mask:
-                import signal as _signal
-                try:
-                    # Unblock INSIDE the try: an OS-pending SIGINT
-                    # delivers at the unblock itself, and the finally
-                    # must still restore the mask.  KI may propagate
-                    # from this block — nothing consumed yet.
-                    _signal.pthread_sigmask(_signal.SIG_UNBLOCK,
-                                            {_signal.SIGINT})
+            if use_gate:
+                # KI may propagate from this block (pending delivered
+                # at window entry, or SIGINT during the wait) — nothing
+                # has been consumed yet, so the stream stays in sync.
+                with gate.window():
                     readable, _, _ = _select.select([self._sock], [],
                                                     [], remaining)
-                finally:
-                    _signal.pthread_sigmask(_signal.SIG_BLOCK,
-                                            {_signal.SIGINT})
-                    # Flush-and-swallow a flag that tripped at the tail
-                    # of the window (see worker.run's unmasked()): from
-                    # here on no KI may interrupt the byte consumption.
-                    try:
-                        _time.sleep(0)
-                    except KeyboardInterrupt:
-                        pass
             elif deadline is not None:
                 readable, _, _ = _select.select([self._sock], [], [],
                                                 remaining)

@@ -1,0 +1,120 @@
+"""Worker-side SIGINT discipline: a Python-level gated handler.
+
+``%dist_interrupt`` (and a forwarded Ctrl-C) delivers SIGINT to worker
+processes (the Jupyter abort idiom; the reference framework's only
+remedy for a stuck cell is destroying the cluster — reference:
+magic.py:963-1003).  The worker must convert it into "abort the running
+cell, keep serving" without ever (a) losing a reply — a dropped reply
+hangs the coordinator forever in the default ``timeout=None`` mode —
+or (b) tearing a half-written control-plane frame.
+
+An earlier design scoped SIGINT with ``pthread_sigmask``: blocked in
+the main thread except inside two windows (the idle recv ``select`` and
+the user-code handler call).  That discipline has a structural hole in
+any process with native threadpools: **a pthread mask only controls OS
+delivery to that one thread, not CPython's signal handling.**  Threads
+spawned lazily *during user code* — XLA compilation pools, gloo
+collective threads, created inside the unmasked window — inherit an
+unblocked SIGINT mask.  The kernel then delivers a process-directed
+SIGINT to one of *them* while the main thread is "masked"; CPython's
+C-level handler trips its process-global flag regardless, and the main
+thread raises KeyboardInterrupt at its next bytecode — in the middle of
+dispatch bookkeeping or the reply send, where a BaseException escapes
+the run loop and tears the worker down.  (Reproduced deterministically:
+one jitted matmul spawns five SIGINT-unblocked threads.)  That was the
+round-2 interrupt-storm tail race: it needed cells that had compiled
+something — which is why it only surfaced in loaded module runs, never
+in 1200 standalone storm cycles.
+
+This module replaces the pthread masks with a **gate checked in the
+Python handler itself**.  CPython guarantees signal handlers execute in
+the main thread, no matter which OS thread received the signal — so the
+raise-or-defer decision is made exactly once, in Python, at handler
+time:
+
+* gate **open** (interruptible window)  -> raise ``KeyboardInterrupt``;
+* gate **closed**                       -> record it as *pending*; the
+  next window entry (or :meth:`shielded` exit) raises it.
+
+Late handler runs are automatically safe: the decision happens when the
+handler *runs*, not when the signal arrived, so a SIGINT that lands on
+the last bytecode of a window and whose handler only executes after the
+window closed becomes pending instead of escaping.  No mask, no flush,
+no thread can defeat it.
+
+All gate state is touched only by the main thread (the handler runs
+there by CPython's guarantee, and windows are a main-thread-loop
+construct), so the flags need no locking.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from contextlib import contextmanager
+
+
+class InterruptGate:
+    """Decides, inside the SIGINT handler, whether to raise or defer."""
+
+    def __init__(self):
+        self._open = False
+        self.pending = False
+        self.installed = False
+
+    # ------------------------------------------------------------------
+
+    def install(self) -> "InterruptGate":
+        """Install the gated handler (main thread only; call before any
+        slow init so an early ``%dist_interrupt`` defers instead of
+        killing a half-initialized worker)."""
+        signal.signal(signal.SIGINT, self._handler)
+        self.installed = True
+        return self
+
+    def _handler(self, signum, frame) -> None:
+        if self._open:
+            raise KeyboardInterrupt
+        self.pending = True
+
+    # ------------------------------------------------------------------
+
+    @contextmanager
+    def window(self):
+        """An *interruptible* section: a pending interrupt is raised at
+        entry; SIGINT inside raises ``KeyboardInterrupt`` at the point
+        of execution; the gate closes again on exit (even via the raise
+        itself)."""
+        self._open = True
+        try:
+            if self.pending:
+                self.pending = False
+                raise KeyboardInterrupt
+            yield
+        finally:
+            self._open = False
+
+    @contextmanager
+    def shielded(self):
+        """An *uninterruptible* sub-section inside a window — e.g. a
+        control-plane send mid-cell, which must never abandon a half-
+        written frame.  A SIGINT during the block becomes pending and is
+        raised at exit, after the protected operation completed, so the
+        interrupt still aborts the surrounding cell promptly."""
+        was = self._open
+        self._open = False
+        try:
+            yield
+        finally:
+            if was:
+                self._open = True
+                if self.pending:
+                    self.pending = False
+                    raise KeyboardInterrupt
+
+    # ------------------------------------------------------------------
+
+    def main_thread(self) -> bool:
+        """Gate operations are meaningful only on the main thread (the
+        handler runs there); other threads must bypass the gate."""
+        return threading.current_thread() is threading.main_thread()

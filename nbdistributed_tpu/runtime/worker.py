@@ -25,14 +25,13 @@ from __future__ import annotations
 
 import argparse
 import os
-import signal as signal_mod
 import sys
 import threading
-import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
 from . import executor, introspect
+from .interrupt import InterruptGate
 
 
 def _load_hf_pretrained_lazy(name_or_path, **kw):
@@ -48,10 +47,17 @@ class DistributedWorker:
     def __init__(self, rank: int, world_size: int, coordinator_host: str,
                  control_port: int, dist_port: int | None = None,
                  backend: str | None = None,
-                 dist_host: str | None = None):
+                 dist_host: str | None = None,
+                 gate: InterruptGate | None = None):
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
+        # SIGINT discipline (see runtime/interrupt.py for the design
+        # and the root-cause story).  main() installs the gate before
+        # construction so interrupts during the slow init phase defer;
+        # an uninstalled gate (direct construction, e.g. in-process
+        # tests) degrades to plain default-handler semantics.
+        self._gate = gate or InterruptGate()
         # Control plane dials the kernel; the jax.distributed rendezvous
         # dials rank 0's host (they differ on all-remote host plans).
         dist_host = dist_host or coordinator_host
@@ -162,19 +168,18 @@ class DistributedWorker:
             except Exception:
                 return  # channel gone; main loop will notice
 
-    def _send_masked(self, msg: Message) -> None:
-        """Send with SIGINT blocked (main thread only — Python delivers
-        signals there): a %dist_interrupt landing mid-``sendall`` would
-        otherwise abandon a half-written frame and corrupt the control-
-        plane stream.  The pending signal is delivered on unmask, where
-        the run loop's KeyboardInterrupt handling catches it."""
-        if threading.current_thread() is threading.main_thread():
-            old = signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
-                                             {signal_mod.SIGINT})
-            try:
+    def _send_shielded(self, msg: Message) -> None:
+        """Send with interrupts deferred (main thread only — that is
+        where the gated handler runs): a %dist_interrupt landing
+        mid-``sendall`` would otherwise abandon a half-written frame and
+        corrupt the control-plane stream.  A deferred interrupt is
+        raised at shield exit — after the frame is whole — so it still
+        aborts the surrounding cell promptly.  Other threads (heartbeat,
+        user threads that print) bypass the gate: CPython never runs
+        signal handlers there."""
+        if self._gate.main_thread():
+            with self._gate.shielded():
                 self.channel.send(msg)
-            finally:
-                signal_mod.pthread_sigmask(signal_mod.SIG_SETMASK, old)
         else:
             self.channel.send(msg)
 
@@ -182,7 +187,7 @@ class DistributedWorker:
         """Push stdout/result text to the coordinator immediately
         (reference: worker.py:45-63)."""
         try:
-            self._send_masked(Message(
+            self._send_shielded(Message(
                 msg_type="stream_output", rank=self.rank,
                 data={"text": text, "stream": stream}))
         except Exception:
@@ -304,57 +309,32 @@ class DistributedWorker:
             "checkpoint": self._handle_checkpoint,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
-        # Ctrl-C) must only ever fire inside the two *interruptible*
-        # sections — the idle recv (aborts nothing, loop continues) and
-        # the handler body (user code; execute converts it to an error
-        # reply).  Everywhere else — dispatch bookkeeping, reply
-        # construction, the reply send — the signal stays masked and
-        # pending, so a request can never lose its reply and a frame
-        # can never be torn mid-write.  (A dropped reply would hang the
-        # coordinator forever in the default timeout=None mode.)
-        is_main = threading.current_thread() is threading.main_thread()
-        if is_main:
-            signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
-                                       {signal_mod.SIGINT})
-
-        def unmasked(fn, *a):
-            if not is_main:
-                return fn(*a)
-            signal_mod.pthread_sigmask(signal_mod.SIG_UNBLOCK,
-                                       {signal_mod.SIGINT})
-            try:
-                return fn(*a)
-            finally:
-                signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
-                                           {signal_mod.SIGINT})
-                # A SIGINT that tripped during the window may not have
-                # raised yet — CPython runs the deferred handler at an
-                # arbitrary later bytecode, and re-blocking the pthread
-                # mask does NOT cancel an already-tripped flag.  Flush
-                # it HERE (sleep(0) runs PyErr_CheckSignals), so the
-                # KeyboardInterrupt surfaces inside this call frame,
-                # where every call site catches it — never later, in
-                # dispatch bookkeeping or mid reply send.  Signals
-                # arriving while masked stay OS-pending (not tripped)
-                # and deliver inside the next window, as designed.
-                time.sleep(0)
-
+        # Ctrl-C) may only surface inside the two *interruptible*
+        # windows — the idle recv select (aborts nothing, loop
+        # continues) and the handler body (user code; execute converts
+        # it to an error reply).  Everywhere else — dispatch
+        # bookkeeping, reply construction, the reply send — the gated
+        # handler records it as pending for the next window, so a
+        # request can never lose its reply and a frame can never be
+        # torn mid-write.  (A dropped reply would hang the coordinator
+        # forever in the default timeout=None mode.)  The gate decides
+        # in the Python handler itself, which CPython always runs on
+        # the main thread — so it holds no matter which OS thread the
+        # kernel picked for delivery (XLA/gloo pools spawned during
+        # user code inherit an unblocked mask; a pthread-mask scheme
+        # is defeated exactly there — see runtime/interrupt.py).
+        gate = self._gate
         while not self._shutdown.is_set():
             try:
-                # The channel itself scopes SIGINT to its select wait
-                # (bytes can never be lost to an interrupt mid-read —
-                # see WorkerChannel.recv); KI surfaces only here.
-                msg = self.channel.recv(interruptible=True)
+                # The channel scopes the gate's window to its select
+                # wait: bytes can never be lost to an interrupt
+                # mid-read (see WorkerChannel.recv); KI surfaces only
+                # here.
+                msg = self.channel.recv(gate=gate)
             except TransportError:
                 break  # coordinator gone
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
-            # WorkerChannel.recv(interruptible=True) scoped SIGINT to
-            # its select wait and flushed any tripped flag before
-            # returning, so from here to the reply send no
-            # KeyboardInterrupt can surface: the flag is clear and OS
-            # delivery is blocked (the handler call re-opens a window
-            # via unmasked(), which flushes the same way).
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
             handler = handlers.get(msg.msg_type)
@@ -364,8 +344,11 @@ class DistributedWorker:
                         data={"error": f"unknown message type "
                                        f"{msg.msg_type!r}"},
                         rank=self.rank)
+                elif gate.main_thread():
+                    with gate.window():
+                        reply = handler(msg)
                 else:
-                    reply = unmasked(handler, msg)
+                    reply = handler(msg)
             except KeyboardInterrupt:
                 # Interrupt racing a non-execute handler: report and
                 # keep serving (execute handles its own, in executor).
@@ -377,7 +360,7 @@ class DistributedWorker:
                           "traceback": traceback.format_exc()},
                     rank=self.rank)
             try:
-                self.channel.send(reply)  # masked + flushed: atomic
+                self.channel.send(reply)  # gate closed: frame is atomic
             except Exception:
                 break
 
@@ -411,22 +394,22 @@ def main(argv: list[str] | None = None) -> int:
                    help="force a JAX platform (cpu for tests/CI)")
     args = p.parse_args(argv)
 
-    # Mask SIGINT for the whole init phase.  The HELLO (readiness
-    # signal) goes out during __init__, so a %dist_interrupt can arrive
-    # while this process is still seeding its namespace — before run()
-    # establishes the masked/unmasked interrupt discipline.  Masking
-    # here makes such an early interrupt *pending* until the first
-    # unmasked idle recv, where it aborts nothing and the loop
+    # Install the interrupt gate (closed) before the slow init phase.
+    # The HELLO (readiness signal) goes out during __init__, so a
+    # %dist_interrupt can arrive while this process is still seeding
+    # its namespace — before run() establishes the window discipline.
+    # A closed gate makes such an early interrupt *pending* until the
+    # first idle recv window, where it aborts nothing and the loop
     # continues — instead of killing a half-initialized worker.
+    gate = InterruptGate()
     if threading.current_thread() is threading.main_thread():
-        signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK,
-                                   {signal_mod.SIGINT})
+        gate.install()
 
     worker = DistributedWorker(
         rank=args.rank, world_size=args.world_size,
         coordinator_host=args.coordinator_host,
         control_port=args.control_port, dist_port=args.dist_port,
-        backend=args.backend, dist_host=args.dist_host)
+        backend=args.backend, dist_host=args.dist_host, gate=gate)
     try:
         worker.run()
     finally:
