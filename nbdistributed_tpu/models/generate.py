@@ -201,7 +201,10 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                        last_only: bool = False, mesh=None,
                        ep_axis: str = "ep"):
     """Run ``tokens`` (B, S) through the model, reading/writing the KV
-    cache at offset ``cache_len`` (traced scalar ok).
+    cache at offset ``cache_len`` (traced scalar ok, or a per-row
+    ``(B,)`` vector when the streams in the batch sit at different
+    logical lengths — batched speculative decoding advances each
+    stream by its own acceptance count).
 
     Works for both model families: the attention stack is shared and
     the feed-forward branch dispatches on the config (dense SwiGLU vs
@@ -215,11 +218,29 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     """
     B, S = tokens.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    positions = cache_len + jnp.broadcast_to(jnp.arange(S), (B, S))
+    cache_len = jnp.asarray(cache_len)
+    per_row = cache_len.ndim == 1  # per-stream cache pointers
+    offs = cache_len[:, None] if per_row else cache_len
+    positions = offs + jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
     scale = 1.0 / float(cfg.head_dim) ** 0.5
     mlp = _make_mlp_fn(cfg, mesh, ep_axis)
     kv_quantized = "k_s" in cache
+
+    def write_kv(buf, new, *, scale_layout=False):
+        """Insert S new entries at the cache pointer: one slice update
+        for a shared scalar pointer, a per-row (vmapped, scatter-
+        lowered) update for per-stream pointers.  ``scale_layout``
+        selects the (B, Hkv, T, 1) int8-scale layout whose token axis
+        sits at -2."""
+        if per_row:
+            start = ((lambda s: (0, s, 0)) if scale_layout
+                     else (lambda s: (s, 0, 0)))
+            return jax.vmap(lambda c, u, s: jax.lax.dynamic_update_slice(
+                c, u, start(s)))(buf, new, cache_len)
+        start = ((0, 0, cache_len, 0) if scale_layout
+                 else (0, cache_len, 0, 0))
+        return jax.lax.dynamic_update_slice(buf, new, start)
 
     def layer_step(x, inputs):
         if kv_quantized:
@@ -235,19 +256,13 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
         if kv_quantized:
             k8, k_sc = _quantize_kv(k)
             v8, v_sc = _quantize_kv(v)
-            kc = jax.lax.dynamic_update_slice(kc, k8,
-                                              (0, cache_len, 0, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v8,
-                                              (0, cache_len, 0, 0))
-            ks = jax.lax.dynamic_update_slice(ks, k_sc,
-                                              (0, 0, cache_len, 0))
-            vs = jax.lax.dynamic_update_slice(vs, v_sc,
-                                              (0, 0, cache_len, 0))
+            kc = write_kv(kc, k8)
+            vc = write_kv(vc, v8)
+            ks = write_kv(ks, k_sc, scale_layout=True)
+            vs = write_kv(vs, v_sc, scale_layout=True)
         else:
-            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                              (0, cache_len, 0, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                              (0, cache_len, 0, 0))
+            kc = write_kv(kc, k.astype(kc.dtype))
+            vc = write_kv(vc, v.astype(vc.dtype))
         window = getattr(cfg, "sliding_window", None)
         if S == 1 and cfg.use_flash and mesh is None:
             # Decode hot path: fused Pallas kernel streams the cache

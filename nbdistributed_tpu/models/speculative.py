@@ -4,30 +4,52 @@ A small draft model proposes ``gamma`` tokens autoregressively; the
 target model scores all of them in ONE batched forward (prefill-shaped
 work, MXU-friendly), and the longest valid prefix is accepted.  Decode
 latency is bounded by target-model *forwards per accepted token*, which
-drops from 1 to ~1/(mean accepted + 1) — the standard single-stream
-inference win, and TPU-native here because both the proposal loop and
-the verify pass reuse the static-shape KV-cache machinery
-(models/generate.py: fixed-length caches, position-masked attention).
+drops from 1 to ~1/(mean accepted + 1) — and TPU-native here because
+both the proposal loop and the verify pass reuse the static-shape
+KV-cache machinery (models/generate.py: fixed-length caches,
+position-masked attention).
 
-Rollback is free by construction: attention masks cache slots by
-position (``t <= pos``), so rejecting tokens just moves the logical
-cache length back — stale slots are overwritten before they can ever
-be read.
+**Batched streams share every forward.**  All B streams ride one
+(B, gamma+1) verify call and one (B, 1) draft call per proposal step —
+the verify matmuls grow along the batch axis, which is exactly how the
+MXU wants them (a B=8 verify is ~the cost of a B=1 verify at these
+sizes, so speculation's win multiplies across streams).  Streams accept
+different prefix lengths per round, so each row keeps its own logical
+cache pointer: ``forward_with_cache`` takes a per-row ``(B,)``
+``cache_len``, positions are masked per row (``t <= pos_b``), and cache
+writes land at per-row offsets.  Rollback is free by construction:
+rejecting tokens just moves a row's pointer back — stale slots are
+position-masked until overwritten.
+
+Finished streams freeze: their advance is masked to zero and their
+(recomputed, identical) writes land in slots beyond the output slice,
+so the while-loop runs until the *slowest* stream reaches
+``max_new_tokens`` without any stream overshooting its committed
+output.
+
+Stream independence holds exactly for the dense family (asserted
+bit-identical to solo runs in the tests).  **MoE configs are the
+qualification**: capacity-based expert dispatch pools all rows' tokens
+into one capacity buffer (parallel/expert.py), so streams in a batch
+couple through capacity drops in *any* batched MoE decode — and a
+frozen stream's discarded recomputation still occupies dispatch slots,
+which can evict an active row's token to the residual path.  Batched
+speculative MoE therefore matches batched MoE decode semantics, not
+solo-run semantics; decoupling would need an active-row mask plumbed
+into the router gates.
 
 Greedy mode reproduces the target model's own greedy decode (verified
 bit-identical against :func:`~.generate.generate` in the fp32 tests) —
 with the usual batched-vs-stepwise numerics caveat: the verify pass
 scores gamma+1 tokens in one forward while ``generate`` decodes S=1 at
 a time, so in bf16 a near-tied top-2 logit can round differently and
-flip an argmax.  Sampled mode implements
-the modified rejection scheme: accept draft token d_i with probability
+flip an argmax.  Sampled mode implements the modified rejection scheme
+per stream: accept draft token d_i with probability
 ``min(1, p_t(d_i)/p_d(d_i))``; on the first rejection resample from
 ``normalize(max(0, p_t - p_d))``; if all gamma survive, sample the
 bonus token from the target's next-position distribution.  The output
-distribution equals sampling from the target alone.
-
-Batch is 1 per call (per-row acceptance lengths would need per-row
-cache pointers); vmap/pmap over calls for batches of streams.
+distribution equals sampling from the target alone, independently per
+stream.
 """
 
 from __future__ import annotations
@@ -50,23 +72,24 @@ def speculative_generate(params: dict, draft_params: dict,
                          temperature: float = 0.0, key=None,
                          max_len: int | None = None,
                          kv_quantized: bool = False):
-    """Generate ``max_new_tokens`` continuations of ``prompt`` (1, S0)
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0)
     with draft-proposed, target-verified decoding.
 
     Both models must share the vocabulary.  Greedy when
-    ``temperature == 0`` — output reproduces the target's own greedy
-    decode (see the module docstring for the batched-vs-stepwise
-    numerics caveat); otherwise the rejection-sampling scheme preserves
-    the target's sampling distribution (``key`` required).
+    ``temperature == 0`` — each stream's output reproduces the target's
+    own greedy decode (see the module docstring for the
+    batched-vs-stepwise numerics caveat); otherwise the
+    rejection-sampling scheme preserves the target's sampling
+    distribution per stream (``key`` required).
 
-    Returns (tokens (1, S0 + max_new_tokens), mean_accepted) — the
+    Returns (tokens (B, S0 + max_new_tokens), mean_accepted) — the
     second value is the average number of draft tokens accepted per
-    verify round (max ``gamma``), the quantity that sets the speedup.
+    verify round per active stream (max ``gamma``), the quantity that
+    sets the speedup.
     """
-    if prompt.shape[0] != 1:
-        raise ValueError(
-            f"speculative_generate is single-stream (batch 1); got "
-            f"batch {prompt.shape[0]}. vmap over calls for more.")
+    B = prompt.shape[0]
+    if B < 1:
+        raise ValueError(f"need at least one stream, got batch {B}")
     if prompt.shape[1] == 0:
         raise ValueError("cannot generate from an empty prompt "
                          "(S == 0)")
@@ -93,39 +116,43 @@ def speculative_generate(params: dict, draft_params: dict,
                          f"(prompt + max_new_tokens + gamma + 1)")
     # int8 caches compose transparently: forward_with_cache dispatches
     # on the cache keys, and rollback-by-pointer works identically.
-    cache_t = init_kv_cache(cfg, 1, T, quantized=kv_quantized)
-    cache_d = init_kv_cache(draft_cfg, 1, T, quantized=kv_quantized)
+    cache_t = init_kv_cache(cfg, B, T, quantized=kv_quantized)
+    cache_d = init_kv_cache(draft_cfg, B, T, quantized=kv_quantized)
 
-    # Prefill both models on the prompt; the target's last-position
-    # logits seed the first accepted token.
+    # Prefill both models on the prompt (streams still aligned, so the
+    # pointer is a shared scalar 0 here); the target's last-position
+    # logits seed the first accepted token of every stream.
     logits_t, cache_t = forward_with_cache(params, prompt, cache_t, 0,
                                            cfg, last_only=True)
     _, cache_d = forward_with_cache(draft_params, prompt, cache_d, 0,
                                     draft_cfg, last_only=True)
 
     key, k0 = jax.random.split(key)
-    first = _sample_1(logits_t[:, -1], temperature, k0)
+    first = _sample_1(logits_t[:, -1], temperature, k0)      # (B,)
 
-    toks = jnp.zeros((1, buf_len), jnp.int32)
+    toks = jnp.zeros((B, buf_len), jnp.int32)
     toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
-    toks = toks.at[0, S0].set(first[0])
+    toks = toks.at[:, S0].set(first)
 
-    # Carried state: token buffer, #generated (>=1 after the seed),
-    # both caches with their logical lengths (prompt is in both), rng,
-    # and the accept-count accumulators.  The caches MUST ride the
-    # loop carry — accepted tokens' K/V written in round r are read in
-    # every later round.
-    state = (toks, jnp.int32(1), cache_t, jnp.int32(S0),
-             cache_d, jnp.int32(S0), key, jnp.float32(0.0),
-             jnp.int32(0))
+    # Carried state: token buffer, per-stream #generated (>=1 after the
+    # seed), both caches with their per-stream logical lengths (prompt
+    # is in both), rng, and the accept-count accumulators.  The caches
+    # MUST ride the loop carry — accepted tokens' K/V written in round
+    # r are read in every later round.
+    ones = jnp.ones((B,), jnp.int32)
+    state = (toks, ones, cache_t, S0 * ones, cache_d, S0 * ones, key,
+             jnp.float32(0.0), jnp.float32(0.0))
 
     def cond(state):
-        return state[1] < max_new_tokens
+        return jnp.any(state[1] < max_new_tokens)
 
     def body(state):
         (toks, n, cache_t, len_t, cache_d, len_d, key, acc_sum,
-         rounds) = state
+         active_rounds) = state
+        done = n >= max_new_tokens                       # (B,)
         pos_last = S0 + n - 1          # buffer index of newest token
+        last_tok = jnp.take_along_axis(
+            toks, pos_last[:, None], axis=1)[:, 0]       # (B,)
 
         # --- draft proposes gamma tokens from its own cache --------
         # Step i feeds the previous token, so the draft cache receives
@@ -135,65 +162,77 @@ def speculative_generate(params: dict, draft_params: dict,
         def draft_step(carry, i):
             cache_d, len_d, tok, key = carry
             lg, cache_d = forward_with_cache(
-                draft_params, tok[None, None], cache_d, len_d,
-                draft_cfg)
+                draft_params, tok[:, None], cache_d, len_d, draft_cfg)
             key, ks = jax.random.split(key)
-            nxt = _sample_1(lg[:, -1], temperature, ks)[0]
-            return (cache_d, len_d + 1, nxt, key), (nxt, lg[0, -1])
+            nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
+            return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
 
-        last_tok = jax.lax.dynamic_index_in_dim(
-            toks[0], pos_last, keepdims=False)
         (cache_d, _, _, key), (drafts, draft_logits) = \
             jax.lax.scan(draft_step, (cache_d, len_d, last_tok, key),
                          jnp.arange(gamma))
-        # drafts: (gamma,) int32; draft_logits: (gamma, V)
+        # drafts: (gamma, B) int32; draft_logits: (gamma, B, V)
         # The scan wrote K/V for [newest, d_1..d_{gamma-1}] — d_gamma's
         # K/V is still missing, and the n_acc == gamma round needs it
         # (the pointer then advances past its slot).  One more write
         # (logits discarded) keeps the lag-one invariant for every
         # n_acc; the slot is stale-and-masked when d_gamma is rejected.
         _, cache_d = forward_with_cache(
-            draft_params, drafts[-1][None, None], cache_d,
+            draft_params, drafts[-1][:, None], cache_d,
             len_d + gamma, draft_cfg)
 
         # --- target verifies the newest token + all proposals ------
-        verify_in = jnp.concatenate(
-            [last_tok[None], drafts])[None]          # (1, gamma+1)
+        # ONE forward shared by every stream: (B, gamma+1) — this
+        # batched verify is the speedup's engine room.
+        verify_in = jnp.concatenate([last_tok[:, None], drafts.T],
+                                    axis=1)              # (B, g+1)
         logits_v, cache_t = forward_with_cache(
-            params, verify_in, cache_t, len_t, cfg)  # (1, g+1, V)
+            params, verify_in, cache_t, len_t, cfg)      # (B, g+1, V)
 
         key, kacc, kfix = jax.random.split(key, 3)
-        n_acc, next_tok = _accept(
-            drafts, draft_logits, logits_v[0], temperature, kacc, kfix)
+        n_acc, next_tok = jax.vmap(
+            _accept, in_axes=(1, 1, 0, None, 0, 0))(
+            drafts, draft_logits, logits_v, temperature,
+            jax.random.split(kacc, B), jax.random.split(kfix, B))
 
         # --- commit ------------------------------------------------
-        # Write all gamma+1 candidate slots; only the first n_acc + 1
-        # are real — the counter never reaches the stale tail before a
-        # later round overwrites it.
-        upd = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
-        upd = upd.at[n_acc].set(next_tok)
-        toks = jax.lax.dynamic_update_slice(toks, upd[None],
-                                            (0, pos_last + 1))
-        n = n + n_acc + 1
+        # Write all gamma+1 candidate slots per row; only the first
+        # n_acc + 1 are real — the counter never reaches the stale
+        # tail before a later round overwrites it.  Finished rows
+        # advance by 0; their (frozen-pointer) writes land at or past
+        # S0 + max_new_tokens, outside the output slice — dynamic
+        # slice clamping keeps even the overshoot case in that region.
+        upd = jnp.concatenate(
+            [drafts.T, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        upd = upd.at[jnp.arange(B), n_acc].set(next_tok)
+        toks = jax.vmap(
+            lambda row, u, s: jax.lax.dynamic_update_slice(row, u,
+                                                           (s,)))(
+            toks, upd, pos_last + 1)
+        adv = jnp.where(done, 0, n_acc + 1)
+        n = n + adv
         # Both caches now hold exactly the accepted tokens' K/V below
         # the new pointers (each lags one token and re-feeds the
         # newest token first); slots past the pointers are stale and
         # position-masked until overwritten.
-        len_t = len_t + n_acc + 1
-        len_d = len_d + n_acc + 1
+        len_t = len_t + adv
+        len_d = len_d + adv
+        acc_sum = acc_sum + jnp.sum(
+            jnp.where(done, 0.0, n_acc.astype(jnp.float32)))
+        active_rounds = active_rounds + jnp.sum(
+            (~done).astype(jnp.float32))
         return (toks, n, cache_t, len_t, cache_d, len_d, key,
-                acc_sum + n_acc.astype(jnp.float32), rounds + 1)
+                acc_sum, active_rounds)
 
-    toks, n, _, _, _, _, _, acc_sum, rounds = jax.lax.while_loop(
+    toks, n, _, _, _, _, _, acc_sum, active_rounds = jax.lax.while_loop(
         cond, body, state)
     out = jax.lax.dynamic_slice(
-        toks, (0, 0), (1, S0 + max_new_tokens))
-    mean_acc = acc_sum / jnp.maximum(rounds.astype(jnp.float32), 1.0)
+        toks, (0, 0), (B, S0 + max_new_tokens))
+    mean_acc = acc_sum / jnp.maximum(active_rounds, 1.0)
     return out, mean_acc
 
 
 def _sample_1(logits, temperature: float, key):
-    """(1, V) or (V,) logits -> scalar-per-row int32 token."""
+    """(B, V) or (V,) logits -> (B,) int32 tokens (independent rows)."""
     if temperature == 0.0:
         return _greedy_tok(jnp.atleast_2d(logits))
     return jax.random.categorical(
@@ -203,7 +242,7 @@ def _sample_1(logits, temperature: float, key):
 
 def _accept(drafts, draft_logits, verify_logits, temperature: float,
             kacc, kfix):
-    """Acceptance rule for one round.
+    """Acceptance rule for one round of one stream (vmapped over B).
 
     drafts: (g,) proposed tokens; draft_logits: (g, V) the draft's
     logits at each proposal; verify_logits: (g+1, V) the target's
