@@ -173,6 +173,107 @@ def zigzag_unshard(x, n: int, axis: int = 1):
     return jnp.take(x, jnp.asarray(inv), axis=axis)
 
 
+def _intervals_touch(q_ivals, k_ivals, window: int) -> bool:
+    """Whether any (query position, key position) pair drawn from the
+    given half-open global-index intervals is visible under the causal
+    + sliding-window mask (``ki <= qi`` and ``ki > qi - window``).
+    Only called with a real window — hop_plan early-returns the full
+    ring otherwise."""
+    for q0, q1 in q_ivals:
+        for k0, k1 in k_ivals:
+            if k0 <= q1 - 1 and k1 - 1 >= q0 - window + 1:
+                return True
+    return False
+
+
+def hop_plan(n: int, s_local: int, window: int | None,
+             schedule: str = "plain", *, sk_local: int | None = None):
+    """The static set of ring steps that can contribute under a sliding
+    window: step ``s`` gives device ``my`` the K/V chunk of device
+    ``(my - s) % n``; a step is in the plan iff ANY device has a
+    mask-visible (q-interval, k-interval) pair there (the plan must be
+    device-uniform — every device executes the same SPMD program).
+
+    Without a window every causal step contributes somewhere (device
+    n-1 sees all of history), so the plan is ``range(n)``.  With a
+    window of w tokens over chunks of C tokens, the plain schedule's
+    plan collapses to a prefix of ``1 + ceil((w-1)/C)`` steps and the
+    zigzag schedule's to a short prefix + suffix (zigzag pairs chunk d
+    with chunk 2n-1-d, whose window neighbors arrive at ring distance
+    n-1, n-2, ...) — O(window/C) hops instead of n, and K/V jump
+    straight across skipped steps in one ``ppermute``.
+
+    ``s_local`` is the per-device Q length; ``sk_local`` the per-device
+    K length when they differ (cross-length attention in the plain
+    schedule; zigzag requires them equal).
+    """
+    if window is None:
+        return tuple(range(n))
+    sk_local = s_local if sk_local is None else sk_local
+    steps = []
+    for s in range(n):
+        for my in range(n):
+            src = (my - s) % n
+            if schedule == "zigzag":
+                C = s_local // 2
+                q_iv = [(my * C, (my + 1) * C),
+                        ((2 * n - 1 - my) * C, (2 * n - my) * C)]
+                k_iv = [(src * C, (src + 1) * C),
+                        ((2 * n - 1 - src) * C, (2 * n - src) * C)]
+            else:
+                q_iv = [(my * s_local, (my + 1) * s_local)]
+                k_iv = [(src * sk_local, (src + 1) * sk_local)]
+            if _intervals_touch(q_iv, k_iv, window):
+                steps.append(s)
+                break
+    return tuple(steps)
+
+
+def _jump(arrs, axis: str, n: int, d: int):
+    """Move every device's chunk ``d`` ring positions forward in ONE
+    ppermute per array (a skipped-hop jump is a single collective, not
+    d neighbor exchanges)."""
+    if d % n == 0:
+        return list(arrs)
+    perm = [(j, (j + d) % n) for j in range(n)]
+    return [jax.lax.ppermute(a, axis, perm) for a in arrs]
+
+
+def _run_hops(plan, n: int, axis: str, my, fold, carry, riders,
+              home: int = 0):
+    """Shared hop-loop driver for every ring path (einsum/flash fwd,
+    flash/zigzag bwd): run ``carry, riders = fold(carry, riders, src)``
+    at each plan step with the K/V (and any gradient-accumulator)
+    ``riders`` rotated between steps.
+
+    Full plan -> the classic fori_loop of neighbor ppermutes (one
+    compiled body, n trips).  Pruned plan (sliding window) -> unrolled,
+    with a single ppermute jumping each gap.  ``home``: how many
+    trailing riders (dk/dv accumulators) must end on their owning
+    device — the fori path returns them home by construction (n
+    rotations), the plan path jumps them back by ``-plan[-1]``.
+    """
+    riders = tuple(riders)
+    if len(plan) == n:
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def body(step, state):
+            c, r = state
+            c, r = fold(c, r, (my - step) % n)
+            return c, tuple(jax.lax.ppermute(x, axis, perm) for x in r)
+
+        return jax.lax.fori_loop(0, n, body, (carry, riders))
+    prev = 0
+    for s in plan:
+        riders = tuple(_jump(riders, axis, n, s - prev))
+        prev = s
+        carry, riders = fold(carry, riders, (my - s) % n)
+    if home:
+        riders = riders[:-home] + tuple(
+            _jump(riders[-home:], axis, n, -plan[-1]))
+    return carry, riders
+
+
 def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
                 scale: float, window: int | None = None):
     """Grouped-einsum online-softmax ring (local view inside shard_map).
@@ -187,11 +288,10 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
     acc = jnp.zeros((B, Sq, Hkv, g, Dh), jnp.float32)
     m = jnp.full((B, Hkv, g, Sq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, Hkv, g, Sq, 1), jnp.float32)
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def body(step, carry):
-        acc, m, l, k_cur, v_cur = carry
-        src = (my - step) % n  # which chunk we currently hold
+    def fold(carry, riders, src):
+        acc, m, l = carry
+        k_cur, v_cur = riders
         Sk = k_cur.shape[1]
         s = jnp.einsum("bqkgd,bskd->bkgqs", qf,
                        k_cur.astype(jnp.float32),
@@ -212,14 +312,13 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
         pv = jnp.einsum("bkgqs,bskd->bqkgd", p,
                         v_cur.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
-        acc_new = acc * corr.transpose(0, 3, 1, 2, 4) + pv
-        # Rotate K/V to the next device; overlapped with the next
-        # step's compute by XLA's async collective scheduling.
-        k_next = jax.lax.ppermute(k_cur, axis, perm)
-        v_next = jax.lax.ppermute(v_cur, axis, perm)
-        return acc_new, m_new, l_new, k_next, v_next
+        return ((acc * corr.transpose(0, 3, 1, 2, 4) + pv, m_new,
+                 l_new), riders)
 
-    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc, m, l, k, v))
+    plan = hop_plan(n, Sq, window if causal else None,
+                    sk_local=k.shape[1])
+    (acc, m, l), _ = _run_hops(plan, n, axis, my, fold, (acc, m, l),
+                               (k, v))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2, 4)
     return out.reshape(B, Sq, H, Dh).astype(q.dtype)
 
@@ -259,7 +358,6 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
                                  _flash_bwd_prep, _flash_forward,
                                  _use_interpret)
 
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     @jax.custom_vjp
     def rf(q, k, v):
@@ -275,22 +373,21 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
         O = jnp.zeros((B, Sq, H, D), jnp.float32)
         L = jnp.full((B * Hkv, H // Hkv, Sq_pad), _NEG_INF, jnp.float32)
 
-        def body(step, carry):
-            O, L, k_cur, v_cur = carry
-            src = (my - step) % n
+        def fold(carry, riders, src):
             # step 0 is always the diagonal chunk (src == my), so L is
             # real from the first fold and fully-masked later hops
             # (lse ~ -inf) get weight exp(-inf - L) = 0.
+            O, L = carry
+            k_cur, v_cur = riders
             o_j, lse_j = _flash_forward(
                 q, k_cur, v_cur, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
                 offsets=(my * Sq, src * Sk), window=window)
-            O, L = _fold_hop(O, L, o_j, lse_j, B, Sq)
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            return O, L, k_next, v_next
+            return _fold_hop(O, L, o_j, lse_j, B, Sq), riders
 
-        O, L, k, v = jax.lax.fori_loop(0, n, body, (O, L, k, v))
+        plan = hop_plan(n, Sq, window if causal else None,
+                        sk_local=Sk)
+        (O, L), _ = _run_hops(plan, n, axis, my, fold, (O, L), (k, v))
         out = O.astype(q.dtype)
         return out, (q, k, v, out, L)
 
@@ -308,25 +405,24 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
         dk0 = jnp.zeros(k.shape, jnp.float32)
         dv0 = jnp.zeros(v.shape, jnp.float32)
 
-        def body(step, carry):
-            dq, k_cur, v_cur, dk_cur, dv_cur = carry
-            src = (my - step) % n
+        def fold(dq, riders, src):
+            # dk/dv accumulators ride WITH their chunk (trailing
+            # riders): each chunk collects its gradient contributions
+            # as it visits every device, then lands home.
+            k_cur, v_cur, dk_cur, dv_cur = riders
             dq_j, dk_j, dv_j = _flash_backward_folded(
                 qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq,
                 q_dtype=q.dtype, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
                 offsets=(my * Sq, src * Sk), window=window)
-            dq = dq + dq_j.astype(jnp.float32)
-            # dk/dv accumulators rotate WITH their chunk: after n hops
-            # every chunk has collected contributions from all devices
-            # and is back home.
-            dk_cur = dk_cur + dk_j.astype(dk_cur.dtype)
-            dv_cur = dv_cur + dv_j.astype(dv_cur.dtype)
-            rot = lambda x: jax.lax.ppermute(x, axis, perm)
-            return dq, rot(k_cur), rot(v_cur), rot(dk_cur), rot(dv_cur)
+            return (dq + dq_j.astype(jnp.float32),
+                    (k_cur, v_cur, dk_cur + dk_j.astype(dk_cur.dtype),
+                     dv_cur + dv_j.astype(dv_cur.dtype)))
 
-        dq, _, _, dk, dv = jax.lax.fori_loop(
-            0, n, body, (dq0, k, v, dk0, dv0))
+        plan = hop_plan(n, Sq, window if causal else None,
+                        sk_local=Sk)
+        dq, (_, _, dk, dv) = _run_hops(plan, n, axis, my, fold, dq0,
+                                       (k, v, dk0, dv0), home=2)
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     rf.defvjp(_rf_fwd, _rf_bwd)
@@ -347,7 +443,6 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                                  _flash_bwd_prep, _flash_forward,
                                  _use_interpret)
 
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     def _offs(idx, C):
         """Global offsets of owner ``idx``'s two half-chunks."""
@@ -372,9 +467,9 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         L = [jnp.full((B * Hkv, G, C_pad), _NEG_INF, jnp.float32)
              for _ in range(2)]
 
-        def body(step, carry):
-            Oa, La, Ob, Lb, k_cur, v_cur = carry
-            src = (my - step) % n
+        def fold(carry, riders, src):
+            Oa, La, Ob, Lb = carry
+            k_cur, v_cur = riders
             k_offs = _offs(src, C)
             Os, Ls = [Oa, Ob], [La, Lb]
             # Step 0 folds real data first for both q halves: (qa, ka)
@@ -393,12 +488,14 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         window=window)
                     Os[qi], Ls[qi] = _fold_hop(Os[qi], Ls[qi], o_j,
                                                lse_j, B, C)
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            return Os[0], Ls[0], Os[1], Ls[1], k_next, v_next
+            return (Os[0], Ls[0], Os[1], Ls[1]), riders
 
-        Oa, La, Ob, Lb, k, v = jax.lax.fori_loop(
-            0, n, body, (O[0], L[0], O[1], L[1], k, v))
+        # Windowed zigzag plans are a short prefix + suffix (chunk d's
+        # pair 2n-1-d meets its window neighbors at ring distance n-1,
+        # n-2, ...); K/V jump across the gap in one ppermute.
+        plan = hop_plan(n, Sq, window, "zigzag")
+        (Oa, La, Ob, Lb), _ = _run_hops(
+            plan, n, axis, my, fold, (O[0], L[0], O[1], L[1]), (k, v))
         out = jnp.concatenate([Oa, Ob], axis=1).astype(q.dtype)
         return out, (q, k, v, out, La, Lb)
 
@@ -421,9 +518,9 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         dk0 = jnp.zeros(k.shape, jnp.float32)
         dv0 = jnp.zeros(v.shape, jnp.float32)
 
-        def body(step, carry):
-            dqa, dqb, k_cur, v_cur, dk_cur, dv_cur = carry
-            src = (my - step) % n
+        def fold(carry, riders, src):
+            dqa, dqb = carry
+            k_cur, v_cur, dk_cur, dv_cur = riders
             k_offs = _offs(src, C)
             dqs = [dqa, dqb]
             for qi in range(2):
@@ -444,12 +541,12 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         dk_j.astype(jnp.float32))
                     dv_cur = dv_cur.at[:, sl].add(
                         dv_j.astype(jnp.float32))
-            rot = lambda x: jax.lax.ppermute(x, axis, perm)
-            return (dqs[0], dqs[1], rot(k_cur), rot(v_cur),
-                    rot(dk_cur), rot(dv_cur))
+            return (dqs[0], dqs[1]), (k_cur, v_cur, dk_cur, dv_cur)
 
-        dqa, dqb, _, _, dk, dv = jax.lax.fori_loop(
-            0, n, body, (dq0[0], dq0[1], k, v, dk0, dv0))
+        plan = hop_plan(n, Sq, window, "zigzag")
+        (dqa, dqb), (_, _, dk, dv) = _run_hops(
+            plan, n, axis, my, fold, (dq0[0], dq0[1]),
+            (k, v, dk0, dv0), home=2)
         dq = jnp.concatenate([dqa, dqb], axis=1)
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
