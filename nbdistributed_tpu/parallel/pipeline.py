@@ -97,6 +97,123 @@ def pipeline_forward(stage_fn, stage_params, x, mesh, *, axis: str = "pp",
     return outs[n_stages - 1:].reshape(batch, *x.shape[1:])
 
 
+def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
+                       n_microbatches: int | None = None):
+    """One-forward-one-backward (1F1B / PipeDream-flush) training
+    schedule: a jitted ``(stage_params, x, batch) -> (loss, grads)``.
+
+    GPipe via autodiff (``jax.grad`` of :func:`pipeline_forward`) runs
+    all M forward microbatches, then replays all M backwards — every
+    stage must hold M microbatches of residuals, so activation memory
+    grows with the microbatch count that was supposed to shrink the
+    bubble.  1F1B interleaves: each scan tick does one forward sub-step
+    (activations ``ppermute`` up) and one backward sub-step (cotangents
+    ``ppermute`` down), with stage ``s`` forwarding microbatch
+    ``t - s`` and backwarding microbatch ``t - 2(S-1) + s``.  A saved
+    input lives exactly ``2(S-1-s)`` ticks, so the in-flight buffer is
+    ``2S - 1`` microbatch inputs regardless of M — **activation memory
+    O(S) instead of O(M)**, which is the schedule's point.  The bubble
+    fraction itself matches GPipe's flush (``(S-1)`` idle ticks at each
+    end: ``2(S-1) / (M + 2(S-1))`` of the combined fwd+bwd timeline) —
+    non-interleaved 1F1B trades no compute, only memory.
+
+    Backward sub-steps recompute the stage forward from the saved
+    *input* (`jax.vjp` at use-time) rather than storing VJP residuals —
+    per-stage activation checkpointing, the standard pairing with 1F1B.
+
+    Contract: ``loss_tail(y_micro, batch_micro) -> scalar`` must be a
+    per-microbatch loss whose full-batch value is the mean over
+    microbatches (true for mean-reduced losses over equal microbatch
+    sizes); ``batch`` is any pytree with leading batch dim.  Gradients
+    match ``jax.grad`` of the sequential/GPipe loss to float tolerance.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro_default = n_microbatches
+
+    @jax.jit
+    def loss_and_grads(stage_params, x, batch):
+        S = n_stages
+        M = n_micro_default if n_micro_default is not None else S
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} "
+                             f"microbatches")
+        xs = x.reshape(M, B // M, *x.shape[1:])
+        bt = jax.tree_util.tree_map(
+            lambda a: a.reshape(M, B // M, *a.shape[1:]), batch)
+        T = M + 2 * (S - 1)
+        A = 2 * S - 1  # in-flight saved inputs: O(S), NOT O(M)
+        multi = S > 1
+
+        def spmd(params, xs, bt):
+            stage = jax.lax.axis_index(axis)
+            local = jax.tree_util.tree_map(lambda a: a[0], params)
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, local)
+
+            def tick(carry, t):
+                f_recv, b_recv, buf, grads, loss_acc = carry
+                # ---- forward sub-step: stage s runs microbatch t-s.
+                m_f = t - stage
+                act_f = (m_f >= 0) & (m_f < M)
+                x_in = jnp.where(stage == 0,
+                                 xs[jnp.clip(m_f, 0, M - 1)], f_recv)
+                y = stage_fn(local, x_in)
+                if multi:
+                    f_recv = jax.lax.ppermute(
+                        y, axis,
+                        [(i, i + 1) for i in range(S - 1)])
+                # Save this tick's input for its backward, 2(S-1-s)
+                # ticks later; slot reuse is safe because lifetimes
+                # never exceed A ticks.
+                buf = buf.at[t % A].set(
+                    jnp.where(act_f, x_in, buf[t % A]))
+
+                # ---- backward sub-step: stage s re-derives microbatch
+                # t - 2(S-1) + s from its saved input (recompute VJP).
+                m_b = t - 2 * (S - 1) + stage
+                act_b = (m_b >= 0) & (m_b < M)
+                slot = (t - 2 * (S - 1) + 2 * stage) % A
+                x_sav = buf[slot]
+                y_b, vjp = jax.vjp(stage_fn, local, x_sav)
+                # Last stage seeds the cotangent from the loss head on
+                # its recomputed output; earlier stages use what the
+                # next stage sent down.
+                mb_idx = jnp.clip(m_b, 0, M - 1)
+                bt_m = jax.tree_util.tree_map(lambda a: a[mb_idx], bt)
+                loss_m, lt_vjp = jax.vjp(
+                    lambda y_: loss_tail(y_, bt_m), y_b)
+                cot_seed = lt_vjp(jnp.float32(1.0) / M)[0]
+                cot = jnp.where(stage == S - 1, cot_seed, b_recv)
+                dp, dx = vjp(cot.astype(y_b.dtype))
+                grads = jax.tree_util.tree_map(
+                    lambda g, d: g + jnp.where(act_b, d, 0), grads, dp)
+                loss_acc = loss_acc + jnp.where(
+                    act_b & (stage == S - 1), loss_m / M, 0.0)
+                if multi:
+                    b_recv = jax.lax.ppermute(
+                        dx, axis,
+                        [(i, i - 1) for i in range(1, S)])
+                return (f_recv, b_recv, buf, grads, loss_acc), None
+
+            buf0 = jnp.zeros((A,) + xs.shape[1:], xs.dtype)
+            (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+                tick, (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]),
+                       buf0, g0, jnp.float32(0.0)),
+                jnp.arange(T))
+            # Loss lives on the last stage; every stage's grads are its
+            # own slice (restack via the pp out_spec).
+            loss = jax.lax.psum(loss_acc, axis)
+            grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+            return loss, grads
+
+        return jax.shard_map(
+            spmd, mesh=mesh, in_specs=(P(axis), P(), P()),
+            out_specs=(P(), P(axis)), check_vma=False)(
+            stage_params, xs, bt)
+
+    return loss_and_grads
+
+
 def make_pipeline_loss(stage_fn, loss_tail, mesh, *, axis: str = "pp",
                        n_microbatches: int | None = None):
     """Compose a pipelined forward with a loss head.
