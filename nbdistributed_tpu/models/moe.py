@@ -29,6 +29,10 @@ class MoEConfig(TransformerConfig):
     top_k: int = 2
     capacity_factor: float = 1.25
     lb_coef: float = 0.01
+    # "dense" = one-hot dispatch einsums (O(T^2) in tokens, the
+    # oracle); "sparse" = sort/segment routing (linear in tokens) —
+    # see parallel/expert.moe_ffn for the FLOP accounting.
+    moe_dispatch: str = "dense"
 
     def num_params(self) -> int:
         emb = self.vocab_size * self.d_model
@@ -111,7 +115,8 @@ def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str):
     h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
                            capacity_factor=cfg.capacity_factor,
-                           mesh=mesh, ep_axis=ep_axis)
+                           mesh=mesh, ep_axis=ep_axis,
+                           dispatch_mode=cfg.moe_dispatch)
     return x + y, layer_aux
 
 

@@ -124,16 +124,61 @@ def _expert_linear(xe, w, spec: str):
     return jnp.einsum(spec, xe, w)
 
 
+def sparse_slots(expert_idx, E: int, C: int):
+    """Sort/segment routing: the same Switch priority rule as
+    :func:`make_dispatch` without materializing any (T, E, C) tensor.
+
+    Flattening (T, k) choice-major and stable-sorting by expert
+    preserves choice-major order within each expert segment, so the
+    rank inside the segment equals the dense path's cumulative-count
+    position — drops are bit-identical.  Returns, in sorted order:
+    ``slot`` (kT,) int32 index into the flat (E*C,) capacity buffer
+    (== E*C for dropped entries, for ``mode="drop"`` scatters),
+    ``tok`` (kT,) source token ids, ``keep`` (kT,) bool, and ``order``
+    (the argsort, for carrying gates along).
+    """
+    T, k = expert_idx.shape
+    flat_e = expert_idx.T.reshape(-1)             # choice-major (kT,)
+    order = jnp.argsort(flat_e, stable=True)
+    e_sorted = flat_e[order]
+    counts = jnp.bincount(flat_e, length=E)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(k * T, dtype=jnp.int32) - starts[e_sorted]
+    keep = pos < C
+    slot = jnp.where(keep, e_sorted * C + pos, E * C).astype(jnp.int32)
+    return slot, (order % T).astype(jnp.int32), keep, order
+
+
 def moe_ffn(x, params: dict, *, top_k: int = 2,
             capacity_factor: float = 1.25, mesh=None,
-            ep_axis: str = "ep"):
+            ep_axis: str = "ep", dispatch_mode: str = "dense"):
     """Mixture-of-experts SwiGLU feed-forward.
 
     x: (..., D) -> (same shape, aux_loss scalar).  When ``mesh`` (with an
     ``ep`` axis) is given, the dispatched activations are sharding-
     constrained so GSPMD places each expert's (C, D) block on its ``ep``
     shard — compiling dispatch/combine into all_to_all collectives.
+
+    ``dispatch_mode`` selects how tokens reach the (E, C, D) capacity
+    buffer (expert compute is identical):
+
+    * ``"dense"`` — one-hot dispatch/combine einsums (the oracle).
+      FLOPs: 2·T·E·C·D each way; with E·C ≈ cf·k·T that is
+      O(cf·k·T²·D) — **quadratic in token count** — plus the
+      (T, k, E, C) slot one-hot in memory.  Fine at small T; the
+      dispatch einsums (4·T·E·C·D) overtake the experts themselves
+      (6·E·C·D·d_ff) once T > 1.5·d_ff — ~21.5k tokens for Mixtral,
+      independent of cf and k (both scale dispatch and experts
+      alike).
+    * ``"sparse"`` — sort/segment routing: stable-sort the kT (token,
+      choice) pairs by expert, take the first C per segment (the same
+      priority rule, bit-identical drops), move rows by gather/scatter.
+      Cost: O(kT log kT) sort + 2·kT·D copied elements — **linear in
+      token count**, no T×E×C tensor anywhere.  Same shardings
+      constrained under a mesh.
     """
+    if dispatch_mode not in ("dense", "sparse"):
+        raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
     orig_shape = x.shape
     D = orig_shape[-1]
     xt = x.reshape(-1, D)
@@ -144,9 +189,15 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     logits = xt.astype(jnp.float32) @ params["router"]
     gates, expert_idx, probs = top_k_routing(logits, top_k)
     aux = load_balance_loss(probs, expert_idx, E)
-    dispatch, combine = make_dispatch(gates, expert_idx, E, C)
 
-    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    if dispatch_mode == "sparse":
+        slot, tok, keep, order = sparse_slots(expert_idx, E, C)
+        g_sorted = gates.T.reshape(-1)[order]
+        xe = jnp.zeros((E * C, D), x.dtype).at[slot].set(
+            xt[tok], mode="drop").reshape(E, C, D)
+    else:
+        dispatch, combine = make_dispatch(gates, expert_idx, E, C)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
     if mesh is not None and ep_axis in mesh.shape:
         sh = NamedSharding(mesh, P(ep_axis, None, None))
         xe = jax.lax.with_sharding_constraint(xe, sh)
@@ -155,5 +206,14 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     ye = _expert_linear(h, params["w_down"], "ecf,efd->ecd")
     if mesh is not None and ep_axis in mesh.shape:
         ye = jax.lax.with_sharding_constraint(ye, sh)
-    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+    if dispatch_mode == "sparse":
+        w = jnp.where(keep, g_sorted, 0.0).astype(x.dtype)
+        # mode="fill": dropped entries (slot == E*C) read zeros —
+        # symmetric with the scatter's mode="drop", not reliant on the
+        # gate weight alone to cancel them.
+        rows = jnp.take(ye.reshape(E * C, D), slot, axis=0,
+                        mode="fill", fill_value=0)
+        y = jnp.zeros((T, D), x.dtype).at[tok].add(rows * w[:, None])
+    else:
+        y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
     return y.reshape(orig_shape), aux

@@ -94,6 +94,113 @@ def test_moe_ffn_matches_dense_routing_reference():
     np.testing.assert_allclose(np.asarray(y), ref, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("capacity_factor", [0.5, 1.0, 4.0])
+def test_sparse_dispatch_matches_dense(capacity_factor):
+    """Sort/segment dispatch must equal the dense one-hot oracle at
+    equal capacity — including bit-identical DROPS under tight
+    capacity (the Switch priority rule: choice-major cumulative
+    order), forward and gradients."""
+    key = jax.random.PRNGKey(7)
+    D, F, E, T, k = 16, 32, 4, 40, 2
+    params = expert.init_moe_params(key, D, F, E, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(8), (T, D), jnp.float32)
+
+    y_d, aux_d = expert.moe_ffn(x, params, top_k=k,
+                                capacity_factor=capacity_factor)
+    y_s, aux_s = expert.moe_ffn(x, params, top_k=k,
+                                capacity_factor=capacity_factor,
+                                dispatch_mode="sparse")
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux_s) == float(aux_d)
+
+    def loss(mode):
+        return lambda p, x_: jnp.sum(expert.moe_ffn(
+            x_, p, top_k=k, capacity_factor=capacity_factor,
+            dispatch_mode=mode)[0] ** 2)
+
+    g_d = jax.grad(loss("dense"), argnums=(0, 1))(params, x)
+    g_s = jax.grad(loss("sparse"), argnums=(0, 1))(params, x)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4), g_s, g_d)
+
+
+def test_sparse_slots_priority_matches_dense_positions():
+    """The sorted-segment rank must reproduce make_dispatch's
+    cumulative-count position for every kept (token, choice)."""
+    idx = jnp.asarray([[0, 1], [0, 2], [0, 1], [1, 0], [2, 2]])
+    E, C, T, k = 3, 2, 5, 2
+    gates = jnp.ones((T, k)) / k
+    dispatch, _ = expert.make_dispatch(gates, idx, E, C)
+    slot, tok, keep, _ = expert.sparse_slots(idx, E, C)
+    dense_slots = set()
+    for t in range(T):
+        for e in range(E):
+            for c in range(C):
+                if float(dispatch[t, e, c]) > 0:
+                    dense_slots.add((t, e * C + c))
+    sparse_kept = {(int(tok[i]), int(slot[i]))
+                   for i in range(k * T) if bool(keep[i])}
+    assert sparse_kept == dense_slots
+
+
+def test_sparse_dispatch_no_quadratic_tensor():
+    """The sparse path must not materialize any (T, E, C) or
+    (T, k, E, C) tensor — the dense path's quadratic memory."""
+    D, F, E, T, k = 16, 32, 8, 64, 2
+    params = expert.init_moe_params(jax.random.PRNGKey(9), D, F, E,
+                                    dtype=jnp.float32)
+    x = jnp.ones((T, D), jnp.float32)
+    C = expert.compute_capacity(T, E, k, 1.25)
+    jaxpr = str(jax.make_jaxpr(lambda p, x_: expert.moe_ffn(
+        x_, p, top_k=k, dispatch_mode="sparse"))(params, x))
+    flat = jaxpr.replace(" ", "")
+    assert f"[{T},{E},{C}]" not in flat  # avals print as f32[T,E,C]
+    assert f"[{T},{k},{E},{C}]" not in flat
+    # ... while the dense path does (sanity that the probe works).
+    jaxpr_d = str(jax.make_jaxpr(lambda p, x_: expert.moe_ffn(
+        x_, p, top_k=k, dispatch_mode="dense"))(params, x))
+    assert f"[{T},{E},{C}]" in jaxpr_d.replace(" ", "")
+
+
+def test_sparse_dispatch_on_ep_mesh():
+    """Sparse dispatch under dp×ep GSPMD matches the unsharded dense
+    oracle."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    key = jax.random.PRNGKey(10)
+    D, F, E, T = 16, 32, 4, 32
+    params = expert.init_moe_params(key, D, F, E, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(11), (T, D), jnp.float32)
+    expected, _ = expert.moe_ffn(x, params, capacity_factor=4.0)
+
+    mesh = mesh_mod.make_mesh({"dp": 2, "ep": 2},
+                              devices=jax.devices()[:4])
+    p = apply_shardings(params, mesh, expert.moe_param_shardings())
+    got, aux = jax.jit(lambda p, x: expert.moe_ffn(
+        x, p, capacity_factor=4.0, mesh=mesh,
+        dispatch_mode="sparse"))(p, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=1e-5, rtol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_model_sparse_dispatch_matches_dense():
+    """Model-level: the full MoE transformer's loss is identical under
+    either dispatch mode (cfg.moe_dispatch)."""
+    import dataclasses
+
+    from nbdistributed_tpu.models import moe_loss_fn, tiny_moe_config
+    cfg_d = tiny_moe_config(dtype=jnp.float32, use_flash=False)
+    cfg_s = dataclasses.replace(cfg_d, moe_dispatch="sparse")
+    params = init_moe_model(jax.random.PRNGKey(12), cfg_d)
+    tok = jax.random.randint(jax.random.PRNGKey(13), (2, 16), 0,
+                             cfg_d.vocab_size)
+    l_d = float(moe_loss_fn(params, {"tokens": tok}, cfg_d))
+    l_s = float(moe_loss_fn(params, {"tokens": tok}, cfg_s))
+    assert abs(l_d - l_s) < 1e-5, (l_d, l_s)
+
+
 def test_moe_ffn_ep_sharded_matches_unsharded():
     """Same layer jitted over a dp×ep mesh must give identical output;
     the dispatched activations get an ep sharding."""
