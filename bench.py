@@ -108,7 +108,7 @@ import jax as _jax, jax.numpy as _jnp, optax as _optax
 from nbdistributed_tpu.models import (forward as _fwd_fn,
                                       init_params as _init,
                                       loss_fn as _loss,
-                                      smol_135m_config as _cfg_fn)
+                                      {cfg_name} as _cfg_fn)
 
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 # Train step uses per-layer remat — the standard long-context training
@@ -275,24 +275,89 @@ _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
 _prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
                               _cfg.vocab_size)
-_N, _G = 64, 4
+_N, _G, _B = 64, 4, 4
+_prompt_b = _jnp.tile(_prompt, (_B, 1))
 _sg = _jax.jit(lambda p, t: _spec(p, p, t, _cfg, _cfg, _N, gamma=_G))
 _pg = _jax.jit(lambda p, t: _gen(p, t, _cfg, _N))
 _out = {}
 _spec_r = None
-for _name, _f in (("plain", _pg), ("spec_selfdraft", _sg)):
-    _r = _f(_p, _prompt)
+# Batched streams share every draft/verify forward, so B streams cost
+# ~one stream's wall-clock: report aggregate tokens/s at B=1 and B=4.
+for _name, _f, _t in (("plain", _pg, _prompt),
+                      ("spec_selfdraft", _sg, _prompt),
+                      ("plain_b4", _pg, _prompt_b),
+                      ("spec_selfdraft_b4", _sg, _prompt_b)):
+    _r = _f(_p, _t)
     _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
     _t0 = _time.time()
-    _r = _f(_p, _prompt)
+    _r = _f(_p, _t)
     _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
     _dt = _time.time() - _t0
-    _out[_name + "_tok_per_s"] = round(_N / _dt, 1)
+    _out[_name + "_tok_per_s"] = round(_N * _t.shape[0] / _dt, 1)
     if isinstance(_r, tuple):
         _spec_r = _r
 _out["gamma"] = _G
+_out["batch"] = _B
 _out["mean_accepted"] = round(float(_spec_r[1]), 2)
 _json.dumps(_out)
+"""
+
+# 7B-class int8 decode at a real memory footprint (BASELINE.json config
+# #5's Llama-2-7B intent): weights init on the host CPU backend (a full
+# bf16 7B never touches the 16G chip), quantized to int8 there, and
+# only the ~6.7G int8 tree + bf16 embeddings move to the TPU.  Decode
+# is weight-streaming-bound, so tokens/s tracks HBM bandwidth.
+DECODE7B_CELL = """
+import gc as _gc, json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.models import (init_params as _init,
+                                      llama2_7b_config as _cfg_fn,
+                                      make_generate_fn as _mkgen,
+                                      quantize_params as _quant)
+_cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
+with _jax.default_device(_jax.devices("cpu")[0]):
+    _p_host = _init(_jax.random.PRNGKey(0), _cfg)
+    _qp_host = _quant(_p_host)
+del _p_host; _gc.collect()
+_dev = _jax.devices()[0]
+_qp = _jax.tree_util.tree_map(lambda a: _jax.device_put(a, _dev),
+                              _qp_host)
+del _qp_host; _gc.collect()
+_jax.block_until_ready(_jax.tree_util.tree_leaves(_qp)[0])
+_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
+                              _cfg.vocab_size)
+_N = 32
+_gen = _mkgen(_cfg, _N, max_len=2048, kv_quantized=True)
+_jax.block_until_ready(_gen(_qp, _prompt))
+_t0 = _time.time()
+_toks = _gen(_qp, _prompt)
+_jax.block_until_ready(_toks)
+_dt = _time.time() - _t0
+_w_bytes = sum(x.size * x.dtype.itemsize
+               for x in _jax.tree_util.tree_leaves(_qp))
+_json.dumps({
+    "model": "llama2-7b int8 weights + int8 KV (random init)",
+    "weight_gb": round(_w_bytes / 1e9, 2),
+    "cache_len": 2048,
+    "tok_per_s": round(_N / _dt, 1),
+    "ms_per_tok": round(_dt / _N * 1e3, 2),
+    "hbm_stream_gb_per_s": round(_w_bytes / (_dt / _N) / 1e9, 1),
+})
+"""
+
+# Drop every underscore-named bench temporary from the worker
+# namespace between heavy cells — the 1B MFU leftovers (~9G with
+# optimizer state) and the 7B int8 tree (~6.7G) cannot coexist in 16G.
+CLEANUP_CELL = """
+import gc
+_doomed = [n for n in list(globals())
+           if n.startswith('_') and not n.startswith('__')]
+for _x in list(_doomed):
+    globals().pop(_x, None)
+globals().pop('_doomed', None)
+globals().pop('_x', None)
+gc.collect()
+'cleaned'
 """
 
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
@@ -435,7 +500,8 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             shape = "(8, 2048, 10)" if backend == "tpu" else "(2, 512, 3)"
             resp = comm.send_to_ranks(
                 [0], "execute",
-                MFU_CELL.format(peak=peak or 1e30, shape=shape),
+                MFU_CELL.format(peak=peak or 1e30, shape=shape,
+                                cfg_name="smol_135m_config"),
                 timeout=1200)
             m = resp[0]
             if m.data.get("error"):
@@ -452,7 +518,43 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         except Exception as e:
             log(f"[bench] MFU measurement skipped: {e}")
 
+        def cleanup_rank0():
+            """Best-effort namespace sweep between heavy cells — MUST
+            run even when the preceding cell failed, or its multi-GB
+            leftovers OOM every later measurement."""
+            try:
+                comm.send_to_ranks([0], "execute", CLEANUP_CELL,
+                                   timeout=300)
+            except Exception as e:
+                log(f"[bench] cleanup failed (continuing): {e}")
+
         if backend == "tpu":
+            # MFU at a scale where MFU means something: ~1.1B params,
+            # d_model=2048 — the GEMM sizes a v5e's MXU can actually
+            # fill (a 135M model's d=576 matmuls cannot).
+            try:
+                log("[bench] measuring tinyllama-1.1B fwd/train MFU "
+                    "on rank 0 (compile is minutes-scale cold)")
+                cleanup_rank0()
+                resp = comm.send_to_ranks(
+                    [0], "execute",
+                    MFU_CELL.format(peak=V5E_PEAK_BF16,
+                                    shape="(8, 2048, 5)",
+                                    cfg_name="tinyllama_1b_config"),
+                    timeout=1800)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] 1B MFU cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    mfu1b = parse_result_json(m)
+                    if mfu1b is not None:
+                        extra["tinyllama_1b"] = mfu1b
+                        log(f"[bench] tinyllama_1b: {mfu1b}")
+            except Exception as e:
+                log(f"[bench] 1B MFU measurement skipped: {e}")
+            finally:
+                cleanup_rank0()
             # The kernel-vs-XLA comparison is only meaningful where
             # the kernel actually compiles (interpret mode on CPU is
             # orders slower by construction).
@@ -504,6 +606,27 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                         log(f"[bench] speculative: {sp}")
             except Exception as e:
                 log(f"[bench] speculative comparison skipped: {e}")
+
+            try:
+                log("[bench] llama2-7B int8 decode at real memory "
+                    "footprint (host-side init+quant, then ~6.7G to "
+                    "the chip)")
+                cleanup_rank0()
+                resp = comm.send_to_ranks([0], "execute", DECODE7B_CELL,
+                                          timeout=1800)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] 7B decode cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    d7 = parse_result_json(m)
+                    if d7 is not None:
+                        extra["decode_7b_int8"] = d7
+                        log(f"[bench] decode_7b_int8: {d7}")
+            except Exception as e:
+                log(f"[bench] 7B decode skipped: {e}")
+            finally:
+                cleanup_rank0()
 
         try:
             # ---- all_reduce bandwidth sweep -------------------------

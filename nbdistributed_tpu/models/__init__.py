@@ -26,6 +26,7 @@ from .transformer import (SeqParallel, TransformerConfig,
                           init_params, llama2_7b_config, loss_fn,
                           make_train_step, mistral_7b_config,
                           param_shardings, smol_135m_config,
+                          tinyllama_1b_config,
                           tiny_config)
 
 __all__ = ["SeqParallel", "TransformerConfig", "forward",
@@ -33,6 +34,7 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "llama2_7b_config", "loss_fn", "make_train_step",
            "mistral_7b_config",
            "param_shardings", "smol_135m_config", "tiny_config",
+           "tinyllama_1b_config",
            "MoEConfig", "init_moe_model", "mixtral_8x7b_config",
            "moe_forward", "moe_loss_fn", "moe_model_shardings",
            "tiny_moe_config",

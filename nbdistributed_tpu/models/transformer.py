@@ -82,6 +82,16 @@ def smol_135m_config(**kw) -> TransformerConfig:
                              max_seq_len=2048, **kw)
 
 
+def tinyllama_1b_config(**kw) -> TransformerConfig:
+    """TinyLlama-1.1B dims (Zhang et al. 2024): the ~1B scale where
+    d_model=2048 matmuls feed the MXU properly — the bench's
+    MFU-at-meaningful-scale config (a 135M model's d=576 GEMMs cannot
+    reach competitive MFU on a v5e)."""
+    return TransformerConfig(vocab_size=32000, d_model=2048, n_layers=22,
+                             n_heads=32, n_kv_heads=4, d_ff=5632,
+                             max_seq_len=2048, **kw)
+
+
 def mistral_7b_config(**kw) -> TransformerConfig:
     """Mistral-7B-v0.1: the sliding-window release (4096-token window,
     rope theta 1e4, 32k positions).  v0.2/v0.3 dropped the window and
@@ -309,7 +319,9 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
                                head_axis=head_axis,
                                window=cfg.sliding_window)
     elif cfg.use_flash:
-        o = flash_attention(q, k, v, True, None, 128, 128,
+        # block sizes None -> TUNED_BLOCKS table (tune_flash.py) with
+        # the 128x128 fallback.
+        o = flash_attention(q, k, v, True, None, None, None,
                             cfg.sliding_window)
     else:
         from ..ops import attention_reference

@@ -634,15 +634,20 @@ _use_interpret = _shared_use_interpret
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,
-                    scale: float | None = None, block_q: int = 128,
-                    block_k: int = 128, window: int | None = None):
+                    scale: float | None = None,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
+                    window: int | None = None):
     """Flash attention: fused, O(S) memory forward.
 
     q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).  ``window``: sliding-window
     size (Mistral-style, causal only) — both passes prune k/q blocks
     outside the band, so compute is O(S * window) instead of O(S^2/2).
-    On non-TPU backends the Pallas kernel runs in interpreter mode
-    (slow but exact), so tests exercise the same code path everywhere.
+    ``block_q``/``block_k`` default to the per-shape tuned table
+    (:data:`TUNED_BLOCKS`, measured by ``tune_flash.py`` on a live
+    chip) falling back to 128.  On non-TPU backends the Pallas kernel
+    runs in interpreter mode (slow but exact), so tests exercise the
+    same code path everywhere.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
                       window)[0]
@@ -652,14 +657,33 @@ def _resolved_scale(scale, D):
     return scale if scale is not None else 1.0 / np.sqrt(D)
 
 
-def _block_sizes(block_q, block_k, Sq, Sk):
+# (Sq, Sk, head_dim, gqa_group) -> (block_q, block_k), measured on a
+# live v5e by tune_flash.py's chained-timing sweep (see BASELINE.md for
+# the sweep protocol and numbers).  The group (H // Hkv) is part of the
+# key because it sets the q-block's batch extent inside the kernel —
+# MHA (group 1) and GQA (group > 1) tune differently at the same S/D.
+# Consulted only when the caller passes no explicit block sizes; empty
+# entries fall back to 128x128.
+TUNED_BLOCKS: dict = {}
+_DEFAULT_BLOCK = 128
+
+
+def _block_sizes(block_q, block_k, Sq, Sk, D=None, group=None):
+    """Resolve block sizes: explicit args win; None consults the tuned
+    per-shape table, then the 128 default; both clamp to the array."""
+    if block_q is None or block_k is None:
+        tq, tk = TUNED_BLOCKS.get((Sq, Sk, D, group),
+                                  (_DEFAULT_BLOCK, _DEFAULT_BLOCK))
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     return min(block_q, Sq), min(block_k, Sk)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
     check_window(window, causal)
     D = q.shape[-1]
-    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
+    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1], D,
+                          q.shape[2] // k.shape[2])
     out, lse = _flash_forward(q, k, v, causal=causal,
                               scale=_resolved_scale(scale, D),
                               block_q=bq, block_k=bk,
@@ -673,7 +697,8 @@ def _flash_bwd(causal, scale, block_q, block_k, window, residuals, g):
     the saved logsumexp, so no O(S^2) tensor exists in the backward
     either."""
     q, k, v, out, lse = residuals
-    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1])
+    bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1],
+                          q.shape[-1], q.shape[2] // k.shape[2])
     return _flash_backward(q, k, v, out, lse, g, causal=causal,
                            scale=_resolved_scale(scale, q.shape[-1]),
                            block_q=bq, block_k=bk,

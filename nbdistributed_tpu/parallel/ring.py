@@ -347,7 +347,8 @@ def _hop_weights(w, B, Sq):
 
 
 def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
-                     block_q: int = 128, block_k: int = 128,
+                     block_q: int | None = None,
+                     block_k: int | None = None,
                      window: int | None = None):
     """Builds the shard_map inner for the Pallas ring with exact
     gradients: forward folds per-hop (out, lse) pairs; backward re-rings
@@ -366,7 +367,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
     def _rf_fwd(q, k, v):
         B, Sq, H, D = q.shape
         Sk, Hkv = k.shape[1], k.shape[2]
-        bq, bk = _block_sizes(block_q, block_k, Sq, Sk)
+        bq, bk = _block_sizes(block_q, block_k, Sq, Sk, D, H // Hkv)
         interp = _use_interpret()
         my = jax.lax.axis_index(axis)
         Sq_pad = -(-Sq // bq) * bq
@@ -394,8 +395,8 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
     def _rf_bwd(res, g):
         q, k, v, out, L = res
         B, Sq, H, D = q.shape
-        Sk = k.shape[1]
-        bq, bk = _block_sizes(block_q, block_k, Sq, Sk)
+        Sk, Hkv = k.shape[1], k.shape[2]
+        bq, bk = _block_sizes(block_q, block_k, Sq, Sk, D, H // Hkv)
         interp = _use_interpret()
         my = jax.lax.axis_index(axis)
         # Hop-invariant work — the q/dO folds and the delta reduction —
@@ -430,7 +431,8 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
 
 
 def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: int | None = None,
+                            block_k: int | None = None,
                             window: int | None = None):
     """Zigzag causal ring (local view: the two half-chunks d and
     2n-1-d, concatenated).  Every hop runs four half-pair Pallas calls
@@ -457,7 +459,7 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         Hkv = k.shape[2]
         C = Sq // 2
         G = H // Hkv
-        bq, bk = _block_sizes(block_q, block_k, C, C)
+        bq, bk = _block_sizes(block_q, block_k, C, C, D, H // Hkv)
         interp = _use_interpret()
         my = jax.lax.axis_index(axis)
         C_pad = -(-C // bq) * bq
@@ -504,7 +506,7 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         B, Sq, H, D = q.shape
         Hkv = k.shape[2]
         C = Sq // 2
-        bq, bk = _block_sizes(block_q, block_k, C, C)
+        bq, bk = _block_sizes(block_q, block_k, C, C, D, H // Hkv)
         interp = _use_interpret()
         my = jax.lax.axis_index(axis)
         q_offs = _offs(my, C)

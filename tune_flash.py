@@ -1,0 +1,136 @@
+"""On-chip flash-attention block-size sweep.
+
+Run on a live TPU (takes ~5-10 min of compiles):
+
+    python tune_flash.py
+
+Sweeps (block_q, block_k) for the flash kernel on the bench shapes,
+timing with the chained-dependency pattern (each scan step's q depends
+on the previous output; per-call time = (long-short chain)/delta with a
+host fetch at the end) — the only timing that survives the axon
+tunnel's async-ack behavior (see .claude/skills/verify/SKILL.md).
+
+Prints per-config timings and the ``TUNED_BLOCKS`` entries to paste
+into ``nbdistributed_tpu/ops/attention.py``, plus the tuned-vs-XLA
+speedup for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from nbdistributed_tpu.ops import attention_reference
+from nbdistributed_tpu.ops.attention import flash_attention
+
+SHAPES = [
+    # (name, B, S, H, Hkv, D) — the round-2 GQA bench shape first.
+    ("gqa_bench", 4, 2048, 8, 2, 128),
+    ("mha_r1", 4, 2048, 8, 8, 128),
+    ("long_gqa", 1, 8192, 8, 2, 128),
+]
+BLOCKS = (128, 256, 512)
+
+
+def chain_ms(f, q, k, v, n1=2, n2=18):
+    def t(n):
+        def body(qc, _):
+            return qc + f(qc, k, v) * 0.015625, None
+
+        g = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
+                                            length=n)[0])
+        float(g(q).sum())                 # compile + one run
+        t0 = time.time()
+        float(g(q * 1.03125).sum())       # fresh input, host fetch
+        return time.time() - t0
+
+    return (t(n2) - t(n1)) / (n2 - n1) * 1e3
+
+
+def grad_chain_ms(f, q, k, v, n1=2, n2=10):
+    def t(n):
+        def body(qc, _):
+            g = jax.grad(lambda qq: f(qq, k, v).astype(
+                jnp.float32).sum())(qc)
+            return qc + g * 0.015625, None
+
+        gfn = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
+                                              length=n)[0])
+        float(gfn(q).sum())
+        t0 = time.time()
+        float(gfn(q * 1.03125).sum())
+        return time.time() - t0
+
+    return (t(n2) - t(n1)) / (n2 - n1) * 1e3
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print("tune_flash.py needs a live TPU "
+              f"(backend={jax.default_backend()})", file=sys.stderr)
+        return 1
+    results = {}
+    for name, B, S, H, Hkv, D in SHAPES:
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
+                              jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, Hkv, D),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hkv, D),
+                              jnp.bfloat16)
+        rows = []
+        for bq in BLOCKS:
+            for bk in BLOCKS:
+                if bq > S or bk > S:
+                    continue
+                fl = functools.partial(flash_attention, causal=True,
+                                       block_q=bq, block_k=bk)
+                try:
+                    fwd = chain_ms(fl, q, k, v)
+                    fb = grad_chain_ms(fl, q, k, v)
+                except Exception as e:  # Mosaic rejects some shapes
+                    print(f"[{name}] bq={bq} bk={bk}: FAILED {e}",
+                          file=sys.stderr)
+                    continue
+                rows.append({"bq": bq, "bk": bk,
+                             "fwd_ms": round(fwd, 3),
+                             "fwd_bwd_ms": round(fb, 3)})
+                print(f"[{name}] bq={bq} bk={bk}: fwd {fwd:.3f} ms, "
+                      f"fwd+bwd {fb:.3f} ms", file=sys.stderr)
+        if not rows:
+            # Every config failed to compile for this shape: record
+            # that and keep the other shapes' results.
+            results[name] = {"shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D}",
+                             "error": "no block config compiled"}
+            continue
+        ref_fwd = chain_ms(lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=True), q, k, v)
+        ref_fb = grad_chain_ms(lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=True), q, k, v)
+        best = min(rows, key=lambda r: r["fwd_bwd_ms"])
+        results[name] = {
+            "shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D} bf16 causal",
+            "rows": rows,
+            "xla_ref": {"fwd_ms": round(ref_fwd, 3),
+                        "fwd_bwd_ms": round(ref_fb, 3)},
+            "best": best,
+            "tuned_speedup_fwd": round(ref_fwd / best["fwd_ms"], 3),
+            "tuned_speedup_fwd_bwd": round(ref_fb / best["fwd_bwd_ms"],
+                                           3),
+            # TUNED_BLOCKS key: (Sq, Sk, head_dim, gqa_group).
+            "tuned_entry": {f"({S}, {S}, {D}, {H // Hkv})":
+                            f"({best['bq']}, {best['bk']})"},
+        }
+        print(f"[{name}] XLA ref: fwd {ref_fwd:.3f} ms, fwd+bwd "
+              f"{ref_fb:.3f} ms; best flash bq={best['bq']} "
+              f"bk={best['bk']}", file=sys.stderr)
+    print(json.dumps(results, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
