@@ -28,24 +28,31 @@ def _all_text(nb):
     return "\n".join(chunks)
 
 
-@pytest.fixture(scope="module")
-def executed_nb():
+def _assert_clean(nb):
+    errors = [out for cell in nb.cells
+              for out in cell.get("outputs", [])
+              if out.get("output_type") == "error"]
+    assert not errors, errors
+
+
+def _execute_notebook(filename: str, *, timeout: int,
+                      env_patch: dict | None = None):
+    """Run one example notebook through a real Jupyter kernel with the
+    repo on PYTHONPATH (kernel + its spawned workers must import this
+    checkout); env is patched for the duration and restored."""
     nbclient = pytest.importorskip("nbclient")
     import nbformat
 
-    nb = nbformat.read(NOTEBOOK, as_version=4)
-    env_patch = {
-        "NBD_NOTEBOOK_BACKEND": "cpu",
-        "NBD_NOTEBOOK_WORKERS": "2",
-        # Kernel + its workers must import the repo checkout.
-        "PYTHONPATH": REPO_ROOT + os.pathsep +
-        os.environ.get("PYTHONPATH", ""),
-    }
+    nb = nbformat.read(os.path.join(REPO_ROOT, "examples", filename),
+                       as_version=4)
+    env_patch = dict(env_patch or {})
+    env_patch["PYTHONPATH"] = (REPO_ROOT + os.pathsep
+                               + os.environ.get("PYTHONPATH", ""))
     old = {k: os.environ.get(k) for k in env_patch}
     os.environ.update(env_patch)
     try:
         client = nbclient.NotebookClient(
-            nb, timeout=300, kernel_name="python3",
+            nb, timeout=timeout, kernel_name="python3",
             resources={"metadata": {"path": REPO_ROOT}})
         client.execute()
     finally:
@@ -57,11 +64,16 @@ def executed_nb():
     return nb
 
 
+@pytest.fixture(scope="module")
+def executed_nb():
+    return _execute_notebook(
+        "00_quickstart.ipynb", timeout=300,
+        env_patch={"NBD_NOTEBOOK_BACKEND": "cpu",
+                   "NBD_NOTEBOOK_WORKERS": "2"})
+
+
 def test_notebook_runs_clean(executed_nb):
-    errors = [out for cell in executed_nb.cells
-              for out in cell.get("outputs", [])
-              if out.get("output_type") == "error"]
-    assert not errors, errors
+    _assert_clean(executed_nb)
 
 
 def test_notebook_rank_tagged_output(executed_nb):
@@ -107,36 +119,12 @@ def test_notebook_checkpoint_restore_exact(executed_nb):
 
 @pytest.fixture(scope="module")
 def executed_parallelism_nb():
-    nbclient = pytest.importorskip("nbclient")
-    import nbformat
-
-    path = os.path.join(REPO_ROOT, "examples", "01_parallelism.ipynb")
-    nb = nbformat.read(path, as_version=4)
-    # Kernel must import the repo checkout (same contract as
-    # executed_nb above); the notebook forces its own cpu/8-device env.
-    env_patch = {"PYTHONPATH": REPO_ROOT + os.pathsep +
-                 os.environ.get("PYTHONPATH", "")}
-    old = {k: os.environ.get(k) for k in env_patch}
-    os.environ.update(env_patch)
-    try:
-        client = nbclient.NotebookClient(
-            nb, timeout=600, kernel_name="python3",
-            resources={"metadata": {"path": REPO_ROOT}})
-        client.execute()
-    finally:
-        for k, v in old.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-    return nb
+    # The notebook forces its own cpu/8-device env internally.
+    return _execute_notebook("01_parallelism.ipynb", timeout=600)
 
 
 def test_parallelism_notebook_runs_clean(executed_parallelism_nb):
-    errors = [out for cell in executed_parallelism_nb.cells
-              for out in cell.get("outputs", [])
-              if out.get("output_type") == "error"]
-    assert not errors, errors
+    _assert_clean(executed_parallelism_nb)
 
 
 def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
@@ -152,3 +140,41 @@ def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
     assert "FSDP train step: loss" in text and "sharded 4-way" in text
     assert "speculative == target greedy: True" in text
     assert "self-draft mean accepted/round: 3.00" in text
+
+
+@pytest.fixture(scope="module")
+def executed_finetune_nb(tmp_path_factory):
+    """The reference's flagship journey (00_accelerate.ipynb): local
+    SmolLM2-135M-architecture checkpoint -> load_hf_pretrained ->
+    packed local-text dataset -> cell-by-cell DDP fine-tune ->
+    generation.  (Checkpoint is locally constructed: zero-egress
+    environment, see BASELINE.md.)  Per-run temp dirs: no /tmp litter
+    or cross-run races on the ~0.5G checkpoint."""
+    tmp = tmp_path_factory.mktemp("finetune_nb")
+    return _execute_notebook(
+        "02_finetune.ipynb", timeout=600,
+        env_patch={"NBD_NOTEBOOK_BACKEND": "cpu",
+                   "NBD_NOTEBOOK_WORKERS": "2",
+                   "NBD_NOTEBOOK_CKPT_DIR": str(tmp / "ckpt"),
+                   "NBD_NOTEBOOK_CK_OUT": str(tmp / "ck_out")})
+
+
+def test_finetune_notebook_runs_clean(executed_finetune_nb):
+    _assert_clean(executed_finetune_nb)
+
+
+def test_finetune_notebook_journey(executed_finetune_nb):
+    """The full accelerate-style journey, rank-tagged: checkpoint
+    built, loaded on both ranks, real-text dataset packed, DDP loss
+    improves, generation produced, state checkpointed."""
+    text = _all_text(executed_finetune_nb)
+    assert "SmolLM2-135M-architecture" in text
+    # 134.5M torch params; the tied lm_head materializes as embed.T in
+    # the JAX pytree -> 162.8M leaves.
+    assert "loaded 162.8M params, d_model=576, layers=30" in text
+    assert "Rank 0" in text and "Rank 1" in text
+    assert "step 0: loss" in text and "step 3: loss" in text
+    assert "improved" in text and "NOT improved" not in text
+    assert "continuation" in text
+    assert "ranks saved" in text
+    assert "❌" not in text
