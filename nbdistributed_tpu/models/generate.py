@@ -181,15 +181,19 @@ def _can_flash_decode_on_mesh(mesh, B, H, Hkv):
     return H % tp_n == 0 and Hkv % tp_n == 0 and B % dp_n == 0
 
 
-def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str):
+def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str,
+                 token_mask=None):
     """The per-layer feed-forward branch: dense SwiGLU, or the MoE
     layer when the config is a :class:`~.moe.MoEConfig` (sharing
-    ``moe._moe_mlp_block`` so the two paths can never diverge)."""
+    ``moe._moe_mlp_block`` so the two paths can never diverge).
+    ``token_mask`` reaches only the MoE dispatch (dense SwiGLU is
+    per-token, so inactive tokens cannot couple anything there)."""
     from .moe import MoEConfig, _moe_mlp_block
 
     if isinstance(cfg, MoEConfig):
         def mlp(x, layer):
-            x, _aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
+            x, _aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis,
+                                     token_mask=token_mask)
             return x
 
         return mlp
@@ -199,7 +203,7 @@ def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str):
 def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                        cfg: TransformerConfig, *,
                        last_only: bool = False, mesh=None,
-                       ep_axis: str = "ep"):
+                       ep_axis: str = "ep", row_mask=None):
     """Run ``tokens`` (B, S) through the model, reading/writing the KV
     cache at offset ``cache_len`` (traced scalar ok, or a per-row
     ``(B,)`` vector when the streams in the batch sit at different
@@ -224,7 +228,12 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     positions = offs + jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
     scale = 1.0 / float(cfg.head_dim) ** 0.5
-    mlp = _make_mlp_fn(cfg, mesh, ep_axis)
+    # row_mask (B,) bool: inactive batch rows (finished speculative
+    # streams) must not couple to live rows — only MoE capacity
+    # dispatch can couple rows, so the mask feeds the expert router.
+    token_mask = (None if row_mask is None else
+                  jnp.broadcast_to(row_mask[:, None], (B, S)))
+    mlp = _make_mlp_fn(cfg, mesh, ep_axis, token_mask=token_mask)
     kv_quantized = "k_s" in cache
 
     def write_kv(buf, new, *, scale_layout=False):

@@ -108,15 +108,19 @@ def moe_model_shardings(cfg: MoEConfig, ep_axis: str = "ep",
     }
 
 
-def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str):
+def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str,
+                  token_mask=None):
     """The MoE feed-forward residual block (the expert analog of
     ``transformer._mlp_block``) — the single definition shared by the
-    training forward and the cached generation path."""
+    training forward and the cached generation path.  ``token_mask``:
+    masked tokens pass through the residual untouched and take no
+    expert capacity (see expert.moe_ffn)."""
     h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
                            capacity_factor=cfg.capacity_factor,
                            mesh=mesh, ep_axis=ep_axis,
-                           dispatch_mode=cfg.moe_dispatch)
+                           dispatch_mode=cfg.moe_dispatch,
+                           token_mask=token_mask)
     return x + y, layer_aux
 
 

@@ -28,15 +28,14 @@ so the while-loop runs until the *slowest* stream reaches
 output.
 
 Stream independence holds exactly for the dense family (asserted
-bit-identical to solo runs in the tests).  **MoE configs are the
-qualification**: capacity-based expert dispatch pools all rows' tokens
-into one capacity buffer (parallel/expert.py), so streams in a batch
-couple through capacity drops in *any* batched MoE decode — and a
-frozen stream's discarded recomputation still occupies dispatch slots,
-which can evict an active row's token to the residual path.  Batched
-speculative MoE therefore matches batched MoE decode semantics, not
-solo-run semantics; decoupling would need an active-row mask plumbed
-into the router gates.
+bit-identical to solo runs in the tests).  For MoE configs, frozen
+streams are *masked out of expert dispatch* (``row_mask`` →
+``moe_ffn(token_mask=...)``): their discarded recomputation takes no
+capacity slot, so finishing early never perturbs a live stream.  The
+remaining (inherent) qualification: capacity-based expert dispatch
+pools all *live* rows' tokens into one capacity buffer, so under
+tight capacity batched MoE decode can drop tokens a solo run would
+keep — batched speculative MoE matches batched MoE decode semantics.
 
 Greedy mode reproduces the target model's own greedy decode (verified
 bit-identical against :func:`~.generate.generate` in the fp32 tests) —
@@ -159,10 +158,13 @@ def speculative_generate(params: dict, draft_params: dict,
         # [newest, d_1..d_{gamma-1}] — it lags one token, exactly like
         # the target's verify write pattern below, which is why both
         # pointers advance by n_acc + 1.
+        active = ~done  # frozen rows: no expert-capacity footprint
+
         def draft_step(carry, i):
             cache_d, len_d, tok, key = carry
             lg, cache_d = forward_with_cache(
-                draft_params, tok[:, None], cache_d, len_d, draft_cfg)
+                draft_params, tok[:, None], cache_d, len_d, draft_cfg,
+                row_mask=active)
             key, ks = jax.random.split(key)
             nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
             return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
@@ -178,7 +180,7 @@ def speculative_generate(params: dict, draft_params: dict,
         # n_acc; the slot is stale-and-masked when d_gamma is rejected.
         _, cache_d = forward_with_cache(
             draft_params, drafts[-1][:, None], cache_d,
-            len_d + gamma, draft_cfg)
+            len_d + gamma, draft_cfg, row_mask=active)
 
         # --- target verifies the newest token + all proposals ------
         # ONE forward shared by every stream: (B, gamma+1) — this
@@ -186,7 +188,8 @@ def speculative_generate(params: dict, draft_params: dict,
         verify_in = jnp.concatenate([last_tok[:, None], drafts.T],
                                     axis=1)              # (B, g+1)
         logits_v, cache_t = forward_with_cache(
-            params, verify_in, cache_t, len_t, cfg)      # (B, g+1, V)
+            params, verify_in, cache_t, len_t, cfg,
+            row_mask=active)                             # (B, g+1, V)
 
         key, kacc, kfix = jax.random.split(key, 3)
         n_acc, next_tok = jax.vmap(

@@ -76,18 +76,23 @@ def top_k_routing(logits, top_k: int):
     return gates, expert_idx, probs
 
 
-def make_dispatch(gates, expert_idx, n_experts: int, capacity: int):
+def make_dispatch(gates, expert_idx, n_experts: int, capacity: int,
+                  token_mask=None):
     """Dense dispatch/combine tensors from routing decisions.
 
     Position of each (token, choice) inside its expert's capacity buffer
     is a cumulative count in choice-major order, so every token's first
     choice outranks any token's second choice — the Switch priority
-    rule.  Returns ``dispatch`` (T, E, C) {0,1} and ``combine``
-    (T, E, C) = dispatch * gate.
+    rule.  ``token_mask`` (T,) bool: masked-out tokens take NO capacity
+    slot (they do not merely get zero gates — they are invisible to
+    other tokens' slot competition).  Returns ``dispatch`` (T, E, C)
+    {0,1} and ``combine`` (T, E, C) = dispatch * gate.
     """
     T, k = expert_idx.shape
     onehot = jax.nn.one_hot(expert_idx, n_experts,
                             dtype=jnp.float32)        # (T, k, E)
+    if token_mask is not None:
+        onehot = onehot * token_mask.astype(jnp.float32)[:, None, None]
     flat = onehot.transpose(1, 0, 2).reshape(k * T, n_experts)
     pos = jnp.cumsum(flat, axis=0) - flat             # (k*T, E)
     pos = pos.reshape(k, T, n_experts).transpose(1, 0, 2)  # (T, k, E)
@@ -100,13 +105,21 @@ def make_dispatch(gates, expert_idx, n_experts: int, capacity: int):
     return dispatch, combine
 
 
-def load_balance_loss(probs, expert_idx, n_experts: int):
+def load_balance_loss(probs, expert_idx, n_experts: int,
+                      token_mask=None):
     """Switch-style auxiliary loss: n_experts * Σ_e f_e · P_e, where
     f_e = fraction of tokens whose FIRST choice is e and P_e = mean
-    router probability of e.  Minimized (=1) at uniform routing."""
+    router probability of e.  Minimized (=1) at uniform routing.
+    ``token_mask`` excludes masked-out tokens from both means."""
     first = jax.nn.one_hot(expert_idx[:, 0], n_experts, dtype=jnp.float32)
-    f = jnp.mean(first, axis=0)
-    p = jnp.mean(probs, axis=0)
+    if token_mask is None:
+        f = jnp.mean(first, axis=0)
+        p = jnp.mean(probs, axis=0)
+    else:
+        m = token_mask.astype(jnp.float32)[:, None]
+        n = jnp.maximum(jnp.sum(m), 1.0)
+        f = jnp.sum(first * m, axis=0) / n
+        p = jnp.sum(probs * m, axis=0) / n
     return n_experts * jnp.sum(f * p)
 
 
@@ -124,34 +137,40 @@ def _expert_linear(xe, w, spec: str):
     return jnp.einsum(spec, xe, w)
 
 
-def sparse_slots(expert_idx, E: int, C: int):
+def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
     """Sort/segment routing: the same Switch priority rule as
     :func:`make_dispatch` without materializing any (T, E, C) tensor.
 
     Flattening (T, k) choice-major and stable-sorting by expert
     preserves choice-major order within each expert segment, so the
     rank inside the segment equals the dense path's cumulative-count
-    position — drops are bit-identical.  Returns, in sorted order:
-    ``slot`` (kT,) int32 index into the flat (E*C,) capacity buffer
-    (== E*C for dropped entries, for ``mode="drop"`` scatters),
-    ``tok`` (kT,) source token ids, ``keep`` (kT,) bool, and ``order``
-    (the argsort, for carrying gates along).
+    position — drops are bit-identical.  ``token_mask`` (T,) bool:
+    masked-out tokens are re-labeled to a sentinel expert E, sorting
+    past every real segment — they take no capacity slot, exactly as
+    in the dense path.  Returns, in sorted order: ``slot`` (kT,) int32
+    index into the flat (E*C,) capacity buffer (== E*C for
+    dropped/masked entries, for ``mode="drop"`` scatters), ``tok``
+    (kT,) source token ids, ``keep`` (kT,) bool, and ``order`` (the
+    argsort, for carrying gates along).
     """
     T, k = expert_idx.shape
     flat_e = expert_idx.T.reshape(-1)             # choice-major (kT,)
+    if token_mask is not None:
+        flat_e = jnp.where(jnp.tile(token_mask, k), flat_e, E)
     order = jnp.argsort(flat_e, stable=True)
     e_sorted = flat_e[order]
-    counts = jnp.bincount(flat_e, length=E)
+    counts = jnp.bincount(flat_e, length=E + 1)   # [..., masked bin]
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(k * T, dtype=jnp.int32) - starts[e_sorted]
-    keep = pos < C
+    keep = (pos < C) & (e_sorted < E)
     slot = jnp.where(keep, e_sorted * C + pos, E * C).astype(jnp.int32)
     return slot, (order % T).astype(jnp.int32), keep, order
 
 
 def moe_ffn(x, params: dict, *, top_k: int = 2,
             capacity_factor: float = 1.25, mesh=None,
-            ep_axis: str = "ep", dispatch_mode: str = "dense"):
+            ep_axis: str = "ep", dispatch_mode: str = "dense",
+            token_mask=None, capacity: int | None = None):
     """Mixture-of-experts SwiGLU feed-forward.
 
     x: (..., D) -> (same shape, aux_loss scalar).  When ``mesh`` (with an
@@ -176,6 +195,15 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
       Cost: O(kT log kT) sort + 2·kT·D copied elements — **linear in
       token count**, no T×E×C tensor anywhere.  Same shardings
       constrained under a mesh.
+
+    ``token_mask`` (bool, shape ``x.shape[:-1]``): masked-out tokens
+    contribute nothing — zero output, no capacity slot consumed, and
+    no effect on the aux loss — so active tokens route exactly as if
+    the masked ones did not exist (at equal ``capacity``).  Batched
+    speculative decoding uses this to keep finished streams from
+    perturbing live ones.  ``capacity`` overrides the
+    ``capacity_factor`` formula (needed when comparing runs whose
+    token counts differ).
     """
     if dispatch_mode not in ("dense", "sparse"):
         raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
@@ -184,19 +212,24 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     xt = x.reshape(-1, D)
     T = xt.shape[0]
     E = params["router"].shape[-1]
-    C = compute_capacity(T, E, top_k, capacity_factor)
+    C = (capacity if capacity is not None
+         else compute_capacity(T, E, top_k, capacity_factor))
+    mask_t = (None if token_mask is None
+              else token_mask.reshape(-1))
 
     logits = xt.astype(jnp.float32) @ params["router"]
     gates, expert_idx, probs = top_k_routing(logits, top_k)
-    aux = load_balance_loss(probs, expert_idx, E)
+    aux = load_balance_loss(probs, expert_idx, E, token_mask=mask_t)
 
     if dispatch_mode == "sparse":
-        slot, tok, keep, order = sparse_slots(expert_idx, E, C)
+        slot, tok, keep, order = sparse_slots(expert_idx, E, C,
+                                              token_mask=mask_t)
         g_sorted = gates.T.reshape(-1)[order]
         xe = jnp.zeros((E * C, D), x.dtype).at[slot].set(
             xt[tok], mode="drop").reshape(E, C, D)
     else:
-        dispatch, combine = make_dispatch(gates, expert_idx, E, C)
+        dispatch, combine = make_dispatch(gates, expert_idx, E, C,
+                                          token_mask=mask_t)
         xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
     if mesh is not None and ep_axis in mesh.shape:
         sh = NamedSharding(mesh, P(ep_axis, None, None))

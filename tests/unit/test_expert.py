@@ -185,6 +185,51 @@ def test_sparse_dispatch_on_ep_mesh():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.parametrize("mode", ["dense", "sparse"])
+def test_token_mask_no_capacity_footprint(mode):
+    """Masked-out tokens must (a) produce zero output and (b) take NO
+    expert-capacity slot: at pinned tight capacity, the active rows'
+    outputs equal a run where the masked tokens do not exist at all —
+    the guarantee batched speculative decoding's frozen streams rely
+    on."""
+    key = jax.random.PRNGKey(20)
+    D, F, E, T, k, C = 16, 32, 4, 16, 2, 2  # tight: actives compete
+    params = expert.init_moe_params(key, D, F, E, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(21), (T, D), jnp.float32)
+    mask = jnp.arange(T) < T // 2          # first half active
+
+    y_masked, aux_m = expert.moe_ffn(x, params, top_k=k, capacity=C,
+                                     dispatch_mode=mode,
+                                     token_mask=mask)
+    y_solo, aux_s = expert.moe_ffn(x[:T // 2], params, top_k=k,
+                                   capacity=C, dispatch_mode=mode)
+    np.testing.assert_allclose(np.asarray(y_masked[:T // 2]),
+                               np.asarray(y_solo), atol=1e-5,
+                               rtol=1e-5)
+    # (a) masked rows are exactly zero (pass through the residual).
+    np.testing.assert_array_equal(np.asarray(y_masked[T // 2:]),
+                                  np.zeros((T // 2, D), np.float32))
+    # aux loss excludes masked tokens.
+    np.testing.assert_allclose(float(aux_m), float(aux_s), rtol=1e-6)
+
+
+def test_token_mask_dense_sparse_agree():
+    """Both dispatch modes implement the identical mask semantics."""
+    key = jax.random.PRNGKey(22)
+    D, F, E, T, k = 16, 32, 4, 24, 2
+    params = expert.init_moe_params(key, D, F, E, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(23), (T, D), jnp.float32)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(24), 0.6, (T,))
+    y_d, aux_d = expert.moe_ffn(x, params, top_k=k, capacity=3,
+                                token_mask=mask)
+    y_s, aux_s = expert.moe_ffn(x, params, top_k=k, capacity=3,
+                                dispatch_mode="sparse",
+                                token_mask=mask)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux_s) == float(aux_d)
+
+
 def test_moe_model_sparse_dispatch_matches_dense():
     """Model-level: the full MoE transformer's loss is identical under
     either dispatch mode (cfg.moe_dispatch)."""

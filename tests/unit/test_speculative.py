@@ -175,6 +175,38 @@ def test_batched_sampled_preserves_target_distribution():
     assert tv < 0.2, (tv, p)
 
 
+def test_batched_moe_spec_matches_solo():
+    """MoE target + draft, batched streams with diverging acceptance:
+    each row must equal its solo run.  Frozen streams are masked out of
+    expert dispatch (row_mask -> moe_ffn token_mask), so finishing
+    early leaves no capacity footprint; capacity is ample here so
+    batched-vs-solo capacity formulas agree (the tight-capacity
+    no-footprint guarantee is pinned in test_expert.py)."""
+    from nbdistributed_tpu.models import init_moe_model
+    from nbdistributed_tpu.models.moe import MoEConfig
+
+    cfg = MoEConfig(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+                    n_kv_heads=2, d_ff=64, max_seq_len=64,
+                    n_experts=4, top_k=2, capacity_factor=4.0,
+                    dtype=jnp.float32, use_flash=False)
+    dcfg = MoEConfig(vocab_size=128, d_model=16, n_layers=1, n_heads=1,
+                     n_kv_heads=1, d_ff=32, max_seq_len=64,
+                     n_experts=2, top_k=1, capacity_factor=4.0,
+                     dtype=jnp.float32, use_flash=False)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    draft = init_moe_model(jax.random.PRNGKey(1), dcfg)
+    prompts = jax.random.randint(jax.random.PRNGKey(2), (3, 5), 0,
+                                 cfg.vocab_size)
+    got, _ = speculative_generate(params, draft, prompts, cfg, dcfg,
+                                  8, gamma=2)
+    for b in range(3):
+        solo, _ = speculative_generate(params, draft,
+                                       prompts[b:b + 1], cfg, dcfg,
+                                       8, gamma=2)
+        np.testing.assert_array_equal(np.asarray(got[b]),
+                                      np.asarray(solo[0]), err_msg=str(b))
+
+
 def test_spec_decode_with_int8_kv(setup):
     """Speculative decoding over int8 KV caches: runs, jits, and for a
     self-draft stays consistent with the int8-cache greedy decode."""
