@@ -117,6 +117,35 @@ _t = generate(_p, _jn.zeros((1, 4), _jn.int32), _cfg, 4,
         check("model stack (flash kernel exact, int8 sampled decode)",
               r0.data.get("output") == "(True, True, True)",
               repr(r0.data.get("error") or r0.data.get("output")))
+
+        # Round-3 additions: batched speculative decoding, sparse MoE
+        # dispatch, and the windowed-ring hop plan.
+        r3_cell = """
+import jax as _j, jax.numpy as _jn
+from nbdistributed_tpu.models import (tiny_config, init_params,
+                                      generate, speculative_generate)
+_cfg = tiny_config(dtype=_jn.float32, use_flash=False)
+_p = init_params(_j.random.PRNGKey(0), _cfg)
+_pr = _j.random.randint(_j.random.PRNGKey(1), (2, 5), 0,
+                        _cfg.vocab_size)
+_sp, _ = speculative_generate(_p, _p, _pr, _cfg, _cfg, 4, gamma=2)
+_ok_spec = bool((_sp == generate(_p, _pr, _cfg, 4)).all())
+from nbdistributed_tpu.parallel import expert as _ex
+_mp = _ex.init_moe_params(_j.random.PRNGKey(2), 16, 32, 4,
+                          dtype=_jn.float32)
+_x = _j.random.normal(_j.random.PRNGKey(3), (24, 16), _jn.float32)
+_yd, _ = _ex.moe_ffn(_x, _mp)
+_ys, _ = _ex.moe_ffn(_x, _mp, dispatch_mode="sparse")
+_ok_moe = float(_jn.max(_jn.abs(_yd - _ys))) < 1e-5
+from nbdistributed_tpu.parallel.ring import hop_plan
+_ok_plan = hop_plan(8, 16, 16) == (0, 1)
+(_ok_spec, _ok_moe, _ok_plan)
+"""
+        r0 = comm.send_to_ranks([0], "execute", r3_cell,
+                                timeout=120)[0]
+        check("batched speculative + sparse MoE + SWA hop plan",
+              r0.data.get("output") == "(True, True, True)",
+              repr(r0.data.get("error") or r0.data.get("output")))
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

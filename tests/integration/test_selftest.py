@@ -13,6 +13,6 @@ pytestmark = [pytest.mark.integration]
 def test_selftest_passes():
     proc = subprocess.run(
         [sys.executable, "-m", "nbdistributed_tpu.selftest"],
-        capture_output=True, text=True, timeout=300)
+        capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "9/9 checks passed" in proc.stdout
+    assert "10/10 checks passed" in proc.stdout
