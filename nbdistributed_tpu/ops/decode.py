@@ -183,8 +183,17 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
     )(*args)
 
 
+# (T, head_dim, gqa_group) -> block_k, measured on a live chip by
+# tune_flash.py's decode sweep.  Consulted when the caller passes no
+# explicit block_k; empty entries fall back to 128.  Decode is
+# HBM-streaming-bound, so the block size mostly trades grid overhead
+# against VMEM residency of the (block_k, D) cache window.
+DECODE_TUNED_BLOCKS: dict = {}
+_DEFAULT_BLOCK_K = 128
+
+
 def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
-                           block_k: int = 128,
+                           block_k: int | None = None,
                            window: int | None = None,
                            k_s=None, v_s=None):
     """Fused decode attention: one new token per sequence against the
@@ -214,6 +223,9 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
         raise ValueError("pass both k_s and v_s, or neither")
     group = H // Hkv
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
+    if block_k is None:
+        block_k = DECODE_TUNED_BLOCKS.get((T, D, group),
+                                          _DEFAULT_BLOCK_K)
     block_k = min(block_k, T)
     qg = q.reshape(B, Hkv, group, D)
     if window is not None and window < 1:

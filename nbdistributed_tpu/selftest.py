@@ -108,7 +108,7 @@ _t = generate(_p, _jn.zeros((1, 4), _jn.int32), _cfg, 4,
               kv_quantized=True)
 (_err < 2e-5, int(_t.shape[1]) == 8, int(_t.max()) < _cfg.vocab_size)
 """
-        # Keep this WELL under the 300 s cap tests/integration/
+        # Keep this WELL under the 420 s cap tests/integration/
         # test_selftest.py puts on the whole selftest subprocess
         # (bring-up + earlier checks can eat ~100 s on a slow box), so
         # a hung cell fails as a reported check, not a TimeoutExpired.

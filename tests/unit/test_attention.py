@@ -223,3 +223,44 @@ class TestSlidingWindow:
             flash_attention(q, q, q, False, None, 32, 32, 16)
         with pytest.raises(ValueError, match="causal"):
             attention_reference(q, q, q, causal=False, window=16)
+
+
+def test_flash_tuned_block_table_consulted():
+    """block_q/block_k=None resolve through TUNED_BLOCKS[(Sq, Sk, D,
+    group)] with a 128 fallback; a tuned entry must change nothing
+    numerically (forward and gradients)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nbdistributed_tpu.ops import attention as att
+
+    B, S, H, Hkv, D = 1, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D))
+    k = jax.random.normal(ks[1], (B, S, Hkv, D))
+    v = jax.random.normal(ks[2], (B, S, Hkv, D))
+    loss = lambda q_: jnp.sum(att.flash_attention(q_, k, v, True) ** 2)
+    default, g_default = jax.value_and_grad(loss)(q)
+    key = (S, S, D, H // Hkv)
+
+    class _Recording(dict):
+        keys_seen: list = []
+
+        def get(self, k_, d=None):
+            _Recording.keys_seen.append(k_)
+            return super().get(k_, d)
+
+    orig = att.TUNED_BLOCKS
+    att.TUNED_BLOCKS = _Recording({key: (32, 32)})
+    try:
+        tuned, g_tuned = jax.value_and_grad(loss)(q)
+    finally:
+        att.TUNED_BLOCKS = orig
+    # The lookup must have fired with the exact (Sq, Sk, D, group) key
+    # (numerics alone cannot prove it: a missed lookup falls back to
+    # the same 128 default).
+    assert key in _Recording.keys_seen, _Recording.keys_seen
+    np.testing.assert_allclose(float(tuned), float(default), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_tuned),
+                               np.asarray(g_default), atol=1e-5,
+                               rtol=1e-5)

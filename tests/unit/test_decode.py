@@ -226,3 +226,46 @@ def test_decode_kernel_int8_requires_both_scales():
     with pytest.raises(ValueError, match="both k_s and v_s"):
         flash_decode_attention(q, kc, kc, jnp.zeros((1,), jnp.int32),
                                k_s=s)
+
+
+class _RecordingTable(dict):
+    """dict that records .get keys — proves the lookup actually fired
+    with the expected key (numerics alone cannot: a silently-missed
+    lookup falls back to the same default)."""
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.keys_seen = []
+
+    def get(self, k, default=None):
+        self.keys_seen.append(k)
+        return super().get(k, default)
+
+
+def test_decode_tuned_block_table_consulted():
+    """block_k=None resolves through DECODE_TUNED_BLOCKS[(T, D, group)]
+    with a 128 fallback; the lookup must fire with that exact key, and
+    a tuned entry must change nothing numerically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nbdistributed_tpu.ops import decode as dec
+
+    B, T, H, Hkv, D = 1, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, D))
+    kc = jax.random.normal(ks[1], (B, T, Hkv, D))
+    vc = jax.random.normal(ks[2], (B, T, Hkv, D))
+    pos = jnp.full((B,), T - 1, jnp.int32)
+    default = dec.flash_decode_attention(q, kc, vc, pos)
+    key = (T, D, H // Hkv)
+    orig = dec.DECODE_TUNED_BLOCKS
+    table = _RecordingTable({key: 32})
+    dec.DECODE_TUNED_BLOCKS = table
+    try:
+        tuned = dec.flash_decode_attention(q, kc, vc, pos)
+    finally:
+        dec.DECODE_TUNED_BLOCKS = orig
+    assert key in table.keys_seen, table.keys_seen
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(default),
+                               atol=2e-5, rtol=2e-5)

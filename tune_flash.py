@@ -128,6 +128,52 @@ def main() -> int:
         print(f"[{name}] XLA ref: fwd {ref_fwd:.3f} ms, fwd+bwd "
               f"{ref_fb:.3f} ms; best flash bq={best['bq']} "
               f"bk={best['bk']}", file=sys.stderr)
+    # ---- decode kernel sweep: block_k over realistic cache shapes.
+    from nbdistributed_tpu.ops.decode import flash_decode_attention
+
+    DECODE_SHAPES = [
+        # (name, B, T, H, Hkv, D)
+        ("smol_decode", 1, 2048, 9, 3, 64),
+        ("llama7b_decode", 1, 2048, 32, 32, 128),
+        ("gqa_long_decode", 1, 8192, 32, 8, 128),
+    ]
+
+    for name, B, T, H, Hkv, D in DECODE_SHAPES:
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, H, D),
+                              jnp.bfloat16)
+        kc = jax.random.normal(jax.random.PRNGKey(1), (B, T, Hkv, D),
+                               jnp.bfloat16)
+        vc = jax.random.normal(jax.random.PRNGKey(2), (B, T, Hkv, D),
+                               jnp.bfloat16)
+        pos = jnp.full((B,), T - 1, jnp.int32)
+        rows = []
+        for bk in BLOCKS:
+            if bk > T:
+                continue
+            try:
+                ms = chain_ms(
+                    lambda qc, k_, v_: flash_decode_attention(
+                        qc, k_, v_, pos, block_k=bk),
+                    q, kc, vc, n1=4, n2=36)
+            except Exception as e:
+                print(f"[{name}] block_k={bk}: FAILED {e}",
+                      file=sys.stderr)
+                continue
+            rows.append({"block_k": bk, "ms": round(ms, 4)})
+            print(f"[{name}] block_k={bk}: {ms:.4f} ms",
+                  file=sys.stderr)
+        if not rows:
+            results[name] = {"error": "no block_k compiled"}
+            continue
+        best = min(rows, key=lambda r: r["ms"])
+        results[name] = {
+            "shape": f"B{B} T{T} H{H} Hkv{Hkv} D{D} bf16",
+            "rows": rows, "best": best,
+            # DECODE_TUNED_BLOCKS key: (T, head_dim, gqa_group).
+            "tuned_entry": {f"({T}, {D}, {H // Hkv})":
+                            best["block_k"]},
+        }
+
     print(json.dumps(results, indent=1))
     return 0
 
