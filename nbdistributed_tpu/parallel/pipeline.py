@@ -121,6 +121,22 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
     *input* (`jax.vjp` at use-time) rather than storing VJP residuals —
     per-stage activation checkpointing, the standard pairing with 1F1B.
 
+    Honest accounting for THIS (dense-SPMD scan) realization: every
+    tick computes both sub-steps on every device — masked warmup/drain
+    work is not free the way it is in a sparse per-device runtime — so
+    the scan runs ``M + 2(S-1)`` full-work ticks where
+    autodiff-GPipe-with-remat replays ``~M + S - 1``: 1F1B here costs
+    ``O(S)`` extra chunk-units in exchange for the O(S)-vs-O(M)
+    activation memory, the right trade exactly when M >> S (the regime
+    where microbatching pays at all).  The same arithmetic is why the
+    *interleaved* (virtual-chunk) 1F1B variant is deliberately absent:
+    its bubble win exists only when idle ticks cost nothing, but an
+    SPMD scan must execute every (device, tick) slot — with V virtual
+    chunks the dense schedule runs ``M + 2(VS-1)`` ticks of unreduced
+    per-tick work, strictly worse.  A sparse interleaved schedule
+    needs per-device program divergence that shard_map's single traced
+    program cannot express.
+
     Contract: ``loss_tail(y_micro, batch_micro) -> scalar`` must be a
     per-microbatch loss whose full-batch value is the mean over
     microbatches (true for mean-reduced losses over equal microbatch
