@@ -292,7 +292,68 @@ print("speculative == target greedy:", bool((spec == ref).all()),
 # Self-draft sanity: drafting with the target itself accepts everything.
 _, acc_self = speculative_generate(params, params, sp_prompt, cfg, cfg,
                                    10, gamma=3)
-print(f"self-draft mean accepted/round: {float(acc_self):.2f} (max 3)")""")
+print(f"self-draft mean accepted/round: {float(acc_self):.2f} (max 3)")
+# Batched streams share every draft/verify forward; per-stream cache
+# pointers keep diverging acceptance independent.
+spec_b, _ = speculative_generate(params, draft, prompt, cfg, draft_cfg,
+                                 10, gamma=3)
+ref_b = generate(params, prompt, cfg, max_new_tokens=10)
+print(f"batched speculative (B={prompt.shape[0]}) == batched greedy:",
+      bool((spec_b == ref_b).all()))""")
+
+md("""## 1F1B pipeline schedule — O(stages) activation memory
+
+GPipe via autodiff stores every microbatch's residuals; the 1F1B
+(PipeDream-flush) scan interleaves one forward and one backward
+sub-step per tick, so the in-flight buffer is `2·stages − 1`
+microbatch inputs regardless of the microbatch count — same loss,
+same gradients.""")
+
+code("""\
+Dm = 16
+fb_stages = {"w": jax.random.normal(jax.random.PRNGKey(20),
+                                    (4, Dm, Dm)) * 0.3,
+             "b": jnp.zeros((4, Dm))}
+fb_stage_fn = lambda pr, h: jnp.tanh(h @ pr["w"] + pr["b"])
+mse = lambda out, tgt: jnp.mean((out - tgt) ** 2)
+xin = jax.random.normal(jax.random.PRNGKey(21), (16, Dm))
+tgt = jax.random.normal(jax.random.PRNGKey(22), (16, Dm))
+sh = pipeline.shard_stage_params(fb_stages, pp_mesh)
+gp = pipeline.make_pipeline_loss(fb_stage_fn, mse, pp_mesh,
+                                 n_microbatches=8)
+l_ref, g_ref = jax.value_and_grad(gp)(sh, xin, tgt)
+fb = pipeline.make_pipeline_1f1b(fb_stage_fn, mse, pp_mesh,
+                                 n_microbatches=8)
+l_fb, g_fb = fb(sh, xin, tgt)
+gerr = max(float(jnp.max(jnp.abs(a - b))) for a, b in
+           zip(jax.tree_util.tree_leaves(g_fb),
+               jax.tree_util.tree_leaves(g_ref)))
+print(f"1F1B vs GPipe grads match: "
+      f"{abs(float(l_fb) - float(l_ref)) < 1e-5 and gerr < 1e-4} "
+      f"(buffer {2 * 4 - 1} deep, not 8)")""")
+
+md("""## Sparse MoE dispatch + windowed-ring hop plan
+
+Two routing upgrades: `dispatch_mode="sparse"` replaces the quadratic
+one-hot dispatch einsums with a sort/segment gather (linear in
+tokens, bit-identical drops), and sliding-window ring attention prunes
+whole out-of-band hops from the ring — `hop_plan` computes the
+contributing steps statically.""")
+
+code("""\
+from nbdistributed_tpu.parallel import expert
+from nbdistributed_tpu.parallel.ring import hop_plan
+
+mx = jax.random.normal(jax.random.PRNGKey(23), (64, 16), jnp.float32)
+mpar = expert.init_moe_params(jax.random.PRNGKey(24), 16, 32, 4,
+                              dtype=jnp.float32)
+yd, _ = expert.moe_ffn(mx, mpar)
+ysp, _ = expert.moe_ffn(mx, mpar, dispatch_mode="sparse")
+print(f"sparse MoE dispatch == dense: "
+      f"{float(jnp.max(jnp.abs(ysp - yd))) < 1e-5}")
+plan = hop_plan(8, 2048, 4096)   # sp=8, 2048-token chunks, 4K window
+print(f"SWA ring hop plan (sp=8, S=16K, window=4K): {plan} — "
+      f"{len(plan)}/8 hops pay compute+ppermute")""")
 
 md("""## LoRA fine-tuning
 

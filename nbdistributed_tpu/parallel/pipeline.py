@@ -231,18 +231,24 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
 
 
 def make_pipeline_loss(stage_fn, loss_tail, mesh, *, axis: str = "pp",
-                       n_microbatches: int | None = None):
+                       n_microbatches: int | None = None,
+                       remat: bool = False):
     """Compose a pipelined forward with a loss head.
 
     ``loss_tail(final_activation, batch) -> scalar``.  The returned
     ``loss(stage_params, x, batch)`` differentiates end-to-end (the
     backward pass pipelines in reverse through the transposed
-    ppermutes).
+    ppermutes).  ``remat=True`` checkpoints each stage application, so
+    the GPipe backward stores M microbatch *inputs* per stage instead
+    of M sets of stage-internal residuals — the intermediate memory
+    point between plain GPipe (O(M·residuals)) and
+    :func:`make_pipeline_1f1b` (O(S·inputs)).
     """
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     @jax.jit
     def loss(stage_params, x, batch):
-        y = pipeline_forward(stage_fn, stage_params, x, mesh, axis=axis,
+        y = pipeline_forward(fn, stage_params, x, mesh, axis=axis,
                              n_microbatches=n_microbatches)
         return loss_tail(y, batch)
 

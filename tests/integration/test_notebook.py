@@ -140,6 +140,11 @@ def test_parallelism_notebook_strategies_exact(executed_parallelism_nb):
     assert "FSDP train step: loss" in text and "sharded 4-way" in text
     assert "speculative == target greedy: True" in text
     assert "self-draft mean accepted/round: 3.00" in text
+    assert "batched speculative (B=2) == batched greedy: True" in text
+    assert "1F1B vs GPipe grads match: True" in text
+    assert "buffer 7 deep" in text
+    assert "sparse MoE dispatch == dense: True" in text
+    assert "3/8 hops pay compute+ppermute" in text
 
 
 @pytest.fixture(scope="module")

@@ -205,6 +205,23 @@ def test_1f1b_trains_and_memory_bound(mesh):
     assert all(s[0] != M for s in carries if len(s) == 3), carries
 
 
+def test_gpipe_remat_matches_plain(mesh):
+    """remat=True must change memory, not math: identical loss and
+    gradients to the non-remat GPipe loss."""
+    params = _params(jax.random.PRNGKey(32))
+    x = jax.random.normal(jax.random.PRNGKey(33), (8, D))
+    y = jax.random.normal(jax.random.PRNGKey(34), (8, D))
+    sharded = pipeline.shard_stage_params(params, mesh)
+    plain = pipeline.make_pipeline_loss(_stage_fn, _mse_tail, mesh)
+    rem = pipeline.make_pipeline_loss(_stage_fn, _mse_tail, mesh,
+                                      remat=True)
+    l0, g0 = jax.value_and_grad(plain)(sharded, x, y)
+    l1, g1 = jax.value_and_grad(rem)(sharded, x, y)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), g1, g0)
+
+
 def test_1f1b_single_stage():
     mesh1 = make_mesh({"pp": 1}, devices=jax.devices()[:1])
     params = _params(jax.random.PRNGKey(29))
