@@ -498,9 +498,24 @@ class DistributedMagics(Magics):
         proc_status = self._pm.get_status()
         live: dict[int, dict] = {}
         alive = self._pm.alive_ranks()
-        if self._comm is not None and alive:
+        # Heartbeats carry the worker loop's busy state; a rank busy in
+        # a long cell cannot answer get_status (the request loop is
+        # serial), so probing it would stall this magic for the full
+        # timeout — skip busy ranks and report what the pings say.
+        busy: dict[int, dict] = {}
+        if self._comm is not None:
+            from ..runtime.worker import HEARTBEAT_INTERVAL_S
+            now = time.time()
+            for r in alive:
+                ping = self._comm.last_ping(r)
+                if (ping is not None and ping[1].get("busy_s") is not None
+                        and now - ping[0] < 3 * HEARTBEAT_INTERVAL_S):
+                    busy[r] = {"type": ping[1].get("busy_type"),
+                               "s": ping[1]["busy_s"] + (now - ping[0])}
+        idle = [r for r in alive if r not in busy]
+        if self._comm is not None and idle:
             try:
-                resp = self._comm.send_to_ranks(alive, "get_status",
+                resp = self._comm.send_to_ranks(idle, "get_status",
                                                 timeout=5)
                 live = {r: m.data for r, m in resp.items()}
             except Exception:
@@ -525,6 +540,10 @@ class DistributedMagics(Magics):
                                      f"/{mem.get('limit') or 0:.2f} GB")
                 line_txt += (f" · {st['global_device_count']} global "
                              f"devices")
+            if rank_id in busy:
+                b = busy[rank_id]
+                line_txt += (f" · ⚙ busy: {b['type']} running "
+                             f"{b['s']:.1f}s")
             if self._comm is not None:
                 seen = self._comm.last_seen(rank_id)
                 if seen is not None:

@@ -66,6 +66,7 @@ class CommunicationManager:
         self._dead: set[int] = set()
         self._ready = threading.Event()
         self._last_seen: dict[int, float] = {}
+        self._last_ping: dict[int, tuple[float, dict]] = {}
         self._output_callback: Callable[[int, dict], None] | None = None
         self._notify_callbacks: list[Callable[[int, Message], None]] = []
         self._listener.on_message = self._on_message
@@ -104,6 +105,16 @@ class CommunicationManager:
     def last_seen(self, rank: int) -> float | None:
         with self._lock:
             return self._last_seen.get(rank)
+
+    def last_ping(self, rank: int) -> tuple[float, dict] | None:
+        """(arrival time, payload) of the rank's latest heartbeat.  The
+        payload carries the worker loop's busy state ({"busy_type",
+        "busy_s"} mid-request, empty when idle) — the only liveness
+        signal that does NOT go through the worker's serial request
+        loop, so it works exactly when a status probe would stall
+        behind a long-running cell."""
+        with self._lock:
+            return self._last_ping.get(rank)
 
     def mark_worker_dead(self, rank: int) -> None:
         """Called by the process monitor when a worker process exits.
@@ -222,7 +233,9 @@ class CommunicationManager:
                 pending.event.set()
             return
         if msg.msg_type == "ping":
-            return  # liveness only; already recorded last_seen
+            with self._lock:
+                self._last_ping[rank] = (time.time(), msg.data or {})
+            return
         for cb in self._notify_callbacks:
             try:
                 cb(rank, msg)

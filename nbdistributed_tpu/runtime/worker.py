@@ -27,6 +27,7 @@ import argparse
 import os
 import sys
 import threading
+import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
@@ -52,6 +53,7 @@ class DistributedWorker:
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
+        self._busy: tuple | None = None  # (msg_type, started_ts) | None
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -161,10 +163,24 @@ class DistributedWorker:
     def _heartbeat(self) -> None:
         """Liveness pings; also the only traffic during long XLA compiles,
         so the coordinator can distinguish busy from dead (the reference
-        cannot: SURVEY §7 'no-timeout mode hangs')."""
+        cannot: SURVEY §7 'no-timeout mode hangs').
+
+        Pings carry the main loop's busy state: the request loop is
+        SERIAL, so a status probe stalls exactly when the user most
+        wants it (mid-cell) — the heartbeat thread reports what the
+        main thread is doing without going through the loop.  (A
+        heartbeat alone proves only the *process* lives; ``busy_s``
+        growing across pings is how the coordinator tells "crunching a
+        long cell" from "idle".)"""
         while not self._shutdown.wait(HEARTBEAT_INTERVAL_S):
+            busy = self._busy  # (msg_type, started); torn reads are
+            data = None        # harmless (both fields set together)
+            if busy is not None:
+                data = {"busy_type": busy[0],
+                        "busy_s": round(time.time() - busy[1], 3)}
             try:
-                self.channel.send(Message(msg_type="ping", rank=self.rank))
+                self.channel.send(Message(msg_type="ping",
+                                          rank=self.rank, data=data))
             except Exception:
                 return  # channel gone; main loop will notice
 
@@ -338,6 +354,7 @@ class DistributedWorker:
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
             handler = handlers.get(msg.msg_type)
+            self._busy = (msg.msg_type, time.time())
             try:
                 if handler is None:
                     reply = msg.reply(
@@ -359,6 +376,8 @@ class DistributedWorker:
                     data={"error": str(e),
                           "traceback": traceback.format_exc()},
                     rank=self.rank)
+            finally:
+                self._busy = None
             try:
                 self.channel.send(reply)  # gate closed: frame is atomic
             except Exception:

@@ -70,6 +70,46 @@ def test_status_magic(ip, capsys):
     assert "backend=cpu" in out
 
 
+def test_status_magic_shows_busy_without_stalling(ip, capsys):
+    """%dist_status during a long cell must return promptly (busy ranks
+    are not probed — their serial loop cannot answer) and report the
+    running cell from the heartbeat payload."""
+    import threading
+    import time as _time
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    comm = DistributedMagics._comm
+    t = threading.Thread(
+        target=lambda: comm.send_to_all(
+            "execute", "import time\ntime.sleep(6)\n'slow'",
+            timeout=120),
+        daemon=True)
+    t.start()
+    try:
+        # EVERY rank must have reported busy before the magic runs —
+        # a rank whose busy ping is still in flight would be probed
+        # via its (blocked) serial loop and stall the full timeout.
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            pings = [comm.last_ping(r) for r in range(2)]
+            if all(p and p[1].get("busy_type") == "execute"
+                   for p in pings):
+                break
+            _time.sleep(0.2)
+        else:
+            raise AssertionError("not all ranks reported busy")
+        capsys.readouterr()
+        t0 = _time.time()
+        ip.run_line_magic("dist_status", "")
+        elapsed = _time.time() - t0
+        out = capsys.readouterr().out
+        assert "busy: execute running" in out, out
+        assert elapsed < 4.0, f"status stalled {elapsed:.1f}s on busy ranks"
+    finally:
+        t.join(timeout=60)
+
+
 def test_error_reported_per_rank(ip, capsys):
     run(ip, "if rank == 1:\n    raise ValueError('r1 only')")
     out = capsys.readouterr().out

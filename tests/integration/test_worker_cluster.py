@@ -253,6 +253,61 @@ def test_reduce_scatter_fallback_op_max(cluster):
     assert out == {0: "[0.0, 2.0]", 1: "[4.0, 6.0]"}
 
 
+def test_heartbeat_carries_busy_state(cluster):
+    """The serial worker loop cannot answer probes mid-cell, so the
+    heartbeat thread reports busy state out-of-band: during a long
+    execute, pings carry {busy_type, busy_s} with busy_s growing;
+    after completion they go back to idle (no payload)."""
+    import threading
+
+    comm, _ = cluster
+    done = threading.Event()
+
+    def _send():
+        comm.send_to_all("execute",
+                         "import time\ntime.sleep(7)\n'long done'",
+                         timeout=120)
+        done.set()
+
+    t = threading.Thread(target=_send, daemon=True)
+    t.start()
+    try:
+        # Wait for a ping that reports the execute in progress.
+        deadline = time.time() + 30
+        seen = None
+        while time.time() < deadline:
+            ping = comm.last_ping(0)
+            if ping and ping[1].get("busy_type") == "execute":
+                seen = ping[1]
+                break
+            time.sleep(0.2)
+        assert seen is not None, "no busy ping within 30s"
+        assert seen["busy_s"] >= 0
+        # A later ping must show the busy time growing.
+        first = seen["busy_s"]
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ping = comm.last_ping(0)
+            if ping[1].get("busy_s", -1) > first + 1.0:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("busy_s did not grow across pings")
+    finally:
+        assert done.wait(60), "long cell never completed"
+        t.join(timeout=10)
+    # Idle again: the next ping drops the busy payload.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        ping = comm.last_ping(0)
+        if ping and not ping[1]:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"ping still busy after completion: "
+                             f"{comm.last_ping(0)}")
+
+
 def test_interrupt_aborts_cell_workers_survive(cluster):
     """%dist_interrupt semantics: SIGINT aborts the running cell with a
     KeyboardInterrupt error response; the workers keep serving."""
