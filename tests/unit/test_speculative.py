@@ -175,6 +175,43 @@ def test_batched_sampled_preserves_target_distribution():
     assert tv < 0.2, (tv, p)
 
 
+@pytest.mark.parametrize("B,S0,new,gamma", [
+    (1, 1, 1, 1),    # minimal everything: seed token only, loop skipped
+    (2, 1, 3, 5),    # gamma > max_new_tokens (overshoot clamping)
+    (3, 7, 2, 4),    # one spec round, wide draft past the target count
+    (5, 2, 6, 3),    # odd batch, short prompts
+])
+def test_spec_edge_geometries_exact(setup, B, S0, new, gamma):
+    """Boundary shapes for the per-stream pointer math: prompts of one
+    token, the degenerate single-token generation (prefill + seed, the
+    while-loop never entered), gamma exceeding the remaining target
+    count (a final round can overshoot by a whole round — the buffer
+    slack and clamped writes must keep committed tokens intact), and
+    odd batch sizes.  Greedy output must equal batched greedy decode
+    in every geometry."""
+    cfg, draft_cfg, params, draft, _ = setup
+    prompts = jax.random.randint(jax.random.PRNGKey(40 + B), (B, S0),
+                                 0, cfg.vocab_size)
+    got, acc = speculative_generate(params, draft, prompts, cfg,
+                                    draft_cfg, new, gamma=gamma)
+    ref = generate(params, prompts, cfg, max_new_tokens=new)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert got.shape == (B, S0 + new)
+    assert 0.0 <= float(acc) <= gamma
+
+
+def test_spec_oversized_max_len_exact(setup):
+    """A max_len far beyond the needed buffer must not disturb the
+    position-masked cache reads or the commit arithmetic."""
+    cfg, draft_cfg, params, draft, _ = setup
+    prompts = jax.random.randint(jax.random.PRNGKey(50), (2, 4), 0,
+                                 cfg.vocab_size)
+    got, _ = speculative_generate(params, draft, prompts, cfg,
+                                  draft_cfg, 6, gamma=2, max_len=128)
+    ref = generate(params, prompts, cfg, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
 def test_batched_moe_spec_matches_solo():
     """MoE target + draft, batched streams with diverging acceptance:
     each row must equal its solo run.  Frozen streams are masked out of
