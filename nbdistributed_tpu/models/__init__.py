@@ -11,7 +11,8 @@ from .hf import (config_from_hf, load_hf_pretrained,
 from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
                    lora_num_params, lora_shardings,
                    make_lora_train_step)
-from .pp import (make_pp_train_step, pp_apply_shardings, pp_loss_fn,
+from .pp import (make_pp_1f1b_train_step, make_pp_train_step,
+                 pp_apply_shardings, pp_loss_fn,
                  pp_stage_params, pp_unstage_params)
 from .speculative import speculative_generate
 from .quant import (dequantize_weight, is_quantized, quantization_error,
@@ -48,5 +49,6 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "quantize_moe_params", "quantize_params", "quantize_weight",
            "quantized_moe_shardings", "quantized_shardings",
            "speculative_generate",
-           "make_pp_train_step", "pp_apply_shardings", "pp_loss_fn",
+           "make_pp_1f1b_train_step", "make_pp_train_step",
+           "pp_apply_shardings", "pp_loss_fn",
            "pp_stage_params", "pp_unstage_params"]

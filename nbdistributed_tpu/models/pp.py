@@ -112,6 +112,72 @@ def make_pp_train_step(cfg: TransformerConfig, optimizer, mesh, *,
     return step
 
 
+def make_pp_1f1b_train_step(cfg: TransformerConfig, optimizer, mesh, *,
+                            pp_axis: str = "pp",
+                            n_microbatches: int | None = None):
+    """The 1F1B (PipeDream-flush) analog of :func:`make_pp_train_step`:
+    same contract, O(stages) in-flight activations instead of O(M).
+
+    The pipelined region covers the layer stack; the embedding (below)
+    and final-norm + lm_head + loss (above) train too: the tail rides
+    ``make_pipeline_1f1b_full``'s tail-parameter gradients, and the
+    embedding gradient is folded per microbatch by a scatter-add
+    ``dx_sink`` as each input-cotangent exits stage 0's backward — no
+    O(M) dx buffer.  Loss and gradients match
+    :func:`make_pp_train_step` (same per-microbatch-mean caveat as the
+    GPipe path: equal microbatch sizes make the mean exact)."""
+    from ..parallel.pipeline import make_pipeline_1f1b_full
+
+    n_stages = mesh.shape[pp_axis]
+    n_micro = (n_microbatches if n_microbatches is not None
+               else n_stages)
+    # The pipeline fn is jit-wrapped per construction; cache it by the
+    # shapes it closes over so eager (un-jitted) step() calls reuse the
+    # compiled program instead of rebuilding it every training step.
+    fn_cache: dict = {}
+
+    def step(params_pp, opt_state, batch):
+        tokens = batch["tokens"]
+        B, S = tokens.shape
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by {n_micro} "
+                             f"microbatches")
+        mb_positions = jnp.broadcast_to(jnp.arange(S),
+                                        (B // n_micro, S))
+
+        def tail_fn(tp, y, bt_m):
+            y = _rms_norm(y, tp["final_norm"], cfg.norm_eps)
+            logits = qlinear(y, tp["lm_head"]).astype(jnp.float32)
+            return shifted_xent(logits, bt_m["tokens"])
+
+        embed = params_pp["embed"]
+
+        def dx_sink(acc, dx, bt_m):
+            return acc.at[bt_m["tokens"]].add(dx.astype(acc.dtype))
+
+        # zeros from shape/dtype only: zeros_like(embed) would capture
+        # the (Auto-mesh) sharding inside the Manual shard_map region.
+        key = (B, S, embed.shape, str(embed.dtype))
+        if key not in fn_cache:
+            fn_cache[key] = make_pipeline_1f1b_full(
+                _stage_fn(cfg, mb_positions), tail_fn, mesh,
+                axis=pp_axis, n_microbatches=n_micro, dx_sink=dx_sink,
+                dx_init=lambda: jnp.zeros(embed.shape, embed.dtype))
+        fn = fn_cache[key]
+        x = embed[tokens].astype(cfg.dtype)
+        tp = {"final_norm": params_pp["final_norm"],
+              "lm_head": params_pp["lm_head"]}
+        loss, g_layers, g_tail, g_embed = fn(
+            tp, params_pp["layers_pp"], x, batch)
+        grads = {"embed": g_embed, "layers_pp": g_layers, **g_tail}
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              params_pp)
+        return (apply_optimizer_updates(params_pp, updates), opt_state,
+                loss)
+
+    return step
+
+
 def pp_apply_shardings(params_pp: dict, mesh, *, pp_axis: str = "pp"):
     """Place ``layers_pp`` stage-sharded over ``pp_axis`` and replicate
     the rest — the standard layout for :func:`make_pp_train_step`."""

@@ -143,11 +143,50 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
     sizes); ``batch`` is any pytree with leading batch dim.  Gradients
     match ``jax.grad`` of the sequential/GPipe loss to float tolerance.
     """
+    full = make_pipeline_1f1b_full(
+        stage_fn, lambda tp, y, b: loss_tail(y, b), mesh, axis=axis,
+        n_microbatches=n_microbatches)
+
+    def plain_loss_and_grads(stage_params, x, batch):
+        # `full` is already jit-wrapped; a second jax.jit here would
+        # only add a trace layer and a duplicate cache entry.
+        loss, stage_grads, _tail, _dx = full({}, stage_params, x,
+                                             batch)
+        return loss, stage_grads
+
+    return plain_loss_and_grads
+
+
+def make_pipeline_1f1b_full(stage_fn, tail_fn, mesh, *,
+                            axis: str = "pp",
+                            n_microbatches: int | None = None,
+                            dx_sink=None, dx_init=None):
+    """The general 1F1B machinery: gradients for the loss tail's own
+    parameters and for the pipeline *input*, on top of the stage
+    gradients — what a full model (embedding below the pipelined
+    region, norm + head + loss above it) needs to train end-to-end
+    under the schedule.
+
+    ``tail_fn(tail_params, y_micro, batch_micro) -> scalar`` is the
+    per-microbatch loss head; its parameter gradients accumulate on
+    the last stage and are psum-replicated.  ``dx_sink(acc, dx_micro,
+    batch_micro) -> acc`` (with ``dx_init()`` building the initial
+    accumulator) folds each microbatch's input-cotangent as it exits
+    stage 0's backward — e.g. an embedding scatter-add — so no O(M)
+    dx buffer ever exists; omit both to skip input gradients.
+
+    Returns a jitted ``(tail_params, stage_params, x, batch) ->
+    (loss, stage_grads, tail_grads, dx_acc)`` (``dx_acc`` is None
+    without a sink).  Schedule, memory bound, and cost accounting: see
+    :func:`make_pipeline_1f1b`, which is this with an empty tail.
+    """
     n_stages = mesh.shape[axis]
     n_micro_default = n_microbatches
+    if (dx_sink is None) != (dx_init is None):
+        raise ValueError("pass both dx_sink and dx_init, or neither")
 
     @jax.jit
-    def loss_and_grads(stage_params, x, batch):
+    def loss_and_grads(tail_params, stage_params, x, batch):
         S = n_stages
         M = n_micro_default if n_micro_default is not None else S
         B = x.shape[0]
@@ -161,13 +200,15 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
         A = 2 * S - 1  # in-flight saved inputs: O(S), NOT O(M)
         multi = S > 1
 
-        def spmd(params, xs, bt):
+        def spmd(tp, params, xs, bt):
             stage = jax.lax.axis_index(axis)
             local = jax.tree_util.tree_map(lambda a: a[0], params)
             g0 = jax.tree_util.tree_map(jnp.zeros_like, local)
+            tg0 = jax.tree_util.tree_map(jnp.zeros_like, tp)
+            dx0 = dx_init() if dx_init is not None else jnp.float32(0.0)
 
             def tick(carry, t):
-                f_recv, b_recv, buf, grads, loss_acc = carry
+                f_recv, b_recv, buf, grads, tg, dxa, loss_acc = carry
                 # ---- forward sub-step: stage s runs microbatch t-s.
                 m_f = t - stage
                 act_f = (m_f >= 0) & (m_f < M)
@@ -197,37 +238,56 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
                 mb_idx = jnp.clip(m_b, 0, M - 1)
                 bt_m = jax.tree_util.tree_map(lambda a: a[mb_idx], bt)
                 loss_m, lt_vjp = jax.vjp(
-                    lambda y_: loss_tail(y_, bt_m), y_b)
-                cot_seed = lt_vjp(jnp.float32(1.0) / M)[0]
+                    lambda tp_, y_: tail_fn(tp_, y_, bt_m), tp, y_b)
+                dtp, cot_seed = lt_vjp(jnp.float32(1.0) / M)
+                last_b = act_b & (stage == S - 1)
+                tg = jax.tree_util.tree_map(
+                    lambda g, d: g + jnp.where(last_b, d, 0), tg, dtp)
                 cot = jnp.where(stage == S - 1, cot_seed, b_recv)
                 dp, dx = vjp(cot.astype(y_b.dtype))
                 grads = jax.tree_util.tree_map(
                     lambda g, d: g + jnp.where(act_b, d, 0), grads, dp)
-                loss_acc = loss_acc + jnp.where(
-                    act_b & (stage == S - 1), loss_m / M, 0.0)
+                if dx_sink is not None:
+                    # Fold stage 0's input-cotangent immediately (other
+                    # stages / inactive ticks fold zeros — a no-op), so
+                    # the input gradient never needs an O(M) buffer.
+                    dxa = dx_sink(
+                        dxa, jnp.where(act_b & (stage == 0), dx, 0),
+                        bt_m)
+                loss_acc = loss_acc + jnp.where(last_b, loss_m / M, 0.0)
                 if multi:
                     b_recv = jax.lax.ppermute(
                         dx, axis,
                         [(i, i - 1) for i in range(1, S)])
-                return (f_recv, b_recv, buf, grads, loss_acc), None
+                return (f_recv, b_recv, buf, grads, tg, dxa,
+                        loss_acc), None
 
             buf0 = jnp.zeros((A,) + xs.shape[1:], xs.dtype)
-            (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+            (_, _, _, grads, tg, dxa, loss_acc), _ = jax.lax.scan(
                 tick, (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]),
-                       buf0, g0, jnp.float32(0.0)),
+                       buf0, g0, tg0, dx0, jnp.float32(0.0)),
                 jnp.arange(T))
-            # Loss lives on the last stage; every stage's grads are its
-            # own slice (restack via the pp out_spec).
+            # Loss and tail grads live on the last stage, the dx
+            # accumulator on stage 0; psum replicates each (all other
+            # stages contributed zeros).  Stage grads are each stage's
+            # own slice (restacked via the pp out_spec).
             loss = jax.lax.psum(loss_acc, axis)
+            tg = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, axis), tg)
+            dxa = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, axis), dxa)
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-            return loss, grads
+            return loss, grads, tg, dxa
 
-        return jax.shard_map(
-            spmd, mesh=mesh, in_specs=(P(axis), P(), P()),
-            out_specs=(P(), P(axis)), check_vma=False)(
-            stage_params, xs, bt)
+        loss, stage_grads, tail_grads, dxa = jax.shard_map(
+            spmd, mesh=mesh, in_specs=(P(), P(axis), P(), P()),
+            out_specs=(P(), P(axis), P(), P()), check_vma=False)(
+            tail_params, stage_params, xs, bt)
+        return (loss, stage_grads, tail_grads,
+                dxa if dx_sink is not None else None)
 
     return loss_and_grads
+
 
 
 def make_pipeline_loss(stage_fn, loss_tail, mesh, *, axis: str = "pp",
