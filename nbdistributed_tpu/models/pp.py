@@ -114,7 +114,8 @@ def make_pp_train_step(cfg: TransformerConfig, optimizer, mesh, *,
 
 def make_pp_1f1b_train_step(cfg: TransformerConfig, optimizer, mesh, *,
                             pp_axis: str = "pp",
-                            n_microbatches: int | None = None):
+                            n_microbatches: int | None = None,
+                            batch_axis: str | None = None):
     """The 1F1B (PipeDream-flush) analog of :func:`make_pp_train_step`:
     same contract, O(stages) in-flight activations instead of O(M).
 
@@ -142,8 +143,10 @@ def make_pp_1f1b_train_step(cfg: TransformerConfig, optimizer, mesh, *,
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by {n_micro} "
                              f"microbatches")
-        mb_positions = jnp.broadcast_to(jnp.arange(S),
-                                        (B // n_micro, S))
+        # (1, S): broadcasts over ANY local row count — with a dp
+        # batch_axis each shard sees B/n_micro/dp rows, so a
+        # full-row-count positions array would mis-broadcast in RoPE.
+        mb_positions = jnp.arange(S)[None]
 
         def tail_fn(tp, y, bt_m):
             y = _rms_norm(y, tp["final_norm"], cfg.norm_eps)
@@ -151,18 +154,23 @@ def make_pp_1f1b_train_step(cfg: TransformerConfig, optimizer, mesh, *,
             return shifted_xent(logits, bt_m["tokens"])
 
         embed = params_pp["embed"]
+        # Close over shape/dtype only: capturing the embed ARRAY in the
+        # cached lambda would pin the first call's (vocab, d_model)
+        # matrix alive for the step function's lifetime (and
+        # zeros_like would drag its Auto-mesh sharding into the Manual
+        # shard_map region).
+        e_shape, e_dtype = embed.shape, embed.dtype
 
         def dx_sink(acc, dx, bt_m):
             return acc.at[bt_m["tokens"]].add(dx.astype(acc.dtype))
 
-        # zeros from shape/dtype only: zeros_like(embed) would capture
-        # the (Auto-mesh) sharding inside the Manual shard_map region.
-        key = (B, S, embed.shape, str(embed.dtype))
+        key = (B, S, e_shape, str(e_dtype))
         if key not in fn_cache:
             fn_cache[key] = make_pipeline_1f1b_full(
                 _stage_fn(cfg, mb_positions), tail_fn, mesh,
                 axis=pp_axis, n_microbatches=n_micro, dx_sink=dx_sink,
-                dx_init=lambda: jnp.zeros(embed.shape, embed.dtype))
+                dx_init=lambda: jnp.zeros(e_shape, e_dtype),
+                batch_axis=batch_axis)
         fn = fn_cache[key]
         x = embed[tokens].astype(cfg.dtype)
         tp = {"final_norm": params_pp["final_norm"],

@@ -98,7 +98,8 @@ def pipeline_forward(stage_fn, stage_params, x, mesh, *, axis: str = "pp",
 
 
 def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
-                       n_microbatches: int | None = None):
+                       n_microbatches: int | None = None,
+                       batch_axis: str | None = None):
     """One-forward-one-backward (1F1B / PipeDream-flush) training
     schedule: a jitted ``(stage_params, x, batch) -> (loss, grads)``.
 
@@ -145,7 +146,7 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
     """
     full = make_pipeline_1f1b_full(
         stage_fn, lambda tp, y, b: loss_tail(y, b), mesh, axis=axis,
-        n_microbatches=n_microbatches)
+        n_microbatches=n_microbatches, batch_axis=batch_axis)
 
     def plain_loss_and_grads(stage_params, x, batch):
         # `full` is already jit-wrapped; a second jax.jit here would
@@ -160,7 +161,8 @@ def make_pipeline_1f1b(stage_fn, loss_tail, mesh, *, axis: str = "pp",
 def make_pipeline_1f1b_full(stage_fn, tail_fn, mesh, *,
                             axis: str = "pp",
                             n_microbatches: int | None = None,
-                            dx_sink=None, dx_init=None):
+                            dx_sink=None, dx_init=None,
+                            batch_axis: str | None = None):
     """The general 1F1B machinery: gradients for the loss tail's own
     parameters and for the pipeline *input*, on top of the stage
     gradients — what a full model (embedding below the pipelined
@@ -179,6 +181,12 @@ def make_pipeline_1f1b_full(stage_fn, tail_fn, mesh, *,
     (loss, stage_grads, tail_grads, dx_acc)`` (``dx_acc`` is None
     without a sink).  Schedule, memory bound, and cost accounting: see
     :func:`make_pipeline_1f1b`, which is this with an empty tail.
+
+    ``batch_axis``: a ``dp`` mesh axis the microbatch *rows* are
+    sharded over (DP × PP): each dp group pipelines its own batch
+    shard, and loss/stage/tail/dx gradients are mean-reduced across
+    the groups — the per-shard-mean of a mean-reduced loss equals the
+    global mean at equal shard sizes, exactly the DDP convention.
     """
     n_stages = mesh.shape[axis]
     n_micro_default = n_microbatches
@@ -276,11 +284,27 @@ def make_pipeline_1f1b_full(stage_fn, tail_fn, mesh, *,
                 lambda g: jax.lax.psum(g, axis), tg)
             dxa = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, axis), dxa)
+            if batch_axis is not None:
+                # DP x PP: every dp group pipelined its own batch
+                # shard; mean-reduce everything across the groups
+                # (equal shard sizes -> the global-batch mean).
+                loss = jax.lax.pmean(loss, batch_axis)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, batch_axis), grads)
+                tg = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, batch_axis), tg)
+                dxa = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, batch_axis), dxa)
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             return loss, grads, tg, dxa
 
+        # Microbatch ROWS (axis 1 of the (M, mb, ...) reshape) carry
+        # the dp sharding when batch_axis is set.
+        data_spec = (P(None, batch_axis) if batch_axis is not None
+                     else P())
         loss, stage_grads, tail_grads, dxa = jax.shard_map(
-            spmd, mesh=mesh, in_specs=(P(), P(axis), P(), P()),
+            spmd, mesh=mesh,
+            in_specs=(P(), P(axis), data_spec, data_spec),
             out_specs=(P(), P(axis), P(), P()), check_vma=False)(
             tail_params, stage_params, xs, bt)
         return (loss, stage_grads, tail_grads,
