@@ -38,7 +38,16 @@ _NEG_INF = -1e30
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
                   mesh=None, rules: dict | None = None,
                   quantized: bool = False):
-    """Zeroed (L, B, max_len, Hkv, Dh) K and V buffers.
+    """Zeroed (L, B, Hkv, max_len, Dh) K and V buffers.
+
+    The cache is **heads-major**: (token, head-dim) are the minor two
+    axes, which is what the Pallas decode kernel's block specs tile
+    (Mosaic requires the last two block dims divisible by (8, 128) or
+    equal to the array's — a (B, T, Hkv, D) layout puts the tiny Hkv
+    extent in the sublane slot, which real-TPU lowering rejects; the
+    CPU interpreter does not enforce this, so only on-chip runs catch
+    it).  It is also the natural TPU tiling: D on lanes, tokens on
+    sublanes.
 
     With ``mesh``, the buffers are laid out by ``rules`` (default:
     :func:`kv_cache_shardings` restricted to the axes the mesh has) so
@@ -49,7 +58,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
     context the cache — not the weights — dominates decode HBM traffic,
     and the scales commute through both attention matmuls (see
     ops/decode.py), so the kernel streams half the bytes."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     if quantized:
         sshape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, 1)
         cache = {"k": jnp.zeros(shape, jnp.int8),
@@ -81,33 +90,32 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
 def kv_cache_shardings(dp_axis: str | None = "dp",
                        tp_axis: str | None = "tp",
                        quantized: bool = False):
-    """PartitionSpec for the cache: batch over dp, KV heads over tp."""
-    spec = P(None, dp_axis, None, tp_axis, None)
+    """PartitionSpec for the cache: batch over dp, KV heads over tp.
+    Both the int8 scales and the heads-major K/V buffers carry the KV
+    heads at axis 2."""
+    spec = P(None, dp_axis, tp_axis, None, None)
     rules = {"k": spec, "v": spec}
     if quantized:
-        sspec = P(None, dp_axis, tp_axis, None, None)
-        rules["k_s"] = sspec
-        rules["v_s"] = sspec
+        rules["k_s"] = spec
+        rules["v_s"] = spec
     return rules
 
 
 def _quantize_kv(x):
     """Per-(token, kv-head) symmetric int8 for a new K or V slab.
 
-    x: (B, S, Hkv, D) -> (q8 int8 same shape, scales (B, Hkv, S, 1)
-    fp32 — the (B, Hkv, T, 1) cache layout the decode kernel's scale
-    blocks require).  The int8 core is quant.quantize_weight (one
-    scheme for weights and cache); only the layout transpose is local."""
+    x: (B, Hkv, S, D) heads-major -> (q8 int8 same shape, scales
+    (B, Hkv, S, 1) fp32) — both already in the cache layout.  The int8
+    core is quant.quantize_weight (one scheme for weights and cache)."""
     from .quant import quantize_weight
     qw = quantize_weight(x, axis=-1)
-    return qw["q8"], qw["s"][..., 0].transpose(0, 2, 1)[..., None]
+    return qw["q8"], qw["s"]
 
 
 def _dequantize_kv(q8, s):
-    """Inverse of :func:`_quantize_kv`: int8 (B, T, Hkv, D) + scales in
-    the (B, Hkv, T, 1) cache layout -> fp32 (B, T, Hkv, D).  The layout
-    permutation lives here and in _quantize_kv only."""
-    return q8.astype(jnp.float32) * s[..., 0].transpose(0, 2, 1)[..., None]
+    """Inverse of :func:`_quantize_kv`: int8 (B, Hkv, T, D) + scales
+    (B, Hkv, T, 1) -> fp32 (B, Hkv, T, D)."""
+    return q8.astype(jnp.float32) * s
 
 
 # ----------------------------------------------------------------------
@@ -116,16 +124,17 @@ def _dequantize_kv(q8, s):
 def _cached_attention(q, kc, vc, positions, scale, window=None):
     """GQA attention of new-token queries against the full cache.
 
-    q: (B, S, H, Dh) — S new tokens; kc/vc: (B, T, Hkv, Dh) — the whole
-    cache buffer; positions: (B, S) global positions of the queries.
-    Valid keys are exactly cache slots t <= position (later slots are
-    unwritten zeros and masked out by the same comparison).
+    q: (B, S, H, Dh) — S new tokens; kc/vc: (B, Hkv, T, Dh) — the
+    whole heads-major cache buffer; positions: (B, S) global positions
+    of the queries.  Valid keys are exactly cache slots t <= position
+    (later slots are unwritten zeros and masked out by the same
+    comparison).
     """
     B, S, H, Dh = q.shape
-    T, Hkv = kc.shape[1], kc.shape[2]
+    Hkv, T = kc.shape[1], kc.shape[2]
     group = H // Hkv
     qg = (q.astype(jnp.float32) * scale).reshape(B, S, Hkv, group, Dh)
-    s = jnp.einsum("bskgd,btkd->bkgst", qg, kc.astype(jnp.float32),
+    s = jnp.einsum("bskgd,bktd->bkgst", qg, kc.astype(jnp.float32),
                    preferred_element_type=jnp.float32)
     t_idx = jnp.arange(T)
     mask = t_idx[None, None, :] <= positions[:, :, None]  # (B,S,T)
@@ -134,7 +143,7 @@ def _cached_attention(q, kc, vc, positions, scale, window=None):
                        > positions[:, :, None] - window)
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkgst,btkd->bskgd", p, vc.astype(jnp.float32),
+    o = jnp.einsum("bkgst,bktd->bskgd", p, vc.astype(jnp.float32),
                    preferred_element_type=jnp.float32)
     return o.reshape(B, S, H * Dh).astype(q.dtype)
 
@@ -149,15 +158,15 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None,
     [t·Hkv/tp, (t+1)·Hkv/tp) — each shard keeps the full group ratio,
     so the local kernel call is the global computation.
 
-    q: (B, H, Dh); kc/vc: (B, T, Hkv, Dh); pos: (B,); optional int8
-    cache scales k_s/v_s: (B, Hkv, T, 1).
+    q: (B, H, Dh); kc/vc: (B, Hkv, T, Dh) heads-major; pos: (B,);
+    optional int8 cache scales k_s/v_s: (B, Hkv, T, 1).
     """
     from ..ops.decode import flash_decode_attention
 
     dp = "dp" if "dp" in mesh.shape else None
     tp = "tp" if "tp" in mesh.shape else None
     qspec = P(dp, tp, None)
-    cspec = P(dp, None, tp, None)
+    cspec = P(dp, tp, None, None)
     sspec = P(dp, tp, None, None)
 
     def inner(q, kc, vc, pos, *scales):
@@ -236,20 +245,17 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     mlp = _make_mlp_fn(cfg, mesh, ep_axis, token_mask=token_mask)
     kv_quantized = "k_s" in cache
 
-    def write_kv(buf, new, *, scale_layout=False):
+    def write_kv(buf, new):
         """Insert S new entries at the cache pointer: one slice update
         for a shared scalar pointer, a per-row (vmapped, scatter-
-        lowered) update for per-stream pointers.  ``scale_layout``
-        selects the (B, Hkv, T, 1) int8-scale layout whose token axis
-        sits at -2."""
+        lowered) update for per-stream pointers.  K/V buffers and int8
+        scales share the heads-major layout — the token axis sits at
+        -2 for both (D or the singleton scale at -1)."""
         if per_row:
-            start = ((lambda s: (0, s, 0)) if scale_layout
-                     else (lambda s: (s, 0, 0)))
             return jax.vmap(lambda c, u, s: jax.lax.dynamic_update_slice(
-                c, u, start(s)))(buf, new, cache_len)
-        start = ((0, 0, cache_len, 0) if scale_layout
-                 else (0, cache_len, 0, 0))
-        return jax.lax.dynamic_update_slice(buf, new, start)
+                c, u, (0, s, 0)))(buf, new, cache_len)
+        return jax.lax.dynamic_update_slice(buf, new,
+                                            (0, 0, cache_len, 0))
 
     def layer_step(x, inputs):
         if kv_quantized:
@@ -262,16 +268,19 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
         k = _rope(qlinear(h, layer["wk"]).reshape(B, S, Hkv, Dh),
                   positions, cfg.rope_theta)
         v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
+        # Heads-major for the cache: (B, S, Hkv, Dh) -> (B, Hkv, S, Dh).
+        kT = k.transpose(0, 2, 1, 3)
+        vT = v.transpose(0, 2, 1, 3)
         if kv_quantized:
-            k8, k_sc = _quantize_kv(k)
-            v8, v_sc = _quantize_kv(v)
+            k8, k_sc = _quantize_kv(kT)
+            v8, v_sc = _quantize_kv(vT)
             kc = write_kv(kc, k8)
             vc = write_kv(vc, v8)
-            ks = write_kv(ks, k_sc, scale_layout=True)
-            vs = write_kv(vs, v_sc, scale_layout=True)
+            ks = write_kv(ks, k_sc)
+            vs = write_kv(vs, v_sc)
         else:
-            kc = write_kv(kc, k.astype(kc.dtype))
-            vc = write_kv(vc, v.astype(vc.dtype))
+            kc = write_kv(kc, kT.astype(kc.dtype))
+            vc = write_kv(vc, vT.astype(vc.dtype))
         window = getattr(cfg, "sliding_window", None)
         if S == 1 and cfg.use_flash and mesh is None:
             # Decode hot path: fused Pallas kernel streams the cache

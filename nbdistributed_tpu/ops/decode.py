@@ -1,12 +1,16 @@
 """Pallas flash-decode: single-token attention against the KV cache.
 
 The decode step's hot op is bandwidth-bound: every generated token
-reads the whole (B, T, Hkv, D) cache once.  This kernel fuses the
-masked online-softmax into that single streaming pass — no (B, H, T)
-score tensor ever hits HBM — with one program per (batch, kv-head)
-whose query block is the GQA *group* (all H/Hkv query heads sharing
-that KV head), so the per-block matmuls are (group, D) @ (D, block_k):
-the same shape decode GQA is compute-bound on.
+reads the whole (B, Hkv, T, D) heads-major cache once.  This kernel
+fuses the masked online-softmax into that single streaming pass — no
+(B, H, T) score tensor ever hits HBM — with one program per (batch,
+kv-head) whose query block is the GQA *group* (all H/Hkv query heads
+sharing that KV head), so the per-block matmuls are
+(group, D) @ (D, block_k): the same shape decode GQA is compute-bound
+on.  The heads-major layout keeps (T, D) as each block's minor dims,
+which Mosaic's block-shape rules require (a seq-major (B, T, Hkv, D)
+cache puts the tiny Hkv in the sublane slot and fails to lower on
+real TPU hardware).
 
 Same recurrence as the prefill flash kernel (attention.py), lifted to
 the cache layout + per-batch valid-length masking (cache slots
@@ -63,8 +67,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
              & ((kb + 1) * block_k > lo))
     def _block():
         q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
-        k_blk = k_ref[0, :, 0].astype(jnp.float32)      # (Bk, D)
-        v_blk = v_ref[0, :, 0].astype(jnp.float32)
+        k_blk = k_ref[0, 0].astype(jnp.float32)         # (Bk, D)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         # A final block that extends past seq_k is padded by Pallas
         # with undefined data (NaN in interpret mode, garbage memory on
         # hardware).  The score mask below already discards those
@@ -125,7 +129,7 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
     from jax.experimental.pallas import tpu as pltpu
 
     B, Hkv, group, D = q.shape
-    T = kc.shape[1]
+    T = kc.shape[2]
     num_kb = -(-T // block_k)
     quantized = k_s is not None
 
@@ -143,16 +147,16 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
     in_specs = [
         pl.BlockSpec((1, 1, group, D),
                      lambda b, h, kb, pos: (b, h, 0, 0)),  # q
-        pl.BlockSpec((1, block_k, 1, D),
-                     lambda b, h, kb, pos: (b, kb, h, 0)),  # k
-        pl.BlockSpec((1, block_k, 1, D),
-                     lambda b, h, kb, pos: (b, kb, h, 0)),  # v
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, kb, pos: (b, h, kb, 0)),  # k
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, kb, pos: (b, h, kb, 0)),  # v
     ]
     args = [pos, q, kc, vc]
     if quantized:
-        # Scales live as (B, Hkv, T, 1): the (1, 1, block_k, 1) block's
-        # last two dims (block_k, 1) satisfy Mosaic's (8k | equal)
-        # rule, which the (B, T, Hkv) layout cannot.
+        # Scales live as (B, Hkv, T, 1), same heads-major layout as
+        # K/V: every block's last two dims are (token-block, minor) and
+        # satisfy Mosaic's (8-divisible | equal) rule.
         in_specs += [
             pl.BlockSpec((1, 1, block_k, 1),
                          lambda b, h, kb, pos: (b, h, kb, 0)),  # k_s
@@ -200,7 +204,8 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     cache.
 
     q: (B, H, D) — this step's queries (S = 1 squeezed);
-    kc/vc: (B, T, Hkv, D) cache buffers (slots beyond ``pos`` unwritten);
+    kc/vc: (B, Hkv, T, D) heads-major cache buffers (slots beyond
+    ``pos`` unwritten);
     pos: (B,) int32 — the global position of the new token per
     sequence (cache slots ``t <= pos[b]`` attend); ``window`` further
     restricts to the last ``window`` positions (sliding-window
@@ -216,7 +221,7 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     quantizer).
     """
     B, H, D = q.shape
-    T, Hkv = kc.shape[1], kc.shape[2]
+    Hkv, T = kc.shape[1], kc.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
     if (k_s is None) != (v_s is None):

@@ -11,14 +11,14 @@ from nbdistributed_tpu.ops.decode import flash_decode_attention
 
 def reference(q, kc, vc, pos):
     B, H, D = q.shape
-    T, Hkv = kc.shape[1], kc.shape[2]
+    Hkv, T = kc.shape[1], kc.shape[2]
     group = H // Hkv
     qg = q.reshape(B, Hkv, group, D).astype(jnp.float32) / np.sqrt(D)
-    s = jnp.einsum("bkgd,btkd->bkgt", qg, kc.astype(jnp.float32))
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kc.astype(jnp.float32))
     mask = jnp.arange(T)[None, None, None, :] <= pos[:, None, None, None]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, -1)
-    o = jnp.einsum("bkgt,btkd->bkgd", p, vc.astype(jnp.float32))
+    o = jnp.einsum("bkgt,bktd->bkgd", p, vc.astype(jnp.float32))
     return o.reshape(B, H, D).astype(q.dtype)
 
 
@@ -35,8 +35,8 @@ def reference(q, kc, vc, pos):
                                    (129, [0, 128])])
 def test_decode_matches_reference(T, pos):
     B, H, Hkv, D = 2, 8, 4, 16
-    kc = jax.random.normal(jax.random.PRNGKey(0), (B, T, Hkv, D))
-    vc = jax.random.normal(jax.random.PRNGKey(1), (B, T, Hkv, D))
+    kc = jax.random.normal(jax.random.PRNGKey(0), (B, Hkv, T, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, T, D))
     q = jax.random.normal(jax.random.PRNGKey(2), (B, H, D))
     pos = jnp.asarray(pos, jnp.int32)
     out = flash_decode_attention(q, kc, vc, pos)
@@ -47,8 +47,8 @@ def test_decode_matches_reference(T, pos):
 
 def test_decode_mha_no_grouping():
     B, T, H, D = 1, 64, 4, 32
-    kc = jax.random.normal(jax.random.PRNGKey(3), (B, T, H, D))
-    vc = jax.random.normal(jax.random.PRNGKey(4), (B, T, H, D))
+    kc = jax.random.normal(jax.random.PRNGKey(3), (B, H, T, D))
+    vc = jax.random.normal(jax.random.PRNGKey(4), (B, H, T, D))
     q = jax.random.normal(jax.random.PRNGKey(5), (B, H, D))
     pos = jnp.asarray([40], jnp.int32)
     out = flash_decode_attention(q, kc, vc, pos)
@@ -58,7 +58,7 @@ def test_decode_mha_no_grouping():
 
 
 def test_decode_rejects_indivisible_heads():
-    kc = jnp.zeros((1, 16, 3, 8))
+    kc = jnp.zeros((1, 3, 16, 8))
     with pytest.raises(ValueError, match="divisible"):
         flash_decode_attention(jnp.zeros((1, 8, 8)), kc, kc,
                                jnp.zeros((1,), jnp.int32))
@@ -137,8 +137,8 @@ def test_decode_sliding_window(T, pos, window):
     """Windowed decode: only the last `window` cache slots attend;
     out-of-band blocks are skipped in the kernel, not just masked."""
     B, H, Hkv, D = 2, 8, 4, 16
-    kc = jax.random.normal(jax.random.PRNGKey(0), (B, T, Hkv, D))
-    vc = jax.random.normal(jax.random.PRNGKey(1), (B, T, Hkv, D))
+    kc = jax.random.normal(jax.random.PRNGKey(0), (B, Hkv, T, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, T, D))
     q = jax.random.normal(jax.random.PRNGKey(2), (B, H, D))
     pos = jnp.asarray(pos, jnp.int32)
     out = flash_decode_attention(q, kc, vc, pos, window=window)
@@ -146,13 +146,13 @@ def test_decode_sliding_window(T, pos, window):
     # Oracle: windowed softmax over the cache.
     group = H // Hkv
     qg = q.reshape(B, Hkv, group, D).astype(jnp.float32) / np.sqrt(D)
-    s = jnp.einsum("bkgd,btkd->bkgt", qg, kc.astype(jnp.float32))
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kc.astype(jnp.float32))
     t = jnp.arange(T)
     keep = ((t[None, :] <= pos[:, None])
             & (t[None, :] > pos[:, None] - window))
     s = jnp.where(keep[:, None, None], s, -1e30)
     p = jax.nn.softmax(s, -1)
-    ref = jnp.einsum("bkgt,btkd->bkgd", p,
+    ref = jnp.einsum("bkgt,bktd->bkgd", p,
                      vc.astype(jnp.float32)).reshape(B, H, D)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
@@ -198,8 +198,8 @@ def test_decode_kernel_int8_cache_matches_dequantized_oracle():
     B, T, H, Hkv, D = 2, 129, 8, 2, 32
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
-    k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
-    v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, T, D), jnp.float32)
     pos = jnp.asarray([T - 1, 77], jnp.int32)
 
     k8, k_s = _quantize_kv(k)
@@ -221,7 +221,7 @@ def test_decode_kernel_int8_requires_both_scales():
     import pytest
     from nbdistributed_tpu.ops.decode import flash_decode_attention
     q = jnp.zeros((1, 4, 8))
-    kc = jnp.zeros((1, 16, 2, 8), jnp.int8)
+    kc = jnp.zeros((1, 2, 16, 8), jnp.int8)
     s = jnp.zeros((1, 2, 16, 1))
     with pytest.raises(ValueError, match="both k_s and v_s"):
         flash_decode_attention(q, kc, kc, jnp.zeros((1,), jnp.int32),
@@ -254,8 +254,8 @@ def test_decode_tuned_block_table_consulted():
     B, T, H, Hkv, D = 1, 64, 4, 2, 16
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, H, D))
-    kc = jax.random.normal(ks[1], (B, T, Hkv, D))
-    vc = jax.random.normal(ks[2], (B, T, Hkv, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, T, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, T, D))
     pos = jnp.full((B,), T - 1, jnp.int32)
     default = dec.flash_decode_attention(q, kc, vc, pos)
     key = (T, D, H // Hkv)

@@ -121,7 +121,7 @@ def test_sharded_cache_layout_is_applied(setup):
     mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2},
                               devices=jax.devices()[:4])
     cache = init_kv_cache(cfg, 2, 16, mesh=mesh)
-    assert cache["k"].sharding.spec == P(None, "dp", None, "tp", None)
+    assert cache["k"].sharding.spec == P(None, "dp", "tp", None, None)
     assert len(cache["k"].sharding.device_set) == 4
 
 

@@ -141,9 +141,9 @@ def main() -> int:
     for name, B, T, H, Hkv, D in DECODE_SHAPES:
         q = jax.random.normal(jax.random.PRNGKey(0), (B, H, D),
                               jnp.bfloat16)
-        kc = jax.random.normal(jax.random.PRNGKey(1), (B, T, Hkv, D),
+        kc = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, T, D),
                                jnp.bfloat16)
-        vc = jax.random.normal(jax.random.PRNGKey(2), (B, T, Hkv, D),
+        vc = jax.random.normal(jax.random.PRNGKey(2), (B, Hkv, T, D),
                                jnp.bfloat16)
         pos = jnp.full((B,), T - 1, jnp.int32)
         rows = []
