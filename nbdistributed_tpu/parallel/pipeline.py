@@ -201,6 +201,14 @@ def make_pipeline_1f1b_full(stage_fn, tail_fn, mesh, *,
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} "
                              f"microbatches")
+        if batch_axis is not None:
+            d = mesh.shape[batch_axis]
+            if (B // M) % d:
+                raise ValueError(
+                    f"per-microbatch rows {B // M} (batch {B} / "
+                    f"{M} microbatches) not divisible by "
+                    f"{batch_axis}={d} — shard_map would fail with an "
+                    f"opaque sharding error")
         xs = x.reshape(M, B // M, *x.shape[1:])
         bt = jax.tree_util.tree_map(
             lambda a: a.reshape(M, B // M, *a.shape[1:]), batch)
