@@ -103,7 +103,7 @@ float(loss_val)
 # expression is a json.dumps string so the coordinator can parse the
 # result out of the REPL echo.
 MFU_CELL = """
-import json as _json, time as _time
+import functools as _functools, json as _json, time as _time
 import jax as _jax, jax.numpy as _jnp, optax as _optax
 from nbdistributed_tpu.models import (forward as _fwd_fn,
                                       init_params as _init,
@@ -132,37 +132,78 @@ _per_layer = (2 * _d * _H * _Dh + 2 * _d * 2 * _Hkv * _Dh
 _attn = 2 * 2 * (_S / 2) * _H * _Dh
 _fwd_flops_tok = _L * (_per_layer + _attn) + 2 * _d * _V
 
-_f = _jax.jit(lambda p, t: _fwd_fn(p, t, _cfg))
-_t0 = _time.time(); _jax.block_until_ready(_f(_p, _tok))
+# The fwd loop donates the previous logits buffer: the timed loop
+# stays fully async (blocking each iteration would add a full tunnel
+# round-trip ~70 ms/step) yet only ONE B*S*V logits buffer ever
+# exists (~1 G at 1B scale — an undonated async loop queues _N of
+# them in flight and OOMs the 16 G chip).  keep_unused=True is
+# load-bearing: without it JAX prunes the unused arg and silently
+# drops the donation (no aliasing, no eager free).
+_f = _jax.jit(lambda p, t, prev: _fwd_fn(p, t, _cfg),
+              donate_argnums=(2,), keep_unused=True)
+_prev = _jnp.zeros((_B, _S, _cfg.vocab_size), _jnp.float32)
+_t0 = _time.time(); _o = _f(_p, _tok, _prev)
+_jax.block_until_ready(_o)
 _fwd_compile_s = _time.time() - _t0
 _t0 = _time.time()
 for _ in range(_N):
-    _o = _f(_p, _tok)
+    _o = _f(_p, _tok, _o)
 _jax.block_until_ready(_o)
 _fwd_s = (_time.time() - _t0) / _N
+_o = None   # 1 G of logits must not stay live across the train phase
 
 _opt = _optax.adamw(1e-4)
-_st = _opt.init(_p)
 
+# Donate params + opt state so XLA updates them in place: without
+# donation the step holds both generations of (params, mu, nu) —
+# 2x 6.6 G at 1B scale — which is exactly what OOMed the first
+# on-chip run of this cell.
 @_jax.jit
-def _train(p, s, t):
-    l, g = _jax.value_and_grad(lambda p: _loss(p, {{"tokens": t}},
-                                               _cfg_t))(p)
-    u, s = _opt.update(g, s, p)
-    return _optax.apply_updates(p, u), s, l
+def _mk_state(p):
+    return _opt.init(p)
 
-_t0 = _time.time()
-_p2, _st2, _l = _train(_p, _st, _tok); _jax.block_until_ready(_l)
-_train_compile_s = _time.time() - _t0
-_t0 = _time.time()
-for _ in range(_N):
-    _p2, _st2, _l = _train(_p2, _st2, _tok)
-_jax.block_until_ready(_l)
-_tr_s = (_time.time() - _t0) / _N
+def _mk_train():
+    @_functools.partial(_jax.jit, donate_argnums=(0, 1))
+    def _train(p, s, t):
+        l, g = _jax.value_and_grad(lambda p: _loss(p, {{"tokens": t}},
+                                                   _cfg_t))(p)
+        u, s = _opt.update(g, s, p)
+        return _optax.apply_updates(p, u), s, l
+    return _train
+
+# Train-phase batch ladder: start at the fwd batch, halve on
+# ResourceExhausted (the train step needs ~2.5x the fwd working set).
+_tr_s = _train_compile_s = None
+_train_B = _B
+while _train_B >= 1:
+    try:
+        _train = _mk_train()
+        _ttok = _tok[:_train_B]
+        _st = _mk_state(_p)
+        _t0 = _time.time()
+        _p2, _st2, _l = _train(_jax.tree_util.tree_map(
+            _jnp.copy, _p), _st, _ttok)
+        _jax.block_until_ready(_l)
+        _train_compile_s = _time.time() - _t0
+        _t0 = _time.time()
+        for _ in range(_N):
+            _p2, _st2, _l = _train(_p2, _st2, _ttok)
+        _jax.block_until_ready(_l)
+        _tr_s = (_time.time() - _t0) / _N
+        _p2 = _st2 = _st = None
+        break
+    except Exception as _e:
+        if "RESOURCE_EXHAUSTED" not in str(_e):
+            raise
+        _p2 = _st2 = _st = _train = None
+        import gc as _gc; _gc.collect()
+        _train_B //= 2
+if _tr_s is None:
+    raise RuntimeError("train step OOMed even at batch 1")
 
 _peak = {peak}
 _json.dumps({{
-    "batch": _B, "seq": _S,
+    "batch": _B, "seq": _S, "train_batch": _train_B,
     "n_params_m": round(sum(x.size for x in
                             _jax.tree_util.tree_leaves(_p)) / 1e6, 1),
     "fwd_ms": round(_fwd_s * 1e3, 2),
@@ -171,10 +212,11 @@ _json.dumps({{
                               2),
     "fwd_mfu": round(_B * _S / _fwd_s * _fwd_flops_tok / _peak, 4),
     "train_ms": round(_tr_s * 1e3, 2),
-    "train_tokens_per_s": round(_B * _S / _tr_s),
-    "train_tflops_per_s": round(_B * _S / _tr_s * 3 * _fwd_flops_tok
-                                / 1e12, 2),
-    "train_mfu": round(_B * _S / _tr_s * 3 * _fwd_flops_tok / _peak, 4),
+    "train_tokens_per_s": round(_train_B * _S / _tr_s),
+    "train_tflops_per_s": round(_train_B * _S / _tr_s
+                                * 3 * _fwd_flops_tok / 1e12, 2),
+    "train_mfu": round(_train_B * _S / _tr_s * 3 * _fwd_flops_tok
+                       / _peak, 4),
     "compile_s": [round(_fwd_compile_s, 1), round(_train_compile_s, 1)],
 }})
 """
@@ -315,8 +357,20 @@ from nbdistributed_tpu.models import (init_params as _init,
                                       make_generate_fn as _mkgen,
                                       quantize_params as _quant)
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
+# Host-side init via numpy, not jax.random: threefry for 6.7e9
+# elements on the CPU backend takes 20+ minutes; numpy's generator
+# fills the same tree in ~1 min.  Values only need realistic scale —
+# decode timing on TPU is value-independent.
+import numpy as _np
+_shapes = _jax.eval_shape(lambda k: _init(k, _cfg),
+                          _jax.random.PRNGKey(0))
+_rng = _np.random.default_rng(0)
 with _jax.default_device(_jax.devices("cpu")[0]):
-    _p_host = _init(_jax.random.PRNGKey(0), _cfg)
+    _p_host = _jax.tree_util.tree_map(
+        lambda s: _jnp.asarray(
+            (_rng.standard_normal(s.shape, _np.float32) * 0.02),
+            s.dtype),
+        _shapes)
     _qp_host = _quant(_p_host)
 del _p_host; _gc.collect()
 _dev = _jax.devices()[0]
@@ -348,16 +402,45 @@ _json.dumps({
 # Drop every underscore-named bench temporary from the worker
 # namespace between heavy cells — the 1B MFU leftovers (~9G with
 # optimizer state) and the 7B int8 tree (~6.7G) cannot coexist in 16G.
+# Escalation ladder, because a failed (OOMed) cell has been observed to
+# leave HBM full even after the pops: pops+gc -> jax.clear_caches()
+# (dead jitted executables can pin constants) -> delete every live
+# jax.Array outright.  The hammer is safe HERE because the bench
+# namespace holds no device values it still needs between heavy cells.
 CLEANUP_CELL = """
-import gc
 _doomed = [n for n in list(globals())
            if n.startswith('_') and not n.startswith('__')]
 for _x in list(_doomed):
     globals().pop(_x, None)
 globals().pop('_doomed', None)
 globals().pop('_x', None)
-gc.collect()
-'cleaned'
+# Imports come AFTER the sweep: they are underscore-named, so popping
+# first means this cell never deletes its own imports mid-flight.
+import gc as _gc, jax as _jx
+_gc.collect()
+
+
+def _in_use():
+    try:
+        return _jx.local_devices()[0].memory_stats()["bytes_in_use"]
+    except Exception:
+        return -1
+
+
+_b0 = _in_use()
+if _b0 > 1 << 30:
+    _jx.clear_caches()
+    _gc.collect()
+    _b1 = _in_use()
+    if _b1 > 1 << 30:
+        for _a in _jx.live_arrays():
+            try:
+                _a.delete()
+            except Exception:
+                pass
+        _jx.clear_caches()
+        _gc.collect()
+"cleaned bytes_in_use=%d->%d" % (_b0, _in_use())
 """
 
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
@@ -413,6 +496,17 @@ def parse_result_json(resp) -> dict | None:
 
 
 def main() -> int:
+    # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
+    # spawned workers: raising SystemExit lets run()'s finally-block
+    # ProcessManager.shutdown() execute.  An orphaned worker keeps its
+    # HBM allocations alive and poisons every later run on the shared
+    # chip with RESOURCE_EXHAUSTED (observed on-chip this round).
+    import signal
+
+    def _term(signum, frame):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _term)
     backend = topology.detect_backend()
     # World size: NBD_BENCH_WORLD env overrides; default is one worker
     # per TPU chip on this host (the bench host has 1), or 2 CPU/gloo
@@ -523,8 +617,10 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             run even when the preceding cell failed, or its multi-GB
             leftovers OOM every later measurement."""
             try:
-                comm.send_to_ranks([0], "execute", CLEANUP_CELL,
-                                   timeout=300)
+                resp = comm.send_to_ranks([0], "execute", CLEANUP_CELL,
+                                          timeout=300)
+                log(f"[bench] cleanup: "
+                    f"{resp[0].data.get('output', resp[0].data)}")
             except Exception as e:
                 log(f"[bench] cleanup failed (continuing): {e}")
 
@@ -560,6 +656,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             # orders slower by construction).
             try:
                 log("[bench] flash vs XLA reference attention")
+                cleanup_rank0()
                 resp = comm.send_to_ranks([0], "execute", FLASH_CELL,
                                           timeout=900)
                 m = resp[0]
@@ -576,6 +673,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
 
             try:
                 log("[bench] decode throughput bf16 vs int8 (smol-135M)")
+                cleanup_rank0()
                 resp = comm.send_to_ranks([0], "execute", DECODE_CELL,
                                           timeout=1200)
                 m = resp[0]
@@ -593,6 +691,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             try:
                 log("[bench] speculative decode (self-draft upper "
                     "bound, smol-135M)")
+                cleanup_rank0()
                 resp = comm.send_to_ranks([0], "execute", SPEC_CELL,
                                           timeout=1200)
                 m = resp[0]

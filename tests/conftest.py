@@ -6,19 +6,27 @@ platform selection — but backends initialize lazily, so a config update
 before the first device query still wins.  Subprocess workers spawned by
 integration tests get a scrubbed env via
 ``nbdistributed_tpu.manager.topology.cpu_worker_env`` instead.
+
+Set ``NBD_TEST_TPU=1`` to leave the platform alone and run the suite on
+the real chip (only meaningful for the single-device kernel/model tests;
+Mosaic enforces block-shape rules that CPU interpret mode does not, so
+an on-chip pass of ``tests/unit/test_attention.py`` etc. is stronger
+evidence than the CPU run).
 """
 
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if not os.environ.get("NBD_TEST_TPU"):
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
-import jax  # noqa: E402
+    import jax
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__ + "/.."))
 if REPO_ROOT not in sys.path:

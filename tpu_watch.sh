@@ -1,0 +1,34 @@
+#!/bin/bash
+# Axon-tunnel watcher: probe every ~10 min; on the first live probe,
+# immediately run the full on-chip bench and the flash/decode block
+# sweep, then keep watching (the tunnel flaps for hours at a time —
+# see BENCH_ATTEMPTS_r03.md).  Logs to $LOGDIR.
+#
+# Probe protocol: device discovery HANGS while the tunnel is down (it
+# does not error), so a 60 s timeout kill means "down".
+LOGDIR=${LOGDIR:-/tmp/tpu_watch}
+mkdir -p "$LOGDIR"
+cd "$(dirname "$0")"
+while true; do
+    ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "$ts LIVE — running bench.py + tune_flash.py" >> "$LOGDIR/probes.log"
+        timeout 4500 python -u bench.py \
+            > "$LOGDIR/bench_$ts.out" 2> "$LOGDIR/bench_$ts.log"
+        pkill -9 -f "nbdistributed_tpu.runtime.worker" 2>/dev/null
+        timeout 3600 python -u tune_flash.py \
+            > "$LOGDIR/tune_$ts.out" 2> "$LOGDIR/tune_$ts.log"
+        # Kernel tests on the real chip: Mosaic enforces block-shape
+        # rules the CPU interpreter does not (two real bugs found that
+        # way this round).  Single-device selection only.
+        NBD_TEST_TPU=1 timeout 2400 python -m pytest \
+            tests/unit/test_decode.py tests/unit/test_attention.py \
+            -q -k "not mesh and not tp_mesh" \
+            > "$LOGDIR/tputests_$ts.out" 2>&1
+        echo "$ts done (bench+tune+tests complete; re-arming)" >> "$LOGDIR/probes.log"
+        sleep 3600   # one capture per window is enough; re-arm hourly
+    else
+        echo "$ts DOWN" >> "$LOGDIR/probes.log"
+        sleep 540
+    fi
+done
