@@ -14,6 +14,7 @@ from .lora import (ALL_TARGETS, ATTN_TARGETS, lora_init, lora_merge,
 from .pp import (make_pp_1f1b_train_step, make_pp_train_step,
                  pp_apply_shardings, pp_loss_fn,
                  pp_stage_params, pp_unstage_params)
+from .serving import DecodeServer
 from .speculative import speculative_generate
 from .quant import (dequantize_weight, is_quantized, quantization_error,
                     quantize_moe_params, quantize_params,
@@ -48,7 +49,7 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "dequantize_weight", "is_quantized", "quantization_error",
            "quantize_moe_params", "quantize_params", "quantize_weight",
            "quantized_moe_shardings", "quantized_shardings",
-           "speculative_generate",
+           "speculative_generate", "DecodeServer",
            "make_pp_1f1b_train_step", "make_pp_train_step",
            "pp_apply_shardings", "pp_loss_fn",
            "pp_stage_params", "pp_unstage_params"]

@@ -211,8 +211,9 @@ def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str,
 
 def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                        cfg: TransformerConfig, *,
-                       last_only: bool = False, mesh=None,
-                       ep_axis: str = "ep", row_mask=None):
+                       last_only: bool = False, last_index=None,
+                       mesh=None, ep_axis: str = "ep", row_mask=None,
+                       token_mask=None):
     """Run ``tokens`` (B, S) through the model, reading/writing the KV
     cache at offset ``cache_len`` (traced scalar ok, or a per-row
     ``(B,)`` vector when the streams in the batch sit at different
@@ -226,8 +227,19 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     Returns (logits fp32, updated cache): (B, S, vocab), or (B, 1,
     vocab) with ``last_only`` — prefill for generation needs only the
     final position, which skips S-1 of the (d_model × vocab) lm_head
-    matmul.  Covers both prefill (S = prompt length, cache_len = 0)
-    and decode (S = 1).
+    matmul.  ``last_index`` (B,) generalizes that to a per-row
+    position (right-padded prompts whose last real token is not at
+    S-1: the serving admission path), gathering the hidden state
+    before final-norm/lm_head so the padded positions never touch
+    the (d_model × vocab) matmul.  Covers both prefill (S = prompt
+    length, cache_len = 0) and decode (S = 1).
+
+    ``token_mask`` (B, S) bool marks which positions are *real*: pad
+    positions must not enter MoE expert dispatch, where they would
+    consume capacity slots and could evict real tokens (dense SwiGLU
+    is per-token, so the mask only reaches the expert router).
+    ``row_mask`` (B,) is the whole-row shorthand the decode step uses
+    for inactive streams; passing both ANDs them.
     """
     B, S = tokens.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -240,8 +252,9 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
     # row_mask (B,) bool: inactive batch rows (finished speculative
     # streams) must not couple to live rows — only MoE capacity
     # dispatch can couple rows, so the mask feeds the expert router.
-    token_mask = (None if row_mask is None else
-                  jnp.broadcast_to(row_mask[:, None], (B, S)))
+    if row_mask is not None:
+        rows = jnp.broadcast_to(row_mask[:, None], (B, S))
+        token_mask = rows if token_mask is None else token_mask & rows
     mlp = _make_mlp_fn(cfg, mesh, ep_axis, token_mask=token_mask)
     kv_quantized = "k_s" in cache
 
@@ -324,7 +337,11 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
         x, (k_new, v_new) = jax.lax.scan(
             layer_step, x, (params["layers"], cache["k"], cache["v"]))
         new = {"k": k_new, "v": v_new}
-    if last_only:
+    if last_index is not None:
+        idx = jnp.asarray(last_index, jnp.int32).reshape(B, 1, 1)
+        x = jnp.take_along_axis(x, jnp.broadcast_to(
+            idx, (B, 1, x.shape[-1])), axis=1)         # (B, 1, D)
+    elif last_only:
         x = x[:, -1:]
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = qlinear(x, params["lm_head"]).astype(jnp.float32)

@@ -1,0 +1,274 @@
+"""Continuous-batching decode server: staggered admission over a fixed
+slot pool, one shared forward per step.
+
+The reference framework has no serving path at all (its users call HF
+``generate`` per prompt in cells); this is the TPU-native serving loop
+the KV-cache machinery was built to support.  Design:
+
+* **Static shapes, dynamic occupancy.**  The cache is one
+  ``(L, max_batch, Hkv, max_len, D)`` pool; a request occupies a batch
+  *slot* for its lifetime.  Admission, completion, and re-use never
+  change any array shape — XLA compiles exactly two programs (prefill
+  per prompt bucket, one decode step) no matter how requests arrive.
+* **Per-slot cache pointers.**  The decode step runs ALL slots in one
+  ``forward_with_cache`` call with a per-row ``(B,)`` ``cache_len`` —
+  the same machinery batched speculative decoding uses
+  (speculative.py) — so requests at different depths share every
+  matmul.  Decode-step cost is one B-row forward regardless of how
+  staggered the batch is: that sharing is the whole point of
+  continuous batching.
+* **Inactive slots freeze exactly like finished speculative streams:**
+  their advance is masked to zero, their (idempotent) cache writes
+  land at a frozen position, and for MoE configs ``row_mask`` keeps
+  them out of expert capacity dispatch, so an empty or finished slot
+  never perturbs a live one.
+* **Prefill-on-admit** runs the prompt as a single-row forward into
+  the slot's cache rows, right-padded to a length *bucket* (one
+  compile per bucket, ``pad_to`` granularity).  Pad positions write
+  garbage cache slots beyond the prompt — harmless by the write-then-
+  attend order: a decode step at position ``p`` overwrites slot ``p``
+  before any query attends it, and attention masks ``t <= p``.  Pads
+  are masked out of MoE expert dispatch (``token_mask``) so they can
+  never consume capacity slots and evict real prompt tokens, and the
+  lm_head runs only at the last real position (``last_index``).
+
+Greedy serving is bit-identical per request to a standalone
+:func:`~.generate.generate` call (asserted in the tests): admission
+order, batch occupancy, and other requests' traffic cannot change any
+request's tokens for the dense family.  For MoE, a request served
+*alone* matches generate exactly (pad masking above); multiple live
+MoE requests pool expert capacity across rows — batched-decode
+semantics, the same caveat as batched speculative decoding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .generate import _sample, forward_with_cache, init_kv_cache
+from .transformer import TransformerConfig
+
+
+class DecodeServer:
+    """Slot-pool continuous-batching server around one model.
+
+    Host-side orchestration (admission queue, completion, output
+    collection) wraps two jitted device programs: a per-bucket prefill
+    and the shared decode step.  Use::
+
+        srv = DecodeServer(params, cfg, max_batch=8, max_len=512)
+        rid = srv.submit([1, 2, 3], max_new_tokens=16)
+        while not srv.done():
+            srv.step()          # emits one token per active request
+        tokens = srv.outputs[rid]
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, *,
+                 max_batch: int, max_len: int,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None, eos_id: int | None = None,
+                 kv_quantized: bool = False, mesh=None,
+                 ep_axis: str = "ep", pad_to: int = 64, key=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if pad_to < 1:
+            raise ValueError(f"pad_to must be >= 1, got {pad_to}")
+        if temperature != 0.0 and key is None:
+            key = jax.random.PRNGKey(0)
+        self._params = params
+        self._cfg = cfg
+        self._mesh = mesh
+        self._ep_axis = ep_axis
+        self._B = max_batch
+        self._T = max_len
+        self._pad_to = pad_to
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        self._eos = eos_id
+        self._key = key if key is not None else jax.random.PRNGKey(0)
+
+        self._cache = init_kv_cache(cfg, max_batch, max_len, mesh=mesh,
+                                    quantized=kv_quantized)
+        self._lens = jnp.zeros((max_batch,), jnp.int32)
+        self._last = jnp.zeros((max_batch,), jnp.int32)
+        self._active = jnp.zeros((max_batch,), bool)
+
+        # Host-side bookkeeping.
+        self._free = list(range(max_batch))
+        self._slot_req: dict[int, int] = {}      # slot -> request id
+        self._budget: dict[int, int] = {}        # request id -> remaining
+        self._pending: list[tuple[int, list[int], int]] = []
+        self._next_id = 0
+        self.outputs: dict[int, list[int]] = {}
+        self.prompts: dict[int, list[int]] = {}
+        self._finished: set[int] = set()
+
+        self._prefill_fn = self._make_prefill()
+        self._step_fn = self._jit_step()
+
+    # ---- jitted programs -------------------------------------------------
+
+    def _make_prefill(self):
+        cfg, mesh, ep_axis = self._cfg, self._mesh, self._ep_axis
+
+        def fn(params, cache, prompt, slot, length):
+            """prompt (1, s_pad) right-padded; writes the slot's cache
+            rows and returns (updated cache, last-real-token logits).
+            token_mask keeps the pad positions out of MoE expert
+            dispatch (they would consume capacity slots and could
+            evict real prompt tokens); last_index gathers the hidden
+            state at the last REAL token before the lm_head, so pads
+            never touch the (d_model x vocab) matmul either."""
+            row = jax.tree_util.tree_map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, 1),
+                cache)
+            s_pad = prompt.shape[1]
+            mask = (jnp.arange(s_pad)[None, :] < length)
+            logits, row = forward_with_cache(
+                params, prompt, row, 0, cfg, mesh=mesh,
+                ep_axis=ep_axis, token_mask=mask,
+                last_index=(length - 1)[None])
+            cache = jax.tree_util.tree_map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                    c, r, slot, 1), cache, row)
+            return cache, logits[0, 0]                 # (V,)
+
+        # The cache pool is donated: admission updates it in place
+        # instead of copying (L, B, Hkv, max_len, D) per request.
+        # One jit serves every prompt bucket — jax.jit retraces (and
+        # caches) per input shape, so padding to pad_to multiples
+        # bounds the compile count.
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _make_step(self):
+        cfg, mesh, ep_axis = self._cfg, self._mesh, self._ep_axis
+        temperature, top_k, top_p = (self._temperature, self._top_k,
+                                     self._top_p)
+
+        def fn(params, cache, lens, last, active, key):
+            logits, cache = forward_with_cache(
+                params, last[:, None], cache, lens, cfg, mesh=mesh,
+                ep_axis=ep_axis, row_mask=active)
+            nxt = _sample(logits[:, -1], temperature, key, top_k, top_p)
+            nxt = jnp.where(active, nxt, last)
+            lens = lens + active.astype(lens.dtype)
+            return cache, lens, nxt
+
+        return fn
+
+    def _jit_step(self):
+        # Donated cache: the decode step rewrites the pool in place.
+        return jax.jit(self._make_step(), donate_argnums=(1,))
+
+    # ---- host-side API ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue a request; returns its id.  Admitted to a slot on this
+        call if one is free, else at the next :meth:`step`."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if len(prompt) + max_new_tokens > self._T:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self._T}")
+        rid = self._next_id
+        self._next_id += 1
+        self.prompts[rid] = prompt
+        self.outputs[rid] = []
+        self._pending.append((rid, prompt, max_new_tokens))
+        self._admit_pending()
+        return rid
+
+    def _bucket(self, n: int) -> int:
+        return -(-n // self._pad_to) * self._pad_to
+
+    def _sample_key(self):
+        if self._temperature == 0.0:
+            return self._key
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def _admit_pending(self) -> None:
+        while self._pending and self._free:
+            rid, prompt, budget = self._pending.pop(0)
+            slot = self._free.pop(0)
+            s_pad = min(self._bucket(len(prompt)), self._T)
+            padded = jnp.asarray(
+                prompt + [0] * (s_pad - len(prompt)),
+                jnp.int32)[None, :]
+            self._cache, last_logits = self._prefill_fn(
+                self._params, self._cache, padded,
+                jnp.int32(slot), jnp.int32(len(prompt)))
+            tok = int(_sample(last_logits[None], self._temperature,
+                              self._sample_key(), self._top_k,
+                              self._top_p)[0])
+            self.outputs[rid].append(tok)
+            self._lens = self._lens.at[slot].set(len(prompt))
+            self._last = self._last.at[slot].set(tok)
+            done = (budget == 1
+                    or (self._eos is not None and tok == self._eos))
+            if done:
+                self._finish(slot, rid)
+            else:
+                self._slot_req[slot] = rid
+                self._budget[rid] = budget - 1
+                self._active = self._active.at[slot].set(True)
+
+    def _finish(self, slot: int, rid: int) -> None:
+        self._finished.add(rid)
+        self._slot_req.pop(slot, None)
+        self._budget.pop(rid, None)
+        self._active = self._active.at[slot].set(False)
+        self._free.append(slot)
+
+    def step(self) -> dict[int, int]:
+        """One decode step for every active slot; returns
+        {request_id: emitted token}.  Admits pending requests first."""
+        self._admit_pending()
+        if not self._slot_req:
+            return {}
+        self._cache, self._lens, nxt = self._step_fn(
+            self._params, self._cache, self._lens, self._last,
+            self._active, self._sample_key())
+        self._last = nxt
+        toks = jax.device_get(nxt)
+        emitted: dict[int, int] = {}
+        for slot, rid in list(self._slot_req.items()):
+            tok = int(toks[slot])
+            self.outputs[rid].append(tok)
+            emitted[rid] = tok
+            self._budget[rid] -= 1
+            if (self._budget[rid] == 0
+                    or (self._eos is not None and tok == self._eos)):
+                self._finish(slot, rid)
+        self._admit_pending()
+        return emitted
+
+    def done(self) -> bool:
+        return not self._slot_req and not self._pending
+
+    def run_until_done(self, max_steps: int | None = None):
+        """Drive :meth:`step` until every request finishes; returns
+        ``self.outputs``."""
+        steps = 0
+        while not self.done():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"server not drained after {max_steps} steps")
+        return self.outputs
+
+    @property
+    def finished(self):
+        return set(self._finished)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._slot_req)

@@ -146,6 +146,31 @@ _ok_plan = hop_plan(8, 16, 16) == (0, 1)
         check("batched speculative + sparse MoE + SWA hop plan",
               r0.data.get("output") == "(True, True, True)",
               repr(r0.data.get("error") or r0.data.get("output")))
+
+        # Continuous-batching server: staggered admission into a
+        # 2-slot pool must reproduce standalone generate per request.
+        serve_cell = """
+import jax as _j, jax.numpy as _jn, numpy as _np
+from nbdistributed_tpu.models import (DecodeServer, tiny_config,
+                                      init_params, generate)
+_cfg = tiny_config(dtype=_jn.float32, use_flash=False)
+_p = init_params(_j.random.PRNGKey(0), _cfg)
+_srv = DecodeServer(_p, _cfg, max_batch=2, max_len=32, pad_to=4)
+_r0 = _srv.submit([5, 9, 2], 4)
+_srv.step()
+_r1 = _srv.submit([7, 1], 3)
+_srv.run_until_done(max_steps=50)
+def _solo(pr, n):
+    o = generate(_p, _jn.asarray(pr, _jn.int32)[None], _cfg, n)
+    return [int(t) for t in _np.asarray(o)[0][len(pr):]]
+(_srv.outputs[_r0] == _solo([5, 9, 2], 4),
+ _srv.outputs[_r1] == _solo([7, 1], 3))
+"""
+        r0 = comm.send_to_ranks([0], "execute", serve_cell,
+                                timeout=120)[0]
+        check("continuous-batching server (staggered == solo)",
+              r0.data.get("output") == "(True, True)",
+              repr(r0.data.get("error") or r0.data.get("output")))
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

@@ -2,6 +2,7 @@
 integration artifact: SURVEY §4 notes the reference declared one but
 never shipped it)."""
 
+import re
 import subprocess
 import sys
 
@@ -15,4 +16,8 @@ def test_selftest_passes():
         [sys.executable, "-m", "nbdistributed_tpu.selftest"],
         capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "10/10 checks passed" in proc.stdout
+    # All checks must pass, however many the selftest carries today.
+    m = re.search(r"(\d+)/(\d+) checks passed", proc.stdout)
+    assert m, proc.stdout
+    assert m.group(1) == m.group(2), proc.stdout
+    assert int(m.group(2)) >= 10, proc.stdout

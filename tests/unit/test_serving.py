@@ -1,0 +1,164 @@
+"""Continuous-batching decode server: staggered admission must be
+bit-identical per request to standalone generate(), slots must recycle,
+EOS must cut streams, and MoE configs must serve through row_mask."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.models import generate, init_params, tiny_config
+from nbdistributed_tpu.models.serving import DecodeServer
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def solo(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None], cfg, n)
+    return [int(t) for t in np.asarray(out)[0][len(prompt):]]
+
+
+def test_staggered_admission_matches_solo_generate(setup):
+    """Three requests of different lengths admitted at different times
+    into a 2-slot pool: every request's greedy tokens must equal its
+    standalone generate() run — occupancy and admission order must be
+    invisible to the numerics."""
+    cfg, params = setup
+    reqs = [([5, 9, 2], 7), ([7, 1, 3, 11, 4], 5), ([2, 2], 6)]
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+
+    r0 = srv.submit(*reqs[0])
+    srv.step()
+    r1 = srv.submit(*reqs[1])          # fills the second slot
+    srv.step()
+    r2 = srv.submit(*reqs[2])          # queues until a slot frees
+    srv.run_until_done(max_steps=100)
+
+    for rid, (prompt, n) in zip((r0, r1, r2), reqs):
+        assert srv.outputs[rid] == solo(params, cfg, prompt, n), rid
+
+
+def test_slots_recycle_and_outputs_complete(setup):
+    """More requests than slots: all finish, each with exactly its
+    token budget (no EOS in play for random-init logits over a tiny
+    vocab is not guaranteed — so disable EOS)."""
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=32, pad_to=4)
+    rids = [srv.submit([i + 1, i + 2], 4) for i in range(5)]
+    srv.run_until_done(max_steps=200)
+    assert srv.done() and srv.n_active == 0
+    for rid in rids:
+        assert len(srv.outputs[rid]) == 4
+    assert srv.finished == set(rids)
+
+
+def test_eos_frees_slot_early(setup):
+    """A request whose next greedy token IS the eos id must finish on
+    that step with the eos included, freeing the slot."""
+    cfg, params = setup
+    prompt, n = [5, 9, 2], 8
+    toks = solo(params, cfg, prompt, n)
+    eos = toks[2]                       # force an early cut at step 3
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=64,
+                       pad_to=4, eos_id=eos)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    got = srv.outputs[rid]
+    assert got == toks[:got.index(eos) + 1]
+    assert got[-1] == eos and len(got) <= n
+
+
+def test_single_token_budget_finishes_at_admission(setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4)
+    rid = srv.submit([3, 1, 4], 1)
+    assert srv.done()
+    assert srv.outputs[rid] == solo(params, cfg, [3, 1, 4], 1)
+
+
+def test_validation_errors(setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=16, pad_to=4)
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit([], 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        srv.submit([1], 0)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        srv.submit([1] * 10, 10)
+
+
+def test_sampled_mode_runs_and_respects_budget(setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=32, pad_to=4,
+                       temperature=1.0, top_k=8,
+                       key=jax.random.PRNGKey(7))
+    rids = [srv.submit([4, 2], 5), srv.submit([9], 3)]
+    srv.run_until_done(max_steps=50)
+    assert [len(srv.outputs[r]) for r in rids] == [5, 3]
+    for r in rids:
+        assert all(0 <= t < cfg.vocab_size for t in srv.outputs[r])
+
+
+def test_int8_cache_serving_matches_int8_generate(setup):
+    """kv_quantized serving must equal kv_quantized generate per
+    request (same quantized-cache numerics path)."""
+    cfg, params = setup
+    prompt, n = [5, 9, 2, 7], 6
+    ref = generate(params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+                   n, kv_quantized=True)
+    ref = [int(t) for t in np.asarray(ref)[0][len(prompt):]]
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=32, pad_to=4,
+                       kv_quantized=True)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    assert srv.outputs[rid] == ref
+
+
+def test_moe_pad_tokens_take_no_expert_capacity():
+    """Tight-capacity MoE where the prompt pads (59 of 64 bucket
+    positions) would flood expert capacity and evict real tokens'
+    expert assignments if they were dispatched: serving must still
+    match solo generate exactly, proving pads are masked out of the
+    router.  capacity here is ceil(cf*k*64/E) = 32 > the C=8 floor,
+    so the mask (not the floor) is what protects the real tokens."""
+    from nbdistributed_tpu.models import init_moe_model, tiny_moe_config
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          capacity_factor=1.0)
+    # Seed pair pinned by a scan: with THIS model and prompt, running
+    # the pads through the router flips the first greedy token (the
+    # pads' identical embeddings flood one expert's capacity segment
+    # ahead of a real token's second-choice slot), so this test fails
+    # on the unmasked path — it discriminates, not just passes.
+    params = init_moe_model(jax.random.PRNGKey(4), cfg)
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(100), (5,), 1, cfg.vocab_size)]
+    n = 5
+    ref = generate(params, jnp.asarray(prompt, jnp.int32)[None], cfg, n)
+    ref = [int(t) for t in np.asarray(ref)[0][len(prompt):]]
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=80, pad_to=64)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    assert srv.outputs[rid] == ref
+
+
+def test_moe_family_serves():
+    """The MoE family drives the same server (row_mask keeps empty
+    slots out of expert capacity); tokens match MoE generate when the
+    pool runs a single request (capacity pooling across live rows is
+    batched-decode semantics, so only the solo case is exact)."""
+    from nbdistributed_tpu.models import init_moe_model, tiny_moe_config
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          capacity_factor=2.0)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    prompt, n = [5, 1, 3], 5
+    ref = generate(params, jnp.asarray(prompt, jnp.int32)[None], cfg, n)
+    ref = [int(t) for t in np.asarray(ref)[0][len(prompt):]]
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=32, pad_to=4)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    assert srv.outputs[rid] == ref
