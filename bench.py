@@ -162,44 +162,53 @@ _opt = _optax.adamw(1e-4)
 def _mk_state(p):
     return _opt.init(p)
 
-def _mk_train():
-    @_functools.partial(_jax.jit, donate_argnums=(0, 1))
-    def _train(p, s, t):
-        l, g = _jax.value_and_grad(lambda p: _loss(p, {{"tokens": t}},
-                                                   _cfg_t))(p)
-        u, s = _opt.update(g, s, p)
-        return _optax.apply_updates(p, u), s, l
-    return _train
-
 # Train-phase batch ladder: start at the fwd batch, halve on
 # ResourceExhausted (the train step needs ~2.5x the fwd working set).
-_tr_s = _train_compile_s = None
-_train_B = _B
-while _train_B >= 1:
-    try:
-        _train = _mk_train()
-        _ttok = _tok[:_train_B]
-        _st = _mk_state(_p)
-        _t0 = _time.time()
-        _p2, _st2, _l = _train(_jax.tree_util.tree_map(
-            _jnp.copy, _p), _st, _ttok)
-        _jax.block_until_ready(_l)
-        _train_compile_s = _time.time() - _t0
-        _t0 = _time.time()
-        for _ in range(_N):
-            _p2, _st2, _l = _train(_p2, _st2, _ttok)
-        _jax.block_until_ready(_l)
-        _tr_s = (_time.time() - _t0) / _N
-        _p2 = _st2 = _st = None
-        break
-    except Exception as _e:
-        if "RESOURCE_EXHAUSTED" not in str(_e):
-            raise
-        _p2 = _st2 = _st = _train = None
-        import gc as _gc; _gc.collect()
-        _train_B //= 2
+def _time_train(_cfg_variant, _start_B):
+    _tr = _comp = None
+    _vB = _start_B
+    _loss2 = lambda p, t: _loss(p, {{"tokens": t}}, _cfg_variant)
+    while _vB >= 1:
+        try:
+            @_functools.partial(_jax.jit, donate_argnums=(0, 1))
+            def _train(p, s, t):
+                l, g = _jax.value_and_grad(_loss2)(p, t)
+                u, s = _opt.update(g, s, p)
+                return _optax.apply_updates(p, u), s, l
+
+            _ttok = _tok[:_vB]
+            _st = _mk_state(_p)
+            _t0 = _time.time()
+            _p2, _st2, _l = _train(_jax.tree_util.tree_map(
+                _jnp.copy, _p), _st, _ttok)
+            _jax.block_until_ready(_l)
+            _comp = _time.time() - _t0
+            _t0 = _time.time()
+            for _ in range(_N):
+                _p2, _st2, _l = _train(_p2, _st2, _ttok)
+            _jax.block_until_ready(_l)
+            _tr = (_time.time() - _t0) / _N
+            _p2 = _st2 = _st = None
+            return _tr, _comp, _vB
+        except Exception as _e:
+            if "RESOURCE_EXHAUSTED" not in str(_e):
+                raise
+            _p2 = _st2 = _st = _train = None
+            import gc as _gc; _gc.collect()
+            _vB //= 2
+    return None, None, 0
+
+
+_tr_s, _train_compile_s, _train_B = _time_train(_cfg_t, _B)
 if _tr_s is None:
     raise RuntimeError("train step OOMed even at batch 1")
+# Same step under the "dots" remat policy (matmul outputs saved,
+# only cheap ops recompute): trades saved-dot bytes for most of the
+# remat recompute — report it alongside so a live window captures
+# which policy wins at this scale/HBM.
+import dataclasses as _dc
+_tr_d, _, _train_B_d = _time_train(
+    _dc.replace(_cfg_t, remat_policy="dots"), _train_B)
 
 _peak = {peak}
 _json.dumps({{
@@ -217,6 +226,11 @@ _json.dumps({{
                                 * 3 * _fwd_flops_tok / 1e12, 2),
     "train_mfu": round(_train_B * _S / _tr_s * 3 * _fwd_flops_tok
                        / _peak, 4),
+    "train_dots_ms": (None if _tr_d is None else round(_tr_d * 1e3, 2)),
+    "train_dots_mfu": (None if _tr_d is None else
+                       round(_train_B_d * _S / _tr_d
+                             * 3 * _fwd_flops_tok / _peak, 4)),
+    "train_dots_batch": _train_B_d,
     "compile_s": [round(_fwd_compile_s, 1), round(_train_compile_s, 1)],
 }})
 """

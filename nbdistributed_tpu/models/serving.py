@@ -36,9 +36,13 @@ Greedy serving is bit-identical per request to a standalone
 :func:`~.generate.generate` call (asserted in the tests): admission
 order, batch occupancy, and other requests' traffic cannot change any
 request's tokens for the dense family.  For MoE, a request served
-*alone* matches generate exactly (pad masking above); multiple live
-MoE requests pool expert capacity across rows — batched-decode
-semantics, the same caveat as batched speculative decoding.
+*alone* matches generate exactly — pads are masked out of expert
+dispatch AND admission runs at the exact prompt length (expert
+capacity is shape-derived, so a padded bucket would inflate it past
+the solo run's; the cost is one admission compile per distinct
+prompt length for MoE configs).  Multiple live MoE requests pool
+expert capacity across rows — batched-decode semantics, the same
+caveat as batched speculative decoding.
 """
 
 from __future__ import annotations
@@ -74,8 +78,16 @@ class DecodeServer:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_to < 1:
             raise ValueError(f"pad_to must be >= 1, got {pad_to}")
-        if temperature != 0.0 and key is None:
-            key = jax.random.PRNGKey(0)
+        from .moe import MoEConfig
+        if isinstance(cfg, MoEConfig):
+            # Expert capacity is computed from the *static* token count
+            # of the prefill shape: a padded bucket would inflate it
+            # past what a solo generate() run of the same prompt gets,
+            # and capacity changes which tokens drop — silently
+            # breaking the solo-request exactness guarantee.  MoE
+            # admission therefore compiles per distinct prompt length
+            # (pad_to=1); dense configs keep the bucket economy.
+            pad_to = 1
         self._params = params
         self._cfg = cfg
         self._mesh = mesh
@@ -249,6 +261,18 @@ class DecodeServer:
                 self._finish(slot, rid)
         self._admit_pending()
         return emitted
+
+    def release(self, rid: int) -> list[int]:
+        """Drop a finished request's host-side record (prompt, output,
+        finished flag) and return its tokens — the eviction API that
+        keeps a long-running server's host memory bounded."""
+        if rid in self._budget or any(r == rid for r, _, _ in
+                                      self._pending):
+            raise ValueError(f"request {rid} is still in flight")
+        toks = self.outputs.pop(rid, [])
+        self.prompts.pop(rid, None)
+        self._finished.discard(rid)
+        return toks
 
     def done(self) -> bool:
         return not self._slot_req and not self._pending

@@ -52,6 +52,15 @@ class TransformerConfig:
     # HBM-bound TPUs.  Composes with sequence parallelism (ring/Ulysses
     # shard S; remat shrinks the per-layer residual footprint).
     remat: bool = False
+    # Remat *policy*: what the checkpointed layer may keep.
+    #   None      — save nothing (full recompute, minimum memory);
+    #   "dots"    — jax.checkpoint_policies.checkpoint_dots: matmul
+    #               outputs are saved, only cheap elementwise/norm ops
+    #               recompute.  The backward skips re-running the MXU
+    #               work, trading ~L·S·(3·d_ff + H·Dh + 2·Hkv·Dh + D)
+    #               bytes of saved dots for most of remat's recompute
+    #               FLOPs — the right default when the model fits.
+    remat_policy: str | None = None
 
     @property
     def head_dim(self) -> int:
@@ -348,7 +357,17 @@ def make_layer_fn(cfg: TransformerConfig, positions,
         x = _attention_block(x, layer, cfg, positions, sp)
         return _mlp_block(x, layer, cfg)
 
-    return jax.checkpoint(one_layer) if cfg.remat else one_layer
+    if not cfg.remat:
+        return one_layer
+    policy = getattr(cfg, "remat_policy", None)
+    if policy is None:
+        return jax.checkpoint(one_layer)
+    if policy == "dots":
+        return jax.checkpoint(
+            one_layer,
+            policy=jax.checkpoint_policies.checkpoint_dots)
+    raise ValueError(f"unknown remat_policy {policy!r} "
+                     f"(None or 'dots')")
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,

@@ -221,6 +221,23 @@ def test_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
 
+    # The "dots" policy (save matmul outputs, recompute only cheap
+    # ops) is also math-neutral; an unknown policy must fail loudly.
+    import pytest
+
+    cfg_d = type(cfg)(**{**cfg.__dict__, "remat": True,
+                         "remat_policy": "dots"})
+    l2, g2 = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg_d))(params)
+    np.testing.assert_allclose(float(l0), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+    cfg_bad = type(cfg)(**{**cfg.__dict__, "remat": True,
+                           "remat_policy": "everything"})
+    with pytest.raises(ValueError, match="remat_policy"):
+        loss_fn(params, batch, cfg_bad)
+
 
 def test_sliding_window_model_paths_agree():
     """sliding_window through the full model: the flash and reference

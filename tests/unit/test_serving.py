@@ -119,31 +119,75 @@ def test_int8_cache_serving_matches_int8_generate(setup):
     assert srv.outputs[rid] == ref
 
 
-def test_moe_pad_tokens_take_no_expert_capacity():
-    """Tight-capacity MoE where the prompt pads (59 of 64 bucket
-    positions) would flood expert capacity and evict real tokens'
-    expert assignments if they were dispatched: serving must still
-    match solo generate exactly, proving pads are masked out of the
-    router.  capacity here is ceil(cf*k*64/E) = 32 > the C=8 floor,
-    so the mask (not the floor) is what protects the real tokens."""
+def test_token_mask_keeps_pads_out_of_expert_capacity():
+    """forward_with_cache's token_mask: right-pad tokens routed
+    through a tight-capacity MoE flood an expert's segment and evict
+    real tokens' second-choice slots — with the mask, the padded
+    prefill's last-real-token logits equal the unpadded run's; without
+    it (seed pair pinned by a scan) they provably differ."""
+    from nbdistributed_tpu.models import init_moe_model, tiny_moe_config
+    from nbdistributed_tpu.models.generate import (forward_with_cache,
+                                                   init_kv_cache)
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          capacity_factor=1.0)
+    params = init_moe_model(jax.random.PRNGKey(4), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(100), (5,), 1,
+                                cfg.vocab_size)
+    L, s_pad = 5, 64
+    padded = jnp.concatenate(
+        [prompt, jnp.zeros((s_pad - L,), jnp.int32)])[None]
+    mask = (jnp.arange(s_pad)[None] < L)
+    idx = jnp.asarray([L - 1])
+
+    ref, _ = forward_with_cache(params, prompt[None],
+                                init_kv_cache(cfg, 1, 80), 0, cfg,
+                                last_index=idx)
+    masked, _ = forward_with_cache(params, padded,
+                                   init_kv_cache(cfg, 1, 80), 0, cfg,
+                                   token_mask=mask, last_index=idx)
+    unmasked, _ = forward_with_cache(params, padded,
+                                     init_kv_cache(cfg, 1, 80), 0, cfg,
+                                     last_index=idx)
+    # Masked pads change nothing vs the unpadded run (no real-token
+    # drops at this size on either side)...
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # ...while unmasked pads provably perturb the real tokens.
+    assert float(jnp.max(jnp.abs(unmasked - ref))) > 0.1
+
+
+def test_moe_long_prompt_exact_length_admission():
+    """MoE expert capacity is shape-derived, so bucket padding would
+    inflate it past a solo generate() run's (20 real tokens: solo
+    capacity 16 vs a 64-bucket's 32) and change which tokens drop.
+    The server admits MoE prompts at exact length — a 20-token prompt
+    must match solo generate even with pad_to=64 requested."""
     from nbdistributed_tpu.models import init_moe_model, tiny_moe_config
     cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
                           capacity_factor=1.0)
-    # Seed pair pinned by a scan: with THIS model and prompt, running
-    # the pads through the router flips the first greedy token (the
-    # pads' identical embeddings flood one expert's capacity segment
-    # ahead of a real token's second-choice slot), so this test fails
-    # on the unmasked path — it discriminates, not just passes.
     params = init_moe_model(jax.random.PRNGKey(4), cfg)
     prompt = [int(t) for t in jax.random.randint(
-        jax.random.PRNGKey(100), (5,), 1, cfg.vocab_size)]
-    n = 5
+        jax.random.PRNGKey(101), (20,), 1, cfg.vocab_size)]
+    n = 4
     ref = generate(params, jnp.asarray(prompt, jnp.int32)[None], cfg, n)
     ref = [int(t) for t in np.asarray(ref)[0][len(prompt):]]
     srv = DecodeServer(params, cfg, max_batch=1, max_len=80, pad_to=64)
     rid = srv.submit(prompt, n)
     srv.run_until_done(max_steps=50)
     assert srv.outputs[rid] == ref
+
+
+def test_release_evicts_and_guards_in_flight(setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4)
+    rid = srv.submit([3, 1], 3)
+    with pytest.raises(ValueError, match="in flight"):
+        srv.release(rid)
+    srv.run_until_done(max_steps=20)
+    toks = srv.release(rid)
+    assert len(toks) == 3
+    assert rid not in srv.outputs and rid not in srv.prompts
+    assert rid not in srv.finished
 
 
 def test_moe_family_serves():
