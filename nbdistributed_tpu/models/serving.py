@@ -265,11 +265,15 @@ class DecodeServer:
     def release(self, rid: int) -> list[int]:
         """Drop a finished request's host-side record (prompt, output,
         finished flag) and return its tokens — the eviction API that
-        keeps a long-running server's host memory bounded."""
+        keeps a long-running server's host memory bounded.  Unknown or
+        already-released ids raise (a silent [] would be
+        indistinguishable from a request that emitted nothing)."""
         if rid in self._budget or any(r == rid for r, _, _ in
                                       self._pending):
             raise ValueError(f"request {rid} is still in flight")
-        toks = self.outputs.pop(rid, [])
+        if rid not in self.outputs:
+            raise KeyError(f"unknown or already-released request {rid}")
+        toks = self.outputs.pop(rid)
         self.prompts.pop(rid, None)
         self._finished.discard(rid)
         return toks

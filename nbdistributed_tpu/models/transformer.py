@@ -357,17 +357,24 @@ def make_layer_fn(cfg: TransformerConfig, positions,
         x = _attention_block(x, layer, cfg, positions, sp)
         return _mlp_block(x, layer, cfg)
 
+    # Validate the policy BEFORE the remat gate: a config carrying a
+    # policy but remat=False (or an unknown policy string) must fail
+    # loudly, not silently train with full activation memory.
+    policy = getattr(cfg, "remat_policy", None)
+    if policy not in (None, "dots"):
+        raise ValueError(f"unknown remat_policy {policy!r} "
+                         f"(None or 'dots')")
+    if policy is not None and not cfg.remat:
+        raise ValueError("remat_policy is set but remat=False — the "
+                         "policy would be silently ignored; set "
+                         "remat=True (or drop the policy)")
     if not cfg.remat:
         return one_layer
-    policy = getattr(cfg, "remat_policy", None)
-    if policy is None:
-        return jax.checkpoint(one_layer)
     if policy == "dots":
         return jax.checkpoint(
             one_layer,
             policy=jax.checkpoint_policies.checkpoint_dots)
-    raise ValueError(f"unknown remat_policy {policy!r} "
-                     f"(None or 'dots')")
+    return jax.checkpoint(one_layer)
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,

@@ -34,7 +34,8 @@ def sharding_tree(mesh, rules):
 
 def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
                        dp_axis: str = "dp", donate: bool = True,
-                       opt_state_sh=None, accum_steps: int = 1):
+                       opt_state_sh=None, accum_steps: int = 1,
+                       accum_rules=None):
     """Combined dp×tp train step: params sharded by ``param_rules``
     (tp axes; ``None`` = fully replicated, i.e. pure DDP), batch sharded
     on ``dp_axis``.
@@ -49,7 +50,16 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
     ``accum_steps > 1`` splits the batch's leading axis into that many
     microbatches inside the compiled step (``lax.scan``, fp32 gradient
     accumulator) — same numerics as the full batch for mean losses,
-    activation memory divided by ``accum_steps``."""
+    activation memory divided by ``accum_steps``.
+
+    ``accum_rules``: optional pytree of ``PartitionSpec`` for the fp32
+    accumulator (ZeRO-2; see :mod:`~nbdistributed_tpu.parallel.zero`).
+    Without accumulation, gradients are transient inside the fused
+    step and XLA already consumes them reduce-scattered when the
+    optimizer state is ZeRO-sharded — the accumulator is the one
+    place a *persistent* full-size gradient buffer exists, so it is
+    the one place ZeRO-2 sharding buys memory (4 bytes/param/replica
+    → /dp)."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     repl = NamedSharding(mesh, P())
@@ -85,15 +95,23 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
 
         micro = jax.tree_util.tree_map(split, batch)
 
+        def pin_accum(t):
+            if accum_rules is None:
+                return t
+            return jax.tree_util.tree_map(
+                lambda a, r: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, r)),
+                t, accum_rules, is_leaf=lambda x: isinstance(x, P))
+
         def body(carry, mb):
             gsum, lsum = carry
             l, g = jax.value_and_grad(loss_fn)(params, mb)
-            gsum = jax.tree_util.tree_map(
-                lambda a, b: a + b.astype(jnp.float32), gsum, g)
+            gsum = pin_accum(jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), gsum, g))
             return (gsum, lsum + l), None
 
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zeros = pin_accum(jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
         (gsum, lsum), _ = jax.lax.scan(body, (zeros, jnp.float32(0.0)),
                                        micro)
         grads = jax.tree_util.tree_map(

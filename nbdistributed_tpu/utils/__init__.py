@@ -15,8 +15,8 @@ def fan_in_normal(key, shape, fan_in, dtype):
             / np.sqrt(fan_in)).astype(dtype)
 
 
-from .data import (batch_iterator, interleave_shards, rank_slice,
-                   shard_arrays)
+from .data import (batch_iterator, interleave_shards,
+                   prefetch_to_device, rank_slice, shard_arrays)
 
 __all__ = ["fan_in_normal", "batch_iterator", "interleave_shards",
-           "rank_slice", "shard_arrays"]
+           "prefetch_to_device", "rank_slice", "shard_arrays"]

@@ -159,3 +159,34 @@ def test_pack_tokens_padding_and_validation():
     with pytest.raises(ValueError, match="eos_id"):
         pack_tokens([[1, 2, 3]], 2, drop_remainder=False)
     assert pack_tokens([], 4).shape == (0, 4)
+
+
+def test_prefetch_to_device_order_and_placement():
+    """Prefetch preserves order and places leaves per the sharding;
+    works for short iterators, empty iterators, and size=1."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.utils.data import prefetch_to_device
+
+    batches = [{"x": np.full((4, 3), i, np.float32)} for i in range(5)]
+    got = list(prefetch_to_device(iter(batches), size=2))
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(b["x"]),
+                                      batches[i]["x"])
+
+    mesh = mesh_mod.make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P("dp"))
+    got = list(prefetch_to_device(iter(batches), size=3, sharding=sh))
+    assert all(b["x"].sharding == sh for b in got)
+    # Sharded batches feed a jitted mean without resharding.
+    assert float(jax.jit(lambda b: jnp.mean(b["x"]))(got[2])) == 2.0
+
+    assert list(prefetch_to_device(iter([]), size=2)) == []
+    assert len(list(prefetch_to_device(iter(batches), size=1))) == 5
+    with pytest.raises(ValueError, match="size"):
+        list(prefetch_to_device(iter(batches), size=0))

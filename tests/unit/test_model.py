@@ -237,6 +237,11 @@ def test_remat_matches_no_remat():
                            "remat_policy": "everything"})
     with pytest.raises(ValueError, match="remat_policy"):
         loss_fn(params, batch, cfg_bad)
+    # A policy without remat=True would be silently ignored — reject.
+    cfg_off = type(cfg)(**{**cfg.__dict__, "remat": False,
+                           "remat_policy": "dots"})
+    with pytest.raises(ValueError, match="remat=False"):
+        loss_fn(params, batch, cfg_off)
 
 
 def test_sliding_window_model_paths_agree():

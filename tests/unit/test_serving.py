@@ -188,6 +188,10 @@ def test_release_evicts_and_guards_in_flight(setup):
     assert len(toks) == 3
     assert rid not in srv.outputs and rid not in srv.prompts
     assert rid not in srv.finished
+    with pytest.raises(KeyError, match="already-released"):
+        srv.release(rid)
+    with pytest.raises(KeyError, match="unknown"):
+        srv.release(9999)
 
 
 def test_moe_family_serves():
