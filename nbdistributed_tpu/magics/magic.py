@@ -723,23 +723,64 @@ class DistributedMagics(Magics):
     # whose users hand-roll torch.save in cells)
 
     @magic_arguments()
-    @argument("path", help="checkpoint directory (per-rank subdirs)")
-    @argument("names", nargs="+", help="worker variable names to save")
+    @argument("path", nargs="?", default=None,
+              help="checkpoint directory (per-rank subdirs)")
+    @argument("names", nargs="*", help="worker variable names to save")
+    @argument("-b", "--background", action="store_true",
+              help="return immediately; the device->host drain and "
+                   "disk IO run on a worker thread (jax.Arrays are "
+                   "immutable, so training can continue while the "
+                   "old buffers stream out)")
+    @argument("--status", action="store_true",
+              help="poll the in-flight background save instead of "
+                   "saving")
     @line_magic
     def dist_checkpoint(self, line):
         """Snapshot named variables from every worker's namespace:
-        ``%dist_checkpoint ckpt/step100 params opt_state``."""
+        ``%dist_checkpoint ckpt/step100 params opt_state``.  With
+        ``--background`` the save overlaps subsequent cells; poll it
+        with ``%dist_checkpoint --status``."""
         if not self._require_cluster():
             return
         args = parse_argstring(self.dist_checkpoint, line)
+        if args.status:
+            try:
+                resps = self._comm.send_to_all(
+                    "checkpoint", {"action": "status"}, timeout=60)
+            except Exception as e:
+                print(f"❌ checkpoint status failed: {e}")
+                return
+            for r in sorted(resps):
+                d = resps[r].data
+                state = d.get("error") or d.get("status")
+                extra = ""
+                if d.get("status") == "done":
+                    total = sum(v.get("bytes", 0) for v in
+                                d.get("summary", {}).values())
+                    extra = f" ({total / 1e6:.1f} MB)"
+                print(f"🔹 Rank {r}: {state}{extra}")
+            return
+        if not args.path or not args.names:
+            print("usage: %dist_checkpoint <path> <names...> "
+                  "[--background] | %dist_checkpoint --status")
+            return
         try:
             resps = self._comm.send_to_all(
                 "checkpoint", {"action": "save", "path": args.path,
-                               "names": args.names}, timeout=600)
+                               "names": args.names,
+                               "background": args.background},
+                timeout=600)
         except Exception as e:
             print(f"❌ checkpoint failed: {e}")
             return
-        self._report_checkpoint(resps, f"saved → {args.path}")
+        verb = (f"background save started → {args.path} "
+                f"(poll: %dist_checkpoint --status)"
+                if args.background else f"saved → {args.path}")
+        for r in sorted(resps):
+            prev = resps[r].data.get("previous_error")
+            if prev:
+                print(f"⚠️  Rank {r}: {prev}")
+        self._report_checkpoint(resps, verb)
 
     @magic_arguments()
     @argument("path", help="checkpoint directory written by "

@@ -165,6 +165,98 @@ def save(path: str, namespace: dict, names: list[str], *, rank: int = 0,
     return summary
 
 
+class AsyncSave:
+    """Handle for a background :func:`save`.
+
+    ``done()`` polls; ``wait(timeout)`` joins and returns the save
+    summary, re-raising any exception the background save hit.  The
+    snapshot semantics are taken at :func:`save_async` call time:
+    ``jax.Array``/numpy leaves are immutable-by-convention (training
+    steps build new buffers), so the thread can read them lazily;
+    plain-Python ("obj") leaves are pickled up front so later cell
+    mutations cannot tear the checkpoint.
+    """
+
+    def __init__(self, thread, result_box):
+        self._thread = thread
+        self._box = result_box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self, timeout: float | None = None) -> dict:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("async checkpoint still writing")
+        if "error" in self._box:
+            raise self._box["error"]
+        return self._box["summary"]
+
+
+def save_async(path: str, namespace: dict, names: list[str], *,
+               rank: int = 0, world_size: int = 1) -> AsyncSave:
+    """Start :func:`save` in a background thread and return a handle.
+
+    The synchronous cost is validation + a *defensive device-side
+    copy* of each ``jax.Array`` leaf (async-dispatched ``jnp.copy`` —
+    returns immediately) + starting the thread; the blocking
+    ``device_get`` and all disk IO happen in the thread.  The device
+    copy is load-bearing, not paranoia: this framework's own train
+    steps donate params/optimizer buffers (``make_tp_train_step``
+    ``donate=True`` default), so the *next* step deletes the buffers
+    a lazy reference would still be draining — the copy owns fresh
+    buffers no donation can touch.  Cost: one transient device-side
+    duplicate of the saved tree until the thread finishes (plan HBM
+    accordingly for near-full-memory models).  numpy leaves are
+    ``copy()``-ed and other Python leaves pickle-round-tripped at
+    call time, so in-place host mutations cannot tear the snapshot
+    either.
+    """
+    import pickle as _pickle
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    missing = [n for n in names if n not in namespace]
+    if missing:
+        raise KeyError(f"names not defined on rank {rank}: {missing}")
+    snapshot: dict = {}
+    for n in names:
+        leaves, treedef = _leaf_entries(namespace[n])
+        frozen = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+                c = jnp.copy(leaf)        # donation-proof device copy
+                c.copy_to_host_async()    # start the D2H DMA now
+                frozen.append(c)
+            elif isinstance(leaf, jax.Array):
+                frozen.append(leaf)  # save() rejects with its message
+            elif isinstance(leaf, np.ndarray):
+                frozen.append(leaf.copy())   # freeze host buffer
+            else:
+                # Mutable Python leaf: freeze NOW via a pickle
+                # round-trip so post-call cell mutations can't tear
+                # the snapshot.
+                frozen.append(_pickle.loads(_pickle.dumps(leaf)))
+        snapshot[n] = jax.tree_util.tree_unflatten(treedef, frozen)
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["summary"] = save(path, snapshot, names, rank=rank,
+                                  world_size=world_size)
+        except BaseException as e:  # surfaced at wait()
+            box["error"] = e
+
+    t = threading.Thread(target=run, name=f"nbd-ckpt-save-r{rank}",
+                         daemon=True)
+    t.start()
+    return AsyncSave(t, box)
+
+
 def _decode_array(raw, meta, *, to_device: bool):
     import jax.numpy as jnp
     import numpy as np

@@ -54,6 +54,7 @@ class DistributedWorker:
         self.world_size = world_size
         self._shutdown = threading.Event()
         self._busy: tuple | None = None  # (msg_type, started_ts) | None
+        self._ckpt_async = None          # in-flight background save
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -273,17 +274,62 @@ class DistributedWorker:
 
     def _handle_checkpoint(self, msg: Message) -> Message:
         """Save/restore named namespace entries (SURVEY §5.4 upgrade —
-        the reference has no checkpoint subsystem at all)."""
+        the reference has no checkpoint subsystem at all).
+
+        ``background: true`` on a save starts
+        :func:`~.checkpoint.save_async` and returns immediately (the
+        worker stays responsive while the device→host drain and disk
+        IO run on a thread); ``action: "status"`` polls the in-flight
+        save — pending / done-with-summary / failed-with-error."""
         from . import checkpoint
 
         action = msg.data.get("action")
-        path = msg.data["path"]
         names = msg.data.get("names")
+        if action == "status":
+            h = self._ckpt_async
+            if h is None:
+                return msg.reply(data={"status": "idle"}, rank=self.rank)
+            if not h.done():
+                return msg.reply(data={"status": "pending"},
+                                 rank=self.rank)
+            self._ckpt_async = None
+            try:
+                summary = h.wait(0)
+            except Exception as e:
+                return msg.reply(data={"error": f"async checkpoint "
+                                                f"failed: {e}"},
+                                 rank=self.rank)
+            return msg.reply(data={"status": "done", "summary": summary},
+                             rank=self.rank)
+        path = msg.data["path"]
         if action == "save":
             if not names:
                 return msg.reply(
                     data={"error": "checkpoint save requires a non-empty "
                                    "list of names"}, rank=self.rank)
+            if msg.data.get("background"):
+                prev = self._ckpt_async
+                if prev is not None and not prev.done():
+                    return msg.reply(
+                        data={"error": "a background checkpoint is "
+                                       "already in flight (poll it "
+                                       "with %dist_checkpoint "
+                                       "--status first)"},
+                        rank=self.rank)
+                reply: dict = {"status": "started", "summary": {}}
+                if prev is not None:
+                    # Completed but never polled: its outcome —
+                    # especially a FAILURE — must not vanish silently.
+                    try:
+                        prev.wait(0)
+                    except Exception as e:
+                        reply["previous_error"] = (
+                            f"previous background checkpoint failed "
+                            f"unpolled: {e}")
+                self._ckpt_async = checkpoint.save_async(
+                    path, self.namespace, names, rank=self.rank,
+                    world_size=self.world_size)
+                return msg.reply(data=reply, rank=self.rank)
             summary = checkpoint.save(path, self.namespace, names,
                                       rank=self.rank,
                                       world_size=self.world_size)

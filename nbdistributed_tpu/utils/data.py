@@ -126,27 +126,32 @@ def prefetch_to_device(batches, *, size: int = 2,
 
     import jax
 
+    # Validate at call time, not first next() (same convention as
+    # batch_iterator): misconfiguration should point here.
     if size < 1:
         raise ValueError(f"size must be >= 1, got {size}")
+    it = iter(batches)
 
     def put(b):
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, sharding), b)
 
-    it = iter(batches)
-    q: collections.deque = collections.deque()
-    try:
-        while len(q) < size:
-            q.append(put(next(it)))
-    except StopIteration:
-        pass
-    while q:
-        out = q.popleft()
+    def gen():
+        q: collections.deque = collections.deque()
         try:
-            q.append(put(next(it)))
+            while len(q) < size:
+                q.append(put(next(it)))
         except StopIteration:
             pass
-        yield out
+        while q:
+            out = q.popleft()
+            try:
+                q.append(put(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+    return gen()
 
 
 def interleave_shards(shards: Sequence[dict[str, Any]]) -> dict[str, Any]:

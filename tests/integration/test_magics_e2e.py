@@ -208,6 +208,44 @@ def test_checkpoint_missing_var_reports_per_rank(ip, capsys, tmp_path):
     assert "❌" in out and "not_a_var" in out
 
 
+def test_background_checkpoint_and_status(ip, capsys, tmp_path):
+    """--background returns immediately; --status polls until done;
+    the written checkpoint restores exactly."""
+    import time
+
+    path = tmp_path / "magic_ck_bg"
+    run(ip, "ckbg_v = jnp.arange(6.0) * (rank + 1)")
+    capsys.readouterr()
+    ip.run_line_magic("dist_checkpoint", f"{path} ckbg_v --background")
+    out = capsys.readouterr().out
+    assert "background save started" in out
+    # Each rank's "done" is reported exactly once (the status poll
+    # consumes the handle), and ranks can finish on different polls —
+    # accumulate across polls.
+    done_total = 0
+    for _ in range(100):
+        ip.run_line_magic("dist_checkpoint", "--status")
+        out = capsys.readouterr().out
+        done_total += out.count("done")
+        if done_total == 2:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(
+            f"background save never finished (saw {done_total} done): "
+            f"{out}")
+    # A second status poll reports idle (the handle was consumed).
+    ip.run_line_magic("dist_checkpoint", "--status")
+    assert capsys.readouterr().out.count("idle") == 2
+    run(ip, "ckbg_v = None")
+    capsys.readouterr()
+    ip.run_line_magic("dist_restore", str(path))
+    capsys.readouterr()
+    run(ip, "float(ckbg_v[5])")
+    out = capsys.readouterr().out
+    assert "5.0" in out and "10.0" in out
+
+
 def test_dist_logs_shows_worker_stdio(ip, capsys):
     # sys.stderr writes bypass the streaming stdout path and land in
     # the process pipe the manager drains.

@@ -176,3 +176,78 @@ def test_info_lists_ranks_and_names(tmp_path):
     assert sorted(meta["ranks"]) == [0, 1]
     assert meta["ranks"][0]["names"] == ["p", "s"]
     assert meta["ranks"][0]["world_size"] == 2
+
+
+def test_save_async_roundtrip_and_done(tmp_path):
+    """Background save: handle transitions to done, wait() returns the
+    summary, and the restored values equal the saved ones."""
+    ns = {"w": jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4),
+          "step": 7}
+    h = checkpoint.save_async(str(tmp_path / "ck"), ns, ["w", "step"])
+    summary = h.wait(30)
+    assert h.done()
+    assert summary["w"]["bytes"] == 24
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out)
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.asarray(ns["w"], np.float32))
+    assert out["step"] == 7
+
+
+def test_save_async_snapshots_mutable_leaves(tmp_path):
+    """Plain-Python leaves are frozen at call time: mutating them
+    after save_async returns must not change what lands on disk."""
+    cfg = {"lr": [1, 2, 3]}
+    ns = {"cfg": cfg}
+    h = checkpoint.save_async(str(tmp_path / "ck"), ns, ["cfg"])
+    cfg["lr"].append(999)        # mutate while (possibly) writing
+    h.wait(30)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out)
+    assert out["cfg"] == {"lr": [1, 2, 3]}
+
+
+def test_save_async_missing_name_raises_synchronously(tmp_path):
+    with pytest.raises(KeyError, match="nope"):
+        checkpoint.save_async(str(tmp_path / "ck"), {"a": 1}, ["nope"])
+
+
+def test_save_async_error_surfaces_at_wait(tmp_path):
+    """A failure inside the thread (unwritable path) re-raises from
+    wait(), not silently."""
+    target = tmp_path / "blocked"
+    target.write_text("a file where the checkpoint dir must go")
+    ns = {"x": jnp.ones(3)}
+    h = checkpoint.save_async(str(target), ns, ["x"])
+    with pytest.raises(Exception):
+        h.wait(30)
+
+
+def test_save_async_survives_buffer_donation(tmp_path):
+    """This repo's own train steps donate params/opt buffers, deleting
+    them on the next step.  save_async's device-side defensive copy
+    must keep the checkpoint intact even when the original buffer is
+    deleted immediately after the call (delete() is exactly what
+    donation does to the old buffer)."""
+    x = jnp.arange(8.0)
+    h = checkpoint.save_async(str(tmp_path / "ck"), {"x": x}, ["x"])
+    x.delete()
+    h.wait(30)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out)
+    np.testing.assert_array_equal(np.asarray(out["x"]),
+                                  np.arange(8.0, dtype=np.float32))
+
+
+def test_save_async_freezes_numpy_leaves(tmp_path):
+    """In-place mutation of a host numpy leaf after save_async must
+    not tear the snapshot (leaves are copy()-ed at call time)."""
+    buf = np.arange(6, dtype=np.int32)
+    h = checkpoint.save_async(str(tmp_path / "ck"), {"buf": buf},
+                              ["buf"])
+    buf[:] = -1
+    h.wait(30)
+    out: dict = {}
+    checkpoint.restore(str(tmp_path / "ck"), out)
+    np.testing.assert_array_equal(out["buf"],
+                                  np.arange(6, dtype=np.int32))
