@@ -358,6 +358,64 @@ _out["mean_accepted"] = round(float(_spec_r[1]), 2)
 _json.dumps(_out)
 """
 
+# Continuous-batching server vs sequential decode.  Decode is
+# HBM-bound (every step streams the weights once regardless of B), so
+# B requests served together approach Bx the aggregate tokens/s of
+# serving them one after another.  Three rows:
+#   sequential  — B separate generate() calls (the no-server baseline)
+#   batched_gen — one generate() at batch B (device-side upper bound)
+#   server      — DecodeServer, which adds the per-step host sync the
+#                 interactive streaming/EOS contract requires (over
+#                 the axon tunnel that round-trip is the dominant
+#                 per-step cost — reported as-is, it IS the product).
+SERVE_CELL = """
+import json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.models import (DecodeServer, init_params,
+                                      make_generate_fn,
+                                      smol_135m_config)
+_cfg = smol_135m_config(dtype=_jnp.bfloat16, use_flash=True)
+_p = init_params(_jax.random.PRNGKey(0), _cfg)
+_N, _B, _L = 48, 4, 16
+_prompts = [[(7 * i + j) % 100 + 1 for j in range(_L)]
+            for i in range(_B)]
+_g1 = make_generate_fn(_cfg, _N, max_len=256)
+_gB = make_generate_fn(_cfg, _N, max_len=256)
+_pb = _jnp.asarray(_prompts, _jnp.int32)
+
+_jax.block_until_ready(_g1(_p, _pb[:1]))        # warm B=1
+_jax.block_until_ready(_gB(_p, _pb))            # warm B=4
+_t0 = _time.time()
+for _i in range(_B):
+    _jax.block_until_ready(_g1(_p, _pb[_i:_i + 1]))
+_dt_seq = _time.time() - _t0
+_t0 = _time.time()
+_jax.block_until_ready(_gB(_p, _pb))
+_dt_bat = _time.time() - _t0
+
+_srv = DecodeServer(_p, _cfg, max_batch=_B, max_len=256, pad_to=_L)
+_w = _srv.submit(_prompts[0], 2)                # warm prefill + step
+_srv.run_until_done(); _srv.release(_w)
+_t0 = _time.time()
+_rids = [_srv.submit(_pr, _N) for _pr in _prompts]
+_srv.run_until_done(max_steps=4 * _N)
+_dt_srv = _time.time() - _t0
+assert all(len(_srv.outputs[_r]) == _N for _r in _rids)
+
+_tot = _B * _N
+_json.dumps({
+    "batch": _B, "new_tokens": _N,
+    "sequential_tok_per_s": round(_tot / _dt_seq, 1),
+    "batched_generate_tok_per_s": round(_tot / _dt_bat, 1),
+    "server_tok_per_s": round(_tot / _dt_srv, 1),
+    "batching_speedup": round(_dt_seq / _dt_bat, 2),
+    "server_vs_sequential": round(_dt_seq / _dt_srv, 2),
+    "per_step_host_sync_ms": round(
+        (_dt_srv - _dt_bat) / _N * 1e3, 2),
+})
+"""
+
+
 # 7B-class int8 decode at a real memory footprint (BASELINE.json config
 # #5's Llama-2-7B intent): weights init on the host CPU backend (a full
 # bf16 7B never touches the 16G chip), quantized to int8 there, and
@@ -719,6 +777,24 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                         log(f"[bench] speculative: {sp}")
             except Exception as e:
                 log(f"[bench] speculative comparison skipped: {e}")
+
+            try:
+                log("[bench] continuous-batching server vs sequential "
+                    "decode (smol-135M)")
+                cleanup_rank0()
+                resp = comm.send_to_ranks([0], "execute", SERVE_CELL,
+                                          timeout=1200)
+                m = resp[0]
+                if m.data.get("error"):
+                    log(f"[bench] serve cell failed: "
+                        f"{m.data.get('traceback', m.data['error'])}")
+                else:
+                    sv = parse_result_json(m)
+                    if sv is not None:
+                        extra["serving"] = sv
+                        log(f"[bench] serving: {sv}")
+            except Exception as e:
+                log(f"[bench] serving comparison skipped: {e}")
 
             try:
                 log("[bench] llama2-7B int8 decode at real memory "

@@ -63,6 +63,17 @@ def test_decode_cell_executes():
     assert res["bf16_tok_per_s"] > 0 and res["int8_tok_per_s"] > 0
 
 
+def test_serve_cell_executes():
+    cell = bench.SERVE_CELL.replace("smol_135m_config", "tiny_config")
+    cell = cell.replace("_N, _B, _L = 48, 4, 16",
+                        "_N, _B, _L = 6, 2, 4")
+    cell = cell.replace("use_flash=True", "use_flash=False")
+    res = run_cell(cell)
+    assert res["server_tok_per_s"] > 0
+    assert res["sequential_tok_per_s"] > 0
+    assert res["batch"] == 2 and res["new_tokens"] == 6
+
+
 def test_cleanup_cell_removes_bench_temporaries():
     ns = {"_p": 1, "_big_buf": 2, "__keep__": 3, "user_var": 4}
     exec(compile(bench.CLEANUP_CELL, "<cell>", "exec"), ns)
