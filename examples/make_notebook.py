@@ -182,6 +182,28 @@ restored_loss = float(loss_fn(params, eval_batch, cfg))
 assert restored_loss == eval_loss, (restored_loss, eval_loss)
 print(f"rank {rank}: eval after restore {restored_loss:.4f} (exact)")""")
 
+md("""### Background (async) checkpointing
+
+`--background` returns immediately: each array is defensively copied
+on-device (safe next to donating train steps) and the device→host
+drain + disk IO run on a worker thread, so the next training cell
+starts at once. `%dist_checkpoint --status` polls per rank.""")
+
+code("%dist_checkpoint /tmp/nbd_demo_ckpt_bg params opt_state --background")
+
+code("""\
+# Training continues immediately while the save drains...
+batch = {k: jnp.asarray(v) for k, v in next(batches).items()}
+params, opt_state, loss = ddp_step(params, opt_state, batch)
+print(f"rank {rank}: trained a step during the save "
+      f"(loss {float(loss):.4f})")""")
+
+code("""\
+import time
+time.sleep(1.0)  # let the background write land for the poll below""")
+
+code("%dist_checkpoint --status")
+
 md("""## Generation
 
 The model family includes a static-shape KV-cache decode loop (one
