@@ -27,14 +27,14 @@ from .transformer import (SeqParallel, TransformerConfig,
                           fsdp_param_shardings, forward,
                           init_params, llama2_7b_config, loss_fn,
                           make_train_step, mistral_7b_config,
-                          param_shardings, smol_135m_config,
-                          tinyllama_1b_config,
+                          packed_positions, param_shardings,
+                          smol_135m_config, tinyllama_1b_config,
                           tiny_config)
 
 __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "fsdp_param_shardings", "init_params",
            "llama2_7b_config", "loss_fn", "make_train_step",
-           "mistral_7b_config",
+           "mistral_7b_config", "packed_positions",
            "param_shardings", "smol_135m_config", "tiny_config",
            "tinyllama_1b_config",
            "MoEConfig", "init_moe_model", "mixtral_8x7b_config",

@@ -63,6 +63,18 @@ def _stage_fn(cfg: TransformerConfig, positions):
     return stage
 
 
+def _reject_segments(batch) -> None:
+    """Packed-document batches are not plumbed through the pipelined
+    losses yet; silently reading only batch["tokens"] would reintroduce
+    the cross-document attention leak segment masking exists to stop —
+    fail loudly instead (the sp path does the same)."""
+    if isinstance(batch, dict) and batch.get("segments") is not None:
+        raise ValueError(
+            'batch["segments"] (packed documents) is not supported by '
+            "the pipelined losses yet — use the plain or dp/tp train "
+            "steps for packed batches, or drop the segments")
+
+
 def pp_loss_fn(params_pp: dict, batch, cfg: TransformerConfig, mesh,
                *, pp_axis: str = "pp",
                n_microbatches: int | None = None):
@@ -71,6 +83,7 @@ def pp_loss_fn(params_pp: dict, batch, cfg: TransformerConfig, mesh,
     ``transformer.loss_fn`` (shared ``shifted_xent``); batch rows are
     the microbatch unit, so ``n_microbatches`` (default: n_stages)
     must divide the batch size."""
+    _reject_segments(batch)
     tokens = batch["tokens"]
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -138,6 +151,7 @@ def make_pp_1f1b_train_step(cfg: TransformerConfig, optimizer, mesh, *,
     fn_cache: dict = {}
 
     def step(params_pp, opt_state, batch):
+        _reject_segments(batch)
         tokens = batch["tokens"]
         B, S = tokens.shape
         if B % n_micro:

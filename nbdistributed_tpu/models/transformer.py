@@ -301,7 +301,7 @@ class SeqParallel:
 
 
 def _attention_block(x, layer, cfg: TransformerConfig, positions,
-                     sp: SeqParallel | None = None):
+                     sp: SeqParallel | None = None, segment_ids=None):
     B, S, D = x.shape
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -310,6 +310,11 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
     v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
+    if segment_ids is not None and sp is not None:
+        raise ValueError("segment_ids (packed documents) is not "
+                         "supported together with sequence "
+                         "parallelism yet — pack within sp shards or "
+                         "drop sp")
     if sp is not None:
         flash = cfg.use_flash if sp.use_flash is None else sp.use_flash
         batch_axis, head_axis = sp._resolved_axes()
@@ -331,11 +336,12 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
         # block sizes None -> TUNED_BLOCKS table (tune_flash.py) with
         # the 128x128 fallback.
         o = flash_attention(q, k, v, True, None, None, None,
-                            cfg.sliding_window)
+                            cfg.sliding_window, segment_ids)
     else:
         from ..ops import attention_reference
         o = attention_reference(q, k, v, causal=True,
-                                window=cfg.sliding_window)
+                                window=cfg.sliding_window,
+                                segment_ids=segment_ids)
     return x + qlinear(o.reshape(B, S, H * Dh), layer["wo"])
 
 
@@ -347,14 +353,14 @@ def _mlp_block(x, layer, cfg: TransformerConfig):
 
 
 def make_layer_fn(cfg: TransformerConfig, positions,
-                  sp: SeqParallel | None = None):
+                  sp: SeqParallel | None = None, segment_ids=None):
     """The per-layer recipe (attention block + MLP block, optionally
     rematerialized) — one definition shared by the plain forward and
     the pipelined stages (models/pp.py), so a change to the layer
     structure cannot silently diverge between them."""
 
     def one_layer(x, layer):
-        x = _attention_block(x, layer, cfg, positions, sp)
+        x = _attention_block(x, layer, cfg, positions, sp, segment_ids)
         return _mlp_block(x, layer, cfg)
 
     # Validate the policy BEFORE the remat gate: a config carrying a
@@ -378,17 +384,22 @@ def make_layer_fn(cfg: TransformerConfig, positions,
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,
-            positions=None, *, sp: SeqParallel | None = None):
+            positions=None, *, sp: SeqParallel | None = None,
+            segment_ids=None):
     """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32.
 
     With ``sp``, attention runs sequence-parallel (see
     :class:`SeqParallel`); shard the batch's S axis over
-    ``sp.mesh[sp.axis]`` and jit as usual."""
+    ``sp.mesh[sp.axis]`` and jit as usual.  ``segment_ids`` (B, S):
+    packed-document attention masking (see
+    :func:`~nbdistributed_tpu.ops.attention.flash_attention`) —
+    positions attend only within their own document."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
-    one_layer = make_layer_fn(cfg, positions, sp)
+    one_layer = make_layer_fn(cfg, positions, sp,
+                              segment_ids=segment_ids)
 
     def layer_step(x, layer):
         return one_layer(x, layer), None
@@ -398,14 +409,38 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
     return qlinear(x, params["lm_head"]).astype(jnp.float32)
 
 
-def shifted_xent(logits, tokens):
+def shifted_xent(logits, tokens, segment_ids=None):
     """The logits-shift next-token cross-entropy tail: logits (B, S, V)
     from a full-S forward predict tokens[:, 1:] from positions 0..S-2.
     The single definition shared by the plain, SP, and pipelined
-    losses — change it here and every path follows."""
+    losses — change it here and every path follows.
+
+    ``segment_ids`` (B, S): packed-document batches exclude the
+    boundary targets — position i must not be trained to predict the
+    first token of the NEXT document (seg[i] != seg[i+1]); the mean
+    runs over the surviving targets."""
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)
-    return jnp.mean(nll)
+    if segment_ids is None:
+        return jnp.mean(nll)
+    keep = (segment_ids[:, :-1] == segment_ids[:, 1:])[..., None]
+    return (jnp.sum(jnp.where(keep, nll, 0.0))
+            / jnp.maximum(jnp.sum(keep), 1))
+
+
+def packed_positions(segment_ids):
+    """Within-document positions for a packed batch: position restarts
+    at 0 at every document boundary, so RoPE sees each document as if
+    it started the sequence — matching what the model will see at
+    inference on unpacked prompts.  segment_ids (B, S) non-decreasing
+    per row -> (B, S) int32."""
+    seg = jnp.asarray(segment_ids)
+    pos = jnp.arange(seg.shape[1], dtype=jnp.int32)[None]
+    is_start = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1], bool),
+         seg[:, 1:] != seg[:, :-1]], axis=1)
+    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+    return pos - seg_start
 
 
 def loss_fn(params, batch, cfg: TransformerConfig,
@@ -419,9 +454,17 @@ def loss_fn(params, batch, cfg: TransformerConfig,
     identical to forwarding tokens[:, :-1] — but it keeps the model's
     sequence length equal to the batch's (typically a power of two, so
     no kernel padding, and divisible by a sequence-parallel axis,
-    which S-1 never is)."""
+    which S-1 never is).
+
+    ``batch["segments"]`` (optional, (B, S)): packed-document
+    training — attention masks across documents, RoPE positions
+    restart per document, and boundary targets drop from the loss."""
     tokens = batch["tokens"]
-    return shifted_xent(forward(params, tokens, cfg, sp=sp), tokens)
+    seg = batch.get("segments")
+    positions = packed_positions(seg) if seg is not None else None
+    logits = forward(params, tokens, cfg, positions, sp=sp,
+                     segment_ids=seg)
+    return shifted_xent(logits, tokens, segment_ids=seg)
 
 
 # ----------------------------------------------------------------------

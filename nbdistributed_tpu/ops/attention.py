@@ -47,16 +47,30 @@ def check_window(window, causal: bool) -> None:
 
 def attention_reference(q, k, v, *, causal: bool = True,
                         scale: float | None = None,
-                        window: int | None = None):
+                        window: int | None = None,
+                        segment_ids=None, kv_segment_ids=None):
     """Exact attention.  q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) with
     H % Hkv == 0 (grouped-query).  ``window``: sliding-window size —
     query row i attends keys in [i - window + 1, i] (Mistral-style;
-    requires ``causal=True``)."""
+    requires ``causal=True``).
+
+    ``segment_ids`` (B, Sq) int: packed-document masking — a query
+    attends only keys with the SAME segment id (``kv_segment_ids``
+    defaults to ``segment_ids``, which requires Sq == Sk).  With
+    ``causal=True`` the diagonal is always in-segment, so every row
+    has at least one key; rows masked everywhere (possible only
+    non-causally) are undefined — keep packed masking causal.
+    """
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
     if H % Hkv:
         raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
     check_window(window, causal)
+    if segment_ids is not None and kv_segment_ids is None:
+        if Sq != Sk:
+            raise ValueError("segment_ids with Sq != Sk needs explicit "
+                             "kv_segment_ids")
+        kv_segment_ids = segment_ids
     group = H // Hkv
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
 
@@ -64,15 +78,24 @@ def attention_reference(q, k, v, *, causal: bool = True,
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
 
-    # (B, H, Sq, Sk)
+    # (B, H, Sq, Sk); the keep mask stays broadcast-shaped — (Sq, Sk)
+    # for the batch-invariant causal band, batch-extended only when
+    # segments actually vary per row.
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    keep = None
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
         keep = ki <= qi
         if window is not None:
             keep = keep & (ki > qi - window)
+        keep = keep[None, None]                      # (1, 1, Sq, Sk)
+    if segment_ids is not None:
+        seg = (jnp.asarray(segment_ids)[:, :, None]
+               == jnp.asarray(kv_segment_ids)[:, None, :])  # (B, Sq, Sk)
+        keep = seg[:, None] if keep is None else keep & seg[:, None]
+    if keep is not None:
         logits = jnp.where(keep, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -117,11 +140,13 @@ def _window_last_q_block(k_idx, q_off, k_off, block_q, block_k,
 
 
 def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
-               seq_k_valid, causal, seq_q_valid=None, window=None):
+               seq_k_valid, causal, seq_q_valid=None, window=None,
+               qseg=None, kseg=None):
     """(block_q, block_k) bool: which score entries are real — inside
     the valid key range, (optionally) inside the valid query range,
-    at-or-below the offset causal diagonal, and (optionally) within
-    the sliding window."""
+    at-or-below the offset causal diagonal, (optionally) within the
+    sliding window, and (optionally) in the same packed-document
+    segment (``qseg`` (block_q, 1) vs ``kseg`` (1, block_k))."""
     qi = (q_idx * block_q
           + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
     ki = (kb * block_k
@@ -133,6 +158,8 @@ def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
         keep = keep & (ki + k_off <= qi + q_off)
         if window is not None:
             keep = keep & (ki + k_off > qi + q_off - window)
+    if qseg is not None:
+        keep = keep & (qseg == kseg)
     return keep
 
 
@@ -142,7 +169,8 @@ def _keep_mask(q_idx, kb, *, block_q, block_k, q_off, k_off,
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                   block_k: int, seq_k: int, seq_k_valid: int,
                   causal: bool, scale: float, block_q: int,
-                  window: int | None = None):
+                  window: int | None = None,
+                  qseg_ref=None, kseg_ref=None):
     """One (batch*kv-head, q-block) program: stream K/V blocks with the
     online-softmax recurrence (running max m, normalizer l, accumulator).
 
@@ -196,22 +224,28 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         num_iters = num_k_blocks
 
     mask_keys = seq_k_valid < seq_k
+    has_seg = qseg_ref is not None
+    qseg_blk = qseg_ref[0] if has_seg else None       # (Bq, 1)
+    need_mask = causal or mask_keys or has_seg
 
     def body(kb, carry):
         accs, ms, ls = carry
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
-        if causal or mask_keys:
+        if need_mask:
+            kseg_blk = (kseg_ref[0, :, pl.ds(kb * block_k, block_k)]
+                        if has_seg else None)          # (1, Bk)
             keep = _keep_mask(q_idx, kb, block_q=block_q,
                               block_k=block_k, q_off=q_off, k_off=k_off,
                               seq_k_valid=seq_k_valid, causal=causal,
-                              window=window)
+                              window=window, qseg=qseg_blk,
+                              kseg=kseg_blk)
         new_acc, new_m, new_l = [], [], []
         for g in range(G):
             s = jax.lax.dot_general(
                 qs[g], k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)   # (Bq, Bk)
-            if causal or mask_keys:
+            if need_mask:
                 s = jnp.where(keep, s, _NEG_INF)
             m_new = jnp.maximum(ms[g],
                                 jnp.max(s, axis=-1, keepdims=True))
@@ -279,16 +313,40 @@ def _offsets_array(offsets):
                       jnp.asarray(k_off, jnp.int32)])
 
 
+def _seg_planes(segment_ids, kv_segment_ids, Sq_pad, Sk_pad):
+    """Stage packed-document segment ids for the kernels.
+
+    Returns (qseg (B, Sq_pad, 1), kseg (B, 1, Sk_pad)) int32 — layouts
+    whose last-two block dims satisfy Mosaic's (8-divisible | equal)
+    rule for per-q-block and full-row staging respectively.
+
+    The pad sentinels (-1 queries / -2 keys) are belt-and-braces, not
+    load-bearing: padded KEYS are always excluded by _keep_mask's
+    ``ki < seq_k_valid`` term regardless of segment values, and padded
+    QUERY rows are sliced off by the wrappers — so user segment ids
+    may be any integers (equality defines membership), including
+    negatives that happen to collide with a sentinel."""
+    qs = jnp.asarray(segment_ids, jnp.int32)
+    ks = jnp.asarray(kv_segment_ids, jnp.int32)
+    qs = jnp.pad(qs, ((0, 0), (0, Sq_pad - qs.shape[1])),
+                 constant_values=-1)
+    ks = jnp.pad(ks, ((0, 0), (0, Sk_pad - ks.shape[1])),
+                 constant_values=-2)
+    return qs[:, :, None], ks[:, None, :]
+
+
 def _flash_forward(q, k, v, *, causal: bool, scale: float,
                    block_q: int, block_k: int, interpret: bool,
-                   offsets=None, window: int | None = None):
+                   offsets=None, window: int | None = None,
+                   segment_ids=None, kv_segment_ids=None):
     """Returns (out (B,Sq,H,D), lse (B*Hkv, group, Sq_pad) float32).
 
     K/V are staged at their native Hkv heads — the GQA group rides the
     q block as a batch dim, so no repeated-KV buffer ever exists.
     ``offsets`` — optional (q_offset, k_offset) traced scalars giving
     the global position of row 0 of q and of k/v, for chunk-of-a-
-    larger-sequence calls (ring attention).
+    larger-sequence calls (ring attention).  ``segment_ids`` — packed-
+    document masking (see :func:`attention_reference`).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -308,9 +366,42 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
     vt = _fold_heads(v, Sk_pad)
 
     grid = (B * Hkv, Sq_pad // block_q)
-    kernel = functools.partial(
+    has_seg = segment_ids is not None
+    in_specs = [
+        pl.BlockSpec((1, group, block_q, D),
+                     lambda bh, qb, offs: (bh, 0, qb, 0)),
+        pl.BlockSpec((1, Sk_pad, D),
+                     lambda bh, qb, offs: (bh, 0, 0)),
+        pl.BlockSpec((1, Sk_pad, D),
+                     lambda bh, qb, offs: (bh, 0, 0)),
+    ]
+    args = [qt, kt, vt]
+    if has_seg:
+        qseg, kseg = _seg_planes(segment_ids, kv_segment_ids,
+                                 Sq_pad, Sk_pad)
+        # Segments are per (batch, position): the index map recovers
+        # the batch row from the folded batch*kv-head program id.
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qb, offs: (bh // Hkv, qb, 0)),
+            pl.BlockSpec((1, 1, Sk_pad),
+                         lambda bh, qb, offs: (bh // Hkv, 0, 0)),
+        ]
+        args += [qseg, kseg]
+
+    base = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=Sk_pad, seq_k_valid=Sk,
         causal=causal, scale=scale, block_q=block_q, window=window)
+
+    def kernel(offs_ref, *refs):
+        if has_seg:
+            (q_r, k_r, v_r, qs_r, ks_r, o_r, l_r) = refs
+            base(offs_ref, q_r, k_r, v_r, o_r, l_r,
+                 qseg_ref=qs_r, kseg_ref=ks_r)
+        else:
+            (q_r, k_r, v_r, o_r, l_r) = refs
+            base(offs_ref, q_r, k_r, v_r, o_r, l_r)
+
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -320,14 +411,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, group, block_q, D),
-                             lambda bh, qb, offs: (bh, 0, qb, 0)),
-                pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),
-                pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, group, block_q, D),
                              lambda bh, qb, offs: (bh, 0, qb, 0)),
@@ -336,7 +420,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
             ],
         ),
         interpret=interpret,
-    )(_offsets_array(offsets), qt, kt, vt)
+    )(_offsets_array(offsets), *args)
     return _unfold_q_gqa(out, B, Hkv, Sq), lse
 
 
@@ -358,7 +442,8 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float,
 def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          dta_ref, dq_ref, *, block_k: int, seq_k: int,
                          seq_k_valid: int, causal: bool, scale: float,
-                         block_q: int, window: int | None = None):
+                         block_q: int, window: int | None = None,
+                         qseg_ref=None, kseg_ref=None):
     from jax.experimental import pallas as pl
 
     G, D = q_ref.shape[1], q_ref.shape[3]
@@ -383,13 +468,18 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     else:
         num_iters = num_k_blocks
 
+    has_seg = qseg_ref is not None
+    qseg_blk = qseg_ref[0] if has_seg else None       # (Bq, 1)
+
     def body(kb, dq_accs):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k)].astype(jnp.float32)
+        kseg_blk = (kseg_ref[0, :, pl.ds(kb * block_k, block_k)]
+                    if has_seg else None)              # (1, Bk)
         keep = _keep_mask(q_idx, kb, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
                           seq_k_valid=seq_k_valid, causal=causal,
-                          window=window)
+                          window=window, qseg=qseg_blk, kseg=kseg_blk)
         out = []
         for g in range(G):
             s = jax.lax.dot_general(
@@ -418,7 +508,8 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
                           block_q: int, seq_q: int, seq_q_valid: int,
                           seq_k_valid: int, causal: bool, scale: float,
                           block_k: int, group: int,
-                          window: int | None = None):
+                          window: int | None = None,
+                          qseg_ref=None, kseg_ref=None):
     """dK/dV for one k-block.  The GQA group rides the *grid* (innermost
     dim, sequential on-core): each step stages only one head's
     (Sq_pad, D) q/dO plane — the same per-program VMEM footprint as an
@@ -450,6 +541,9 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
     else:
         first_block = 0
 
+    has_seg = qseg_ref is not None
+    kseg_blk = kseg_ref[0] if has_seg else None       # (1, Bk)
+
     def body(qb, carry):
         dk_acc, dv_acc = carry
         q_blk = (q_ref[0, 0, pl.ds(qb * block_q, block_q)]
@@ -462,6 +556,8 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
         # neither, (Sq_pad, 1) matching the array is).
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]   # (Bq, 1)
         delta = dta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        qseg_blk = (qseg_ref[0, pl.ds(qb * block_q, block_q)]
+                    if has_seg else None)             # (Bq, 1)
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
@@ -470,7 +566,8 @@ def _flash_bwd_dkv_kernel(offs_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
         keep = _keep_mask(qb, k_idx, block_q=block_q, block_k=block_k,
                           q_off=q_off, k_off=k_off,
                           seq_k_valid=seq_k_valid, causal=causal,
-                          seq_q_valid=seq_q_valid, window=window)
+                          seq_q_valid=seq_q_valid, window=window,
+                          qseg=qseg_blk, kseg=kseg_blk)
         s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)                          # (Bq, Bk)
         dv_new = dv_acc + jax.lax.dot_general(
@@ -515,19 +612,22 @@ def _flash_bwd_prep(q, o, g, block_q: int, Hkv: int):
 
 def _flash_backward(q, k, v, o, lse, g, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool,
-                    offsets=None, window: int | None = None):
+                    offsets=None, window: int | None = None,
+                    segment_ids=None, kv_segment_ids=None):
     qt, got, delta = _flash_bwd_prep(q, o, g, block_q, k.shape[2])
     return _flash_backward_folded(
         qt, got, delta, lse, k, v, B=q.shape[0], Sq=q.shape[1],
         q_dtype=q.dtype, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        offsets=offsets, window=window)
+        offsets=offsets, window=window, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids)
 
 
 def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
                            q_dtype, causal: bool, scale: float,
                            block_q: int, block_k: int, interpret: bool,
-                           offsets=None, window: int | None = None):
+                           offsets=None, window: int | None = None,
+                           segment_ids=None, kv_segment_ids=None):
     """The two backward pallas_calls over pre-folded q/dO/delta (see
     :func:`_flash_bwd_prep`); k/v arrive raw (B, Sk, Hkv, D) and stay
     at Hkv heads throughout — the dK/dV kernel's contractions sum the
@@ -543,11 +643,48 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
     kt = _fold_heads(k, Sk_pad)           # (B*Hkv, Sk_pad, D)
     vt = _fold_heads(v, Sk_pad)
     offs = _offsets_array(offsets)
+    has_seg = segment_ids is not None
+    if has_seg:
+        qseg, kseg = _seg_planes(segment_ids, kv_segment_ids,
+                                 Sq_pad, Sk_pad)
 
-    dq_kernel = functools.partial(
+    dq_base = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, seq_k=Sk_pad,
         seq_k_valid=Sk, causal=causal, scale=scale, block_q=block_q,
         window=window)
+
+    def dq_kernel(offs_ref, *refs):
+        if has_seg:
+            (q_r, k_r, v_r, do_r, l_r, d_r, qs_r, ks_r, dq_r) = refs
+            dq_base(offs_ref, q_r, k_r, v_r, do_r, l_r, d_r, dq_r,
+                    qseg_ref=qs_r, kseg_ref=ks_r)
+        else:
+            (q_r, k_r, v_r, do_r, l_r, d_r, dq_r) = refs
+            dq_base(offs_ref, q_r, k_r, v_r, do_r, l_r, d_r, dq_r)
+
+    dq_in_specs = [
+        pl.BlockSpec((1, group, block_q, D),
+                     lambda bh, qb, offs: (bh, 0, qb, 0)),  # q
+        pl.BlockSpec((1, Sk_pad, D),
+                     lambda bh, qb, offs: (bh, 0, 0)),      # k
+        pl.BlockSpec((1, Sk_pad, D),
+                     lambda bh, qb, offs: (bh, 0, 0)),      # v
+        pl.BlockSpec((1, group, block_q, D),
+                     lambda bh, qb, offs: (bh, 0, qb, 0)),  # dO
+        pl.BlockSpec((1, group, block_q),
+                     lambda bh, qb, offs: (bh, 0, qb)),     # lse
+        pl.BlockSpec((1, group, block_q),
+                     lambda bh, qb, offs: (bh, 0, qb)),     # dta
+    ]
+    dq_args = [qt, kt, vt, got, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qb, offs: (bh // Hkv, qb, 0)),
+            pl.BlockSpec((1, 1, Sk_pad),
+                         lambda bh, qb, offs: (bh // Hkv, 0, 0)),
+        ]
+        dq_args += [qseg, kseg]
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((B * Hkv, group, Sq_pad, D),
@@ -555,30 +692,58 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B * Hkv, Sq_pad // block_q),
-            in_specs=[
-                pl.BlockSpec((1, group, block_q, D),
-                             lambda bh, qb, offs: (bh, 0, qb, 0)),  # q
-                pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),      # k
-                pl.BlockSpec((1, Sk_pad, D),
-                             lambda bh, qb, offs: (bh, 0, 0)),      # v
-                pl.BlockSpec((1, group, block_q, D),
-                             lambda bh, qb, offs: (bh, 0, qb, 0)),  # dO
-                pl.BlockSpec((1, group, block_q),
-                             lambda bh, qb, offs: (bh, 0, qb)),     # lse
-                pl.BlockSpec((1, group, block_q),
-                             lambda bh, qb, offs: (bh, 0, qb)),     # dta
-            ],
+            in_specs=dq_in_specs,
             out_specs=pl.BlockSpec((1, group, block_q, D),
                                    lambda bh, qb, offs: (bh, 0, qb, 0)),
         ),
         interpret=interpret,
-    )(offs, qt, kt, vt, got, lse, delta)
+    )(offs, *dq_args)
 
-    dkv_kernel = functools.partial(
+    dkv_base = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=Sq_pad,
         seq_q_valid=Sq, seq_k_valid=Sk, causal=causal, scale=scale,
         block_k=block_k, group=group, window=window)
+
+    def dkv_kernel(offs_ref, *refs):
+        if has_seg:
+            (k_r, v_r, q_r, do_r, l_r, d_r, qs_r, ks_r,
+             dk_r, dv_r, dk_s, dv_s) = refs
+            dkv_base(offs_ref, k_r, v_r, q_r, do_r, l_r, d_r,
+                     dk_r, dv_r, dk_s, dv_s,
+                     qseg_ref=qs_r, kseg_ref=ks_r)
+        else:
+            (k_r, v_r, q_r, do_r, l_r, d_r,
+             dk_r, dv_r, dk_s, dv_s) = refs
+            dkv_base(offs_ref, k_r, v_r, q_r, do_r, l_r, d_r,
+                     dk_r, dv_r, dk_s, dv_s)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_k, D),
+                     lambda bh, kb, g, offs: (bh, kb, 0)),   # k
+        pl.BlockSpec((1, block_k, D),
+                     lambda bh, kb, g, offs: (bh, kb, 0)),   # v
+        pl.BlockSpec((1, 1, Sq_pad, D),
+                     lambda bh, kb, g, offs: (bh, g, 0, 0)),  # q
+        pl.BlockSpec((1, 1, Sq_pad, D),
+                     lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dO
+        # lse/delta get a trailing unit dim so the last two
+        # block dims (Sq_pad, 1) equal the array dims — the
+        # (1, 1, Sq_pad) layout fails Mosaic's block-shape
+        # rule whenever group is not 1 or a multiple of 8.
+        pl.BlockSpec((1, 1, Sq_pad, 1),
+                     lambda bh, kb, g, offs: (bh, g, 0, 0)),  # lse
+        pl.BlockSpec((1, 1, Sq_pad, 1),
+                     lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dta
+    ]
+    dkv_args = [kt, vt, qt, got, lse[..., None], delta[..., None]]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, Sq_pad, 1),
+                         lambda bh, kb, g, offs: (bh // Hkv, 0, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, kb, g, offs: (bh // Hkv, 0, kb)),
+        ]
+        dkv_args += [qseg, kseg]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
@@ -591,24 +756,7 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
             # accumulators carry this k-block's dk/dv across the
             # group's heads; q/dO stage one (Sq_pad, D) plane at a time.
             grid=(B * Hkv, Sk_pad // block_k, group),
-            in_specs=[
-                pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, g, offs: (bh, kb, 0)),   # k
-                pl.BlockSpec((1, block_k, D),
-                             lambda bh, kb, g, offs: (bh, kb, 0)),   # v
-                pl.BlockSpec((1, 1, Sq_pad, D),
-                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # q
-                pl.BlockSpec((1, 1, Sq_pad, D),
-                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dO
-                # lse/delta get a trailing unit dim so the last two
-                # block dims (Sq_pad, 1) equal the array dims — the
-                # (1, 1, Sq_pad) layout fails Mosaic's block-shape
-                # rule whenever group is not 1 or a multiple of 8.
-                pl.BlockSpec((1, 1, Sq_pad, 1),
-                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # lse
-                pl.BlockSpec((1, 1, Sq_pad, 1),
-                             lambda bh, kb, g, offs: (bh, g, 0, 0)),  # dta
-            ],
+            in_specs=dkv_in_specs,
             out_specs=[
                 pl.BlockSpec((1, block_k, D),
                              lambda bh, kb, g, offs: (bh, kb, 0)),
@@ -621,7 +769,7 @@ def _flash_backward_folded(qt, got, delta, lse, k, v, *, B: int, Sq: int,
             ],
         ),
         interpret=interpret,
-    )(offs, kt, vt, qt, got, lse[..., None], delta[..., None])
+    )(offs, *dkv_args)
 
     dq = _unfold_q_gqa(dq, B, Hkv, Sq)
     dk = _unfold_heads(dk, B, Hkv, Sk)
@@ -637,12 +785,17 @@ def flash_attention(q, k, v, causal: bool = True,
                     scale: float | None = None,
                     block_q: int | None = None,
                     block_k: int | None = None,
-                    window: int | None = None):
+                    window: int | None = None,
+                    segment_ids=None):
     """Flash attention: fused, O(S) memory forward.
 
     q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).  ``window``: sliding-window
     size (Mistral-style, causal only) — both passes prune k/q blocks
     outside the band, so compute is O(S * window) instead of O(S^2/2).
+    ``segment_ids`` (B, S) int: packed-document masking — queries
+    attend only keys in the same segment (requires Sq == Sk; compose
+    with causal for the standard packed-pretraining mask).  Both
+    backward kernels apply the identical mask.
     ``block_q``/``block_k`` default to the per-shape tuned table
     (:data:`TUNED_BLOCKS`, measured by ``tune_flash.py`` on a live
     chip) falling back to 128.  On non-TPU backends the Pallas kernel
@@ -650,7 +803,7 @@ def flash_attention(q, k, v, causal: bool = True,
     same code path everywhere.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                      window)[0]
+                      window, segment_ids)[0]
 
 
 def _resolved_scale(scale, D):
@@ -679,8 +832,12 @@ def _block_sizes(block_q, block_k, Sq, Sk, D=None, group=None):
     return min(block_q, Sq), min(block_k, Sk)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
+               segment_ids=None):
     check_window(window, causal)
+    if segment_ids is not None and q.shape[1] != k.shape[1]:
+        raise ValueError("segment_ids requires Sq == Sk (packed "
+                         "self-attention)")
     D = q.shape[-1]
     bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1], D,
                           q.shape[2] // k.shape[2])
@@ -688,21 +845,29 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
                               scale=_resolved_scale(scale, D),
                               block_q=bq, block_k=bk,
                               interpret=_use_interpret(),
-                              window=window)
-    return out, (q, k, v, out, lse)
+                              window=window, segment_ids=segment_ids,
+                              kv_segment_ids=segment_ids)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, window, residuals, g):
     """Blockwise Pallas backward: reconstructs each score block from
     the saved logsumexp, so no O(S^2) tensor exists in the backward
     either."""
-    q, k, v, out, lse = residuals
+    q, k, v, out, lse, segment_ids = residuals
     bq, bk = _block_sizes(block_q, block_k, q.shape[1], k.shape[1],
                           q.shape[-1], q.shape[2] // k.shape[2])
-    return _flash_backward(q, k, v, out, lse, g, causal=causal,
-                           scale=_resolved_scale(scale, q.shape[-1]),
-                           block_q=bq, block_k=bk,
-                           interpret=_use_interpret(), window=window)
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, g, causal=causal,
+        scale=_resolved_scale(scale, q.shape[-1]),
+        block_q=bq, block_k=bk,
+        interpret=_use_interpret(), window=window,
+        segment_ids=segment_ids, kv_segment_ids=segment_ids)
+    if segment_ids is None:
+        return dq, dk, dv, None
+    # Integer primal: its cotangent is the symbolic-zero float0.
+    dseg = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

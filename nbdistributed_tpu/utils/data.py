@@ -164,7 +164,8 @@ def interleave_shards(shards: Sequence[dict[str, Any]]) -> dict[str, Any]:
 
 def pack_tokens(docs: Sequence[Sequence[int]], seq_len: int, *,
                 eos_id: int | None = None,
-                drop_remainder: bool = True) -> np.ndarray:
+                drop_remainder: bool = True,
+                return_segments: bool = False):
     """Pack variable-length token documents into fixed (N, seq_len)
     windows — the standard LM-pretraining prep: concatenate all docs
     (optionally ``eos_id``-separated) and chunk the stream.
@@ -175,16 +176,30 @@ def pack_tokens(docs: Sequence[Sequence[int]], seq_len: int, *,
     ``seq_len = model_S`` straight into the logits-shift loss
     (``models.transformer.loss_fn`` predicts positions 1..S-1 from
     0..S-2 — no +1 fencepost to manage).
+
+    ``return_segments=True`` additionally returns per-window document
+    ids (N, seq_len) int32 (global doc index; eos separators belong to
+    the document they end, trailing padding to the final one) — feed
+    them as ``batch["segments"]`` so attention masks across documents,
+    RoPE restarts per document, and boundary targets drop from the
+    loss; without them packed windows silently leak attention across
+    documents.
     """
     if seq_len < 2:
         raise ValueError(f"seq_len must be >= 2, got {seq_len}")
     parts: list[np.ndarray] = []
-    for d in docs:
-        parts.append(np.asarray(d, np.int32).ravel())
+    seg_parts: list[np.ndarray] = []
+    for i, d in enumerate(docs):
+        arr = np.asarray(d, np.int32).ravel()
+        n = len(arr) + (1 if eos_id is not None else 0)
+        parts.append(arr)
         if eos_id is not None:
             parts.append(np.asarray([eos_id], np.int32))
+        seg_parts.append(np.full((n,), i, np.int32))
     stream = (np.concatenate(parts) if parts
               else np.zeros((0,), np.int32))
+    segs = (np.concatenate(seg_parts) if seg_parts
+            else np.zeros((0,), np.int32))
     n_full, tail = divmod(len(stream), seq_len)
     if tail and not drop_remainder:
         if eos_id is None:
@@ -193,5 +208,10 @@ def pack_tokens(docs: Sequence[Sequence[int]], seq_len: int, *,
                 "trailing window")
         pad = np.full((seq_len - tail,), eos_id, np.int32)
         stream = np.concatenate([stream, pad])
+        segs = np.concatenate(
+            [segs, np.full((seq_len - tail,), segs[-1], np.int32)])
         n_full += 1
-    return stream[: n_full * seq_len].reshape(n_full, seq_len)
+    windows = stream[: n_full * seq_len].reshape(n_full, seq_len)
+    if not return_segments:
+        return windows
+    return windows, segs[: n_full * seq_len].reshape(n_full, seq_len)

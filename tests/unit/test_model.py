@@ -324,3 +324,74 @@ def test_fsdp_sharding_matches_replicated():
 def test_hsdp_fsdp_plus_tp_matches_replicated():
     """2-D weight sharding (FSDP over dp x Megatron over tp)."""
     _run_fsdp_case({"dp": 2, "tp": 2}, "tp", optax.sgd(1e-2), 2, 3)
+
+
+def test_packed_documents_match_separate_forwards():
+    """The whole packed-training contract in one test: a window
+    holding two packed documents (segment mask + per-document RoPE
+    positions) must produce, at each document's positions, EXACTLY
+    the logits of forwarding that document alone — and the packed
+    loss must equal the token-weighted mix of the per-document
+    losses."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nbdistributed_tpu.models import (forward, init_params, loss_fn,
+                                          packed_positions, tiny_config)
+    from nbdistributed_tpu.models.transformer import shifted_xent
+
+    for use_flash in (False, True):
+        cfg = tiny_config(dtype=jnp.float32, use_flash=use_flash)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        la, lb = 20, 12
+        d0 = jax.random.randint(jax.random.PRNGKey(1), (1, la), 0,
+                                cfg.vocab_size)
+        d1 = jax.random.randint(jax.random.PRNGKey(2), (1, lb), 0,
+                                cfg.vocab_size)
+        packed = jnp.concatenate([d0, d1], axis=1)
+        seg = jnp.concatenate([jnp.zeros((1, la), jnp.int32),
+                               jnp.ones((1, lb), jnp.int32)], axis=1)
+        pos = packed_positions(seg)
+        np.testing.assert_array_equal(
+            np.asarray(pos[0]),
+            np.concatenate([np.arange(la), np.arange(lb)]))
+
+        lp = forward(params, packed, cfg, pos, segment_ids=seg)
+        l0 = forward(params, d0, cfg)
+        l1 = forward(params, d1, cfg)
+        np.testing.assert_allclose(np.asarray(lp[:, :la]),
+                                   np.asarray(l0), atol=2e-5,
+                                   rtol=2e-5,
+                                   err_msg=f"doc0 flash={use_flash}")
+        np.testing.assert_allclose(np.asarray(lp[:, la:]),
+                                   np.asarray(l1), atol=2e-5,
+                                   rtol=2e-5,
+                                   err_msg=f"doc1 flash={use_flash}")
+
+        # Packed loss == token-weighted mean of the per-doc losses
+        # (the boundary target is excluded, so the target counts are
+        # (la-1) and (lb-1)).
+        packed_loss = float(loss_fn(params, {"tokens": packed,
+                                             "segments": seg}, cfg))
+        per0 = float(shifted_xent(l0, d0))
+        per1 = float(shifted_xent(l1, d1))
+        mix = (per0 * (la - 1) + per1 * (lb - 1)) / (la + lb - 2)
+        np.testing.assert_allclose(packed_loss, mix, rtol=1e-5)
+
+
+def test_pack_tokens_segments_roundtrip():
+    from nbdistributed_tpu.utils.data import pack_tokens
+
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    win, seg = pack_tokens(docs, 5, eos_id=0, return_segments=True)
+    assert win.shape == seg.shape == (2, 5)
+    np.testing.assert_array_equal(win[0], [1, 2, 3, 0, 4])
+    np.testing.assert_array_equal(seg[0], [0, 0, 0, 0, 1])
+    np.testing.assert_array_equal(win[1], [5, 0, 6, 7, 8])
+    np.testing.assert_array_equal(seg[1], [1, 1, 2, 2, 2])
+    # Padded trailing window inherits the final doc's segment.
+    win2, seg2 = pack_tokens(docs, 4, eos_id=0, drop_remainder=False,
+                             return_segments=True)
+    assert win2.shape == seg2.shape == (3, 4)
+    np.testing.assert_array_equal(seg2[-1], [2, 2, 2, 2])

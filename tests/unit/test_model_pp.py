@@ -159,3 +159,35 @@ def test_pp_batch_divisibility(setup):
     bad = {"tokens": jnp.zeros((3, 16), jnp.int32)}
     with pytest.raises(ValueError, match="microbatches"):
         pp_loss_fn(pp, bad, cfg, mesh)
+
+
+def test_pp_losses_reject_packed_segments():
+    """The pipelined losses do not plumb segment ids; they must fail
+    loudly rather than silently leak attention across documents."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from nbdistributed_tpu.models import (init_params,
+                                          make_pp_1f1b_train_step,
+                                          pp_apply_shardings, pp_loss_fn,
+                                          pp_stage_params, tiny_config)
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+
+    cfg = dataclasses.replace(tiny_config(dtype=jnp.float32,
+                                          use_flash=False), n_layers=2)
+    mesh = mesh_mod.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    p = pp_apply_shardings(
+        pp_stage_params(init_params(jax.random.PRNGKey(0), cfg), 2),
+        mesh)
+    tok = jnp.zeros((2, 16), jnp.int32)
+    batch = {"tokens": tok, "segments": jnp.zeros_like(tok)}
+    with pytest.raises(ValueError, match="segments"):
+        pp_loss_fn(p, batch, cfg, mesh)
+    opt = optax.sgd(1e-2)
+    step = make_pp_1f1b_train_step(cfg, opt, mesh)
+    with pytest.raises(ValueError, match="segments"):
+        step(p, opt.init(p), batch)
