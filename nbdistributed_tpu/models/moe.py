@@ -125,13 +125,17 @@ def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str,
 
 
 def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
-                ep_axis: str = "ep", positions=None, sp=None):
+                ep_axis: str = "ep", positions=None, sp=None,
+                segment_ids=None):
     """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar).
 
     ``sp`` (a ``transformer.SeqParallel``) routes attention through
     ring/Ulysses sequence parallelism, exactly as in the dense family —
     the MoE dispatch is token-wise, so GSPMD keeps it sequence-sharded
-    for free.  Composes with ``mesh``/``ep_axis`` expert placement."""
+    for free.  Composes with ``mesh``/``ep_axis`` expert placement.
+    ``segment_ids``: packed-document attention masking (the attention
+    stack is shared with the dense family); expert dispatch is
+    unaffected — every real token routes regardless of its document."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -139,7 +143,8 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
 
     def layer_step(carry, layer):
         x, aux = carry
-        x = _attention_block(x, layer, cfg, positions, sp)
+        x = _attention_block(x, layer, cfg, positions, sp,
+                             segment_ids)
         x, layer_aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
         return (x, aux + layer_aux), None
 
@@ -163,8 +168,18 @@ def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
     like any others.  The load-balance *aux* term is never bit-equal —
     it now averages router stats over T = B*S tokens instead of
     B*(S-1) (and capacity itself scales with T) — a deliberate, tiny
-    objective change, not an oversight."""
+    objective change, not an oversight.
+
+    ``batch["segments"]`` engages the packed-document contract as in
+    the dense family: cross-document attention masked, per-document
+    RoPE restart, boundary targets dropped."""
+    from .transformer import packed_positions
+
     tokens = batch["tokens"]
+    seg = batch.get("segments") if isinstance(batch, dict) else None
+    positions = packed_positions(seg) if seg is not None else None
     logits, aux = moe_forward(params, tokens, cfg, mesh=mesh,
-                              ep_axis=ep_axis, sp=sp)
-    return shifted_xent(logits, tokens) + cfg.lb_coef * aux
+                              ep_axis=ep_axis, positions=positions,
+                              sp=sp, segment_ids=seg)
+    return (shifted_xent(logits, tokens, segment_ids=seg)
+            + cfg.lb_coef * aux)

@@ -371,3 +371,23 @@ class TestSegmentIds:
         b = flash_attention(q, k, v, True, None, 32, 32,
                             segment_ids=seg_neg)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_segments_compose_with_sliding_window(self):
+        """window AND segment masks AND together: both kernel passes
+        must match the reference with both constraints active."""
+        q, k, v, seg = self._inputs(S=96)
+        W = 24
+        out = flash_attention(q, k, v, True, None, 32, 32, W,
+                              segment_ids=seg)
+        ref = attention_reference(q, k, v, causal=True, window=W,
+                                  segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        g = jax.grad(lambda q_: jnp.sum(flash_attention(
+            q_, k, v, True, None, 32, 32, W,
+            segment_ids=seg) ** 2))(q)
+        gr = jax.grad(lambda q_: jnp.sum(attention_reference(
+            q_, k, v, causal=True, window=W,
+            segment_ids=seg) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   atol=1e-4, rtol=1e-4)

@@ -345,3 +345,41 @@ def test_moe_seq_parallel_matches_plain():
     l = float(moe_loss_fn(mp, {"tokens": tok_s}, mcfg, mesh=mesh,
                           sp=sp))
     assert np.isfinite(l)
+
+
+def test_moe_packed_documents_match_separate_forwards():
+    """Packed-document contract for the MoE family: at LOSSLESS expert
+    capacity (so packed-vs-solo capacity differences cannot drop
+    tokens) a packed window's logits equal each document forwarded
+    alone, and moe_loss_fn consumes batch["segments"]."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nbdistributed_tpu.models import (init_moe_model, moe_forward,
+                                          moe_loss_fn, packed_positions,
+                                          tiny_moe_config)
+
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          capacity_factor=2.0)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    la, lb = 14, 10
+    d0 = jax.random.randint(jax.random.PRNGKey(1), (1, la), 0,
+                            cfg.vocab_size)
+    d1 = jax.random.randint(jax.random.PRNGKey(2), (1, lb), 0,
+                            cfg.vocab_size)
+    packed = jnp.concatenate([d0, d1], axis=1)
+    seg = jnp.concatenate([jnp.zeros((1, la), jnp.int32),
+                           jnp.ones((1, lb), jnp.int32)], axis=1)
+    lp, _ = moe_forward(params, packed, cfg,
+                        positions=packed_positions(seg),
+                        segment_ids=seg)
+    l0, _ = moe_forward(params, d0, cfg)
+    l1, _ = moe_forward(params, d1, cfg)
+    np.testing.assert_allclose(np.asarray(lp[:, :la]), np.asarray(l0),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lp[:, la:]), np.asarray(l1),
+                               atol=2e-5, rtol=2e-5)
+    loss = float(moe_loss_fn(params, {"tokens": packed,
+                                      "segments": seg}, cfg))
+    assert np.isfinite(loss)
