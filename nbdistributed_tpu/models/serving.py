@@ -32,6 +32,17 @@ the KV-cache machinery was built to support.  Design:
   never consume capacity slots and evict real prompt tokens, and the
   lm_head runs only at the last real position (``last_index``).
 
+**Speculative serving** (``draft_params``/``draft_cfg``/``gamma``):
+every step runs one draft-propose / target-verify round
+(:func:`~.speculative.spec_round`) — the draft proposes ``gamma``
+tokens per slot, ONE batched target forward verifies every slot's
+candidates, and each active request emits its accepted prefix + the
+correction/bonus token (1..gamma+1 tokens per step, diverging freely
+per slot).  Greedy speculative serving reproduces the target's own
+greedy decode per request — the draft only affects speed.  Budget
+and EOS cut a stream mid-round by truncating its emission; the
+slot's stale device state dies with the slot.
+
 Greedy serving is bit-identical per request to a standalone
 :func:`~.generate.generate` call (asserted in the tests): admission
 order, batch occupancy, and other requests' traffic cannot change any
@@ -64,7 +75,8 @@ class DecodeServer:
         srv = DecodeServer(params, cfg, max_batch=8, max_len=512)
         rid = srv.submit([1, 2, 3], max_new_tokens=16)
         while not srv.done():
-            srv.step()          # emits one token per active request
+            srv.step()   # plain: 1 token per active request;
+                         # speculative mode: 1..gamma+1 per request
         tokens = srv.outputs[rid]
     """
 
@@ -73,11 +85,26 @@ class DecodeServer:
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, eos_id: int | None = None,
                  kv_quantized: bool = False, mesh=None,
-                 ep_axis: str = "ep", pad_to: int = 64, key=None):
+                 ep_axis: str = "ep", pad_to: int = 64, key=None,
+                 draft_params=None, draft_cfg=None, gamma: int = 4):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_to < 1:
             raise ValueError(f"pad_to must be >= 1, got {pad_to}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("pass both draft_params and draft_cfg, "
+                             "or neither")
+        if draft_cfg is not None:
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("target and draft must share a "
+                                 "vocabulary")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            if top_k is not None or top_p is not None:
+                raise ValueError("speculative serving supports plain "
+                                 "temperature sampling only (the "
+                                 "acceptance rule is defined on the "
+                                 "untruncated distributions)")
         from .moe import MoEConfig
         if isinstance(cfg, MoEConfig):
             # Expert capacity is computed from the *static* token count
@@ -107,6 +134,20 @@ class DecodeServer:
         self._last = jnp.zeros((max_batch,), jnp.int32)
         self._active = jnp.zeros((max_batch,), bool)
 
+        # Speculative mode: a draft model proposes gamma tokens per
+        # step, the target verifies them in ONE batched forward —
+        # every step emits 1..gamma+1 tokens per active slot.
+        self._draft_params = draft_params
+        self._draft_cfg = draft_cfg
+        self._gamma = gamma
+        if draft_cfg is not None:
+            self._cache_d = init_kv_cache(draft_cfg, max_batch,
+                                          max_len, mesh=mesh,
+                                          quantized=kv_quantized)
+            self._lens_d = jnp.zeros((max_batch,), jnp.int32)
+            self._prefill_d = self._make_prefill(draft_cfg)
+            self._spec_fn = self._jit_spec_step()
+
         # Host-side bookkeeping.
         self._free = list(range(max_batch))
         self._slot_req: dict[int, int] = {}      # slot -> request id
@@ -122,8 +163,9 @@ class DecodeServer:
 
     # ---- jitted programs -------------------------------------------------
 
-    def _make_prefill(self):
-        cfg, mesh, ep_axis = self._cfg, self._mesh, self._ep_axis
+    def _make_prefill(self, cfg=None):
+        cfg = cfg if cfg is not None else self._cfg
+        mesh, ep_axis = self._mesh, self._ep_axis
 
         def fn(params, cache, prompt, slot, length):
             """prompt (1, s_pad) right-padded; writes the slot's cache
@@ -174,6 +216,26 @@ class DecodeServer:
         # Donated cache: the decode step rewrites the pool in place.
         return jax.jit(self._make_step(), donate_argnums=(1,))
 
+    def _jit_spec_step(self):
+        from .speculative import spec_round
+
+        cfg, dcfg = self._cfg, self._draft_cfg
+        gamma, temperature = self._gamma, self._temperature
+
+        def fn(params, draft_params, cache_t, lens_t, cache_d, lens_d,
+               last, active, key):
+            (cache_t, lens_t, cache_d, lens_d, key, cand, n_acc,
+             new_last) = spec_round(
+                params, draft_params, cfg, dcfg, gamma=gamma,
+                temperature=temperature, cache_t=cache_t,
+                len_t=lens_t, cache_d=cache_d, len_d=lens_d,
+                last_tok=last, key=key, active=active)
+            return cache_t, lens_t, cache_d, lens_d, cand, n_acc, \
+                new_last
+
+        # Both cache pools donated (updated in place each round).
+        return jax.jit(fn, donate_argnums=(2, 4))
+
     # ---- host-side API ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int) -> int:
@@ -185,10 +247,18 @@ class DecodeServer:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
-        if len(prompt) + max_new_tokens > self._T:
+        need = len(prompt) + max_new_tokens
+        if self._draft_cfg is not None:
+            # A final speculative round can write up to gamma + 1
+            # cache slots past the budget before the slot finishes.
+            need += self._gamma + 1
+        if need > self._T:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_len {self._T}")
+                f"({max_new_tokens})"
+                + (f" + speculative headroom ({self._gamma + 1})"
+                   if self._draft_cfg is not None else "")
+                + f" exceeds max_len {self._T}")
         rid = self._next_id
         self._next_id += 1
         self.prompts[rid] = prompt
@@ -223,6 +293,13 @@ class DecodeServer:
             self.outputs[rid].append(tok)
             self._lens = self._lens.at[slot].set(len(prompt))
             self._last = self._last.at[slot].set(tok)
+            if self._draft_cfg is not None:
+                # Draft cache prefills the same prompt (its seed
+                # logits are discarded — the target seeds the stream).
+                self._cache_d, _ = self._prefill_d(
+                    self._draft_params, self._cache_d, padded,
+                    jnp.int32(slot), jnp.int32(len(prompt)))
+                self._lens_d = self._lens_d.at[slot].set(len(prompt))
             done = (budget == 1
                     or (self._eos is not None and tok == self._eos))
             if done:
@@ -239,25 +316,59 @@ class DecodeServer:
         self._active = self._active.at[slot].set(False)
         self._free.append(slot)
 
-    def step(self) -> dict[int, int]:
+    def step(self) -> dict[int, list[int]]:
         """One decode step for every active slot; returns
-        {request_id: emitted token}.  Admits pending requests first."""
+        {request_id: tokens emitted this step} — one token per step in
+        plain mode, 1..gamma+1 in speculative mode.  Admits pending
+        requests first."""
         self._admit_pending()
         if not self._slot_req:
             return {}
+        if self._draft_cfg is not None:
+            return self._spec_step()
         self._cache, self._lens, nxt = self._step_fn(
             self._params, self._cache, self._lens, self._last,
             self._active, self._sample_key())
         self._last = nxt
         toks = jax.device_get(nxt)
-        emitted: dict[int, int] = {}
+        emitted: dict[int, list[int]] = {}
         for slot, rid in list(self._slot_req.items()):
             tok = int(toks[slot])
             self.outputs[rid].append(tok)
-            emitted[rid] = tok
+            emitted[rid] = [tok]
             self._budget[rid] -= 1
             if (self._budget[rid] == 0
                     or (self._eos is not None and tok == self._eos)):
+                self._finish(slot, rid)
+        self._admit_pending()
+        return emitted
+
+    def _spec_step(self) -> dict[int, list[int]]:
+        """One speculative round: draft proposes gamma tokens per
+        slot, ONE target forward verifies all slots' candidates.
+        Per-slot acceptance lengths diverge freely; budget/EOS cut a
+        stream mid-round by truncating its emission and finishing the
+        slot (its device-side cache state beyond the cut is stale but
+        dies with the slot — re-admission prefills from 0)."""
+        (self._cache, self._lens, self._cache_d, self._lens_d,
+         cand, n_acc, new_last) = self._spec_fn(
+            self._params, self._draft_params, self._cache, self._lens,
+            self._cache_d, self._lens_d, self._last, self._active,
+            self._sample_key())
+        self._last = new_last
+        cand_h, acc_h = jax.device_get((cand, n_acc))
+        emitted: dict[int, list[int]] = {}
+        for slot, rid in list(self._slot_req.items()):
+            toks = [int(t) for t in cand_h[slot][: int(acc_h[slot]) + 1]]
+            toks = toks[: self._budget[rid]]
+            if self._eos is not None and self._eos in toks:
+                toks = toks[: toks.index(self._eos) + 1]
+            self.outputs[rid].extend(toks)
+            emitted[rid] = toks
+            self._budget[rid] -= len(toks)
+            if (self._budget[rid] == 0
+                    or (self._eos is not None and toks
+                        and toks[-1] == self._eos)):
                 self._finish(slot, rid)
         self._admit_pending()
         return emitted

@@ -64,6 +64,75 @@ def _greedy_tok(logits):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
+               temperature: float, cache_t, len_t, cache_d, len_d,
+               last_tok, key, active):
+    """ONE draft-propose / target-verify round for B streams — the
+    engine shared by :func:`speculative_generate`'s closed loop and
+    the continuous-batching server's speculative mode.
+
+    State contract (the lag-one cache discipline): both caches hold
+    exactly the committed tokens' K/V below their pointers, and
+    ``last_tok`` is the newest committed token, NOT yet written to
+    either cache — each model re-feeds it first, which is why both
+    pointers advance by ``n_acc + 1``.
+
+    Returns ``(cache_t, len_t, cache_d, len_d, key, cand, n_acc,
+    new_last)``: ``cand`` (B, gamma+1) holds each row's candidate
+    tokens (accepted prefix + correction/bonus at index ``n_acc``;
+    later entries stale), ``n_acc`` (B,) the accepted draft counts,
+    ``new_last`` the per-row newest committed token.  Rows with
+    ``active=False`` freeze: pointers do not advance (callers mask),
+    and ``row_mask`` keeps them out of MoE expert capacity.
+    """
+    B = last_tok.shape[0]
+
+    def draft_step(carry, i):
+        cache_d, len_d, tok, key = carry
+        lg, cache_d = forward_with_cache(
+            draft_params, tok[:, None], cache_d, len_d, draft_cfg,
+            row_mask=active)
+        key, ks = jax.random.split(key)
+        nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
+        return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
+
+    (cache_d, _, _, key), (drafts, draft_logits) = \
+        jax.lax.scan(draft_step, (cache_d, len_d, last_tok, key),
+                     jnp.arange(gamma))
+    # drafts: (gamma, B) int32; draft_logits: (gamma, B, V)
+    # The scan wrote K/V for [newest, d_1..d_{gamma-1}] — d_gamma's
+    # K/V is still missing, and the n_acc == gamma round needs it
+    # (the pointer then advances past its slot).  One more write
+    # (logits discarded) keeps the lag-one invariant for every
+    # n_acc; the slot is stale-and-masked when d_gamma is rejected.
+    _, cache_d = forward_with_cache(
+        draft_params, drafts[-1][:, None], cache_d,
+        len_d + gamma, draft_cfg, row_mask=active)
+
+    # --- target verifies the newest token + all proposals ------
+    # ONE forward shared by every stream: (B, gamma+1) — this
+    # batched verify is the speedup's engine room.
+    verify_in = jnp.concatenate([last_tok[:, None], drafts.T],
+                                axis=1)              # (B, g+1)
+    logits_v, cache_t = forward_with_cache(
+        params, verify_in, cache_t, len_t, cfg,
+        row_mask=active)                             # (B, g+1, V)
+
+    key, kacc, kfix = jax.random.split(key, 3)
+    n_acc, next_tok = jax.vmap(
+        _accept, in_axes=(1, 1, 0, None, 0, 0))(
+        drafts, draft_logits, logits_v, temperature,
+        jax.random.split(kacc, B), jax.random.split(kfix, B))
+
+    cand = jnp.concatenate(
+        [drafts.T, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    cand = cand.at[jnp.arange(B), n_acc].set(next_tok)
+    adv = jnp.where(active, n_acc + 1, 0)
+    new_last = jnp.where(active, next_tok, last_tok)
+    return (cache_t, len_t + adv, cache_d, len_d + adv, key, cand,
+            n_acc, new_last)
+
+
 def speculative_generate(params: dict, draft_params: dict,
                          prompt, cfg: TransformerConfig,
                          draft_cfg: TransformerConfig,
@@ -152,50 +221,14 @@ def speculative_generate(params: dict, draft_params: dict,
         pos_last = S0 + n - 1          # buffer index of newest token
         last_tok = jnp.take_along_axis(
             toks, pos_last[:, None], axis=1)[:, 0]       # (B,)
-
-        # --- draft proposes gamma tokens from its own cache --------
-        # Step i feeds the previous token, so the draft cache receives
-        # [newest, d_1..d_{gamma-1}] — it lags one token, exactly like
-        # the target's verify write pattern below, which is why both
-        # pointers advance by n_acc + 1.
         active = ~done  # frozen rows: no expert-capacity footprint
 
-        def draft_step(carry, i):
-            cache_d, len_d, tok, key = carry
-            lg, cache_d = forward_with_cache(
-                draft_params, tok[:, None], cache_d, len_d, draft_cfg,
-                row_mask=active)
-            key, ks = jax.random.split(key)
-            nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
-            return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
-
-        (cache_d, _, _, key), (drafts, draft_logits) = \
-            jax.lax.scan(draft_step, (cache_d, len_d, last_tok, key),
-                         jnp.arange(gamma))
-        # drafts: (gamma, B) int32; draft_logits: (gamma, B, V)
-        # The scan wrote K/V for [newest, d_1..d_{gamma-1}] — d_gamma's
-        # K/V is still missing, and the n_acc == gamma round needs it
-        # (the pointer then advances past its slot).  One more write
-        # (logits discarded) keeps the lag-one invariant for every
-        # n_acc; the slot is stale-and-masked when d_gamma is rejected.
-        _, cache_d = forward_with_cache(
-            draft_params, drafts[-1][:, None], cache_d,
-            len_d + gamma, draft_cfg, row_mask=active)
-
-        # --- target verifies the newest token + all proposals ------
-        # ONE forward shared by every stream: (B, gamma+1) — this
-        # batched verify is the speedup's engine room.
-        verify_in = jnp.concatenate([last_tok[:, None], drafts.T],
-                                    axis=1)              # (B, g+1)
-        logits_v, cache_t = forward_with_cache(
-            params, verify_in, cache_t, len_t, cfg,
-            row_mask=active)                             # (B, g+1, V)
-
-        key, kacc, kfix = jax.random.split(key, 3)
-        n_acc, next_tok = jax.vmap(
-            _accept, in_axes=(1, 1, 0, None, 0, 0))(
-            drafts, draft_logits, logits_v, temperature,
-            jax.random.split(kacc, B), jax.random.split(kfix, B))
+        (cache_t, len_t, cache_d, len_d, key, upd, n_acc, _) = \
+            spec_round(params, draft_params, cfg, draft_cfg,
+                       gamma=gamma, temperature=temperature,
+                       cache_t=cache_t, len_t=len_t, cache_d=cache_d,
+                       len_d=len_d, last_tok=last_tok, key=key,
+                       active=active)
 
         # --- commit ------------------------------------------------
         # Write all gamma+1 candidate slots per row; only the first
@@ -204,21 +237,11 @@ def speculative_generate(params: dict, draft_params: dict,
         # advance by 0; their (frozen-pointer) writes land at or past
         # S0 + max_new_tokens, outside the output slice — dynamic
         # slice clamping keeps even the overshoot case in that region.
-        upd = jnp.concatenate(
-            [drafts.T, jnp.zeros((B, 1), jnp.int32)], axis=1)
-        upd = upd.at[jnp.arange(B), n_acc].set(next_tok)
         toks = jax.vmap(
             lambda row, u, s: jax.lax.dynamic_update_slice(row, u,
                                                            (s,)))(
             toks, upd, pos_last + 1)
-        adv = jnp.where(done, 0, n_acc + 1)
-        n = n + adv
-        # Both caches now hold exactly the accepted tokens' K/V below
-        # the new pointers (each lags one token and re-feeds the
-        # newest token first); slots past the pointers are stale and
-        # position-masked until overwritten.
-        len_t = len_t + adv
-        len_d = len_d + adv
+        n = n + jnp.where(done, 0, n_acc + 1)
         acc_sum = acc_sum + jnp.sum(
             jnp.where(done, 0.0, n_acc.astype(jnp.float32)))
         active_rounds = active_rounds + jnp.sum(

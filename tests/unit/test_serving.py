@@ -210,3 +210,76 @@ def test_moe_family_serves():
     rid = srv.submit(prompt, n)
     srv.run_until_done(max_steps=50)
     assert srv.outputs[rid] == ref
+
+
+# ---------------------------------------------------------------------
+# speculative serving
+
+@pytest.fixture(scope="module")
+def spec_setup():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    target = init_params(jax.random.PRNGKey(0), cfg)
+    draft = init_params(jax.random.PRNGKey(42), cfg)  # a WORSE model
+    return cfg, target, draft
+
+
+def test_spec_serving_matches_solo_generate_staggered(spec_setup):
+    """Greedy speculative serving must reproduce the TARGET's own
+    greedy decode per request (the draft only affects speed), under
+    staggered admission into a 2-slot pool."""
+    cfg, target, draft = spec_setup
+    reqs = [([5, 9, 2], 9), ([7, 1, 3, 11], 6), ([2, 2], 7)]
+    srv = DecodeServer(target, cfg, max_batch=2, max_len=64, pad_to=4,
+                       draft_params=draft, draft_cfg=cfg, gamma=3)
+    r0 = srv.submit(*reqs[0])
+    srv.step()
+    r1 = srv.submit(*reqs[1])
+    srv.step()
+    r2 = srv.submit(*reqs[2])
+    srv.run_until_done(max_steps=100)
+    for rid, (prompt, n) in zip((r0, r1, r2), reqs):
+        assert srv.outputs[rid] == solo(target, cfg, prompt, n), rid
+        assert len(srv.outputs[rid]) == n
+
+
+def test_spec_serving_emits_multiple_tokens_per_step(spec_setup):
+    """A self-draft accepts everything: each round must emit
+    gamma + 1 tokens for the slot (the mechanics of batched verify)."""
+    cfg, target, _ = spec_setup
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=64, pad_to=4,
+                       draft_params=target, draft_cfg=cfg, gamma=3)
+    rid = srv.submit([5, 9, 2], 13)
+    out = srv.step()
+    assert out[rid] and len(out[rid]) == 4   # gamma + 1 accepted
+    srv.run_until_done(max_steps=20)
+    assert len(srv.outputs[rid]) == 13
+    assert srv.outputs[rid] == solo(target, cfg, [5, 9, 2], 13)
+
+
+def test_spec_serving_eos_cuts_mid_round(spec_setup):
+    cfg, target, draft = spec_setup
+    prompt, n = [5, 9, 2], 10
+    toks = solo(target, cfg, prompt, n)
+    eos = toks[4]
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=64, pad_to=4,
+                       eos_id=eos, draft_params=draft, draft_cfg=cfg,
+                       gamma=3)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    got = srv.outputs[rid]
+    assert got[-1] == eos
+    assert got == toks[: got.index(eos) + 1]
+
+
+def test_spec_serving_validation(spec_setup):
+    cfg, target, draft = spec_setup
+    with pytest.raises(ValueError, match="both draft_params"):
+        DecodeServer(target, cfg, max_batch=1, max_len=32,
+                     draft_params=draft)
+    with pytest.raises(ValueError, match="temperature sampling only"):
+        DecodeServer(target, cfg, max_batch=1, max_len=32, top_k=4,
+                     draft_params=draft, draft_cfg=cfg)
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=16, pad_to=4,
+                       draft_params=draft, draft_cfg=cfg, gamma=3)
+    with pytest.raises(ValueError, match="speculative headroom"):
+        srv.submit([1, 2, 3, 4], 9)   # 4 + 9 + 4 > 16
