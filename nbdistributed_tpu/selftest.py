@@ -163,13 +163,20 @@ _srv.run_until_done(max_steps=50)
 def _solo(pr, n):
     o = generate(_p, _jn.asarray(pr, _jn.int32)[None], _cfg, n)
     return [int(t) for t in _np.asarray(o)[0][len(pr):]]
+_dr = init_params(_j.random.PRNGKey(9), _cfg)
+_ssrv = DecodeServer(_p, _cfg, max_batch=2, max_len=32, pad_to=4,
+                     draft_params=_dr, draft_cfg=_cfg, gamma=2)
+_r2 = _ssrv.submit([5, 9, 2], 4)
+_ssrv.run_until_done(max_steps=20)
 (_srv.outputs[_r0] == _solo([5, 9, 2], 4),
- _srv.outputs[_r1] == _solo([7, 1], 3))
+ _srv.outputs[_r1] == _solo([7, 1], 3),
+ _ssrv.outputs[_r2] == _solo([5, 9, 2], 4))
 """
         r0 = comm.send_to_ranks([0], "execute", serve_cell,
-                                timeout=120)[0]
-        check("continuous-batching server (staggered == solo)",
-              r0.data.get("output") == "(True, True)",
+                                timeout=180)[0]
+        check("continuous-batching server (staggered + speculative "
+              "== solo)",
+              r0.data.get("output") == "(True, True, True)",
               repr(r0.data.get("error") or r0.data.get("output")))
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
