@@ -30,8 +30,11 @@ class MoEConfig(TransformerConfig):
     capacity_factor: float = 1.25
     lb_coef: float = 0.01
     # "dense" = one-hot dispatch einsums (O(T^2) in tokens, the
-    # oracle); "sparse" = sort/segment routing (linear in tokens) —
-    # see parallel/expert.moe_ffn for the FLOP accounting.
+    # oracle); "sparse" = sort/segment routing (linear in tokens,
+    # bit-identical drops); "dropless" = MegaBlocks-style ragged_dot
+    # grouped matmuls (no capacity buffer, no drops; not composable
+    # with an ep mesh axis yet) — see parallel/expert.moe_ffn for the
+    # FLOP accounting and semantics.
     moe_dispatch: str = "dense"
 
     def num_params(self) -> int:

@@ -137,6 +137,71 @@ def _expert_linear(xe, w, spec: str):
     return jnp.einsum(spec, xe, w)
 
 
+def _route_sort(expert_idx, E: int, token_mask=None):
+    """The ONE routing-sort prologue shared by the sparse and dropless
+    paths: flatten (T, k) choice-major (choice-major ordering is what
+    makes the Switch priority rule and mask semantics line up), relabel
+    masked tokens to the sentinel expert E (sorting past every real
+    segment), and stable-sort by expert.
+
+    Returns (order, e_sorted, tok, counts): the argsort, the sorted
+    expert ids, the source token id per sorted row, and the
+    ``bincount(length=E+1)`` including the sentinel bin."""
+    T, k = expert_idx.shape
+    flat_e = expert_idx.T.reshape(-1)             # choice-major (kT,)
+    if token_mask is not None:
+        flat_e = jnp.where(jnp.tile(token_mask, k), flat_e, E)
+    order = jnp.argsort(flat_e, stable=True)
+    e_sorted = flat_e[order]
+    counts = jnp.bincount(flat_e, length=E + 1)   # [..., masked bin]
+    return order, e_sorted, (order % T).astype(jnp.int32), counts
+
+
+def _ragged_expert_linear(xs, w, group_sizes, e_sorted):
+    """``ragged_dot`` over expert segments, supporting int8 weight-only
+    quantized leaves: the per-(expert, output-channel) scales become a
+    per-ROW rescale gathered by each row's expert id (constant along
+    the contraction dim, so the grouped dot still reads raw int8)."""
+    from ..models.transformer import is_quantized
+    if is_quantized(w):
+        y = jax.lax.ragged_dot(xs, w["q8"].astype(xs.dtype),
+                               group_sizes)
+        s_rows = w["s"][jnp.clip(e_sorted, 0, w["s"].shape[0] - 1), 0]
+        return (y.astype(jnp.float32) * s_rows).astype(xs.dtype)
+    return jax.lax.ragged_dot(xs, w.astype(xs.dtype), group_sizes)
+
+
+def _dropless_ffn(xt, params, gates, expert_idx, E: int,
+                  token_mask=None):
+    """MegaBlocks-style dropless expert compute: sort the (token,
+    choice) pairs by expert and run the SwiGLU as grouped matmuls over
+    the variable-size segments (``jax.lax.ragged_dot``) — every routed
+    token is computed, no capacity buffer exists, and compute is
+    exactly sum_e n_e GEMM rows (what the MXU would do with perfect
+    per-expert batching).
+
+    Masked tokens sort into a sentinel bin PAST every real segment
+    (group_sizes covers only real experts, so ragged_dot's uncovered
+    tail rows are zeros) and their gate weight is zeroed — both belts.
+    """
+    T, D = xt.shape
+    order, e_sorted, tok, counts = _route_sort(expert_idx, E,
+                                               token_mask)
+    keep = e_sorted < E
+    group_sizes = counts[:E].astype(jnp.int32)
+
+    xs = jnp.where(keep[:, None], xt[tok], 0)     # (kT, D)
+    h = (jax.nn.silu(_ragged_expert_linear(
+            xs, params["w_gate"], group_sizes, e_sorted))
+         * _ragged_expert_linear(xs, params["w_up"], group_sizes,
+                                 e_sorted))
+    rows = _ragged_expert_linear(h, params["w_down"], group_sizes,
+                                 e_sorted)        # (kT, D)
+    g_sorted = gates.T.reshape(-1)[order]
+    w = jnp.where(keep, g_sorted, 0.0).astype(xt.dtype)
+    return jnp.zeros((T, D), xt.dtype).at[tok].add(rows * w[:, None])
+
+
 def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
     """Sort/segment routing: the same Switch priority rule as
     :func:`make_dispatch` without materializing any (T, E, C) tensor.
@@ -153,18 +218,14 @@ def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
     (kT,) source token ids, ``keep`` (kT,) bool, and ``order`` (the
     argsort, for carrying gates along).
     """
-    T, k = expert_idx.shape
-    flat_e = expert_idx.T.reshape(-1)             # choice-major (kT,)
-    if token_mask is not None:
-        flat_e = jnp.where(jnp.tile(token_mask, k), flat_e, E)
-    order = jnp.argsort(flat_e, stable=True)
-    e_sorted = flat_e[order]
-    counts = jnp.bincount(flat_e, length=E + 1)   # [..., masked bin]
+    order, e_sorted, tok, counts = _route_sort(expert_idx, E,
+                                               token_mask)
+    k, T = expert_idx.shape[1], expert_idx.shape[0]
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(k * T, dtype=jnp.int32) - starts[e_sorted]
     keep = (pos < C) & (e_sorted < E)
     slot = jnp.where(keep, e_sorted * C + pos, E * C).astype(jnp.int32)
-    return slot, (order % T).astype(jnp.int32), keep, order
+    return slot, tok, keep, order
 
 
 def moe_ffn(x, params: dict, *, top_k: int = 2,
@@ -196,6 +257,18 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
       token count**, no T×E×C tensor anywhere.  Same shardings
       constrained under a mesh.
 
+    * ``"dropless"`` — MegaBlocks-style: no capacity buffer at all.
+      Tokens sort by expert and the SwiGLU runs as three
+      ``jax.lax.ragged_dot`` grouped matmuls over the variable-size
+      expert segments — every token reaches every expert it routed
+      to, so there are NO drops and ``capacity_factor``/``capacity``
+      are ignored.  Equals the dense oracle whenever the oracle's
+      capacity is lossless; under tight capacity it is the *better*
+      answer (the one capacity only approximates).  Not yet
+      composable with an ``ep`` mesh axis (variable group sizes
+      cannot be statically sharded over experts) — pass
+      ``mesh=None`` or a mesh without ``ep``.
+
     ``token_mask`` (bool, shape ``x.shape[:-1]``): masked-out tokens
     contribute nothing — zero output, no capacity slot consumed, and
     no effect on the aux loss — so active tokens route exactly as if
@@ -205,8 +278,14 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     ``capacity_factor`` formula (needed when comparing runs whose
     token counts differ).
     """
-    if dispatch_mode not in ("dense", "sparse"):
+    if dispatch_mode not in ("dense", "sparse", "dropless"):
         raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
+    if dispatch_mode == "dropless" and mesh is not None \
+            and ep_axis in mesh.shape:
+        raise ValueError(
+            "dropless dispatch cannot shard experts over an ep mesh "
+            "axis (variable group sizes); use dense/sparse for "
+            "expert parallelism")
     orig_shape = x.shape
     D = orig_shape[-1]
     xt = x.reshape(-1, D)
@@ -220,6 +299,11 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     logits = xt.astype(jnp.float32) @ params["router"]
     gates, expert_idx, probs = top_k_routing(logits, top_k)
     aux = load_balance_loss(probs, expert_idx, E, token_mask=mask_t)
+
+    if dispatch_mode == "dropless":
+        y = _dropless_ffn(xt, params, gates, expert_idx, E,
+                          token_mask=mask_t)
+        return y.reshape(orig_shape), aux
 
     if dispatch_mode == "sparse":
         slot, tok, keep, order = sparse_slots(expert_idx, E, C,

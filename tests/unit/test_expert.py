@@ -383,3 +383,114 @@ def test_moe_packed_documents_match_separate_forwards():
     loss = float(moe_loss_fn(params, {"tokens": packed,
                                       "segments": seg}, cfg))
     assert np.isfinite(loss)
+
+
+class TestDropless:
+    """MegaBlocks-style dropless dispatch (jax.lax.ragged_dot)."""
+
+    def _setup(self, T=24, D=16, F=32, E=4, seed=0, dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        from nbdistributed_tpu.parallel import expert
+        dtype = dtype or jnp.float32
+        p = expert.init_moe_params(jax.random.PRNGKey(seed), D, F, E,
+                                   dtype=dtype)
+        x = jax.random.normal(jax.random.PRNGKey(seed + 1), (T, D),
+                              dtype)
+        return expert, p, x, E
+
+    def test_matches_dense_at_lossless_capacity(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        expert, p, x, E = self._setup()
+        yd, auxd = expert.moe_ffn(x, p, capacity_factor=float(E))
+        yl, auxl = expert.moe_ffn(x, p, dispatch_mode="dropless")
+        np.testing.assert_allclose(np.asarray(yl), np.asarray(yd),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(auxl), float(auxd), rtol=1e-6)
+
+        gd = jax.grad(lambda x_: jnp.sum(expert.moe_ffn(
+            x_, p, capacity_factor=float(E))[0] ** 2))(x)
+        gl = jax.grad(lambda x_: jnp.sum(expert.moe_ffn(
+            x_, p, dispatch_mode="dropless")[0] ** 2))(x)
+        np.testing.assert_allclose(np.asarray(gl), np.asarray(gd),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_no_drops_under_tight_capacity(self):
+        """Dense with capacity 8 drops tokens at T=96; dropless must
+        equal dense-with-ample-capacity instead."""
+        import numpy as np
+        expert, p, x, E = self._setup(T=96)
+        y_tight, _ = expert.moe_ffn(x, p, capacity=8)
+        y_ample, _ = expert.moe_ffn(x, p, capacity=96 * 2)
+        y_less, _ = expert.moe_ffn(x, p, dispatch_mode="dropless")
+        np.testing.assert_allclose(np.asarray(y_less),
+                                   np.asarray(y_ample),
+                                   atol=1e-5, rtol=1e-5)
+        assert np.abs(np.asarray(y_tight)
+                      - np.asarray(y_ample)).max() > 1e-4
+
+    def test_token_mask_zeroes_masked_rows(self):
+        import jax.numpy as jnp
+        import numpy as np
+        expert, p, x, E = self._setup()
+        mask = jnp.arange(x.shape[0]) % 3 != 0
+        ym, _ = expert.moe_ffn(x, p, dispatch_mode="dropless",
+                               token_mask=mask)
+        yd, _ = expert.moe_ffn(x, p, capacity_factor=float(E),
+                               token_mask=mask)
+        np.testing.assert_allclose(np.asarray(ym), np.asarray(yd),
+                                   atol=1e-5, rtol=1e-5)
+        assert np.abs(np.asarray(ym)[~np.asarray(mask)]).max() == 0
+
+    def test_quantized_experts(self):
+        """int8 expert weights route through ragged_dot with per-row
+        expert scales; must equal the dense path on the same
+        quantized weights at lossless capacity."""
+        import numpy as np
+
+        from nbdistributed_tpu.models.quant import quantize_weight
+        expert, p, x, E = self._setup()
+        pq = dict(p)
+        for n in ("w_gate", "w_up", "w_down"):
+            pq[n] = quantize_weight(p[n])
+        yd, _ = expert.moe_ffn(x, pq, capacity_factor=float(E))
+        yl, _ = expert.moe_ffn(x, pq, dispatch_mode="dropless")
+        np.testing.assert_allclose(np.asarray(yl), np.asarray(yd),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rejects_ep_mesh(self):
+        import jax
+        import pytest
+
+        from nbdistributed_tpu.parallel import mesh as mesh_mod
+        expert, p, x, E = self._setup()
+        mesh = mesh_mod.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="dropless"):
+            expert.moe_ffn(x, p, dispatch_mode="dropless", mesh=mesh)
+
+    def test_model_level_dropless(self):
+        """The MoE family runs end-to-end with moe_dispatch='dropless'
+        and matches the dense model at lossless capacity."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nbdistributed_tpu.models import (init_moe_model,
+                                              moe_forward,
+                                              tiny_moe_config)
+        cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                              capacity_factor=2.0)  # lossless (E/k=2)
+        params = init_moe_model(jax.random.PRNGKey(0), cfg)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2, 18), 0,
+                                 cfg.vocab_size)
+        ld, _ = moe_forward(params, tok, cfg)
+        ll, _ = moe_forward(params, tok,
+                            dataclasses.replace(
+                                cfg, moe_dispatch="dropless"))
+        np.testing.assert_allclose(np.asarray(ll), np.asarray(ld),
+                                   atol=2e-5, rtol=2e-5)
