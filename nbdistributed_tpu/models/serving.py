@@ -160,6 +160,7 @@ class DecodeServer:
 
         self._prefill_fn = self._make_prefill()
         self._step_fn = self._jit_step()
+        self._step_many_fn = self._jit_step_many()
 
     # ---- jitted programs -------------------------------------------------
 
@@ -215,6 +216,22 @@ class DecodeServer:
     def _jit_step(self):
         # Donated cache: the decode step rewrites the pool in place.
         return jax.jit(self._make_step(), donate_argnums=(1,))
+
+    def _jit_step_many(self):
+        step = self._make_step()
+
+        def many(params, cache, lens, last, active, keys):
+            def body(carry, k):
+                cache, lens, last = carry
+                cache, lens, nxt = step(params, cache, lens, last,
+                                        active, k)
+                return (cache, lens, nxt), nxt
+
+            (cache, lens, last), toks = jax.lax.scan(
+                body, (cache, lens, last), keys)
+            return cache, lens, last, toks        # toks (n, B)
+
+        return jax.jit(many, donate_argnums=(1,))
 
     def _jit_spec_step(self):
         from .speculative import spec_round
@@ -359,17 +376,65 @@ class DecodeServer:
         cand_h, acc_h = jax.device_get((cand, n_acc))
         emitted: dict[int, list[int]] = {}
         for slot, rid in list(self._slot_req.items()):
-            toks = [int(t) for t in cand_h[slot][: int(acc_h[slot]) + 1]]
-            toks = toks[: self._budget[rid]]
-            if self._eos is not None and self._eos in toks:
-                toks = toks[: toks.index(self._eos) + 1]
-            self.outputs[rid].extend(toks)
-            emitted[rid] = toks
-            self._budget[rid] -= len(toks)
-            if (self._budget[rid] == 0
-                    or (self._eos is not None and toks
-                        and toks[-1] == self._eos)):
-                self._finish(slot, rid)
+            emitted[rid] = self._emit(
+                slot, rid,
+                [int(t) for t in cand_h[slot][: int(acc_h[slot]) + 1]])
+        self._admit_pending()
+        return emitted
+
+    def _emit(self, slot: int, rid: int, toks: list[int]) -> list[int]:
+        """Budget-then-EOS truncation + bookkeeping for a multi-token
+        emission — the ONE definition of the cut semantics, shared by
+        the speculative round and step_many (both can overshoot
+        device-side; the surplus is discarded here and the slot's
+        stale device state dies with the slot)."""
+        toks = toks[: self._budget[rid]]
+        if self._eos is not None and self._eos in toks:
+            toks = toks[: toks.index(self._eos) + 1]
+        self.outputs[rid].extend(toks)
+        self._budget[rid] -= len(toks)
+        if (self._budget[rid] == 0
+                or (self._eos is not None and toks
+                    and toks[-1] == self._eos)):
+            self._finish(slot, rid)
+        return toks
+
+    def step_many(self, n: int) -> dict[int, list[int]]:
+        """Run ``n`` plain decode steps in ONE device program
+        (``lax.scan``) and apply budget/EOS host-side afterwards.
+
+        Amortizes the per-step host round-trip that dominates
+        single-step serving over a high-latency link (the axon tunnel
+        adds ~70 ms per sync): tokens stream back every ``n`` steps
+        instead of every step.  Trade-offs, by construction: pending
+        requests admit only at scan boundaries (up to ``n`` steps of
+        admission latency), and a slot whose stream hits EOS or its
+        budget mid-scan keeps computing to the boundary (its surplus
+        tokens are discarded host-side; its surplus cache state is
+        stale-but-dead exactly like a mid-round speculative cut).
+        The emitted tokens are bit-identical to ``n`` successive
+        :meth:`step` calls in greedy mode.  Plain mode only —
+        speculative serving already emits multiple tokens per step.
+        """
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if self._draft_cfg is not None:
+            raise ValueError("step_many is for plain serving; "
+                             "speculative mode already amortizes "
+                             "(gamma+1 tokens per step)")
+        self._admit_pending()
+        if not self._slot_req:
+            return {}
+        keys = jax.random.split(self._sample_key(), n)
+        (self._cache, self._lens, self._last,
+         toks) = self._step_many_fn(
+            self._params, self._cache, self._lens, self._last,
+            self._active, keys)
+        toks_h = jax.device_get(toks)              # (n, B)
+        emitted: dict[int, list[int]] = {}
+        for slot, rid in list(self._slot_req.items()):
+            emitted[rid] = self._emit(
+                slot, rid, [int(t) for t in toks_h[:, slot]])
         self._admit_pending()
         return emitted
 

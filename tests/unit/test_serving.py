@@ -283,3 +283,69 @@ def test_spec_serving_validation(spec_setup):
                        draft_params=draft, draft_cfg=cfg, gamma=3)
     with pytest.raises(ValueError, match="speculative headroom"):
         srv.submit([1, 2, 3, 4], 9)   # 4 + 9 + 4 > 16
+
+
+def test_step_many_matches_single_steps(setup):
+    """step_many(n) must emit exactly what n successive step() calls
+    emit (greedy), amortizing the host sync without changing tokens."""
+    cfg, params = setup
+    reqs = [([5, 9, 2], 9), ([7, 1, 3, 11], 7)]
+    a = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+    b = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+    ra = [a.submit(*r) for r in reqs]
+    rb = [b.submit(*r) for r in reqs]
+    for _ in range(8):
+        a.step()
+    b.step_many(4)
+    b.step_many(4)
+    for x, y in zip(ra, rb):
+        assert a.outputs[x] == b.outputs[y]
+    a.run_until_done(max_steps=20)
+    b.run_until_done(max_steps=20)
+    for x, y, (prompt, n) in zip(ra, rb, reqs):
+        assert b.outputs[y] == solo(params, cfg, prompt, n)
+
+
+def test_step_many_truncates_budget_and_eos(setup):
+    cfg, params = setup
+    prompt, n = [5, 9, 2], 6
+    toks = solo(params, cfg, prompt, n)
+    # Budget cut mid-scan: ask for 6, scan 8 past the end.
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=64, pad_to=4)
+    rid = srv.submit(prompt, n)
+    out = srv.step_many(8)
+    assert out[rid] == toks[1:]          # seed emitted at admission
+    assert srv.done() and len(srv.outputs[rid]) == n
+    # EOS cut mid-scan.
+    eos = toks[3]
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=64, pad_to=4,
+                       eos_id=eos)
+    rid = srv.submit(prompt, 8)
+    srv.step_many(8)
+    got = srv.outputs[rid]
+    assert got[-1] == eos and got == toks[: got.index(eos) + 1]
+
+
+def test_step_many_admits_at_boundaries(setup):
+    """A request queued while a scan runs is admitted at the next
+    boundary and still matches its solo decode."""
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=64, pad_to=4)
+    r0 = srv.submit([5, 9, 2], 5)
+    r1 = srv.submit([7, 1], 4)           # queued: one slot
+    srv.step_many(4)                     # finishes r0, admits r1
+    srv.run_until_done(max_steps=20)
+    assert srv.outputs[r0] == solo(params, cfg, [5, 9, 2], 5)
+    assert srv.outputs[r1] == solo(params, cfg, [7, 1], 4)
+
+
+def test_step_many_validation(setup, spec_setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        srv.step_many(0)
+    _, target, draft = spec_setup
+    ssrv = DecodeServer(target, cfg, max_batch=1, max_len=32, pad_to=4,
+                        draft_params=draft, draft_cfg=cfg)
+    with pytest.raises(ValueError, match="plain serving"):
+        ssrv.step_many(2)
