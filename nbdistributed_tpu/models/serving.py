@@ -350,13 +350,7 @@ class DecodeServer:
         toks = jax.device_get(nxt)
         emitted: dict[int, list[int]] = {}
         for slot, rid in list(self._slot_req.items()):
-            tok = int(toks[slot])
-            self.outputs[rid].append(tok)
-            emitted[rid] = [tok]
-            self._budget[rid] -= 1
-            if (self._budget[rid] == 0
-                    or (self._eos is not None and tok == self._eos)):
-                self._finish(slot, rid)
+            emitted[rid] = self._emit(slot, rid, [int(toks[slot])])
         self._admit_pending()
         return emitted
 

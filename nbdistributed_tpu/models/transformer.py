@@ -310,11 +310,11 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
     v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if segment_ids is not None and sp is not None:
-        raise ValueError("segment_ids (packed documents) is not "
-                         "supported together with sequence "
-                         "parallelism yet — pack within sp shards or "
-                         "drop sp")
+    if (segment_ids is not None and sp is not None
+            and sp.method != "ring"):
+        raise ValueError("segment_ids (packed documents) with "
+                         "sequence parallelism is supported for the "
+                         "ring method only (method='ring')")
     if sp is not None:
         flash = cfg.use_flash if sp.use_flash is None else sp.use_flash
         batch_axis, head_axis = sp._resolved_axes()
@@ -331,7 +331,8 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
                                causal=True, use_flash=flash,
                                batch_axis=batch_axis,
                                head_axis=head_axis,
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window,
+                               segment_ids=segment_ids)
     elif cfg.use_flash:
         # block sizes None -> TUNED_BLOCKS table (tune_flash.py) with
         # the 128x128 fallback.

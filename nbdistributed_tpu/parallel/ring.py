@@ -49,7 +49,8 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
              use_flash: bool, schedule: str,
              batch_axis: str | None = None,
              head_axis: str | None = None,
-             window: int | None = None):
+             window: int | None = None,
+             with_segments: bool = False):
     """Jitted ring kernel, cached per (mesh, axis, causal, scale, path)
     so repeated training-loop calls hit the jit cache instead of
     retracing.  ``batch_axis``/``head_axis`` put the embarrassingly
@@ -62,13 +63,19 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
     if schedule == "zigzag":
         inner = _make_ring_flash_zigzag(axis, n, scale, window=window)
     elif use_flash:
-        inner = _make_ring_flash(axis, n, causal, scale, window=window)
+        inner = _make_ring_flash(axis, n, causal, scale, window=window,
+                                 with_segments=with_segments)
     else:
         inner = functools.partial(_ring_inner, axis=axis, n=n,
                                   causal=causal, scale=scale,
                                   window=window)
+    in_specs = (spec, spec, spec)
+    if with_segments:
+        # Segment ids are per (batch, position): sequence-sharded like
+        # q, replicated over heads.
+        in_specs = in_specs + (P(batch_axis, axis),)
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        inner, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False))
 
 
@@ -77,7 +84,8 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                    use_flash: bool = False, schedule: str = "plain",
                    batch_axis: str | None = None,
                    head_axis: str | None = None,
-                   window: int | None = None):
+                   window: int | None = None,
+                   segment_ids=None):
     """Exact (causal) attention with Q/K/V sharded on ``axis`` along the
     sequence dimension.
 
@@ -138,9 +146,27 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                              f"{2 * n}, got S={q.shape[1]}")
     from ..ops.attention import check_window
     check_window(window, causal)
+    if segment_ids is not None:
+        # Packed-document masking: each device's q-chunk segments stay
+        # local; the K-chunk segments ride the ring with K/V (a tiny
+        # int32 extra rider).  Hops whose chunks share no segment
+        # self-heal through the lse fold (weight 0).
+        if schedule == "zigzag":
+            raise ValueError("segment_ids with the zigzag schedule is "
+                             "not supported yet — use schedule='plain'")
+        if segment_ids.shape != q.shape[:2]:
+            raise ValueError(
+                f"segment_ids shape {segment_ids.shape} != (B, S) "
+                f"{q.shape[:2]}")
+        if q.shape[1] != k.shape[1]:
+            raise ValueError("segment_ids requires Sq == Sk")
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ring_fn(mesh, axis, causal, scale, use_flash, schedule,
-                    batch_axis, head_axis, window)(q, k, v)
+    fn = _ring_fn(mesh, axis, causal, scale, use_flash, schedule,
+                  batch_axis, head_axis, window,
+                  with_segments=segment_ids is not None)
+    if segment_ids is None:
+        return fn(q, k, v)
+    return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
 
 def zigzag_order(S: int, n: int):
@@ -274,11 +300,13 @@ def _run_hops(plan, n: int, axis: str, my, fold, carry, riders,
     return carry, riders
 
 
-def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
+def _ring_inner(q, k, v, seg=None, *, axis: str, n: int, causal: bool,
                 scale: float, window: int | None = None):
     """Grouped-einsum online-softmax ring (local view inside shard_map).
 
-    q: (B, Sq, H, D) local chunk; k/v: (B, Sk, Hkv, D) rotating chunks.
+    q: (B, Sq, H, D) local chunk; k/v: (B, Sk, Hkv, D) rotating chunks;
+    ``seg``: optional (B, Sq) local segment ids (the K-side copy rides
+    the ring as an extra rider — packed-document masking).
     """
     B, Sq, H, Dh = q.shape
     Hkv = k.shape[2]
@@ -291,20 +319,29 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
 
     def fold(carry, riders, src):
         acc, m, l = carry
-        k_cur, v_cur = riders
+        if seg is None:
+            k_cur, v_cur = riders
+            kseg_cur = None
+        else:
+            k_cur, v_cur, kseg_cur = riders
         Sk = k_cur.shape[1]
         s = jnp.einsum("bqkgd,bskd->bkgqs", qf,
                        k_cur.astype(jnp.float32),
                        preferred_element_type=jnp.float32)
-        if causal:
-            qi = (my * Sq
-                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0))
-            ki = (src * Sk
-                  + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1))
-            keep = ki <= qi
-            if window is not None:
-                keep = keep & (ki > qi - window)
-            s = jnp.where(keep[None, None, None], s, _NEG_INF)
+        if causal or seg is not None:
+            keep = jnp.ones((1, Sq, Sk), bool)
+            if causal:
+                qi = (my * Sq + jax.lax.broadcasted_iota(
+                    jnp.int32, (Sq, Sk), 0))
+                ki = (src * Sk + jax.lax.broadcasted_iota(
+                    jnp.int32, (Sq, Sk), 1))
+                ck = ki <= qi
+                if window is not None:
+                    ck = ck & (ki > qi - window)
+                keep = keep & ck[None]
+            if seg is not None:
+                keep = keep & (seg[:, :, None] == kseg_cur[:, None, :])
+            s = jnp.where(keep[:, None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                       # (B,Hkv,g,Sq,Sk)
         corr = jnp.exp(m - m_new)                    # (B,Hkv,g,Sq,1)
@@ -317,8 +354,9 @@ def _ring_inner(q, k, v, *, axis: str, n: int, causal: bool,
 
     plan = hop_plan(n, Sq, window if causal else None,
                     sk_local=k.shape[1])
+    riders = (k, v) if seg is None else (k, v, seg)
     (acc, m, l), _ = _run_hops(plan, n, axis, my, fold, (acc, m, l),
-                               (k, v))
+                               riders)
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2, 4)
     return out.reshape(B, Sq, H, Dh).astype(q.dtype)
 
@@ -349,22 +387,23 @@ def _hop_weights(w, B, Sq):
 def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
                      block_q: int | None = None,
                      block_k: int | None = None,
-                     window: int | None = None):
+                     window: int | None = None,
+                     with_segments: bool = False):
     """Builds the shard_map inner for the Pallas ring with exact
     gradients: forward folds per-hop (out, lse) pairs; backward re-rings
     K/V through the blockwise dq/dkv kernels using the saved global
     logsumexp (hops are independent given (lse, delta), exactly like
-    k-blocks inside one kernel call)."""
+    k-blocks inside one kernel call).  ``with_segments``: the inner
+    takes a fourth (B, Sq) segment-id chunk; its K-side copy rides the
+    ring with K/V and each hop's kernel call applies the packed-
+    document mask in both passes (a hop sharing no segment self-heals
+    to weight 0 through the lse fold)."""
     from ..ops.attention import (_block_sizes, _flash_backward_folded,
                                  _flash_bwd_prep, _flash_forward,
                                  _use_interpret)
 
 
-    @jax.custom_vjp
-    def rf(q, k, v):
-        return _rf_fwd(q, k, v)[0]
-
-    def _rf_fwd(q, k, v):
+    def _rf_fwd(q, k, v, seg=None):
         B, Sq, H, D = q.shape
         Sk, Hkv = k.shape[1], k.shape[2]
         bq, bk = _block_sizes(block_q, block_k, Sq, Sk, D, H // Hkv)
@@ -379,21 +418,27 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
             # real from the first fold and fully-masked later hops
             # (lse ~ -inf) get weight exp(-inf - L) = 0.
             O, L = carry
-            k_cur, v_cur = riders
+            if seg is None:
+                k_cur, v_cur = riders
+                kseg_cur = None
+            else:
+                k_cur, v_cur, kseg_cur = riders
             o_j, lse_j = _flash_forward(
                 q, k_cur, v_cur, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
-                offsets=(my * Sq, src * Sk), window=window)
+                offsets=(my * Sq, src * Sk), window=window,
+                segment_ids=seg, kv_segment_ids=kseg_cur)
             return _fold_hop(O, L, o_j, lse_j, B, Sq), riders
 
         plan = hop_plan(n, Sq, window if causal else None,
                         sk_local=Sk)
-        (O, L), _ = _run_hops(plan, n, axis, my, fold, (O, L), (k, v))
+        riders = (k, v) if seg is None else (k, v, seg)
+        (O, L), _ = _run_hops(plan, n, axis, my, fold, (O, L), riders)
         out = O.astype(q.dtype)
-        return out, (q, k, v, out, L)
+        return out, (q, k, v, out, L, seg)
 
     def _rf_bwd(res, g):
-        q, k, v, out, L = res
+        q, k, v, out, L, seg = res
         B, Sq, H, D = q.shape
         Sk, Hkv = k.shape[1], k.shape[2]
         bq, bk = _block_sizes(block_q, block_k, Sq, Sk, D, H // Hkv)
@@ -410,23 +455,52 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
             # dk/dv accumulators ride WITH their chunk (trailing
             # riders): each chunk collects its gradient contributions
             # as it visits every device, then lands home.
-            k_cur, v_cur, dk_cur, dv_cur = riders
+            if seg is None:
+                k_cur, v_cur, dk_cur, dv_cur = riders
+                kseg_cur = None
+            else:
+                k_cur, v_cur, kseg_cur, dk_cur, dv_cur = riders
             dq_j, dk_j, dv_j = _flash_backward_folded(
                 qt, got, delta, L, k_cur, v_cur, B=B, Sq=Sq,
                 q_dtype=q.dtype, causal=causal, scale=scale,
                 block_q=bq, block_k=bk, interpret=interp,
-                offsets=(my * Sq, src * Sk), window=window)
-            return (dq + dq_j.astype(jnp.float32),
-                    (k_cur, v_cur, dk_cur + dk_j.astype(dk_cur.dtype),
-                     dv_cur + dv_j.astype(dv_cur.dtype)))
+                offsets=(my * Sq, src * Sk), window=window,
+                segment_ids=seg, kv_segment_ids=kseg_cur)
+            rest = (dk_cur + dk_j.astype(dk_cur.dtype),
+                    dv_cur + dv_j.astype(dv_cur.dtype))
+            head = ((k_cur, v_cur) if seg is None
+                    else (k_cur, v_cur, kseg_cur))
+            return dq + dq_j.astype(jnp.float32), head + rest
 
         plan = hop_plan(n, Sq, window if causal else None,
                         sk_local=Sk)
-        dq, (_, _, dk, dv) = _run_hops(plan, n, axis, my, fold, dq0,
-                                       (k, v, dk0, dv0), home=2)
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        riders = ((k, v, dk0, dv0) if seg is None
+                  else (k, v, seg, dk0, dv0))
+        dq, out_riders = _run_hops(plan, n, axis, my, fold, dq0,
+                                   riders, home=2)
+        dk, dv = out_riders[-2], out_riders[-1]
+        grads = (dq.astype(q.dtype), dk.astype(k.dtype),
+                 dv.astype(v.dtype))
+        if seg is None:
+            return grads + (None,)
+        return grads + (np.zeros(seg.shape, jax.dtypes.float0),)
 
-    rf.defvjp(_rf_fwd, _rf_bwd)
+    # custom_vjp needs a fixed arity, so build the exact-arity wrapper
+    # for each variant around the shared fwd/bwd bodies.
+    if with_segments:
+        @jax.custom_vjp
+        def rf(q, k, v, seg):
+            return _rf_fwd(q, k, v, seg)[0]
+
+        rf.defvjp(lambda q, k, v, seg: _rf_fwd(q, k, v, seg), _rf_bwd)
+        return rf
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _rf_fwd(q, k, v)[0]
+
+    rf.defvjp(lambda q, k, v: _rf_fwd(q, k, v),
+              lambda res, g: _rf_bwd(res, g)[:3])
     return rf
 
 
