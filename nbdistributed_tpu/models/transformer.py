@@ -310,11 +310,6 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
     v = qlinear(h, layer["wv"]).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if (segment_ids is not None and sp is not None
-            and sp.method != "ring"):
-        raise ValueError("segment_ids (packed documents) with "
-                         "sequence parallelism is supported for the "
-                         "ring method only (method='ring')")
     if sp is not None:
         flash = cfg.use_flash if sp.use_flash is None else sp.use_flash
         batch_axis, head_axis = sp._resolved_axes()
@@ -324,7 +319,8 @@ def _attention_block(x, layer, cfg: TransformerConfig, positions,
                                   causal=True, use_flash=flash,
                                   batch_axis=batch_axis,
                                   head_axis=head_axis,
-                                  window=cfg.sliding_window)
+                                  window=cfg.sliding_window,
+                                  segment_ids=segment_ids)
         else:
             from ..parallel.ring import ring_attention
             o = ring_attention(q, k, v, sp.mesh, axis=sp.axis,

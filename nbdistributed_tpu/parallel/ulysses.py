@@ -39,13 +39,17 @@ from jax.sharding import PartitionSpec as P
 def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
                 use_flash: bool, batch_axis: str | None = None,
                 head_axis: str | None = None,
-                window: int | None = None):
+                window: int | None = None,
+                with_segments: bool = False):
     spec = P(batch_axis, axis, head_axis, None)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
                               scale=scale, use_flash=use_flash,
                               window=window)
+    in_specs = (spec, spec, spec)
+    if with_segments:
+        in_specs = in_specs + (P(batch_axis, axis),)
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        inner, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False))
 
 
@@ -54,7 +58,8 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
                       use_flash: bool = False,
                       batch_axis: str | None = None,
                       head_axis: str | None = None,
-                      window: int | None = None):
+                      window: int | None = None,
+                      segment_ids=None):
     """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``,
     computed head-parallel after an all-to-all re-shard.
 
@@ -96,14 +101,30 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
             f"k/v head counts differ: {Hkv} vs {v.shape[2]}")
     from ..ops.attention import check_window
     check_window(window, causal)
+    if segment_ids is not None:
+        # Packed-document masking: each device's local segment chunk is
+        # all-gathered to full length inside the shard_map (tiny int32
+        # vs the activation all-to-alls) and the local full-sequence
+        # attention applies the mask.
+        if segment_ids.shape != q.shape[:2]:
+            raise ValueError(
+                f"segment_ids shape {segment_ids.shape} != (B, S) "
+                f"{q.shape[:2]}")
+        if q.shape[1] != k.shape[1]:
+            raise ValueError("segment_ids requires Sq == Sk")
     D = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(D))
-    return _ulysses_fn(mesh, axis, causal, scale, use_flash,
-                       batch_axis, head_axis, window)(q, k, v)
+    fn = _ulysses_fn(mesh, axis, causal, scale, use_flash,
+                     batch_axis, head_axis, window,
+                     with_segments=segment_ids is not None)
+    if segment_ids is None:
+        return fn(q, k, v)
+    return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
 
-def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,
-                   use_flash: bool, window: int | None = None):
+def _ulysses_inner(q, k, v, seg=None, *, axis: str, causal: bool,
+                   scale: float, use_flash: bool,
+                   window: int | None = None):
     from ..ops import attention_reference, flash_attention
 
     # seq-sharded -> head-sharded: gather the full sequence, keep H/n.
@@ -117,12 +138,16 @@ def _ulysses_inner(q, k, v, *, axis: str, causal: bool, scale: float,
 
     # After the all-to-all each device holds the FULL sequence on its
     # head slice, so the sliding window is just the local kernels'
-    # ordinary window argument.
+    # ordinary window argument — and packed-document segments are the
+    # full-length ids, all-gathered from the sequence shards.
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    seg_full = (None if seg is None else
+                jax.lax.all_gather(seg, axis, axis=1, tiled=True))
     if use_flash:
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                              window=window)
+                              window=window, segment_ids=seg_full)
     else:
         out = attention_reference(qh, kh, vh, causal=causal,
-                                  scale=scale, window=window)
+                                  scale=scale, window=window,
+                                  segment_ids=seg_full)
     return heads_to_seq(out.astype(q.dtype))

@@ -471,7 +471,8 @@ class TestRingSegments:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_gradients_match_reference(self):
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_gradients_match_reference(self, use_flash):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -484,7 +485,7 @@ class TestRingSegments:
 
         def loss_r(q_, k_, v_):
             return jnp.sum(ring_attention(
-                q_, k_, v_, mesh, causal=True, use_flash=True,
+                q_, k_, v_, mesh, causal=True, use_flash=use_flash,
                 segment_ids=seg) ** 2)
 
         def loss_ref(q_, k_, v_):
@@ -534,21 +535,3 @@ class TestRingSegments:
                          use_flash=False)
         got = float(loss_fn(params, batch, cfg, sp=sp))
         np.testing.assert_allclose(got, ref, rtol=1e-5)
-
-    def test_model_sp_ulysses_rejects_segments(self):
-        import jax
-        import jax.numpy as jnp
-
-        from nbdistributed_tpu.models import (SeqParallel, init_params,
-                                              loss_fn, tiny_config)
-        from nbdistributed_tpu.parallel import mesh as mesh_mod
-
-        cfg = tiny_config(dtype=jnp.float32, use_flash=False)
-        params = init_params(jax.random.PRNGKey(0), cfg)
-        mesh = mesh_mod.make_mesh({"sp": 4}, devices=jax.devices()[:4])
-        tok = jnp.zeros((2, 32), jnp.int32)
-        batch = {"tokens": tok, "segments": jnp.zeros_like(tok)}
-        sp = SeqParallel(mesh=mesh, axis="sp", method="ulysses",
-                         use_flash=False)
-        with pytest.raises(ValueError, match="ring method only"):
-            loss_fn(params, batch, cfg, sp=sp)
