@@ -61,7 +61,8 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
     n = mesh.shape[axis]
     spec = P(batch_axis, axis, head_axis, None)
     if schedule == "zigzag":
-        inner = _make_ring_flash_zigzag(axis, n, scale, window=window)
+        inner = _make_ring_flash_zigzag(axis, n, scale, window=window,
+                                        with_segments=with_segments)
     elif use_flash:
         inner = _make_ring_flash(axis, n, causal, scale, window=window,
                                  with_segments=with_segments)
@@ -151,9 +152,9 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         # local; the K-chunk segments ride the ring with K/V (a tiny
         # int32 extra rider).  Hops whose chunks share no segment
         # self-heal through the lse fold (weight 0).
-        if schedule == "zigzag":
-            raise ValueError("segment_ids with the zigzag schedule is "
-                             "not supported yet — use schedule='plain'")
+        # Zigzag composes too: the segment array must be in zigzag
+        # order like q/k/v (zigzag_shard it with them) — the fold
+        # slices its half-chunks exactly as it slices K/V.
         if segment_ids.shape != q.shape[:2]:
             raise ValueError(
                 f"segment_ids shape {segment_ids.shape} != (B, S) "
@@ -507,7 +508,8 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
 def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                             block_q: int | None = None,
                             block_k: int | None = None,
-                            window: int | None = None):
+                            window: int | None = None,
+                            with_segments: bool = False):
     """Zigzag causal ring (local view: the two half-chunks d and
     2n-1-d, concatenated).  Every hop runs four half-pair Pallas calls
     with exact global offsets; causal block-skip inside the kernel
@@ -524,11 +526,7 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         """Global offsets of owner ``idx``'s two half-chunks."""
         return (idx * C, (2 * n - 1 - idx) * C)
 
-    @jax.custom_vjp
-    def rf(q, k, v):
-        return _rf_fwd(q, k, v)[0]
-
-    def _rf_fwd(q, k, v):
+    def _rf_fwd(q, k, v, seg=None):
         B, Sq, H, D = q.shape
         Hkv = k.shape[2]
         C = Sq // 2
@@ -539,13 +537,18 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         C_pad = -(-C // bq) * bq
         q_offs = _offs(my, C)
         qh = (q[:, :C], q[:, C:])
+        qsegh = (None, None) if seg is None else (seg[:, :C], seg[:, C:])
         O = [jnp.zeros((B, C, H, D), jnp.float32) for _ in range(2)]
         L = [jnp.full((B * Hkv, G, C_pad), _NEG_INF, jnp.float32)
              for _ in range(2)]
 
         def fold(carry, riders, src):
             Oa, La, Ob, Lb = carry
-            k_cur, v_cur = riders
+            if seg is None:
+                k_cur, v_cur = riders
+                kseg_cur = None
+            else:
+                k_cur, v_cur, kseg_cur = riders
             k_offs = _offs(src, C)
             Os, Ls = [Oa, Ob], [La, Lb]
             # Step 0 folds real data first for both q halves: (qa, ka)
@@ -561,7 +564,11 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         causal=True, scale=scale, block_q=bq,
                         block_k=bk, interpret=interp,
                         offsets=(q_offs[qi], k_offs[ki]),
-                        window=window)
+                        window=window,
+                        segment_ids=qsegh[qi],
+                        kv_segment_ids=(
+                            None if kseg_cur is None else
+                            kseg_cur[:, ki * C:(ki + 1) * C]))
                     Os[qi], Ls[qi] = _fold_hop(Os[qi], Ls[qi], o_j,
                                                lse_j, B, C)
             return (Os[0], Ls[0], Os[1], Ls[1]), riders
@@ -570,13 +577,14 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         # pair 2n-1-d meets its window neighbors at ring distance n-1,
         # n-2, ...); K/V jump across the gap in one ppermute.
         plan = hop_plan(n, Sq, window, "zigzag")
+        riders = (k, v) if seg is None else (k, v, seg)
         (Oa, La, Ob, Lb), _ = _run_hops(
-            plan, n, axis, my, fold, (O[0], L[0], O[1], L[1]), (k, v))
+            plan, n, axis, my, fold, (O[0], L[0], O[1], L[1]), riders)
         out = jnp.concatenate([Oa, Ob], axis=1).astype(q.dtype)
-        return out, (q, k, v, out, La, Lb)
+        return out, (q, k, v, out, La, Lb, seg)
 
     def _rf_bwd(res, g):
-        q, k, v, out, La, Lb = res
+        q, k, v, out, La, Lb, seg = res
         B, Sq, H, D = q.shape
         Hkv = k.shape[2]
         C = Sq // 2
@@ -585,6 +593,7 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
         my = jax.lax.axis_index(axis)
         q_offs = _offs(my, C)
         Ls = (La, Lb)
+        qsegh = (None, None) if seg is None else (seg[:, :C], seg[:, C:])
         # Hoisted per-half backward prep (hop-invariant).
         prep = [_flash_bwd_prep(q[:, h * C:(h + 1) * C],
                                 out[:, h * C:(h + 1) * C],
@@ -596,7 +605,11 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
 
         def fold(carry, riders, src):
             dqa, dqb = carry
-            k_cur, v_cur, dk_cur, dv_cur = riders
+            if seg is None:
+                k_cur, v_cur, dk_cur, dv_cur = riders
+                kseg_cur = None
+            else:
+                k_cur, v_cur, kseg_cur, dk_cur, dv_cur = riders
             k_offs = _offs(src, C)
             dqs = [dqa, dqb]
             for qi in range(2):
@@ -610,21 +623,46 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
                         scale=scale, block_q=bq, block_k=bk,
                         interpret=interp,
                         offsets=(q_offs[qi], k_offs[ki]),
-                        window=window)
+                        window=window,
+                        segment_ids=qsegh[qi],
+                        kv_segment_ids=(
+                            None if kseg_cur is None else
+                            kseg_cur[:, ki * C:(ki + 1) * C]))
                     dqs[qi] = dqs[qi] + dq_j.astype(jnp.float32)
                     sl = slice(ki * C, (ki + 1) * C)
                     dk_cur = dk_cur.at[:, sl].add(
                         dk_j.astype(jnp.float32))
                     dv_cur = dv_cur.at[:, sl].add(
                         dv_j.astype(jnp.float32))
-            return (dqs[0], dqs[1]), (k_cur, v_cur, dk_cur, dv_cur)
+            head = ((k_cur, v_cur) if seg is None
+                    else (k_cur, v_cur, kseg_cur))
+            return (dqs[0], dqs[1]), head + (dk_cur, dv_cur)
 
         plan = hop_plan(n, Sq, window, "zigzag")
-        (dqa, dqb), (_, _, dk, dv) = _run_hops(
-            plan, n, axis, my, fold, (dq0[0], dq0[1]),
-            (k, v, dk0, dv0), home=2)
+        riders = ((k, v, dk0, dv0) if seg is None
+                  else (k, v, seg, dk0, dv0))
+        (dqa, dqb), out_riders = _run_hops(
+            plan, n, axis, my, fold, (dq0[0], dq0[1]), riders, home=2)
+        dk, dv = out_riders[-2], out_riders[-1]
         dq = jnp.concatenate([dqa, dqb], axis=1)
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        grads = (dq.astype(q.dtype), dk.astype(k.dtype),
+                 dv.astype(v.dtype))
+        if seg is None:
+            return grads + (None,)
+        return grads + (np.zeros(seg.shape, jax.dtypes.float0),)
 
-    rf.defvjp(_rf_fwd, _rf_bwd)
+    if with_segments:
+        @jax.custom_vjp
+        def rf(q, k, v, seg):
+            return _rf_fwd(q, k, v, seg)[0]
+
+        rf.defvjp(lambda q, k, v, seg: _rf_fwd(q, k, v, seg), _rf_bwd)
+        return rf
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _rf_fwd(q, k, v)[0]
+
+    rf.defvjp(lambda q, k, v: _rf_fwd(q, k, v),
+              lambda res, g: _rf_bwd(res, g)[:3])
     return rf
