@@ -365,6 +365,30 @@ def _ring_inner(q, k, v, seg=None, *, axis: str, n: int, causal: bool,
 # ----------------------------------------------------------------------
 # Flash (Pallas) inner path
 
+def _wrap_vjp(rf_fwd, rf_bwd, with_segments: bool):
+    """The custom_vjp trailer shared by the plain and zigzag flash
+    builders: custom_vjp needs a FIXED arity, so build the exact-arity
+    wrapper per variant around the shared fwd/bwd bodies (rf_bwd always
+    returns a 4-tuple whose last entry is the segment cotangent —
+    float0 for int ids, None when absent — truncated to 3 for the
+    segment-free variant)."""
+    if with_segments:
+        @jax.custom_vjp
+        def rf(q, k, v, seg):
+            return rf_fwd(q, k, v, seg)[0]
+
+        rf.defvjp(lambda q, k, v, seg: rf_fwd(q, k, v, seg), rf_bwd)
+        return rf
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return rf_fwd(q, k, v)[0]
+
+    rf.defvjp(lambda q, k, v: rf_fwd(q, k, v),
+              lambda res, g: rf_bwd(res, g)[:3])
+    return rf
+
+
 def _fold_hop(O, L, o_j, lse_j, B, Sq):
     """One online-softmax fold of a hop contribution (o_j, lse_j) into
     the running (O, L) — the numerically delicate core shared by the
@@ -486,23 +510,7 @@ def _make_ring_flash(axis: str, n: int, causal: bool, scale: float,
             return grads + (None,)
         return grads + (np.zeros(seg.shape, jax.dtypes.float0),)
 
-    # custom_vjp needs a fixed arity, so build the exact-arity wrapper
-    # for each variant around the shared fwd/bwd bodies.
-    if with_segments:
-        @jax.custom_vjp
-        def rf(q, k, v, seg):
-            return _rf_fwd(q, k, v, seg)[0]
-
-        rf.defvjp(lambda q, k, v, seg: _rf_fwd(q, k, v, seg), _rf_bwd)
-        return rf
-
-    @jax.custom_vjp
-    def rf(q, k, v):
-        return _rf_fwd(q, k, v)[0]
-
-    rf.defvjp(lambda q, k, v: _rf_fwd(q, k, v),
-              lambda res, g: _rf_bwd(res, g)[:3])
-    return rf
+    return _wrap_vjp(_rf_fwd, _rf_bwd, with_segments)
 
 
 def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
@@ -651,18 +659,4 @@ def _make_ring_flash_zigzag(axis: str, n: int, scale: float,
             return grads + (None,)
         return grads + (np.zeros(seg.shape, jax.dtypes.float0),)
 
-    if with_segments:
-        @jax.custom_vjp
-        def rf(q, k, v, seg):
-            return _rf_fwd(q, k, v, seg)[0]
-
-        rf.defvjp(lambda q, k, v, seg: _rf_fwd(q, k, v, seg), _rf_bwd)
-        return rf
-
-    @jax.custom_vjp
-    def rf(q, k, v):
-        return _rf_fwd(q, k, v)[0]
-
-    rf.defvjp(lambda q, k, v: _rf_fwd(q, k, v),
-              lambda res, g: _rf_bwd(res, g)[:3])
-    return rf
+    return _wrap_vjp(_rf_fwd, _rf_bwd, with_segments)
