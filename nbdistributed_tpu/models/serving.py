@@ -238,6 +238,7 @@ class DecodeServer:
 
         cfg, dcfg = self._cfg, self._draft_cfg
         gamma, temperature = self._gamma, self._temperature
+        mesh, ep_axis = self._mesh, self._ep_axis
 
         def fn(params, draft_params, cache_t, lens_t, cache_d, lens_d,
                last, active, key):
@@ -246,7 +247,8 @@ class DecodeServer:
                 params, draft_params, cfg, dcfg, gamma=gamma,
                 temperature=temperature, cache_t=cache_t,
                 len_t=lens_t, cache_d=cache_d, len_d=lens_d,
-                last_tok=last, key=key, active=active)
+                last_tok=last, key=key, active=active, mesh=mesh,
+                ep_axis=ep_axis)
             return cache_t, lens_t, cache_d, lens_d, cand, n_acc, \
                 new_last
 

@@ -66,7 +66,7 @@ def _greedy_tok(logits):
 
 def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
                temperature: float, cache_t, len_t, cache_d, len_d,
-               last_tok, key, active):
+               last_tok, key, active, mesh=None, ep_axis: str = "ep"):
     """ONE draft-propose / target-verify round for B streams — the
     engine shared by :func:`speculative_generate`'s closed loop and
     the continuous-batching server's speculative mode.
@@ -91,7 +91,7 @@ def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
         cache_d, len_d, tok, key = carry
         lg, cache_d = forward_with_cache(
             draft_params, tok[:, None], cache_d, len_d, draft_cfg,
-            row_mask=active)
+            row_mask=active, mesh=mesh, ep_axis=ep_axis)
         key, ks = jax.random.split(key)
         nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
         return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
@@ -107,7 +107,8 @@ def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
     # n_acc; the slot is stale-and-masked when d_gamma is rejected.
     _, cache_d = forward_with_cache(
         draft_params, drafts[-1][:, None], cache_d,
-        len_d + gamma, draft_cfg, row_mask=active)
+        len_d + gamma, draft_cfg, row_mask=active, mesh=mesh,
+        ep_axis=ep_axis)
 
     # --- target verifies the newest token + all proposals ------
     # ONE forward shared by every stream: (B, gamma+1) — this
@@ -116,7 +117,7 @@ def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
                                 axis=1)              # (B, g+1)
     logits_v, cache_t = forward_with_cache(
         params, verify_in, cache_t, len_t, cfg,
-        row_mask=active)                             # (B, g+1, V)
+        row_mask=active, mesh=mesh, ep_axis=ep_axis)  # (B, g+1, V)
 
     key, kacc, kfix = jax.random.split(key, 3)
     n_acc, next_tok = jax.vmap(
@@ -139,7 +140,8 @@ def speculative_generate(params: dict, draft_params: dict,
                          max_new_tokens: int, *, gamma: int = 4,
                          temperature: float = 0.0, key=None,
                          max_len: int | None = None,
-                         kv_quantized: bool = False):
+                         kv_quantized: bool = False,
+                         mesh=None, ep_axis: str = "ep"):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S0)
     with draft-proposed, target-verified decoding.
 
@@ -154,6 +156,12 @@ def speculative_generate(params: dict, draft_params: dict,
     second value is the average number of draft tokens accepted per
     verify round per active stream (max ``gamma``), the quantity that
     sets the speedup.
+
+    With ``mesh``, both KV caches are created sharded (batch over
+    ``dp``, KV heads over ``tp``) and every forward routes through the
+    mesh-aware decode path (``_flash_decode_on_mesh`` for the S=1
+    draft steps; MoE expert all-to-alls over ``ep_axis``) — pass
+    target/draft params sharded by ``param_shardings``.
     """
     B = prompt.shape[0]
     if B < 1:
@@ -184,16 +192,20 @@ def speculative_generate(params: dict, draft_params: dict,
                          f"(prompt + max_new_tokens + gamma + 1)")
     # int8 caches compose transparently: forward_with_cache dispatches
     # on the cache keys, and rollback-by-pointer works identically.
-    cache_t = init_kv_cache(cfg, B, T, quantized=kv_quantized)
-    cache_d = init_kv_cache(draft_cfg, B, T, quantized=kv_quantized)
+    cache_t = init_kv_cache(cfg, B, T, mesh=mesh,
+                            quantized=kv_quantized)
+    cache_d = init_kv_cache(draft_cfg, B, T, mesh=mesh,
+                            quantized=kv_quantized)
 
     # Prefill both models on the prompt (streams still aligned, so the
     # pointer is a shared scalar 0 here); the target's last-position
     # logits seed the first accepted token of every stream.
     logits_t, cache_t = forward_with_cache(params, prompt, cache_t, 0,
-                                           cfg, last_only=True)
+                                           cfg, last_only=True,
+                                           mesh=mesh, ep_axis=ep_axis)
     _, cache_d = forward_with_cache(draft_params, prompt, cache_d, 0,
-                                    draft_cfg, last_only=True)
+                                    draft_cfg, last_only=True,
+                                    mesh=mesh, ep_axis=ep_axis)
 
     key, k0 = jax.random.split(key)
     first = _sample_1(logits_t[:, -1], temperature, k0)      # (B,)
@@ -228,7 +240,7 @@ def speculative_generate(params: dict, draft_params: dict,
                        gamma=gamma, temperature=temperature,
                        cache_t=cache_t, len_t=len_t, cache_d=cache_d,
                        len_d=len_d, last_tok=last_tok, key=key,
-                       active=active)
+                       active=active, mesh=mesh, ep_axis=ep_axis)
 
         # --- commit ------------------------------------------------
         # Write all gamma+1 candidate slots per row; only the first
