@@ -27,6 +27,11 @@ driver-defined all_reduce metric):
 TPU bring-up failures (the axon tunnel flaps: device discovery hangs)
 retry with backoff, then fall back to a 2-process CPU/gloo world — the
 metric name always carries the backend that actually ran.
+
+**Per-measurement process isolation is the rule**: every heavy TPU
+measurement family (MFU, flash-vs-XLA, decode, speculative, serving,
+7B int8) runs in its own freshly-spawned worker process, torn down
+(blocking) before the next spawns — see :func:`measure_family`.
 """
 
 from __future__ import annotations
@@ -487,50 +492,6 @@ _json.dumps({
 })
 """
 
-# Drop every underscore-named bench temporary from the worker
-# namespace between heavy cells — the 1B MFU leftovers (~9G with
-# optimizer state) and the 7B int8 tree (~6.7G) cannot coexist in 16G.
-# Escalation ladder, because a failed (OOMed) cell has been observed to
-# leave HBM full even after the pops: pops+gc -> jax.clear_caches()
-# (dead jitted executables can pin constants) -> delete every live
-# jax.Array outright.  The hammer is safe HERE because the bench
-# namespace holds no device values it still needs between heavy cells.
-CLEANUP_CELL = """
-_doomed = [n for n in list(globals())
-           if n.startswith('_') and not n.startswith('__')]
-for _x in list(_doomed):
-    globals().pop(_x, None)
-globals().pop('_doomed', None)
-globals().pop('_x', None)
-# Imports come AFTER the sweep: they are underscore-named, so popping
-# first means this cell never deletes its own imports mid-flight.
-import gc as _gc, jax as _jx
-_gc.collect()
-
-
-def _in_use():
-    try:
-        return _jx.local_devices()[0].memory_stats()["bytes_in_use"]
-    except Exception:
-        return -1
-
-
-_b0 = _in_use()
-if _b0 > 1 << 30:
-    _jx.clear_caches()
-    _gc.collect()
-    _b1 = _in_use()
-    if _b1 > 1 << 30:
-        for _a in _jx.live_arrays():
-            try:
-                _a.delete()
-            except Exception:
-                pass
-        _jx.clear_caches()
-        _gc.collect()
-"cleaned bytes_in_use=%d->%d" % (_b0, _in_use())
-"""
-
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
 # measurement on a 1-process world (labeled as such).
 ALLREDUCE_CELL = """
@@ -583,6 +544,116 @@ def parse_result_json(resp) -> dict | None:
         return None
 
 
+def _spawn_world(backend: str, world: int):
+    """Spawn a worker world; returns (comm, pm) attached and ready."""
+    from nbdistributed_tpu.manager import wait_until_ready
+    comm = CommunicationManager(num_workers=world, timeout=300)
+    pm = ProcessManager()
+    try:
+        pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+        pm.start_workers(world, comm.port, backend=backend)
+        wait_until_ready(comm, pm, 150)
+    except Exception:
+        _teardown(comm, pm, world)
+        raise
+    return comm, pm
+
+
+def _teardown(comm, pm, world: int) -> None:
+    """Polite shutdown broadcast, then the tiered kill ladder, then the
+    listener close.  BLOCKING (pm.shutdown waits through SIGTERM →
+    SIGKILL), so by the time it returns no worker of this world can
+    still be holding chip HBM when the next world spawns."""
+    try:
+        comm.post(list(range(world)), "shutdown")
+        time.sleep(0.3)
+    except Exception:
+        pass
+    try:
+        pm.shutdown()
+    except Exception:
+        pass
+    try:
+        comm.shutdown()
+    except Exception:
+        pass
+
+
+def _exec_measure(comm, name: str, cell: str, timeout: int) -> dict | None:
+    """Run one measurement cell on rank 0; parse its trailing JSON."""
+    resp = comm.send_to_ranks([0], "execute", cell, timeout=timeout)
+    m = resp[0]
+    if m.data.get("error"):
+        log(f"[bench] {name} cell failed: "
+            f"{m.data.get('traceback', m.data['error'])}")
+        return None
+    out = parse_result_json(m)
+    if out is not None:
+        log(f"[bench] {name}: {out}")
+    return out
+
+
+# Sentinel: measure_family could not even attach a worker — the signal
+# run_families uses to distinguish "this cell failed" (keep going) from
+# "the accelerator tunnel is gone" (stop burning attach timeouts).
+SPAWN_FAILED = object()
+
+
+def measure_family(backend: str, name: str, cell: str, timeout: int):
+    """Run ONE measurement family in its own fresh worker process.
+
+    Per-measurement process isolation is the bench rule, learned the
+    hard way: round 3's only on-chip flash sample measured 0.065x vs
+    XLA inside a worker whose HBM a previously-OOMed 1B train cell had
+    filled — no amount of in-process cleanup (namespace sweeps,
+    jax.clear_caches, live-array deletion) reliably un-poisons a
+    wedged allocator, and a contaminated number is worse than none.
+    The worker is spawned fresh, runs exactly one measurement cell,
+    and is torn down (blocking) before the next family starts, so no
+    family can see another's leftovers.
+
+    Returns the parsed result dict, None (cell failed — measurement
+    lost but the world is healthy), or :data:`SPAWN_FAILED` (no worker
+    attached at all).
+    """
+    log(f"[bench] {name}: spawning fresh worker")
+    try:
+        comm, pm = _spawn_world(backend, 1)
+    except Exception as e:
+        log(f"[bench] {name} skipped (spawn failed): {e}")
+        return SPAWN_FAILED
+    try:
+        return _exec_measure(comm, name, cell, timeout)
+    except Exception as e:
+        log(f"[bench] {name} skipped: {e}")
+        return None
+    finally:
+        _teardown(comm, pm, 1)
+
+
+def run_families(backend: str, families, extra: dict,
+                 measure=None) -> None:
+    """Run measurement families, each in a fresh process, filling
+    ``extra[name]``.  Bails out after two consecutive spawn failures:
+    a wedged tunnel would otherwise cost the full ~150 s attach
+    timeout per remaining family, serially — minutes of dead time
+    that can push the bench past the driver's outer deadline."""
+    measure = measure if measure is not None else measure_family
+    spawn_failures = 0
+    for name, cell, cell_timeout in families:
+        out = measure(backend, name, cell, cell_timeout)
+        if out is SPAWN_FAILED:
+            spawn_failures += 1
+            if spawn_failures >= 2:
+                log("[bench] two consecutive spawn failures — tunnel "
+                    "presumed down, skipping remaining families")
+                return
+            continue
+        spawn_failures = 0
+        if out is not None:
+            extra[name] = out
+
+
 def main() -> int:
     # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
     # spawned workers: raising SystemExit lets run()'s finally-block
@@ -623,14 +694,9 @@ def main() -> int:
 def run(backend: str, world: int, attempt: int = 1) -> int:
     log(f"[bench] backend={backend} world={world} attempt={attempt}")
 
-    comm = None
-    pm = ProcessManager()
+    comm = pm = None
     try:
-        comm = CommunicationManager(num_workers=world, timeout=300)
-        pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
-        pm.start_workers(world, comm.port, backend=backend)
-        from nbdistributed_tpu.manager import wait_until_ready
-        wait_until_ready(comm, pm, 150)
+        comm, pm = _spawn_world(backend, world)
         log("[bench] workers attached; running setup cell")
         resp = comm.send_to_all("execute", SETUP, timeout=600)
         for r, m in resp.items():
@@ -670,171 +736,30 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
 
         extra: dict = {"overhead_ms_per_cell": round(overhead_ms, 3)}
 
-        # The context measurements below are best-effort: a
-        # coordinator-side TimeoutError/WorkerDied there must not
-        # discard the already-measured primary metric (the whole point
-        # of the fallback ladder is that a JSON line always comes out).
-        try:
-            # ---- flagship-model MFU on rank 0's accelerator ---------
-            log("[bench] measuring smol-135M fwd/train MFU on rank 0 "
-                "(compiles ~1-2 min on a cold chip)")
-            peak = V5E_PEAK_BF16 if backend == "tpu" else 0
-            shape = "(8, 2048, 10)" if backend == "tpu" else "(2, 512, 3)"
-            resp = comm.send_to_ranks(
-                [0], "execute",
-                MFU_CELL.format(peak=peak or 1e30, shape=shape,
-                                cfg_name="smol_135m_config"),
-                timeout=1200)
-            m = resp[0]
-            if m.data.get("error"):
-                log(f"[bench] MFU cell failed: "
-                    f"{m.data.get('traceback', m.data['error'])}")
-            else:
-                mfu = parse_result_json(m)
+        # The context measurements below are best-effort: a failure
+        # there must not discard the already-measured primary metric
+        # (the whole point of the fallback ladder is that a JSON line
+        # always comes out).
+        if backend != "tpu":
+            # CPU fallback: keep the MFU probe in the pooled world
+            # (process contamination is an HBM phenomenon; host RAM is
+            # plentiful and fallback runs should stay quick).
+            try:
+                log("[bench] measuring smol-135M fwd/train on rank 0")
+                mfu = _exec_measure(
+                    comm, "smol135m",
+                    MFU_CELL.format(peak=1e30, shape="(2, 512, 3)",
+                                    cfg_name="smol_135m_config"), 1200)
                 if mfu is not None:
-                    if backend != "tpu":
-                        mfu.pop("fwd_mfu", None)  # no meaningful CPU peak
-                        mfu.pop("train_mfu", None)
+                    mfu.pop("fwd_mfu", None)     # no meaningful CPU peak
+                    mfu.pop("train_mfu", None)
                     extra["smol135m"] = mfu
-                    log(f"[bench] smol135m: {mfu}")
-        except Exception as e:
-            log(f"[bench] MFU measurement skipped: {e}")
-
-        def cleanup_rank0():
-            """Best-effort namespace sweep between heavy cells — MUST
-            run even when the preceding cell failed, or its multi-GB
-            leftovers OOM every later measurement."""
-            try:
-                resp = comm.send_to_ranks([0], "execute", CLEANUP_CELL,
-                                          timeout=300)
-                log(f"[bench] cleanup: "
-                    f"{resp[0].data.get('output', resp[0].data)}")
             except Exception as e:
-                log(f"[bench] cleanup failed (continuing): {e}")
-
-        if backend == "tpu":
-            # MFU at a scale where MFU means something: ~1.1B params,
-            # d_model=2048 — the GEMM sizes a v5e's MXU can actually
-            # fill (a 135M model's d=576 matmuls cannot).
-            try:
-                log("[bench] measuring tinyllama-1.1B fwd/train MFU "
-                    "on rank 0 (compile is minutes-scale cold)")
-                cleanup_rank0()
-                resp = comm.send_to_ranks(
-                    [0], "execute",
-                    MFU_CELL.format(peak=V5E_PEAK_BF16,
-                                    shape="(8, 2048, 5)",
-                                    cfg_name="tinyllama_1b_config"),
-                    timeout=1800)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] 1B MFU cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    mfu1b = parse_result_json(m)
-                    if mfu1b is not None:
-                        extra["tinyllama_1b"] = mfu1b
-                        log(f"[bench] tinyllama_1b: {mfu1b}")
-            except Exception as e:
-                log(f"[bench] 1B MFU measurement skipped: {e}")
-            finally:
-                cleanup_rank0()
-            # The kernel-vs-XLA comparison is only meaningful where
-            # the kernel actually compiles (interpret mode on CPU is
-            # orders slower by construction).
-            try:
-                log("[bench] flash vs XLA reference attention")
-                cleanup_rank0()
-                resp = comm.send_to_ranks([0], "execute", FLASH_CELL,
-                                          timeout=900)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] flash cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    fa = parse_result_json(m)
-                    if fa is not None:
-                        extra["flash_attn"] = fa
-                        log(f"[bench] flash_attn: {fa}")
-            except Exception as e:
-                log(f"[bench] flash comparison skipped: {e}")
-
-            try:
-                log("[bench] decode throughput bf16 vs int8 (smol-135M)")
-                cleanup_rank0()
-                resp = comm.send_to_ranks([0], "execute", DECODE_CELL,
-                                          timeout=1200)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] decode cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    dc = parse_result_json(m)
-                    if dc is not None:
-                        extra["decode"] = dc
-                        log(f"[bench] decode: {dc}")
-            except Exception as e:
-                log(f"[bench] decode comparison skipped: {e}")
-
-            try:
-                log("[bench] speculative decode (self-draft upper "
-                    "bound, smol-135M)")
-                cleanup_rank0()
-                resp = comm.send_to_ranks([0], "execute", SPEC_CELL,
-                                          timeout=1200)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] spec cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    sp = parse_result_json(m)
-                    if sp is not None:
-                        extra["speculative"] = sp
-                        log(f"[bench] speculative: {sp}")
-            except Exception as e:
-                log(f"[bench] speculative comparison skipped: {e}")
-
-            try:
-                log("[bench] continuous-batching server vs sequential "
-                    "decode (smol-135M)")
-                cleanup_rank0()
-                resp = comm.send_to_ranks([0], "execute", SERVE_CELL,
-                                          timeout=1200)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] serve cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    sv = parse_result_json(m)
-                    if sv is not None:
-                        extra["serving"] = sv
-                        log(f"[bench] serving: {sv}")
-            except Exception as e:
-                log(f"[bench] serving comparison skipped: {e}")
-
-            try:
-                log("[bench] llama2-7B int8 decode at real memory "
-                    "footprint (host-side init+quant, then ~6.7G to "
-                    "the chip)")
-                cleanup_rank0()
-                resp = comm.send_to_ranks([0], "execute", DECODE7B_CELL,
-                                          timeout=1800)
-                m = resp[0]
-                if m.data.get("error"):
-                    log(f"[bench] 7B decode cell failed: "
-                        f"{m.data.get('traceback', m.data['error'])}")
-                else:
-                    d7 = parse_result_json(m)
-                    if d7 is not None:
-                        extra["decode_7b_int8"] = d7
-                        log(f"[bench] decode_7b_int8: {d7}")
-            except Exception as e:
-                log(f"[bench] 7B decode skipped: {e}")
-            finally:
-                cleanup_rank0()
+                log(f"[bench] MFU measurement skipped: {e}")
 
         try:
-            # ---- all_reduce bandwidth sweep -------------------------
+            # ---- all_reduce bandwidth sweep (needs the pooled world:
+            # the collective spans all workers) ----------------------
             log("[bench] all_reduce bandwidth sweep")
             resp = comm.send_to_all("execute", ALLREDUCE_CELL,
                                     timeout=600)
@@ -849,6 +774,36 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                     log(f"[bench] allreduce: {sweep}")
         except Exception as e:
             log(f"[bench] allreduce sweep skipped: {e}")
+
+        # The pooled world's job is done.  Tear it down (blocking)
+        # BEFORE the per-family measurements: two processes share the
+        # one chip's HBM, so the pooled workers must be gone before a
+        # family worker attaches.
+        _teardown(comm, pm, world)
+        comm = pm = None
+
+        if backend == "tpu":
+            # Every heavy measurement family runs in its own fresh
+            # worker process (see measure_family's docstring for why).
+            families = (
+                # Flagship MFU (135M — the reference demo scale).
+                ("smol135m", MFU_CELL.format(
+                    peak=V5E_PEAK_BF16, shape="(8, 2048, 10)",
+                    cfg_name="smol_135m_config"), 1800),
+                # MFU at a scale where MFU means something: ~1.1B
+                # params, d_model=2048 — GEMMs a v5e MXU can fill.
+                ("tinyllama_1b", MFU_CELL.format(
+                    peak=V5E_PEAK_BF16, shape="(8, 2048, 5)",
+                    cfg_name="tinyllama_1b_config"), 1800),
+                # Kernel-vs-XLA only where the kernel compiles
+                # (interpret mode on CPU is orders slower by design).
+                ("flash_attn", FLASH_CELL, 900),
+                ("decode", DECODE_CELL, 1200),
+                ("speculative", SPEC_CELL, 1200),
+                ("serving", SERVE_CELL, 1200),
+                ("decode_7b_int8", DECODE7B_CELL, 1800),
+            )
+            run_families(backend, families, extra)
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"
@@ -892,14 +847,8 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         log(f"[bench] {backend} run failed:\n{traceback.format_exc()}")
         return 1
     finally:
-        try:
-            comm.post(list(range(world)), "shutdown")
-            time.sleep(0.3)
-        except Exception:
-            pass
-        pm.shutdown()
-        if comm is not None:
-            comm.shutdown()
+        if pm is not None or comm is not None:
+            _teardown(comm, pm, world)
 
 
 if __name__ == "__main__":

@@ -74,8 +74,51 @@ def test_serve_cell_executes():
     assert res["batch"] == 2 and res["new_tokens"] == 6
 
 
-def test_cleanup_cell_removes_bench_temporaries():
-    ns = {"_p": 1, "_big_buf": 2, "__keep__": 3, "user_var": 4}
-    exec(compile(bench.CLEANUP_CELL, "<cell>", "exec"), ns)
-    assert "_p" not in ns and "_big_buf" not in ns
-    assert ns["__keep__"] == 3 and ns["user_var"] == 4
+def test_run_families_bails_after_consecutive_spawn_failures():
+    """Two consecutive SPAWN_FAILED results (tunnel gone) must stop
+    the family sweep instead of paying the attach timeout per
+    remaining family."""
+    calls = []
+
+    def fake_measure(backend, name, cell, timeout):
+        calls.append(name)
+        return bench.SPAWN_FAILED
+
+    extra: dict = {}
+    fams = [(n, "cell", 1) for n in ("a", "b", "c", "d")]
+    bench.run_families("tpu", fams, extra, measure=fake_measure)
+    assert calls == ["a", "b"]
+    assert extra == {}
+
+
+def test_run_families_single_spawn_failure_continues():
+    """A lone spawn failure (transient flap) must not end the sweep,
+    and a later success resets the failure counter."""
+    results = {"a": bench.SPAWN_FAILED, "b": {"x": 1},
+               "c": bench.SPAWN_FAILED, "d": {"y": 2}}
+    calls = []
+
+    def fake_measure(backend, name, cell, timeout):
+        calls.append(name)
+        return results[name]
+
+    extra: dict = {}
+    fams = [(n, "cell", 1) for n in ("a", "b", "c", "d")]
+    bench.run_families("tpu", fams, extra, measure=fake_measure)
+    assert calls == ["a", "b", "c", "d"]
+    assert extra == {"b": {"x": 1}, "d": {"y": 2}}
+
+
+def test_run_families_cell_failure_is_not_spawn_failure():
+    """None (cell failed, world healthy) never trips the bail-out."""
+    calls = []
+
+    def fake_measure(backend, name, cell, timeout):
+        calls.append(name)
+        return None
+
+    extra: dict = {}
+    fams = [(n, "cell", 1) for n in ("a", "b", "c")]
+    bench.run_families("tpu", fams, extra, measure=fake_measure)
+    assert calls == ["a", "b", "c"]
+    assert extra == {}
