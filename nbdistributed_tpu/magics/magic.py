@@ -250,6 +250,10 @@ class DistributedMagics(Magics):
                    "training mode)")
     @argument("--chips-per-worker", type=int, default=1,
               help="TPU chips owned by each worker process")
+    @argument("--chips", default=None,
+              help="explicit TPU chip ids, comma-separated (e.g. "
+                   "'2,3') — pin workers to specific chips on a "
+                   "shared host; the reference's --gpu-ids analog")
     @argument("--attach-timeout", type=float, default=180.0,
               help="seconds to wait for workers to come up")
     @argument("--hosts", default=None,
@@ -268,6 +272,31 @@ class DistributedMagics(Magics):
             return
         t0 = time.time()
         num_workers = args.num_workers
+        # Explicit chip pinning (reference: magic.py:454-488): parse
+        # and sanity-check before anything spawns; full count/dup/
+        # availability validation happens pre-spawn in start_workers.
+        chips = None
+        if args.chips:
+            from ..manager import topology as _topo
+            try:
+                chips = _topo.parse_chips(args.chips)
+            except ValueError as e:
+                print(f"❌ {e}")
+                return
+            if args.hosts:
+                print("❌ --chips is a single-host option; host plans "
+                      "assign whole hosts, not chips.")
+                return
+            backend_now = (args.backend if args.backend != "auto"
+                           else _topo.detect_backend())
+            if backend_now != "tpu":
+                # Reference parity: "CUDA not available, GPU IDs will
+                # be ignored" (magic.py:481-483).
+                print("⚠️  TPU backend not active, chip IDs will be "
+                      "ignored")
+                chips = None
+            else:
+                print(f"Using TPU chips: {chips}")
         host_specs = None
         if args.hosts:
             if args.chips_per_worker != 1:
@@ -313,7 +342,8 @@ class DistributedMagics(Magics):
             else:
                 pm.start_workers(num_workers, comm.port,
                                  backend=args.backend,
-                                 chips_per_worker=args.chips_per_worker)
+                                 chips_per_worker=args.chips_per_worker,
+                                 chips=chips)
             from ..manager import wait_until_ready
             wait_until_ready(
                 comm, pm, args.attach_timeout,

@@ -113,8 +113,13 @@ class ProcessManager:
     def start_workers(self, num_workers: int, control_port: int, *,
                       backend: str = "auto", coordinator_host: str = "127.0.0.1",
                       chips_per_worker: int = 1,
+                      chips: list[int] | None = None,
                       extra_env: dict | None = None) -> None:
         """Spawn ``num_workers`` worker processes on this host.
+
+        ``chips`` pins the workers to an explicit (possibly
+        non-contiguous) chip set — the reference's ``gpu_ids`` analog
+        (reference: process_manager.py:107-112); TPU backend only.
 
         The caller (magic layer) pairs this with
         ``CommunicationManager.wait_for_workers``; use
@@ -129,14 +134,16 @@ class ProcessManager:
             # Fail fast, before any child exists, when the topology
             # can't fit this host's chips (reference validates GPU ids
             # against device_count pre-spawn: magic.py:454-488).
-            topology.validate_tpu_request(num_workers, chips_per_worker)
+            topology.validate_tpu_request(num_workers, chips_per_worker,
+                                          chips=chips)
         self.backend = backend
         self.world_size = num_workers
         self.dist_port = find_free_port() if num_workers > 1 else None
 
         for rank in range(num_workers):
             env = topology.worker_env(rank, num_workers, backend,
-                                      chips_per_worker=chips_per_worker)
+                                      chips_per_worker=chips_per_worker,
+                                      chips=chips)
             if extra_env:
                 env.update(extra_env)
             cmd = [sys.executable, "-m", "nbdistributed_tpu.runtime.worker",

@@ -34,8 +34,37 @@ def cpu_worker_env(base: dict | None = None) -> dict:
     return env
 
 
+def parse_chips(spec: str) -> list[int]:
+    """Parse an explicit chip-id list (``"2,3"``) — the analog of the
+    reference's ``--gpu-ids`` parse (reference: magic.py:456-459, with
+    its bad-format message at magic.py:485-488)."""
+    try:
+        chips = [int(x.strip()) for x in spec.split(",")]
+    except ValueError:
+        raise ValueError(
+            "Invalid chip IDs format. Use comma-separated integers "
+            "(e.g. '0,1,3')") from None
+    if not chips:
+        raise ValueError("empty chip ID list")
+    if any(c < 0 for c in chips):
+        raise ValueError(f"chip IDs must be >= 0, got {chips}")
+    return chips
+
+
+def _chips_for_rank(chips: list[int], rank: int,
+                    chips_per_worker: int) -> list[int]:
+    """Rank's slice of an explicit chip list, with modulo recycling
+    when the list is short (parity with the reference's
+    process_manager.py:107-112 fallback; the validated magic path
+    rejects short lists before this can engage)."""
+    base = rank * chips_per_worker
+    return [chips[(base + i) % len(chips)]
+            for i in range(chips_per_worker)]
+
+
 def tpu_worker_env(rank: int, world_size: int, *,
                    chips_per_worker: int = 1,
+                   chips: list[int] | None = None,
                    tpu_process_base_port: int = 8476,
                    base: dict | None = None) -> dict:
     """Env for a TPU worker owning ``chips_per_worker`` chips of a
@@ -45,8 +74,11 @@ def tpu_worker_env(rank: int, world_size: int, *,
     ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` carve the
     chip grid, ``TPU_VISIBLE_CHIPS`` pins this worker's chips, and
     ``TPU_PROCESS_ADDRESSES`` lists every worker's TPU-runtime port.
-    Multi-host pods need per-host launch instead (SURVEY §5.8 notes the
-    reference has the same single-node assumption at worker.py:129).
+    ``chips`` pins an explicit (possibly non-contiguous) chip set —
+    the analog of the reference's ``--gpu-ids`` assignment (reference:
+    process_manager.py:107-112); default is chips 0..N-1.  Multi-host
+    pods need per-host launch instead (SURVEY §5.8 notes the reference
+    has the same single-node assumption at worker.py:129).
     """
     env = dict(base if base is not None else os.environ)
     total_chips = world_size * chips_per_worker
@@ -59,15 +91,19 @@ def tpu_worker_env(rank: int, world_size: int, *,
         px, py = grid
         env["TPU_PROCESS_BOUNDS"] = f"{px},{py},1"
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
-        env["TPU_VISIBLE_CHIPS"] = str(rank)
+        env["TPU_VISIBLE_CHIPS"] = (
+            str(_chips_for_rank(chips, rank, 1)[0])
+            if chips else str(rank))
     else:
         # One worker spanning several chips (e.g. 2 workers x 4 chips).
         env["TPU_PROCESS_BOUNDS"] = f"1,{world_size},1"
         cx, cy = _V5E_GRIDS.get(chips_per_worker, (1, chips_per_worker))
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{cx},{cy},1"
-        first = rank * chips_per_worker
-        env["TPU_VISIBLE_CHIPS"] = ",".join(
-            str(first + i) for i in range(chips_per_worker))
+        mine = (_chips_for_rank(chips, rank, chips_per_worker)
+                if chips else
+                range(rank * chips_per_worker,
+                      (rank + 1) * chips_per_worker))
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in mine)
     env["TPU_PROCESS_ADDRESSES"] = ",".join(
         f"localhost:{tpu_process_base_port + r}" for r in range(world_size))
     env["TPU_PROCESS_PORT"] = str(tpu_process_base_port + rank)
@@ -76,12 +112,14 @@ def tpu_worker_env(rank: int, world_size: int, *,
 
 
 def worker_env(rank: int, world_size: int, backend: str, *,
-               chips_per_worker: int = 1, base: dict | None = None) -> dict:
+               chips_per_worker: int = 1, chips: list[int] | None = None,
+               base: dict | None = None) -> dict:
     if backend == "cpu":
         return cpu_worker_env(base)
     if backend == "tpu":
         return tpu_worker_env(rank, world_size,
-                              chips_per_worker=chips_per_worker, base=base)
+                              chips_per_worker=chips_per_worker,
+                              chips=chips, base=base)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -109,12 +147,41 @@ def available_tpu_chips() -> int | None:
     return None
 
 
-def validate_tpu_request(world_size: int, chips_per_worker: int) -> None:
+def validate_tpu_request(world_size: int, chips_per_worker: int,
+                         chips: list[int] | None = None) -> None:
     """Fail fast (before any spawn) when the requested topology cannot
     fit this host's chips — N workers dying inside the TPU runtime is a
-    much worse error message."""
+    much worse error message.
+
+    With an explicit ``chips`` list, mirrors the reference's pre-spawn
+    GPU-id validation (reference: magic.py:454-488): every id must
+    exist on this host, and the list must cover ``-n`` workers.  Two
+    departures, both because TPU runtime processes cannot share a chip
+    the way CUDA contexts share a GPU: short lists are rejected here
+    (the reference's API layer would recycle ids modulo, mapping two
+    processes onto one device) and so are duplicate ids.
+    """
     need = world_size * chips_per_worker
     have = available_tpu_chips()
+    if chips is not None:
+        if len(chips) < need:
+            raise ValueError(
+                f"Not enough chip IDs specified. Need {need} "
+                f"({world_size} worker(s) × {chips_per_worker} "
+                f"chip(s)), got {len(chips)}. Either specify more "
+                f"chip IDs or reduce -n.")
+        used = chips[:need]
+        dups = sorted({c for c in used if used.count(c) > 1})
+        if dups:
+            raise ValueError(
+                f"duplicate chip IDs {dups}: TPU runtime processes "
+                f"cannot share a chip")
+        if have is not None:
+            invalid = sorted({c for c in used if c >= have})
+            if invalid:
+                raise ValueError(
+                    f"Invalid chip IDs: {invalid}. Available chips: "
+                    f"{list(range(have))}")
     if have is not None and need > have:
         # Suggest the largest world size that both fits the host AND
         # lands on a supported grid — advice the next attempt can

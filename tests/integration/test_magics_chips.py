@@ -1,0 +1,68 @@
+"""%dist_init --chips: the reference's --gpu-ids surface
+(reference: magic.py:454-488) on the TPU chip-partitioning contract.
+
+Error paths run before any worker spawns, so these drive a real
+IPython shell WITHOUT the module-scoped cluster the e2e tests use.
+"""
+
+import pytest
+
+pytestmark = [pytest.mark.integration]
+
+
+@pytest.fixture()
+def shell():
+    from IPython.testing.globalipapp import get_ipython, start_ipython
+
+    ip = start_ipython() or get_ipython()
+    ip.run_line_magic("load_ext", "nbdistributed_tpu")
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is None, \
+        "these tests need a cluster-free shell"
+    yield ip
+    if DistributedMagics._comm is not None:
+        ip.run_line_magic("dist_shutdown", "")
+
+
+def test_chips_bad_format_rejected_before_spawn(shell, capsys):
+    shell.run_line_magic("dist_init", "-n 2 --chips 2,x")
+    out = capsys.readouterr().out
+    assert "comma-separated integers" in out
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is None
+
+
+def test_chips_conflicts_with_hosts(shell, capsys):
+    shell.run_line_magic(
+        "dist_init", "-n 2 --chips 0,1 --hosts local:2")
+    out = capsys.readouterr().out
+    assert "single-host option" in out
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is None
+
+
+def test_chips_validation_fails_fast_on_tpu(shell, capsys, monkeypatch):
+    """-n 2 with a 1-id list on an (simulated) 4-chip TPU host: the
+    pre-spawn validator rejects it with the reference's message."""
+    from nbdistributed_tpu.manager import topology
+
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 4)
+    shell.run_line_magic("dist_init", "-n 2 --backend tpu --chips 3")
+    out = capsys.readouterr().out
+    assert "Not enough chip IDs" in out
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is None
+
+
+def test_chips_ignored_on_cpu_backend(shell, capsys):
+    """Reference parity ("CUDA not available, GPU IDs will be
+    ignored"): a cpu world starts normally, chips dropped."""
+    shell.run_line_magic(
+        "dist_init", "-n 2 --backend cpu --chips 0,1 "
+                     "--attach-timeout 120 -t 60")
+    out = capsys.readouterr().out
+    assert "chip IDs will be ignored" in out
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is not None   # world came up anyway
+    shell.run_line_magic("dist_shutdown", "")
+    capsys.readouterr()

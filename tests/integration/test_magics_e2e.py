@@ -10,9 +10,11 @@ pytestmark = [pytest.mark.integration]
 
 @pytest.fixture(scope="module")
 def ip():
-    from IPython.testing.globalipapp import start_ipython
+    from IPython.testing.globalipapp import get_ipython, start_ipython
 
-    shell = start_ipython()
+    # start_ipython() returns the shell only on its FIRST call per
+    # process; any earlier IPython-driving module leaves it None.
+    shell = start_ipython() or get_ipython()
     shell.run_line_magic("load_ext", "nbdistributed_tpu")
     shell.run_line_magic(
         "dist_init", "-n 2 --backend cpu --attach-timeout 180 -t 120")

@@ -74,6 +74,101 @@ def test_start_workers_tpu_fails_fast_before_spawn(monkeypatch):
     assert not pm.processes
 
 
+# ---------------------------------------------------------------------
+# explicit chip pinning (--chips): the reference's --gpu-ids analog
+# (reference: magic.py:454-488 validation, process_manager.py:107-112
+# assignment/recycling)
+
+def test_parse_chips():
+    assert topology.parse_chips("2,3") == [2, 3]
+    assert topology.parse_chips(" 0, 1 ,3") == [0, 1, 3]
+
+
+def test_parse_chips_bad_format():
+    with pytest.raises(ValueError, match="comma-separated integers"):
+        topology.parse_chips("2,x")
+    with pytest.raises(ValueError, match="comma-separated integers"):
+        topology.parse_chips("2;3")
+    with pytest.raises(ValueError, match=">= 0"):
+        topology.parse_chips("0,-1")
+
+
+def test_chip_pinning_env_non_contiguous(monkeypatch):
+    """--chips 2,3 on a shared host: rank r pins chips[r], not r."""
+    for rank, want in ((0, "2"), (1, "3")):
+        env = topology.tpu_worker_env(rank, 2, chips=[2, 3], base={})
+        assert env["TPU_VISIBLE_CHIPS"] == want
+        assert env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+
+
+def test_chip_pinning_env_multi_chip_worker():
+    """chips_per_worker=2 with an explicit list: consecutive slices."""
+    env0 = topology.tpu_worker_env(0, 2, chips_per_worker=2,
+                                   chips=[4, 5, 6, 7], base={})
+    env1 = topology.tpu_worker_env(1, 2, chips_per_worker=2,
+                                   chips=[4, 5, 6, 7], base={})
+    assert env0["TPU_VISIBLE_CHIPS"] == "4,5"
+    assert env1["TPU_VISIBLE_CHIPS"] == "6,7"
+
+
+def test_chip_pinning_env_recycles_modulo():
+    """API-layer parity with the reference's modulo fallback
+    (process_manager.py:107-112); the validated path rejects short
+    lists before this engages."""
+    env = topology.tpu_worker_env(1, 2, chips=[5], base={})
+    assert env["TPU_VISIBLE_CHIPS"] == "5"
+
+
+def test_validate_chips_not_enough(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    with pytest.raises(ValueError, match="Not enough chip IDs"):
+        topology.validate_tpu_request(4, 1, chips=[2, 3])
+    with pytest.raises(ValueError, match="Need 4"):
+        topology.validate_tpu_request(2, 2, chips=[0, 1, 2])
+
+
+def test_validate_chips_duplicates(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    with pytest.raises(ValueError, match="duplicate chip IDs"):
+        topology.validate_tpu_request(2, 1, chips=[3, 3])
+
+
+def test_validate_chips_invalid_vs_available(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 4)
+    with pytest.raises(ValueError) as e:
+        topology.validate_tpu_request(2, 1, chips=[2, 9])
+    msg = str(e.value)
+    assert "Invalid chip IDs: [9]" in msg
+    assert "[0, 1, 2, 3]" in msg
+
+
+def test_validate_chips_ok(monkeypatch):
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 4)
+    topology.validate_tpu_request(2, 1, chips=[2, 3])   # no raise
+    # Extra ids beyond the need are allowed (first N used) and not
+    # held against availability.
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 2)
+    topology.validate_tpu_request(2, 1, chips=[0, 1, 9])
+
+
+def test_validate_chips_unknown_count(monkeypatch):
+    """No probe signal: format/count/dup checks still apply, the
+    availability check is skipped."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: None)
+    topology.validate_tpu_request(2, 1, chips=[6, 7])
+
+
+def test_start_workers_rejects_bad_chip_request(monkeypatch):
+    from nbdistributed_tpu.manager import ProcessManager
+
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 4)
+    pm = ProcessManager()
+    with pytest.raises(ValueError, match="Not enough chip IDs"):
+        pm.start_workers(4, 55555, backend="tpu", chips=[1, 2])
+    assert not pm.processes
+
+
 class _FakeComm:
     num_workers = 4
 
