@@ -351,34 +351,46 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
 # ----------------------------------------------------------------------
 # sampling + the decode loop
 
-def _sample(logits, temperature: float, key, top_k: int | None = None,
-            top_p: float | None = None):
-    """logits: (B, vocab) -> (B,) int32.
+def truncate_logits(logits, top_k: int | None = None,
+                    top_p: float | None = None):
+    """Mask ``logits`` (…, vocab) outside the ``top_k`` largest and/or
+    the smallest ``top_p`` nucleus (Holtzman et al. 2019) to ``-inf``.
 
-    Greedy at ``temperature == 0``; otherwise categorical over the
-    temperature-scaled logits, optionally truncated to the ``top_k``
-    most likely tokens and/or the smallest ``top_p`` nucleus (Holtzman
-    et al. 2019).  Both filters are static-shape (sort + mask, no
-    data-dependent shapes) so the whole sampler jits and scans."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+    Both filters are static-shape (sort + mask, no data-dependent
+    shapes) so every consumer jits and scans.  Callers apply
+    temperature *before* filtering — the nucleus depends on it.
+    Shared by :func:`_sample` and the speculative path (which filters
+    draft AND target distributions with the same knobs, making the
+    accepted output distribution equal the truncated target's)."""
     if top_k is not None:
         # Mask everything below the k-th largest logit per row.
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]          # (B, 1)
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         # Nucleus: keep the smallest prefix of the sorted distribution
         # with cumulative probability >= top_p.  The shifted cumsum
         # keeps every token whose *preceding* mass is < top_p, so the
         # top-1 token always survives.
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1) - probs
         cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1,
                              keepdims=True) - 1
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _sample(logits, temperature: float, key, top_k: int | None = None,
+            top_p: float | None = None):
+    """logits: (B, vocab) -> (B,) int32.
+
+    Greedy at ``temperature == 0``; otherwise categorical over the
+    temperature-scaled logits, optionally truncated by
+    :func:`truncate_logits`."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = truncate_logits(logits / temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

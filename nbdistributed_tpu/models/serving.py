@@ -100,11 +100,6 @@ class DecodeServer:
                                  "vocabulary")
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
-            if top_k is not None or top_p is not None:
-                raise ValueError("speculative serving supports plain "
-                                 "temperature sampling only (the "
-                                 "acceptance rule is defined on the "
-                                 "untruncated distributions)")
         from .moe import MoEConfig
         if isinstance(cfg, MoEConfig):
             # Expert capacity is computed from the *static* token count
@@ -239,6 +234,7 @@ class DecodeServer:
         cfg, dcfg = self._cfg, self._draft_cfg
         gamma, temperature = self._gamma, self._temperature
         mesh, ep_axis = self._mesh, self._ep_axis
+        top_k, top_p = self._top_k, self._top_p
 
         def fn(params, draft_params, cache_t, lens_t, cache_d, lens_d,
                last, active, key):
@@ -248,7 +244,7 @@ class DecodeServer:
                 temperature=temperature, cache_t=cache_t,
                 len_t=lens_t, cache_d=cache_d, len_d=lens_d,
                 last_tok=last, key=key, active=active, mesh=mesh,
-                ep_axis=ep_axis)
+                ep_axis=ep_axis, top_k=top_k, top_p=top_p)
             return cache_t, lens_t, cache_d, lens_d, cand, n_acc, \
                 new_last
 

@@ -53,10 +53,12 @@ stream.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
-from .generate import forward_with_cache, init_kv_cache
+from .generate import forward_with_cache, init_kv_cache, truncate_logits
 from .transformer import TransformerConfig
 
 
@@ -66,7 +68,8 @@ def _greedy_tok(logits):
 
 def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
                temperature: float, cache_t, len_t, cache_d, len_d,
-               last_tok, key, active, mesh=None, ep_axis: str = "ep"):
+               last_tok, key, active, mesh=None, ep_axis: str = "ep",
+               top_k: int | None = None, top_p: float | None = None):
     """ONE draft-propose / target-verify round for B streams — the
     engine shared by :func:`speculative_generate`'s closed loop and
     the continuous-batching server's speculative mode.
@@ -93,7 +96,7 @@ def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
             draft_params, tok[:, None], cache_d, len_d, draft_cfg,
             row_mask=active, mesh=mesh, ep_axis=ep_axis)
         key, ks = jax.random.split(key)
-        nxt = _sample_1(lg[:, -1], temperature, ks)  # (B,)
+        nxt = _sample_1(lg[:, -1], temperature, ks, top_k, top_p)  # (B,)
         return (cache_d, len_d + 1, nxt, key), (nxt, lg[:, -1])
 
     (cache_d, _, _, key), (drafts, draft_logits) = \
@@ -120,8 +123,11 @@ def spec_round(params, draft_params, cfg, draft_cfg, *, gamma: int,
         row_mask=active, mesh=mesh, ep_axis=ep_axis)  # (B, g+1, V)
 
     key, kacc, kfix = jax.random.split(key, 3)
+    # top_k/top_p bind via partial (static ints for lax.top_k — they
+    # must not pass through vmap as mapped operands).
     n_acc, next_tok = jax.vmap(
-        _accept, in_axes=(1, 1, 0, None, 0, 0))(
+        functools.partial(_accept, top_k=top_k, top_p=top_p),
+        in_axes=(1, 1, 0, None, 0, 0))(
         drafts, draft_logits, logits_v, temperature,
         jax.random.split(kacc, B), jax.random.split(kfix, B))
 
@@ -139,6 +145,8 @@ def speculative_generate(params: dict, draft_params: dict,
                          draft_cfg: TransformerConfig,
                          max_new_tokens: int, *, gamma: int = 4,
                          temperature: float = 0.0, key=None,
+                         top_k: int | None = None,
+                         top_p: float | None = None,
                          max_len: int | None = None,
                          kv_quantized: bool = False,
                          mesh=None, ep_axis: str = "ep"):
@@ -150,7 +158,11 @@ def speculative_generate(params: dict, draft_params: dict,
     own greedy decode (see the module docstring for the
     batched-vs-stepwise numerics caveat); otherwise the
     rejection-sampling scheme preserves the target's sampling
-    distribution per stream (``key`` required).
+    distribution per stream (``key`` required).  ``top_k``/``top_p``
+    compose with sampling via truncation-aware acceptance (draft
+    proposals and the rejection test both use the truncated
+    distributions — see :func:`_accept`): the output distribution
+    equals ``generate(..., top_k=, top_p=)``'s.
 
     Returns (tokens (B, S0 + max_new_tokens), mean_accepted) — the
     second value is the average number of draft tokens accepted per
@@ -178,6 +190,11 @@ def speculative_generate(params: dict, draft_params: dict,
                          f"{max_new_tokens}")
     if temperature != 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size="
+                         f"{cfg.vocab_size}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -208,7 +225,8 @@ def speculative_generate(params: dict, draft_params: dict,
                                     mesh=mesh, ep_axis=ep_axis)
 
     key, k0 = jax.random.split(key)
-    first = _sample_1(logits_t[:, -1], temperature, k0)      # (B,)
+    first = _sample_1(logits_t[:, -1], temperature, k0,
+                      top_k, top_p)                          # (B,)
 
     toks = jnp.zeros((B, buf_len), jnp.int32)
     toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
@@ -240,7 +258,8 @@ def speculative_generate(params: dict, draft_params: dict,
                        gamma=gamma, temperature=temperature,
                        cache_t=cache_t, len_t=len_t, cache_d=cache_d,
                        len_d=len_d, last_tok=last_tok, key=key,
-                       active=active, mesh=mesh, ep_axis=ep_axis)
+                       active=active, mesh=mesh, ep_axis=ep_axis,
+                       top_k=top_k, top_p=top_p)
 
         # --- commit ------------------------------------------------
         # Write all gamma+1 candidate slots per row; only the first
@@ -269,27 +288,42 @@ def speculative_generate(params: dict, draft_params: dict,
     return out, mean_acc
 
 
-def _sample_1(logits, temperature: float, key):
-    """(B, V) or (V,) logits -> (B,) int32 tokens (independent rows)."""
+def _sample_1(logits, temperature: float, key,
+              top_k: int | None = None, top_p: float | None = None):
+    """(B, V) or (V,) logits -> (B,) int32 tokens (independent rows).
+    ``top_k``/``top_p`` truncate the distribution before sampling
+    (see :func:`~.generate.truncate_logits`)."""
     if temperature == 0.0:
         return _greedy_tok(jnp.atleast_2d(logits))
-    return jax.random.categorical(
-        key, jnp.atleast_2d(logits) / temperature, axis=-1).astype(
-            jnp.int32)
+    logits = truncate_logits(jnp.atleast_2d(logits) / temperature,
+                             top_k, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def _accept(drafts, draft_logits, verify_logits, temperature: float,
-            kacc, kfix):
+            kacc, kfix, *, top_k: int | None = None,
+            top_p: float | None = None):
     """Acceptance rule for one round of one stream (vmapped over B).
 
     drafts: (g,) proposed tokens; draft_logits: (g, V) the draft's
     logits at each proposal; verify_logits: (g+1, V) the target's
     logits at [newest, d_1..d_g] — position i scores d_{i+1}.
     Returns (n_acc in [0, g], next token after the accepted prefix).
+
+    ``top_k``/``top_p`` implement truncation-aware speculative
+    sampling: BOTH distributions are filtered with the same knobs
+    before the rejection test.  The accept/resample lemma holds for
+    any (p, q) pair, so the emitted distribution equals sampling from
+    the *truncated target* — exactly what ``generate(top_k=, top_p=)``
+    samples.  The draft proposals must be drawn from the same
+    truncated draft distribution (:func:`_sample_1` with the same
+    knobs), which also keeps ``q(d_i) > 0`` for every proposal.
     """
     g = drafts.shape[0]
     if temperature == 0.0:
-        # Greedy: accept while the target's argmax equals the draft.
+        # Greedy: accept while the target's argmax equals the draft
+        # (truncation never changes an argmax: top-k keeps the k
+        # largest, nucleus always keeps the top-1 token).
         tgt = _greedy_tok(verify_logits)             # (g+1,)
         match = tgt[:g] == drafts
         n_acc = jnp.argmin(jnp.concatenate(
@@ -298,8 +332,10 @@ def _accept(drafts, draft_logits, verify_logits, temperature: float,
         # (== bonus position when everything matched).
         return n_acc, tgt[n_acc]
 
-    pt = jax.nn.softmax(verify_logits / temperature, axis=-1)  # (g+1,V)
-    pd = jax.nn.softmax(draft_logits / temperature, axis=-1)   # (g,V)
+    pt = jax.nn.softmax(truncate_logits(
+        verify_logits / temperature, top_k, top_p), axis=-1)  # (g+1,V)
+    pd = jax.nn.softmax(truncate_logits(
+        draft_logits / temperature, top_k, top_p), axis=-1)   # (g,V)
     pt_i = jnp.take_along_axis(pt[:g], drafts[:, None], axis=-1)[:, 0]
     pd_i = jnp.take_along_axis(pd, drafts[:, None], axis=-1)[:, 0]
     u = jax.random.uniform(kacc, (g,))

@@ -271,14 +271,32 @@ def test_spec_serving_eos_cuts_mid_round(spec_setup):
     assert got == toks[: got.index(eos) + 1]
 
 
+def test_spec_serving_top_k1_matches_solo_greedy(spec_setup):
+    """Speculative serving with sampling + top_k=1 (deterministic
+    truncation) must reproduce the target's greedy decode per request
+    — the truncation-aware acceptance path through the server."""
+    from nbdistributed_tpu.models import generate
+
+    cfg, target, draft = spec_setup
+    srv = DecodeServer(target, cfg, max_batch=2, max_len=64, pad_to=4,
+                       temperature=0.8, top_k=1,
+                       draft_params=draft, draft_cfg=cfg, gamma=3,
+                       key=jax.random.PRNGKey(11))
+    reqs = [([5, 9, 2], 8), ([7, 1, 3, 11], 6)]
+    rids = [srv.submit(*r) for r in reqs]
+    srv.run_until_done(max_steps=100)
+    for rid, (prompt, n) in zip(rids, reqs):
+        solo = generate(target, jnp.asarray([prompt], jnp.int32),
+                        cfg, n)
+        assert srv.outputs[rid] == [int(t) for t in
+                                    solo[0, len(prompt):]]
+
+
 def test_spec_serving_validation(spec_setup):
     cfg, target, draft = spec_setup
     with pytest.raises(ValueError, match="both draft_params"):
         DecodeServer(target, cfg, max_batch=1, max_len=32,
                      draft_params=draft)
-    with pytest.raises(ValueError, match="temperature sampling only"):
-        DecodeServer(target, cfg, max_batch=1, max_len=32, top_k=4,
-                     draft_params=draft, draft_cfg=cfg)
     srv = DecodeServer(target, cfg, max_batch=1, max_len=16, pad_to=4,
                        draft_params=draft, draft_cfg=cfg, gamma=3)
     with pytest.raises(ValueError, match="speculative headroom"):

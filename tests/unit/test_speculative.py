@@ -135,12 +135,15 @@ def test_batched_sampled_runs_and_jits(setup):
     assert 0.0 <= float(acc) <= 3.0
 
 
-def test_batched_sampled_preserves_target_distribution():
+@pytest.mark.parametrize("top_k,top_p", [(None, None), (6, 0.9)])
+def test_batched_sampled_preserves_target_distribution(top_k, top_p):
     """Rejection sampling must reproduce the target's sampling
-    distribution per stream.  Small vocab (16) so empirical TV distance
-    is resolvable: compare the first *speculated* token (position
-    S0+1, decided by the accept/resample rule) against target-only
-    sampling over many keys × batch rows."""
+    distribution per stream — including truncation-aware mode, where
+    the emitted distribution must equal the *truncated* target's
+    (i.e. generate() with the same top_k/top_p).  Small vocab (16) so
+    empirical TV distance is resolvable: compare the first
+    *speculated* token (position S0+1, decided by the accept/resample
+    rule) against target-only sampling over many keys × batch rows."""
     V = 16
     cfg = TransformerConfig(vocab_size=V, d_model=32, n_layers=1,
                             n_heads=2, n_kv_heads=2, d_ff=64,
@@ -157,9 +160,10 @@ def test_batched_sampled_preserves_target_distribution():
 
     spec = jax.jit(lambda k: speculative_generate(
         params, draft, prompt, cfg, draft_cfg, 2, gamma=2,
-        temperature=temp, key=k)[0][:, 5])
+        temperature=temp, key=k, top_k=top_k, top_p=top_p)[0][:, 5])
     ref = jax.jit(lambda k: generate(
-        params, prompt, cfg, 2, temperature=temp, key=k)[:, 5])
+        params, prompt, cfg, 2, temperature=temp, key=k,
+        top_k=top_k, top_p=top_p)[:, 5])
 
     counts = jnp.zeros((2, V))
     for i in range(n_keys):
@@ -173,6 +177,41 @@ def test_batched_sampled_preserves_target_distribution():
     # n=480 draws over 16 bins: same-distribution empirical TV is
     # ~0.08; a broken accept rule shifts mass far beyond 0.2.
     assert tv < 0.2, (tv, p)
+
+
+def test_top_k1_sampled_equals_greedy(setup):
+    """top_k=1 truncates both distributions to the argmax token, so
+    sampled speculative decoding becomes deterministic and must equal
+    the target's greedy decode — a sharp end-to-end check of the
+    truncation-aware draft/accept/resample path."""
+    cfg, draft_cfg, params, draft, prompt = setup
+    ref = generate(params, prompt, cfg, max_new_tokens=10)
+    got, _ = speculative_generate(
+        params, draft, prompt, cfg, draft_cfg, 10, gamma=3,
+        temperature=0.7, key=jax.random.PRNGKey(3), top_k=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_truncated_self_draft_accepts_everything(setup):
+    """Draft == target under truncation: identical truncated
+    distributions give acceptance probability 1 for every proposal."""
+    cfg, _, params, _, prompt = setup
+    _, mean_acc = speculative_generate(
+        params, params, prompt, cfg, cfg, 8, gamma=4, temperature=0.9,
+        key=jax.random.PRNGKey(5), top_k=8, top_p=0.95)
+    assert float(mean_acc) == 4.0
+
+
+def test_truncation_validation(setup):
+    cfg, draft_cfg, params, draft, prompt = setup
+    with pytest.raises(ValueError, match="top_k"):
+        speculative_generate(params, draft, prompt, cfg, draft_cfg, 4,
+                             temperature=1.0, key=jax.random.PRNGKey(0),
+                             top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_generate(params, draft, prompt, cfg, draft_cfg, 4,
+                             temperature=1.0, key=jax.random.PRNGKey(0),
+                             top_p=1.5)
 
 
 @pytest.mark.parametrize("B,S0,new,gamma", [
