@@ -32,9 +32,10 @@ class MoEConfig(TransformerConfig):
     # "dense" = one-hot dispatch einsums (O(T^2) in tokens, the
     # oracle); "sparse" = sort/segment routing (linear in tokens,
     # bit-identical drops); "dropless" = MegaBlocks-style ragged_dot
-    # grouped matmuls (no capacity buffer, no drops; not composable
-    # with an ep mesh axis yet) — see parallel/expert.moe_ffn for the
-    # FLOP accounting and semantics.
+    # grouped matmuls (no per-expert capacity, no drops; over an ep
+    # mesh axis it becomes the shard-capacity hybrid — static
+    # per-shard exchange, drops only at whole-shard overflow) — see
+    # parallel/expert.moe_ffn for the FLOP accounting and semantics.
     moe_dispatch: str = "dense"
 
     def num_params(self) -> int:

@@ -91,6 +91,11 @@ class DecodeServer:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_to < 1:
             raise ValueError(f"pad_to must be >= 1, got {pad_to}")
+        if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+            raise ValueError(f"top_k must be in [1, vocab_size="
+                             f"{cfg.vocab_size}], got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("pass both draft_params and draft_cfg, "
                              "or neither")

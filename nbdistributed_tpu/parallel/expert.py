@@ -202,6 +202,105 @@ def _dropless_ffn(xt, params, gates, expert_idx, E: int,
     return jnp.zeros((T, D), xt.dtype).at[tok].add(rows * w[:, None])
 
 
+def _dropless_ffn_ep(xt, params, gates, expert_idx, E: int, mesh,
+                     ep_axis: str, capacity_factor: float,
+                     token_mask=None, capacity: int | None = None):
+    """Expert-parallel dropless: a static shard-level exchange feeding
+    locally dropless ``ragged_dot`` segments.
+
+    True dropless dispatch (variable per-expert group sizes) cannot
+    cross an SPMD shard boundary — the exchange needs a static shape.
+    The hybrid: tokens sort by expert exactly as in the replicated
+    dropless path, but the static exchange buffer is bounded per
+    *shard* (``Cs = ceil(cf·kT/ep)``, pooled over the shard's E/ep
+    experts), not per expert.  Inside each shard (a ``shard_map`` over
+    ``ep_axis``) the expert segments stay variable-size and every
+    received token is computed — so the only drop point is whole-shard
+    overflow, which pools the per-expert slack (a hot expert borrows
+    headroom from its shard-mates; per-expert capacity has no such
+    pooling) and vanishes once ``cf·kT/ep`` reaches ``kT``.  The
+    ``with_sharding_constraint`` on the (ep, Cs, D) buffer makes GSPMD
+    compile the exchange as an all_to_all over ICI, as in the
+    dense/sparse paths.
+    """
+    from ..models.transformer import is_quantized
+
+    T, D = xt.shape
+    k = expert_idx.shape[1]
+    n_ep = mesh.shape[ep_axis]
+    if E % n_ep:
+        raise ValueError(f"n_experts {E} not divisible by ep axis "
+                         f"size {n_ep}")
+    E_loc = E // n_ep
+    kT = k * T
+    # Same formula as the per-expert paths, pooled at shard level:
+    # "experts" = shards, so the bound is ceil(cf·kT/ep) rounded to 8.
+    Cs = (capacity if capacity is not None
+          else compute_capacity(T, n_ep, k, capacity_factor))
+    Cs = min(Cs, kT)   # a shard can never receive more than kT rows
+
+    order, e_sorted, tok, counts = _route_sort(expert_idx, E,
+                                               token_mask)
+    counts_e = counts[:E]
+    # Sorted rows are contiguous per shard (expert ids ascending =>
+    # shard ids ascending); position within the shard's segment is the
+    # row index minus the shard's start row.
+    s_sorted = e_sorted // E_loc                  # sentinel rows: n_ep
+    shard_counts = counts_e.reshape(n_ep, E_loc).sum(axis=1)
+    shard_starts = jnp.cumsum(shard_counts) - shard_counts
+    pos = (jnp.arange(kT, dtype=jnp.int32)
+           - shard_starts[jnp.minimum(s_sorted, n_ep - 1)])
+    keep = (e_sorted < E) & (pos < Cs)
+    slot = jnp.where(keep, s_sorted * Cs + pos,
+                     n_ep * Cs).astype(jnp.int32)
+    buf = jnp.zeros((n_ep * Cs, D), xt.dtype).at[slot].set(
+        xt[tok], mode="drop").reshape(n_ep, Cs, D)
+
+    # Per-expert group sizes AFTER the shard cut: expert e's rows sit
+    # at within-shard positions [off_e, off_e + n_e); kept are < Cs.
+    off_e = (jnp.cumsum(counts_e) - counts_e
+             - shard_starts[jnp.arange(E) // E_loc])
+    gs_kept = (jnp.clip(off_e + counts_e, 0, Cs)
+               - jnp.clip(off_e, 0, Cs)).astype(jnp.int32)   # (E,)
+
+    sh = NamedSharding(mesh, P(ep_axis, None, None))
+    buf = jax.lax.with_sharding_constraint(buf, sh)   # a2a in
+
+    def wspec(w):
+        if is_quantized(w):
+            return {"q8": P(ep_axis, None, None),
+                    "s": P(ep_axis, None, None)}
+        return P(ep_axis, None, None)
+
+    def local_ffn(b, gs, wg, wu, wd):
+        x = b[0]                                      # (Cs, D)
+        # Row -> local expert id, from the kept group sizes (rows past
+        # the covered total are zeros and land on the clipped last id).
+        e_row = jnp.minimum(
+            jnp.searchsorted(jnp.cumsum(gs),
+                             jnp.arange(x.shape[0]), side="right"),
+            gs.shape[0] - 1)
+        h = (jax.nn.silu(_ragged_expert_linear(x, wg, gs, e_row))
+             * _ragged_expert_linear(x, wu, gs, e_row))
+        return _ragged_expert_linear(h, wd, gs, e_row)[None]
+
+    buf_out = jax.shard_map(
+        local_ffn, mesh=mesh,
+        in_specs=(P(ep_axis, None, None), P(ep_axis),
+                  wspec(params["w_gate"]), wspec(params["w_up"]),
+                  wspec(params["w_down"])),
+        out_specs=P(ep_axis, None, None), check_vma=False)(
+        buf, gs_kept, params["w_gate"], params["w_up"],
+        params["w_down"])
+    buf_out = jax.lax.with_sharding_constraint(buf_out, sh)  # a2a out
+
+    g_sorted = gates.T.reshape(-1)[order]
+    w = jnp.where(keep, g_sorted, 0.0).astype(xt.dtype)
+    rows = jnp.take(buf_out.reshape(n_ep * Cs, D), slot, axis=0,
+                    mode="fill", fill_value=0)
+    return jnp.zeros((T, D), xt.dtype).at[tok].add(rows * w[:, None])
+
+
 def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
     """Sort/segment routing: the same Switch priority rule as
     :func:`make_dispatch` without materializing any (T, E, C) tensor.
@@ -257,17 +356,20 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
       token count**, no T×E×C tensor anywhere.  Same shardings
       constrained under a mesh.
 
-    * ``"dropless"`` — MegaBlocks-style: no capacity buffer at all.
-      Tokens sort by expert and the SwiGLU runs as three
+    * ``"dropless"`` — MegaBlocks-style: no per-expert capacity
+      buffer.  Tokens sort by expert and the SwiGLU runs as three
       ``jax.lax.ragged_dot`` grouped matmuls over the variable-size
       expert segments — every token reaches every expert it routed
       to, so there are NO drops and ``capacity_factor``/``capacity``
       are ignored.  Equals the dense oracle whenever the oracle's
       capacity is lossless; under tight capacity it is the *better*
-      answer (the one capacity only approximates).  Not yet
-      composable with an ``ep`` mesh axis (variable group sizes
-      cannot be statically sharded over experts) — pass
-      ``mesh=None`` or a mesh without ``ep``.
+      answer (the one capacity only approximates).  Over an ``ep``
+      mesh axis it becomes the shard-capacity hybrid
+      (:func:`_dropless_ffn_ep`): a static per-SHARD exchange buffer
+      (``capacity_factor``/``capacity`` bound the shard total,
+      ``Cs = ceil(cf·kT/ep)``) feeds locally dropless ragged
+      segments — per-expert slack pools across each shard's E/ep
+      experts, so drops only occur at whole-shard overflow.
 
     ``token_mask`` (bool, shape ``x.shape[:-1]``): masked-out tokens
     contribute nothing — zero output, no capacity slot consumed, and
@@ -280,12 +382,6 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     """
     if dispatch_mode not in ("dense", "sparse", "dropless"):
         raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
-    if dispatch_mode == "dropless" and mesh is not None \
-            and ep_axis in mesh.shape:
-        raise ValueError(
-            "dropless dispatch cannot shard experts over an ep mesh "
-            "axis (variable group sizes); use dense/sparse for "
-            "expert parallelism")
     orig_shape = x.shape
     D = orig_shape[-1]
     xt = x.reshape(-1, D)
@@ -301,8 +397,13 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
     aux = load_balance_loss(probs, expert_idx, E, token_mask=mask_t)
 
     if dispatch_mode == "dropless":
-        y = _dropless_ffn(xt, params, gates, expert_idx, E,
-                          token_mask=mask_t)
+        if mesh is not None and ep_axis in mesh.shape:
+            y = _dropless_ffn_ep(xt, params, gates, expert_idx, E,
+                                 mesh, ep_axis, capacity_factor,
+                                 token_mask=mask_t, capacity=capacity)
+        else:
+            y = _dropless_ffn(xt, params, gates, expert_idx, E,
+                              token_mask=mask_t)
         return y.reshape(orig_shape), aux
 
     if dispatch_mode == "sparse":

@@ -461,14 +461,107 @@ class TestDropless:
         np.testing.assert_allclose(np.asarray(yl), np.asarray(yd),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_rejects_ep_mesh(self):
+    def test_ep_mesh_matches_replicated_dropless(self):
+        """Shard-capacity hybrid over a dp×ep mesh at lossless shard
+        capacity (Cs = kT) must equal the replicated dropless path
+        bit-for-bit in forward AND gradients — the exchange and the
+        local ragged segments reorder nothing observable."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nbdistributed_tpu.parallel import mesh as mesh_mod
+        from nbdistributed_tpu.parallel.tensor_parallel import \
+            apply_shardings
+        expert, p, x, E = self._setup(T=64)
+        y_ref, aux_ref = expert.moe_ffn(x, p, dispatch_mode="dropless")
+        g_ref = jax.grad(lambda x_: jnp.sum(expert.moe_ffn(
+            x_, p, dispatch_mode="dropless")[0] ** 2))(x)
+
+        mesh = mesh_mod.make_mesh({"dp": 2, "ep": 2},
+                                  devices=jax.devices()[:4])
+        ps = apply_shardings(p, mesh, expert.moe_param_shardings())
+        f = jax.jit(lambda x_, p_: expert.moe_ffn(
+            x_, p_, dispatch_mode="dropless", mesh=mesh,
+            capacity_factor=float(2 * E)))
+        y, aux = f(x, ps)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux), float(aux_ref),
+                                   rtol=1e-6)
+        g = jax.jit(jax.grad(lambda x_: jnp.sum(expert.moe_ffn(
+            x_, ps, dispatch_mode="dropless", mesh=mesh,
+            capacity_factor=float(2 * E))[0] ** 2)))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_ep_mesh_shard_overflow_drops_only_tail(self):
+        """Under a tight SHARD capacity the hybrid drops exactly the
+        sorted tail of each shard's segment; ample shard capacity is
+        drop-free even when per-expert capacity at the same total
+        would drop (the pooling property)."""
+        import jax
+        import numpy as np
+
+        from nbdistributed_tpu.parallel import mesh as mesh_mod
+        from nbdistributed_tpu.parallel.tensor_parallel import \
+            apply_shardings
+        expert, p, x, E = self._setup(T=96)
+        mesh = mesh_mod.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+        ps = apply_shardings(p, mesh, expert.moe_param_shardings())
+        y_ref, _ = expert.moe_ffn(x, p, dispatch_mode="dropless")
+        # Ample shard capacity: exact.
+        y_ample, _ = jax.jit(lambda: expert.moe_ffn(
+            x, ps, dispatch_mode="dropless", mesh=mesh,
+            capacity=2 * 96))()
+        np.testing.assert_allclose(np.asarray(y_ample),
+                                   np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+        # Tight shard capacity: still runs, deviates (rows dropped).
+        y_tight, _ = jax.jit(lambda: expert.moe_ffn(
+            x, ps, dispatch_mode="dropless", mesh=mesh, capacity=8))()
+        assert np.abs(np.asarray(y_tight)
+                      - np.asarray(y_ref)).max() > 1e-4
+
+    def test_ep_mesh_token_mask_and_quantized(self):
+        """token_mask and int8 expert weights both compose with the
+        ep-mesh hybrid."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nbdistributed_tpu.models.quant import quantize_weight
+        from nbdistributed_tpu.parallel import mesh as mesh_mod
+        from nbdistributed_tpu.parallel.tensor_parallel import \
+            apply_shardings
+        expert, p, x, E = self._setup()
+        mask = jnp.arange(x.shape[0]) % 3 != 0
+        mesh = mesh_mod.make_mesh({"ep": 2}, devices=jax.devices()[:2])
+        pq = dict(p)
+        for n in ("w_gate", "w_up", "w_down"):
+            pq[n] = quantize_weight(p[n])
+        y_ref, _ = expert.moe_ffn(x, pq, dispatch_mode="dropless",
+                                  token_mask=mask)
+        from nbdistributed_tpu.models.quant import _q_spec
+        rules = {n: (_q_spec(s) if n in ("w_gate", "w_up", "w_down")
+                     else s)
+                 for n, s in expert.moe_param_shardings().items()}
+        pqs = apply_shardings(pq, mesh, rules)
+        y, _ = jax.jit(lambda: expert.moe_ffn(
+            x, pqs, dispatch_mode="dropless", mesh=mesh,
+            capacity_factor=float(2 * E), token_mask=mask))()
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+        assert np.abs(np.asarray(y)[~np.asarray(mask)]).max() == 0
+
+    def test_ep_mesh_rejects_indivisible_experts(self):
         import jax
         import pytest
 
         from nbdistributed_tpu.parallel import mesh as mesh_mod
-        expert, p, x, E = self._setup()
-        mesh = mesh_mod.make_mesh({"ep": 4}, devices=jax.devices()[:4])
-        with pytest.raises(ValueError, match="dropless"):
+        expert, p, x, E = self._setup()      # E = 4
+        mesh = mesh_mod.make_mesh({"ep": 3}, devices=jax.devices()[:3])
+        with pytest.raises(ValueError, match="not divisible"):
             expert.moe_ffn(x, p, dispatch_mode="dropless", mesh=mesh)
 
     def test_model_level_dropless(self):

@@ -297,6 +297,10 @@ def test_spec_serving_validation(spec_setup):
     with pytest.raises(ValueError, match="both draft_params"):
         DecodeServer(target, cfg, max_batch=1, max_len=32,
                      draft_params=draft)
+    with pytest.raises(ValueError, match="top_k"):
+        DecodeServer(target, cfg, max_batch=1, max_len=32, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        DecodeServer(target, cfg, max_batch=1, max_len=32, top_p=0.0)
     srv = DecodeServer(target, cfg, max_batch=1, max_len=16, pad_to=4,
                        draft_params=draft, draft_cfg=cfg, gamma=3)
     with pytest.raises(ValueError, match="speculative headroom"):

@@ -199,7 +199,10 @@ def test_truncated_self_draft_accepts_everything(setup):
     _, mean_acc = speculative_generate(
         params, params, prompt, cfg, cfg, 8, gamma=4, temperature=0.9,
         key=jax.random.PRNGKey(5), top_k=8, top_p=0.95)
-    assert float(mean_acc) == 4.0
+    # Tolerance, not equality: batched verify and stepwise draft can
+    # tile matmuls differently, leaving pt/pd an ulp apart (the
+    # batched-vs-stepwise caveat in the module docstring).
+    assert float(mean_acc) >= 4.0 - 1e-5
 
 
 def test_truncation_validation(setup):
