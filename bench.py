@@ -631,6 +631,73 @@ def measure_family(backend: str, name: str, cell: str, timeout: int):
         _teardown(comm, pm, 1)
 
 
+def tpu_families():
+    """(name, cell, timeout) per TPU measurement family — shared by
+    the full run and the NBD_BENCH_ONLY re-measure mode."""
+    return (
+        # Flagship MFU (135M — the reference demo scale).
+        ("smol135m", MFU_CELL.format(
+            peak=V5E_PEAK_BF16, shape="(8, 2048, 10)",
+            cfg_name="smol_135m_config"), 1800),
+        # MFU at a scale where MFU means something: ~1.1B params,
+        # d_model=2048 — GEMMs a v5e MXU can fill.
+        ("tinyllama_1b", MFU_CELL.format(
+            peak=V5E_PEAK_BF16, shape="(8, 2048, 5)",
+            cfg_name="tinyllama_1b_config"), 1800),
+        # Kernel-vs-XLA only where the kernel compiles (interpret
+        # mode on CPU is orders slower by design).
+        ("flash_attn", FLASH_CELL, 900),
+        ("decode", DECODE_CELL, 1200),
+        ("speculative", SPEC_CELL, 1200),
+        ("serving", SERVE_CELL, 1200),
+        ("decode_7b_int8", DECODE7B_CELL, 1800),
+    )
+
+
+def run_families_only(names: list[str]) -> int:
+    """NBD_BENCH_ONLY mode: re-measure the named families (each in a
+    fresh worker) and MERGE the results into BENCH_TPU_LAST.json.
+
+    The watcher uses this after tune_flash.py lands a tuned block
+    table: fresh workers import the tuned sizes, so re-running just
+    the kernel families captures the post-tuning numbers without
+    paying for a full bench pass."""
+    backend = topology.detect_backend()
+    if backend != "tpu":
+        log(f"[bench] NBD_BENCH_ONLY needs a TPU backend, "
+            f"detected {backend}")
+        return 1
+    unknown = [n for n in names
+               if n not in {f[0] for f in tpu_families()}]
+    if unknown:
+        log(f"[bench] unknown families {unknown}; known: "
+            f"{[f[0] for f in tpu_families()]}")
+        return 1
+    extra: dict = {}
+    fams = [f for f in tpu_families() if f[0] in names]
+    run_families(backend, fams, extra)
+    result = {"metric": "bench_families_remeasure_tpu",
+              "value": len(extra), "unit": "families",
+              "vs_baseline": 1.0, "extra": extra}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_LAST.json")
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+        snap.setdefault("result", {}).setdefault("extra", {}).update(
+            extra)
+        snap["remeasured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        snap["remeasured_families"] = sorted(extra)
+        with open(path + ".tmp", "w") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except (OSError, ValueError) as e:
+        log(f"[bench] could not merge into snapshot: {e}")
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def run_families(backend: str, families, extra: dict,
                  measure=None) -> None:
     """Run measurement families, each in a fresh process, filling
@@ -666,6 +733,10 @@ def main() -> int:
         raise SystemExit(143)
 
     signal.signal(signal.SIGTERM, _term)
+    only = os.environ.get("NBD_BENCH_ONLY")
+    if only:
+        return run_families_only(
+            [n.strip() for n in only.split(",") if n.strip()])
     backend = topology.detect_backend()
     # World size: NBD_BENCH_WORLD env overrides; default is one worker
     # per TPU chip on this host (the bench host has 1), or 2 CPU/gloo
@@ -785,25 +856,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         if backend == "tpu":
             # Every heavy measurement family runs in its own fresh
             # worker process (see measure_family's docstring for why).
-            families = (
-                # Flagship MFU (135M — the reference demo scale).
-                ("smol135m", MFU_CELL.format(
-                    peak=V5E_PEAK_BF16, shape="(8, 2048, 10)",
-                    cfg_name="smol_135m_config"), 1800),
-                # MFU at a scale where MFU means something: ~1.1B
-                # params, d_model=2048 — GEMMs a v5e MXU can fill.
-                ("tinyllama_1b", MFU_CELL.format(
-                    peak=V5E_PEAK_BF16, shape="(8, 2048, 5)",
-                    cfg_name="tinyllama_1b_config"), 1800),
-                # Kernel-vs-XLA only where the kernel compiles
-                # (interpret mode on CPU is orders slower by design).
-                ("flash_attn", FLASH_CELL, 900),
-                ("decode", DECODE_CELL, 1200),
-                ("speculative", SPEC_CELL, 1200),
-                ("serving", SERVE_CELL, 1200),
-                ("decode_7b_int8", DECODE7B_CELL, 1800),
-            )
-            run_families(backend, families, extra)
+            run_families(backend, tpu_families(), extra)
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"

@@ -816,8 +816,11 @@ def _resolved_scale(scale, D):
 # key because it sets the q-block's batch extent inside the kernel —
 # MHA (group 1) and GQA (group > 1) tune differently at the same S/D.
 # Consulted only when the caller passes no explicit block sizes; empty
-# entries fall back to 128x128.
-TUNED_BLOCKS: dict = {}
+# entries fall back to 128x128.  Seeded from ops/tuned_blocks.json
+# (written by tune_flash.py on a live chip — see ops/_tuned.py).
+from ._tuned import load as _load_tuned
+
+TUNED_BLOCKS: dict = _load_tuned()[0]
 _DEFAULT_BLOCK = 128
 
 

@@ -191,8 +191,11 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
 # tune_flash.py's decode sweep.  Consulted when the caller passes no
 # explicit block_k; empty entries fall back to 128.  Decode is
 # HBM-streaming-bound, so the block size mostly trades grid overhead
-# against VMEM residency of the (block_k, D) cache window.
-DECODE_TUNED_BLOCKS: dict = {}
+# against VMEM residency of the (block_k, D) cache window.  Seeded
+# from ops/tuned_blocks.json (see ops/_tuned.py).
+from ._tuned import load as _load_tuned
+
+DECODE_TUNED_BLOCKS: dict = _load_tuned()[1]
 _DEFAULT_BLOCK_K = 128
 
 

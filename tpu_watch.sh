@@ -18,6 +18,11 @@ while true; do
         pkill -9 -f "nbdistributed_tpu.runtime.worker" 2>/dev/null
         timeout 3600 python -u tune_flash.py \
             > "$LOGDIR/tune_$ts.out" 2> "$LOGDIR/tune_$ts.log"
+        # The tune wrote ops/tuned_blocks.json; fresh workers import
+        # it, so re-measuring just the kernel families captures the
+        # post-tuning numbers (merged into BENCH_TPU_LAST.json).
+        NBD_BENCH_ONLY=flash_attn,decode timeout 1800 python -u bench.py \
+            > "$LOGDIR/retune_$ts.out" 2> "$LOGDIR/retune_$ts.log"
         # Kernel tests on the real chip: Mosaic enforces block-shape
         # rules the CPU interpreter does not (two real bugs found that
         # way this round).  Single-device selection only.
