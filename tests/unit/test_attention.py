@@ -8,6 +8,10 @@ import pytest
 
 from nbdistributed_tpu.ops import attention_reference, flash_attention
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def rand(shape, key, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)

@@ -10,7 +10,9 @@ import pytest
 
 import bench
 
-pytestmark = [pytest.mark.unit]
+# Heavy (exec real model cells at toy scale): excluded from the fast
+# product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
 
 
 def run_cell(src: str) -> dict:

@@ -8,6 +8,10 @@ import pytest
 
 from nbdistributed_tpu.ops.decode import flash_decode_attention
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def reference(q, kc, vc, pos):
     B, H, D = q.shape

@@ -13,6 +13,10 @@ from nbdistributed_tpu.models import (MoEConfig, init_moe_model,
 from nbdistributed_tpu.parallel import expert, mesh as mesh_mod
 from nbdistributed_tpu.parallel.tensor_parallel import apply_shardings
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def test_capacity_rounding():
     assert expert.compute_capacity(64, 4, 2, 1.0) == 32

@@ -12,6 +12,10 @@ from nbdistributed_tpu.models import (forward, forward_with_cache,
                                       make_generate_fn, param_shardings,
                                       tiny_config)
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 @pytest.fixture(scope="module")
 def setup():

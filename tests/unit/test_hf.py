@@ -13,6 +13,10 @@ transformers = pytest.importorskip("transformers")
 from nbdistributed_tpu.models import (config_from_hf, forward, generate,
                                       params_from_hf)
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def tiny_hf_llama(tie=False, n_kv=2):
     from transformers import LlamaConfig, LlamaForCausalLM

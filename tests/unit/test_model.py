@@ -13,6 +13,10 @@ from nbdistributed_tpu.models import (forward, init_params, loss_fn,
 from nbdistributed_tpu.parallel import data_parallel, mesh as mesh_mod
 from nbdistributed_tpu.parallel import tensor_parallel
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 CFG = tiny_config(dtype=jnp.float32, use_flash=False)
 
 

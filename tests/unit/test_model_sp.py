@@ -13,6 +13,10 @@ from nbdistributed_tpu.models import (SeqParallel, forward, init_params,
                                       param_shardings, tiny_config)
 from nbdistributed_tpu.parallel import mesh as mesh_mod
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -15,6 +15,10 @@ from nbdistributed_tpu.models import (dequantize_weight, forward,
                                       quantized_shardings, tiny_config)
 from nbdistributed_tpu.parallel.mesh import make_mesh
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 @pytest.fixture(scope="module")
 def setup():

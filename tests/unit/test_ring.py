@@ -9,6 +9,10 @@ from nbdistributed_tpu.ops import attention_reference
 from nbdistributed_tpu.parallel import mesh as mesh_mod
 from nbdistributed_tpu.parallel.ring import ring_attention
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def rand(shape, key):
     return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)

@@ -10,6 +10,10 @@ import pytest
 from nbdistributed_tpu.models import generate, init_params, tiny_config
 from nbdistributed_tpu.models.serving import DecodeServer
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 @pytest.fixture(scope="module")
 def setup():

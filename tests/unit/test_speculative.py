@@ -10,6 +10,10 @@ from nbdistributed_tpu.models import (TransformerConfig, generate,
                                       init_params, speculative_generate,
                                       tiny_config)
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -16,6 +16,10 @@ from nbdistributed_tpu.parallel.zero import (_add_dp,
                                              make_zero1_train_step,
                                              zero1_state_shardings)
 
+# Heavy interpret-mode kernel/model tests: excluded from the
+# fast product-path tier (`pytest -m "not slow"`).
+pytestmark = [pytest.mark.unit, pytest.mark.slow]
+
 
 def test_add_dp_first_free_divisible_axis():
     assert _add_dp(P(), (8, 6), "dp", 4) == P("dp", None)
