@@ -73,6 +73,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
             rules = kv_cache_shardings(
                 dp_axis="dp" if "dp" in mesh.shape else None,
                 tp_axis="tp" if "tp" in mesh.shape else None,
+                sp_axis="sp" if "sp" in mesh.shape else None,
                 quantized=quantized)
         missing = set(cache) - set(rules)
         if missing:
@@ -89,11 +90,16 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, *,
 
 def kv_cache_shardings(dp_axis: str | None = "dp",
                        tp_axis: str | None = "tp",
+                       sp_axis: str | None = None,
                        quantized: bool = False):
-    """PartitionSpec for the cache: batch over dp, KV heads over tp.
-    Both the int8 scales and the heads-major K/V buffers carry the KV
-    heads at axis 2."""
-    spec = P(None, dp_axis, tp_axis, None, None)
+    """PartitionSpec for the cache: batch over dp, KV heads over tp,
+    and optionally the TOKEN axis over ``sp_axis`` — sequence-parallel
+    decode for contexts whose cache outgrows one chip's HBM (each
+    shard holds a T/n slice; the decode kernel combines shards by
+    log-sum-exp, see :func:`_flash_decode_on_mesh`).  Both the int8
+    scales and the heads-major K/V buffers carry the KV heads at
+    axis 2 and tokens at axis 3."""
+    spec = P(None, dp_axis, tp_axis, sp_axis, None)
     rules = {"k": spec, "v": spec}
     if quantized:
         rules["k_s"] = spec
@@ -151,12 +157,22 @@ def _cached_attention(q, kc, vc, positions, scale, window=None):
 def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None,
                           k_s=None, v_s=None):
     """Run the Pallas decode kernel under GSPMD via shard_map: batch
-    over ``dp``, heads over ``tp`` (other mesh axes replicated).
+    over ``dp``, heads over ``tp``, and the cache's TOKEN axis over
+    ``sp`` (sequence-parallel decode — other mesh axes replicated).
 
     The GQA grouping survives head sharding because q-head block
     [t·H/tp, (t+1)·H/tp) maps exactly onto kv-head block
     [t·Hkv/tp, (t+1)·Hkv/tp) — each shard keeps the full group ratio,
     so the local kernel call is the global computation.
+
+    With an ``sp`` axis, each shard runs the kernel over its local
+    T/n cache slice at shifted positions (``pos − shard·T/n``; the
+    sliding-window bound is offset-invariant, so ``window`` composes
+    unchanged) and the shards merge by log-sum-exp:
+    ``o = Σ exp(lse_i − m)·o_i / Σ exp(lse_i − m)`` with
+    ``m = max_i lse_i`` — exactly the flash inter-block combine, run
+    across chips (one fused psum over ICI per layer per step).  A
+    shard wholly past ``pos`` reports ``lse = −inf`` and weighs zero.
 
     q: (B, H, Dh); kc/vc: (B, Hkv, T, Dh) heads-major; pos: (B,);
     optional int8 cache scales k_s/v_s: (B, Hkv, T, 1).
@@ -165,14 +181,33 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None,
 
     dp = "dp" if "dp" in mesh.shape else None
     tp = "tp" if "tp" in mesh.shape else None
+    sp = "sp" if "sp" in mesh.shape else None
     qspec = P(dp, tp, None)
-    cspec = P(dp, tp, None, None)
-    sspec = P(dp, tp, None, None)
+    cspec = P(dp, tp, sp, None)
+    sspec = P(dp, tp, sp, None)
 
     def inner(q, kc, vc, pos, *scales):
         ks, vs = scales if scales else (None, None)
-        return flash_decode_attention(q, kc, vc, pos, scale=scale,
-                                      window=window, k_s=ks, v_s=vs)
+        if sp is None:
+            return flash_decode_attention(q, kc, vc, pos, scale=scale,
+                                          window=window, k_s=ks,
+                                          v_s=vs)
+        t_loc = kc.shape[2]
+        pos_loc = pos - jax.lax.axis_index(sp) * t_loc
+        o, lse = flash_decode_attention(q, kc, vc, pos_loc,
+                                        scale=scale, window=window,
+                                        k_s=ks, v_s=vs,
+                                        return_lse=True)
+        lse = lse[..., None]                            # (B, H, 1)
+        m = jax.lax.pmax(lse, sp)
+        w = jnp.exp(lse - m)
+        # ONE psum on the hot path (per layer per step): the weight
+        # column rides as an extra feature of the weighted output.
+        both = jax.lax.psum(
+            jnp.concatenate([o.astype(jnp.float32) * w, w], axis=-1),
+            sp)
+        num, den = both[..., :-1], both[..., -1:]
+        return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 
     quant = k_s is not None
     in_specs = ((qspec, cspec, cspec, P(dp))
@@ -182,12 +217,14 @@ def _flash_decode_on_mesh(q, kc, vc, pos, mesh, scale, window=None,
                          out_specs=qspec, check_vma=False)(*args)
 
 
-def _can_flash_decode_on_mesh(mesh, B, H, Hkv):
-    """The sharded kernel needs each shard to hold whole head groups
-    and whole batch rows."""
+def _can_flash_decode_on_mesh(mesh, B, H, Hkv, T=None):
+    """The sharded kernel needs each shard to hold whole head groups,
+    whole batch rows, and (under ``sp``) equal token slices."""
     tp_n = mesh.shape.get("tp", 1)
     dp_n = mesh.shape.get("dp", 1)
-    return H % tp_n == 0 and Hkv % tp_n == 0 and B % dp_n == 0
+    sp_n = mesh.shape.get("sp", 1)
+    return (H % tp_n == 0 and Hkv % tp_n == 0 and B % dp_n == 0
+            and (T is None or T % sp_n == 0))
 
 
 def _make_mlp_fn(cfg: TransformerConfig, mesh, ep_axis: str,
@@ -305,7 +342,8 @@ def forward_with_cache(params: dict, tokens, cache: dict, cache_len,
                 q[:, 0], kc, vc, positions[:, 0], scale=scale,
                 window=window, k_s=ks, v_s=vs).reshape(B, 1, H * Dh)
         elif (S == 1 and cfg.use_flash and mesh is not None
-              and _can_flash_decode_on_mesh(mesh, B, H, Hkv)):
+              and _can_flash_decode_on_mesh(mesh, B, H, Hkv,
+                                            kc.shape[2])):
             # Same kernel under GSPMD: shard_map carves the batch over
             # dp and the (already tp-sharded) heads over tp, so the
             # kernel runs on local shards instead of forcing GSPMD to

@@ -35,7 +35,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_s, m_s, l_s, *, block_k: int, seq_k: int,
                    scale: float, num_kb: int,
                    window: int | None = None,
-                   ks_ref=None, vs_ref=None):
+                   ks_ref=None, vs_ref=None, lse_ref=None):
     """One grid step = one (batch, kv-head, k-block).  The k axis rides
     the grid (sequential on-core), so only a (block_k, D) window of the
     cache is ever staged in VMEM — context length is bounded by HBM,
@@ -52,6 +52,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     b = pl.program_id(0)
     kb = pl.program_id(2)
     valid = pos_ref[b] + 1                              # keys [0, valid)
+    # The sp-sharded caller passes LOCAL positions that can exceed
+    # this shard's cache length (a later global position means "every
+    # local key attends") — clamp the upper bound to seq_k so the
+    # padded tail of a partial final block never enters the softmax.
+    # The window's lower bound stays on the UNCLAMPED position: it is
+    # offset-invariant in local coordinates only as valid - window.
+    valid_k = jnp.minimum(valid, seq_k)
     # Sliding window: only keys in [valid - window, valid) attend;
     # blocks entirely below the window are skipped like blocks past
     # the valid length.
@@ -63,7 +70,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         m_s[...] = jnp.full_like(m_s, _NEG_INF)
         l_s[...] = jnp.zeros_like(l_s)
 
-    @pl.when((kb * block_k < valid)
+    @pl.when((kb * block_k < valid_k)
              & ((kb + 1) * block_k > lo))
     def _block():
         q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
@@ -88,11 +95,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         ki = (kb * block_k
               + jax.lax.broadcasted_iota(jnp.int32,
                                          (q.shape[0], block_k), 1))
-        # < valid also masks the padded tail of a non-multiple T
-        # (valid <= seq_k always) — including any NaN columns of s
-        # from padded k rows (jnp.where does not propagate the
+        # < valid_k also masks the padded tail of a non-multiple T
+        # (valid_k <= seq_k by construction, even for the sp-sharded
+        # caller's overshooting positions) — including any NaN columns
+        # of s from padded k rows (jnp.where does not propagate the
         # unselected branch).
-        s = jnp.where((ki < valid) & (ki >= lo), s, _NEG_INF)
+        s = jnp.where((ki < valid_k) & (ki >= lo), s, _NEG_INF)
         m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -117,14 +125,22 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         o_ref[0, 0] = (acc_s[...]
                        / jnp.maximum(l_s[...], 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp of the masked scores; an all-masked shard
+            # (this query attends to nothing here — the sp-sharded
+            # cache case) reports NEG_INF so the cross-shard combine
+            # weighs it zero.
+            lse_ref[0, 0] = jnp.where(
+                l_s[...] > 0.0, m_s[...] + jnp.log(
+                    jnp.maximum(l_s[...], 1e-30)), _NEG_INF)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("block_k", "scale", "interpret",
-                                    "window"))
+                                    "window", "return_lse"))
 def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
                  interpret: bool, window: int | None = None,
-                 k_s=None, v_s=None):
+                 k_s=None, v_s=None, return_lse: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -134,15 +150,20 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
     quantized = k_s is not None
 
     def _kernel(pos_ref, *refs):
-        if quantized:
-            q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, a, m, l = refs
+        lse_ref = None
+        if return_lse:
+            *refs, a, m, l = refs
+            *refs, o_ref, lse_ref = refs
         else:
-            (q_ref, k_ref, v_ref, o_ref, a, m, l), ks_ref, vs_ref = \
-                refs, None, None
+            *refs, o_ref, a, m, l = refs
+        if quantized:
+            q_ref, k_ref, v_ref, ks_ref, vs_ref = refs
+        else:
+            (q_ref, k_ref, v_ref), ks_ref, vs_ref = refs, None, None
         _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, a, m, l,
                        block_k=block_k, seq_k=T, scale=scale,
                        num_kb=num_kb, window=window, ks_ref=ks_ref,
-                       vs_ref=vs_ref)
+                       vs_ref=vs_ref, lse_ref=lse_ref)
 
     in_specs = [
         pl.BlockSpec((1, 1, group, D),
@@ -165,6 +186,20 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
         ]
         args += [k_s, v_s]
 
+    out_specs = pl.BlockSpec((1, 1, group, D),
+                             lambda b, h, kb, pos: (b, h, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype)
+    if return_lse:
+        # The lse plane keeps a trailing unit dim so its block's last
+        # two dims equal the array's — Mosaic's block-shape rule (the
+        # same pattern as the int8 scale planes).
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 1, group, 1),
+                                  lambda b, h, kb, pos: (b, h, 0, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((B, Hkv, group, 1),
+                                          jnp.float32)]
+
     # pos rides as a prefetched scalar array (SMEM on real TPU) —
     # the kernel indexes it by the batch program id.  The k axis is the
     # innermost grid dim: sequential on-core, scratch carries state.
@@ -174,15 +209,14 @@ def _decode_call(q, kc, vc, pos, *, block_k: int, scale: float,
             num_scalar_prefetch=1,
             grid=(B, Hkv, num_kb),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, group, D),
-                                   lambda b, h, kb, pos: (b, h, 0, 0)),
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((group, D), jnp.float32),   # acc
                 pltpu.VMEM((group, 1), jnp.float32),   # running max
                 pltpu.VMEM((group, 1), jnp.float32),   # normalizer
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(*args)
 
@@ -202,7 +236,8 @@ _DEFAULT_BLOCK_K = 128
 def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
                            block_k: int | None = None,
                            window: int | None = None,
-                           k_s=None, v_s=None):
+                           k_s=None, v_s=None,
+                           return_lse: bool = False):
     """Fused decode attention: one new token per sequence against the
     cache.
 
@@ -222,6 +257,13 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     stream from HBM at half width; the scales commute through the two
     matmuls inside the kernel (see models/quant.py for the cache
     quantizer).
+
+    ``return_lse=True`` additionally returns the per-query-head
+    log-sum-exp of the masked scores, (B, H) fp32 (``NEG_INF`` for a
+    query that attends to nothing) — the combiner a sequence-sharded
+    cache needs: shards compute locally and merge as
+    ``o = Σ exp(lse_i − m)·o_i / Σ exp(lse_i − m)`` (see
+    ``models/generate._flash_decode_on_mesh``).
     """
     B, H, D = q.shape
     Hkv, T = kc.shape[1], kc.shape[2]
@@ -241,5 +283,8 @@ def flash_decode_attention(q, kc, vc, pos, *, scale: float | None = None,
     out = _decode_call(qg, kc, vc, jnp.asarray(pos, jnp.int32),
                        block_k=block_k, scale=float(scale),
                        interpret=_use_interpret(), window=window,
-                       k_s=k_s, v_s=v_s)
+                       k_s=k_s, v_s=v_s, return_lse=return_lse)
+    if return_lse:
+        o, lse = out
+        return o.reshape(B, H, D), lse.reshape(B, H)
     return out.reshape(B, H, D)
