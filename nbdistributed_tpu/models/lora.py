@@ -61,10 +61,25 @@ def lora_init(key, cfg: TransformerConfig, rank: int,
               targets=ATTN_TARGETS, dtype=None) -> dict:
     """Adapter pytree for ``targets`` (subset of the per-layer weight
     names).  ``a`` is fan-in-scaled gaussian, ``b`` zeros — the merged
-    model is exactly the base model at step 0."""
+    model is exactly the base model at step 0.
+
+    Works for both model families: the MoE transformer's attention
+    projections share the dense family's names and shapes, so
+    attention-target LoRA (the classic recipe) applies unchanged —
+    only the expert SwiGLU weights are off-limits (they carry a
+    leading ``n_experts`` axis; per-expert adapters are a different
+    object)."""
     if rank < 1:
         raise ValueError(f"rank must be >= 1, got {rank}")
     _check_targets(targets)
+    from .moe import MoEConfig
+    if isinstance(cfg, MoEConfig):
+        bad = [t for t in targets if t in ("w_gate", "w_up", "w_down")]
+        if bad:
+            raise ValueError(
+                f"LoRA targets {bad} are expert weights on a MoE "
+                f"config (leading n_experts axis); target the "
+                f"attention projections {ATTN_TARGETS} instead")
     dtype = dtype if dtype is not None else cfg.dtype
     L = cfg.n_layers
     dims = layer_weight_dims(cfg)
@@ -121,7 +136,8 @@ def lora_num_params(lora: dict) -> int:
 
 
 def make_lora_train_step(cfg: TransformerConfig, optimizer, *,
-                         alpha: float = 16.0, sp=None):
+                         alpha: float = 16.0, sp=None, mesh=None,
+                         ep_axis: str = "ep"):
     """Returns ``step(base_params, lora, opt_state, batch) ->
     (lora, opt_state, loss)``.  Only the adapter pytree is
     differentiated and updated; optimizer state exists only for adapter
@@ -129,12 +145,26 @@ def make_lora_train_step(cfg: TransformerConfig, optimizer, *,
     with :func:`lora_shardings`, then jit over any dp/tp mesh exactly
     like the full train step.  ``sp`` (a ``SeqParallel``) additionally
     runs attention sequence-parallel — long-context LoRA fine-tuning
-    composes for free because the merge happens before the forward."""
+    composes for free because the merge happens before the forward.
+
+    A :class:`~.moe.MoEConfig` dispatches to the MoE loss (load
+    balance included); ``mesh``/``ep_axis`` route its expert
+    all-to-alls — adapter fine-tuning of a Mixtral-class model on a
+    dp×ep mesh uses the identical step shape."""
+    from .moe import MoEConfig, moe_loss_fn
+
+    if isinstance(cfg, MoEConfig):
+        def base_loss(p, batch):
+            return moe_loss_fn(p, batch, cfg, mesh=mesh,
+                               ep_axis=ep_axis, sp=sp)
+    else:
+        def base_loss(p, batch):
+            return loss_fn(p, batch, cfg, sp)
 
     def step(base_params, lora, opt_state, batch):
         def adapted_loss(l):
-            return loss_fn(lora_merge(base_params, l, alpha=alpha),
-                           batch, cfg, sp)
+            return base_loss(lora_merge(base_params, l, alpha=alpha),
+                             batch)
 
         loss, grads = jax.value_and_grad(adapted_loss)(lora)
         updates, opt_state = optimizer.update(grads, opt_state, lora)
