@@ -210,6 +210,24 @@ print(f"MoE loss over dp×ep mesh: {mloss:.4f}")
 print("expert weights sharding:",
       mp["layers"]["moe"]["w_up"].sharding.spec)""")
 
+md("""### Dropless dispatch — no token ever dropped
+
+`dispatch_mode="dropless"` runs the expert SwiGLU as
+`jax.lax.ragged_dot` grouped matmuls over variable-size expert
+segments.  Over the `ep` mesh it becomes the shard-capacity hybrid:
+a static per-shard all-to-all feeds locally dropless segments —
+per-expert slack pools across each shard's experts.""")
+
+code("""\
+import dataclasses
+mcfg_ll = dataclasses.replace(mcfg, capacity_factor=float(mcfg.n_experts))
+mcfg_dl = dataclasses.replace(mcfg, moe_dispatch="dropless",
+                              capacity_factor=float(mcfg.n_experts))
+l_dense = float(moe_loss_fn(mp, mb, mcfg_ll, mesh=ep_mesh))
+l_dropless = float(moe_loss_fn(mp, mb, mcfg_dl, mesh=ep_mesh))
+print(f"lossless dense {l_dense:.6f}  dropless-over-ep {l_dropless:.6f}"
+      f"  equal: {abs(l_dense - l_dropless) < 1e-5}")""")
+
 md("""### Model-integrated SP — train long context in one line
 
 `make_train_step(cfg, opt, sp=SeqParallel(mesh))` routes every layer's
@@ -243,11 +261,28 @@ code("""\
 from nbdistributed_tpu.models import generate
 
 prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 6), 0, cfg.vocab_size)
-toks = generate(params, prompt, cfg, max_new_tokens=8, mesh=mesh)
-print("greedy:   ", np.asarray(toks)[:, 6:])
+toks_greedy = generate(params, prompt, cfg, max_new_tokens=8, mesh=mesh)
+print("greedy:   ", np.asarray(toks_greedy)[:, 6:])
 toks = generate(params, prompt, cfg, max_new_tokens=8, temperature=0.8,
                 top_k=50, top_p=0.95, key=jax.random.PRNGKey(9), mesh=mesh)
 print("top-k/p:  ", np.asarray(toks)[:, 6:])""")
+
+md("""### Sequence-parallel decode — the KV cache sharded over `sp`
+
+Long-context serving: the cache (not the weights) outgrows one chip's
+HBM first.  Each `sp` shard runs the decode kernel over its `T/n`
+cache slice and shards merge by log-sum-exp — flash's inter-block
+combine run across chips, one fused psum per layer per step.""")
+
+code("""\
+sp_mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2, "sp": 2})
+cfg_f = dataclasses.replace(cfg, use_flash=True)
+ps_sp = tensor_parallel.apply_shardings(params, sp_mesh, rules)
+toks_sp = generate(ps_sp, prompt, cfg_f, max_new_tokens=8,
+                   mesh=sp_mesh, max_len=32)
+print("sp-sharded decode matches:",
+      bool(np.array_equal(np.asarray(toks_sp),
+                          np.asarray(toks_greedy))))""")
 
 md("""## Int8 weight-only quantization
 
