@@ -78,6 +78,11 @@ class DecodeServer:
             srv.step()   # plain: 1 token per active request;
                          # speculative mode: 1..gamma+1 per request
         tokens = srv.outputs[rid]
+
+    ``prefill_chunk=N`` (dense family) admits long prompts in
+    fixed-size segments through one compiled (1, N) program —
+    admission activation memory O(N) instead of O(S_prompt), no
+    per-bucket compiles (see :meth:`_run_prefill`).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
@@ -86,7 +91,8 @@ class DecodeServer:
                  top_p: float | None = None, eos_id: int | None = None,
                  kv_quantized: bool = False, mesh=None,
                  ep_axis: str = "ep", pad_to: int = 64, key=None,
-                 draft_params=None, draft_cfg=None, gamma: int = 4):
+                 draft_params=None, draft_cfg=None, gamma: int = 4,
+                 prefill_chunk: int | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_to < 1:
@@ -96,6 +102,9 @@ class DecodeServer:
                              f"{cfg.vocab_size}], got {top_k}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("pass both draft_params and draft_cfg, "
                              "or neither")
@@ -115,6 +124,14 @@ class DecodeServer:
             # admission therefore compiles per distinct prompt length
             # (pad_to=1); dense configs keep the bucket economy.
             pad_to = 1
+            if prefill_chunk is not None:
+                # Chunked admission derives capacity from the CHUNK's
+                # token count — again not a solo run's.  Same reason.
+                raise ValueError(
+                    "prefill_chunk is a dense-family option: MoE "
+                    "expert capacity is shape-derived, so per-chunk "
+                    "capacity would differ from a solo run's and "
+                    "change which tokens drop")
         self._params = params
         self._cfg = cfg
         self._mesh = mesh
@@ -122,6 +139,7 @@ class DecodeServer:
         self._B = max_batch
         self._T = max_len
         self._pad_to = pad_to
+        self._prefill_chunk = prefill_chunk
         self._temperature = temperature
         self._top_k = top_k
         self._top_p = top_p
@@ -168,21 +186,25 @@ class DecodeServer:
         cfg = cfg if cfg is not None else self._cfg
         mesh, ep_axis = self._mesh, self._ep_axis
 
-        def fn(params, cache, prompt, slot, length):
+        def fn(params, cache, prompt, slot, start, length):
             """prompt (1, s_pad) right-padded; writes the slot's cache
-            rows and returns (updated cache, last-real-token logits).
-            token_mask keeps the pad positions out of MoE expert
-            dispatch (they would consume capacity slots and could
-            evict real prompt tokens); last_index gathers the hidden
-            state at the last REAL token before the lm_head, so pads
-            never touch the (d_model x vocab) matmul either."""
+            rows at offset ``start`` and returns (updated cache,
+            logits at the segment's last REAL token).  ``start`` is 0
+            for whole-prompt (bucketed) admission; chunked admission
+            streams fixed-size segments at increasing offsets through
+            this one compiled shape.  token_mask keeps the pad
+            positions out of MoE expert dispatch (they would consume
+            capacity slots and could evict real prompt tokens);
+            last_index gathers the hidden state at the last REAL token
+            before the lm_head, so pads never touch the
+            (d_model x vocab) matmul either."""
             row = jax.tree_util.tree_map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, 1),
                 cache)
             s_pad = prompt.shape[1]
             mask = (jnp.arange(s_pad)[None, :] < length)
             logits, row = forward_with_cache(
-                params, prompt, row, 0, cfg, mesh=mesh,
+                params, prompt, row, start, cfg, mesh=mesh,
                 ep_axis=ep_axis, token_mask=mask,
                 last_index=(length - 1)[None])
             cache = jax.tree_util.tree_map(
@@ -296,17 +318,49 @@ class DecodeServer:
         self._key, k = jax.random.split(self._key)
         return k
 
+    def _run_prefill(self, prefill_fn, params, cache, prompt: list,
+                     slot: int):
+        """Prefill one slot; returns (cache, last-real-token logits).
+
+        Default: one bucketed whole-prompt forward (compile count
+        bounded by distinct buckets).  With ``prefill_chunk`` and a
+        longer prompt: fixed-size segments stream through ONE compiled
+        (1, chunk) program at increasing cache offsets — admission
+        activation memory drops from O(S_prompt) to O(chunk) and long
+        prompts stop minting per-bucket compiles.  The final segment
+        (padded to the chunk) carries the logits; a causal forward
+        makes chunked and single-shot prefill the same computation
+        (same argument as :func:`~.generate.prefill_chunked`)."""
+        L = len(prompt)
+        ck = self._prefill_chunk
+        if ck is None or L <= ck:
+            s_pad = min(self._bucket(L), self._T)
+            padded = jnp.asarray(prompt + [0] * (s_pad - L),
+                                 jnp.int32)[None, :]
+            return prefill_fn(params, cache, padded, jnp.int32(slot),
+                              jnp.int32(0), jnp.int32(L))
+        n_full = L // ck
+        if L % ck == 0:
+            n_full -= 1        # keep the last full chunk as the tail
+        for i in range(n_full):
+            seg = jnp.asarray(prompt[i * ck:(i + 1) * ck],
+                              jnp.int32)[None, :]
+            cache, _ = prefill_fn(params, cache, seg, jnp.int32(slot),
+                                  jnp.int32(i * ck), jnp.int32(ck))
+        tail = prompt[n_full * ck:]
+        seg = jnp.asarray(tail + [0] * (ck - len(tail)),
+                          jnp.int32)[None, :]
+        return prefill_fn(params, cache, seg, jnp.int32(slot),
+                          jnp.int32(n_full * ck),
+                          jnp.int32(len(tail)))
+
     def _admit_pending(self) -> None:
         while self._pending and self._free:
             rid, prompt, budget = self._pending.pop(0)
             slot = self._free.pop(0)
-            s_pad = min(self._bucket(len(prompt)), self._T)
-            padded = jnp.asarray(
-                prompt + [0] * (s_pad - len(prompt)),
-                jnp.int32)[None, :]
-            self._cache, last_logits = self._prefill_fn(
-                self._params, self._cache, padded,
-                jnp.int32(slot), jnp.int32(len(prompt)))
+            self._cache, last_logits = self._run_prefill(
+                self._prefill_fn, self._params, self._cache, prompt,
+                slot)
             tok = int(_sample(last_logits[None], self._temperature,
                               self._sample_key(), self._top_k,
                               self._top_p)[0])
@@ -316,9 +370,9 @@ class DecodeServer:
             if self._draft_cfg is not None:
                 # Draft cache prefills the same prompt (its seed
                 # logits are discarded — the target seeds the stream).
-                self._cache_d, _ = self._prefill_d(
-                    self._draft_params, self._cache_d, padded,
-                    jnp.int32(slot), jnp.int32(len(prompt)))
+                self._cache_d, _ = self._run_prefill(
+                    self._prefill_d, self._draft_params,
+                    self._cache_d, prompt, slot)
                 self._lens_d = self._lens_d.at[slot].set(len(prompt))
             done = (budget == 1
                     or (self._eos is not None and tok == self._eos))

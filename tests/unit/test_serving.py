@@ -375,3 +375,71 @@ def test_step_many_validation(setup, spec_setup):
                         draft_params=draft, draft_cfg=cfg)
     with pytest.raises(ValueError, match="plain serving"):
         ssrv.step_many(2)
+
+
+# ---------------------------------------------------------------------
+# chunked prefill admission
+
+@pytest.mark.parametrize("L", [7, 12, 13])
+def test_chunked_prefill_matches_solo(setup, L):
+    """Chunked admission (chunk=4: exact-multiple, tail, and
+    shorter-than-chunk prompts) must be invisible to the numerics —
+    outputs equal solo generate and bucketed admission."""
+    cfg, params = setup
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(40 + L), (L,), 1, cfg.vocab_size)]
+    n = 5
+    ref = solo(params, cfg, prompt, n)
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4,
+                       prefill_chunk=4)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=30)
+    assert srv.outputs[rid] == ref
+
+
+def test_chunked_prefill_single_compile_shape(setup):
+    """Every chunk segment shares one (1, chunk) program: admitting
+    prompts of different lengths > chunk adds ONE prefill executable,
+    where bucketed admission would mint one per bucket."""
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4,
+                       prefill_chunk=4)
+    if not hasattr(srv._prefill_fn, "_cache_size"):
+        pytest.skip("jit cache introspection unavailable")
+    r0 = srv.submit([int(t) for t in range(1, 10)], 2)    # L=9
+    r1 = srv.submit([int(t) for t in range(1, 14)], 2)    # L=13
+    srv.run_until_done(max_steps=20)
+    assert srv._prefill_fn._cache_size() == 1
+    assert len(srv.outputs[r0]) == 2 and len(srv.outputs[r1]) == 2
+
+
+def test_chunked_prefill_speculative(spec_setup):
+    """Chunked admission composes with speculative serving: both
+    caches prefill chunk-wise; greedy output equals the target's."""
+    from nbdistributed_tpu.models import generate
+
+    cfg, target, draft = spec_setup
+    prompt = [5, 9, 2, 7, 1, 3, 11, 4, 6]                 # L=9
+    n = 6
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=64, pad_to=4,
+                       draft_params=draft, draft_cfg=cfg, gamma=3,
+                       prefill_chunk=4)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=30)
+    solo_toks = generate(target, jnp.asarray([prompt], jnp.int32),
+                         cfg, n)
+    assert srv.outputs[rid] == [int(t) for t in
+                                solo_toks[0, len(prompt):]]
+
+
+def test_chunked_prefill_rejected_for_moe():
+    from nbdistributed_tpu.models import init_moe_model, tiny_moe_config
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False)
+    params = init_moe_model(jax.random.PRNGKey(4), cfg)
+    with pytest.raises(ValueError, match="dense-family"):
+        DecodeServer(params, cfg, max_batch=1, max_len=32,
+                     prefill_chunk=8)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        DecodeServer(params, tiny_config(dtype=jnp.float32,
+                                         use_flash=False),
+                     max_batch=1, max_len=32, prefill_chunk=0)
