@@ -364,9 +364,9 @@ def make_layer_fn(cfg: TransformerConfig, positions,
     # policy but remat=False (or an unknown policy string) must fail
     # loudly, not silently train with full activation memory.
     policy = getattr(cfg, "remat_policy", None)
-    if policy not in (None, "dots"):
+    if policy not in (None, "dots", "attn_only", "mlp_only"):
         raise ValueError(f"unknown remat_policy {policy!r} "
-                         f"(None or 'dots')")
+                         f"(None, 'dots', 'attn_only' or 'mlp_only')")
     if policy is not None and not cfg.remat:
         raise ValueError("remat_policy is set but remat=False — the "
                          "policy would be silently ignored; set "
@@ -377,6 +377,32 @@ def make_layer_fn(cfg: TransformerConfig, positions,
         return jax.checkpoint(
             one_layer,
             policy=jax.checkpoint_policies.checkpoint_dots)
+    if policy == "attn_only":
+        # Recompute only the attention block (the O(S·D) internals the
+        # flash kernel re-runs cheaply off its saved logsumexp); the
+        # MLP's d_ff-wide activations — the per-layer memory bulk —
+        # stay saved, so the backward skips 2/3 of the layer FLOPs a
+        # full remat would re-run.
+        attn = jax.checkpoint(lambda x, layer: _attention_block(
+            x, layer, cfg, positions, sp, segment_ids))
+
+        def one_layer_attn(x, layer):
+            return _mlp_block(attn(x, layer), layer, cfg)
+
+        return one_layer_attn
+    if policy == "mlp_only":
+        # Mirror image: recompute the MLP (plain GEMMs), keep the
+        # attention internals saved — maximal memory saving among the
+        # partial policies (the d_ff buffers dominate) at ~2/3-layer
+        # recompute.
+        mlp = jax.checkpoint(lambda x, layer: _mlp_block(
+            x, layer, cfg))
+
+        def one_layer_mlp(x, layer):
+            return mlp(_attention_block(x, layer, cfg, positions, sp,
+                                        segment_ids), layer)
+
+        return one_layer_mlp
     return jax.checkpoint(one_layer)
 
 

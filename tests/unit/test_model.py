@@ -237,6 +237,18 @@ def test_remat_matches_no_remat():
                     jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+    # Structured partial policies: checkpoint one sub-block, keep the
+    # other's activations — still math-neutral.
+    for pol in ("attn_only", "mlp_only"):
+        cfg_p = type(cfg)(**{**cfg.__dict__, "remat": True,
+                             "remat_policy": pol})
+        lp, gp = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg_p))(params)
+        np.testing.assert_allclose(float(l0), float(lp), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(gp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
     cfg_bad = type(cfg)(**{**cfg.__dict__, "remat": True,
                            "remat_policy": "everything"})
     with pytest.raises(ValueError, match="remat_policy"):
