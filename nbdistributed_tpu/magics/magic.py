@@ -50,6 +50,9 @@ Every cell now runs on ALL workers. Namespace on each worker:
   pipeline_forward, shard_stage_params, moe_ffn, init_moe_params
                        — mesh/SP/PP/EP building blocks
   load_hf_pretrained   — HF Llama-family checkpoint → JAX pytree
+  generate, speculative_generate, DecodeServer
+                       — KV-cache decode / draft-verify decoding /
+                         continuous-batching serving
 
 Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·

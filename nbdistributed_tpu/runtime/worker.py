@@ -109,6 +109,7 @@ class DistributedWorker:
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+        from .. import models
         from ..parallel import collectives, expert, mesh as mesh_mod, \
             pipeline
         from ..parallel.ring import (ring_attention, zigzag_shard,
@@ -150,6 +151,9 @@ class DistributedWorker:
             "moe_ffn": expert.moe_ffn,
             "init_moe_params": expert.init_moe_params,
             "load_hf_pretrained": _load_hf_pretrained_lazy,
+            "generate": models.generate,
+            "speculative_generate": models.speculative_generate,
+            "DecodeServer": models.DecodeServer,
             "batch_iterator": data_mod.batch_iterator,
             "shard_arrays": data_mod.shard_arrays,
             "pack_tokens": data_mod.pack_tokens,
