@@ -435,6 +435,22 @@ while not _srv2.done():
 _dt_many = _time.time() - _t0
 assert all(len(_srv2.outputs[_r]) == _N for _r in _rids2)
 
+# Speculative server with spec_step_many(2): up to 2*(gamma+1) tokens
+# per host sync — the compounded amortization (self-draft = the
+# gamma-acceptance upper bound, as in the SPEC row).
+_srv3 = DecodeServer(_p, _cfg, max_batch=_B, max_len=256, pad_to=_L,
+                     draft_params=_p, draft_cfg=_cfg, gamma=4)
+_w = _srv3.submit(_prompts[0], 10)      # warm prefills + the scan
+while not _srv3.done():
+    _srv3.spec_step_many(2)
+_srv3.release(_w)
+_t0 = _time.time()
+_rids3 = [_srv3.submit(_pr, _N) for _pr in _prompts]
+while not _srv3.done():
+    _srv3.spec_step_many(2)
+_dt_spec_many = _time.time() - _t0
+assert all(len(_srv3.outputs[_r]) == _N for _r in _rids3)
+
 _tot = _B * _N
 _json.dumps({
     "batch": _B, "new_tokens": _N,
@@ -442,6 +458,7 @@ _json.dumps({
     "batched_generate_tok_per_s": round(_tot / _dt_bat, 1),
     "server_tok_per_s": round(_tot / _dt_srv, 1),
     "server_stepmany8_tok_per_s": round(_tot / _dt_many, 1),
+    "server_spec_many2_tok_per_s": round(_tot / _dt_spec_many, 1),
     "batching_speedup": round(_dt_seq / _dt_bat, 2),
     "server_vs_sequential": round(_dt_seq / _dt_srv, 2),
     "per_step_host_sync_ms": round(

@@ -165,6 +165,7 @@ class DecodeServer:
             self._lens_d = jnp.zeros((max_batch,), jnp.int32)
             self._prefill_d = self._make_prefill(draft_cfg)
             self._spec_fn = self._jit_spec_step()
+            self._spec_many_fn = self._jit_spec_many()
 
         # Host-side bookkeeping.
         self._free = list(range(max_batch))
@@ -254,6 +255,44 @@ class DecodeServer:
             return cache, lens, last, toks        # toks (n, B)
 
         return jax.jit(many, donate_argnums=(1,))
+
+    def _jit_spec_many(self):
+        from .speculative import spec_round
+
+        cfg, dcfg = self._cfg, self._draft_cfg
+        gamma, temperature = self._gamma, self._temperature
+        mesh, ep_axis = self._mesh, self._ep_axis
+        top_k, top_p = self._top_k, self._top_p
+        T = self._T
+
+        def fn(params, draft_params, cache_t, lens_t, cache_d, lens_d,
+               last, active, keys):
+            def body(carry, key):
+                cache_t, lens_t, cache_d, lens_d, last = carry
+                # Self-freeze before the cache could overflow: a round
+                # writes at positions < lens + gamma + 1.  submit()
+                # guarantees prompt + budget + gamma + 1 <= max_len,
+                # so a stream always reaches its budget before
+                # freezing here (the freeze only stops budget-overrun
+                # rounds whose tokens the host discards anyway).
+                act = active & (lens_t + gamma + 1 <= T)
+                (cache_t, lens_t, cache_d, lens_d, _k, cand, n_acc,
+                 new_last) = spec_round(
+                    params, draft_params, cfg, dcfg, gamma=gamma,
+                    temperature=temperature, cache_t=cache_t,
+                    len_t=lens_t, cache_d=cache_d, len_d=lens_d,
+                    last_tok=last, key=key, active=act, mesh=mesh,
+                    ep_axis=ep_axis, top_k=top_k, top_p=top_p)
+                return ((cache_t, lens_t, cache_d, lens_d, new_last),
+                        (cand, n_acc, act))
+
+            carry = (cache_t, lens_t, cache_d, lens_d, last)
+            (cache_t, lens_t, cache_d, lens_d, last), \
+                (cands, n_accs, acts) = jax.lax.scan(body, carry, keys)
+            return (cache_t, lens_t, cache_d, lens_d, last, cands,
+                    n_accs, acts)
+
+        return jax.jit(fn, donate_argnums=(2, 4))
 
     def _jit_spec_step(self):
         from .speculative import spec_round
@@ -470,9 +509,8 @@ class DecodeServer:
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
         if self._draft_cfg is not None:
-            raise ValueError("step_many is for plain serving; "
-                             "speculative mode already amortizes "
-                             "(gamma+1 tokens per step)")
+            raise ValueError("step_many is for plain serving; use "
+                             "spec_step_many on a speculative server")
         self._admit_pending()
         if not self._slot_req:
             return {}
@@ -486,6 +524,48 @@ class DecodeServer:
         for slot, rid in list(self._slot_req.items()):
             emitted[rid] = self._emit(
                 slot, rid, [int(t) for t in toks_h[:, slot]])
+        self._admit_pending()
+        return emitted
+
+    def spec_step_many(self, n: int) -> dict[int, list[int]]:
+        """Run ``n`` speculative rounds in ONE device program
+        (``lax.scan`` over :func:`~.speculative.spec_round`) — up to
+        ``n·(gamma+1)`` tokens per slot per host sync.
+
+        The speculative analog of :meth:`step_many`, with the same
+        trade-offs: admission only at scan boundaries, and budget/EOS
+        cuts applied host-side after the scan (surplus rounds'
+        tokens are discarded; surplus cache state is stale-but-dead).
+        Rows additionally self-freeze device-side when another round
+        could write past ``max_len`` — that bound only triggers past
+        the stream's budget, so emissions are bit-identical to ``n``
+        successive :meth:`step` calls in greedy mode."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if self._draft_cfg is None:
+            raise ValueError("spec_step_many needs a speculative "
+                             "server (draft_params/draft_cfg); use "
+                             "step_many for plain serving")
+        self._admit_pending()
+        if not self._slot_req:
+            return {}
+        keys = jax.random.split(self._sample_key(), n)
+        (self._cache, self._lens, self._cache_d, self._lens_d,
+         self._last, cands, n_accs, acts) = self._spec_many_fn(
+            self._params, self._draft_params, self._cache, self._lens,
+            self._cache_d, self._lens_d, self._last, self._active,
+            keys)
+        cands_h, accs_h, acts_h = jax.device_get(
+            (cands, n_accs, acts))                 # (n,B,g+1),(n,B),(n,B)
+        emitted: dict[int, list[int]] = {}
+        for slot, rid in list(self._slot_req.items()):
+            toks: list[int] = []
+            for r in range(n):
+                if acts_h[r, slot]:
+                    toks.extend(
+                        int(t) for t in
+                        cands_h[r, slot][: int(accs_h[r, slot]) + 1])
+            emitted[rid] = self._emit(slot, rid, toks)
         self._admit_pending()
         return emitted
 

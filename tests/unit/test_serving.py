@@ -443,3 +443,74 @@ def test_chunked_prefill_rejected_for_moe():
         DecodeServer(params, tiny_config(dtype=jnp.float32,
                                          use_flash=False),
                      max_batch=1, max_len=32, prefill_chunk=0)
+
+
+# ---------------------------------------------------------------------
+# spec_step_many: device-side multi-round speculation
+
+def test_spec_step_many_matches_single_steps(spec_setup):
+    """spec_step_many(n) must emit exactly what n successive step()
+    calls emit (greedy speculative), and both must equal solo
+    generate."""
+    cfg, target, draft = spec_setup
+    reqs = [([5, 9, 2], 9), ([7, 1, 3, 11], 7)]
+    mk = lambda: DecodeServer(target, cfg, max_batch=2, max_len=64,
+                              pad_to=4, draft_params=draft,
+                              draft_cfg=cfg, gamma=3)
+    a, b = mk(), mk()
+    ra = [a.submit(*r) for r in reqs]
+    rb = [b.submit(*r) for r in reqs]
+    for _ in range(4):
+        a.step()
+    b.spec_step_many(2)
+    b.spec_step_many(2)
+    for x, y in zip(ra, rb):
+        assert a.outputs[x] == b.outputs[y]
+    while not b.done():
+        b.spec_step_many(2)
+    for y, (prompt, n) in zip(rb, reqs):
+        assert b.outputs[y] == solo(target, cfg, prompt, n)
+
+
+def test_spec_step_many_freezes_at_max_len(spec_setup):
+    """A stream at the tightest legal max_len (prompt + budget +
+    gamma + 1): surplus rounds self-freeze device-side instead of
+    overflowing the cache, and the output is exactly the budget."""
+    cfg, target, draft = spec_setup
+    prompt, n, gamma = [5, 9, 2], 6, 3
+    T = len(prompt) + n + gamma + 1                  # == 13
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=T, pad_to=4,
+                       draft_params=draft, draft_cfg=cfg, gamma=gamma)
+    rid = srv.submit(prompt, n)
+    while not srv.done():
+        srv.spec_step_many(4)                        # overshoots freely
+    assert srv.outputs[rid] == solo(target, cfg, prompt, n)
+
+
+def test_spec_step_many_eos_cut(spec_setup):
+    """EOS discovered mid-scan truncates host-side exactly like the
+    single-round path."""
+    cfg, target, draft = spec_setup
+    prompt, n = [5, 9, 2], 8
+    toks = solo(target, cfg, prompt, n)
+    eos = toks[3]
+    srv = DecodeServer(target, cfg, max_batch=1, max_len=64, pad_to=4,
+                       draft_params=draft, draft_cfg=cfg, gamma=3,
+                       eos_id=eos)
+    rid = srv.submit(prompt, n)
+    while not srv.done():
+        srv.spec_step_many(3)
+    got = srv.outputs[rid]
+    assert got == toks[: toks.index(eos) + 1]
+
+
+def test_spec_step_many_validation(setup, spec_setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4)
+    with pytest.raises(ValueError, match="speculative server"):
+        srv.spec_step_many(2)
+    _, target, draft = spec_setup
+    ssrv = DecodeServer(target, cfg, max_batch=1, max_len=32, pad_to=4,
+                        draft_params=draft, draft_cfg=cfg)
+    with pytest.raises(ValueError, match=">= 1"):
+        ssrv.spec_step_many(0)
