@@ -70,8 +70,16 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         m_s[...] = jnp.full_like(m_s, _NEG_INF)
         l_s[...] = jnp.zeros_like(l_s)
 
+    # lo < valid_k: an sp-sharded caller's overshooting position can
+    # put the whole window past this shard's slice (lo >= valid_k) —
+    # without this clause such a block runs with an empty mask and its
+    # all -NEG_INF scores make p == 1 everywhere (m_new == NEG_INF),
+    # averaging garbage rows into acc; today the cross-shard combine
+    # happens to flush it (exp(lse−m) underflows to 0 because NEG_INF
+    # is finite), but correctness must not hang on an underflow.
     @pl.when((kb * block_k < valid_k)
-             & ((kb + 1) * block_k > lo))
+             & ((kb + 1) * block_k > lo)
+             & (lo < valid_k))
     def _block():
         q = q_ref[0, 0].astype(jnp.float32) * scale     # (group, D)
         k_blk = k_ref[0, 0].astype(jnp.float32)         # (Bk, D)

@@ -235,7 +235,11 @@ def _dropless_ffn_ep(xt, params, gates, expert_idx, E: int, mesh,
     kT = k * T
     # Same formula as the per-expert paths, pooled at shard level:
     # "experts" = shards, so the bound is ceil(cf·kT/ep) rounded to 8.
-    Cs = (capacity if capacity is not None
+    # An explicit ``capacity`` keeps its dense/sparse meaning —
+    # per-EXPERT — and pools to E_loc·capacity per shard, so a caller
+    # switching dispatch modes with a tuned per-expert value gets at
+    # least the headroom the other modes gave (plus the pooling).
+    Cs = (E_loc * capacity if capacity is not None
           else compute_capacity(T, n_ep, k, capacity_factor))
     Cs = min(Cs, kT)   # a shard can never receive more than kT rows
 
@@ -366,8 +370,8 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
       answer (the one capacity only approximates).  Over an ``ep``
       mesh axis it becomes the shard-capacity hybrid
       (:func:`_dropless_ffn_ep`): a static per-SHARD exchange buffer
-      (``capacity_factor``/``capacity`` bound the shard total,
-      ``Cs = ceil(cf·kT/ep)``) feeds locally dropless ragged
+      (``Cs = ceil(cf·kT/ep)``; an explicit per-expert ``capacity``
+      pools to ``(E/ep)·capacity``) feeds locally dropless ragged
       segments — per-expert slack pools across each shard's E/ep
       experts, so drops only occur at whole-shard overflow.
 

@@ -482,3 +482,33 @@ def test_decode_server_on_sp_mesh():
                         cfg_ref, n)
         assert srv.outputs[rid] == [int(t) for t in
                                     solo[0, len(prompt):]]
+
+
+def test_sp_sharded_decode_window_entirely_past_shard():
+    """Round-4 review band: with a sliding window, an sp shard whose
+    entire slice lies BELOW the window (lo >= valid_k) must contribute
+    nothing — the block guard must skip it outright rather than run an
+    empty-mask block whose garbage only underflow discards.  T=384,
+    sp=2, window=100, pos=300: shard 0's keys [0,192) are all below
+    lo=201."""
+    from nbdistributed_tpu.models.generate import _flash_decode_on_mesh
+    from nbdistributed_tpu.ops.decode import flash_decode_attention
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    B, H, Hkv, T, D = 1, 2, 1, 384, 16
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(ks[0], (B, H, D))
+    kc = jax.random.normal(ks[1], (B, Hkv, T, D))
+    vc = jax.random.normal(ks[2], (B, Hkv, T, D))
+    mesh = mesh_mod.make_mesh({"sp": 2}, devices=jax.devices()[:2])
+    for p in (300, 291, 355):          # across the hazardous band
+        pos = jnp.asarray([p], jnp.int32)
+        ref = flash_decode_attention(q, kc, vc, pos, block_k=128,
+                                     window=100)
+        got = jax.jit(lambda pos=pos: _flash_decode_on_mesh(
+            q, kc, vc, pos, mesh, 1.0 / np.sqrt(D), 100))()
+        assert np.isfinite(np.asarray(got)).all()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
