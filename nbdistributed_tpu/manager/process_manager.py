@@ -117,9 +117,12 @@ class ProcessManager:
                       extra_env: dict | None = None) -> None:
         """Spawn ``num_workers`` worker processes on this host.
 
-        ``chips`` pins the workers to an explicit (possibly
-        non-contiguous) chip set — the reference's ``gpu_ids`` analog
-        (reference: process_manager.py:107-112); TPU backend only.
+        ``chips`` pins the workers to an explicit chip set — the
+        reference's ``gpu_ids`` analog (reference:
+        process_manager.py:107-112); TPU backend only.  Non-contiguous
+        ids are fine for single-chip workers; with
+        ``chips_per_worker > 1`` each worker's slice must be an
+        aligned physical subgrid block (validated pre-spawn).
 
         The caller (magic layer) pairs this with
         ``CommunicationManager.wait_for_workers``; use
@@ -130,12 +133,15 @@ class ProcessManager:
             raise RuntimeError("workers already running; shutdown first")
         if backend == "auto":
             backend = topology.detect_backend()
+        host_chips = None
         if backend == "tpu":
             # Fail fast, before any child exists, when the topology
             # can't fit this host's chips (reference validates GPU ids
-            # against device_count pre-spawn: magic.py:454-488).
-            topology.validate_tpu_request(num_workers, chips_per_worker,
-                                          chips=chips)
+            # against device_count pre-spawn: magic.py:454-488).  The
+            # returned probe feeds the env carve so validation and env
+            # construction share one host geometry (one probe).
+            host_chips = topology.validate_tpu_request(
+                num_workers, chips_per_worker, chips=chips)
         self.backend = backend
         self.world_size = num_workers
         self.dist_port = find_free_port() if num_workers > 1 else None
@@ -143,7 +149,7 @@ class ProcessManager:
         for rank in range(num_workers):
             env = topology.worker_env(rank, num_workers, backend,
                                       chips_per_worker=chips_per_worker,
-                                      chips=chips)
+                                      chips=chips, host_chips=host_chips)
             if extra_env:
                 env.update(extra_env)
             cmd = [sys.executable, "-m", "nbdistributed_tpu.runtime.worker",

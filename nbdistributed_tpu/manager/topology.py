@@ -51,20 +51,96 @@ def parse_chips(spec: str) -> list[int]:
     return chips
 
 
+def _carve_geometry(host: int, chips_per_worker: int):
+    """``((hx, hy), cx, cy)`` when the ``host`` chip grid exists and
+    divides into aligned (cx,cy) subgrid blocks; None otherwise.  The
+    single source of truth for "is this carve geometry known" —
+    ``_grid_blocks``, ``_process_bounds`` and ``validate_tpu_request``
+    all consult it so their notions cannot diverge."""
+    hgrid = _V5E_GRIDS.get(host)
+    cx, cy = _V5E_GRIDS.get(chips_per_worker, (1, chips_per_worker))
+    if not hgrid or hgrid[0] % cx or hgrid[1] % cy:
+        return None
+    return hgrid, cx, cy
+
+
+def _grid_blocks(total_chips: int, chips_per_worker: int) -> list[list[int]]:
+    """The aligned (cx,cy) physical subgrid blocks of a ``total_chips``
+    host grid, in row-major block order — each block is the chip-id set
+    one multi-chip worker may own.  Chip ids map to the physical grid
+    row-major (id = x*Y + y on an (X, Y) grid), so a worker's block is
+    generally NOT a consecutive id run: 2 workers x 4 chips on a (2,4)
+    v5e-8 carve 2x2 subgrids {0,1,4,5} / {2,3,6,7}.  Both the default
+    ``TPU_VISIBLE_CHIPS`` assignment and the explicit-chips validation
+    derive from this one function so the ids can never contradict the
+    declared ``TPU_CHIPS_PER_PROCESS_BOUNDS`` carve."""
+    geo = _carve_geometry(total_chips, chips_per_worker)
+    if geo is None:
+        # No aligned carve exists; fall back to consecutive full runs
+        # (partial trailing blocks are dropped — never phantom ids
+        # past total_chips; the callers validate totals against
+        # _V5E_GRIDS separately).
+        return [list(range(b, b + chips_per_worker))
+                for b in range(0, total_chips - chips_per_worker + 1,
+                               chips_per_worker)]
+    (hx, hy), cx, cy = geo
+    return [[(ax + i) * hy + (ay + j)
+             for i in range(cx) for j in range(cy)]
+            for ax in range(0, hx, cx) for ay in range(0, hy, cy)]
+
+
+def _process_bounds(host: int, chips_per_worker: int,
+                    taken: list[list[int]]) -> str | None:
+    """``TPU_PROCESS_BOUNDS`` for workers owning the ``taken`` blocks
+    (sorted id lists) of a ``host``-chip grid, or None when no coherent
+    rectangular process grid is derivable — the blocks aren't aligned
+    subgrids of a known host grid, or they don't fill a rows × cols
+    box (a diagonal pick of 2 blocks would declare 4 process slots).
+    The ONE place block-grid geometry turns into bounds, shared by
+    ``tpu_worker_env`` and ``validate_tpu_request``."""
+    geo = _carve_geometry(host, chips_per_worker)
+    if geo is None:
+        return None
+    (_, hy), _, cy = geo
+    key = [sorted(b) for b in _grid_blocks(host, chips_per_worker)]
+    if any(t not in key for t in taken):
+        return None
+    nby = hy // cy                            # blocks per grid row
+    idx = [key.index(t) for t in taken]
+    bx = {i // nby for i in idx}
+    by = {i % nby for i in idx}
+    if len(bx) * len(by) != len(taken):
+        return None
+    return f"{len(bx)},{len(by)},1"
+
+
 def _chips_for_rank(chips: list[int], rank: int,
                     chips_per_worker: int) -> list[int]:
-    """Rank's slice of an explicit chip list, with modulo recycling
-    when the list is short (parity with the reference's
-    process_manager.py:107-112 fallback; the validated magic path
-    rejects short lists before this can engage)."""
+    """Rank's slice of an explicit chip list.  A short list raises
+    here rather than recycling modulo (the reference recycles GPU ids,
+    process_manager.py:107-112, because CUDA contexts can share a
+    device; TPU runtime processes cannot share a chip, so recycling
+    would pin two workers to one chip and both would die inside the
+    runtime).  The validated magic path rejects short lists earlier;
+    this keeps the invariant for direct callers of
+    ``tpu_worker_env``/``worker_env`` too."""
     base = rank * chips_per_worker
-    return [chips[(base + i) % len(chips)]
-            for i in range(chips_per_worker)]
+    if base + chips_per_worker > len(chips):
+        raise ValueError(
+            f"chip list {chips} too short for rank {rank} x "
+            f"{chips_per_worker} chip(s)/worker: TPU runtime processes "
+            f"cannot share a chip, so ids are never recycled")
+    if len(set(chips)) != len(chips):
+        raise ValueError(
+            f"duplicate ids in chip list {chips}: TPU runtime "
+            f"processes cannot share a chip")
+    return chips[base:base + chips_per_worker]
 
 
 def tpu_worker_env(rank: int, world_size: int, *,
                    chips_per_worker: int = 1,
                    chips: list[int] | None = None,
+                   host_chips: int | None = None,
                    tpu_process_base_port: int = 8476,
                    base: dict | None = None) -> dict:
     """Env for a TPU worker owning ``chips_per_worker`` chips of a
@@ -74,11 +150,19 @@ def tpu_worker_env(rank: int, world_size: int, *,
     ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` carve the
     chip grid, ``TPU_VISIBLE_CHIPS`` pins this worker's chips, and
     ``TPU_PROCESS_ADDRESSES`` lists every worker's TPU-runtime port.
-    ``chips`` pins an explicit (possibly non-contiguous) chip set —
-    the analog of the reference's ``--gpu-ids`` assignment (reference:
-    process_manager.py:107-112); default is chips 0..N-1.  Multi-host
-    pods need per-host launch instead (SURVEY §5.8 notes the reference
-    has the same single-node assumption at worker.py:129).
+    ``chips`` pins an explicit chip set — the analog of the
+    reference's ``--gpu-ids`` assignment (reference:
+    process_manager.py:107-112).  Single-chip workers may pin any
+    distinct ids (non-contiguous is fine, e.g. ``2,3`` on a shared
+    host); multi-chip workers must each own an aligned physical
+    subgrid block (see ``_grid_blocks`` — enforced pre-spawn by
+    ``validate_tpu_request``).  Default is the row-major grid carve.
+    ``host_chips`` is the host's probed chip count: subgrid geometry
+    must be carved from the HOST grid (a 4-chip job on a v5e-8 lives
+    on the (2,4) grid, where a 2x2 block is {0,1,4,5}, not {0,1,2,3}).
+    Multi-host pods need per-host launch instead (SURVEY §5.8 notes
+    the reference has the same single-node assumption at
+    worker.py:129).
     """
     env = dict(base if base is not None else os.environ)
     total_chips = world_size * chips_per_worker
@@ -96,14 +180,37 @@ def tpu_worker_env(rank: int, world_size: int, *,
             if chips else str(rank))
     else:
         # One worker spanning several chips (e.g. 2 workers x 4 chips).
-        env["TPU_PROCESS_BOUNDS"] = f"1,{world_size},1"
+        # Geometry is carved from the HOST grid when known (else from
+        # the requested total): default chips are the first
+        # ``world_size`` blocks of the row-major carve, and
+        # TPU_PROCESS_BOUNDS is the rectangle those blocks span in
+        # block coordinates — the same _grid_blocks geometry
+        # validate_tpu_request checks explicit lists against, so the
+        # ids and the declared bounds derive from one carve.
+        host = host_chips if host_chips in _V5E_GRIDS else total_chips
         cx, cy = _V5E_GRIDS.get(chips_per_worker, (1, chips_per_worker))
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{cx},{cy},1"
-        mine = (_chips_for_rank(chips, rank, chips_per_worker)
-                if chips else
-                range(rank * chips_per_worker,
-                      (rank + 1) * chips_per_worker))
+        blocks = _grid_blocks(host, chips_per_worker)
+        if chips:
+            mine = _chips_for_rank(chips, rank, chips_per_worker)
+        else:
+            if world_size > len(blocks):
+                raise ValueError(
+                    f"{world_size} worker(s) × {chips_per_worker} "
+                    f"chip(s)/worker exceed the host's {len(blocks)} "
+                    f"subgrid block(s) of {chips_per_worker} chips")
+            mine = blocks[rank]
         env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in mine)
+        taken = ([sorted(chips[r * chips_per_worker:
+                               (r + 1) * chips_per_worker])
+                  for r in range(world_size)] if chips
+                 else [sorted(b) for b in blocks[:world_size]])
+        # validate_tpu_request rejects non-rectangular picks pre-spawn;
+        # a direct caller bypassing it (or an unknown host geometry)
+        # gets the linear fallback carve instead of contradictory vars.
+        env["TPU_PROCESS_BOUNDS"] = (
+            _process_bounds(host, chips_per_worker, taken)
+            or f"1,{world_size},1")
     env["TPU_PROCESS_ADDRESSES"] = ",".join(
         f"localhost:{tpu_process_base_port + r}" for r in range(world_size))
     env["TPU_PROCESS_PORT"] = str(tpu_process_base_port + rank)
@@ -113,13 +220,15 @@ def tpu_worker_env(rank: int, world_size: int, *,
 
 def worker_env(rank: int, world_size: int, backend: str, *,
                chips_per_worker: int = 1, chips: list[int] | None = None,
+               host_chips: int | None = None,
                base: dict | None = None) -> dict:
     if backend == "cpu":
         return cpu_worker_env(base)
     if backend == "tpu":
         return tpu_worker_env(rank, world_size,
                               chips_per_worker=chips_per_worker,
-                              chips=chips, base=base)
+                              chips=chips, host_chips=host_chips,
+                              base=base)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -148,10 +257,12 @@ def available_tpu_chips() -> int | None:
 
 
 def validate_tpu_request(world_size: int, chips_per_worker: int,
-                         chips: list[int] | None = None) -> None:
+                         chips: list[int] | None = None) -> int | None:
     """Fail fast (before any spawn) when the requested topology cannot
     fit this host's chips — N workers dying inside the TPU runtime is a
-    much worse error message.
+    much worse error message.  Returns the probed host chip count (or
+    None when unknowable) so the caller can feed the SAME geometry
+    into ``tpu_worker_env(host_chips=...)`` without a second probe.
 
     With an explicit ``chips`` list, mirrors the reference's pre-spawn
     GPU-id validation (reference: magic.py:454-488): every id must
@@ -182,6 +293,43 @@ def validate_tpu_request(world_size: int, chips_per_worker: int,
                 raise ValueError(
                     f"Invalid chip IDs: {invalid}. Available chips: "
                     f"{list(range(have))}")
+        if chips_per_worker > 1 and _carve_geometry(have, chips_per_worker):
+            # TPU_CHIPS_PER_PROCESS_BOUNDS declares a contiguous
+            # (cx,cy) physical subgrid per worker; a TPU_VISIBLE_CHIPS
+            # set that is not such a subgrid (e.g. '0,2,4,6')
+            # contradicts that carve and the runtime may reject or
+            # mis-map it.  Each worker's slice must be one of the
+            # aligned subgrid blocks of the host grid, and the blocks
+            # together must fill a rectangle of the block grid (the
+            # process grid is rectangular).  Blocks are not always
+            # consecutive ids: 4 chips/worker on a (2,4) v5e-8 is
+            # {0,1,4,5} / {2,3,6,7}.  (Block reuse needs no check:
+            # blocks partition the id space, so reuse implies
+            # duplicate ids, rejected above.)  Unknown or non-v5e host
+            # geometry skips these checks entirely — trust the user,
+            # as with the availability check below; never re-anchor to
+            # the request size (a (1,2) block at ids [2,3] is legal on
+            # a real v5e-8 even though a 2-chip grid wouldn't hold it).
+            blocks = [sorted(b)
+                      for b in _grid_blocks(have, chips_per_worker)]
+            taken = []
+            for r in range(world_size):
+                sl = used[r * chips_per_worker:(r + 1) * chips_per_worker]
+                if sorted(sl) not in blocks:
+                    raise ValueError(
+                        f"chip IDs {sl} for worker {r} do not form a "
+                        f"contiguous physical subgrid of "
+                        f"{chips_per_worker} chips: multi-chip workers "
+                        f"carve aligned subgrids, one of {blocks}")
+                taken.append(sorted(sl))
+            if _process_bounds(have, chips_per_worker, taken) is None:
+                raise ValueError(
+                    f"chip blocks {taken} do not fill a rectangle of "
+                    f"the host's block grid: the TPU process grid is "
+                    f"rectangular, so the workers' blocks must span a "
+                    f"full rows × cols box (a diagonal pick like "
+                    f"[0,1]+[6,7] declares 4 process slots for 2 "
+                    f"workers)")
     if have is not None and need > have:
         # Suggest the largest world size that both fits the host AND
         # lands on a supported grid — advice the next attempt can
@@ -198,6 +346,7 @@ def validate_tpu_request(world_size: int, chips_per_worker: int,
         raise ValueError(
             f"unsupported single-host chip count {need}; supported: "
             f"{sorted(_V5E_GRIDS)}")
+    return have
 
 
 def detect_backend() -> str:

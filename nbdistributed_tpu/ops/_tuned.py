@@ -38,7 +38,10 @@ def load(path: str | None = None):
         decode = {_parse_key(k): int(v)
                   for k, v in raw.get("decode", {}).items()}
         return flash, decode
-    except (OSError, ValueError, TypeError):
+    except (OSError, ValueError, TypeError, AttributeError):
+        # AttributeError covers wrong-schema files (top level or a
+        # sub-table not a dict): a malformed table must degrade to
+        # kernel defaults, never break import of ops.attention/decode.
         return {}, {}
 
 

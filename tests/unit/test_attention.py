@@ -412,3 +412,9 @@ def test_tuned_blocks_file_roundtrip(tmp_path):
     assert _tuned.load(str(tmp_path / "absent.json")) == ({}, {})
     (tmp_path / "bad.json").write_text("{not json")
     assert _tuned.load(str(tmp_path / "bad.json")) == ({}, {})
+    # Valid JSON, wrong schema: top level or sub-tables not dicts —
+    # must degrade to defaults, never crash import of ops.attention.
+    for bad in ('["a list"]', '{"flash": [1,2]}', '{"decode": 7}',
+                '{"flash": {"x": 1}}', '{"flash": {"1,2": null}}'):
+        (tmp_path / "schema.json").write_text(bad)
+        assert _tuned.load(str(tmp_path / "schema.json")) == ({}, {})

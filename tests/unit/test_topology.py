@@ -112,12 +112,123 @@ def test_chip_pinning_env_multi_chip_worker():
     assert env1["TPU_VISIBLE_CHIPS"] == "6,7"
 
 
-def test_chip_pinning_env_recycles_modulo():
-    """API-layer parity with the reference's modulo fallback
-    (process_manager.py:107-112); the validated path rejects short
-    lists before this engages."""
-    env = topology.tpu_worker_env(1, 2, chips=[5], base={})
-    assert env["TPU_VISIBLE_CHIPS"] == "5"
+def test_chip_pinning_env_short_list_raises():
+    """A short chip list raises at env-construction time (never the
+    reference's modulo recycling, process_manager.py:107-112 — TPU
+    runtime processes cannot share a chip), so direct callers of
+    tpu_worker_env that bypass validate_tpu_request still cannot pin
+    two workers to one chip."""
+    with pytest.raises(ValueError, match="never recycled"):
+        topology.tpu_worker_env(1, 2, chips=[5], base={})
+    with pytest.raises(ValueError, match="never recycled"):
+        topology.tpu_worker_env(1, 2, chips_per_worker=2,
+                                chips=[0, 1, 2], base={})
+    # Duplicates in a long-enough list are equally chip-sharing.
+    with pytest.raises(ValueError, match="duplicate ids"):
+        topology.tpu_worker_env(0, 2, chips_per_worker=2,
+                                chips=[0, 1, 0, 1], base={})
+
+
+def test_grid_blocks_no_phantom_ids():
+    """The consecutive-run fallback never emits ids past total_chips
+    (partial trailing blocks are dropped, not padded)."""
+    for total, cpw in ((8, 3), (4, 3), (8, 5)):
+        for b in topology._grid_blocks(total, cpw):
+            assert all(c < total for c in b), (total, cpw, b)
+            assert len(b) == cpw
+
+
+def test_validate_chips_non_v5e_host_skips_geometry(monkeypatch):
+    """A probed count outside the v5e grid table (e.g. a 16-entry axon
+    pool) must skip the subgrid checks — never re-anchor them to the
+    request size."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 16)
+    assert topology.validate_tpu_request(1, 2, chips=[2, 3]) == 16
+
+
+def test_validate_chips_adjacency(monkeypatch):
+    """chips_per_worker>1 requires each worker's slice to be an
+    aligned physical subgrid block of the host grid (the TPU runtime
+    carves a contiguous (cx,cy) subgrid per process)."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    with pytest.raises(ValueError, match="physical subgrid"):
+        topology.validate_tpu_request(2, 2, chips=[0, 2, 4, 6])
+    with pytest.raises(ValueError, match="physical subgrid"):
+        topology.validate_tpu_request(1, 2, chips=[1, 2])  # unaligned
+    topology.validate_tpu_request(2, 2, chips=[0, 1, 2, 3])  # ok
+    topology.validate_tpu_request(1, 2, chips=[2, 3])        # ok
+    topology.validate_tpu_request(2, 2, chips=[2, 3, 0, 1])  # any order
+
+
+def test_validate_chips_subgrid_blocks_cpw4(monkeypatch):
+    """4 chips/worker on a (2,4) v5e-8: the physical 2x2 subgrids are
+    {0,1,4,5} / {2,3,6,7} under the row-major id map — NOT consecutive
+    id runs.  The validator and the default env derive from the same
+    carve, so the blocks agree."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    topology.validate_tpu_request(2, 4, chips=[0, 1, 4, 5, 2, 3, 6, 7])
+    with pytest.raises(ValueError, match="physical subgrid"):
+        # A consecutive id run is a 1x4 strip, contradicting the
+        # declared 2x2 TPU_CHIPS_PER_PROCESS_BOUNDS carve.
+        topology.validate_tpu_request(2, 4, chips=list(range(8)))
+    env0 = topology.tpu_worker_env(0, 2, chips_per_worker=4, base={})
+    env1 = topology.tpu_worker_env(1, 2, chips_per_worker=4, base={})
+    assert env0["TPU_VISIBLE_CHIPS"] == "0,1,4,5"
+    assert env1["TPU_VISIBLE_CHIPS"] == "2,3,6,7"
+    assert env0["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    assert env0["TPU_PROCESS_BOUNDS"] == "1,2,1"
+
+
+def test_multi_chip_default_carve_is_host_aware(monkeypatch):
+    """A 4-chip worker on an 8-chip host must get a 2x2 block of the
+    HOST's (2,4) grid — {0,1,4,5} — not the (2,2) grid's {0,1,2,3};
+    the env carve and validate_tpu_request agree on the geometry."""
+    env = topology.tpu_worker_env(0, 1, chips_per_worker=4,
+                                  host_chips=8, base={})
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,4,5"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    topology.validate_tpu_request(1, 4, chips=[0, 1, 4, 5])   # ok
+    with pytest.raises(ValueError, match="physical subgrid"):
+        topology.validate_tpu_request(1, 4, chips=[0, 1, 2, 3])
+    # Without host info the requested total is the grid (standalone
+    # 4-chip host): a (2,2) grid is one block, consecutive ids.
+    env = topology.tpu_worker_env(0, 1, chips_per_worker=4, base={})
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    # Explicit non-first blocks still span a coherent process grid:
+    # workers on blocks {4,5} and {6,7} of the (2,4) host sit in one
+    # grid row of blocks -> process bounds 1,2.
+    env = topology.tpu_worker_env(0, 2, chips_per_worker=2,
+                                  chips=[4, 5, 6, 7], host_chips=8,
+                                  base={})
+    assert env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+
+
+def test_validate_chips_rectangle_and_ordering(monkeypatch):
+    """Diagonal block picks are rejected (the TPU process grid is a
+    rectangle: 2 workers on blocks {0,1}+{6,7} of a (2,4) host would
+    declare 4 process slots); out-of-range ids get the range error,
+    not a misleading subgrid message."""
+    monkeypatch.setattr(topology, "available_tpu_chips", lambda: 8)
+    with pytest.raises(ValueError, match="rectangle"):
+        topology.validate_tpu_request(2, 2, chips=[0, 1, 6, 7])
+    with pytest.raises(ValueError, match="rectangle"):
+        topology.validate_tpu_request(2, 2, chips=[2, 3, 4, 5])
+    topology.validate_tpu_request(2, 2, chips=[0, 1, 4, 5])  # a column
+    with pytest.raises(ValueError, match="Invalid chip IDs: \\[8, 9\\]"):
+        topology.validate_tpu_request(2, 2, chips=[0, 1, 8, 9])
+    assert topology.validate_tpu_request(2, 2,
+                                         chips=[0, 1, 2, 3]) == 8
+    # tpu_worker_env falls back to the linear carve (never an
+    # inconsistent rectangle) when handed a non-rectangular pick, and
+    # raises (not IndexError) when the host has too few blocks.
+    env = topology.tpu_worker_env(0, 2, chips_per_worker=2,
+                                  chips=[0, 1, 6, 7], host_chips=8,
+                                  base={})
+    assert env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+    with pytest.raises(ValueError, match="subgrid block"):
+        topology.tpu_worker_env(1, 2, chips_per_worker=4,
+                                host_chips=4, base={})
 
 
 def test_validate_chips_not_enough(monkeypatch):
@@ -154,9 +265,12 @@ def test_validate_chips_ok(monkeypatch):
 
 def test_validate_chips_unknown_count(monkeypatch):
     """No probe signal: format/count/dup checks still apply, the
-    availability check is skipped."""
+    availability AND subgrid-geometry checks are skipped (a (1,2)
+    block at ids [2,3] is legal on a real v5e-8 even though a
+    2-chip grid alone wouldn't contain it)."""
     monkeypatch.setattr(topology, "available_tpu_chips", lambda: None)
     topology.validate_tpu_request(2, 1, chips=[6, 7])
+    assert topology.validate_tpu_request(1, 2, chips=[2, 3]) is None
 
 
 def test_start_workers_rejects_bad_chip_request(monkeypatch):
