@@ -113,18 +113,23 @@ def moe_model_shardings(cfg: MoEConfig, ep_axis: str = "ep",
 
 
 def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str,
-                  token_mask=None):
+                  token_mask=None, token_axes: tuple = ("dp",)):
     """The MoE feed-forward residual block (the expert analog of
     ``transformer._mlp_block``) — the single definition shared by the
     training forward and the cached generation path.  ``token_mask``:
     masked tokens pass through the residual untouched and take no
-    expert capacity (see expert.moe_ffn)."""
+    expert capacity (see expert.moe_ffn).  ``token_axes``: the mesh
+    axes the flattened token dim is sharded over — the training
+    forward adds the sequence-parallel axis so the hierarchical
+    dropless path keeps its routing sorts sequence-sharded (the
+    decode path's per-step tokens are dp-sharded only)."""
     h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     y, layer_aux = moe_ffn(h, layer["moe"], top_k=cfg.top_k,
                            capacity_factor=cfg.capacity_factor,
                            mesh=mesh, ep_axis=ep_axis,
                            dispatch_mode=cfg.moe_dispatch,
-                           token_mask=token_mask)
+                           token_mask=token_mask,
+                           token_axes=token_axes)
     return x + y, layer_aux
 
 
@@ -145,11 +150,14 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens].astype(cfg.dtype)
 
+    tok_axes = ("dp",) + ((sp.axis,) if sp is not None else ())
+
     def layer_step(carry, layer):
         x, aux = carry
         x = _attention_block(x, layer, cfg, positions, sp,
                              segment_ids)
-        x, layer_aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis)
+        x, layer_aux = _moe_mlp_block(x, layer, cfg, mesh, ep_axis,
+                                      token_axes=tok_axes)
         return (x, aux + layer_aux), None
 
     (x, aux), _ = jax.lax.scan(layer_step, (x, jnp.float32(0.0)),

@@ -202,73 +202,84 @@ def _dropless_ffn(xt, params, gates, expert_idx, E: int,
     return jnp.zeros((T, D), xt.dtype).at[tok].add(rows * w[:, None])
 
 
-def _dropless_ffn_ep(xt, params, gates, expert_idx, E: int, mesh,
+def _dropless_ffn_ep(xt, params, logits, top_k: int, E: int, mesh,
                      ep_axis: str, capacity_factor: float,
-                     token_mask=None, capacity: int | None = None):
-    """Expert-parallel dropless: a static shard-level exchange feeding
-    locally dropless ``ragged_dot`` segments.
+                     token_mask=None, capacity: int | None = None,
+                     token_axes: tuple = ("dp",)):
+    """Expert-parallel dropless: hierarchical per-token-shard routing
+    feeding locally dropless ``ragged_dot`` segments — no global
+    collective anywhere on the token path.
 
     True dropless dispatch (variable per-expert group sizes) cannot
-    cross an SPMD shard boundary — the exchange needs a static shape.
-    The hybrid: tokens sort by expert exactly as in the replicated
-    dropless path, but the static exchange buffer is bounded per
-    *shard* (``Cs = ceil(cf·kT/ep)``, pooled over the shard's E/ep
-    experts), not per expert.  Inside each shard (a ``shard_map`` over
-    ``ep_axis``) the expert segments stay variable-size and every
-    received token is computed — so the only drop point is whole-shard
-    overflow, which pools the per-expert slack (a hot expert borrows
-    headroom from its shard-mates; per-expert capacity has no such
-    pooling) and vanishes once ``cf·kT/ep`` reaches ``kT``.  The
-    ``with_sharding_constraint`` on the (ep, Cs, D) buffer makes GSPMD
-    compile the exchange as an all_to_all over ICI, as in the
-    dense/sparse paths.
+    cross an SPMD shard boundary — a static bound is needed somewhere.
+    Earlier revisions bounded a global (ep, Cs, D) exchange buffer and
+    let GSPMD compile the token movement, but the routing sort ran on
+    the GLOBALLY flattened (kT,) choice array: with tokens sharded
+    over a data axis, GSPMD lowers that sort (and the sorted (kT, D)
+    row gather feeding the buffer) as all-gather-shaped collectives —
+    fine at bench scale, quadratic wire cost at pod scale.
+
+    This version keeps every step shard-local (a ``shard_map`` over
+    the token axes × ``ep_axis``):
+
+    * tokens stay sharded over ``token_axes`` (activations between
+      layers are replicated over ``ep``, so each (token-shard, ep)
+      device already holds its token block — dispatch needs NO
+      exchange at all, only a local sort of ``kT/n_dp`` choices);
+    * each device selects the rows routed to ITS ``E/ep`` experts into
+      a static ``(Cs, D)`` buffer, ``Cs = ceil(cf·k·T_loc/ep)`` pooled
+      over the shard's experts (an explicit per-expert ``capacity``
+      pools to ``(E/ep)·capacity``) — drops only at whole-(token-
+      shard, ep) overflow, vanishing once the bound reaches
+      ``k·T_loc``;
+    * the SwiGLU runs as three ``ragged_dot`` grouped matmuls over the
+      variable-size local expert segments (every received row
+      computed);
+    * combine is one ``psum`` over ``ep`` of the (T_loc, D) partial
+      outputs — the single collective in the layer, riding ICI.
+
+    Takes the raw router ``logits`` rather than precomputed
+    gates/indices: ``lax.top_k`` lowers to XLA's TopK custom call,
+    which GSPMD does not partition over sharded rows (it all-gathers
+    the (T, E) probs) — running the top-k on each shard's local
+    logits block inside the shard_map keeps routing collective-free
+    and is exact (top-k is row-wise).
+
+    The ep-redundant sort (each ep shard re-sorts its token block's
+    choices) trades ``n_ep``× duplicated O(kT_loc log kT_loc) integer
+    work for zero token-exchange collectives — integer sorts are noise
+    next to the expert GEMMs on the MXU.  ``token_axes`` names the
+    mesh axes the flattened token dim is sharded over (axes absent
+    from the mesh are ignored; a token count not divisible by the
+    token-shard product falls back to replicated-token semantics).
     """
     from ..models.transformer import is_quantized
 
     T, D = xt.shape
-    k = expert_idx.shape[1]
+    k = top_k
     n_ep = mesh.shape[ep_axis]
     if E % n_ep:
         raise ValueError(f"n_experts {E} not divisible by ep axis "
                          f"size {n_ep}")
     E_loc = E // n_ep
-    kT = k * T
+    tok_axes = tuple(a for a in token_axes
+                     if a in mesh.shape and a != ep_axis)
+    n_tok = 1
+    for a in tok_axes:
+        n_tok *= mesh.shape[a]
+    if n_tok == 1 or T % n_tok:
+        tok_axes, n_tok = (), 1
+    T_loc = T // n_tok
+    kT_loc = k * T_loc
     # Same formula as the per-expert paths, pooled at shard level:
-    # "experts" = shards, so the bound is ceil(cf·kT/ep) rounded to 8.
-    # An explicit ``capacity`` keeps its dense/sparse meaning —
+    # "experts" = shards, so the bound is ceil(cf·k·T_loc/ep) rounded
+    # to 8.  An explicit ``capacity`` keeps its dense/sparse meaning —
     # per-EXPERT — and pools to E_loc·capacity per shard, so a caller
     # switching dispatch modes with a tuned per-expert value gets at
     # least the headroom the other modes gave (plus the pooling).
     Cs = (E_loc * capacity if capacity is not None
-          else compute_capacity(T, n_ep, k, capacity_factor))
-    Cs = min(Cs, kT)   # a shard can never receive more than kT rows
-
-    order, e_sorted, tok, counts = _route_sort(expert_idx, E,
-                                               token_mask)
-    counts_e = counts[:E]
-    # Sorted rows are contiguous per shard (expert ids ascending =>
-    # shard ids ascending); position within the shard's segment is the
-    # row index minus the shard's start row.
-    s_sorted = e_sorted // E_loc                  # sentinel rows: n_ep
-    shard_counts = counts_e.reshape(n_ep, E_loc).sum(axis=1)
-    shard_starts = jnp.cumsum(shard_counts) - shard_counts
-    pos = (jnp.arange(kT, dtype=jnp.int32)
-           - shard_starts[jnp.minimum(s_sorted, n_ep - 1)])
-    keep = (e_sorted < E) & (pos < Cs)
-    slot = jnp.where(keep, s_sorted * Cs + pos,
-                     n_ep * Cs).astype(jnp.int32)
-    buf = jnp.zeros((n_ep * Cs, D), xt.dtype).at[slot].set(
-        xt[tok], mode="drop").reshape(n_ep, Cs, D)
-
-    # Per-expert group sizes AFTER the shard cut: expert e's rows sit
-    # at within-shard positions [off_e, off_e + n_e); kept are < Cs.
-    off_e = (jnp.cumsum(counts_e) - counts_e
-             - shard_starts[jnp.arange(E) // E_loc])
-    gs_kept = (jnp.clip(off_e + counts_e, 0, Cs)
-               - jnp.clip(off_e, 0, Cs)).astype(jnp.int32)   # (E,)
-
-    sh = NamedSharding(mesh, P(ep_axis, None, None))
-    buf = jax.lax.with_sharding_constraint(buf, sh)   # a2a in
+          else compute_capacity(T_loc, n_ep, k, capacity_factor))
+    Cs = min(Cs, kT_loc)   # a shard never receives more than kT rows
 
     def wspec(w):
         if is_quantized(w):
@@ -276,33 +287,63 @@ def _dropless_ffn_ep(xt, params, gates, expert_idx, E: int, mesh,
                     "s": P(ep_axis, None, None)}
         return P(ep_axis, None, None)
 
-    def local_ffn(b, gs, wg, wu, wd):
-        x = b[0]                                      # (Cs, D)
-        # Row -> local expert id, from the kept group sizes (rows past
-        # the covered total are zeros and land on the clipped last id).
-        e_row = jnp.minimum(
-            jnp.searchsorted(jnp.cumsum(gs),
-                             jnp.arange(x.shape[0]), side="right"),
-            gs.shape[0] - 1)
-        h = (jax.nn.silu(_ragged_expert_linear(x, wg, gs, e_row))
-             * _ragged_expert_linear(x, wu, gs, e_row))
-        return _ragged_expert_linear(h, wd, gs, e_row)[None]
+    tok_entry = tok_axes if tok_axes else None
+    mask = (jnp.ones((T,), bool) if token_mask is None else token_mask)
 
-    buf_out = jax.shard_map(
+    def local_ffn(x, lg, tm, wg, wu, wd):
+        # x (T_loc, D); lg (T_loc, E) router logits; wg/wu/wd local
+        # (E_loc, ...).  Routing (softmax + top-k + sort) is computed
+        # here, on the shard's rows — row-wise ops, exact vs global.
+        j = jax.lax.axis_index(ep_axis)
+        g, ei, _ = top_k_routing(lg, k)
+        order, e_sorted, tok, counts = _route_sort(ei, E, tm)
+        counts_e = counts[:E]
+        starts_e = jnp.cumsum(counts_e) - counts_e        # (E,)
+        lo = j * E_loc
+        # This shard's segment is rows [starts_e[lo], starts_e[lo] +
+        # sum of its expert counts): expert ids ascending => shard
+        # segments contiguous in the sorted order.
+        start_shard = starts_e[lo]
+        in_shard = (e_sorted >= lo) & (e_sorted < lo + E_loc)
+        pos = jnp.arange(kT_loc, dtype=jnp.int32) - start_shard
+        keep = in_shard & (pos < Cs)
+        slot = jnp.where(keep, pos, Cs).astype(jnp.int32)
+        xs = jnp.where(keep[:, None], x[tok], 0)
+        buf = jnp.zeros((Cs, D), x.dtype).at[slot].set(
+            xs, mode="drop")                              # (Cs, D)
+        # Per-local-expert group sizes after the Cs cut: expert e's
+        # rows sit at within-shard positions [off_e, off_e + n_e).
+        # (dynamic_slice: ``lo`` is a traced axis_index.)
+        off_e = jax.lax.dynamic_slice(starts_e, (lo,),
+                                      (E_loc,)) - start_shard
+        n_e = jax.lax.dynamic_slice(counts_e, (lo,), (E_loc,))
+        gs = (jnp.clip(off_e + n_e, 0, Cs)
+              - jnp.clip(off_e, 0, Cs)).astype(jnp.int32)
+        # Row -> local expert id (rows past the covered total are
+        # zeros and land on the clipped last id).
+        e_row = jnp.minimum(
+            jnp.searchsorted(jnp.cumsum(gs), jnp.arange(Cs),
+                             side="right"),
+            E_loc - 1)
+        h = (jax.nn.silu(_ragged_expert_linear(buf, wg, gs, e_row))
+             * _ragged_expert_linear(buf, wu, gs, e_row))
+        out = _ragged_expert_linear(h, wd, gs, e_row)     # (Cs, D)
+        g_sorted = g.T.reshape(-1)[order]
+        wgt = jnp.where(keep, g_sorted, 0.0).astype(x.dtype)
+        rows = jnp.take(out, slot, axis=0, mode="fill", fill_value=0)
+        y = jnp.zeros((T_loc, D), x.dtype).at[tok].add(
+            rows * wgt[:, None])
+        return jax.lax.psum(y, ep_axis)                   # combine
+
+    return jax.shard_map(
         local_ffn, mesh=mesh,
-        in_specs=(P(ep_axis, None, None), P(ep_axis),
+        in_specs=(P(tok_entry, None), P(tok_entry, None),
+                  P(tok_entry),
                   wspec(params["w_gate"]), wspec(params["w_up"]),
                   wspec(params["w_down"])),
-        out_specs=P(ep_axis, None, None), check_vma=False)(
-        buf, gs_kept, params["w_gate"], params["w_up"],
-        params["w_down"])
-    buf_out = jax.lax.with_sharding_constraint(buf_out, sh)  # a2a out
-
-    g_sorted = gates.T.reshape(-1)[order]
-    w = jnp.where(keep, g_sorted, 0.0).astype(xt.dtype)
-    rows = jnp.take(buf_out.reshape(n_ep * Cs, D), slot, axis=0,
-                    mode="fill", fill_value=0)
-    return jnp.zeros((T, D), xt.dtype).at[tok].add(rows * w[:, None])
+        out_specs=P(tok_entry, None), check_vma=False)(
+        xt, logits, mask, params["w_gate"],
+        params["w_up"], params["w_down"])
 
 
 def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
@@ -334,7 +375,8 @@ def sparse_slots(expert_idx, E: int, C: int, token_mask=None):
 def moe_ffn(x, params: dict, *, top_k: int = 2,
             capacity_factor: float = 1.25, mesh=None,
             ep_axis: str = "ep", dispatch_mode: str = "dense",
-            token_mask=None, capacity: int | None = None):
+            token_mask=None, capacity: int | None = None,
+            token_axes: tuple = ("dp",)):
     """Mixture-of-experts SwiGLU feed-forward.
 
     x: (..., D) -> (same shape, aux_loss scalar).  When ``mesh`` (with an
@@ -368,11 +410,16 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
       are ignored.  Equals the dense oracle whenever the oracle's
       capacity is lossless; under tight capacity it is the *better*
       answer (the one capacity only approximates).  Over an ``ep``
-      mesh axis it becomes the shard-capacity hybrid
-      (:func:`_dropless_ffn_ep`): a static per-SHARD exchange buffer
-      (``Cs = ceil(cf·kT/ep)``; an explicit per-expert ``capacity``
-      pools to ``(E/ep)·capacity``) feeds locally dropless ragged
-      segments — per-expert slack pools across each shard's E/ep
+      mesh axis it becomes the hierarchical shard-capacity hybrid
+      (:func:`_dropless_ffn_ep`): routing sorts stay local to each
+      token shard (``token_axes`` names the mesh axes the flattened
+      token dim is sharded over, default ``("dp",)``), each
+      (token-shard, ep) device selects its experts' rows into a
+      static ``(Cs, D)`` buffer (``Cs = ceil(cf·k·T_loc/ep)``; an
+      explicit per-expert ``capacity`` pools to ``(E/ep)·capacity``)
+      feeding locally dropless ragged segments, and combine is one
+      ``psum`` over ``ep`` — no global all-gather/all-to-all on the
+      token path.  Per-expert slack pools across each shard's E/ep
       experts, so drops only occur at whole-shard overflow.
 
     ``token_mask`` (bool, shape ``x.shape[:-1]``): masked-out tokens
@@ -397,17 +444,30 @@ def moe_ffn(x, params: dict, *, top_k: int = 2,
               else token_mask.reshape(-1))
 
     logits = xt.astype(jnp.float32) @ params["router"]
+
+    if (dispatch_mode == "dropless" and mesh is not None
+            and ep_axis in mesh.shape):
+        # Routing (top-k) happens per token shard inside the
+        # hierarchical path's shard_map (lax.top_k's TopK custom call
+        # is not GSPMD-partitioned — see _dropless_ffn_ep).  The aux
+        # loss needs only the FIRST choice, which argmax (a plain
+        # partitionable reduce) computes identically (both break ties
+        # toward the lowest index).
+        probs = jax.nn.softmax(logits, axis=-1)
+        first = jnp.argmax(probs, axis=-1).astype(jnp.int32)[:, None]
+        aux = load_balance_loss(probs, first, E, token_mask=mask_t)
+        y = _dropless_ffn_ep(xt, params, logits, top_k, E,
+                             mesh, ep_axis, capacity_factor,
+                             token_mask=mask_t, capacity=capacity,
+                             token_axes=token_axes)
+        return y.reshape(orig_shape), aux
+
     gates, expert_idx, probs = top_k_routing(logits, top_k)
     aux = load_balance_loss(probs, expert_idx, E, token_mask=mask_t)
 
     if dispatch_mode == "dropless":
-        if mesh is not None and ep_axis in mesh.shape:
-            y = _dropless_ffn_ep(xt, params, gates, expert_idx, E,
-                                 mesh, ep_axis, capacity_factor,
-                                 token_mask=mask_t, capacity=capacity)
-        else:
-            y = _dropless_ffn(xt, params, gates, expert_idx, E,
-                              token_mask=mask_t)
+        y = _dropless_ffn(xt, params, gates, expert_idx, E,
+                          token_mask=mask_t)
         return y.reshape(orig_shape), aux
 
     if dispatch_mode == "sparse":

@@ -558,6 +558,71 @@ class TestDropless:
                                    atol=1e-5, rtol=1e-5)
         assert np.abs(np.asarray(y)[~np.asarray(mask)]).max() == 0
 
+    def test_ep_hier_no_global_collectives_on_token_path(self):
+        """The hierarchical dropless-EP exchange keeps every routing
+        step per-token-shard local: the program must contain NO
+        all_gather and NO all_to_all — the only collective on the
+        token path is the combine psum over ep.  Checked structurally
+        in the jaxpr (shard_map collectives are explicit there) AND in
+        the optimized HLO with the tokens genuinely dp-sharded (GSPMD
+        resharding would surface as all-gather there)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from nbdistributed_tpu.parallel import mesh as mesh_mod
+        from nbdistributed_tpu.parallel.tensor_parallel import \
+            apply_shardings
+        expert, p, x, E = self._setup(T=64)
+        mesh = mesh_mod.make_mesh({"dp": 2, "ep": 2},
+                                  devices=jax.devices()[:4])
+        ps = apply_shardings(p, mesh, expert.moe_param_shardings())
+
+        def fn(x_, p_):
+            return expert.moe_ffn(x_, p_, dispatch_mode="dropless",
+                                  mesh=mesh,
+                                  capacity_factor=float(2 * E))[0]
+
+        jaxpr = str(jax.make_jaxpr(fn)(x, ps))
+        assert "shard_map" in jaxpr
+        assert "all_gather" not in jaxpr, jaxpr
+        assert "all_to_all" not in jaxpr, jaxpr
+        assert "psum" in jaxpr
+
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        hlo = jax.jit(fn).lower(xs, ps).compile().as_text()
+        assert "all-gather" not in hlo, \
+            [l for l in hlo.splitlines() if "all-gather" in l]
+        assert "all-to-all" not in hlo, \
+            [l for l in hlo.splitlines() if "all-to-all" in l]
+        # And the sharded-input program still matches the oracle.
+        y = jax.jit(fn)(xs, ps)
+        y_ref = expert.moe_ffn(x, p, dispatch_mode="dropless")[0]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+
+        # Same property with the token dim sharded over BOTH dp and a
+        # sequence axis (the training layout under sequence
+        # parallelism): token_axes=("dp","sp") keeps routing local.
+        mesh2 = mesh_mod.make_mesh({"dp": 2, "sp": 2, "ep": 2},
+                                   devices=jax.devices()[:8])
+        ps2 = apply_shardings(p, mesh2, expert.moe_param_shardings())
+
+        def fn2(x_, p_):
+            return expert.moe_ffn(x_, p_, dispatch_mode="dropless",
+                                  mesh=mesh2, token_axes=("dp", "sp"),
+                                  capacity_factor=float(2 * E))[0]
+
+        xs2 = jax.device_put(
+            x, NamedSharding(mesh2, P(("dp", "sp"), None)))
+        hlo2 = jax.jit(fn2).lower(xs2, ps2).compile().as_text()
+        assert "all-gather" not in hlo2, \
+            [l for l in hlo2.splitlines() if "all-gather" in l]
+        y2 = jax.jit(fn2)(xs2, ps2)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+
     def test_ep_mesh_rejects_indivisible_experts(self):
         import jax
         import pytest

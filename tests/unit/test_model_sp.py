@@ -100,6 +100,36 @@ def test_sp_sliding_window_matches_plain(setup):
                                    err_msg=method)
 
 
+def test_sp_moe_dropless_matches_plain():
+    """Sequence parallelism composes with the hierarchical dropless-EP
+    path: moe_forward threads token_axes=("dp", sp.axis) so the
+    routing sorts run on (dp, sp)-sharded token blocks (no per-layer
+    activation all-gather over sp), and at lossless capacity the
+    dp×sp×ep result matches the replicated model."""
+    from nbdistributed_tpu.models import (init_moe_model, moe_forward,
+                                          moe_model_shardings,
+                                          tiny_moe_config)
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False,
+                          moe_dispatch="dropless",
+                          capacity_factor=2.0)     # lossless (E/k = 2)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+    ref, aux_ref = moe_forward(params, tokens, cfg)
+    mesh = mesh_mod.make_mesh({"dp": 2, "sp": 2, "ep": 2},
+                              devices=jax.devices()[:8])
+    sp = SeqParallel(mesh=mesh, method="ring", use_flash=False)
+    tok_s = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+    p_s = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        moe_model_shardings(cfg, tp_axis=None)))
+    got, aux = jax.jit(lambda p, t: moe_forward(
+        p, t, cfg, mesh=mesh, sp=sp))(p_s, tok_s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
 def test_sp_bad_method():
     with pytest.raises(ValueError, match="unknown SeqParallel method"):
         SeqParallel(mesh=None, method="nope")
