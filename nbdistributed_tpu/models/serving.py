@@ -61,7 +61,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .generate import _sample, forward_with_cache, init_kv_cache
+from .generate import (_sample, forward_with_cache, init_kv_cache,
+                       kv_cache_shardings)
 from .transformer import TransformerConfig
 
 
@@ -83,6 +84,10 @@ class DecodeServer:
     fixed-size segments through one compiled (1, N) program —
     admission activation memory O(N) instead of O(S_prompt), no
     per-bucket compiles (see :meth:`_run_prefill`).
+
+    :meth:`cache_prefix` registers a shared system prompt: its KV
+    block is prefilled once, and matching submissions admit by one
+    HBM copy + suffix-only prefill (see the method docstring).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
@@ -136,6 +141,7 @@ class DecodeServer:
         self._cfg = cfg
         self._mesh = mesh
         self._ep_axis = ep_axis
+        self._kv_quantized = kv_quantized
         self._B = max_batch
         self._T = max_len
         self._pad_to = pad_to
@@ -166,6 +172,18 @@ class DecodeServer:
             self._prefill_d = self._make_prefill(draft_cfg)
             self._spec_fn = self._jit_spec_step()
             self._spec_many_fn = self._jit_spec_many()
+
+        # Prefix cache: shared prompt prefixes prefilled ONCE into
+        # dedicated 1-slot KV blocks; admission copies the block
+        # (HBM-to-HBM, zero FLOPs) and prefills only the suffix.
+        self._prefixes: dict[int, tuple] = {}    # pid -> (tokens, ...)
+        self._next_pid = 0
+        self._absorb_fn = jax.jit(
+            lambda cache, pfx, slot: jax.tree_util.tree_map(
+                lambda c, p: jax.lax.dynamic_update_slice(
+                    c, p, (0, slot) + (0,) * (c.ndim - 2)),
+                cache, pfx),
+            donate_argnums=(0,))
 
         # Host-side bookkeeping.
         self._free = list(range(max_batch))
@@ -358,7 +376,7 @@ class DecodeServer:
         return k
 
     def _run_prefill(self, prefill_fn, params, cache, prompt: list,
-                     slot: int):
+                     slot: int, start: int = 0):
         """Prefill one slot; returns (cache, last-real-token logits).
 
         Default: one bucketed whole-prompt forward (compile count
@@ -369,15 +387,20 @@ class DecodeServer:
         prompts stop minting per-bucket compiles.  The final segment
         (padded to the chunk) carries the logits; a causal forward
         makes chunked and single-shot prefill the same computation
-        (same argument as :func:`~.generate.prefill_chunked`)."""
+        (same argument as :func:`~.generate.prefill_chunked`).
+
+        ``start``: cache offset of the first token — 0 for whole
+        prompts; the prefix length for suffix-only admission after a
+        :meth:`cache_prefix` hit (the attention machinery already
+        supports arbitrary offsets for chunked admission)."""
         L = len(prompt)
         ck = self._prefill_chunk
         if ck is None or L <= ck:
-            s_pad = min(self._bucket(L), self._T)
+            s_pad = min(self._bucket(L), self._T - start)
             padded = jnp.asarray(prompt + [0] * (s_pad - L),
                                  jnp.int32)[None, :]
             return prefill_fn(params, cache, padded, jnp.int32(slot),
-                              jnp.int32(0), jnp.int32(L))
+                              jnp.int32(start), jnp.int32(L))
         n_full = L // ck
         if L % ck == 0:
             n_full -= 1        # keep the last full chunk as the tail
@@ -385,21 +408,142 @@ class DecodeServer:
             seg = jnp.asarray(prompt[i * ck:(i + 1) * ck],
                               jnp.int32)[None, :]
             cache, _ = prefill_fn(params, cache, seg, jnp.int32(slot),
-                                  jnp.int32(i * ck), jnp.int32(ck))
+                                  jnp.int32(start + i * ck),
+                                  jnp.int32(ck))
         tail = prompt[n_full * ck:]
-        seg = jnp.asarray(tail + [0] * (ck - len(tail)),
+        # Clamp the tail's pad so the padded write never reaches past
+        # max_len (dynamic_update_slice would CLAMP the start index
+        # and silently shift the write onto earlier cache rows).
+        seg_len = min(ck, self._T - start - n_full * ck)
+        seg = jnp.asarray(tail + [0] * (seg_len - len(tail)),
                           jnp.int32)[None, :]
         return prefill_fn(params, cache, seg, jnp.int32(slot),
-                          jnp.int32(n_full * ck),
+                          jnp.int32(start + n_full * ck),
                           jnp.int32(len(tail)))
+
+    def cache_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix ONCE into a dedicated 1-slot
+        KV block; returns a prefix id.  Subsequent :meth:`submit`
+        calls whose prompt starts with these tokens admit by COPYING
+        the block into their slot (one HBM-to-HBM
+        ``dynamic_update_slice``, zero FLOPs) and prefilling only the
+        suffix — the standard continuous-batching treatment of shared
+        system prompts.  Exactness is free: causal attention makes a
+        position's K/V depend only on tokens at or before it, and RoPE
+        positions are absolute, so the copied rows are bit-identical
+        to a full prefill's.
+
+        Dense family only: MoE expert capacity is shape-derived, so a
+        suffix-length prefill would change which tokens drop vs a solo
+        run (the same reason MoE rejects ``prefill_chunk``).
+        """
+        from .moe import MoEConfig
+        if isinstance(self._cfg, MoEConfig):
+            raise ValueError(
+                "prefix caching is a dense-family option: MoE expert "
+                "capacity is shape-derived, so suffix prefill would "
+                "differ from a solo run and change which tokens drop")
+        toks = [int(t) for t in tokens]
+        if not toks:
+            raise ValueError("empty prefix")
+        if len(toks) >= self._T:
+            raise ValueError(f"prefix ({len(toks)}) must leave room "
+                             f"under max_len {self._T}")
+        # Shard the prefix buffer like the pool along the KV-head (tp)
+        # axis so the prefill forward and the absorb copy keep the
+        # mesh layout; batch (size 1) and tokens stay replicated — a
+        # 1-slot buffer can't split over dp, and its bucket length
+        # need not divide sp (GSPMD localizes the copy into the
+        # sp-sharded pool).
+        rules = None
+        if self._mesh is not None:
+            rules = kv_cache_shardings(
+                dp_axis=None,
+                tp_axis="tp" if "tp" in self._mesh.shape else None,
+                sp_axis=None, quantized=self._kv_quantized)
+
+        def build(cfg, params, prefill_fn):
+            # Size the scratch buffer for the PADDED writes (bucketed
+            # or chunk-aligned), not just the real rows — an
+            # undersized buffer would make dynamic_update_slice clamp
+            # the write offset and shift rows.
+            ck = self._prefill_chunk
+            t_buf = self._bucket(len(toks))
+            if ck is not None and len(toks) > ck:
+                t_buf = max(t_buf, -(-len(toks) // ck) * ck)
+            buf = init_kv_cache(cfg, 1, min(t_buf, self._T),
+                                mesh=self._mesh, rules=rules,
+                                quantized=self._kv_quantized)
+            buf, last_logits = self._run_prefill(prefill_fn, params,
+                                                 buf, toks, 0)
+            # Keep only the real rows: the copy into a slot must not
+            # drag pad garbage past the suffix's overwrite range.
+            buf = jax.tree_util.tree_map(
+                lambda c: c[:, :, :, :len(toks)], buf)
+            return buf, last_logits
+
+        buf_t, last_logits = build(self._cfg, self._params,
+                                   self._prefill_fn)
+        buf_d = (build(self._draft_cfg, self._draft_params,
+                       self._prefill_d)[0]
+                 if self._draft_cfg is not None else None)
+        pid = self._next_pid
+        self._next_pid += 1
+        self._prefixes[pid] = (toks, buf_t, buf_d, last_logits)
+        return pid
+
+    def drop_prefix(self, pid: int) -> None:
+        """Free a cached prefix's KV block (in-flight requests that
+        already absorbed it are unaffected — the copy is by value)."""
+        if pid not in self._prefixes:
+            raise KeyError(f"unknown prefix id {pid}")
+        del self._prefixes[pid]
+
+    def _match_prefix(self, prompt: list):
+        """Longest registered prefix the prompt starts with, or None."""
+        best = None
+        for pid, (toks, *_rest) in self._prefixes.items():
+            n = len(toks)
+            if n <= len(prompt) and prompt[:n] == toks:
+                if best is None or n > len(self._prefixes[best][0]):
+                    best = pid
+        return best
 
     def _admit_pending(self) -> None:
         while self._pending and self._free:
             rid, prompt, budget = self._pending.pop(0)
             slot = self._free.pop(0)
-            self._cache, last_logits = self._run_prefill(
-                self._prefill_fn, self._params, self._cache, prompt,
-                slot)
+            pid = self._match_prefix(prompt)
+            if pid is not None:
+                ptoks, buf_t, buf_d, plogits = self._prefixes[pid]
+                n_pfx = len(ptoks)
+                suffix = prompt[n_pfx:]
+                self._cache = self._absorb_fn(self._cache, buf_t,
+                                              jnp.int32(slot))
+                if suffix:
+                    self._cache, last_logits = self._run_prefill(
+                        self._prefill_fn, self._params, self._cache,
+                        suffix, slot, start=n_pfx)
+                else:
+                    last_logits = plogits
+                if self._draft_cfg is not None:
+                    self._cache_d = self._absorb_fn(
+                        self._cache_d, buf_d, jnp.int32(slot))
+                    if suffix:
+                        self._cache_d, _ = self._run_prefill(
+                            self._prefill_d, self._draft_params,
+                            self._cache_d, suffix, slot, start=n_pfx)
+            else:
+                self._cache, last_logits = self._run_prefill(
+                    self._prefill_fn, self._params, self._cache,
+                    prompt, slot)
+                if self._draft_cfg is not None:
+                    # Draft cache prefills the same prompt (its seed
+                    # logits are discarded — the target seeds the
+                    # stream).
+                    self._cache_d, _ = self._run_prefill(
+                        self._prefill_d, self._draft_params,
+                        self._cache_d, prompt, slot)
             tok = int(_sample(last_logits[None], self._temperature,
                               self._sample_key(), self._top_k,
                               self._top_p)[0])
@@ -407,11 +551,6 @@ class DecodeServer:
             self._lens = self._lens.at[slot].set(len(prompt))
             self._last = self._last.at[slot].set(tok)
             if self._draft_cfg is not None:
-                # Draft cache prefills the same prompt (its seed
-                # logits are discarded — the target seeds the stream).
-                self._cache_d, _ = self._run_prefill(
-                    self._prefill_d, self._draft_params,
-                    self._cache_d, prompt, slot)
                 self._lens_d = self._lens_d.at[slot].set(len(prompt))
             done = (budget == 1
                     or (self._eos is not None and tok == self._eos))

@@ -48,32 +48,40 @@ def test_spec_cell_executes_batched():
 
 def test_decode7b_cell_executes_at_toy_scale():
     cell = bench.DECODE7B_CELL.replace("llama2_7b_config", "tiny_config")
-    cell = cell.replace("_N = 32", "_N = 4")
-    cell = cell.replace("max_len=2048", "max_len=64")
-    cell = cell.replace('"cache_len": 2048', '"cache_len": 64')
+    cell = cell.replace("_N, _CL = 32, 2048", "_N, _CL = 4, 64")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
     assert res["tok_per_s"] > 0
     assert res["weight_gb"] >= 0  # rounds to 0.0 at toy scale
+    assert res["roofline_pct_v5e"] >= 0
 
 
 def test_decode_cell_executes():
     cell = bench.DECODE_CELL.replace("smol_135m_config", "tiny_config")
-    cell = cell.replace("_N = 64", "_N = 4")
+    cell = cell.replace("_N, _ML = 64, 128", "_N, _ML = 4, 128")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
     assert res["bf16_tok_per_s"] > 0 and res["int8_tok_per_s"] > 0
+    for k in ("bf16", "int8", "int8_kv8"):
+        assert res[k + "_roofline_pct_v5e"] >= 0
+        assert res[k + "_bytes_per_tok_mb"] > 0
+    # int8 weights + int8 KV must stream fewer bytes than bf16.
+    assert (res["int8_kv8_bytes_per_tok_mb"]
+            < res["bf16_bytes_per_tok_mb"])
 
 
 def test_serve_cell_executes():
     cell = bench.SERVE_CELL.replace("smol_135m_config", "tiny_config")
     cell = cell.replace("_N, _B, _L = 48, 4, 16",
                         "_N, _B, _L = 6, 2, 4")
+    cell = cell.replace("_PL, _SL = 128, 8", "_PL, _SL = 12, 4")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
     assert res["server_tok_per_s"] > 0
     assert res["sequential_tok_per_s"] > 0
     assert res["batch"] == 2 and res["new_tokens"] == 6
+    assert res["admit_ms_plain"] > 0
+    assert res["admit_ms_prefix_cached"] > 0
 
 
 def test_run_families_bails_after_consecutive_spawn_failures():

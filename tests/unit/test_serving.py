@@ -514,3 +514,185 @@ def test_spec_step_many_validation(setup, spec_setup):
                         draft_params=draft, draft_cfg=cfg)
     with pytest.raises(ValueError, match=">= 1"):
         ssrv.spec_step_many(0)
+
+
+# ---------------------------------------------------------------------
+# prefix caching (cache_prefix / drop_prefix): shared system prompts
+# admit by copying a prefilled KV block + suffix-only prefill
+
+def test_prefix_cache_matches_solo_generate(setup):
+    """N requests sharing a system prefix, admitted via cache_prefix:
+    every request's greedy tokens must equal its standalone generate()
+    run — the copied KV rows are bit-identical to a full prefill's
+    (causal attention + absolute RoPE), so solo-equality survives."""
+    cfg, params = setup
+    sys_prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+    suffixes = [[5, 3], [8, 8, 8], [1], [9, 7, 9, 7]]
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+    pid = srv.cache_prefix(sys_prefix)
+    assert pid == 0
+    rids = [srv.submit(sys_prefix + s, 5) for s in suffixes]
+    srv.run_until_done(max_steps=200)
+    for rid, s in zip(rids, suffixes):
+        assert srv.outputs[rid] == solo(params, cfg, sys_prefix + s, 5), \
+            (rid, s)
+
+
+def test_prefix_cache_whole_prompt_hit(setup):
+    """A prompt EQUAL to the cached prefix admits with zero prefill
+    forwards (the stored last-token logits seed the stream)."""
+    cfg, params = setup
+    prefix = [2, 7, 1, 8, 2, 8]
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4)
+    srv.cache_prefix(prefix)
+    calls = []
+    orig = srv._prefill_fn
+    srv._prefill_fn = (lambda *a, **k: calls.append(1) or orig(*a, **k))
+    rid = srv.submit(prefix, 4)
+    srv.run_until_done(max_steps=50)
+    assert calls == []          # no prefill forward ran at admission
+    assert srv.outputs[rid] == solo(params, cfg, prefix, 4)
+
+
+def test_prefix_cache_longest_match_and_miss(setup):
+    """Longest registered prefix wins; non-matching prompts take the
+    plain path; drop_prefix frees and unmatches."""
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+    p_short = srv.cache_prefix([4, 2])
+    p_long = srv.cache_prefix([4, 2, 6, 1])
+    assert srv._match_prefix([4, 2, 6, 1, 9]) == p_long
+    assert srv._match_prefix([4, 2, 9]) == p_short
+    assert srv._match_prefix([9, 9]) is None
+    # Both matched and unmatched prompts produce solo-exact streams.
+    reqs = [([4, 2, 6, 1, 9], 5), ([9, 9, 3], 5)]
+    rids = [srv.submit(p, n) for p, n in reqs]
+    srv.run_until_done(max_steps=100)
+    for rid, (p, n) in zip(rids, reqs):
+        assert srv.outputs[rid] == solo(params, cfg, p, n)
+    srv.drop_prefix(p_long)
+    assert srv._match_prefix([4, 2, 6, 1, 9]) == p_short
+    with pytest.raises(KeyError):
+        srv.drop_prefix(p_long)
+
+
+def test_prefix_cache_saves_prefill_tokens(setup):
+    """The admission-cost win: with a cached 16-token prefix, each
+    admission's prefill forward sees only the suffix bucket, not the
+    whole prompt — count the token positions fed through prefill."""
+    cfg, params = setup
+    prefix = list(range(1, 17))              # 16 tokens
+    suffix = [7, 3]
+    fed = {"with": 0, "without": 0}
+
+    def counting(srv, key):
+        orig = srv._prefill_fn
+
+        def wrapper(p, cache, prompt, slot, start, length):
+            fed[key] += prompt.shape[1]
+            return orig(p, cache, prompt, slot, start, length)
+
+        srv._prefill_fn = wrapper
+
+    srv_a = DecodeServer(params, cfg, max_batch=1, max_len=64, pad_to=4)
+    pid = srv_a.cache_prefix(prefix)         # one-time prefix prefill
+    counting(srv_a, "with")
+    srv_b = DecodeServer(params, cfg, max_batch=1, max_len=64, pad_to=4)
+    counting(srv_b, "without")
+    for srv, key in ((srv_a, "with"), (srv_b, "without")):
+        for _ in range(3):
+            srv.submit(prefix + suffix, 3)
+        srv.run_until_done(max_steps=100)
+    assert fed["with"] == 3 * 4              # 3 suffix buckets (pad 4)
+    assert fed["without"] == 3 * 20          # 3 whole-prompt buckets
+    assert list(srv_a.outputs.values()) == list(srv_b.outputs.values())
+
+
+def test_prefix_cache_speculative(spec_setup):
+    """Prefix admission composes with speculative serving: target AND
+    draft caches absorb the prefix block; greedy streams match the
+    plain server's."""
+    cfg, params, dparams = spec_setup
+    prefix = [5, 1, 5, 1, 5, 1]
+    reqs = [(prefix + [2, 6], 6), (prefix + [9], 6)]
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4,
+                       draft_params=dparams, draft_cfg=cfg, gamma=2)
+    srv.cache_prefix(prefix)
+    rids = [srv.submit(p, n) for p, n in reqs]
+    srv.run_until_done(max_steps=100)
+    for rid, (p, n) in zip(rids, reqs):
+        assert srv.outputs[rid] == solo(params, cfg, p, n)
+
+
+def test_prefix_cache_chunked_prefill_compose(setup):
+    """A long prefix built through chunked prefill + chunked suffix
+    admission still reproduces solo generate()."""
+    cfg, params = setup
+    prefix = [(i * 7) % 50 + 1 for i in range(37)]   # > chunk
+    suffix = [3, 3, 9, 27, 5]
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=128, pad_to=4,
+                       prefill_chunk=16)
+    srv.cache_prefix(prefix)
+    rid = srv.submit(prefix + suffix, 6)
+    srv.run_until_done(max_steps=100)
+    assert srv.outputs[rid] == solo(params, cfg, prefix + suffix, 6)
+
+
+def test_prefix_cache_int8_kv(setup):
+    """Prefix blocks copy through the quantized cache's int8+scale
+    leaves; streams match the int8 solo run."""
+    cfg, params = setup
+    prefix = [6, 2, 8, 4]
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32, pad_to=4,
+                       kv_quantized=True)
+    srv.cache_prefix(prefix)
+    rid = srv.submit(prefix + [1, 3], 4)
+    srv.run_until_done(max_steps=50)
+    out = generate(params,
+                   jnp.asarray(prefix + [1, 3], jnp.int32)[None], cfg,
+                   4, kv_quantized=True)
+    want = [int(t) for t in np.asarray(out)[0][6:]]
+    assert srv.outputs[rid] == want
+
+
+def test_prefix_cache_rejected_for_moe():
+    from nbdistributed_tpu.models import (init_moe_model,
+                                          tiny_moe_config)
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False)
+    params = init_moe_model(jax.random.PRNGKey(0), cfg)
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=32)
+    with pytest.raises(ValueError, match="dense-family"):
+        srv.cache_prefix([1, 2, 3])
+
+
+def test_prefix_cache_validation(setup):
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=16)
+    with pytest.raises(ValueError, match="empty"):
+        srv.cache_prefix([])
+    with pytest.raises(ValueError, match="max_len"):
+        srv.cache_prefix(list(range(16)))
+
+
+def test_prefix_cache_on_mesh(setup):
+    """Prefix admission over a dp×tp mesh: the prefix buffer is
+    tp-sharded like the pool (batch/token replicated — a 1-slot
+    buffer can't split over dp), the absorb copy preserves the pool's
+    layout through donation, and streams stay solo-exact."""
+    from nbdistributed_tpu.models import param_shardings
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.parallel.tensor_parallel import \
+        apply_shardings
+    cfg, params = setup
+    mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2},
+                              devices=jax.devices()[:4])
+    ps = apply_shardings(params, mesh, param_shardings(cfg))
+    prefix = [3, 1, 4, 1, 5, 9]
+    reqs = [(prefix + [2, 6], 5), (prefix + [8], 5), ([9, 9], 5)]
+    srv = DecodeServer(ps, cfg, max_batch=2, max_len=32, pad_to=4,
+                       mesh=mesh)
+    srv.cache_prefix(prefix)
+    rids = [srv.submit(p, n) for p, n in reqs]
+    srv.run_until_done(max_steps=100)
+    for rid, (p, n) in zip(rids, reqs):
+        assert srv.outputs[rid] == solo(params, cfg, p, n), (rid, p)
