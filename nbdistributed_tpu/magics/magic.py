@@ -145,6 +145,7 @@ class DistributedMagics(Magics):
         cls._timeline = Timeline()
         cls._active_display = None
         cls._proxy_registry = {}
+        cls._cell_rank_history = {}
 
     def on_extension_loaded(self) -> None:
         print("nbdistributed_tpu loaded. Start workers with: "
@@ -193,7 +194,15 @@ class DistributedMagics(Magics):
 
         def _send():
             try:
-                result.update(comm.send_to_ranks(ranks, "execute", code))
+                # target_ranks ride the request: the worker publishes
+                # them while the cell runs, and the eager
+                # world-collectives raise at CALL time when entered by
+                # a strict subset (runtime/collective_guard.py) —
+                # BEFORE the control plane would hang on replies that
+                # cannot come.
+                result.update(comm.send_to_ranks(
+                    ranks, "execute",
+                    {"code": code, "target_ranks": list(ranks)}))
             except Exception as e:
                 error.append(e)
 
@@ -238,7 +247,46 @@ class DistributedMagics(Magics):
                 print(f"❌ {type(e).__name__}: {e}")
             return None
         display_mod.print_rank_errors(result)
+        self._record_cell_ranks(result, ranks)
         return result
+
+    # Coordinator-side record of which ranks executed each cell (the
+    # SURVEY §5.2 check): keyed by the worker-computed source hash.
+    _cell_rank_history: dict = {}
+
+    def _record_cell_ranks(self, result: dict, ranks: list[int]) -> None:
+        """Track per-cell rank coverage and warn when a cell that
+        ACTUALLY invoked world-collectives (runtime count, not a text
+        scan) completed on a strict subset of the mesh.  The
+        deadlocking case raises on the worker at call time
+        (runtime/collective_guard.py) and its per-rank error already
+        tells the story — the warning is suppressed when any reply
+        errored.  What remains covers calls that complete locally
+        (e.g. raw control-plane requests with no target stamp), which
+        silently diverge state across ranks.  The accumulated history
+        names the cell's earlier rank coverage so the user can see
+        the drift; it is bounded and cleared on shutdown/reset."""
+        ops, h, errored = 0, None, False
+        for msg in result.values():
+            d = getattr(msg, "data", None)
+            if isinstance(d, dict):
+                h = d.get("cell_sha1", h)
+                ops = max(ops, int(d.get("collective_ops") or 0))
+                errored = errored or "error" in d
+        hist = DistributedMagics._cell_rank_history
+        prior = set(hist.get(h, ())) if h is not None else set()
+        if h is not None:
+            hist[h] = prior | set(ranks)
+            while len(hist) > 512:            # bound a long session
+                hist.pop(next(iter(hist)))
+        if ops and len(ranks) < self._world and not errored:
+            extra = (f" (earlier runs of this cell covered ranks "
+                     f"{sorted(prior)})" if prior - set(ranks) else "")
+            print(f"⚠️ This cell made {ops} world-collective call(s) "
+                  f"but ran on ranks {sorted(ranks)} of "
+                  f"{self._world} — collective results computed by a "
+                  f"subset diverge from the mesh; run it on all "
+                  f"ranks.{extra}")
 
     # ==================================================================
     # %dist_init

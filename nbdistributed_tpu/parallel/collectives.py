@@ -31,6 +31,8 @@ from typing import Any
 
 import numpy as np
 
+from ..runtime.collective_guard import check as _guard_check
+
 
 def _jax():
     import jax
@@ -137,6 +139,7 @@ def all_reduce(x, op: str = "sum"):
     copies are compensated (sum is rescaled; mean/max/min are invariant
     under duplication).  With one process the call is an identity.
     """
+    _guard_check("all_reduce")
     jax = _jax()
     import jax.numpy as jnp
 
@@ -164,6 +167,7 @@ def all_gather(x):
     dimension = number of ranks (``dist.all_gather`` analog).
     Lowered to an XLA all-gather over ICI/DCN; per-process duplicate
     rows (when a worker owns several devices) are sliced away."""
+    _guard_check("all_gather")
     jax = _jax()
     import jax.numpy as jnp
 
@@ -186,6 +190,7 @@ def broadcast(x, root: int = 0):
     """Every process returns root's value (``dist.broadcast`` analog).
     Implemented as mask-and-sum so any root works, not just process 0
     (``multihost_utils.broadcast_one_to_all`` only supports root 0)."""
+    _guard_check("broadcast")
     jax = _jax()
     import jax.numpy as jnp
 
@@ -200,6 +205,7 @@ def broadcast(x, root: int = 0):
 def barrier(name: str = "nbd_barrier"):
     """Block until every process arrives (``dist.barrier`` analog;
     reference uses it for %sync at worker.py:213-215)."""
+    _guard_check("barrier")
     jax = _jax()
     if jax.process_count() == 1:
         return
@@ -232,6 +238,7 @@ def reduce_scatter(x, op: str = "sum"):
     reduce-scatter (psum_scatter — no full all-reduce on the wire);
     other ops / multi-device processes fall back to all-reduce+slice.
     """
+    _guard_check("reduce_scatter")
     jax = _jax()
     import jax.numpy as jnp
 
@@ -292,6 +299,7 @@ def all_reduce_quantized(x, op: str = "sum", *, block: int = 256):
     from XLA's own collectives).  Intended for DCN-bound gradient
     exchange; use :func:`all_reduce` when exactness matters.
     """
+    _guard_check("all_reduce_quantized")
     jax = _jax()
     import jax.numpy as jnp
 

@@ -1,0 +1,84 @@
+"""Runtime collective-hazard guard — SURVEY §5.2's coordinator-side
+check, upgraded to call-time enforcement.
+
+The magic layer's pre-flight regex scan (magics/magic.py) warns on
+textual matches only: it misses aliased or indirect collective calls
+and fires on comments/strings.  This module is the RUNTIME truth:
+
+* the coordinator stamps every execute request with its target ranks
+  (``{"code": ..., "target_ranks": [...]}``);
+* the worker publishes them for the duration of the cell
+  (:func:`begin_cell` / :func:`end_cell` around the executor);
+* every eager world-collective (parallel/collectives.py) calls
+  :func:`check` on entry.
+
+A world-collective entered by a strict subset of the mesh can never
+complete — the absent ranks never join — so :func:`check` raises
+:class:`CollectiveHazardError` immediately; the error surfaces
+through the normal per-rank error path BEFORE the control plane
+would hang waiting on a reply that cannot come.  (In-jit
+``lax.psum`` over a worker-local device mesh is a different thing —
+device-level, completes locally — and is deliberately not guarded.)
+
+The cell's collective call count and code hash also ride the execute
+response (``collective_ops`` / ``cell_sha1``), giving the
+coordinator a per-cell record of which ranks ran collective-bearing
+code; the magic layer warns on subset records too, covering calls
+that happen to complete locally (e.g. a single-process world where
+``all_reduce`` is the identity).
+
+The worker's message loop is serial, so plain module state suffices;
+a user thread calling a collective outside any cell sees inactive
+state and passes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class CollectiveHazardError(RuntimeError):
+    """A world-collective was invoked from a cell running on a strict
+    subset of the mesh — raised at call time instead of deadlocking
+    the cluster."""
+
+
+_state: dict = {"targets": None, "world": 0, "ops": 0}
+
+
+def begin_cell(targets, world: int) -> None:
+    """Publish the current cell's target ranks (``None`` = unknown —
+    legacy raw-string execute requests — which disables the subset
+    check but keeps the op count)."""
+    _state["targets"] = None if targets is None else sorted(targets)
+    _state["world"] = int(world)
+    _state["ops"] = 0
+
+
+def end_cell() -> int:
+    """Clear the cell context; returns the number of eager
+    world-collective calls the cell made."""
+    ops = _state["ops"]
+    _state["targets"], _state["world"], _state["ops"] = None, 0, 0
+    return ops
+
+
+def cell_hash(code: str) -> str:
+    """Stable short id for a cell's source, reported alongside the
+    collective count so the coordinator can correlate executions of
+    the same cell across ranks."""
+    return hashlib.sha1(code.encode()).hexdigest()[:12]
+
+
+def check(op: str) -> None:
+    """Entry hook for each eager world-collective."""
+    _state["ops"] += 1
+    targets, world = _state["targets"], _state["world"]
+    if targets is not None and world and len(targets) < world:
+        raise CollectiveHazardError(
+            f"{op}() called from a cell running on ranks {targets} — "
+            f"a strict subset of the {world}-rank mesh.  A "
+            f"world-collective entered by a subset never completes "
+            f"(the other ranks never join) and would deadlock the "
+            f"cluster; run the cell on all ranks, or keep subset "
+            f"cells to rank-local work.")

@@ -31,7 +31,7 @@ import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
-from . import executor, introspect
+from . import collective_guard, executor, introspect
 from .interrupt import InterruptGate
 
 
@@ -218,10 +218,25 @@ class DistributedWorker:
     # message handlers (dispatch table analog of reference: worker.py:205-221)
 
     def _handle_execute(self, msg: Message) -> Message:
-        result = executor.execute_cell(
-            msg.data if isinstance(msg.data, str) else msg.data.get("code", ""),
-            self.namespace, self._stream, rank=self.rank,
-            filename=f"<rank {self.rank}>")
+        code = (msg.data if isinstance(msg.data, str)
+                else msg.data.get("code", ""))
+        # Publish the cell's target ranks for the duration of the cell:
+        # the eager world-collectives consult them at CALL time and
+        # raise on a strict subset instead of deadlocking (see
+        # runtime/collective_guard.py).  Raw-string requests (bench
+        # cells, direct control-plane callers) carry no targets — the
+        # subset check stays inactive for them.
+        targets = (None if isinstance(msg.data, str)
+                   else msg.data.get("target_ranks"))
+        collective_guard.begin_cell(targets, self.world_size)
+        try:
+            result = executor.execute_cell(
+                code, self.namespace, self._stream, rank=self.rank,
+                filename=f"<rank {self.rank}>")
+        finally:
+            ops = collective_guard.end_cell()
+        result["collective_ops"] = ops
+        result["cell_sha1"] = collective_guard.cell_hash(code)
         return msg.reply(data=result, rank=self.rank)
 
     def _handle_get_var(self, msg: Message) -> Message:

@@ -289,3 +289,40 @@ def test_dist_interrupt_magic_idle(ip, capsys):
     if "post-interrupt-alive" not in out:
         _dump_worker_stdio()
     assert "post-interrupt-alive" in out
+
+
+def test_collective_subset_runtime_guard(ip, capsys):
+    """ACTUALLY calling a world-collective from a subset cell — via an
+    alias the pre-flight regex cannot see — must surface a prompt
+    per-rank error (the runtime guard raises at CALL time,
+    runtime/collective_guard.py) instead of deadlocking the mesh."""
+    import time
+
+    run(ip, "alias_fn = all_reduce")       # full mesh: bind, no call
+    capsys.readouterr()
+    t0 = time.time()
+    run(ip, "%%rank [0]\nalias_fn(1.0)")   # no collective token here
+    dt = time.time() - t0
+    out = capsys.readouterr().out
+    assert "strict subset" in out and "deadlock" in out, out
+    assert dt < 60, f"guard should raise instantly, took {dt:.0f}s"
+    # The mesh survived: both ranks still answer.
+    run(ip, "'alive-' + str(rank)")
+    out = capsys.readouterr().out
+    assert "alive-0" in out and "alive-1" in out
+
+
+def test_collective_full_mesh_still_works_and_counts(ip, capsys):
+    """Full-mesh collectives keep working under the guard, and the
+    coordinator records the cell's rank coverage from the
+    worker-reported hash."""
+    run(ip, "full_sum = all_reduce(rank + 1.0)\nfloat(full_sum)")
+    out = capsys.readouterr().out
+    assert "3.0" in out                    # (0+1) + (1+1)
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    from nbdistributed_tpu.runtime import collective_guard
+    # The auto-distribute transformer ships the cell with a trailing
+    # newline; the worker hashes exactly what it executed.
+    h = collective_guard.cell_hash(
+        "full_sum = all_reduce(rank + 1.0)\nfloat(full_sum)\n")
+    assert DistributedMagics._cell_rank_history.get(h) == {0, 1}
