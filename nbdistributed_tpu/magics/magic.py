@@ -706,9 +706,7 @@ class DistributedMagics(Magics):
                     print(f"❌ rank {r}: {e}")
                 return
             self.shell.user_ns[target] = {
-                r: (m.bufs["value"] if m.data.get("array")
-                    else m.data.get("value"))
-                for r, m in resps.items()}
+                r: self._pulled_value(m) for r, m in resps.items()}
             print(f"✅ {target} = {{rank: value}} from "
                   f"{sorted(resps)} ranks")
             return
@@ -721,15 +719,30 @@ class DistributedMagics(Magics):
         if resp.data.get("error"):
             print(f"❌ {resp.data['error']}")
             return
+        self.shell.user_ns[target] = self._pulled_value(resp)
         if resp.data.get("array"):
-            value = resp.bufs["value"]
-            self.shell.user_ns[target] = value
             print(f"✅ {target} = array{tuple(resp.data['shape'])} "
                   f"{resp.data['dtype']} (from rank {args.rank})")
+        elif resp.data.get("pytree") is not None:
+            print(f"✅ {target} = pytree "
+                  f"({resp.data['n_leaves']} array leaves, from rank "
+                  f"{args.rank})")
         else:
-            self.shell.user_ns[target] = resp.data.get("value")
             print(f"✅ {target} = {self.shell.user_ns[target]!r} "
                   f"(from rank {args.rank})")
+
+    @staticmethod
+    def _pulled_value(msg):
+        """Reconstruct one rank's get_var reply: raw array, pytree on
+        the buffer path (treedef JSON + leaf bufs — no pickle; leaves
+        copied out of the read-only decode views), or plain JSON
+        value."""
+        if msg.data.get("array"):
+            return msg.bufs["value"]
+        if msg.data.get("pytree") is not None:
+            from ..messaging.codec import unflatten_pytree_wire
+            return unflatten_pytree_wire(msg.data["pytree"], msg.bufs)
+        return msg.data.get("value")
 
     @magic_arguments()
     @argument("name", help="kernel variable name")
@@ -761,9 +774,20 @@ class DistributedMagics(Magics):
                                          {"name": args.name},
                                          bufs={"value": arr}, timeout=60)
             else:
-                self._comm.send_to_ranks(ranks, "set_var",
-                                         {"name": args.name,
-                                          "value": value}, timeout=60)
+                # Pytrees of arrays (params/optimizer state) take the
+                # buffer path: treedef as JSON, leaves as raw bufs —
+                # never the codec's pickle fallback.
+                payload = {"name": args.name, "value": value}
+                bufs = None
+                if isinstance(value, (dict, list, tuple)):
+                    from ..messaging.codec import flatten_pytree_wire
+                    try:
+                        meta, bufs = flatten_pytree_wire(value)
+                        payload = {"name": args.name, "pytree": meta}
+                    except TypeError:
+                        bufs = None
+                self._comm.send_to_ranks(ranks, "set_var", payload,
+                                         bufs=bufs, timeout=60)
         except Exception as e:
             print(f"❌ push failed: {e}")
             return

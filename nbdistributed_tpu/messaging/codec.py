@@ -198,6 +198,102 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
     )
 
 
+def flatten_pytree_wire(value: Any) -> tuple[dict, dict]:
+    """Flatten a dict/list/tuple pytree of arrays (+ JSON scalars)
+    into ``(meta, bufs)`` for the buffer path: the tree structure
+    travels as JSON in the message data, the array leaves as raw
+    binary buffers — no pickle anywhere, so model/optimizer state
+    crosses ``allow_pickle=False`` channels intact.
+
+    ``meta`` is a recursive ``{"k": kind, ...}`` description; leaves
+    record whether they were JAX arrays so the receiving side can
+    rebuild them on-device.  Raises TypeError for values that are not
+    such a pytree (an unknown leaf type, non-string dict keys, or no
+    array leaves at all) — callers fall back to the plain JSON or
+    explicit-pickle paths.
+    """
+    values: dict[str, Any] = {}
+    jax_names: list[str] = []
+
+    def rec(v):
+        # Exact container types only: a NamedTuple, OrderedDict, or
+        # other subclass would be silently flattened to the base type
+        # and come back structurally wrong (optax states are
+        # NamedTuples) — those keep the explicit-pickle fallback.
+        if type(v) is dict:
+            if not all(isinstance(k, str) for k in v):
+                raise TypeError("pytree wire needs string dict keys")
+            return {"k": "dict",
+                    "items": [[k, rec(x)] for k, x in v.items()]}
+        if type(v) in (list, tuple):
+            return {"k": "list" if type(v) is list else "tuple",
+                    "items": [rec(x) for x in v]}
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return {"k": "json", "v": v}
+        mod = type(v).__module__
+        if isinstance(v, np.ndarray) or mod.startswith(("jax", "numpy")):
+            arr = v if isinstance(v, np.ndarray) else None
+            if arr is not None and arr.dtype.hasobject:
+                # np.random.Generator, dtype objects, object arrays …
+                # have no raw-bytes representation.
+                raise TypeError("object-dtype leaf cannot cross the "
+                                "buffer path")
+            name = f"pt{len(values)}"
+            is_jax = mod.startswith("jax")
+            if is_jax and not hasattr(v, "dtype"):
+                raise TypeError(f"not a pytree-wire leaf: "
+                                f"{type(v).__name__}")
+            values[name] = v
+            if is_jax:
+                jax_names.append(name)
+            return {"k": "leaf", "buf": name, "jax": is_jax}
+        raise TypeError(f"not a pytree-wire leaf: {type(v).__name__}")
+
+    meta = rec(value)
+    if not values:
+        # Pure-JSON values don't need the buffer path at all.
+        raise TypeError("pytree has no array leaves")
+    if jax_names:
+        # One batched device_get for all JAX leaves — per-leaf
+        # np.asarray would serialize a D2H transfer per leaf.
+        import jax
+
+        fetched = jax.device_get([values[n] for n in jax_names])
+        values.update(zip(jax_names, fetched))
+    bufs: dict[str, Any] = {}
+    for name, v in values.items():
+        arr = np.asarray(v)
+        if arr.dtype.hasobject:
+            raise TypeError("object-dtype leaf cannot cross the "
+                            "buffer path")
+        bufs[name] = arr
+    return meta, bufs
+
+
+def unflatten_pytree_wire(meta: dict, bufs: dict, leaf_fn=None) -> Any:
+    """Rebuild the value from :func:`flatten_pytree_wire` output.
+    ``leaf_fn(arr, is_jax)`` converts each leaf — pass e.g.
+    ``lambda a, j: jnp.asarray(a) if j else a`` to put JAX leaves
+    back on device.  The default COPIES each leaf: decoded buffers
+    are read-only ``frombuffer`` views, and a pulled/pushed tree must
+    be mutable like any other value."""
+    leaf_fn = leaf_fn or (lambda arr, is_jax: np.array(arr))
+
+    def rec(m):
+        k = m["k"]
+        if k == "dict":
+            return {key: rec(sub) for key, sub in m["items"]}
+        if k == "list":
+            return [rec(x) for x in m["items"]]
+        if k == "tuple":
+            return tuple(rec(x) for x in m["items"])
+        if k == "json":
+            return m["v"]
+        return leaf_fn(bufs[m["buf"]], m.get("jax", False))
+
+    return rec(meta)
+
+
 def frame_ready(buf: bytes | bytearray | memoryview) -> int:
     """Return total frame size if ``buf`` starts with a complete frame,
     else 0.  Used by incremental socket readers."""

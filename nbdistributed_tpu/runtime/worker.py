@@ -262,14 +262,38 @@ class DistributedWorker:
                                    "shape": list(value.shape),
                                    "sharding": None},
                              rank=self.rank, bufs={"value": value})
+        if isinstance(value, (dict, list, tuple)):
+            # Pytrees of arrays (params, optimizer state) travel on
+            # the buffer path — treedef as JSON, leaves as raw bufs —
+            # never the codec's pickle fallback, so they survive
+            # allow_pickle=False channels (SURVEY §2.2's trust
+            # boundary).  Non-conforming containers fall through.
+            from ..messaging.codec import flatten_pytree_wire
+            try:
+                meta, bufs = flatten_pytree_wire(value)
+            except TypeError:
+                pass
+            else:
+                return msg.reply(
+                    data={"pytree": meta, "n_leaves": len(bufs)},
+                    rank=self.rank, bufs=bufs)
         return msg.reply(data={"array": False, "value": value},
                          rank=self.rank)
 
     def _handle_set_var(self, msg: Message) -> Message:
         import jax.numpy as jnp
+        import numpy as np
 
         name = msg.data["name"]
-        if "value" in msg.bufs:
+        if msg.data.get("pytree") is not None:
+            from ..messaging.codec import unflatten_pytree_wire
+            # jax leaves go back on device; numpy leaves are COPIED —
+            # the decoded buffers are read-only frombuffer views.
+            self.namespace[name] = unflatten_pytree_wire(
+                msg.data["pytree"], msg.bufs,
+                leaf_fn=lambda a, is_jax: jnp.asarray(a) if is_jax
+                else np.array(a))
+        elif "value" in msg.bufs:
             self.namespace[name] = jnp.asarray(msg.bufs["value"])
         else:
             self.namespace[name] = msg.data.get("value")

@@ -387,3 +387,59 @@ def test_interrupt_storm_no_deaths_no_lost_replies(cluster):
                                        timeout=60))
         assert out == {r: str(i * 2) for r in range(WORLD)}, (i, out)
     assert pm.alive_ranks() == list(range(WORLD))
+
+
+def test_params_pytree_pull_push_without_pickle():
+    """VERDICT r4 #6 done-bar: a model-params pytree crosses an
+    allow_pickle=False control plane — treedef as JSON, leaves as raw
+    buffers — and round-trips arrays + structure exactly.  A 1-worker
+    world with pickle DISABLED on the coordinator channel: any pickle
+    fallback would raise CodecError at decode."""
+    import jax
+
+    from nbdistributed_tpu.messaging.codec import unflatten_pytree_wire
+
+    comm = CommunicationManager(num_workers=1, timeout=60,
+                                allow_pickle=False)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(1, comm.port, backend="cpu")
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
+        comm.send_to_all(
+            "execute",
+            "from nbdistributed_tpu.models import init_params, "
+            "tiny_config\n"
+            "_cfg = tiny_config()\n"
+            "params = init_params(jax.random.PRNGKey(0), _cfg)")
+        resp = comm.send_to_rank(0, "get_var", "params", timeout=60)
+        assert resp.data.get("pytree") is not None, resp.data
+        pulled = unflatten_pytree_wire(resp.data["pytree"], resp.bufs)
+
+        # Structure + every leaf must match the same init done here.
+        from nbdistributed_tpu.models import init_params, tiny_config
+        want = init_params(jax.random.PRNGKey(0), tiny_config())
+        assert (jax.tree_util.tree_structure(pulled)
+                == jax.tree_util.tree_structure(
+                    jax.tree_util.tree_map(np.asarray, want)))
+        for got, exp in zip(jax.tree_util.tree_leaves(pulled),
+                            jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(exp))
+
+        # Push the pytree back under a new name (same pickle-free
+        # path in the other direction) and check a leaf on the worker.
+        from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+        meta, bufs = flatten_pytree_wire(pulled)
+        comm.send_to_rank(0, "set_var",
+                          {"name": "params2", "pytree": meta},
+                          bufs=bufs, timeout=60)
+        out = comm.send_to_rank(0, "execute",
+                                "bool(jnp.array_equal(params2['embed'],"
+                                " params['embed']))", timeout=60)
+        assert out.data["output"] == "True"
+    finally:
+        comm.post([0], "shutdown")
+        time.sleep(0.3)
+        pm.shutdown()
+        comm.shutdown()

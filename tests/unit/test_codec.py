@@ -101,3 +101,102 @@ def test_multiple_buffers_order_preserved():
     np.testing.assert_array_equal(out.bufs["a"], a)
     np.testing.assert_array_equal(out.bufs["b"], b)
     assert out.bufs["c"] == b"z"
+
+
+# ---------------------------------------------------------------------
+# pytree wire: treedef as JSON + leaves as buffers (no pickle)
+
+def test_pytree_wire_roundtrip_structure_and_values():
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    tree = {"layers": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                       "b": np.zeros(3, np.float32)},
+            "meta": ["adam", 3, 0.1, None, True],
+            "pair": (np.int32(7), "x")}
+    meta, bufs = flatten_pytree_wire(tree)
+    got = unflatten_pytree_wire(meta, bufs)
+    assert list(got) == ["layers", "meta", "pair"]   # insertion order
+    np.testing.assert_array_equal(got["layers"]["w"],
+                                  tree["layers"]["w"])
+    assert got["meta"] == ["adam", 3, 0.1, None, True]
+    assert isinstance(got["pair"], tuple)
+    assert int(got["pair"][0]) == 7 and got["pair"][1] == "x"
+
+
+def test_pytree_wire_survives_pickle_free_channel():
+    """A params-like pytree rides a Message as JSON meta + buffers —
+    encode/decode with allow_pickle=False must succeed bit-for-bit
+    (the whole point: model state without pickle)."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    import ml_dtypes
+    tree = {"w": np.arange(4, dtype=ml_dtypes.bfloat16),
+            "opt": {"mu": np.ones((2, 2), np.float32), "step": 3}}
+    meta, bufs = flatten_pytree_wire(tree)
+    m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
+    out = decode(encode(m, allow_pickle=False), allow_pickle=False)
+    got = unflatten_pytree_wire(out.data["pytree"], out.bufs)
+    assert got["w"].dtype == tree["w"].dtype
+    np.testing.assert_array_equal(np.asarray(got["w"], np.float32),
+                                  np.asarray(tree["w"], np.float32))
+    np.testing.assert_array_equal(got["opt"]["mu"], tree["opt"]["mu"])
+    assert got["opt"]["step"] == 3
+
+
+def test_pytree_wire_rejects_non_pytrees():
+    from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({"fn": lambda: 1})       # unknown leaf
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({1: np.zeros(2)})        # non-str keys
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({"a": 1, "b": "x"})      # no array leaves
+
+
+def test_pytree_wire_jax_leaves_flagged():
+    import jax.numpy as jnp
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    tree = {"j": jnp.ones(3), "n": np.ones(3)}
+    meta, bufs = flatten_pytree_wire(tree)
+    flags = {k: sub["jax"] for k, sub in meta["items"]}
+    assert flags == {"j": True, "n": False}
+    got = unflatten_pytree_wire(
+        meta, bufs, leaf_fn=lambda a, is_jax: jnp.asarray(a)
+        if is_jax else a)
+    assert isinstance(got["j"], jnp.ndarray)
+    assert isinstance(got["n"], np.ndarray)
+
+
+def test_pytree_wire_rejects_object_and_subclass_leaves():
+    """Non-array numpy/jax objects (np.random.Generator, dtypes) and
+    subclassed containers (NamedTuples like optax states, OrderedDict)
+    must be rejected so callers fall back to the explicit-pickle path
+    instead of shipping pointer bytes or flattening structure."""
+    import collections
+    from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({"rng": np.random.default_rng(),
+                             "w": np.ones(3)})
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({"o": np.asarray([object()], dtype=object)})
+    Named = collections.namedtuple("Named", "mu nu")
+    with pytest.raises(TypeError):
+        flatten_pytree_wire(Named(np.ones(2), np.ones(2)))
+    with pytest.raises(TypeError):
+        flatten_pytree_wire(
+            collections.OrderedDict(a=np.ones(2)))
+
+
+def test_pytree_wire_pulled_leaves_are_writable():
+    """Decoded buffers are read-only frombuffer views; the default
+    reconstruction must copy so pulled trees are mutable."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    meta, bufs = flatten_pytree_wire({"w": np.ones(3, np.float32)})
+    m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
+    out = decode(encode(m))
+    got = unflatten_pytree_wire(out.data["pytree"], out.bufs)
+    got["w"] += 1                      # must not raise read-only
+    np.testing.assert_array_equal(got["w"], np.full(3, 2.0))
