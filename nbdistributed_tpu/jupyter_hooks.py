@@ -1,0 +1,73 @@
+"""Jupyter-server integration: persist execution timelines INTO the
+notebook file at save time.
+
+The reference injects browser JavaScript that writes
+``Jupyter.notebook.metadata.execution_timelines`` (reference:
+magic.py:196-233) — a mechanism that silently no-ops everywhere except
+the classic Notebook front-end.  The frontend-agnostic equivalent is a
+server-side ``pre_save_hook``: the kernel flushes the timeline to a
+sidecar JSON next to the notebook (``%timeline_sidecar``), and this
+hook folds the sidecar into the notebook's metadata whenever the file
+is saved — so the record travels inside the ``.ipynb`` again, for any
+front-end (Lab, VS Code, classic), without trusting injected JS.
+
+Enable in ``jupyter_server_config.py``::
+
+    from nbdistributed_tpu.jupyter_hooks import pre_save_hook
+    c.FileContentsManager.pre_save_hook = pre_save_hook
+
+Then in the notebook::
+
+    %timeline_sidecar on          # auto-flush after every cell
+    # ... work ...                # each save embeds the latest record
+
+The hook is deliberately fail-open: a missing, malformed, or
+unreadable sidecar must never break saving a notebook.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+SIDECAR_SUFFIX = ".nbd_timeline.json"
+
+# Notebook metadata key — same name the reference's JS used, so tools
+# reading either format find the record in the same place.
+METADATA_KEY = "execution_timelines"
+
+
+def sidecar_path(notebook_path: str) -> str:
+    """``x.ipynb`` -> ``x.ipynb.nbd_timeline.json`` (next to it)."""
+    return str(notebook_path) + SIDECAR_SUFFIX
+
+
+def pre_save_hook(model=None, path: str = "", contents_manager=None,
+                  **kwargs) -> None:
+    """``FileContentsManager.pre_save_hook`` — folds the kernel-written
+    timeline sidecar into ``metadata.execution_timelines`` of the
+    notebook being saved.  No sidecar, wrong model type, or any error:
+    the save proceeds untouched."""
+    try:
+        if not isinstance(model, dict) or model.get("type") != "notebook":
+            return
+        content = model.get("content")
+        if not isinstance(content, dict):
+            return
+        os_path = path
+        if contents_manager is not None:
+            getter = getattr(contents_manager, "_get_os_path", None)
+            if getter is not None:
+                os_path = getter(path)
+        sc = sidecar_path(os_path)
+        if not os.path.exists(sc):
+            return
+        with open(sc) as f:
+            payload = json.load(f)
+        if not isinstance(payload, dict) or "records" not in payload:
+            return
+        content.setdefault("metadata", {})[METADATA_KEY] = payload
+    except Exception:
+        # Fail-open: persisting a convenience record must never block
+        # saving the user's notebook.
+        return

@@ -58,7 +58,8 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_profile start/stop ·
-%timeline_show · %dist_shutdown
+%timeline_show · %timeline_sidecar (in-notebook persistence) ·
+%dist_shutdown
 """
 
 
@@ -75,6 +76,7 @@ class DistributedMagics(Magics):
     _display_lock = threading.Lock()
     _instance = None
     _proxy_registry: dict = {}
+    _sidecar: str | None = None
 
     _cell_hooks: tuple | None = None
 
@@ -128,10 +130,30 @@ class DistributedMagics(Magics):
             return
         self._cell_t0 = None
         tl = DistributedMagics._timeline
-        if len(tl.records) > self._cell_recs_before:
-            return  # the cell was distributed — already recorded richer
-        tl.record_local(self._cell_raw, t0, time.time() - t0,
-                        ok=bool(getattr(result, "success", True)))
+        if len(tl.records) <= self._cell_recs_before:
+            # not distributed — record the local cell (distributed
+            # cells were already recorded richer by _run_on_ranks)
+            tl.record_local(self._cell_raw, t0, time.time() - t0,
+                            ok=bool(getattr(result, "success", True)))
+        self._flush_sidecar()
+
+    def _flush_sidecar(self) -> None:
+        """Write the timeline sidecar after every cell when
+        %timeline_sidecar is on — the server-side pre_save_hook
+        (jupyter_hooks.py) folds it into the notebook's metadata at
+        save time.  Fail-open: a write error must never break cells."""
+        path = DistributedMagics._sidecar
+        if not path:
+            return
+        import json
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(DistributedMagics._timeline.payload(), f)
+            import os
+            os.replace(tmp, path)
+        except Exception:
+            pass
 
     # ==================================================================
     # state helpers
@@ -146,6 +168,13 @@ class DistributedMagics(Magics):
         cls._active_display = None
         cls._proxy_registry = {}
         cls._cell_rank_history = {}
+        if cls._sidecar:
+            import os
+            try:
+                os.remove(cls._sidecar)
+            except OSError:
+                pass
+        cls._sidecar = None
 
     def on_extension_loaded(self) -> None:
         print("nbdistributed_tpu loaded. Start workers with: "
@@ -738,7 +767,8 @@ class DistributedMagics(Magics):
         copied out of the read-only decode views), or plain JSON
         value."""
         if msg.data.get("array"):
-            return msg.bufs["value"]
+            import numpy as np
+            return np.array(msg.bufs["value"])   # decode views are RO
         if msg.data.get("pytree") is not None:
             from ..messaging.codec import unflatten_pytree_wire
             return unflatten_pytree_wire(msg.data["pytree"], msg.bufs)
@@ -988,6 +1018,54 @@ class DistributedMagics(Magics):
         """Dump every record's raw internals (reference:
         %timeline_debug, magic.py:1778-1870)."""
         print(self._timeline.debug_dump())
+
+    @line_magic
+    def timeline_sidecar(self, line):
+        """``%timeline_sidecar on [path] | off`` — auto-flush the
+        timeline to a sidecar JSON after every cell; the server-side
+        ``pre_save_hook`` (nbdistributed_tpu.jupyter_hooks) folds it
+        into the notebook's ``metadata.execution_timelines`` at save,
+        closing the reference's in-notebook persistence
+        (reference: magic.py:196-233) without its classic-frontend-
+        only injected JS.  With no explicit path, the notebook's own
+        path is taken from ``JPY_SESSION_NAME`` when the front-end
+        provides it."""
+        import os
+
+        parts = line.split(None, 1)
+        mode = parts[0] if parts else "on"
+        if mode == "off":
+            old = DistributedMagics._sidecar
+            DistributedMagics._sidecar = None
+            # Remove the file too: a stale sidecar would keep being
+            # embedded into the notebook on every later save.
+            if old:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+            print("✅ timeline sidecar off (file removed)")
+            return
+        if mode != "on":
+            print("usage: %timeline_sidecar on [path] | off")
+            return
+        if len(parts) > 1:
+            # Everything after "on" is the path (spaces allowed;
+            # surrounding quotes stripped).
+            nb_path = parts[1].strip().strip("'\"")
+        else:
+            nb_path = os.environ.get("JPY_SESSION_NAME")
+            if not nb_path:
+                print("❌ no notebook path available (JPY_SESSION_NAME "
+                      "unset — older front-end?); pass one explicitly: "
+                      "%timeline_sidecar on my_notebook.ipynb")
+                return
+        from ..jupyter_hooks import sidecar_path
+        DistributedMagics._sidecar = sidecar_path(nb_path)
+        self._flush_sidecar()
+        print(f"✅ timeline sidecar → {DistributedMagics._sidecar} "
+              f"(enable the pre_save_hook in jupyter_server_config.py "
+              f"to embed it into the notebook at save)")
 
     # ==================================================================
     # shutdown / reset (tiered, reference: magic.py:810-1040)

@@ -81,11 +81,18 @@ class Timeline:
             out.append(json.dumps(asdict(r), indent=2, default=str))
         return "\n".join(out)
 
+    def payload(self) -> dict:
+        """The persisted form — shared by :meth:`save`, the sidecar
+        flush (%timeline_sidecar), and the notebook-metadata
+        pre_save_hook (jupyter_hooks.py)."""
+        return {"version": 1,
+                "records": [asdict(r) for r in self.records]}
+
     def save(self, path: str) -> int:
-        payload = [asdict(r) for r in self.records]
+        payload = self.payload()
         with open(path, "w") as f:
-            json.dump({"version": 1, "records": payload}, f, indent=1)
-        return len(payload)
+            json.dump(payload, f, indent=1)
+        return len(payload["records"])
 
     def summary(self) -> str:
         if not self.records:

@@ -351,3 +351,27 @@ def test_collective_full_mesh_still_works_and_counts(ip, capsys):
     h = collective_guard.cell_hash(
         "full_sum = all_reduce(rank + 1.0)\nfloat(full_sum)\n")
     assert DistributedMagics._cell_rank_history.get(h) == {0, 1}
+
+
+def test_timeline_sidecar_flushes_and_hook_embeds(ip, capsys, tmp_path):
+    """%timeline_sidecar on <nb> auto-writes the sidecar after each
+    cell; the server pre_save_hook folds it into notebook metadata —
+    the in-.ipynb persistence path end-to-end."""
+    import json
+
+    from nbdistributed_tpu import jupyter_hooks as jh
+
+    nb = tmp_path / "session.ipynb"
+    nb.write_text("{}")
+    ip.run_line_magic("timeline_sidecar", f"on {nb}")
+    capsys.readouterr()
+    run(ip, "sidecar_probe = rank + 40\nsidecar_probe")
+    capsys.readouterr()
+    sc = jh.sidecar_path(str(nb))
+    payload = json.loads(open(sc).read())
+    assert any("sidecar_probe" in r["code"] for r in payload["records"])
+    model = {"type": "notebook", "content": {"metadata": {}}}
+    jh.pre_save_hook(model=model, path=str(nb))
+    assert model["content"]["metadata"][jh.METADATA_KEY]["records"]
+    ip.run_line_magic("timeline_sidecar", "off")
+    capsys.readouterr()

@@ -1,0 +1,88 @@
+"""Notebook-metadata timeline persistence: the server-side
+pre_save_hook folds the kernel-written sidecar into the .ipynb's
+metadata at save — the frontend-agnostic replacement for the
+reference's classic-notebook-only injected JS (reference:
+magic.py:196-233)."""
+
+import json
+
+import pytest
+
+from nbdistributed_tpu import jupyter_hooks as jh
+from nbdistributed_tpu.magics.timeline import Timeline
+
+pytestmark = [pytest.mark.unit]
+
+
+def _model():
+    return {"type": "notebook",
+            "content": {"metadata": {"kernelspec": {"name": "py"}},
+                        "cells": []}}
+
+
+def _write_sidecar(tmp_path, payload):
+    nb = tmp_path / "nb.ipynb"
+    nb.write_text("{}")
+    sc = jh.sidecar_path(str(nb))
+    with open(sc, "w") as f:
+        json.dump(payload, f)
+    return str(nb)
+
+
+def test_hook_injects_sidecar_into_metadata(tmp_path):
+    tl = Timeline()
+    rec = tl.start("x = 1", [0, 1])
+    tl.finish(rec, None)
+    nb = _write_sidecar(tmp_path, tl.payload())
+    model = _model()
+    jh.pre_save_hook(model=model, path=nb)
+    got = model["content"]["metadata"][jh.METADATA_KEY]
+    assert got["version"] == 1
+    assert got["records"][0]["code"] == "x = 1"
+    assert got["records"][0]["target_ranks"] == [0, 1]
+    # Pre-existing metadata keys survive.
+    assert model["content"]["metadata"]["kernelspec"] == {"name": "py"}
+
+
+def test_hook_noop_without_sidecar(tmp_path):
+    nb = tmp_path / "plain.ipynb"
+    nb.write_text("{}")
+    model = _model()
+    jh.pre_save_hook(model=model, path=str(nb))
+    assert jh.METADATA_KEY not in model["content"]["metadata"]
+
+
+def test_hook_fail_open(tmp_path):
+    """Malformed sidecar, wrong model type, missing content: saving
+    must proceed untouched, never raise."""
+    nb = tmp_path / "nb.ipynb"
+    nb.write_text("{}")
+    with open(jh.sidecar_path(str(nb)), "w") as f:
+        f.write("{not json")
+    model = _model()
+    jh.pre_save_hook(model=model, path=str(nb))
+    assert jh.METADATA_KEY not in model["content"]["metadata"]
+    with open(jh.sidecar_path(str(nb)), "w") as f:
+        f.write('["a list, not a payload"]')
+    jh.pre_save_hook(model=model, path=str(nb))
+    assert jh.METADATA_KEY not in model["content"]["metadata"]
+    jh.pre_save_hook(model={"type": "file"}, path=str(nb))
+    jh.pre_save_hook(model=None, path=str(nb))
+    jh.pre_save_hook()                      # no args at all
+
+
+def test_hook_resolves_contents_manager_os_path(tmp_path):
+    """Jupyter passes API paths; the hook resolves them through the
+    contents manager's _get_os_path."""
+    tl = Timeline()
+    tl.start("y = 2", [0])
+    nb = _write_sidecar(tmp_path, tl.payload())
+
+    class _CM:
+        def _get_os_path(self, api_path):
+            assert api_path == "nb.ipynb"
+            return nb
+
+    model = _model()
+    jh.pre_save_hook(model=model, path="nb.ipynb", contents_manager=_CM())
+    assert model["content"]["metadata"][jh.METADATA_KEY]["records"]
