@@ -745,7 +745,9 @@ def tpu_families():
         ("flash_attn", FLASH_CELL, 900),
         ("decode", DECODE_CELL, 1200),
         ("speculative", SPEC_CELL, 1200),
-        ("serving", SERVE_CELL, 1200),
+        # Prefix-admission measurement added two more server worlds
+        # (extra prefill/absorb compiles) — budget accordingly.
+        ("serving", SERVE_CELL, 1800),
         ("decode_7b_int8", DECODE7B_CELL, 1800),
     )
 

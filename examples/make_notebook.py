@@ -216,6 +216,40 @@ prompt = jnp.ones((1, 4), jnp.int32) * (rank + 1)
 out_tokens = generate(params, prompt, cfg, max_new_tokens=8)
 print(f"rank {rank}: {out_tokens[0].tolist()}")""")
 
+md("""## Continuous-batching serving with prefix caching
+
+`DecodeServer` (seeded in every worker namespace) serves staggered
+requests from one slot-pool KV cache — every decode step is one shared
+batched forward no matter how requests arrive, and greedy outputs are
+bit-identical per request to standalone `generate`.  A shared system
+prompt registered with `cache_prefix` is prefilled ONCE; matching
+requests then admit by one HBM-to-HBM copy plus a suffix-only prefill
+(causal attention + absolute RoPE make the copied KV rows exact).""")
+
+code("""\
+%%rank [0]
+srv = DecodeServer(params, cfg, max_batch=2, max_len=64, pad_to=4)
+system_prompt = [7, 3, 9, 1]
+srv.cache_prefix(system_prompt)          # prefilled once
+ra = srv.submit(system_prompt + [5], 6)  # admits via HBM copy + suffix
+rb = srv.submit(system_prompt + [8, 2], 6)
+srv.run_until_done()
+print("request A:", srv.outputs[ra])
+print("request B:", srv.outputs[rb])
+solo = generate(params, jnp.asarray([system_prompt + [5]], jnp.int32),
+                cfg, max_new_tokens=6)[0][5:].tolist()
+assert srv.outputs[ra] == solo, "serving must match solo generate"
+print("bit-identical to solo generate:", solo)""")
+
+md("""## Pull model state into the kernel — no pickle
+
+`%dist_pull` / `%dist_push` carry whole params/optimizer pytrees as a
+JSON tree description plus raw array buffers — model state crosses the
+control plane without pickle, so hardened (`allow_pickle=False`)
+deployments lose nothing.""")
+
+code("%dist_pull params --rank 0 --as kernel_params")
+
 md("""## Bring your HuggingFace checkpoint
 
 Any Llama-architecture `transformers` model converts into this

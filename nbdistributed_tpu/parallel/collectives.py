@@ -191,6 +191,7 @@ def broadcast(x, root: int = 0):
     Implemented as mask-and-sum so any root works, not just process 0
     (``multihost_utils.broadcast_one_to_all`` only supports root 0)."""
     _guard_check("broadcast")
+    _check_root(root, "broadcast")
     jax = _jax()
     import jax.numpy as jnp
 
@@ -332,6 +333,71 @@ def all_reduce_quantized(x, op: str = "sum", *, block: int = 256):
     return out.reshape(orig_shape).astype(orig_dtype)
 
 
+def _check_root(root: int, what: str) -> None:
+    """torch.distributed raises on an invalid root; so do we — the
+    mask-and-sum broadcast would otherwise silently yield zeros and
+    the root-gated returns would yield None on every rank."""
+    w = world_size()
+    if not 0 <= root < w:
+        raise ValueError(f"{what}: root {root} out of range for "
+                         f"world size {w}")
+
+
+def scatter(x, root: int = 0):
+    """Rank ``root`` provides a stacked ``(world, ...)`` array; every
+    rank returns its own row (``dist.scatter`` analog, functional).
+
+    XLA's collectives are symmetric, so the one-sided scatter is a
+    broadcast of root's stack + a local row slice — simple and
+    correct; the extra wire traffic vs a true scatter is
+    ``(world-1)/world`` of the stack, acceptable at notebook scale
+    (use sharded arrays + ``jax.device_put`` for bulk data placement).
+    Non-root ranks still pass a same-shape array (any values) — every
+    process participates, as with all eager collectives here."""
+    _guard_check("scatter")
+    _check_root(root, "scatter")
+    jax = _jax()
+    import jax.numpy as jnp
+
+    from ..runtime.collective_guard import nested as _guard_nested
+
+    x = jnp.asarray(x)
+    w = world_size()
+    if x.shape[:1] != (w,):
+        raise ValueError(
+            f"scatter needs a ({w}, ...) stacked array (one row per "
+            f"rank), got shape {x.shape}")
+    if w == 1:
+        return x[0]
+    with _guard_nested():   # one user-level op = one counted op
+        return broadcast(x, root=root)[rank()]
+
+
+def gather(x, root: int = 0):
+    """Gather per-rank values to ``root``: root returns the stacked
+    ``(world, ...)`` array, every other rank returns None
+    (``dist.gather`` analog).  Implemented over the symmetric
+    all-gather; see :func:`scatter` for the symmetry note."""
+    _guard_check("gather")
+    _check_root(root, "gather")
+    from ..runtime.collective_guard import nested as _guard_nested
+    with _guard_nested():
+        out = all_gather(x)
+    return out if rank() == root else None
+
+
+def reduce(x, root: int = 0, op: str = "sum"):
+    """Reduce across ranks to ``root``: root returns the reduced
+    value, every other rank returns None (``dist.reduce`` analog,
+    over the symmetric all-reduce)."""
+    _guard_check("reduce")
+    _check_root(root, "reduce")
+    from ..runtime.collective_guard import nested as _guard_nested
+    with _guard_nested():
+        out = all_reduce(x, op=op)
+    return out if rank() == root else None
+
+
 class DistNamespace:
     """``dist``-style facade seeded into worker namespaces so users who
     know torch.distributed feel at home (reference seeds ``dist`` at
@@ -342,6 +408,9 @@ class DistNamespace:
     broadcast = staticmethod(broadcast)
     barrier = staticmethod(barrier)
     reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    gather = staticmethod(gather)
+    reduce = staticmethod(reduce)
 
     @staticmethod
     def get_rank() -> int:

@@ -43,7 +43,26 @@ class CollectiveHazardError(RuntimeError):
     the cluster."""
 
 
-_state: dict = {"targets": None, "world": 0, "ops": 0}
+_state: dict = {"targets": None, "world": 0, "ops": 0, "nested": 0}
+
+
+def nested():
+    """Context manager for composite collectives (scatter/gather/
+    reduce) delegating to guarded primitives: the composite counts
+    itself once via :func:`check`, then suppresses the inner
+    primitives' counts so one user-level call records one op (the
+    subset raise already happened at the composite's own check)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        _state["nested"] += 1
+        try:
+            yield
+        finally:
+            _state["nested"] -= 1
+
+    return _cm()
 
 
 def begin_cell(targets, world: int) -> None:
@@ -60,6 +79,7 @@ def end_cell() -> int:
     world-collective calls the cell made."""
     ops = _state["ops"]
     _state["targets"], _state["world"], _state["ops"] = None, 0, 0
+    _state["nested"] = 0
     return ops
 
 
@@ -72,6 +92,8 @@ def cell_hash(code: str) -> str:
 
 def check(op: str) -> None:
     """Entry hook for each eager world-collective."""
+    if _state["nested"]:
+        return                  # implementation detail of a composite
     _state["ops"] += 1
     targets, world = _state["targets"], _state["world"]
     if targets is not None and world and len(targets) < world:

@@ -443,3 +443,37 @@ def test_params_pytree_pull_push_without_pickle():
         time.sleep(0.3)
         pm.shutdown()
         comm.shutdown()
+
+
+def test_scatter_gather_reduce_cross_process(cluster):
+    """dist.scatter/gather/reduce across a real 2-process gloo world:
+    scatter hands each rank the ROOT's row (non-root feeds garbage and
+    root=1, so a no-communication or root-ignoring implementation
+    fails), gather stacks on root only, reduce lands on root only."""
+    comm, _ = cluster
+    out = outputs(comm.send_to_all(
+        "execute",
+        "stk = (jnp.stack([jnp.full(2, 10.0), jnp.full(2, 20.0)])\n"
+        "       if rank == 1 else jnp.full((2, 2), -99.0))\n"
+        "s = dist.scatter(stk, root=1)\n"
+        "float(s[0])", timeout=120))
+    assert out == {0: "10.0", 1: "20.0"}
+    out = outputs(comm.send_to_all(
+        "execute",
+        "try:\n"
+        "    dist.scatter(jnp.zeros((2, 2)), root=5)\n"
+        "    bad = 'no raise'\n"
+        "except ValueError as e:\n"
+        "    bad = 'out of range' in str(e)\n"
+        "bad", timeout=120))
+    assert out == {0: "True", 1: "True"}
+    out = outputs(comm.send_to_all(
+        "execute",
+        "g = dist.gather(jnp.full(2, rank + 1.0), root=1)\n"
+        "'none' if g is None else str(g.shape)", timeout=120))
+    assert out == {0: "'none'", 1: "'(2, 2)'"}
+    out = outputs(comm.send_to_all(
+        "execute",
+        "r = dist.reduce(jnp.ones(3) * (rank + 1), root=0)\n"
+        "'none' if r is None else str(float(r[0]))", timeout=120))
+    assert out == {0: "'3.0'", 1: "'none'"}

@@ -150,3 +150,21 @@ def test_all_reduce_quantized_integer_rounds():
     out = collectives.all_reduce_quantized(x)
     assert out.dtype == jnp.int32
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_scatter_gather_reduce_single_process():
+    """torch.distributed-parity one-sided ops: single-process
+    identities + the root/None contract."""
+    x = jnp.arange(6.0).reshape(1, 6)          # (world=1, ...)
+    np.testing.assert_allclose(np.asarray(collectives.scatter(x)),
+                               np.arange(6.0))
+    with pytest.raises(ValueError, match="stacked"):
+        collectives.scatter(jnp.arange(6.0))   # not (world, ...)
+    g = collectives.gather(jnp.arange(3.0), root=0)
+    assert g is not None and g.shape == (1, 3)
+    r = collectives.reduce(jnp.ones(2), root=0)
+    np.testing.assert_allclose(np.asarray(r), np.ones(2))
+    d = collectives.DistNamespace()
+    assert d.scatter is collectives.scatter
+    assert d.gather is collectives.gather
+    assert d.reduce is collectives.reduce
