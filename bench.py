@@ -588,6 +588,57 @@ _json.dumps({
 })
 """
 
+# MoE dispatch-mode throughput: one train-step (loss+grads) per
+# dispatch mode on a ~0.5B-expert MoE.  The dense one-hot dispatch
+# materializes a (T, k, E, C) slot tensor — with C ~ cf*k*T/E that is
+# O(T^2) MEMORY, terabytes at T = 8192 — so dense is measured only at
+# a small token count (T = 512, where it is feasible), while sparse
+# (sort/segment, linear) and dropless (ragged_dot) run the big shape
+# too.  The small-shape three-way + big-shape pair together turn the
+# dispatch-mode design (linear vs quadratic in tokens) into numbers.
+MOE_CELL = """
+import dataclasses, json as _json, time as _time
+import jax as _jax, jax.numpy as _jnp
+from nbdistributed_tpu.models.moe import (MoEConfig, init_moe_model,
+                                          moe_loss_fn)
+_DM, _DF, _NL, _B, _S, _steps = 1024, 2048, 8, 8, 1024, 3
+_cfg0 = MoEConfig(vocab_size=32000, d_model=_DM, n_layers=_NL,
+                  n_heads=16, n_kv_heads=4, d_ff=_DF,
+                  max_seq_len=2048, n_experts=8, top_k=2,
+                  dtype=_jnp.bfloat16, use_flash=True)
+_p = init_moe_model(_jax.random.PRNGKey(0), _cfg0)
+_out = {"capacity_factor": _cfg0.capacity_factor,
+        "n_experts": _cfg0.n_experts, "top_k": _cfg0.top_k}
+
+def _measure(mode, B, S):
+    _cfg = dataclasses.replace(_cfg0, moe_dispatch=mode)
+    _tok = _jax.random.randint(_jax.random.PRNGKey(1), (B, S), 0,
+                               _cfg0.vocab_size)
+    _f = _jax.jit(_jax.grad(lambda p, b: moe_loss_fn(p, b, _cfg)))
+    _jax.block_until_ready(_f(_p, {"tokens": _tok}))   # compile
+    _t0 = _time.time()
+    for _ in range(_steps):
+        _g = _f(_p, {"tokens": _tok})
+    _jax.block_until_ready(_g)
+    return B * S / ((_time.time() - _t0) / _steps)
+
+_Bs, _Ss = max(1, _B // 4), max(32, _S // 4)       # small: T feasible
+_out["small_tokens"] = _Bs * _Ss                    # for dense
+for _mode in ("dense", "sparse", "dropless"):
+    _out["small_" + _mode + "_tok_per_s"] = round(
+        _measure(_mode, _Bs, _Ss), 1)
+_out["big_tokens"] = _B * _S
+for _mode in ("sparse", "dropless"):
+    _out["big_" + _mode + "_tok_per_s"] = round(
+        _measure(_mode, _B, _S), 1)
+_out["small_sparse_vs_dense"] = round(
+    _out["small_sparse_tok_per_s"] / _out["small_dense_tok_per_s"], 2)
+_out["small_dropless_vs_dense"] = round(
+    _out["small_dropless_tok_per_s"] / _out["small_dense_tok_per_s"],
+    2)
+_json.dumps(_out)
+"""
+
 # all_reduce bus-bandwidth sweep; degenerates to an HBM on-device copy
 # measurement on a 1-process world (labeled as such).
 ALLREDUCE_CELL = """
@@ -749,6 +800,10 @@ def tpu_families():
         # (extra prefill/absorb compiles) — budget accordingly.
         ("serving", SERVE_CELL, 1800),
         ("decode_7b_int8", DECODE7B_CELL, 1800),
+        # MoE dispatch modes (dense/sparse/dropless train-step
+        # throughput at the same routing) — evidences the dispatch
+        # design (linear vs quadratic in tokens) on silicon.
+        ("moe_dispatch", MOE_CELL, 1800),
     )
 
 

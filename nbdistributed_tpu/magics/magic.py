@@ -33,7 +33,7 @@ from .timeline import Timeline
 _COLLECTIVE_TOKENS = re.compile(
     r"\b(all_reduce_quantized|all_reduce|all_gather|broadcast|"
     r"reduce_scatter|barrier|psum|pmean|pmax|pmin|ppermute|all_to_all|"
-    r"sync_global_devices|shard_map)\b")
+    r"sync_global_devices|shard_map|scatter|gather|reduce)\b")
 
 _BANNER = """\
 ✅ {n} workers ready (backend={backend}, attach {secs:.1f}s).

@@ -34,6 +34,7 @@ state and passes.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 
 
@@ -46,23 +47,18 @@ class CollectiveHazardError(RuntimeError):
 _state: dict = {"targets": None, "world": 0, "ops": 0, "nested": 0}
 
 
+@contextlib.contextmanager
 def nested():
     """Context manager for composite collectives (scatter/gather/
     reduce) delegating to guarded primitives: the composite counts
     itself once via :func:`check`, then suppresses the inner
     primitives' counts so one user-level call records one op (the
     subset raise already happened at the composite's own check)."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def _cm():
-        _state["nested"] += 1
-        try:
-            yield
-        finally:
-            _state["nested"] -= 1
-
-    return _cm()
+    _state["nested"] += 1
+    try:
+        yield
+    finally:
+        _state["nested"] -= 1
 
 
 def begin_cell(targets, world: int) -> None:

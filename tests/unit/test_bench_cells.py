@@ -132,3 +132,17 @@ def test_run_families_cell_failure_is_not_spawn_failure():
     bench.run_families("tpu", fams, extra, measure=fake_measure)
     assert calls == ["a", "b", "c"]
     assert extra == {}
+
+
+def test_moe_dispatch_cell_executes():
+    cell = bench.MOE_CELL.replace(
+        "_DM, _DF, _NL, _B, _S, _steps = 1024, 2048, 8, 8, 1024, 3",
+        "_DM, _DF, _NL, _B, _S, _steps = 64, 128, 2, 2, 32, 1")
+    cell = cell.replace("use_flash=True", "use_flash=False")
+    cell = cell.replace("n_heads=16, n_kv_heads=4", "n_heads=4, n_kv_heads=2")
+    res = run_cell(cell)
+    for mode in ("dense", "sparse", "dropless"):
+        assert res["small_" + mode + "_tok_per_s"] > 0
+    for mode in ("sparse", "dropless"):
+        assert res["big_" + mode + "_tok_per_s"] > 0
+    assert res["big_tokens"] == 64
