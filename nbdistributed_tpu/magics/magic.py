@@ -33,7 +33,7 @@ from .timeline import Timeline
 _COLLECTIVE_TOKENS = re.compile(
     r"\b(all_reduce_quantized|all_reduce|all_gather|broadcast|"
     r"reduce_scatter|barrier|psum|pmean|pmax|pmin|ppermute|all_to_all|"
-    r"sync_global_devices|shard_map|scatter|gather|reduce)\b")
+    r"sync_global_devices|shard_map|dist\.(?:scatter|gather|reduce))\b")
 
 _BANNER = """\
 ✅ {n} workers ready (backend={backend}, attach {secs:.1f}s).
@@ -1044,7 +1044,9 @@ class DistributedMagics(Magics):
                     os.remove(old)
                 except OSError:
                     pass
-            print("✅ timeline sidecar off (file removed)")
+            print("✅ timeline sidecar off (file removed; a timeline "
+                  "already embedded by an earlier save stays in the "
+                  "notebook's metadata until overwritten)")
             return
         if mode != "on":
             print("usage: %timeline_sidecar on [path] | off")
@@ -1060,9 +1062,26 @@ class DistributedMagics(Magics):
                       "unset — older front-end?); pass one explicitly: "
                       "%timeline_sidecar on my_notebook.ipynb")
                 return
+            if not os.path.isabs(nb_path):
+                # JPY_SESSION_NAME is server-root-relative
+                # ('sub/nb.ipynb') while this kernel runs in the
+                # notebook's own directory — resolve the BASENAME in
+                # the cwd so the kernel writes the same file the
+                # server-side pre_save_hook (which resolves the full
+                # API path against the server root) will read.
+                nb_path = os.path.basename(nb_path)
         from ..jupyter_hooks import sidecar_path
         DistributedMagics._sidecar = sidecar_path(nb_path)
         self._flush_sidecar()
+        if not os.path.exists(DistributedMagics._sidecar):
+            # The per-cell flush is fail-open; the explicit 'on' is
+            # the one moment to fail loudly instead of advertising a
+            # sidecar that can never be written.
+            bad = DistributedMagics._sidecar
+            DistributedMagics._sidecar = None
+            print(f"❌ could not write {bad} (missing directory or "
+                  f"permissions?); sidecar NOT enabled")
+            return
         print(f"✅ timeline sidecar → {DistributedMagics._sidecar} "
               f"(enable the pre_save_hook in jupyter_server_config.py "
               f"to embed it into the notebook at save)")

@@ -230,8 +230,21 @@ def flatten_pytree_wire(value: Any) -> tuple[dict, dict]:
                     "items": [rec(x) for x in v]}
         if v is None or isinstance(v, (bool, int, float, str)):
             return {"k": "json", "v": v}
+        if isinstance(v, np.generic):
+            # numpy scalars keep their exact type across the wire (a
+            # 0-d ndarray would silently change isinstance checks /
+            # hashability after one round-trip).
+            return {"k": "npscalar", "dtype": v.dtype.name,
+                    "v": v.item()}
         mod = type(v).__module__
         if isinstance(v, np.ndarray) or mod.startswith(("jax", "numpy")):
+            if isinstance(v, np.ndarray) and type(v) is not np.ndarray:
+                # MaskedArray, np.matrix, … — np.asarray would strip
+                # subclass state (masks!) silently; keep them on the
+                # explicit-pickle path like subclassed containers.
+                raise TypeError(
+                    f"ndarray subclass {type(v).__name__} cannot cross "
+                    f"the buffer path without losing state")
             arr = v if isinstance(v, np.ndarray) else None
             if arr is not None and arr.dtype.hasobject:
                 # np.random.Generator, dtype objects, object arrays …
@@ -289,6 +302,8 @@ def unflatten_pytree_wire(meta: dict, bufs: dict, leaf_fn=None) -> Any:
             return tuple(rec(x) for x in m["items"])
         if k == "json":
             return m["v"]
+        if k == "npscalar":
+            return np.dtype(m["dtype"]).type(m["v"])
         return leaf_fn(bufs[m["buf"]], m.get("jax", False))
 
     return rec(meta)

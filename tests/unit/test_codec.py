@@ -200,3 +200,29 @@ def test_pytree_wire_pulled_leaves_are_writable():
     got = unflatten_pytree_wire(out.data["pytree"], out.bufs)
     got["w"] += 1                      # must not raise read-only
     np.testing.assert_array_equal(got["w"], np.full(3, 2.0))
+
+
+def test_pytree_wire_numpy_scalars_keep_type():
+    """np.int64/np.float32 leaves round-trip as the SAME scalar type
+    (never 0-d ndarrays — isinstance/hash/JSON behavior must not
+    change after a pull/push round-trip)."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    tree = {"step": np.int64(3), "lr": np.float32(0.1),
+            "w": np.ones(2, np.float32)}
+    meta, bufs = flatten_pytree_wire(tree)
+    got = unflatten_pytree_wire(meta, bufs)
+    assert type(got["step"]) is np.int64 and got["step"] == 3
+    assert type(got["lr"]) is np.float32
+    np.testing.assert_allclose(got["lr"], np.float32(0.1))
+
+
+def test_pytree_wire_rejects_ndarray_subclasses():
+    """MaskedArray/np.matrix would silently lose subclass state under
+    np.asarray — they must fall back to the explicit-pickle path."""
+    from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+    masked = np.ma.masked_invalid(np.array([1.0, np.nan]))
+    with pytest.raises(TypeError, match="subclass"):
+        flatten_pytree_wire({"m": masked, "w": np.ones(2)})
+    with pytest.raises(TypeError, match="subclass"):
+        flatten_pytree_wire({"m": np.matrix([[1.0]]), "w": np.ones(2)})
