@@ -57,7 +57,8 @@ Every cell now runs on ALL workers. Namespace on each worker:
 Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
-%dist_checkpoint/%dist_restore path names · %dist_profile start/stop ·
+%dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
+%dist_profile start/stop ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown
 """
@@ -77,6 +78,10 @@ class DistributedMagics(Magics):
     _instance = None
     _proxy_registry: dict = {}
     _sidecar: str | None = None
+    # Last successful %dist_init line — %dist_heal replays it after a
+    # crash (kept across %dist_reset on purpose: healing after a reset
+    # is the common recovery flow).
+    _last_init_line: str | None = None
 
     _cell_hooks: tuple | None = None
 
@@ -137,14 +142,16 @@ class DistributedMagics(Magics):
                             ok=bool(getattr(result, "success", True)))
         self._flush_sidecar()
 
-    def _flush_sidecar(self) -> None:
+    def _flush_sidecar(self) -> bool:
         """Write the timeline sidecar after every cell when
         %timeline_sidecar is on — the server-side pre_save_hook
         (jupyter_hooks.py) folds it into the notebook's metadata at
-        save time.  Fail-open: a write error must never break cells."""
+        save time.  Fail-open (a write error must never break cells)
+        but returns whether THIS write landed, so %timeline_sidecar on
+        can fail loudly instead of trusting a stale file."""
         path = DistributedMagics._sidecar
         if not path:
-            return
+            return False
         import json
         try:
             tmp = path + ".tmp"
@@ -152,8 +159,9 @@ class DistributedMagics(Magics):
                 json.dump(DistributedMagics._timeline.payload(), f)
             import os
             os.replace(tmp, path)
+            return True
         except Exception:
-            pass
+            return False
 
     # ==================================================================
     # state helpers
@@ -439,6 +447,7 @@ class DistributedMagics(Magics):
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
         DistributedMagics._world = num_workers
+        DistributedMagics._last_init_line = line
         self._enable_auto_mode()
         print(_BANNER.format(n=num_workers,
                              backend=pm.backend,
@@ -447,7 +456,53 @@ class DistributedMagics(Magics):
     def _announce_death(self, rank: int, rc: int | None) -> None:
         # Runs on the monitor thread; a print is best-effort context.
         print(f"\n💀 worker {rank} exited (code {rc}). "
-              "%dist_status / %dist_reset")
+              "%dist_status / %dist_heal [--restore ckpt] / %dist_reset")
+
+    @magic_arguments()
+    @argument("--restore", default=None,
+              help="checkpoint directory to %%dist_restore once the "
+                   "world is back")
+    @argument("--force", action="store_true",
+              help="rebuild even when every worker looks alive")
+    @line_magic
+    def dist_heal(self, line):
+        """Recover from worker death: tear the remnants down, respawn
+        the world with the SAME ``%dist_init`` configuration, and
+        optionally restore a checkpoint into the fresh namespaces.
+
+        ``jax.distributed`` worlds are fixed-membership — a dead rank
+        cannot rejoin a live coordination service — so recovery is a
+        full restart + state restore, the standard elastic-training
+        recipe (SURVEY §5.3): pair with periodic
+        ``%dist_checkpoint path names --background`` and healing costs
+        one respawn plus one restore, not a lost session.
+        """
+        args = parse_argstring(self.dist_heal, line)
+        replay = DistributedMagics._last_init_line
+        if replay is None:
+            print("❌ nothing to heal from: no successful %dist_init "
+                  "recorded in this session")
+            return
+        dead: list[int] = []
+        pm = DistributedMagics._pm
+        if pm is not None and self._running():
+            alive = set(pm.alive_ranks())
+            dead = sorted(set(range(self._world)) - alive)
+            if not dead and not args.force:
+                print(f"✅ all {self._world} workers alive; nothing to "
+                      f"heal (--force rebuilds anyway)")
+                return
+        print(f"🩹 healing: dead ranks {dead if dead else '(world down)'}"
+              f" — rebuilding with: %dist_init {replay}")
+        self.shutdown_all()
+        self._nuclear_shutdown()
+        self.dist_init(replay)
+        if not self._running():
+            print("❌ heal failed: the replayed %dist_init did not "
+                  "bring the world up")
+            return
+        if args.restore:
+            self.dist_restore(args.restore)
 
     # ==================================================================
     # execution magics
@@ -1072,11 +1127,12 @@ class DistributedMagics(Magics):
                 nb_path = os.path.basename(nb_path)
         from ..jupyter_hooks import sidecar_path
         DistributedMagics._sidecar = sidecar_path(nb_path)
-        self._flush_sidecar()
-        if not os.path.exists(DistributedMagics._sidecar):
+        if not self._flush_sidecar():
             # The per-cell flush is fail-open; the explicit 'on' is
             # the one moment to fail loudly instead of advertising a
-            # sidecar that can never be written.
+            # sidecar that can never be written (a stale file from an
+            # earlier session must not mask the failure — hence the
+            # return value, not an existence probe).
             bad = DistributedMagics._sidecar
             DistributedMagics._sidecar = None
             print(f"❌ could not write {bad} (missing directory or "

@@ -228,16 +228,25 @@ def flatten_pytree_wire(value: Any) -> tuple[dict, dict]:
         if type(v) in (list, tuple):
             return {"k": "list" if type(v) is list else "tuple",
                     "items": [rec(x) for x in v]}
-        if v is None or isinstance(v, (bool, int, float, str)):
-            return {"k": "json", "v": v}
         if isinstance(v, np.generic):
             # numpy scalars keep their exact type across the wire (a
             # 0-d ndarray would silently change isinstance checks /
-            # hashability after one round-trip).
-            return {"k": "npscalar", "dtype": v.dtype.name,
-                    "v": v.item()}
+            # hashability after one round-trip).  Checked BEFORE the
+            # plain-python branch: np.float64 subclasses float and
+            # would otherwise silently decay to a python float.  Only
+            # JSON-safe kinds ride the meta; complex/datetime/bytes_
+            # scalars fall through to the buffer path (as 0-d arrays —
+            # their .item() would break the JSON header).
+            if isinstance(v, (np.bool_, np.integer, np.floating)):
+                return {"k": "npscalar", "dtype": v.dtype.name,
+                        "v": v.item()}
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return {"k": "json", "v": v}
         mod = type(v).__module__
-        if isinstance(v, np.ndarray) or mod.startswith(("jax", "numpy")):
+        if (isinstance(v, (np.ndarray, np.generic))    # np.generic:
+                # non-JSON scalar kinds (complex, datetime, ml_dtypes
+                # like bfloat16) ride as 0-d buffers
+                or mod.startswith(("jax", "numpy"))):
             if isinstance(v, np.ndarray) and type(v) is not np.ndarray:
                 # MaskedArray, np.matrix, … — np.asarray would strip
                 # subclass state (masks!) silently; keep them on the
@@ -303,7 +312,9 @@ def unflatten_pytree_wire(meta: dict, bufs: dict, leaf_fn=None) -> Any:
         if k == "json":
             return m["v"]
         if k == "npscalar":
-            return np.dtype(m["dtype"]).type(m["v"])
+            # _np_dtype: ml_dtypes scalar kinds (bfloat16, float8_*)
+            # are not plain np.dtype names.
+            return _np_dtype(m["dtype"]).type(m["v"])
         return leaf_fn(bufs[m["buf"]], m.get("jax", False))
 
     return rec(meta)

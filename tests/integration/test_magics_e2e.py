@@ -375,3 +375,43 @@ def test_timeline_sidecar_flushes_and_hook_embeds(ip, capsys, tmp_path):
     assert model["content"]["metadata"][jh.METADATA_KEY]["records"]
     ip.run_line_magic("timeline_sidecar", "off")
     capsys.readouterr()
+
+
+def test_dist_heal_respawns_and_restores(ip, capsys, tmp_path):
+    """Elastic recovery (SURVEY §5.3): kill a worker hard, %dist_heal
+    rebuilds the world with the remembered %dist_init config and
+    restores the checkpoint — the session continues where it saved.
+    Runs LAST-ish in this module: it replaces the fixture's cluster
+    with an identical fresh one."""
+    import time as _time
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    run(ip, "heal_v = jnp.arange(3.0) + rank")
+    capsys.readouterr()
+    ip.run_line_magic("dist_checkpoint", f"{tmp_path}/heal_ck heal_v")
+    capsys.readouterr()
+
+    # All alive: heal is a no-op without --force.
+    ip.run_line_magic("dist_heal", "")
+    out = capsys.readouterr().out
+    assert "nothing to heal" in out
+
+    DistributedMagics._pm.processes[1].kill()       # hard crash
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        if 1 not in set(DistributedMagics._pm.alive_ranks()):
+            break
+        _time.sleep(0.2)
+    else:
+        raise AssertionError("worker 1 death not detected")
+
+    ip.run_line_magic("dist_heal", f"--restore {tmp_path}/heal_ck")
+    out = capsys.readouterr().out
+    assert "healing: dead ranks [1]" in out, out
+    assert "workers ready" in out                   # world is back
+    assert DistributedMagics._world == 2
+    run(ip, "print('healed', rank, float(heal_v.sum()))")
+    out = capsys.readouterr().out
+    assert "healed 0 3.0" in out                    # 0+1+2 restored
+    assert "healed 1 6.0" in out                    # 1+2+3 restored

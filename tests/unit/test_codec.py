@@ -208,13 +208,34 @@ def test_pytree_wire_numpy_scalars_keep_type():
     change after a pull/push round-trip)."""
     from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
                                                    unflatten_pytree_wire)
+    import ml_dtypes
+    bf16 = np.asarray([1.5], ml_dtypes.bfloat16)[0]
     tree = {"step": np.int64(3), "lr": np.float32(0.1),
+            "lr64": np.float64(0.2),       # subclasses python float!
+            "flag": np.bool_(True), "bf": bf16,
             "w": np.ones(2, np.float32)}
     meta, bufs = flatten_pytree_wire(tree)
     got = unflatten_pytree_wire(meta, bufs)
     assert type(got["step"]) is np.int64 and got["step"] == 3
     assert type(got["lr"]) is np.float32
+    assert type(got["lr64"]) is np.float64
+    assert type(got["flag"]) is np.bool_
     np.testing.assert_allclose(got["lr"], np.float32(0.1))
+    np.testing.assert_allclose(got["lr64"], np.float64(0.2))
+    if isinstance(bf16, np.generic):
+        # ml_dtypes scalar: either exact-type npscalar (when it
+        # registers as np.floating) or a 0-d buffer — both must
+        # round-trip the VALUE without error.
+        assert float(np.asarray(got["bf"], np.float32)) == 1.5
+    # Non-JSON scalar kinds (complex) take the buffer path instead of
+    # breaking the JSON header: value survives, type may become 0-d.
+    meta2, bufs2 = flatten_pytree_wire({"z": np.complex64(1 + 2j),
+                                        "w": np.ones(2)})
+    got2 = unflatten_pytree_wire(meta2, bufs2)
+    assert complex(got2["z"]) == 1 + 2j
+    # And the full frame still encodes with pickle disabled.
+    m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
+    decode(encode(m, allow_pickle=False), allow_pickle=False)
 
 
 def test_pytree_wire_rejects_ndarray_subclasses():
