@@ -237,7 +237,10 @@ def flatten_pytree_wire(value: Any) -> tuple[dict, dict]:
             # JSON-safe kinds ride the meta; complex/datetime/bytes_
             # scalars fall through to the buffer path (as 0-d arrays —
             # their .item() would break the JSON header).
-            if isinstance(v, (np.bool_, np.integer, np.floating)):
+            if (isinstance(v, (np.bool_, np.integer, np.floating))
+                    and not isinstance(v, np.timedelta64)):
+                # (timedelta64 subclasses signedinteger but .item()
+                # yields datetime.timedelta — not JSON; buffer path.)
                 return {"k": "npscalar", "dtype": v.dtype.name,
                         "v": v.item()}
         if v is None or isinstance(v, (bool, int, float, str)):

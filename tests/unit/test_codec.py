@@ -229,10 +229,18 @@ def test_pytree_wire_numpy_scalars_keep_type():
         assert float(np.asarray(got["bf"], np.float32)) == 1.5
     # Non-JSON scalar kinds (complex) take the buffer path instead of
     # breaking the JSON header: value survives, type may become 0-d.
-    meta2, bufs2 = flatten_pytree_wire({"z": np.complex64(1 + 2j),
-                                        "w": np.ones(2)})
+    meta2, bufs2 = flatten_pytree_wire(
+        {"z": np.complex64(1 + 2j),
+         "t": np.timedelta64(5, "s"),    # subclasses signedinteger!
+         "d": np.datetime64("2026-08-01"),
+         "w": np.ones(2)})
     got2 = unflatten_pytree_wire(meta2, bufs2)
     assert complex(got2["z"]) == 1 + 2j
+    assert got2["t"] == np.timedelta64(5, "s")
+    assert got2["d"] == np.datetime64("2026-08-01")
+    m2 = Message(msg_type="response", data={"pytree": meta2},
+                 bufs=bufs2)
+    decode(encode(m2, allow_pickle=False), allow_pickle=False)
     # And the full frame still encodes with pickle disabled.
     m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
     decode(encode(m, allow_pickle=False), allow_pickle=False)
