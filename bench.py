@@ -123,6 +123,10 @@ _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _cfg_t = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True, remat=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
 _B, _S, _N = {shape}
+# Timed-loop repetitions (fwd, train): median/min across reps guards
+# against the tunnel's one-off spikes.  The CPU fallback passes (1, 1)
+# — host timing has no spikes and the fallback must stay quick.
+_R_FWD, _R_TR = {reps}
 _tok = _jax.random.randint(_jax.random.PRNGKey(1), (_B, _S), 0,
                            _cfg.vocab_size)
 
@@ -144,17 +148,26 @@ _fwd_flops_tok = _L * (_per_layer + _attn) + 2 * _d * _V
 # them in flight and OOMs the 16 G chip).  keep_unused=True is
 # load-bearing: without it JAX prunes the unused arg and silently
 # drops the donation (no aliasing, no eager free).
+# Every iteration runs on DIFFERENT token values and the loop ends in
+# a value fetch: identical repeated inputs are served by the tunnel's
+# program+input result cache and block_until_ready is async-acked, so
+# the naive fixed-input loop "measures" a free forward.  Median of 3
+# timed loops tames the window's second-scale one-off spikes.
 _f = _jax.jit(lambda p, t, prev: _fwd_fn(p, t, _cfg),
               donate_argnums=(2,), keep_unused=True)
 _prev = _jnp.zeros((_B, _S, _cfg.vocab_size), _jnp.float32)
 _t0 = _time.time(); _o = _f(_p, _tok, _prev)
-_jax.block_until_ready(_o)
+float(_o[0, 0, 0])
 _fwd_compile_s = _time.time() - _t0
-_t0 = _time.time()
-for _ in range(_N):
-    _o = _f(_p, _tok, _o)
-_jax.block_until_ready(_o)
-_fwd_s = (_time.time() - _t0) / _N
+_fwd_samples = []
+for _rep in range(_R_FWD):
+    _t0 = _time.time()
+    for _i in range(_N):
+        _ti = (_tok + (_rep * _N + _i + 1)) % _cfg.vocab_size
+        _o = _f(_p, _ti, _o)
+    float(_o[0, 0, 0])            # value fetch forces the whole loop
+    _fwd_samples.append((_time.time() - _t0) / _N)
+_fwd_s = sorted(_fwd_samples)[len(_fwd_samples) // 2]
 _o = None   # 1 G of logits must not stay live across the train phase
 
 _opt = _optax.adamw(1e-4)
@@ -186,13 +199,19 @@ def _time_train(_cfg_variant, _start_B):
             _t0 = _time.time()
             _p2, _st2, _l = _train(_jax.tree_util.tree_map(
                 _jnp.copy, _p), _st, _ttok)
-            _jax.block_until_ready(_l)
+            float(_l)                 # value fetch, not an async ack
             _comp = _time.time() - _t0
-            _t0 = _time.time()
-            for _ in range(_N):
-                _p2, _st2, _l = _train(_p2, _st2, _ttok)
-            _jax.block_until_ready(_l)
-            _tr = (_time.time() - _t0) / _N
+            # Params/opt state evolve every step, so the loop is
+            # cache-proof by construction; two timed loops (min) guard
+            # against the tunnel's one-off second-scale spikes.
+            _trs = []
+            for _rep in range(_R_TR):
+                _t0 = _time.time()
+                for _ in range(_N):
+                    _p2, _st2, _l = _train(_p2, _st2, _ttok)
+                float(_l)
+                _trs.append((_time.time() - _t0) / _N)
+            _tr = min(_trs)
             _p2 = _st2 = _st = None
             return _tr, _comp, _vB
         except Exception as _e:
@@ -258,7 +277,11 @@ _json.dumps({{
 # program, and per-call time is the (long - short) chain difference —
 # the only pattern that survives the axon tunnel's async-ack/caching
 # behavior (a plain dispatch loop + block_until_ready measured 0.03 ms
-# for a 35-GFLOP attention, 5x past the chip's peak).
+# for a 35-GFLOP attention, 5x past the chip's peak).  Each chain
+# length is the MEDIAN of several fresh-input timed calls: the
+# 2026-08-01 window showed second-scale one-off spikes on single
+# timed samples (t18-t2 deltas came out negative or 50x high), so a
+# single-shot delta is noise — the median of 3+ is stable.
 FLASH_CELL = """
 import json as _json, time as _time
 import jax as _jax, jax.numpy as _jnp
@@ -272,7 +295,7 @@ _k = _jax.random.normal(_jax.random.PRNGKey(1), (_B, _S, _Hkv, _D),
 _v = _jax.random.normal(_jax.random.PRNGKey(2), (_B, _S, _Hkv, _D),
                         _jnp.bfloat16)
 
-def _chain_ms(f, n1=2, n2=18):
+def _chain_ms(f, n1=2, n2=18, reps=5):
     def _t(n):
         def body(q, _):
             # Accumulate on the CARRY with a bf16-visible factor
@@ -282,28 +305,45 @@ def _chain_ms(f, n1=2, n2=18):
             return q + f(q, _k, _v) * 0.015625, None
         g = _jax.jit(lambda q: _jax.lax.scan(body, q, None, length=n)[0])
         float(g(_q).sum())            # compile + one run
-        _t0 = _time.time()
-        # Timed call uses a DIFFERENT input than the warmup so a
-        # program+input-level result cache can never serve it.
-        float(g(_q * 1.03125).sum())  # host fetch forces completion
-        return _time.time() - _t0
-    return (_t(n2) - _t(n1)) / (n2 - n1) * 1e3
+        _ts = []
+        for _i in range(reps):
+            # Every timed call uses a DIFFERENT input value than the
+            # warmup and every other rep, so a program+input-level
+            # result cache can never serve it.
+            _qi = _q * (1.0 + 0.03125 * (_i + 1))
+            _t0 = _time.time()
+            float(g(_qi).sum())  # host value fetch forces completion
+            _ts.append(_time.time() - _t0)
+        _ts.sort()
+        return _ts[len(_ts) // 2], _ts
+    _hi, _hs = _t(n2)
+    _lo, _ls = _t(n1)
+    _ms = (_hi - _lo) / (n2 - n1) * 1e3
+    return _ms, {"lo_s": [round(x, 4) for x in _ls],
+                 "hi_s": [round(x, 4) for x in _hs]}
 
 _out = {}
-_out["flash_ms"] = round(_chain_ms(
-    lambda q, k, v: _flash(q, k, v, True)), 3)
-_out["xla_ref_ms"] = round(_chain_ms(
-    lambda q, k, v: _ref(q, k, v, causal=True)), 3)
-_out["speedup"] = round(_out["xla_ref_ms"] / _out["flash_ms"], 3)
+_fm, _fsamp = _chain_ms(lambda q, k, v: _flash(q, k, v, True))
+_rm, _rsamp = _chain_ms(lambda q, k, v: _ref(q, k, v, causal=True))
+_out["flash_ms"] = None if _fm <= 0 else round(_fm, 3)
+_out["xla_ref_ms"] = None if _rm <= 0 else round(_rm, 3)
+_out["speedup"] = (None if _fm <= 0 or _rm <= 0
+                   else round(_rm / _fm, 3))
+_out["samples"] = {"flash": _fsamp, "xla_ref": _rsamp}
 _out["shape"] = (f"B{_B} S{_S} H{_H} Hkv{_Hkv} D{_D} "
-                 f"{_q.dtype.name} causal, chained timing")
+                 f"{_q.dtype.name} causal, chained median-of-5 timing")
 _json.dumps(_out)
 """
 
 # Single-batch decode throughput, fp vs int8 weight-only: decode is
 # HBM-bound (every step streams every weight), so int8 should approach
-# 2x.  The generate loop is data-chained step to step, so wall-clock /
-# tokens is an honest per-token time even over an async dispatch path.
+# 2x.  Per-token time is the DELTA between a long and a short generate
+# program (median of fresh-prompt reps each): the delta cancels the
+# fixed dispatch+fetch round-trip, every timed call uses a prompt no
+# earlier call saw (a program+input result cache can never serve it),
+# and the final np.asarray is a value fetch (block_until_ready is
+# async-acked by the tunnel and proves nothing — the 2026-08-01 window
+# "measured" a 64-step weight-streaming decode at 0.096 ms that way).
 # Each row also reports tokens/s as a percent of the v5e HBM roofline
 # (VERDICT r4 #2): bytes/token = weight bytes + the FULL allocated KV
 # cache (the decode kernel's grid covers every k-block of max_len and
@@ -311,7 +351,7 @@ _json.dumps(_out)
 # 819 GB/s / bytes_per_token.
 DECODE_CELL = """
 import json as _json, time as _time
-import jax as _jax, jax.numpy as _jnp
+import jax as _jax, jax.numpy as _jnp, numpy as _np
 from nbdistributed_tpu.models import (init_params as _init,
                                       make_generate_fn as _mkgen,
                                       quantize_params as _quant,
@@ -319,12 +359,9 @@ from nbdistributed_tpu.models import (init_params as _init,
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
 _qp = _quant(_p)
-_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
-                              _cfg.vocab_size)
-_N, _ML = 64, 128
-_gen = _mkgen(_cfg, _N, max_len=_ML)
-_gen_q8kv = _mkgen(_cfg, _N, max_len=_ML, kv_quantized=True)
+_N1, _N2, _ML = 32, 256, 512
 _HBM_V5E = 819e9
+_REPS = 3
 
 def _tree_bytes(t):
     return sum(x.size * x.dtype.itemsize
@@ -337,24 +374,51 @@ def _kv_bytes(q8):
         _kv += 2 * _cfg.n_layers * _cfg.n_kv_heads * _ML * 4  # scales
     return _kv
 
+def _prompt_for(_seed):
+    return _jax.random.randint(_jax.random.PRNGKey(_seed), (1, 16), 0,
+                               _cfg.vocab_size)
+
+_seed = [0]
+def _median_gen_s(_g, _params):
+    _ts = []
+    for _ in range(_REPS):
+        _seed[0] += 1
+        _pr = _prompt_for(_seed[0])
+        _t0 = _time.time()
+        int(_np.asarray(_g(_params, _pr))[0, -1])   # value fetch
+        _ts.append(_time.time() - _t0)
+    _ts.sort()
+    return _ts[len(_ts) // 2]
+
 _out = {}
-for _name, _params, _g, _q8 in (("bf16", _p, _gen, False),
-                                ("int8", _qp, _gen, False),
-                                ("int8_kv8", _qp, _gen_q8kv, True)):
-    _jax.block_until_ready(_g(_params, _prompt))
-    _t0 = _time.time()
-    _toks = _g(_params, _prompt)
-    _jax.block_until_ready(_toks)
-    _dt = _time.time() - _t0
-    _tps = _N / _dt
+for _name, _params, _q8 in (("bf16", _p, False),
+                            ("int8", _qp, False),
+                            ("int8_kv8", _qp, True)):
+    _g1 = _mkgen(_cfg, _N1, max_len=_ML, kv_quantized=_q8)
+    _g2 = _mkgen(_cfg, _N2, max_len=_ML, kv_quantized=_q8)
+    _seed[0] += 1
+    int(_np.asarray(_g1(_params, _prompt_for(_seed[0])))[0, -1])
+    _seed[0] += 1
+    int(_np.asarray(_g2(_params, _prompt_for(_seed[0])))[0, -1])
+    _lo = _median_gen_s(_g1, _params)
+    _hi = _median_gen_s(_g2, _params)
+    _per_tok_s = (_hi - _lo) / (_N2 - _N1)
     _bpt = _tree_bytes(_params) + _kv_bytes(_q8)
-    _out[_name + "_tok_per_s"] = round(_tps, 1)
-    _out[_name + "_ms_per_tok"] = round(_dt / _N * 1e3, 2)
+    if _per_tok_s <= 0:
+        _out[_name + "_tok_per_s"] = None     # noise won: say so
+        _out[_name + "_ms_per_tok"] = None
+        _out[_name + "_roofline_pct_v5e"] = None
+    else:
+        _tps = 1.0 / _per_tok_s
+        _out[_name + "_tok_per_s"] = round(_tps, 1)
+        _out[_name + "_ms_per_tok"] = round(_per_tok_s * 1e3, 3)
+        _out[_name + "_roofline_pct_v5e"] = round(
+            100.0 * _tps / (_HBM_V5E / _bpt), 1)
     _out[_name + "_bytes_per_tok_mb"] = round(_bpt / 1e6, 1)
-    _out[_name + "_roofline_pct_v5e"] = round(
-        100.0 * _tps / (_HBM_V5E / _bpt), 1)
-_out["int8_speedup"] = round(_out["int8_tok_per_s"]
-                             / _out["bf16_tok_per_s"], 2)
+    _out[_name + "_lo_hi_s"] = [round(_lo, 4), round(_hi, 4)]
+_out["int8_speedup"] = (
+    round(_out["int8_tok_per_s"] / _out["bf16_tok_per_s"], 2)
+    if _out["bf16_tok_per_s"] and _out["int8_tok_per_s"] else None)
 _json.dumps(_out)
 """
 
@@ -364,35 +428,65 @@ _json.dumps(_out)
 # A real small draft lands between this and plain decode.
 SPEC_CELL = """
 import json as _json, time as _time
-import jax as _jax, jax.numpy as _jnp
+import jax as _jax, jax.numpy as _jnp, numpy as _np
 from nbdistributed_tpu.models import (generate as _gen,
                                       init_params as _init,
                                       smol_135m_config as _cfg_fn,
                                       speculative_generate as _spec)
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
-_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
-                              _cfg.vocab_size)
-_N, _G, _B = 64, 4, 4
-_prompt_b = _jnp.tile(_prompt, (_B, 1))
-_sg = _jax.jit(lambda p, t: _spec(p, p, t, _cfg, _cfg, _N, gamma=_G))
-_pg = _jax.jit(lambda p, t: _gen(p, t, _cfg, _N))
+_N1, _N2, _G, _B = 16, 64, 4, 4
+_REPS = 3
+
+def _mk(_n, _spec_mode):
+    if _spec_mode:
+        return _jax.jit(lambda p, t: _spec(p, p, t, _cfg, _cfg, _n,
+                                           gamma=_G))
+    return _jax.jit(lambda p, t: _gen(p, t, _cfg, _n))
+
+_seed = [100]
+def _prompt_for(_b):
+    _seed[0] += 1
+    return _jax.random.randint(_jax.random.PRNGKey(_seed[0]), (_b, 16),
+                               0, _cfg.vocab_size)
+
+def _fetch(_r):
+    # Value fetch forces completion (block_until_ready is async-acked
+    # over the tunnel); fresh prompts per rep defeat result caches.
+    _toks = _r[0] if isinstance(_r, tuple) else _r
+    int(_np.asarray(_toks)[0, -1])
+    return _r
+
+def _median_s(_f, _b):
+    _ts = []
+    for _ in range(_REPS):
+        _pr = _prompt_for(_b)
+        _t0 = _time.time()
+        _r = _fetch(_f(_p, _pr))
+        _ts.append(_time.time() - _t0)
+    _ts.sort()
+    return _ts[len(_ts) // 2], _r
+
 _out = {}
 _spec_r = None
 # Batched streams share every draft/verify forward, so B streams cost
 # ~one stream's wall-clock: report aggregate tokens/s at B=1 and B=4.
-for _name, _f, _t in (("plain", _pg, _prompt),
-                      ("spec_selfdraft", _sg, _prompt),
-                      ("plain_b4", _pg, _prompt_b),
-                      ("spec_selfdraft_b4", _sg, _prompt_b)):
-    _r = _f(_p, _t)
-    _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
-    _t0 = _time.time()
-    _r = _f(_p, _t)
-    _jax.block_until_ready(_r[0] if isinstance(_r, tuple) else _r)
-    _dt = _time.time() - _t0
-    _out[_name + "_tok_per_s"] = round(_N * _t.shape[0] / _dt, 1)
-    if isinstance(_r, tuple):
+# Per-token time = (N2-run - N1-run)/(N2-N1), medians of fresh-prompt
+# reps — the delta cancels the fixed dispatch+fetch round-trip.
+for _name, _spec_mode, _b in (("plain", False, 1),
+                              ("spec_selfdraft", True, 1),
+                              ("plain_b4", False, _B),
+                              ("spec_selfdraft_b4", True, _B)):
+    _f1, _f2 = _mk(_N1, _spec_mode), _mk(_N2, _spec_mode)
+    _fetch(_f1(_p, _prompt_for(_b)))     # compile + first run
+    _fetch(_f2(_p, _prompt_for(_b)))
+    _lo, _ = _median_s(_f1, _b)
+    _hi, _r = _median_s(_f2, _b)
+    _per_tok = (_hi - _lo) / (_N2 - _N1)
+    _out[_name + "_tok_per_s"] = (
+        None if _per_tok <= 0 else round(_b / _per_tok, 1))
+    _out[_name + "_lo_hi_s"] = [round(_lo, 4), round(_hi, 4)]
+    if _spec_mode:
         _spec_r = _r
 _out["gamma"] = _G
 _out["batch"] = _B
@@ -412,7 +506,7 @@ _json.dumps(_out)
 #                 per-step cost — reported as-is, it IS the product).
 SERVE_CELL = """
 import json as _json, time as _time
-import jax as _jax, jax.numpy as _jnp
+import jax as _jax, jax.numpy as _jnp, numpy as _np
 from nbdistributed_tpu.models import (DecodeServer, init_params,
                                       make_generate_fn,
                                       smol_135m_config)
@@ -425,15 +519,30 @@ _g1 = make_generate_fn(_cfg, _N, max_len=256)
 _gB = make_generate_fn(_cfg, _N, max_len=256)
 _pb = _jnp.asarray(_prompts, _jnp.int32)
 
-_jax.block_until_ready(_g1(_p, _pb[:1]))        # warm B=1
-_jax.block_until_ready(_gB(_p, _pb))            # warm B=4
-_t0 = _time.time()
-for _i in range(_B):
-    _jax.block_until_ready(_g1(_p, _pb[_i:_i + 1]))
-_dt_seq = _time.time() - _t0
-_t0 = _time.time()
-_jax.block_until_ready(_gB(_p, _pb))
-_dt_bat = _time.time() - _t0
+# Warm with prompt VALUES the timed calls never reuse, end every
+# timed call in a value fetch (block_until_ready is async-acked over
+# the tunnel), and take the median of 3 varied-input reps — a
+# program+input result cache can never serve a timed call.
+_warm = (_pb + 37) % _cfg.vocab_size
+int(_np.asarray(_g1(_p, _warm[:1]))[0, -1])     # warm B=1
+int(_np.asarray(_gB(_p, _warm))[0, -1])         # warm B=4
+
+def _median3(_f):
+    _ts = []
+    for _rep in range(3):
+        _pbr = (_pb + _rep * 101) % _cfg.vocab_size
+        _t0 = _time.time()
+        _f(_pbr)
+        _ts.append(_time.time() - _t0)
+    _ts.sort()
+    return _ts[1]
+
+def _run_seq(_pbr):
+    for _i in range(_B):
+        int(_np.asarray(_g1(_p, _pbr[_i:_i + 1]))[0, -1])
+
+_dt_seq = _median3(_run_seq)
+_dt_bat = _median3(lambda _pbr: int(_np.asarray(_gB(_p, _pbr))[0, -1]))
 
 _srv = DecodeServer(_p, _cfg, max_batch=_B, max_len=256, pad_to=_L)
 _w = _srv.submit(_prompts[0], 2)                # warm prefill + step
@@ -484,8 +593,11 @@ _PL, _SL = 128, 8
 _pfx = [(13 * _j) % 100 + 1 for _j in range(_PL)]
 _sfx = [[(7 * _i + _j) % 100 + 1 for _j in range(_SL)]
         for _i in range(_B)]
+# Warm with a suffix the timed loop never submits (same prompt values
+# after an identical release would hand a result cache a free hit).
+_wsfx = [(11 * _j) % 100 + 101 for _j in range(_SL)]
 _srv4 = DecodeServer(_p, _cfg, max_batch=_B, max_len=256, pad_to=8)
-_w = _srv4.submit(_pfx + _sfx[0], 1)            # warm both buckets
+_w = _srv4.submit(_pfx + _wsfx, 1)              # warm both buckets
 _srv4.run_until_done(); _srv4.release(_w)
 _t0 = _time.time()
 for _s in _sfx:
@@ -494,7 +606,7 @@ _srv4.run_until_done()
 _dt_admit_plain = _time.time() - _t0
 _srv5 = DecodeServer(_p, _cfg, max_batch=_B, max_len=256, pad_to=8)
 _srv5.cache_prefix(_pfx)
-_w = _srv5.submit(_pfx + _sfx[0], 1)            # warm absorb + suffix
+_w = _srv5.submit(_pfx + _wsfx, 1)              # warm absorb + suffix
 _srv5.run_until_done(); _srv5.release(_w)
 _t0 = _time.time()
 for _s in _sfx:
@@ -558,15 +670,36 @@ _qp = _jax.tree_util.tree_map(lambda a: _jax.device_put(a, _dev),
                               _qp_host)
 del _qp_host; _gc.collect()
 _jax.block_until_ready(_jax.tree_util.tree_leaves(_qp)[0])
-_prompt = _jax.random.randint(_jax.random.PRNGKey(1), (1, 16), 0,
-                              _cfg.vocab_size)
-_N, _CL = 32, 2048
-_gen = _mkgen(_cfg, _N, max_len=_CL, kv_quantized=True)
-_jax.block_until_ready(_gen(_qp, _prompt))
-_t0 = _time.time()
-_toks = _gen(_qp, _prompt)
-_jax.block_until_ready(_toks)
-_dt = _time.time() - _t0
+_N1, _N2, _CL = 8, 32, 2048
+# Per-token time = delta between a long and a short generate program
+# (medians of fresh-prompt reps): cancels the fixed round-trip, and
+# the np.asarray value fetch forces completion (block_until_ready is
+# async-acked over the tunnel; same-input repeats hit result caches).
+import numpy as _np
+_g1 = _mkgen(_cfg, _N1, max_len=_CL, kv_quantized=True)
+_g2 = _mkgen(_cfg, _N2, max_len=_CL, kv_quantized=True)
+
+_seed = [0]
+def _prompt_for():
+    _seed[0] += 1
+    return _jax.random.randint(_jax.random.PRNGKey(_seed[0]), (1, 16),
+                               0, _cfg.vocab_size)
+
+def _median_s(_g, _reps=3):
+    _ts = []
+    for _ in range(_reps):
+        _pr = _prompt_for()
+        _t0 = _time.time()
+        int(_np.asarray(_g(_qp, _pr))[0, -1])
+        _ts.append(_time.time() - _t0)
+    _ts.sort()
+    return _ts[len(_ts) // 2]
+
+int(_np.asarray(_g1(_qp, _prompt_for()))[0, -1])   # compile + first
+int(_np.asarray(_g2(_qp, _prompt_for()))[0, -1])
+_lo = _median_s(_g1)
+_hi = _median_s(_g2)
+_dt_tok = (_hi - _lo) / (_N2 - _N1)
 _w_bytes = sum(x.size * x.dtype.itemsize
                for x in _jax.tree_util.tree_leaves(_qp))
 # Roofline %: the decode kernel streams the FULL allocated cache every
@@ -579,12 +712,14 @@ _json.dumps({
     "model": "llama2-7b int8 weights + int8 KV (random init)",
     "weight_gb": round(_w_bytes / 1e9, 2),
     "cache_len": _CL,
-    "tok_per_s": round(_N / _dt, 1),
-    "ms_per_tok": round(_dt / _N * 1e3, 2),
-    "hbm_stream_gb_per_s": round(_w_bytes / (_dt / _N) / 1e9, 1),
+    "lo_hi_s": [round(_lo, 4), round(_hi, 4)],
+    "tok_per_s": (None if _dt_tok <= 0 else round(1.0 / _dt_tok, 1)),
+    "ms_per_tok": (None if _dt_tok <= 0 else round(_dt_tok * 1e3, 2)),
+    "hbm_stream_gb_per_s": (None if _dt_tok <= 0 else
+                            round(_w_bytes / _dt_tok / 1e9, 1)),
     "bytes_per_tok_gb": round(_bpt / 1e9, 2),
-    "roofline_pct_v5e": round(
-        100.0 * (_N / _dt) / (819e9 / _bpt), 1),
+    "roofline_pct_v5e": (None if _dt_tok <= 0 else round(
+        100.0 * (1.0 / _dt_tok) / (819e9 / _bpt), 1)),
 })
 """
 
@@ -610,32 +745,52 @@ _p = init_moe_model(_jax.random.PRNGKey(0), _cfg0)
 _out = {"capacity_factor": _cfg0.capacity_factor,
         "n_experts": _cfg0.n_experts, "top_k": _cfg0.top_k}
 
+import numpy as _np
+_seed = [1000]
 def _measure(mode, B, S):
+    # Per-step time = delta between a (1+_steps)-step and a 1-step
+    # loop (median of 2 each), every step on FRESH token values and
+    # every loop ending in a value fetch — same-input repeats are
+    # served by the tunnel's result cache and block_until_ready is
+    # async-acked, so the naive loop "measures" ~0.
     _cfg = dataclasses.replace(_cfg0, moe_dispatch=mode)
-    _tok = _jax.random.randint(_jax.random.PRNGKey(1), (B, S), 0,
-                               _cfg0.vocab_size)
     _f = _jax.jit(_jax.grad(lambda p, b: moe_loss_fn(p, b, _cfg)))
-    _jax.block_until_ready(_f(_p, {"tokens": _tok}))   # compile
-    _t0 = _time.time()
-    for _ in range(_steps):
-        _g = _f(_p, {"tokens": _tok})
-    _jax.block_until_ready(_g)
-    return B * S / ((_time.time() - _t0) / _steps)
+    def _toks():
+        _seed[0] += 1
+        return _jax.random.randint(_jax.random.PRNGKey(_seed[0]),
+                                   (B, S), 0, _cfg0.vocab_size)
+    def _loop_s(_n):
+        _ts = []
+        for _ in range(2):
+            _batches = [_toks() for _i in range(_n)]
+            _t0 = _time.time()
+            for _tk in _batches:
+                _g = _f(_p, {"tokens": _tk})
+            float(_np.asarray(
+                _jax.tree_util.tree_leaves(_g)[0]).ravel()[0])
+            _ts.append(_time.time() - _t0)
+        return min(_ts)
+    float(_np.asarray(_jax.tree_util.tree_leaves(
+        _f(_p, {"tokens": _toks()}))[0]).ravel()[0])   # compile
+    _dt = (_loop_s(1 + _steps) - _loop_s(1)) / _steps
+    return None if _dt <= 0 else B * S / _dt           # noise: say so
 
 _Bs, _Ss = max(1, _B // 4), max(32, _S // 4)       # small: T feasible
 _out["small_tokens"] = _Bs * _Ss                    # for dense
 for _mode in ("dense", "sparse", "dropless"):
-    _out["small_" + _mode + "_tok_per_s"] = round(
-        _measure(_mode, _Bs, _Ss), 1)
+    _tps = _measure(_mode, _Bs, _Ss)
+    _out["small_" + _mode + "_tok_per_s"] = (
+        None if _tps is None else round(_tps, 1))
 _out["big_tokens"] = _B * _S
 for _mode in ("sparse", "dropless"):
-    _out["big_" + _mode + "_tok_per_s"] = round(
-        _measure(_mode, _B, _S), 1)
-_out["small_sparse_vs_dense"] = round(
-    _out["small_sparse_tok_per_s"] / _out["small_dense_tok_per_s"], 2)
-_out["small_dropless_vs_dense"] = round(
-    _out["small_dropless_tok_per_s"] / _out["small_dense_tok_per_s"],
-    2)
+    _tps = _measure(_mode, _B, _S)
+    _out["big_" + _mode + "_tok_per_s"] = (
+        None if _tps is None else round(_tps, 1))
+for _mode in ("sparse", "dropless"):
+    _num = _out["small_" + _mode + "_tok_per_s"]
+    _den = _out["small_dense_tok_per_s"]
+    _out["small_" + _mode + "_vs_dense"] = (
+        None if not _num or not _den else round(_num / _den, 2))
 _json.dumps(_out)
 """
 
@@ -653,24 +808,37 @@ for _mib in (1, 4, 16, 64):
     if world_size > 1:
         _jax.block_until_ready(all_reduce(_x))      # warm the program
         _t0 = _time.time()
-        for _ in range(5):
-            _y = all_reduce(_x)
-        _jax.block_until_ready(_y)
+        for _i in range(5):
+            # Vary the operand per call so a program+input result
+            # cache can never serve a timed iteration (i+1: factor
+            # 1.0 would replay the warm-up input bit-for-bit).
+            _y = all_reduce(_x * (1.0 + (_i + 1) * 0.015625))
+        float(_y[0])                                # value fetch
         _dt = (_time.time() - _t0) / 5
         _bus = 2 * (world_size - 1) / world_size * _mib / 1024 / _dt
         _rows.append({"mib": _mib, "s": round(_dt, 6),
                       "bus_gb_per_s_per_chip": round(_bus, 3)})
     else:
-        _f = _jax.jit(lambda a: a + 1.0)
-        _jax.block_until_ready(_f(_x))
-        _t0 = _time.time()
-        for _ in range(10):
-            _y = _f(_x)
-        _jax.block_until_ready(_y)
-        _dt = (_time.time() - _t0) / 10
+        # Chained scan delta (same pattern as the flash cell): the
+        # carry feeds each +1.0, so per-iteration HBM read+write time
+        # is (long-short chain)/delta with a value fetch at the end —
+        # honest over the tunnel's async-ack/result-cache behavior.
+        def _loop_s(_n):
+            _g = _jax.jit(lambda a: _jax.lax.scan(
+                lambda c, _: (c + 1.0, None), a, None, length=_n)[0])
+            float(_g(_x).sum())                     # compile + first
+            _ts = []
+            for _i in range(3):
+                _xi = _x * (1.0 + 0.0625 * (_i + 1))
+                _t0 = _time.time()
+                float(_g(_xi).sum())
+                _ts.append(_time.time() - _t0)
+            return sorted(_ts)[1]
+        _dt = (_loop_s(12) - _loop_s(2)) / 10
         _rows.append({"mib": _mib, "s": round(_dt, 6),
-                      "hbm_rw_gb_per_s": round(2 * _mib / 1024 / _dt,
-                                               1)})
+                      "hbm_rw_gb_per_s": (
+                          None if _dt <= 0 else
+                          round(2 * _mib / 1024 / _dt, 1))})
 _json.dumps({"mode": "bus" if world_size > 1 else
              "single_chip_hbm_bound", "rows": _rows})
 """
@@ -784,12 +952,12 @@ def tpu_families():
     return (
         # Flagship MFU (135M — the reference demo scale).
         ("smol135m", MFU_CELL.format(
-            peak=V5E_PEAK_BF16, shape="(8, 2048, 10)",
+            peak=V5E_PEAK_BF16, shape="(8, 2048, 10)", reps="(3, 2)",
             cfg_name="smol_135m_config"), 1800),
         # MFU at a scale where MFU means something: ~1.1B params,
         # d_model=2048 — GEMMs a v5e MXU can fill.
         ("tinyllama_1b", MFU_CELL.format(
-            peak=V5E_PEAK_BF16, shape="(8, 2048, 5)",
+            peak=V5E_PEAK_BF16, shape="(8, 2048, 5)", reps="(3, 2)",
             cfg_name="tinyllama_1b_config"), 1800),
         # Kernel-vs-XLA only where the kernel compiles (interpret
         # mode on CPU is orders slower by design).
@@ -973,6 +1141,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                 mfu = _exec_measure(
                     comm, "smol135m",
                     MFU_CELL.format(peak=1e30, shape="(2, 512, 3)",
+                                    reps="(1, 1)",
                                     cfg_name="smol_135m_config"), 1200)
                 if mfu is not None:
                     mfu.pop("fwd_mfu", None)     # no meaningful CPU peak

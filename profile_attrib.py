@@ -129,14 +129,18 @@ def main() -> int:
         tok = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
                                  cfg.vocab_size)
         f = jax.jit(lambda p, t: forward(p, t, cfg))
-        jax.block_until_ready(f(params, tok))          # compile outside
+        float(f(params, tok)[0, 0, 0])                 # compile outside
         trace_dir = "/tmp/nbd_profile"
         os.makedirs(trace_dir, exist_ok=True)
         with jax.profiler.trace(trace_dir):
             o = None
-            for _ in range(steps):
-                o = f(params, tok)
-            jax.block_until_ready(o)
+            for i in range(steps):
+                # Fresh token values per step and a value fetch at the
+                # end: the tunnel serves repeated identical inputs from
+                # a result cache and async-acks block_until_ready, so
+                # the naive loop would trace ~zero device time.
+                o = f(params, (tok + i + 1) % cfg.vocab_size)
+            float(o[0, 0, 0])
         out.update(_parse_trace(trace_dir))
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"

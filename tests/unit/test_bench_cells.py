@@ -31,6 +31,7 @@ def run_cell(src: str) -> dict:
 
 def test_mfu_cell_executes():
     cell = bench.MFU_CELL.format(peak=1e30, shape="(1, 64, 2)",
+                                 reps="(2, 2)",
                                  cfg_name="tiny_config")
     res = run_cell(cell)
     assert res["fwd_tokens_per_s"] > 0 and res["train_tokens_per_s"] > 0
@@ -38,32 +39,47 @@ def test_mfu_cell_executes():
 
 def test_spec_cell_executes_batched():
     cell = bench.SPEC_CELL.replace("smol_135m_config", "tiny_config")
-    cell = cell.replace("_N, _G, _B = 64, 4, 4", "_N, _G, _B = 8, 2, 2")
+    cell = cell.replace("_N1, _N2, _G, _B = 16, 64, 4, 4",
+                        "_N1, _N2, _G, _B = 4, 8, 2, 2")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
-    assert res["spec_selfdraft_b4_tok_per_s"] > 0
+    # tok_per_s rows are None when measurement noise wins (tiny CPU
+    # deltas); execution + sample bookkeeping is what's asserted.
+    for name in ("plain", "spec_selfdraft", "plain_b4",
+                 "spec_selfdraft_b4"):
+        assert res[name + "_tok_per_s"] is None \
+            or res[name + "_tok_per_s"] > 0
+        lo, hi = res[name + "_lo_hi_s"]
+        assert lo > 0 and hi > 0
     assert res["batch"] == 2
     assert 0 <= res["mean_accepted"] <= 2
 
 
 def test_decode7b_cell_executes_at_toy_scale():
     cell = bench.DECODE7B_CELL.replace("llama2_7b_config", "tiny_config")
-    cell = cell.replace("_N, _CL = 32, 2048", "_N, _CL = 4, 64")
+    cell = cell.replace("_N1, _N2, _CL = 8, 32, 2048",
+                        "_N1, _N2, _CL = 2, 4, 64")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
-    assert res["tok_per_s"] > 0
+    assert res["tok_per_s"] is None or res["tok_per_s"] > 0
+    lo, hi = res["lo_hi_s"]
+    assert lo > 0 and hi > 0
     assert res["weight_gb"] >= 0  # rounds to 0.0 at toy scale
-    assert res["roofline_pct_v5e"] >= 0
+    assert res["roofline_pct_v5e"] is None or res["roofline_pct_v5e"] >= 0
 
 
 def test_decode_cell_executes():
     cell = bench.DECODE_CELL.replace("smol_135m_config", "tiny_config")
-    cell = cell.replace("_N, _ML = 64, 128", "_N, _ML = 4, 128")
+    cell = cell.replace("_N1, _N2, _ML = 32, 256, 512",
+                        "_N1, _N2, _ML = 2, 6, 64")
     cell = cell.replace("use_flash=True", "use_flash=False")
     res = run_cell(cell)
-    assert res["bf16_tok_per_s"] > 0 and res["int8_tok_per_s"] > 0
     for k in ("bf16", "int8", "int8_kv8"):
-        assert res[k + "_roofline_pct_v5e"] >= 0
+        # tok_per_s is None when noise wins the tiny CPU delta; the
+        # sample bookkeeping must always be present and positive.
+        assert res[k + "_tok_per_s"] is None or res[k + "_tok_per_s"] > 0
+        lo, hi = res[k + "_lo_hi_s"]
+        assert lo > 0 and hi > 0
         assert res[k + "_bytes_per_tok_mb"] > 0
     # int8 weights + int8 KV must stream fewer bytes than bf16.
     assert (res["int8_kv8_bytes_per_tok_mb"]
@@ -141,8 +157,12 @@ def test_moe_dispatch_cell_executes():
     cell = cell.replace("use_flash=True", "use_flash=False")
     cell = cell.replace("n_heads=16, n_kv_heads=4", "n_heads=4, n_kv_heads=2")
     res = run_cell(cell)
+    # Rows are None when measurement noise wins the tiny CPU delta
+    # ("noise won: say so" — same contract as the decode cells).
     for mode in ("dense", "sparse", "dropless"):
-        assert res["small_" + mode + "_tok_per_s"] > 0
+        v = res["small_" + mode + "_tok_per_s"]
+        assert v is None or v > 0
     for mode in ("sparse", "dropless"):
-        assert res["big_" + mode + "_tok_per_s"] > 0
+        v = res["big_" + mode + "_tok_per_s"]
+        assert v is None or v > 0
     assert res["big_tokens"] == 64

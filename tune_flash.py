@@ -57,36 +57,51 @@ if SMOKE:
     DECODE_SHAPES = [("smoke_decode", 1, 256, 2, 2, 64)]
 
 
+# Median-of-REPS fresh-input samples per chain length: the 2026-08-01
+# window showed second-scale one-off spikes and occasional
+# impossibly-fast samples on single-shot timed calls (deltas came out
+# negative or 50x high), so a single sample per chain length is noise.
+# Every timed call uses a DIFFERENT input value, so a program+input
+# result cache can never serve it.
+REPS = 5
+
+
+def _median_t(g, q, reps=REPS):
+    float(g(q).sum())                 # compile + one run
+    ts = []
+    for i in range(reps):
+        qi = q * (1.0 + 0.03125 * (i + 1))
+        t0 = time.time()
+        float(g(qi).sum())            # host value fetch
+        ts.append(time.time() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
 def chain_ms(f, q, k, v, n1=2, n2=18):
-    def t(n):
+    def mk(n):
         def body(qc, _):
             return qc + f(qc, k, v) * 0.015625, None
 
-        g = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
-                                            length=n)[0])
-        float(g(q).sum())                 # compile + one run
-        t0 = time.time()
-        float(g(q * 1.03125).sum())       # fresh input, host fetch
-        return time.time() - t0
+        return jax.jit(lambda qq: jax.lax.scan(body, qq, None,
+                                               length=n)[0])
 
-    return (t(n2) - t(n1)) / (n2 - n1) * 1e3
+    return (_median_t(mk(n2), q) - _median_t(mk(n1), q)) \
+        / (n2 - n1) * 1e3
 
 
 def grad_chain_ms(f, q, k, v, n1=2, n2=10):
-    def t(n):
+    def mk(n):
         def body(qc, _):
             g = jax.grad(lambda qq: f(qq, k, v).astype(
                 jnp.float32).sum())(qc)
             return qc + g * 0.015625, None
 
-        gfn = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
-                                              length=n)[0])
-        float(gfn(q).sum())
-        t0 = time.time()
-        float(gfn(q * 1.03125).sum())
-        return time.time() - t0
+        return jax.jit(lambda qq: jax.lax.scan(body, qq, None,
+                                               length=n)[0])
 
-    return (t(n2) - t(n1)) / (n2 - n1) * 1e3
+    return (_median_t(mk(n2), q) - _median_t(mk(n1), q)) \
+        / (n2 - n1) * 1e3
 
 
 def main() -> int:
@@ -97,6 +112,31 @@ def main() -> int:
     results = {}
     flash_tbl: dict = {}
     decode_tbl: dict = {}
+
+    def checkpoint_tables():
+        """Write the accumulated tables after EVERY shape: tunnel
+        windows die mid-sweep (2026-08-01 did), and a partial table
+        that includes the headline gqa entry beats a lost sweep.
+        MERGED over the existing on-disk table — an early checkpoint
+        must never gut a previous window's complete table down to the
+        one shape measured so far (save() replaces the whole file)."""
+        if flash_tbl or decode_tbl:
+            from nbdistributed_tpu.ops import _tuned
+            path = "/tmp/tuned_blocks_smoke.json" if SMOKE else None
+            old_flash, old_decode = _tuned.load(path)
+            p = _tuned.save(
+                {**old_flash, **flash_tbl},
+                {**old_decode, **decode_tbl},
+                meta={"measured_at": time.strftime(
+                          "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                      "device": jax.devices()[0].device_kind},
+                path=path)
+            results["tuned_blocks_path"] = p
+            print(f"[tune] checkpointed {p}", file=sys.stderr)
+
+    def valid(ms):
+        return ms is not None and ms > 0
+
     for name, B, S, H, Hkv, D in SHAPES:
         q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
                               jnp.bfloat16)
@@ -104,6 +144,20 @@ def main() -> int:
                               jnp.bfloat16)
         v = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hkv, D),
                               jnp.bfloat16)
+        # XLA reference FIRST: a mid-sweep tunnel death still leaves
+        # the comparison for whatever configs landed.  Same
+        # noise-retry-then-None contract as the kernel rows — a spike
+        # on a ref sample must not publish a negative "speedup".
+        def _ref(q_, k_, v_):
+            return attention_reference(q_, k_, v_, causal=True)
+        ref_fwd = chain_ms(_ref, q, k, v)
+        if not valid(ref_fwd):
+            ref_fwd = chain_ms(_ref, q, k, v)
+        ref_fb = grad_chain_ms(_ref, q, k, v)
+        if not valid(ref_fb):
+            ref_fb = grad_chain_ms(_ref, q, k, v)
+        print(f"[{name}] XLA ref: fwd {ref_fwd:.3f} ms, fwd+bwd "
+              f"{ref_fb:.3f} ms", file=sys.stderr)
         rows = []
         for bq in BLOCKS:
             for bk in BLOCKS:
@@ -113,44 +167,68 @@ def main() -> int:
                                        block_q=bq, block_k=bk)
                 try:
                     fwd = chain_ms(fl, q, k, v)
-                    fb = grad_chain_ms(fl, q, k, v)
+                    if not valid(fwd):      # noise won: one retry
+                        fwd = chain_ms(fl, q, k, v)
                 except Exception as e:  # Mosaic rejects some shapes
                     print(f"[{name}] bq={bq} bk={bk}: FAILED {e}",
                           file=sys.stderr)
                     continue
                 rows.append({"bq": bq, "bk": bk,
-                             "fwd_ms": round(fwd, 3),
-                             "fwd_bwd_ms": round(fb, 3)})
-                print(f"[{name}] bq={bq} bk={bk}: fwd {fwd:.3f} ms, "
-                      f"fwd+bwd {fb:.3f} ms", file=sys.stderr)
-        if not rows:
-            # Every config failed to compile for this shape: record
-            # that and keep the other shapes' results.
+                             "fwd_ms": (round(fwd, 3) if valid(fwd)
+                                        else None)})
+                print(f"[{name}] bq={bq} bk={bk}: fwd {fwd:.3f} ms",
+                      file=sys.stderr)
+        ok_rows = [r for r in rows if valid(r["fwd_ms"])]
+        if not ok_rows:
+            # Every config failed to compile or measure: record that
+            # and keep the other shapes' results.
             results[name] = {"shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D}",
-                             "error": "no block config compiled"}
+                             "rows": rows,
+                             "error": "no block config measured"}
             continue
-        ref_fwd = chain_ms(lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=True), q, k, v)
-        ref_fb = grad_chain_ms(lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=True), q, k, v)
-        best = min(rows, key=lambda r: r["fwd_bwd_ms"])
+        # fwd+bwd sweep only for the top fwd configs: the bwd kernel
+        # compiles are the expensive half of the sweep, and a config
+        # outside the fwd top-3 never wins the combined time.
+        ok_rows.sort(key=lambda r: r["fwd_ms"])
+        for r in ok_rows[:3]:
+            fl = functools.partial(flash_attention, causal=True,
+                                   block_q=r["bq"], block_k=r["bk"])
+            try:
+                fb = grad_chain_ms(fl, q, k, v)
+                if not valid(fb):
+                    fb = grad_chain_ms(fl, q, k, v)
+            except Exception as e:
+                print(f"[{name}] bq={r['bq']} bk={r['bk']}: "
+                      f"bwd FAILED {e}", file=sys.stderr)
+                continue
+            r["fwd_bwd_ms"] = round(fb, 3) if valid(fb) else None
+            print(f"[{name}] bq={r['bq']} bk={r['bk']}: fwd+bwd "
+                  f"{fb:.3f} ms", file=sys.stderr)
+        with_fb = [r for r in ok_rows if valid(r.get("fwd_bwd_ms"))]
+        best = (min(with_fb, key=lambda r: r["fwd_bwd_ms"])
+                if with_fb else ok_rows[0])
         results[name] = {
             "shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D} bf16 causal",
             "rows": rows,
-            "xla_ref": {"fwd_ms": round(ref_fwd, 3),
-                        "fwd_bwd_ms": round(ref_fb, 3)},
+            "xla_ref": {"fwd_ms": (round(ref_fwd, 3)
+                                   if valid(ref_fwd) else None),
+                        "fwd_bwd_ms": (round(ref_fb, 3)
+                                       if valid(ref_fb) else None)},
             "best": best,
-            "tuned_speedup_fwd": round(ref_fwd / best["fwd_ms"], 3),
-            "tuned_speedup_fwd_bwd": round(ref_fb / best["fwd_bwd_ms"],
-                                           3),
+            "tuned_speedup_fwd": (round(ref_fwd / best["fwd_ms"], 3)
+                                  if valid(ref_fwd) else None),
+            "tuned_speedup_fwd_bwd": (
+                round(ref_fb / best["fwd_bwd_ms"], 3)
+                if valid(ref_fb) and valid(best.get("fwd_bwd_ms"))
+                else None),
             # TUNED_BLOCKS key: (Sq, Sk, head_dim, gqa_group).
             "tuned_entry": {f"({S}, {S}, {D}, {H // Hkv})":
                             f"({best['bq']}, {best['bk']})"},
         }
         flash_tbl[(S, S, D, H // Hkv)] = (best["bq"], best["bk"])
-        print(f"[{name}] XLA ref: fwd {ref_fwd:.3f} ms, fwd+bwd "
-              f"{ref_fb:.3f} ms; best flash bq={best['bq']} "
-              f"bk={best['bk']}", file=sys.stderr)
+        print(f"[{name}] best flash bq={best['bq']} bk={best['bk']}",
+              file=sys.stderr)
+        checkpoint_tables()
     # ---- decode kernel sweep: block_k over realistic cache shapes.
     from nbdistributed_tpu.ops.decode import flash_decode_attention
 
@@ -171,15 +249,21 @@ def main() -> int:
                     lambda qc, k_, v_: flash_decode_attention(
                         qc, k_, v_, pos, block_k=bk),
                     q, kc, vc, n1=4, n2=36)
+                if not valid(ms):           # noise won: one retry
+                    ms = chain_ms(
+                        lambda qc, k_, v_: flash_decode_attention(
+                            qc, k_, v_, pos, block_k=bk),
+                        q, kc, vc, n1=4, n2=36)
             except Exception as e:
                 print(f"[{name}] block_k={bk}: FAILED {e}",
                       file=sys.stderr)
                 continue
-            rows.append({"block_k": bk, "ms": round(ms, 4)})
+            if valid(ms):
+                rows.append({"block_k": bk, "ms": round(ms, 4)})
             print(f"[{name}] block_k={bk}: {ms:.4f} ms",
                   file=sys.stderr)
         if not rows:
-            results[name] = {"error": "no block_k compiled"}
+            results[name] = {"error": "no block_k measured"}
             continue
         best = min(rows, key=lambda r: r["ms"])
         results[name] = {
@@ -190,17 +274,7 @@ def main() -> int:
                             best["block_k"]},
         }
         decode_tbl[(T, D, H // Hkv)] = best["block_k"]
-
-    if flash_tbl or decode_tbl:
-        from nbdistributed_tpu.ops import _tuned
-        path = _tuned.save(
-            flash_tbl, decode_tbl,
-            meta={"measured_at": time.strftime(
-                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                  "device": jax.devices()[0].device_kind},
-            path="/tmp/tuned_blocks_smoke.json" if SMOKE else None)
-        results["tuned_blocks_path"] = path
-        print(f"[tune] wrote {path}", file=sys.stderr)
+        checkpoint_tables()
 
     print(json.dumps(results, indent=1))
     return 0
