@@ -9,6 +9,16 @@
 LOGDIR=${LOGDIR:-/tmp/tpu_watch}
 mkdir -p "$LOGDIR"
 cd "$(dirname "$0")"
+# Persistent XLA compilation cache, inherited by every child process
+# (bench workers, tune, profile, on-chip tests): cold compiles are
+# ~10 min of every window, and the tune -> tuned-re-measure -> full
+# bench chain recompiles the same programs in fresh processes.  With
+# the cache they compile once per window, and window N+1 skips even
+# that.  Write failures degrade silently (raise_persistent_cache_errors
+# defaults to False) — worst case is a cold compile, never a crash.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 while true; do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
     if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
