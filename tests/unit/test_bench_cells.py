@@ -31,7 +31,7 @@ def run_cell(src: str) -> dict:
 
 def test_mfu_cell_executes():
     cell = bench.MFU_CELL.format(peak=1e30, shape="(1, 64, 2)",
-                                 reps="(2, 2)",
+                                 reps="(2, 2)", tr_start="2 * _B",
                                  cfg_name="tiny_config")
     res = run_cell(cell)
     assert res["fwd_tokens_per_s"] > 0 and res["train_tokens_per_s"] > 0
