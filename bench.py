@@ -244,28 +244,28 @@ if _tr_s is None:
 # every policy that fits so the round records WHICH one wins at this
 # scale/HBM, not just that a knob exists.
 import dataclasses as _dc
+
+def _row(_tp, _tb):
+    return (None if _tp is None else
+            {{"ms": round(_tp * 1e3, 2), "batch": _tb,
+              "mfu": round(_tb * _S / _tp * 3 * _fwd_flops_tok
+                           / {peak}, 4)}})
+
 _policies = {{}}
 for _pol in ("dots", "attn_only", "mlp_only"):
     _tp, _, _tb = _time_train(
         _dc.replace(_cfg_t, remat_policy=_pol), _train_B)
-    _policies[_pol] = (
-        None if _tp is None else
-        {{"ms": round(_tp * 1e3, 2), "batch": _tb,
-          "mfu": round(_tb * _S / _tp * 3 * _fwd_flops_tok
-                       / {peak}, 4)}})
+    _policies[_pol] = _row(_tp, _tb)
 # Control row, NOT a remat policy: use_flash=False swaps the Pallas
 # flash fwd+bwd kernels for the reference einsum attention compiled
 # by XLA (materializes the (B, H, S, S) scores — the same baseline
 # the flash speedup row compares against), in the SAME remat config.
 # If this row beats the flash rows, the Pallas backward is costing
 # more than it saves and the honest train setting is XLA attention.
-_tp, _, _tb = _time_train(_dc.replace(_cfg_t, use_flash=False),
-                          _train_B)
-_ref_attn_row = (
-    None if _tp is None else
-    {{"ms": round(_tp * 1e3, 2), "batch": _tb,
-      "mfu": round(_tb * _S / _tp * 3 * _fwd_flops_tok
-                   / {peak}, 4)}})
+# Ladder starts at _B, not _train_B: the materialized scores OOM far
+# earlier than flash-remat, and every OOM rung costs a cold compile.
+_tp, _, _tb = _time_train(_dc.replace(_cfg_t, use_flash=False), _B)
+_ref_attn_row = _row(_tp, _tb)
 _tr_d = None if _policies["dots"] is None else \
     _policies["dots"]["ms"] / 1e3
 _train_B_d = 0 if _policies["dots"] is None else \
@@ -979,12 +979,12 @@ def tpu_families():
         # Flagship MFU (135M — the reference demo scale).
         ("smol135m", MFU_CELL.format(
             peak=V5E_PEAK_BF16, shape="(8, 2048, 10)", reps="(3, 2)",
-            tr_start="2 * _B", cfg_name="smol_135m_config"), 1800),
+            tr_start="2 * _B", cfg_name="smol_135m_config"), 2400),
         # MFU at a scale where MFU means something: ~1.1B params,
         # d_model=2048 — GEMMs a v5e MXU can fill.
         ("tinyllama_1b", MFU_CELL.format(
             peak=V5E_PEAK_BF16, shape="(8, 2048, 5)", reps="(3, 2)",
-            tr_start="2 * _B", cfg_name="tinyllama_1b_config"), 1800),
+            tr_start="2 * _B", cfg_name="tinyllama_1b_config"), 2400),
         # Kernel-vs-XLA only where the kernel compiles (interpret
         # mode on CPU is orders slower by design).
         ("flash_attn", FLASH_CELL, 900),

@@ -1,0 +1,94 @@
+"""Tunnel timing-health preflight: print raw sample distributions.
+
+Run at the START of a live window (tpu_watch.sh step 0).  For the
+bench's pinned GQA shape it prints every raw wall-time sample for:
+
+- chained-scan programs at n=2 and n=18 (6 fresh-input repeats each,
+  XLA reference and the Pallas flash kernel), and
+- 3 same-input repeats (result-cache probe: near-zero times here mean
+  the tunnel serves repeated program+input pairs from a cache).
+
+The 2026-08-01 window (BENCH_ATTEMPTS_r05.md) showed second-scale
+one-off spikes and result-cache hits that single-shot timings cannot
+survive; this preflight makes each window's noise profile part of the
+record, so any later number that looks odd can be read against the
+window's actual timing health.  No repo state is touched; output is
+stderr-style plain lines, one JSON summary line at the end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from nbdistributed_tpu.ops import attention_reference as ref
+from nbdistributed_tpu.ops import flash_attention as flash
+
+SMOKE = bool(os.environ.get("NBD_PROBE_CPU_SMOKE"))
+if SMOKE:
+    B, S, H, Hkv, D = 1, 128, 2, 1, 64   # CPU-feasible harness check
+else:
+    B, S, H, Hkv, D = 4, 2048, 8, 2, 128
+
+
+def probe(name: str, f, q, k, v, out: dict) -> None:
+    for n in (2, 18):
+        def body(qc, _):
+            return qc + f(qc, k, v) * 0.015625, None
+
+        g = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
+                                            length=n)[0])
+        t0 = time.time()
+        float(g(q).sum())
+        print(f"[probe] {name} n={n} compile+first: "
+              f"{time.time() - t0:.3f}s", flush=True)
+        fresh = []
+        for i in range(6):
+            qi = q * (1.0 + (i + 1) * 0.03125)
+            t0 = time.time()
+            float(g(qi).sum())
+            fresh.append(round((time.time() - t0) * 1e3, 2))
+        same = []
+        qi = q * 1.03125
+        for _ in range(3):
+            t0 = time.time()
+            float(g(qi).sum())
+            same.append(round((time.time() - t0) * 1e3, 2))
+        print(f"[probe] {name} n={n} fresh ms: {fresh}", flush=True)
+        print(f"[probe] {name} n={n} same-input ms: {same}", flush=True)
+        out[f"{name}_n{n}"] = {"fresh_ms": fresh, "same_input_ms": same}
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu" and not SMOKE:
+        print("probe_timing.py needs a live TPU (the pinned shape is "
+              f"minutes/call on CPU; backend={jax.default_backend()})",
+              file=sys.stderr)
+        return 1
+    out: dict = {"device": str(jax.devices()[0]),
+                 "shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D} bf16 causal"}
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
+                          jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, Hkv, D),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hkv, D),
+                          jnp.bfloat16)
+    probe("xla_ref", lambda a, b, c: ref(a, b, c, causal=True),
+          q, k, v, out)
+    if jax.default_backend() == "tpu":   # interpret mode: minutes/call
+        probe("flash", lambda a, b, c: flash(a, b, c, True),
+              q, k, v, out)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,11 @@ while true; do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
     if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$ts LIVE — kernel rows, tune, tuned full bench" >> "$LOGDIR/probes.log"
+        # 0. Timing-health preflight (~3 min): every window's noise
+        #    profile (spikes, result-cache hits) goes on the record
+        #    before any number is measured — see BENCH_ATTEMPTS_r05.md.
+        timeout 600 python -u tools/probe_timing.py \
+            > "$LOGDIR/preflight_$ts.out" 2>&1
         # Window plan, ordered by verdict priority so a SHORT window
         # still lands the headline artifacts:
         # 1. Quick kernel families first (~30 min incl. cold compile):
