@@ -309,10 +309,11 @@ _json.dumps({{
 # timed samples (t18-t2 deltas came out negative or 50x high), so a
 # single-shot delta is noise — the median of 3+ is stable.
 FLASH_CELL = """
-import json as _json, time as _time
+import json as _json
 import jax as _jax, jax.numpy as _jnp
 from nbdistributed_tpu.ops import attention_reference as _ref
 from nbdistributed_tpu.ops import flash_attention as _flash
+from nbdistributed_tpu.ops.timing import chained_delta_ms as _cdm
 _B, _S, _H, _Hkv, _D = 4, 2048, 8, 2, 128
 _q = _jax.random.normal(_jax.random.PRNGKey(0), (_B, _S, _H, _D),
                         _jnp.bfloat16)
@@ -321,36 +322,9 @@ _k = _jax.random.normal(_jax.random.PRNGKey(1), (_B, _S, _Hkv, _D),
 _v = _jax.random.normal(_jax.random.PRNGKey(2), (_B, _S, _Hkv, _D),
                         _jnp.bfloat16)
 
-def _chain_ms(f, n1=2, n2=18, reps=5):
-    def _t(n):
-        def body(q, _):
-            # Accumulate on the CARRY with a bf16-visible factor
-            # (1/64 > ulp at magnitude 1), so every scan step sees
-            # genuinely different values — a real data dependency no
-            # scheduler can elide.
-            return q + f(q, _k, _v) * 0.015625, None
-        g = _jax.jit(lambda q: _jax.lax.scan(body, q, None, length=n)[0])
-        float(g(_q).sum())            # compile + one run
-        _ts = []
-        for _i in range(reps):
-            # Every timed call uses a DIFFERENT input value than the
-            # warmup and every other rep, so a program+input-level
-            # result cache can never serve it.
-            _qi = _q * (1.0 + 0.03125 * (_i + 1))
-            _t0 = _time.time()
-            float(g(_qi).sum())  # host value fetch forces completion
-            _ts.append(_time.time() - _t0)
-        _ts.sort()
-        return _ts[len(_ts) // 2], _ts
-    _hi, _hs = _t(n2)
-    _lo, _ls = _t(n1)
-    _ms = (_hi - _lo) / (n2 - n1) * 1e3
-    return _ms, {"lo_s": [round(x, 4) for x in _ls],
-                 "hi_s": [round(x, 4) for x in _hs]}
-
 _out = {}
-_fm, _fsamp = _chain_ms(lambda q, k, v: _flash(q, k, v, True))
-_rm, _rsamp = _chain_ms(lambda q, k, v: _ref(q, k, v, causal=True))
+_fm, _fsamp = _cdm(lambda q: _flash(q, _k, _v, True), _q)
+_rm, _rsamp = _cdm(lambda q: _ref(q, _k, _v, causal=True), _q)
 _out["flash_ms"] = None if _fm <= 0 else round(_fm, 3)
 _out["xla_ref_ms"] = None if _rm <= 0 else round(_rm, 3)
 _out["speedup"] = (None if _fm <= 0 or _rm <= 0
@@ -1033,9 +1007,15 @@ def run_families_only(names: list[str]) -> int:
             snap = json.load(f)
         snap.setdefault("result", {}).setdefault("extra", {}).update(
             extra)
-        snap["remeasured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        snap["remeasured_at"] = ts
         snap["remeasured_families"] = sorted(extra)
+        snap.setdefault("family_measured_at", {}).update(
+            {k: ts for k in extra})
+        # A family just re-measured is no longer carried stale data.
+        snap["carried_from_previous"] = [
+            k for k in snap.get("carried_from_previous", [])
+            if k not in extra]
         with open(path + ".tmp", "w") as f:
             json.dump(snap, f, indent=1)
         os.replace(path + ".tmp", path)
@@ -1043,6 +1023,37 @@ def run_families_only(names: list[str]) -> int:
         log(f"[bench] could not merge into snapshot: {e}")
     print(json.dumps(result), flush=True)
     return 0
+
+
+def persist_tpu_snapshot(path: str, result: dict, extra: dict) -> None:
+    """Atomically write BENCH_TPU_LAST.json, MERGING per-family over
+    the previous snapshot: families the tunnel died before
+    re-measuring are carried forward with their original timestamps
+    (``family_measured_at`` / ``carried_from_previous`` keep the
+    record honest) — a partial window must never erase a fuller
+    earlier capture."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    prev_extra, fam_ts, prev_ts = {}, {}, None
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        prev_extra = prev.get("result", {}).get("extra", {})
+        fam_ts = prev.get("family_measured_at", {})
+        prev_ts = prev.get("measured_at")
+    except (OSError, ValueError):
+        pass
+    carried = sorted(k for k in prev_extra if k not in extra)
+    fam_ts.update({k: now for k in extra})
+    for k in carried:
+        fam_ts.setdefault(k, prev_ts)
+    snap_result = dict(result)
+    snap_result["extra"] = {**prev_extra, **extra}
+    with open(path + ".tmp", "w") as f:
+        json.dump({"measured_at": now,
+                   "family_measured_at": fam_ts,
+                   "carried_from_previous": carried,
+                   "result": snap_result}, f, indent=1)
+    os.replace(path + ".tmp", path)   # atomic
 
 
 def run_families(backend: str, families, extra: dict,
@@ -1221,11 +1232,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             try:
                 path = os.path.join(os.path.dirname(
                     os.path.abspath(__file__)), "BENCH_TPU_LAST.json")
-                with open(path + ".tmp", "w") as f:
-                    json.dump({"measured_at": time.strftime(
-                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                        "result": result}, f, indent=1)
-                os.replace(path + ".tmp", path)   # atomic
+                persist_tpu_snapshot(path, result, extra)
             except OSError as e:
                 log(f"[bench] could not persist TPU snapshot: {e}")
         else:

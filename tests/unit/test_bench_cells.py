@@ -150,6 +150,44 @@ def test_run_families_cell_failure_is_not_spawn_failure():
     assert extra == {}
 
 
+def test_chained_delta_ms_measures_positive_time():
+    """The shared chained-scan protocol (ops/timing.py — used by the
+    bench flash cell, tune_flash, and the preflight probe) must
+    produce a positive per-call time with honest host timing."""
+    import jax.numpy as jnp
+
+    from nbdistributed_tpu.ops.timing import chained_delta_ms
+
+    x = jnp.full((256, 256), 0.5, jnp.float32)
+    ms, samples = chained_delta_ms(lambda c: (c @ c) * 1e-3, x,
+                                   n1=2, n2=10, reps=3)
+    assert len(samples["lo_s"]) == 3 and len(samples["hi_s"]) == 3
+    assert all(t > 0 for t in samples["lo_s"] + samples["hi_s"])
+    assert ms > 0
+
+
+def test_persist_tpu_snapshot_carries_unmeasured_families(tmp_path):
+    """A partial window's snapshot must carry forward families the
+    tunnel died before re-measuring, with their original timestamps —
+    never erase a fuller earlier capture."""
+    path = str(tmp_path / "BENCH_TPU_LAST.json")
+    bench.persist_tpu_snapshot(
+        path, {"metric": "m", "extra": {}},
+        {"flash_attn": {"speedup": 1.5}, "decode": {"tok": 100}})
+    first = json.load(open(path))
+    assert first["carried_from_previous"] == []
+    ts_flash = first["family_measured_at"]["flash_attn"]
+
+    # Second (partial) run re-measures only decode.
+    bench.persist_tpu_snapshot(
+        path, {"metric": "m", "extra": {}}, {"decode": {"tok": 120}})
+    snap = json.load(open(path))
+    assert snap["result"]["extra"]["decode"] == {"tok": 120}
+    assert snap["result"]["extra"]["flash_attn"] == {"speedup": 1.5}
+    assert snap["carried_from_previous"] == ["flash_attn"]
+    assert snap["family_measured_at"]["flash_attn"] == ts_flash
+
+
 def test_moe_dispatch_cell_executes():
     cell = bench.MOE_CELL.replace(
         "_DM, _DF, _NL, _B, _S, _steps = 1024, 2048, 8, 8, 1024, 3",

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from nbdistributed_tpu.ops import attention_reference as ref
 from nbdistributed_tpu.ops import flash_attention as flash
+from nbdistributed_tpu.ops.timing import FRESH_FACTOR, chain_program
 
 SMOKE = bool(os.environ.get("NBD_PROBE_CPU_SMOKE"))
 if SMOKE:
@@ -40,24 +41,23 @@ else:
 
 
 def probe(name: str, f, q, k, v, out: dict) -> None:
+    # chain_program + FRESH_FACTOR come from ops/timing.py — the SAME
+    # protocol constants the bench flash cell and tune_flash use, so
+    # this noise profile is evidence about the programs they time.
     for n in (2, 18):
-        def body(qc, _):
-            return qc + f(qc, k, v) * 0.015625, None
-
-        g = jax.jit(lambda qq: jax.lax.scan(body, qq, None,
-                                            length=n)[0])
+        g = chain_program(lambda qc: f(qc, k, v), n)
         t0 = time.time()
         float(g(q).sum())
         print(f"[probe] {name} n={n} compile+first: "
               f"{time.time() - t0:.3f}s", flush=True)
         fresh = []
         for i in range(6):
-            qi = q * (1.0 + (i + 1) * 0.03125)
+            qi = q * (1.0 + (i + 1) * FRESH_FACTOR)
             t0 = time.time()
             float(g(qi).sum())
             fresh.append(round((time.time() - t0) * 1e3, 2))
         same = []
-        qi = q * 1.03125
+        qi = q * (1.0 + FRESH_FACTOR)   # repeats fresh sample i=0
         for _ in range(3):
             t0 = time.time()
             float(g(qi).sum())

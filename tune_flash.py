@@ -57,51 +57,25 @@ if SMOKE:
     DECODE_SHAPES = [("smoke_decode", 1, 256, 2, 2, 64)]
 
 
-# Median-of-REPS fresh-input samples per chain length: the 2026-08-01
-# window showed second-scale one-off spikes and occasional
-# impossibly-fast samples on single-shot timed calls (deltas came out
-# negative or 50x high), so a single sample per chain length is noise.
-# Every timed call uses a DIFFERENT input value, so a program+input
-# result cache can never serve it.
-REPS = 5
-
-
-def _median_t(g, q, reps=REPS):
-    float(g(q).sum())                 # compile + one run
-    ts = []
-    for i in range(reps):
-        qi = q * (1.0 + 0.03125 * (i + 1))
-        t0 = time.time()
-        float(g(qi).sum())            # host value fetch
-        ts.append(time.time() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+# The chained-delta protocol (fresh-input medians, value fetches,
+# (long-short)/delta) lives in ops/timing.py — the SAME code path the
+# bench flash cell and the watcher's preflight probe use, so a sweep
+# measures exactly the program the bench times.  A <= 0 return means
+# noise won; callers retry once then skip the row.
+from nbdistributed_tpu.ops.timing import chained_delta_ms
 
 
 def chain_ms(f, q, k, v, n1=2, n2=18):
-    def mk(n):
-        def body(qc, _):
-            return qc + f(qc, k, v) * 0.015625, None
-
-        return jax.jit(lambda qq: jax.lax.scan(body, qq, None,
-                                               length=n)[0])
-
-    return (_median_t(mk(n2), q) - _median_t(mk(n1), q)) \
-        / (n2 - n1) * 1e3
+    return chained_delta_ms(lambda qc: f(qc, k, v), q,
+                            n1=n1, n2=n2)[0]
 
 
 def grad_chain_ms(f, q, k, v, n1=2, n2=10):
-    def mk(n):
-        def body(qc, _):
-            g = jax.grad(lambda qq: f(qq, k, v).astype(
-                jnp.float32).sum())(qc)
-            return qc + g * 0.015625, None
+    def step(qc):
+        return jax.grad(lambda qq: f(qq, k, v).astype(
+            jnp.float32).sum())(qc)
 
-        return jax.jit(lambda qq: jax.lax.scan(body, qq, None,
-                                               length=n)[0])
-
-    return (_median_t(mk(n2), q) - _median_t(mk(n1), q)) \
-        / (n2 - n1) * 1e3
+    return chained_delta_ms(step, q, n1=n1, n2=n2)[0]
 
 
 def main() -> int:
