@@ -127,11 +127,12 @@ _B, _S, _N = {shape}
 # against the tunnel's one-off spikes.  The CPU fallback passes (1, 1)
 # — host timing has no spikes and the fallback must stay quick.
 _R_FWD, _R_TR = {reps}
-# Token buffer at 2x the fwd batch: the train ladder probes UPWARD
-# from 2*_B first (per-layer remat keeps activations O(S) per layer,
-# so a bigger batch often fits and lifts MFU); _tok[:_vB] then slices
-# a genuine _vB rows instead of silently capping at _B.
-_tok = _jax.random.randint(_jax.random.PRNGKey(1), (2 * _B, _S), 0,
+# Token buffer at 4x the fwd batch: the train ladder probes UPWARD
+# from 2*_B (per-layer remat keeps activations O(S) per layer, so a
+# bigger batch often fits and lifts MFU) and the chunked-CE control
+# row probes 2x beyond whatever that finds; _tok[:_vB] then slices a
+# genuine _vB rows instead of silently capping.
+_tok = _jax.random.randint(_jax.random.PRNGKey(1), (4 * _B, _S), 0,
                            _cfg.vocab_size)
 
 # Analytic matmul FLOPs/token (fwd): qkv + out projections, SwiGLU
@@ -266,6 +267,13 @@ for _pol in ("dots", "attn_only", "mlp_only"):
 # earlier than flash-remat, and every OOM rung costs a cold compile.
 _tp, _, _tb = _time_train(_dc.replace(_cfg_t, use_flash=False), _B)
 _ref_attn_row = _row(_tp, _tb)
+# Chunked-vocab CE control row (ops/xent.py): the (B, S, V) logits
+# never materialize — the buffer that caps the train batch — so the
+# ladder probes 2x beyond whatever batch the standard loss found.
+_tp, _, _tb = _time_train(
+    _dc.replace(_cfg_t, ce_chunk=_cfg.vocab_size // 4),
+    2 * max(_train_B, _B))
+_ce_chunk_row = _row(_tp, _tb)
 _tr_d = None if _policies["dots"] is None else \
     _policies["dots"]["ms"] / 1e3
 _train_B_d = 0 if _policies["dots"] is None else \
@@ -294,6 +302,7 @@ _json.dumps({{
     "train_dots_batch": _train_B_d,
     "train_remat_policies": _policies,
     "train_ref_attn": _ref_attn_row,
+    "train_ce_chunk": _ce_chunk_row,
     "compile_s": [round(_fwd_compile_s, 1), round(_train_compile_s, 1)],
 }})
 """
@@ -967,7 +976,9 @@ def tpu_families():
         # Prefix-admission measurement added two more server worlds
         # (extra prefill/absorb compiles) — budget accordingly.
         ("serving", SERVE_CELL, 1800),
-        ("decode_7b_int8", DECODE7B_CELL, 1800),
+        # 6.7 G of int8 weights cross the tunnel at unknown bandwidth
+        # and the two generate programs compile at 7B: budget wide.
+        ("decode_7b_int8", DECODE7B_CELL, 2400),
         # MoE dispatch modes (dense/sparse/dropless train-step
         # throughput at the same routing) — evidences the dispatch
         # design (linear vs quadratic in tokens) on silicon.

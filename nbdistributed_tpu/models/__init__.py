@@ -25,6 +25,7 @@ from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   tiny_moe_config)
 from .transformer import (SeqParallel, TransformerConfig,
                           fsdp_param_shardings, forward,
+                          forward_hidden,
                           init_params, llama2_7b_config, loss_fn,
                           make_train_step, mistral_7b_config,
                           packed_positions, param_shardings,
@@ -32,6 +33,7 @@ from .transformer import (SeqParallel, TransformerConfig,
                           tiny_config)
 
 __all__ = ["SeqParallel", "TransformerConfig", "forward",
+           "forward_hidden",
            "fsdp_param_shardings", "init_params",
            "llama2_7b_config", "loss_fn", "make_train_step",
            "mistral_7b_config", "packed_positions",

@@ -61,6 +61,17 @@ class TransformerConfig:
     #               bytes of saved dots for most of remat's recompute
     #               FLOPs — the right default when the model fits.
     remat_policy: str | None = None
+    # Chunked-vocab cross-entropy (ops/xent.py): the training loss
+    # streams the lm_head in blocks of this many vocab columns and
+    # never materializes the (B, S, V) logits — the buffer that caps
+    # the train batch at LM scale (two+ fp32 copies of it live in the
+    # naive loss).  None = standard full-logits path.  Single-device /
+    # dp only: under tp the head is already vocab-sharded, and the SP
+    # loss path keeps the standard tail.  An int8-quantized lm_head
+    # also falls back to the standard path (quantized heads are the
+    # inference configuration; training wants the dense head) — the
+    # chunked tail only engages on a plain-array head.
+    ce_chunk: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -406,17 +417,13 @@ def make_layer_fn(cfg: TransformerConfig, positions,
     return jax.checkpoint(one_layer)
 
 
-def forward(params: dict, tokens, cfg: TransformerConfig,
-            positions=None, *, sp: SeqParallel | None = None,
-            segment_ids=None):
-    """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32.
-
-    With ``sp``, attention runs sequence-parallel (see
-    :class:`SeqParallel`); shard the batch's S axis over
-    ``sp.mesh[sp.axis]`` and jit as usual.  ``segment_ids`` (B, S):
-    packed-document attention masking (see
-    :func:`~nbdistributed_tpu.ops.attention.flash_attention`) —
-    positions attend only within their own document."""
+def forward_hidden(params: dict, tokens, cfg: TransformerConfig,
+                   positions=None, *, sp: SeqParallel | None = None,
+                   segment_ids=None):
+    """tokens: (B, S) int32 -> final-norm hidden states (B, S, D) in
+    ``cfg.dtype`` — everything before the lm_head.  The chunked-vocab
+    loss (ops/xent.py) consumes this directly so the (B, S, V) logits
+    never exist."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -428,7 +435,22 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
         return one_layer(x, layer), None
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return _rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: dict, tokens, cfg: TransformerConfig,
+            positions=None, *, sp: SeqParallel | None = None,
+            segment_ids=None):
+    """tokens: (B, S) int32 -> logits (B, S, vocab) in fp32.
+
+    With ``sp``, attention runs sequence-parallel (see
+    :class:`SeqParallel`); shard the batch's S axis over
+    ``sp.mesh[sp.axis]`` and jit as usual.  ``segment_ids`` (B, S):
+    packed-document attention masking (see
+    :func:`~nbdistributed_tpu.ops.attention.flash_attention`) —
+    positions attend only within their own document."""
+    x = forward_hidden(params, tokens, cfg, positions, sp=sp,
+                       segment_ids=segment_ids)
     return qlinear(x, params["lm_head"]).astype(jnp.float32)
 
 
@@ -485,6 +507,18 @@ def loss_fn(params, batch, cfg: TransformerConfig,
     tokens = batch["tokens"]
     seg = batch.get("segments")
     positions = packed_positions(seg) if seg is not None else None
+    if (cfg.ce_chunk is not None and sp is None
+            and not is_quantized(params["lm_head"])):
+        # Chunked-vocab tail (ops/xent.py): the (B, S, V) logits never
+        # materialize.  Same shift/boundary-mask contract as
+        # shifted_xent — tests pin the two paths equal to fp32
+        # reassociation.
+        from ..ops.xent import shifted_chunked_xent
+        hidden = forward_hidden(params, tokens, cfg, positions, sp=sp,
+                                segment_ids=seg)
+        return shifted_chunked_xent(hidden, params["lm_head"], tokens,
+                                    segment_ids=seg,
+                                    chunk=cfg.ce_chunk)
     logits = forward(params, tokens, cfg, positions, sp=sp,
                      segment_ids=seg)
     return shifted_xent(logits, tokens, segment_ids=seg)

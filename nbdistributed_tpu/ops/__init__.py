@@ -2,6 +2,8 @@
 
 from .attention import attention_reference, flash_attention
 from .decode import flash_decode_attention
+from .xent import chunked_softmax_xent, shifted_chunked_xent
 
-__all__ = ["attention_reference", "flash_attention",
-           "flash_decode_attention"]
+__all__ = ["attention_reference", "chunked_softmax_xent",
+           "flash_attention", "flash_decode_attention",
+           "shifted_chunked_xent"]

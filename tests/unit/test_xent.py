@@ -1,0 +1,98 @@
+"""Chunked-vocab cross-entropy (ops/xent.py) must equal the naive
+full-logits loss — value AND gradients — to fp32 reassociation.  The
+whole point of the chunked tail is that it is a pure memory
+optimization: any numerical drift would silently change training."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.models import init_params, loss_fn, tiny_config
+from nbdistributed_tpu.models.transformer import shifted_xent
+from nbdistributed_tpu.ops.xent import (chunked_softmax_xent,
+                                        shifted_chunked_xent)
+
+pytestmark = pytest.mark.unit
+
+
+def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+def test_chunked_xent_matches_naive_logsumexp():
+    k = jax.random.PRNGKey(0)
+    N, D, V = 24, 16, 130
+    x = jax.random.normal(k, (N, D), jnp.float32)
+    W = jax.random.normal(jax.random.PRNGKey(1), (D, V), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V)
+    naive = -jnp.take_along_axis(
+        jax.nn.log_softmax((x @ W).astype(jnp.float32), axis=-1),
+        t[:, None], axis=-1).mean()
+    # chunk=32 does not divide V=130: exercises the ragged pad mask.
+    got = chunked_softmax_xent(x, W, t, chunk=32)
+    np.testing.assert_allclose(float(got), float(naive), rtol=1e-6)
+
+
+def test_chunked_xent_valid_mask():
+    k = jax.random.PRNGKey(3)
+    N, D, V = 12, 8, 64
+    x = jax.random.normal(k, (N, D), jnp.float32)
+    W = jax.random.normal(jax.random.PRNGKey(4), (D, V), jnp.float32)
+    t = jax.random.randint(jax.random.PRNGKey(5), (N,), 0, V)
+    valid = jnp.arange(N) % 3 != 0
+    nll = -jnp.take_along_axis(
+        jax.nn.log_softmax(x @ W, axis=-1), t[:, None], axis=-1)[:, 0]
+    naive = (nll * valid).sum() / valid.sum()
+    got = chunked_softmax_xent(x, W, t, valid=valid, chunk=16)
+    np.testing.assert_allclose(float(got), float(naive), rtol=1e-6)
+
+
+def test_loss_fn_chunked_matches_standard_value_and_grads():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    cfg_c = dataclasses.replace(cfg, ce_chunk=100)   # ragged vs V=512
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    f_std = jax.jit(jax.value_and_grad(
+        lambda p_, t: loss_fn(p_, {"tokens": t}, cfg)))
+    f_chk = jax.jit(jax.value_and_grad(
+        lambda p_, t: loss_fn(p_, {"tokens": t}, cfg_c)))
+    l0, g0 = f_std(p, tok)
+    l1, g1 = f_chk(p, tok)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    _tree_allclose(g0, g1, rtol=2e-4, atol=2e-5)
+
+
+def test_loss_fn_chunked_with_packed_segments():
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    cfg_c = dataclasses.replace(cfg, ce_chunk=128)
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0,
+                             cfg.vocab_size)
+    seg = jnp.concatenate([jnp.zeros((2, 10), jnp.int32),
+                           jnp.ones((2, 14), jnp.int32)], axis=1)
+    batch = {"tokens": tok, "segments": seg}
+    l0 = loss_fn(p, batch, cfg)
+    l1 = loss_fn(p, batch, cfg_c)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+
+
+def test_shifted_chunked_matches_shifted_xent_directly():
+    k = jax.random.PRNGKey(7)
+    B, S, D, V = 2, 16, 8, 96
+    hidden = jax.random.normal(k, (B, S, D), jnp.float32)
+    W = jax.random.normal(jax.random.PRNGKey(8), (D, V), jnp.float32)
+    tok = jax.random.randint(jax.random.PRNGKey(9), (B, S), 0, V)
+    logits = (hidden @ W).astype(jnp.float32)
+    naive = shifted_xent(logits, tok)
+    got = shifted_chunked_xent(hidden, W, tok, chunk=40)
+    np.testing.assert_allclose(float(got), float(naive), rtol=1e-6)
