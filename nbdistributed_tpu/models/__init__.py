@@ -21,6 +21,7 @@ from .quant import (dequantize_weight, is_quantized, quantization_error,
                     quantize_weight, quantized_moe_shardings,
                     quantized_shardings)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
+                  moe_forward_hidden,
                   moe_forward, moe_loss_fn, moe_model_shardings,
                   tiny_moe_config)
 from .transformer import (SeqParallel, TransformerConfig,
@@ -40,7 +41,7 @@ __all__ = ["SeqParallel", "TransformerConfig", "forward",
            "param_shardings", "smol_135m_config", "tiny_config",
            "tinyllama_1b_config",
            "MoEConfig", "init_moe_model", "mixtral_8x7b_config",
-           "moe_forward", "moe_loss_fn", "moe_model_shardings",
+           "moe_forward", "moe_forward_hidden", "moe_loss_fn", "moe_model_shardings",
            "tiny_moe_config",
            "forward_with_cache", "generate", "init_kv_cache",
            "kv_cache_shardings", "make_generate_fn", "prefill_chunked",

@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
 from ..utils import fan_in_normal
 from .transformer import (TransformerConfig, _attention_block, _rms_norm,
-                          qlinear, shifted_xent)
+                          is_quantized, qlinear, shifted_xent)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,18 +133,12 @@ def _moe_mlp_block(x, layer, cfg: MoEConfig, mesh, ep_axis: str,
     return x + y, layer_aux
 
 
-def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
-                ep_axis: str = "ep", positions=None, sp=None,
-                segment_ids=None):
-    """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar).
-
-    ``sp`` (a ``transformer.SeqParallel``) routes attention through
-    ring/Ulysses sequence parallelism, exactly as in the dense family —
-    the MoE dispatch is token-wise, so GSPMD keeps it sequence-sharded
-    for free.  Composes with ``mesh``/``ep_axis`` expert placement.
-    ``segment_ids``: packed-document attention masking (the attention
-    stack is shared with the dense family); expert dispatch is
-    unaffected — every real token routes regardless of its document."""
+def moe_forward_hidden(params: dict, tokens, cfg: MoEConfig, *,
+                       mesh=None, ep_axis: str = "ep", positions=None,
+                       sp=None, segment_ids=None):
+    """tokens (B, S) int32 -> (final-norm hidden (B, S, D) in
+    ``cfg.dtype``, aux scalar) — everything before the lm_head, for
+    the chunked-vocab loss tail (ops/xent.py)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -162,9 +156,26 @@ def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
 
     (x, aux), _ = jax.lax.scan(layer_step, (x, jnp.float32(0.0)),
                                params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = qlinear(x, params["lm_head"]).astype(jnp.float32)
-    return logits, aux / cfg.n_layers
+    return _rms_norm(x, params["final_norm"], cfg.norm_eps), \
+        aux / cfg.n_layers
+
+
+def moe_forward(params: dict, tokens, cfg: MoEConfig, *, mesh=None,
+                ep_axis: str = "ep", positions=None, sp=None,
+                segment_ids=None):
+    """tokens (B, S) int32 -> (logits (B, S, vocab) fp32, aux scalar).
+
+    ``sp`` (a ``transformer.SeqParallel``) routes attention through
+    ring/Ulysses sequence parallelism, exactly as in the dense family —
+    the MoE dispatch is token-wise, so GSPMD keeps it sequence-sharded
+    for free.  Composes with ``mesh``/``ep_axis`` expert placement.
+    ``segment_ids``: packed-document attention masking (the attention
+    stack is shared with the dense family); expert dispatch is
+    unaffected — every real token routes regardless of its document."""
+    x, aux = moe_forward_hidden(params, tokens, cfg, mesh=mesh,
+                                ep_axis=ep_axis, positions=positions,
+                                sp=sp, segment_ids=segment_ids)
+    return qlinear(x, params["lm_head"]).astype(jnp.float32), aux
 
 
 def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
@@ -190,6 +201,20 @@ def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
     tokens = batch["tokens"]
     seg = batch.get("segments") if isinstance(batch, dict) else None
     positions = packed_positions(seg) if seg is not None else None
+    if (cfg.ce_chunk is not None and sp is None and mesh is None
+            and not is_quantized(params["lm_head"])):
+        # Chunked-vocab tail, same contract as the dense family
+        # (transformer.loss_fn): the (B, S, V) logits never
+        # materialize; tests pin the two paths equal.
+        from ..ops.xent import shifted_chunked_xent
+        x, aux = moe_forward_hidden(params, tokens, cfg, mesh=mesh,
+                                    ep_axis=ep_axis,
+                                    positions=positions, sp=sp,
+                                    segment_ids=seg)
+        return (shifted_chunked_xent(x, params["lm_head"], tokens,
+                                     segment_ids=seg,
+                                     chunk=cfg.ce_chunk)
+                + cfg.lb_coef * aux)
     logits, aux = moe_forward(params, tokens, cfg, mesh=mesh,
                               ep_axis=ep_axis, positions=positions,
                               sp=sp, segment_ids=seg)

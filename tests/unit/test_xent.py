@@ -86,6 +86,22 @@ def test_loss_fn_chunked_with_packed_segments():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
 
 
+def test_moe_loss_fn_chunked_matches_standard():
+    from nbdistributed_tpu.models import (init_moe_model, moe_loss_fn,
+                                          tiny_moe_config)
+    cfg = tiny_moe_config(dtype=jnp.float32, use_flash=False)
+    cfg_c = dataclasses.replace(cfg, ce_chunk=100)   # ragged chunk
+    p = init_moe_model(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    l0, g0 = jax.value_and_grad(
+        lambda p_: moe_loss_fn(p_, {"tokens": tok}, cfg))(p)
+    l1, g1 = jax.value_and_grad(
+        lambda p_: moe_loss_fn(p_, {"tokens": tok}, cfg_c))(p)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    _tree_allclose(g0, g1, rtol=2e-4, atol=2e-5)
+
+
 def test_shifted_chunked_matches_shifted_xent_directly():
     k = jax.random.PRNGKey(7)
     B, S, D, V = 2, 16, 8, 96
