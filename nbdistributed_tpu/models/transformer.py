@@ -65,12 +65,14 @@ class TransformerConfig:
     # streams the lm_head in blocks of this many vocab columns and
     # never materializes the (B, S, V) logits — the buffer that caps
     # the train batch at LM scale (two+ fp32 copies of it live in the
-    # naive loss).  None = standard full-logits path.  Single-device /
-    # dp only: under tp the head is already vocab-sharded, and the SP
-    # loss path keeps the standard tail.  An int8-quantized lm_head
-    # also falls back to the standard path (quantized heads are the
-    # inference configuration; training wants the dense head) — the
-    # chunked tail only engages on a plain-array head.
+    # naive loss).  None = standard full-logits path.  Engages on the
+    # single-device / dp / sp paths (the scan body is row-wise math
+    # GSPMD partitions over sharded tokens); under tp the head is
+    # already vocab-sharded and the loss falls back to the standard
+    # tail (loss_fn checks the sp mesh's tp axis; plain-tp callers
+    # keep ce_chunk=None).  An int8-quantized lm_head also falls back
+    # (quantized heads are the inference configuration; training
+    # wants the dense head).
     ce_chunk: int | None = None
 
     @property
@@ -507,12 +509,22 @@ def loss_fn(params, batch, cfg: TransformerConfig,
     tokens = batch["tokens"]
     seg = batch.get("segments")
     positions = packed_positions(seg) if seg is not None else None
-    if (cfg.ce_chunk is not None and sp is None
+    # A tp axis in the sp mesh means the lm_head is vocab-sharded
+    # (param_shardings: P(None, "tp")) — slicing it chunk-wise would
+    # make GSPMD re-gather the head every scan step, destroying the
+    # memory win; fall back to the standard (already tp-sharded) tail.
+    tp_sharded_head = (
+        sp is not None and sp.tp_axis is not None
+        and dict(getattr(sp.mesh, "shape", {})).get(sp.tp_axis, 1) > 1)
+    if (cfg.ce_chunk is not None and not tp_sharded_head
             and not is_quantized(params["lm_head"])):
         # Chunked-vocab tail (ops/xent.py): the (B, S, V) logits never
         # materialize.  Same shift/boundary-mask contract as
         # shifted_xent — tests pin the two paths equal to fp32
-        # reassociation.
+        # reassociation.  Composes with sp: the scan body is plain
+        # row-wise math over S-sharded hidden states and a replicated
+        # head chunk, so GSPMD partitions it like the standard tail
+        # (equality tested on the virtual sp mesh).
         from ..ops.xent import shifted_chunked_xent
         hidden = forward_hidden(params, tokens, cfg, positions, sp=sp,
                                 segment_ids=seg)

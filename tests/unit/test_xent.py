@@ -86,6 +86,36 @@ def test_loss_fn_chunked_with_packed_segments():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
 
 
+@pytest.mark.slow
+def test_loss_fn_chunked_composes_with_sp():
+    """ce_chunk under ring sequence parallelism: the chunked tail is
+    row-wise math over S-sharded hidden states and replicated head
+    chunks, so GSPMD must partition it to the same value the plain
+    single-device loss produces."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nbdistributed_tpu.models import (SeqParallel, init_params,
+                                          param_shardings)
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+
+    cfg = tiny_config(dtype=jnp.float32, use_flash=False)
+    cfg_c = dataclasses.replace(cfg, ce_chunk=128)
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    ref = loss_fn(p, {"tokens": tok}, cfg)
+    mesh = mesh_mod.make_mesh({"sp": 4, "tp": 1},
+                              devices=jax.devices()[:4])
+    sp = SeqParallel(mesh=mesh, method="ring", use_flash=False)
+    tok_s = jax.device_put(tok, NamedSharding(mesh, P(None, "sp")))
+    p_s = jax.device_put(p, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_shardings(cfg)))
+    got = jax.jit(
+        lambda p_, t: loss_fn(p_, {"tokens": t}, cfg_c, sp=sp))(
+            p_s, tok_s)
+    np.testing.assert_allclose(float(got), float(ref), rtol=2e-5)
+
+
 def test_moe_loss_fn_chunked_matches_standard():
     from nbdistributed_tpu.models import (init_moe_model, moe_loss_fn,
                                           tiny_moe_config)
