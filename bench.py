@@ -115,12 +115,13 @@ from nbdistributed_tpu.models import (forward as _fwd_fn,
                                       loss_fn as _loss,
                                       {cfg_name} as _cfg_fn)
 
-_cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
+_cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True{extra_cfg})
 # Train step uses per-layer remat — the standard long-context training
 # configuration (keeps activation memory O(S); without it the B=8
 # S=2048 train step needs ~20 G HBM vs the v5e's 16 G).  MFU stays the
 # PaLM convention: 3x fwd model FLOPs, recompute not counted.
-_cfg_t = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True, remat=True)
+_cfg_t = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True,
+                 remat=True{extra_cfg})
 _p = _init(_jax.random.PRNGKey(0), _cfg)
 _B, _S, _N = {shape}
 # Timed-loop repetitions (fwd, train): median/min across reps guards
@@ -962,12 +963,21 @@ def tpu_families():
         # Flagship MFU (135M — the reference demo scale).
         ("smol135m", MFU_CELL.format(
             peak=V5E_PEAK_BF16, shape="(8, 2048, 10)", reps="(3, 2)",
-            tr_start="2 * _B", cfg_name="smol_135m_config"), 2400),
+            tr_start="2 * _B", extra_cfg="",
+            cfg_name="smol_135m_config"), 2400),
         # MFU at a scale where MFU means something: ~1.1B params,
         # d_model=2048 — GEMMs a v5e MXU can fill.
         ("tinyllama_1b", MFU_CELL.format(
             peak=V5E_PEAK_BF16, shape="(8, 2048, 5)", reps="(3, 2)",
-            tr_start="2 * _B", cfg_name="tinyllama_1b_config"), 2400),
+            tr_start="2 * _B", extra_cfg="",
+            cfg_name="tinyllama_1b_config"), 2400),
+        # Long-context single-chip training: S=8192 with per-layer
+        # remat; the policy table (and the ce_chunk row — at S=8192
+        # the fp32 logits alone are 1.6 G/row) lands alongside.
+        ("smol135m_s8192", MFU_CELL.format(
+            peak=V5E_PEAK_BF16, shape="(1, 8192, 3)", reps="(3, 2)",
+            tr_start="2 * _B", extra_cfg=", max_seq_len=8192",
+            cfg_name="smol_135m_config"), 2400),
         # Kernel-vs-XLA only where the kernel compiles (interpret
         # mode on CPU is orders slower by design).
         ("flash_attn", FLASH_CELL, 900),
@@ -1036,13 +1046,20 @@ def run_families_only(names: list[str]) -> int:
     return 0
 
 
-def persist_tpu_snapshot(path: str, result: dict, extra: dict) -> None:
+def persist_tpu_snapshot(path: str, result: dict, extra: dict,
+                         stamp=None) -> None:
     """Atomically write BENCH_TPU_LAST.json, MERGING per-family over
     the previous snapshot: families the tunnel died before
     re-measuring are carried forward with their original timestamps
     (``family_measured_at`` / ``carried_from_previous`` keep the
     record honest) — a partial window must never erase a fuller
-    earlier capture."""
+    earlier capture.
+
+    ``stamp``: names measured at THIS moment (the incremental
+    per-family persist passes just the family that finished, so
+    earlier families keep their real measurement times).  Default
+    (None) stamps every key of ``extra``; keys never stamped before
+    are stamped regardless."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     prev_extra, fam_ts, prev_ts = {}, {}, None
     try:
@@ -1054,7 +1071,10 @@ def persist_tpu_snapshot(path: str, result: dict, extra: dict) -> None:
     except (OSError, ValueError):
         pass
     carried = sorted(k for k in prev_extra if k not in extra)
-    fam_ts.update({k: now for k in extra})
+    fam_ts.update({k: now
+                   for k in (extra if stamp is None else stamp)})
+    for k in extra:
+        fam_ts.setdefault(k, now)      # first sighting of this key
     for k in carried:
         fam_ts.setdefault(k, prev_ts)
     snap_result = dict(result)
@@ -1068,12 +1088,16 @@ def persist_tpu_snapshot(path: str, result: dict, extra: dict) -> None:
 
 
 def run_families(backend: str, families, extra: dict,
-                 measure=None) -> None:
+                 measure=None, on_family=None) -> None:
     """Run measurement families, each in a fresh process, filling
     ``extra[name]``.  Bails out after two consecutive spawn failures:
     a wedged tunnel would otherwise cost the full ~150 s attach
     timeout per remaining family, serially — minutes of dead time
-    that can push the bench past the driver's outer deadline."""
+    that can push the bench past the driver's outer deadline.
+
+    ``on_family(name)`` fires after every successful measurement — the
+    TPU path persists the snapshot there, so a window (or outer
+    timeout) dying mid-run keeps every family already measured."""
     measure = measure if measure is not None else measure_family
     spawn_failures = 0
     for name, cell, cell_timeout in families:
@@ -1088,6 +1112,11 @@ def run_families(backend: str, families, extra: dict,
         spawn_failures = 0
         if out is not None:
             extra[name] = out
+            if on_family is not None:
+                try:
+                    on_family(name)
+                except Exception as e:
+                    log(f"[bench] on_family({name}) failed: {e}")
 
 
 def main() -> int:
@@ -1190,6 +1219,7 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                     comm, "smol135m",
                     MFU_CELL.format(peak=1e30, shape="(2, 512, 3)",
                                     reps="(1, 1)", tr_start="_B",
+                                    extra_cfg="",
                                     cfg_name="smol_135m_config"), 1200)
                 if mfu is not None:
                     mfu.pop("fwd_mfu", None)     # no meaningful CPU peak
@@ -1223,11 +1253,6 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         _teardown(comm, pm, world)
         comm = pm = None
 
-        if backend == "tpu":
-            # Every heavy measurement family runs in its own fresh
-            # worker process (see measure_family's docstring for why).
-            run_families(backend, tpu_families(), extra)
-
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"
                       f"_x{world}",
@@ -1237,13 +1262,31 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             "extra": extra,
         }
         if backend == "tpu":
-            # Persist the successful on-chip run: the axon tunnel flaps
-            # for hours, so a later (fallback) run can still attach the
-            # last measured TPU numbers, honestly timestamped.
+            # Every heavy measurement family runs in its own fresh
+            # worker process (see measure_family's docstring for why).
+            # The snapshot persists after EVERY family (merge-aware),
+            # so a tunnel death or outer-timeout kill mid-run keeps
+            # everything measured up to that point; the final persist
+            # stamps the completed run.  ``extra`` is shared by
+            # reference with ``result``, so each persist sees the
+            # families measured so far.
+            path = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "BENCH_TPU_LAST.json")
+
+            def _persist(name=None):
+                try:
+                    persist_tpu_snapshot(
+                        path, result, extra,
+                        stamp=None if name is None else [name])
+                except OSError as e:
+                    log(f"[bench] could not persist TPU snapshot: {e}")
+
+            run_families(backend, tpu_families(), extra,
+                         on_family=_persist)
+            # Final stamp: only keys never stamped (overhead/allreduce
+            # rows) get `now`; measured families keep their times.
             try:
-                path = os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "BENCH_TPU_LAST.json")
-                persist_tpu_snapshot(path, result, extra)
+                persist_tpu_snapshot(path, result, extra, stamp=[])
             except OSError as e:
                 log(f"[bench] could not persist TPU snapshot: {e}")
         else:

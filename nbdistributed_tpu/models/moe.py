@@ -19,8 +19,9 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
 from ..utils import fan_in_normal
-from .transformer import (TransformerConfig, _attention_block, _rms_norm,
-                          is_quantized, qlinear, shifted_xent)
+from .transformer import (TransformerConfig, _attention_block,
+                          _preset, _rms_norm, is_quantized, qlinear,
+                          shifted_xent)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,15 +52,17 @@ class MoEConfig(TransformerConfig):
 
 
 def tiny_moe_config(**kw) -> MoEConfig:
-    return MoEConfig(vocab_size=512, d_model=128, n_layers=2,
-                     n_heads=4, n_kv_heads=2, d_ff=256,
-                     max_seq_len=256, n_experts=4, top_k=2, **kw)
+    # Caller kwargs override the preset (same contract as the dense
+    # factories — shared _preset helper).
+    return _preset(kw, cls=MoEConfig, vocab_size=512, d_model=128,
+                   n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+                   max_seq_len=256, n_experts=4, top_k=2)
 
 
 def mixtral_8x7b_config(**kw) -> MoEConfig:
-    return MoEConfig(vocab_size=32000, d_model=4096, n_layers=32,
-                     n_heads=32, n_kv_heads=8, d_ff=14336,
-                     max_seq_len=4096, n_experts=8, top_k=2, **kw)
+    return _preset(kw, cls=MoEConfig, vocab_size=32000, d_model=4096,
+                   n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+                   max_seq_len=4096, n_experts=8, top_k=2)
 
 
 def init_moe_model(key, cfg: MoEConfig) -> dict:

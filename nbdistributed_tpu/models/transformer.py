@@ -92,16 +92,25 @@ class TransformerConfig:
 # Preset configs.  llama2_7b matches the acceptance config in
 # BASELINE.json ("8-rank Llama-2-7B forward"); tiny is the test/demo
 # scale (SmolLM2-135M-like role in the reference's notebook).
+# Caller kwargs OVERRIDE the preset's defaults (so e.g.
+# smol_135m_config(max_seq_len=8192) works — the bench's long-context
+# row does exactly that).
+def _preset(kw: dict, cls=None, **defaults):
+    """Build a preset config with caller kwargs overriding the
+    defaults.  ``cls`` lets subclass factories (MoEConfig) share the
+    same override contract."""
+    return (cls or TransformerConfig)(**{**defaults, **kw})
+
+
 def tiny_config(**kw) -> TransformerConfig:
-    return TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
-                             n_heads=4, n_kv_heads=2, d_ff=384,
-                             max_seq_len=256, **kw)
+    return _preset(kw, vocab_size=512, d_model=128, n_layers=2,
+                   n_heads=4, n_kv_heads=2, d_ff=384, max_seq_len=256)
 
 
 def smol_135m_config(**kw) -> TransformerConfig:
-    return TransformerConfig(vocab_size=49152, d_model=576, n_layers=30,
-                             n_heads=9, n_kv_heads=3, d_ff=1536,
-                             max_seq_len=2048, **kw)
+    return _preset(kw, vocab_size=49152, d_model=576, n_layers=30,
+                   n_heads=9, n_kv_heads=3, d_ff=1536,
+                   max_seq_len=2048)
 
 
 def tinyllama_1b_config(**kw) -> TransformerConfig:
@@ -109,9 +118,9 @@ def tinyllama_1b_config(**kw) -> TransformerConfig:
     d_model=2048 matmuls feed the MXU properly — the bench's
     MFU-at-meaningful-scale config (a 135M model's d=576 GEMMs cannot
     reach competitive MFU on a v5e)."""
-    return TransformerConfig(vocab_size=32000, d_model=2048, n_layers=22,
-                             n_heads=32, n_kv_heads=4, d_ff=5632,
-                             max_seq_len=2048, **kw)
+    return _preset(kw, vocab_size=32000, d_model=2048, n_layers=22,
+                   n_heads=32, n_kv_heads=4, d_ff=5632,
+                   max_seq_len=2048)
 
 
 def mistral_7b_config(**kw) -> TransformerConfig:
@@ -119,16 +128,16 @@ def mistral_7b_config(**kw) -> TransformerConfig:
     rope theta 1e4, 32k positions).  v0.2/v0.3 dropped the window and
     raised theta to 1e6 — convert those via config_from_hf instead of
     this preset."""
-    return TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32,
-                             n_heads=32, n_kv_heads=8, d_ff=14336,
-                             max_seq_len=32768, sliding_window=4096,
-                             rope_theta=10000.0, **kw)
+    return _preset(kw, vocab_size=32000, d_model=4096, n_layers=32,
+                   n_heads=32, n_kv_heads=8, d_ff=14336,
+                   max_seq_len=32768, sliding_window=4096,
+                   rope_theta=10000.0)
 
 
 def llama2_7b_config(**kw) -> TransformerConfig:
-    return TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32,
-                             n_heads=32, n_kv_heads=32, d_ff=11008,
-                             max_seq_len=4096, **kw)
+    return _preset(kw, vocab_size=32000, d_model=4096, n_layers=32,
+                   n_heads=32, n_kv_heads=32, d_ff=11008,
+                   max_seq_len=4096)
 
 
 # ----------------------------------------------------------------------

@@ -32,6 +32,7 @@ def run_cell(src: str) -> dict:
 def test_mfu_cell_executes():
     cell = bench.MFU_CELL.format(peak=1e30, shape="(1, 64, 2)",
                                  reps="(2, 2)", tr_start="2 * _B",
+                                 extra_cfg=", max_seq_len=128",
                                  cfg_name="tiny_config")
     res = run_cell(cell)
     assert res["fwd_tokens_per_s"] > 0 and res["train_tokens_per_s"] > 0
@@ -135,6 +136,29 @@ def test_run_families_single_spawn_failure_continues():
     assert extra == {"b": {"x": 1}, "d": {"y": 2}}
 
 
+def test_run_families_on_family_fires_per_success():
+    """The incremental-persist hook fires after every successful
+    family (not for failures), and a hook crash never kills the
+    sweep."""
+    results = {"a": {"x": 1}, "b": None, "c": {"y": 2}}
+    seen = []
+
+    def fake_measure(backend, name, cell, timeout):
+        return results[name]
+
+    def hook(name):
+        seen.append(name)
+        if name == "a":
+            raise RuntimeError("persist hiccup")   # must be survived
+
+    extra: dict = {}
+    fams = [(n, "cell", 1) for n in ("a", "b", "c")]
+    bench.run_families("tpu", fams, extra, measure=fake_measure,
+                       on_family=hook)
+    assert seen == ["a", "c"]
+    assert extra == {"a": {"x": 1}, "c": {"y": 2}}
+
+
 def test_run_families_cell_failure_is_not_spawn_failure():
     """None (cell failed, world healthy) never trips the bail-out."""
     calls = []
@@ -186,6 +210,31 @@ def test_persist_tpu_snapshot_carries_unmeasured_families(tmp_path):
     assert snap["result"]["extra"]["flash_attn"] == {"speedup": 1.5}
     assert snap["carried_from_previous"] == ["flash_attn"]
     assert snap["family_measured_at"]["flash_attn"] == ts_flash
+
+
+def test_persist_tpu_snapshot_stamp_is_per_family(tmp_path,
+                                                  monkeypatch):
+    """The incremental persist stamps ONLY the family that just
+    finished: families measured hours earlier keep their real
+    measurement times across later persists of the same run."""
+    path = str(tmp_path / "BENCH_TPU_LAST.json")
+    times = iter(["T1", "T2", "T3"])
+    monkeypatch.setattr(bench.time, "strftime",
+                        lambda *_a, **_k: next(times))
+    extra = {"smol135m": {"mfu": 0.4}}
+    result = {"metric": "m", "extra": extra}
+    bench.persist_tpu_snapshot(path, result, extra,
+                               stamp=["smol135m"])       # at T1
+    extra["tinyllama_1b"] = {"mfu": 0.38}
+    bench.persist_tpu_snapshot(path, result, extra,
+                               stamp=["tinyllama_1b"])   # at T2
+    extra["allreduce"] = {"rows": []}
+    bench.persist_tpu_snapshot(path, result, extra,
+                               stamp=[])                 # final, T3
+    snap = json.load(open(path))
+    assert snap["family_measured_at"]["smol135m"] == "T1"
+    assert snap["family_measured_at"]["tinyllama_1b"] == "T2"
+    assert snap["family_measured_at"]["allreduce"] == "T3"
 
 
 def test_moe_dispatch_cell_executes():

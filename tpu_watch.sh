@@ -53,7 +53,10 @@ while true; do
         #    serving + prefix admission, 7B-int8, MoE dispatch) is
         #    measured WITH tuned kernels in one pass — no separate
         #    retune step needed.
-        timeout 7200 python -u bench.py \
+        # 3 h budget: the family list grew (long-context MFU row) and
+        # the snapshot now persists after every family, so a long run
+        # can only gain — a mid-run kill keeps everything measured.
+        timeout 10800 python -u bench.py \
             > "$LOGDIR/bench_$ts.out" 2> "$LOGDIR/bench_$ts.log"
         pkill -9 -f "nbdistributed_tpu.runtime.worker" 2>/dev/null
         # 4. Where-does-the-time-go breakdown (VERDICT r3 item 8):
