@@ -181,6 +181,22 @@ for step in range(4):
 print(f"rank {rank}: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
       f"({'improved' if losses[-1] < losses[0] else 'NOT improved'})")""")
 
+md("""## Memory-lean loss: chunked-vocab cross-entropy
+
+`ce_chunk=N` makes the loss stream the lm_head in N-column blocks
+(`ops/xent.py`): the `(B, S, V)` logits — the buffer that caps the
+train batch at LM scale — never materialize, in forward or backward.
+Same numbers, a fraction of the memory:""")
+
+code("""\
+import dataclasses
+cfg_lean = dataclasses.replace(cfg, ce_chunk=8192)
+check = {"tokens": jnp.asarray(data[:2])}
+l_full = float(loss_fn(params, check, cfg))
+l_lean = float(loss_fn(params, check, cfg_lean))
+print(f"rank {rank}: full-logits loss {l_full:.6f}, "
+      f"chunked {l_lean:.6f} (match: {abs(l_full - l_lean) < 1e-4})")""")
+
 md("""## Generate from the fine-tuned weights (rank 0)
 
 `%%rank [0]` targets one worker, like the reference's rank-0
