@@ -365,10 +365,12 @@ import jax as _jax, jax.numpy as _jnp, numpy as _np
 from nbdistributed_tpu.models import (init_params as _init,
                                       make_generate_fn as _mkgen,
                                       quantize_params as _quant,
+                                      quantize_params4 as _quant4,
                                       smol_135m_config as _cfg_fn)
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
 _qp = _quant(_p)
+_q4p = _quant4(_p)
 _N1, _N2, _ML = 32, 256, 512
 _HBM_V5E = 819e9
 _REPS = 3
@@ -403,7 +405,8 @@ def _median_gen_s(_g, _params):
 _out = {}
 for _name, _params, _q8 in (("bf16", _p, False),
                             ("int8", _qp, False),
-                            ("int8_kv8", _qp, True)):
+                            ("int8_kv8", _qp, True),
+                            ("int4_kv8", _q4p, True)):
     _g1 = _mkgen(_cfg, _N1, max_len=_ML, kv_quantized=_q8)
     _g2 = _mkgen(_cfg, _N2, max_len=_ML, kv_quantized=_q8)
     _seed[0] += 1

@@ -16,9 +16,12 @@ from .pp import (make_pp_1f1b_train_step, make_pp_train_step,
                  pp_stage_params, pp_unstage_params)
 from .serving import DecodeServer
 from .speculative import speculative_generate
-from .quant import (dequantize_weight, is_quantized, quantization_error,
+from .quant import (dequantize_weight, dequantize_weight4,
+                    is_quantized, is_quantized4, quantization_error,
                     quantize_moe_params, quantize_params,
+                    quantize_params4, quantize_weight4,
                     quantize_weight, quantized_moe_shardings,
+                    quantized_shardings4,
                     quantized_shardings)
 from .moe import (MoEConfig, init_moe_model, mixtral_8x7b_config,
                   moe_forward_hidden,

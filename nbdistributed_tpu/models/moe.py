@@ -20,7 +20,8 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.expert import init_moe_params, moe_ffn, moe_param_shardings
 from ..utils import fan_in_normal
 from .transformer import (TransformerConfig, _attention_block,
-                          _preset, _rms_norm, is_quantized, qlinear,
+                          _preset, _rms_norm, is_quantized,
+                          is_quantized4, qlinear,
                           shifted_xent)
 
 
@@ -205,7 +206,8 @@ def moe_loss_fn(params, batch, cfg: MoEConfig, *, mesh=None,
     seg = batch.get("segments") if isinstance(batch, dict) else None
     positions = packed_positions(seg) if seg is not None else None
     if (cfg.ce_chunk is not None and sp is None and mesh is None
-            and not is_quantized(params["lm_head"])):
+            and not is_quantized(params["lm_head"])
+            and not is_quantized4(params["lm_head"])):
         # Chunked-vocab tail, same contract as the dense family
         # (transformer.loss_fn): the (B, S, V) logits never
         # materialize; tests pin the two paths equal.

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .lora import ATTN_TARGETS  # one definition, shared with LoRA
-from .transformer import is_quantized  # noqa: F401  (re-export)
+from .transformer import (_pack_nibbles,  # noqa: F401  (re-exports)
+                          _unpack_nibbles, is_quantized, is_quantized4)
 
 # Weights worth quantizing: all the big matmuls.  Norm gains stay fp32,
 # the embedding stays fp (it is a gather, not a matmul; its lm_head tie
@@ -57,22 +58,108 @@ def dequantize_weight(qw: dict, dtype=jnp.float32):
     return (qw["q8"].astype(jnp.float32) * qw["s"]).astype(dtype)
 
 
+# ---------------------------------------------------------------- int4
+# Int4 weight-only: HALF the int8 bytes again — decode streams every
+# weight per token, so bytes/token is the throughput.  Two design
+# points differ from int8:
+#
+# * **Grouped scales**: 15 levels need finer scale granularity than
+#   per-output-channel; scales are per (contraction-group, out-channel)
+#   with ``group`` input rows per scale (default 64 — divides every
+#   family config's d_model/d_ff).  Grouped scales no longer commute
+#   with the whole matmul, so qlinear's int4 path runs one small
+#   batched einsum per group block and combines with the scales after
+#   (decode is bandwidth-bound; the extra reduction is noise).
+# * **Explicit nibble packing in uint8** (two weights per byte along
+#   the contraction axis), NOT the native jnp.int4 dtype: jax arrays
+#   report int4 at one byte per element on the backends here, so the
+#   native dtype's HBM claim is unverifiable off-chip — the packed
+#   uint8 array is exactly d_in/2 x d_out bytes on every backend, and
+#   the unpack (shift/mask/sign-extend) is elementwise arithmetic XLA
+#   fuses into the consumer.  The pack/unpack pair is defined beside
+#   its qlinear consumer in transformer.py (single definition of the
+#   layout) and re-exported here.
+
+
+def quantize_weight4(w, *, group: int = 64) -> dict:
+    """Symmetric per-(group, output-channel) int4 quantization:
+    ``{"q4": uint8 (..., d_in/2, d_out) nibble-packed,
+    "s": fp32 (..., G, 1, d_out)}`` with ``G = d_in // group``."""
+    wf = w.astype(jnp.float32)
+    d_in = wf.shape[-2]
+    if d_in % group or group % 2:
+        raise ValueError(f"group {group} must be even and divide "
+                         f"d_in {d_in}")
+    g_shape = (*wf.shape[:-2], d_in // group, group, wf.shape[-1])
+    wg = wf.reshape(g_shape)
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / s), -7, 7).astype(jnp.int32)
+    q = q.reshape(wf.shape)
+    return {"q4": _pack_nibbles(q), "s": s}
+
+
+def dequantize_weight4(qw: dict, dtype=jnp.float32):
+    q = _unpack_nibbles(qw["q4"], jnp.float32)
+    s = qw["s"]
+    G = s.shape[-3]
+    d_in = q.shape[-2]
+    wg = q.reshape(*q.shape[:-2], G, d_in // G, q.shape[-1]) * s
+    return wg.reshape(*q.shape[:-2], d_in, q.shape[-1]).astype(dtype)
+
+
+def quantize_params4(params: dict, targets=DEFAULT_TARGETS,
+                     quantize_lm_head: bool = True,
+                     group: int = 64) -> dict:
+    """Int4 variant of :func:`quantize_params` (same pytree
+    transform; leaves become ``{"q4", "s"}``)."""
+    return _map_targets(
+        params, lambda w: quantize_weight4(w, group=group), targets,
+        quantize_lm_head)
+
+
+def _q_spec4(spec: P) -> dict:
+    """Spec pair for an int4 leaf: the packed array keeps the weight's
+    spec (packing halves the contraction extent, never its sharding);
+    the grouped scale replicates over the contraction shard — G is
+    d_in/group and need not divide a tp axis (wo at smol scale has
+    G=9), and scales are ~1.5 % of the weight bytes, so replication
+    costs nothing where uneven sharding would refuse to place."""
+    return {"q4": spec, "s": P(*spec[:-2], None, None, spec[-1])}
+
+
+def quantized_shardings4(rules: dict, targets=DEFAULT_TARGETS,
+                         quantize_lm_head: bool = True) -> dict:
+    """Sharding rules matching :func:`quantize_params4`."""
+    return _map_targets(rules, _q_spec4, targets, quantize_lm_head)
+
+
+def _map_targets(tree: dict, leaf_fn, targets,
+                 include_lm_head: bool) -> dict:
+    """Apply ``leaf_fn`` to the targeted ``layers`` weights (and
+    optionally ``lm_head``) of a params-or-rules pytree — the single
+    structural transform all four quantize/sharding variants share.
+    Everything else passes through by reference."""
+    layers = dict(tree["layers"])
+    for name in targets:
+        if name not in layers:
+            raise ValueError(f"unknown quantization target {name!r}; "
+                             f"layer weights: {sorted(tree['layers'])}")
+        layers[name] = leaf_fn(layers[name])
+    out = dict(tree)
+    out["layers"] = layers
+    if include_lm_head:
+        out["lm_head"] = leaf_fn(tree["lm_head"])
+    return out
+
+
 def quantize_params(params: dict, targets=DEFAULT_TARGETS,
                     quantize_lm_head: bool = True) -> dict:
     """Params pytree with the targeted per-layer weights (and optionally
     ``lm_head``) replaced by int8 ``{"q8", "s"}`` leaves.  Everything
     else (embed, norms) is passed through by reference."""
-    layers = dict(params["layers"])
-    for name in targets:
-        if name not in layers:
-            raise ValueError(f"unknown quantization target {name!r}; "
-                             f"layer weights: {sorted(params['layers'])}")
-        layers[name] = quantize_weight(layers[name])
-    out = dict(params)
-    out["layers"] = layers
-    if quantize_lm_head:
-        out["lm_head"] = quantize_weight(params["lm_head"])
-    return out
+    return _map_targets(params, quantize_weight, targets,
+                        quantize_lm_head)
 
 
 def _q_spec(spec: P) -> dict:
@@ -88,17 +175,7 @@ def quantized_shardings(rules: dict, targets=DEFAULT_TARGETS,
     :func:`_q_spec`).  ``targets``/``quantize_lm_head`` must match what
     was passed to :func:`quantize_params`, or device_put will die on a
     pytree structure mismatch far from the mistake."""
-    layers = dict(rules["layers"])
-    for name in targets:
-        if name not in layers:
-            raise ValueError(f"unknown quantization target {name!r}; "
-                             f"layer weights: {sorted(rules['layers'])}")
-        layers[name] = _q_spec(layers[name])
-    out = dict(rules)
-    out["layers"] = layers
-    if quantize_lm_head:
-        out["lm_head"] = _q_spec(rules["lm_head"])
-    return out
+    return _map_targets(rules, _q_spec, targets, quantize_lm_head)
 
 
 EXPERT_TARGETS = ("w_gate", "w_up", "w_down")
@@ -136,23 +213,29 @@ def quantized_moe_shardings(rules: dict,
 
 def quantization_error(params: dict, qparams: dict) -> dict:
     """Per-weight relative Frobenius error of the quantization — a
-    quick fidelity report (int8 per-channel is typically ~0.2-0.5%)."""
+    quick fidelity report (int8 per-channel is typically ~0.2-0.5 %;
+    int4 group-64 ~2-4 %).  Handles both leaf kinds."""
     report = {}
+
+    def _deq(qw):
+        return (dequantize_weight4(qw) if is_quantized4(qw)
+                else dequantize_weight(qw))
 
     def _rel(w, qw):
         wf = w.astype(jnp.float32)
-        err = dequantize_weight(qw) - wf
+        err = _deq(qw) - wf
         return float(jnp.linalg.norm(err) / jnp.linalg.norm(wf))
 
     def _walk(prefix, ref_tree, q_tree):
         for name, leaf in q_tree.items():
-            if is_quantized(leaf):
+            if is_quantized(leaf) or is_quantized4(leaf):
                 report[prefix + name] = _rel(ref_tree[name], leaf)
             elif isinstance(leaf, dict):
                 # Nested weight groups (the MoE 'moe' subtree).
                 _walk(prefix + name + ".", ref_tree[name], leaf)
 
     _walk("", params["layers"], qparams["layers"])
-    if is_quantized(qparams.get("lm_head")):
-        report["lm_head"] = _rel(params["lm_head"], qparams["lm_head"])
+    head = qparams.get("lm_head")
+    if is_quantized(head) or is_quantized4(head):
+        report["lm_head"] = _rel(params["lm_head"], head)
     return report

@@ -247,6 +247,57 @@ def is_quantized(leaf) -> bool:
     return isinstance(leaf, dict) and "q8" in leaf and "s" in leaf
 
 
+def is_quantized4(leaf) -> bool:
+    """True for a nibble-packed int4 leaf ``{"q4", "s"}``
+    (models/quant.py quantize_weight4)."""
+    return isinstance(leaf, dict) and "q4" in leaf and "s" in leaf
+
+
+# Nibble pack/unpack live HERE (beside the qlinear consumer) so the
+# packing layout has exactly one definition; quant.py re-exports them
+# — the same no-import-cycle arrangement as is_quantized above.
+
+def _pack_nibbles(q):
+    """(..., d_in, d_out) int values in [-7, 7] -> (..., d_in/2, d_out)
+    uint8; row 2k rides the low nibble, row 2k+1 the high."""
+    lo = (q[..., 0::2, :] & 0xF)
+    hi = (q[..., 1::2, :] & 0xF)
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def _unpack_nibbles(packed, dtype):
+    """Inverse of :func:`_pack_nibbles` (sign-extended)."""
+    p = packed.astype(jnp.int32)
+    lo = (((p & 0xF) ^ 8) - 8)
+    hi = ((((p >> 4) & 0xF) ^ 8) - 8)
+    q = jnp.stack([lo, hi], axis=-2)          # (..., d_in/2, 2, d_out)
+    return q.reshape(*packed.shape[:-2], packed.shape[-2] * 2,
+                     packed.shape[-1]).astype(dtype)
+
+
+def _qlinear4(x, w):
+    """``x @ W`` for a nibble-packed int4 leaf with grouped scales.
+
+    The packed uint8 array (d_in/2, d_out) is HALF the int8 bytes —
+    what decode streams; the unpack (shift/mask/sign-extend) is
+    elementwise arithmetic XLA fuses into the consumer.  Grouped
+    scales don't commute with the whole matmul, so the contraction
+    runs as G batched (group x d_out) einsums whose partials combine
+    with the (G, d_out) scales — one extra small reduction on the
+    activation side, nothing extra on the weight side."""
+    q4, s = w["q4"], w["s"]
+    d_in, d_out = q4.shape[-2] * 2, q4.shape[-1]
+    G = s.shape[-3]
+    group = d_in // G
+    qu = _unpack_nibbles(q4, x.dtype)
+    qg = qu.reshape(G, group, d_out)
+    xg = x.reshape(*x.shape[:-1], G, group)
+    y = jnp.einsum("...gk,gko->...go", xg, qg).astype(jnp.float32)
+    y = jnp.einsum("...go,go->...o", y,
+                   s.reshape(G, d_out).astype(jnp.float32))
+    return y.astype(x.dtype)
+
+
 def qlinear(x, w):
     """``x @ w`` where ``w`` is a plain array or an int8 weight-only
     quantized leaf ``{"q8", "s"}`` (see models/quant.py).  Per-output-
@@ -257,6 +308,8 @@ def qlinear(x, w):
     if is_quantized(w):
         y = x @ w["q8"].astype(x.dtype)
         return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    if is_quantized4(w):
+        return _qlinear4(x, w)
     return x @ w
 
 
@@ -526,7 +579,8 @@ def loss_fn(params, batch, cfg: TransformerConfig,
         sp is not None and sp.tp_axis is not None
         and dict(getattr(sp.mesh, "shape", {})).get(sp.tp_axis, 1) > 1)
     if (cfg.ce_chunk is not None and not tp_sharded_head
-            and not is_quantized(params["lm_head"])):
+            and not is_quantized(params["lm_head"])
+            and not is_quantized4(params["lm_head"])):
         # Chunked-vocab tail (ops/xent.py): the (B, S, V) logits never
         # materialize.  Same shift/boundary-mask contract as
         # shifted_xent — tests pin the two paths equal to fp32

@@ -82,9 +82,13 @@ def test_decode_cell_executes():
         lo, hi = res[k + "_lo_hi_s"]
         assert lo > 0 and hi > 0
         assert res[k + "_bytes_per_tok_mb"] > 0
-    # int8 weights + int8 KV must stream fewer bytes than bf16.
+    # int8 weights + int8 KV must stream fewer bytes than bf16, and
+    # nibble-packed int4 fewer again (the packed uint8 array is
+    # exactly half the int8 weight bytes plus group scales).
     assert (res["int8_kv8_bytes_per_tok_mb"]
             < res["bf16_bytes_per_tok_mb"])
+    assert (res["int4_kv8_bytes_per_tok_mb"]
+            < res["int8_kv8_bytes_per_tok_mb"])
 
 
 def test_serve_cell_executes():

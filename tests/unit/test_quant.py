@@ -154,3 +154,135 @@ def test_quantized_moe_forward_and_ep_mesh():
         lambda p, t: moe_forward(p, t, mcfg, mesh=mesh))(qp_s, tokens)
     np.testing.assert_allclose(np.asarray(got_s), np.asarray(got),
                                atol=2e-4, rtol=2e-4)
+
+
+# ------------------------------------------------------------------ int4
+# Nibble-packed int4 with grouped scales (quant.py quantize_weight4).
+
+def test_int4_pack_roundtrip_exact():
+    """Every representable value survives pack -> unpack bit-exactly."""
+    from nbdistributed_tpu.models.quant import (_pack_nibbles,
+                                                _unpack_nibbles)
+    q = jnp.arange(-7, 8, dtype=jnp.int32)
+    q = jnp.tile(q, 4).reshape(12, 5)          # even rows, odd cols
+    packed = _pack_nibbles(q)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == (6, 5)
+    back = _unpack_nibbles(packed, jnp.int32)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(q))
+
+
+def test_int4_roundtrip_error_bounded():
+    """Grouped symmetric int4: error <= s/2 per element, i.e.
+    <= group-max / 14."""
+    from nbdistributed_tpu.models import (dequantize_weight4,
+                                          quantize_weight4)
+    w = jax.random.normal(jax.random.PRNGKey(5), (128, 32)) * 2.0
+    qw = quantize_weight4(w, group=64)
+    assert qw["q4"].shape == (64, 32)
+    back = np.asarray(dequantize_weight4(qw))
+    wg = np.asarray(w).reshape(2, 64, 32)
+    bound = (np.abs(wg).max(axis=1, keepdims=True) / 14.0 + 1e-6)
+    err = np.abs(back.reshape(2, 64, 32) - wg)
+    assert np.all(err <= bound)
+
+
+def test_int4_qlinear_matches_dequantized_matmul():
+    """qlinear's grouped-einsum int4 path == x @ dequant(W4) up to
+    fp32 reassociation."""
+    from nbdistributed_tpu.models import dequantize_weight4, quantize_weight4
+    from nbdistributed_tpu.models.transformer import qlinear
+    w = jax.random.normal(jax.random.PRNGKey(6), (128, 48))
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 128))
+    qw = quantize_weight4(w, group=64)
+    ref = x @ dequantize_weight4(qw)
+    got = qlinear(x, qw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_int4_forward_matches_dequantized_params(setup):
+    """The whole model with int4 leaves == the same model with those
+    leaves dequantized back to fp — isolates the packed-compute path
+    from the quantization error itself."""
+    from nbdistributed_tpu.models import (dequantize_weight4,
+                                          is_quantized4,
+                                          quantize_params4)
+    cfg, params, tokens = setup
+    q4 = quantize_params4(params)
+    deq = jax.tree_util.tree_map(
+        lambda leaf: (dequantize_weight4(leaf, cfg.dtype)
+                      if is_quantized4(leaf) else leaf),
+        q4, is_leaf=is_quantized4)
+    ref = np.asarray(forward(deq, tokens, cfg))
+    got = np.asarray(forward(q4, tokens, cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_int4_generation_runs(setup):
+    from nbdistributed_tpu.models import quantize_params4
+    cfg, params, _ = setup
+    q4 = quantize_params4(params)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    toks = generate(q4, prompt, cfg, max_new_tokens=6)
+    assert toks.shape == (1, 10)
+
+
+def test_int4_memory_below_half_of_int8(setup):
+    """Packed uint8 bytes = half the int8 weight bytes; group scales
+    add ~6%: the int4 tree must land well under int8's."""
+    from nbdistributed_tpu.models import quantize_params4
+    cfg, params, _ = setup
+
+    def nbytes(t):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(t))
+
+    b8 = nbytes(quantize_params(params))
+    b4 = nbytes(quantize_params4(params))
+    assert b4 < 0.75 * b8
+
+
+def test_int4_shardings_structure_matches(setup):
+    """quantized_shardings4 must mirror quantize_params4's pytree so
+    device_put(tree_map(...)) works — a structure mismatch dies far
+    from the mistake."""
+    from nbdistributed_tpu.models import (quantize_params4,
+                                          quantized_shardings4)
+    cfg, params, _ = setup
+    q4 = quantize_params4(params)
+    rules = quantized_shardings4(param_shardings(cfg))
+    jax.tree_util.tree_map(lambda a, b: None, q4, rules)  # must not raise
+
+
+def test_int4_tensor_parallel_places_and_matches(setup):
+    """quantized_shardings4 must PLACE on a real tp mesh (the grouped
+    scales replicate over the contraction shard — G=2 here and 9 at
+    smol scale need not divide tp) and the sharded forward must match
+    the unsharded int4 forward."""
+    from jax.sharding import PartitionSpec as P
+
+    from nbdistributed_tpu.models import (quantize_params4,
+                                          quantized_shardings4)
+    cfg, params, tokens = setup
+    q4 = quantize_params4(params)
+    ref = np.asarray(forward(q4, tokens, cfg))
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    rules = quantized_shardings4(param_shardings(cfg))
+    q_s = jax.device_put(q4, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), rules,
+        is_leaf=lambda x: isinstance(x, P)))
+    got = np.asarray(jax.jit(lambda p, t: forward(p, t, cfg))(q_s,
+                                                              tokens))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_int4_quantization_error_reports_all_targets(setup):
+    from nbdistributed_tpu.models import (quantization_error,
+                                          quantize_params4)
+    cfg, params, _ = setup
+    rep = quantization_error(params, quantize_params4(params))
+    assert set(rep) >= {"wq", "wo", "w_gate", "lm_head"}
+    # int4 group-64 lands in the few-percent band: real numbers, not
+    # zeros, and better than 15 % everywhere at this scale.
+    assert all(0.0 < v < 0.15 for v in rep.values())
