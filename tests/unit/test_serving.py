@@ -123,6 +123,24 @@ def test_int8_cache_serving_matches_int8_generate(setup):
     assert srv.outputs[rid] == ref
 
 
+def test_int4_params_serving_matches_int4_generate(setup):
+    """Nibble-packed int4 weights serve through DecodeServer exactly
+    as through standalone generate (the qlinear packed path under the
+    server's slot-pooled cache)."""
+    from nbdistributed_tpu.models import quantize_params4
+    cfg, params = setup
+    q4 = quantize_params4(params)
+    prompt, n = [5, 9, 2, 7], 6
+    ref = generate(q4, jnp.asarray(prompt, jnp.int32)[None], cfg,
+                   n, kv_quantized=True)
+    ref = [int(t) for t in np.asarray(ref)[0][len(prompt):]]
+    srv = DecodeServer(q4, cfg, max_batch=2, max_len=32, pad_to=4,
+                       kv_quantized=True)
+    rid = srv.submit(prompt, n)
+    srv.run_until_done(max_steps=50)
+    assert srv.outputs[rid] == ref
+
+
 def test_token_mask_keeps_pads_out_of_expert_capacity():
     """forward_with_cache's token_mask: right-pad tokens routed
     through a tight-capacity MoE flood an expert's segment and evict
