@@ -1063,7 +1063,7 @@ def run_families_only(names: list[str]) -> int:
 
 
 def persist_tpu_snapshot(path: str, result: dict, extra: dict,
-                         stamp=None) -> None:
+                         stamp=None) -> dict:
     """Atomically write BENCH_TPU_LAST.json, MERGING per-family over
     the previous snapshot: families the tunnel died before
     re-measuring are carried forward with their original timestamps
@@ -1095,12 +1095,14 @@ def persist_tpu_snapshot(path: str, result: dict, extra: dict,
         fam_ts.setdefault(k, prev_ts)
     snap_result = dict(result)
     snap_result["extra"] = {**prev_extra, **extra}
+    snap = {"measured_at": now,
+            "family_measured_at": fam_ts,
+            "carried_from_previous": carried,
+            "result": snap_result}
     with open(path + ".tmp", "w") as f:
-        json.dump({"measured_at": now,
-                   "family_measured_at": fam_ts,
-                   "carried_from_previous": carried,
-                   "result": snap_result}, f, indent=1)
+        json.dump(snap, f, indent=1)
     os.replace(path + ".tmp", path)   # atomic
+    return snap
 
 
 def run_families(backend: str, families, extra: dict,
@@ -1113,10 +1115,32 @@ def run_families(backend: str, families, extra: dict,
 
     ``on_family(name)`` fires after every successful measurement — the
     TPU path persists the snapshot there, so a window (or outer
-    timeout) dying mid-run keeps every family already measured."""
+    timeout) dying mid-run keeps every family already measured.
+
+    ``NBD_BENCH_FAMILY_BUDGET_S`` (default 5400) bounds the whole
+    family stage: once exceeded, remaining families are skipped with a
+    loud log instead of risking the driver's outer deadline killing
+    the run before its one JSON line prints — the per-family snapshot
+    still holds everything measured, and earlier windows' families
+    ride it as carried entries."""
     measure = measure if measure is not None else measure_family
+    try:
+        budget = float(os.environ.get("NBD_BENCH_FAMILY_BUDGET_S",
+                                      5400))
+    except ValueError:
+        log("[bench] NBD_BENCH_FAMILY_BUDGET_S is not a number; "
+            "using 5400")
+        budget = 5400.0
+    t_start = time.time()
     spawn_failures = 0
-    for name, cell, cell_timeout in families:
+    families = list(families)
+    for i, (name, cell, cell_timeout) in enumerate(families):
+        elapsed = time.time() - t_start
+        if elapsed > budget:
+            log(f"[bench] family budget {budget:.0f}s exhausted after "
+                f"{elapsed:.0f}s — skipping "
+                f"{[n for n, _, _ in families[i:]]}")
+            return
         out = measure(backend, name, cell, cell_timeout)
         if out is SPAWN_FAILED:
             spawn_failures += 1
@@ -1301,8 +1325,19 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                          on_family=_persist)
             # Final stamp: only keys never stamped (overhead/allreduce
             # rows) get `now`; measured families keep their times.
+            # Families an EARLIER window measured but this run did not
+            # (budget/flap skips) are annotated onto the printed line
+            # from the snapshot persist's OWN return value (never a
+            # re-read — a failed write must not mislabel this run's
+            # live families as stale carried data).
             try:
-                persist_tpu_snapshot(path, result, extra, stamp=[])
+                snap = persist_tpu_snapshot(path, result, extra,
+                                            stamp=[])
+                carried = {k: snap["family_measured_at"].get(k)
+                           for k in snap["carried_from_previous"]}
+                if carried:
+                    extra["carried_families"] = carried
+                    extra["snapshot_file"] = os.path.basename(path)
             except OSError as e:
                 log(f"[bench] could not persist TPU snapshot: {e}")
         else:

@@ -182,6 +182,27 @@ def test_run_families_on_family_fires_per_success():
     assert extra == {"a": {"x": 1}, "c": {"y": 2}}
 
 
+def test_run_families_budget_skips_remaining(monkeypatch):
+    """Once the family-stage budget is exhausted, remaining families
+    are skipped loudly instead of risking the driver's outer deadline
+    (the one JSON line must always print)."""
+    import time
+
+    monkeypatch.setenv("NBD_BENCH_FAMILY_BUDGET_S", "0.05")
+    calls = []
+
+    def slow_measure(backend, name, cell, timeout):
+        calls.append(name)
+        time.sleep(0.06)
+        return {"v": 1}
+
+    extra: dict = {}
+    fams = [(n, "cell", 1) for n in ("a", "b", "c")]
+    bench.run_families("tpu", fams, extra, measure=slow_measure)
+    assert calls == ["a"]          # budget spent during 'a'
+    assert extra == {"a": {"v": 1}}
+
+
 def test_run_families_cell_failure_is_not_spawn_failure():
     """None (cell failed, world healthy) never trips the bail-out."""
     calls = []
