@@ -241,6 +241,31 @@ solo = generate(params, jnp.asarray([system_prompt + [5]], jnp.int32),
 assert srv.outputs[ra] == solo, "serving must match solo generate"
 print("bit-identical to solo generate:", solo)""")
 
+md("""## Quantized decode: int8 and nibble-packed int4
+
+Decode streams every weight per token, so bytes are throughput:
+`quantize_params` stores the matmul weights int8 (half the bf16
+stream), `quantize_params4` nibble-packs them into uint8 at exactly
+0.5 bytes/weight with per-64-input-group scales.  Both trees serve
+through the same `generate`/`DecodeServer` paths via `qlinear`
+dispatch.""")
+
+code("""\
+%%rank [0]
+from nbdistributed_tpu.models import quantize_params, quantize_params4
+
+def tree_mb(t):
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(t)) / 1e6
+
+q8, q4 = quantize_params(params), quantize_params4(params)
+toks8 = generate(q8, prompt, cfg, max_new_tokens=8)[0].tolist()
+toks4 = generate(q4, prompt, cfg, max_new_tokens=8)[0].tolist()
+print(f"fp {tree_mb(params):.1f} MB -> int8 {tree_mb(q8):.1f} MB "
+      f"-> int4 {tree_mb(q4):.1f} MB")
+print("int8 decode:", toks8)
+print("int4 decode:", toks4)""")
+
 md("""## Pull model state into the kernel — no pickle
 
 `%dist_pull` / `%dist_push` carry whole params/optimizer pytrees as a
