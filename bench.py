@@ -444,17 +444,30 @@ import json as _json, time as _time
 import jax as _jax, jax.numpy as _jnp, numpy as _np
 from nbdistributed_tpu.models import (generate as _gen,
                                       init_params as _init,
+                                      quantize_params4 as _quant4,
                                       smol_135m_config as _cfg_fn,
                                       speculative_generate as _spec)
 _cfg = _cfg_fn(dtype=_jnp.bfloat16, use_flash=True)
 _p = _init(_jax.random.PRNGKey(0), _cfg)
+_q4 = _quant4(_p)
 _N1, _N2, _G, _B = 16, 64, 4, 4
 _REPS = 3
 
-def _mk(_n, _spec_mode):
-    if _spec_mode:
+def _mk(_n, _mode):
+    # "spec" = self-draft (acceptance == gamma, pure-mechanics upper
+    # bound); "spec4" = int4-quantized-self draft (the textbook cheap
+    # draft: near-gamma acceptance, draft forward streams half the
+    # bytes) — the realistic point between self-draft and plain.
+    if _mode == "spec":
         return _jax.jit(lambda p, t: _spec(p, p, t, _cfg, _cfg, _n,
                                            gamma=_G))
+    if _mode == "spec4":
+        # Draft tree rides as a traced ARGUMENT, not a closure: a
+        # closed-over pytree is baked into each executable as
+        # constants (extra HBM copies, slower compiles).
+        _f4 = _jax.jit(lambda p, d, t: _spec(p, d, t, _cfg, _cfg, _n,
+                                             gamma=_G))
+        return lambda p, t: _f4(p, _q4, t)
     return _jax.jit(lambda p, t: _gen(p, t, _cfg, _n))
 
 _seed = [100]
@@ -486,11 +499,12 @@ _spec_r = None
 # ~one stream's wall-clock: report aggregate tokens/s at B=1 and B=4.
 # Per-token time = (N2-run - N1-run)/(N2-N1), medians of fresh-prompt
 # reps — the delta cancels the fixed dispatch+fetch round-trip.
-for _name, _spec_mode, _b in (("plain", False, 1),
-                              ("spec_selfdraft", True, 1),
-                              ("plain_b4", False, _B),
-                              ("spec_selfdraft_b4", True, _B)):
-    _f1, _f2 = _mk(_N1, _spec_mode), _mk(_N2, _spec_mode)
+for _name, _mode, _b in (("plain", "plain", 1),
+                         ("spec_selfdraft", "spec", 1),
+                         ("plain_b4", "plain", _B),
+                         ("spec_selfdraft_b4", "spec", _B),
+                         ("spec_int4draft_b4", "spec4", _B)):
+    _f1, _f2 = _mk(_N1, _mode), _mk(_N2, _mode)
     _fetch(_f1(_p, _prompt_for(_b)))     # compile + first run
     _fetch(_f2(_p, _prompt_for(_b)))
     _lo, _ = _median_s(_f1, _b)
@@ -499,7 +513,9 @@ for _name, _spec_mode, _b in (("plain", False, 1),
     _out[_name + "_tok_per_s"] = (
         None if _per_tok <= 0 else round(_b / _per_tok, 1))
     _out[_name + "_lo_hi_s"] = [round(_lo, 4), round(_hi, 4)]
-    if _spec_mode:
+    if _mode == "spec4":
+        _out["int4draft_mean_accepted"] = round(float(_r[1]), 2)
+    elif _mode == "spec":
         _spec_r = _r
 _out["gamma"] = _G
 _out["batch"] = _B
@@ -997,7 +1013,8 @@ def tpu_families():
         # mode on CPU is orders slower by design).
         ("flash_attn", FLASH_CELL, 900),
         ("decode", DECODE_CELL, 1200),
-        ("speculative", SPEC_CELL, 1200),
+        # +2 compiles for the int4-draft row.
+        ("speculative", SPEC_CELL, 1500),
         # Prefix-admission measurement added two more server worlds
         # (extra prefill/absorb compiles) — budget accordingly.
         ("serving", SERVE_CELL, 1800),

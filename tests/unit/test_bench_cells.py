@@ -47,13 +47,14 @@ def test_spec_cell_executes_batched():
     # tok_per_s rows are None when measurement noise wins (tiny CPU
     # deltas); execution + sample bookkeeping is what's asserted.
     for name in ("plain", "spec_selfdraft", "plain_b4",
-                 "spec_selfdraft_b4"):
+                 "spec_selfdraft_b4", "spec_int4draft_b4"):
         assert res[name + "_tok_per_s"] is None \
             or res[name + "_tok_per_s"] > 0
         lo, hi = res[name + "_lo_hi_s"]
         assert lo > 0 and hi > 0
     assert res["batch"] == 2
     assert 0 <= res["mean_accepted"] <= 2
+    assert 0 <= res["int4draft_mean_accepted"] <= 2
 
 
 def test_decode7b_cell_executes_at_toy_scale():

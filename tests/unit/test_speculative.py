@@ -41,6 +41,23 @@ def test_greedy_exact_vs_target_decode(setup, gamma):
     assert 0.0 <= float(mean_acc) <= gamma
 
 
+def test_int4_draft_exact_and_high_acceptance(setup):
+    """The textbook deployment: draft = the int4-quantized target.
+    Greedy speculative output stays bit-identical to the target's own
+    decode (correctness never depends on the draft), and acceptance
+    stays high (the quantized model mostly agrees with itself)."""
+    from nbdistributed_tpu.models import quantize_params4
+    cfg, _, params, _, prompt = setup
+    q4 = quantize_params4(params)
+    ref = generate(params, prompt, cfg, max_new_tokens=12)
+    got, mean_acc = speculative_generate(
+        params, q4, prompt, cfg, cfg, 12, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # Random tiny weights still agree with their own int4 copy most
+    # of the time; the bound just pins "not degenerate".
+    assert float(mean_acc) >= 1.0
+
+
 def test_self_draft_accepts_everything(setup):
     """Draft == target: every greedy proposal matches, so every round
     accepts all gamma tokens and output equals target greedy."""
