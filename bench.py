@@ -731,6 +731,12 @@ _out = {"model": "llama2-7b (random init), weight-only quant + int8 KV",
 for _name, _qfn in (("int8", _quant), ("int4", _quant4)):
     with _jax.default_device(_jax.devices("cpu")[0]):
         _qh = _qfn(_p_host)
+    if _name == "int4":
+        # Last quantize consumed it: drop the ~13.4 GB bf16 host tree
+        # now so it never overlaps the int4 transfer (ADVICE r5 —
+        # keeping it resident across both passes nearly doubled peak
+        # host memory on the TPU VM).
+        del _p_host
     _qp = _jax.tree_util.tree_map(lambda a: _jax.device_put(a, _dev),
                                   _qh)
     del _qh; _gc.collect()

@@ -59,6 +59,7 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
 %dist_profile start/stop ·
+%dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown
 """
@@ -82,6 +83,27 @@ class DistributedMagics(Magics):
     # crash (kept across %dist_reset on purpose: healing after a reset
     # is the common recovery flow).
     _last_init_line: str | None = None
+    # Last checkpoint path a %dist_checkpoint COMPLETED writing — the
+    # auto-heal supervisor restores it after a respawn.  Background
+    # saves park their path in _bg_ckpt_path until a --status poll
+    # confirms every rank finished (an in-flight or failed save must
+    # never become the heal target).
+    _last_ckpt_path: str | None = None
+    _bg_ckpt_path: str | None = None
+    # Ranks whose in-flight background save has reported "done": the
+    # worker consumes its async handle on the first done poll (later
+    # polls say "idle"), so doneness must accumulate ACROSS polls.
+    _bg_ckpt_done: set = set()
+
+    @classmethod
+    def _clear_bg_ckpt(cls) -> None:
+        """Invalidate the pending background-save promotion (the two
+        fields are one invariant — always cleared together)."""
+        cls._bg_ckpt_path = None
+        cls._bg_ckpt_done = set()
+
+    # Active auto-heal supervisor (resilience/supervisor.py), or None.
+    _supervisor = None
 
     _cell_hooks: tuple | None = None
 
@@ -168,6 +190,15 @@ class DistributedMagics(Magics):
 
     @classmethod
     def reset_class_state(cls) -> None:
+        if cls._supervisor is not None:
+            cls._supervisor.stop()
+            cls._supervisor = None
+        # In-flight background-save tracking is world-specific (per-
+        # rank doneness): stale entries from a previous (possibly
+        # larger) world must not promote a half-written checkpoint in
+        # the next one.  _last_ckpt_path survives like _last_init_line:
+        # it names a COMPLETED checkpoint, healing's restore target.
+        cls._clear_bg_ckpt()
         cls._comm = None
         cls._pm = None
         cls._world = 0
@@ -447,6 +478,12 @@ class DistributedMagics(Magics):
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
         DistributedMagics._world = num_workers
+        if DistributedMagics._last_init_line != line:
+            # A DIFFERENT world configuration invalidates the previous
+            # world's checkpoint as an auto-heal restore target (its
+            # rank layout / model state need not fit this world).  A
+            # same-line re-init — the heal replay path — keeps it.
+            DistributedMagics._last_ckpt_path = None
         DistributedMagics._last_init_line = line
         self._enable_auto_mode()
         print(_BANNER.format(n=num_workers,
@@ -494,15 +531,214 @@ class DistributedMagics(Magics):
                 return
         print(f"🩹 healing: dead ranks {dead if dead else '(world down)'}"
               f" — rebuilding with: %dist_init {replay}")
+        sup = DistributedMagics._supervisor  # survives a manual heal
         self.shutdown_all()
         self._nuclear_shutdown()
         self.dist_init(replay)
         if not self._running():
             print("❌ heal failed: the replayed %dist_init did not "
                   "bring the world up")
+            if sup is not None and not sup.on_own_thread():
+                print("⚠️ supervision was stopped by this heal and is "
+                      "now OFF — %dist_supervise on after recovery")
             return
         if args.restore:
             self.dist_restore(args.restore)
+        if sup is not None and not sup.on_own_thread():
+            # Manual heal with supervision active: re-bind the
+            # supervisor to the fresh world (shutdown_all stopped it).
+            # The supervisor-driven path re-binds itself from the heal
+            # callback's return value instead.
+            sup.attach(self._comm, self._pm)
+            DistributedMagics._supervisor = sup
+
+    # ==================================================================
+    # resilience: auto-heal supervision + fault injection
+
+    def _supervised_heal(self):
+        """Heal callback the supervisor runs on worker death: replay
+        the recorded %dist_init, restore the last checkpoint (when one
+        was taken), hand the fresh (comm, pm) back for re-binding."""
+        line = ""
+        ckpt = DistributedMagics._last_ckpt_path
+        if ckpt:
+            # Verbatim, NOT shlex-quoted: IPython's arg_split keeps
+            # quote characters inside the token (non-posix), so
+            # _last_ckpt_path already holds exactly the token the user
+            # typed (quotes and all, e.g. '"my ckpt"').  Re-emitting it
+            # unchanged reproduces the same token — and the same rank
+            # directories — through dist_heal's parse; adding a quoting
+            # layer would become part of the path and miss the files.
+            line = f"--restore {ckpt}"
+        print("\n🛡  supervisor: auto-healing...")
+        self.dist_heal(line)
+        if not self._running():
+            raise RuntimeError("auto-heal failed: the replayed "
+                               "%dist_init did not bring the world up")
+        return DistributedMagics._comm, DistributedMagics._pm
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["on", "off", "status"])
+    @argument("--max-restarts", type=int, default=3,
+              help="restart budget inside --window seconds")
+    @argument("--window", type=float, default=600.0,
+              help="restart-budget window in seconds")
+    @argument("--degraded-after", type=float, default=6.0,
+              help="heartbeat staleness (s) before a rank is flagged "
+                   "degraded (slow/wedged — NOT restarted)")
+    @argument("--no-auto", action="store_true",
+              help="observe and log transitions only; never heal")
+    @line_magic
+    def dist_supervise(self, line):
+        """Auto-heal supervisor: watches process deaths + heartbeat
+        staleness; on death, automatically replays %dist_init and
+        restores the last %dist_checkpoint, within a capped restart
+        budget.  ``%dist_supervise on [knobs] | off | status``; every
+        transition also shows in %dist_status."""
+        from ..resilience.supervisor import Supervisor, SupervisorPolicy
+        args = parse_argstring(self.dist_supervise, line)
+        sup = DistributedMagics._supervisor
+        if args.command == "off":
+            if sup is None:
+                print("supervisor: not running")
+                return
+            sup.stop()
+            DistributedMagics._supervisor = None
+            print("✅ supervisor stopped")
+            return
+        if args.command == "status":
+            if sup is None:
+                print("supervisor: not running (%dist_supervise on)")
+            else:
+                print(sup.describe())
+            return
+        if not self._require_cluster():
+            return
+        if sup is not None:
+            sup.stop()
+        policy = SupervisorPolicy(
+            degraded_after_s=args.degraded_after,
+            max_restarts=args.max_restarts,
+            restart_window_s=args.window,
+            auto_heal=not args.no_auto)
+        sup = Supervisor(policy, heal=self._supervised_heal)
+        sup.attach(self._comm, self._pm)
+        DistributedMagics._supervisor = sup
+        print(f"✅ supervising {self._world} workers: auto-heal "
+              f"{'ON' if policy.auto_heal else 'OFF'}, budget "
+              f"{policy.max_restarts} restarts/{policy.restart_window_s:.0f}s, "
+              f"degraded after {policy.degraded_after_s:.0f}s silence"
+              + ("" if DistributedMagics._last_ckpt_path else
+                 " · no checkpoint yet — heal will restore nothing "
+                 "(%dist_checkpoint to protect state)"))
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["on", "off", "status"])
+    @argument("--seed", type=int, default=0,
+              help="fault plan seed (same seed = same fault sequence)")
+    @argument("--drop", type=float, default=0.0,
+              help="probability a control frame is dropped")
+    @argument("--delay-p", type=float, default=0.0, dest="delay_p",
+              help="probability a frame is delayed by --delay-s")
+    @argument("--delay-s", type=float, default=0.02, dest="delay_s")
+    @argument("--duplicate", type=float, default=0.0,
+              help="probability a frame is sent twice")
+    @argument("--truncate", type=float, default=0.0,
+              help="probability a frame is cut mid-write "
+                   "(connection-fatal: exercises death handling)")
+    @argument("--freeze-heartbeats", action="store_true",
+              help="stop worker pings (exercises degraded detection)")
+    @argument("--kill-rank", type=int, default=None,
+              help="SIGKILL this rank ...")
+    @argument("--kill-at", type=int, default=None,
+              help="... at this received-message index (1 = next)")
+    @argument("--side", default="both",
+              choices=["coordinator", "worker", "both"],
+              help="which send path(s) inject frame faults")
+    @line_magic
+    def dist_chaos(self, line):
+        """Deterministic fault injection on the live control plane:
+        ``%dist_chaos on --drop 0.1 --seed 7`` / ``off`` / ``status``.
+        The same knobs drive CI via the NBD_FAULT_PLAN env spec; pair
+        with retries (NBD_RETRY_TIMEOUT_S) and %dist_supervise to
+        rehearse preemption recovery in a notebook."""
+        from ..resilience.faults import FaultPlan
+        args = parse_argstring(self.dist_chaos, line)
+        if not self._require_cluster():
+            return
+        if args.command == "off":
+            self._comm.set_fault_plan(None)
+            try:
+                resps = self._comm.send_to_all(
+                    "chaos", {"action": "clear"}, timeout=30)
+                for r in sorted(resps):
+                    c = resps[r].data.get("counters")
+                    if c:
+                        print(f"🔹 rank {r} injected: {c}")
+            except Exception as e:
+                print(f"⚠️ worker-side clear failed: {e}")
+            print("✅ chaos off")
+            return
+        if args.command == "status":
+            plan = self._comm.fault_plan()
+            print(f"coordinator side: "
+                  f"{plan.counters if plan else 'off'}")
+            try:
+                resps = self._comm.send_to_all(
+                    "chaos", {"action": "status"}, timeout=30)
+                for r in sorted(resps):
+                    d = resps[r].data
+                    print(f"🔹 rank {r}: {d.get('status')} "
+                          f"counters={d.get('counters')} "
+                          f"dedup_hits={d.get('dedup_hits')}")
+            except Exception as e:
+                print(f"⚠️ worker-side status failed: {e}")
+            return
+        # Reconfiguring while chaos is active: clear the coordinator
+        # plan FIRST (like the 'off' path) so the arming broadcast
+        # below doesn't have to fight the outgoing fault schedule it
+        # replaces.  (The workers' old plans still apply to the acks —
+        # that side is inherently chaotic until the new spec lands.)
+        self._comm.set_fault_plan(None)
+        spec = {"seed": args.seed, "drop": args.drop,
+                "delay_p": args.delay_p, "delay_s": args.delay_s,
+                "duplicate": args.duplicate, "truncate": args.truncate,
+                "freeze_heartbeat": args.freeze_heartbeats}
+        kill_armed = (args.kill_rank is not None
+                      and args.side in ("worker", "both"))
+        if args.kill_rank is not None and not kill_armed:
+            print("⚠️ --kill-rank ignored: the kill arms on workers, "
+                  "but --side coordinator never ships them a plan")
+        if args.freeze_heartbeats and args.side == "coordinator":
+            print("⚠️ --freeze-heartbeats ignored: only the worker "
+                  "heartbeat loop consults it, but --side coordinator "
+                  "never ships workers a plan")
+        if args.side in ("worker", "both"):
+            wspec = dict(spec)
+            if kill_armed:
+                wspec["kill_rank"] = args.kill_rank
+                wspec["kill_at"] = args.kill_at or 1
+            try:
+                self._comm.send_to_all("chaos", {"action": "set",
+                                                 "spec": wspec},
+                                       timeout=30)
+            except Exception as e:
+                print(f"❌ arming worker-side chaos failed: {e}")
+                return
+        if args.side in ("coordinator", "both"):
+            # Different stream than the workers' (offset seed) so the
+            # two directions don't mirror each other's decisions.
+            cspec = dict(spec)
+            cspec["seed"] = args.seed + 1
+            self._comm.set_fault_plan(FaultPlan.from_spec(cspec))
+        warn = (" · ⚠ no retry policy on this manager — lost frames "
+                "only surface as timeouts"
+                if not self._comm.retry.enabled() else "")
+        print(f"💥 chaos ON ({args.side}): {spec}"
+              + (f" · kill rank {args.kill_rank} at msg "
+                 f"{args.kill_at or 1}" if kill_armed else "") + warn)
 
     # ==================================================================
     # execution magics
@@ -714,6 +950,12 @@ class DistributedMagics(Magics):
                 if seen is not None:
                     line_txt += f" · seen {time.time() - seen:.1f}s ago"
             print(line_txt)
+        sup = DistributedMagics._supervisor
+        if sup is not None:
+            print(sup.describe())
+        plan = self._comm.fault_plan() if self._comm is not None else None
+        if plan is not None:
+            print(f"💥 chaos active (coordinator side): {plan.counters}")
 
     @magic_arguments()
     @argument("--ranks", default=None,
@@ -949,6 +1191,23 @@ class DistributedMagics(Magics):
                                 d.get("summary", {}).values())
                     extra = f" ({total / 1e6:.1f} MB)"
                 print(f"🔹 Rank {r}: {state}{extra}")
+            if DistributedMagics._bg_ckpt_path is not None:
+                for r, m in resps.items():
+                    if m.data.get("error"):
+                        # A failed rank save disqualifies the whole
+                        # checkpoint as a heal target.
+                        DistributedMagics._clear_bg_ckpt()
+                        break
+                    if m.data.get("status") == "done":
+                        DistributedMagics._bg_ckpt_done.add(r)
+                if (DistributedMagics._bg_ckpt_path is not None
+                        and DistributedMagics._bg_ckpt_done
+                        >= set(range(self._world))):
+                    # Every rank finished cleanly: the background save
+                    # is now a valid auto-heal restore target.
+                    DistributedMagics._last_ckpt_path = \
+                        DistributedMagics._bg_ckpt_path
+                    DistributedMagics._clear_bg_ckpt()
             return
         if not args.path or not args.names:
             print("usage: %dist_checkpoint <path> <names...> "
@@ -970,7 +1229,20 @@ class DistributedMagics(Magics):
             prev = resps[r].data.get("previous_error")
             if prev:
                 print(f"⚠️  Rank {r}: {prev}")
-        self._report_checkpoint(resps, verb)
+        if self._report_checkpoint(resps, verb):
+            # The supervisor restores the most recent COMPLETED
+            # checkpoint after an auto-heal respawn; a background save
+            # only qualifies once a --status poll shows every rank done.
+            if args.background:
+                DistributedMagics._bg_ckpt_path = args.path
+                DistributedMagics._bg_ckpt_done = set()
+            else:
+                DistributedMagics._last_ckpt_path = args.path
+                # This sync save is now the newest completed
+                # checkpoint: drop any older background save still
+                # pending promotion, or a later --status poll would
+                # overwrite the heal target with stale state.
+                DistributedMagics._clear_bg_ckpt()
 
     @magic_arguments()
     @argument("path", help="checkpoint directory written by "
@@ -1149,6 +1421,17 @@ class DistributedMagics(Magics):
     def shutdown_all(cls) -> None:
         """Polite tier: control-plane shutdown broadcast, then process
         teardown (reference: magic.py:1005-1036)."""
+        sup = cls._supervisor
+        if sup is not None and not sup.on_own_thread():
+            # A user-initiated shutdown ends supervision; when the
+            # SUPERVISOR is the caller (mid-heal, tearing down the old
+            # world before respawning), it must stay alive.
+            sup.stop()
+            cls._supervisor = None
+        # An in-flight background save dies with its world; its
+        # per-rank doneness must not leak into the next world and
+        # promote a half-written checkpoint as the heal target.
+        cls._clear_bg_ckpt()
         if cls._pm is not None:
             cls._pm.quiesce()  # planned exits are not deaths
         if cls._comm is not None:

@@ -110,6 +110,15 @@ class ProcessManager:
         monitor thread."""
         self._death_callbacks.append(cb)
 
+    def remove_death_callback(self, cb: Callable[[int, int | None], None]) \
+            -> None:
+        """Detach a callback registered above (no-op if absent) — a
+        stopped supervisor must not keep receiving death reports."""
+        try:
+            self._death_callbacks.remove(cb)
+        except ValueError:
+            pass
+
     def start_workers(self, num_workers: int, control_port: int, *,
                       backend: str = "auto", coordinator_host: str = "127.0.0.1",
                       chips_per_worker: int = 1,

@@ -83,6 +83,11 @@ class Message:
     msg_id: str = field(default_factory=lambda: uuid.uuid4().hex)
     timestamp: float = field(default_factory=time.time)
     bufs: dict[str, Any] = field(default_factory=dict)  # name -> ndarray | bytes
+    # Delivery attempt, 0 = first send.  A retried request goes out
+    # under the SAME msg_id with a bumped attempt, so the worker's
+    # replay cache recognizes it and the wire shows which delivery a
+    # frame belongs to (debugging dropped-frame chaos runs).
+    attempt: int = 0
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
@@ -117,6 +122,10 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
         "rank": msg.rank,
         "ts": msg.timestamp,
     }
+    if msg.attempt:
+        # Only on redeliveries: first-send frames stay byte-identical
+        # to the pre-retry wire format.
+        header["at"] = msg.attempt
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -195,6 +204,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         msg_id=header["id"],
         timestamp=header["ts"],
         bufs=bufs,
+        attempt=header.get("at", 0),
     )
 
 

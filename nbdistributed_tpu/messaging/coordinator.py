@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..resilience.retry import RetryPolicy
 from .codec import Message
 from .native import make_listener
 from .transport import TransportError
@@ -50,10 +51,17 @@ class CommunicationManager:
 
     def __init__(self, num_workers: int, *, host: str = "127.0.0.1",
                  port: int = 0, timeout: float | None = None,
-                 allow_pickle: bool = True, auth_token: str | None = None):
+                 allow_pickle: bool = True, auth_token: str | None = None,
+                 retry: RetryPolicy | None = None):
         self.num_workers = num_workers
         self.default_timeout = timeout  # None = wait forever (training mode)
         self.auth_token = auth_token
+        # Redelivery policy for slow/lost responses (resilience/retry):
+        # explicit argument > NBD_RETRY_* env > disabled (the exact
+        # pre-retry single-attempt behavior).
+        self.retry = (retry if retry is not None
+                      else RetryPolicy.from_env() or RetryPolicy())
+        self.retries_sent = 0  # redeliveries actually transmitted
         # Native C++ listener when built (see messaging/native.py), the
         # pure-Python selector listener otherwise — same protocol.
         self._listener = make_listener(host=host, port=port,
@@ -86,6 +94,15 @@ class CommunicationManager:
         """Register a sink for unsolicited non-stream messages
         (heartbeats, profiler events, timeline marks)."""
         self._notify_callbacks.append(cb)
+
+    def set_fault_plan(self, plan) -> None:
+        """Install (or clear, with ``None``) a chaos
+        :class:`~nbdistributed_tpu.resilience.faults.FaultPlan` on the
+        coordinator→worker send path."""
+        self._listener.fault_plan = plan
+
+    def fault_plan(self):
+        return getattr(self._listener, "fault_plan", None)
 
     # ------------------------------------------------------------------
     # readiness / liveness
@@ -150,6 +167,15 @@ class CommunicationManager:
 
         ``timeout=...`` (unset) uses the manager default; ``None`` waits
         forever — but still aborts if an expected worker dies.
+
+        With a retry policy enabled (``retry=`` / ``NBD_RETRY_*``), a
+        request whose responses are slower than the per-attempt timeout
+        is REDELIVERED to the still-missing ranks under the same msg_id
+        with exponential backoff + jitter — the worker's replay cache
+        makes redelivery idempotent, so a lost request or lost reply
+        costs one backoff interval instead of the whole deadline.  The
+        caller's ``timeout`` stays the total budget; the final attempt
+        waits out whatever remains of it (forever when ``None``).
         """
         if timeout is ...:
             timeout = self.default_timeout
@@ -164,15 +190,47 @@ class CommunicationManager:
             with self._lock:
                 del self._pending[msg.msg_id]
             raise WorkerDied(f"workers {sorted(already_dead)} are dead")
+        policy = self.retry
+        attempts = policy.attempts if policy.enabled() else 1
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         try:
             self._listener.send_to_ranks(list(ranks), msg)
-            if not pending.event.wait(timeout):
+            complete = False
+            for attempt in range(1, attempts + 1):
+                if attempt > 1:
+                    # Redeliver to the stragglers only, same msg_id.
+                    with self._lock:
+                        missing_now = sorted(pending.expect
+                                             - set(pending.responses))
+                    msg.attempt = attempt - 1
+                    try:
+                        self._listener.send_to_ranks(missing_now, msg)
+                        self.retries_sent += 1
+                    except TransportError:
+                        pass  # disconnected rank: death callback aborts us
+                if attempt == attempts:
+                    step = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                else:
+                    step = policy.attempt_wait_s(attempt - 1)
+                    if deadline is not None:
+                        step = min(step,
+                                   max(0.0, deadline - time.monotonic()))
+                complete = pending.event.wait(step)
+                if complete:
+                    break
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    break
+            if not complete:
                 with self._lock:  # IO thread inserts under the same lock
                     got = set(pending.responses)
                 missing = sorted(pending.expect - got)
                 raise TimeoutError(
                     f"no response from ranks {missing} within {timeout}s "
-                    f"for '{msg_type}'")
+                    f"for '{msg_type}'"
+                    + (f" ({attempts} deliveries)" if attempts > 1 else ""))
             if pending.failure is not None:
                 raise pending.failure
             with self._lock:

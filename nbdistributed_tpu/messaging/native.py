@@ -130,6 +130,10 @@ class NativeCoordinatorListener:
         self.on_message = lambda r, m: None
         self.on_connect = lambda r: None
         self.on_disconnect = lambda r: None
+        # Chaos hook (resilience/faults.py) — applied in this Python
+        # wrapper so fault injection behaves identically over the C++
+        # and pure-Python transports.
+        self.fault_plan = None
 
     def start(self) -> None:
         self._running = True
@@ -155,19 +159,32 @@ class NativeCoordinatorListener:
 
     def send_to_rank(self, rank: int, msg) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)
-        self._send_frame(rank, frame)
+        self._send_frame(rank, frame, msg.msg_type)
 
     def send_to_ranks(self, ranks: list[int], msg) -> None:
         from .transport import TransportError
         frame = encode(msg, allow_pickle=self._allow_pickle)
-        missing = [r for r in ranks if self._try_send(r, frame) != 0]
+        missing = [r for r in ranks
+                   if self._transmit(r, frame, msg.msg_type) != 0]
         if missing:
             raise TransportError(f"ranks {missing} are not connected")
 
-    def _send_frame(self, rank: int, frame: bytes) -> None:
+    def _send_frame(self, rank: int, frame: bytes, kind: str) -> None:
         from .transport import TransportError
-        if self._try_send(rank, frame) != 0:
+        if self._transmit(rank, frame, kind) != 0:
             raise TransportError(f"rank {rank} is not connected")
+
+    def _transmit(self, rank: int, frame: bytes, kind: str) -> int:
+        plan = self.fault_plan
+        if plan is None:
+            return self._try_send(rank, frame)
+        rcs: list[int] = []
+        plan.transmit(frame, lambda f: rcs.append(self._try_send(rank, f)),
+                      kind=kind)
+        # A dropped frame never touched the socket: report success —
+        # under chaos, loss is the point, and the retry layer owns
+        # recovery.
+        return rcs[-1] if rcs else 0
 
     def _try_send(self, rank: int, frame: bytes) -> int:
         if not self._handle:

@@ -178,6 +178,10 @@ class CoordinatorListener:
         self.on_message: Callable[[int, Message], None] = lambda r, m: None
         self.on_connect: Callable[[int], None] = lambda r: None
         self.on_disconnect: Callable[[int], None] = lambda r: None
+        # Chaos hook (resilience/faults.py): when set, every outgoing
+        # frame passes through the plan, which may drop/delay/
+        # duplicate/truncate it deterministically.  None in production.
+        self.fault_plan = None
         # wake-up pipe so close() interrupts select()
         self._wake_r, self._wake_w = socket.socketpair()
 
@@ -220,12 +224,21 @@ class CoordinatorListener:
         with self._lock:
             return sorted(self._conns)
 
+    def _transmit(self, conn: "_ConnState", frame: bytes,
+                  kind: str) -> None:
+        plan = self.fault_plan
+        if plan is not None:
+            plan.transmit(frame, conn.send_frame, kind=kind)
+        else:
+            conn.send_frame(frame)
+
     def send_to_rank(self, rank: int, msg: Message) -> None:
         with self._lock:
             conn = self._conns.get(rank)
         if conn is None:
             raise TransportError(f"rank {rank} is not connected")
-        conn.send_frame(encode(msg, allow_pickle=self._allow_pickle))
+        self._transmit(conn, encode(msg, allow_pickle=self._allow_pickle),
+                       msg.msg_type)
 
     def send_to_ranks(self, ranks: list[int], msg: Message) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)
@@ -236,7 +249,7 @@ class CoordinatorListener:
             if conn is None:
                 missing.append(r)
             else:
-                conn.send_frame(frame)
+                self._transmit(conn, frame, msg.msg_type)
         if missing:
             raise TransportError(f"ranks {missing} are not connected")
 
@@ -377,15 +390,28 @@ class WorkerChannel:
         _set_keepalive(self._sock)
         self._wlock = threading.Lock()
         self._rbuf = bytearray()
+        # Chaos hook (resilience/faults.py), mirroring the listener's:
+        # outgoing frames (replies, stream output, pings) pass through
+        # the plan when set.  The HELLO preamble below deliberately
+        # bypasses it — an unattached worker is a bring-up problem, not
+        # a chaos scenario.
+        self.fault_plan = None
         with self._wlock:
             # The authenticated preamble variant when the coordinator
             # requires the shared secret (non-loopback binds).
             self._sock.sendall(make_preamble(rank, auth_token))
 
-    def send(self, msg: Message) -> None:
-        frame = encode(msg, allow_pickle=self._allow_pickle)
+    def _send_frame(self, frame: bytes) -> None:
         with self._wlock:
             self._sock.sendall(frame)
+
+    def send(self, msg: Message) -> None:
+        frame = encode(msg, allow_pickle=self._allow_pickle)
+        plan = self.fault_plan
+        if plan is not None:
+            plan.transmit(frame, self._send_frame, kind=msg.msg_type)
+        else:
+            self._send_frame(frame)
 
     def recv(self, timeout: float | None = None, *,
              gate=None) -> Message:

@@ -286,6 +286,17 @@ def _qlinear4(x, w):
     with the (G, d_out) scales — one extra small reduction on the
     activation side, nothing extra on the weight side."""
     q4, s = w["q4"], w["s"]
+    if q4.ndim != 2:
+        # quantize_weight4 supports stacked leaves (e.g. the
+        # (n_layers, ...) scanned-layers tree), but this contraction
+        # is written for one 2D weight — the reshape below would fold
+        # the leading dims into G and fail with an opaque size
+        # mismatch (or worse, silently contract wrong axes).
+        raise ValueError(
+            f"qlinear on a stacked int4 leaf (q4 shape "
+            f"{tuple(q4.shape)}): expected a 2D (d_in/2, d_out) "
+            f"weight — index or scan over the leading "
+            f"{q4.ndim - 2} dim(s) and apply qlinear per slice")
     d_in, d_out = q4.shape[-2] * 2, q4.shape[-1]
     G = s.shape[-3]
     group = d_in // G
@@ -552,6 +563,33 @@ def packed_positions(segment_ids):
     return pos - seg_start
 
 
+def _head_vocab_sharded(head) -> bool:
+    """Best-effort: is this lm_head leaf sharded on its vocab (last)
+    axis by a >1-way mesh axis?  Catches the plain-TP layout
+    (``device_put`` with ``P(None, "tp")``, no SeqParallel object)
+    whose sharding the ``sp``-based check below cannot see.  Only
+    concrete arrays expose a committed ``NamedSharding``; under jit
+    tracing or for quantized dict leaves detection is impossible and
+    this returns False (the documented contract — don't set
+    ``ce_chunk`` under plain tp — still applies there)."""
+    try:
+        spec = head.sharding.spec
+        mesh_shape = dict(head.sharding.mesh.shape)
+        ndim = head.ndim
+    except Exception:
+        return False
+    if len(spec) < ndim:
+        return False  # trailing (vocab) axis unmentioned = replicated
+    entry = spec[ndim - 1]
+    if entry is None:
+        return False
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for a in axes:
+        size *= mesh_shape.get(a, 1)
+    return size > 1
+
+
 def loss_fn(params, batch, cfg: TransformerConfig,
             sp: SeqParallel | None = None):
     """Next-token cross-entropy.  batch: {tokens (B,S)}; predicts
@@ -578,6 +616,19 @@ def loss_fn(params, batch, cfg: TransformerConfig,
     tp_sharded_head = (
         sp is not None and sp.tp_axis is not None
         and dict(getattr(sp.mesh, "shape", {})).get(sp.tp_axis, 1) > 1)
+    if (not tp_sharded_head and cfg.ce_chunk is not None
+            and _head_vocab_sharded(params["lm_head"])):
+        # Plain-TP trap (ADVICE r5): a vocab-sharded head reached the
+        # chunked path without an sp object — slicing it chunk-wise
+        # would make GSPMD re-gather the whole head every scan step,
+        # silently destroying the memory win.  Fall back loudly.
+        import warnings
+        warnings.warn(
+            "ce_chunk ignored: lm_head is vocab-sharded (plain tensor "
+            "parallelism) — the chunked tail would re-gather the head "
+            "every scan step; using the standard tp-sharded tail "
+            "instead", stacklevel=2)
+        tp_sharded_head = True
     if (cfg.ce_chunk is not None and not tp_sharded_head
             and not is_quantized(params["lm_head"])
             and not is_quantized4(params["lm_head"])):

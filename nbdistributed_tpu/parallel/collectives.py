@@ -32,6 +32,7 @@ from typing import Any
 import numpy as np
 
 from ..runtime.collective_guard import check as _guard_check
+from ..utils.compat import shard_map as _shard_map
 
 
 def _jax():
@@ -103,7 +104,7 @@ def _reduce_fn(mesh, prim_name: str):
     prim = getattr(jax.lax, prim_name)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+    @functools.partial(_shard_map, mesh=mesh, in_specs=P("proc"),
                        out_specs=P())
     def f(a):
         # Each device holds one copy on the leading axis; drop it, then
@@ -122,7 +123,7 @@ def _gather_fn(mesh):
     # check_vma off: all_gather's output is replicated over "proc" but the
     # static varying-axes analysis cannot prove it.
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+    @functools.partial(_shard_map, mesh=mesh, in_specs=P("proc"),
                        out_specs=P(), check_vma=False)
     def f(a):
         return jax.lax.all_gather(a[0], "proc")
@@ -222,7 +223,7 @@ def _reduce_scatter_fn(mesh):
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+    @functools.partial(_shard_map, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
     def f(a):
         return jax.lax.psum_scatter(a[0], "proc", scatter_dimension=0,
@@ -273,7 +274,7 @@ def _quantized_all_reduce_fn(mesh, block: int):
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+    @functools.partial(_shard_map, mesh=mesh, in_specs=P("proc"),
                        out_specs=P(), check_vma=False)
     def f(a):
         shard = jax.lax.psum_scatter(a[0], "proc", scatter_dimension=0,

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.compat import shard_map
+
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
                     dtype=jnp.bfloat16) -> dict:
@@ -335,7 +337,7 @@ def _dropless_ffn_ep(xt, params, logits, top_k: int, E: int, mesh,
             rows * wgt[:, None])
         return jax.lax.psum(y, ep_axis)                   # combine
 
-    return jax.shard_map(
+    return shard_map(
         local_ffn, mesh=mesh,
         in_specs=(P(tok_entry, None), P(tok_entry, None),
                   P(tok_entry),

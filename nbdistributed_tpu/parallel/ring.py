@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ..utils.compat import shard_map
 
 _NEG_INF = -1e30
 
@@ -75,7 +76,7 @@ def _ring_fn(mesh, axis: str, causal: bool, scale: float,
         # Segment ids are per (batch, position): sequence-sharded like
         # q, replicated over heads.
         in_specs = in_specs + (P(batch_axis, axis),)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         inner, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False))
 

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ..utils.compat import shard_map
 
 
 @functools.lru_cache(maxsize=None)
@@ -48,7 +49,7 @@ def _ulysses_fn(mesh, axis: str, causal: bool, scale: float,
     in_specs = (spec, spec, spec)
     if with_segments:
         in_specs = in_specs + (P(batch_axis, axis),)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         inner, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False))
 

@@ -1,0 +1,40 @@
+"""Fault injection + self-healing for the control plane (L2/L3).
+
+The reference assumes a well-behaved cluster: the coordinator
+fail-fasts on ``WorkerDied`` and recovery is a *manual* ``%dist_heal``
+replay.  Real TPU fleets see preemptions, slow hosts, and flaky DCN
+links — pod-scale work treats preemption-tolerance and supervised
+re-attachment as table stakes ("Exploring the limits of Concurrency in
+ML Training on Google TPUs"; the Podracer architectures).  This package
+makes failures *injectable deterministically* in CI and *survivable
+automatically* at runtime:
+
+- :mod:`~nbdistributed_tpu.resilience.faults` — a seeded
+  :class:`FaultPlan` that drops / delays / duplicates / truncates
+  control-plane frames, freezes heartbeats, and SIGKILLs a chosen rank
+  at a chosen message index.  Hooked into the transport send paths and
+  the worker loop; enabled via the ``NBD_FAULT_PLAN`` env knob or the
+  ``%dist_chaos`` magic.
+- :mod:`~nbdistributed_tpu.resilience.retry` — :class:`RetryPolicy`:
+  per-request deadlines with exponential backoff + jitter redelivery
+  for ``CommunicationManager.send_to_ranks``.
+- :mod:`~nbdistributed_tpu.resilience.dedup` — :class:`ReplayCache`:
+  the worker-side bounded reply cache that makes request redelivery
+  idempotent (a retried ``execute`` is never double-executed).
+- :mod:`~nbdistributed_tpu.resilience.supervisor` —
+  :class:`Supervisor`: consumes process-death callbacks + heartbeat
+  staleness, distinguishes *degraded* from *dead*, and auto-heals
+  (replay ``%dist_init`` + restore the last checkpoint) under a capped
+  restart budget.
+
+Everything here is stdlib-only (no JAX import) so the coordinator side
+stays light and the modules are unit-testable without a backend.
+"""
+
+from .dedup import ReplayCache
+from .faults import FaultPlan
+from .retry import RetryPolicy
+from .supervisor import Supervisor, SupervisorPolicy
+
+__all__ = ["FaultPlan", "ReplayCache", "RetryPolicy", "Supervisor",
+           "SupervisorPolicy"]

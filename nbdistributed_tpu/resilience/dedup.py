@@ -1,0 +1,102 @@
+"""Worker-side reply replay cache: idempotent request redelivery.
+
+The retry layer (``RetryPolicy`` in ``CommunicationManager``) resends
+a request under the SAME message id when responses are slow — which is
+indistinguishable, at the worker, from a duplicated frame on a flaky
+link.  Either way the request must not run twice: a redelivered
+``execute`` re-running user code would double-apply optimizer steps,
+re-append to lists, double-increment counters — silent state
+corruption.  The worker therefore remembers the replies it already
+sent, keyed by message id, and answers a redelivered request from the
+cache.
+
+Bounded three ways:
+
+- **entries** (LRU): retries target recent requests; anything older
+  than ``capacity`` requests ago can no longer be in flight.
+- **oversized read-only replies** are not cached at all: re-running a
+  ``get_var``/``get_status`` on a redelivered frame is semantically
+  safe (the handler only reads), so pinning a multi-GB params pull is
+  pointless.
+- **total bytes**: mutating request types (``execute``, ``set_var``,
+  ``checkpoint``, ``sync``) must stay cached whole — re-running them
+  is exactly the corruption this cache prevents — but their
+  accumulated size (e.g. cells whose last expression reprs to tens of
+  MB) is capped by evicting from the LRU end down to
+  ``max_total_bytes``, always keeping the ``min_keep`` most recent
+  replies (the only ones a live retry can still target).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+# Request types whose handlers only READ state: re-running one on a
+# redelivered frame is semantically safe, so their (potentially huge)
+# replies may be skipped / evicted by the byte bounds.
+_READ_ONLY = frozenset({"get_var", "get_namespace_info", "get_status"})
+
+
+def _reply_bytes(reply) -> int:
+    total = 0
+    for v in getattr(reply, "bufs", {}).values():
+        total += getattr(v, "nbytes", None) or len(v)
+    data = getattr(reply, "data", None)
+    if isinstance(data, (str, bytes)):
+        total += len(data)
+    elif isinstance(data, dict):
+        # Reply data is a small JSON-able dict; the only large member
+        # in practice is execute's "output"/"traceback" repr strings.
+        total += sum(len(v) for v in data.values()
+                     if isinstance(v, (str, bytes)))
+    return total
+
+
+class ReplayCache:
+    """msg_id -> already-sent reply, bounded LRU.  Single-consumer by
+    design: only the worker's serial request loop touches it."""
+
+    def __init__(self, capacity: int = 128,
+                 max_buf_bytes: int = 8 << 20,
+                 max_total_bytes: int = 64 << 20, min_keep: int = 8):
+        self.capacity = capacity
+        self.max_buf_bytes = max_buf_bytes
+        self.max_total_bytes = max_total_bytes
+        self.min_keep = min_keep
+        self._cache: OrderedDict[str, object] = OrderedDict()
+        self._sizes: dict[str, int] = {}
+        self._total = 0
+        self.hits = 0       # redeliveries answered from cache
+        self.stores = 0
+
+    def get(self, msg_id: str):
+        reply = self._cache.get(msg_id)
+        if reply is not None:
+            self.hits += 1
+            self._cache.move_to_end(msg_id)
+        return reply
+
+    def put(self, request, reply) -> bool:
+        """Record the reply just sent for ``request``.  Returns whether
+        it was cached (False only for oversized read-only replies)."""
+        size = _reply_bytes(reply)
+        if request.msg_type in _READ_ONLY and size > self.max_buf_bytes:
+            return False
+        self._cache[request.msg_id] = reply
+        self._cache.move_to_end(request.msg_id)
+        self._total += size - self._sizes.get(request.msg_id, 0)
+        self._sizes[request.msg_id] = size
+        while (len(self._cache) > self.capacity
+               or (self._total > self.max_total_bytes
+                   and len(self._cache) > self.min_keep)):
+            evicted, _ = self._cache.popitem(last=False)
+            self._total -= self._sizes.pop(evicted, 0)
+        self.stores += 1
+        return True
+
+    @property
+    def total_bytes(self) -> int:
+        return self._total
+
+    def __len__(self) -> int:
+        return len(self._cache)

@@ -1,0 +1,178 @@
+"""Seeded, deterministic fault injection for the control plane.
+
+A :class:`FaultPlan` sits on a transport send path (``WorkerChannel.
+send`` / ``CoordinatorListener.send_to_ranks`` / the native listener's
+Python wrapper) and decides, per outgoing frame, whether to drop,
+delay, duplicate, or truncate it — plus two process-level faults the
+worker loop consults directly: heartbeat freeze and SIGKILL at a
+chosen message index.
+
+Determinism is the design center: every per-frame decision is a pure
+function of ``(seed, frame index)``, so a fixed seed replays the exact
+same fault sequence as long as the frame order is deterministic.  To
+keep it deterministic in practice, frames whose message type is in
+``exempt`` (heartbeat ``ping`` by default — the heartbeat thread's
+cadence is wall-clock, not program order) bypass the plan without
+consuming an index.
+
+Wire-visible effects map to real failure modes:
+
+- **drop** — lost frame on a flaky link; recovered by the retry layer
+  (requests) or by request redelivery reaching the dedup cache
+  (replies).
+- **delay** — a slow host / congested DCN hop.
+- **duplicate** — retransmission at a lower layer; must be absorbed by
+  the worker's ReplayCache and the coordinator's late-response drop.
+- **truncate** — mid-frame connection tear: the receiver's framer sees
+  garbage, drops the connection, and the death/disconnect machinery
+  must take over (this fault is connection-fatal by design).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable
+
+DEFAULT_EXEMPT = ("ping",)
+
+_SPEC_KEYS = frozenset({
+    "seed", "drop", "delay_p", "delay_s", "duplicate", "truncate",
+    "freeze_heartbeat", "kill_rank", "kill_at", "exempt",
+})
+
+
+class FaultPlan:
+    """One deterministic chaos schedule.  Thread-safe; counters record
+    what actually happened for ``%dist_chaos status`` and assertions."""
+
+    def __init__(self, *, seed: int = 0, drop: float = 0.0,
+                 delay_p: float = 0.0, delay_s: float = 0.02,
+                 duplicate: float = 0.0, truncate: float = 0.0,
+                 freeze_heartbeat: bool = False,
+                 kill_rank: int | None = None, kill_at: int | None = None,
+                 exempt=DEFAULT_EXEMPT):
+        self.seed = int(seed)
+        self.drop = float(drop)
+        self.delay_p = float(delay_p)
+        self.delay_s = float(delay_s)
+        self.duplicate = float(duplicate)
+        self.truncate = float(truncate)
+        self.freeze_heartbeat = bool(freeze_heartbeat)
+        if (kill_rank is None) != (kill_at is None):
+            # Half a kill spec is silently inert (should_kill would
+            # never fire) — the same typo'd-knob failure mode the
+            # unknown-key check below exists to prevent.
+            raise ValueError(
+                f"kill_rank and kill_at must be set together "
+                f"(got kill_rank={kill_rank!r}, kill_at={kill_at!r})")
+        self.kill_rank = kill_rank
+        self.kill_at = kill_at
+        self.exempt = frozenset(exempt or ())
+        self._lock = threading.Lock()
+        self._index = 0
+        self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
+                         "duplicated": 0, "truncated": 0, "exempt": 0}
+
+    # ------------------------------------------------------------------
+    # construction / description
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "FaultPlan":
+        """Build from a JSON-able spec dict (the ``%dist_chaos``
+        broadcast / ``NBD_FAULT_PLAN`` payload).  Unknown keys are an
+        error — a typo'd knob must not silently inject nothing."""
+        if not isinstance(spec, dict):
+            raise TypeError(f"fault spec must be a dict, got "
+                            f"{type(spec).__name__}")
+        unknown = set(spec) - _SPEC_KEYS
+        if unknown:
+            raise ValueError(f"unknown fault spec keys {sorted(unknown)} "
+                             f"(known: {sorted(_SPEC_KEYS)})")
+        return cls(**spec)
+
+    @classmethod
+    def from_env(cls, var: str = "NBD_FAULT_PLAN") -> "FaultPlan | None":
+        raw = os.environ.get(var)
+        if not raw:
+            return None
+        return cls.from_spec(json.loads(raw))
+
+    def spec(self) -> dict:
+        """Round-trippable description (``from_spec(p.spec())`` builds
+        an equivalent plan with fresh counters)."""
+        return {"seed": self.seed, "drop": self.drop,
+                "delay_p": self.delay_p, "delay_s": self.delay_s,
+                "duplicate": self.duplicate, "truncate": self.truncate,
+                "freeze_heartbeat": self.freeze_heartbeat,
+                "kill_rank": self.kill_rank, "kill_at": self.kill_at,
+                "exempt": sorted(self.exempt)}
+
+    # ------------------------------------------------------------------
+    # per-frame decisions
+
+    def decide(self, index: int) -> list[str]:
+        """Actions for frame ``index`` — pure in (seed, index).  Drop
+        and truncate are exclusive terminal outcomes; delay and
+        duplicate compose with a normal send."""
+        rng = random.Random(self.seed * 1_000_003 + index)
+        if self.drop and rng.random() < self.drop:
+            return ["drop"]
+        if self.truncate and rng.random() < self.truncate:
+            return ["truncate"]
+        acts = []
+        if self.delay_p and rng.random() < self.delay_p:
+            acts.append("delay")
+        if self.duplicate and rng.random() < self.duplicate:
+            acts.append("duplicate")
+        return acts
+
+    def transmit(self, frame: bytes, send: Callable[[bytes], None], *,
+                 kind: str | None = None) -> None:
+        """Pass one outgoing frame through the plan.  ``send`` performs
+        the actual (whole-frame) write; it may be called 0, 1, or 2
+        times, or once with a truncated frame."""
+        if kind is not None and kind in self.exempt:
+            with self._lock:
+                self.counters["exempt"] += 1
+            send(frame)
+            return
+        with self._lock:
+            index = self._index
+            self._index += 1
+        acts = self.decide(index)
+        with self._lock:
+            if "drop" in acts:
+                self.counters["dropped"] += 1
+                return
+            if "truncate" in acts:
+                self.counters["truncated"] += 1
+            if "delay" in acts:
+                self.counters["delayed"] += 1
+            if "duplicate" in acts:
+                self.counters["duplicated"] += 1
+            self.counters["sent"] += 1
+        if "truncate" in acts:
+            send(frame[:max(1, len(frame) // 2)])
+            return
+        if "delay" in acts:
+            time.sleep(self.delay_s)
+        send(frame)
+        if "duplicate" in acts:
+            send(frame)
+
+    # ------------------------------------------------------------------
+    # process-level faults (worker loop)
+
+    def heartbeat_frozen(self) -> bool:
+        return self.freeze_heartbeat
+
+    def should_kill(self, rank: int, msg_index: int) -> bool:
+        """SIGKILL trigger: ``rank`` matches and the worker has received
+        at least ``kill_at`` control messages since the plan was
+        installed (``>=`` so a skipped index can never disarm it)."""
+        return (self.kill_rank == rank and self.kill_at is not None
+                and msg_index >= self.kill_at)

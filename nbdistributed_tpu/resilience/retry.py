@@ -1,0 +1,75 @@
+"""Retry policy for control-plane requests.
+
+One :class:`RetryPolicy` describes how ``CommunicationManager.
+send_to_ranks`` redelivers a request whose responses are slow to
+arrive: wait ``attempt_timeout_s``, then resend the SAME message id
+(attempt counter bumped) to the ranks that have not answered yet, with
+exponential backoff + jitter between redeliveries.  Redelivery is safe
+because the worker's :class:`~nbdistributed_tpu.resilience.dedup.
+ReplayCache` makes requests idempotent — a duplicate is answered from
+the cached reply, never re-executed.
+
+Retries are OFF by default (``attempt_timeout_s=None``): in the
+default no-timeout "training mode" a slow cell is indistinguishable
+from a lost frame, and worker death already aborts requests via the
+death callbacks.  They are switched on per-manager (chaos tests,
+flaky-DCN deployments) or fleet-wide via env::
+
+    NBD_RETRY_TIMEOUT_S=5       # per-attempt wait; presence enables
+    NBD_RETRY_ATTEMPTS=4        # total deliveries (1 initial + 3 re)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Redelivery schedule for one request.
+
+    ``attempts`` counts total deliveries (the initial send included).
+    ``attempt_timeout_s=None`` disables redelivery entirely — the
+    request waits out its caller deadline in one attempt, exactly the
+    pre-retry behavior.
+    """
+
+    attempts: int = 4
+    attempt_timeout_s: float | None = None
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 5.0
+    jitter: float = 0.25  # fraction of the backoff, symmetric
+
+    def enabled(self) -> bool:
+        return self.attempt_timeout_s is not None and self.attempts > 1
+
+    def backoff_s(self, attempt: int, u: float | None = None) -> float:
+        """Backoff after delivery ``attempt`` (0-based): exponential,
+        capped, with +-``jitter`` fraction of spread.  ``u`` in [0, 1)
+        pins the jitter draw for deterministic tests."""
+        b = min(self.backoff_max_s,
+                self.backoff_base_s * self.backoff_factor ** attempt)
+        if self.jitter:
+            if u is None:
+                u = random.random()
+            b *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return b
+
+    def attempt_wait_s(self, attempt: int, u: float | None = None) -> float:
+        """How long to wait for responses after delivery ``attempt``
+        before redelivering: the per-attempt timeout plus the backoff
+        (waiting for the reply IS the backoff opportunity — a response
+        arriving during it completes the request immediately)."""
+        return (self.attempt_timeout_s or 0.0) + self.backoff_s(attempt, u)
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy | None":
+        env = os.environ if env is None else env
+        raw = env.get("NBD_RETRY_TIMEOUT_S")
+        if not raw:
+            return None
+        return cls(attempts=max(1, int(env.get("NBD_RETRY_ATTEMPTS", "4"))),
+                   attempt_timeout_s=float(raw))

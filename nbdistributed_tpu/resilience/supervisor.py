@@ -1,0 +1,292 @@
+"""Auto-heal supervisor: from manual ``%dist_heal`` to a control loop.
+
+Consumes the two liveness signals the stack already produces —
+``ProcessManager`` death callbacks (authoritative: the child exited)
+and coordinator-side heartbeat freshness (``last_ping``/``last_seen``)
+— and maintains a per-rank state machine:
+
+    alive ⇄ degraded          (heartbeats stale / resumed — a slow or
+                               wedged host, NOT grounds for restart)
+    alive|degraded → dead     (process exit; only this triggers heal)
+    dead → healing → alive    (auto-heal under the restart budget)
+
+``jax.distributed`` worlds are fixed-membership — a dead rank cannot
+rejoin a live coordination service — so healing is always a FULL
+restart + state restore (replay the recorded ``%dist_init``, restore
+the last checkpoint), never a single-rank rejoin.  The heal callback
+is pluggable: the magic layer wires ``%dist_heal`` replay; tests wire
+a direct cluster rebuild.
+
+The restart budget (``max_restarts`` per ``restart_window_s``) caps
+crash-loops: a world that keeps dying stops being restarted and the
+transition log says so, instead of burning TPU quota respawning a
+broken program forever.  Every transition lands in a bounded event log
+surfaced by ``%dist_status``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+ALIVE = "alive"
+DEGRADED = "degraded"
+DEAD = "dead"
+HEALING = "healing"
+
+
+@dataclass(frozen=True)
+class SupervisorPolicy:
+    degraded_after_s: float = 6.0     # 3 missed heartbeats
+    poll_s: float = 0.5
+    max_restarts: int = 3
+    restart_window_s: float = 600.0
+    auto_heal: bool = True
+
+
+class Supervisor:
+    """One supervision loop over a (comm, pm) pair.
+
+    ``heal()`` — required for auto-heal — must rebuild the world and
+    restore state; it may return a fresh ``(comm, pm)`` pair (the
+    usual case: healing replaces both) which the supervisor rebinds
+    to.  It runs on the supervisor's own thread, never on the process
+    monitor's callback thread.
+    """
+
+    def __init__(self, policy: SupervisorPolicy | None = None, *,
+                 heal=None, clock=time.time):
+        self.policy = policy or SupervisorPolicy()
+        self._heal_fn = heal
+        self._clock = clock
+        self.events: deque[dict] = deque(maxlen=256)
+        self.heals_done = 0
+        self.heals_failed = 0
+        self._state: dict[int, str] = {}
+        self._restarts: deque[float] = deque()
+        self._comm = None
+        self._pm = None
+        self._pm_hooked: int | None = None  # id(pm) with our callback
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._pending_heal = False
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def _hook_pm(self, pm) -> None:
+        """Move the death callback to ``pm`` (lock held).  Detaching
+        from the previous ProcessManager matters even though a healed
+        world's old pm is dying anyway: a stopped-and-reattached cycle
+        on the SAME pm must not accumulate callbacks to retired state."""
+        if self._pm_hooked == id(pm):
+            return
+        old = self._pm
+        if old is not None and self._pm_hooked == id(old):
+            remove = getattr(old, "remove_death_callback", None)
+            if remove is not None:
+                remove(self._on_death)
+        pm.add_death_callback(self._on_death)
+        self._pm_hooked = id(pm)
+
+    def attach(self, comm, pm) -> None:
+        """Bind to a live cluster and start (or resume, after a
+        ``stop()``) supervising."""
+        with self._lock:
+            self._hook_pm(pm)
+            self._comm, self._pm = comm, pm
+            self._state = {r: ALIVE for r in range(comm.num_workers)}
+            self._pending_heal = False
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._wake.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="nbd-supervisor",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            pm = self._pm
+            if pm is not None and self._pm_hooked == id(pm):
+                remove = getattr(pm, "remove_death_callback", None)
+                if remove is not None:
+                    remove(self._on_death)
+            self._pm_hooked = None
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+
+    def on_own_thread(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    # ------------------------------------------------------------------
+    # inputs
+
+    def _on_death(self, rank: int, rc: int | None) -> None:
+        """ProcessManager monitor callback — must not block: record and
+        wake the supervisor thread, which owns the (slow) heal."""
+        with self._lock:
+            if self._state.get(rank) in (DEAD, HEALING):
+                return
+            self._transition(rank, DEAD, f"process exit (code {rc})")
+            self._pending_heal = True
+        self._wake.set()
+
+    def _transition(self, rank, to: str, detail: str = "") -> None:
+        # lock held by caller
+        frm = self._state.get(rank)
+        if frm == to:
+            return
+        if rank is not None:
+            self._state[rank] = to
+        self.events.append({"ts": self._clock(), "rank": rank,
+                            "from": frm, "to": to, "detail": detail})
+
+    # ------------------------------------------------------------------
+    # loop
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.policy.poll_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._scan_staleness()
+                if self._pending_heal and self.policy.auto_heal:
+                    self._heal_once()
+            except Exception:
+                # The supervision loop must survive its own bugs —
+                # a dead supervisor is exactly the failure mode this
+                # subsystem exists to prevent.
+                import traceback
+                traceback.print_exc()
+
+    def _scan_staleness(self) -> None:
+        with self._lock:
+            comm = self._comm
+            ranks = [r for r, s in self._state.items()
+                     if s in (ALIVE, DEGRADED)]
+        if comm is None:
+            return
+        now = self._clock()
+        for rank in ranks:
+            ping = comm.last_ping(rank)
+            seen = comm.last_seen(rank)
+            candidates = [t for t in ((ping[0] if ping else None), seen)
+                          if t is not None]
+            if not candidates:
+                continue  # never heard from it; bring-up owns that
+            age = now - max(candidates)
+            with self._lock:
+                st = self._state.get(rank)
+                if age > self.policy.degraded_after_s and st == ALIVE:
+                    self._transition(rank, DEGRADED,
+                                     f"no heartbeat for {age:.1f}s")
+                elif age <= self.policy.degraded_after_s \
+                        and st == DEGRADED:
+                    self._transition(rank, ALIVE, "heartbeat resumed")
+
+    # ------------------------------------------------------------------
+    # healing
+
+    def _heal_once(self) -> None:
+        with self._lock:
+            self._pending_heal = False
+            now = self._clock()
+            while (self._restarts and now - self._restarts[0]
+                    > self.policy.restart_window_s):
+                self._restarts.popleft()
+            if len(self._restarts) >= self.policy.max_restarts:
+                self.events.append({
+                    "ts": now, "rank": None, "from": DEAD, "to": DEAD,
+                    "detail": (f"restart budget exhausted "
+                               f"({self.policy.max_restarts} per "
+                               f"{self.policy.restart_window_s:.0f}s); "
+                               f"manual %dist_heal required")})
+                return
+            self._restarts.append(now)
+            dead = sorted(r for r, s in self._state.items() if s == DEAD)
+            for r in list(self._state):
+                self._transition(r, HEALING,
+                                 f"auto-heal (dead ranks {dead})")
+        heal = self._heal_fn
+        try:
+            result = heal() if heal is not None else None
+        except Exception as e:
+            self.heals_failed += 1
+            with self._lock:
+                for r in list(self._state):
+                    self._transition(r, DEAD, f"heal failed: {e}")
+                # Transient respawn failures (port in TIME_WAIT, slow
+                # attach) must not silently end supervision: retry on
+                # the next poll, bounded by the restart budget — each
+                # attempt consumed a slot, so a genuinely broken world
+                # stops at "budget exhausted", not in a tight loop.
+                self._pending_heal = True
+            return
+        if self._stop.is_set():
+            # stop() raced the (slow) respawn: the heal callback may
+            # have brought a world up that nobody is supervising now.
+            # Don't rebind — surface it so the operator can decide.
+            self.events.append({
+                "ts": self._clock(), "rank": None,
+                "from": HEALING, "to": ALIVE,
+                "detail": "heal completed AFTER supervisor stop — the "
+                          "respawned world is unsupervised; shut it "
+                          "down manually if unwanted"})
+            return
+        with self._lock:
+            if result is not None:
+                comm, pm = result
+                self._hook_pm(pm)
+                self._comm, self._pm = comm, pm
+                self._state = {r: HEALING
+                               for r in range(comm.num_workers)}
+            for r in list(self._state):
+                self._transition(r, ALIVE, "healed")
+            self.heals_done += 1
+
+    # ------------------------------------------------------------------
+    # reporting
+
+    def healthy(self) -> bool:
+        with self._lock:
+            return (bool(self._state)
+                    and all(s == ALIVE for s in self._state.values()))
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"states": dict(self._state),
+                    "restarts_used": len(self._restarts),
+                    "max_restarts": self.policy.max_restarts,
+                    "auto_heal": self.policy.auto_heal,
+                    "heals_done": self.heals_done,
+                    "heals_failed": self.heals_failed,
+                    "events": list(self.events)}
+
+    def describe(self) -> str:
+        """Human-readable block for ``%dist_status``."""
+        st = self.status()
+        icon = {ALIVE: "●", DEGRADED: "◐", DEAD: "✖", HEALING: "🩹"}
+        ranks = " ".join(f"{icon.get(s, '?')}{r}:{s}"
+                         for r, s in sorted(st["states"].items()))
+        lines = [f"🛡  supervisor: {ranks or '(no ranks)'} · "
+                 f"restarts {st['restarts_used']}/{st['max_restarts']} "
+                 f"in window · heals {st['heals_done']} ok"
+                 + (f", {st['heals_failed']} failed"
+                    if st["heals_failed"] else "")
+                 + ("" if st["auto_heal"] else " · auto-heal OFF")]
+        for ev in list(st["events"])[-5:]:
+            rank = "world" if ev["rank"] is None else f"rank {ev['rank']}"
+            lines.append(f"   {time.strftime('%H:%M:%S', time.localtime(ev['ts']))} "
+                         f"{rank}: {ev['from']} → {ev['to']}"
+                         + (f" ({ev['detail']})" if ev["detail"] else ""))
+        return "\n".join(lines)

@@ -31,6 +31,8 @@ import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
+from ..resilience.dedup import ReplayCache
+from ..resilience.faults import FaultPlan
 from . import collective_guard, executor, introspect
 from .interrupt import InterruptGate
 
@@ -49,12 +51,21 @@ class DistributedWorker:
                  control_port: int, dist_port: int | None = None,
                  backend: str | None = None,
                  dist_host: str | None = None,
-                 gate: InterruptGate | None = None):
+                 gate: InterruptGate | None = None,
+                 fault_plan: FaultPlan | None = None):
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
         self._busy: tuple | None = None  # (msg_type, started_ts) | None
         self._ckpt_async = None          # in-flight background save
+        # Resilience state: the reply-replay cache makes request
+        # redelivery idempotent (a retried execute NEVER runs twice);
+        # the fault plan (env knob / %dist_chaos) injects deterministic
+        # control-plane failures.
+        self._replay = ReplayCache()
+        self._fault_plan = fault_plan
+        self._install_plan: tuple | None = None  # armed by %dist_chaos
+        self._msg_seen = 0  # control messages received (kill index)
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -97,6 +108,7 @@ class DistributedWorker:
         self.channel = WorkerChannel(
             coordinator_host, control_port, rank=rank,
             auth_token=os.environ.get("NBD_AUTH_TOKEN") or None)
+        self.channel.fault_plan = fault_plan
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            name="nbd-heartbeat", daemon=True)
         self._hb_thread.start()
@@ -116,6 +128,7 @@ class DistributedWorker:
                                      zigzag_unshard)
         from ..parallel.ulysses import ulysses_attention
         from ..utils import data as data_mod
+        from ..utils.compat import shard_map as _compat_shard_map
 
         dist = collectives.DistNamespace()
         ns = {
@@ -132,7 +145,7 @@ class DistributedWorker:
             "NamedSharding": NamedSharding,
             "P": PartitionSpec,
             "PartitionSpec": PartitionSpec,
-            "shard_map": jax.shard_map,
+            "shard_map": getattr(jax, "shard_map", _compat_shard_map),
             "dist": dist,
             "all_reduce": collectives.all_reduce,
             "all_gather": collectives.all_gather,
@@ -178,6 +191,9 @@ class DistributedWorker:
         growing across pings is how the coordinator tells "crunching a
         long cell" from "idle".)"""
         while not self._shutdown.wait(HEARTBEAT_INTERVAL_S):
+            plan = self._fault_plan
+            if plan is not None and plan.heartbeat_frozen():
+                continue  # injected staleness: process alive, pings gone
             busy = self._busy  # (msg_type, started); torn reads are
             data = None        # harmless (both fields set together)
             if busy is not None:
@@ -306,8 +322,57 @@ class DistributedWorker:
         return msg.reply(data={"status": "synced"}, rank=self.rank)
 
     def _handle_get_status(self, msg: Message) -> Message:
-        return msg.reply(data=introspect.device_status(
-            self.rank, self.world_size), rank=self.rank)
+        data = introspect.device_status(self.rank, self.world_size)
+        # Resilience counters ride the status probe so chaos runs can
+        # assert "zero double-executions" (every redelivery was
+        # answered from the replay cache) from the coordinator side.
+        data["dedup_hits"] = self._replay.hits
+        plan = self._fault_plan
+        if plan is not None:
+            data["fault_counters"] = dict(plan.counters)
+        return msg.reply(data=data, rank=self.rank)
+
+    def _handle_chaos(self, msg: Message) -> Message:
+        """Install / clear / report the worker-side fault plan at
+        runtime (``%dist_chaos``).  ``set`` ARMS the plan rather than
+        installing it: it takes effect after this reply is sent, so
+        the acknowledgement itself cannot be eaten by the plan it
+        confirms."""
+        data = msg.data or {}
+        action = data.get("action", "status")
+        if action == "set":
+            try:
+                plan = FaultPlan.from_spec(data.get("spec") or {})
+            except (TypeError, ValueError) as e:
+                return msg.reply(data={"error": f"bad fault spec: {e}"},
+                                 rank=self.rank)
+            self._install_plan = (plan,)
+            return msg.reply(data={"status": "armed",
+                                   "spec": plan.spec()}, rank=self.rank)
+        if action == "clear":
+            old = self._fault_plan
+            self._set_fault_plan(None)  # immediate: the ack must land
+            return msg.reply(
+                data={"status": "cleared",
+                      "counters": dict(old.counters) if old else None},
+                rank=self.rank)
+        plan = self._fault_plan
+        return msg.reply(
+            data={"status": "active" if plan is not None else "off",
+                  "spec": plan.spec() if plan is not None else None,
+                  "counters": dict(plan.counters)
+                  if plan is not None else None,
+                  "dedup_hits": self._replay.hits},
+            rank=self.rank)
+
+    def _set_fault_plan(self, plan: FaultPlan | None) -> None:
+        self._fault_plan = plan
+        self.channel.fault_plan = plan
+        # kill_at counts messages SINCE THE PLAN WAS INSTALLED (the
+        # should_kill contract): a runtime-armed kill_at=5 must mean
+        # "the 5th message from now", not an absolute since-spawn index
+        # the session has long passed.
+        self._msg_seen = 0
 
     def _handle_get_namespace_info(self, msg: Message) -> Message:
         return msg.reply(
@@ -412,6 +477,7 @@ class DistributedWorker:
             "get_namespace_info": self._handle_get_namespace_info,
             "profile": self._handle_profile,
             "checkpoint": self._handle_checkpoint,
+            "chaos": self._handle_chaos,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*
@@ -440,8 +506,26 @@ class DistributedWorker:
                 break  # coordinator gone
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
+            self._msg_seen += 1
+            plan = self._fault_plan
+            if plan is not None and plan.should_kill(self.rank,
+                                                     self._msg_seen):
+                # Injected preemption: die the way a preempted TPU VM
+                # does — no teardown, no reply, mid-request.
+                os.kill(os.getpid(), 9)  # SIGKILL
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
+            cached = self._replay.get(msg.msg_id)
+            if cached is not None:
+                # Redelivered request (retry layer or duplicated
+                # frame): answer from the replay cache — NEVER run a
+                # request twice (a re-run execute would double-apply
+                # user state mutations).
+                try:
+                    self.channel.send(cached)
+                except Exception:
+                    break
+                continue
             handler = handlers.get(msg.msg_type)
             self._busy = (msg.msg_type, time.time())
             try:
@@ -467,10 +551,16 @@ class DistributedWorker:
                     rank=self.rank)
             finally:
                 self._busy = None
+            self._replay.put(msg, reply)
             try:
                 self.channel.send(reply)  # gate closed: frame is atomic
             except Exception:
                 break
+            if self._install_plan is not None:
+                # A %dist_chaos 'set' armed during this request: its
+                # ack is on the wire, start injecting now.
+                self._set_fault_plan(self._install_plan[0])
+                self._install_plan = None
 
     def shutdown(self) -> None:
         """Teardown (reference: worker.py:569-580)."""
@@ -517,7 +607,10 @@ def main(argv: list[str] | None = None) -> int:
         rank=args.rank, world_size=args.world_size,
         coordinator_host=args.coordinator_host,
         control_port=args.control_port, dist_port=args.dist_port,
-        backend=args.backend, dist_host=args.dist_host, gate=gate)
+        backend=args.backend, dist_host=args.dist_host, gate=gate,
+        # NBD_FAULT_PLAN (JSON spec): deterministic fault injection
+        # from process start — how CI chaos tests seed a worker.
+        fault_plan=FaultPlan.from_env())
     try:
         worker.run()
     finally:

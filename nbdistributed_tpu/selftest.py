@@ -11,6 +11,7 @@ test of an installation (``nbd-selftest``) without pytest or a notebook.
 
 from __future__ import annotations
 
+import os
 import sys
 import tempfile
 import time
@@ -178,6 +179,36 @@ _ssrv.run_until_done(max_steps=20)
               "== solo)",
               r0.data.get("output") == "(True, True, True)",
               repr(r0.data.get("error") or r0.data.get("output")))
+
+        # Fault-injection smoke (gated: NBD_SELFTEST_FAULTS=1).
+        # Duplicate-heavy plans on BOTH control-plane directions: the
+        # worker replay cache must absorb every redelivered frame so a
+        # 10-increment counter lands on exactly 10 per rank.
+        # (Duplicate-only because this manager has no retry policy —
+        # dropped frames would surface as request timeouts, which the
+        # chaos integration test covers with retries enabled.)
+        if os.environ.get("NBD_SELFTEST_FAULTS"):
+            from nbdistributed_tpu.resilience import FaultPlan
+            comm.send_to_all(
+                "chaos", {"action": "set",
+                          "spec": {"seed": 7, "duplicate": 0.5}},
+                timeout=60)
+            comm.set_fault_plan(FaultPlan(seed=8, duplicate=0.5))
+            comm.send_to_all("execute", "_ft_n = 0", timeout=60)
+            for _ in range(10):
+                comm.send_to_all("execute", "_ft_n += 1", timeout=60)
+            out = {r: m.data.get("output") for r, m in
+                   comm.send_to_all("execute", "_ft_n",
+                                    timeout=60).items()}
+            st = comm.send_to_all("get_status", timeout=60)
+            dedup = sum(m.data.get("dedup_hits", 0)
+                        for m in st.values())
+            comm.set_fault_plan(None)
+            comm.send_to_all("chaos", {"action": "clear"}, timeout=60)
+            check("fault-injection smoke (duplicates absorbed, "
+                  "exactly-once execute)",
+                  out == {0: "10", 1: "10"},
+                  f"{out} dedup_hits={dedup}")
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

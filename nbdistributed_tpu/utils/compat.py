@@ -1,0 +1,45 @@
+"""JAX version-compatibility shims.
+
+The repo targets the current ``jax.shard_map`` API (``check_vma=``),
+but container images pin a range of JAX releases: on 0.4.x the
+function only exists as ``jax.experimental.shard_map.shard_map`` and
+the replication check is spelled ``check_rep=``.  Every internal call
+site goes through :func:`shard_map` so the version split lives in
+exactly one place.
+
+No module-level ``jax`` import: several callers (parallel/collectives)
+deliberately defer JAX import until first use so platform-selection
+config updates still win.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, **kw):
+    """``jax.shard_map(f, **kw)`` on any supported JAX version.
+
+    Accepts the modern keyword set; on legacy JAX (no ``jax.shard_map``)
+    ``check_vma`` is translated to its old name ``check_rep``.
+    """
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    return legacy(f, **kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` on any supported JAX version (legacy
+    releases spell it ``psum(1, axis)``, which XLA folds to a
+    constant)."""
+    from jax import lax
+
+    native = getattr(lax, "axis_size", None)
+    if native is not None:
+        return native(axis_name)
+    return lax.psum(1, axis_name)

@@ -377,6 +377,39 @@ def test_timeline_sidecar_flushes_and_hook_embeds(ip, capsys, tmp_path):
     capsys.readouterr()
 
 
+def test_dist_chaos_and_supervise_magics(ip, capsys):
+    """Notebook surface of the resilience stack: %dist_chaos arms /
+    reports / clears fault plans on both sides (duplicate-only, so the
+    un-retried magics channel stays reliable — dedup absorbs the
+    dups), and %dist_supervise attaches, surfaces in %dist_status, and
+    stops.  The heavy kill-and-heal path is covered in
+    test_chaos_heal.py."""
+    ip.run_line_magic("dist_chaos", "on --duplicate 0.5 --seed 7")
+    out = capsys.readouterr().out
+    assert "chaos ON" in out
+    run(ip, "chaos_v = rank + 1\nchaos_v")
+    out = capsys.readouterr().out
+    assert "Rank 0" in out and "Rank 1" in out  # cells still exact
+    ip.run_line_magic("dist_chaos", "status")
+    out = capsys.readouterr().out
+    assert "rank 0" in out and "counters=" in out
+    ip.run_line_magic("dist_chaos", "off")
+    out = capsys.readouterr().out
+    assert "chaos off" in out
+    ip.run_line_magic("dist_supervise", "on --max-restarts 2")
+    out = capsys.readouterr().out
+    assert "supervising 2 workers" in out
+    ip.run_line_magic("dist_status", "")
+    out = capsys.readouterr().out
+    assert "supervisor" in out and "alive" in out
+    ip.run_line_magic("dist_supervise", "status")
+    out = capsys.readouterr().out
+    assert "restarts 0/2" in out
+    ip.run_line_magic("dist_supervise", "off")
+    out = capsys.readouterr().out
+    assert "supervisor stopped" in out
+
+
 def test_dist_heal_respawns_and_restores(ip, capsys, tmp_path):
     """Elastic recovery (SURVEY §5.3): kill a worker hard, %dist_heal
     rebuilds the world with the remembered %dist_init config and

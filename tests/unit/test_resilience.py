@@ -1,0 +1,446 @@
+"""Unit tests for the resilience subsystem: retry/backoff schedule,
+deterministic fault plans, the exec-dedup replay cache, the auto-heal
+supervisor state machine, and the coordinator's redelivery path driven
+end-to-end over the real transport with scripted workers."""
+
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.messaging import (CommunicationManager, Message,
+                                         WorkerChannel, decode, encode)
+from nbdistributed_tpu.resilience import (FaultPlan, ReplayCache,
+                                          RetryPolicy, Supervisor,
+                                          SupervisorPolicy)
+
+pytestmark = [pytest.mark.unit, pytest.mark.faults]
+
+
+# ----------------------------------------------------------------------
+# RetryPolicy
+
+def test_retry_disabled_by_default():
+    assert not RetryPolicy().enabled()
+    assert RetryPolicy(attempt_timeout_s=1.0, attempts=1).enabled() is False
+    assert RetryPolicy(attempt_timeout_s=1.0).enabled()
+
+
+def test_backoff_grows_exponentially_and_caps():
+    p = RetryPolicy(attempt_timeout_s=1.0, backoff_base_s=0.25,
+                    backoff_factor=2.0, backoff_max_s=1.0, jitter=0.0)
+    waits = [p.backoff_s(i) for i in range(5)]
+    assert waits == [0.25, 0.5, 1.0, 1.0, 1.0]  # capped at max
+
+
+def test_jitter_bounds_and_determinism():
+    p = RetryPolicy(attempt_timeout_s=2.0, backoff_base_s=1.0,
+                    backoff_factor=1.0, jitter=0.25)
+    lo, hi = p.backoff_s(0, u=0.0), p.backoff_s(0, u=1.0)
+    assert lo == pytest.approx(0.75) and hi == pytest.approx(1.25)
+    assert p.backoff_s(0, u=0.5) == pytest.approx(1.0)
+    # attempt_wait = per-attempt timeout + backoff
+    assert p.attempt_wait_s(0, u=0.5) == pytest.approx(3.0)
+    # random draws stay inside the jitter envelope
+    for _ in range(50):
+        assert 0.75 <= p.backoff_s(0) <= 1.25
+
+
+def test_retry_from_env():
+    assert RetryPolicy.from_env(env={}) is None
+    p = RetryPolicy.from_env(env={"NBD_RETRY_TIMEOUT_S": "2.5",
+                                  "NBD_RETRY_ATTEMPTS": "6"})
+    assert p.attempt_timeout_s == 2.5 and p.attempts == 6 and p.enabled()
+
+
+# ----------------------------------------------------------------------
+# FaultPlan
+
+def test_fault_plan_deterministic_per_seed():
+    a = FaultPlan(seed=11, drop=0.3, delay_p=0.2, duplicate=0.2)
+    b = FaultPlan(seed=11, drop=0.3, delay_p=0.2, duplicate=0.2)
+    assert [a.decide(i) for i in range(200)] == \
+           [b.decide(i) for i in range(200)]
+    c = FaultPlan(seed=12, drop=0.3, delay_p=0.2, duplicate=0.2)
+    assert [a.decide(i) for i in range(200)] != \
+           [c.decide(i) for i in range(200)]
+
+
+def test_fault_plan_spec_roundtrip_and_unknown_keys():
+    p = FaultPlan(seed=5, drop=0.1, duplicate=0.05, kill_rank=1,
+                  kill_at=3, freeze_heartbeat=True)
+    q = FaultPlan.from_spec(p.spec())
+    assert q.spec() == p.spec()
+    with pytest.raises(ValueError, match="unknown fault spec"):
+        FaultPlan.from_spec({"dorp": 0.1})
+    with pytest.raises(TypeError):
+        FaultPlan.from_spec([1, 2])
+
+
+def test_fault_plan_from_env(monkeypatch):
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv("NBD_FAULT_PLAN", '{"seed": 9, "drop": 0.5}')
+    p = FaultPlan.from_env()
+    assert p.seed == 9 and p.drop == 0.5
+
+
+def test_transmit_effects_and_counters():
+    sent = []
+
+    class Scripted(FaultPlan):
+        script = {0: ["drop"], 1: [], 2: ["duplicate"], 3: ["truncate"],
+                  4: ["delay"]}
+
+        def decide(self, index):
+            return self.script.get(index, [])
+
+    p = Scripted(delay_s=0.0)
+    frame = b"x" * 10
+    for _ in range(5):
+        p.transmit(frame, sent.append)
+    # drop: nothing; plain: 1; duplicate: 2; truncate: half; delay: 1
+    assert sent == [frame, frame, frame, frame[:5], frame]
+    assert p.counters["dropped"] == 1
+    assert p.counters["duplicated"] == 1
+    assert p.counters["truncated"] == 1
+    assert p.counters["delayed"] == 1
+    assert p.counters["sent"] == 4
+
+
+def test_transmit_exempt_kinds_skip_plan_and_index():
+    p = FaultPlan(seed=0, drop=1.0)  # drops EVERY planned frame
+    sent = []
+    p.transmit(b"hb", sent.append, kind="ping")  # exempt by default
+    p.transmit(b"rq", sent.append, kind="execute")
+    assert sent == [b"hb"]
+    assert p.counters["exempt"] == 1 and p.counters["dropped"] == 1
+
+
+def test_should_kill_is_at_or_after_index():
+    p = FaultPlan(kill_rank=1, kill_at=3)
+    assert not p.should_kill(0, 5)       # other rank never
+    assert not p.should_kill(1, 2)
+    assert p.should_kill(1, 3) and p.should_kill(1, 4)
+    # half a kill spec is a rejected typo, not a silent no-op
+    with pytest.raises(ValueError, match="kill_rank and kill_at"):
+        FaultPlan(kill_rank=1)
+    with pytest.raises(ValueError, match="kill_rank and kill_at"):
+        FaultPlan(kill_at=5)
+
+
+# ----------------------------------------------------------------------
+# codec: the attempt field rides redeliveries only
+
+def test_codec_attempt_roundtrip():
+    first = Message(msg_type="execute", data="x")
+    assert decode(encode(first)).attempt == 0
+    first.attempt = 2
+    again = decode(encode(first))
+    assert again.attempt == 2 and again.msg_id == first.msg_id
+
+
+# ----------------------------------------------------------------------
+# ReplayCache
+
+def _msg(t="execute", data=None):
+    return Message(msg_type=t, data=data)
+
+
+def test_replay_cache_hit_and_counters():
+    c = ReplayCache()
+    req = _msg()
+    rep = req.reply(data={"output": "1"})
+    assert c.get(req.msg_id) is None
+    assert c.put(req, rep)
+    assert c.get(req.msg_id) is rep
+    assert c.hits == 1 and c.stores == 1
+
+
+def test_replay_cache_lru_bound():
+    c = ReplayCache(capacity=3)
+    reqs = [_msg() for _ in range(5)]
+    for r in reqs:
+        c.put(r, r.reply(data={}))
+    assert len(c) == 3
+    assert c.get(reqs[0].msg_id) is None      # evicted
+    assert c.get(reqs[-1].msg_id) is not None
+
+
+def test_replay_cache_total_byte_budget_evicts_old_keeps_recent():
+    """Mutating replies are always cached, but their accumulated size
+    is capped: old entries evict down to the byte budget while the
+    min_keep most recent (the only retry targets) always survive."""
+    c = ReplayCache(capacity=100, max_total_bytes=10_000, min_keep=2)
+    reqs = [_msg("execute", f"cell {i}") for i in range(6)]
+    for r in reqs:
+        assert c.put(r, r.reply(data={"output": "x" * 3000}))
+    assert c.total_bytes <= 10_000 + 3000  # budget honored (±1 entry)
+    assert len(c) >= 2
+    assert c.get(reqs[-1].msg_id) is not None   # most recent kept
+    assert c.get(reqs[0].msg_id) is None        # oldest evicted
+    # min_keep floor: a tiny budget still keeps the recent tail
+    c2 = ReplayCache(capacity=100, max_total_bytes=1, min_keep=2)
+    r1, r2, r3 = (_msg("execute", str(i)) for i in range(3))
+    for r in (r1, r2, r3):
+        c2.put(r, r.reply(data={"output": "y" * 500}))
+    assert len(c2) == 2
+    assert c2.get(r3.msg_id) is not None
+
+
+def test_replay_cache_oversized_readonly_not_pinned():
+    import numpy as np
+    c = ReplayCache(max_buf_bytes=100)
+    big = _msg("get_var", "params")
+    big_reply = big.reply(data={"array": True},
+                          bufs={"value": np.zeros(1000, np.float32)})
+    assert not c.put(big, big_reply)          # re-reading is safe
+    assert c.get(big.msg_id) is None
+    # mutating types are always cached, whatever their size
+    ex = _msg("execute", "x = 1")
+    ex_reply = ex.reply(data={},
+                        bufs={"value": np.zeros(1000, np.float32)})
+    assert c.put(ex, ex_reply)
+    assert c.get(ex.msg_id) is ex_reply
+
+
+# ----------------------------------------------------------------------
+# Supervisor state machine (fake comm/pm — no processes)
+
+class FakePM:
+    def __init__(self):
+        self.cbs = []
+
+    def add_death_callback(self, cb):
+        self.cbs.append(cb)
+
+    def die(self, rank, rc=-9):
+        for cb in self.cbs:
+            cb(rank, rc)
+
+
+class FakeComm:
+    def __init__(self, n=2):
+        self.num_workers = n
+        self.pings = {}
+        self.seen = {}
+
+    def last_ping(self, rank):
+        return self.pings.get(rank)
+
+    def last_seen(self, rank):
+        return self.seen.get(rank)
+
+
+FAST = SupervisorPolicy(poll_s=0.02, degraded_after_s=0.3)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_supervisor_heals_on_death_and_rebinds():
+    healed = threading.Event()
+    comm2, pm2 = FakeComm(), FakePM()
+
+    def heal():
+        healed.set()
+        return comm2, pm2
+
+    sup = Supervisor(FAST, heal=heal)
+    comm, pm = FakeComm(), FakePM()
+    try:
+        sup.attach(comm, pm)
+        now = time.time()
+        comm.seen = {0: now, 1: now}
+        pm.die(1)
+        assert healed.wait(5), "heal was never invoked"
+        assert _wait(sup.healthy)
+        st = sup.status()
+        assert st["heals_done"] == 1 and st["restarts_used"] == 1
+        # rebound to the fresh pair: a death on the NEW pm is seen
+        pm2.die(0)
+        assert _wait(lambda: sup.status()["heals_done"] == 2)
+        kinds = [(e["rank"], e["to"]) for e in sup.status()["events"]]
+        assert (1, "dead") in kinds and (1, "healing") in kinds
+    finally:
+        sup.stop()
+
+
+def test_supervisor_restart_budget_caps_crash_loops():
+    calls = []
+    sup = Supervisor(SupervisorPolicy(poll_s=0.02, max_restarts=1,
+                                      restart_window_s=600.0),
+                     heal=lambda: calls.append(1) or None)
+    comm, pm = FakeComm(), FakePM()
+    try:
+        sup.attach(comm, pm)
+        pm.die(0)
+        assert _wait(lambda: len(calls) == 1)
+        assert _wait(sup.healthy)
+        pm.die(1)  # budget (1) exhausted: must NOT heal again
+        assert _wait(lambda: any("budget exhausted" in e["detail"]
+                                 for e in sup.status()["events"]))
+        assert len(calls) == 1
+        assert sup.status()["states"][1] == "dead"
+    finally:
+        sup.stop()
+
+
+def test_supervisor_degraded_is_not_dead():
+    """Stale heartbeats flag a rank degraded — and recover to alive
+    when pings resume; heal never fires for staleness alone."""
+    calls = []
+    sup = Supervisor(FAST, heal=lambda: calls.append(1) or None)
+    comm, pm = FakeComm(), FakePM()
+    try:
+        sup.attach(comm, pm)
+        now = time.time()
+        comm.seen = {0: now, 1: now - 10}     # rank 1 silent for 10s
+        assert _wait(lambda: sup.status()["states"][1] == "degraded")
+        assert sup.status()["states"][0] == "alive"
+        comm.seen[1] = time.time()            # heartbeat resumes
+        assert _wait(lambda: sup.status()["states"][1] == "alive")
+        assert not calls
+    finally:
+        sup.stop()
+
+
+def test_supervisor_failed_heal_retries_until_budget_exhausted():
+    """A transient respawn failure re-arms the heal (bounded by the
+    restart budget) instead of silently ending supervision; a world
+    that keeps failing stops at 'budget exhausted'."""
+    def heal():
+        raise RuntimeError("respawn failed")
+
+    sup = Supervisor(SupervisorPolicy(poll_s=0.02, max_restarts=2,
+                                      restart_window_s=600.0),
+                     heal=heal)
+    comm, pm = FakeComm(), FakePM()
+    try:
+        sup.attach(comm, pm)
+        pm.die(0)
+        assert _wait(lambda: sup.status()["heals_failed"] == 2)
+        assert _wait(lambda: any("heal failed" in e["detail"]
+                                 for e in sup.status()["events"]))
+        assert _wait(lambda: any("budget exhausted" in e["detail"]
+                                 for e in sup.status()["events"]))
+        time.sleep(0.2)  # must not keep retrying past the budget
+        assert sup.status()["heals_failed"] == 2
+        assert not sup.healthy()
+    finally:
+        sup.stop()
+
+
+# ----------------------------------------------------------------------
+# Coordinator redelivery over the real transport (scripted workers)
+
+class ScriptedWorker:
+    """Worker loop that answers via a handler(rank, msg) -> data|None."""
+
+    def __init__(self, port, rank, handler):
+        self.chan = WorkerChannel("127.0.0.1", port, rank=rank)
+        self.rank = rank
+        self.handler = handler
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                msg = self.chan.recv()
+            except Exception:
+                return
+            out = self.handler(self.rank, msg)
+            if out is not None:
+                try:
+                    self.chan.send(msg.reply(data=out, rank=self.rank))
+                except Exception:
+                    return
+
+    def close(self):
+        self.chan.close()
+
+
+def test_redelivery_after_dropped_request_same_msg_id():
+    """The listener drops the first delivery; the retry layer resends
+    the SAME msg_id with a bumped attempt and the request completes
+    well inside its total deadline."""
+    mgr = CommunicationManager(
+        num_workers=1, timeout=30,
+        retry=RetryPolicy(attempts=3, attempt_timeout_s=0.3,
+                          backoff_base_s=0.05, jitter=0.0))
+    seen = []
+
+    class DropFirst(FaultPlan):
+        def decide(self, index):
+            return ["drop"] if index == 0 else []
+
+    mgr.set_fault_plan(DropFirst())
+    w = ScriptedWorker(mgr.port, 0,
+                       lambda r, m: seen.append((m.msg_id, m.attempt))
+                       or {"ok": True})
+    try:
+        mgr.wait_for_workers(timeout=10)
+        t0 = time.time()
+        out = mgr.send_to_all("execute", "x")
+        assert time.time() - t0 < 5
+        assert out[0].data == {"ok": True}
+        assert mgr.retries_sent >= 1
+        # worker saw exactly one delivery (the redelivery), attempt 1
+        assert len(seen) == 1 and seen[0][1] == 1
+    finally:
+        w.close()
+        mgr.shutdown()
+
+
+def test_redelivery_of_lost_reply_not_reexecuted_semantics():
+    """A worker whose FIRST reply is eaten: redelivery arrives under
+    the same msg_id; the (scripted) worker answers it again and the
+    coordinator returns exactly one response object."""
+    replies = {"n": 0}
+
+    def handler(rank, msg):
+        replies["n"] += 1
+        return {"n": replies["n"], "attempt": msg.attempt}
+
+    mgr = CommunicationManager(
+        num_workers=1, timeout=30,
+        retry=RetryPolicy(attempts=4, attempt_timeout_s=0.3,
+                          backoff_base_s=0.05, jitter=0.0))
+    w = ScriptedWorker(mgr.port, 0, handler)
+    try:
+        mgr.wait_for_workers(timeout=10)
+
+        class DropFirstReply(FaultPlan):
+            def decide(self, index):
+                return ["drop"] if index == 0 else []
+
+        w.chan.fault_plan = DropFirstReply()
+        out = mgr.send_to_all("execute", "x")
+        # first reply dropped -> redelivered request answered again
+        assert out[0].data["n"] == 2 and out[0].data["attempt"] == 1
+    finally:
+        w.close()
+        mgr.shutdown()
+
+
+def test_no_retry_policy_single_attempt_times_out_unchanged():
+    """Without a policy the old contract holds: one delivery, timeout
+    names the missing ranks."""
+    mgr = CommunicationManager(num_workers=1, timeout=0.3)
+    deliveries = []
+    w = ScriptedWorker(mgr.port, 0,
+                       lambda r, m: deliveries.append(m.attempt) and None)
+    try:
+        mgr.wait_for_workers(timeout=10)
+        with pytest.raises(TimeoutError, match=r"\[0\]"):
+            mgr.send_to_all("execute", "x")
+        assert deliveries == [0]
+    finally:
+        w.close()
+        mgr.shutdown()
