@@ -1309,6 +1309,21 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         except Exception as e:
             log(f"[bench] allreduce sweep skipped: {e}")
 
+        # Snapshot the observability registry into the BENCH json so
+        # perf runs carry comms/retry counters alongside the timings
+        # (the coordinator's codec wire hook has been counting every
+        # frame of the run).  Best-effort like the other context
+        # measurements.
+        try:
+            from nbdistributed_tpu.observability import metrics as _obsm
+            snap = _obsm.registry().to_json()
+            extra["observability_metrics"] = {
+                "retries_sent": comm.retries_sent,
+                "wire_counters": snap.get("counters", {}),
+            }
+        except Exception as e:
+            log(f"[bench] metrics snapshot skipped: {e}")
+
         # The pooled world's job is done.  Tear it down (blocking)
         # BEFORE the per-family measurements: two processes share the
         # one chip's HBM, so the pooled workers must be gone before a

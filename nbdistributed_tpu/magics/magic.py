@@ -8,7 +8,9 @@ Rebuilds the reference's magic surface with the same names and semantics
 plain cells run on all workers (reference: magic.py:609-645).
 
 TPU-era additions beyond parity: ``%dist_profile`` (jax.profiler over all
-workers), ``%dist_pull``/``%dist_push`` (the reference wired get_var/
+workers), ``%dist_trace``/``%dist_metrics`` (cross-rank span tracing
+with Perfetto export + the unified metrics registry — observability/),
+``%dist_pull``/``%dist_push`` (the reference wired get_var/
 set_var in the worker but never exposed them: SURVEY §2.1 #9), and a
 static collective-hazard warning when ``%%rank`` subsets run collective-
 bearing code (SURVEY §5.2 — a mesh-deadlock guard the reference lacks).
@@ -58,7 +60,8 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
-%dist_profile start/stop ·
+%dist_profile start/stop · %dist_trace start/stop/save (Perfetto) ·
+%dist_metrics ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown
@@ -255,6 +258,18 @@ class DistributedMagics(Magics):
         assert comm is not None
         disp = display_mod.StreamDisplay()
         rec = self._timeline.start(code, ranks, kind=kind)
+        # Cell-level span while a %dist_trace session is active: the
+        # send span (opened inside send_to_ranks, on the helper thread)
+        # nests under it via activate(), and the timeline record
+        # carries its ids so a row maps to the span tree in Perfetto.
+        tr = comm.tracer
+        cell_span = (tr.begin(f"cell/{kind}", kind="cell",
+                              attrs={"ranks": list(ranks),
+                                     "code": code.strip()[:120]})
+                     if tr.enabled else None)
+        if cell_span is not None:
+            rec.trace_id = cell_span.trace_id
+            rec.span_id = cell_span.span_id
         with DistributedMagics._display_lock:
             DistributedMagics._active_display = disp
         result: dict = {}
@@ -268,9 +283,10 @@ class DistributedMagics(Magics):
                 # a strict subset (runtime/collective_guard.py) —
                 # BEFORE the control plane would hang on replies that
                 # cannot come.
-                result.update(comm.send_to_ranks(
-                    ranks, "execute",
-                    {"code": code, "target_ranks": list(ranks)}))
+                with tr.activate(cell_span):
+                    result.update(comm.send_to_ranks(
+                        ranks, "execute",
+                        {"code": code, "target_ranks": list(ranks)}))
             except Exception as e:
                 error.append(e)
 
@@ -302,6 +318,7 @@ class DistributedMagics(Magics):
         finally:
             with DistributedMagics._display_lock:
                 DistributedMagics._active_display = None
+            tr.end(cell_span)
         self._timeline.finish(rec, result or None)
         if error:
             e = error[0]
@@ -941,6 +958,13 @@ class DistributedMagics(Magics):
                                      f"/{mem.get('limit') or 0:.2f} GB")
                 line_txt += (f" · {st['global_device_count']} global "
                              f"devices")
+                # A profiler/span trace left running used to be
+                # invisible; surface both (satellite of ISSUE 2).
+                if st.get("profiling"):
+                    line_txt += f" · 🔬 profiling → {st['profiling']}"
+                if st.get("tracing"):
+                    line_txt += (f" · 📡 tracing "
+                                 f"({st.get('trace_spans', 0)} spans)")
             if rank_id in busy:
                 b = busy[rank_id]
                 line_txt += (f" · ⚙ busy: {b['type']} running "
@@ -956,6 +980,10 @@ class DistributedMagics(Magics):
         plan = self._comm.fault_plan() if self._comm is not None else None
         if plan is not None:
             print(f"💥 chaos active (coordinator side): {plan.counters}")
+        if self._comm is not None and self._comm.tracer.enabled:
+            print(f"📡 span trace active: {len(self._comm.tracer)} "
+                  f"coordinator spans — %dist_trace save <path> / "
+                  f"%dist_trace stop")
 
     @magic_arguments()
     @argument("--ranks", default=None,
@@ -1319,6 +1347,208 @@ class DistributedMagics(Magics):
             print(f"🔬 profiling started → {args.log_dir}/rank*/")
         else:
             print(f"🔬 profiling stopped; traces in {args.log_dir}/rank*/")
+
+    # ==================================================================
+    # observability: cross-rank span tracing + metrics (ISSUE 2)
+
+    @magic_arguments()
+    @argument("action", nargs="?", default="status",
+              choices=["start", "stop", "save", "status"])
+    @argument("path", nargs="?", default="nbd_trace.json",
+              help="output file for `save` (Chrome-trace JSON; load in "
+                   "ui.perfetto.dev)")
+    @line_magic
+    def dist_trace(self, line):
+        """Cross-rank span tracing: ``%dist_trace start`` records
+        coordinator spans around every request and worker spans around
+        handler dispatch / cell execution / checkpoints / eager
+        collectives, all under ONE trace id propagated in the wire
+        envelope; ``save`` merges coordinator + all ranks onto the
+        coordinator's timebase (per-rank clock offsets estimated from
+        request RTTs) into one Perfetto-loadable file, with any active
+        fault plan's decisions folded in as instant events.  Off by
+        default with near-zero overhead."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_trace, line)
+        comm = self._comm
+        tr = comm.tracer
+        if args.action == "start":
+            import uuid
+            tid = uuid.uuid4().hex[:16]
+            try:
+                # Workers first (adopting the shared trace id), so the
+                # coordinator never stamps a request that lands on a
+                # not-yet-tracing worker.
+                comm.send_to_all("trace", {"action": "start",
+                                           "trace_id": tid}, timeout=30)
+            except Exception as e:
+                print(f"❌ starting worker tracers failed: {e}")
+                return
+            tr.start(trace_id=tid)
+            print(f"📡 tracing ON (trace {tid}) — run cells, then "
+                  f"%dist_trace save <path>")
+            return
+        if args.action == "stop":
+            n = tr.stop()
+            try:
+                resps = comm.send_to_all("trace", {"action": "stop"},
+                                         timeout=30)
+                per_rank = {r: resps[r].data.get("spans")
+                            for r in sorted(resps)}
+            except Exception as e:
+                per_rank = f"<worker stop failed: {e}>"
+            print(f"📡 tracing OFF — buffered spans: coordinator {n}, "
+                  f"workers {per_rank} (%dist_trace save still works)")
+            return
+        if args.action == "status":
+            state = "ON" if tr.enabled else "off"
+            print(f"coordinator: tracing {state}, {len(tr)} spans "
+                  f"buffered"
+                  + (f", trace {tr.trace_id}" if tr.trace_id else ""))
+            try:
+                resps = comm.send_to_all("trace", {"action": "status"},
+                                         timeout=30)
+                for r in sorted(resps):
+                    d = resps[r].data
+                    print(f"🔹 rank {r}: {d.get('status')} "
+                          f"({d.get('spans', 0)} spans)")
+            except Exception as e:
+                print(f"⚠️ worker-side status failed: {e}")
+            return
+        # save: collect per-rank dumps + fault events, merge on the
+        # coordinator's timebase, write one Chrome-trace JSON.
+        from ..observability import export as obs_export
+        try:
+            resps = comm.send_to_all("trace", {"action": "dump"},
+                                     timeout=120)
+        except Exception as e:
+            print(f"❌ collecting worker traces failed: {e}")
+            return
+        rank_dumps = {r: m.data.get("trace") or {}
+                      for r, m in resps.items()}
+        rank_faults = {r: m.data.get("fault_events") or []
+                       for r, m in resps.items()}
+        plan = comm.fault_plan()
+        cdump = tr.dump()
+        offsets = comm.clock.offsets()
+        merged = obs_export.merge_trace(
+            cdump, rank_dumps, offsets,
+            coordinator_faults=plan.events() if plan is not None else [],
+            rank_faults=rank_faults)
+        try:
+            n = obs_export.save_trace(args.path, merged)
+        except OSError as e:
+            print(f"❌ could not write {args.path}: {e}")
+            return
+        n_spans = {r: len(d.get("spans", [])) for r, d in
+                   sorted(rank_dumps.items())}
+        offs = {r: round(o * 1e3, 3) for r, o in sorted(offsets.items())}
+        print(f"✅ {n} events → {args.path} (coordinator "
+              f"{len(cdump['spans'])} spans, ranks {n_spans}, "
+              f"clock offsets {offs} ms) — load in ui.perfetto.dev")
+
+    @magic_arguments()
+    @argument("--prom", action="store_true",
+              help="print Prometheus exposition text instead of the "
+                   "summary")
+    @argument("--save", default=None,
+              help="also write the full JSON snapshot (coordinator + "
+                   "per-rank) to this path")
+    @line_magic
+    def dist_metrics(self, line):
+        """One coherent view of the session's metrics: wire messages /
+        bytes, retries, dedup hits, cell and collective durations,
+        fault injections, supervisor transitions — from the
+        coordinator's registry and every rank's, with resilience
+        counters mirrored in at snapshot time."""
+        if not self._require_cluster():
+            return
+        args = parse_argstring(self.dist_metrics, line)
+        comm = self._comm
+        from ..observability import metrics as obs_metrics
+        reg = obs_metrics.registry()
+        # Mirror coordinator-side resilience state into the registry so
+        # the export is self-contained.
+        now = time.time()
+        for r in comm.connected_ranks():
+            seen = comm.last_seen(r)
+            if seen is not None:
+                reg.gauge("nbd_heartbeat_staleness_seconds",
+                          "seconds since this rank was last heard",
+                          {"rank": str(r)}).set(round(now - seen, 3))
+        plan = comm.fault_plan()
+        if plan is not None:
+            for action, c in plan.counters.items():
+                reg.gauge("nbd_fault_injections",
+                          "fault-plan decisions by action",
+                          {"action": action}).set(c)
+        sup = DistributedMagics._supervisor
+        if sup is not None:
+            reg.gauge("nbd_supervisor_transitions",
+                      "supervisor state transitions observed "
+                      "(monotonic)").set(
+                sup.status().get("transitions", 0))
+        try:
+            resps = comm.send_to_all(
+                "metrics",
+                {"format": "prometheus" if args.prom else "json"},
+                timeout=30)
+        except Exception as e:
+            print(f"❌ metrics fetch failed: {e}")
+            return
+        if args.prom:
+            print("── coordinator ──")
+            print(reg.prometheus_text(), end="")
+            for r in sorted(resps):
+                print(f"── rank {r} ──")
+                print(resps[r].data.get("text", ""), end="")
+            return
+        coord = reg.to_json()
+        rank_json = {r: resps[r].data.get("metrics", {})
+                     for r in sorted(resps)}
+        if args.save:
+            import json
+            with open(args.save, "w") as f:
+                json.dump({"coordinator": coord,
+                           "ranks": {str(r): v
+                                     for r, v in rank_json.items()}}, f,
+                          indent=1)
+            print(f"✅ full snapshot → {args.save}")
+
+        def _total(snap: dict, name: str) -> float:
+            """Sum every series of ``name`` across counters+gauges."""
+            tot = 0.0
+            for sect in ("counters", "gauges"):
+                for k, v in snap.get(sect, {}).items():
+                    if k == name or k.startswith(name + "{"):
+                        tot += v
+            return tot
+
+        def _hist(snap: dict, name: str) -> tuple[int, float]:
+            count, total = 0, 0.0
+            for k, v in snap.get("histograms", {}).items():
+                if k == name or k.startswith(name + "{"):
+                    count += v.get("count", 0)
+                    total += v.get("sum", 0.0)
+            return count, total
+
+        print(f"📊 coordinator: wire tx/rx "
+              f"{_total(coord, 'nbd_wire_messages_total'):.0f} msgs · "
+              f"{_total(coord, 'nbd_wire_bytes_total') / 1e6:.2f} MB · "
+              f"retries {_total(coord, 'nbd_retries_total'):.0f}")
+        for r in sorted(rank_json):
+            snap = rank_json[r]
+            cells, cell_s = _hist(snap, "nbd_cell_seconds")
+            colls, coll_s = _hist(snap, "nbd_collective_seconds")
+            print(f"🔹 rank {r}: cells {cells} ({cell_s:.2f}s) · "
+                  f"collectives {colls} ({coll_s:.2f}s) · dedup "
+                  f"{_total(snap, 'nbd_dedup_hits'):.0f} · wire "
+                  f"{_total(snap, 'nbd_wire_messages_total'):.0f} msgs "
+                  f"{_total(snap, 'nbd_wire_bytes_total') / 1e6:.2f} MB"
+                  + (f" · faults "
+                     f"{_total(snap, 'nbd_fault_injections'):.0f}"
+                     if _total(snap, "nbd_fault_injections") else ""))
 
     # ==================================================================
     # timeline magics (reference: magic.py:1778-1870)

@@ -33,6 +33,11 @@ class CellRecord:
     rank_duration_s: dict[int, float] = field(default_factory=dict)
     rank_status: dict[int, str] = field(default_factory=dict)
     kind: str = "distributed"  # distributed | rank | sync | local
+    # Span ids when a %dist_trace session was active during this cell
+    # (observability/spans.py) — the bridge from a timeline row to the
+    # matching span tree in the merged Perfetto trace.
+    trace_id: str | None = None
+    span_id: str | None = None
 
 
 class Timeline:

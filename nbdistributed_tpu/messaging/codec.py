@@ -58,6 +58,25 @@ class CodecError(Exception):
     """Raised on malformed frames or disallowed encodings."""
 
 
+# Per-frame accounting hook ``(direction "tx"|"rx", msg_type, nbytes)``
+# — installed by observability.metrics.install_wire_hook.  "rx" fires
+# here in decode (exactly one decode per received frame); "tx" fires at
+# the transports' per-socket writes (a fan-out send writes one encoded
+# frame to N sockets, and a chaos plan may drop or duplicate a write —
+# encode-time counting would misstate all of those).  One global read
+# per frame when unset; the hook must never raise.
+_wire_hook = None
+
+
+def set_wire_hook(hook) -> None:
+    global _wire_hook
+    _wire_hook = hook
+
+
+def wire_hook():
+    return _wire_hook
+
+
 def _np_dtype(name: str) -> np.dtype:
     """dtype-from-string that understands ml_dtypes extras (bfloat16 etc.)."""
     try:
@@ -88,6 +107,11 @@ class Message:
     # replay cache recognizes it and the wire shows which delivery a
     # frame belongs to (debugging dropped-frame chaos runs).
     attempt: int = 0
+    # Span context {"tid": trace_id, "sid": span_id} while a trace is
+    # active (observability/spans.py), None otherwise.  Like `attempt`,
+    # the header field is only emitted when set — untraced frames stay
+    # byte-identical to the pre-tracing wire format.
+    trace: dict | None = None
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
@@ -126,6 +150,9 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
         # Only on redeliveries: first-send frames stay byte-identical
         # to the pre-retry wire format.
         header["at"] = msg.attempt
+    if msg.trace:
+        # Only while a trace is active (near-zero overhead when off).
+        header["tr"] = msg.trace
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -197,6 +224,9 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
     else:
         data = header.get("data")
 
+    hook = _wire_hook
+    if hook is not None:
+        hook("rx", header["type"], len(frame))
     return Message(
         msg_type=header["type"],
         data=data,
@@ -205,6 +235,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         timestamp=header["ts"],
         bufs=bufs,
         attempt=header.get("at", 0),
+        trace=header.get("tr"),
     )
 
 

@@ -26,6 +26,9 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..observability import metrics as obs_metrics
+from ..observability import spans as obs_spans
+from ..observability.clock import ClockEstimator
 from ..resilience.retry import RetryPolicy
 from .codec import Message
 from .native import make_listener
@@ -37,13 +40,18 @@ class WorkerDied(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("expect", "responses", "event", "failure")
+    __slots__ = ("expect", "responses", "event", "failure", "sent_at")
 
     def __init__(self, expect: set[int]):
         self.expect = set(expect)
         self.responses: dict[int, Message] = {}
         self.event = threading.Event()
         self.failure: Exception | None = None
+        # Wall clock of the FIRST delivery: the t_send of the NTP-style
+        # clock samples (observability/clock.py).  Redeliveries do not
+        # refresh it — a retried sample just has a big RTT and loses
+        # the min-RTT filter.
+        self.sent_at: float = 0.0
 
 
 class CommunicationManager:
@@ -62,6 +70,12 @@ class CommunicationManager:
         self.retry = (retry if retry is not None
                       else RetryPolicy.from_env() or RetryPolicy())
         self.retries_sent = 0  # redeliveries actually transmitted
+        # Observability: the process tracer (spans around requests,
+        # off until %dist_trace start), per-rank clock offsets fed from
+        # response RTTs, and wire-frame accounting into the registry.
+        self.tracer = obs_spans.tracer()
+        self.clock = ClockEstimator()
+        obs_metrics.install_wire_hook()
         # Native C++ listener when built (see messaging/native.py), the
         # pure-Python selector listener otherwise — same protocol.
         self._listener = make_listener(host=host, port=port,
@@ -182,6 +196,14 @@ class CommunicationManager:
         if not ranks:
             return {}  # an empty expectation would otherwise never complete
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        tr = self.tracer
+        span = (tr.begin(f"send/{msg_type}", kind="coordinator",
+                         attrs={"ranks": list(ranks)})
+                if tr.enabled else None)
+        if span is not None:
+            # The worker's handler span adopts these ids as its parent,
+            # stitching the cross-process timeline together.
+            msg.trace = tr.context_for(span)
         pending = _Pending(set(ranks))
         with self._lock:
             already_dead = pending.expect & self._dead
@@ -195,6 +217,7 @@ class CommunicationManager:
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         try:
+            pending.sent_at = time.time()
             self._listener.send_to_ranks(list(ranks), msg)
             complete = False
             for attempt in range(1, attempts + 1):
@@ -207,6 +230,9 @@ class CommunicationManager:
                     try:
                         self._listener.send_to_ranks(missing_now, msg)
                         self.retries_sent += 1
+                        obs_metrics.registry().counter(
+                            "nbd_retries_total",
+                            "request redeliveries transmitted").inc()
                     except TransportError:
                         pass  # disconnected rank: death callback aborts us
                 if attempt == attempts:
@@ -236,6 +262,9 @@ class CommunicationManager:
             with self._lock:
                 return dict(pending.responses)
         finally:
+            if span is not None:
+                span.attrs["deliveries"] = msg.attempt + 1
+                tr.end(span)
             with self._lock:
                 self._pending.pop(msg.msg_id, None)
 
@@ -287,6 +316,12 @@ class CommunicationManager:
                     return  # late response to a timed-out request
                 pending.responses[rank] = msg
                 complete = set(pending.responses) >= pending.expect
+            if pending.sent_at:
+                # NTP-style clock sample: (t_send, worker reply stamp,
+                # t_recv) — the estimator's min-RTT filter keeps only
+                # the cleanest of these.
+                self.clock.add(rank, pending.sent_at, msg.timestamp,
+                               time.time())
             if complete:
                 pending.event.set()
             return

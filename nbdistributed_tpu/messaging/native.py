@@ -177,14 +177,27 @@ class NativeCoordinatorListener:
     def _transmit(self, rank: int, frame: bytes, kind: str) -> int:
         plan = self.fault_plan
         if plan is None:
-            return self._try_send(rank, frame)
+            return self._send_accounted(rank, frame, kind)
         rcs: list[int] = []
-        plan.transmit(frame, lambda f: rcs.append(self._try_send(rank, f)),
-                      kind=kind)
+        plan.transmit(
+            frame,
+            lambda f: rcs.append(self._send_accounted(rank, f, kind)),
+            kind=kind)
         # A dropped frame never touched the socket: report success —
         # under chaos, loss is the point, and the retry layer owns
         # recovery.
         return rcs[-1] if rcs else 0
+
+    def _send_accounted(self, rank: int, frame: bytes, kind: str) -> int:
+        rc = self._try_send(rank, frame)
+        if rc == 0:
+            # tx accounting on the actual (successful) socket write,
+            # mirroring the Python transport's per-rank counting.
+            from .codec import wire_hook
+            hook = wire_hook()
+            if hook is not None:
+                hook("tx", kind, len(frame))
+        return rc
 
     def _try_send(self, rank: int, frame: bytes) -> int:
         if not self._handle:

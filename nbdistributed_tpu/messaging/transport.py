@@ -31,7 +31,8 @@ import struct
 import threading
 from typing import Callable
 
-from .codec import CodecError, Message, decode, encode, frame_ready
+from .codec import (CodecError, Message, decode, encode, frame_ready,
+                    wire_hook)
 
 # Connection preamble: worker announces its rank in a fixed header
 # before any frames — the identity handshake ZMQ did with socket
@@ -226,11 +227,21 @@ class CoordinatorListener:
 
     def _transmit(self, conn: "_ConnState", frame: bytes,
                   kind: str) -> None:
+        # tx accounting wraps the ACTUAL socket write: a fan-out send
+        # counts once per rank, and a chaos plan's drops (0 writes) /
+        # duplicates (2 writes) / truncations (shorter frame) are all
+        # counted as what really hit the wire.
+        def _tx(f: bytes) -> None:
+            conn.send_frame(f)
+            hook = wire_hook()
+            if hook is not None:
+                hook("tx", kind, len(f))
+
         plan = self.fault_plan
         if plan is not None:
-            plan.transmit(frame, conn.send_frame, kind=kind)
+            plan.transmit(frame, _tx, kind=kind)
         else:
-            conn.send_frame(frame)
+            _tx(frame)
 
     def send_to_rank(self, rank: int, msg: Message) -> None:
         with self._lock:
@@ -407,11 +418,19 @@ class WorkerChannel:
 
     def send(self, msg: Message) -> None:
         frame = encode(msg, allow_pickle=self._allow_pickle)
+
+        def _tx(f: bytes) -> None:
+            # Count actual writes (see CoordinatorListener._transmit).
+            self._send_frame(f)
+            hook = wire_hook()
+            if hook is not None:
+                hook("tx", msg.msg_type, len(f))
+
         plan = self.fault_plan
         if plan is not None:
-            plan.transmit(frame, self._send_frame, kind=msg.msg_type)
+            plan.transmit(frame, _tx, kind=msg.msg_type)
         else:
-            self._send_frame(frame)
+            _tx(frame)
 
     def recv(self, timeout: float | None = None, *,
              gate=None) -> Message:

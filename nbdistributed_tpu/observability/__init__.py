@@ -1,0 +1,40 @@
+"""Unified observability: cross-rank tracing + metrics (L2.5, ISSUE 2).
+
+SURVEY §5.5 flags metrics/logging/observability as the reference's
+biggest operational gap, and the resilience layer (PR 1) made it acute:
+retry, dedup, and supervisor counters exist but are scattered across
+ad-hoc ``get_status`` dicts with no history, no cross-rank view, and no
+export.  This package is the one coherent place where traces and
+metrics from the coordinator and every rank land:
+
+- :mod:`~nbdistributed_tpu.observability.spans` — lightweight span
+  tracing.  A process-local :class:`Tracer` (off by default, one
+  attribute check when disabled) records named spans with
+  ``trace_id``/``span_id``/``parent_id``; the ids propagate across the
+  control plane in an optional codec header field (mirroring the
+  resilience layer's ``attempt``), so a worker's handler span is a
+  *child* of the coordinator's send span in one merged timeline.
+- :mod:`~nbdistributed_tpu.observability.clock` — NTP-style per-rank
+  clock-offset estimation from request/response RTTs, so merged
+  timelines align even though every process stamps its own wall clock.
+- :mod:`~nbdistributed_tpu.observability.metrics` — a process-local
+  registry of counters / gauges / fixed-bucket histograms (wire
+  messages and bytes, retries, dedup hits, cell and collective
+  durations, fault injections, supervisor transitions) with JSON and
+  Prometheus-text export.
+- :mod:`~nbdistributed_tpu.observability.export` — merge coordinator +
+  all-rank span dumps into one Chrome-trace-event JSON
+  (Perfetto-loadable, ``pid`` = rank) with :class:`FaultPlan` decisions
+  folded in as instant events, so chaos runs are visually debuggable.
+
+Surfaced via ``%dist_trace start|stop|save`` and ``%dist_metrics``.
+Everything here is stdlib-only (no JAX import) so the coordinator side
+stays light and the modules are unit-testable without a backend.
+"""
+
+from .clock import ClockEstimator
+from .metrics import MetricsRegistry, registry
+from .spans import Tracer, maybe_span, tracer
+
+__all__ = ["ClockEstimator", "MetricsRegistry", "Tracer", "maybe_span",
+           "registry", "tracer"]

@@ -1,0 +1,85 @@
+"""NTP-style per-rank clock-offset estimation from request RTTs.
+
+Every process stamps spans with its own ``time.time()``; on a pod the
+hosts' clocks can disagree by milliseconds — enough to render a
+worker's handler span *outside* the coordinator send span that caused
+it.  The coordinator therefore estimates each rank's offset the way
+NTP does, from the request/response timestamps it already has:
+
+    t_send   coordinator clock, request handed to the transport
+    t_remote worker clock, reply envelope stamped (codec ``ts``)
+    t_recv   coordinator clock, reply arrived
+
+    rtt    = t_recv - t_send
+    offset = t_remote - (t_send + t_recv) / 2
+
+A single sample is noisy — the worker stamp is not at the wire
+midpoint (handler time skews it late) and queueing inflates RTT — so
+the estimator applies the classic NTP filter: keep the K lowest-RTT
+samples per rank (minimal queueing ⇒ minimal midpoint error) and
+report the median of their offsets.  Fast requests (status probes,
+trace control messages) dominate the minimum, which is exactly what we
+want.  Corrected worker time = worker wall clock − offset.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ClockEstimator:
+    """Accumulates ``(rtt, offset)`` samples per rank; thread-safe
+    (fed from the coordinator IO thread)."""
+
+    def __init__(self, keep: int = 16):
+        # Per rank: the `keep` lowest-RTT samples seen so far, sorted
+        # ascending by RTT.
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._best: dict[int, list[tuple[float, float]]] = {}
+        self._count: dict[int, int] = {}
+
+    def add(self, rank: int, t_send: float, t_remote: float,
+            t_recv: float) -> None:
+        rtt = t_recv - t_send
+        if rtt < 0:  # clock stepped mid-request; unusable sample
+            return
+        offset = t_remote - (t_send + t_recv) / 2.0
+        with self._lock:
+            best = self._best.setdefault(rank, [])
+            self._count[rank] = self._count.get(rank, 0) + 1
+            if len(best) < self.keep or rtt < best[-1][0]:
+                best.append((rtt, offset))
+                best.sort(key=lambda s: s[0])
+                del best[self.keep:]
+
+    def offset(self, rank: int) -> float:
+        """Estimated ``worker_clock - coordinator_clock`` in seconds
+        (0.0 with no samples: an uncorrected merge beats no merge)."""
+        with self._lock:
+            best = self._best.get(rank)
+            if not best:
+                return 0.0
+            offs = sorted(off for _, off in best)
+        mid = len(offs) // 2
+        if len(offs) % 2:
+            return offs[mid]
+        return (offs[mid - 1] + offs[mid]) / 2.0
+
+    def offsets(self) -> dict[int, float]:
+        with self._lock:
+            ranks = list(self._best)
+        return {r: self.offset(r) for r in ranks}
+
+    def stats(self) -> dict[int, dict]:
+        """Per-rank diagnostics for status surfaces: sample count, best
+        RTT, current estimate."""
+        out: dict[int, dict] = {}
+        with self._lock:
+            items = {r: list(b) for r, b in self._best.items()}
+            counts = dict(self._count)
+        for r, best in items.items():
+            out[r] = {"samples": counts.get(r, 0),
+                      "min_rtt_s": best[0][0] if best else None,
+                      "offset_s": self.offset(r)}
+        return out

@@ -1,0 +1,162 @@
+"""Merge per-process span dumps into one Chrome-trace-event JSON.
+
+The output loads directly in Perfetto (ui.perfetto.dev → "Open trace
+file") or ``chrome://tracing``: one *process* row per rank (``pid`` =
+rank; the coordinator is ``pid`` −1, matching its sentinel rank in the
+wire protocol), one *thread* track per recording thread, spans as
+complete events (``ph: "X"``), and :class:`FaultPlan` decisions folded
+in as instant events (``ph: "i"``) so a chaos run shows *where* the
+drops and duplicates landed relative to the requests they afflicted.
+
+Worker timestamps are corrected by the per-rank clock offset estimated
+from request RTTs (:mod:`~nbdistributed_tpu.observability.clock`), and
+the whole merge is rebased to the earliest event so timestamps stay
+small.  Span/parent ids travel in ``args`` — Perfetto surfaces them in
+the detail pane, which is how a worker handler span is tied back to
+the coordinator send span that caused it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+COORDINATOR_PID = -1
+
+
+def _span_event(span: dict, pid: int, offset_s: float,
+                base_s: float) -> dict:
+    args: dict[str, Any] = dict(span.get("attrs") or {})
+    args["trace_id"] = span.get("trace_id")
+    args["span_id"] = span.get("span_id")
+    if span.get("parent_id"):
+        args["parent_id"] = span["parent_id"]
+    return {
+        "name": span["name"],
+        "cat": span.get("kind") or "span",
+        "ph": "X",
+        "ts": (span["t0"] - offset_s - base_s) * 1e6,
+        "dur": max(0.0, span.get("dur", 0.0)) * 1e6,
+        "pid": pid,
+        "tid": span.get("tid", 0),
+        "args": args,
+    }
+
+
+def _instant_event(ev: dict, pid: int, offset_s: float,
+                   base_s: float) -> dict:
+    return {
+        "name": ev["name"],
+        "cat": ev.get("kind") or "instant",
+        "ph": "i",
+        "s": "t",
+        "ts": (ev["t0"] - offset_s - base_s) * 1e6,
+        "pid": pid,
+        "tid": ev.get("tid", 0),
+        "args": dict(ev.get("attrs") or {}),
+    }
+
+
+def _fault_events(events: list[dict], pid: int, offset_s: float,
+                  base_s: float) -> list[dict]:
+    out = []
+    for ev in events or []:
+        for action in ev.get("actions", ()):
+            out.append({
+                "name": f"fault:{action}",
+                "cat": "fault",
+                "ph": "i",
+                "s": "p",  # process scope: a full-height marker
+                "ts": (ev["ts"] - offset_s - base_s) * 1e6,
+                "pid": pid,
+                "tid": 0,
+                "args": {"frame_kind": ev.get("kind")},
+            })
+    return out
+
+
+def _meta(pid: int, label: str, sort_index: int) -> list[dict]:
+    return [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": label}},
+        {"name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"sort_index": sort_index}},
+    ]
+
+
+def merge_trace(coordinator: dict | None,
+                ranks: dict[int, dict] | None = None,
+                offsets: dict[int, float] | None = None,
+                coordinator_faults: list[dict] | None = None,
+                rank_faults: dict[int, list[dict]] | None = None) -> dict:
+    """Build the merged Chrome trace object.
+
+    ``coordinator`` / ``ranks[r]`` are ``Tracer.dump()`` payloads;
+    ``offsets[r]`` is the estimated ``worker_clock − coordinator_clock``
+    for rank ``r`` (applied as a subtraction, so every event lands on
+    the coordinator's timebase); the fault lists are
+    ``FaultPlan.events()``.
+    """
+    ranks = ranks or {}
+    offsets = offsets or {}
+    rank_faults = rank_faults or {}
+
+    # Rebase to the earliest (corrected) timestamp in the merge.
+    t_candidates: list[float] = []
+    for dump, off in ([(coordinator, 0.0)] if coordinator else []) + [
+            (ranks[r], offsets.get(r, 0.0)) for r in ranks]:
+        for s in (dump or {}).get("spans", []):
+            t_candidates.append(s["t0"] - off)
+        for ev in (dump or {}).get("instants", []):
+            t_candidates.append(ev["t0"] - off)
+    for ev in coordinator_faults or []:
+        t_candidates.append(ev["ts"])
+    for r, evs in rank_faults.items():
+        off = offsets.get(r, 0.0)
+        t_candidates.extend(ev["ts"] - off for ev in evs or [])
+    base_s = min(t_candidates) if t_candidates else 0.0
+
+    events: list[dict] = []
+    dropped = 0
+    if coordinator:
+        events += _meta(COORDINATOR_PID, "coordinator", -1)
+        events += [_span_event(s, COORDINATOR_PID, 0.0, base_s)
+                   for s in coordinator.get("spans", [])]
+        events += [_instant_event(ev, COORDINATOR_PID, 0.0, base_s)
+                   for ev in coordinator.get("instants", [])]
+        dropped += coordinator.get("dropped", 0)
+    events += _fault_events(coordinator_faults or [], COORDINATOR_PID,
+                            0.0, base_s)
+    for r in sorted(ranks):
+        off = offsets.get(r, 0.0)
+        dump = ranks[r] or {}
+        events += _meta(r, f"rank {r}", r)
+        events += [_span_event(s, r, off, base_s)
+                   for s in dump.get("spans", [])]
+        events += [_instant_event(ev, r, off, base_s)
+                   for ev in dump.get("instants", [])]
+        dropped += dump.get("dropped", 0)
+    for r in sorted(rank_faults):
+        events += _fault_events(rank_faults[r], r,
+                                offsets.get(r, 0.0), base_s)
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "nbdistributed_tpu %dist_trace",
+            "base_unix_s": base_s,
+            "clock_offsets_s": {str(r): offsets.get(r, 0.0)
+                                for r in sorted(ranks)},
+            "spans_dropped": dropped,
+        },
+    }
+
+
+def save_trace(path: str, merged: dict) -> int:
+    """Write the merged trace; returns the number of non-metadata
+    events (the useful-content count surfaced by ``%dist_trace
+    save``)."""
+    with open(path, "w") as f:
+        json.dump(merged, f)
+    return sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")

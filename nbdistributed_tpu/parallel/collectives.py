@@ -27,10 +27,13 @@ jit straight through them.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any
 
 import numpy as np
 
+from ..observability import metrics as _obs_metrics
+from ..observability.spans import maybe_span as _maybe_span
 from ..runtime.collective_guard import check as _guard_check
 from ..utils.compat import shard_map as _shard_map
 
@@ -38,6 +41,32 @@ from ..utils.compat import shard_map as _shard_map
 def _jax():
     import jax
     return jax
+
+
+def _instrumented(name: str):
+    """Observability wrapper for an eager collective: a
+    ``collective/<op>`` span while a trace is active (one flag check
+    when not) and an always-on duration histogram in the process
+    metrics registry.  Metrics are resolved once, at decoration time —
+    the per-call cost is one ``observe``.  Composed ops (broadcast →
+    all_reduce) record both levels, mirroring their span nesting."""
+    reg = _obs_metrics.registry()
+    hist = reg.histogram("nbd_collective_seconds",
+                         "eager collective duration", {"op": name})
+    calls = reg.counter("nbd_collectives_total",
+                        "eager collective calls", {"op": name})
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            with _maybe_span(f"collective/{name}", kind="collective"):
+                out = fn(*args, **kwargs)
+            calls.inc()
+            hist.observe(time.perf_counter() - t0)
+            return out
+        return wrapped
+    return deco
 
 
 @functools.lru_cache(maxsize=None)
@@ -131,6 +160,7 @@ def _gather_fn(mesh):
     return f
 
 
+@_instrumented("all_reduce")
 def all_reduce(x, op: str = "sum"):
     """Elementwise reduce across all ranks; every rank gets the result
     (torch ``dist.all_reduce`` analog, but functional).
@@ -163,6 +193,7 @@ def all_reduce(x, op: str = "sum"):
     return out
 
 
+@_instrumented("all_gather")
 def all_gather(x):
     """Gather per-rank values; returns a stacked array with leading
     dimension = number of ranks (``dist.all_gather`` analog).
@@ -187,6 +218,7 @@ def all_gather(x):
     return out
 
 
+@_instrumented("broadcast")
 def broadcast(x, root: int = 0):
     """Every process returns root's value (``dist.broadcast`` analog).
     Implemented as mask-and-sum so any root works, not just process 0
@@ -204,6 +236,7 @@ def broadcast(x, root: int = 0):
     return all_reduce(contribution, op="sum")
 
 
+@_instrumented("barrier")
 def barrier(name: str = "nbd_barrier"):
     """Block until every process arrives (``dist.barrier`` analog;
     reference uses it for %sync at worker.py:213-215)."""
@@ -232,6 +265,7 @@ def _reduce_scatter_fn(mesh):
     return f
 
 
+@_instrumented("reduce_scatter")
 def reduce_scatter(x, op: str = "sum"):
     """Reduce across processes, then return this process's equal chunk of
     the leading axis (``dist.reduce_scatter`` analog).
@@ -291,6 +325,7 @@ def _quantized_all_reduce_fn(mesh, block: int):
     return f
 
 
+@_instrumented("all_reduce_quantized")
 def all_reduce_quantized(x, op: str = "sum", *, block: int = 256):
     """Approximate all-reduce with int8-quantized gather phase.
 
@@ -344,6 +379,7 @@ def _check_root(root: int, what: str) -> None:
                          f"world size {w}")
 
 
+@_instrumented("scatter")
 def scatter(x, root: int = 0):
     """Rank ``root`` provides a stacked ``(world, ...)`` array; every
     rank returns its own row (``dist.scatter`` analog, functional).
@@ -374,6 +410,7 @@ def scatter(x, root: int = 0):
         return broadcast(x, root=root)[rank()]
 
 
+@_instrumented("gather")
 def gather(x, root: int = 0):
     """Gather per-rank values to ``root``: root returns the stacked
     ``(world, ...)`` array, every other rank returns None
@@ -387,6 +424,7 @@ def gather(x, root: int = 0):
     return out if rank() == root else None
 
 
+@_instrumented("reduce")
 def reduce(x, root: int = 0, op: str = "sum"):
     """Reduce across ranks to ``root``: root returns the reduced
     value, every other rank returns None (``dist.reduce`` analog,

@@ -33,23 +33,34 @@ from collections import OrderedDict
 
 # Request types whose handlers only READ state: re-running one on a
 # redelivered frame is semantically safe, so their (potentially huge)
-# replies may be skipped / evicted by the byte bounds.
-_READ_ONLY = frozenset({"get_var", "get_namespace_info", "get_status"})
+# replies may be skipped / evicted by the byte bounds.  ``trace`` and
+# ``metrics`` qualify: a dump/snapshot reply can run to megabytes (a
+# span dump is bounded only by MAX_SPANS) and re-running either is
+# harmless (start/stop replies are tiny, so they stay cached and
+# idempotent regardless).
+_READ_ONLY = frozenset({"get_var", "get_namespace_info", "get_status",
+                        "trace", "metrics"})
+
+
+def _json_size(v) -> int:
+    """Approximate in-memory size of a JSON-able value, recursing into
+    containers — a span dump is a deeply nested list of dicts, and
+    sizing only top-level strings would account a multi-MB reply as a
+    few bytes, making the byte bounds inert."""
+    if isinstance(v, (str, bytes)):
+        return len(v)
+    if isinstance(v, dict):
+        return sum(len(k) + _json_size(x) for k, x in v.items()) + 2
+    if isinstance(v, (list, tuple)):
+        return sum(_json_size(x) for x in v) + 2
+    return 8  # number / bool / None
 
 
 def _reply_bytes(reply) -> int:
     total = 0
     for v in getattr(reply, "bufs", {}).values():
         total += getattr(v, "nbytes", None) or len(v)
-    data = getattr(reply, "data", None)
-    if isinstance(data, (str, bytes)):
-        total += len(data)
-    elif isinstance(data, dict):
-        # Reply data is a small JSON-able dict; the only large member
-        # in practice is execute's "output"/"traceback" repr strings.
-        total += sum(len(v) for v in data.values()
-                     if isinstance(v, (str, bytes)))
-    return total
+    return total + _json_size(getattr(reply, "data", None))
 
 
 class ReplayCache:

@@ -49,6 +49,8 @@ class FaultPlan:
     """One deterministic chaos schedule.  Thread-safe; counters record
     what actually happened for ``%dist_chaos status`` and assertions."""
 
+    MAX_EVENTS = 4096  # injected-decision log bound (~0.5 MB worst case)
+
     def __init__(self, *, seed: int = 0, drop: float = 0.0,
                  delay_p: float = 0.0, delay_s: float = 0.02,
                  duplicate: float = 0.0, truncate: float = 0.0,
@@ -76,6 +78,11 @@ class FaultPlan:
         self._index = 0
         self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
                          "duplicated": 0, "truncated": 0, "exempt": 0}
+        # Timestamped record of every non-clean decision, bounded, for
+        # the observability layer: the merged Chrome trace folds these
+        # in as instant events so a chaos run shows WHERE the drops
+        # and duplicates landed relative to the requests they afflict.
+        self._events: list[dict] = []
 
     # ------------------------------------------------------------------
     # construction / description
@@ -145,6 +152,9 @@ class FaultPlan:
             self._index += 1
         acts = self.decide(index)
         with self._lock:
+            if acts and len(self._events) < self.MAX_EVENTS:
+                self._events.append({"ts": time.time(), "index": index,
+                                     "actions": list(acts), "kind": kind})
             if "drop" in acts:
                 self.counters["dropped"] += 1
                 return
@@ -163,6 +173,12 @@ class FaultPlan:
         send(frame)
         if "duplicate" in acts:
             send(frame)
+
+    def events(self) -> list[dict]:
+        """Timestamped injected decisions (``{ts, index, actions,
+        kind}``) for trace export; JSON-able."""
+        with self._lock:
+            return [dict(e) for e in self._events]
 
     # ------------------------------------------------------------------
     # process-level faults (worker loop)

@@ -62,6 +62,11 @@ class Supervisor:
         self._heal_fn = heal
         self._clock = clock
         self.events: deque[dict] = deque(maxlen=256)
+        # Monotonic count of recorded events: the deque above is
+        # bounded (display/debugging), so totals must not be derived
+        # from its length (a crash-looping world would saturate at the
+        # maxlen and report a frozen number).
+        self.transitions = 0
         self.heals_done = 0
         self.heals_failed = 0
         self._state: dict[int, str] = {}
@@ -146,6 +151,7 @@ class Supervisor:
             return
         if rank is not None:
             self._state[rank] = to
+        self.transitions += 1
         self.events.append({"ts": self._clock(), "rank": rank,
                             "from": frm, "to": to, "detail": detail})
 
@@ -205,6 +211,7 @@ class Supervisor:
                     > self.policy.restart_window_s):
                 self._restarts.popleft()
             if len(self._restarts) >= self.policy.max_restarts:
+                self.transitions += 1
                 self.events.append({
                     "ts": now, "rank": None, "from": DEAD, "to": DEAD,
                     "detail": (f"restart budget exhausted "
@@ -236,6 +243,7 @@ class Supervisor:
             # stop() raced the (slow) respawn: the heal callback may
             # have brought a world up that nobody is supervising now.
             # Don't rebind — surface it so the operator can decide.
+            self.transitions += 1
             self.events.append({
                 "ts": self._clock(), "rank": None,
                 "from": HEALING, "to": ALIVE,
@@ -270,6 +278,7 @@ class Supervisor:
                     "auto_heal": self.policy.auto_heal,
                     "heals_done": self.heals_done,
                     "heals_failed": self.heals_failed,
+                    "transitions": self.transitions,
                     "events": list(self.events)}
 
     def describe(self) -> str:

@@ -26,6 +26,8 @@ import time
 import traceback
 from typing import Any, Callable
 
+from ..observability.spans import maybe_span
+
 StreamFn = Callable[[str, str], None]  # (text, stream_kind) -> None
 
 
@@ -95,6 +97,13 @@ def execute_cell(code: str, namespace: dict, stream_fn: StreamFn | None = None,
     t0 = time.perf_counter()
     result_value: Any = None
     has_result = False
+    # Span around the user code itself (a child of the worker's
+    # handler-dispatch span), so a merged trace separates cell compute
+    # from control-plane handling.  No-op unless a trace is active.
+    cell_span = maybe_span("cell", kind="cell",
+                           attrs={"rank": rank,
+                                  "code": code.strip()[:120]})
+    cell_span.__enter__()
     try:
         try:
             # Path (a): whole cell is a single expression.
@@ -155,4 +164,5 @@ def execute_cell(code: str, namespace: dict, stream_fn: StreamFn | None = None,
             "duration_s": time.perf_counter() - t0,
         }
     finally:
+        cell_span.__exit__(None, None, None)
         sys.stdout = old_stdout

@@ -31,6 +31,8 @@ import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
+from ..observability import metrics as obs_metrics
+from ..observability import spans as obs_spans
 from ..resilience.dedup import ReplayCache
 from ..resilience.faults import FaultPlan
 from . import collective_guard, executor, introspect
@@ -66,6 +68,13 @@ class DistributedWorker:
         self._fault_plan = fault_plan
         self._install_plan: tuple | None = None  # armed by %dist_chaos
         self._msg_seen = 0  # control messages received (kill index)
+        # Observability: the process tracer (enabled by the 'trace'
+        # control message), wire-frame accounting, and the directory
+        # the ACTIVE jax.profiler trace was started with (None = not
+        # profiling — the idempotence state for _handle_profile).
+        self._tracer = obs_spans.tracer()
+        obs_metrics.install_wire_hook()
+        self._profile_dir: str | None = None
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -253,6 +262,13 @@ class DistributedWorker:
             ops = collective_guard.end_cell()
         result["collective_ops"] = ops
         result["cell_sha1"] = collective_guard.cell_hash(code)
+        reg = obs_metrics.registry()
+        reg.counter("nbd_cells_total", "cells executed", {
+            "status": "error" if result.get("error") else "success",
+        }).inc()
+        reg.histogram("nbd_cell_seconds",
+                      "per-cell user-code duration").observe(
+            result.get("duration_s", 0.0))
         return msg.reply(data=result, rank=self.rank)
 
     def _handle_get_var(self, msg: Message) -> Message:
@@ -330,6 +346,13 @@ class DistributedWorker:
         plan = self._fault_plan
         if plan is not None:
             data["fault_counters"] = dict(plan.counters)
+        # Observability state: until these fields, there was no way to
+        # tell from the coordinator that a profiler trace or a span
+        # trace was left running on a worker.
+        data["profiling"] = self._profile_dir
+        data["tracing"] = self._tracer.enabled
+        if self._tracer.enabled:
+            data["trace_spans"] = len(self._tracer)
         return msg.reply(data=data, rank=self.rank)
 
     def _handle_chaos(self, msg: Message) -> Message:
@@ -438,12 +461,18 @@ class DistributedWorker:
                     path, self.namespace, names, rank=self.rank,
                     world_size=self.world_size)
                 return msg.reply(data=reply, rank=self.rank)
-            summary = checkpoint.save(path, self.namespace, names,
-                                      rank=self.rank,
-                                      world_size=self.world_size)
+            with obs_spans.maybe_span("checkpoint/save",
+                                      kind="checkpoint",
+                                      attrs={"path": path}):
+                summary = checkpoint.save(path, self.namespace, names,
+                                          rank=self.rank,
+                                          world_size=self.world_size)
         elif action == "restore":
-            summary = checkpoint.restore(path, self.namespace, names,
-                                         rank=self.rank)
+            with obs_spans.maybe_span("checkpoint/restore",
+                                      kind="checkpoint",
+                                      attrs={"path": path}):
+                summary = checkpoint.restore(path, self.namespace, names,
+                                             rank=self.rank)
         else:
             return msg.reply(data={"error": f"unknown checkpoint action "
                                             f"{action!r}"}, rank=self.rank)
@@ -451,16 +480,103 @@ class DistributedWorker:
                          rank=self.rank)
 
     def _handle_profile(self, msg: Message) -> Message:
+        """jax.profiler start/stop, idempotent.  ``_profile_dir`` is
+        the source of truth for "a trace is running" — a second start
+        and a stop-without-start reply with a clear ``{status, error}``
+        instead of the opaque profiler traceback, and stop reports the
+        directory the trace was actually STARTED with rather than
+        trusting the stop message's ``log_dir``."""
         import jax
         action = msg.data.get("action")
-        log_dir = f"{msg.data.get('log_dir', '/tmp/nbd_profile')}" \
-                  f"/rank{self.rank}"
         if action == "start":
-            jax.profiler.start_trace(log_dir)
+            if self._profile_dir is not None:
+                return msg.reply(
+                    data={"status": "profiling",
+                          "log_dir": self._profile_dir,
+                          "error": "a profiler trace is already running "
+                                   f"(started with {self._profile_dir}); "
+                                   "stop it first"},
+                    rank=self.rank)
+            log_dir = f"{msg.data.get('log_dir', '/tmp/nbd_profile')}" \
+                      f"/rank{self.rank}"
+            try:
+                jax.profiler.start_trace(log_dir)
+            except Exception as e:
+                return msg.reply(data={"status": "idle",
+                                       "error": f"start_trace failed: {e}"},
+                                 rank=self.rank)
+            self._profile_dir = log_dir
             return msg.reply(data={"status": "profiling",
                                    "log_dir": log_dir}, rank=self.rank)
-        jax.profiler.stop_trace()
-        return msg.reply(data={"status": "stopped", "log_dir": log_dir},
+        if action == "stop":
+            if self._profile_dir is None:
+                return msg.reply(
+                    data={"status": "idle",
+                          "error": "no profiler trace is running"},
+                    rank=self.rank)
+            log_dir, self._profile_dir = self._profile_dir, None
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return msg.reply(data={"status": "idle",
+                                       "log_dir": log_dir,
+                                       "error": f"stop_trace failed: {e}"},
+                                 rank=self.rank)
+            return msg.reply(data={"status": "stopped",
+                                   "log_dir": log_dir}, rank=self.rank)
+        return msg.reply(data={"error": f"unknown profile action "
+                                        f"{action!r}"}, rank=self.rank)
+
+    # ------------------------------------------------------------------
+    # observability handlers (ISSUE 2)
+
+    def _handle_trace(self, msg: Message) -> Message:
+        """Span-trace control: ``start`` (adopting the coordinator's
+        trace id so all processes share one), ``stop``, ``dump``
+        (spans + instants + this plan's fault events, for the merged
+        export), ``status``."""
+        data = msg.data or {}
+        action = data.get("action", "status")
+        tr = self._tracer
+        if action == "start":
+            tid = tr.start(trace_id=data.get("trace_id"))
+            return msg.reply(data={"status": "tracing", "trace_id": tid},
+                             rank=self.rank)
+        if action == "stop":
+            n = tr.stop()
+            return msg.reply(data={"status": "stopped", "spans": n},
+                             rank=self.rank)
+        if action == "dump":
+            plan = self._fault_plan
+            return msg.reply(
+                data={"status": "ok", "trace": tr.dump(),
+                      "fault_events": plan.events() if plan is not None
+                      else []},
+                rank=self.rank)
+        return msg.reply(
+            data={"status": "tracing" if tr.enabled else "off",
+                  "spans": len(tr), "trace_id": tr.trace_id},
+            rank=self.rank)
+
+    def _handle_metrics(self, msg: Message) -> Message:
+        """Snapshot the process metrics registry, mirroring the
+        resilience counters (dedup hits, fault injections) into it
+        first so one export carries everything."""
+        reg = obs_metrics.registry()
+        reg.gauge("nbd_dedup_hits",
+                  "redelivered requests answered from the replay "
+                  "cache").set(self._replay.hits)
+        plan = self._fault_plan
+        if plan is not None:
+            for action, n in plan.counters.items():
+                reg.gauge("nbd_fault_injections",
+                          "fault-plan decisions by action",
+                          {"action": action}).set(n)
+        if (msg.data or {}).get("format") == "prometheus":
+            return msg.reply(data={"status": "ok",
+                                   "text": reg.prometheus_text()},
+                             rank=self.rank)
+        return msg.reply(data={"status": "ok", "metrics": reg.to_json()},
                          rank=self.rank)
 
     # ------------------------------------------------------------------
@@ -478,6 +594,8 @@ class DistributedWorker:
             "profile": self._handle_profile,
             "checkpoint": self._handle_checkpoint,
             "chaos": self._handle_chaos,
+            "trace": self._handle_trace,
+            "metrics": self._handle_metrics,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*
@@ -521,6 +639,10 @@ class DistributedWorker:
                 # frame): answer from the replay cache — NEVER run a
                 # request twice (a re-run execute would double-apply
                 # user state mutations).
+                self._tracer.instant(f"dedup/{msg.msg_type}",
+                                     kind="dedup",
+                                     attrs={"msg_id": msg.msg_id,
+                                            "attempt": msg.attempt})
                 try:
                     self.channel.send(cached)
                 except Exception:
@@ -528,6 +650,20 @@ class DistributedWorker:
                 continue
             handler = handlers.get(msg.msg_type)
             self._busy = (msg.msg_type, time.time())
+            # Dispatch span: a child of the coordinator's send span
+            # when the request carried the wire trace context, a root
+            # span otherwise.  Activated around the handler so inner
+            # spans (cell execution, checkpoint IO, collectives called
+            # from user code) nest under it.
+            tr = self._tracer
+            span = None
+            if tr.enabled:
+                ctx = msg.trace or {}
+                span = tr.begin(f"handle/{msg.msg_type}", kind="worker",
+                                trace_id=ctx.get("tid"),
+                                parent_id=ctx.get("sid"),
+                                attrs={"msg_id": msg.msg_id,
+                                       "attempt": msg.attempt})
             try:
                 if handler is None:
                     reply = msg.reply(
@@ -535,10 +671,11 @@ class DistributedWorker:
                                        f"{msg.msg_type!r}"},
                         rank=self.rank)
                 elif gate.main_thread():
-                    with gate.window():
+                    with gate.window(), tr.activate(span):
                         reply = handler(msg)
                 else:
-                    reply = handler(msg)
+                    with tr.activate(span):
+                        reply = handler(msg)
             except KeyboardInterrupt:
                 # Interrupt racing a non-execute handler: report and
                 # keep serving (execute handles its own, in executor).
@@ -551,6 +688,7 @@ class DistributedWorker:
                     rank=self.rank)
             finally:
                 self._busy = None
+                tr.end(span)
             self._replay.put(msg, reply)
             try:
                 self.channel.send(reply)  # gate closed: frame is atomic

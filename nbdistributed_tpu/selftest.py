@@ -209,6 +209,42 @@ _ssrv.run_until_done(max_steps=20)
                   "exactly-once execute)",
                   out == {0: "10", 1: "10"},
                   f"{out} dedup_hits={dedup}")
+
+        # Observability smoke (gated: NBD_SELFTEST_OBS=1): trace a
+        # 2-rank cell end-to-end and assert the merged Chrome-trace
+        # export carries spans from the coordinator AND every rank,
+        # stitched under one trace id.
+        if os.environ.get("NBD_SELFTEST_OBS"):
+            from nbdistributed_tpu.observability import export as _obs_exp
+            comm.send_to_all("trace", {"action": "start",
+                                       "trace_id": "selftest0trace00"},
+                             timeout=60)
+            comm.tracer.start(trace_id="selftest0trace00")
+            comm.send_to_all(
+                "execute", "float(all_reduce(jnp.ones(2))[0])",
+                timeout=180)
+            comm.tracer.stop()
+            dumps = comm.send_to_all("trace", {"action": "dump"},
+                                     timeout=60)
+            comm.send_to_all("trace", {"action": "stop"}, timeout=60)
+            merged = _obs_exp.merge_trace(
+                comm.tracer.dump(),
+                {r: m.data.get("trace") or {} for r, m in dumps.items()},
+                comm.clock.offsets())
+            spans = [e for e in merged["traceEvents"]
+                     if e.get("ph") == "X"]
+            pids = {e["pid"] for e in spans}
+            names = {e["name"] for e in spans}
+            check("observability (2-rank traced cell, merged export)",
+                  {-1, 0, 1} <= pids and "handle/execute" in names
+                  and any(n.startswith("send/") for n in names),
+                  f"pids={sorted(pids)} names={sorted(names)[:8]}")
+            m0 = comm.send_to_ranks([0], "metrics", {}, timeout=60)[0]
+            mj = m0.data.get("metrics", {})
+            check("observability (rank metrics registry exports)",
+                  any(k.startswith("nbd_wire_messages_total")
+                      for k in mj.get("counters", {})),
+                  repr(sorted(mj.get("counters", {}))[:6]))
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

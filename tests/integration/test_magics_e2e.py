@@ -377,6 +377,108 @@ def test_timeline_sidecar_flushes_and_hook_embeds(ip, capsys, tmp_path):
     capsys.readouterr()
 
 
+def test_dist_trace_magic_records_and_saves(ip, capsys, tmp_path):
+    """%dist_trace start → traced cell → save: the merged Chrome-trace
+    file carries coordinator AND both ranks' spans, and the timeline
+    record of the traced cell carries the cell span's ids."""
+    import json
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    ip.run_line_magic("dist_trace", "start")
+    out = capsys.readouterr().out
+    assert "tracing ON" in out
+    run(ip, "traced_v = rank * 3\ntraced_v")
+    capsys.readouterr()
+    # Let an IDLE heartbeat land (2 s cadence): %dist_status skips the
+    # get_status probe for ranks whose last ping carried busy state,
+    # and the per-rank tracing marker rides that probe.
+    import time as _time
+    _time.sleep(2.5)
+    ip.run_line_magic("dist_status", "")
+    out = capsys.readouterr().out
+    assert "span trace active" in out
+    assert "📡 tracing (" in out  # per-rank marker from get_status
+    ip.run_line_magic("dist_trace", "status")
+    out = capsys.readouterr().out
+    assert "tracing ON" in out and "rank 0" in out
+    path = tmp_path / "magic_trace.json"
+    ip.run_line_magic("dist_trace", f"save {path}")
+    out = capsys.readouterr().out
+    assert "events →" in out and "perfetto" in out
+    trace = json.loads(path.read_text())
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} >= {-1, 0, 1}
+    names = {e["name"] for e in spans}
+    assert "cell/distributed" in names and "handle/execute" in names \
+        and "cell" in names
+    # the timeline record of the traced cell names its span
+    rec = next(r for r in DistributedMagics._timeline.records
+               if "traced_v" in r.code and r.kind == "distributed")
+    assert rec.span_id is not None
+    assert any(e["args"].get("span_id") == rec.span_id for e in spans)
+    ip.run_line_magic("dist_trace", "stop")
+    out = capsys.readouterr().out
+    assert "tracing OFF" in out
+
+
+def test_dist_metrics_magic_reports(ip, capsys, tmp_path):
+    import json
+
+    ip.run_line_magic("dist_metrics", "")
+    out = capsys.readouterr().out
+    assert "coordinator: wire" in out
+    assert "rank 0: cells" in out and "rank 1: cells" in out
+    path = tmp_path / "metrics.json"
+    ip.run_line_magic("dist_metrics", f"--save {path}")
+    capsys.readouterr()
+    snap = json.loads(path.read_text())
+    assert "coordinator" in snap and set(snap["ranks"]) == {"0", "1"}
+    assert any(k.startswith("nbd_wire_messages_total")
+               for k in snap["ranks"]["0"]["counters"])
+    ip.run_line_magic("dist_metrics", "--prom")
+    out = capsys.readouterr().out
+    assert "# TYPE nbd_wire_messages_total counter" in out
+
+
+def test_profile_handler_idempotent(ip, tmp_path):
+    """Satellite of ISSUE 2: stop-without-start and double-start reply
+    with clear {status, error} instead of crashing the handler, and
+    stop reports the directory the trace was STARTED with."""
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    comm = DistributedMagics._comm
+    resp = comm.send_to_all("profile", {"action": "stop"}, timeout=60)
+    for m in resp.values():
+        assert m.data["status"] == "idle"
+        assert "no profiler trace" in m.data["error"]
+    d1 = str(tmp_path / "prof1")
+    resp = comm.send_to_all("profile", {"action": "start",
+                                        "log_dir": d1}, timeout=60)
+    started = {r: m.data for r, m in resp.items()}
+    ok = all(d["status"] == "profiling" and "error" not in d
+             for d in started.values())
+    if ok:
+        # second start: clear error, original dir reported
+        resp = comm.send_to_all("profile", {"action": "start",
+                                            "log_dir": "/tmp/other"},
+                                timeout=60)
+        for r, m in resp.items():
+            assert "already running" in m.data["error"]
+            assert m.data["log_dir"] == started[r]["log_dir"]
+        # stop reports the ACTUAL start dir, not the stop message's
+        resp = comm.send_to_all("profile", {"action": "stop",
+                                            "log_dir": "/tmp/bogus"},
+                                timeout=60)
+        for r, m in resp.items():
+            assert m.data["status"] == "stopped"
+            assert m.data["log_dir"] == started[r]["log_dir"]
+    # and a second stop is clean either way
+    resp = comm.send_to_all("profile", {"action": "stop"}, timeout=60)
+    for m in resp.values():
+        assert m.data["status"] == "idle"
+
+
 def test_dist_chaos_and_supervise_magics(ip, capsys):
     """Notebook surface of the resilience stack: %dist_chaos arms /
     reports / clears fault plans on both sides (duplicate-only, so the

@@ -1,0 +1,383 @@
+"""Unit tests for the observability layer (ISSUE 2): span tracer
+semantics, metrics registry (counter/histogram + Prometheus golden),
+NTP-style clock-offset estimation on synthetic RTTs, Chrome-trace
+export roundtrip, and the codec's optional ``tr`` header."""
+
+import json
+import struct
+
+import pytest
+
+from nbdistributed_tpu.messaging import codec
+from nbdistributed_tpu.observability.clock import ClockEstimator
+from nbdistributed_tpu.observability.export import merge_trace, save_trace
+from nbdistributed_tpu.observability.metrics import (MetricsRegistry,
+                                                     registry)
+from nbdistributed_tpu.observability.spans import Tracer
+
+pytestmark = [pytest.mark.unit, pytest.mark.obs]
+
+
+# ---------------------------------------------------------------------
+# spans
+
+
+def test_tracer_disabled_records_nothing():
+    tr = Tracer()
+    assert tr.begin("x") is None
+    with tr.span("y") as s:
+        assert s is None
+    tr.instant("z")
+    assert len(tr) == 0
+    assert tr.context() is None  # no wire header when off
+
+
+def test_tracer_nesting_and_ids():
+    tr = Tracer()
+    tid = tr.start()
+    with tr.span("outer", kind="coordinator") as outer:
+        assert outer.trace_id == tid
+        with tr.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == tid
+    dump = tr.dump()
+    assert {s["name"] for s in dump["spans"]} == {"outer", "inner"}
+    # inner ended first (stack order) and both have durations set
+    assert all(s["dur"] >= 0.0 for s in dump["spans"])
+
+
+def test_tracer_explicit_wire_parent_wins():
+    tr = Tracer()
+    tr.start()
+    sp = tr.begin("handle/execute", trace_id="remotetid", parent_id="abc")
+    tr.end(sp)
+    d = tr.dump()["spans"][0]
+    assert d["trace_id"] == "remotetid" and d["parent_id"] == "abc"
+
+
+def test_tracer_activate_crosses_threads():
+    import threading
+    tr = Tracer()
+    tr.start()
+    parent = tr.begin("cell/distributed")
+    child_parent = []
+
+    def work():
+        with tr.activate(parent):
+            sp = tr.begin("send/execute")
+            child_parent.append(sp.parent_id)
+            tr.end(sp)
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+    tr.end(parent)
+    assert child_parent == [parent.span_id]
+
+
+def test_tracer_start_clears_and_stop_keeps():
+    tr = Tracer()
+    tr.start()
+    tr.end(tr.begin("a"))
+    assert tr.stop() == 1
+    assert len(tr) == 1          # buffered for dump after stop
+    tr.start()
+    assert len(tr) == 0          # new session clears
+
+
+def test_tracer_span_cap():
+    from nbdistributed_tpu.observability import spans as spans_mod
+    tr = Tracer()
+    tr.start()
+    old = spans_mod.MAX_SPANS
+    spans_mod.MAX_SPANS = 3  # the cap is read at end() time
+    try:
+        for _ in range(5):
+            tr.end(tr.begin("s"))
+    finally:
+        spans_mod.MAX_SPANS = old
+    assert len(tr) == 3
+    assert tr.dump()["dropped"] == 2
+
+
+def test_context_carries_current_span():
+    tr = Tracer()
+    tr.start()
+    with tr.span("outer") as s:
+        ctx = tr.context()
+        assert ctx == {"tid": s.trace_id, "sid": s.span_id}
+
+
+# ---------------------------------------------------------------------
+# codec tr header
+
+
+def test_codec_trace_header_roundtrip():
+    m = codec.Message(msg_type="execute", data={"code": "1"},
+                      trace={"tid": "t1", "sid": "s1"})
+    out = codec.decode(codec.encode(m))
+    assert out.trace == {"tid": "t1", "sid": "s1"}
+
+
+def test_codec_no_trace_no_header():
+    """The acceptance bar: no wire header emitted unless a trace is
+    active — untraced frames stay byte-identical to the old format."""
+    frame = codec.encode(codec.Message(msg_type="execute", data="x"))
+    hlen = struct.unpack_from("<4sIQ", frame, 0)[1]
+    header = json.loads(bytes(frame[codec.HEADER_SIZE:
+                                    codec.HEADER_SIZE + hlen]))
+    assert "tr" not in header
+    assert codec.decode(frame).trace is None
+
+
+# ---------------------------------------------------------------------
+# metrics registry
+
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("hits", "help text")
+    c.inc()
+    c.inc(2)
+    assert reg.counter("hits").value == 3  # get-or-create returns same
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        reg.gauge("hits")  # kind clash is an error, not silent
+
+
+def test_labeled_series_are_independent():
+    reg = MetricsRegistry()
+    reg.counter("msgs", labels={"dir": "tx"}).inc(5)
+    reg.counter("msgs", labels={"dir": "rx"}).inc(7)
+    j = reg.to_json()
+    assert j["counters"]['msgs{dir="tx"}'] == 5
+    assert j["counters"]['msgs{dir="rx"}'] == 7
+
+
+def test_histogram_bucket_placement():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    cum = dict(h.cumulative())
+    assert cum["0.01"] == 1
+    assert cum["0.1"] == 3
+    assert cum["1"] == 4
+    assert cum["+Inf"] == 5
+    assert h.count == 5
+    assert abs(h.sum - 5.605) < 1e-9
+
+
+def test_prometheus_text_golden():
+    reg = MetricsRegistry()
+    reg.counter("nbd_wire_bytes_total", "bytes moved",
+                {"dir": "tx"}).inc(1024)
+    reg.gauge("nbd_dedup_hits").set(2)
+    reg.histogram("nbd_cell_seconds", "cell time",
+                  buckets=(0.1, 1.0)).observe(0.5)
+    # Golden: series sorted by name, HELP only where help was given.
+    expected = (
+        '# HELP nbd_cell_seconds cell time\n'
+        '# TYPE nbd_cell_seconds histogram\n'
+        'nbd_cell_seconds_bucket{le="0.1"} 0\n'
+        'nbd_cell_seconds_bucket{le="1"} 1\n'
+        'nbd_cell_seconds_bucket{le="+Inf"} 1\n'
+        'nbd_cell_seconds_sum 0.5\n'
+        'nbd_cell_seconds_count 1\n'
+        '# TYPE nbd_dedup_hits gauge\n'
+        'nbd_dedup_hits 2\n'
+        '# HELP nbd_wire_bytes_total bytes moved\n'
+        '# TYPE nbd_wire_bytes_total counter\n'
+        'nbd_wire_bytes_total{dir="tx"} 1024\n'
+    )
+    assert reg.prometheus_text() == expected
+
+
+def test_wire_hook_counts_actual_socket_writes():
+    """tx is counted per ACTUAL socket write (fan-out = one per rank;
+    chaos drops = zero, duplicates = two), rx per decoded frame."""
+    import threading
+
+    from nbdistributed_tpu.messaging.transport import (
+        CoordinatorListener, WorkerChannel)
+    from nbdistributed_tpu.observability.metrics import install_wire_hook
+    from nbdistributed_tpu.resilience.faults import FaultPlan
+
+    install_wire_hook()
+    reg = registry()
+
+    def total(name, direction):
+        return sum(v for k, v in reg.to_json()["counters"].items()
+                   if k.startswith(name) and f'dir="{direction}"' in k)
+
+    listener = CoordinatorListener()
+    connected = threading.Event()
+    ranks: set = set()
+
+    def on_conn(r):
+        ranks.add(r)
+        if len(ranks) == 2:
+            connected.set()
+
+    listener.on_connect = on_conn
+    listener.start()
+    chans = [WorkerChannel("127.0.0.1", listener.port, rank=r)
+             for r in (0, 1)]
+    try:
+        assert connected.wait(10)
+        # fan-out: one encode, TWO socket writes -> two tx counts
+        before = total("nbd_wire_messages_total", "tx")
+        listener.send_to_ranks([0, 1],
+                               codec.Message(msg_type="execute", data="x"))
+        assert total("nbd_wire_messages_total", "tx") == before + 2
+        # duplicate plan: one send call -> two actual writes
+        listener.fault_plan = FaultPlan(duplicate=1.0, exempt=())
+        before = total("nbd_wire_messages_total", "tx")
+        listener.send_to_rank(0, codec.Message(msg_type="execute"))
+        assert total("nbd_wire_messages_total", "tx") == before + 2
+        # drop plan: the frame never touched a socket -> zero counts
+        listener.fault_plan = FaultPlan(drop=1.0, exempt=())
+        before = total("nbd_wire_messages_total", "tx")
+        listener.send_to_rank(0, codec.Message(msg_type="execute"))
+        assert total("nbd_wire_messages_total", "tx") == before
+        listener.fault_plan = None
+        # rx side: each frame the worker channel decodes counts once
+        before = total("nbd_wire_messages_total", "rx")
+        before_b = total("nbd_wire_bytes_total", "rx")
+        msg = chans[0].recv(timeout=10)
+        assert msg.msg_type == "execute"
+        assert total("nbd_wire_messages_total", "rx") == before + 1
+        assert total("nbd_wire_bytes_total", "rx") > before_b
+    finally:
+        for c in chans:
+            c.close()
+        listener.close()
+
+
+# ---------------------------------------------------------------------
+# clock offset estimation
+
+
+def test_clock_estimator_recovers_offset_from_noisy_rtts():
+    import random
+    rng = random.Random(7)
+    est = ClockEstimator()
+    true_offset = 0.350  # worker clock runs 350 ms ahead
+    t = 1000.0
+    for _ in range(200):
+        t += rng.uniform(0.01, 0.05)
+        # asymmetric network + handler time: the reply stamp sits
+        # somewhere inside the interval, not at the midpoint
+        up = rng.uniform(0.0005, 0.003)
+        handler = rng.expovariate(1 / 0.002)
+        down = rng.uniform(0.0005, 0.003)
+        t_send = t
+        t_remote = t_send + up + handler + true_offset
+        t_recv = t_send + up + handler + down
+        est.add(1, t_send, t_remote, t_recv)
+    assert abs(est.offset(1) - true_offset) < 0.005
+    stats = est.stats()[1]
+    assert stats["samples"] == 200
+    assert stats["min_rtt_s"] is not None
+
+
+def test_clock_estimator_defaults_and_negative_rtt():
+    est = ClockEstimator()
+    assert est.offset(3) == 0.0          # no samples: identity merge
+    est.add(0, 100.0, 100.5, 99.0)       # clock stepped: rejected
+    assert est.offsets() == {}
+    est.add(0, 100.0, 100.2, 100.01)
+    assert abs(est.offset(0) - 0.195) < 1e-9
+
+
+def test_clock_estimator_keeps_lowest_rtt_samples():
+    est = ClockEstimator(keep=2)
+    # Two clean samples with offset ~0.1, then many inflated ones with
+    # a wild offset — the min-RTT filter must ignore the inflated ones.
+    est.add(0, 0.0, 0.105, 0.01)
+    est.add(0, 1.0, 1.105, 1.01)
+    for i in range(20):
+        est.add(0, 10.0 + i, 15.0 + i, 12.0 + i)  # rtt 2s, offset 4s
+    assert abs(est.offset(0) - 0.1) < 1e-6
+
+
+# ---------------------------------------------------------------------
+# chrome trace export
+
+
+def _dump_with(spans, instants=(), trace_id="t0"):
+    return {"trace_id": trace_id, "spans": list(spans),
+            "instants": list(instants), "dropped": 0}
+
+
+def test_merge_trace_is_valid_chrome_format(tmp_path):
+    coord = _dump_with([
+        {"name": "send/execute", "kind": "coordinator", "tid": 0,
+         "trace_id": "t0", "span_id": "c1", "t0": 100.0, "dur": 0.5},
+    ])
+    ranks = {
+        r: _dump_with([
+            {"name": "handle/execute", "kind": "worker", "tid": 0,
+             "trace_id": "t0", "span_id": f"w{r}", "parent_id": "c1",
+             "t0": 100.25 + 0.2, "dur": 0.1},
+        ])
+        for r in (0, 1)
+    }
+    merged = merge_trace(coord, ranks, {0: 0.2, 1: 0.2},
+                         coordinator_faults=[
+                             {"ts": 100.1, "index": 3,
+                              "actions": ["drop"], "kind": "execute"}])
+    evs = merged["traceEvents"]
+    # every event is well-formed chrome-trace (metadata events carry
+    # no timestamp, by the format)
+    for e in evs:
+        assert {"name", "ph", "pid"} <= set(e)
+        if e["ph"] != "M":
+            assert "ts" in e
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {-1, 0, 1}
+    # clock correction puts the worker span INSIDE the coordinator one
+    c = next(e for e in spans if e["pid"] == -1)
+    for r in (0, 1):
+        w = next(e for e in spans if e["pid"] == r)
+        assert c["ts"] <= w["ts"] <= c["ts"] + c["dur"]
+        # parent/span ids surfaced for Perfetto's detail pane
+        assert w["args"]["parent_id"] == "c1"
+    faults = [e for e in evs if e["ph"] == "i" and e["cat"] == "fault"]
+    assert len(faults) == 1 and faults[0]["name"] == "fault:drop"
+    # file roundtrip: valid JSON, event count excludes metadata
+    path = str(tmp_path / "trace.json")
+    n = save_trace(path, merged)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["traceEvents"] == evs
+    assert n == len([e for e in evs if e["ph"] != "M"])
+
+
+def test_merge_trace_rebases_timestamps():
+    coord = _dump_with([{"name": "a", "kind": "", "tid": 0,
+                         "trace_id": "t", "span_id": "s",
+                         "t0": 1.75e9, "dur": 0.001}])
+    merged = merge_trace(coord, {}, {})
+    ev = [e for e in merged["traceEvents"] if e["ph"] == "X"][0]
+    assert ev["ts"] == 0.0  # rebased to the earliest event
+    assert merged["otherData"]["base_unix_s"] == 1.75e9
+
+
+def test_merge_trace_empty_inputs():
+    merged = merge_trace(None, {}, {})
+    assert merged["traceEvents"] == []
+
+
+def test_fault_plan_records_timestamped_events():
+    from nbdistributed_tpu.resilience.faults import FaultPlan
+    plan = FaultPlan(seed=3, drop=1.0)  # every frame dropped
+    sent = []
+    plan.transmit(b"xxxx", sent.append, kind="execute")
+    assert sent == []
+    evs = plan.events()
+    assert len(evs) == 1
+    assert evs[0]["actions"] == ["drop"]
+    assert evs[0]["kind"] == "execute"
+    assert evs[0]["ts"] > 0
