@@ -955,6 +955,91 @@ def _exec_measure(comm, name: str, cell: str, timeout: int) -> dict | None:
     return out
 
 
+def measure_flight_recorder(comm, echoes: int = 40) -> dict:
+    """ISSUE 3 numbers for the BENCH json: how many events this run's
+    coordinator ring holds, the raw append cost, and the flight
+    recorder's overhead on a control-plane echo round-trip measured
+    directly — the same ``get_status`` echo with recording on
+    (default) and forced off.  The acceptance bar is < 5 %: the append
+    is microseconds against a multi-hundred-microsecond socket
+    round-trip."""
+    import statistics
+
+    from nbdistributed_tpu.observability import flightrec
+
+    out: dict = {"coordinator_events": len(comm.flight),
+                 "ring_path": getattr(comm.flight, "path", None)}
+
+    rec = flightrec.FlightRecorder(
+        os.path.join(flightrec.run_dir(), "bench-micro.ring"))
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec.record("dispatch", msg_id="0123456789abcdef",
+                   type="execute", attempt=0)
+    out["append_ns"] = round((time.perf_counter() - t0) / n * 1e9)
+    rec.close()
+
+    def _echo_s() -> float:
+        t0 = time.perf_counter()
+        comm.send_to_ranks([0], "get_status", timeout=60)
+        return time.perf_counter() - t0
+
+    def _median_echo() -> float:
+        return statistics.median(_echo_s() for _ in range(echoes))
+
+    def _worker_flight(enabled: bool) -> None:
+        # BOTH ends record on the echo path (coordinator 'send',
+        # worker 'dispatch'): the no-record leg must silence the
+        # worker's ring too or the comparison hides half the cost.
+        comm.send_to_ranks(
+            [0], "execute",
+            "import nbdistributed_tpu.observability.flightrec as _f\n"
+            f"_f.recorder().enabled = {enabled}", timeout=60)
+
+    _median_echo()                      # warm both paths
+    on_s = _median_echo()
+    comm.flight.enabled = False
+    _worker_flight(False)
+    try:
+        off_s = _median_echo()
+    finally:
+        comm.flight.enabled = True
+        _worker_flight(True)
+    out["echo_us_record"] = round(on_s * 1e6, 1)
+    out["echo_us_norecord"] = round(off_s * 1e6, 1)
+    out["echo_overhead_pct"] = round((on_s - off_s) / off_s * 100, 2) \
+        if off_s > 0 else None
+    return out
+
+
+def measure_telemetry_peaks(comm) -> dict:
+    """Peak-HBM summary from the heartbeat-piggybacked telemetry
+    snapshots the coordinator accumulated during the run — the device-
+    memory-over-time trajectory for the BENCH json."""
+    from nbdistributed_tpu.observability import telemetry as _tel
+
+    peaks = {}
+    last = {}
+    for r in range(comm.num_workers):
+        hist = comm.telemetry_history(r)
+        if not hist:
+            continue
+        p = _tel.peak_hbm(hist)
+        if p:
+            peaks[str(r)] = p
+        snap = hist[-1]
+        last[str(r)] = {k: snap.get(k)
+                        for k in ("bufs", "compiles", "compile_s")
+                        if snap.get(k) is not None}
+    out = {}
+    if peaks:
+        out["peak_hbm_bytes"] = peaks
+    if last:
+        out["last_snapshot"] = last
+    return out
+
+
 # Sentinel: measure_family could not even attach a worker — the signal
 # run_families uses to distinguish "this cell failed" (keep going) from
 # "the accelerator tunnel is gone" (stop burning attach timeouts).
@@ -1323,6 +1408,20 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
             }
         except Exception as e:
             log(f"[bench] metrics snapshot skipped: {e}")
+
+        try:
+            extra["flight_recorder"] = measure_flight_recorder(comm)
+            log(f"[bench] flight recorder: {extra['flight_recorder']}")
+        except Exception as e:
+            log(f"[bench] flight recorder measurement skipped: {e}")
+
+        try:
+            tel = measure_telemetry_peaks(comm)
+            if tel:
+                extra["telemetry"] = tel
+                log(f"[bench] telemetry peaks: {tel}")
+        except Exception as e:
+            log(f"[bench] telemetry summary skipped: {e}")
 
         # The pooled world's job is done.  Tear it down (blocking)
         # BEFORE the per-family measurements: two processes share the

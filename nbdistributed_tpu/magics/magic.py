@@ -61,7 +61,8 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
 %dist_profile start/stop · %dist_trace start/stop/save (Perfetto) ·
-%dist_metrics ·
+%dist_metrics · %dist_top (live device telemetry) ·
+%dist_postmortem (crash bundles from the flight recorder) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown
@@ -511,6 +512,20 @@ class DistributedMagics(Magics):
         # Runs on the monitor thread; a print is best-effort context.
         print(f"\n💀 worker {rank} exited (code {rc}). "
               "%dist_status / %dist_heal [--restore ckpt] / %dist_reset")
+        # Automatic postmortem: recover the dead rank's flight ring and
+        # last telemetry NOW, while the evidence is fresh.  When a
+        # supervisor is attached it owns capture (on its own thread,
+        # before the heal destroys the world); otherwise this monitor-
+        # thread capture is the only shot.
+        if DistributedMagics._supervisor is None \
+                and DistributedMagics._comm is not None:
+            from ..observability import postmortem as pm_mod
+            manifest = pm_mod.capture(
+                DistributedMagics._comm, [rank],
+                reason=f"worker {rank} exited (code {rc})")
+            if manifest is not None:
+                print(f"🛩  postmortem bundle → {manifest['dir']} "
+                      f"(%dist_postmortem --last)")
 
     @magic_arguments()
     @argument("--restore", default=None,
@@ -973,6 +988,14 @@ class DistributedMagics(Magics):
                 seen = self._comm.last_seen(rank_id)
                 if seen is not None:
                     line_txt += f" · seen {time.time() - seen:.1f}s ago"
+                # Heartbeat age as its own column: `seen` refreshes on
+                # ANY frame (a reply stream keeps it young), so a rank
+                # whose heartbeat thread froze — the early sign of a
+                # wedged host — is only visible here, before the
+                # supervisor's degraded timeout fires.
+                ping = self._comm.last_ping(rank_id)
+                line_txt += (f" · hb {time.time() - ping[0]:.1f}s"
+                             if ping is not None else " · hb –")
             print(line_txt)
         sup = DistributedMagics._supervisor
         if sup is not None:
@@ -1549,6 +1572,132 @@ class DistributedMagics(Magics):
                   + (f" · faults "
                      f"{_total(snap, 'nbd_fault_injections'):.0f}"
                      if _total(snap, "nbd_fault_injections") else ""))
+
+    # ==================================================================
+    # flight recorder: live telemetry + crash postmortems (ISSUE 3)
+
+    @staticmethod
+    def _fmt_gb(n) -> str:
+        return "-" if n is None else f"{n / 1e9:.2f}"
+
+    @line_magic
+    def dist_top(self, line):
+        """Live per-rank dashboard from the PUSH path: process state,
+        busy cell, heartbeat age, HBM in-use/limit/peak, live buffer
+        and compile counts, dedup hits — all read from heartbeat
+        piggybacks and the process table, so it renders instantly even
+        while every worker is busy mid-cell (a ``get_status`` probe
+        would stall behind the serial request loop)."""
+        if self._pm is None or self._comm is None:
+            print("❌ No cluster. %dist_init to start one.")
+            return
+        from ..runtime.worker import HEARTBEAT_INTERVAL_S
+        comm, pm = self._comm, self._pm
+        sup_states = {}
+        if DistributedMagics._supervisor is not None:
+            sup_states = DistributedMagics._supervisor.status()["states"]
+        proc = pm.get_status()
+        now = time.time()
+        print(f"⏱  cluster top · {self._world} workers · backend="
+              f"{pm.backend} · {time.strftime('%H:%M:%S')}")
+        hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}{'hb-age':<8}"
+               f"{'HBM use/limit GB':<18}{'peak':<7}{'bufs':<6}"
+               f"{'compiles':<9}{'dedup':<6}")
+        print(hdr)
+        print("─" * len(hdr))
+        for r in range(self._world):
+            p = proc.get(r) or {}
+            ping = comm.last_ping(r)
+            tel = comm.last_telemetry(r) or {}
+            if not p.get("running", False):
+                state = f"✖ dead({p.get('returncode')})"
+            elif sup_states.get(r) in ("degraded", "healing"):
+                state = "◐ " + sup_states[r]
+            elif (ping is not None
+                    and now - ping[0] > 3 * HEARTBEAT_INTERVAL_S):
+                state = "◐ stale"
+            else:
+                state = "● alive"
+            busy = "-"
+            if ping is not None and ping[1].get("busy_s") is not None:
+                busy = (f"{ping[1].get('busy_type')} "
+                        f"{ping[1]['busy_s'] + (now - ping[0]):.1f}s")
+            hb = f"{now - ping[0]:.1f}s" if ping is not None else "-"
+            from ..observability.telemetry import hbm_totals
+            hbm = hbm_totals(tel) or {}
+            mem = (f"{self._fmt_gb(hbm.get('in_use'))}"
+                   f"/{self._fmt_gb(hbm.get('limit'))}"
+                   if hbm.get("in_use") is not None else "-")
+            peak = self._fmt_gb(hbm.get("peak"))
+            print(f"{r:<5}{state:<11}{busy:<18}{hb:<8}{mem:<18}"
+                  f"{peak:<7}{str(tel.get('bufs', '-')):<6}"
+                  f"{str(tel.get('compiles', '-')):<9}"
+                  f"{str(tel.get('dedup', '-')):<6}")
+        import os as _os
+        print(f"coordinator: retries sent {comm.retries_sent} · "
+              f"run dir {_os.environ.get('NBD_RUN_DIR', '(unset)')}")
+
+    @magic_arguments()
+    @argument("--last", action="store_true",
+              help="show the newest bundle's report instead of "
+                   "capturing a fresh one")
+    @argument("--save", default=None,
+              help="capture the bundle into this directory")
+    @line_magic
+    def dist_postmortem(self, line):
+        """Crash postmortems from the always-on flight recorder.
+
+        Default: capture a fresh bundle NOW — recover every process's
+        flight ring (including rings left by dead/SIGKILLed workers),
+        attach the last heartbeat telemetry per rank, coordinator
+        spans, and fault-plan decisions, merge everything into one
+        clock-aligned Chrome trace, and print the report.  ``--last``
+        re-prints the newest existing bundle (e.g. the one the
+        supervisor captured before auto-healing); ``--save DIR``
+        captures into a directory of your choosing."""
+        args = parse_argstring(self.dist_postmortem, line)
+        from ..observability import postmortem as pm_mod
+        if args.last:
+            sup = DistributedMagics._supervisor
+            bundle = None
+            if sup is not None and sup.last_postmortem is not None:
+                bundle = sup.last_postmortem["dir"]
+            else:
+                bundles = pm_mod.list_bundles()
+                bundle = bundles[-1] if bundles else None
+            if bundle is None:
+                print("❌ no postmortem bundle captured yet in this "
+                      "run (%dist_postmortem captures one on demand)")
+                return
+            try:
+                import os as _os
+                with open(_os.path.join(bundle, "report.txt")) as f:
+                    print(f.read())
+            except OSError as e:
+                print(f"❌ could not read {bundle}: {e}")
+            return
+        if self._comm is None:
+            print("❌ no coordinator in this session — use "
+                  "%dist_postmortem --last to view an existing bundle")
+            return
+        dead = []
+        if self._pm is not None:
+            alive = set(self._pm.alive_ranks())
+            dead = sorted(set(range(self._world)) - alive)
+        manifest = pm_mod.capture(self._comm, dead, out_dir=args.save,
+                                  reason="on demand (%dist_postmortem)")
+        if manifest is None:
+            print("❌ postmortem capture failed (is the run directory "
+                  "writable?)")
+            return
+        try:
+            import os as _os
+            with open(_os.path.join(manifest["dir"], "report.txt")) as f:
+                print(f.read())
+        except OSError:
+            pass
+        print(f"✅ bundle → {manifest['dir']} (trace.json loads in "
+              f"ui.perfetto.dev)")
 
     # ==================================================================
     # timeline magics (reference: magic.py:1778-1870)

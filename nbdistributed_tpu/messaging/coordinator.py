@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable
 
+from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability.clock import ClockEstimator
@@ -36,7 +38,13 @@ from .transport import TransportError
 
 
 class WorkerDied(RuntimeError):
-    """A worker exited/disconnected while a request was pending on it."""
+    """A worker exited/disconnected while a request was pending on it.
+
+    ``msg_id`` names the pending request that was aborted (when raised
+    from one) — the postmortem layer matches it against the dead
+    rank's recovered flight ring to find the fatal dispatch."""
+
+    msg_id: str | None = None
 
 
 class _Pending:
@@ -76,12 +84,22 @@ class CommunicationManager:
         self.tracer = obs_spans.tracer()
         self.clock = ClockEstimator()
         obs_metrics.install_wire_hook()
+        # Flight recorder (always on): opening it here also mints the
+        # shared run directory and exports NBD_RUN_DIR, so workers
+        # spawned after this constructor land their rings next to ours.
+        self.flight = flightrec.init("coordinator")
+        # Push-based per-rank telemetry: the last few snapshots that
+        # rode heartbeat pings (runtime/worker.py piggybacks them) —
+        # the postmortem's "last known device state" for a dead rank.
+        self._telemetry: dict[int, deque] = {}
         # Native C++ listener when built (see messaging/native.py), the
         # pure-Python selector listener otherwise — same protocol.
         self._listener = make_listener(host=host, port=port,
                                        allow_pickle=allow_pickle,
                                        auth_token=auth_token)
         self.port = self._listener.port
+        self.flight.record("coordinator_start",
+                           num_workers=num_workers, port=self.port)
         self._lock = threading.Lock()
         self._pending: dict[str, _Pending] = {}
         self._connected: set[int] = set()
@@ -147,16 +165,38 @@ class CommunicationManager:
         with self._lock:
             return self._last_ping.get(rank)
 
+    def last_telemetry(self, rank: int) -> dict | None:
+        """The rank's newest heartbeat-piggybacked telemetry snapshot
+        (HBM, live buffers, compile activity), or None."""
+        with self._lock:
+            hist = self._telemetry.get(rank)
+            return hist[-1] if hist else None
+
+    def telemetry_history(self, rank: int) -> list[dict]:
+        """The last few telemetry snapshots for ``rank`` (bounded) —
+        what the postmortem bundles as the dead rank's final device
+        state."""
+        with self._lock:
+            return list(self._telemetry.get(rank) or ())
+
     def mark_worker_dead(self, rank: int) -> None:
         """Called by the process monitor when a worker process exits.
         Aborts every pending request still expecting this rank."""
         with self._lock:
+            newly = rank not in self._dead
             self._dead.add(rank)
-            pendings = [p for p in self._pending.values() if rank in p.expect
-                        and rank not in p.responses]
-        for p in pendings:
-            p.failure = WorkerDied(f"worker {rank} died while a request "
-                                   "was pending")
+            pendings = [(mid, p) for mid, p in self._pending.items()
+                        if rank in p.expect and rank not in p.responses]
+        if newly:
+            self.flight.record("worker_dead", rank=rank,
+                               pending=[mid for mid, _ in pendings])
+        for mid, p in pendings:
+            failure = WorkerDied(f"worker {rank} died while a request "
+                                 "was pending")
+            # Which request died with it — the postmortem matches this
+            # id against the dead rank's recovered dispatch events.
+            failure.msg_id = mid
+            p.failure = failure
             p.event.set()
 
     # ------------------------------------------------------------------
@@ -218,6 +258,8 @@ class CommunicationManager:
                     else time.monotonic() + timeout)
         try:
             pending.sent_at = time.time()
+            self.flight.record("send", msg_id=msg.msg_id, type=msg_type,
+                               ranks=list(ranks))
             self._listener.send_to_ranks(list(ranks), msg)
             complete = False
             for attempt in range(1, attempts + 1):
@@ -228,6 +270,9 @@ class CommunicationManager:
                                              - set(pending.responses))
                     msg.attempt = attempt - 1
                     try:
+                        self.flight.record("retry", msg_id=msg.msg_id,
+                                           attempt=msg.attempt,
+                                           ranks=missing_now)
                         self._listener.send_to_ranks(missing_now, msg)
                         self.retries_sent += 1
                         obs_metrics.registry().counter(
@@ -326,8 +371,13 @@ class CommunicationManager:
                 pending.event.set()
             return
         if msg.msg_type == "ping":
+            data = msg.data or {}
             with self._lock:
-                self._last_ping[rank] = (time.time(), msg.data or {})
+                self._last_ping[rank] = (time.time(), data)
+                tel = data.get("tel")
+                if tel is not None:
+                    self._telemetry.setdefault(
+                        rank, deque(maxlen=8)).append(tel)
             return
         for cb in self._notify_callbacks:
             try:

@@ -26,15 +26,34 @@ metrics from the coordinator and every rank land:
   all-rank span dumps into one Chrome-trace-event JSON
   (Perfetto-loadable, ``pid`` = rank) with :class:`FaultPlan` decisions
   folded in as instant events, so chaos runs are visually debuggable.
+- :mod:`~nbdistributed_tpu.observability.flightrec` — the ISSUE 3
+  layer the above lack: an **always-on, crash-surviving flight
+  recorder**.  Every process appends self-delimiting event records to
+  an mmap-backed ring file under the shared run directory
+  (``NBD_RUN_DIR``); a reader recovers the ring — including a torn
+  final record — from the file of a SIGKILLed process.
+- :mod:`~nbdistributed_tpu.observability.telemetry` — per-worker
+  device telemetry (HBM in-use/peak, live buffers, compile activity)
+  sampled off the hot path and piggybacked on heartbeat pings, so the
+  coordinator holds a push-based live view that works mid-cell.
+- :mod:`~nbdistributed_tpu.observability.postmortem` — assembles the
+  flight rings, last telemetry, coordinator spans, and fault events
+  into a postmortem bundle (merged Chrome trace + human report) when a
+  worker dies.
 
-Surfaced via ``%dist_trace start|stop|save`` and ``%dist_metrics``.
-Everything here is stdlib-only (no JAX import) so the coordinator side
-stays light and the modules are unit-testable without a backend.
+Surfaced via ``%dist_trace start|stop|save``, ``%dist_metrics``,
+``%dist_top``, and ``%dist_postmortem``.  Everything here is
+stdlib-only at import time (no JAX import — telemetry touches devices
+lazily) so the coordinator side stays light and the modules are
+unit-testable without a backend.
 """
 
 from .clock import ClockEstimator
+from .flightrec import FlightRecorder, read_ring
 from .metrics import MetricsRegistry, registry
 from .spans import Tracer, maybe_span, tracer
+from .telemetry import TelemetrySampler
 
-__all__ = ["ClockEstimator", "MetricsRegistry", "Tracer", "maybe_span",
+__all__ = ["ClockEstimator", "FlightRecorder", "MetricsRegistry",
+           "TelemetrySampler", "Tracer", "maybe_span", "read_ring",
            "registry", "tracer"]

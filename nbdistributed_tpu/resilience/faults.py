@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Callable
 
+from ..observability import flightrec
+
 DEFAULT_EXEMPT = ("ping",)
 
 _SPEC_KEYS = frozenset({
@@ -151,6 +153,13 @@ class FaultPlan:
             index = self._index
             self._index += 1
         acts = self.decide(index)
+        if acts:
+            # Injected decisions also land in the crash-surviving
+            # flight ring: the in-memory event log below dies with the
+            # process, and "what was chaos doing just before the kill"
+            # is a postmortem question by definition.
+            flightrec.record("fault", actions=list(acts), kind=kind,
+                             index=index)
         with self._lock:
             if acts and len(self._events) < self.MAX_EVENTS:
                 self._events.append({"ts": time.time(), "index": index,

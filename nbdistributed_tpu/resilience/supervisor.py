@@ -31,6 +31,8 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
+from ..observability import flightrec
+
 ALIVE = "alive"
 DEGRADED = "degraded"
 DEAD = "dead"
@@ -44,6 +46,10 @@ class SupervisorPolicy:
     max_restarts: int = 3
     restart_window_s: float = 600.0
     auto_heal: bool = True
+    # Assemble a postmortem bundle (observability/postmortem.py) for
+    # newly-dead ranks BEFORE healing replaces the world — the heal is
+    # what destroys the evidence a human would want afterwards.
+    postmortem: bool = True
 
 
 class Supervisor:
@@ -69,6 +75,10 @@ class Supervisor:
         self.transitions = 0
         self.heals_done = 0
         self.heals_failed = 0
+        # Newest postmortem bundle manifest captured by this
+        # supervisor (None until a death is processed).
+        self.last_postmortem: dict | None = None
+        self._postmortem_pending: set[int] = set()
         self._state: dict[int, str] = {}
         self._restarts: deque[float] = deque()
         self._comm = None
@@ -142,6 +152,7 @@ class Supervisor:
                 return
             self._transition(rank, DEAD, f"process exit (code {rc})")
             self._pending_heal = True
+            self._postmortem_pending.add(rank)
         self._wake.set()
 
     def _transition(self, rank, to: str, detail: str = "") -> None:
@@ -154,6 +165,10 @@ class Supervisor:
         self.transitions += 1
         self.events.append({"ts": self._clock(), "rank": rank,
                             "from": frm, "to": to, "detail": detail})
+        # Mirror every transition into the crash-surviving flight ring:
+        # the in-memory event deque dies with the coordinator process.
+        flightrec.record("supervisor_transition", rank=rank,
+                         frm=frm, to=to, detail=detail)
 
     # ------------------------------------------------------------------
     # loop
@@ -166,6 +181,7 @@ class Supervisor:
                 return
             try:
                 self._scan_staleness()
+                self._capture_postmortems()
                 if self._pending_heal and self.policy.auto_heal:
                     self._heal_once()
             except Exception:
@@ -199,6 +215,34 @@ class Supervisor:
                 elif age <= self.policy.degraded_after_s \
                         and st == DEGRADED:
                     self._transition(rank, ALIVE, "heartbeat resumed")
+
+    # ------------------------------------------------------------------
+    # postmortems
+
+    def _capture_postmortems(self) -> None:
+        """Bundle the newly-dead ranks' black boxes on the supervisor's
+        own thread, BEFORE any heal replaces the world.  Best-effort by
+        contract: a full postmortem disk must never block recovery."""
+        with self._lock:
+            dead = sorted(self._postmortem_pending)
+            self._postmortem_pending.clear()
+            comm = self._comm
+        if not dead or not self.policy.postmortem or comm is None:
+            return
+        try:
+            from ..observability import postmortem as pm_mod
+            manifest = pm_mod.capture(
+                comm, dead, reason=f"supervisor: ranks {dead} died")
+        except Exception:
+            manifest = None
+        if manifest is not None:
+            self.last_postmortem = manifest
+            with self._lock:
+                self.transitions += 1
+                self.events.append({
+                    "ts": self._clock(), "rank": None,
+                    "from": DEAD, "to": DEAD,
+                    "detail": f"postmortem → {manifest['dir']}"})
 
     # ------------------------------------------------------------------
     # healing
@@ -279,6 +323,8 @@ class Supervisor:
                     "heals_done": self.heals_done,
                     "heals_failed": self.heals_failed,
                     "transitions": self.transitions,
+                    "last_postmortem": (self.last_postmortem or {})
+                    .get("dir"),
                     "events": list(self.events)}
 
     def describe(self) -> str:

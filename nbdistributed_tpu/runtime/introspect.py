@@ -97,8 +97,13 @@ def _device_str(arr) -> str:
 def device_status(rank: int, world_size: int) -> dict:
     """Per-worker status snapshot: devices, memory, backend
     (reference: worker.py:509-567, with ``memory_stats()`` supplying what
-    ``torch.cuda.memory_allocated`` did)."""
+    ``torch.cuda.memory_allocated`` did).  Memory numbers come from the
+    same probe the heartbeat telemetry pushes
+    (:func:`~nbdistributed_tpu.observability.telemetry.device_memory`),
+    so the pull and push views cannot drift."""
     import jax
+
+    from ..observability.telemetry import device_memory
 
     devices = []
     for d in jax.local_devices():
@@ -107,18 +112,12 @@ def device_status(rank: int, world_size: int) -> dict:
             "platform": d.platform,
             "kind": getattr(d, "device_kind", "unknown"),
         }
-        try:
-            stats = d.memory_stats() or {}
-            limit = stats.get("bytes_limit")
-            in_use = stats.get("bytes_in_use")
-            entry["memory_gb"] = {
-                "in_use": round(in_use / 1e9, 3) if in_use is not None else None,
-                "limit": round(limit / 1e9, 3) if limit is not None else None,
-                "peak": round(stats.get("peak_bytes_in_use", 0) / 1e9, 3)
-                if stats.get("peak_bytes_in_use") is not None else None,
-            }
-        except Exception:
-            entry["memory_gb"] = None
+        mem = device_memory(d)
+        entry["memory_gb"] = None if mem is None else {
+            key: (round(mem[key] / 1e9, 3) if mem[key] is not None
+                  else None)
+            for key in ("in_use", "limit", "peak")
+        }
         devices.append(entry)
 
     return {

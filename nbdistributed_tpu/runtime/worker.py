@@ -31,8 +31,10 @@ import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
+from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
+from ..observability import telemetry as obs_telemetry
 from ..resilience.dedup import ReplayCache
 from ..resilience.faults import FaultPlan
 from . import collective_guard, executor, introspect
@@ -75,6 +77,13 @@ class DistributedWorker:
         self._tracer = obs_spans.tracer()
         obs_metrics.install_wire_hook()
         self._profile_dir: str | None = None
+        # Flight recorder: opened FIRST (before the slow jax init) so
+        # even a bring-up crash leaves a black box.  Always on; the
+        # ring file lives under the run dir the coordinator exported
+        # (NBD_RUN_DIR) and survives this process's death by SIGKILL.
+        self._flight = flightrec.init(f"rank{rank}")
+        self._flight.record("worker_start", rank=rank, pid=os.getpid(),
+                            world_size=world_size)
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -110,6 +119,13 @@ class DistributedWorker:
         # --- interactive namespace (reference: worker.py:160-177) --------
         self.namespace: dict = {}
         self._seed_namespace()
+
+        # Telemetry sampler: snapshots HBM / live buffers / compile
+        # activity off the hot path; the heartbeat thread piggybacks
+        # the snapshots so the coordinator sees device state even while
+        # the serial request loop is busy in a long cell.
+        self._telemetry = obs_telemetry.TelemetrySampler(
+            rank, extra_fn=self._telemetry_extra)
 
         # --- control plane (reference: worker.py:154-157) ----------------
         # NBD_AUTH_TOKEN: shared secret required by non-loopback
@@ -198,7 +214,11 @@ class DistributedWorker:
         main thread is doing without going through the loop.  (A
         heartbeat alone proves only the *process* lives; ``busy_s``
         growing across pings is how the coordinator tells "crunching a
-        long cell" from "idle".)"""
+        long cell" from "idle".)
+
+        Pings also piggyback a compact telemetry snapshot (HBM, live
+        buffers, compile activity — every few pings, the sampler
+        paces itself), making the coordinator's view push-based."""
         while not self._shutdown.wait(HEARTBEAT_INTERVAL_S):
             plan = self._fault_plan
             if plan is not None and plan.heartbeat_frozen():
@@ -209,10 +229,36 @@ class DistributedWorker:
                 data = {"busy_type": busy[0],
                         "busy_s": round(time.time() - busy[1], 3)}
             try:
+                snap = self._telemetry.maybe_sample()
+            except Exception:
+                snap = None
+            if snap is not None:
+                data = dict(data or {})
+                data["tel"] = snap
+            try:
                 self.channel.send(Message(msg_type="ping",
                                           rank=self.rank, data=data))
-            except Exception:
+            except Exception as e:
+                # The last thing this process can still do is say WHY
+                # the pings stopped: the coordinator sees only silence,
+                # but the flight ring survives for the postmortem.
+                obs_metrics.registry().counter(
+                    "nbd_heartbeat_send_failures",
+                    "heartbeat pings that failed to send").inc()
+                self._flight.record("heartbeat_send_failed",
+                                    error=f"{type(e).__name__}: {e}")
+                self._flight.flush()
                 return  # channel gone; main loop will notice
+
+    def _telemetry_extra(self) -> dict:
+        """Resilience counters riding each telemetry snapshot, so the
+        coordinator's push-based view (and the postmortem's last
+        snapshot) carries them without a status probe."""
+        extra = {"dedup": self._replay.hits, "msgs": self._msg_seen}
+        busy = self._busy
+        if busy is not None:
+            extra["busy"] = busy[0]
+        return extra
 
     def _send_shielded(self, msg: Message) -> None:
         """Send with interrupts deferred (main thread only — that is
@@ -254,12 +300,18 @@ class DistributedWorker:
         targets = (None if isinstance(msg.data, str)
                    else msg.data.get("target_ranks"))
         collective_guard.begin_cell(targets, self.world_size)
+        self._flight.record("cell_start", msg_id=msg.msg_id,
+                            code=code.strip()[:120])
         try:
             result = executor.execute_cell(
                 code, self.namespace, self._stream, rank=self.rank,
                 filename=f"<rank {self.rank}>")
         finally:
             ops = collective_guard.end_cell()
+        self._flight.record(
+            "cell_end", msg_id=msg.msg_id,
+            status="error" if result.get("error") else "success",
+            duration_s=round(result.get("duration_s", 0.0), 4))
         result["collective_ops"] = ops
         result["cell_sha1"] = collective_guard.cell_hash(code)
         reg = obs_metrics.registry()
@@ -370,6 +422,7 @@ class DistributedWorker:
                 return msg.reply(data={"error": f"bad fault spec: {e}"},
                                  rank=self.rank)
             self._install_plan = (plan,)
+            self._flight.record("fault_plan_armed", spec=plan.spec())
             return msg.reply(data={"status": "armed",
                                    "spec": plan.spec()}, rank=self.rank)
         if action == "clear":
@@ -433,6 +486,8 @@ class DistributedWorker:
             return msg.reply(data={"status": "done", "summary": summary},
                              rank=self.rank)
         path = msg.data["path"]
+        self._flight.record("checkpoint", action=action, path=path,
+                            background=bool(msg.data.get("background")))
         if action == "save":
             if not names:
                 return msg.reply(
@@ -625,11 +680,18 @@ class DistributedWorker:
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
             self._msg_seen += 1
+            # Flight event BEFORE the kill check: when an injected (or
+            # real) preemption lands mid-request, the ring of the dead
+            # process still names the fatal message — the postmortem's
+            # anchor fact.
+            self._flight.record("dispatch", msg_id=msg.msg_id,
+                                type=msg.msg_type, attempt=msg.attempt)
             plan = self._fault_plan
             if plan is not None and plan.should_kill(self.rank,
                                                      self._msg_seen):
                 # Injected preemption: die the way a preempted TPU VM
-                # does — no teardown, no reply, mid-request.
+                # does — no teardown, no reply, mid-request.  (No flush
+                # needed: the mmap's dirty pages outlive the process.)
                 os.kill(os.getpid(), 9)  # SIGKILL
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
@@ -643,6 +705,8 @@ class DistributedWorker:
                                      kind="dedup",
                                      attrs={"msg_id": msg.msg_id,
                                             "attempt": msg.attempt})
+                self._flight.record("dedup_hit", msg_id=msg.msg_id,
+                                    attempt=msg.attempt)
                 try:
                     self.channel.send(cached)
                 except Exception:
@@ -702,6 +766,8 @@ class DistributedWorker:
 
     def shutdown(self) -> None:
         """Teardown (reference: worker.py:569-580)."""
+        self._flight.record("worker_shutdown", rank=self.rank)
+        self._flight.flush()
         self._shutdown.set()
         try:
             self.channel.close()

@@ -245,6 +245,33 @@ _ssrv.run_until_done(max_steps=20)
                   any(k.startswith("nbd_wire_messages_total")
                       for k in mj.get("counters", {})),
                   repr(sorted(mj.get("counters", {}))[:6]))
+
+            # Postmortem sub-check (ISSUE 3): every process has been
+            # flight-recording since bring-up — recover the rings from
+            # the run dir, assemble a bundle, and assert the merged
+            # trace carries recovered events for the coordinator and
+            # both ranks (no one had to die for this to work).
+            from nbdistributed_tpu.observability import flightrec
+            from nbdistributed_tpu.observability import \
+                postmortem as _obs_pm
+            manifest = _obs_pm.capture(comm, [],
+                                       reason="selftest sub-check")
+            ok, detail = False, "capture returned None"
+            if manifest is not None:
+                import json as _json
+                with open(os.path.join(manifest["dir"],
+                                       "trace.json")) as f:
+                    tr = _json.load(f)
+                flight = [e for e in tr["traceEvents"]
+                          if e.get("cat") == "flight"]
+                pids = {e["pid"] for e in flight}
+                rings = flightrec.find_rings(
+                    os.environ.get("NBD_RUN_DIR", ""))
+                ok = {-1, 0, 1} <= pids and len(rings) >= 3
+                detail = (f"flight pids={sorted(pids)} "
+                          f"rings={len(rings)} dir={manifest['dir']}")
+            check("observability (flight rings recovered into "
+                  "postmortem bundle)", ok, detail)
     except Exception as e:
         check("harness", False, f"{type(e).__name__}: {e}")
     finally:

@@ -13,10 +13,16 @@ One scripted session against real worker subprocesses under
    never hang;
 3. the auto-heal supervisor rebuilds the world and restores the
    checkpointed namespace — the session ends healed: all ranks alive,
-   ``counter`` back at 20 from the checkpoint.
+   ``counter`` back at 20 from the checkpoint;
+4. (ISSUE 3) the supervisor captured a postmortem bundle for the
+   killed rank BEFORE healing: the dead rank's flight ring — recovered
+   from the file its SIGKILLed process left behind — contains the
+   dispatch event of the fatal message id, and the merged Chrome trace
+   carries every surviving pid plus the dead rank's recovered events.
 """
 
 import json
+import os
 import threading
 import time
 
@@ -24,10 +30,12 @@ import pytest
 
 from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
 from nbdistributed_tpu.messaging import CommunicationManager, WorkerDied
+from nbdistributed_tpu.observability import flightrec
 from nbdistributed_tpu.resilience import (FaultPlan, RetryPolicy,
                                           Supervisor, SupervisorPolicy)
 
-pytestmark = [pytest.mark.integration, pytest.mark.faults]
+pytestmark = [pytest.mark.integration, pytest.mark.faults,
+              pytest.mark.postmortem]
 
 WORLD = 2
 ATTACH_TIMEOUT = 120
@@ -58,8 +66,15 @@ def outputs(responses):
     return {r: m.data.get("output") for r, m in responses.items()}
 
 
-def test_chaos_drop_kill_heal_zero_double_executions(tmp_path):
+def test_chaos_drop_kill_heal_zero_double_executions(tmp_path,
+                                                     monkeypatch):
     ckpt = str(tmp_path / "ck")
+    # Route every process's flight ring (coordinator + workers inherit
+    # the env at spawn) into this test's run dir, and force a FRESH
+    # coordinator ring there (an earlier test in this pytest process
+    # may have opened one under a different run dir).
+    monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path / "run"))
+    flightrec.reset_for_tests()
     # Worker-side plan via the env knob (both ranks, fixed seed):
     # drops/duplicates replies and other worker->coordinator frames.
     env = {"NBD_FAULT_PLAN": json.dumps(
@@ -124,9 +139,13 @@ def test_chaos_drop_kill_heal_zero_double_executions(tmp_path):
                                    "spec": {"kill_rank": 1,
                                             "kill_at": 1}}, timeout=60)
         t0 = time.time()
-        with pytest.raises(WorkerDied):
+        with pytest.raises(WorkerDied) as died:
             comm.send_to_all("execute", "'doomed'", timeout=60)
         detect_s = time.time() - t0
+        # The aborted request's id — the postmortem must find its
+        # dispatch event in the DEAD rank's recovered flight ring.
+        fatal_id = died.value.msg_id
+        assert fatal_id, "WorkerDied did not carry the aborted msg_id"
         assert detect_s < 30, \
             f"death detection took {detect_s:.1f}s (heartbeat-scale " \
             f"expected)"
@@ -152,6 +171,39 @@ def test_chaos_drop_kill_heal_zero_double_executions(tmp_path):
         kinds = [(e["rank"], e["to"]) for e in sup.status()["events"]]
         assert (1, "dead") in kinds and (1, "healing") in kinds \
             and (1, "alive") in kinds
+
+        # --- phase 4: postmortem bundle for the killed rank ----------
+        manifest = sup.last_postmortem
+        assert manifest is not None, \
+            "supervisor healed without capturing a postmortem"
+        assert manifest["dead_ranks"] == [1]
+        bundle = manifest["dir"]
+        # The dead rank's ring, recovered from the SIGKILLed process's
+        # file, names the fatal message: its dispatch event was
+        # recorded BEFORE the injected kill fired.
+        ring1 = json.load(open(os.path.join(bundle,
+                                            "flight_rank1.json")))
+        assert any(e.get("t") == "dispatch"
+                   and e.get("msg_id") == fatal_id
+                   for e in ring1["events"]), \
+            f"fatal dispatch {fatal_id} missing from recovered ring"
+        # ...and its last recorded act is that dispatch (nothing after
+        # the kill), preceded by the same chaos-phase history the live
+        # ranks saw (cell events from phase 1).
+        assert ring1["events"][-1]["t"] == "dispatch"
+        assert any(e["t"] == "cell_start" for e in ring1["events"])
+        # Merged Chrome trace: all surviving pids plus the dead rank's
+        # recovered events on one timeline.
+        trace = json.load(open(os.path.join(bundle, "trace.json")))
+        flight = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "flight"]
+        assert {e["pid"] for e in flight} >= {-1, 0, 1}
+        assert any(e["pid"] == 1
+                   and e["args"].get("msg_id") == fatal_id
+                   for e in flight)
+        # Human-readable report names the casualty.
+        report = open(os.path.join(bundle, "report.txt")).read()
+        assert "rank 1 [DEAD]" in report
     finally:
         sup.stop()
         try:

@@ -1,0 +1,122 @@
+"""Notebook surface of the flight-recorder stack (ISSUE 3): %dist_top
+live telemetry dashboard on a 4-rank CPU cluster, %dist_postmortem
+bundle capture/replay, and the %dist_status heartbeat-age column.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.integration, pytest.mark.obs,
+              pytest.mark.postmortem]
+
+WORLD = 4
+
+
+@pytest.fixture(scope="module")
+def ip(tmp_path_factory):
+    from IPython.testing.globalipapp import get_ipython, start_ipython
+
+    from nbdistributed_tpu.observability import flightrec
+
+    # Fresh run dir for this module's rings and bundles; the workers
+    # inherit it at spawn, and reset_for_tests forces the coordinator
+    # ring to re-open there too.
+    run_d = str(tmp_path_factory.mktemp("nbd_run"))
+    old_run_dir = os.environ.get("NBD_RUN_DIR")
+    os.environ["NBD_RUN_DIR"] = run_d
+    flightrec.reset_for_tests()
+
+    shell = start_ipython() or get_ipython()
+    shell.run_line_magic("load_ext", "nbdistributed_tpu")
+    shell.run_line_magic(
+        "dist_init", f"-n {WORLD} --backend cpu --attach-timeout 240 "
+                     f"-t 120")
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    assert DistributedMagics._comm is not None, "cluster failed to start"
+    yield shell
+    shell.run_line_magic("dist_shutdown", "")
+    if old_run_dir is None:
+        os.environ.pop("NBD_RUN_DIR", None)
+    else:
+        os.environ["NBD_RUN_DIR"] = old_run_dir
+    flightrec.reset_for_tests()
+
+
+def _wait_for_telemetry(comm, ranks, timeout=60):
+    """Block until every rank's heartbeat has piggybacked at least one
+    telemetry snapshot (first ping ~2 s after attach)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(comm.last_telemetry(r) is not None for r in ranks):
+            return
+        time.sleep(0.2)
+    raise AssertionError("telemetry snapshots never arrived")
+
+
+def test_dist_top_renders_live_table(ip, capsys):
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    _wait_for_telemetry(DistributedMagics._comm, range(WORLD))
+    capsys.readouterr()
+    ip.run_line_magic("dist_top", "")
+    out = capsys.readouterr().out
+    assert f"cluster top · {WORLD} workers" in out
+    assert "hb-age" in out and "HBM" in out and "bufs" in out
+    import re
+    lines = out.splitlines()
+    for r in range(WORLD):
+        row = next(ln for ln in lines if ln.startswith(f"{r} "))
+        assert "alive" in row, row
+        # heartbeat age rendered as a number, not the '-' placeholder
+        assert re.search(r"\d+\.\d+s", row), row
+        # push-based: the live-buffer count rode a heartbeat piggyback
+        assert any(tok.isdigit() for tok in row.split()[3:]), row
+    assert "run dir" in out
+
+
+def test_dist_status_shows_heartbeat_age(ip, capsys):
+    ip.run_line_magic("dist_status", "")
+    out = capsys.readouterr().out
+    # every rank line carries the hb column with a real age
+    hb_lines = [ln for ln in out.splitlines() if "· hb " in ln]
+    assert len(hb_lines) == WORLD, out
+    assert not any("hb –" in ln for ln in hb_lines), out
+
+
+def test_dist_postmortem_on_demand_and_last(ip, capsys):
+    ip.run_cell("pm_probe = rank * 2\npm_probe")
+    capsys.readouterr()
+    ip.run_line_magic("dist_postmortem", "")
+    out = capsys.readouterr().out
+    assert "nbdistributed_tpu postmortem" in out
+    assert "bundle →" in out
+    bundle = out.split("bundle →")[1].split()[0]
+    # every process's flight ring was recovered into the bundle
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    flight = [e for e in trace["traceEvents"]
+              if e.get("cat") == "flight"]
+    assert {e["pid"] for e in flight} >= {-1, 0, 1, 2, 3}
+    # the probe cell's dispatch + cell events are in a worker ring
+    ring0 = json.load(open(os.path.join(bundle, "flight_rank0.json")))
+    kinds = {e["t"] for e in ring0["events"]}
+    assert "dispatch" in kinds and "cell_start" in kinds
+    assert not ring0["torn_tail"]          # healthy worker, clean ring
+    # --last re-prints the newest bundle without capturing a new one
+    from nbdistributed_tpu.observability import postmortem as pm_mod
+    n_before = len(pm_mod.list_bundles())
+    ip.run_line_magic("dist_postmortem", "--last")
+    out = capsys.readouterr().out
+    assert "nbdistributed_tpu postmortem" in out
+    assert len(pm_mod.list_bundles()) == n_before
+
+
+def test_dist_postmortem_save_dir(ip, capsys, tmp_path):
+    target = str(tmp_path / "pm_bundle")
+    ip.run_line_magic("dist_postmortem", f"--save {target}")
+    out = capsys.readouterr().out
+    assert "bundle →" in out
+    assert os.path.exists(os.path.join(target, "report.txt"))
+    assert os.path.exists(os.path.join(target, "manifest.json"))

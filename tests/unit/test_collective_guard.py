@@ -81,11 +81,14 @@ def test_executor_response_carries_count(monkeypatch):
     from nbdistributed_tpu.messaging.codec import Message
     from nbdistributed_tpu.runtime import worker as worker_mod
 
+    from nbdistributed_tpu.observability.flightrec import _NullRecorder
+
     class _W:
         rank = 0
         world_size = 2
         namespace = {"cg": cg}
         _stream = staticmethod(lambda text, kind: None)
+        _flight = _NullRecorder()
 
     handle = worker_mod.DistributedWorker._handle_execute
     w = _W()

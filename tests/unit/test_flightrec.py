@@ -1,0 +1,351 @@
+"""Flight recorder, telemetry, and postmortem units (ISSUE 3).
+
+The crash-recovery tests simulate what a SIGKILL leaves behind — a
+ring file whose final record was cut mid-write — by truncating or
+corrupting the bytes directly, and assert the reader recovers every
+COMPLETE event and flags the torn tail.
+"""
+
+import json
+import os
+import struct
+
+import pytest
+
+from nbdistributed_tpu.observability import flightrec as fr
+from nbdistributed_tpu.observability import postmortem as pm_mod
+from nbdistributed_tpu.observability import telemetry as tel
+
+pytestmark = [pytest.mark.unit, pytest.mark.obs, pytest.mark.postmortem]
+
+
+def _ring(tmp_path, name="t.ring", size=1 << 16):
+    return fr.FlightRecorder(str(tmp_path / name), ring_bytes=size)
+
+
+def _last_record_pos(path):
+    blob = open(path, "rb").read()
+    idx = blob.find(fr.REC_MAGIC, 64)
+    last = -1
+    while idx != -1:
+        last = idx
+        idx = blob.find(fr.REC_MAGIC, idx + 1)
+    assert last >= 0
+    return last, blob
+
+
+# ----------------------------------------------------------------------
+# append / recover round-trip
+
+
+class TestRoundTrip:
+    def test_events_recovered_in_order(self, tmp_path):
+        r = _ring(tmp_path)
+        for i in range(20):
+            r.record("dispatch", msg_id=f"m{i}", n=i)
+        d = fr.read_ring(r.path)
+        assert d["recovered"] == 20
+        assert not d["torn_tail"]
+        assert [e["n"] for e in d["events"]] == list(range(20))
+        assert all(e["t"] == "dispatch" for e in d["events"])
+        assert all(e["ts"] > 0 for e in d["events"])
+        assert d["pid"] == os.getpid()
+
+    def test_fast_encoder_matches_json_for_escapy_values(self, tmp_path):
+        r = _ring(tmp_path)
+        tricky = 'x = "quo\\ted"\nline2\ttab'
+        r.record("cell_start", code=tricky, flag=True, none=None,
+                 f=1.5, nested={"a": [1, 2]})
+        ev = fr.read_ring(r.path)["events"][0]
+        assert ev["code"] == tricky
+        assert ev["flag"] is True and ev["none"] is None
+        assert ev["f"] == 1.5 and ev["nested"] == {"a": [1, 2]}
+
+    def test_wrap_drops_oldest_keeps_newest(self, tmp_path):
+        r = _ring(tmp_path, size=4096)
+        n = 400
+        for i in range(n):
+            r.record("ev", n=i, pad="x" * 40)
+        d = fr.read_ring(r.path)
+        assert d["events"][-1]["n"] == n - 1          # newest survives
+        assert d["overwritten"] > 0                   # oldest gone
+        assert d["recovered"] + d["overwritten"] == n
+        # the survivors are a contiguous suffix, in order
+        ns = [e["n"] for e in d["events"]]
+        assert ns == list(range(n - d["recovered"], n))
+        assert not d["torn_tail"]                     # clean writer
+
+    def test_reopen_does_not_leak_previous_generation(self, tmp_path):
+        """Opening an existing ring path (pid recycling, re-init) must
+        zero the whole region: the old generation's CRC-valid records
+        must not merge into the new writer's recovery output."""
+        p = str(tmp_path / "reopen.ring")
+        r1 = fr.FlightRecorder(p)
+        for i in range(50):
+            r1.record("gen1", n=i)
+        r1.close()
+        r2 = fr.FlightRecorder(p)
+        r2.record("gen2", n=0)
+        d = fr.read_ring(r2.path)
+        assert [e["t"] for e in d["events"]] == ["gen2"]
+        assert d["overwritten"] == 0
+
+    def test_oversize_payload_does_not_corrupt_neighbors(self, tmp_path):
+        r = _ring(tmp_path)
+        r.record("before", n=1)
+        r.record("big", blob="y" * (fr.MAX_PAYLOAD + 100))
+        r.record("after", n=2)
+        d = fr.read_ring(r.path)
+        names = [e["t"] for e in d["events"]]
+        assert "before" in names and "after" in names
+
+
+# ----------------------------------------------------------------------
+# crash recovery (simulated SIGKILL mid-write)
+
+
+class TestTornTail:
+    def _write(self, tmp_path, n=12):
+        r = _ring(tmp_path, name="torn.ring")
+        for i in range(n):
+            r.record("ev", n=i)
+        r.flush()
+        r.close()
+        return str(tmp_path / "torn.ring"), n
+
+    def test_truncated_final_record_flagged(self, tmp_path):
+        path, n = self._write(tmp_path)
+        last, blob = _last_record_pos(path)
+        # cut the file mid-payload of the final record
+        open(path, "wb").write(blob[: last + fr.REC_HEADER_SIZE + 2])
+        d = fr.read_ring(path)
+        assert d["recovered"] == n - 1
+        assert d["torn_tail"] is True
+        assert [e["n"] for e in d["events"]] == list(range(n - 1))
+
+    def test_corrupted_final_payload_flagged(self, tmp_path):
+        path, n = self._write(tmp_path)
+        last, blob = _last_record_pos(path)
+        mangled = bytearray(blob)
+        pos = last + fr.REC_HEADER_SIZE + 1
+        mangled[pos] = mangled[pos] ^ 0xFF            # bit-flip, CRC fails
+        open(path, "wb").write(bytes(mangled))
+        d = fr.read_ring(path)
+        assert d["recovered"] == n - 1
+        assert d["torn_tail"] is True
+
+    def test_corrupt_middle_record_not_reported_as_torn(self, tmp_path):
+        path, n = self._write(tmp_path)
+        blob = open(path, "rb").read()
+        first = blob.find(fr.REC_MAGIC, 64)
+        mangled = bytearray(blob)
+        pos = first + fr.REC_HEADER_SIZE + 1
+        mangled[pos] = mangled[pos] ^ 0xFF
+        open(path, "wb").write(bytes(mangled))
+        d = fr.read_ring(path)
+        assert d["recovered"] == n - 1                # one casualty
+        assert d["torn_tail"] is False                # but tail is whole
+
+    def test_reader_ignores_header_hints(self, tmp_path):
+        """Recovery must not trust the writer's header (a torn header
+        is as likely as a torn record): zero the hint fields and the
+        scan still finds everything."""
+        path, n = self._write(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        blob[16:40] = b"\0" * 24                      # hint region
+        open(path, "wb").write(bytes(blob))
+        d = fr.read_ring(path)
+        assert d["recovered"] == n
+
+
+# ----------------------------------------------------------------------
+# process wiring
+
+
+class TestProcessWiring:
+    def test_run_dir_minted_and_exported(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("NBD_RUN_DIR", raising=False)
+        monkeypatch.setattr("tempfile.gettempdir",
+                            lambda: str(tmp_path))
+        d = fr.run_dir()
+        assert os.path.isdir(d)
+        assert os.environ["NBD_RUN_DIR"] == d
+        assert fr.run_dir() == d                      # stable
+
+    def test_init_and_module_record(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+        fr.reset_for_tests()
+        try:
+            r = fr.init("rank7")
+            fr.record("dispatch", msg_id="abc")
+            assert len(r) == 1
+            d = fr.read_latest(str(tmp_path), "rank7")
+            assert d["events"][0]["msg_id"] == "abc"
+            assert fr.find_rings(str(tmp_path), "rank7")
+        finally:
+            fr.reset_for_tests()
+
+    def test_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+        monkeypatch.setenv("NBD_FLIGHT", "0")
+        fr.reset_for_tests()
+        try:
+            r = fr.init("rank8")
+            r.record("ev")
+            assert len(r) == 0
+            assert fr.find_rings(str(tmp_path)) == []
+        finally:
+            fr.reset_for_tests()
+
+    def test_unwritable_dir_degrades_to_noop(self, tmp_path,
+                                             monkeypatch):
+        # NBD_RUN_DIR "under" a regular file: makedirs/open must fail,
+        # and the recorder must degrade to a no-op, never raise.
+        blocker = tmp_path / "a_file"
+        blocker.write_text("x")
+        monkeypatch.setenv("NBD_RUN_DIR", str(blocker / "sub"))
+        fr.reset_for_tests()
+        try:
+            r = fr.init("rank9")
+            r.record("ev")                            # must not raise
+            assert len(r) == 0
+        finally:
+            fr.reset_for_tests()
+
+    def test_record_before_init_is_noop(self):
+        fr.reset_for_tests()
+        fr.record("ev", n=1)                          # must not raise
+        assert len(fr.recorder()) == 0
+
+
+# ----------------------------------------------------------------------
+# telemetry
+
+
+class TestTelemetry:
+    def test_sampler_snapshot_shape(self):
+        s = tel.TelemetrySampler(0, extra_fn=lambda: {"dedup": 3})
+        snap = s.sample()
+        assert snap["ts"] > 0
+        assert snap["bufs"] >= 0                      # CPU backend: works
+        assert snap["dedup"] == 3
+        assert s.last is snap
+
+    def test_sampler_paces_itself(self):
+        s = tel.TelemetrySampler(0, min_interval_s=3600)
+        assert s.maybe_sample() is not None
+        assert s.maybe_sample() is None               # too soon
+
+    def test_extra_fn_failure_is_soft(self):
+        def boom():
+            raise RuntimeError("x")
+        snap = tel.TelemetrySampler(0, extra_fn=boom).sample()
+        assert "ts" in snap
+
+    def test_device_memory_none_on_cpu(self):
+        import jax
+        assert tel.device_memory(jax.devices()[0]) is None
+
+    def test_device_status_still_reports(self):
+        from nbdistributed_tpu.runtime import introspect
+        st = introspect.device_status(0, 1)
+        assert st["devices"]
+        assert "memory_gb" in st["devices"][0]
+
+    def test_peak_hbm_summary(self):
+        snaps = [
+            {"hbm": [{"id": 0, "in_use": 5, "peak": 10, "limit": 100}]},
+            {"hbm": [{"id": 0, "in_use": 7, "peak": 30, "limit": 100}]},
+            None,
+        ]
+        assert tel.peak_hbm(snaps) == {"0": 30}
+
+
+# ----------------------------------------------------------------------
+# postmortem bundles
+
+
+class _FakeComm:
+    """The minimal coordinator surface postmortem.capture touches."""
+
+    def __init__(self, n):
+        self.num_workers = n
+        from nbdistributed_tpu.observability.clock import ClockEstimator
+        from nbdistributed_tpu.observability.spans import Tracer
+        self.tracer = Tracer()
+        self.clock = ClockEstimator()
+
+    def fault_plan(self):
+        return None
+
+    def telemetry_history(self, rank):
+        return [{"ts": 5.0, "hbm": [{"id": 0, "in_use": 9,
+                                     "peak": 11, "limit": 100}],
+                 "bufs": 4}] if rank == 1 else []
+
+
+class TestPostmortem:
+    def _seed_rings(self, run_d, torn_rank=1):
+        for r in (0, 1):
+            rec = fr.FlightRecorder(
+                fr.ring_path(str(run_d), f"rank{r}", pid=1000 + r))
+            for i in range(5):
+                rec.record("dispatch", msg_id=f"r{r}m{i}")
+            rec.close()
+        crec = fr.FlightRecorder(
+            fr.ring_path(str(run_d), "coordinator", pid=999))
+        crec.record("send", msg_id="r1m4", type="execute")
+        crec.close()
+        if torn_rank is not None:
+            path = fr.ring_path(str(run_d), f"rank{torn_rank}",
+                                pid=1000 + torn_rank)
+            last, blob = _last_record_pos(path)
+            open(path, "wb").write(
+                blob[: last + fr.REC_HEADER_SIZE + 2])
+
+    def test_capture_builds_full_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+        self._seed_rings(tmp_path)
+        manifest = pm_mod.capture(_FakeComm(2), [1], reason="test kill")
+        assert manifest is not None
+        d = manifest["dir"]
+        assert manifest["dead_ranks"] == [1]
+        assert manifest["rings"]["1"]["torn_tail"] is True
+        # dead rank's recovered flight ring, with the torn tail cut off
+        ring1 = json.load(open(os.path.join(d, "flight_rank1.json")))
+        assert [e["msg_id"] for e in ring1["events"]] == \
+            [f"r1m{i}" for i in range(4)]
+        # merged chrome trace has every pid incl. the dead rank's
+        trace = json.load(open(os.path.join(d, "trace.json")))
+        flight = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "flight"]
+        assert {e["pid"] for e in flight} == {-1, 0, 1}
+        dead_evs = [e for e in flight if e["pid"] == 1]
+        assert all(e["args"].get("ring_torn_tail") for e in dead_evs)
+        # telemetry + human report
+        telj = json.load(open(os.path.join(d, "telemetry.json")))
+        assert telj["1"][0]["bufs"] == 4
+        report = open(os.path.join(d, "report.txt")).read()
+        assert "rank 1 [DEAD]" in report
+        assert "TORN final record" in report
+        assert "test kill" in report
+        # bundle listing / --last plumbing
+        assert pm_mod.list_bundles(str(tmp_path)) == [d]
+
+    def test_capture_never_raises(self, tmp_path, monkeypatch):
+        blocker = tmp_path / "a_file"
+        blocker.write_text("x")
+        monkeypatch.setenv("NBD_RUN_DIR", str(blocker / "sub"))
+        assert pm_mod.capture(_FakeComm(2), [0]) is None
+
+    def test_flight_to_trace_dump_empty(self):
+        assert pm_mod.flight_to_trace_dump(None)["instants"] == []
+
+
+# ----------------------------------------------------------------------
+# format stability: a reader from another process must agree on layout
+
+
+def test_record_header_layout_frozen():
+    assert fr.REC_HEADER_SIZE == struct.calcsize("<4sHIQ") == 18
+    assert fr.REC_MAGIC == b"\xf1\x1e\xc0\xde"
