@@ -64,8 +64,11 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_metrics · %dist_top (live device telemetry) ·
 %dist_postmortem (crash bundles from the flight recorder) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
+%dist_attach (rejoin this fleet after a kernel restart) ·
+%dist_gc (sweep stale session run dirs) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
-%dist_shutdown
+%dist_shutdown (explicit fleet teardown — a kernel restart alone only
+orphans the fleet; it stays reattachable for NBD_ORPHAN_TTL_S)
 """
 
 
@@ -108,6 +111,9 @@ class DistributedMagics(Magics):
 
     # Active auto-heal supervisor (resilience/supervisor.py), or None.
     _supervisor = None
+    # True when this kernel joined the fleet via %dist_attach rather
+    # than spawning it (durable sessions) — surfaced in %dist_status.
+    _attached: bool = False
 
     _cell_hooks: tuple | None = None
 
@@ -206,6 +212,7 @@ class DistributedMagics(Magics):
         cls._comm = None
         cls._pm = None
         cls._world = 0
+        cls._attached = False
         cls._auto_active = False
         cls._timeline = Timeline()
         cls._active_display = None
@@ -398,11 +405,18 @@ class DistributedMagics(Magics):
                    "host); requires --coordinator-addr for remote hosts")
     @argument("--coordinator-addr", default="127.0.0.1",
               help="address of this kernel reachable from every host")
+    @argument("--attach", nargs="?", const="", default=None,
+              dest="attach_dir",
+              help="reattach to a surviving fleet instead of spawning "
+                   "one (optionally naming its run dir) — alias for "
+                   "%%dist_attach")
     @line_magic
     def dist_init(self, line):
         """Start N workers and route subsequent cells to them
         (reference: magic.py:397-536)."""
         args = parse_argstring(self.dist_init, line)
+        if args.attach_dir is not None:
+            return self.dist_attach(args.attach_dir)
         if self._running():
             print(f"⚠️ {self._world} workers already running. "
                   "%dist_shutdown first.")
@@ -459,10 +473,17 @@ class DistributedMagics(Magics):
             import secrets
             bind_host = "0.0.0.0"
             auth_token = secrets.token_hex(16)
+        # Durable session identity: the token ties workers, manifest,
+        # and any future reattaching coordinator to ONE session; epoch
+        # 1 is this first coordinator's tenancy (a reattach bumps it).
+        from ..resilience import session as session_mod
+        session_token = session_mod.mint_token()
         comm = CommunicationManager(num_workers=num_workers,
                                     host=bind_host,
                                     timeout=args.timeout,
-                                    auth_token=auth_token)
+                                    auth_token=auth_token,
+                                    session_token=session_token,
+                                    session_epoch=1)
         pm = ProcessManager()
         pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
         pm.add_death_callback(self._announce_death)
@@ -480,7 +501,10 @@ class DistributedMagics(Magics):
                 pm.start_workers(num_workers, comm.port,
                                  backend=args.backend,
                                  chips_per_worker=args.chips_per_worker,
-                                 chips=chips)
+                                 chips=chips,
+                                 extra_env={
+                                     "NBD_SESSION_TOKEN": session_token,
+                                     "NBD_SESSION_EPOCH": "1"})
             from ..manager import wait_until_ready
             wait_until_ready(
                 comm, pm, args.attach_timeout,
@@ -496,6 +520,45 @@ class DistributedMagics(Magics):
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
         DistributedMagics._world = num_workers
+        DistributedMagics._attached = False
+        if host_specs is None:
+            # Session manifest: what a future %dist_attach needs to
+            # adopt this fleet after THIS kernel dies.  Single-host
+            # only — pid adoption and the shared run-dir manifest
+            # assume one pid namespace and filesystem.
+            import os as _os
+            from ..observability import flightrec as _flightrec
+            _rd = _flightrec.run_dir()
+            _existing = session_mod.read_manifest(_rd)
+            if (_existing is not None
+                    and _existing.get("token") != session_token
+                    and session_mod.live_pids(_existing)):
+                # NBD_RUN_DIR points at ANOTHER session whose fleet is
+                # still alive (e.g. after a failed %dist_attach, or a
+                # user-exported run dir): clobbering its manifest would
+                # strand that fleet unreattachable.  This new world
+                # simply isn't durable.
+                print(f"⚠️ {_rd} already holds a LIVE session's "
+                      "manifest — not overwriting it; this world is "
+                      "NOT reattachable. %dist_attach that session, "
+                      "or unset NBD_RUN_DIR and re-init.")
+            else:
+                try:
+                    session_mod.write_manifest(
+                        _rd, session_mod.make_manifest(
+                            world_size=num_workers,
+                            control_host="127.0.0.1",
+                            control_port=comm.port, bind_host=bind_host,
+                            token=session_token, epoch=1,
+                            pids={r: p.pid
+                                  for r, p in pm.processes.items()},
+                            backend=pm.backend, dist_port=pm.dist_port,
+                            auth_token=auth_token, init_line=line,
+                            supervised=DistributedMagics._supervisor
+                            is not None))
+                except OSError as e:
+                    print(f"⚠️ session manifest not written ({e}) — "
+                          "%dist_attach will not find this session")
         if DistributedMagics._last_init_line != line:
             # A DIFFERENT world configuration invalidates the previous
             # world's checkpoint as an auto-heal restore target (its
@@ -585,6 +648,117 @@ class DistributedMagics(Magics):
             DistributedMagics._supervisor = sup
 
     # ==================================================================
+    # durable sessions: reattach + stale-run GC (ISSUE 4)
+
+    @magic_arguments()
+    @argument("run_dir", nargs="?", default=None,
+              help="session run directory (default: NBD_RUN_DIR, else "
+                   "the newest manifest with live pids under the runs "
+                   "root)")
+    @argument("-t", "--timeout", type=float, default=None,
+              help="per-request timeout for the new manager (default: "
+                   "none — training mode)")
+    @argument("--attach-timeout", type=float, default=90.0,
+              help="seconds to wait for orphaned workers to dial back")
+    @line_magic
+    def dist_attach(self, line):
+        """Reattach this kernel to a fleet that survived its
+        coordinator's death (durable sessions).
+
+        Reads the session manifest under the run dir, adopts the
+        worker pids, re-binds the control endpoint, bumps the session
+        epoch (fencing out any stale coordinator), verifies the
+        session token with a per-rank hello, and drains results the
+        workers parked while orphaned — the interrupted cell's output
+        is redelivered exactly once, and every worker's namespace,
+        compiled functions, and device state are exactly as the crash
+        left them."""
+        import os as _os
+
+        from ..resilience import session as session_mod
+        args = parse_argstring(self.dist_attach, line)
+        if self._running():
+            print(f"⚠️ {self._world} workers already running. "
+                  "%dist_shutdown first.")
+            return
+        t0 = time.time()
+        run_dir = (args.run_dir or "").strip().strip("'\"") or None
+        try:
+            comm, pm, manifest, hello = session_mod.attach(
+                run_dir, attach_timeout=args.attach_timeout,
+                request_timeout=args.timeout)
+        except Exception as e:
+            print(f"❌ attach failed: {e}")
+            return
+        pm.add_death_callback(self._announce_death)
+        comm.set_output_callback(self._feed_stream)
+        DistributedMagics._comm = comm
+        DistributedMagics._pm = pm
+        DistributedMagics._world = comm.num_workers
+        DistributedMagics._attached = True
+        if manifest.get("init_line") is not None:
+            # %dist_heal replays the ORIGINAL init of this session.
+            DistributedMagics._last_init_line = manifest["init_line"]
+        self._enable_auto_mode()
+        sizes = sorted({(m.data or {}).get("namespace_size") or 0
+                        for m in hello.values()})
+        print(f"🔗 reattached to {comm.num_workers} workers "
+              f"(epoch {comm.session_epoch}, "
+              f"run {_os.environ.get('NBD_RUN_DIR')}, "
+              f"{time.time() - t0:.1f}s) — namespaces intact "
+              f"({'/'.join(str(s) for s in sizes)} names/rank)")
+        # Exactly-once redelivery of results parked while orphaned.
+        if any((m.data or {}).get("parked") for m in hello.values()):
+            try:
+                drained = session_mod.drain_mailboxes(comm)
+            except Exception as e:
+                print(f"⚠️ mailbox drain failed: {e} — parked results "
+                      "remain claimable on the workers")
+                drained = {}
+            for r in sorted(drained):
+                for mid, res in drained[r].items():
+                    res = res or {}
+                    text = (res.get("error")
+                            or str(res.get("output") or "").strip()
+                            or "(no output)")
+                    print(f"📬 rank {r} · interrupted cell "
+                          f"{mid[:8]}… finished while orphaned: {text}")
+        if manifest.get("supervised") \
+                and DistributedMagics._supervisor is None:
+            print("🛡  re-arming supervision (the session had "
+                  "%dist_supervise on)")
+            self.dist_supervise("on")
+        print("Every cell runs on ALL workers again. %dist_status "
+              "shows the session header.")
+
+    @magic_arguments()
+    @argument("--dry-run", action="store_true",
+              help="list what would be swept without removing anything")
+    @argument("--ttl", type=float, default=None,
+              help="stale age in seconds (default: NBD_GC_TTL_S, "
+                   "else 6h)")
+    @argument("--root", default=None,
+              help="runs root to sweep (default: <tmpdir>/nbd_runs)")
+    @line_magic
+    def dist_gc(self, line):
+        """Sweep abandoned session run dirs: siblings whose manifest
+        (or directory) is older than the TTL and whose recorded pids
+        are all dead.  The current session's run dir and any dir with
+        a live pid are never touched."""
+        from ..resilience import session as session_mod
+        args = parse_argstring(self.dist_gc, line)
+        res = session_mod.gc_runs(args.root, ttl_s=args.ttl,
+                                  dry_run=args.dry_run)
+        verb = "would sweep" if args.dry_run else "swept"
+        print(f"🧹 {verb} {len(res['swept'])} stale run dir(s) under "
+              f"{res['root']} (ttl {res['ttl_s']:.0f}s) · "
+              f"kept {len(res['kept'])}")
+        for d in res["swept"]:
+            print(f"   - {d}")
+        for e in res["errors"]:
+            print(f"   ⚠ {e}")
+
+    # ==================================================================
     # resilience: auto-heal supervision + fault injection
 
     def _supervised_heal(self):
@@ -638,6 +812,7 @@ class DistributedMagics(Magics):
             sup.stop()
             DistributedMagics._supervisor = None
             print("✅ supervisor stopped")
+            self._note_supervised(False)
             return
         if args.command == "status":
             if sup is None:
@@ -657,6 +832,7 @@ class DistributedMagics(Magics):
         sup = Supervisor(policy, heal=self._supervised_heal)
         sup.attach(self._comm, self._pm)
         DistributedMagics._supervisor = sup
+        self._note_supervised(True)
         print(f"✅ supervising {self._world} workers: auto-heal "
               f"{'ON' if policy.auto_heal else 'OFF'}, budget "
               f"{policy.max_restarts} restarts/{policy.restart_window_s:.0f}s, "
@@ -664,6 +840,17 @@ class DistributedMagics(Magics):
               + ("" if DistributedMagics._last_ckpt_path else
                  " · no checkpoint yet — heal will restore nothing "
                  "(%dist_checkpoint to protect state)"))
+
+    @staticmethod
+    def _note_supervised(on: bool) -> None:
+        """Record the supervision flag in the session manifest so a
+        reattaching coordinator re-arms it (durable sessions)."""
+        import os as _os
+
+        from ..resilience import session as session_mod
+        d = _os.environ.get("NBD_RUN_DIR")
+        if d:
+            session_mod.update_manifest(d, supervised=on)
 
     @magic_arguments()
     @argument("command", nargs="?", default="status",
@@ -956,10 +1143,32 @@ class DistributedMagics(Magics):
         mode = "ON" if self._auto_active else "OFF"
         print(f"🌐 Cluster: {self._world} workers · backend="
               f"{self._pm.backend} · auto-mode {mode}")
+        # Durable-session header: run dir, token fingerprint, epoch,
+        # and whether this kernel spawned the fleet (orphan-capable:
+        # it survives us) or adopted one (%dist_attach).
+        if self._comm is not None and getattr(self._comm,
+                                              "session_token", None):
+            import os as _os
+
+            from ..resilience import session as session_mod
+            ttl = _os.environ.get("NBD_ORPHAN_TTL_S") or "600"
+            print(f"🔑 session: run {_os.environ.get('NBD_RUN_DIR', '-')}"
+                  f" · token {session_mod.token_fingerprint(self._comm.session_token)}"
+                  f" · epoch {self._comm.session_epoch}"
+                  f" · {'attached' if DistributedMagics._attached else 'orphan-capable'}"
+                  f" (orphan TTL {ttl}s)")
+        connected = (set(self._comm.connected_ranks())
+                     if self._comm is not None else None)
         for rank_id in sorted(proc_status):
             p = proc_status[rank_id]
-            state = "● running" if p["running"] else \
-                f"✖ exited ({p['returncode']})"
+            if not p["running"]:
+                state = f"✖ exited ({p['returncode']})"
+            elif connected is not None and rank_id not in connected:
+                # Process alive but not attached to THIS coordinator:
+                # the fleet-side view of orphan grace.
+                state = "◌ orphaned"
+            else:
+                state = "● running"
             line_txt = f"├─ Rank {rank_id}: pid {p['pid']} {state}"
             if rank_id in live:
                 st = live[rank_id]
@@ -1571,7 +1780,13 @@ class DistributedMagics(Magics):
                   f"{_total(snap, 'nbd_wire_bytes_total') / 1e6:.2f} MB"
                   + (f" · faults "
                      f"{_total(snap, 'nbd_fault_injections'):.0f}"
-                     if _total(snap, "nbd_fault_injections") else ""))
+                     if _total(snap, "nbd_fault_injections") else "")
+                  + (f" · parked "
+                     f"{_total(snap, 'nbd_mailbox_parked'):.0f}"
+                     if _total(snap, "nbd_mailbox_parked") else "")
+                  + (f" · orphan transitions "
+                     f"{_total(snap, 'nbd_orphan_transitions'):.0f}"
+                     if _total(snap, "nbd_orphan_transitions") else ""))
 
     # ==================================================================
     # flight recorder: live telemetry + crash postmortems (ISSUE 3)
@@ -1854,19 +2069,63 @@ class DistributedMagics(Magics):
                         "nbdistributed_tpu.runtime.worker"],
                        capture_output=True)
 
+    @classmethod
+    def _end_durable_session(cls, token: str | None, epoch: int) -> None:
+        """EXPLICIT fleet teardown ends the durable session (manifest
+        removed, so nothing adopts or GC-protects the remains) — but
+        only when THIS kernel still owns it: a fenced-out stale
+        coordinator's %dist_shutdown must not delete the manifest of a
+        session that was handed to a newer epoch (the filesystem-plane
+        twin of the workers' epoch fence).  A kernel exit deliberately
+        does not come through here — it merely orphans the fleet,
+        which is what %dist_attach resumes."""
+        import os as _os
+
+        from ..resilience import session as session_mod
+        d = _os.environ.get("NBD_RUN_DIR")
+        if not d or token is None:
+            return
+        m = session_mod.read_manifest(d)
+        if m is None:
+            return
+        if m.get("token") != token:
+            return  # another session's manifest — not ours to remove
+        if int(m.get("epoch") or 0) > epoch:
+            print("⚠️ this session was reattached by a newer "
+                  "coordinator (manifest epoch "
+                  f"{m.get('epoch')} > ours {epoch}); leaving its "
+                  "manifest in place")
+            return
+        session_mod.end_session(d)
+
+    @classmethod
+    def _session_identity(cls) -> tuple[str | None, int]:
+        comm = cls._comm
+        return (getattr(comm, "session_token", None) if comm else None,
+                int(getattr(comm, "session_epoch", 0) or 0)
+                if comm else 0)
+
     @line_magic
     def dist_shutdown(self, line):
-        """Stop all workers (reference: magic.py:810-837)."""
+        """Stop all workers (reference: magic.py:810-837).  This is the
+        explicit fleet teardown of a durable session: workers and the
+        session manifest are destroyed.  (Exiting/restarting the kernel
+        WITHOUT this magic leaves the fleet orphaned-but-alive for
+        NBD_ORPHAN_TTL_S — reattach with %dist_attach.)"""
         had = self._world
+        token, epoch = self._session_identity()
         self.shutdown_all()
         self._nuclear_shutdown()
+        self._end_durable_session(token, epoch)
         print(f"✅ shut down {had} workers" if had else "✅ nothing to "
               "shut down")
 
     @line_magic
     def dist_reset(self, line):
         """Full reset for a fresh start (reference: magic.py:963-1003)."""
+        token, epoch = self._session_identity()
         self.shutdown_all()
         self._nuclear_shutdown()
+        self._end_durable_session(token, epoch)
         DistributedMagics._timeline = Timeline()
         print("✅ reset complete — %dist_init to start a new cluster")

@@ -91,6 +91,60 @@ class _ChildIO:
         return "".join(list(self.lines)[-n:])
 
 
+class _AdoptedProcess:
+    """Popen-compatible shim over an externally-discovered pid the
+    reattach path adopts (durable sessions: the workers outlived the
+    coordinator that spawned them, so they are NOT our children and
+    ``Popen.wait``/``poll`` semantics don't exist).  Death-watch is a
+    signal-0 probe; the exit code of a non-child is unknowable, so a
+    vanished pid reports returncode -1."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.stdout = None  # stdio belongs to the dead coordinator
+        self.returncode: int | None = None
+
+    def poll(self) -> int | None:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            self.returncode = -1
+            return self.returncode
+        except PermissionError:
+            return None  # alive under another uid
+        except OSError:
+            self.returncode = -1
+            return self.returncode
+        return None
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired(f"pid {self.pid}",
+                                                timeout)
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
+
+    def send_signal(self, sig: int) -> None:
+        os.kill(self.pid, sig)
+
+
+class _AdoptedIO:
+    """Stdio placeholder for adopted workers — their pipes died with
+    the previous coordinator; ``%dist_logs`` should say so instead of
+    rendering an empty tail as 'no output'."""
+
+    def __init__(self, pid: int):
+        self._pid = pid
+
+    def tail(self, n: int = 40) -> str:
+        return (f"(adopted worker pid {self._pid}: stdio was captured "
+                "by the previous coordinator and is not available)\n")
+
+
 class ProcessManager:
     def __init__(self):
         self.processes: dict[int, subprocess.Popen] = {}
@@ -223,6 +277,24 @@ class ProcessManager:
                             dict(os.environ))
         self._start_monitor()
         return self.world_size
+
+    def adopt(self, pids: dict[int, int], *, backend: str | None = None,
+              dist_port: int | None = None) -> None:
+        """Adopt externally-discovered worker processes this manager
+        did not spawn — the ``%dist_attach`` reattach path (durable
+        sessions).  Death-watch works through the same monitor thread
+        via signal-0 polling (see :class:`_AdoptedProcess`); interrupt
+        and tiered shutdown work unchanged (the workers were started
+        with their own process groups)."""
+        if self.processes:
+            raise RuntimeError("workers already running; shutdown first")
+        self.backend = backend
+        self.world_size = len(pids)
+        self.dist_port = dist_port
+        for rank, pid in sorted(pids.items()):
+            self.processes[rank] = _AdoptedProcess(pid)
+            self.io[rank] = _AdoptedIO(pid)
+        self._start_monitor()
 
     def _spawn(self, rank: int, cmd: list[str], env: dict) -> None:
         proc = subprocess.Popen(

@@ -112,6 +112,14 @@ class Message:
     # the header field is only emitted when set — untraced frames stay
     # byte-identical to the pre-tracing wire format.
     trace: dict | None = None
+    # Session epoch of the sending coordinator (durable sessions).  A
+    # reattaching coordinator bumps the manifest epoch and stamps every
+    # frame; workers reject frames stamped with an OLDER epoch, so a
+    # stale coordinator (the pre-crash kernel, or a second kernel that
+    # lost the attach race) can never drive a fleet that has been
+    # handed over.  None (the default) is never rejected — unstamped
+    # sessions keep the pre-epoch wire format byte-identically.
+    epoch: int | None = None
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
@@ -153,6 +161,9 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
     if msg.trace:
         # Only while a trace is active (near-zero overhead when off).
         header["tr"] = msg.trace
+    if msg.epoch is not None:
+        # Only for epoch-stamped (durable) sessions.
+        header["ep"] = msg.epoch
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -236,6 +247,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         bufs=bufs,
         attempt=header.get("at", 0),
         trace=header.get("tr"),
+        epoch=header.get("ep"),
     )
 
 

@@ -68,10 +68,20 @@ class CommunicationManager:
     def __init__(self, num_workers: int, *, host: str = "127.0.0.1",
                  port: int = 0, timeout: float | None = None,
                  allow_pickle: bool = True, auth_token: str | None = None,
-                 retry: RetryPolicy | None = None):
+                 retry: RetryPolicy | None = None,
+                 session_token: str | None = None,
+                 session_epoch: int = 0):
         self.num_workers = num_workers
         self.default_timeout = timeout  # None = wait forever (training mode)
         self.auth_token = auth_token
+        # Durable-session identity (resilience/session.py): when the
+        # epoch is nonzero every outgoing request is stamped with it,
+        # and workers whose fleet has been handed to a NEWER epoch
+        # answer our frames with a stale-coordinator error instead of
+        # executing them.  Zero (the default) leaves frames unstamped —
+        # the pre-epoch wire format, never rejected.
+        self.session_token = session_token
+        self.session_epoch = int(session_epoch or 0)
         # Redelivery policy for slow/lost responses (resilience/retry):
         # explicit argument > NBD_RETRY_* env > disabled (the exact
         # pre-retry single-attempt behavior).
@@ -236,6 +246,8 @@ class CommunicationManager:
         if not ranks:
             return {}  # an empty expectation would otherwise never complete
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        if self.session_epoch:
+            msg.epoch = self.session_epoch
         tr = self.tracer
         span = (tr.begin(f"send/{msg_type}", kind="coordinator",
                          attrs={"ranks": list(ranks)})
@@ -314,15 +326,21 @@ class CommunicationManager:
                 self._pending.pop(msg.msg_id, None)
 
     def post(self, ranks: list[int], msg_type: str, data: Any = None, *,
-             bufs: dict | None = None) -> None:
+             bufs: dict | None = None) -> str:
         """Fire-and-forget send (no response expected) — used for
         shutdown-style messages where the reference tolerates silence
-        (reference: worker.py:205-206 sends no shutdown response)."""
+        (reference: worker.py:205-206 sends no shutdown response).
+        Returns the message id, so a caller that later needs to
+        correlate (e.g. the reattach tests matching a parked result to
+        the request the coordinator died holding) can."""
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        if self.session_epoch:
+            msg.epoch = self.session_epoch
         try:
             self._listener.send_to_ranks(list(ranks), msg)
         except TransportError:
             pass
+        return msg.msg_id
 
     # ------------------------------------------------------------------
     # IO-thread callbacks

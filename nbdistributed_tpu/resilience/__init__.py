@@ -21,6 +21,12 @@ automatically* at runtime:
 - :mod:`~nbdistributed_tpu.resilience.dedup` — :class:`ReplayCache`:
   the worker-side bounded reply cache that makes request redelivery
   idempotent (a retried ``execute`` is never double-executed).
+- :mod:`~nbdistributed_tpu.resilience.session` — durable sessions:
+  the ``session.json`` manifest under ``NBD_RUN_DIR`` (world size,
+  control endpoint, pids, token, epoch), :func:`session.attach` — the
+  ``%dist_attach`` reattach path that lets a fresh kernel adopt a
+  fleet orphaned by coordinator death — and :func:`session.gc_runs`
+  stale-run sweeping.
 - :mod:`~nbdistributed_tpu.resilience.supervisor` —
   :class:`Supervisor`: consumes process-death callbacks + heartbeat
   staleness, distinguishes *degraded* from *dead*, and auto-heals
@@ -31,10 +37,11 @@ Everything here is stdlib-only (no JAX import) so the coordinator side
 stays light and the modules are unit-testable without a backend.
 """
 
-from .dedup import ReplayCache
+from . import session
+from .dedup import ReplayCache, ResultMailbox
 from .faults import FaultPlan
 from .retry import RetryPolicy
 from .supervisor import Supervisor, SupervisorPolicy
 
-__all__ = ["FaultPlan", "ReplayCache", "RetryPolicy", "Supervisor",
-           "SupervisorPolicy"]
+__all__ = ["FaultPlan", "ReplayCache", "ResultMailbox", "RetryPolicy",
+           "Supervisor", "SupervisorPolicy", "session"]

@@ -63,6 +63,76 @@ def _reply_bytes(reply) -> int:
     return total + _json_size(getattr(reply, "data", None))
 
 
+class ResultMailbox:
+    """Parked replies awaiting redelivery to a FUTURE coordinator.
+
+    When a worker's coordinator dies mid-cell (orphan grace, ISSUE 4)
+    the finished cell's reply has nowhere to go: the mailbox keeps it,
+    keyed by ``msg_id``, until a reattaching coordinator drains it.
+    Claims are destructive — the exactly-once half of redelivery (the
+    at-least-once half is the replay cache answering a redelivered
+    ``drain`` from its own cache).  Bounded like the replay cache:
+    oldest-first eviction by entry count and accumulated bytes, with
+    the newest entry always kept (it is the in-flight cell's result —
+    the one reattach exists to recover).
+    """
+
+    def __init__(self, capacity: int = 32,
+                 max_total_bytes: int = 32 << 20):
+        self.capacity = max(1, capacity)
+        self.max_total_bytes = max_total_bytes
+        self._box: OrderedDict[str, object] = OrderedDict()
+        self._sizes: dict[str, int] = {}
+        self._total = 0
+        self.parked = 0      # park() calls accepted (monotonic)
+        self.claimed = 0
+        self.evicted = 0
+
+    def park(self, msg_id: str, reply) -> bool:
+        """Store (or refresh) a reply for later claim."""
+        size = _reply_bytes(reply)
+        self._box[msg_id] = reply
+        self._box.move_to_end(msg_id)
+        self._total += size - self._sizes.get(msg_id, 0)
+        self._sizes[msg_id] = size
+        while len(self._box) > 1 and (
+                len(self._box) > self.capacity
+                or self._total > self.max_total_bytes):
+            old, _ = self._box.popitem(last=False)
+            self._total -= self._sizes.pop(old, 0)
+            self.evicted += 1
+        self.parked += 1
+        return True
+
+    def claim(self, msg_id: str):
+        """Pop one parked reply (None if absent / already claimed)."""
+        reply = self._box.pop(msg_id, None)
+        if reply is not None:
+            self._total -= self._sizes.pop(msg_id, 0)
+            self.claimed += 1
+        return reply
+
+    def claim_all(self) -> dict[str, object]:
+        """Pop everything, oldest first."""
+        out = dict(self._box)
+        self.claimed += len(out)
+        self._box.clear()
+        self._sizes.clear()
+        self._total = 0
+        return out
+
+    def ids(self) -> list[str]:
+        return list(self._box)
+
+    def counters(self) -> dict:
+        return {"parked": self.parked, "claimed": self.claimed,
+                "evicted": self.evicted, "held": len(self._box),
+                "bytes": self._total}
+
+    def __len__(self) -> int:
+        return len(self._box)
+
+
 class ReplayCache:
     """msg_id -> already-sent reply, bounded LRU.  Single-consumer by
     design: only the worker's serial request loop touches it."""

@@ -305,6 +305,18 @@ class Supervisor:
             for r in list(self._state):
                 self._transition(r, ALIVE, "healed")
             self.heals_done += 1
+            comm, pm = self._comm, self._pm
+        # Durable-session manifest upkeep: the healed fleet's pids and
+        # endpoint must replace the dead ones, or a later %dist_attach
+        # would adopt corpses.  (The magic-layer heal path rewrites the
+        # manifest through %dist_init anyway; this covers direct
+        # Supervisor embeddings.)  Best-effort by contract.
+        if comm is not None and pm is not None:
+            try:
+                from . import session as session_mod
+                session_mod.refresh_after_heal(comm, pm)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # reporting

@@ -35,7 +35,7 @@ from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability import telemetry as obs_telemetry
-from ..resilience.dedup import ReplayCache
+from ..resilience.dedup import _READ_ONLY, ReplayCache, ResultMailbox
 from ..resilience.faults import FaultPlan
 from . import collective_guard, executor, introspect
 from .interrupt import InterruptGate
@@ -48,6 +48,16 @@ def _load_hf_pretrained_lazy(name_or_path, **kw):
     return load_hf_pretrained(name_or_path, **kw)
 
 HEARTBEAT_INTERVAL_S = 2.0
+
+# Orphan grace (durable sessions, ISSUE 4): when the coordinator dies,
+# the worker does NOT exit — it parks the in-flight cell's result,
+# keeps its namespace and flight recorder, and waits up to
+# NBD_ORPHAN_TTL_S for a fresh coordinator to reattach (dialing the
+# control endpoint back, re-reading the session manifest between
+# attempts in case the new coordinator had to bind a different port).
+# TTL 0 disables the grace period (legacy exit-on-disconnect).
+DEFAULT_ORPHAN_TTL_S = 600.0
+ORPHAN_RECONNECT_POLL_S = 1.0
 
 
 class DistributedWorker:
@@ -70,6 +80,29 @@ class DistributedWorker:
         self._fault_plan = fault_plan
         self._install_plan: tuple | None = None  # armed by %dist_chaos
         self._msg_seen = 0  # control messages received (kill index)
+        # Durable-session state: the session token proves a reattaching
+        # coordinator resumes THIS session; the epoch fences stale
+        # coordinators out (only a hello may raise it); the mailbox
+        # parks results whose reply had no coordinator to land on.
+        self._session_token = os.environ.get("NBD_SESSION_TOKEN") or None
+        self._epoch = int(os.environ.get("NBD_SESSION_EPOCH", "0") or 0)
+        try:
+            self._orphan_ttl = float(
+                os.environ.get("NBD_ORPHAN_TTL_S", DEFAULT_ORPHAN_TTL_S))
+        except ValueError:
+            self._orphan_ttl = DEFAULT_ORPHAN_TTL_S
+        self._mailbox = ResultMailbox()
+        self._orphaned = False
+        self._hb_fail_streak = 0
+        # Message received while VALIDATING a reconnect (the hello a
+        # new coordinator owes us) — consumed by the run loop before
+        # its next channel.recv.
+        self._resume_msg = None
+        # (msg_type, msg_id, reply) of the last reply SENT: a send into
+        # a dying coordinator's socket can succeed locally yet never be
+        # read, so orphan entry re-parks it for redelivery (mutating
+        # types only — see _park).
+        self._last_reply: tuple | None = None
         # Observability: the process tracer (enabled by the 'trace'
         # control message), wire-frame accounting, and the directory
         # the ACTIVE jax.profiler trace was started with (None = not
@@ -130,9 +163,13 @@ class DistributedWorker:
         # --- control plane (reference: worker.py:154-157) ----------------
         # NBD_AUTH_TOKEN: shared secret required by non-loopback
         # coordinators (multihost); shipped via the worker env.
+        # Endpoint + auth kept for the orphan reconnect loop.
+        self._coordinator_host = coordinator_host
+        self._control_port = control_port
+        self._auth_token = os.environ.get("NBD_AUTH_TOKEN") or None
         self.channel = WorkerChannel(
             coordinator_host, control_port, rank=rank,
-            auth_token=os.environ.get("NBD_AUTH_TOKEN") or None)
+            auth_token=self._auth_token)
         self.channel.fault_plan = fault_plan
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            name="nbd-heartbeat", daemon=True)
@@ -238,17 +275,24 @@ class DistributedWorker:
             try:
                 self.channel.send(Message(msg_type="ping",
                                           rank=self.rank, data=data))
+                self._hb_fail_streak = 0
             except Exception as e:
-                # The last thing this process can still do is say WHY
-                # the pings stopped: the coordinator sees only silence,
-                # but the flight ring survives for the postmortem.
+                # Say WHY the pings stopped: the coordinator sees only
+                # silence, but the flight ring survives for the
+                # postmortem.  With orphan grace enabled the thread
+                # KEEPS RUNNING — the main loop owns reattach, and the
+                # swapped-in channel makes these sends succeed again;
+                # the streak counter is the orphan-entry signal.
+                self._hb_fail_streak += 1
                 obs_metrics.registry().counter(
                     "nbd_heartbeat_send_failures",
                     "heartbeat pings that failed to send").inc()
                 self._flight.record("heartbeat_send_failed",
-                                    error=f"{type(e).__name__}: {e}")
+                                    error=f"{type(e).__name__}: {e}",
+                                    streak=self._hb_fail_streak)
                 self._flight.flush()
-                return  # channel gone; main loop will notice
+                if self._orphan_ttl <= 0:
+                    return  # legacy: no grace period configured
 
     def _telemetry_extra(self) -> dict:
         """Resilience counters riding each telemetry snapshot, so the
@@ -405,6 +449,11 @@ class DistributedWorker:
         data["tracing"] = self._tracer.enabled
         if self._tracer.enabled:
             data["trace_spans"] = len(self._tracer)
+        # Durable-session state: what a reattached coordinator rebuilds
+        # its rank table from.
+        data["session_epoch"] = self._epoch
+        data["mailbox_parked"] = len(self._mailbox)
+        data["orphan_ttl_s"] = self._orphan_ttl
         return msg.reply(data=data, rank=self.rank)
 
     def _handle_chaos(self, msg: Message) -> Message:
@@ -635,6 +684,206 @@ class DistributedWorker:
                          rank=self.rank)
 
     # ------------------------------------------------------------------
+    # durable sessions (ISSUE 4): hello/mailbox handlers + orphan grace
+
+    def _handle_hello(self, msg: Message) -> Message:
+        """Session handover: a (re)attaching coordinator proves the
+        session token and presents its epoch.  An epoch >= ours is
+        adopted (frames from any older coordinator are rejected from
+        then on); a LOWER one is itself stale — two kernels racing to
+        attach resolve to whichever bumped the manifest last."""
+        data = msg.data or {}
+        if self._session_token and data.get("token") != self._session_token:
+            self._flight.record("hello_rejected", reason="token")
+            return msg.reply(data={"error": "session token mismatch "
+                                            "(not this fleet's session)"},
+                             rank=self.rank)
+        try:
+            epoch = int(data.get("epoch") or 0)
+        except (TypeError, ValueError):
+            return msg.reply(data={"error": "bad epoch"}, rank=self.rank)
+        if epoch < self._epoch:
+            self._flight.record("hello_rejected", reason="stale_epoch",
+                                offered=epoch, epoch=self._epoch)
+            return msg.reply(
+                data={"error": f"stale epoch {epoch} < {self._epoch}"},
+                rank=self.rank)
+        prev, self._epoch = self._epoch, epoch
+        self._flight.record("hello", epoch=epoch, prev_epoch=prev)
+        return msg.reply(
+            data={"status": "ok", "rank": self.rank, "pid": os.getpid(),
+                  "epoch": epoch, "world_size": self.world_size,
+                  "parked": self._mailbox.ids(),
+                  "dedup_hits": self._replay.hits,
+                  "namespace_size": len(self.namespace)},
+            rank=self.rank)
+
+    def _handle_mailbox(self, msg: Message) -> Message:
+        """Parked-result redelivery.  ``drain`` claims every parked
+        reply (destructive — exactly once; a REDELIVERED drain is
+        answered from the replay cache, which caches this very reply);
+        ``claim`` takes one by msg_id; default reports state."""
+        action = (msg.data or {}).get("action", "status")
+        if action == "drain":
+            claimed = self._mailbox.claim_all()
+            self._flight.record("mailbox_drained", n=len(claimed))
+            return msg.reply(
+                data={"status": "ok",
+                      "results": {mid: getattr(r, "data", None)
+                                  for mid, r in claimed.items()}},
+                rank=self.rank)
+        if action == "claim":
+            r = self._mailbox.claim((msg.data or {}).get("msg_id", ""))
+            return msg.reply(
+                data={"status": "ok",
+                      "result": getattr(r, "data", None)},
+                rank=self.rank)
+        return msg.reply(
+            data={"status": "ok", "parked": self._mailbox.ids(),
+                  "counters": self._mailbox.counters()},
+            rank=self.rank)
+
+    def _park(self, msg_type: str, msg_id: str, reply: Message) -> None:
+        """Park a reply for redelivery to a future coordinator.
+        Read-only replies are skipped (re-probing is safe and their
+        staleness makes redelivery noise); mutating results — exactly
+        what must not be lost or re-executed — are kept."""
+        if msg_type in _READ_ONLY or msg_type in ("hello", "mailbox"):
+            return
+        self._mailbox.park(msg_id, reply)
+        obs_metrics.registry().counter(
+            "nbd_mailbox_parked",
+            "replies parked for redelivery after coordinator "
+            "loss").inc()
+        self._flight.record("mailbox_parked", msg_id=msg_id,
+                            type=msg_type)
+
+    def _say(self, text: str) -> None:
+        """Orphan-path stdout: the spawning coordinator owned our
+        stdout pipe, so after ITS death a plain print raises
+        BrokenPipeError — precisely on the code path that exists to
+        survive that death."""
+        try:
+            print(text, flush=True)
+        except OSError:
+            pass
+
+    def _coordinator_endpoint(self) -> tuple[str, int, bool]:
+        """Where the reconnect loop should dial: the session manifest's
+        endpoint when one exists for OUR session (a reattaching
+        coordinator that couldn't re-bind the old port publishes its
+        replacement there), else the spawn-time endpoint.
+
+        The third element is ``expect_hello``: True when the manifest
+        epoch is AHEAD of ours — a new coordinator has claimed the
+        fleet and will hello promptly, so a listener at that endpoint
+        that never sends a frame is an impostor (an unrelated process
+        on a recycled port), not a coordinator.  A same-epoch endpoint
+        is the ORIGINAL coordinator (transient reconnect) and may
+        legitimately be idle, so no traffic is demanded of it."""
+        d = os.environ.get("NBD_RUN_DIR")
+        if d:
+            try:
+                from ..resilience.session import read_manifest
+                m = read_manifest(d)
+            except Exception:
+                m = None
+            if m is not None and (not self._session_token
+                                  or m.get("token") == self._session_token):
+                ctl = m.get("control") or {}
+                try:
+                    return (ctl.get("host") or self._coordinator_host,
+                            int(ctl.get("port") or self._control_port),
+                            int(m.get("epoch") or 0) > self._epoch)
+                except (TypeError, ValueError):
+                    pass
+        return self._coordinator_host, self._control_port, False
+
+    def _enter_orphan_and_wait(self) -> bool:
+        """The coordinator is gone: park the result it may never have
+        read, then poll the control endpoint until a fresh coordinator
+        listens there (True — resume serving) or the TTL expires
+        (False — self-terminate).  The heartbeat thread keeps running
+        throughout; its sends start succeeding the moment the channel
+        is swapped, which is also the new coordinator's liveness
+        signal."""
+        ttl = self._orphan_ttl
+        if ttl <= 0 or self._shutdown.is_set():
+            return False
+        last, self._last_reply = self._last_reply, None
+        if last is not None:
+            # This reply's send "succeeded" into a socket whose reader
+            # may already have been dead — keep it claimable.
+            self._park(*last)
+        self._orphaned = True
+        obs_metrics.registry().counter(
+            "nbd_orphan_transitions",
+            "orphan state machine transitions",
+            {"event": "entered"}).inc()
+        self._flight.record("orphan_entered", ttl_s=ttl,
+                            parked=len(self._mailbox))
+        self._flight.flush()
+        self._say(f"[worker {self.rank}] coordinator lost — orphaned, "
+                  f"awaiting reattach for {ttl:.0f}s")
+        deadline = time.monotonic() + ttl
+        while not self._shutdown.is_set():
+            host, port, expect_hello = self._coordinator_endpoint()
+            try:
+                ch = WorkerChannel(host, port, rank=self.rank,
+                                   auth_token=self._auth_token,
+                                   connect_timeout=5.0)
+            except Exception:
+                ch = None
+            if ch is not None and expect_hello:
+                # A NEW coordinator published this endpoint (manifest
+                # epoch ahead of ours): its hello must arrive or this
+                # listener isn't it — a bare TCP accept must not count
+                # as a reattach, or an unrelated process on a recycled
+                # port would absorb the worker forever and void the
+                # TTL contract.  The wait stays inside THIS episode's
+                # deadline, so a silent impostor can't extend grace.
+                step = min(30.0, max(1.0, deadline - time.monotonic()))
+                try:
+                    self._resume_msg = ch.recv(timeout=step)
+                except Exception:
+                    try:
+                        ch.close()
+                    except Exception:
+                        pass
+                    ch = None
+            if ch is not None:
+                ch.fault_plan = self._fault_plan
+                old, self.channel = self.channel, ch
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                self._orphaned = False
+                self._hb_fail_streak = 0
+                obs_metrics.registry().counter(
+                    "nbd_orphan_transitions",
+                    "orphan state machine transitions",
+                    {"event": "reattached"}).inc()
+                self._flight.record("orphan_reattached",
+                                    host=host, port=port)
+                self._say(f"[worker {self.rank}] reattached to "
+                          f"coordinator at {host}:{port}")
+                return True
+            if time.monotonic() >= deadline:
+                break
+            self._shutdown.wait(ORPHAN_RECONNECT_POLL_S)
+        obs_metrics.registry().counter(
+            "nbd_orphan_transitions",
+            "orphan state machine transitions",
+            {"event": "expired"}).inc()
+        self._flight.record("orphan_expired", ttl_s=ttl,
+                            parked=len(self._mailbox))
+        self._flight.flush()
+        self._say(f"[worker {self.rank}] orphan TTL expired unclaimed "
+                  "— self-terminating")
+        return False
+
+    # ------------------------------------------------------------------
 
     def run(self) -> None:
         """Serial request loop (reference: worker.py:181-246).  One request
@@ -651,6 +900,8 @@ class DistributedWorker:
             "chaos": self._handle_chaos,
             "trace": self._handle_trace,
             "metrics": self._handle_metrics,
+            "hello": self._handle_hello,
+            "mailbox": self._handle_mailbox,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*
@@ -673,13 +924,30 @@ class DistributedWorker:
                 # The channel scopes the gate's window to its select
                 # wait: bytes can never be lost to an interrupt
                 # mid-read (see WorkerChannel.recv); KI surfaces only
-                # here.
-                msg = self.channel.recv(gate=gate)
+                # here.  A frame consumed while VALIDATING a reconnect
+                # (the new coordinator's hello) is served first.
+                msg = self._resume_msg or self.channel.recv(gate=gate)
+                self._resume_msg = None
             except TransportError:
-                break  # coordinator gone
+                # Coordinator gone.  Durable sessions: enter orphan
+                # grace and wait for a fresh coordinator to reattach;
+                # only a TTL expiry (or TTL 0) ends this process.
+                if self._enter_orphan_and_wait():
+                    continue
+                break
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
             self._msg_seen += 1
+            # A new request proves the coordinator consumed our last
+            # reply (the serial request-response protocol: it only
+            # sends the next request after reading the previous
+            # response), so that reply no longer needs orphan-entry
+            # parking — without this, every later orphanhood would
+            # repark (and the next attach redeliver) a result the dead
+            # coordinator already displayed.  The genuinely in-flight
+            # request is still covered: its own reply send fails and
+            # parks directly.
+            self._last_reply = None
             # Flight event BEFORE the kill check: when an injected (or
             # real) preemption lands mid-request, the ring of the dead
             # process still names the fatal message — the postmortem's
@@ -693,6 +961,36 @@ class DistributedWorker:
                 # does — no teardown, no reply, mid-request.  (No flush
                 # needed: the mmap's dirty pages outlive the process.)
                 os.kill(os.getpid(), 9)  # SIGKILL
+            # Epoch fence (durable sessions): after a reattach raised
+            # our session epoch, frames stamped with an older one come
+            # from a coordinator that no longer owns this fleet — a
+            # stale kernel must be able to learn that, but never to
+            # execute, mutate, or SHUT DOWN the fleet (checked before
+            # the shutdown branch on purpose).  Only a hello can raise
+            # the epoch, so it is exempt here.
+            if (msg.epoch is not None and self._epoch
+                    and msg.epoch < self._epoch
+                    and msg.msg_type != "hello"):
+                obs_metrics.registry().counter(
+                    "nbd_epoch_rejected",
+                    "frames rejected from a stale-epoch "
+                    "coordinator").inc()
+                self._flight.record("epoch_rejected", msg_id=msg.msg_id,
+                                    type=msg.msg_type,
+                                    frame_epoch=msg.epoch,
+                                    epoch=self._epoch)
+                try:
+                    self.channel.send(msg.reply(
+                        data={"error": f"stale coordinator epoch "
+                                       f"{msg.epoch} (this fleet was "
+                                       f"reattached at epoch "
+                                       f"{self._epoch}); request "
+                                       f"ignored",
+                              "stale_epoch": True},
+                        rank=self.rank))
+                except Exception:
+                    pass
+                continue
             if msg.msg_type == "shutdown":
                 break  # no response, by protocol (reference: worker.py:205)
             cached = self._replay.get(msg.msg_id)
@@ -710,7 +1008,9 @@ class DistributedWorker:
                 try:
                     self.channel.send(cached)
                 except Exception:
-                    break
+                    # Channel died under the resend: keep the reply
+                    # claimable and let recv surface the orphan path.
+                    self._park(msg.msg_type, msg.msg_id, cached)
                 continue
             handler = handlers.get(msg.msg_type)
             self._busy = (msg.msg_type, time.time())
@@ -756,8 +1056,13 @@ class DistributedWorker:
             self._replay.put(msg, reply)
             try:
                 self.channel.send(reply)  # gate closed: frame is atomic
+                self._last_reply = (msg.msg_type, msg.msg_id, reply)
             except Exception:
-                break
+                # No coordinator to land the result on: park it for
+                # redelivery (mutating types) and loop — the next recv
+                # raises TransportError, which is the orphan entry.
+                self._park(msg.msg_type, msg.msg_id, reply)
+                continue
             if self._install_plan is not None:
                 # A %dist_chaos 'set' armed during this request: its
                 # ack is on the wire, start injecting now.

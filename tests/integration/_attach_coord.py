@@ -1,0 +1,67 @@
+"""Sacrificial first coordinator for the reattach integration test.
+
+NOT a test module (no ``test_`` prefix).  Run as a subprocess:
+
+    python tests/integration/_attach_coord.py RUN_DIR WORLD
+
+Brings up WORLD CPU workers with durable-session env (token, epoch 1,
+short-ish orphan TTL), writes the session manifest, seeds the
+namespace (``x = 42``, ``hits = 0``), then fires an in-flight cell
+(bump ``hits``, sleep, yield ``hits``) WITHOUT waiting for the reply,
+publishes the cell's msg_id + status to ``RUN_DIR/coord1.json``,
+prints READY, and sleeps until the test SIGKILLs it mid-cell — the
+coordinator-crash scenario the reattach path exists for.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+
+def main() -> int:
+    run_dir, world = sys.argv[1], int(sys.argv[2])
+    os.environ["NBD_RUN_DIR"] = run_dir
+
+    from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+    from nbdistributed_tpu.messaging import CommunicationManager
+    from nbdistributed_tpu.resilience import session
+
+    token = session.mint_token()
+    comm = CommunicationManager(num_workers=world, timeout=120,
+                                session_token=token, session_epoch=1)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    pm.start_workers(world, comm.port, backend="cpu", extra_env={
+        "NBD_SESSION_TOKEN": token,
+        "NBD_SESSION_EPOCH": "1",
+        "NBD_ORPHAN_TTL_S": "120",
+    })
+    wait_until_ready(comm, pm, 180)
+    session.write_manifest(run_dir, session.make_manifest(
+        world_size=world, control_host="127.0.0.1",
+        control_port=comm.port, token=token, epoch=1,
+        pids={r: p.pid for r, p in pm.processes.items()},
+        backend="cpu", dist_port=pm.dist_port,
+        init_line=f"-n {world} --backend cpu"))
+    comm.send_to_all("execute", "x = 42", timeout=120)
+    comm.send_to_all("execute", "hits = 0", timeout=120)
+    # The in-flight cell: mutates state (so double-execution would be
+    # provable), sleeps past this process's death, and its final
+    # expression is the result the mailbox must redeliver exactly once.
+    fatal_mid = comm.post(
+        list(range(world)), "execute",
+        {"code": "hits += 1\nimport time\ntime.sleep(4.0)\nhits"})
+    with open(os.path.join(run_dir, "coord1.json"), "w") as f:
+        json.dump({"fatal_mid": fatal_mid, "pid": os.getpid(),
+                   "port": comm.port, "token": token}, f)
+    print("READY", flush=True)
+    time.sleep(600)  # SIGKILLed here by the test
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Acceptance tests for durable sessions (ISSUE 4).
+
+The scenario the tentpole exists for, end to end on the CPU backend:
+
+1. A **sacrificial coordinator subprocess** brings up a 4-rank fleet,
+   seeds the namespace, fires an in-flight cell, and is SIGKILLed
+   mid-cell by this test — the kernel-restart failure mode.
+2. The test process becomes the **fresh coordinator**: it reattaches
+   via the session manifest and asserts (a) every rank's pre-crash
+   namespace is intact, (b) the interrupted cell's parked result is
+   redelivered exactly once with zero double-execution, and (c) a
+   stale coordinator's epoch-stamped frames are rejected without
+   executing.
+3. A separate fleet with a short ``NBD_ORPHAN_TTL_S`` is orphaned and
+   NOT reattached: (d) every worker self-terminates at TTL expiry with
+   flight-recorded ``orphan_expired`` events.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.observability import flightrec
+from nbdistributed_tpu.resilience import session
+
+pytestmark = [pytest.mark.integration, pytest.mark.faults]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+COORD1 = os.path.join(REPO_ROOT, "tests", "integration",
+                      "_attach_coord.py")
+WORLD = 4
+
+
+def outputs(responses):
+    return {r: m.data.get("output") for r, m in responses.items()}
+
+
+def _kill_manifest_pids(run_dir):
+    m = session.read_manifest(run_dir) or {}
+    for pid in (m.get("pids") or {}).values():
+        try:
+            os.kill(int(pid), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+
+
+def test_coordinator_crash_attach_redeliver_epoch(tmp_path,
+                                                  monkeypatch):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    monkeypatch.setenv("NBD_RUN_DIR", run_dir)
+    flightrec.reset_for_tests()
+
+    coord1 = subprocess.Popen(
+        [sys.executable, COORD1, run_dir, str(WORLD)],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    comm = pm = None
+    try:
+        # --- phase 1: sacrificial coordinator up, cell in flight -----
+        status_path = os.path.join(run_dir, "coord1.json")
+        deadline = time.time() + 240
+        while not os.path.exists(status_path):
+            assert coord1.poll() is None, (
+                "coordinator #1 died during bring-up:\n"
+                + coord1.stdout.read().decode("utf-8", "replace"))
+            assert time.time() < deadline, "coordinator #1 never ready"
+            time.sleep(0.2)
+        st = json.load(open(status_path))
+        fatal_mid = st["fatal_mid"]
+        time.sleep(1.0)  # the cell (sleep 4s) is now genuinely mid-flight
+        os.kill(coord1.pid, signal.SIGKILL)  # kernel restart, simulated
+        coord1.wait()
+
+        # --- phase 2: fresh coordinator reattaches -------------------
+        comm, pm, manifest, hello = session.attach(
+            run_dir, attach_timeout=120, request_timeout=120)
+        assert comm.session_epoch == 2
+        assert manifest["epoch"] == 2
+        assert manifest["control"]["port"] == comm.port
+        assert sorted(hello) == list(range(WORLD))
+        for r, h in hello.items():
+            assert h.data["status"] == "ok" and h.data["epoch"] == 2
+            # the interrupted cell's result is parked on every rank
+            assert fatal_mid in h.data["parked"], \
+                f"rank {r} parked {h.data['parked']}, not {fatal_mid}"
+
+        # (a) pre-crash namespace intact on all ranks
+        out = outputs(comm.send_to_all("execute", "x", timeout=120))
+        assert out == {r: "42" for r in range(WORLD)}
+
+        # (b) parked result redelivered exactly once, zero
+        # double-execution (the cell incremented `hits` exactly once)
+        drained = session.drain_mailboxes(comm)
+        for r in range(WORLD):
+            assert drained[r][fatal_mid]["output"] == "1", drained[r]
+        again = session.drain_mailboxes(comm)
+        assert all(not d for d in again.values()), again
+        out = outputs(comm.send_to_all("execute", "hits", timeout=120))
+        assert out == {r: "1" for r in range(WORLD)}, \
+            f"interrupted cell double-executed: {out}"
+        stat = comm.send_to_all("mailbox", {"action": "status"},
+                                timeout=60)
+        for r, m in stat.items():
+            c = m.data["counters"]
+            assert c["parked"] >= 1 and c["claimed"] >= 1
+            assert not m.data["parked"]
+        # dedup counters prove redelivery never re-ran anything
+        gs = comm.send_to_all("get_status", timeout=60)
+        for r, m in gs.items():
+            assert m.data["session_epoch"] == 2
+            assert m.data["mailbox_parked"] == 0
+
+        # (c) a stale coordinator's frames are rejected by epoch and
+        # do NOT execute
+        comm.session_epoch = 1  # impersonate the dead coordinator
+        try:
+            resp = comm.send_to_all("execute", "x = 'clobbered'",
+                                    timeout=60)
+        finally:
+            comm.session_epoch = 2
+        for r, m in resp.items():
+            assert m.data.get("stale_epoch") is True
+            assert "stale coordinator epoch 1" in m.data["error"]
+        out = outputs(comm.send_to_all("execute", "x", timeout=120))
+        assert out == {r: "42" for r in range(WORLD)}, \
+            "stale-epoch execute mutated the namespace"
+
+        # a normal cell still works at the new epoch, end to end
+        out = outputs(comm.send_to_all(
+            "execute", "y = x + rank\ny", timeout=120))
+        assert out == {r: str(42 + r) for r in range(WORLD)}
+    finally:
+        if coord1.poll() is None:
+            coord1.kill()
+        if comm is not None:
+            try:
+                comm.post(list(range(WORLD)), "shutdown")
+                time.sleep(0.3)
+            except Exception:
+                pass
+            comm.shutdown()
+        if pm is not None:
+            pm.shutdown()
+        _kill_manifest_pids(run_dir)
+        flightrec.reset_for_tests()
+
+
+def test_orphan_ttl_expiry_self_terminates(tmp_path, monkeypatch):
+    run_dir = str(tmp_path / "run")
+    monkeypatch.setenv("NBD_RUN_DIR", run_dir)
+    flightrec.reset_for_tests()
+    world = 2
+    comm = CommunicationManager(num_workers=world, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    try:
+        pm.start_workers(world, comm.port, backend="cpu", extra_env={
+            "NBD_ORPHAN_TTL_S": "2"})
+        wait_until_ready(comm, pm, 120)
+        out = outputs(comm.send_to_all("execute", "1 + 1", timeout=60))
+        assert out == {0: "2", 1: "2"}
+        # Coordinator "dies": the listener closes, nothing ever
+        # reattaches, and no teardown signal is sent to the workers.
+        pm.quiesce()
+        comm.shutdown()
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            if all(p.poll() is not None for p in pm.processes.values()):
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("orphaned workers did not self-terminate at "
+                        "TTL expiry")
+        # Clean exits (no signal): the TTL path is a deliberate
+        # shutdown, not a crash.
+        assert all(p.poll() == 0 for p in pm.processes.values()), \
+            {r: p.poll() for r, p in pm.processes.items()}
+        # Flight rings narrate the whole orphan lifecycle.
+        for r in range(world):
+            ring = flightrec.read_latest(run_dir, f"rank{r}")
+            assert ring is not None
+            kinds = [e.get("t") for e in ring["events"]]
+            assert "orphan_entered" in kinds
+            assert "orphan_expired" in kinds
+            assert "worker_shutdown" in kinds  # clean self-termination
+            assert "orphan_reattached" not in kinds
+    finally:
+        pm.shutdown()
+        try:
+            comm.shutdown()
+        except Exception:
+            pass
+        flightrec.reset_for_tests()

@@ -512,6 +512,57 @@ def test_dist_chaos_and_supervise_magics(ip, capsys):
     assert "supervisor stopped" in out
 
 
+def test_status_shows_durable_session_header(ip, capsys):
+    """%dist_status names the run dir, token fingerprint, epoch, and
+    the orphan-capable state of a durable session (ISSUE 4)."""
+    ip.run_line_magic("dist_status", "")
+    out = capsys.readouterr().out
+    assert "session: run " in out
+    assert "epoch 1" in out
+    assert "orphan-capable" in out
+    assert "token" in out
+
+
+def test_session_manifest_written_by_init(ip):
+    """%dist_init persisted an adoptable manifest under NBD_RUN_DIR:
+    live pids, the live control port, epoch 1, a token."""
+    import os
+
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    from nbdistributed_tpu.resilience import session
+
+    m = session.read_manifest(os.environ["NBD_RUN_DIR"])
+    assert m is not None
+    assert m["world_size"] == 2
+    assert m["control"]["port"] == DistributedMagics._comm.port
+    assert sorted(session.live_pids(m)) == [0, 1]
+    assert m["epoch"] == 1 and m["token"]
+    assert m["init_line"] == DistributedMagics._last_init_line
+
+
+def test_dist_gc_magic_sweeps_stale_runs(ip, capsys, tmp_path):
+    """%dist_gc --dry-run lists but keeps; the real run removes only
+    the stale sibling (old manifest, dead pid)."""
+    import os
+    import time as _time
+
+    from nbdistributed_tpu.resilience import session
+
+    root = str(tmp_path / "runs")
+    d = os.path.join(root, "run-dead")
+    session.write_manifest(d, session.make_manifest(
+        world_size=1, control_host="127.0.0.1", control_port=1,
+        token="t", epoch=1, pids={0: 2 ** 22 + 7}))
+    old = _time.time() - 7200
+    os.utime(session.manifest_path(d), (old, old))
+    ip.run_line_magic("dist_gc", f"--dry-run --ttl 3600 --root {root}")
+    out = capsys.readouterr().out
+    assert "would sweep 1" in out and os.path.isdir(d)
+    ip.run_line_magic("dist_gc", f"--ttl 3600 --root {root}")
+    out = capsys.readouterr().out
+    assert "swept 1" in out and not os.path.exists(d)
+
+
 def test_dist_heal_respawns_and_restores(ip, capsys, tmp_path):
     """Elastic recovery (SURVEY §5.3): kill a worker hard, %dist_heal
     rebuilds the world with the remembered %dist_init config and

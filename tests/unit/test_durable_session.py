@@ -1,0 +1,334 @@
+"""Unit tests for durable sessions (ISSUE 4): the session manifest
+round-trip, the parked-result mailbox, the codec's epoch header, the
+stale-run GC, and ProcessManager adoption of externally-discovered
+pids."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager.process_manager import (_AdoptedProcess,
+                                                       ProcessManager)
+from nbdistributed_tpu.messaging import Message, decode, encode
+from nbdistributed_tpu.resilience import ResultMailbox, session
+
+pytestmark = [pytest.mark.unit, pytest.mark.faults]
+
+
+# ----------------------------------------------------------------------
+# manifest round-trip
+
+def _manifest(**kw):
+    base = dict(world_size=2, control_host="127.0.0.1",
+                control_port=5123, token="tok123", epoch=1,
+                pids={0: 100, 1: 101}, backend="cpu", dist_port=5999,
+                init_line="-n 2 --backend cpu")
+    base.update(kw)
+    return session.make_manifest(**base)
+
+
+def test_manifest_roundtrip(tmp_path):
+    d = str(tmp_path / "run")
+    path = session.write_manifest(d, _manifest())
+    assert os.path.basename(path) == session.MANIFEST_NAME
+    assert not os.path.exists(path + ".tmp")  # atomic replace
+    m = session.read_manifest(d)
+    assert m["world_size"] == 2
+    assert m["control"] == {"host": "127.0.0.1", "port": 5123,
+                            "bind_host": "127.0.0.1"}
+    assert m["token"] == "tok123" and m["epoch"] == 1
+    assert m["pids"] == {"0": 100, "1": 101}  # JSON string keys
+    assert m["init_line"] == "-n 2 --backend cpu"
+    assert m["updated_ts"] > 0
+
+
+def test_manifest_update_and_epoch_bump(tmp_path):
+    d = str(tmp_path / "run")
+    session.write_manifest(d, _manifest())
+    m = session.update_manifest(d, epoch=2,
+                                control={"host": "127.0.0.1",
+                                         "port": 6000,
+                                         "bind_host": "127.0.0.1"})
+    assert m["epoch"] == 2 and m["control"]["port"] == 6000
+    # unrelated fields survive the read-modify-write
+    assert session.read_manifest(d)["token"] == "tok123"
+
+
+def test_manifest_missing_and_corrupt(tmp_path):
+    assert session.read_manifest(str(tmp_path / "nope")) is None
+    d = str(tmp_path / "bad")
+    os.makedirs(d)
+    with open(session.manifest_path(d), "w") as f:
+        f.write("{torn json")
+    assert session.read_manifest(d) is None
+    assert session.update_manifest(d, epoch=9) is None
+
+
+def test_end_session_removes_manifest(tmp_path):
+    d = str(tmp_path / "run")
+    session.write_manifest(d, _manifest())
+    assert session.end_session(d) is True
+    assert session.read_manifest(d) is None
+    assert session.end_session(d) is False  # already gone
+    assert session.end_session(None) is False
+
+
+def test_token_mint_and_fingerprint():
+    a, b = session.mint_token(), session.mint_token()
+    assert a != b and len(a) == 16
+    assert session.token_fingerprint(a) != session.token_fingerprint(b)
+    assert len(session.token_fingerprint(a)) == 8
+    assert a not in session.token_fingerprint(a)  # never the secret
+    assert session.token_fingerprint(None) == "-"
+
+
+def test_live_pids_filters_dead(tmp_path):
+    m = _manifest(pids={0: os.getpid(), 1: 2 ** 22 + 12345})
+    live = session.live_pids(m)
+    assert live == {0: os.getpid()}
+    m["pids"]["2"] = "garbage"
+    assert session.live_pids(m) == {0: os.getpid()}
+
+
+# ----------------------------------------------------------------------
+# result mailbox
+
+def _reply(mid, data):
+    return Message(msg_type="response", msg_id=mid, data=data)
+
+
+def test_mailbox_park_claim_exactly_once():
+    box = ResultMailbox()
+    box.park("m1", _reply("m1", {"output": "1"}))
+    box.park("m2", _reply("m2", {"output": "2"}))
+    assert box.ids() == ["m1", "m2"] and len(box) == 2
+    r = box.claim("m1")
+    assert r.data == {"output": "1"}
+    assert box.claim("m1") is None  # destructive: exactly once
+    rest = box.claim_all()
+    assert list(rest) == ["m2"] and len(box) == 0
+    assert box.claim_all() == {}
+    c = box.counters()
+    assert c["parked"] == 2 and c["claimed"] == 2 and c["evicted"] == 0
+
+
+def test_mailbox_capacity_evicts_oldest():
+    box = ResultMailbox(capacity=3)
+    for i in range(5):
+        box.park(f"m{i}", _reply(f"m{i}", {"output": str(i)}))
+    assert box.ids() == ["m2", "m3", "m4"]
+    assert box.counters()["evicted"] == 2
+
+
+def test_mailbox_byte_bound_keeps_newest():
+    box = ResultMailbox(capacity=100, max_total_bytes=2000)
+    for i in range(5):
+        box.park(f"m{i}", _reply(f"m{i}", {"output": "x" * 900}))
+    assert "m4" in box.ids() and len(box) <= 3
+    # a single oversized entry is still kept (it is the in-flight
+    # cell's result — the thing reattach exists to recover)
+    big = ResultMailbox(capacity=4, max_total_bytes=100)
+    big.park("huge", _reply("huge", {"output": "y" * 10_000}))
+    assert big.ids() == ["huge"]
+
+
+def test_mailbox_repark_same_id_refreshes():
+    box = ResultMailbox()
+    box.park("m", _reply("m", {"output": "old"}))
+    box.park("m", _reply("m", {"output": "new"}))
+    assert len(box) == 1
+    assert box.claim("m").data == {"output": "new"}
+
+
+# ----------------------------------------------------------------------
+# codec epoch header
+
+def test_codec_epoch_roundtrip_and_absent_when_unset():
+    msg = Message(msg_type="execute", data={"code": "1"}, epoch=3)
+    out = decode(encode(msg))
+    assert out.epoch == 3 and out.msg_id == msg.msg_id
+    plain = Message(msg_type="execute", data={"code": "1"})
+    frame = encode(plain)
+    assert decode(frame).epoch is None
+    # unstamped frames keep the pre-epoch wire format byte-for-byte
+    assert b'"ep"' not in frame
+    # replies never inherit the request's epoch
+    assert msg.reply(data={}).epoch is None
+
+
+# ----------------------------------------------------------------------
+# stale-run GC
+
+def _mk_run(root, name, *, pids, age_s, manifest=True):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    ref = d
+    if manifest:
+        session.write_manifest(d, _manifest(pids=pids))
+        ref = session.manifest_path(d)
+    old = time.time() - age_s
+    os.utime(ref, (old, old))
+    return d
+
+
+def test_gc_sweeps_only_stale_dead_runs(tmp_path, monkeypatch):
+    root = str(tmp_path / "nbd_runs")
+    stale = _mk_run(root, "run-old-dead", pids={0: 2 ** 22 + 1},
+                    age_s=7200)
+    live = _mk_run(root, "run-old-live", pids={0: os.getpid()},
+                   age_s=7200)
+    fresh = _mk_run(root, "run-fresh-dead", pids={0: 2 ** 22 + 2},
+                    age_s=10)
+    bare = _mk_run(root, "run-bare", pids={}, age_s=7200,
+                   manifest=False)
+    current = _mk_run(root, "run-current", pids={0: 2 ** 22 + 3},
+                      age_s=7200)
+    monkeypatch.setenv("NBD_RUN_DIR", current)
+
+    dry = session.gc_runs(root, ttl_s=3600, dry_run=True)
+    assert sorted(dry["swept"]) == sorted([stale, bare])
+    assert all(os.path.isdir(d) for d in (stale, live, fresh, bare))
+
+    res = session.gc_runs(root, ttl_s=3600)
+    assert sorted(res["swept"]) == sorted([stale, bare])
+    assert not os.path.exists(stale) and not os.path.exists(bare)
+    # live pid, fresh mtime, and the current run dir all survive
+    assert os.path.isdir(live) and os.path.isdir(fresh)
+    assert os.path.isdir(current)
+    assert current in res["kept"]
+
+
+def test_gc_missing_root_is_empty(tmp_path):
+    res = session.gc_runs(str(tmp_path / "absent"), ttl_s=1)
+    assert res["swept"] == [] and res["errors"] == []
+
+
+def test_discover_run_dir_prefers_env_then_newest(tmp_path,
+                                                  monkeypatch):
+    root = str(tmp_path / "nbd_runs")
+    older = _mk_run(root, "run-a", pids={0: os.getpid()}, age_s=100)
+    newer = _mk_run(root, "run-b", pids={0: os.getpid()}, age_s=0)
+    _mk_run(root, "run-dead", pids={0: 2 ** 22 + 9}, age_s=0)
+    monkeypatch.delenv("NBD_RUN_DIR", raising=False)
+    monkeypatch.setattr(session, "default_runs_root", lambda: root)
+    assert session.discover_run_dir() == newer
+    monkeypatch.setenv("NBD_RUN_DIR", older)
+    assert session.discover_run_dir() == older
+
+
+# ----------------------------------------------------------------------
+# attach lock (split-brain guard) + attach failure hygiene
+
+def test_attach_lock_contested_stale_and_release(tmp_path):
+    d = str(tmp_path)
+    lock = session._acquire_attach_lock(d)
+    # held by a live pid (ours): a second claimant must fail loudly
+    with pytest.raises(RuntimeError, match="another coordinator"):
+        session._acquire_attach_lock(d)
+    session._release_attach_lock(lock)
+    # a dead holder's abandoned lock is broken and re-claimed
+    with open(os.path.join(d, session.LOCK_NAME), "w") as f:
+        f.write(str(2 ** 22 + 99))
+    lock2 = session._acquire_attach_lock(d)
+    assert int(open(lock2).read()) == os.getpid()
+    session._release_attach_lock(lock2)
+    session._release_attach_lock(lock2)  # idempotent
+
+
+def test_attach_failure_restores_env_and_releases_lock(tmp_path,
+                                                       monkeypatch):
+    """A failed attach must not leave this kernel pointed at a fleet
+    it never joined (a later %dist_init would clobber its manifest),
+    must release the epoch lock, and must not kill the fleet."""
+    d = str(tmp_path / "run")
+    session.write_manifest(d, _manifest(world_size=1,
+                                        pids={0: os.getpid()},
+                                        control_port=0))
+    monkeypatch.setenv("NBD_RUN_DIR", "/somewhere/else")
+    with pytest.raises(TimeoutError):
+        # our own pid poses as the worker; it never dials the control
+        # plane, so the readiness wait times out
+        session.attach(d, attach_timeout=0.1)
+    assert os.environ["NBD_RUN_DIR"] == "/somewhere/else"
+    assert not os.path.exists(os.path.join(d, session.LOCK_NAME))
+    # the epoch claim itself is durable (manifest already bumped) so a
+    # retry claims the NEXT epoch — but the fleet was left untouched
+    assert session.read_manifest(d)["epoch"] == 2
+
+
+# ----------------------------------------------------------------------
+# ProcessManager adoption
+
+def test_adopted_process_polls_liveness():
+    alive = _AdoptedProcess(os.getpid())
+    assert alive.poll() is None
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    gone = _AdoptedProcess(child.pid)
+    assert gone.poll() == -1  # exit code of a non-child is unknowable
+    assert gone.poll() == -1  # stable after first detection
+    assert gone.wait(timeout=1) == -1
+
+
+def test_process_manager_adopt_and_death_watch():
+    child = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(60)"],
+                             start_new_session=True)
+    pm = ProcessManager()
+    deaths = []
+    pm.add_death_callback(lambda r, rc: deaths.append((r, rc)))
+    try:
+        pm.adopt({0: child.pid}, backend="cpu", dist_port=None)
+        assert pm.world_size == 1 and pm.backend == "cpu"
+        assert pm.alive_ranks() == [0]
+        assert pm.is_running()
+        assert "adopted" in pm.io[0].tail()
+        with pytest.raises(RuntimeError):
+            pm.adopt({1: os.getpid()})  # already running
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait()  # reap so signal-0 stops seeing it
+        deadline = time.time() + 10
+        while not deaths and time.time() < deadline:
+            time.sleep(0.05)
+        assert deaths == [(0, -1)]
+        assert pm.alive_ranks() == []
+    finally:
+        pm.shutdown()
+        if child.poll() is None:
+            child.kill()
+
+
+# ----------------------------------------------------------------------
+# refresh_after_heal manifest upkeep
+
+class _FakeComm:
+    def __init__(self, port, epoch, n):
+        self.port = port
+        self.session_epoch = epoch
+        self.num_workers = n
+
+
+class _FakePm:
+    def __init__(self, pids):
+        self.processes = {r: _AdoptedProcess(p)
+                          for r, p in pids.items()}
+
+
+def test_refresh_after_heal_updates_pids_and_port(tmp_path,
+                                                  monkeypatch):
+    d = str(tmp_path / "run")
+    session.write_manifest(d, _manifest())
+    monkeypatch.setenv("NBD_RUN_DIR", d)
+    m = session.refresh_after_heal(_FakeComm(7777, 3, 2),
+                                   _FakePm({0: 200, 1: 201}))
+    assert m["pids"] == {"0": 200, "1": 201}
+    assert m["control"]["port"] == 7777
+    assert m["epoch"] == 3
+    monkeypatch.delenv("NBD_RUN_DIR")
+    assert session.refresh_after_heal(_FakeComm(1, 1, 1),
+                                      _FakePm({})) is None
