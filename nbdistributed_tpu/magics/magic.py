@@ -63,6 +63,8 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_profile start/stop · %dist_trace start/stop/save (Perfetto) ·
 %dist_metrics · %dist_top (live device telemetry) ·
 %dist_postmortem (crash bundles from the flight recorder) ·
+%dist_watchdog (collective hang detection + escalation) ·
+%dist_doctor (stuck-cell report: skew table, stacks, flight tails) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
 %dist_gc (sweep stale session run dirs) ·
@@ -111,6 +113,15 @@ class DistributedMagics(Magics):
 
     # Active auto-heal supervisor (resilience/supervisor.py), or None.
     _supervisor = None
+    # Active hang watchdog (resilience/watchdog.py), or None.  Auto-
+    # started by %dist_init/%dist_attach when NBD_HANG enables it
+    # (default on, ladder warn→dump); reconfigured by %dist_watchdog.
+    _watchdog = None
+    # True while %dist_heal is tearing down + respawning: shutdown_all
+    # must NOT discard the watchdog then — the replayed %dist_init
+    # re-binds the SAME instance, preserving a %dist_watchdog-
+    # customized policy and the counters/event history.
+    _healing: bool = False
     # True when this kernel joined the fleet via %dist_attach rather
     # than spawning it (durable sessions) — surfaced in %dist_status.
     _attached: bool = False
@@ -203,6 +214,9 @@ class DistributedMagics(Magics):
         if cls._supervisor is not None:
             cls._supervisor.stop()
             cls._supervisor = None
+        if cls._watchdog is not None:
+            cls._watchdog.stop()
+            cls._watchdog = None
         # In-flight background-save tracking is world-specific (per-
         # rank doneness): stale entries from a previous (possibly
         # larger) world must not promote a half-written checkpoint in
@@ -257,7 +271,8 @@ class DistributedMagics(Magics):
                 print(f"[rank {rank}] {text}", end=""
                       if text.endswith("\n") else "\n")
 
-    def _run_on_ranks(self, code: str, ranks: list[int], kind: str):
+    def _run_on_ranks(self, code: str, ranks: list[int], kind: str,
+                      deadline_s: float | None = None):
         """Send an execute request and stream output while waiting
         (reference: magic.py:1042-1129 runs the send in a helper thread
         and polls buffers from the main thread; same structure, 30 ms
@@ -291,10 +306,15 @@ class DistributedMagics(Magics):
                 # a strict subset (runtime/collective_guard.py) —
                 # BEFORE the control plane would hang on replies that
                 # cannot come.
+                payload = {"code": code, "target_ranks": list(ranks)}
+                if deadline_s is not None:
+                    # The worker echoes this back on heartbeats so
+                    # the hang watchdog can enforce the budget with
+                    # no coordinator-side bookkeeping.
+                    payload["deadline_s"] = deadline_s
                 with tr.activate(cell_span):
                     result.update(comm.send_to_ranks(
-                        ranks, "execute",
-                        {"code": code, "target_ranks": list(ranks)}))
+                        ranks, "execute", payload))
             except Exception as e:
                 error.append(e)
 
@@ -567,6 +587,7 @@ class DistributedMagics(Magics):
             DistributedMagics._last_ckpt_path = None
         DistributedMagics._last_init_line = line
         self._enable_auto_mode()
+        self._maybe_start_watchdog()
         print(_BANNER.format(n=num_workers,
                              backend=pm.backend,
                              secs=time.time() - t0))
@@ -627,9 +648,13 @@ class DistributedMagics(Magics):
         print(f"🩹 healing: dead ranks {dead if dead else '(world down)'}"
               f" — rebuilding with: %dist_init {replay}")
         sup = DistributedMagics._supervisor  # survives a manual heal
-        self.shutdown_all()
-        self._nuclear_shutdown()
-        self.dist_init(replay)
+        DistributedMagics._healing = True    # so does the watchdog
+        try:
+            self.shutdown_all()
+            self._nuclear_shutdown()
+            self.dist_init(replay)
+        finally:
+            DistributedMagics._healing = False
         if not self._running():
             print("❌ heal failed: the replayed %dist_init did not "
                   "bring the world up")
@@ -728,6 +753,7 @@ class DistributedMagics(Magics):
             print("🛡  re-arming supervision (the session had "
                   "%dist_supervise on)")
             self.dist_supervise("on")
+        self._maybe_start_watchdog()
         print("Every cell runs on ALL workers again. %dist_status "
               "shows the session header.")
 
@@ -960,15 +986,203 @@ class DistributedMagics(Magics):
                  f"{args.kill_at or 1}" if kill_armed else "") + warn)
 
     # ==================================================================
-    # execution magics
+    # hang watchdog + stuck-cell doctor (ISSUE 5)
 
-    @cell_magic
-    def distributed(self, line, cell):
-        """Run the cell on every worker (reference: magic.py:1042-1129)."""
+    def _maybe_start_watchdog(self) -> None:
+        """Arm (or, after a heal, re-bind) the hang watchdog for the
+        world that just came up.  Policy comes from the NBD_HANG_* env
+        knobs (NBD_HANG=0 disables; %dist_watchdog reconfigures)."""
+        from ..resilience.watchdog import HangPolicy, HangWatchdog
+        wd = DistributedMagics._watchdog
+        if wd is not None:
+            # Heal path: the surviving watchdog re-binds to the fresh
+            # world, keeping any %dist_watchdog-customized policy —
+            # UNCONDITIONALLY, before any env parsing: an env that
+            # fails the strict parse (or NBD_HANG flipped to 0
+            # mid-session) must not leave this instance silently
+            # watching the torn-down world's comm/pm forever.
+            wd.attach(self._comm, self._pm)
+            return
+        try:
+            policy = HangPolicy.from_env()
+        except ValueError as e:
+            print(f"⚠️ hang watchdog NOT started: {e}")
+            return
+        if not policy.enabled:
+            return
+        wd = HangWatchdog(policy, heal=self._supervised_heal)
+        wd.attach(self._comm, self._pm)
+        DistributedMagics._watchdog = wd
+
+    @staticmethod
+    def _hang_piggyback_off() -> bool:
+        """Workers gate the heartbeat collective-position piggyback on
+        NBD_HANG at SPAWN time: with it off, a coordinator-side
+        watchdog can only ever see coarse busy state (stall detection;
+        no skew, no --deadline)."""
+        import os as _os
+        return str(_os.environ.get("NBD_HANG", "1")).lower() \
+            in ("0", "false", "off")
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["on", "off", "status"])
+    @argument("--skew", type=float, default=None,
+              help="seconds a rank may lag its peers' collective "
+                   "position before the cell is flagged HUNG")
+    @argument("--stall", type=float, default=None,
+              help="seconds a rank may stay busy with zero collective "
+                   "progress before the cell is flagged HUNG")
+    @argument("--poll", type=float, default=None,
+              help="watchdog poll cadence in seconds")
+    @argument("--grace", type=float, default=None,
+              help="pause between escalation ladder steps")
+    @argument("--escalate", default=None,
+              help="comma-separated ladder from: warn,dump,interrupt,"
+                   "heal (default warn,dump)")
+    @line_magic
+    def dist_watchdog(self, line):
+        """Collective hang watchdog: compares every rank's position in
+        the collective stream (piggybacked on heartbeats) and flags a
+        cell HUNG — cross-rank skew, absolute stall, or a blown
+        ``%%distributed --deadline`` — distinct from merely slow, then
+        walks the escalation ladder: warn → stack-dump (SIGUSR1) →
+        interrupt → heal.  ``%dist_watchdog on [knobs] | off |
+        status``; auto-armed at %dist_init unless NBD_HANG=0."""
+        from ..resilience.watchdog import (HangPolicy, HangWatchdog,
+                                           parse_ladder)
+        args = parse_argstring(self.dist_watchdog, line)
+        wd = DistributedMagics._watchdog
+        if args.command != "on" and any(
+                v is not None for v in (args.skew, args.stall,
+                                        args.poll, args.grace,
+                                        args.escalate)):
+            # Knobs without 'on' would be parsed and silently dropped
+            # — the user would believe the policy changed.
+            print("❌ policy flags require the 'on' subcommand "
+                  "(%dist_watchdog on --stall ...); nothing changed")
+            return
+        if args.command == "off":
+            if wd is None:
+                print("hang watchdog: not running")
+                return
+            wd.stop()
+            DistributedMagics._watchdog = None
+            print("✅ hang watchdog stopped")
+            return
+        if args.command == "status":
+            if wd is None:
+                print("hang watchdog: not running (%dist_watchdog on)")
+            else:
+                print(wd.describe())
+            return
         if not self._require_cluster():
             return
+        # Lenient env parse: a typo'd NBD_HANG_ESCALATE must not wedge
+        # the one command that can fix it.
+        base = (wd.policy if wd is not None
+                else HangPolicy.from_env_lenient())
+        try:
+            policy = HangPolicy(
+                enabled=True,
+                poll_s=args.poll if args.poll is not None
+                else base.poll_s,
+                skew_s=args.skew if args.skew is not None
+                else base.skew_s,
+                stall_s=args.stall if args.stall is not None
+                else base.stall_s,
+                grace_s=args.grace if args.grace is not None
+                else base.grace_s,
+                escalate=parse_ladder(args.escalate)
+                if args.escalate is not None else base.escalate)
+        except ValueError as e:
+            print(f"❌ {e}")
+            return
+        if wd is not None:
+            # Reconfigure the LIVE instance: a policy change mid-hang
+            # must not zero ladder progress, counters, or history (a
+            # replaced watchdog would re-run warn/dump from step 0 on
+            # the still-hung cell).
+            wd.set_policy(policy)
+        else:
+            wd = HangWatchdog(policy, heal=self._supervised_heal)
+            wd.attach(self._comm, self._pm)
+            DistributedMagics._watchdog = wd
+        print(f"✅ hang watchdog ON: {policy.describe()}")
+        if self._hang_piggyback_off():
+            print("   ⚠ NBD_HANG=0: workers spawned with it send no "
+                  "collective positions — skew/--deadline detection "
+                  "is unavailable (coarse busy-stall only); unset "
+                  "NBD_HANG and re-%dist_init for full detection")
+        if "heal" in policy.escalate \
+                and not DistributedMagics._last_ckpt_path:
+            print("   · no checkpoint yet — a heal step would restore "
+                  "nothing (%dist_checkpoint to protect state)")
+
+    @magic_arguments()
+    @argument("--save", default=None,
+              help="also write the report to this path")
+    @argument("--no-stacks", action="store_true",
+              help="skip the SIGUSR1 stack dump (read-only diagnosis)")
+    @line_magic
+    def dist_doctor(self, line):
+        """The stuck-cell doctor: one report naming the lagging
+        rank(s) and the divergence point — per-rank collective
+        positions and busy ages, the skew table, in-flight requests,
+        watchdog verdicts, freshly dumped all-thread stacks (SIGUSR1 →
+        faulthandler, per-rank files under the run dir), and each
+        flight ring's last events.  Works mid-hang: nothing here goes
+        through the workers' (possibly wedged) serial request
+        loops."""
+        if self._pm is None or self._comm is None:
+            print("❌ No cluster. %dist_init to start one.")
+            return
+        from ..resilience.watchdog import hang_report
+        args = parse_argstring(self.dist_doctor, line)
+        report = hang_report(self._comm, self._pm,
+                             DistributedMagics._watchdog,
+                             dump_stacks=not args.no_stacks)
+        print(report)
+        if args.save:
+            try:
+                with open(args.save, "w") as f:
+                    f.write(report + "\n")
+                print(f"✅ report → {args.save}")
+            except OSError as e:
+                print(f"❌ could not write {args.save}: {e}")
+
+    # ==================================================================
+    # execution magics
+
+    @magic_arguments()
+    @argument("--deadline", type=float, default=None,
+              help="per-cell budget in seconds: the hang watchdog "
+                   "escalates (warn → dump → interrupt → heal, per "
+                   "its ladder) when any rank is still busy past it")
+    @cell_magic
+    def distributed(self, line, cell):
+        """Run the cell on every worker (reference: magic.py:1042-1129).
+        ``%%distributed --deadline 60`` arms a per-cell budget the
+        hang watchdog enforces through its escalation ladder."""
+        if not self._require_cluster():
+            return
+        try:
+            args = parse_argstring(self.distributed, line)
+        except Exception as e:
+            print(f"❌ {e}")
+            return
+        if args.deadline is not None:
+            if DistributedMagics._watchdog is None:
+                print("⚠️ --deadline set but the hang watchdog is off "
+                      "(%dist_watchdog on) — the budget will not be "
+                      "enforced")
+            elif self._hang_piggyback_off():
+                print("⚠️ --deadline set but workers were spawned "
+                      "with NBD_HANG=0 (no heartbeat piggyback) — "
+                      "the budget will not be enforced")
         result = self._run_on_ranks(cell, list(range(self._world)),
-                                    kind="distributed")
+                                    kind="distributed",
+                                    deadline_s=args.deadline)
         if result is not None:
             self._sync_ide_quietly()
 
@@ -1132,6 +1346,13 @@ class DistributedMagics(Magics):
                         and now - ping[0] < 3 * HEARTBEAT_INTERVAL_S):
                     busy[r] = {"type": ping[1].get("busy_type"),
                                "s": ping[1]["busy_s"] + (now - ping[0])}
+                    col = ping[1].get("col")
+                    # Seconds since the rank last ENTERED a collective
+                    # — a long cell actively advancing through
+                    # collectives is busy, never stalled.
+                    busy[r]["col_age"] = (
+                        (col.get("age") or 0) + (now - ping[0])
+                        if col else None)
         idle = [r for r in alive if r not in busy]
         if self._comm is not None and idle:
             try:
@@ -1159,6 +1380,32 @@ class DistributedMagics(Magics):
                   f" (orphan TTL {ttl}s)")
         connected = (set(self._comm.connected_ranks())
                      if self._comm is not None else None)
+        # Stall threshold for the ⚠ state: the active watchdog's
+        # policy, else the env-configured default — a rank busy beyond
+        # it is rendered stalled even before (or without) a watchdog
+        # verdict, so the human eye gets the same signal.
+        wd = DistributedMagics._watchdog
+        stalled: set = set()
+        if wd is not None:
+            # An armed watchdog is the authority: a rank is stalled
+            # when its current assessment says HUNG, never merely
+            # long-busy (the core "distinct from slow" contract).
+            for v in wd.last_verdicts:
+                stalled.update(v.get("ranks") or ())
+        else:
+            from ..resilience.watchdog import HangPolicy
+            pol = HangPolicy.from_env_lenient()
+            # NBD_HANG=0 turns hang detection OFF everywhere — a long
+            # legitimate cell must then render busy, never stalled.
+            # Without a watchdog, stalled = busy past the window AND
+            # no collective entered within it (a rank advancing
+            # through collectives is slow, not stuck).
+            if pol.enabled:
+                for r, b in busy.items():
+                    if b["s"] > pol.stall_s and (
+                            b.get("col_age") is None
+                            or b["col_age"] > pol.stall_s):
+                        stalled.add(r)
         for rank_id in sorted(proc_status):
             p = proc_status[rank_id]
             if not p["running"]:
@@ -1167,6 +1414,12 @@ class DistributedMagics(Magics):
                 # Process alive but not attached to THIS coordinator:
                 # the fleet-side view of orphan grace.
                 state = "◌ orphaned"
+            elif rank_id in stalled:
+                # Alive and heartbeating, but stuck by the watchdog's
+                # assessment (or, unarmed, busy past the stall window
+                # with zero collective progress) — the live-but-stuck
+                # middle state the hang watchdog exists for.
+                state = "⚠ stalled"
             else:
                 state = "● running"
             line_txt = f"├─ Rank {rank_id}: pid {p['pid']} {state}"
@@ -1209,6 +1462,8 @@ class DistributedMagics(Magics):
         sup = DistributedMagics._supervisor
         if sup is not None:
             print(sup.describe())
+        if wd is not None:
+            print(wd.describe())
         plan = self._comm.fault_plan() if self._comm is not None else None
         if plan is not None:
             print(f"💥 chaos active (coordinator side): {plan.counters}")
@@ -1816,8 +2071,8 @@ class DistributedMagics(Magics):
         print(f"⏱  cluster top · {self._world} workers · backend="
               f"{pm.backend} · {time.strftime('%H:%M:%S')}")
         hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}{'hb-age':<8}"
-               f"{'HBM use/limit GB':<18}{'peak':<7}{'bufs':<6}"
-               f"{'compiles':<9}{'dedup':<6}")
+               f"{'col#':<7}{'HBM use/limit GB':<18}{'peak':<7}"
+               f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
         print(hdr)
         print("─" * len(hdr))
         for r in range(self._world):
@@ -1838,13 +2093,22 @@ class DistributedMagics(Magics):
                 busy = (f"{ping[1].get('busy_type')} "
                         f"{ping[1]['busy_s'] + (now - ping[0]):.1f}s")
             hb = f"{now - ping[0]:.1f}s" if ping is not None else "-"
+            # Collective-stream position (hang watchdog piggyback):
+            # "#7*" = entered collective 7 and still inside it — the
+            # cross-rank skew on this column IS the hang signature.
+            col = "-"
+            if ping is not None and ping[1].get("col"):
+                c = ping[1]["col"]
+                col = (f"#{c.get('seq')}"
+                       + ("*" if c.get("in") else ""))
             from ..observability.telemetry import hbm_totals
             hbm = hbm_totals(tel) or {}
             mem = (f"{self._fmt_gb(hbm.get('in_use'))}"
                    f"/{self._fmt_gb(hbm.get('limit'))}"
                    if hbm.get("in_use") is not None else "-")
             peak = self._fmt_gb(hbm.get("peak"))
-            print(f"{r:<5}{state:<11}{busy:<18}{hb:<8}{mem:<18}"
+            print(f"{r:<5}{state:<11}{busy:<18}{hb:<8}{col:<7}"
+                  f"{mem:<18}"
                   f"{peak:<7}{str(tel.get('bufs', '-')):<6}"
                   f"{str(tel.get('compiles', '-')):<9}"
                   f"{str(tel.get('dedup', '-')):<6}")
@@ -1899,8 +2163,21 @@ class DistributedMagics(Magics):
         if self._pm is not None:
             alive = set(self._pm.alive_ranks())
             dead = sorted(set(range(self._world)) - alive)
+        # A capture taken mid-hang keeps the doctor's diagnosis next
+        # to the black boxes (read-only: no stack-dump signal here —
+        # the bundle must not perturb what it records).
+        hang = None
+        wd = DistributedMagics._watchdog
+        if wd is not None and (wd.last_verdicts or wd.status()["active"]):
+            from ..resilience.watchdog import hang_report
+            try:
+                hang = hang_report(self._comm, self._pm, wd,
+                                   dump_stacks=False)
+            except Exception:
+                hang = None
         manifest = pm_mod.capture(self._comm, dead, out_dir=args.save,
-                                  reason="on demand (%dist_postmortem)")
+                                  reason="on demand (%dist_postmortem)",
+                                  hang_report=hang)
         if manifest is None:
             print("❌ postmortem capture failed (is the run directory "
                   "writable?)")
@@ -2022,6 +2299,18 @@ class DistributedMagics(Magics):
             # world before respawning), it must stay alive.
             sup.stop()
             cls._supervisor = None
+        wd = cls._watchdog
+        if wd is not None and not wd.on_own_thread() \
+                and not cls._healing:
+            # Same own-thread rule: a watchdog-driven heal goes through
+            # this teardown; the watchdog re-binds to the healed world
+            # (its heal callback returns the fresh pair) instead of
+            # stopping itself mid-ladder.  During ANY %dist_heal
+            # (_healing) the instance likewise survives so the
+            # replayed %dist_init re-binds it with its customized
+            # policy and history intact.
+            wd.stop()
+            cls._watchdog = None
         # An in-flight background save dies with its world; its
         # per-rank doneness must not leak into the next world and
         # promote a half-written checkpoint as the heal target.

@@ -50,13 +50,24 @@ def wait_until_ready(comm, pm, timeout_s: float, *, poll_s: float = 2.0,
                 # Re-raise with the *elapsed/budget* picture — the
                 # inner error only knows the last poll interval, which
                 # once produced "did not attach within 2s" after a
-                # 240 s wait.
+                # 240 s wait — plus each missing rank's exit status
+                # and captured stdio, so an attach timeout is
+                # diagnosable in one read instead of a separate
+                # %dist_logs round.
                 missing = sorted(set(range(comm.num_workers))
                                  - set(comm.connected_ranks()))
+                diag = ""
+                diag_fn = getattr(pm, "startup_diagnostics", None)
+                if diag_fn is not None:
+                    try:
+                        diag = diag_fn(missing)
+                    except Exception:
+                        diag = ""  # diagnostics must not mask the error
                 raise TimeoutError(
                     f"workers {missing} did not attach to the control "
                     f"plane within {time.time() - t0:.0f}s (budget "
-                    f"{timeout_s:.0f}s)") from None
+                    f"{timeout_s:.0f}s)"
+                    + (f"\n{diag}" if diag else "")) from None
             if on_wait is not None:
                 on_wait()
 
@@ -342,6 +353,52 @@ class ProcessManager:
                 raise RuntimeError(
                     f"worker {rank} exited with code {rc} during startup.\n"
                     f"--- worker {rank} output ---\n{self.io[rank].tail()}")
+
+    def startup_diagnostics(self, ranks: list[int] | None = None,
+                            tail_lines: int = 8) -> str:
+        """Per-rank exit status + captured stdio tail for the given
+        ranks (default: all) — folded into attach-timeout errors so
+        "workers [2] did not attach" also says WHY (exit code, the
+        ImportError, the bind failure...) without a second probe."""
+        lines = []
+        for rank in sorted(ranks if ranks is not None
+                           else self.processes):
+            proc = self.processes.get(rank)
+            if proc is None:
+                lines.append(f"--- rank {rank}: never spawned")
+                continue
+            rc = proc.poll()
+            state = (f"exited with code {rc}" if rc is not None
+                     else f"still running (pid {proc.pid}, never "
+                          f"attached)")
+            lines.append(f"--- rank {rank}: {state}")
+            io = self.io.get(rank)
+            tail = io.tail(tail_lines) if io is not None else ""
+            if tail.strip():
+                lines.append(tail.rstrip("\n"))
+            else:
+                lines.append("    (no output captured)")
+        return "\n".join(lines)
+
+    def dump_stacks(self, ranks: list[int] | None = None) -> list[int]:
+        """SIGUSR1 the worker process(es): each worker's faulthandler
+        appends an all-thread stack dump to its
+        ``<run_dir>/stacks-rank{N}.txt`` — the %dist_doctor's way to
+        see INSIDE a wedged rank (works even when the main thread is
+        stuck in a loop or a native call).  Returns the ranks
+        signaled.  Signal delivery is to the worker pid only, not the
+        process group (XLA helper subprocesses must not see it)."""
+        signaled = []
+        for rank, proc in sorted(self.processes.items()):
+            if ranks is not None and rank not in ranks:
+                continue
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGUSR1)
+                    signaled.append(rank)
+                except Exception:
+                    pass
+        return signaled
 
     def interrupt(self, ranks: list[int] | None = None) -> list[int]:
         """SIGINT the worker process(es) — Jupyter-style cell interrupt.

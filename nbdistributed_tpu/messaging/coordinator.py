@@ -48,9 +48,11 @@ class WorkerDied(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("expect", "responses", "event", "failure", "sent_at")
+    __slots__ = ("expect", "responses", "event", "failure", "sent_at",
+                 "msg_type")
 
-    def __init__(self, expect: set[int]):
+    def __init__(self, expect: set[int], msg_type: str = ""):
+        self.msg_type = msg_type
         self.expect = set(expect)
         self.responses: dict[int, Message] = {}
         self.event = threading.Event()
@@ -165,6 +167,19 @@ class CommunicationManager:
         with self._lock:
             return self._last_seen.get(rank)
 
+    def pending_snapshot(self) -> dict[str, dict]:
+        """Read-only view of in-flight requests for the hang watchdog:
+        ``{msg_id: {"type", "expect", "responded", "sent_at"}}``.  A
+        cell where some ranks responded while others sit on an old
+        collective seq is the watchdog's skew signal — this is how it
+        learns which ranks a hung request is still waiting on."""
+        with self._lock:
+            return {mid: {"type": p.msg_type,
+                          "expect": sorted(p.expect),
+                          "responded": sorted(p.responses),
+                          "sent_at": p.sent_at}
+                    for mid, p in self._pending.items()}
+
     def last_ping(self, rank: int) -> tuple[float, dict] | None:
         """(arrival time, payload) of the rank's latest heartbeat.  The
         payload carries the worker loop's busy state ({"busy_type",
@@ -256,7 +271,7 @@ class CommunicationManager:
             # The worker's handler span adopts these ids as its parent,
             # stitching the cross-process timeline together.
             msg.trace = tr.context_for(span)
-        pending = _Pending(set(ranks))
+        pending = _Pending(set(ranks), msg_type)
         with self._lock:
             already_dead = pending.expect & self._dead
             self._pending[msg.msg_id] = pending

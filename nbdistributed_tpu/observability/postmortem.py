@@ -153,6 +153,7 @@ def build_bundle(out_dir: str, *, run_dir: str,
                  coordinator_faults: list | None = None,
                  rank_faults: dict | None = None,
                  telemetry: dict | None = None,
+                 hang_report: str | None = None,
                  reason: str = "") -> dict:
     """Assemble and write one bundle; returns the manifest (with
     ``"dir"`` set).  Pure function of its inputs + the ring files on
@@ -202,6 +203,17 @@ def build_bundle(out_dir: str, *, run_dir: str,
         with open(os.path.join(out_dir, name), "w") as f:
             json.dump(payload, f, default=str)
     report = render_report(manifest, rings, telemetry)
+    if hang_report:
+        # The stuck-cell doctor's assessment (ISSUE 5): per-rank
+        # collective positions, the skew table, and stack-dump tails
+        # at capture time — a hang that escalated into a death (or a
+        # manual capture mid-hang) keeps its diagnosis next to the
+        # black boxes.
+        manifest["hang_report"] = "hang_report.txt"
+        with open(os.path.join(out_dir, "hang_report.txt"), "w") as f:
+            f.write(hang_report + "\n")
+        report += ("\n(hang diagnosis at capture time: "
+                   "hang_report.txt)")
     with open(os.path.join(out_dir, "report.txt"), "w") as f:
         f.write(report + "\n")
     return manifest
@@ -209,7 +221,8 @@ def build_bundle(out_dir: str, *, run_dir: str,
 
 def capture(comm, dead_ranks=None, *, out_dir: str | None = None,
             reason: str = "", rank_dumps: dict | None = None,
-            rank_faults: dict | None = None) -> dict | None:
+            rank_faults: dict | None = None,
+            hang_report: str | None = None) -> dict | None:
     """High-level capture against a live coordinator: pulls everything
     the coordinator holds (tracer dump, clock offsets, fault-plan
     events, piggybacked telemetry), recovers the rings from the run
@@ -246,7 +259,8 @@ def capture(comm, dead_ranks=None, *, out_dir: str | None = None,
                      if getattr(comm, "clock", None) is not None else {}),
             coordinator_faults=(plan.events() if plan is not None else []),
             rank_faults=rank_faults,
-            telemetry=telemetry, reason=reason)
+            telemetry=telemetry, hang_report=hang_report,
+            reason=reason)
         return manifest
     except Exception:
         return None

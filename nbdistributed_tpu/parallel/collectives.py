@@ -35,6 +35,7 @@ import numpy as np
 from ..observability import metrics as _obs_metrics
 from ..observability.spans import maybe_span as _maybe_span
 from ..runtime.collective_guard import check as _guard_check
+from ..runtime.collective_guard import done as _guard_done
 from ..utils.compat import shard_map as _shard_map
 
 
@@ -60,8 +61,16 @@ def _instrumented(name: str):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
             t0 = time.perf_counter()
-            with _maybe_span(f"collective/{name}", kind="collective"):
-                out = fn(*args, **kwargs)
+            try:
+                with _maybe_span(f"collective/{name}", kind="collective"):
+                    out = fn(*args, **kwargs)
+            finally:
+                # Mark the guard's progress stream not-in-flight even
+                # when the op raised (hazard error, interrupt) — the
+                # watchdog must not keep seeing a long-dead entry as
+                # "still inside".  Nested composite internals are
+                # suppressed by the guard itself.
+                _guard_done(name)
             calls.inc()
             hist.observe(time.perf_counter() - t0)
             return out

@@ -32,6 +32,14 @@ automatically* at runtime:
   staleness, distinguishes *degraded* from *dead*, and auto-heals
   (replay ``%dist_init`` + restore the last checkpoint) under a capped
   restart budget.
+- :mod:`~nbdistributed_tpu.resilience.watchdog` — the collective hang
+  watchdog + stuck-cell doctor: :class:`HangWatchdog` compares
+  per-rank collective-stream positions (piggybacked on heartbeats)
+  and flags cells HUNG — cross-rank skew, absolute stall, or a blown
+  ``--deadline`` — distinct from merely slow, then walks a
+  configurable escalation ladder (warn → stack-dump → interrupt →
+  heal); :func:`~nbdistributed_tpu.resilience.watchdog.hang_report`
+  assembles the ``%dist_doctor`` diagnosis.
 
 Everything here is stdlib-only (no JAX import) so the coordinator side
 stays light and the modules are unit-testable without a backend.
@@ -42,6 +50,8 @@ from .dedup import ReplayCache, ResultMailbox
 from .faults import FaultPlan
 from .retry import RetryPolicy
 from .supervisor import Supervisor, SupervisorPolicy
+from .watchdog import HangPolicy, HangWatchdog, SkewDetector, hang_report
 
-__all__ = ["FaultPlan", "ReplayCache", "ResultMailbox", "RetryPolicy",
-           "Supervisor", "SupervisorPolicy", "session"]
+__all__ = ["FaultPlan", "HangPolicy", "HangWatchdog", "ReplayCache",
+           "ResultMailbox", "RetryPolicy", "SkewDetector", "Supervisor",
+           "SupervisorPolicy", "hang_report", "session"]

@@ -44,7 +44,13 @@ DEFAULT_EXEMPT = ("ping",)
 _SPEC_KEYS = frozenset({
     "seed", "drop", "delay_p", "delay_s", "duplicate", "truncate",
     "freeze_heartbeat", "kill_rank", "kill_at", "exempt",
+    "freeze_rank", "freeze_at", "freeze_s",
 })
+
+# A frozen rank must stay frozen long past any watchdog policy window,
+# but not forever: the sleep is broken early by the escalation
+# ladder's interrupt, and a test that never interrupts still exits.
+DEFAULT_FREEZE_S = 3600.0
 
 
 class FaultPlan:
@@ -58,6 +64,9 @@ class FaultPlan:
                  duplicate: float = 0.0, truncate: float = 0.0,
                  freeze_heartbeat: bool = False,
                  kill_rank: int | None = None, kill_at: int | None = None,
+                 freeze_rank: int | None = None,
+                 freeze_at: int | None = None,
+                 freeze_s: float = DEFAULT_FREEZE_S,
                  exempt=DEFAULT_EXEMPT):
         self.seed = int(seed)
         self.drop = float(drop)
@@ -75,11 +84,22 @@ class FaultPlan:
                 f"(got kill_rank={kill_rank!r}, kill_at={kill_at!r})")
         self.kill_rank = kill_rank
         self.kill_at = kill_at
+        if (freeze_rank is None) != (freeze_at is None):
+            raise ValueError(
+                f"freeze_rank and freeze_at must be set together "
+                f"(got freeze_rank={freeze_rank!r}, "
+                f"freeze_at={freeze_at!r})")
+        self.freeze_rank = freeze_rank
+        self.freeze_at = freeze_at
+        self.freeze_s = float(freeze_s)
+        self._froze = False  # one-shot: the mesh must survive AFTER
+        # the hang is broken, so later collectives run clean
         self.exempt = frozenset(exempt or ())
         self._lock = threading.Lock()
         self._index = 0
         self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
-                         "duplicated": 0, "truncated": 0, "exempt": 0}
+                         "duplicated": 0, "truncated": 0, "exempt": 0,
+                         "frozen": 0}
         # Timestamped record of every non-clean decision, bounded, for
         # the observability layer: the merged Chrome trace folds these
         # in as instant events so a chaos run shows WHERE the drops
@@ -118,6 +138,8 @@ class FaultPlan:
                 "duplicate": self.duplicate, "truncate": self.truncate,
                 "freeze_heartbeat": self.freeze_heartbeat,
                 "kill_rank": self.kill_rank, "kill_at": self.kill_at,
+                "freeze_rank": self.freeze_rank,
+                "freeze_at": self.freeze_at, "freeze_s": self.freeze_s,
                 "exempt": sorted(self.exempt)}
 
     # ------------------------------------------------------------------
@@ -201,3 +223,26 @@ class FaultPlan:
         installed (``>=`` so a skipped index can never disarm it)."""
         return (self.kill_rank == rank and self.kill_at is not None
                 and msg_index >= self.kill_at)
+
+    def has_freeze(self) -> bool:
+        return self.freeze_rank is not None
+
+    def should_freeze(self, rank: int, collective_seq: int) -> float | None:
+        """Collective-freeze trigger (hang watchdog's chaos scenario):
+        when ``rank`` matches and the process-global collective
+        sequence has reached ``freeze_at``, return the seconds to
+        block (ONE-SHOT — the rank wedges inside exactly one
+        collective, so after the escalation ladder breaks the hang
+        the mesh keeps working); otherwise None.  ``>=`` like
+        ``should_kill`` so a skipped index can never disarm it."""
+        if (self.freeze_rank != rank or self.freeze_at is None
+                or collective_seq < self.freeze_at):
+            return None
+        with self._lock:
+            if self._froze:
+                return None
+            self._froze = True
+            self.counters["frozen"] += 1
+        flightrec.record("fault", actions=["freeze"], kind="collective",
+                         index=collective_seq)
+        return self.freeze_s

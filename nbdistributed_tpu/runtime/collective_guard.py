@@ -30,12 +30,24 @@ that happen to complete locally (e.g. a single-process world where
 The worker's message loop is serial, so plain module state suffices;
 a user thread calling a collective outside any cell sees inactive
 state and passes.
+
+Beyond the hazard check, this module is also the worker-side half of
+the **hang watchdog** (ISSUE 5): every guarded entry advances a
+monotonic per-process collective sequence and publishes a compact
+``(seq, op, entered-at, in-flight)`` snapshot that the heartbeat
+thread piggybacks on its pings.  The coordinator's watchdog compares
+these positions across ranks — "ranks 0–2 entered ``all_reduce`` #7,
+rank 3 never did" is the signature of a wedged rank that heartbeats
+alone can never show (the process is alive; it is just stuck).  The
+snapshot is a single tuple replaced atomically, so the heartbeat
+thread's read can never tear against the main thread's write.
 """
 
 from __future__ import annotations
 
 import contextlib
 import hashlib
+import time
 
 
 class CollectiveHazardError(RuntimeError):
@@ -45,6 +57,41 @@ class CollectiveHazardError(RuntimeError):
 
 
 _state: dict = {"targets": None, "world": 0, "ops": 0, "nested": 0}
+
+# Collective progress stream (hang watchdog, ISSUE 5).  ``_snap`` is
+# the atomically-replaced snapshot tuple ``(seq, op, entered_at_mono,
+# in_flight)``; ``seq`` is monotonic over the PROCESS lifetime (not
+# reset per cell) so the coordinator can order positions across cells
+# without extra bookkeeping.  ``_freeze_hook`` is the chaos harness's
+# injection point: called at every guarded entry with (op, seq) and
+# may block — how a test freezes a rank "inside" a collective.
+_snap: tuple | None = None
+_freeze_hook = None
+
+
+def set_freeze_hook(fn) -> None:
+    """Install (or clear, with ``None``) the chaos freeze hook — a
+    callable ``(op, seq)`` run at each guarded collective entry, on
+    the cell's own thread, allowed to sleep.  Wired by the worker from
+    its :class:`~nbdistributed_tpu.resilience.faults.FaultPlan`."""
+    global _freeze_hook
+    _freeze_hook = fn
+
+
+def progress() -> dict | None:
+    """Compact position-in-the-collective-stream snapshot for the
+    heartbeat piggyback: ``{"seq", "op", "in", "age", "cops"}`` —
+    global sequence number, last op entered, whether the rank is
+    still inside it, seconds since entry (monotonic clock), and the
+    current cell's op count.  ``None`` before the first collective
+    (keeps idle pings small)."""
+    s = _snap
+    if s is None:
+        return None
+    seq, op, t, in_flight = s
+    return {"seq": seq, "op": op, "in": in_flight,
+            "age": round(time.monotonic() - t, 3),
+            "cops": _state["ops"]}
 
 
 @contextlib.contextmanager
@@ -87,10 +134,21 @@ def cell_hash(code: str) -> str:
 
 
 def check(op: str) -> None:
-    """Entry hook for each eager world-collective."""
+    """Entry hook for each eager world-collective.  Advances the
+    progress stream (the watchdog's skew signal) BEFORE the hazard
+    check so even a call that raises is on record, then runs the
+    chaos freeze hook — which may block this rank right here, the
+    deterministic stand-in for "wedged inside a collective"."""
+    global _snap
     if _state["nested"]:
         return                  # implementation detail of a composite
     _state["ops"] += 1
+    prev = _snap
+    seq = (prev[0] if prev is not None else 0) + 1
+    _snap = (seq, op, time.monotonic(), True)
+    fz = _freeze_hook
+    if fz is not None:
+        fz(op, seq)
     targets, world = _state["targets"], _state["world"]
     if targets is not None and world and len(targets) < world:
         raise CollectiveHazardError(
@@ -100,3 +158,24 @@ def check(op: str) -> None:
             f"(the other ranks never join) and would deadlock the "
             f"cluster; run the cell on all ranks, or keep subset "
             f"cells to rank-local work.")
+
+
+def done(op: str) -> None:
+    """Exit hook for each eager world-collective (called by the
+    ``_instrumented`` wrapper in a ``finally``, so an op that raised
+    — hazard error, interrupt — is still marked not-in-flight).
+    Nested composite internals are suppressed like :func:`check`."""
+    global _snap
+    if _state["nested"]:
+        return
+    s = _snap
+    if s is not None and s[3]:
+        _snap = (s[0], s[1], s[2], False)
+
+
+def reset_progress() -> None:
+    """Test helper: forget the progress stream (and any freeze hook)
+    so suites that re-enter worlds start from seq 0."""
+    global _snap, _freeze_hook
+    _snap = None
+    _freeze_hook = None

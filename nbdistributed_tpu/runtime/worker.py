@@ -70,7 +70,12 @@ class DistributedWorker:
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
-        self._busy: tuple | None = None  # (msg_type, started_ts) | None
+        # (msg_type, started_monotonic, msg_id, deadline_s|None) while a
+        # request is being handled, else None.  MONOTONIC clock on
+        # purpose: busy_s feeds the hang watchdog's stall detection, and
+        # a wall-clock step (NTP slew, suspend/resume) must not fake or
+        # mask a stall.
+        self._busy: tuple | None = None
         self._ckpt_async = None          # in-flight background save
         # Resilience state: the reply-replay cache makes request
         # redelivery idempotent (a retried execute NEVER runs twice);
@@ -117,6 +122,39 @@ class DistributedWorker:
         self._flight = flightrec.init(f"rank{rank}")
         self._flight.record("worker_start", rank=rank, pid=os.getpid(),
                             world_size=world_size)
+        # Hang watchdog (ISSUE 5): when enabled (NBD_HANG, default on)
+        # heartbeats also carry the in-flight request id, its optional
+        # per-cell deadline, and the collective-progress snapshot from
+        # the guard — the coordinator-side watchdog's raw material.
+        # Disabled, the heartbeat pays exactly one flag check.
+        self._hang_enabled = os.environ.get(
+            "NBD_HANG", "1").lower() not in ("0", "false", "off")
+        # Stack dump on demand: SIGUSR1 makes faulthandler write every
+        # thread's traceback to a per-rank file under the run dir —
+        # the %dist_doctor's view INTO a wedged rank (works even while
+        # the main thread is stuck in a loop or a native call; the C
+        # handler needs no GIL).  The file object must stay referenced
+        # for the lifetime of the process (faulthandler keeps the fd).
+        # Per-pid name, like the flight rings: a healed/respawned rank
+        # must never truncate its dead predecessor's dumped stacks —
+        # they are postmortem evidence.
+        self._stack_file = None
+        try:
+            import faulthandler
+            import signal as _signal
+            if threading.current_thread() is threading.main_thread():
+                path = os.path.join(
+                    flightrec.run_dir(),
+                    f"stacks-rank{rank}.{os.getpid()}.txt")
+                self._stack_file = open(path, "w")
+                faulthandler.register(_signal.SIGUSR1,
+                                      file=self._stack_file,
+                                      all_threads=True)
+        except Exception:
+            self._stack_file = None  # never block bring-up on this
+        # Spawn-time fault plans (NBD_FAULT_PLAN) bypass
+        # _set_fault_plan — wire their collective-freeze fault here.
+        self._install_freeze_hook(fault_plan)
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -260,11 +298,23 @@ class DistributedWorker:
             plan = self._fault_plan
             if plan is not None and plan.heartbeat_frozen():
                 continue  # injected staleness: process alive, pings gone
-            busy = self._busy  # (msg_type, started); torn reads are
-            data = None        # harmless (both fields set together)
+            busy = self._busy  # one tuple, replaced atomically — the
+            data = None        # read can never tear across fields
             if busy is not None:
+                # Monotonic arithmetic: wall-clock jumps must neither
+                # fake nor mask a stall (the watchdog consumes this).
                 data = {"busy_type": busy[0],
-                        "busy_s": round(time.time() - busy[1], 3)}
+                        "busy_s": round(time.monotonic() - busy[1], 3)}
+                if self._hang_enabled:
+                    if busy[2] is not None:
+                        data["busy_id"] = busy[2]
+                    if busy[3] is not None:
+                        data["busy_deadline"] = busy[3]
+            if self._hang_enabled:
+                col = collective_guard.progress()
+                if col is not None:
+                    data = dict(data or {})
+                    data["col"] = col
             try:
                 snap = self._telemetry.maybe_sample()
             except Exception:
@@ -498,6 +548,29 @@ class DistributedWorker:
         # "the 5th message from now", not an absolute since-spawn index
         # the session has long passed.
         self._msg_seen = 0
+        self._install_freeze_hook(plan)
+
+    def _install_freeze_hook(self, plan: FaultPlan | None) -> None:
+        """Wire the plan's collective-freeze fault into the guard: a
+        chosen rank blocks at a chosen collective entry — alive,
+        heartbeating, making no progress — the deterministic stand-in
+        for a wedged rank the hang watchdog exists to catch.  The
+        sleep runs inside the cell's interrupt window, so the
+        escalation ladder's %dist_interrupt breaks it."""
+        if plan is None or not plan.has_freeze():
+            collective_guard.set_freeze_hook(None)
+            return
+
+        def _freeze(op: str, seq: int) -> None:
+            wait = plan.should_freeze(self.rank, seq)
+            if wait is None:
+                return
+            self._flight.record("fault_freeze", op=op, seq=seq,
+                                freeze_s=wait)
+            self._flight.flush()
+            time.sleep(wait)
+
+        collective_guard.set_freeze_hook(_freeze)
 
     def _handle_get_namespace_info(self, msg: Message) -> Message:
         return msg.reply(
@@ -1013,7 +1086,20 @@ class DistributedWorker:
                     self._park(msg.msg_type, msg.msg_id, cached)
                 continue
             handler = handlers.get(msg.msg_type)
-            self._busy = (msg.msg_type, time.time())
+            # Per-cell deadline budget (%%distributed --deadline S):
+            # rides the execute payload, echoed back on heartbeats so
+            # the coordinator's watchdog can escalate a cell that blew
+            # its own budget without any coordinator-side bookkeeping.
+            deadline = None
+            if isinstance(msg.data, dict):
+                d = msg.data.get("deadline_s")
+                if d is not None:
+                    try:
+                        deadline = float(d)
+                    except (TypeError, ValueError):
+                        deadline = None
+            self._busy = (msg.msg_type, time.monotonic(), msg.msg_id,
+                          deadline)
             # Dispatch span: a child of the coordinator's send span
             # when the request carried the wire trace context, a root
             # span otherwise.  Activated around the handler so inner

@@ -1,0 +1,229 @@
+"""Acceptance test for the collective hang watchdog + stuck-cell
+doctor (ISSUE 5), against real worker subprocesses on the CPU backend:
+
+1. a uniformly-slow cell (every rank equally busy, no divergence)
+   produces ZERO hang verdicts — slow is not hung;
+2. the chaos plan freezes rank 1 inside its second collective entry
+   (deterministic ``freeze_rank``/``freeze_at``) while rank 0 finishes
+   the cell: the watchdog flags the cell HUNG with a **skew** verdict
+   naming rank 1 and the divergent collective, `%dist_doctor`'s report
+   names the laggard, a mid-hang postmortem bundle carries the hang
+   report, and the escalation ladder (warn → stack-dump → interrupt)
+   breaks the hang WITHOUT killing any rank;
+3. a pure-Python infinite loop on rank 1 (zero collectives) is flagged
+   **stall**, not skew, and the ladder breaks it the same way;
+4. the mesh survives it all: a cross-process all_reduce still works.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.observability import flightrec
+from nbdistributed_tpu.observability import metrics as obs_metrics
+from nbdistributed_tpu.observability import postmortem as pm_mod
+from nbdistributed_tpu.resilience import (HangPolicy, HangWatchdog,
+                                          hang_report)
+
+pytestmark = [pytest.mark.integration, pytest.mark.hang]
+
+WORLD = 2
+ATTACH_TIMEOUT = 120
+
+HANG_CELL = """
+import jax.numpy as jnp
+a = all_reduce(jnp.ones(2))        # collective #1: both ranks join
+if rank == 1:
+    b = all_reduce(a)              # collective #2: frozen by the plan
+'done-%d' % rank
+"""
+
+LOOP_CELL = """
+if rank == 1:
+    while True:                    # data-dependent infinite loop
+        pass
+'ok-%d' % rank
+"""
+
+
+def _bring_up(extra_env=None):
+    comm = CommunicationManager(num_workers=WORLD, timeout=120)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(WORLD, comm.port, backend="cpu",
+                         extra_env=extra_env)
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
+    except Exception:
+        pm.shutdown()
+        comm.shutdown()
+        raise
+    return comm, pm
+
+
+def _send_async(comm, code, timeout=120):
+    out = {}
+
+    def _run():
+        try:
+            out["resp"] = comm.send_to_all(
+                "execute", {"code": code, "target_ranks": [0, 1]},
+                timeout=timeout)
+        except Exception as e:  # pragma: no cover - surfaced by asserts
+            out["error"] = e
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return t, out
+
+
+def _wait_active_hang(wd, deadline_s=60):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        st = wd.status()
+        if st["active"]:
+            return st
+        time.sleep(0.2)
+    pytest.fail(f"watchdog never flagged a hang: {wd.status()}")
+
+
+def _run_cell(comm, code, timeout=120):
+    return {r: m.data for r, m in comm.send_to_all(
+        "execute", {"code": code, "target_ranks": [0, 1]},
+        timeout=timeout).items()}
+
+
+def test_hang_watchdog_detects_diagnoses_and_breaks(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path / "run"))
+    flightrec.reset_for_tests()
+    # Deterministic wedge: rank 1 blocks inside its SECOND collective
+    # entry (the hang cell's in-branch all_reduce), one-shot.
+    env = {"NBD_FAULT_PLAN": json.dumps(
+        {"freeze_rank": 1, "freeze_at": 2, "freeze_s": 600})}
+    comm, pm = _bring_up(extra_env=env)
+    wd = HangWatchdog(HangPolicy(
+        poll_s=0.25, skew_s=3.0, stall_s=8.0, grace_s=1.0,
+        escalate=("warn", "dump", "interrupt")))
+    wd.attach(comm, pm)
+    try:
+        # --- phase 1: uniformly slow is NOT hung ---------------------
+        out = _run_cell(comm, "import time\ntime.sleep(4)\n'slow-ok'")
+        assert all(d.get("output") == "'slow-ok'" for d in out.values())
+        assert wd.cells_flagged == 0, wd.status()
+
+        # --- phase 2: rank 1 freezes mid-collective ------------------
+        t, box = _send_async(comm, HANG_CELL)
+        st = _wait_active_hang(wd)
+        (active,) = st["active"].values()
+        assert active["kind"] == "skew", st
+        assert active["ranks"] == [1], st
+        (verdict,) = [v for v in st["last_verdicts"]
+                      if v["kind"] == "skew"]
+        # The divergence point: rank 1 is wedged inside all_reduce #2.
+        assert verdict["op"] == "all_reduce" and verdict["seq"] == 2
+        assert verdict["peers"] == [0]  # rank 0 finished the cell
+
+        # The stuck-cell doctor, consulted MID-HANG, names the
+        # laggard and the divergence without touching the wedged
+        # rank's request loop.
+        report = hang_report(comm, pm, wd, dump_stacks=False)
+        assert "HUNG [skew]" in report
+        assert "rank(s) [1]" in report
+        assert "all_reduce" in report and "#2" in report
+        # A postmortem captured mid-hang bundles the diagnosis.
+        manifest = pm_mod.capture(comm, [], reason="mid-hang",
+                                  hang_report=report)
+        assert manifest is not None
+        assert manifest.get("hang_report") == "hang_report.txt"
+        bundled = open(os.path.join(manifest["dir"],
+                                    "hang_report.txt")).read()
+        assert "HUNG [skew]" in bundled
+
+        # The escalation ladder breaks the hang: the frozen rank's
+        # cell aborts with KeyboardInterrupt, rank 0's result stands,
+        # and NOBODY dies.
+        t.join(timeout=90)
+        assert not t.is_alive(), "escalation never broke the hang"
+        assert "error" not in box, box
+        resp = {r: m.data for r, m in box["resp"].items()}
+        assert resp[0].get("output") == "'done-0'", resp
+        assert "KeyboardInterrupt" in (resp[1].get("error") or ""), resp
+        assert pm.alive_ranks() == [0, 1]
+        esc = wd.escalations
+        assert esc.get("warn", 0) >= 1 and esc.get("dump", 0) >= 1 \
+            and esc.get("interrupt", 0) >= 1, esc
+        # The dump step's SIGUSR1 left per-rank all-thread stacks
+        # (per-pid file names, like the flight rings, so a later heal
+        # can never truncate this evidence).
+        from nbdistributed_tpu.resilience.watchdog import _stack_file
+        stacks = _stack_file(os.environ["NBD_RUN_DIR"], 1)
+        assert stacks is not None and os.path.exists(stacks)
+        assert "File" in open(stacks).read()
+        # Metrics counted the verdict and every ladder step.
+        counters = obs_metrics.registry().to_json()["counters"]
+        assert counters.get('nbd_hang_verdicts_total{kind="skew"}',
+                            0) >= 1
+        assert counters.get('nbd_hang_escalations_total'
+                            '{step="interrupt"}', 0) >= 1
+
+        # Hang resolved: active set drains.
+        deadline = time.time() + 15
+        while wd.status()["active"] and time.time() < deadline:
+            time.sleep(0.2)
+        assert wd.status()["active"] == {}
+        assert wd.cells_resolved >= 1
+
+        # --- phase 3: infinite loop, zero collectives => STALL -------
+        flagged_before = wd.cells_flagged
+        t, box = _send_async(comm, LOOP_CELL)
+        st = _wait_active_hang(wd)
+        (active,) = st["active"].values()
+        assert active["kind"] == "stall", st
+        assert active["ranks"] == [1], st
+        t.join(timeout=90)
+        assert not t.is_alive(), "escalation never broke the loop"
+        resp = {r: m.data for r, m in box["resp"].items()}
+        assert "KeyboardInterrupt" in (resp[1].get("error") or ""), resp
+        assert wd.cells_flagged == flagged_before + 1
+        assert pm.alive_ranks() == [0, 1]
+        # Let the stall verdict drain (the busy ping persists until
+        # the next idle heartbeat arrives) before the healthy-mesh
+        # phase asserts a clean doctor report.
+        deadline = time.time() + 15
+        while wd.status()["active"] and time.time() < deadline:
+            time.sleep(0.2)
+        assert wd.status()["active"] == {}
+
+        # --- phase 4: the mesh SURVIVED both hangs -------------------
+        # (the freeze was one-shot; collectives run clean again).  A
+        # late-landing interrupt may abort one follow-up cell — absorb
+        # it with one retry, like %dist_interrupt's probe does.
+        for attempt in range(3):
+            out = _run_cell(
+                comm, "import jax.numpy as jnp\n"
+                      "float(all_reduce(jnp.ones(2))[0])")
+            if all("error" not in d for d in out.values()):
+                break
+            assert all("KeyboardInterrupt" in d.get("error", "")
+                       for d in out.values() if "error" in d), out
+        assert {d.get("output") for d in out.values()} == {"2.0"}, out
+        # Doctor on a healthy mesh: no verdicts, stacks readable.
+        report = hang_report(comm, pm, wd, dump_stacks=True,
+                             stack_wait_s=1.0)
+        assert "verdicts: none" in report
+        assert "stacks (SIGUSR1" in report
+    finally:
+        wd.stop()
+        try:
+            comm.post(list(range(WORLD)), "shutdown")
+            time.sleep(0.3)
+        except Exception:
+            pass
+        pm.shutdown()
+        comm.shutdown()

@@ -601,3 +601,70 @@ def test_dist_heal_respawns_and_restores(ip, capsys, tmp_path):
     out = capsys.readouterr().out
     assert "healed 0 3.0" in out                    # 0+1+2 restored
     assert "healed 1 6.0" in out                    # 1+2+3 restored
+
+
+def test_watchdog_and_doctor_magics(ip, capsys):
+    """%dist_watchdog lifecycle + %dist_doctor on a healthy mesh (the
+    hang-breaking acceptance path lives in test_hang_watchdog.py; the
+    magic surface is what this covers): auto-armed at init, status,
+    reconfigure with knobs, a ladder typo is rejected, the doctor's
+    report renders positions and 'verdicts: none', and --deadline
+    rides a %%distributed cell without tripping a healthy run."""
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+
+    # Auto-armed by the fixture's %dist_init (NBD_HANG defaults on).
+    assert DistributedMagics._watchdog is not None
+    ip.run_line_magic("dist_watchdog", "status")
+    out = capsys.readouterr().out
+    assert "hang watchdog" in out and "ladder" in out
+
+    ip.run_line_magic("dist_watchdog",
+                      "on --skew 7 --stall 44 --escalate warn,interrupt")
+    out = capsys.readouterr().out
+    assert "hang watchdog ON" in out
+    assert "skew 7s" in out and "stall 44s" in out
+    assert "warn→interrupt" in out
+    pol = DistributedMagics._watchdog.policy
+    assert (pol.skew_s, pol.stall_s) == (7.0, 44.0)
+
+    ip.run_line_magic("dist_watchdog", "on --escalate warn,dmup")
+    out = capsys.readouterr().out
+    assert "unknown escalation" in out
+
+    # A generous deadline on a fast cell: runs clean, no verdict.
+    run(ip, "%%distributed --deadline 300\ndl_ok = rank + 40\ndl_ok")
+    out = capsys.readouterr().out
+    assert "40" in out and "41" in out
+    assert DistributedMagics._watchdog.cells_flagged == 0
+
+    run(ip, "import jax.numpy as jnp\n"
+            "wd_v = float(all_reduce(jnp.ones(2))[0])\nwd_v")
+    capsys.readouterr()
+    # The collective position rides the NEXT heartbeat (2 s cadence) —
+    # wait for it so the doctor/top assertions see the piggyback.
+    import time as _time
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        pings = [DistributedMagics._comm.last_ping(r) for r in (0, 1)]
+        if all(p is not None and p[1].get("col") for p in pings):
+            break
+        _time.sleep(0.3)
+    else:
+        raise AssertionError("collective piggyback never arrived")
+    ip.run_line_magic("dist_doctor", "--no-stacks")
+    out = capsys.readouterr().out
+    assert "stuck-cell doctor" in out
+    assert "verdicts: none" in out
+    assert "col#" in out
+
+    # %dist_top renders the collective-seq column from the piggyback.
+    ip.run_line_magic("dist_top", "")
+    out = capsys.readouterr().out
+    assert "col#" in out and "#1" in out
+
+    ip.run_line_magic("dist_watchdog", "off")
+    out = capsys.readouterr().out
+    assert "stopped" in out
+    assert DistributedMagics._watchdog is None
+    ip.run_line_magic("dist_watchdog", "status")
+    assert "not running" in capsys.readouterr().out
