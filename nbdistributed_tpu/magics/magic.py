@@ -425,6 +425,11 @@ class DistributedMagics(Magics):
                    "host); requires --coordinator-addr for remote hosts")
     @argument("--coordinator-addr", default="127.0.0.1",
               help="address of this kernel reachable from every host")
+    @argument("--agents", default=None,
+              help="host-agent endpoints 'h1=10.0.0.2:7411,h2=...' — "
+                   "remote hosts listed here launch through their "
+                   "nbd_agent daemon (tools/nbd_agent.py) instead of "
+                   "ssh")
     @argument("--attach", nargs="?", const="", default=None,
               dest="attach_dir",
               help="reattach to a surviving fleet instead of spawning "
@@ -469,6 +474,7 @@ class DistributedMagics(Magics):
             else:
                 print(f"Using TPU chips: {chips}")
         host_specs = None
+        agents = None
         if args.hosts:
             if args.chips_per_worker != 1:
                 print("❌ --chips-per-worker is a single-host option; "
@@ -481,6 +487,20 @@ class DistributedMagics(Magics):
                 print(f"❌ {e}")
                 return
             num_workers = sum(h.workers for h in host_specs)
+            if args.agents:
+                from ..manager import hostagent
+                try:
+                    # IPython's non-posix arg_split keeps quote chars
+                    # inside the token; strip them like %dist_attach.
+                    agents = hostagent.parse_agents(
+                        args.agents.strip().strip("'\""))
+                except ValueError as e:
+                    print(f"❌ {e}")
+                    return
+        elif args.agents:
+            print("❌ --agents requires a --hosts plan naming the "
+                  "agent hosts.")
+            return
         # Remote hosts must be able to dial the control plane: bind all
         # interfaces when the plan leaves this machine (default stays
         # loopback-only) — and require a per-cluster shared secret on
@@ -513,10 +533,23 @@ class DistributedMagics(Magics):
                   + (f", hosts={args.hosts}" if args.hosts else "")
                   + ")...")
             if host_specs is not None:
+                import os as _os
+                # Agents authenticate with their daemon-start secret
+                # (export the same one as NBD_AGENT_TOKEN here), NOT
+                # this session's minted control-plane token.
+                agent_token = _os.environ.get("NBD_AGENT_TOKEN")
+                if agents and agent_token is None:
+                    print("⚠️ NBD_AGENT_TOKEN is not set — dialing the "
+                          "agents with this session's minted secret, "
+                          "which only works if the daemons were "
+                          "started with it")
                 pm.start_workers_multihost(
                     host_specs, comm.port,
                     coordinator_host=args.coordinator_addr,
-                    backend=args.backend, auth_token=auth_token)
+                    backend=args.backend, auth_token=auth_token,
+                    agents=agents, agent_token=agent_token,
+                    extra_env={"NBD_SESSION_TOKEN": session_token,
+                               "NBD_SESSION_EPOCH": "1"})
             else:
                 pm.start_workers(num_workers, comm.port,
                                  backend=args.backend,
@@ -537,10 +570,36 @@ class DistributedMagics(Magics):
             comm.shutdown()
             return
         comm.set_output_callback(self._feed_stream)
+        # Host topology → link shaping, partition sentry, per-host
+        # status (single-host worlds: everything "local", inert).
+        comm.set_host_map(pm.hosts)
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
         DistributedMagics._world = num_workers
         DistributedMagics._attached = False
+        if host_specs is not None:
+            # Multi-host session bootstrap: the workers got the
+            # session token/epoch via their env; the hello exchange
+            # mirrors the session manifest to every worker so the
+            # orphan reconnect loop can rediscover the endpoint
+            # WITHOUT a shared run-dir filesystem (partition
+            # tolerance, ISSUE 6).
+            mirror = session_mod.make_manifest(
+                world_size=num_workers,
+                control_host=args.coordinator_addr,
+                control_port=comm.port, bind_host=bind_host,
+                token=session_token, epoch=1,
+                pids={r: p.pid for r, p in pm.processes.items()},
+                backend=pm.backend, dist_port=pm.dist_port,
+                auth_token=auth_token, init_line=line)
+            try:
+                comm.send_to_all(
+                    "hello", {"token": session_token, "epoch": 1,
+                              "manifest": mirror}, timeout=30)
+            except Exception as e:
+                print(f"⚠️ manifest mirror hello failed ({e}) — "
+                      "orphaned workers will only retry the "
+                      "spawn-time endpoint")
         if host_specs is None:
             # Session manifest: what a future %dist_attach needs to
             # adopt this fleet after THIS kernel dies.  Single-host
@@ -717,6 +776,7 @@ class DistributedMagics(Magics):
             return
         pm.add_death_callback(self._announce_death)
         comm.set_output_callback(self._feed_stream)
+        comm.set_host_map(pm.hosts)
         DistributedMagics._comm = comm
         DistributedMagics._pm = pm
         DistributedMagics._world = comm.num_workers
@@ -902,6 +962,29 @@ class DistributedMagics(Magics):
     @argument("--side", default="both",
               choices=["coordinator", "worker", "both"],
               help="which send path(s) inject frame faults")
+    @argument("--partition", default=None,
+              help="host pair 'hostA,hostB' whose link to blackhole "
+                   "(multi-host worlds; labels from the --hosts plan, "
+                   "'local' = the coordinator's host)")
+    @argument("--partition-after", type=float, default=0.0,
+              dest="partition_after",
+              help="seconds after arming before the partition opens")
+    @argument("--partition-for", type=float, default=10.0,
+              dest="partition_for",
+              help="partition duration in seconds (0 = until "
+                   "%%dist_chaos off — allowed with --side coordinator "
+                   "only: a worker-side plan can't be cleared across "
+                   "the link it cuts)")
+    @argument("--link-latency", type=float, default=0.0,
+              dest="link_latency",
+              help="added per-frame delay on the --link-hosts pair "
+                   "(uniformly-slow link, no partition)")
+    @argument("--link-loss", type=float, default=0.0, dest="link_loss",
+              help="per-frame drop probability on the --link-hosts "
+                   "pair")
+    @argument("--link-hosts", default=None, dest="link_hosts",
+              help="host pair 'hostA,hostB' for --link-latency/"
+                   "--link-loss ('*,hostB' matches any peer)")
     @line_magic
     def dist_chaos(self, line):
         """Deterministic fault injection on the live control plane:
@@ -951,6 +1034,56 @@ class DistributedMagics(Magics):
                 "delay_p": args.delay_p, "delay_s": args.delay_s,
                 "duplicate": args.duplicate, "truncate": args.truncate,
                 "freeze_heartbeat": args.freeze_heartbeats}
+
+        def _host_pair(raw: str) -> list[str] | None:
+            # Non-posix arg_split keeps quote chars inside the token.
+            raw = raw.strip().strip("'\"")
+            pair = [h.strip() for h in raw.split(",") if h.strip()]
+            if len(pair) != 2:
+                print(f"❌ host pair must be 'hostA,hostB', got {raw!r}")
+                return None
+            return pair
+
+        links = []
+        if args.partition:
+            pair = _host_pair(args.partition)
+            if pair is None:
+                return
+            if not args.partition_for and args.side != "coordinator":
+                # An open-ended partition shipped to the WORKERS can
+                # never be cleared: `%dist_chaos off` cannot traverse
+                # the link the plan itself blackholes, so the far side
+                # would wait out its orphan TTL and self-terminate —
+                # a fleet-destroying knob documented as reversible.
+                print("❌ --partition-for 0 (until cleared) is "
+                      "coordinator-side only — the 'off' that would "
+                      "clear a worker-side plan can't cross the "
+                      "partition. Use --side coordinator, or give a "
+                      "finite --partition-for.")
+                return
+            links.append({"hosts": pair,
+                          "after_s": args.partition_after,
+                          "for_s": args.partition_for})
+        if args.link_latency or args.link_loss:
+            if not args.link_hosts:
+                print("❌ --link-latency/--link-loss need --link-hosts "
+                      "'hostA,hostB' to name the link")
+                return
+            pair = _host_pair(args.link_hosts)
+            if pair is None:
+                return
+            links.append({"hosts": pair,
+                          "latency_s": args.link_latency,
+                          "loss": args.link_loss})
+        if links:
+            known = set((self._pm.hosts or {}).values()) | {"local", "*"}
+            for l in links:
+                unknown = set(l["hosts"]) - known
+                if unknown:
+                    print(f"⚠️ link hosts {sorted(unknown)} are not in "
+                          f"this world's host map {sorted(known)} — "
+                          "the spec will match nothing")
+            spec["links"] = links
         kill_armed = (args.kill_rank is not None
                       and args.side in ("worker", "both"))
         if args.kill_rank is not None and not kill_armed:
@@ -1406,7 +1539,33 @@ class DistributedMagics(Magics):
                             b.get("col_age") is None
                             or b["col_age"] > pol.stall_s):
                         stalled.add(r)
-        for rank_id in sorted(proc_status):
+        # Multi-host worlds: group ranks per host, with the link's
+        # health (RTT from clock samples, worst heartbeat age,
+        # redeliveries ≈ loss) on each host header (ISSUE 6).
+        hosts_map = dict(getattr(self._pm, "hosts", None) or {})
+        multi = len(set(hosts_map.values())) > 1
+        link = None
+        if multi and self._comm is not None:
+            try:
+                link = self._comm.link_stats()
+            except Exception:
+                link = None
+        order = (sorted(proc_status,
+                        key=lambda r: (hosts_map.get(r, "local"), r))
+                 if multi else sorted(proc_status))
+        cur_host = None
+        for rank_id in order:
+            if multi:
+                h = hosts_map.get(rank_id, "local")
+                if h != cur_host:
+                    cur_host = h
+                    hdr = f"┌ host {h}"
+                    hs = ((link or {}).get("hosts") or {}).get(h)
+                    if hs:
+                        from ..resilience.partition import \
+                            format_link_suffix
+                        hdr += f" · {format_link_suffix(hs)}"
+                    print(hdr)
             p = proc_status[rank_id]
             if not p["running"]:
                 state = f"✖ exited ({p['returncode']})"

@@ -1,0 +1,625 @@
+"""Host agent: spawn + death-watch workers on a host without ssh.
+
+The ssh proxy path (``multihost.ssh_argv``) assumes an sshd, keys, and
+a login shell on every host — none of which exist on stock TPU pod
+VMs driven by an orchestrator, and none of which CI can exercise for
+real.  The host agent replaces that hop with this stack's OWN
+authenticated protocol: a small daemon (``tools/nbd_agent.py``) runs
+on each host, the coordinator's :class:`AgentClient` dials it over the
+existing ``NBDA``-preamble codec (same shared-secret handshake as the
+worker control plane — the agent port spawns processes, so it is
+never left unauthenticated on a non-loopback bind), and
+``ProcessManager.start_workers_multihost(..., agents=...)`` executes a
+:func:`~.multihost.make_launch_plan` through it instead of ``ssh``.
+
+Request types (all JSON + the shared codec, no pickle):
+
+    spawn  {rank, argv, env}        -> {pid}
+    poll   {}                       -> {exits: {rank: rc}}  (all known)
+    signal {rank, sig, group}       -> {signaled: bool}
+    tail   {rank, n}                -> {text}
+    ping   {}                       -> {status, workers, host}
+    reap   {}                       -> SIGTERM/SIGKILL every child
+
+Death-watch is push-based: the agent's monitor thread posts an
+unsolicited ``worker_exit {rank, rc}`` to the attached client the
+moment a child exits, and the client's receive thread folds it into a
+local table — so ``_AgentWorker.poll()`` (called 4×/s per rank by the
+ProcessManager monitor) never touches the network.  **Link loss makes
+workers UNKNOWN, not dead**: a broken agent connection is exactly what
+a network partition looks like from the coordinator, and reporting
+"exited" would turn every partition into N spurious heals — the
+partition sentry (``resilience/partition.py``) owns that call.  The
+client redials in the background and resyncs exit state with one
+``poll`` request after reconnecting.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from ..messaging.codec import Message
+from ..messaging.transport import (CoordinatorListener, TransportError,
+                                   WorkerChannel)
+
+AGENT_CLIENT_RANK = 0  # preamble rank the manager announces to the agent
+
+
+# ----------------------------------------------------------------------
+# agent (daemon) side
+
+
+class _AgentChildIO:
+    """Bounded ring of a child's merged stdout/stderr (the agent-side
+    twin of process_manager._ChildIO — kept local so the agent daemon
+    imports no manager machinery it doesn't need)."""
+
+    def __init__(self, proc: subprocess.Popen, rank: int):
+        self.lines: deque[str] = deque(maxlen=400)
+        self._thread = threading.Thread(
+            target=self._drain, args=(proc,),
+            name=f"nbd-agent-worker-{rank}-io", daemon=True)
+        self._thread.start()
+
+    def _drain(self, proc: subprocess.Popen) -> None:
+        try:
+            for line in proc.stdout:  # type: ignore[union-attr]
+                self.lines.append(line.decode("utf-8", "replace")
+                                  if isinstance(line, bytes) else line)
+        except ValueError:
+            pass
+
+    def tail(self, n: int = 40) -> str:
+        return "".join(list(self.lines)[-n:])
+
+
+class HostAgent:
+    """One per host: accepts an authenticated manager connection and
+    runs spawn/poll/signal/tail requests against local children."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 auth_token: str | None = None,
+                 host_label: str | None = None,
+                 run_dir: str | None = None):
+        self.host_label = host_label or os.environ.get("NBD_HOST") \
+            or "agent"
+        # Per-host run dir: flight rings / stack dumps / manifests of
+        # agent-spawned workers land HERE, never on the coordinator's
+        # filesystem — the shared-run-dir assumption is exactly what
+        # multi-host execution turns off.
+        self.run_dir = run_dir or os.environ.get("NBD_RUN_DIR")
+        self._listener = CoordinatorListener(host, port,
+                                             auth_token=auth_token)
+        self.host, self.port = self._listener.host, self._listener.port
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._io: dict[int, _AgentChildIO] = {}
+        self._exits: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener.on_message = self._on_message
+        self._listener.start()
+        self._monitor = threading.Thread(target=self._watch,
+                                         name="nbd-agent-monitor",
+                                         daemon=True)
+        self._monitor.start()
+
+    # -- request handling ---------------------------------------------
+
+    def _on_message(self, conn_rank: int, msg: Message) -> None:
+        try:
+            reply = self._handle(msg)
+        except Exception as e:
+            reply = msg.reply(data={"error": f"{type(e).__name__}: {e}"})
+        try:
+            self._listener.send_to_rank(conn_rank, reply)
+        except TransportError:
+            pass  # client vanished mid-request; it will resync on redial
+
+    def _handle(self, msg: Message) -> Message:
+        data = msg.data or {}
+        t = msg.msg_type
+        if t == "spawn":
+            return msg.reply(data=self._spawn(data))
+        if t == "poll":
+            with self._lock:
+                exits = {str(r): rc for r, rc in self._exits.items()}
+            return msg.reply(data={"exits": exits})
+        if t == "signal":
+            return msg.reply(data={
+                "signaled": self._signal(int(data["rank"]),
+                                         int(data["sig"]),
+                                         bool(data.get("group")))})
+        if t == "tail":
+            io = self._io.get(int(data.get("rank", -1)))
+            return msg.reply(data={
+                "text": io.tail(int(data.get("n", 40)))
+                if io is not None else ""})
+        if t == "ping":
+            with self._lock:
+                workers = sorted(self._procs)
+            return msg.reply(data={"status": "ok", "host":
+                                   self.host_label, "workers": workers,
+                                   "run_dir": self.run_dir})
+        if t == "reap":
+            n = self._reap()
+            return msg.reply(data={"reaped": n})
+        return msg.reply(data={"error": f"unknown agent request {t!r}"})
+
+    def _spawn(self, data: dict) -> dict:
+        rank = int(data["rank"])
+        argv = [str(a) for a in (data.get("argv") or ())]
+        if not argv:
+            return {"error": "spawn needs argv"}
+        # Env: the agent's own environment (its NBD_RUN_DIR, its
+        # platform neutralization) + the plan's overrides — the same
+        # layering the ssh path's `exec env K=V ...` produces, with
+        # the agent host's run dir winning over anything inherited.
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in (data.get("env") or {}).items()})
+        if self.run_dir:
+            env["NBD_RUN_DIR"] = self.run_dir
+        env.setdefault("NBD_HOST", self.host_label)
+        with self._lock:
+            old = self._procs.get(rank)
+            if old is not None and old.poll() is None:
+                return {"error": f"rank {rank} is already running "
+                                 f"(pid {old.pid})"}
+            proc = subprocess.Popen(
+                argv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, env=env,
+                start_new_session=True, cwd=os.getcwd())
+            self._procs[rank] = proc
+            self._io[rank] = _AgentChildIO(proc, rank)
+            self._exits.pop(rank, None)
+        return {"pid": proc.pid, "host": self.host_label}
+
+    def _signal(self, rank: int, sig: int, group: bool) -> bool:
+        with self._lock:
+            proc = self._procs.get(rank)
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            if group:
+                try:
+                    os.killpg(os.getpgid(proc.pid), sig)
+                    return True
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+            proc.send_signal(sig)
+            return True
+        except (ProcessLookupError, OSError):
+            return False
+
+    def _reap(self) -> int:
+        with self._lock:
+            procs = list(self._procs.items())
+        n = 0
+        for _rank, proc in procs:
+            if proc.poll() is None:
+                self._signal_tree(proc, _signal.SIGTERM)
+                n += 1
+        deadline = time.time() + 3.0
+        while time.time() < deadline and any(p.poll() is None
+                                             for _, p in procs):
+            time.sleep(0.05)
+        for _rank, proc in procs:
+            if proc.poll() is None:
+                self._signal_tree(proc, _signal.SIGKILL)
+        return n
+
+    @staticmethod
+    def _signal_tree(proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    # -- death-watch ---------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(0.25):
+            dead: list[tuple[int, int]] = []
+            with self._lock:
+                for rank, proc in self._procs.items():
+                    rc = proc.poll()
+                    if rc is not None and rank not in self._exits:
+                        self._exits[rank] = rc
+                        dead.append((rank, rc))
+            for rank, rc in dead:
+                # Push the exit to whatever manager is attached; a
+                # partitioned-away manager resyncs via `poll` later.
+                try:
+                    self._listener.send_to_rank(
+                        AGENT_CLIENT_RANK,
+                        Message(msg_type="worker_exit",
+                                data={"rank": rank, "rc": rc}))
+                except TransportError:
+                    pass
+
+    # -- lifecycle -----------------------------------------------------
+
+    def serve_forever(self) -> None:
+        try:
+            while not self._stop.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            pass
+
+    def close(self, *, reap: bool = True) -> None:
+        self._stop.set()
+        if reap:
+            try:
+                self._reap()
+            except Exception:
+                pass
+        self._listener.close()
+
+
+# ----------------------------------------------------------------------
+# coordinator (client) side
+
+
+class AgentClient:
+    """The coordinator's connection to one host's agent.
+
+    Requests are correlated by msg_id on a receive thread that also
+    folds in unsolicited ``worker_exit`` notices.  When the link
+    drops, ``link_up`` flips False and every worker's exit state
+    becomes UNKNOWN (``exit_code`` returns None) — partition-safe by
+    construction — while a background redial loop keeps trying; the
+    first request after a reconnect resyncs exits with ``poll``.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 auth_token: str | None = None,
+                 connect_timeout: float = 10.0):
+        self.host, self.port = host, port
+        self._auth_token = auth_token
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._pending: dict[str, tuple[threading.Event, list]] = {}
+        self._exits: dict[int, int] = {}
+        self._closed = threading.Event()
+        self.link_up = False
+        self.reconnects = 0
+        # msg_id of an in-flight fire-and-forget resync 'poll' sent
+        # right after a redial: its reply is folded in by the recv
+        # loop itself (a blocking request() there would deadlock — the
+        # redial runs ON the recv thread, the only thread that could
+        # deliver the reply).
+        self._resync_mid: str | None = None
+        self._ch: WorkerChannel | None = None
+        self._dial()
+        self._thread = threading.Thread(target=self._recv_loop,
+                                        name="nbd-agent-client",
+                                        daemon=True)
+        self._thread.start()
+
+    def _dial(self) -> None:
+        self._ch = WorkerChannel(self.host, self.port,
+                                 rank=AGENT_CLIENT_RANK,
+                                 auth_token=self._auth_token,
+                                 connect_timeout=self._connect_timeout)
+        self.link_up = True
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            ch = self._ch
+            if ch is None:
+                return
+            try:
+                msg = ch.recv(timeout=1.0)
+            except TimeoutError:
+                continue
+            except TransportError:
+                self.link_up = False
+                with self._lock:
+                    # Fail pending requests fast; callers see link loss.
+                    for ev, box in self._pending.values():
+                        box.append(None)
+                        ev.set()
+                    self._pending.clear()
+                if self._closed.is_set():
+                    return
+                self._redial_until_up()
+                continue
+            if msg.msg_type == "worker_exit":
+                d = msg.data or {}
+                try:
+                    with self._lock:
+                        self._exits[int(d["rank"])] = int(d["rc"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+                continue
+            if msg.msg_id == self._resync_mid \
+                    and msg.msg_type == "response":
+                # The post-reconnect resync reply: fold in every exit
+                # the outage ate (the push notices had no live client
+                # to land on).
+                self._resync_mid = None
+                self._fold_exits((msg.data or {}).get("exits") or {})
+                continue
+            with self._lock:
+                slot = self._pending.pop(msg.msg_id, None)
+            if slot is not None:
+                ev, box = slot
+                box.append(msg)
+                ev.set()
+
+    def _fold_exits(self, exits: dict) -> None:
+        with self._lock:
+            for r, rc in exits.items():
+                try:
+                    self._exits[int(r)] = int(rc)
+                except (TypeError, ValueError):
+                    pass
+
+    def _redial_until_up(self) -> None:
+        while not self._closed.wait(2.0):
+            try:
+                old, self._ch = self._ch, None
+                if old is not None:
+                    try:
+                        old.close()
+                    except Exception:
+                        pass
+                self._dial()
+                self.reconnects += 1
+            except Exception:
+                continue
+            # Resync exits missed while the link was down —
+            # fire-and-forget: we ARE the recv thread, so a blocking
+            # request() here could never see its own reply.
+            try:
+                msg = Message(msg_type="poll", data={},
+                              rank=AGENT_CLIENT_RANK)
+                self._resync_mid = msg.msg_id
+                self._ch.send(msg)
+            except Exception:
+                self._resync_mid = None
+            return
+
+    # -- requests ------------------------------------------------------
+
+    def request(self, msg_type: str, data: dict,
+                timeout: float = 15.0) -> Message:
+        ch = self._ch
+        if ch is None or not self.link_up:
+            raise TransportError(f"agent {self.host}:{self.port} link "
+                                 "is down")
+        msg = Message(msg_type=msg_type, data=data,
+                      rank=AGENT_CLIENT_RANK)
+        ev = threading.Event()
+        box: list = []
+        with self._lock:
+            self._pending[msg.msg_id] = (ev, box)
+        try:
+            ch.send(msg)
+        except Exception as e:
+            with self._lock:
+                self._pending.pop(msg.msg_id, None)
+            raise TransportError(f"agent send failed: {e}") from e
+        if not ev.wait(timeout):
+            with self._lock:
+                self._pending.pop(msg.msg_id, None)
+            raise TimeoutError(f"agent {self.host}:{self.port} did not "
+                               f"answer '{msg_type}' in {timeout:.0f}s")
+        resp = box[0] if box else None
+        if resp is None:
+            raise TransportError(f"agent {self.host}:{self.port} link "
+                                 f"dropped during '{msg_type}'")
+        err = (resp.data or {}).get("error")
+        if err:
+            raise RuntimeError(f"agent {self.host}:{self.port}: {err}")
+        return resp
+
+    def spawn(self, rank: int, argv, env) -> int:
+        resp = self.request("spawn", {
+            "rank": rank, "argv": list(argv),
+            "env": {k: v for k, v in (dict(env) if env else {}).items()},
+        }, timeout=30.0)
+        return int(resp.data["pid"])
+
+    def signal(self, rank: int, sig: int, *, group: bool = False) -> bool:
+        try:
+            resp = self.request("signal", {"rank": rank, "sig": int(sig),
+                                           "group": group}, timeout=10.0)
+        except (TransportError, TimeoutError):
+            return False
+        return bool((resp.data or {}).get("signaled"))
+
+    def tail(self, rank: int, n: int = 40) -> str | None:
+        try:
+            resp = self.request("tail", {"rank": rank, "n": n},
+                                timeout=10.0)
+        except (TransportError, TimeoutError, RuntimeError):
+            return None
+        return (resp.data or {}).get("text", "")
+
+    def exit_code(self, rank: int) -> int | None:
+        """The rank's known exit code, or None (alive OR unknowable —
+        a down link never reports death)."""
+        with self._lock:
+            return self._exits.get(rank)
+
+    def close(self) -> None:
+        self._closed.set()
+        ch, self._ch = self._ch, None
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
+class _AgentWorker:
+    """Popen-compatible shim over a worker the agent spawned on a
+    remote host.  ``poll`` reads the client's local exit table (the
+    push-fed death-watch) — no network per call; link loss reads as
+    alive-unknown, the partition-safe answer.  ``remote = True`` keeps
+    the ProcessManager's group-kill path from ever signalling the
+    REMOTE pid number on the LOCAL host (which could hit an innocent
+    local process)."""
+
+    remote = True
+
+    def __init__(self, client: AgentClient, rank: int, pid: int):
+        self._client = client
+        self.rank = rank
+        self.pid = int(pid)
+        self.stdout = None
+        self.returncode: int | None = None
+
+    def poll(self) -> int | None:
+        if self.returncode is None:
+            self.returncode = self._client.exit_code(self.rank)
+        return self.returncode
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired(
+                    f"agent worker rank {self.rank}", timeout)
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
+
+    def send_signal(self, sig: int) -> None:
+        self._client.signal(self.rank, sig)
+
+    def send_signal_group(self, sig: int) -> None:
+        self._client.signal(self.rank, sig, group=True)
+
+
+class _AgentWorkerIO:
+    """Stdio view of an agent-spawned worker: the ring lives on the
+    agent; ``tail`` fetches it on demand (and says so when the link is
+    down rather than rendering silence as 'no output')."""
+
+    def __init__(self, client: AgentClient, rank: int):
+        self._client = client
+        self._rank = rank
+
+    def tail(self, n: int = 40) -> str:
+        text = self._client.tail(self._rank, n)
+        if text is None:
+            return (f"(agent link {self._client.host}:"
+                    f"{self._client.port} is down — worker stdio "
+                    "unavailable)\n")
+        return text
+
+
+def parse_agents(spec: str | dict | None) -> dict[str, tuple[str, int]]:
+    """Parse ``"hostB=127.0.1.3:7411,hostC=10.0.0.4:7411"`` (or an
+    already-split mapping) into ``{host_label: (addr, port)}``.
+    Malformed entries are a loud ValueError — a typo'd agent endpoint
+    must not silently fall back to ssh."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        items = spec.items()
+    else:
+        items = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, sep, ep = part.partition("=")
+            if not sep:
+                raise ValueError(f"bad agent spec {part!r} (want "
+                                 f"host=addr:port)")
+            items.append((host.strip(), ep.strip()))
+    out: dict[str, tuple[str, int]] = {}
+    for host, ep in items:
+        if isinstance(ep, tuple):
+            addr, port = ep
+        else:
+            addr, sep, port = str(ep).rpartition(":")
+            if not sep or not addr:
+                raise ValueError(f"bad agent endpoint {ep!r} for host "
+                                 f"{host!r} (want addr:port)")
+        try:
+            port = int(port)
+        except (TypeError, ValueError):
+            raise ValueError(f"bad agent port {port!r} for host "
+                             f"{host!r}")
+        if not host:
+            raise ValueError(f"empty host label in agent spec "
+                             f"(endpoint {addr}:{port})")
+        if host in out:
+            raise ValueError(f"duplicate agent entry for host {host!r}")
+        out[host] = (addr, port)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI entry shared with ``tools/nbd_agent.py``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="nbdistributed_tpu host agent: spawns and "
+                    "death-watches workers on this host for a remote "
+                    "coordinator (the ssh-free multi-host launch path)")
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="address to listen on (non-loopback binds "
+                        "REQUIRE --token-file/--token-env)")
+    p.add_argument("--port", type=int, default=0,
+                   help="port (0 = ephemeral, printed on stdout)")
+    p.add_argument("--token-file", default=None,
+                   help="file holding the shared secret the "
+                        "coordinator must present")
+    p.add_argument("--token-env", default=None,
+                   help="env var holding the shared secret")
+    p.add_argument("--host-label", default=None,
+                   help="host label for link shaping / diagnosis "
+                        "(default: $NBD_HOST or 'agent')")
+    p.add_argument("--run-dir", default=None,
+                   help="per-host run dir for worker flight rings "
+                        "(default: $NBD_RUN_DIR, else minted)")
+    args = p.parse_args(argv)
+
+    token = None
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+    elif args.token_env:
+        token = os.environ.get(args.token_env) or None
+    if token is None and args.bind not in ("127.0.0.1", "localhost") \
+            and not args.bind.startswith("127."):
+        print("refusing an unauthenticated non-loopback bind: this "
+              "port spawns processes. Pass --token-file or "
+              "--token-env.", file=sys.stderr)
+        return 2
+    run_dir = args.run_dir or os.environ.get("NBD_RUN_DIR")
+    if not run_dir:
+        import tempfile
+        run_dir = tempfile.mkdtemp(prefix="nbd_agent_")
+    os.makedirs(run_dir, exist_ok=True)
+    os.environ["NBD_RUN_DIR"] = run_dir
+
+    agent = HostAgent(args.bind, args.port, auth_token=token,
+                      host_label=args.host_label, run_dir=run_dir)
+    # Machine-readable readiness line: launchers (and the integration
+    # tests) block on it.
+    print(f"NBD_AGENT_READY host={agent.host} port={agent.port} "
+          f"label={agent.host_label} run_dir={run_dir}", flush=True)
+
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _term)
+    try:
+        agent.serve_forever()
+    finally:
+        agent.close()
+    return 0

@@ -29,6 +29,7 @@ and ambiguous configs are refused loudly rather than mis-wired.
 from __future__ import annotations
 
 import dataclasses
+import os
 import shlex
 import sys
 
@@ -50,8 +51,15 @@ class WorkerLaunch:
 
 
 def parse_hosts(spec: str) -> list[HostSpec]:
-    """``"h1,h2:4,local:2"`` -> [HostSpec("h1",1), HostSpec("h2",4), ...]"""
+    """``"h1,h2:4,local:2"`` -> [HostSpec("h1",1), HostSpec("h2",4), ...]
+
+    Duplicate hosts are rejected loudly: ``"h1,h1:2"`` is always a
+    typo (the launch plan would assign two rank ranges to one box and,
+    on TPU, double-book its chips), and the merged meaning the user
+    intended is ambiguous — 1+2 workers or 2?
+    """
     out = []
+    seen: set[str] = set()
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -65,6 +73,12 @@ def parse_hosts(spec: str) -> list[HostSpec]:
             raise ValueError(f"bad worker count {n!r} for host {host!r}")
         if workers < 1:
             raise ValueError(f"host {host!r}: workers must be >= 1")
+        if host in seen:
+            raise ValueError(
+                f"host {host!r} listed more than once in {spec!r} — "
+                f"merge the entries (e.g. {host}:N) instead of "
+                f"repeating the host")
+        seen.add(host)
         out.append(HostSpec(host, workers))
     if not out:
         raise ValueError(f"no hosts in spec {spec!r}")
@@ -81,6 +95,14 @@ def make_launch_plan(hosts: list[HostSpec], *, coordinator_host: str,
     loopback with remote hosts is rejected (the classic silent-hang
     misconfig).
     """
+    dup = {h.host for h in hosts
+           if sum(1 for x in hosts if x.host == h.host) > 1}
+    if dup:
+        # parse_hosts already refuses duplicate spec entries; this
+        # guards hand-built HostSpec lists taking the same wrong turn.
+        raise ValueError(f"duplicate host(s) {sorted(dup)} in the plan "
+                         "— each host appears once, with its worker "
+                         "count")
     remote = [h for h in hosts if h.host != "local"]
     if remote and coordinator_host in ("127.0.0.1", "localhost", ""):
         raise ValueError(
@@ -116,17 +138,27 @@ def make_launch_plan(hosts: list[HostSpec], *, coordinator_host: str,
             if dist_port is not None:
                 argv += ["--dist-port", str(dist_port),
                          "--dist-host", dist_host]
-            env: dict[str, str] = {}
+            env: dict[str, str] = {
+                # Host labels: feed per-link fault shaping, the
+                # partition sentry's failure domains, and per-host
+                # status grouping (ISSUE 6).  NBD_COORD_HOST is the
+                # coordinator's OWN label (its env, else "local") —
+                # the worker's half of every link pair; without it a
+                # relabelled coordinator would shape frames on a pair
+                # the workers never match.
+                "NBD_HOST": h.host,
+                "NBD_COORD_HOST": os.environ.get("NBD_HOST") or "local",
+            }
             if backend == "cpu":
                 # Deterministic worker env regardless of what the
                 # remote login shell (or, via the ssh proxy in tests,
                 # the coordinator) exports: exactly one CPU device per
                 # process, gloo across processes, no accelerator
                 # plugin.  Empty string = unset for all three.
-                env = {"JAX_PLATFORMS": "cpu",
-                       "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
-                       "XLA_FLAGS": "",
-                       "PALLAS_AXON_POOL_IPS": ""}
+                env.update({"JAX_PLATFORMS": "cpu",
+                            "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+                            "XLA_FLAGS": "",
+                            "PALLAS_AXON_POOL_IPS": ""})
             # backend == "tpu", one worker per host: no carving env —
             # the worker owns every local chip and jax.distributed
             # handles cross-host wiring.
@@ -134,6 +166,14 @@ def make_launch_plan(hosts: list[HostSpec], *, coordinator_host: str,
                                      argv=tuple(argv),
                                      env=tuple(sorted(env.items()))))
             rank += 1
+    ranks = [l.rank for l in plan]
+    if ranks != list(range(world)):
+        # Unreachable by construction today; a refactor that breaks
+        # the host-major assignment must fail HERE, not as a silent
+        # half-wired world (two workers claiming one rank deadlocks
+        # jax.distributed with no error).
+        raise ValueError(f"internal error: launch plan ranks {ranks} "
+                         f"are not exactly 0..{world - 1}")
     return plan
 
 

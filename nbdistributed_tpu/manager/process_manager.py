@@ -163,6 +163,12 @@ class ProcessManager:
         self.backend: str | None = None
         self.world_size = 0
         self.dist_port: int | None = None
+        # rank -> host label ("local" for direct children).  Feeds the
+        # per-link fault shaping, the partition sentry's failure
+        # domains, and per-host status/doctor grouping (ISSUE 6).
+        self.hosts: dict[int, str] = {}
+        # host label -> AgentClient for agent-launched hosts.
+        self._agents: dict = {}
         self._monitor_thread: threading.Thread | None = None
         self._monitor_stop = threading.Event()
         self._death_callbacks: list[Callable[[int, int | None], None]] = []
@@ -234,27 +240,42 @@ class ProcessManager:
             if self.dist_port is not None:
                 cmd += ["--dist-port", str(self.dist_port)]
             self._spawn(rank, cmd, env)
+        self.hosts = {r: "local" for r in range(num_workers)}
         self._start_monitor()
 
     def start_workers_multihost(self, hosts, control_port: int, *,
                                 coordinator_host: str,
                                 backend: str = "auto",
                                 ssh: str = "ssh",
-                                auth_token: str | None = None) -> int:
+                                auth_token: str | None = None,
+                                agents=None,
+                                agent_token: str | None = None,
+                                extra_env: dict | None = None) -> int:
         """Launch workers across hosts per a
         :func:`~nbdistributed_tpu.manager.multihost.make_launch_plan`.
 
         ``hosts``: a spec string (``"h1,h2:2,local"``) or list of
-        ``HostSpec``.  Entries with host ``"local"`` spawn directly;
-        remote entries spawn an ssh proxy process whose stdio/kill
-        semantics match a local child's.  Returns the world size.
+        ``HostSpec``.  Entries with host ``"local"`` spawn directly.
+        Remote entries launch through their **host agent** when
+        ``agents`` maps their label to an endpoint (``{"h2":
+        ("10.0.0.3", 7411)}`` or the ``"h2=10.0.0.3:7411"`` spec
+        string — see :mod:`~nbdistributed_tpu.manager.hostagent`),
+        and through an ssh proxy process otherwise.  ``extra_env``
+        rides every worker's env (session token/epoch, host labels).
+        Returns the world size.
         """
-        from . import multihost
+        from . import hostagent, multihost
 
         if self.processes:
             raise RuntimeError("workers already running; shutdown first")
         specs = multihost.parse_hosts(hosts) if isinstance(hosts, str) \
             else list(hosts)
+        agent_eps = hostagent.parse_agents(agents)
+        unknown = set(agent_eps) - {h.host for h in specs}
+        if unknown:
+            raise ValueError(
+                f"agent endpoints for hosts {sorted(unknown)} that are "
+                f"not in the host spec {[h.host for h in specs]}")
         if backend == "auto":
             backend = topology.detect_backend()
         self.backend = backend
@@ -264,28 +285,61 @@ class ProcessManager:
             specs, coordinator_host=coordinator_host,
             control_port=control_port, dist_port=self.dist_port,
             backend=backend)
+        ship = dict(extra_env or {})
         if auth_token:
             # Ship the control-plane shared secret in every worker's
             # env (rides the ssh remote command for remote entries —
             # visible to local `ps` on that host; see multihost.ssh_argv).
+            ship["NBD_AUTH_TOKEN"] = auth_token
+        if ship:
             import dataclasses as _dc
             plan = [_dc.replace(
-                l, env=tuple(sorted({**dict(l.env),
-                                     "NBD_AUTH_TOKEN": auth_token}
-                                    .items())))
+                l, env=tuple(sorted({**dict(l.env), **ship}.items())))
                 for l in plan]
-        for launch in plan:
-            if launch.host == "local":
-                # Direct spawn: local base env (incl. the cpu backend's
-                # sitecustomize neutralization) + the plan's overrides.
-                env = topology.cpu_worker_env() if backend == "cpu" \
-                    else dict(os.environ)
-                env.update(dict(launch.env))
-                self._spawn(launch.rank, list(launch.argv), env)
-            else:
-                self._spawn(launch.rank,
-                            multihost.ssh_argv(launch, ssh=ssh),
-                            dict(os.environ))
+        try:
+            for launch in plan:
+                self.hosts[launch.rank] = launch.host
+                if launch.host == "local":
+                    # Direct spawn: local base env (incl. the cpu
+                    # backend's sitecustomize neutralization) + the
+                    # plan's overrides.
+                    env = topology.cpu_worker_env() if backend == "cpu" \
+                        else dict(os.environ)
+                    env.update(dict(launch.env))
+                    self._spawn(launch.rank, list(launch.argv), env)
+                elif launch.host in agent_eps:
+                    client = self._agents.get(launch.host)
+                    if client is None:
+                        addr, port = agent_eps[launch.host]
+                        # The agent's ADMISSION secret (fixed at daemon
+                        # start, NBD_AGENT_TOKEN on the kernel side) is
+                        # distinct from the per-session control-plane
+                        # token the workers dial back with; the latter
+                        # is only a usable fallback when the caller
+                        # started the daemons with it (tests do).
+                        client = hostagent.AgentClient(
+                            addr, port,
+                            auth_token=(agent_token if agent_token
+                                        is not None else auth_token))
+                        self._agents[launch.host] = client
+                    pid = client.spawn(launch.rank, launch.argv,
+                                       dict(launch.env))
+                    self.processes[launch.rank] = \
+                        hostagent._AgentWorker(client, launch.rank, pid)
+                    self.io[launch.rank] = \
+                        hostagent._AgentWorkerIO(client, launch.rank)
+                else:
+                    self._spawn(launch.rank,
+                                multihost.ssh_argv(launch, ssh=ssh),
+                                dict(os.environ))
+        except Exception:
+            # A half-spawned world must not leak children or agent
+            # connections: reap what came up, then re-raise.
+            try:
+                self.shutdown()
+            except Exception:
+                pass
+            raise
         self._start_monitor()
         return self.world_size
 
@@ -305,6 +359,7 @@ class ProcessManager:
         for rank, pid in sorted(pids.items()):
             self.processes[rank] = _AdoptedProcess(pid)
             self.io[rank] = _AdoptedIO(pid)
+        self.hosts = {r: "local" for r in self.processes}
         self._start_monitor()
 
     def _spawn(self, rank: int, cmd: list[str], env: dict) -> None:
@@ -449,13 +504,33 @@ class ProcessManager:
                     proc.stdout.close()
                 except OSError:
                     pass
+        for client in self._agents.values():
+            # Belt-and-braces remote reap (the per-rank SIGTERM/SIGKILL
+            # above already went through the agent), then drop the
+            # connection.
+            try:
+                client.request("reap", {}, timeout=10.0)
+            except Exception:
+                pass
+            client.close()
+        self._agents.clear()
         self.processes.clear()
         self.io.clear()
+        self.hosts.clear()
         self._reported_dead.clear()
         self.world_size = 0
 
     @staticmethod
     def _signal_group(proc: subprocess.Popen, sig: int) -> None:
+        if getattr(proc, "remote", False):
+            # Agent-spawned worker: its pid belongs to ANOTHER host's
+            # pid namespace — a local killpg on that number could hit
+            # an innocent local process.  Route through the agent.
+            try:
+                proc.send_signal_group(sig)
+            except Exception:
+                pass
+            return
         try:
             os.killpg(os.getpgid(proc.pid), sig)
         except (ProcessLookupError, PermissionError, OSError):

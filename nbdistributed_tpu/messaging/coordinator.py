@@ -22,6 +22,7 @@ three structural fixes called out in SURVEY §7:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -31,7 +32,7 @@ from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability.clock import ClockEstimator
-from ..resilience.retry import RetryPolicy
+from ..resilience.retry import RetryPolicy, class_of
 from .codec import Message
 from .native import make_listener
 from .transport import TransportError
@@ -89,7 +90,13 @@ class CommunicationManager:
         # pre-retry single-attempt behavior).
         self.retry = (retry if retry is not None
                       else RetryPolicy.from_env() or RetryPolicy())
+        # Per-message-class budget overrides (NBD_RETRY_CLASS_*): bulk
+        # push/pull/checkpoint frames get a long-haul budget on slow
+        # links while control frames keep their tight one (ISSUE 6).
+        self.retry_classes = RetryPolicy.classes_from_env(self.retry)
         self.retries_sent = 0  # redeliveries actually transmitted
+        self.retries_by_rank: dict[int, int] = {}  # per-rank, for the
+        # per-link loss estimate in link_stats()
         # Observability: the process tracer (spans around requests,
         # off until %dist_trace start), per-rank clock offsets fed from
         # response RTTs, and wire-frame accounting into the registry.
@@ -115,7 +122,14 @@ class CommunicationManager:
         self._lock = threading.Lock()
         self._pending: dict[str, _Pending] = {}
         self._connected: set[int] = set()
+        self._ever_connected: set[int] = set()
         self._dead: set[int] = set()
+        # Host topology (multi-host worlds): rank -> host label, plus
+        # this process's own label — fed to the listener for per-link
+        # fault shaping and to the partition sentry / link_stats.
+        self.hosts: dict[int, str] = {}
+        self.local_host: str = os.environ.get("NBD_HOST") or "local"
+        self._listener.local_host = self.local_host
         self._ready = threading.Event()
         self._last_seen: dict[int, float] = {}
         self._last_ping: dict[int, tuple[float, dict]] = {}
@@ -147,6 +161,19 @@ class CommunicationManager:
 
     def fault_plan(self):
         return getattr(self._listener, "fault_plan", None)
+
+    def set_host_map(self, hosts: dict[int, str]) -> None:
+        """Record which host each rank runs on (multi-host worlds) —
+        feeds per-link fault shaping, the partition sentry, and the
+        per-host diagnosis surfaces."""
+        self.hosts = dict(hosts or {})
+        self._listener.host_of_rank = dict(self.hosts)
+
+    def retry_for(self, msg_type: str) -> RetryPolicy:
+        """The redelivery policy for one message type: its class
+        override when configured (NBD_RETRY_CLASS_*), the base policy
+        otherwise."""
+        return self.retry_classes.get(class_of(msg_type), self.retry)
 
     # ------------------------------------------------------------------
     # readiness / liveness
@@ -203,6 +230,53 @@ class CommunicationManager:
         state."""
         with self._lock:
             return list(self._telemetry.get(rank) or ())
+
+    def link_stats(self) -> dict:
+        """Per-rank and per-host link health, assembled from state the
+        coordinator already collects: the clock estimator's min-RTT
+        samples (RTT estimate per rank), heartbeat ages, and redelivery
+        counts (loss proxy — every retry is a frame some link ate or
+        delayed past its class budget).  Shape::
+
+            {"ranks": {rank: {"host", "rtt_ms", "offset_ms", "samples",
+                              "hb_age_s", "retries"}},
+             "hosts": {host: {"ranks", "rtt_ms" (min over ranks),
+                              "hb_age_s" (max), "retries" (sum)}}}
+        """
+        now = time.time()
+        clock = self.clock.stats()
+        with self._lock:
+            pings = dict(self._last_ping)
+            retries = dict(self.retries_by_rank)
+        ranks: dict[int, dict] = {}
+        for r in range(self.num_workers):
+            cs = clock.get(r) or {}
+            ping = pings.get(r)
+            rtt = cs.get("min_rtt_s")
+            ranks[r] = {
+                "host": self.hosts.get(r, "local"),
+                "rtt_ms": round(rtt * 1e3, 2) if rtt is not None else None,
+                "offset_ms": round((cs.get("offset_s") or 0.0) * 1e3, 2),
+                "samples": cs.get("samples", 0),
+                "hb_age_s": (round(now - ping[0], 1)
+                             if ping is not None else None),
+                "retries": retries.get(r, 0),
+            }
+        hosts: dict[str, dict] = {}
+        for r, v in ranks.items():
+            h = hosts.setdefault(v["host"], {"ranks": [], "rtt_ms": None,
+                                             "hb_age_s": None,
+                                             "retries": 0})
+            h["ranks"].append(r)
+            if v["rtt_ms"] is not None and (h["rtt_ms"] is None
+                                            or v["rtt_ms"] < h["rtt_ms"]):
+                h["rtt_ms"] = v["rtt_ms"]
+            if v["hb_age_s"] is not None and (h["hb_age_s"] is None
+                                              or v["hb_age_s"]
+                                              > h["hb_age_s"]):
+                h["hb_age_s"] = v["hb_age_s"]
+            h["retries"] += v["retries"]
+        return {"ranks": ranks, "hosts": hosts}
 
     def mark_worker_dead(self, rank: int) -> None:
         """Called by the process monitor when a worker process exits.
@@ -279,7 +353,7 @@ class CommunicationManager:
             with self._lock:
                 del self._pending[msg.msg_id]
             raise WorkerDied(f"workers {sorted(already_dead)} are dead")
-        policy = self.retry
+        policy = self.retry_for(msg_type)
         attempts = policy.attempts if policy.enabled() else 1
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
@@ -302,6 +376,10 @@ class CommunicationManager:
                                            ranks=missing_now)
                         self._listener.send_to_ranks(missing_now, msg)
                         self.retries_sent += 1
+                        with self._lock:
+                            for r in missing_now:
+                                self.retries_by_rank[r] = \
+                                    self.retries_by_rank.get(r, 0) + 1
                         obs_metrics.registry().counter(
                             "nbd_retries_total",
                             "request redeliveries transmitted").inc()
@@ -362,16 +440,33 @@ class CommunicationManager:
 
     def _on_connect(self, rank: int) -> None:
         with self._lock:
+            reconnect = rank in self._ever_connected
             self._connected.add(rank)
+            self._ever_connected.add(rank)
             self._dead.discard(rank)
             self._last_seen[rank] = time.time()
             all_in = len(self._connected) >= self.num_workers
+        # Transport-level connect events land in the flight ring on
+        # BOTH sides so a postmortem can tell "link flapped" (connect /
+        # eof / reconnect trail) from "peer died" (eof, then nothing).
+        if reconnect:
+            self.flight.record("transport_reconnect", rank=rank,
+                               host=self.hosts.get(rank))
+            obs_metrics.registry().counter(
+                "nbd_link_reconnects_total",
+                "worker control-plane reconnections (link flaps, "
+                "partition heals, orphan reattaches)").inc()
+        else:
+            self.flight.record("transport_connect", rank=rank,
+                               host=self.hosts.get(rank))
         if all_in:
             self._ready.set()
 
     def _on_disconnect(self, rank: int) -> None:
         with self._lock:
             self._connected.discard(rank)
+        self.flight.record("transport_eof", rank=rank,
+                           host=self.hosts.get(rank))
         self.mark_worker_dead(rank)
 
     def _on_message(self, rank: int, msg: Message) -> None:
@@ -388,6 +483,24 @@ class CommunicationManager:
                     pass
             return
         if msg.msg_type == "response":
+            # Epoch fence, worker→coordinator direction (ISSUE 6):
+            # workers stamp replies with their session epoch, so a
+            # result computed for a PREVIOUS tenancy — a stale-side
+            # rank delivering across a healed partition after this
+            # coordinator already healed replacements — is rejected
+            # here, never double-applied.  Unstamped replies (epoch
+            # None: pre-partition worlds) are never rejected.
+            if (msg.epoch is not None and self.session_epoch
+                    and msg.epoch < self.session_epoch):
+                obs_metrics.registry().counter(
+                    "nbd_epoch_rejected_results",
+                    "stale-epoch worker replies rejected by the "
+                    "coordinator").inc()
+                self.flight.record("epoch_rejected_result", rank=rank,
+                                   msg_id=msg.msg_id,
+                                   frame_epoch=msg.epoch,
+                                   epoch=self.session_epoch)
+                return
             with self._lock:
                 pending = self._pending.get(msg.msg_id)
                 if pending is None:

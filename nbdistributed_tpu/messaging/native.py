@@ -132,8 +132,11 @@ class NativeCoordinatorListener:
         self.on_disconnect = lambda r: None
         # Chaos hook (resilience/faults.py) — applied in this Python
         # wrapper so fault injection behaves identically over the C++
-        # and pure-Python transports.
+        # and pure-Python transports.  host_of_rank/local_host feed the
+        # per-link shaping exactly like the Python listener's.
         self.fault_plan = None
+        self.host_of_rank: dict[int, str] = {}
+        self.local_host: str = "local"
 
     def start(self) -> None:
         self._running = True
@@ -179,6 +182,12 @@ class NativeCoordinatorListener:
         if plan is None:
             return self._send_accounted(rank, frame, kind)
         rcs: list[int] = []
+        if plan.has_links():
+            plan.link_transmit(
+                self.local_host, self.host_of_rank.get(rank), frame,
+                lambda f: rcs.append(self._send_accounted(rank, f, kind)),
+                kind=kind)
+            return rcs[-1] if rcs else 0
         plan.transmit(
             frame,
             lambda f: rcs.append(self._send_accounted(rank, f, kind)),

@@ -183,6 +183,13 @@ class CoordinatorListener:
         # frame passes through the plan, which may drop/delay/
         # duplicate/truncate it deterministically.  None in production.
         self.fault_plan = None
+        # Link-shaping topology (ISSUE 6): which host each rank lives
+        # on, and this process's own host label — a fault plan with
+        # per-link specs uses them to decide which frames cross a
+        # partitioned / slow / lossy link.  Empty map = no link ever
+        # matches (single-host worlds pay nothing).
+        self.host_of_rank: dict[int, str] = {}
+        self.local_host: str = "local"
         # wake-up pipe so close() interrupts select()
         self._wake_r, self._wake_w = socket.socketpair()
 
@@ -239,7 +246,16 @@ class CoordinatorListener:
 
         plan = self.fault_plan
         if plan is not None:
-            plan.transmit(frame, _tx, kind=kind)
+            if plan.has_links():
+                # Link shaping first (partition/loss/latency/bw for the
+                # host pair this frame crosses), composing with the
+                # per-frame faults inside link_transmit.
+                dst = (self.host_of_rank.get(conn.rank)
+                       if conn.rank is not None else None)
+                plan.link_transmit(self.local_host, dst, frame, _tx,
+                                   kind=kind)
+            else:
+                plan.transmit(frame, _tx, kind=kind)
         else:
             _tx(frame)
 
@@ -407,6 +423,14 @@ class WorkerChannel:
         # bypasses it — an unattached worker is a bring-up problem, not
         # a chaos scenario.
         self.fault_plan = None
+        # Link-shaping labels (ISSUE 6): which host this process lives
+        # on and which host the coordinator lives on.  When a fault
+        # plan declares the pair partitioned, send() SEVERS the
+        # connection and raises — emulating the keepalive teardown a
+        # real blackholed link ends in — so the worker's orphan
+        # machinery engages exactly as it would on real hardware.
+        self.local_host: str | None = None
+        self.peer_host: str | None = None
         with self._wlock:
             # The authenticated preamble variant when the coordinator
             # requires the shared secret (non-loopback binds).
@@ -428,7 +452,22 @@ class WorkerChannel:
 
         plan = self.fault_plan
         if plan is not None:
-            plan.transmit(frame, _tx, kind=msg.msg_type)
+            if plan.has_links() and self.local_host:
+                if plan.link_blocked(self.local_host, self.peer_host):
+                    # Injected partition: tear the stream the way TCP
+                    # keepalive would on a real blackholed link, then
+                    # surface it — the recv side sees EOF and enters
+                    # the orphan machinery.
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    raise TransportError(
+                        "link partitioned (injected fault)")
+                plan.link_transmit(self.local_host, self.peer_host,
+                                   frame, _tx, kind=msg.msg_type)
+            else:
+                plan.transmit(frame, _tx, kind=msg.msg_type)
         else:
             _tx(frame)
 
@@ -473,21 +512,32 @@ class WorkerChannel:
                     raise TimeoutError("recv timed out")
             else:
                 remaining = None
-            if use_gate:
-                # KI may propagate from this block (pending delivered
-                # at window entry, or SIGINT during the wait) — nothing
-                # has been consumed yet, so the stream stays in sync.
-                with gate.window():
-                    readable, _, _ = _select.select([self._sock], [],
-                                                    [], remaining)
-            elif deadline is not None:
-                readable, _, _ = _select.select([self._sock], [], [],
-                                                remaining)
-            else:
-                readable = [self._sock]
-            if not readable:
-                raise TimeoutError("recv timed out")
-            data = self._sock.recv(1 << 20)
+            try:
+                if use_gate:
+                    # KI may propagate from this block (pending
+                    # delivered at window entry, or SIGINT during the
+                    # wait) — nothing has been consumed yet, so the
+                    # stream stays in sync.
+                    with gate.window():
+                        readable, _, _ = _select.select([self._sock], [],
+                                                        [], remaining)
+                elif deadline is not None:
+                    readable, _, _ = _select.select([self._sock], [], [],
+                                                    remaining)
+                else:
+                    readable = [self._sock]
+                if not readable:
+                    raise TimeoutError("recv timed out")
+                data = self._sock.recv(1 << 20)
+            except TimeoutError:
+                raise  # a timeout is not a dead socket (OSError subclass!)
+            except (OSError, ValueError) as e:
+                # The socket died under us — a peer reset, or our own
+                # send path severed it (injected link partition).  Both
+                # mean "coordinator unreachable": surface the one error
+                # the worker loop's orphan machinery handles.
+                raise TransportError(
+                    f"connection lost: {type(e).__name__}: {e}") from e
             if not data:
                 raise TransportError("coordinator closed connection")
             self._rbuf.extend(data)

@@ -97,6 +97,19 @@ def render_report(manifest: dict, rings: dict, telemetry: dict) -> str:
         f"run dir : {manifest.get('run_dir')}",
         "",
     ]
+    # Multi-host worlds: per-host grouping + link health at capture
+    # time — "which link was sick" belongs next to "which rank died".
+    links = manifest.get("link_stats") or {}
+    if len(links.get("hosts") or {}) > 1:
+        from ..resilience.partition import format_link_suffix
+        lines.append("hosts / links at capture:")
+        for h, hs in sorted(links["hosts"].items()):
+            dead = [r for r in hs.get("ranks", ())
+                    if r in (manifest.get("dead_ranks") or [])]
+            lines.append(f"   {h:<14} ranks {hs.get('ranks')} · "
+                         f"{format_link_suffix(hs)}"
+                         + (f" · DEAD {dead}" if dead else ""))
+        lines.append("")
     for key in sorted(rings, key=str):
         ring = rings[key]
         if ring is None:
@@ -154,6 +167,7 @@ def build_bundle(out_dir: str, *, run_dir: str,
                  rank_faults: dict | None = None,
                  telemetry: dict | None = None,
                  hang_report: str | None = None,
+                 link_stats: dict | None = None,
                  reason: str = "") -> dict:
     """Assemble and write one bundle; returns the manifest (with
     ``"dir"`` set).  Pure function of its inputs + the ring files on
@@ -178,6 +192,7 @@ def build_bundle(out_dir: str, *, run_dir: str,
                            "overwritten": v["overwritten"],
                            "path": v["path"]}
                   for k, v in rings.items() if v is not None},
+        "link_stats": link_stats or {},
         "dir": out_dir,
     }
 
@@ -247,6 +262,11 @@ def capture(comm, dead_ranks=None, *, out_dir: str | None = None,
             if hist:
                 telemetry[r] = list(hist)
         plan = comm.fault_plan() if hasattr(comm, "fault_plan") else None
+        try:
+            links = comm.link_stats() if hasattr(comm,
+                                                 "link_stats") else None
+        except Exception:
+            links = None
         out = out_dir or _next_bundle_dir(run_d)
         flightrec.record("postmortem", dir=out, dead=dead, reason=reason)
         manifest = build_bundle(
@@ -260,7 +280,7 @@ def capture(comm, dead_ranks=None, *, out_dir: str | None = None,
             coordinator_faults=(plan.events() if plan is not None else []),
             rank_faults=rank_faults,
             telemetry=telemetry, hang_report=hang_report,
-            reason=reason)
+            link_stats=links, reason=reason)
         return manifest
     except Exception:
         return None

@@ -44,8 +44,101 @@ DEFAULT_EXEMPT = ("ping",)
 _SPEC_KEYS = frozenset({
     "seed", "drop", "delay_p", "delay_s", "duplicate", "truncate",
     "freeze_heartbeat", "kill_rank", "kill_at", "exempt",
-    "freeze_rank", "freeze_at", "freeze_s",
+    "freeze_rank", "freeze_at", "freeze_s", "links",
 })
+
+_LINK_KEYS = frozenset({
+    "hosts", "after_s", "for_s", "latency_s", "loss", "bw_bytes_s",
+})
+
+
+class LinkSpec:
+    """Shaping for one host-pair link (ISSUE 6).
+
+    ``hosts`` is an unordered pair of host labels (``"*"`` matches any
+    host); the remaining knobs describe what the link does to frames
+    crossing it:
+
+    - ``after_s``/``for_s`` — a **partition window**: starting
+      ``after_s`` seconds after the plan is installed, the link drops
+      every frame for ``for_s`` seconds (0 = forever).  Workers sever
+      their connection on the first blocked send, so the far side
+      rides the orphan machinery exactly as it would when a real DCN
+      link blackholes and TCP keepalive finally tears the stream.
+    - ``latency_s`` — added one-way delay per frame (a slow hop).
+    - ``loss`` — per-frame drop probability (seeded per link).
+    - ``bw_bytes_s`` — bandwidth cap: each frame sleeps
+      ``len(frame)/bw`` before the write (a saturated link).
+
+    Heartbeats are NOT exempt from link shaping (unlike the per-frame
+    faults): a partition that let pings through would be undetectable,
+    which is the opposite of the point.
+    """
+
+    def __init__(self, *, hosts, after_s: float | None = None,
+                 for_s: float | None = None, latency_s: float = 0.0,
+                 loss: float = 0.0, bw_bytes_s: float = 0.0):
+        hosts = tuple(hosts or ())
+        if len(hosts) != 2 or not all(isinstance(h, str) and h
+                                      for h in hosts):
+            raise ValueError(
+                f"link spec needs a pair of host labels, got {hosts!r}")
+        if hosts[0] == hosts[1] and hosts[0] != "*":
+            raise ValueError(f"link spec pairs a host with itself: "
+                             f"{hosts!r} (a host cannot partition from "
+                             f"itself)")
+        self.hosts = frozenset(hosts)
+        # A partition window is declared by PRESENCE of either knob
+        # (None = absent), so `for_s=0` keeps its documented meaning —
+        # "from after_s until cleared" — instead of silently injecting
+        # nothing when after_s is also 0.
+        self.has_partition = after_s is not None or for_s is not None
+        self.after_s = float(after_s or 0.0)
+        self.for_s = float(for_s or 0.0)
+        self.latency_s = float(latency_s)
+        self.loss = float(loss)
+        self.bw_bytes_s = float(bw_bytes_s)
+        # Stable per-link loss salt (str.hash is randomized per
+        # process and would break cross-fleet seeded determinism);
+        # precomputed — the send path must not pay a crc per frame.
+        import zlib
+        self._loss_salt = zlib.crc32(
+            "|".join(sorted(self.hosts)).encode()) & 0xFFFF
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "LinkSpec":
+        if not isinstance(spec, dict):
+            raise TypeError(f"link spec must be a dict, got "
+                            f"{type(spec).__name__}")
+        unknown = set(spec) - _LINK_KEYS
+        if unknown:
+            raise ValueError(f"unknown link spec keys {sorted(unknown)} "
+                             f"(known: {sorted(_LINK_KEYS)})")
+        return cls(**spec)
+
+    def spec(self) -> dict:
+        # None for an undeclared window, so the roundtrip preserves
+        # has_partition (0.0 values would re-declare one).
+        return {"hosts": sorted(self.hosts),
+                "after_s": self.after_s if self.has_partition else None,
+                "for_s": self.for_s if self.has_partition else None,
+                "latency_s": self.latency_s,
+                "loss": self.loss, "bw_bytes_s": self.bw_bytes_s}
+
+    def matches(self, a: str, b: str) -> bool:
+        pair = {a, b}
+        if "*" in self.hosts:
+            other = next(iter(self.hosts - {"*"}), "*")
+            return other == "*" or other in pair
+        return self.hosts == pair
+
+    def partition_active(self, elapsed_s: float) -> bool:
+        """Is the partition window open ``elapsed_s`` seconds after the
+        plan was installed?  ``for_s == 0`` with a declared window
+        means 'until cleared'."""
+        if not self.has_partition or elapsed_s < self.after_s:
+            return False
+        return not self.for_s or elapsed_s < self.after_s + self.for_s
 
 # A frozen rank must stay frozen long past any watchdog policy window,
 # but not forever: the sleep is broken early by the escalation
@@ -67,6 +160,7 @@ class FaultPlan:
                  freeze_rank: int | None = None,
                  freeze_at: int | None = None,
                  freeze_s: float = DEFAULT_FREEZE_S,
+                 links=None,
                  exempt=DEFAULT_EXEMPT):
         self.seed = int(seed)
         self.drop = float(drop)
@@ -94,12 +188,23 @@ class FaultPlan:
         self.freeze_s = float(freeze_s)
         self._froze = False  # one-shot: the mesh must survive AFTER
         # the hang is broken, so later collectives run clean
+        # Per-link (host-pair) shaping: partition windows, latency,
+        # loss, bandwidth caps — applied by the transports to frames
+        # whose (src, dst) host labels match (ISSUE 6).  The window
+        # clock starts when the plan is INSTALLED (this constructor),
+        # the same origin kill_at counts messages from.
+        self.links = tuple(
+            l if isinstance(l, LinkSpec) else LinkSpec.from_spec(l)
+            for l in (links or ()))
+        self._t0 = time.monotonic()
         self.exempt = frozenset(exempt or ())
         self._lock = threading.Lock()
         self._index = 0
+        self._link_index: dict[frozenset, int] = {}
         self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
                          "duplicated": 0, "truncated": 0, "exempt": 0,
-                         "frozen": 0}
+                         "frozen": 0, "link_dropped": 0,
+                         "link_delayed": 0}
         # Timestamped record of every non-clean decision, bounded, for
         # the observability layer: the merged Chrome trace folds these
         # in as instant events so a chaos run shows WHERE the drops
@@ -140,6 +245,7 @@ class FaultPlan:
                 "kill_rank": self.kill_rank, "kill_at": self.kill_at,
                 "freeze_rank": self.freeze_rank,
                 "freeze_at": self.freeze_at, "freeze_s": self.freeze_s,
+                "links": [l.spec() for l in self.links],
                 "exempt": sorted(self.exempt)}
 
     # ------------------------------------------------------------------
@@ -246,3 +352,78 @@ class FaultPlan:
         flightrec.record("fault", actions=["freeze"], kind="collective",
                          index=collective_seq)
         return self.freeze_s
+
+    # ------------------------------------------------------------------
+    # per-link shaping (transport hooks, ISSUE 6)
+
+    def has_links(self) -> bool:
+        return bool(self.links)
+
+    def link_for(self, src: str | None, dst: str | None) -> "LinkSpec | None":
+        """The first link spec matching the (unordered) host pair, or
+        None.  Frames that stay on one host never match (a host cannot
+        partition from itself)."""
+        if not self.links or not src or not dst or src == dst:
+            return None
+        for link in self.links:
+            if link.matches(src, dst):
+                return link
+        return None
+
+    def link_blocked(self, src: str | None, dst: str | None,
+                     now: float | None = None) -> bool:
+        """Is the src<->dst link inside an active partition window?
+        Consulted by worker send paths (which sever + raise so the
+        orphan machinery engages) and by the orphan reconnect loop
+        (which must not dial through a down link — locally the connect
+        would succeed, voiding the emulation)."""
+        link = self.link_for(src, dst)
+        if link is None or not link.has_partition:
+            return False
+        elapsed = (time.monotonic() if now is None else now) - self._t0
+        return link.partition_active(elapsed)
+
+    def link_transmit(self, src: str | None, dst: str | None,
+                      frame: bytes, send: Callable[[bytes], None], *,
+                      kind: str | None = None) -> None:
+        """Shape one frame crossing src<->dst, then continue through
+        the per-frame faults (:meth:`transmit`).  Partition and loss
+        drop the frame silently (the coordinator path — workers check
+        :meth:`link_blocked` first and sever instead); latency and the
+        bandwidth cap sleep on the caller thread, which is exactly
+        where a slow link's backpressure lands."""
+        link = self.link_for(src, dst)
+        if link is None:
+            self.transmit(frame, send, kind=kind)
+            return
+        if link.has_partition and link.partition_active(
+                time.monotonic() - self._t0):
+            with self._lock:
+                self.counters["link_dropped"] += 1
+                if len(self._events) < self.MAX_EVENTS:
+                    self._events.append(
+                        {"ts": time.time(), "index": -1,
+                         "actions": ["link_partition"], "kind": kind,
+                         "link": sorted({src, dst})})
+            return
+        if link.loss:
+            pair = frozenset((src, dst))
+            with self._lock:
+                idx = self._link_index.get(pair, 0)
+                self._link_index[pair] = idx + 1
+            rng = random.Random((self.seed * 1_000_003 + idx)
+                                ^ link._loss_salt)
+            if rng.random() < link.loss:
+                with self._lock:
+                    self.counters["link_dropped"] += 1
+                flightrec.record("fault", actions=["link_loss"],
+                                 kind=kind, index=idx)
+                return
+        wait = link.latency_s
+        if link.bw_bytes_s:
+            wait += len(frame) / link.bw_bytes_s
+        if wait > 0:
+            with self._lock:
+                self.counters["link_delayed"] += 1
+            time.sleep(wait)
+        self.transmit(frame, send, kind=kind)

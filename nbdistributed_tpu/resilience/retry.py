@@ -17,6 +17,20 @@ flaky-DCN deployments) or fleet-wide via env::
 
     NBD_RETRY_TIMEOUT_S=5       # per-attempt wait; presence enables
     NBD_RETRY_ATTEMPTS=4        # total deliveries (1 initial + 3 re)
+
+**Per-message-class budgets** (ISSUE 6): on a multi-host link, one
+timeout cannot fit both a 200-byte control frame and a multi-GB
+``%dist_push`` — a budget tight enough to catch a lost control frame
+trips spurious redeliveries on every big transfer crossing a slow
+link.  Message types therefore map to classes (``control`` vs
+``bulk``), each overridable independently::
+
+    NBD_RETRY_CLASS_BULK_TIMEOUT_S=60   # long-haul budget for
+    NBD_RETRY_CLASS_BULK_ATTEMPTS=2     # push/pull/checkpoint frames
+    NBD_RETRY_CLASS_CONTROL_TIMEOUT_S=5 # tight budget for the rest
+
+Unset classes inherit the base ``NBD_RETRY_*`` policy, so existing
+single-knob deployments behave byte-identically.
 """
 
 from __future__ import annotations
@@ -24,6 +38,17 @@ from __future__ import annotations
 import os
 import random
 from dataclasses import dataclass
+
+# Message types whose payloads scale with user data (array pulls,
+# pytree pushes, checkpoint IO): the "bulk" class.  Everything else —
+# execute dispatch, status probes, hello/mailbox, chaos control — is
+# "control": small frames whose loss should be detected fast.
+BULK_TYPES = frozenset({"get_var", "set_var", "checkpoint"})
+RETRY_CLASSES = ("control", "bulk")
+
+
+def class_of(msg_type: str) -> str:
+    return "bulk" if msg_type in BULK_TYPES else "control"
 
 
 @dataclass(frozen=True)
@@ -73,3 +98,42 @@ class RetryPolicy:
             return None
         return cls(attempts=max(1, int(env.get("NBD_RETRY_ATTEMPTS", "4"))),
                    attempt_timeout_s=float(raw))
+
+    @classmethod
+    def classes_from_env(cls, base: "RetryPolicy",
+                         env=None) -> dict[str, "RetryPolicy"]:
+        """Per-class overrides of ``base`` from ``NBD_RETRY_CLASS_*``.
+        Only classes with at least one knob set appear in the result;
+        a class with only ``_ATTEMPTS`` set inherits the base timeout
+        (and stays disabled if the base has none).  Malformed values
+        are ignored knob-wise — a typo'd number must not silently turn
+        retries off for a whole class."""
+        env = os.environ if env is None else env
+        out: dict[str, RetryPolicy] = {}
+        for klass in RETRY_CLASSES:
+            prefix = f"NBD_RETRY_CLASS_{klass.upper()}_"
+            timeout = base.attempt_timeout_s
+            attempts = base.attempts
+            seen = False
+            raw = env.get(prefix + "TIMEOUT_S")
+            if raw:
+                try:
+                    timeout = float(raw)
+                    seen = True
+                except ValueError:
+                    pass
+            raw = env.get(prefix + "ATTEMPTS")
+            if raw:
+                try:
+                    attempts = max(1, int(raw))
+                    seen = True
+                except ValueError:
+                    pass
+            if seen:
+                out[klass] = cls(
+                    attempts=attempts, attempt_timeout_s=timeout,
+                    backoff_base_s=base.backoff_base_s,
+                    backoff_factor=base.backoff_factor,
+                    backoff_max_s=base.backoff_max_s,
+                    jitter=base.jitter)
+        return out

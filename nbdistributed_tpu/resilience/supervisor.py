@@ -32,11 +32,17 @@ from collections import deque
 from dataclasses import dataclass
 
 from ..observability import flightrec
+from .partition import PartitionSentry
 
 ALIVE = "alive"
 DEGRADED = "degraded"
 DEAD = "dead"
 HEALING = "healing"
+# Host-level partition suspicion (ISSUE 6): every rank on one host
+# went silent/dead together while the rest of the fleet is fine.  NOT
+# grounds for healing until the partition grace expires — the far side
+# is (probably) alive, orphaned, and holding state.
+SUSPECT = "suspect-partition"
 
 
 @dataclass(frozen=True)
@@ -50,6 +56,12 @@ class SupervisorPolicy:
     # newly-dead ranks BEFORE healing replaces the world — the heal is
     # what destroys the evidence a human would want afterwards.
     postmortem: bool = True
+    # Partition grace (multi-host worlds): how long whole-host silence
+    # is ridden out as a SUSPECTED partition before the host is
+    # declared lost and healing proceeds.  None = NBD_PARTITION_GRACE_S
+    # (default 30 s).  Must stay below the workers' orphan TTL, or a
+    # healed link finds its orphans already self-terminated.
+    partition_grace_s: float | None = None
 
 
 class Supervisor:
@@ -80,6 +92,7 @@ class Supervisor:
         self.last_postmortem: dict | None = None
         self._postmortem_pending: set[int] = set()
         self._state: dict[int, str] = {}
+        self._sentry: PartitionSentry | None = None
         self._restarts: deque[float] = deque()
         self._comm = None
         self._pm = None
@@ -110,12 +123,22 @@ class Supervisor:
 
     def attach(self, comm, pm) -> None:
         """Bind to a live cluster and start (or resume, after a
-        ``stop()``) supervising."""
+        ``stop()``) supervising.  Multi-host worlds (the process
+        manager carries a rank→host map with ≥2 hosts) get a
+        :class:`~.partition.PartitionSentry`: whole-host silence is a
+        suspected partition, not N deaths."""
+        hosts = dict(getattr(pm, "hosts", None) or {})
         with self._lock:
             self._hook_pm(pm)
             self._comm, self._pm = comm, pm
             self._state = {r: ALIVE for r in range(comm.num_workers)}
             self._pending_heal = False
+            self._sentry = PartitionSentry(
+                hosts, local_host=getattr(comm, "local_host", "local"),
+                grace_s=self.policy.partition_grace_s,
+                source="supervisor", clock=self._clock)
+            if not self._sentry.active:
+                self._sentry = None
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._wake.clear()
@@ -181,8 +204,9 @@ class Supervisor:
                 return
             try:
                 self._scan_staleness()
+                self._scan_partitions()
                 self._capture_postmortems()
-                if self._pending_heal and self.policy.auto_heal:
+                if self.policy.auto_heal and self._heal_needed():
                     self._heal_once()
             except Exception:
                 # The supervision loop must survive its own bugs —
@@ -215,6 +239,102 @@ class Supervisor:
                 elif age <= self.policy.degraded_after_s \
                         and st == DEGRADED:
                     self._transition(rank, ALIVE, "heartbeat resumed")
+
+    # ------------------------------------------------------------------
+    # partition suspicion (multi-host worlds)
+
+    def _scan_partitions(self) -> None:
+        """Feed the sentry one liveness snapshot and apply its
+        transitions: whole-host silence → SUSPECT (heal deferred),
+        recovery → ALIVE, grace expiry → DEAD + heal."""
+        sentry = self._sentry
+        if sentry is None:
+            return
+        with self._lock:
+            comm = self._comm
+            states = dict(self._state)
+        if comm is None:
+            return
+        now = self._clock()
+        silent: set[int] = set()
+        fresh: set[int] = set()
+        for r in range(comm.num_workers):
+            ping = comm.last_ping(r)
+            seen = comm.last_seen(r)
+            ts = [t for t in ((ping[0] if ping else None), seen)
+                  if t is not None]
+            if not ts:
+                continue  # never heard from; bring-up owns it
+            if now - max(ts) <= self.policy.degraded_after_s:
+                fresh.add(r)
+            else:
+                silent.add(r)
+        dead = {r for r, s in states.items() if s == DEAD}
+        events = sentry.observe(silent, dead, fresh, now=now)
+        if not events:
+            return
+        with self._lock:
+            for ev in events:
+                if ev["event"] == "suspected":
+                    for r in ev["ranks"]:
+                        # Known process-deaths keep their DEAD state
+                        # (that fact survives the suspicion); the heal
+                        # deferral works off the sentry's host state,
+                        # not the rank label.
+                        if self._state.get(r) != DEAD:
+                            self._transition(
+                                r, SUSPECT,
+                                f"host {ev['host']}: every rank silent "
+                                f"at once — suspected partition; heal "
+                                f"deferred {sentry.grace_s:.0f}s")
+                elif ev["event"] == "healed":
+                    for r in ev["ranks"]:
+                        st = self._state.get(r)
+                        # A DEAD rank only resurrects if IT was heard
+                        # from: one sibling's ping proves the LINK is
+                        # back, not that a rank whose process exited
+                        # mid-partition lives — resurrecting it here
+                        # would clear the pending heal and leave the
+                        # fleet permanently short.
+                        if st in (SUSPECT, DEGRADED) \
+                                or (st == DEAD and r in fresh):
+                            self._transition(
+                                r, ALIVE,
+                                f"host {ev['host']}: partition healed "
+                                f"— rank heard from again")
+                elif ev["event"] == "expired":
+                    for r in ev["ranks"]:
+                        self._transition(
+                            r, DEAD,
+                            f"host {ev['host']}: partition grace "
+                            f"expired — treating host as lost")
+                        self._postmortem_pending.add(r)
+                    self._pending_heal = True
+        self._wake.set()
+
+    def _heal_needed(self) -> bool:
+        """Is a heal both pending and currently allowed?  Deferred
+        while every unhealthy rank sits behind a link the sentry still
+        suspects (the far side is riding its orphan grace); cleared
+        entirely when the world recovered on its own (a healed
+        partition must not trigger a respawn of a healthy fleet)."""
+        with self._lock:
+            if not self._pending_heal:
+                return False
+            dead = [r for r, s in self._state.items() if s == DEAD]
+            unhealthy = {r for r, s in self._state.items()
+                         if s in (DEAD, SUSPECT)}
+            if not dead and not unhealthy:
+                self._pending_heal = False
+                return False
+            if not dead:
+                # Only SUSPECT ranks remain: the sentry owns them.
+                return False
+        sentry = self._sentry
+        if sentry is not None and unhealthy and \
+                unhealthy <= sentry.suspected_ranks():
+            return False
+        return True
 
     # ------------------------------------------------------------------
     # postmortems
@@ -327,6 +447,7 @@ class Supervisor:
                     and all(s == ALIVE for s in self._state.values()))
 
     def status(self) -> dict:
+        sentry = self._sentry
         with self._lock:
             return {"states": dict(self._state),
                     "restarts_used": len(self._restarts),
@@ -335,6 +456,8 @@ class Supervisor:
                     "heals_done": self.heals_done,
                     "heals_failed": self.heals_failed,
                     "transitions": self.transitions,
+                    "suspected_hosts": (sentry.suspected_hosts()
+                                        if sentry is not None else {}),
                     "last_postmortem": (self.last_postmortem or {})
                     .get("dir"),
                     "events": list(self.events)}
@@ -342,7 +465,8 @@ class Supervisor:
     def describe(self) -> str:
         """Human-readable block for ``%dist_status``."""
         st = self.status()
-        icon = {ALIVE: "●", DEGRADED: "◐", DEAD: "✖", HEALING: "🩹"}
+        icon = {ALIVE: "●", DEGRADED: "◐", DEAD: "✖", HEALING: "🩹",
+                SUSPECT: "⚡"}
         ranks = " ".join(f"{icon.get(s, '?')}{r}:{s}"
                          for r, s in sorted(st["states"].items()))
         lines = [f"🛡  supervisor: {ranks or '(no ranks)'} · "
@@ -351,6 +475,10 @@ class Supervisor:
                  + (f", {st['heals_failed']} failed"
                     if st["heals_failed"] else "")
                  + ("" if st["auto_heal"] else " · auto-heal OFF")]
+        if self._sentry is not None:
+            note = self._sentry.describe()
+            if note:
+                lines.append(f"   {note}")
         for ev in list(st["events"])[-5:]:
             rank = "world" if ev["rank"] is None else f"rank {ev['rank']}"
             lines.append(f"   {time.strftime('%H:%M:%S', time.localtime(ev['ts']))} "

@@ -79,6 +79,7 @@ from dataclasses import dataclass
 
 from ..observability import flightrec
 from ..observability import metrics as obs_metrics
+from .partition import PartitionSentry
 
 LADDER_STEPS = ("warn", "dump", "interrupt", "heal")
 
@@ -390,6 +391,7 @@ class HangWatchdog:
         self.escalations: dict[str, int] = {}
         self.last_verdicts: list[dict] = []
         self._hangs: dict = {}  # cell -> {"step","next_ts","first_ts","verdict"}
+        self._sentry: PartitionSentry | None = None
         self._comm = None
         self._pm = None
         self._lock = threading.RLock()
@@ -400,11 +402,21 @@ class HangWatchdog:
     # lifecycle
 
     def attach(self, comm, pm=None) -> None:
+        hosts = dict(getattr(pm, "hosts", None) or {})
         with self._lock:
             self._comm, self._pm = comm, pm
             self._hangs.clear()
             self.detector.reset()
             self.last_verdicts = []
+            # Host-level failure domains (ISSUE 6): whole-host
+            # heartbeat loss is a suspected partition — those ranks'
+            # silence is the supervisor's problem (and their apparent
+            # lag frozen data), never grounds for a hang verdict.
+            self._sentry = PartitionSentry(
+                hosts, local_host=getattr(comm, "local_host", "local"),
+                source="watchdog", clock=self._clock)
+            if not self._sentry.active:
+                self._sentry = None
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(target=self._loop,
@@ -501,11 +513,34 @@ class HangWatchdog:
         if comm is None:
             return []
         views = self.rank_views(now)
+        suspected: set = set()
+        sentry = self._sentry
+        if sentry is not None:
+            silent: set = set()
+            fresh: set = set()
+            for r in range(comm.num_workers):
+                ping = comm.last_ping(r)
+                if ping is None:
+                    continue
+                (fresh if now - ping[0] <= self.policy.hb_stale_s
+                 else silent).add(r)
+            for ev in sentry.observe(silent, set(), fresh, now=now):
+                self._event("partition",
+                            f"host {ev['host']}: {ev['event']} "
+                            f"(ranks {ev['ranks']})")
+            suspected = sentry.suspected_ranks()
         try:
             pending = comm.pending_snapshot()
         except Exception:
             pending = {}
         verdicts = self.detector.observe(now, views, pending)
+        if suspected:
+            # A suspected-partition host's ranks are unreachable, not
+            # hung: their apparent lag is frozen data.  Verdicts that
+            # blame only them are suppressed (the supervisor's
+            # partition machinery owns that failure domain).
+            verdicts = [v for v in verdicts
+                        if not set(v["ranks"]) <= suspected]
         reg = obs_metrics.registry()
         due_steps: list[tuple] = []
         with self._lock:
@@ -618,9 +653,12 @@ class HangWatchdog:
     # reporting
 
     def status(self) -> dict:
+        sentry = self._sentry
         with self._lock:
             return {
                 "policy": self.policy.describe(),
+                "suspected_hosts": (sentry.suspected_hosts()
+                                    if sentry is not None else {}),
                 "active": {str(c): {"kind": st["verdict"]["kind"],
                                     "ranks": st["verdict"]["ranks"],
                                     "steps_taken": st["step"],
@@ -726,6 +764,32 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
         f"time    : {time.strftime('%Y-%m-%dT%H:%M:%S')}",
         f"world   : {getattr(comm, 'num_workers', '?')} workers",
         f"policy  : {policy.describe()}",
+    ]
+    # Multi-host worlds: per-host link health (RTT from the clock
+    # estimator's min-RTT samples, heartbeat ages, redeliveries as the
+    # loss proxy) plus any partition suspicion — "which link is sick"
+    # before "which rank is stuck".
+    hosts_map = dict(getattr(pm, "hosts", None) or {})
+    if len(set(hosts_map.values()) | {getattr(comm, "local_host",
+                                              "local")}) > 1:
+        try:
+            ls = comm.link_stats()
+        except Exception:
+            ls = None
+        if ls:
+            from .partition import format_link_suffix
+            lines.append("")
+            lines.append("hosts / links (rtt = min clock-sample RTT; "
+                         "retries ≈ frames a link ate):")
+            for h, hs in sorted(ls["hosts"].items()):
+                lines.append(f"   {h:<14} ranks {hs['ranks']} · "
+                             f"{format_link_suffix(hs)}")
+        sentry = getattr(wd, "_sentry", None) if wd is not None else None
+        if sentry is not None:
+            note = sentry.describe()
+            if note:
+                lines.append(f"   {note}")
+    lines += [
         "",
         f"{'rank':<5}{'busy':<22}{'hb-age':<8}{'col#':<6}"
         f"{'op':<22}{'in':<4}{'col-age':<9}{'cell-ops':<8}",

@@ -91,6 +91,18 @@ class DistributedWorker:
         # parks results whose reply had no coordinator to land on.
         self._session_token = os.environ.get("NBD_SESSION_TOKEN") or None
         self._epoch = int(os.environ.get("NBD_SESSION_EPOCH", "0") or 0)
+        # Host labels (multi-host worlds, ISSUE 6): which host this
+        # worker runs on and which host the coordinator runs on — the
+        # link-fault layer shapes frames by this pair, and the orphan
+        # reconnect loop refuses to dial through a partitioned link.
+        self._host_label = os.environ.get("NBD_HOST") or "local"
+        self._coord_label = os.environ.get("NBD_COORD_HOST") or "local"
+        # Manifest mirror (partition tolerance): multi-host worlds
+        # share no run-dir filesystem, so the coordinator mirrors its
+        # session manifest to every worker in the hello exchange — the
+        # reconnect loop's endpoint discovery works from this copy when
+        # no shared NBD_RUN_DIR manifest exists.
+        self._manifest_mirror: dict | None = None
         try:
             self._orphan_ttl = float(
                 os.environ.get("NBD_ORPHAN_TTL_S", DEFAULT_ORPHAN_TTL_S))
@@ -209,6 +221,10 @@ class DistributedWorker:
             coordinator_host, control_port, rank=rank,
             auth_token=self._auth_token)
         self.channel.fault_plan = fault_plan
+        self.channel.local_host = self._host_label
+        self.channel.peer_host = self._coord_label
+        self._flight.record("transport_connect", host=coordinator_host,
+                            port=control_port)
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            name="nbd-heartbeat", daemon=True)
         self._hb_thread.start()
@@ -782,6 +798,17 @@ class DistributedWorker:
                 data={"error": f"stale epoch {epoch} < {self._epoch}"},
                 rank=self.rank)
         prev, self._epoch = self._epoch, epoch
+        # Multi-host session bootstrap: workers spawned through an
+        # agent/ssh plan carry no NBD_SESSION_TOKEN env — the first
+        # hello supplies it (later hellos are then token-verified), and
+        # mirrors the session manifest so the orphan reconnect loop can
+        # discover a replacement endpoint WITHOUT the shared run-dir
+        # filesystem durable sessions assume on one host.
+        if self._session_token is None and data.get("token"):
+            self._session_token = str(data["token"])
+        mirror = data.get("manifest")
+        if isinstance(mirror, dict):
+            self._manifest_mirror = mirror
         self._flight.record("hello", epoch=epoch, prev_epoch=prev)
         return msg.reply(
             data={"status": "ok", "rank": self.rank, "pid": os.getpid(),
@@ -841,11 +868,26 @@ class DistributedWorker:
         except OSError:
             pass
 
+    def _manifest_dial_host(self, ctl: dict) -> str:
+        """The address this worker should dial from a manifest control
+        block.  Manifests written on the coordinator's host may record
+        a loopback dial address (fine for same-host workers); a worker
+        that originally dialed a non-loopback address must keep doing
+        so — its loopback is a different machine."""
+        host = ctl.get("host") or self._coordinator_host
+        if host in ("127.0.0.1", "localhost") \
+                and self._coordinator_host not in ("127.0.0.1",
+                                                   "localhost"):
+            return self._coordinator_host
+        return host
+
     def _coordinator_endpoint(self) -> tuple[str, int, bool]:
         """Where the reconnect loop should dial: the session manifest's
         endpoint when one exists for OUR session (a reattaching
         coordinator that couldn't re-bind the old port publishes its
-        replacement there), else the spawn-time endpoint.
+        replacement there), else the hello-mirrored manifest (multi-
+        host worlds share no run-dir filesystem), else the spawn-time
+        endpoint.
 
         The third element is ``expect_hello``: True when the manifest
         epoch is AHEAD of ours — a new coordinator has claimed the
@@ -855,21 +897,27 @@ class DistributedWorker:
         is the ORIGINAL coordinator (transient reconnect) and may
         legitimately be idle, so no traffic is demanded of it."""
         d = os.environ.get("NBD_RUN_DIR")
+        candidates = []
         if d:
             try:
                 from ..resilience.session import read_manifest
-                m = read_manifest(d)
+                candidates.append(read_manifest(d))
             except Exception:
-                m = None
-            if m is not None and (not self._session_token
-                                  or m.get("token") == self._session_token):
-                ctl = m.get("control") or {}
-                try:
-                    return (ctl.get("host") or self._coordinator_host,
-                            int(ctl.get("port") or self._control_port),
-                            int(m.get("epoch") or 0) > self._epoch)
-                except (TypeError, ValueError):
-                    pass
+                pass
+        candidates.append(self._manifest_mirror)
+        for m in candidates:
+            if m is None or not isinstance(m, dict):
+                continue
+            if self._session_token \
+                    and m.get("token") != self._session_token:
+                continue
+            ctl = m.get("control") or {}
+            try:
+                return (self._manifest_dial_host(ctl),
+                        int(ctl.get("port") or self._control_port),
+                        int(m.get("epoch") or 0) > self._epoch)
+            except (TypeError, ValueError):
+                continue
         return self._coordinator_host, self._control_port, False
 
     def _enter_orphan_and_wait(self) -> bool:
@@ -900,6 +948,18 @@ class DistributedWorker:
                   f"awaiting reattach for {ttl:.0f}s")
         deadline = time.monotonic() + ttl
         while not self._shutdown.is_set():
+            plan = self._fault_plan
+            if (plan is not None and plan.has_links()
+                    and plan.link_blocked(self._host_label,
+                                          self._coord_label)):
+                # The injected partition is still open: locally the
+                # dial would succeed (there is no real cable to cut),
+                # which would void the emulation — wait it out, still
+                # inside THIS episode's TTL.
+                if time.monotonic() >= deadline:
+                    break
+                self._shutdown.wait(ORPHAN_RECONNECT_POLL_S)
+                continue
             host, port, expect_hello = self._coordinator_endpoint()
             try:
                 ch = WorkerChannel(host, port, rank=self.rank,
@@ -926,6 +986,8 @@ class DistributedWorker:
                     ch = None
             if ch is not None:
                 ch.fault_plan = self._fault_plan
+                ch.local_host = self._host_label
+                ch.peer_host = self._coord_label
                 old, self.channel = self.channel, ch
                 try:
                     old.close()
@@ -1001,10 +1063,16 @@ class DistributedWorker:
                 # (the new coordinator's hello) is served first.
                 msg = self._resume_msg or self.channel.recv(gate=gate)
                 self._resume_msg = None
-            except TransportError:
-                # Coordinator gone.  Durable sessions: enter orphan
-                # grace and wait for a fresh coordinator to reattach;
-                # only a TTL expiry (or TTL 0) ends this process.
+            except TransportError as e:
+                # Coordinator gone.  Flight-record the EOF (with the
+                # error text: a postmortem distinguishes "link
+                # flapped" — eof then reattach — from "peer died":
+                # eof then orphan expiry), then enter orphan grace and
+                # wait for a fresh coordinator; only a TTL expiry (or
+                # TTL 0) ends this process.
+                self._flight.record("transport_eof",
+                                    error=str(e)[:120],
+                                    host=self._coordinator_host)
                 if self._enter_orphan_and_wait():
                     continue
                 break
@@ -1078,6 +1146,13 @@ class DistributedWorker:
                                             "attempt": msg.attempt})
                 self._flight.record("dedup_hit", msg_id=msg.msg_id,
                                     attempt=msg.attempt)
+                # Re-stamp with the CURRENT epoch: a reply cached under
+                # a previous tenancy but redelivered to the coordinator
+                # that legitimately adopted this worker is canonical,
+                # not stale — only a worker still LIVING in the old
+                # epoch sends old stamps.
+                if self._epoch:
+                    cached.epoch = self._epoch
                 try:
                     self.channel.send(cached)
                 except Exception:
@@ -1139,6 +1214,13 @@ class DistributedWorker:
             finally:
                 self._busy = None
                 tr.end(span)
+            # Epoch-stamp the reply (worker→coordinator direction): a
+            # coordinator that healed replacements while we were
+            # partitioned away must reject THIS tenancy's results
+            # rather than double-apply them (unstamped when epoch 0 —
+            # pre-epoch sessions keep their wire format).
+            if self._epoch and reply.epoch is None:
+                reply.epoch = self._epoch
             self._replay.put(msg, reply)
             try:
                 self.channel.send(reply)  # gate closed: frame is atomic

@@ -1,0 +1,63 @@
+"""Far-side helper for the netns scenario: runs inside its OWN network
+namespace (spawned as ``unshare -n python _netns_far.py <workdir>``).
+
+Protocol with the orchestrator (_netns_world.py), via files in the
+shared workdir:
+
+1. write ``far.pid`` (the orchestrator moves the veth peer into our
+   namespace by this pid);
+2. wait for ``vethB`` to appear, bring it + lo up with 10.99.0.2/24;
+3. start a :class:`HostAgent` on 10.99.0.2 and write ``far.ready``;
+4. serve until ``stop`` appears.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from nbdistributed_tpu.manager.hostagent import HostAgent  # noqa: E402
+
+FAR_ADDR = "10.99.0.2"
+AGENT_PORT = 7411
+TOKEN = "netns-secret"
+
+
+def sh(*cmd) -> int:
+    return subprocess.run(list(cmd), capture_output=True).returncode
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    with open(os.path.join(workdir, "far.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    deadline = time.time() + 60
+    while sh("ip", "link", "show", "vethB") != 0:
+        if time.time() > deadline:
+            print("far: vethB never arrived", flush=True)
+            return 1
+        time.sleep(0.1)
+    assert sh("ip", "link", "set", "lo", "up") == 0
+    assert sh("ip", "addr", "add", f"{FAR_ADDR}/24", "dev", "vethB") == 0
+    assert sh("ip", "link", "set", "vethB", "up") == 0
+
+    run_dir = os.path.join(workdir, "run_far")
+    os.makedirs(run_dir, exist_ok=True)
+    os.environ["NBD_RUN_DIR"] = run_dir
+    agent = HostAgent(FAR_ADDR, AGENT_PORT, auth_token=TOKEN,
+                      host_label="hostB", run_dir=run_dir)
+    with open(os.path.join(workdir, "far.ready"), "w") as f:
+        f.write(f"{agent.host}:{agent.port}")
+    stop = os.path.join(workdir, "stop")
+    try:
+        while not os.path.exists(stop):
+            time.sleep(0.2)
+    finally:
+        agent.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""Orchestrator for the two-network-namespace scenario — the REAL-link
+variant of the multihost acceptance test.
+
+Runs under ``unshare -Urn`` (root inside a fresh user+net namespace):
+
+1. builds a veth pair and moves one end into a SECOND net namespace
+   (the "far host") running :mod:`_netns_far`'s HostAgent — the link
+   between coordinator and far host is now an actual veth device, not
+   loopback;
+2. launches a 2-rank world: rank 0 a direct child, rank 1 spawned via
+   the agent across the veth (authenticated NBDA preamble over
+   10.99.0.0/24);
+3. runs a cell on both ranks and checks streamed stdout crossed the
+   link;
+4. **downs the veth** — a real network partition, no fault plan — and
+   asserts the supervisor's partition sentry flags hostB as SUSPECTED
+   without healing;
+5. **ups the veth** and asserts suspicion clears, both ranks serve
+   again, and zero heals happened end to end.
+
+Writes ``result.json`` into the workdir; exit code 0 = all held.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from nbdistributed_tpu.manager import topology  # noqa: E402
+from nbdistributed_tpu.manager.hostagent import (AgentClient,  # noqa: E402
+                                                 _AgentWorker,
+                                                 _AgentWorkerIO)
+from nbdistributed_tpu.manager.multihost import (HostSpec,  # noqa: E402
+                                                 make_launch_plan)
+from nbdistributed_tpu.manager.process_manager import (  # noqa: E402
+    ProcessManager, wait_until_ready)
+from nbdistributed_tpu.messaging import CommunicationManager  # noqa: E402
+from nbdistributed_tpu.resilience.supervisor import (  # noqa: E402
+    Supervisor, SupervisorPolicy)
+
+NEAR_ADDR = "10.99.0.1"
+FAR_ADDR = "10.99.0.2"
+AGENT_PORT = 7411
+TOKEN = "netns-secret"
+
+
+def sh(*cmd, check=True) -> int:
+    r = subprocess.run(list(cmd), capture_output=True)
+    if check and r.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)}: rc {r.returncode}: "
+                           f"{r.stderr.decode(errors='replace')}")
+    return r.returncode
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    result = {"ok": False}
+    far = None
+    comm = pm = sup = client = None
+    try:
+        sh("ip", "link", "set", "lo", "up")
+        far_env = dict(os.environ)
+        far_env.pop("NBD_RUN_DIR", None)
+        far = subprocess.Popen(
+            ["unshare", "-n", sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_netns_far.py"), workdir],
+            env=far_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        pid_file = os.path.join(workdir, "far.pid")
+        wait_for(lambda: os.path.exists(pid_file), 30, "far pid")
+        far_pid = open(pid_file).read().strip()
+        sh("ip", "link", "add", "vethA", "type", "veth", "peer",
+           "name", "vethB")
+        sh("ip", "link", "set", "vethB", "netns", far_pid)
+        sh("ip", "addr", "add", f"{NEAR_ADDR}/24", "dev", "vethA")
+        sh("ip", "link", "set", "vethA", "up")
+        wait_for(lambda: os.path.exists(
+            os.path.join(workdir, "far.ready")), 60, "far agent")
+
+        run_near = os.path.join(workdir, "run_near")
+        os.makedirs(run_near, exist_ok=True)
+        os.environ["NBD_RUN_DIR"] = run_near
+
+        comm = CommunicationManager(num_workers=2, host=NEAR_ADDR,
+                                    auth_token=TOKEN,
+                                    session_token="ns-tok",
+                                    session_epoch=1)
+        # Control-plane-only world (dist_port None): the data plane is
+        # not under test here — the control link crossing the veth is.
+        plan = make_launch_plan(
+            [HostSpec("local"), HostSpec("hostB")],
+            coordinator_host=NEAR_ADDR, control_port=comm.port,
+            dist_port=None, backend="cpu")
+        pm = ProcessManager()
+        pm.backend = "cpu"
+        pm.world_size = 2
+        pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+        ship = {"NBD_AUTH_TOKEN": TOKEN, "NBD_SESSION_TOKEN": "ns-tok",
+                "NBD_SESSION_EPOCH": "1", "NBD_ORPHAN_TTL_S": "120"}
+        env0 = topology.cpu_worker_env()
+        env0.update(dict(plan[0].env))
+        env0.update(ship)
+        pm._spawn(0, list(plan[0].argv), env0)
+        client = AgentClient(FAR_ADDR, AGENT_PORT, auth_token=TOKEN)
+        env1 = dict(plan[1].env)
+        env1.update(ship)
+        pid = client.spawn(1, plan[1].argv, env1)
+        pm.processes[1] = _AgentWorker(client, 1, pid)
+        pm.io[1] = _AgentWorkerIO(client, 1)
+        pm.hosts = {0: "local", 1: "hostB"}
+        pm._agents["hostB"] = client
+        pm._start_monitor()
+        comm.set_host_map(pm.hosts)
+        wait_until_ready(comm, pm, 240)
+
+        streamed = []
+        comm.set_output_callback(
+            lambda r, d: streamed.append((r, d.get("text", ""))))
+        resp = comm.send_to_all(
+            "execute",
+            "print(f'veth-hello-{rank}')\nresult = rank * 10 + 7\n"
+            "result", timeout=240)
+        assert all(not m.data.get("error") for m in resp.values()), resp
+        assert resp[1].data["output"].strip().endswith("17")
+        result["streamed_far"] = any(
+            r == 1 and "veth-hello-1" in t for r, t in streamed)
+        assert result["streamed_far"], (
+            "far stdout never crossed the veth", streamed)
+
+        heals = []
+        sup = Supervisor(SupervisorPolicy(
+            poll_s=0.3, degraded_after_s=3.0, postmortem=False,
+            partition_grace_s=120.0),
+            heal=lambda: heals.append(1) or None)
+        sup.attach(comm, pm)
+
+        # --- a REAL partition: take the link down -------------------
+        sh("ip", "link", "set", "vethA", "down")
+        wait_for(lambda: "hostB" in sup.status()["suspected_hosts"],
+                 40, "partition suspicion")
+        result["suspected"] = True
+        assert not heals, "healed during a link-down partition"
+
+        # --- and heal it --------------------------------------------
+        sh("ip", "link", "set", "vethA", "up")
+        wait_for(lambda: not sup.status()["suspected_hosts"], 40,
+                 "suspicion to clear after link-up")
+        resp = comm.send_to_all("execute", "result2 = rank + 1\n"
+                                "result2", timeout=120)
+        assert all(not m.data.get("error") for m in resp.values()), resp
+        assert not heals
+        result["ok"] = True
+        return 0
+    finally:
+        result["heals"] = len(locals().get("heals") or [])
+        with open(os.path.join(workdir, "result.json"), "w") as f:
+            json.dump(result, f)
+        with open(os.path.join(workdir, "stop"), "w") as f:
+            f.write("1")
+        try:
+            if sup is not None:
+                sup.stop()
+            if pm is not None:
+                pm.shutdown()
+            if comm is not None:
+                comm.shutdown()
+        except Exception:
+            pass
+        if far is not None:
+            try:
+                far.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                far.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,500 @@
+"""Acceptance tests for ISSUE 6: real multi-host execution with a
+partition-tolerant control plane.
+
+The two-"host" world here is the **multi-address fallback** from the
+issue: the far host is a real ``nbd_agent`` daemon bound to a distinct
+non-loopback-semantics address (``127.0.1.x``), with its OWN run dir
+and no shared session manifest — frames genuinely cross the
+authenticated (``NBDA``) link, worker spawn/death-watch/stdio go
+through the agent protocol, and the shared-filesystem assumption is
+actually off (the far side's reconnect endpoint comes from the
+hello-mirrored manifest, not a file).  The network-namespace + veth
+variant lives in ``test_netns_real_link`` and skips (loudly) where the
+kernel can't move a veth peer across namespaces.
+
+Scenarios:
+
+1. ``test_partition_orphan_reattach_exactly_once`` — a 4-rank world
+   split across two hosts runs a real collective cell over the link; a
+   seeded ``FaultPlan`` link partition opens mid-cell; the far side
+   orphans and is NOT healed during the partition grace; the link
+   heals; the fleet reattaches and the in-flight result is delivered
+   exactly once.  Then a uniformly-slow link (latency, no partition)
+   produces zero supervisor heals and zero watchdog verdicts.
+2. ``test_stale_epoch_fenced_after_partition`` — the split-brain arm:
+   the coordinator adopts a newer epoch while the far side is
+   partitioned away; the stale side's results are rejected on
+   reconnect (never double-applied) until a hello hands it the new
+   tenancy.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.manager.multihost import HostSpec
+from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.observability import flightrec
+from nbdistributed_tpu.observability import metrics as obs_metrics
+from nbdistributed_tpu.resilience import session
+from nbdistributed_tpu.resilience.faults import FaultPlan
+from nbdistributed_tpu.resilience.supervisor import (Supervisor,
+                                                     SupervisorPolicy)
+from nbdistributed_tpu.resilience.watchdog import HangPolicy, HangWatchdog
+
+pytestmark = [pytest.mark.integration, pytest.mark.faults,
+              pytest.mark.multihost]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+TOKEN = "mh-it-secret"
+COORD_ADDR = "127.0.1.10"     # non-loopback-semantics dial address
+AGENT_ADDR = "127.0.1.12"
+
+
+def _addr_bindable(addr: str) -> bool:
+    import socket
+    try:
+        s = socket.socket()
+        s.bind((addr, 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _agent_env() -> dict:
+    """Scrubbed env for the agent daemon (and so for the workers it
+    spawns): no TPU platform grab, CPU backend defaults."""
+    from nbdistributed_tpu.manager import topology
+    env = topology.cpu_worker_env()
+    env.pop("NBD_RUN_DIR", None)   # the agent minds its OWN run dir
+    env.pop("NBD_FAULT_PLAN", None)
+    return env
+
+
+def _start_agent(tmp_path, label: str, addr: str):
+    """Spawn tools/nbd_agent.py, wait for its READY line, return
+    (proc, port, run_dir)."""
+    run_dir = str(tmp_path / f"run_{label}")
+    os.makedirs(run_dir, exist_ok=True)
+    secret = tmp_path / f"{label}.secret"
+    secret.write_text(TOKEN)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "nbd_agent.py"),
+         "--bind", addr, "--port", "0", "--token-file", str(secret),
+         "--host-label", label, "--run-dir", run_dir],
+        cwd=REPO_ROOT, env=_agent_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 60
+    port = None
+    while time.time() < deadline:
+        line = proc.stdout.readline().decode("utf-8", "replace")
+        if not line:
+            raise AssertionError(
+                f"agent {label} died during bring-up (rc "
+                f"{proc.poll()})")
+        if line.startswith("NBD_AGENT_READY"):
+            port = int(dict(kv.split("=", 1)
+                            for kv in line.split()[1:])["port"])
+            break
+    assert port is not None, f"agent {label} never printed READY"
+    return proc, port, run_dir
+
+
+def _bring_up(tmp_path, monkeypatch, world_local: int, world_far: int,
+              request_timeout=None):
+    """Two-host world: ``world_local`` direct children + ``world_far``
+    agent-spawned on hostB at a distinct 127.0.1.x address.  Returns
+    (comm, pm, agent_proc, far_run_dir, mirror)."""
+    run_a = str(tmp_path / "run_local")
+    os.makedirs(run_a, exist_ok=True)
+    monkeypatch.setenv("NBD_RUN_DIR", run_a)
+    flightrec.reset_for_tests()
+    agent_proc, agent_port, run_b = _start_agent(tmp_path, "hostB",
+                                                 AGENT_ADDR)
+    world = world_local + world_far
+    comm = CommunicationManager(num_workers=world, host="0.0.0.0",
+                                auth_token=TOKEN,
+                                timeout=request_timeout,
+                                session_token="sess-tok",
+                                session_epoch=1)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    try:
+        pm.start_workers_multihost(
+            [HostSpec("local", world_local),
+             HostSpec("hostB", world_far)],
+            comm.port, coordinator_host=COORD_ADDR, backend="cpu",
+            auth_token=TOKEN,
+            agents={"hostB": (AGENT_ADDR, agent_port)},
+            extra_env={"NBD_SESSION_TOKEN": "sess-tok",
+                       "NBD_SESSION_EPOCH": "1",
+                       "NBD_ORPHAN_TTL_S": "120"})
+        assert pm.hosts == {**{r: "local" for r in range(world_local)},
+                            **{r: "hostB" for r in
+                               range(world_local, world)}}
+        comm.set_host_map(pm.hosts)
+        wait_until_ready(comm, pm, 240)
+        # Manifest mirror via hello: the far host shares no run dir,
+        # so this is its ONLY endpoint-discovery channel.
+        mirror = session.make_manifest(
+            world_size=world, control_host=COORD_ADDR,
+            control_port=comm.port, bind_host="0.0.0.0",
+            token="sess-tok", epoch=1,
+            pids={r: p.pid for r, p in pm.processes.items()},
+            backend="cpu", dist_port=pm.dist_port)
+        hello = comm.send_to_all(
+            "hello", {"token": "sess-tok", "epoch": 1,
+                      "manifest": mirror}, timeout=30)
+        assert all(
+            (m.data or {}).get("status") == "ok"
+            for m in hello.values()), hello
+    except Exception:
+        pm.shutdown()
+        comm.shutdown()
+        agent_proc.terminate()
+        raise
+    return comm, pm, agent_proc, run_b, mirror
+
+
+def _teardown(comm, pm, agent_proc):
+    try:
+        pm.shutdown()
+    finally:
+        comm.shutdown()
+        agent_proc.terminate()
+        try:
+            agent_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            agent_proc.kill()
+
+
+def _counter(name: str) -> float:
+    return (obs_metrics.registry().to_json()["counters"].get(name)
+            or 0.0)
+
+
+@pytest.mark.skipif(not _addr_bindable(AGENT_ADDR),
+                    reason="cannot bind 127.0.1.x on this host")
+def test_partition_orphan_reattach_exactly_once(tmp_path, monkeypatch):
+    comm, pm, agent_proc, run_b, _mirror = _bring_up(
+        tmp_path, monkeypatch, world_local=2, world_far=2)
+    sup = None
+    wd = None
+    try:
+        world = 4
+        far = [2, 3]
+        streamed = []
+        comm.set_output_callback(
+            lambda r, d: streamed.append((r, d.get("text", ""))))
+
+        # --- phase 1: a real collective cell over the link ----------
+        resp = comm.send_to_all(
+            "execute",
+            "print(f'over-the-link-{rank}')\n"
+            "total = float(all_reduce(jnp.array([rank + 1.0]))[0])\n"
+            "total", timeout=240)
+        for r in range(world):
+            assert not resp[r].data.get("error"), resp[r].data
+            assert resp[r].data["output"].strip().endswith("10.0")
+        assert any(r in far and "over-the-link" in t
+                   for r, t in streamed), \
+            "no stdout streamed back across the agent-host link"
+
+        # --- phase 2: seeded partition mid-cell ---------------------
+        sup_heals = []
+        sup = Supervisor(SupervisorPolicy(
+            poll_s=0.3, degraded_after_s=3.0, postmortem=False,
+            partition_grace_s=90.0),
+            heal=lambda: sup_heals.append(time.time()) or None)
+        sup.attach(comm, pm)
+
+        link_spec = {"links": [{"hosts": ["local", "hostB"],
+                                "after_s": 2.0, "for_s": 12.0}]}
+        acks = comm.send_to_all("chaos", {"action": "set",
+                                          "spec": link_spec},
+                                timeout=30)
+        assert all(m.data.get("status") == "armed"
+                   for m in acks.values()), acks
+        comm.set_fault_plan(FaultPlan.from_spec(link_spec))
+
+        cell_err = []
+
+        def _dispatch():
+            try:
+                comm.send_to_all(
+                    "execute",
+                    "import time as _t\n_t.sleep(6.0)\n"
+                    "inflight = rank * 100 + 7\ninflight",
+                    timeout=60)
+            except Exception as e:
+                cell_err.append(e)
+
+        t = threading.Thread(target=_dispatch, daemon=True)
+        t.start()
+        t.join(timeout=90)
+        assert not t.is_alive(), "partitioned cell dispatch wedged"
+        # The far side severed mid-cell: the pending request aborts.
+        assert cell_err, "partition never aborted the in-flight request"
+
+        # Suspected partition, NOT N deaths: the supervisor flags the
+        # host and defers healing for the grace window.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if "hostB" in sup.status()["suspected_hosts"]:
+                break
+            time.sleep(0.2)
+        assert "hostB" in sup.status()["suspected_hosts"], \
+            sup.status()
+        assert not sup_heals, "healed during partition grace!"
+        assert _counter(
+            'nbd_partition_suspected_total{source="supervisor"}') >= 1
+
+        # --- phase 3: the link heals; the fleet reattaches ----------
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sorted(comm.connected_ranks()) == list(range(world)):
+                break
+            time.sleep(0.3)
+        assert sorted(comm.connected_ranks()) == list(range(world)), (
+            comm.connected_ranks(), pm.startup_diagnostics())
+        assert _counter("nbd_link_reconnects_total") >= len(far)
+        # Suspicion clears; still zero heals.
+        deadline = time.time() + 20
+        while time.time() < deadline and sup.status()["suspected_hosts"]:
+            time.sleep(0.2)
+        assert sup.status()["suspected_hosts"] == {}
+        assert not sup_heals
+
+        # The in-flight result was parked far-side and is delivered
+        # EXACTLY once.
+        drained = session.drain_mailboxes(comm, timeout=30)
+        far_results = {r: v for r, v in drained.items() if v}
+        assert sorted(far_results) == far, drained
+        for r in far:
+            vals = list(far_results[r].values())
+            assert len(vals) == 1
+            assert vals[0].get("output", "").strip() \
+                == str(r * 100 + 7), vals
+        again = session.drain_mailboxes(comm, timeout=30)
+        assert all(not v for v in again.values()), (
+            "second drain redelivered a claimed result", again)
+
+        # Zero double-execution anywhere: every rank ran the cell
+        # exactly once (namespace value present and correct).
+        got = comm.send_to_all("get_var", {"name": "inflight"},
+                               timeout=30)
+        for r in range(world):
+            assert got[r].data.get("value") == r * 100 + 7
+
+        # Far-side black boxes (per-host run dir!) recorded the
+        # episode: transport EOF → orphan → reattach.
+        for r in far:
+            ring = flightrec.read_latest(run_b, f"rank{r}")
+            assert ring is not None, f"no far-side ring for rank {r}"
+            kinds = [e.get("t") for e in ring["events"]]
+            assert "transport_eof" in kinds, kinds[-20:]
+            assert "orphan_entered" in kinds, kinds[-20:]
+            assert "orphan_reattached" in kinds, kinds[-20:]
+
+        # The mesh survived: a fresh collective still works.
+        resp = comm.send_to_all(
+            "execute",
+            "again = float(all_reduce(jnp.array([1.0]))[0])\nagain",
+            timeout=240)
+        for r in range(world):
+            assert resp[r].data["output"].strip() == str(world * 1.0)
+
+        # --- phase 4: uniformly-slow link ⇒ zero verdicts/heals -----
+        comm.set_fault_plan(None)
+        comm.send_to_all("chaos", {"action": "clear"}, timeout=30)
+        slow_spec = {"links": [{"hosts": ["local", "hostB"],
+                                "latency_s": 0.25}]}
+        comm.send_to_all("chaos", {"action": "set", "spec": slow_spec},
+                         timeout=30)
+        comm.set_fault_plan(FaultPlan.from_spec(slow_spec))
+        # skew_s must exceed ping cadence (2 s) + link latency, or
+        # heartbeat propagation lag alone fakes divergence (the PR 5
+        # false-positive analysis); 6 s is still far below the cell.
+        wd = HangWatchdog(HangPolicy(poll_s=0.3, skew_s=6.0,
+                                     stall_s=8.0, escalate=()))
+        wd.attach(comm, pm)
+        resp = comm.send_to_all(
+            "execute",
+            "import time as _t\n"
+            "for _i in range(3):\n"
+            "    _t.sleep(0.8)\n"
+            "    s = float(all_reduce(jnp.array([1.0]))[0])\n"
+            "s", timeout=240)
+        for r in range(world):
+            assert resp[r].data["output"].strip() == str(world * 1.0)
+        time.sleep(1.0)  # a few more watchdog polls on the idle world
+        assert wd.verdicts_total == 0, wd.status()
+        assert not sup_heals
+        assert sup.status()["suspected_hosts"] == {}
+        comm.send_to_all("chaos", {"action": "clear"}, timeout=30)
+    finally:
+        if wd is not None:
+            wd.stop()
+        if sup is not None:
+            sup.stop()
+        _teardown(comm, pm, agent_proc)
+
+
+# ----------------------------------------------------------------------
+# network-namespace + veth variant: a REAL link, a REAL link-down
+
+
+_NETNS_PROBE = """
+set -e
+ip link set lo up
+unshare -n sleep 5 &
+pid=$!
+sleep 0.3
+ip link add pvA type veth peer name pvB
+ip link set pvB netns $pid
+"""
+
+
+def _netns_support() -> tuple:
+    """Can this kernel give us two unprivileged network namespaces
+    joined by a veth pair?  Probes the EXACT operations the scenario
+    needs, so the skip reason names what's missing."""
+    for tool in ("unshare", "ip"):
+        if shutil.which(tool) is None:
+            return False, f"'{tool}' is not installed"
+    try:
+        r = subprocess.run(["unshare", "-Urn", "sh", "-c",
+                            _NETNS_PROBE],
+                           capture_output=True, timeout=30)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return False, f"unshare probe failed to run: {e}"
+    if r.returncode != 0:
+        err = (r.stderr or r.stdout or b"").decode(
+            "utf-8", "replace").strip().splitlines()
+        return False, ("kernel refused unprivileged netns+veth setup"
+                       + (f" ({err[-1]})" if err else ""))
+    return True, ""
+
+
+def test_netns_real_link(tmp_path):
+    """Frames cross an actual veth device between two network
+    namespaces; the partition is a real ``ip link set ... down``.
+    Skips — loudly, with the reason — where the kernel can't do
+    unprivileged netns+veth (e.g. 4.4-era kernels)."""
+    ok, reason = _netns_support()
+    if not ok:
+        pytest.skip(f"two-namespace veth world unavailable: {reason}")
+    env = _agent_env()
+    r = subprocess.run(
+        ["unshare", "-Urn", sys.executable,
+         os.path.join(REPO_ROOT, "tests", "integration",
+                      "_netns_world.py"), str(tmp_path)],
+        env=env, cwd=REPO_ROOT, capture_output=True, timeout=420)
+    result_path = tmp_path / "result.json"
+    result = {}
+    if result_path.exists():
+        import json
+        result = json.loads(result_path.read_text())
+    assert r.returncode == 0 and result.get("ok"), (
+        "netns world failed:\n"
+        + (r.stdout or b"").decode("utf-8", "replace")[-4000:]
+        + (r.stderr or b"").decode("utf-8", "replace")[-2000:]
+        + f"\nresult: {result}")
+    assert result.get("streamed_far"), result
+    assert result.get("suspected"), result
+    assert result.get("heals") == 0, result
+
+
+@pytest.mark.skipif(not _addr_bindable(AGENT_ADDR),
+                    reason="cannot bind 127.0.1.x on this host")
+def test_stale_epoch_fenced_after_partition(tmp_path, monkeypatch):
+    """Split-brain resolution: the coordinator adopts a newer epoch
+    while the far side is partitioned away (the 'healed replacements
+    meanwhile' tenancy change); when the link heals, the stale side's
+    results are rejected — never double-applied — until a hello hands
+    it the new epoch."""
+    comm, pm, agent_proc, run_b, _mirror = _bring_up(
+        tmp_path, monkeypatch, world_local=1, world_far=1)
+    try:
+        link_spec = {"links": [{"hosts": ["local", "hostB"],
+                                "after_s": 1.0, "for_s": 10.0}]}
+        acks = comm.send_to_all("chaos", {"action": "set",
+                                          "spec": link_spec},
+                                timeout=30)
+        assert all(m.data.get("status") == "armed"
+                   for m in acks.values())
+        comm.set_fault_plan(FaultPlan.from_spec(link_spec))
+
+        cell_err = []
+
+        def _dispatch():
+            try:
+                comm.send_to_all(
+                    "execute",
+                    "import time as _t\n_t.sleep(4.0)\n"
+                    "split = rank + 500\nsplit", timeout=60)
+            except Exception as e:
+                cell_err.append(e)
+
+        t = threading.Thread(target=_dispatch, daemon=True)
+        t.start()
+        t.join(timeout=90)
+        assert cell_err, "partition never aborted the request"
+
+        # Tenancy change while the far side is unreachable (what a
+        # %dist_attach / heal-with-replacements does to the epoch).
+        comm.session_epoch = 2
+        comm.set_fault_plan(None)  # coordinator side: link is "up" for
+        # the new tenancy; the far worker's own plan still blocks it
+        # until the window closes.
+        hello0 = comm.send_to_rank(
+            0, "hello", {"token": "sess-tok", "epoch": 2}, timeout=30)
+        assert hello0.data.get("status") == "ok"
+
+        # The stale side reconnects once ITS window closes.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sorted(comm.connected_ranks()) == [0, 1]:
+                break
+            time.sleep(0.3)
+        assert sorted(comm.connected_ranks()) == [0, 1]
+
+        # Its replies are stamped with the superseded epoch 1 and the
+        # coordinator refuses to apply them: the request times out
+        # rather than double-applying a stale result.
+        rejected_before = _counter("nbd_epoch_rejected_results")
+        with pytest.raises(TimeoutError):
+            comm.send_to_rank(1, "get_status", timeout=6)
+        assert _counter("nbd_epoch_rejected_results") > rejected_before
+
+        # A hello hands rank 1 the new tenancy; it serves again, and
+        # the parked in-flight result is claimable exactly once.
+        hello1 = comm.send_to_rank(
+            1, "hello", {"token": "sess-tok", "epoch": 2}, timeout=30)
+        assert hello1.data.get("status") == "ok"
+        assert hello1.data.get("parked"), "in-flight result not parked"
+        st = comm.send_to_rank(1, "get_status", timeout=30)
+        assert st.data.get("session_epoch") == 2
+        drained = session.drain_mailboxes(comm, timeout=30)
+        vals = list((drained.get(1) or {}).values())
+        assert len(vals) == 1 \
+            and vals[0].get("output", "").strip() == "501", drained
+        again = session.drain_mailboxes(comm, timeout=30)
+        assert not again.get(1), again
+        # Exactly-once: the cell ran once on the stale side, never
+        # re-executed through all of this.
+        got = comm.send_to_rank(1, "get_var", {"name": "split"},
+                                timeout=30)
+        assert got.data.get("value") == 501
+    finally:
+        _teardown(comm, pm, agent_proc)

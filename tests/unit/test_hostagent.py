@@ -1,0 +1,137 @@
+"""Host-agent protocol unit tests: spawn, push-based death-watch,
+signals, stdio tails, and the partition-safe link-loss semantics —
+all in-process against a real HostAgent on loopback (the full
+multi-address / multi-host path lives in
+tests/integration/test_multihost_partition.py)."""
+
+import signal
+import sys
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager.hostagent import (AgentClient, HostAgent,
+                                                 _AgentWorker,
+                                                 _AgentWorkerIO)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture
+def agent(tmp_path):
+    a = HostAgent("127.0.0.1", 0, auth_token="agent-secret",
+                  host_label="hostX", run_dir=str(tmp_path / "run"))
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def client(agent):
+    c = AgentClient("127.0.0.1", agent.port, auth_token="agent-secret")
+    yield c
+    c.close()
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_ping_reports_identity(agent, client):
+    resp = client.request("ping", {})
+    assert resp.data["host"] == "hostX"
+    assert resp.data["run_dir"] == agent.run_dir
+
+
+def test_spawn_exit_pushed_and_tail(agent, client):
+    pid = client.spawn(0, [sys.executable, "-c",
+                           "print('agent-child-out'); "
+                           "import time; time.sleep(0.2)"], {})
+    w = _AgentWorker(client, 0, pid)
+    assert w.pid == pid
+    # The exit arrives by PUSH (worker_exit), no poll request needed.
+    assert _wait(lambda: w.poll() is not None), "exit never reported"
+    assert w.poll() == 0
+    io = _AgentWorkerIO(client, 0)
+    assert "agent-child-out" in io.tail()
+
+
+def test_spawn_env_and_run_dir(agent, client):
+    pid = client.spawn(1, [sys.executable, "-c",
+                           "import os; print('RD=' +"
+                           " os.environ.get('NBD_RUN_DIR', '') +"
+                           " ' HL=' + os.environ.get('NBD_HOST', ''))"],
+                       {"NBD_HOST": "hostX"})
+    w = _AgentWorker(client, 1, pid)
+    assert _wait(lambda: w.poll() is not None)
+    tail = _AgentWorkerIO(client, 1).tail()
+    # The agent's OWN run dir wins (per-host black boxes), and the
+    # plan's host label rides through.
+    assert f"RD={agent.run_dir}" in tail
+    assert "HL=hostX" in tail
+
+
+def test_signal_terminates_worker(agent, client):
+    pid = client.spawn(0, [sys.executable, "-c",
+                           "import time; time.sleep(60)"], {})
+    w = _AgentWorker(client, 0, pid)
+    time.sleep(0.3)
+    assert client.signal(0, signal.SIGTERM)
+    assert _wait(lambda: w.poll() is not None), "SIGTERM never landed"
+    assert w.poll() != 0
+
+
+def test_duplicate_rank_spawn_refused(agent, client):
+    client.spawn(0, [sys.executable, "-c",
+                     "import time; time.sleep(30)"], {})
+    with pytest.raises(RuntimeError, match="already running"):
+        client.spawn(0, [sys.executable, "-c", "pass"], {})
+    client.signal(0, signal.SIGKILL)
+
+
+def test_reconnect_resyncs_exits_missed_during_outage(agent, client):
+    """An exit that happens while the client link is down (its push
+    notice has nowhere to land) must be folded in by the
+    fire-and-forget resync after the redial — and the resync must not
+    deadlock the recv thread it runs on."""
+    pid = client.spawn(0, [sys.executable, "-c",
+                           "import time; time.sleep(1.0)"], {})
+    w = _AgentWorker(client, 0, pid)
+    assert w.poll() is None
+    # Sever the link out from under the client; the worker exits
+    # during the outage, the agent's push finds no live connection.
+    client._ch._sock.close()
+    assert _wait(lambda: not client.link_up or client.reconnects > 0)
+    assert _wait(lambda: w.poll() is not None, timeout=20.0), \
+        "exit during the outage was never resynced after reconnect"
+    assert w.poll() == 0
+    assert client.reconnects >= 1
+
+
+def test_link_loss_means_unknown_not_dead(agent, client):
+    """The partition-safety contract: when the agent link drops, a
+    live worker's poll() answers None (alive/unknown) — never a
+    phantom exit code that would trigger N spurious heals."""
+    pid = client.spawn(0, [sys.executable, "-c",
+                           "import time; time.sleep(30)"], {})
+    w = _AgentWorker(client, 0, pid)
+    assert w.poll() is None
+    agent.close(reap=False)   # the link dies; the worker does not
+    assert _wait(lambda: not client.link_up), "link loss undetected"
+    for _ in range(5):
+        assert w.poll() is None
+        time.sleep(0.05)
+    # Requests now fail fast instead of hanging.
+    from nbdistributed_tpu.messaging.transport import TransportError
+    with pytest.raises((TransportError, TimeoutError)):
+        client.request("ping", {}, timeout=2.0)
+    # Manual cleanup: the agent was closed without reaping.
+    import os
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        pass

@@ -20,6 +20,46 @@ def test_parse_hosts_rejects(bad):
         parse_hosts(bad)
 
 
+@pytest.mark.parametrize("dup", ["h1,h1", "h1,h2,h1:3", "local,local:2"])
+def test_parse_hosts_rejects_duplicate_hosts(dup):
+    """'h1,h1:2' is always a typo: the plan would double-book one box
+    and the intended merge is ambiguous — refuse loudly."""
+    with pytest.raises(ValueError, match="more than once"):
+        parse_hosts(dup)
+
+
+def test_make_launch_plan_rejects_duplicate_hostspecs():
+    """Hand-built HostSpec lists get the same guard as the spec
+    string."""
+    with pytest.raises(ValueError, match="duplicate host"):
+        make_launch_plan([HostSpec("h1"), HostSpec("h1", 2)],
+                         coordinator_host="10.0.0.9", control_port=1,
+                         dist_port=2, backend="cpu")
+
+
+def test_plan_ranks_are_dense_and_unique():
+    plan = make_launch_plan(
+        [HostSpec("a", 2), HostSpec("b", 3), HostSpec("local", 1)],
+        coordinator_host="10.0.0.9", control_port=1, dist_port=2,
+        backend="cpu")
+    assert [l.rank for l in plan] == list(range(6))
+    # Every worker knows its host label (link shaping / diagnosis).
+    for launch in plan:
+        assert dict(launch.env)["NBD_HOST"] == launch.host
+
+
+def test_parse_agents_forms_and_rejects():
+    from nbdistributed_tpu.manager.hostagent import parse_agents
+    assert parse_agents(None) == {}
+    assert parse_agents("h1=10.0.0.2:7411,h2=10.0.0.3:8000") == {
+        "h1": ("10.0.0.2", 7411), "h2": ("10.0.0.3", 8000)}
+    assert parse_agents({"h1": ("a", 1)}) == {"h1": ("a", 1)}
+    for bad in ("h1", "h1=addr", "h1=addr:xx", "=a:1",
+                "h1=a:1,h1=b:2"):
+        with pytest.raises(ValueError):
+            parse_agents(bad)
+
+
 def test_plan_assigns_ranks_host_major():
     plan = make_launch_plan(
         [HostSpec("a", 2), HostSpec("b", 1)], coordinator_host="10.0.0.9",
@@ -63,7 +103,12 @@ def test_tpu_plan_ships_no_carving_env():
     plan = make_launch_plan([HostSpec("h1"), HostSpec("h2")],
                             coordinator_host="10.0.0.9", control_port=1,
                             dist_port=2, backend="tpu")
-    assert all(l.env == () for l in plan)
+    # Only the host labels ride a TPU plan's env — no chip carving.
+    for launch in plan:
+        env = dict(launch.env)
+        assert env.pop("NBD_HOST") == launch.host
+        assert env.pop("NBD_COORD_HOST")
+        assert env == {}
 
 
 def test_dist_host_is_rank0_host_for_remote_plans():

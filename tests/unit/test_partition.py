@@ -1,0 +1,501 @@
+"""Unit tests for ISSUE 6: per-link fault shaping, the partition
+sentry, supervisor/watchdog host domains, per-class retry budgets, and
+the reply-epoch fence."""
+
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.messaging.codec import Message
+from nbdistributed_tpu.messaging.coordinator import (CommunicationManager,
+                                                     _Pending)
+from nbdistributed_tpu.messaging.transport import (CoordinatorListener,
+                                                   TransportError,
+                                                   WorkerChannel)
+from nbdistributed_tpu.resilience.faults import FaultPlan, LinkSpec
+from nbdistributed_tpu.resilience.partition import PartitionSentry
+from nbdistributed_tpu.resilience.retry import (BULK_TYPES, RetryPolicy,
+                                                class_of)
+from nbdistributed_tpu.resilience.supervisor import (SUSPECT, Supervisor,
+                                                     SupervisorPolicy)
+
+pytestmark = pytest.mark.faults
+
+
+# ----------------------------------------------------------------------
+# LinkSpec / FaultPlan link shaping
+
+
+def test_link_spec_validation():
+    with pytest.raises(ValueError):
+        LinkSpec(hosts=["a"])                 # not a pair
+    with pytest.raises(ValueError):
+        LinkSpec(hosts=["a", "a"])            # self-partition
+    with pytest.raises(ValueError):
+        LinkSpec.from_spec({"hosts": ["a", "b"], "nope": 1})
+    l = LinkSpec.from_spec({"hosts": ["a", "b"], "after_s": 2,
+                            "for_s": 5})
+    assert l.matches("a", "b") and l.matches("b", "a")
+    assert not l.matches("a", "c")
+
+
+def test_link_spec_partition_window():
+    l = LinkSpec(hosts=["a", "b"], after_s=2.0, for_s=5.0)
+    assert not l.partition_active(1.9)
+    assert l.partition_active(2.0)
+    assert l.partition_active(6.9)
+    assert not l.partition_active(7.0)
+    # for_s=0 with after_s set: partitioned from after_s onward.
+    forever = LinkSpec(hosts=["a", "b"], after_s=1.0)
+    assert not forever.partition_active(0.5)
+    assert forever.partition_active(100.0)
+    # An EXPLICIT for_s=0 (the "%dist_chaos --partition-for 0" form)
+    # means "until cleared", even with after_s 0 — not a no-op.
+    now = LinkSpec.from_spec({"hosts": ["a", "b"], "after_s": 0,
+                              "for_s": 0})
+    assert now.has_partition and now.partition_active(0.0)
+    assert now.partition_active(1e6)
+    # No window declared at all: never partitioned — and the spec
+    # roundtrip preserves that (0.0 defaults must not re-declare one).
+    shaped = LinkSpec(hosts=["a", "b"], latency_s=0.01)
+    assert not shaped.has_partition
+    assert not LinkSpec.from_spec(shaped.spec()).has_partition
+    assert LinkSpec.from_spec(now.spec()).has_partition
+
+
+def test_link_spec_wildcard():
+    l = LinkSpec(hosts=["*", "b"])
+    assert l.matches("anything", "b") and l.matches("b", "x")
+    assert not l.matches("x", "y")
+
+
+def test_fault_plan_links_spec_roundtrip():
+    p = FaultPlan.from_spec({"seed": 3, "links": [
+        {"hosts": ["local", "hostB"], "after_s": 1, "for_s": 2},
+        {"hosts": ["local", "hostC"], "latency_s": 0.05, "loss": 0.1},
+    ]})
+    assert p.has_links()
+    p2 = FaultPlan.from_spec(p.spec())
+    assert [l.spec() for l in p2.links] == [l.spec() for l in p.links]
+
+
+def test_link_blocked_window_timing():
+    p = FaultPlan.from_spec({"links": [
+        {"hosts": ["local", "hostB"], "after_s": 5.0, "for_s": 10.0}]})
+    # Window not yet open.
+    assert not p.link_blocked("hostB", "local")
+    # Rewind the install clock so 7 s have "elapsed": window open.
+    p._t0 = time.monotonic() - 7.0
+    assert p.link_blocked("hostB", "local")
+    assert p.link_blocked("local", "hostB")
+    assert not p.link_blocked("local", "hostC")
+    # Same-host traffic never crosses a link.
+    assert not p.link_blocked("hostB", "hostB")
+    # Window closed again after after_s + for_s.
+    p._t0 = time.monotonic() - 16.0
+    assert not p.link_blocked("hostB", "local")
+
+
+def test_link_transmit_partition_drops_silently():
+    p = FaultPlan.from_spec({"links": [
+        {"hosts": ["local", "hostB"], "after_s": 0.0, "for_s": 60.0}]})
+    sent = []
+    p.link_transmit("local", "hostB", b"x" * 10, sent.append,
+                    kind="execute")
+    assert sent == []
+    assert p.counters["link_dropped"] == 1
+    # Frames on an unmatched pair pass through untouched.
+    p.link_transmit("local", "hostC", b"y", sent.append, kind="execute")
+    assert sent == [b"y"]
+
+
+def test_link_transmit_loss_is_seeded():
+    def drops(seed):
+        p = FaultPlan.from_spec({"seed": seed, "links": [
+            {"hosts": ["a", "b"], "loss": 0.5}]})
+        out = []
+        for i in range(40):
+            got = []
+            p.link_transmit("a", "b", b"f", got.append, kind="k")
+            out.append(bool(got))
+        return out
+
+    assert drops(7) == drops(7)          # deterministic per seed
+    assert drops(7) != drops(8)          # seed actually matters
+    assert 0 < sum(drops(7)) < 40        # some pass, some drop
+
+
+def test_link_transmit_latency_composes_with_frame_faults():
+    p = FaultPlan.from_spec({"drop": 1.0, "links": [
+        {"hosts": ["a", "b"], "latency_s": 0.0}]})
+    sent = []
+    # Link passes the frame, the per-frame fault layer then drops it.
+    p.link_transmit("a", "b", b"f", sent.append, kind="k")
+    assert sent == []
+    assert p.counters["dropped"] == 1
+
+
+def test_worker_channel_severs_on_partition():
+    """A blocked link makes send() raise AND tears the socket so the
+    recv side surfaces TransportError — the orphan-entry path."""
+    lst = CoordinatorListener()
+    lst.start()
+    try:
+        ch = WorkerChannel("127.0.0.1", lst.port, rank=0)
+        ch.local_host, ch.peer_host = "hostB", "local"
+        ch.fault_plan = FaultPlan.from_spec({"links": [
+            {"hosts": ["local", "hostB"], "after_s": 0.0,
+             "for_s": 60.0}]})
+        with pytest.raises(TransportError):
+            ch.send(Message(msg_type="ping", rank=0))
+        with pytest.raises(TransportError):
+            ch.recv(timeout=1.0)
+    finally:
+        lst.close()
+
+
+def test_listener_drops_frames_to_partitioned_host():
+    lst = CoordinatorListener()
+    lst.local_host = "local"
+    lst.host_of_rank = {0: "hostB", 1: "hostC"}
+    lst.start()
+    try:
+        ch0 = WorkerChannel("127.0.0.1", lst.port, rank=0)
+        ch1 = WorkerChannel("127.0.0.1", lst.port, rank=1)
+        # Identify both connections (preamble consumed on first recv).
+        ch0.send(Message(msg_type="ping", rank=0))
+        ch1.send(Message(msg_type="ping", rank=1))
+        deadline = time.time() + 5
+        while len(lst.connected_ranks()) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        lst.fault_plan = FaultPlan.from_spec({"links": [
+            {"hosts": ["local", "hostB"], "after_s": 0.0,
+             "for_s": 60.0}]})
+        msg = Message(msg_type="execute", data="x")
+        lst.send_to_ranks([0, 1], msg)
+
+        def rx(ch, bucket):
+            try:
+                bucket.append(ch.recv(timeout=2.0))
+            except TimeoutError:
+                bucket.append(None)
+
+        b0, b1 = [], []
+        threading.Thread(target=rx, args=(ch1, b1), daemon=True).start()
+        threading.Thread(target=rx, args=(ch0, b0), daemon=True).start()
+        time.sleep(2.5)
+        assert b1 and b1[0] is not None, "hostC frame should arrive"
+        assert not b0 or b0[0] is None, "hostB frame crossed a " \
+                                        "partitioned link"
+        assert lst.fault_plan.counters["link_dropped"] >= 1
+        ch0.close()
+        ch1.close()
+    finally:
+        lst.close()
+
+
+# ----------------------------------------------------------------------
+# PartitionSentry
+
+
+def _sentry(grace=10.0, clock=None):
+    return PartitionSentry({0: "local", 1: "hostB", 2: "hostB",
+                            3: "hostC"},
+                           local_host="local", grace_s=grace,
+                           source="test",
+                           clock=clock or (lambda: 0.0))
+
+
+def test_sentry_whole_host_silence_is_suspected():
+    s = _sentry()
+    # Partial silence: no suspicion.
+    assert s.observe({1}, set(), {0, 2, 3}, now=1.0) == []
+    # Whole host B silent, witnesses elsewhere fresh: suspected.
+    evs = s.observe({1, 2}, set(), {0, 3}, now=2.0)
+    assert [e["event"] for e in evs] == ["suspected"]
+    assert evs[0]["host"] == "hostB" and evs[0]["ranks"] == [1, 2]
+    assert s.suspected_ranks() == {1, 2}
+    # Steady state: no repeat events.
+    assert s.observe({1, 2}, set(), {0, 3}, now=3.0) == []
+
+
+def test_sentry_needs_a_fresh_witness():
+    s = _sentry()
+    # EVERYTHING silent — that's a dead coordinator-side network or a
+    # stopped world, not a partition of one host.
+    assert s.observe({1, 2, 3}, set(), set(), now=1.0) == []
+
+
+def test_sentry_heals_on_any_rank_returning():
+    s = _sentry()
+    s.observe({1, 2}, set(), {0, 3}, now=1.0)
+    evs = s.observe({2}, set(), {0, 1, 3}, now=2.0)
+    assert [e["event"] for e in evs] == ["healed"]
+    assert s.suspected_ranks() == set()
+
+
+def test_sentry_grace_expiry():
+    s = _sentry(grace=10.0)
+    s.observe({1, 2}, set(), {0, 3}, now=1.0)
+    assert s.observe({1, 2}, set(), {0, 3}, now=9.0) == []
+    evs = s.observe({1, 2}, set(), {0, 3}, now=12.0)
+    assert [e["event"] for e in evs] == ["expired"]
+    assert s.expired_hosts() == ["hostB"]
+    assert s.suspected_ranks() == set()
+    # A late return still heals an expired host.
+    evs = s.observe(set(), set(), {0, 1, 2, 3}, now=13.0)
+    assert [e["event"] for e in evs] == ["healed"]
+
+
+def test_sentry_counts_process_death_as_gone():
+    s = _sentry()
+    evs = s.observe({1}, {2}, {0, 3}, now=1.0)
+    assert [e["event"] for e in evs] == ["suspected"]
+
+
+def test_sentry_local_host_exempt_and_single_host_inert():
+    s = _sentry()
+    # rank 0 is on the coordinator's host: its silence alone never
+    # makes a suspicion (not even with witnesses).
+    assert s.observe({0}, set(), {1, 2, 3}, now=1.0) == []
+    single = PartitionSentry({0: "local", 1: "local"},
+                             local_host="local", grace_s=5.0)
+    assert not single.active
+    assert single.observe({0, 1}, set(), set()) == []
+
+
+# ----------------------------------------------------------------------
+# Supervisor host domains (fake comm/pm, fake clock)
+
+
+class FakePM:
+    def __init__(self, hosts):
+        self.hosts = dict(hosts)
+        self.cbs = []
+
+    def add_death_callback(self, cb):
+        self.cbs.append(cb)
+
+    def remove_death_callback(self, cb):
+        if cb in self.cbs:
+            self.cbs.remove(cb)
+
+    def die(self, rank, rc=-9):
+        for cb in self.cbs:
+            cb(rank, rc)
+
+
+class FakeComm:
+    def __init__(self, n=3):
+        self.num_workers = n
+        self.local_host = "local"
+        self.pings = {}
+        self.seen = {}
+
+    def last_ping(self, rank):
+        return self.pings.get(rank)
+
+    def last_seen(self, rank):
+        return self.seen.get(rank)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+POLICY = SupervisorPolicy(poll_s=0.02, degraded_after_s=1.0,
+                          postmortem=False, partition_grace_s=30.0)
+
+
+def test_supervisor_defers_heal_during_partition_grace_then_heals():
+    clock = Clock()
+    healed = threading.Event()
+
+    def heal():
+        healed.set()
+        return None
+
+    sup = Supervisor(POLICY, heal=heal, clock=clock)
+    comm = FakeComm(3)
+    pm = FakePM({0: "local", 1: "hostB", 2: "hostB"})
+    try:
+        sup.attach(comm, pm)
+        comm.seen = {0: clock.t, 1: clock.t, 2: clock.t}
+        # Host B falls silent while rank 0 stays fresh.
+        clock.t += 10.0
+        comm.seen[0] = clock.t
+        assert _wait(lambda: SUSPECT in sup.status()["states"].values())
+        assert "hostB" in sup.status()["suspected_hosts"]
+        # Inside the grace window: no heal, ever.
+        time.sleep(0.2)
+        assert not healed.is_set()
+        # Grace expires with the host still gone: now it heals.
+        clock.t += 31.0
+        comm.seen[0] = clock.t
+        assert healed.wait(5), "heal never ran after grace expiry"
+        kinds = [(e["rank"], e["to"]) for e in sup.status()["events"]]
+        assert (1, SUSPECT) in kinds and (1, "dead") in kinds
+    finally:
+        sup.stop()
+
+
+def test_supervisor_partition_heal_restores_alive_without_respawn():
+    clock = Clock()
+    healed = threading.Event()
+    sup = Supervisor(POLICY, heal=lambda: healed.set(), clock=clock)
+    comm = FakeComm(3)
+    pm = FakePM({0: "local", 1: "hostB", 2: "hostB"})
+    try:
+        sup.attach(comm, pm)
+        comm.seen = {0: clock.t, 1: clock.t, 2: clock.t}
+        clock.t += 10.0
+        comm.seen[0] = clock.t
+        assert _wait(lambda: SUSPECT in sup.status()["states"].values())
+        # The link comes back inside the grace window.
+        clock.t += 5.0
+        comm.seen = {0: clock.t, 1: clock.t, 2: clock.t}
+        assert _wait(sup.healthy), "world did not return to ALIVE"
+        time.sleep(0.2)
+        assert not healed.is_set(), "partition heal must not respawn"
+    finally:
+        sup.stop()
+
+
+def test_supervisor_whole_host_death_defers_but_partial_heals():
+    """All ranks of one host dying together rides the partition grace;
+    a single rank dying on a multi-rank host heals immediately."""
+    clock = Clock()
+    healed = threading.Event()
+    sup = Supervisor(POLICY, heal=lambda: healed.set(), clock=clock)
+    comm = FakeComm(3)
+    pm = FakePM({0: "local", 1: "hostB", 2: "hostB"})
+    try:
+        sup.attach(comm, pm)
+        comm.seen = {0: clock.t, 1: clock.t, 2: clock.t}
+        # Only rank 1 dies; rank 2 (same host) keeps heartbeating.
+        clock.t += 2.0
+        comm.seen = {0: clock.t, 1: clock.t - 2, 2: clock.t}
+        pm.die(1)
+        assert healed.wait(5), "partial-host death must heal promptly"
+    finally:
+        sup.stop()
+
+    # Whole host dies at once → deferred while the sentry suspects.
+    clock2 = Clock()
+    healed2 = threading.Event()
+    sup2 = Supervisor(POLICY, heal=lambda: healed2.set(), clock=clock2)
+    comm2 = FakeComm(3)
+    pm2 = FakePM({0: "local", 1: "hostB", 2: "hostB"})
+    try:
+        sup2.attach(comm2, pm2)
+        clock2.t += 2.0
+        comm2.seen = {0: clock2.t, 1: clock2.t - 2, 2: clock2.t - 2}
+        pm2.die(1)
+        pm2.die(2)
+        assert _wait(
+            lambda: "hostB" in sup2.status()["suspected_hosts"])
+        time.sleep(0.2)
+        assert not healed2.is_set(), \
+            "whole-host death healed inside partition grace"
+        # The link "heals": rank 2 is heard from again, but rank 1's
+        # process is KNOWN dead — a sibling's ping must not resurrect
+        # it, and with the suspicion cleared the deferred heal fires.
+        clock2.t += 5.0
+        comm2.seen = {0: clock2.t, 2: clock2.t}
+        assert healed2.wait(5), (
+            "dead rank never healed after the partition cleared")
+        assert sup2.status()["states"][1] == "dead" or healed2.is_set()
+    finally:
+        sup2.stop()
+
+
+# ----------------------------------------------------------------------
+# Per-class retry budgets
+
+
+def test_class_of_mapping():
+    assert class_of("get_var") == "bulk"
+    assert class_of("set_var") == "bulk"
+    assert class_of("checkpoint") == "bulk"
+    for t in ("execute", "get_status", "hello", "mailbox", "chaos"):
+        assert class_of(t) == "control"
+    assert BULK_TYPES == {"get_var", "set_var", "checkpoint"}
+
+
+def test_retry_classes_from_env():
+    base = RetryPolicy(attempts=4, attempt_timeout_s=5.0)
+    out = RetryPolicy.classes_from_env(base, env={})
+    assert out == {}
+    out = RetryPolicy.classes_from_env(base, env={
+        "NBD_RETRY_CLASS_BULK_TIMEOUT_S": "60",
+        "NBD_RETRY_CLASS_BULK_ATTEMPTS": "2",
+        "NBD_RETRY_CLASS_CONTROL_TIMEOUT_S": "1.5",
+    })
+    assert out["bulk"].attempt_timeout_s == 60.0
+    assert out["bulk"].attempts == 2
+    assert out["control"].attempt_timeout_s == 1.5
+    assert out["control"].attempts == 4          # inherited
+    # Backoff shape is inherited from the base policy.
+    assert out["bulk"].backoff_base_s == base.backoff_base_s
+    # Malformed values are ignored knob-wise.
+    out = RetryPolicy.classes_from_env(base, env={
+        "NBD_RETRY_CLASS_BULK_TIMEOUT_S": "lots"})
+    assert out == {}
+
+
+def test_coordinator_retry_for_uses_class_override(monkeypatch):
+    monkeypatch.setenv("NBD_RETRY_TIMEOUT_S", "2")
+    monkeypatch.setenv("NBD_RETRY_CLASS_BULK_TIMEOUT_S", "90")
+    comm = CommunicationManager(num_workers=1)
+    try:
+        assert comm.retry_for("execute").attempt_timeout_s == 2.0
+        assert comm.retry_for("get_var").attempt_timeout_s == 90.0
+        assert comm.retry_for("get_var").enabled()
+    finally:
+        comm.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Reply-epoch fence (coordinator side)
+
+
+def test_coordinator_rejects_stale_epoch_reply():
+    comm = CommunicationManager(num_workers=1, session_token="t",
+                                session_epoch=3)
+    try:
+        req = Message(msg_type="execute", data="x")
+        pending = _Pending({0}, "execute")
+        with comm._lock:
+            comm._pending[req.msg_id] = pending
+        stale = Message(msg_type="response", msg_id=req.msg_id,
+                        rank=0, epoch=2)
+        comm._on_message(0, stale)
+        assert pending.responses == {}, "stale-epoch reply was applied"
+        current = Message(msg_type="response", msg_id=req.msg_id,
+                          rank=0, epoch=3)
+        comm._on_message(0, current)
+        assert 0 in pending.responses
+        # Unstamped replies (pre-epoch workers) are never rejected.
+        pending2 = _Pending({0}, "execute")
+        req2 = Message(msg_type="execute")
+        with comm._lock:
+            comm._pending[req2.msg_id] = pending2
+        comm._on_message(0, Message(msg_type="response",
+                                    msg_id=req2.msg_id, rank=0))
+        assert 0 in pending2.responses
+    finally:
+        comm.shutdown()

@@ -218,3 +218,58 @@ class TestAuthToken:
             s.close()
         finally:
             lis.close()
+
+
+class TestAuthNonLoopback:
+    """The NBDA preamble exercised the way multihost actually uses it:
+    a NON-loopback-address bind (distinct 127.0.1.x addresses — the
+    shared-filesystem/loopback assumptions off, no root needed), with
+    both the accept and the wrong-secret reject paths (ISSUE 6
+    satellite: until now auth was only ever tested on 127.0.0.1)."""
+
+    BIND = "127.0.1.21"
+
+    def _bindable(self):
+        import socket as socket_mod
+        try:
+            s = socket_mod.socket()
+            s.bind((self.BIND, 0))
+            s.close()
+            return True
+        except OSError:
+            return False
+
+    def test_auth_accepts_and_rejects_on_non_loopback_bind(self):
+        from nbdistributed_tpu.messaging.transport import (
+            CoordinatorListener, Message, WorkerChannel)
+        if not self._bindable():
+            pytest.skip(f"cannot bind {self.BIND} on this host")
+        lis = CoordinatorListener(self.BIND, 0, auth_token="sekrit")
+        connected, messages = [], []
+        lis.on_connect = connected.append
+        lis.on_message = lambda r, m: messages.append((r, m))
+        lis.start()
+        try:
+            assert lis.host == self.BIND
+            # Wrong secret first: dropped before any frame decodes.
+            try:
+                bad = WorkerChannel(self.BIND, lis.port, rank=0,
+                                    auth_token="not-the-secret")
+                bad.send(Message(msg_type="execute", data="1", rank=0))
+            except OSError:
+                pass
+            time.sleep(0.4)
+            assert connected == [] and messages == []
+            # Right secret: attaches and routes across the
+            # non-loopback address.
+            ch = WorkerChannel(self.BIND, lis.port, rank=3,
+                               auth_token="sekrit")
+            ch.send(Message(msg_type="hello", data={"ok": 1}, rank=3))
+            deadline = time.time() + 5
+            while time.time() < deadline and not messages:
+                time.sleep(0.01)
+            assert connected == [3]
+            assert messages and messages[0][0] == 3
+            ch.close()
+        finally:
+            lis.close()

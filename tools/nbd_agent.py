@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Host agent daemon for ssh-free multi-host worker launch.
+
+Run one per host; the coordinator's ``ProcessManager`` dials it (over
+the same authenticated ``NBDA`` codec the worker control plane uses)
+to spawn, death-watch, signal, and tail workers on this host::
+
+    echo "$SECRET" > /run/nbd_agent.secret
+    python tools/nbd_agent.py --bind 10.0.0.3 --port 7411 \
+        --token-file /run/nbd_agent.secret --host-label hostB
+
+Then, from the notebook::
+
+    %dist_init --hosts hostA,hostB --coordinator-addr 10.0.0.2 \
+        --agents "hostA=10.0.0.2:7411,hostB=10.0.0.3:7411"
+
+The agent prints ``NBD_AGENT_READY host=... port=...`` on stdout once
+listening.  Workers it spawns get the agent host's OWN run dir
+(flight rings, stack dumps — per-host, no shared filesystem assumed)
+and its ``--host-label`` as ``NBD_HOST`` for per-link fault shaping
+and per-host diagnosis.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nbdistributed_tpu.manager.hostagent import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
