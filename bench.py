@@ -46,6 +46,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from nbdistributed_tpu.manager import ProcessManager, topology
 from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.utils import knobs
 
 STEPS = 60
 WARMUP = 5
@@ -1233,8 +1234,8 @@ def run_families(backend: str, families, extra: dict,
     ride it as carried entries."""
     measure = measure if measure is not None else measure_family
     try:
-        budget = float(os.environ.get("NBD_BENCH_FAMILY_BUDGET_S",
-                                      5400))
+        budget = float(knobs.get_raw("NBD_BENCH_FAMILY_BUDGET_S",
+                                     "5400"))
     except ValueError:
         log("[bench] NBD_BENCH_FAMILY_BUDGET_S is not a number; "
             "using 5400")
@@ -1279,7 +1280,7 @@ def main() -> int:
         raise SystemExit(143)
 
     signal.signal(signal.SIGTERM, _term)
-    only = os.environ.get("NBD_BENCH_ONLY")
+    only = knobs.get_str("NBD_BENCH_ONLY")
     if only:
         return run_families_only(
             [n.strip() for n in only.split(",") if n.strip()])
@@ -1289,7 +1290,7 @@ def main() -> int:
     # workers so the DDP all_reduce branch is a real cross-process
     # collective.
     default_world = "1" if backend == "tpu" else "2"
-    world = int(os.environ.get("NBD_BENCH_WORLD", default_world))
+    world = int(knobs.get_raw("NBD_BENCH_WORLD", default_world))
     if backend == "tpu":
         for i, delay in enumerate(TPU_ATTEMPTS):
             if delay:

@@ -1,0 +1,31 @@
+"""Static analysis (ISSUE 7): pre-dispatch SPMD cell vetting and the
+framework self-lint.
+
+Two halves:
+
+- **Cell vetting** (:mod:`cellcheck`): an IPython-syntax-aware AST
+  analyzer the ``%%distributed``/``%%rank`` magics run coordinator-
+  side BEFORE ``send_to_ranks`` — rank-conditional collectives,
+  subset-rankspec collectives, rank-conditional early exits, blocking
+  host syncs in loops, namespace shadowing.  Findings annotate by
+  default, hard-block under ``--strict``/``%dist_lint strict``, are
+  flight-recorded and counted (``nbd_lint_findings_total{rule}``),
+  and :mod:`preflight` lets a later hang verdict on a flagged cell
+  cite the pre-flight finding.
+
+- **Self-lint** (:mod:`selfcheck`, ``tools/nbd_lint.py --self``):
+  custom AST passes over the framework itself — thread-shared-state
+  discipline, the codec wire-extension registry, and the env-knob
+  registry (every ``NBD_*`` declared in utils/knobs.py and
+  README-documented).
+
+Everything here is stdlib-only (ast + re) and safe to import from
+any layer.
+"""
+
+from .cellcheck import (COLLECTIVE_NAMES, FRAMEWORK_NAMES, Finding,
+                        VetResult, vet_cell)
+from .ipycompat import strip_ipython
+
+__all__ = ["vet_cell", "VetResult", "Finding", "strip_ipython",
+           "COLLECTIVE_NAMES", "FRAMEWORK_NAMES"]

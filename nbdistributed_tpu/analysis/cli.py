@@ -1,0 +1,139 @@
+"""``nbd-lint`` — the static-analysis CLI (console script + CI gate).
+
+Three modes:
+
+- ``nbd-lint --self [ROOT]``: run the framework self-lint passes
+  (analysis/selfcheck.py) over a repo checkout; nonzero exit on any
+  finding.  This is CI's ``static-analysis`` job.
+- ``nbd-lint FILE [FILE...]`` (or ``-`` for stdin): vet each file as
+  a notebook cell with the SPMD analyzer; nonzero exit on
+  error-severity findings (``--strict`` also fails on warnings).
+  ``--ranks '[0,2]' --world 4`` supplies the dispatch context so the
+  subset-collective rule arms.
+- ``nbd-lint --knob-table``: print the README "Configuration
+  reference" markdown table from the knob registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _repo_root(explicit: str | None) -> str | None:
+    if explicit:
+        return explicit
+    # A checkout holds README.md next to the package dir.  From a
+    # non-editable (wheel) install the package's parent is
+    # site-packages — no README there, so fall back to the cwd before
+    # giving up (running the knob-doc pass against a missing README
+    # would flag every declared knob).
+    import nbdistributed_tpu
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(nbdistributed_tpu.__file__)))
+    for cand in (pkg_parent, os.getcwd()):
+        if os.path.isfile(os.path.join(cand, "README.md")) \
+                and os.path.isdir(os.path.join(cand,
+                                               "nbdistributed_tpu")):
+            return cand
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nbd-lint",
+        description="nbdistributed_tpu static analysis: SPMD cell "
+                    "vetting and the framework self-lint")
+    ap.add_argument("files", nargs="*",
+                    help="cell/script files to vet ('-' = stdin)")
+    ap.add_argument("--self", dest="self_lint", action="store_true",
+                    help="run the framework self-lint passes")
+    ap.add_argument("--root", default=None,
+                    help="repo root for --self (default: the "
+                         "installed package's checkout)")
+    ap.add_argument("--ranks", default=None,
+                    help="rankspec context for cell vetting, e.g. "
+                         "'[0,2]'")
+    ap.add_argument("--world", type=int, default=None,
+                    help="world size context for cell vetting")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on warning-severity findings")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the configuration-reference markdown "
+                         "table from the env-knob registry")
+    args = ap.parse_args(argv)
+
+    if args.knob_table:
+        from ..utils.knobs import knob_table_markdown
+        print(knob_table_markdown())
+        return 0
+
+    rc = 0
+    if args.self_lint:
+        from .selfcheck import run_self_lint
+        root = _repo_root(args.root)
+        if root is None:
+            print("nbd-lint --self needs a repo checkout (README.md "
+                  "next to nbdistributed_tpu/); run it from one or "
+                  "pass --root", file=sys.stderr)
+            return 2
+        results = run_self_lint(root)
+        total = 0
+        for name, findings in results.items():
+            status = "clean" if not findings else \
+                f"{len(findings)} finding(s)"
+            print(f"[{name}] {status}")
+            for f in findings:
+                print(f"  {f.render()}")
+            total += len(findings)
+        if total:
+            print(f"\nnbd-lint --self: {total} finding(s)")
+            rc = 1
+        else:
+            print("\nnbd-lint --self: all passes clean")
+
+    if args.files:
+        from ..magics import rankspec
+        from .cellcheck import vet_cell
+        ranks = None
+        if args.ranks:
+            world = args.world or 0
+            if not world:
+                print("--ranks needs --world", file=sys.stderr)
+                return 2
+            ranks = rankspec.parse_ranks(args.ranks, world)
+        for path in args.files:
+            if path == "-":
+                src, label = sys.stdin.read(), "<stdin>"
+            else:
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        src = f.read()
+                except OSError as e:
+                    print(f"{path}: {e}", file=sys.stderr)
+                    rc = 2
+                    continue
+                label = path
+            res = vet_cell(src, ranks=ranks, world=args.world)
+            if not res.parsed:
+                print(f"{label}: not analyzable (syntax error after "
+                      f"IPython stripping) — would dispatch unvetted")
+                continue
+            for f in res.findings:
+                print(f"{label}:{f.line}: [{f.severity}] [{f.rule}] "
+                      f"{f.message}")
+            bad = res.errors or (args.strict and res.warnings)
+            if bad:
+                rc = 1
+            elif not res.findings:
+                print(f"{label}: clean")
+
+    if not args.self_lint and not args.files:
+        ap.print_help()
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""IPython-syntax-aware source cleaning for AST consumers.
+
+Notebook cells are not quite Python: line magics (``%time f()``),
+shell escapes (``!pip list``, ``files = !ls``), help syntax
+(``obj?``/``?obj``) and a leading cell magic (``%%time``) all fail
+``ast.parse``.  :func:`strip_ipython` rewrites exactly those lines to
+``pass`` **without changing the line count or indentation**, so every
+finding an AST pass reports still points at the user's real line —
+the one shared helper for the cell analyzer and any future AST
+consumer (satellite of ISSUE 7).
+
+Two guards keep string literals intact: source that already parses is
+returned verbatim (a ``!cmd`` line inside a triple-quoted template is
+DATA, not IPython syntax), and the rewrite pass tracks triple-quote
+state so a string's interior lines are never replaced even in cells
+that genuinely mix multi-line strings with magic lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+# ``x = !cmd`` / ``x = %magic`` assignment capture: IPython grammar
+# allows a simple target list before the escape.
+_ASSIGN_ESCAPE = re.compile(
+    r"^\s*[\w.]+(\s*,\s*[\w.]+)*\s*=\s*[!%]")
+_HELP_SUFFIX = re.compile(r"^[^#'\"]*\?{1,2}\s*$")
+# ``%magic`` / ``%%cellmagic`` need a word character right after the
+# percent(s): a bare ``% b`` could be a wrapped modulo continuation
+# line, which must survive untouched.
+_MAGIC_PREFIX = re.compile(r"%{1,2}\w")
+
+
+def _is_ipython_line(stripped: str) -> bool:
+    if not stripped:
+        return False
+    if stripped.startswith(("!", "?")):
+        return True
+    if stripped.startswith("%") and _MAGIC_PREFIX.match(stripped):
+        return True
+    if _ASSIGN_ESCAPE.match(stripped):
+        return True
+    # Trailing ``?``/``??`` help (``obj.method?``) — but not inside a
+    # comment or string, which the cheap regex above excludes.
+    if _HELP_SUFFIX.match(stripped):
+        return True
+    return False
+
+
+_TRIPLE = re.compile(r"'''|\"\"\"")
+
+
+def _track_triple(line: str, in_string: str | None) -> str | None:
+    """Advance the open-triple-quote state across one line.  Inline
+    comments are honored only outside a string; escaped quotes and
+    single-quoted strings containing triple-quote text are rare enough
+    in notebook cells that the parse-first shortcut above handles
+    them."""
+    pos = 0
+    while True:
+        if in_string is None:
+            hash_at = line.find("#", pos)
+            m = _TRIPLE.search(line, pos)
+            if not m or (hash_at != -1 and hash_at < m.start()):
+                return None
+            in_string = m.group(0)
+            pos = m.end()
+        else:
+            close = line.find(in_string, pos)
+            if close == -1:
+                return in_string
+            in_string = None
+            pos = close + 3
+
+
+def strip_ipython(source: str) -> str:
+    """Replace IPython-only lines with ``pass`` (indentation kept) so
+    the result parses with ``ast.parse`` while every surviving node
+    keeps its original line number.  Sources that already parse —
+    pure Python, including multi-line strings whose content LOOKS
+    like shell/magic syntax — come back unchanged."""
+    try:
+        ast.parse(source)
+        return source
+    except (SyntaxError, ValueError):
+        pass
+    out: list[str] = []
+    changed = False
+    in_string: str | None = None
+    for line in source.splitlines():
+        stripped = line.strip()
+        if in_string is None and _is_ipython_line(stripped):
+            indent = line[:len(line) - len(line.lstrip())]
+            out.append(indent + "pass")
+            changed = True
+        else:
+            in_string = _track_triple(line, in_string)
+            out.append(line)
+    if not changed:
+        return source
+    cleaned = "\n".join(out)
+    if source.endswith("\n"):
+        cleaned += "\n"
+    return cleaned

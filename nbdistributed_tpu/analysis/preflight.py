@@ -1,0 +1,69 @@
+"""Coordinator-side memory of pre-dispatch lint findings.
+
+When the magic layer vets a cell and dispatches it anyway (default
+mode annotates, it does not block), the findings are remembered here,
+keyed by the cell's source hash — the same ``cell_sha1`` the worker
+computes (runtime/collective_guard.cell_hash) and the coordinator now
+stamps on each pending execute request.  If a hang verdict later
+lands on that cell, the watchdog, the stuck-cell doctor, and the
+postmortem bundle all cite the pre-flight finding: "the analyzer told
+you so" is the difference between a mystery hang and a closed loop.
+
+Bounded, process-local, stdlib-only.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from threading import Lock
+
+_MAX = 256
+_lock = Lock()
+_notes: "OrderedDict[str, dict]" = OrderedDict()
+
+
+def summarize(findings) -> str:
+    """One-line human summary of a finding list (errors first)."""
+    ordered = sorted(findings,
+                     key=lambda f: 0 if f.severity == "error" else 1)
+    if not ordered:
+        return ""
+    head = ordered[0]
+    out = f"[{head.rule}] at L{head.line}: {head.message}"
+    if len(ordered) > 1:
+        rest = len(ordered) - 1
+        out += f" (+{rest} more finding{'s' if rest > 1 else ''})"
+    return out
+
+
+def note(cell_sha1: str, findings) -> None:
+    """Remember a vetted-and-dispatched cell's findings."""
+    if not findings:
+        return
+    entry = {
+        "summary": summarize(findings),
+        "rules": sorted({f.rule for f in findings}),
+        "errors": sum(1 for f in findings if f.severity == "error"),
+        "warnings": sum(1 for f in findings
+                        if f.severity == "warning"),
+        "ts": time.time(),
+    }
+    with _lock:
+        _notes.pop(cell_sha1, None)
+        _notes[cell_sha1] = entry
+        while len(_notes) > _MAX:
+            _notes.popitem(last=False)
+
+
+def lookup(cell_sha1: str | None) -> dict | None:
+    if not cell_sha1:
+        return None
+    with _lock:
+        entry = _notes.get(cell_sha1)
+        return dict(entry) if entry is not None else None
+
+
+def clear() -> None:
+    with _lock:
+        _notes.clear()

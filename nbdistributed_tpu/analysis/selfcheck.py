@@ -1,0 +1,455 @@
+"""Self-lint: custom AST passes over the framework's own source.
+
+Run by ``tools/nbd_lint.py --self`` (the CI ``static-analysis`` job)
+and by the ``lint``-marked unit tests.  Three passes, each encoding a
+project invariant that used to live only in review comments:
+
+1. **env-knob registry** (:func:`check_env_knobs`): every ``NBD_*``
+   string in the product tree (``nbdistributed_tpu/``, ``tools/``,
+   ``bench.py``) must be declared in ``utils/knobs.py`` and
+   documented in README's configuration reference.  Undocumented
+   knobs fail CI.
+
+2. **codec wire-extension registry** (:func:`check_codec_headers`):
+   the optional frame-header keys ``encode``/``decode`` handle and
+   the heartbeat-ping piggyback fields the worker writes must match
+   ``messaging/codec.py``'s ``WIRE_EXTENSIONS`` table exactly —
+   declared-but-unused and used-but-undeclared both fail.
+
+3. **thread-shared-state discipline**
+   (:func:`check_thread_shared_state`): in classes that own a
+   ``self._lock`` (coordinator, watchdog, supervisor — objects whose
+   fields are touched from supervisor/watchdog/IO threads), every
+   read-modify-write of ``self`` state (``+=``, container mutation)
+   outside a ``with self._lock:`` block is a finding, unless the
+   attribute is listed in the module's ``_LINT_SINGLE_WRITER``
+   exemption table (the documented single-writer / thread-safe-
+   container pattern).  Plain attribute rebinds are allowed — that is
+   the documented atomic-replace pattern.
+
+Stdlib-only; every finding carries ``file:line`` so CI output is
+clickable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+_NBD_FULL = re.compile(r"^NBD_[A-Z][A-Z0-9_]*$")
+
+# Product scan scope, relative to the repo root.  Tests and examples
+# SET knobs (monkeypatch, notebook parametrization) but only the
+# product tree READS them — declarations cover readers.
+_PRODUCT_DIRS = ("nbdistributed_tpu", "tools")
+_PRODUCT_FILES = ("bench.py",)
+
+# Container-constructor names recognized when classifying ``__init__``
+# attributes for the thread pass.
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
+                    "defaultdict", "Counter"}
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popleft",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "extend", "insert"}
+
+_THREAD_CHECKED_FILES = (
+    os.path.join("nbdistributed_tpu", "messaging", "coordinator.py"),
+    os.path.join("nbdistributed_tpu", "resilience", "watchdog.py"),
+    os.path.join("nbdistributed_tpu", "resilience", "supervisor.py"),
+)
+
+
+@dataclass
+class SelfFinding:
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _iter_product_files(root: str):
+    for d in _PRODUCT_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [n for n in dirnames
+                           if n != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+    for f in _PRODUCT_FILES:
+        path = os.path.join(root, f)
+        if os.path.exists(path):
+            yield path
+
+
+def _parse(path: str) -> ast.Module | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), path)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root)
+
+
+# ----------------------------------------------------------------------
+# pass 1: env-knob registry
+
+
+def check_env_knobs(root: str, readme: str | None = None
+                    ) -> list[SelfFinding]:
+    from ..utils import knobs
+
+    findings: list[SelfFinding] = []
+    for path in _iter_product_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            s = node.value
+            if s.endswith("_") and s.startswith("NBD_"):
+                # Dynamic composition prefix (f-string builders).
+                if _NBD_FULL.match(s) and s not in knobs.PREFIXES:
+                    findings.append(SelfFinding(
+                        _rel(root, path), node.lineno, "env-knob",
+                        f"dynamic knob prefix {s!r} is not declared "
+                        f"in utils/knobs.py PREFIXES"))
+                continue
+            if _NBD_FULL.match(s) and s not in knobs.KNOBS:
+                findings.append(SelfFinding(
+                    _rel(root, path), node.lineno, "env-knob",
+                    f"{s} is read/written here but not declared in "
+                    f"utils/knobs.py — declare it (and document it "
+                    f"in README's configuration reference)"))
+    # README documentation check.
+    readme_path = readme or os.path.join(root, "README.md")
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    for name in sorted(knobs.KNOBS):
+        if not re.search(rf"\b{re.escape(name)}\b", text):
+            findings.append(SelfFinding(
+                "README.md", 0, "env-knob",
+                f"declared knob {name} is not documented in README "
+                f"(regenerate the table: nbd-lint --knob-table)"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# pass 2: codec wire-extension registry
+
+
+def _func(tree: ast.Module, name: str) -> ast.FunctionDef | None:
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _method(tree: ast.Module, cls: str, name: str
+            ) -> ast.FunctionDef | None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == name:
+                    return sub
+    return None
+
+
+def _subscript_str_key(node: ast.AST, varname: str) -> str | None:
+    """``varname["key"]`` → "key"."""
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == varname
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)):
+        return node.slice.value
+    return None
+
+
+def check_codec_headers(root: str) -> list[SelfFinding]:
+    from ..messaging.codec import BASE_HEADER_KEYS, WIRE_EXTENSIONS
+
+    findings: list[SelfFinding] = []
+    declared_header = {k for k, v in WIRE_EXTENSIONS.items()
+                       if v["plane"] == "header"}
+    declared_ping = {k for k, v in WIRE_EXTENSIONS.items()
+                     if v["plane"] == "ping"}
+
+    codec_path = os.path.join(root, "nbdistributed_tpu", "messaging",
+                              "codec.py")
+    tree = _parse(codec_path)
+    if tree is None:
+        return [SelfFinding("nbdistributed_tpu/messaging/codec.py", 0,
+                            "codec-header", "could not parse codec.py")]
+    rel_codec = _rel(root, codec_path)
+
+    enc = _func(tree, "encode")
+    emitted: set[str] = set()
+    for node in ast.walk(enc) if enc else ():
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                key = _subscript_str_key(tgt, "header")
+                if key is not None:
+                    emitted.add(key)
+    emitted -= set(BASE_HEADER_KEYS)
+
+    dec = _func(tree, "decode")
+    read: set[str] = set()
+    for node in ast.walk(dec) if dec else ():
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "header"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            read.add(node.args[0].value)
+    read -= set(BASE_HEADER_KEYS)
+
+    for key in sorted(emitted - declared_header):
+        findings.append(SelfFinding(
+            rel_codec, enc.lineno, "codec-header",
+            f"encode() emits optional header {key!r} not declared in "
+            f"WIRE_EXTENSIONS"))
+    for key in sorted(read - declared_header):
+        findings.append(SelfFinding(
+            rel_codec, dec.lineno, "codec-header",
+            f"decode() reads optional header {key!r} not declared in "
+            f"WIRE_EXTENSIONS"))
+    for key in sorted(declared_header - emitted):
+        findings.append(SelfFinding(
+            rel_codec, enc.lineno if enc else 0, "codec-header",
+            f"WIRE_EXTENSIONS declares header {key!r} but encode() "
+            f"never emits it"))
+    for key in sorted(declared_header - read):
+        findings.append(SelfFinding(
+            rel_codec, dec.lineno if dec else 0, "codec-header",
+            f"WIRE_EXTENSIONS declares header {key!r} but decode() "
+            f"never reads it"))
+
+    # Ping plane: the worker heartbeat's data dict.
+    worker_path = os.path.join(root, "nbdistributed_tpu", "runtime",
+                               "worker.py")
+    wtree = _parse(worker_path)
+    if wtree is None:
+        findings.append(SelfFinding(
+            "nbdistributed_tpu/runtime/worker.py", 0, "codec-header",
+            "could not parse worker.py"))
+        return findings
+    hb = None
+    for node in ast.walk(wtree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_heartbeat":
+            hb = node
+            break
+    written: set[str] = set()
+    for node in ast.walk(hb) if hb else ():
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                key = _subscript_str_key(tgt, "data")
+                if key is not None:
+                    written.add(key)
+                if isinstance(tgt, ast.Name) and tgt.id == "data" \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            written.add(k.value)
+    rel_worker = _rel(root, worker_path)
+    for key in sorted(written - declared_ping):
+        findings.append(SelfFinding(
+            rel_worker, hb.lineno if hb else 0, "codec-header",
+            f"heartbeat piggybacks ping field {key!r} not declared in "
+            f"WIRE_EXTENSIONS (plane 'ping')"))
+    for key in sorted(declared_ping - written):
+        findings.append(SelfFinding(
+            rel_worker, hb.lineno if hb else 0, "codec-header",
+            f"WIRE_EXTENSIONS declares ping field {key!r} but the "
+            f"heartbeat never sends it"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# pass 3: thread-shared-state discipline
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` → "X"."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _module_exemptions(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``_LINT_SINGLE_WRITER = {"Class.attr": "why"}``."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_LINT_SINGLE_WRITER"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(v, ast.Constant):
+                    out[str(k.value)] = str(v.value)
+    return out
+
+
+class _ThreadPass(ast.NodeVisitor):
+    def __init__(self, relpath: str, cls: str, containers: set[str],
+                 exempt: dict[str, str]):
+        self.relpath = relpath
+        self.cls = cls
+        self.containers = containers
+        self.exempt = exempt
+        self.locked = 0
+        self.findings: list[SelfFinding] = []
+
+    def _is_exempt(self, attr: str) -> bool:
+        return f"{self.cls}.{attr}" in self.exempt
+
+    def _flag(self, node: ast.AST, attr: str, what: str) -> None:
+        if self._is_exempt(attr):
+            return
+        self.findings.append(SelfFinding(
+            self.relpath, node.lineno, "thread-shared-state",
+            f"{self.cls}.{attr}: {what} outside `with self._lock:` — "
+            f"use the lock, replace atomically (plain rebind), or "
+            f"document the single-writer pattern in "
+            f"_LINT_SINGLE_WRITER"))
+
+    # -- lock tracking --------------------------------------------------
+
+    def _with_takes_lock(self, node: ast.With) -> bool:
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and "lock" in attr:
+                return True
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        if self._with_takes_lock(node):
+            self.locked += 1
+            self.generic_visit(node)
+            self.locked -= 1
+        else:
+            self.generic_visit(node)
+
+    # -- mutation patterns ----------------------------------------------
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = _self_attr(node.target)
+        if attr is not None and not self.locked:
+            self._flag(node, attr, "read-modify-write (`+=`)")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.locked:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                    if attr is not None and attr in self.containers:
+                        self._flag(node, attr, "container item write")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        if not self.locked:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                    if attr is not None and attr in self.containers:
+                        self._flag(node, attr, "container item delete")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if not self.locked and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None and attr in self.containers:
+                self._flag(node, attr,
+                           f"container mutation (.{node.func.attr})")
+        self.generic_visit(node)
+
+
+def check_thread_shared_state(root: str) -> list[SelfFinding]:
+    findings: list[SelfFinding] = []
+    for rel in _THREAD_CHECKED_FILES:
+        path = os.path.join(root, rel)
+        tree = _parse(path)
+        if tree is None:
+            continue
+        exempt = _module_exemptions(tree)
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            init = None
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) \
+                        and sub.name == "__init__":
+                    init = sub
+                    break
+            if init is None:
+                continue
+            has_lock = False
+            containers: set[str] = set()
+            for stmt in ast.walk(init):
+                if isinstance(stmt, ast.Assign):
+                    tgts = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and stmt.value is not None:
+                    tgts = [stmt.target]
+                else:
+                    continue
+                for tgt in tgts:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if "lock" in attr:
+                        has_lock = True
+                    v = stmt.value
+                    if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+                        containers.add(attr)
+                    elif isinstance(v, ast.Call):
+                        fn = v.func
+                        ctor = (fn.id if isinstance(fn, ast.Name)
+                                else fn.attr
+                                if isinstance(fn, ast.Attribute)
+                                else None)
+                        if ctor in _CONTAINER_CTORS:
+                            containers.add(attr)
+            if not has_lock:
+                continue
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) \
+                        and sub.name != "__init__":
+                    p = _ThreadPass(rel.replace(os.sep, "/"),
+                                    node.name, containers, exempt)
+                    p.visit(sub)
+                    findings.extend(p.findings)
+    return findings
+
+
+# ----------------------------------------------------------------------
+
+
+def run_self_lint(root: str) -> dict[str, list[SelfFinding]]:
+    """All passes; ``{pass_name: findings}`` (empty lists = clean)."""
+    return {
+        "env-knobs": check_env_knobs(root),
+        "codec-headers": check_codec_headers(root),
+        "thread-shared-state": check_thread_shared_state(root),
+    }

@@ -28,6 +28,7 @@ from IPython.core.magic_arguments import (argument, magic_arguments,
 
 from ..manager import ProcessManager
 from ..messaging import CommunicationManager, WorkerDied
+from ..utils import knobs as _knobs
 from . import display as display_mod
 from . import proxies, rankspec
 from .timeline import Timeline
@@ -65,6 +66,9 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_postmortem (crash bundles from the flight recorder) ·
 %dist_watchdog (collective hang detection + escalation) ·
 %dist_doctor (stuck-cell report: skew table, stacks, flight tails) ·
+%dist_lint warn|strict|off (pre-dispatch cell vetting: rank-conditional
+collectives, subset hazards, host-syncs in loops — strict blocks
+error-severity cells; also %%distributed --strict per cell) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
 %dist_gc (sweep stale session run dirs) ·
@@ -110,6 +114,10 @@ class DistributedMagics(Magics):
         fields are one invariant — always cleared together)."""
         cls._bg_ckpt_path = None
         cls._bg_ckpt_done = set()
+
+    # Session-wide pre-dispatch cell-vetting mode (ISSUE 7): None =
+    # resolve the NBD_LINT knob at use time; %dist_lint pins it.
+    _lint_mode: str | None = None
 
     # Active auto-heal supervisor (resilience/supervisor.py), or None.
     _supervisor = None
@@ -533,11 +541,10 @@ class DistributedMagics(Magics):
                   + (f", hosts={args.hosts}" if args.hosts else "")
                   + ")...")
             if host_specs is not None:
-                import os as _os
                 # Agents authenticate with their daemon-start secret
                 # (export the same one as NBD_AGENT_TOKEN here), NOT
                 # this session's minted control-plane token.
-                agent_token = _os.environ.get("NBD_AGENT_TOKEN")
+                agent_token = _knobs.get_str("NBD_AGENT_TOKEN")
                 if agents and agent_token is None:
                     print("⚠️ NBD_AGENT_TOKEN is not set — dialing the "
                           "agents with this session's minted secret, "
@@ -605,7 +612,6 @@ class DistributedMagics(Magics):
             # adopt this fleet after THIS kernel dies.  Single-host
             # only — pid adoption and the shared run-dir manifest
             # assume one pid namespace and filesystem.
-            import os as _os
             from ..observability import flightrec as _flightrec
             _rd = _flightrec.run_dir()
             _existing = session_mod.read_manifest(_rd)
@@ -757,8 +763,6 @@ class DistributedMagics(Magics):
         is redelivered exactly once, and every worker's namespace,
         compiled functions, and device state are exactly as the crash
         left them."""
-        import os as _os
-
         from ..resilience import session as session_mod
         args = parse_argstring(self.dist_attach, line)
         if self._running():
@@ -789,7 +793,7 @@ class DistributedMagics(Magics):
                         for m in hello.values()})
         print(f"🔗 reattached to {comm.num_workers} workers "
               f"(epoch {comm.session_epoch}, "
-              f"run {_os.environ.get('NBD_RUN_DIR')}, "
+              f"run {_knobs.get_str('NBD_RUN_DIR')}, "
               f"{time.time() - t0:.1f}s) — namespaces intact "
               f"({'/'.join(str(s) for s in sizes)} names/rank)")
         # Exactly-once redelivery of results parked while orphaned.
@@ -931,10 +935,8 @@ class DistributedMagics(Magics):
     def _note_supervised(on: bool) -> None:
         """Record the supervision flag in the session manifest so a
         reattaching coordinator re-arms it (durable sessions)."""
-        import os as _os
-
         from ..resilience import session as session_mod
-        d = _os.environ.get("NBD_RUN_DIR")
+        d = _knobs.get_str("NBD_RUN_DIR")
         if d:
             session_mod.update_manifest(d, supervised=on)
 
@@ -1153,9 +1155,7 @@ class DistributedMagics(Magics):
         NBD_HANG at SPAWN time: with it off, a coordinator-side
         watchdog can only ever see coarse busy state (stall detection;
         no skew, no --deadline)."""
-        import os as _os
-        return str(_os.environ.get("NBD_HANG", "1")).lower() \
-            in ("0", "false", "off")
+        return not _knobs.get_bool("NBD_HANG", True)
 
     @magic_arguments()
     @argument("command", nargs="?", default="status",
@@ -1285,9 +1285,126 @@ class DistributedMagics(Magics):
                 print(f"❌ could not write {args.save}: {e}")
 
     # ==================================================================
+    # pre-dispatch cell vetting (ISSUE 7)
+
+    @classmethod
+    def _lint_mode_now(cls) -> str:
+        """The effective vetting mode: the %dist_lint-pinned value,
+        else the NBD_LINT env knob, else ``warn``."""
+        if cls._lint_mode is not None:
+            return cls._lint_mode
+        mode = (_knobs.get_str("NBD_LINT", "warn") or "warn").lower()
+        return mode if mode in ("warn", "strict", "off") else "warn"
+
+    def _vet_cell(self, code: str, ranks: list[int], *,
+                  strict: bool = False) -> bool:
+        """Statically vet a cell BEFORE ``send_to_ranks`` (the ISSUE 7
+        tentpole): rank-conditional collectives, subset-rankspec
+        collectives, rank-conditional early exits, blocking host
+        syncs in loops, namespace shadowing.  Findings print as
+        inline annotations; error-severity findings block dispatch
+        only under ``--strict`` / ``%dist_lint strict``.  Returns
+        False when the cell must not ship.  Unparseable source NEVER
+        blocks — it degrades to the legacy regex warning for subset
+        cells and dispatches."""
+        mode = self._lint_mode_now()
+        if mode == "off" and not strict:
+            return True  # an explicit per-cell --strict still vets
+        try:
+            from .. import analysis
+            res = analysis.vet_cell(code, ranks=ranks,
+                                    world=self._world)
+        except Exception:
+            return True  # the analyzer must never break dispatch
+        if not res.parsed:
+            if len(ranks) < self._world \
+                    and _COLLECTIVE_TOKENS.search(code):
+                print(f"⚠️ Cell names a collective but targets only "
+                      f"ranks {ranks} of {self._world}. A collective "
+                      "run by a subset deadlocks the mesh; %sync can "
+                      "realign after errors.")
+            return True
+        if not res.findings:
+            return True
+        from ..analysis import preflight
+        from ..observability import flightrec
+        from ..observability import metrics as obs_metrics
+        from ..runtime.collective_guard import cell_hash
+        sha = cell_hash(code)
+        reg = obs_metrics.registry()
+        for f in res.findings:
+            reg.counter("nbd_lint_findings_total",
+                        "pre-dispatch cell-vetting findings",
+                        {"rule": f.rule}).inc()
+            flightrec.record("lint_finding", rule=f.rule,
+                             severity=f.severity, line=f.line,
+                             cell=sha)
+            print(f.render())
+        errors = res.errors
+        if errors and (strict or mode == "strict"):
+            print(f"⛔ cell NOT dispatched: {len(errors)} error-"
+                  f"severity finding(s) under strict vetting — fix "
+                  f"the cell, or loosen with %dist_lint warn (or "
+                  f"drop --strict) to dispatch anyway")
+            return False
+        # Dispatched despite findings: remember them so a later hang
+        # verdict / %dist_doctor / postmortem on this cell cites the
+        # pre-flight warning (resilience/watchdog.py).
+        preflight.note(sha, res.findings)
+        return True
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["strict", "warn", "off", "status"])
+    @line_magic
+    def dist_lint(self, line):
+        """Pre-dispatch SPMD cell vetting: every ``%%distributed`` /
+        ``%%rank`` / auto-distributed cell is AST-analyzed
+        coordinator-side before dispatch — rank-conditional
+        collectives (``if rank == 0: all_reduce(...)`` deadlocks the
+        mesh), collectives in subset-``--ranks`` cells,
+        rank-conditional ``return``/``break``/``raise`` that desync
+        the collective sequence, blocking host syncs inside loops
+        (``.item()``, ``device_get``, printing device values), and
+        shadowed framework names.  ``%dist_lint warn`` (default)
+        annotates, ``strict`` blocks error-severity cells,
+        ``off`` disables; the NBD_LINT env knob sets the session
+        default, and ``%%distributed --strict`` arms strict for one
+        cell.  Never blocks on unparseable source."""
+        args = parse_argstring(self.dist_lint, line)
+        if args.command == "status":
+            mode = self._lint_mode_now()
+            src = ("pinned by %dist_lint"
+                   if DistributedMagics._lint_mode is not None
+                   else "from NBD_LINT / default")
+            print(f"🔎 cell vetting: {mode} ({src})")
+            from ..observability import metrics as obs_metrics
+            counters = obs_metrics.registry().to_json()["counters"]
+            found = {k: v for k, v in counters.items()
+                     if k.startswith("nbd_lint_findings_total")}
+            if found:
+                print("   findings this session:")
+                for k in sorted(found):
+                    rule = k.split('rule="')[-1].rstrip('"}')
+                    print(f"   · {rule}: {found[k]:.0f}")
+            else:
+                print("   no findings this session")
+            return
+        DistributedMagics._lint_mode = args.command
+        verb = {"strict": "ON (strict — error-severity cells are "
+                          "blocked pre-dispatch)",
+                "warn": "ON (annotate only)",
+                "off": "OFF"}[args.command]
+        print(f"✅ cell vetting {verb}")
+
+    # ==================================================================
     # execution magics
 
     @magic_arguments()
+    @argument("--strict", action="store_true",
+              help="block dispatch when the pre-flight analyzer finds "
+                   "an error-severity hazard (rank-conditional "
+                   "collective, subset collective, desyncing exit)")
     @argument("--deadline", type=float, default=None,
               help="per-cell budget in seconds: the hang watchdog "
                    "escalates (warn → dump → interrupt → heal, per "
@@ -1313,6 +1430,9 @@ class DistributedMagics(Magics):
                 print("⚠️ --deadline set but workers were spawned "
                       "with NBD_HANG=0 (no heartbeat piggyback) — "
                       "the budget will not be enforced")
+        if not self._vet_cell(cell, list(range(self._world)),
+                              strict=args.strict):
+            return
         result = self._run_on_ranks(cell, list(range(self._world)),
                                     kind="distributed",
                                     deadline_s=args.deadline)
@@ -1330,11 +1450,12 @@ class DistributedMagics(Magics):
         except rankspec.RankSpecError as e:
             print(f"❌ {e}")
             return
-        if len(ranks) < self._world and _COLLECTIVE_TOKENS.search(cell):
-            print(f"⚠️ Cell names a collective but targets only ranks "
-                  f"{ranks} of {self._world}. A collective run by a "
-                  "subset deadlocks the mesh; %sync can realign after "
-                  "errors.")
+        # Pre-dispatch vetting with the SUBSET context armed: the
+        # analyzer upgrades the old regex warning to real findings
+        # (calls = error under strict, bare references = warning) and
+        # falls back to the regex only for unparseable source.
+        if not self._vet_cell(cell, ranks):
+            return
         self._run_on_ranks(cell, ranks, kind="rank")
 
     @magic_arguments()
@@ -1502,11 +1623,9 @@ class DistributedMagics(Magics):
         # it survives us) or adopted one (%dist_attach).
         if self._comm is not None and getattr(self._comm,
                                               "session_token", None):
-            import os as _os
-
             from ..resilience import session as session_mod
-            ttl = _os.environ.get("NBD_ORPHAN_TTL_S") or "600"
-            print(f"🔑 session: run {_os.environ.get('NBD_RUN_DIR', '-')}"
+            ttl = _knobs.get_raw("NBD_ORPHAN_TTL_S") or "600"
+            print(f"🔑 session: run {_knobs.get_str('NBD_RUN_DIR', '-')}"
                   f" · token {session_mod.token_fingerprint(self._comm.session_token)}"
                   f" · epoch {self._comm.session_epoch}"
                   f" · {'attached' if DistributedMagics._attached else 'orphan-capable'}"
@@ -2271,9 +2390,8 @@ class DistributedMagics(Magics):
                   f"{peak:<7}{str(tel.get('bufs', '-')):<6}"
                   f"{str(tel.get('compiles', '-')):<9}"
                   f"{str(tel.get('dedup', '-')):<6}")
-        import os as _os
         print(f"coordinator: retries sent {comm.retries_sent} · "
-              f"run dir {_os.environ.get('NBD_RUN_DIR', '(unset)')}")
+              f"run dir {_knobs.get_str('NBD_RUN_DIR', '(unset)')}")
 
     @magic_arguments()
     @argument("--last", action="store_true",
@@ -2527,10 +2645,8 @@ class DistributedMagics(Magics):
         twin of the workers' epoch fence).  A kernel exit deliberately
         does not come through here — it merely orphans the fleet,
         which is what %dist_attach resumes."""
-        import os as _os
-
         from ..resilience import session as session_mod
-        d = _os.environ.get("NBD_RUN_DIR")
+        d = _knobs.get_str("NBD_RUN_DIR")
         if not d or token is None:
             return
         m = session_mod.read_manifest(d)

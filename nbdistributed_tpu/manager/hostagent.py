@@ -45,6 +45,7 @@ import time
 from collections import deque
 
 from ..messaging.codec import Message
+from ..utils import knobs
 from ..messaging.transport import (CoordinatorListener, TransportError,
                                    WorkerChannel)
 
@@ -87,13 +88,13 @@ class HostAgent:
                  auth_token: str | None = None,
                  host_label: str | None = None,
                  run_dir: str | None = None):
-        self.host_label = host_label or os.environ.get("NBD_HOST") \
+        self.host_label = host_label or knobs.get_str("NBD_HOST") \
             or "agent"
         # Per-host run dir: flight rings / stack dumps / manifests of
         # agent-spawned workers land HERE, never on the coordinator's
         # filesystem — the shared-run-dir assumption is exactly what
         # multi-host execution turns off.
-        self.run_dir = run_dir or os.environ.get("NBD_RUN_DIR")
+        self.run_dir = run_dir or knobs.get_str("NBD_RUN_DIR")
         self._listener = CoordinatorListener(host, port,
                                              auth_token=auth_token)
         self.host, self.port = self._listener.host, self._listener.port
@@ -600,7 +601,7 @@ def main(argv: list[str] | None = None) -> int:
               "port spawns processes. Pass --token-file or "
               "--token-env.", file=sys.stderr)
         return 2
-    run_dir = args.run_dir or os.environ.get("NBD_RUN_DIR")
+    run_dir = args.run_dir or knobs.get_str("NBD_RUN_DIR")
     if not run_dir:
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="nbd_agent_")

@@ -29,10 +29,10 @@ and ambiguous configs are refused loudly rather than mis-wired.
 from __future__ import annotations
 
 import dataclasses
-import os
 import shlex
 import sys
 
+from ..utils import knobs
 from . import topology
 
 
@@ -147,7 +147,7 @@ def make_launch_plan(hosts: list[HostSpec], *, coordinator_host: str,
                 # relabelled coordinator would shape frames on a pair
                 # the workers never match.
                 "NBD_HOST": h.host,
-                "NBD_COORD_HOST": os.environ.get("NBD_HOST") or "local",
+                "NBD_COORD_HOST": knobs.get_str("NBD_HOST") or "local",
             }
             if backend == "cpu":
                 # Deterministic worker env regardless of what the

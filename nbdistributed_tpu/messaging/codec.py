@@ -53,6 +53,43 @@ HEADER_SIZE = struct.calcsize(_HEADER_FMT)  # 16 bytes
 # Coordinator sentinel rank (reference: communication.py:44 uses -1 too).
 COORDINATOR_RANK = -1
 
+# Base frame-header keys: always present, the original wire schema.
+BASE_HEADER_KEYS = frozenset(
+    {"id", "type", "rank", "ts", "data", "enc", "bufs"})
+
+# The one registry of OPTIONAL wire extensions — every field that can
+# ride the wire beyond the base schema is declared here, and the
+# static self-lint (analysis/selfcheck.py) verifies this table against
+# the code: the ``header``-plane keys must match exactly what
+# :func:`encode` conditionally emits and :func:`decode` reads, and the
+# ``ping``-plane keys must match what the worker's heartbeat thread
+# piggybacks into a ping's ``data`` dict (runtime/worker.py) for the
+# coordinator/watchdog to read.  Adding a field in only one place
+# fails ``nbd-lint --self`` in CI instead of silently desyncing the
+# two ends of the wire.
+WIRE_EXTENSIONS: dict[str, dict] = {
+    # frame-header plane (encode/decode below)
+    "at": {"plane": "header", "attr": "attempt",
+           "doc": "delivery attempt (>0 only on retry redeliveries)"},
+    "tr": {"plane": "header", "attr": "trace",
+           "doc": "span context while a %dist_trace is active"},
+    "ep": {"plane": "header", "attr": "epoch",
+           "doc": "session epoch stamp (durable-session fencing)"},
+    # heartbeat-ping data plane (worker _heartbeat → coordinator)
+    "busy_type": {"plane": "ping",
+                  "doc": "in-flight request type while busy"},
+    "busy_s": {"plane": "ping",
+               "doc": "seconds busy on the monotonic clock"},
+    "busy_id": {"plane": "ping",
+                "doc": "in-flight request id (hang watchdog)"},
+    "busy_deadline": {"plane": "ping",
+                      "doc": "per-cell --deadline budget echo"},
+    "col": {"plane": "ping",
+            "doc": "collective-progress snapshot (hang watchdog)"},
+    "tel": {"plane": "ping",
+            "doc": "device telemetry sample (HBM, buffers, compiles)"},
+}
+
 
 class CodecError(Exception):
     """Raised on malformed frames or disallowed encodings."""

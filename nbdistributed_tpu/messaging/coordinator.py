@@ -22,7 +22,6 @@ three structural fixes called out in SURVEY §7:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -33,9 +32,21 @@ from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability.clock import ClockEstimator
 from ..resilience.retry import RetryPolicy, class_of
+from ..utils import knobs
 from .codec import Message
 from .native import make_listener
 from .transport import TransportError
+
+
+# Documented exemptions for the thread-shared-state self-lint
+# (analysis/selfcheck.py): attributes with exactly one writer thread
+# (or GIL-atomic mutation) that deliberately skip the lock.
+_LINT_SINGLE_WRITER = {
+    "CommunicationManager._notify_callbacks":
+        "registered from the main thread at wiring time only; list "
+        "append is atomic under the GIL and the IO thread only "
+        "iterates",
+}
 
 
 class WorkerDied(RuntimeError):
@@ -50,7 +61,7 @@ class WorkerDied(RuntimeError):
 
 class _Pending:
     __slots__ = ("expect", "responses", "event", "failure", "sent_at",
-                 "msg_type")
+                 "msg_type", "cell_sha1")
 
     def __init__(self, expect: set[int], msg_type: str = ""):
         self.msg_type = msg_type
@@ -63,6 +74,10 @@ class _Pending:
         # refresh it — a retried sample just has a big RTT and loses
         # the min-RTT filter.
         self.sent_at: float = 0.0
+        # Source hash of an execute request's cell (the same value the
+        # worker reports as ``cell_sha1``): lets a hang verdict on this
+        # request cite the pre-dispatch lint finding for its cell.
+        self.cell_sha1: str | None = None
 
 
 class CommunicationManager:
@@ -128,7 +143,7 @@ class CommunicationManager:
         # this process's own label — fed to the listener for per-link
         # fault shaping and to the partition sentry / link_stats.
         self.hosts: dict[int, str] = {}
-        self.local_host: str = os.environ.get("NBD_HOST") or "local"
+        self.local_host: str = knobs.get_str("NBD_HOST") or "local"
         self._listener.local_host = self.local_host
         self._ready = threading.Event()
         self._last_seen: dict[int, float] = {}
@@ -204,7 +219,8 @@ class CommunicationManager:
             return {mid: {"type": p.msg_type,
                           "expect": sorted(p.expect),
                           "responded": sorted(p.responses),
-                          "sent_at": p.sent_at}
+                          "sent_at": p.sent_at,
+                          "cell_sha1": p.cell_sha1}
                     for mid, p in self._pending.items()}
 
     def last_ping(self, rank: int) -> tuple[float, dict] | None:
@@ -346,6 +362,10 @@ class CommunicationManager:
             # stitching the cross-process timeline together.
             msg.trace = tr.context_for(span)
         pending = _Pending(set(ranks), msg_type)
+        if msg_type == "execute" and isinstance(data, dict) \
+                and isinstance(data.get("code"), str):
+            from ..runtime.collective_guard import cell_hash
+            pending.cell_sha1 = cell_hash(data["code"])
         with self._lock:
             already_dead = pending.expect & self._dead
             self._pending[msg.msg_id] = pending
@@ -375,8 +395,11 @@ class CommunicationManager:
                                            attempt=msg.attempt,
                                            ranks=missing_now)
                         self._listener.send_to_ranks(missing_now, msg)
-                        self.retries_sent += 1
                         with self._lock:
+                            # Concurrent senders (a %dist_top reader,
+                            # two cells in flight) share this counter:
+                            # the read-modify-write needs the lock.
+                            self.retries_sent += 1
                             for r in missing_now:
                                 self.retries_by_rank[r] = \
                                     self.retries_by_rank.get(r, 0) + 1

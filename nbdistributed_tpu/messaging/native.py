@@ -22,6 +22,7 @@ import ctypes
 import os
 import threading
 
+from ..utils import knobs
 from .codec import CodecError, decode, encode
 
 _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
@@ -89,13 +90,13 @@ def load_library():
 
 
 def available() -> bool:
-    if os.environ.get("NBD_NATIVE") == "0":
+    if knobs.get_str("NBD_NATIVE") == "0":
         return False
     try:
         load_library()
         return True
     except OSError:
-        if os.environ.get("NBD_NATIVE") == "1":
+        if knobs.get_str("NBD_NATIVE") == "1":
             raise
         return False
 
@@ -267,7 +268,7 @@ def make_listener(host: str = "127.0.0.1", port: int = 0, *,
             return NativeCoordinatorListener(host, port,
                                              allow_pickle=allow_pickle,
                                              auth_token=auth_token)
-        if os.environ.get("NBD_NATIVE") == "1":
+        if knobs.get_str("NBD_NATIVE") == "1":
             raise OSError(
                 "NBD_NATIVE=1 but libnbdtransport.so predates the "
                 "authenticated preamble; rebuild with native/build.sh")

@@ -109,7 +109,8 @@ def _encode_payload(etype: str, ts: float, fields: dict) -> bytes:
 
 
 def _enabled_by_env() -> bool:
-    return os.environ.get("NBD_FLIGHT", "1") not in ("0", "false", "off")
+    from ..utils import knobs
+    return knobs.get_bool("NBD_FLIGHT", True)
 
 
 def run_dir(create: bool = True) -> str:
@@ -118,7 +119,8 @@ def run_dir(create: bool = True) -> str:
     processes spawned later (their env is a copy of ours,
     ``manager/topology.py``) land their rings next to the
     coordinator's."""
-    d = os.environ.get("NBD_RUN_DIR")
+    from ..utils import knobs
+    d = knobs.get_str("NBD_RUN_DIR")
     if not d:
         d = os.path.join(tempfile.gettempdir(), "nbd_runs",
                          f"run-{int(time.time())}-{os.getpid()}")
@@ -376,8 +378,9 @@ def init(proc: str, *, directory: str | None = None):
             return _RECORDER
         try:
             d = directory or run_dir()
-            size = int(os.environ.get("NBD_FLIGHT_RING_BYTES",
-                                      DEFAULT_RING_BYTES))
+            from ..utils import knobs
+            size = knobs.get_int("NBD_FLIGHT_RING_BYTES",
+                                 DEFAULT_RING_BYTES)
             _RECORDER = FlightRecorder(ring_path(d, proc), size)
         except Exception:
             _RECORDER = _NullRecorder()

@@ -34,6 +34,7 @@ import time
 
 from . import export as obs_export
 from . import flightrec
+from ..utils import knobs
 
 
 def flight_to_trace_dump(ring: dict | None) -> dict:
@@ -78,7 +79,7 @@ def _next_bundle_dir(root: str) -> str:
 
 def list_bundles(directory: str | None = None) -> list[str]:
     """Bundle directories under the run dir, oldest → newest."""
-    d = directory or os.environ.get("NBD_RUN_DIR")
+    d = directory or knobs.get_str("NBD_RUN_DIR")
     if not d or not os.path.isdir(d):
         return []
     out = [os.path.join(d, n) for n in sorted(os.listdir(d))

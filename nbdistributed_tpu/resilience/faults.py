@@ -230,7 +230,9 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls, var: str = "NBD_FAULT_PLAN") -> "FaultPlan | None":
-        raw = os.environ.get(var)
+        from ..utils import knobs
+        raw = (knobs.get_raw(var) if var in knobs.KNOBS
+               else os.environ.get(var))
         if not raw:
             return None
         return cls.from_spec(json.loads(raw))

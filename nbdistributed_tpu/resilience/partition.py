@@ -34,7 +34,6 @@ paying nothing).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
@@ -49,12 +48,9 @@ EXPIRED = "expired"
 
 
 def partition_grace_s(env=None) -> float:
-    env = os.environ if env is None else env
-    try:
-        return float(env.get("NBD_PARTITION_GRACE_S",
-                             DEFAULT_PARTITION_GRACE_S))
-    except (TypeError, ValueError):
-        return DEFAULT_PARTITION_GRACE_S
+    from ..utils import knobs
+    return knobs.get_float("NBD_PARTITION_GRACE_S",
+                           float(DEFAULT_PARTITION_GRACE_S), env=env)
 
 
 def format_link_suffix(host_stats: dict) -> str:

@@ -92,11 +92,12 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls, env=None) -> "RetryPolicy | None":
-        env = os.environ if env is None else env
-        raw = env.get("NBD_RETRY_TIMEOUT_S")
+        from ..utils import knobs
+        raw = knobs.get_raw("NBD_RETRY_TIMEOUT_S", env=env)
         if not raw:
             return None
-        return cls(attempts=max(1, int(env.get("NBD_RETRY_ATTEMPTS", "4"))),
+        return cls(attempts=max(1, knobs.get_int("NBD_RETRY_ATTEMPTS",
+                                                 4, env=env)),
                    attempt_timeout_s=float(raw))
 
     @classmethod

@@ -44,6 +44,8 @@ import shutil
 import tempfile
 import time
 
+from ..utils import knobs
+
 MANIFEST_NAME = "session.json"
 LOCK_NAME = "session.lock"
 MANIFEST_VERSION = 1
@@ -209,9 +211,9 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
     """
     root = root or default_runs_root()
     if ttl_s is None:
-        ttl_s = float(os.environ.get("NBD_GC_TTL_S", DEFAULT_GC_TTL_S))
+        ttl_s = knobs.get_float("NBD_GC_TTL_S", float(DEFAULT_GC_TTL_S))
     now = now if now is not None else time.time()
-    current = os.environ.get("NBD_RUN_DIR")
+    current = knobs.get_str("NBD_RUN_DIR")
     current = os.path.realpath(current) if current else None
     swept: list[str] = []
     kept: list[str] = []
@@ -299,7 +301,7 @@ def discover_run_dir() -> str | None:
     """Best reattach candidate when the caller names none: the env run
     dir if it holds a manifest, else the newest sibling under the runs
     root whose manifest still has live pids."""
-    env = os.environ.get("NBD_RUN_DIR")
+    env = knobs.get_str("NBD_RUN_DIR")
     if env and read_manifest(env) is not None:
         return env
     root = default_runs_root()
@@ -370,7 +372,7 @@ def attach(run_dir: str | None = None, *, attach_timeout: float = 90.0,
         # one — restored on ANY failure below, so a failed attach
         # doesn't leave this kernel pointed at (and a later %dist_init
         # clobbering) a fleet it never joined.
-        prev_run_dir = os.environ.get("NBD_RUN_DIR")
+        prev_run_dir = knobs.get_str("NBD_RUN_DIR")
         os.environ["NBD_RUN_DIR"] = run_dir
         comm = None
         try:
@@ -452,7 +454,7 @@ def refresh_after_heal(comm, pm) -> dict | None:
     """Manifest upkeep after a supervisor heal: the respawned fleet's
     pids/endpoint replace the dead ones, or a later ``%dist_attach``
     would adopt corpses.  No-op (None) without a run dir or manifest."""
-    run_dir = os.environ.get("NBD_RUN_DIR")
+    run_dir = knobs.get_str("NBD_RUN_DIR")
     if not run_dir:
         return None
     pids = {}

@@ -179,15 +179,17 @@ class Supervisor:
         self._wake.set()
 
     def _transition(self, rank, to: str, detail: str = "") -> None:
-        # lock held by caller
-        frm = self._state.get(rank)
-        if frm == to:
-            return
-        if rank is not None:
-            self._state[rank] = to
-        self.transitions += 1
-        self.events.append({"ts": self._clock(), "rank": rank,
-                            "from": frm, "to": to, "detail": detail})
+        # Callers hold the lock; re-acquiring the RLock here costs
+        # nothing and keeps the method safe for the stray direct call.
+        with self._lock:
+            frm = self._state.get(rank)
+            if frm == to:
+                return
+            if rank is not None:
+                self._state[rank] = to
+            self.transitions += 1
+            self.events.append({"ts": self._clock(), "rank": rank,
+                                "from": frm, "to": to, "detail": detail})
         # Mirror every transition into the crash-surviving flight ring:
         # the in-memory event deque dies with the coordinator process.
         flightrec.record("supervisor_transition", rank=rank,
@@ -392,8 +394,8 @@ class Supervisor:
         try:
             result = heal() if heal is not None else None
         except Exception as e:
-            self.heals_failed += 1
             with self._lock:
+                self.heals_failed += 1
                 for r in list(self._state):
                     self._transition(r, DEAD, f"heal failed: {e}")
                 # Transient respawn failures (port in TIME_WAIT, slow
@@ -407,13 +409,14 @@ class Supervisor:
             # stop() raced the (slow) respawn: the heal callback may
             # have brought a world up that nobody is supervising now.
             # Don't rebind — surface it so the operator can decide.
-            self.transitions += 1
-            self.events.append({
-                "ts": self._clock(), "rank": None,
-                "from": HEALING, "to": ALIVE,
-                "detail": "heal completed AFTER supervisor stop — the "
-                          "respawned world is unsupervised; shut it "
-                          "down manually if unwanted"})
+            with self._lock:
+                self.transitions += 1
+                self.events.append({
+                    "ts": self._clock(), "rank": None,
+                    "from": HEALING, "to": ALIVE,
+                    "detail": "heal completed AFTER supervisor stop — "
+                              "the respawned world is unsupervised; "
+                              "shut it down manually if unwanted"})
             return
         with self._lock:
             if result is not None:

@@ -79,9 +79,24 @@ from dataclasses import dataclass
 
 from ..observability import flightrec
 from ..observability import metrics as obs_metrics
+from ..utils import knobs
 from .partition import PartitionSentry
 
 LADDER_STEPS = ("warn", "dump", "interrupt", "heal")
+
+
+def _preflight_note(cell_sha1: str | None) -> dict | None:
+    """The pre-dispatch lint finding recorded for this cell's source
+    hash, if the analyzer flagged it (analysis/preflight) — a hang
+    verdict landing on a flagged cell cites it, closing the loop
+    between the static warning and the runtime failure."""
+    if not cell_sha1:
+        return None
+    try:
+        from ..analysis import preflight
+        return preflight.lookup(cell_sha1)
+    except Exception:
+        return None
 
 
 def parse_ladder(raw: str) -> tuple[str, ...]:
@@ -121,23 +136,18 @@ class HangPolicy:
 
     @classmethod
     def from_env(cls, env=None) -> "HangPolicy":
-        env = os.environ if env is None else env
-
-        def _f(name: str, default: float) -> float:
-            try:
-                return float(env.get(name, default))
-            except (TypeError, ValueError):
-                return default
-
         kw: dict = {
-            "enabled": str(env.get("NBD_HANG", "1")).lower()
-            not in ("0", "false", "off"),
-            "poll_s": _f("NBD_HANG_POLL_S", cls.poll_s),
-            "skew_s": _f("NBD_HANG_SKEW_S", cls.skew_s),
-            "stall_s": _f("NBD_HANG_STALL_S", cls.stall_s),
-            "grace_s": _f("NBD_HANG_GRACE_S", cls.grace_s),
+            "enabled": knobs.get_bool("NBD_HANG", True, env=env),
+            "poll_s": knobs.get_float("NBD_HANG_POLL_S", cls.poll_s,
+                                      env=env),
+            "skew_s": knobs.get_float("NBD_HANG_SKEW_S", cls.skew_s,
+                                      env=env),
+            "stall_s": knobs.get_float("NBD_HANG_STALL_S", cls.stall_s,
+                                       env=env),
+            "grace_s": knobs.get_float("NBD_HANG_GRACE_S", cls.grace_s,
+                                       env=env),
         }
-        raw = env.get("NBD_HANG_ESCALATE")
+        raw = knobs.get_str("NBD_HANG_ESCALATE", env=env)
         if raw:
             kw["escalate"] = parse_ladder(raw)
         return cls(**kw)
@@ -552,6 +562,13 @@ class HangWatchdog:
                     # Newly HUNG — distinct from slow, by construction.
                     st = {"step": 0, "next_ts": now, "first_ts": now,
                           "verdict": v}
+                    # The analyzer told you so: when the hung cell was
+                    # flagged pre-dispatch, the verdict carries the
+                    # finding (the doctor and postmortem render it).
+                    note = _preflight_note(
+                        (pending.get(cell) or {}).get("cell_sha1"))
+                    if note:
+                        st["preflight"] = note["summary"]
                     self._hangs[cell] = st
                     self.cells_flagged += 1
                     self.verdicts_total += 1
@@ -561,10 +578,19 @@ class HangWatchdog:
                     flightrec.record("hang_verdict", kind=v["kind"],
                                      cell=str(cell)[:16],
                                      ranks=v["ranks"], seq=v.get("seq"),
-                                     op=v.get("op"))
+                                     op=v.get("op"),
+                                     preflight=st.get("preflight"))
                     self._event("verdict", v["detail"], cell=cell,
                                 kind=v["kind"], ranks=v["ranks"])
+                    if "preflight" in st:
+                        self._event(
+                            "preflight",
+                            "pre-flight lint had flagged this cell "
+                            "before dispatch: " + st["preflight"],
+                            cell=cell)
                 st["verdict"] = v
+                if "preflight" in st:
+                    v["preflight"] = st["preflight"]
                 ladder = self.policy.escalate
                 if st["step"] < len(ladder) and now >= st["next_ts"]:
                     step = ladder[st["step"]]
@@ -594,12 +620,15 @@ class HangWatchdog:
     # escalation ladder
 
     def _event(self, event: str, detail: str, **extra) -> None:
-        # lock held by callers that mutate; the deque is thread-safe
-        self.events.append({"ts": self._clock(), "event": event,
-                            "detail": detail, **extra})
+        # Callers arrive with and without the lock held; the RLock
+        # makes re-acquiring free for the former.
+        with self._lock:
+            self.events.append({"ts": self._clock(), "event": event,
+                                "detail": detail, **extra})
 
     def _run_step(self, step: str, cell, verdict: dict) -> None:
-        self.escalations[step] = self.escalations.get(step, 0) + 1
+        with self._lock:
+            self.escalations[step] = self.escalations.get(step, 0) + 1
         obs_metrics.registry().counter(
             "nbd_hang_escalations_total",
             "escalation ladder steps executed",
@@ -613,6 +642,9 @@ class HangWatchdog:
                 print(f"\n⚠️ hang watchdog [{verdict['kind'].upper()}]: "
                       f"{verdict['detail']} — %dist_doctor for the "
                       f"full report")
+                if verdict.get("preflight"):
+                    print(f"   ↳ pre-flight lint flagged this cell "
+                          f"before dispatch: {verdict['preflight']}")
             elif step == "dump":
                 pm = self._pm
                 if pm is not None and hasattr(pm, "dump_stacks"):
@@ -620,7 +652,7 @@ class HangWatchdog:
                     self._event("stacks",
                                 f"SIGUSR1 stack dump → ranks "
                                 f"{signaled} (stacks-rank*.txt under "
-                                f"{os.environ.get('NBD_RUN_DIR', '?')})",
+                                f"{knobs.get_str('NBD_RUN_DIR', '?')})",
                                 cell=cell)
             elif step == "interrupt":
                 # Interrupt ALL ranks, not just the laggards: peers
@@ -854,12 +886,20 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
             lines.append(f"   {mid[:12]}… {p.get('type') or '?'} "
                          f"age {age} · responded {p['responded']} · "
                          f"waiting on {missing}")
+            note = _preflight_note(p.get("cell_sha1"))
+            if note:
+                lines.append(f"      ↳ pre-flight lint flagged this "
+                             f"cell before dispatch: "
+                             f"{note['summary']}")
     # Verdicts.
     lines.append("")
     if verdicts:
         lines.append("verdicts:")
         for v in verdicts:
             lines.append(f"   ⚠ HUNG [{v['kind']}] {v['detail']}")
+            if v.get("preflight"):
+                lines.append(f"      ↳ pre-flight lint flagged this "
+                             f"cell before dispatch: {v['preflight']}")
     elif wd is not None:
         lines.append("verdicts: none — nothing HUNG by current policy")
     else:
@@ -868,7 +908,7 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
     if wd is not None and wd.escalations:
         lines.append(f"escalations so far: {dict(wd.escalations)}")
     # Stacks: freshly dumped, then read back.
-    run_d = os.environ.get("NBD_RUN_DIR") or ""
+    run_d = knobs.get_str("NBD_RUN_DIR") or ""
     if dump_stacks and pm is not None and hasattr(pm, "dump_stacks"):
         signaled = pm.dump_stacks(None)
         if signaled:

@@ -37,6 +37,7 @@ from ..observability import spans as obs_spans
 from ..observability import telemetry as obs_telemetry
 from ..resilience.dedup import _READ_ONLY, ReplayCache, ResultMailbox
 from ..resilience.faults import FaultPlan
+from ..utils import knobs
 from . import collective_guard, executor, introspect
 from .interrupt import InterruptGate
 
@@ -89,25 +90,22 @@ class DistributedWorker:
         # coordinator resumes THIS session; the epoch fences stale
         # coordinators out (only a hello may raise it); the mailbox
         # parks results whose reply had no coordinator to land on.
-        self._session_token = os.environ.get("NBD_SESSION_TOKEN") or None
-        self._epoch = int(os.environ.get("NBD_SESSION_EPOCH", "0") or 0)
+        self._session_token = knobs.get_str("NBD_SESSION_TOKEN") or None
+        self._epoch = knobs.get_int("NBD_SESSION_EPOCH", 0)
         # Host labels (multi-host worlds, ISSUE 6): which host this
         # worker runs on and which host the coordinator runs on — the
         # link-fault layer shapes frames by this pair, and the orphan
         # reconnect loop refuses to dial through a partitioned link.
-        self._host_label = os.environ.get("NBD_HOST") or "local"
-        self._coord_label = os.environ.get("NBD_COORD_HOST") or "local"
+        self._host_label = knobs.get_str("NBD_HOST") or "local"
+        self._coord_label = knobs.get_str("NBD_COORD_HOST") or "local"
         # Manifest mirror (partition tolerance): multi-host worlds
         # share no run-dir filesystem, so the coordinator mirrors its
         # session manifest to every worker in the hello exchange — the
         # reconnect loop's endpoint discovery works from this copy when
         # no shared NBD_RUN_DIR manifest exists.
         self._manifest_mirror: dict | None = None
-        try:
-            self._orphan_ttl = float(
-                os.environ.get("NBD_ORPHAN_TTL_S", DEFAULT_ORPHAN_TTL_S))
-        except ValueError:
-            self._orphan_ttl = DEFAULT_ORPHAN_TTL_S
+        self._orphan_ttl = knobs.get_float("NBD_ORPHAN_TTL_S",
+                                           float(DEFAULT_ORPHAN_TTL_S))
         self._mailbox = ResultMailbox()
         self._orphaned = False
         self._hb_fail_streak = 0
@@ -139,8 +137,7 @@ class DistributedWorker:
         # per-cell deadline, and the collective-progress snapshot from
         # the guard — the coordinator-side watchdog's raw material.
         # Disabled, the heartbeat pays exactly one flag check.
-        self._hang_enabled = os.environ.get(
-            "NBD_HANG", "1").lower() not in ("0", "false", "off")
+        self._hang_enabled = knobs.get_bool("NBD_HANG", True)
         # Stack dump on demand: SIGUSR1 makes faulthandler write every
         # thread's traceback to a per-rank file under the run dir —
         # the %dist_doctor's view INTO a wedged rank (works even while
@@ -216,7 +213,7 @@ class DistributedWorker:
         # Endpoint + auth kept for the orphan reconnect loop.
         self._coordinator_host = coordinator_host
         self._control_port = control_port
-        self._auth_token = os.environ.get("NBD_AUTH_TOKEN") or None
+        self._auth_token = knobs.get_str("NBD_AUTH_TOKEN") or None
         self.channel = WorkerChannel(
             coordinator_host, control_port, rank=rank,
             auth_token=self._auth_token)
@@ -896,7 +893,7 @@ class DistributedWorker:
         on a recycled port), not a coordinator.  A same-epoch endpoint
         is the ORIGINAL coordinator (transient reconnect) and may
         legitimately be idle, so no traffic is demanded of it."""
-        d = os.environ.get("NBD_RUN_DIR")
+        d = knobs.get_str("NBD_RUN_DIR")
         candidates = []
         if d:
             try:

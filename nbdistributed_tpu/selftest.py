@@ -16,6 +16,8 @@ import sys
 import tempfile
 import time
 
+from .utils import knobs as _knobs
+
 
 def main() -> int:
     from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
@@ -187,7 +189,7 @@ _ssrv.run_until_done(max_steps=20)
         # (Duplicate-only because this manager has no retry policy —
         # dropped frames would surface as request timeouts, which the
         # chaos integration test covers with retries enabled.)
-        if os.environ.get("NBD_SELFTEST_FAULTS"):
+        if _knobs.get_raw("NBD_SELFTEST_FAULTS"):
             from nbdistributed_tpu.resilience import FaultPlan
             comm.send_to_all(
                 "chaos", {"action": "set",
@@ -214,7 +216,7 @@ _ssrv.run_until_done(max_steps=20)
         # 2-rank cell end-to-end and assert the merged Chrome-trace
         # export carries spans from the coordinator AND every rank,
         # stitched under one trace id.
-        if os.environ.get("NBD_SELFTEST_OBS"):
+        if _knobs.get_raw("NBD_SELFTEST_OBS"):
             from nbdistributed_tpu.observability import export as _obs_exp
             comm.send_to_all("trace", {"action": "start",
                                        "trace_id": "selftest0trace00"},
@@ -266,7 +268,7 @@ _ssrv.run_until_done(max_steps=20)
                           if e.get("cat") == "flight"]
                 pids = {e["pid"] for e in flight}
                 rings = flightrec.find_rings(
-                    os.environ.get("NBD_RUN_DIR", ""))
+                    _knobs.get_str("NBD_RUN_DIR", ""))
                 ok = {-1, 0, 1} <= pids and len(rings) >= 3
                 detail = (f"flight pids={sorted(pids)} "
                           f"rings={len(rings)} dir={manifest['dir']}")

@@ -1,0 +1,218 @@
+"""The NBD_* environment-knob registry — every env knob in one table.
+
+Every ``NBD_*`` variable the framework (or its tools/bench harness)
+reads MUST be declared here.  The declaration is load-bearing three
+ways:
+
+- the accessors below are the one choke point for env reads, so a
+  typo'd knob name fails fast instead of silently reading nothing;
+- ``tools/nbd_lint.py --self`` (analysis/selfcheck.py) walks the tree
+  and fails CI on any ``NBD_*`` string that is not declared here, and
+  on any declared knob missing from README's configuration reference;
+- :func:`knob_table_markdown` renders the README "Configuration
+  reference" table from this registry, so docs cannot drift from code.
+
+Stdlib-only and import-light on purpose: resilience/ and
+observability/ modules import this at startup.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str | None   # shown in docs; None = unset/required-by-context
+    kind: str             # str | int | float | bool | json | path
+    doc: str
+    scope: str = "core"   # grouping for the README table
+
+
+def _k(name, default, kind, doc, scope="core"):
+    return Knob(name, default, kind, doc, scope)
+
+
+_ALL = (
+    # --- core / topology ------------------------------------------------
+    _k("NBD_RUN_DIR", None, "path",
+       "Shared per-session run directory (flight rings, stack dumps, "
+       "session manifest, postmortem bundles). Minted and exported by "
+       "the first coordinator when unset."),
+    _k("NBD_HOST", "local", "str",
+       "This process's host label in a multi-host world (set by the "
+       "launch plan; feeds link-fault shaping and per-host status)."),
+    _k("NBD_COORD_HOST", "local", "str",
+       "The coordinator's host label as seen by a worker (set by the "
+       "launch plan; the worker side of each link pair)."),
+    _k("NBD_NATIVE", None, "str",
+       "Control-plane transport override: 1 = require the native C++ "
+       "listener, 0 = force the pure-Python one, unset = auto."),
+    _k("NBD_AUTH_TOKEN", None, "str",
+       "Shared secret for non-loopback control-plane binds (multi-host "
+       "worlds); shipped to workers via their environment."),
+    _k("NBD_AGENT_TOKEN", None, "str",
+       "Admission secret for dialing nbd_agent host daemons "
+       "(%dist_init --agents); distinct from the per-session token."),
+    _k("NBD_AGENT_READY", None, "str",
+       "Set by tools/nbd_agent.py in its readiness line (internal "
+       "handshake marker for launchers that scrape agent stdout)."),
+    # --- durable sessions ----------------------------------------------
+    _k("NBD_SESSION_TOKEN", None, "str",
+       "Durable-session identity a worker was spawned under (set by "
+       "%dist_init; proves a reattaching coordinator resumes THIS "
+       "session).", "session"),
+    _k("NBD_SESSION_EPOCH", "0", "int",
+       "Session epoch a worker was spawned under; only a hello "
+       "exchange may raise it (stale-coordinator fencing).", "session"),
+    _k("NBD_ORPHAN_TTL_S", "600", "float",
+       "Seconds an orphaned worker (coordinator gone) keeps running "
+       "and reattachable before self-terminating; 0 = legacy exit-on-"
+       "disconnect.", "session"),
+    _k("NBD_GC_TTL_S", "21600", "float",
+       "Stale-run age for %dist_gc / nbd-gc sweeps of abandoned "
+       "session run dirs.", "session"),
+    # --- retry / redelivery ---------------------------------------------
+    _k("NBD_RETRY_TIMEOUT_S", None, "float",
+       "Per-attempt response wait; PRESENCE enables request "
+       "redelivery.", "retry"),
+    _k("NBD_RETRY_ATTEMPTS", "4", "int",
+       "Total deliveries per request (1 initial + N-1 redeliveries).",
+       "retry"),
+    _k("NBD_RETRY_CLASS_BULK_TIMEOUT_S", None, "float",
+       "Bulk-class (push/pull/checkpoint) per-attempt budget override.",
+       "retry"),
+    _k("NBD_RETRY_CLASS_BULK_ATTEMPTS", None, "int",
+       "Bulk-class delivery-count override.", "retry"),
+    _k("NBD_RETRY_CLASS_CONTROL_TIMEOUT_S", None, "float",
+       "Control-class per-attempt budget override.", "retry"),
+    _k("NBD_RETRY_CLASS_CONTROL_ATTEMPTS", None, "int",
+       "Control-class delivery-count override.", "retry"),
+    # --- chaos / fault injection ----------------------------------------
+    _k("NBD_FAULT_PLAN", None, "json",
+       "Spawn-time deterministic fault-plan spec (the %dist_chaos "
+       "knobs as JSON) — CI's chaos entry point.", "chaos"),
+    # --- hang watchdog ---------------------------------------------------
+    _k("NBD_HANG", "1", "bool",
+       "Master switch for hang detection; 0 also drops the heartbeat "
+       "collective-position piggyback at worker spawn.", "hang"),
+    _k("NBD_HANG_POLL_S", "1.0", "float",
+       "Watchdog poll cadence.", "hang"),
+    _k("NBD_HANG_SKEW_S", "20", "float",
+       "Cross-rank lag persistence before a skew verdict.", "hang"),
+    _k("NBD_HANG_STALL_S", "120", "float",
+       "Busy-with-zero-collective-progress window before a stall "
+       "verdict.", "hang"),
+    _k("NBD_HANG_GRACE_S", "15", "float",
+       "Pause between escalation-ladder steps.", "hang"),
+    _k("NBD_HANG_ESCALATE", "warn,dump", "str",
+       "Escalation ladder, comma-separated from: warn, dump, "
+       "interrupt, heal.", "hang"),
+    _k("NBD_PARTITION_GRACE_S", "30", "float",
+       "Whole-host silence grace before a suspected partition is "
+       "declared lost and healing proceeds.", "hang"),
+    # --- flight recorder / observability ---------------------------------
+    _k("NBD_FLIGHT", "1", "bool",
+       "Always-on mmap flight recorder; 0 disables.", "observability"),
+    _k("NBD_FLIGHT_RING_BYTES", "262144", "int",
+       "Flight-recorder ring-file capacity per process.",
+       "observability"),
+    # --- static analysis -------------------------------------------------
+    _k("NBD_LINT", "warn", "str",
+       "Default pre-dispatch cell-vetting mode: warn (annotate), "
+       "strict (block cells with error findings), off.", "lint"),
+    # --- selftest / bench / tools ---------------------------------------
+    _k("NBD_SELFTEST_FAULTS", None, "bool",
+       "nbd-selftest: also run the fault-injection smoke section.",
+       "harness"),
+    _k("NBD_SELFTEST_OBS", None, "bool",
+       "nbd-selftest: also run the observability/postmortem sections.",
+       "harness"),
+    _k("NBD_BENCH_ONLY", None, "str",
+       "bench.py: comma-separated benchmark families to run.",
+       "harness"),
+    _k("NBD_BENCH_WORLD", None, "int",
+       "bench.py: world size override for multi-process rows.",
+       "harness"),
+    _k("NBD_BENCH_FAMILY_BUDGET_S", None, "float",
+       "bench.py: per-family wall-clock budget.", "harness"),
+    _k("NBD_PROBE_CPU_SMOKE", None, "bool",
+       "tools/probe_timing.py: run the CPU smoke variant.", "harness"),
+)
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _ALL}
+
+# Dynamically-composed knob-name prefixes (f-string builders like
+# retry.py's NBD_RETRY_CLASS_<CLASS>_*).  The self-lint accepts a bare
+# string constant ending in "_" only when it is declared here.
+PREFIXES: frozenset[str] = frozenset({"NBD_RETRY_CLASS_"})
+
+_FALSE = ("0", "false", "off")
+
+
+def _declared(name: str) -> Knob:
+    k = KNOBS.get(name)
+    if k is None:
+        raise KeyError(
+            f"{name} is not a declared knob — add it to "
+            f"nbdistributed_tpu/utils/knobs.py (and README's "
+            f"configuration reference)")
+    return k
+
+
+def get_raw(name: str, default: str | None = None, *,
+            env=None) -> str | None:
+    """The raw env value of a DECLARED knob (None when unset and no
+    default given).  ``env`` substitutes a mapping for testing —
+    the same convention the from_env constructors already use."""
+    _declared(name)
+    return (os.environ if env is None else env).get(name, default)
+
+
+def get_str(name: str, default: str | None = None, *,
+            env=None) -> str | None:
+    return get_raw(name, default, env=env)
+
+
+def get_float(name: str, default: float, *, env=None) -> float:
+    """Float knob; malformed values fall back to ``default`` (an env
+    typo must degrade, not crash a worker at spawn)."""
+    raw = get_raw(name, env=env)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def get_int(name: str, default: int, *, env=None) -> int:
+    raw = get_raw(name, env=env)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def get_bool(name: str, default: bool = False, *, env=None) -> bool:
+    """Bool knob: unset → default; "0"/"false"/"off" (any case) →
+    False; anything else truthy."""
+    raw = get_raw(name, env=env)
+    if raw is None or raw == "":
+        return default
+    return str(raw).lower() not in _FALSE
+
+
+def knob_table_markdown() -> str:
+    """Render the registry as the README "Configuration reference"
+    markdown table (regenerate with ``nbd-lint --knob-table``)."""
+    lines = ["| Knob | Default | Type | What it does |",
+             "|------|---------|------|--------------|"]
+    for k in _ALL:
+        default = "–" if k.default is None else f"`{k.default}`"
+        lines.append(f"| `{k.name}` | {default} | {k.kind} | {k.doc} |")
+    return "\n".join(lines)

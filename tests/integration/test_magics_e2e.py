@@ -668,3 +668,56 @@ def test_watchdog_and_doctor_magics(ip, capsys):
     assert DistributedMagics._watchdog is None
     ip.run_line_magic("dist_watchdog", "status")
     assert "not running" in capsys.readouterr().out
+
+
+def test_dist_lint_strict_blocks_hazardous_cell(ip, capsys):
+    # The PR 5 frozen-rank cell shape, caught BEFORE dispatch: under
+    # strict vetting the cell never ships, so the live fleet cannot
+    # deadlock (no watchdog/interrupt needed to clean up after it).
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    ip.run_line_magic("dist_lint", "strict")
+    capsys.readouterr()
+    run(ip, "%%distributed\n"
+            "import jax.numpy as jnp\n"
+            "if rank == 0:\n"
+            "    _hz = all_reduce(jnp.ones(1))\n"
+            "'hz-done'")
+    out = capsys.readouterr().out
+    assert "rank-conditional-collective" in out
+    assert "NOT dispatched" in out
+    assert "hz-done" not in out
+    ip.run_line_magic("dist_lint", "warn")
+    capsys.readouterr()
+    assert DistributedMagics._lint_mode == "warn"
+
+
+def test_distributed_strict_flag_blocks_one_cell(ip, capsys):
+    run(ip, "%%distributed --strict\n"
+            "if rank == 1:\n"
+            "    _hz2 = barrier()\n"
+            "'hz2-done'")
+    out = capsys.readouterr().out
+    assert "NOT dispatched" in out and "hz2-done" not in out
+    # The flag is per-cell: the next plain cell dispatches normally.
+    run(ip, "%%distributed\nlint_ok = rank + 70\nlint_ok")
+    out = capsys.readouterr().out
+    assert "70" in out and "71" in out
+
+
+def test_dist_lint_warn_annotates_but_dispatches(ip, capsys):
+    # Warning-severity finding (host sync in a loop): annotated inline,
+    # cell still runs on every rank.
+    run(ip, "%%distributed\n"
+            "for _li in range(2):\n"
+            "    print(_li * rank)\n"
+            "'warn-done'")
+    out = capsys.readouterr().out
+    assert "host-sync-in-loop" in out
+    assert "warn-done" in out
+
+
+def test_dist_lint_status_counts_findings(ip, capsys):
+    ip.run_line_magic("dist_lint", "status")
+    out = capsys.readouterr().out
+    assert "cell vetting: warn" in out
+    assert "rank-conditional-collective" in out  # counted earlier

@@ -1,0 +1,711 @@
+"""Static-analysis tests (ISSUE 7): the pre-dispatch SPMD cell
+analyzer (rule-by-rule, plus the never-block-on-unparseable contract),
+the IPython source-stripping helper, the preflight finding memory, the
+env-knob registry accessors, and the framework self-lint passes —
+including the acceptance gates: the PR 5 frozen-rank hang cell is an
+error pre-dispatch, the analyzer has zero error-severity false
+positives over the examples/ notebooks and the selftest corpus, and
+``run_self_lint`` is clean over this very checkout (the CI
+``static-analysis`` job as a test)."""
+
+import ast
+import json
+import os
+
+import pytest
+
+from nbdistributed_tpu.analysis import (cellcheck, ipycompat, preflight,
+                                        strip_ipython, vet_cell)
+from nbdistributed_tpu.analysis.selfcheck import (_ThreadPass,
+                                                  check_env_knobs,
+                                                  run_self_lint)
+from nbdistributed_tpu.utils import knobs
+
+pytestmark = [pytest.mark.unit, pytest.mark.lint]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The exact cell shape tests/integration/test_hang_watchdog.py wedges:
+# rank 1's in-branch all_reduce is collective #2 for rank 1 only.
+HANG_CELL = """
+import jax.numpy as jnp
+a = all_reduce(jnp.ones(2))        # collective #1: both ranks join
+if rank == 1:
+    b = all_reduce(a)              # collective #2: frozen by the plan
+'done-%d' % rank
+"""
+
+
+def rules(res, severity=None):
+    return [f.rule for f in res.findings
+            if severity is None or f.severity == severity]
+
+
+# ----------------------------------------------------------------------
+# rank-conditional collectives
+
+
+def test_frozen_rank_hang_cell_is_an_error_pre_dispatch():
+    res = vet_cell(HANG_CELL)
+    assert res.parsed
+    errs = res.errors
+    assert [f.rule for f in errs] == ["rank-conditional-collective"]
+    # The finding points at the in-branch collective, not the safe one.
+    assert errs[0].line == 5
+    assert "all_reduce" in errs[0].message
+
+
+def test_process_index_branch_flagged():
+    res = vet_cell("if jax.process_index() == 0:\n    barrier()")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_while_on_rank_flagged():
+    res = vet_cell("while rank < 1:\n    x = all_reduce(x)")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_ternary_on_rank_flagged():
+    res = vet_cell("x = all_reduce(y) if rank == 0 else y")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_rank_conditional_collective_inside_def_body():
+    # A def body runs when every rank calls it — the branch inside
+    # still diverges, including through the return value expression.
+    res = vet_cell("def step():\n"
+                   "    if rank == 0:\n"
+                   "        return all_reduce(x)")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_match_on_rank_flagged():
+    res = vet_cell("match rank:\n"
+                   "    case 0:\n"
+                   "        all_reduce(x)\n"
+                   "    case _:\n"
+                   "        pass\n")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+    # A rank-dependent case GUARD diverges the same way.
+    res = vet_cell("match mode:\n"
+                   "    case 'a' if rank == 0:\n"
+                   "        barrier()\n")
+    assert rules(res, "error") == ["rank-conditional-collective"]
+    # Uniform subject, uniform guards: clean.
+    assert not vet_cell("match mode:\n"
+                        "    case 'a':\n"
+                        "        x = all_reduce(x)\n").findings
+
+
+def test_match_on_rank_exit_desyncs():
+    res = vet_cell("match rank:\n"
+                   "    case 0:\n"
+                   "        raise ValueError('x')\n"
+                   "y = all_reduce(x)\n")
+    assert rules(res, "error") == ["rank-conditional-exit"]
+
+
+def test_uniform_condition_is_clean():
+    assert not vet_cell(
+        "if step % 10 == 0:\n    x = all_reduce(x)").findings
+
+
+def test_collective_outside_branch_is_clean():
+    assert not vet_cell(
+        "x = all_reduce(x)\nif rank == 0:\n    print('saved')"
+    ).errors
+
+
+def test_rank_conditional_def_definition_is_not_a_collective():
+    # Defining a helper under a rank branch executes no collective.
+    res = vet_cell("if rank == 0:\n"
+                   "    def helper():\n"
+                   "        return all_reduce(x)")
+    assert "rank-conditional-collective" not in rules(res, "error")
+
+
+# ----------------------------------------------------------------------
+# rank-conditional exits
+
+
+def test_raise_before_collectives_desyncs():
+    res = vet_cell("if rank == 0:\n"
+                   "    raise ValueError('x')\n"
+                   "y = all_reduce(x)")
+    assert rules(res, "error") == ["rank-conditional-exit"]
+
+
+def test_raise_after_last_collective_is_clean():
+    assert not vet_cell("x = all_reduce(x)\n"
+                        "if rank == 0:\n"
+                        "    raise ValueError(str(x))").errors
+
+
+def test_break_skipping_loop_collectives_desyncs():
+    res = vet_cell("for i in range(5):\n"
+                   "    if rank == 1:\n"
+                   "        break\n"
+                   "    x = all_reduce(x)")
+    assert rules(res, "error") == ["rank-conditional-exit"]
+
+
+def test_break_in_while_training_loop_desyncs():
+    # The most common SPMD loop shape: collectives at the top of a
+    # while body, rank-conditional break below — the break skips the
+    # remaining ITERATIONS' collectives.
+    res = vet_cell("while step < 10:\n"
+                   "    g = all_reduce(g)\n"
+                   "    if rank == 0:\n"
+                   "        break")
+    assert rules(res, "error") == ["rank-conditional-exit"]
+
+
+def test_break_on_uniform_condition_is_clean():
+    assert not vet_cell("for i in range(5):\n"
+                        "    if done:\n"
+                        "        break\n"
+                        "    x = all_reduce(x)").errors
+
+
+# ----------------------------------------------------------------------
+# subset rankspec vs collectives
+
+
+def test_subset_collective_call_is_an_error():
+    res = vet_cell("y = all_reduce(x)", ranks=[0], world=4)
+    assert rules(res, "error") == ["subset-collective"]
+
+
+def test_subset_bare_reference_is_a_warning():
+    res = vet_cell("alias = all_reduce", ranks=[0], world=4)
+    assert rules(res) == ["subset-collective-ref"]
+    assert not res.errors
+
+
+def test_subset_collective_inside_def_is_a_warning():
+    res = vet_cell("def f():\n    return all_reduce(x)",
+                   ranks=[0, 2], world=4)
+    assert "subset-collective" in rules(res, "warning")
+    assert not res.errors
+
+
+def test_full_world_collective_is_clean():
+    assert not vet_cell("y = all_reduce(x)",
+                        ranks=[0, 1, 2, 3], world=4).findings
+    # Duplicate rank listings still cover the world.
+    assert not vet_cell("y = all_reduce(x)",
+                        ranks=[0, 0, 1], world=2).findings
+
+
+# ----------------------------------------------------------------------
+# host syncs in loops (perf lints stay warnings)
+
+
+@pytest.mark.parametrize("cell", [
+    "for i in range(10):\n    tot += loss.item()",
+    "while True:\n    y = jax.device_get(x)",
+    "for i in range(3):\n    print(loss)",
+    "for i in range(3):\n    vals = x.tolist()",
+])
+def test_host_sync_in_loop_warns(cell):
+    res = vet_cell(cell)
+    assert rules(res) == ["host-sync-in-loop"]
+    assert not res.errors
+
+
+def test_host_sync_outside_loop_is_clean():
+    assert not vet_cell("tot = loss.item()\nprint(loss)").findings
+
+
+def test_constant_print_in_loop_is_clean():
+    assert not vet_cell("for i in range(3):\n    print('step')"
+                        ).findings
+
+
+# ----------------------------------------------------------------------
+# namespace hazards
+
+
+@pytest.mark.parametrize("cell", [
+    "rank = 5",
+    "del all_reduce",
+    "from mymod import rank",
+    "def all_reduce():\n    pass",
+    "for rank in range(3):\n    pass",
+])
+def test_framework_name_shadowing_warns(cell):
+    res = vet_cell(cell)
+    assert rules(res) == ["namespace-shadow"]
+    assert not res.errors
+
+
+def test_idiomatic_reimports_are_not_hazards():
+    assert not vet_cell("import jax\n"
+                        "import jax.numpy as jnp\n"
+                        "import numpy as np").findings
+
+
+def test_attribute_and_subscript_writes_are_not_shadowing():
+    assert not vet_cell("cfg.rank = 3\nstate['rank'] = 4").findings
+
+
+# ----------------------------------------------------------------------
+# contracts: never block on unparseable, never raise, ordering
+
+
+def test_unparseable_source_reports_parsed_false_and_no_findings():
+    res = vet_cell("def f(:")
+    assert not res.parsed and res.findings == []
+
+
+def test_vet_never_raises_on_weird_input():
+    for src in ("", "\x00", "  ", "\n\n", "ловлю = 1",
+                "x = " + "(" * 200 + "1" + ")" * 200):
+        vet_cell(src, ranks=[0], world=2)
+
+
+def test_errors_sort_before_warnings_and_dedup():
+    res = vet_cell("for i in range(4):\n"
+                   "    print(loss)\n"
+                   "if rank == 0:\n"
+                   "    y = all_reduce(x)\n")
+    sevs = [f.severity for f in res.findings]
+    assert sevs == sorted(sevs, key=lambda s: 0 if s == "error" else 1)
+    keys = [(f.rule, f.line, f.col) for f in res.findings]
+    assert len(keys) == len(set(keys))
+
+
+# ----------------------------------------------------------------------
+# ipycompat: line-preserving IPython stripping
+
+
+def test_strip_line_magic_and_shell_escape_keep_line_numbers():
+    src = "%time x = 1\n!pip list\ny = all_reduce(x) if rank==0 else 2"
+    cleaned = strip_ipython(src)
+    assert cleaned.splitlines()[0] == "pass"
+    assert cleaned.splitlines()[1] == "pass"
+    res = vet_cell(src)
+    assert res.errors and res.errors[0].line == 3
+
+
+def test_strip_assignment_escape_and_help_suffix():
+    cleaned = strip_ipython("files = !ls\nobj.method??\nx = 1")
+    lines = cleaned.splitlines()
+    assert lines[0] == "pass" and lines[1] == "pass"
+    assert lines[2] == "x = 1"
+    ast.parse(cleaned)
+
+
+def test_strip_preserves_indentation():
+    cleaned = strip_ipython("for i in range(2):\n    %time f(i)")
+    assert cleaned.splitlines()[1] == "    pass"
+    ast.parse(cleaned)
+
+
+def test_modulo_continuation_line_survives():
+    src = "y = (x\n% b)"
+    assert strip_ipython(src) == src
+
+
+def test_pure_python_returns_identity():
+    src = "a = 1\nb = a % 2\n"
+    assert strip_ipython(src) is src
+
+
+def test_string_literals_are_not_ipython_syntax():
+    # A shell-looking line INSIDE a triple-quoted string is data; the
+    # cell parses as-is and must come back verbatim — corrupting the
+    # string would turn the cell unparseable and blind the vetting.
+    src = ('cmd = """\n'
+           '!pip install foo\n'
+           '"""\n'
+           'if rank == 0:\n'
+           '    all_reduce(x)\n')
+    assert strip_ipython(src) == src
+    res = vet_cell(src)
+    assert res.parsed
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_mixed_magic_and_multiline_string():
+    # A real magic line alongside a multi-line string whose interior
+    # line starts with '!': only the magic line is rewritten.
+    src = ('%time x = 1\n'
+           'tmpl = """\n'
+           '!do-not-touch\n'
+           '"""\n'
+           'if rank == 0:\n'
+           '    all_reduce(x)\n')
+    cleaned = strip_ipython(src)
+    lines = cleaned.splitlines()
+    assert lines[0] == "pass"
+    assert lines[2] == "!do-not-touch"
+    res = vet_cell(src)
+    assert res.parsed
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_cell_magic_line_stripped():
+    cleaned = strip_ipython("%%time\nx = 1")
+    assert cleaned.splitlines()[0] == "pass"
+    ast.parse(cleaned)
+
+
+def test_is_ipython_line_classifier():
+    assert ipycompat._is_ipython_line("%time f()")
+    assert ipycompat._is_ipython_line("!ls")
+    assert ipycompat._is_ipython_line("obj?")
+    assert not ipycompat._is_ipython_line("x = y % z")
+    assert not ipycompat._is_ipython_line("")
+
+
+# ----------------------------------------------------------------------
+# preflight memory (the "analyzer told you so" loop)
+
+
+def test_preflight_note_and_lookup_roundtrip():
+    preflight.clear()
+    res = vet_cell(HANG_CELL)
+    preflight.note("sha-abc", res.findings)
+    entry = preflight.lookup("sha-abc")
+    assert entry is not None
+    assert entry["errors"] == 1
+    assert "rank-conditional-collective" in entry["rules"]
+    assert "rank-conditional-collective" in entry["summary"]
+    assert preflight.lookup("sha-unknown") is None
+    assert preflight.lookup(None) is None
+    preflight.clear()
+    assert preflight.lookup("sha-abc") is None
+
+
+def test_preflight_empty_findings_not_noted():
+    preflight.clear()
+    preflight.note("sha-clean", [])
+    assert preflight.lookup("sha-clean") is None
+
+
+def test_preflight_is_bounded():
+    preflight.clear()
+    findings = vet_cell(HANG_CELL).findings
+    for i in range(preflight._MAX + 10):
+        preflight.note(f"sha-{i}", findings)
+    assert preflight.lookup("sha-0") is None          # evicted
+    assert preflight.lookup(f"sha-{preflight._MAX + 9}") is not None
+    preflight.clear()
+
+
+def test_summarize_puts_errors_first():
+    res = vet_cell("for i in range(3):\n"
+                   "    print(loss)\n"
+                   "    if rank == 0:\n"
+                   "        x = all_reduce(x)")
+    s = preflight.summarize(res.findings)
+    assert s.startswith("[rank-conditional-collective]")
+    assert "more finding" in s
+
+
+# ----------------------------------------------------------------------
+# env-knob registry accessors
+
+
+def test_undeclared_knob_read_fails_fast():
+    with pytest.raises(KeyError, match="NBD_TOTALLY_BOGUS"):
+        knobs.get_raw("NBD_TOTALLY_BOGUS")
+
+
+def test_knob_accessor_semantics():
+    env = {"NBD_HANG": "off", "NBD_HANG_SKEW_S": "2.5",
+           "NBD_FLIGHT_RING_BYTES": "1024",
+           "NBD_ORPHAN_TTL_S": "soon"}
+    assert knobs.get_bool("NBD_HANG", True, env=env) is False
+    assert knobs.get_bool("NBD_FLIGHT", True, env=env) is True
+    assert knobs.get_float("NBD_HANG_SKEW_S", 20.0, env=env) == 2.5
+    assert knobs.get_int("NBD_FLIGHT_RING_BYTES", 0, env=env) == 1024
+    # Typo'd numeric knobs degrade to the default, never crash.
+    assert knobs.get_float("NBD_ORPHAN_TTL_S", 600.0, env=env) == 600.0
+    assert knobs.get_str("NBD_RUN_DIR", "-", env=env) == "-"
+
+
+def test_knob_table_documents_every_knob():
+    table = knobs.knob_table_markdown()
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table
+
+
+# ----------------------------------------------------------------------
+# framework self-lint (the CI static-analysis gate, as a test)
+
+
+def test_self_lint_clean_on_this_checkout():
+    results = run_self_lint(REPO)
+    assert set(results) == {"env-knobs", "codec-headers",
+                            "thread-shared-state"}
+    for name, findings in results.items():
+        assert findings == [], (
+            f"[{name}] " + "; ".join(f.render() for f in findings))
+
+
+def test_cli_repo_root_resolution(tmp_path, monkeypatch):
+    from nbdistributed_tpu.analysis.cli import _repo_root, main
+    assert _repo_root("/explicit/x") == "/explicit/x"
+    # This checkout: README.md sits next to the package dir.
+    assert _repo_root(None) == REPO
+    # No checkout anywhere (package parent is faked away, cwd bare):
+    # --self must refuse with a clear exit code, not flag every knob
+    # as undocumented against a missing README.
+    monkeypatch.chdir(tmp_path)
+    import nbdistributed_tpu
+    monkeypatch.setattr(nbdistributed_tpu, "__file__",
+                        str(tmp_path / "site-packages"
+                            / "nbdistributed_tpu" / "__init__.py"))
+    assert _repo_root(None) is None
+    assert main(["--self"]) == 2
+
+
+def test_env_knob_pass_catches_undeclared_knob(tmp_path):
+    pkg = tmp_path / "nbdistributed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "mod.py").write_text(
+        "import os\nX = os.environ.get('NBD_BOGUS_KNOB')\n")
+    findings = check_env_knobs(str(tmp_path))
+    assert any(f.rule == "env-knob" and "NBD_BOGUS_KNOB" in f.message
+               for f in findings)
+
+
+def _thread_findings(src, exempt=None):
+    tree = ast.parse(src)
+    cls = tree.body[0]
+    fn = [n for n in cls.body if isinstance(n, ast.FunctionDef)
+          and n.name != "__init__"][0]
+    p = _ThreadPass("x.py", cls.name, {"counts"}, exempt or {})
+    p.visit(fn)
+    return p.findings
+
+
+_THREAD_SRC = """
+class C:
+    def __init__(self):
+        self._lock = None
+        self.counts = dict()
+    def bump(self):
+        <BODY>
+"""
+
+
+def test_thread_pass_flags_unlocked_mutation():
+    src = _THREAD_SRC.replace("<BODY>", "self.counts['a'] = 1")
+    assert _thread_findings(src)
+    src = _THREAD_SRC.replace("<BODY>", "self.n += 1")
+    assert _thread_findings(src)
+
+
+def test_thread_pass_accepts_locked_mutation_and_exemptions():
+    src = _THREAD_SRC.replace(
+        "<BODY>", "with self._lock:\n            self.counts['a'] = 1")
+    assert not _thread_findings(src)
+    src = _THREAD_SRC.replace("<BODY>", "self.n += 1")
+    assert not _thread_findings(src, exempt={"C.n": "single writer"})
+
+
+# ----------------------------------------------------------------------
+# acceptance corpus: zero error-severity false positives
+
+
+def _notebook_cells(path):
+    with open(path, encoding="utf-8") as f:
+        nb = json.load(f)
+    for cell in nb.get("cells", []):
+        if cell.get("cell_type") == "code":
+            yield "".join(cell.get("source", []))
+
+
+def _subset_context(src, world):
+    """Mirror the magic layer: a leading ``%%rank [spec]`` arms the
+    subset rule with the parsed ranks."""
+    from nbdistributed_tpu.magics import rankspec
+    first = src.splitlines()[0].strip() if src.strip() else ""
+    if first.startswith("%%rank"):
+        spec = first[len("%%rank"):].strip()
+        try:
+            return rankspec.parse_ranks(spec, world), world
+        except rankspec.RankSpecError:
+            return None, None
+    return None, world
+
+
+@pytest.mark.parametrize("nb", ["00_quickstart.ipynb",
+                                "01_parallelism.ipynb",
+                                "02_finetune.ipynb"])
+def test_no_error_false_positives_in_example_notebooks(nb):
+    path = os.path.join(REPO, "examples", nb)
+    bad = []
+    for i, src in enumerate(_notebook_cells(path)):
+        ranks, world = _subset_context(src, world=2)
+        res = vet_cell(src, ranks=ranks, world=world)
+        for f in res.errors:
+            bad.append(f"{nb} cell {i} L{f.line}: [{f.rule}] "
+                       f"{f.snippet.strip()}")
+    assert not bad, "\n".join(bad)
+
+
+def _selftest_cells():
+    """Every cell the selftest dispatches: the inline one-liners plus
+    the big ``*_cell`` string assignments, extracted from the module
+    source so the corpus cannot drift from the code."""
+    path = os.path.join(REPO, "nbdistributed_tpu", "selftest.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    cells = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id.endswith("_cell")
+                        for t in node.targets)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            cells.append(node.value.value)
+        # Inline cells: string literals passed to send_to_all /
+        # send_to_ranks "execute" calls.
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("send_to_all", "send_to_ranks")):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value not in ("execute", "sync",
+                                              "get_status",
+                                              "checkpoint", "trace",
+                                              "metrics"):
+                    cells.append(arg.value)
+    assert len(cells) >= 8
+    return cells
+
+
+def test_no_error_false_positives_in_selftest_corpus():
+    bad = []
+    for i, src in enumerate(_selftest_cells()):
+        res = vet_cell(src, ranks=None, world=2)
+        for f in res.errors:
+            bad.append(f"selftest cell {i} L{f.line}: [{f.rule}] "
+                       f"{f.snippet.strip()}")
+    assert not bad, "\n".join(bad)
+
+
+def test_integration_hang_cells_classified_correctly():
+    # The deliberately-hazardous watchdog cell IS an error…
+    assert vet_cell(HANG_CELL).errors
+    # …while its companions (uniformly slow, rank-local infinite
+    # loop, post-hang realignment) carry no error findings.
+    clean = [
+        "import time\ntime.sleep(0.5)\n'slow-%d' % rank",
+        "if rank == 1:\n    while True:\n        pass\n'ok-%d' % rank",
+        "float(all_reduce(jnp.ones(2))[0])",
+    ]
+    for src in clean:
+        assert not vet_cell(src).errors, src
+
+
+# ----------------------------------------------------------------------
+# magic-layer wiring: _vet_cell gates dispatch
+
+
+@pytest.fixture
+def magic(monkeypatch, tmp_path):
+    """A DistributedMagics instance with a fake 2-rank world and no
+    IPython shell — enough surface for the pre-dispatch vet path."""
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    monkeypatch.setenv("NBD_FLIGHT", "0")
+    monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+    monkeypatch.setattr(DistributedMagics, "_world", 2)
+    monkeypatch.setattr(DistributedMagics, "_lint_mode", "warn")
+    preflight.clear()
+    yield DistributedMagics.__new__(DistributedMagics)
+    preflight.clear()
+
+
+def test_magic_warn_mode_annotates_and_dispatches(magic, capsys):
+    from nbdistributed_tpu.runtime.collective_guard import cell_hash
+    assert magic._vet_cell(HANG_CELL, [0, 1]) is True
+    out = capsys.readouterr().out
+    assert "rank-conditional-collective" in out
+    # Dispatched-despite-findings cells are remembered by hash so a
+    # later hang verdict cites the pre-flight finding.
+    note = preflight.lookup(cell_hash(HANG_CELL))
+    assert note is not None and note["errors"] == 1
+
+
+def test_magic_strict_mode_blocks_error_cells(magic, capsys):
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    DistributedMagics._lint_mode = "strict"
+    assert magic._vet_cell(HANG_CELL, [0, 1]) is False
+    assert "NOT dispatched" in capsys.readouterr().out
+    # Warnings alone never block, even under strict.
+    assert magic._vet_cell(
+        "for i in range(3):\n    print(loss)", [0, 1]) is True
+
+
+def test_magic_per_cell_strict_flag_blocks(magic):
+    assert magic._vet_cell(HANG_CELL, [0, 1], strict=True) is False
+
+
+def test_magic_off_mode_skips_analysis(magic, capsys):
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    DistributedMagics._lint_mode = "off"
+    assert magic._vet_cell(HANG_CELL, [0, 1]) is True
+    assert capsys.readouterr().out == ""
+
+
+def test_magic_per_cell_strict_overrides_off_mode(magic, capsys):
+    # An explicit `%%distributed --strict` must vet (and block) even
+    # when the session mode is off — the flag is a per-cell request.
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    DistributedMagics._lint_mode = "off"
+    assert magic._vet_cell(HANG_CELL, [0, 1], strict=True) is False
+    assert "NOT dispatched" in capsys.readouterr().out
+
+
+def test_magic_unparseable_never_blocks_even_strict(magic, capsys):
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    DistributedMagics._lint_mode = "strict"
+    assert magic._vet_cell("def f(:", [0, 1]) is True
+    # Unparseable subset cells degrade to the legacy regex warning.
+    assert magic._vet_cell("def f(:\nall_reduce(x)", [0]) is True
+    assert "deadlock" in capsys.readouterr().out.lower()
+
+
+def test_magic_findings_counted_in_metrics(magic):
+    from nbdistributed_tpu.observability import metrics as obs_metrics
+    c = obs_metrics.registry().counter(
+        "nbd_lint_findings_total",
+        "pre-dispatch cell-vetting findings",
+        {"rule": "rank-conditional-collective"})
+    before = c.value
+    magic._vet_cell(HANG_CELL, [0, 1])
+    assert c.value == before + 1
+
+
+def test_magic_lint_mode_resolution(magic, monkeypatch):
+    from nbdistributed_tpu.magics.magic import DistributedMagics
+    DistributedMagics._lint_mode = None
+    monkeypatch.setenv("NBD_LINT", "strict")
+    assert DistributedMagics._lint_mode_now() == "strict"
+    monkeypatch.setenv("NBD_LINT", "bogus")
+    assert DistributedMagics._lint_mode_now() == "warn"
+    DistributedMagics._lint_mode = "off"       # %dist_lint pin wins
+    assert DistributedMagics._lint_mode_now() == "off"
+
+
+# ----------------------------------------------------------------------
+# codec registry sanity (the table both the codec and self-lint import)
+
+
+def test_wire_extensions_registry_shape():
+    from nbdistributed_tpu.messaging.codec import (BASE_HEADER_KEYS,
+                                                   WIRE_EXTENSIONS)
+    assert {"at", "tr", "ep"} <= {
+        k for k, v in WIRE_EXTENSIONS.items() if v["plane"] == "header"}
+    assert {"col", "busy_s", "tel"} <= {
+        k for k, v in WIRE_EXTENSIONS.items() if v["plane"] == "ping"}
+    assert not set(WIRE_EXTENSIONS) & set(BASE_HEADER_KEYS)

@@ -1,8 +1,15 @@
-"""Rank-spec grammar tests (reference grammar: magic.py:1679-1715)."""
+"""Rank-spec grammar tests (reference grammar: magic.py:1679-1715).
+
+Carries the ``lint`` marker: the static analyzer's subset-collective
+rule (analysis/cellcheck.py) trusts this parser for its "does the
+rankspec cover the world?" decision, so its edge cases are part of
+the static-analysis CI job."""
 
 import pytest
 
 from nbdistributed_tpu.magics.rankspec import RankSpecError, parse_ranks
+
+pytestmark = [pytest.mark.unit, pytest.mark.lint]
 
 
 def test_simple_list():
@@ -42,3 +49,48 @@ def test_descending_range_rejected():
 def test_malformed_specs_rejected(bad):
     with pytest.raises(RankSpecError):
         parse_ranks(bad, 8)
+
+
+# -- edge cases the subset-collective lint rule leans on ---------------
+
+
+@pytest.mark.parametrize("bad", ["[ ]", "[\t]", "[0,]", "[,1]",
+                                 "[0,,1]", "[0 1]", "[1-]", "[-2]"])
+def test_empty_and_ragged_specs_rejected(bad):
+    with pytest.raises(RankSpecError):
+        parse_ranks(bad, 8)
+
+
+def test_overlapping_ranges_collapse_to_unique_sorted():
+    assert parse_ranks("[0-2, 1-3]", 8) == [0, 1, 2, 3]
+    assert parse_ranks("[2, 0-2, 2-2]", 8) == [0, 1, 2]
+
+
+def test_degenerate_single_element_range():
+    assert parse_ranks("[1-1]", 4) == [1]
+
+
+def test_exact_world_coverage_is_not_a_subset():
+    # The analyzer arms the subset-collective rule only when the
+    # parsed set is a STRICT subset — full coverage must parse to
+    # exactly the world.
+    assert parse_ranks("[0-3]", 4) == [0, 1, 2, 3]
+
+
+def test_range_straddling_world_bound_names_the_bad_ranks():
+    with pytest.raises(RankSpecError, match=r"\[4, 5\]"):
+        parse_ranks("[2-5]", 4)
+
+
+def test_boundary_rank_equal_to_world_size_rejected():
+    with pytest.raises(RankSpecError):
+        parse_ranks("[4]", 4)
+    assert parse_ranks("[3]", 4) == [3]
+
+
+def test_leading_zeros_parse_as_ints():
+    assert parse_ranks("[00, 01]", 4) == [0, 1]
+
+
+def test_internal_whitespace_in_ranges():
+    assert parse_ranks("[ 0 - 2 ]", 8) == [0, 1, 2]

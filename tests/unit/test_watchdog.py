@@ -590,3 +590,84 @@ def test_wait_until_ready_timeout_carries_diagnostics():
     assert "did not attach" in msg and "budget" in msg
     assert "rank 1: exited with code 17" in msg
     assert "boom at startup" in msg
+
+
+def test_hang_verdict_cites_preflight_lint_finding(tmp_path,
+                                                   monkeypatch):
+    """ISSUE 7 loop closure: when the hung cell was flagged by the
+    pre-dispatch analyzer, the verdict, the doctor report, and the
+    watchdog events all cite the pre-flight finding."""
+    from nbdistributed_tpu.analysis import preflight, vet_cell
+
+    monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+    preflight.clear()
+    hazardous = ("import jax.numpy as jnp\n"
+                 "if rank == 1:\n"
+                 "    b = all_reduce(jnp.ones(2))\n")
+    res = vet_cell(hazardous)
+    assert res.errors
+    preflight.note("sha-hang", res.findings)
+
+    pol = HangPolicy(skew_s=2, stall_s=60, grace_s=30,
+                     escalate=("warn",))
+    clock = {"t": time.time()}
+    wd = HangWatchdog(pol, clock=lambda: clock["t"])
+    comm = FakeComm(2)
+    wd._comm = comm
+    comm.pending["mH"] = {"type": "execute", "expect": [0, 1],
+                          "responded": [], "sent_at": clock["t"] - 5,
+                          "cell_sha1": "sha-hang"}
+
+    def _ping(seq, in_):
+        return {"busy_type": "execute", "busy_s": 20.0,
+                "busy_id": "mH",
+                "col": {"seq": seq, "op": "all_reduce", "in": in_,
+                        "age": 18.0, "cops": seq}}
+
+    for _ in range(2):
+        comm.pings[0] = (clock["t"], _ping(2, True))
+        comm.pings[1] = (clock["t"], _ping(1, False))
+        wd.poll_once()
+        clock["t"] += 3.0
+    assert wd.cells_flagged == 1
+    st = wd._hangs["mH"]
+    assert "rank-conditional-collective" in st.get("preflight", "")
+    assert any(e["event"] == "preflight" for e in wd.events)
+
+    report = hang_report(comm, None, wd, dump_stacks=False)
+    assert "pre-flight lint flagged this cell" in report
+    assert "rank-conditional-collective" in report
+    preflight.clear()
+
+
+def test_hang_verdict_without_preflight_note_has_no_citation(
+        tmp_path, monkeypatch):
+    from nbdistributed_tpu.analysis import preflight
+
+    monkeypatch.setenv("NBD_RUN_DIR", str(tmp_path))
+    preflight.clear()
+    pol = HangPolicy(skew_s=2, stall_s=60, grace_s=30,
+                     escalate=("warn",))
+    clock = {"t": time.time()}
+    wd = HangWatchdog(pol, clock=lambda: clock["t"])
+    comm = FakeComm(2)
+    wd._comm = comm
+    comm.pending["mN"] = {"type": "execute", "expect": [0, 1],
+                          "responded": [], "sent_at": clock["t"] - 5,
+                          "cell_sha1": "sha-unvetted"}
+
+    def _ping(seq, in_):
+        return {"busy_type": "execute", "busy_s": 20.0,
+                "busy_id": "mN",
+                "col": {"seq": seq, "op": "all_reduce", "in": in_,
+                        "age": 18.0, "cops": seq}}
+
+    for _ in range(2):
+        comm.pings[0] = (clock["t"], _ping(2, True))
+        comm.pings[1] = (clock["t"], _ping(1, False))
+        wd.poll_once()
+        clock["t"] += 3.0
+    assert wd.cells_flagged == 1
+    assert "preflight" not in wd._hangs["mN"]
+    report = hang_report(comm, None, wd, dump_stacks=False)
+    assert "pre-flight lint" not in report

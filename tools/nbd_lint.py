@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Checkout-local launcher for ``nbd-lint`` (the console script ships
+via pyproject; CI and developers in a raw checkout run this file:
+``python tools/nbd_lint.py --self``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nbdistributed_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,8 +32,9 @@ import jax.numpy as jnp
 from nbdistributed_tpu.ops import attention_reference as ref
 from nbdistributed_tpu.ops import flash_attention as flash
 from nbdistributed_tpu.ops.timing import FRESH_FACTOR, chain_program
+from nbdistributed_tpu.utils import knobs
 
-SMOKE = bool(os.environ.get("NBD_PROBE_CPU_SMOKE"))
+SMOKE = bool(knobs.get_raw("NBD_PROBE_CPU_SMOKE"))
 if SMOKE:
     B, S, H, Hkv, D = 1, 128, 2, 1, 64   # CPU-feasible harness check
 else:
