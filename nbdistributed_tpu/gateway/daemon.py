@@ -1,0 +1,918 @@
+"""The gateway daemon: one pooled worker fleet, N tenant kernels.
+
+``GatewayDaemon`` is a headless coordinator.  It owns the workers the
+way ``%dist_init`` does — a :class:`CommunicationManager` (wired with
+the pool's bounded :class:`~.scheduler.Scheduler` policy) plus a
+:class:`ProcessManager` — and opens a SECOND listener, the *tenant
+plane*, speaking the same authenticated codec the workers do.
+Notebook kernels dial it as tenants (:class:`~.client.TenantClient`,
+``%dist_attach --tenant``); their cells are admitted by the
+:class:`~.tenancy.TenantRegistry`, scheduled by the shared
+``Scheduler``, executed tenant-tagged on the mesh, and their replies
+routed back — or, when the tenant kernel has crashed, parked in that
+tenant's own mailbox partition for exactly-once redelivery on
+reattach.
+
+Robustness contract (what the chaos tests pin):
+
+- a tenant connection death detaches the tenant but destroys nothing:
+  queued and in-flight cells finish, results park, the tenant name +
+  token + epoch survive for ``%dist_attach --tenant``;
+- a reattach bumps the tenant epoch, so the dead kernel's old
+  connection (were it to twitch again) is fenced with ``stale_epoch``
+  — the PR 4 stale-coordinator fence, scoped to one tenant;
+- admission control is explicit: a full pool refuses the hello, a
+  tenant at its in-flight cap gets ``{"status": "rejected"}``, a busy
+  mesh replies ``{"status": "queued", "position": n}`` instead of
+  silently blocking, and overload sheds the lowest-priority queued
+  cell with a visible ``{"status": "shed"}`` verdict — the mesh never
+  wedges behind one tenant's flood.
+
+The daemon also writes a **gateway manifest** (``gateway.json`` under
+the run dir, next to the workers' ``session.json``): the tenant-plane
+endpoint + pool token a kernel needs to attach, the per-tenant
+token/epoch table a *crashed* kernel's successor reads to reattach by
+name, and the daemon pid that ``gc_runs`` probes so a live pool's run
+dir is never swept.
+
+Run it as ``python -m nbdistributed_tpu.gateway.daemon -n 4`` or via
+``tools/nbd_gateway.py`` / ``%dist_pool start``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from ..observability import flightrec
+from ..observability import metrics as obs_metrics
+from ..resilience import session as session_mod
+from ..utils import knobs
+from .scheduler import CellRejected, CellShed, SchedPolicy, Scheduler
+from .tenancy import TenantRegistry, TenantRejected
+
+GATEWAY_MANIFEST_NAME = "gateway.json"
+
+# Tenant-plane request types a connection may send BEFORE its
+# tenant_hello: status probes and the admin stop need no tenant slot
+# (the transport-level pool token already authenticated the peer).
+_PRE_HELLO = frozenset({"tenant_hello", "pool_status", "pool_shutdown"})
+
+
+def gateway_manifest_path(run_dir: str) -> str:
+    return os.path.join(run_dir, GATEWAY_MANIFEST_NAME)
+
+
+def read_gateway_manifest(run_dir: str) -> dict | None:
+    """The run dir's gateway manifest, or None (missing/torn — same
+    lenient contract as :func:`~..resilience.session.read_manifest`)."""
+    try:
+        with open(gateway_manifest_path(run_dir)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return m if isinstance(m, dict) else None
+
+
+def gateway_alive(manifest: dict | None) -> bool:
+    """True when the manifest's daemon pid is a live process — the
+    ``gc_runs`` liveness probe that keeps a pooled fleet's run dir."""
+    if not manifest:
+        return False
+    try:
+        pid = int(manifest.get("pid") or 0)
+    except (TypeError, ValueError):
+        return False
+    return bool(pid) and session_mod.pid_alive(pid)
+
+
+def discover_gateway(run_dir: str | None = None) -> str | None:
+    """Best pool to attach to when the caller names none: the env run
+    dir if it holds a live gateway manifest, else the newest live one
+    under the runs root — the ``discover_run_dir`` analog."""
+    if run_dir:
+        return run_dir if read_gateway_manifest(run_dir) else None
+    env = knobs.get_str("NBD_RUN_DIR")
+    if env and gateway_alive(read_gateway_manifest(env)):
+        return env
+    root = session_mod.default_runs_root()
+    best: tuple[float, str] | None = None
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        d = os.path.join(root, name)
+        m = read_gateway_manifest(d)
+        if not gateway_alive(m):
+            continue
+        ts = m.get("updated_ts") or m.get("created_ts") or 0.0
+        if best is None or ts > best[0]:
+            best = (ts, d)
+    return best[1] if best else None
+
+
+class GatewayDaemon:
+    """Owns the pooled fleet and serves the tenant plane.
+
+    Constructing it spawns (and waits for) the workers; ``close()``
+    tears everything down and removes the manifests.  All tenant-plane
+    callbacks run on the listener's IO thread and must not block —
+    ``execute`` is served on its own thread per request (bounded by
+    the scheduler's admission control, which is the point).
+    """
+
+    def __init__(self, world_size: int, *, backend: str = "auto",
+                 host: str = "127.0.0.1", tenant_port: int = 0,
+                 policy: SchedPolicy | None = None,
+                 max_tenants: int | None = None,
+                 request_timeout: float | None = None,
+                 attach_timeout: float = 180.0,
+                 pool_token: str | None = None,
+                 watchdog: bool = True):
+        from ..manager import ProcessManager, wait_until_ready
+        from ..messaging import CommunicationManager
+
+        self.policy = policy or SchedPolicy.pool_from_env()
+        if max_tenants is None:
+            max_tenants = knobs.get_int("NBD_POOL_MAX_TENANTS", 8)
+        self.registry = TenantRegistry(max_tenants=max_tenants)
+        # The pool token authenticates the tenant plane (transport
+        # preamble digest) and authorizes pool_shutdown.  Kernels read
+        # it from the gateway manifest — same-filesystem trust, like
+        # the session manifest's auth_token.
+        self.pool_token = pool_token or session_mod.mint_token()
+        self.request_timeout = request_timeout
+        self._lock = threading.Lock()   # mailbox park/claim + serving
+        # Manifest publishing gets its OWN lock: it serializes two
+        # writers sharing one .tmp path, and file IO under the hot
+        # _lock would stall every park/claim/serve-count behind disk.
+        self._manifest_lock = threading.Lock()
+        self._manifest_dirty = threading.Event()
+        self._closed = threading.Event()    # set AFTER teardown done
+        # Per-tenant count of execute serve threads between spawn and
+        # their post-_deliver exit.  Eviction consults it: the
+        # scheduler marks a cell complete BEFORE _deliver parks its
+        # reply, so "scheduler idle + mailbox empty" alone can evict
+        # a tenant whose result is mid-park and lose it.
+        self._serving: dict[str, int] = {}
+        self._close_lock = threading.Lock()
+        self._close_started = False
+        self.flight = flightrec.init("gateway")
+        self.run_dir = flightrec.run_dir()
+
+        session_token = session_mod.mint_token()
+        self.comm = CommunicationManager(
+            num_workers=world_size, timeout=request_timeout,
+            session_token=session_token, session_epoch=1,
+            scheduler=Scheduler(self.policy))
+        self.pm = ProcessManager()
+        self.pm.add_death_callback(
+            lambda r, rc: self.comm.mark_worker_dead(r))
+        try:
+            self.pm.start_workers(
+                world_size, self.comm.port, backend=backend,
+                extra_env={"NBD_SESSION_TOKEN": session_token,
+                           "NBD_SESSION_EPOCH": "1"})
+            wait_until_ready(self.comm, self.pm, attach_timeout)
+            self.comm.set_output_callback(self._on_stream)
+            self.world_size = world_size
+
+            # Workers' session manifest: the fleet outlives this
+            # daemon exactly like a single-kernel fleet outlives its
+            # kernel — a future coordinator (or replacement gateway)
+            # can adopt it.
+            try:
+                session_mod.write_manifest(
+                    self.run_dir, session_mod.make_manifest(
+                        world_size=world_size,
+                        control_host="127.0.0.1",
+                        control_port=self.comm.port,
+                        token=session_token, epoch=1,
+                        pids={r: p.pid
+                              for r, p in self.pm.processes.items()},
+                        backend=self.pm.backend,
+                        dist_port=self.pm.dist_port))
+            except OSError:
+                pass
+
+            # Tenant plane: same listener class + codec as the worker
+            # plane, authenticated with the pool token.  Inside the
+            # same guard as the spawn: a bad --tenant-port must not
+            # orphan the already-running fleet.
+            from ..messaging.native import make_listener
+            self._tenants_listener = make_listener(
+                host=host, port=tenant_port,
+                auth_token=self.pool_token)
+            self._tenants_listener.on_message = self._on_tenant_message
+            self._tenants_listener.on_disconnect = self._on_tenant_gone
+            self._tenants_listener.start()
+        except BaseException:
+            # BaseException: a SIGTERM handler raising SystemExit
+            # mid-spawn (the %dist_pool start timeout path) must
+            # still reap the half-started fleet, same as any error.
+            self.pm.shutdown()
+            self.comm.shutdown()
+            raise
+        self.tenant_host = host
+        self.tenant_port = self._tenants_listener.port
+
+        # Hang watchdog over the pool: verdicts carry the tenant of
+        # the hung cell (pending snapshots are tenant-tagged), so
+        # blame lands on the right notebook.
+        self._watchdog = None
+        if watchdog and knobs.get_bool("NBD_HANG", True):
+            try:
+                from ..resilience.watchdog import (HangPolicy,
+                                                   HangWatchdog)
+                self._watchdog = HangWatchdog(
+                    HangPolicy.from_env_lenient())
+                self._watchdog.attach(self.comm, self.pm)
+            except Exception:
+                self._watchdog = None
+
+        self.flight.record("gateway_start", world_size=world_size,
+                           tenant_port=self.tenant_port,
+                           policy=self.policy.describe())
+        # First publish is synchronous — READY implies a readable
+        # manifest; later republishes go through the writer thread.
+        self._write_manifest_sync()
+        threading.Thread(target=self._manifest_writer, daemon=True,
+                         name="nbd-gw-manifest").start()
+
+    # ------------------------------------------------------------------
+    # manifest
+
+    def _write_manifest(self) -> None:
+        """Request a manifest publish.  The write itself happens on a
+        dedicated writer thread — hello/detach call this from the
+        tenant-plane listener IO thread, and json.dump + os.replace
+        there stalled every other tenant's frames behind disk on a
+        slow runs root."""
+        self._manifest_dirty.set()
+
+    def _manifest_writer(self) -> None:
+        while True:
+            self._manifest_dirty.wait()
+            if self._close_started:
+                return      # close() removes the manifest; stop here
+            self._manifest_dirty.clear()
+            self._write_manifest_sync()
+
+    def _write_manifest_sync(self) -> None:
+        m = {
+            "kind": "gateway",
+            "pid": os.getpid(),
+            "world_size": self.world_size,
+            "tenant_plane": {"host": self.tenant_host,
+                             "port": self.tenant_port},
+            "pool_token": self.pool_token,
+            "policy": self.policy.describe(),
+            "max_tenants": self.registry.max_tenants,
+            "created_ts": getattr(self, "_created_ts", None)
+            or time.time(),
+            "updated_ts": time.time(),
+            "tenants": self.registry.manifest_block(),
+        }
+        self._created_ts = m["created_ts"]
+        path = gateway_manifest_path(self.run_dir)
+        tmp = path + ".tmp"
+        # Serialized: hello (listener thread) and eviction (its own
+        # thread) both publish — two unserialized writers share the
+        # one .tmp path and can os.replace torn JSON into place.
+        with self._manifest_lock:
+            if self._close_started:
+                return      # don't resurrect a manifest close removes
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(m, f, indent=1)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # tenant plane (listener IO thread — keep fast, never block)
+
+    def _send_to_client(self, client_id: int, msg) -> bool:
+        from ..messaging.transport import TransportError
+        try:
+            self._tenants_listener.send_to_rank(client_id, msg)
+            return True
+        except TransportError:
+            return False
+
+    def _on_tenant_gone(self, client_id: int) -> None:
+        t = self.registry.detach_client(client_id)
+        if t is not None:
+            self.flight.record("tenant_detached", tenant=t.name)
+            obs_metrics.registry().counter(
+                "nbd_tenant_detaches_total",
+                "tenant detaches by kind (clean = explicit goodbye, "
+                "lost = connection dropped: kernel crash or exit)",
+                {"tenant": t.name, "kind": "lost"}).inc()
+            self._write_manifest()
+
+    def _on_tenant_message(self, client_id: int, msg) -> None:
+        mt = msg.msg_type
+        tenant = self.registry.by_client(client_id)
+        if tenant is None and mt not in _PRE_HELLO:
+            self._send_to_client(client_id, msg.reply(
+                data={"error": "no tenant_hello on this connection"}))
+            return
+        if tenant is not None and self.registry.fence(tenant,
+                                                      msg.epoch):
+            # A reattach bumped this tenant's epoch: the old kernel's
+            # connection is fenced exactly like a stale coordinator.
+            obs_metrics.registry().counter(
+                "nbd_tenant_epoch_rejected_total",
+                "frames rejected from a stale tenant epoch",
+                {"tenant": tenant.name}).inc()
+            self.flight.record("tenant_epoch_rejected",
+                               tenant=tenant.name, frame_epoch=msg.epoch,
+                               epoch=tenant.epoch)
+            self._send_to_client(client_id, msg.reply(
+                data={"error": f"stale tenant epoch {msg.epoch} "
+                               f"(this tenant reattached at epoch "
+                               f"{tenant.epoch}); request ignored",
+                      "stale_epoch": True}))
+            return
+        if mt == "tenant_hello":
+            self._handle_hello(client_id, msg)
+        elif mt == "execute":
+            # Counted HERE (listener thread, before detach can be
+            # processed on this connection) — not in the serve thread,
+            # which may not have started when a detach lands.
+            with self._lock:
+                self._serving[tenant.name] = self._serving.get(
+                    tenant.name, 0) + 1
+            threading.Thread(target=self._serve_execute,
+                             args=(tenant, msg, client_id),
+                             name=f"nbd-gw-{tenant.name}",
+                             daemon=True).start()
+        elif mt == "mailbox":
+            # Off the listener thread: a drain reply carries up to the
+            # whole mailbox (32 MB bound) and a slow client's full
+            # socket buffer would block sendall — wedging every other
+            # tenant's hellos/executes/detaches behind it.  Counted
+            # here (listener thread) like execute so a detach can't
+            # evict the tenant while its claimed results are mid-send.
+            with self._lock:
+                self._serving[tenant.name] = self._serving.get(
+                    tenant.name, 0) + 1
+            threading.Thread(target=self._serve_mailbox,
+                             args=(tenant, msg, client_id),
+                             name=f"nbd-gw-mb-{tenant.name}",
+                             daemon=True).start()
+        elif mt == "pool_status":
+            self._send_to_client(client_id, msg.reply(
+                data=self.status()))
+        elif mt == "detach":
+            t = self.registry.detach_client(client_id)
+            evicted = False
+            if t is not None:
+                # A clean goodbye with nothing parked and nothing in
+                # flight frees the tenant's admission slot; anything
+                # recoverable keeps the slot for reattach.
+                with self._lock:
+                    serving = self._serving.get(t.name, 0)
+                if (serving == 0 and len(t.mailbox) == 0
+                        and self.comm.scheduler.tenant_idle(t.name)):
+                    # Eviction runs on its own thread AFTER the
+                    # worker-namespace GC broadcast: until the evict
+                    # lands, a new same-name hello is refused (wrong
+                    # token against the still-registered tenant), so
+                    # the late tenant_gc frame can never delete a NEW
+                    # tenant's freshly created namespace.  Off the
+                    # listener thread: send_to_ranks blocks.
+                    evicted = True
+                    threading.Thread(
+                        target=self._evict_after_gc,
+                        args=(t.name,), daemon=True,
+                        name=f"nbd-gw-gc-{t.name}").start()
+                self.flight.record("tenant_detached", tenant=t.name,
+                                   clean=True, evicted=evicted)
+                obs_metrics.registry().counter(
+                    "nbd_tenant_detaches_total",
+                    "tenant detaches by kind (clean = explicit "
+                    "goodbye, lost = connection dropped: kernel "
+                    "crash or exit)",
+                    {"tenant": t.name, "kind": "clean"}).inc()
+                self._write_manifest()
+            self._send_to_client(client_id, msg.reply(
+                data={"status": "detached", "evicted": evicted}))
+        elif mt == "pool_shutdown":
+            if (msg.data or {}).get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            self._send_to_client(client_id, msg.reply(
+                data={"status": "stopping"}))
+            # Off-thread: close() joins the listener's IO thread —
+            # the very thread running this callback.
+            threading.Thread(target=self.close,
+                             name="nbd-gw-stop", daemon=True).start()
+        else:
+            self._send_to_client(client_id, msg.reply(
+                data={"error": f"unknown tenant-plane request "
+                               f"{mt!r}"}))
+
+    def _handle_hello(self, client_id: int, msg) -> None:
+        data = msg.data or {}
+        name = str(data.get("tenant") or "").strip()
+        if not name:
+            self._send_to_client(client_id, msg.reply(
+                data={"error": "tenant_hello needs a tenant name"}))
+            return
+        prio = data.get("priority")
+        if prio is not None:
+            try:
+                prio = int(prio)
+            except (TypeError, ValueError):
+                prio = None   # absent/garbage: keep current priority
+        existing = self.registry.by_client(client_id)
+        if existing is not None and existing.name != name:
+            # One tenant identity per connection: a re-hello under a
+            # DIFFERENT name would overwrite the client map while the
+            # first tenant's client_id stayed pointing here — forever
+            # "attached", unevictable, its slot and namespaces leaked.
+            self._send_to_client(client_id, msg.reply(data={
+                "error": f"connection already attached as tenant "
+                         f"{existing.name!r} — open a new connection "
+                         "to attach another tenant",
+                "rejected": True}))
+            return
+        try:
+            t, reply = self.registry.hello(
+                name, data.get("token"), client_id, priority=prio)
+        except TenantRejected as e:
+            obs_metrics.registry().counter(
+                "nbd_tenant_rejected_total",
+                "tenant hellos refused (admission control / bad "
+                "token)", {"reason": e.reason.split("=")[0][:32]}).inc()
+            self.flight.record("tenant_rejected", tenant=name,
+                               reason=e.reason)
+            self._send_to_client(client_id, msg.reply(
+                data={"error": str(e), "rejected": True}))
+            return
+        reply["world_size"] = self.world_size
+        reply["policy"] = self.policy.describe()
+        self.flight.record("tenant_" + reply["status"], tenant=name,
+                           epoch=t.epoch)
+        obs_metrics.registry().counter(
+            "nbd_tenant_attaches_total",
+            "tenant hellos accepted",
+            {"tenant": name, "kind": reply["status"]}).inc()
+        self._send_to_client(client_id, msg.reply(data=reply))
+        self._write_manifest()
+
+    def _handle_mailbox(self, client_id: int, tenant, msg) -> None:
+        action = (msg.data or {}).get("action", "status")
+        if action == "drain":
+            with self._lock:
+                claimed = tenant.mailbox.claim_all()
+            ok = self._send_to_client(client_id, msg.reply(
+                data={"status": "ok",
+                      "results": {mid: getattr(r, "data", None)
+                                  for mid, r in claimed.items()}}))
+            if ok:
+                self.flight.record("tenant_mailbox_drained",
+                                   tenant=tenant.name, n=len(claimed))
+            elif claimed:
+                # The drain reply never left the gateway: put the
+                # results back (oldest first, preserving order) so the
+                # claim stays exactly-once instead of silently
+                # becoming at-most-once on a dead socket.
+                with self._lock:
+                    for mid, r in claimed.items():
+                        tenant.mailbox.park(mid, r)
+                self.flight.record("tenant_mailbox_reparked",
+                                   tenant=tenant.name, n=len(claimed))
+                # A successor kernel may have attached in the
+                # claim/repark window — its hello saw an EMPTY
+                # mailbox, so nudge it (the dead drain requester is
+                # excluded; no successor, no notice).
+                self._notify_parked(tenant, exclude_cid=client_id)
+            return
+        with self._lock:
+            parked = tenant.mailbox.ids()
+            counters = tenant.mailbox.counters()
+        self._send_to_client(client_id, msg.reply(
+            data={"status": "ok", "parked": parked,
+                  "counters": counters}))
+
+    # ------------------------------------------------------------------
+    # cell routing (one thread per in-flight tenant request)
+
+    def _serve_done(self, name: str) -> None:
+        """Release one serve-counter slot (incremented on the
+        listener thread before the serve thread spawned)."""
+        with self._lock:
+            n = self._serving.get(name, 1) - 1
+            if n <= 0:
+                self._serving.pop(name, None)
+            else:
+                self._serving[name] = n
+
+    def _serve_mailbox(self, tenant, msg, client_id: int) -> None:
+        try:
+            self._handle_mailbox(client_id, tenant, msg)
+        finally:
+            # Held until the claimed results are sent or REPARKED —
+            # a clean detach racing the drain must not evict the
+            # tenant while its mailbox claim is in flight.
+            self._serve_done(tenant.name)
+
+    def _serve_execute(self, tenant, msg, submit_cid: int) -> None:
+        try:
+            self._serve_execute_inner(tenant, msg, submit_cid)
+        finally:
+            # Decremented only after _deliver has sent or PARKED the
+            # reply — until then the tenant must not be evictable.
+            self._serve_done(tenant.name)
+
+    def _serve_execute_inner(self, tenant, msg,
+                             submit_cid: int) -> None:
+        name = tenant.name
+        tenant.cells_submitted += 1
+        tenant.last_seen = time.time()
+        data = msg.data if isinstance(msg.data, dict) else {
+            "code": msg.data}
+        ranks = data.get("target_ranks")
+        if not isinstance(ranks, list) or not ranks or not all(
+                isinstance(r, int) and 0 <= r < self.world_size
+                for r in ranks):
+            ranks = list(range(self.world_size))
+            data = dict(data)
+            data["target_ranks"] = ranks
+        try:
+            prio = int(data.get("priority", tenant.priority))
+        except (TypeError, ValueError):
+            prio = tenant.priority
+        reg = obs_metrics.registry()
+
+        def on_verdict(ticket):
+            v = ticket.verdict
+            if v.get("status") == "queued":
+                # The explicit backpressure reply: the kernel learns
+                # its position instead of silently blocking.
+                reg.counter("nbd_tenant_queued_total",
+                            "tenant cells that waited in the pool "
+                            "queue", {"tenant": name}).inc()
+                # Only the SUBMITTING connection understands this
+                # msg_id; after a reattach the notice is just noise.
+                if tenant.client_id == submit_cid:
+                    self._send_to_client(submit_cid, msg.reply(
+                        msg_type="queued",
+                        data={"status": "queued",
+                              "position": v.get("position"),
+                              "msg_id": msg.msg_id}))
+
+        status = "ok"
+        try:
+            resps = self.comm.send_to_ranks(
+                ranks, "execute", data, tenant=name, priority=prio,
+                msg_id=msg.msg_id, on_verdict=on_verdict,
+                timeout=self.request_timeout)
+            results = {str(r): m.data for r, m in resps.items()}
+            if any(isinstance(d, dict) and d.get("error")
+                   for d in results.values()):
+                status = "error"
+            reply = msg.reply(data={"status": status,
+                                    "results": results})
+        except CellShed:
+            status = "shed"
+            reg.counter("nbd_tenant_shed_total",
+                        "tenant cells shed under overload",
+                        {"tenant": name}).inc()
+            reply = msg.reply(data={
+                "status": "shed", "reason": "overload",
+                "error": "cell shed under overload: the pool queue "
+                         "was full and this was the lowest-priority "
+                         "queued cell — retry, or raise priority"})
+        except CellRejected as e:
+            status = "rejected"
+            reply = msg.reply(data={
+                "status": "rejected", "reason": e.reason,
+                "error": f"cell rejected: {e.reason} — wait for "
+                         f"in-flight cells to finish"})
+        except Exception as e:
+            status = "error"
+            reply = msg.reply(data={"status": "error",
+                                    "error": f"{type(e).__name__}: "
+                                             f"{e}"})
+        if status == "ok":
+            tenant.cells_done += 1
+        elif status == "error":
+            tenant.cells_failed += 1
+        reg.counter("nbd_tenant_cells_total",
+                    "tenant cells by terminal status",
+                    {"tenant": name, "status": status}).inc()
+        self._deliver(tenant, reply, submit_cid)
+
+    def _gc_tenant_ns(self, name: str) -> bool:
+        """Drop a departed tenant's per-worker namespaces from every
+        LIVE rank — a dead worker's process took its namespace dicts
+        with it, and targeting it would make send_to_ranks raise
+        BEFORE transmitting to anyone.  Returns True only when every
+        live rank confirmed the drop; a failure is flight-recorded so
+        a stale-namespace postmortem has the evidence."""
+        try:
+            live = sorted(set(range(self.world_size))
+                          - self.comm.dead_ranks())
+            if live:
+                self.comm.send_to_ranks(live, "tenant_gc",
+                                        {"tenant": name}, timeout=30.0)
+            self.flight.record("tenant_ns_gc", tenant=name,
+                               ranks=live)
+            return True
+        except Exception as e:
+            self.flight.record("tenant_ns_gc_failed", tenant=name,
+                               error=f"{type(e).__name__}: {e}")
+            return False
+
+    def _evict_after_gc(self, name: str) -> None:
+        """GC first, THEN free the admission slot.  The registry
+        refuses a tokenless same-name hello while the departed tenant
+        is still registered, so ordering the evict after the gc
+        broadcast is what makes the gc unable to race a new tenant's
+        first cell.  If the tenant reattached in the gap (old token),
+        evict refuses and the slot — though not the namespace, which
+        a clean goodbye forfeits — survives.
+
+        The gc broadcast RETRIES with backoff: a busy mesh (one long
+        cell on a serial worker loop) times the one-shot send out,
+        and giving up there leaked the admission slot and the
+        namespaces for the daemon's lifetime — max_tenants refusals
+        against an empty pool after enough name rotations.  Retrying
+        stops when the tenant reattaches (the namespace is live
+        again — deleting it would wipe a running session) or the
+        daemon closes; a still-failing mesh after the retry window is
+        flight-recorded and keeps the slot (the stated-limit trade:
+        a leaked slot over a leaked namespace handed to a stranger)."""
+        delay, deadline = 2.0, time.time() + 1800.0
+        while True:
+            # Liveness check BEFORE every broadcast attempt, not just
+            # after a failure: a tenant that reattached while this
+            # thread was still being scheduled must not have its gc
+            # land on a session that is live again.  (A reattach in
+            # the check→send gap is safe: the per-worker control
+            # channel is serial, so the reattached kernel's first
+            # cell — which lazily rebuilds the namespace — is
+            # processed AFTER this gc frame.)
+            t = self.registry.get(name)
+            if t is None or t.client_id is not None:
+                return          # gone, or reattached: namespace live
+            if self._gc_tenant_ns(name):
+                break
+            if time.time() >= deadline:
+                self.flight.record("tenant_gc_abandoned", tenant=name)
+                return          # slot survives; documented trade
+            if self._closed.wait(delay):
+                return          # daemon tearing down
+            delay = min(delay * 2, 60.0)
+        t = self.registry.get(name)
+        if t is None or t.client_id is not None or len(t.mailbox) \
+                or not self.comm.scheduler.tenant_idle(name):
+            # The tenant came back during the gc window — and possibly
+            # crashed AGAIN with parked work (reattach + crash fits in
+            # a 30 s broadcast stall behind a busy mesh).  Evicting now
+            # would destroy the mailbox and the session token the next
+            # reattach needs; its clean goodbye, when it comes, will
+            # run its own eviction.
+            return
+        if self.registry.evict(name):
+            self.comm.scheduler.forget_tenant(name)
+            self.flight.record("tenant_evicted", tenant=name)
+            self._write_manifest()
+
+    def _deliver(self, tenant, reply, submit_cid: int | None = None) -> None:
+        """Route a terminal reply to the tenant's live connection, or
+        park it in the tenant's mailbox partition for exactly-once
+        redelivery on reattach.
+
+        When the tenant reattached WHILE the cell was in flight, the
+        live connection is a NEW kernel with no waiter for this
+        msg_id — a successful send there would be silently dropped
+        client-side and the result lost forever.  Park instead: the
+        reattached kernel's next mailbox drain redelivers it.
+
+        Stated limit: a successful socket write counts as delivered.
+        A kernel SIGKILLed after the OS accepts the bytes but before
+        the user sees them loses that one reply — closing the window
+        needs an app-level ack protocol, and the single-kernel orphan
+        path accepts the same window by design (README "Tenant
+        fencing & crash isolation")."""
+        cid = tenant.client_id
+        if (cid is not None
+                and (submit_cid is None or cid == submit_cid)
+                and self._send_to_client(cid, reply)):
+            return
+        with self._lock:
+            tenant.mailbox.park(reply.msg_id, reply)
+            tenant.parked_total += 1
+        obs_metrics.registry().counter(
+            "nbd_tenant_parked_total",
+            "tenant replies parked for redelivery (kernel was gone "
+            "when the cell finished)", {"tenant": tenant.name}).inc()
+        self.flight.record("tenant_result_parked", tenant=tenant.name,
+                           msg_id=reply.msg_id)
+        if submit_cid is not None:
+            # Parked BECAUSE the tenant reattached mid-cell: the new
+            # kernel's hello listed the mailbox BEFORE this park, so
+            # without a nudge nothing would ever drain it (and an
+            # errored cell's traceback travels only in this reply).
+            self._notify_parked(tenant, exclude_cid=submit_cid)
+
+    def _notify_parked(self, tenant, *, exclude_cid=None) -> None:
+        """Nudge the tenant's LIVE connection that its mailbox gained
+        results its hello never listed — without the notice nothing
+        drains them until another attach.  ``exclude_cid`` is the
+        connection whose death/supersession caused the park (sending
+        there is pointless).  Best effort: a lost notice just leaves
+        the results claimable on the next attach."""
+        cid = tenant.client_id
+        if cid is None or cid == exclude_cid:
+            return
+        from ..messaging.codec import Message
+        self._send_to_client(cid, Message(
+            msg_type="parked_notice",
+            data={"tenant": tenant.name}))
+
+    def _on_stream(self, rank: int, data: dict) -> None:
+        """Worker stream output: tenant-tagged prints route to the one
+        kernel whose cell produced them; untagged output (gateway-
+        internal probes) is dropped."""
+        name = (data or {}).get("tenant")
+        if not name:
+            return
+        t = self.registry.get(name)
+        if t is None or t.client_id is None:
+            return
+        from ..messaging.codec import Message
+        self._send_to_client(t.client_id, Message(
+            msg_type="stream_output", rank=rank, data=data))
+
+    # ------------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``%dist_pool status`` payload: scheduler counters,
+        tenant table, and a per-rank busy view (tenant-attributed)
+        assembled from heartbeat pings — renders even mid-cell."""
+        sched = self.comm.scheduler.snapshot()
+        now = time.time()
+        ranks = {}
+        connected = self.comm.connected_ranks()
+        for r in range(self.world_size):
+            ping = self.comm.last_ping(r)
+            row = {"alive": r in connected}
+            if ping is not None:
+                row["hb_age_s"] = round(now - ping[0], 1)
+                if ping[1].get("busy_s") is not None:
+                    row["busy_type"] = ping[1].get("busy_type")
+                    row["busy_s"] = round(
+                        ping[1]["busy_s"] + (now - ping[0]), 1)
+                    row["tenant"] = ping[1].get("busy_tenant")
+            ranks[str(r)] = row
+        wd = None
+        if self._watchdog is not None:
+            wd = [dict(v) for v in self._watchdog.last_verdicts]
+        return {"status": "ok", "run_dir": self.run_dir,
+                "pid": os.getpid(), "world_size": self.world_size,
+                "scheduler": sched,
+                "tenants": self.registry.describe(),
+                "ranks": ranks, "hang_verdicts": wd}
+
+    def close(self) -> None:
+        with self._close_lock:
+            started, self._close_started = self._close_started, True
+        self._manifest_dirty.set()      # release the writer thread
+        if started:
+            # Another thread owns the teardown; block until it is DONE
+            # (not merely begun) so main() can't exit the process with
+            # pooled workers still alive behind a half-run shutdown.
+            self._closed.wait(timeout=30.0)
+            return
+        self.flight.record("gateway_stop")
+        if self._watchdog is not None:
+            try:
+                self._watchdog.stop()
+            except Exception:
+                pass
+        try:
+            self._tenants_listener.close()
+        except Exception:
+            pass
+        self.pm.quiesce()
+        try:
+            self.comm.post(self.comm.connected_ranks(), "shutdown")
+            time.sleep(0.3)
+        except Exception:
+            pass
+        try:
+            self.comm.shutdown()
+        except Exception:
+            pass
+        try:
+            self.pm.shutdown()
+        except Exception:
+            pass
+        # Under _manifest_lock: a writer-thread publish that passed
+        # its _close_started check before we set the flag must not
+        # os.replace a manifest back into place after these removals
+        # (with pid reuse, a resurrected gateway.json reads as a LIVE
+        # pool and attaches/gc target a daemon that no longer exists).
+        with self._manifest_lock:
+            for p in (gateway_manifest_path(self.run_dir),
+                      session_mod.manifest_path(self.run_dir)):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        self._closed.set()
+
+    def wait(self) -> None:
+        """Block until ``close()`` (pool_shutdown or a signal)."""
+        self._closed.wait()
+
+
+# ----------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="nbdistributed_tpu session gateway daemon")
+    p.add_argument("-n", "--workers", type=int, default=2)
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "cpu", "tpu"])
+    p.add_argument("--host", default="127.0.0.1",
+                   help="tenant-plane bind host")
+    p.add_argument("--tenant-port", type=int, default=0)
+    p.add_argument("--run-dir", default=None,
+                   help="run directory (default: NBD_RUN_DIR, else "
+                        "minted under the runs root)")
+    p.add_argument("--max-tenants", type=int, default=None)
+    p.add_argument("--sched", default=None, choices=[None, "fifo",
+                                                     "fair"])
+    p.add_argument("--mesh-slots", type=int, default=None)
+    p.add_argument("--queue-depth", type=int, default=None)
+    p.add_argument("--tenant-inflight", type=int, default=None)
+    p.add_argument("--request-timeout", type=float, default=None)
+    p.add_argument("--attach-timeout", type=float, default=180.0)
+    args = p.parse_args(argv)
+
+    if args.run_dir:
+        os.environ["NBD_RUN_DIR"] = args.run_dir
+    policy = SchedPolicy.pool_from_env()
+    if args.sched:
+        policy.mode = args.sched
+    if args.mesh_slots is not None:
+        policy.mesh_slots = max(0, args.mesh_slots)
+    if args.queue_depth is not None:
+        policy.queue_depth = max(0, args.queue_depth)
+    if args.tenant_inflight is not None:
+        policy.tenant_inflight = max(0, args.tenant_inflight)
+
+    # Handlers BEFORE construction: spawning the workers is exactly
+    # the window where a fleet exists but no handler did — a SIGTERM
+    # there (the %dist_pool start readiness-timeout path) used to die
+    # with the default action and orphan the half-started workers.
+    state: dict = {"gw": None}
+
+    def _on_signal(signum, _frame):
+        gw = state["gw"]
+        if gw is not None:
+            gw.close()
+        else:
+            # Mid-construction: raise through __init__, whose
+            # BaseException guard reaps anything already spawned.
+            raise SystemExit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (in-process embedding)
+    try:
+        state["gw"] = gw = GatewayDaemon(
+            args.workers, backend=args.backend, host=args.host,
+            tenant_port=args.tenant_port, policy=policy,
+            max_tenants=args.max_tenants,
+            request_timeout=args.request_timeout,
+            attach_timeout=args.attach_timeout)
+        print(f"NBD_GATEWAY_READY run_dir={gw.run_dir} "
+              f"port={gw.tenant_port} world={gw.world_size}",
+              flush=True)
+        gw.wait()
+    finally:
+        if state["gw"] is not None:
+            state["gw"].close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

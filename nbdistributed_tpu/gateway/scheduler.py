@@ -1,0 +1,370 @@
+"""The mesh scheduler: who runs the next cell on the pooled fleet.
+
+Extracted from the coordinator's request routing (ISSUE 8 tentpole) so
+the gateway daemon and the single-kernel path share ONE dispatch
+decision point: ``CommunicationManager.send_to_ranks`` submits every
+``execute`` request here before it touches the wire.  A plain
+``%dist_init`` world runs the default policy — unlimited mesh slots,
+one implicit tenant — where every submit dispatches immediately, so
+the single-kernel path pays one dict lookup and keeps its exact
+pre-gateway behavior while exercising the same code the pool does.
+
+Pure state machine by design: no threads of its own, an injectable
+monotonic clock (``now=``), and every transition returns an explicit
+verdict dict — the unit tests drive fairness/priority/backpressure/
+shedding with a fake clock and zero sleeps.  The only concession to
+its callers is the per-ticket ``threading.Event`` a queued submitter
+can block on; the scheduler itself never waits.
+
+Admission control and overload behavior (the robustness contract):
+
+- **per-tenant in-flight cap** (``tenant_inflight``): a tenant whose
+  queued+active cells hit the cap gets ``{"status": "rejected"}`` —
+  one tenant's runaway notebook loop cannot monopolize the queue.
+- **queue-depth backpressure** (``queue_depth``): a submit that finds
+  the mesh busy is QUEUED and told so explicitly —
+  ``{"status": "queued", "position": n}`` — never silently blocked.
+- **graceful shedding**: when the queue itself is full, the lowest-
+  priority, youngest queued cell is SHED with a visible verdict (its
+  ticket's event fires so its submitter learns immediately); older and
+  higher-priority work always survives.  The mesh never wedges.
+
+Scheduling policy (``mode``): ``"fifo"`` dispatches in arrival order;
+``"fair"`` (the pool default) picks the highest priority first, then
+the tenant that has been served least, then arrival order — so an
+interactive tenant's occasional cells interleave with a batch tenant's
+flood instead of starving behind it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# Ticket states.
+QUEUED = "queued"
+ACTIVE = "active"
+SHED = "shed"          # overload: a queued cell lost a shedding round
+REJECTED = "rejected"  # admission: refused at the tenant-inflight cap
+DONE = "done"
+
+_DISPATCH = {"status": "dispatch"}
+
+
+class CellRejected(RuntimeError):
+    """Admission control refused the cell outright (tenant cap)."""
+
+    def __init__(self, reason: str, tenant: str):
+        super().__init__(f"cell rejected ({reason}) for tenant "
+                         f"{tenant!r}")
+        self.reason = reason
+        self.tenant = tenant
+
+
+class CellShed(RuntimeError):
+    """The cell was shed under overload (queue full, lowest priority)."""
+
+    def __init__(self, tenant: str, msg_id: str):
+        super().__init__(
+            f"cell shed under overload (tenant {tenant!r}): the queue "
+            f"was full and this was the lowest-priority queued cell")
+        self.tenant = tenant
+        self.msg_id = msg_id
+
+
+class SchedPolicy:
+    """Scheduler configuration.  ``0`` means *unlimited* for every
+    bound — the single-kernel default is all-unlimited FIFO, which
+    reproduces pre-gateway behavior exactly."""
+
+    __slots__ = ("mode", "mesh_slots", "tenant_inflight", "queue_depth")
+
+    def __init__(self, mode: str = "fifo", mesh_slots: int = 0,
+                 tenant_inflight: int = 0, queue_depth: int = 0):
+        if mode not in ("fifo", "fair"):
+            raise ValueError(f"unknown scheduler mode {mode!r} "
+                             "(fifo|fair)")
+        self.mode = mode
+        self.mesh_slots = max(0, int(mesh_slots))
+        self.tenant_inflight = max(0, int(tenant_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+
+    @classmethod
+    def pool_from_env(cls, env=None) -> "SchedPolicy":
+        """The gateway's policy from the ``NBD_POOL_*`` /
+        ``NBD_TENANT_*`` knobs (serial mesh, fair-share, bounded
+        queue by default)."""
+        from ..utils import knobs
+        mode = knobs.get_str("NBD_POOL_SCHED", "fair", env=env) or "fair"
+        if mode not in ("fifo", "fair"):
+            # Knobs convention: an env typo degrades to the default
+            # instead of killing the daemon at construction.
+            mode = "fair"
+        return cls(
+            mode=mode,
+            mesh_slots=knobs.get_int("NBD_POOL_MESH_SLOTS", 1, env=env),
+            tenant_inflight=knobs.get_int("NBD_TENANT_MAX_INFLIGHT", 8,
+                                          env=env),
+            queue_depth=knobs.get_int("NBD_POOL_QUEUE_DEPTH", 64,
+                                      env=env))
+
+    def describe(self) -> dict:
+        return {"mode": self.mode, "mesh_slots": self.mesh_slots,
+                "tenant_inflight": self.tenant_inflight,
+                "queue_depth": self.queue_depth}
+
+
+class Ticket:
+    """One scheduled cell.  ``event`` fires when the ticket leaves the
+    queue — promoted to ACTIVE (run it) or SHED (report the verdict);
+    check ``state`` after the wait."""
+
+    __slots__ = ("tenant", "msg_id", "priority", "seq", "state",
+                 "enqueued_at", "verdict", "event")
+
+    def __init__(self, tenant: str, msg_id: str, priority: int,
+                 seq: int, now: float):
+        self.tenant = tenant
+        self.msg_id = msg_id
+        self.priority = priority
+        self.seq = seq
+        self.state = QUEUED
+        self.enqueued_at = now
+        self.verdict: dict = {}
+        self.event = threading.Event()
+
+
+class _TenantStats:
+    __slots__ = ("queued", "active", "served", "completed", "shed",
+                 "rejected")
+
+    def __init__(self):
+        self.queued = 0
+        self.active = 0
+        self.served = 0      # total dispatches granted (fair-share key)
+        self.completed = 0
+        self.shed = 0
+        self.rejected = 0
+
+    def as_dict(self) -> dict:
+        return {"queued": self.queued, "active": self.active,
+                "served": self.served, "completed": self.completed,
+                "shed": self.shed, "rejected": self.rejected}
+
+
+class Scheduler:
+    """Thread-safe dispatch gate over the mesh.  See module docstring
+    for the policy contract."""
+
+    def __init__(self, policy: SchedPolicy | None = None, *,
+                 now=time.monotonic):
+        self.policy = policy or SchedPolicy()
+        self._now = now
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._queue: list[Ticket] = []          # queued, arrival order
+        self._active: dict[str, Ticket] = {}    # msg_id -> ticket
+        self._tenants: dict[str, _TenantStats] = {}
+        self.shed_total = 0
+
+    # ------------------------------------------------------------------
+
+    def _stats(self, tenant: str) -> _TenantStats:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self._tenants[tenant] = _TenantStats()
+        return st
+
+    def _slots_free(self) -> bool:
+        return (not self.policy.mesh_slots
+                or len(self._active) < self.policy.mesh_slots)
+
+    def _grant(self, t: Ticket) -> None:
+        # Lock held.  QUEUED/fresh -> ACTIVE.
+        st = self._stats(t.tenant)
+        if t.state == QUEUED and t in self._queue:
+            self._queue.remove(t)
+            st.queued -= 1
+        t.state = ACTIVE
+        st.active += 1
+        st.served += 1
+        self._active[t.msg_id] = t
+        t.event.set()
+
+    def _shed_ticket(self, t: Ticket) -> None:
+        # Lock held.  QUEUED -> SHED, visible verdict, event fired.
+        if t in self._queue:
+            self._queue.remove(t)
+        st = self._stats(t.tenant)
+        st.queued -= 1
+        st.shed += 1
+        self.shed_total += 1
+        t.state = SHED
+        t.verdict = {"status": "shed", "reason": "overload",
+                     "tenant": t.tenant, "msg_id": t.msg_id}
+        t.event.set()
+
+    def _pick_next(self) -> Ticket | None:
+        # Lock held.  FIFO: arrival order.  Fair: highest priority,
+        # then least-served tenant, then arrival order.
+        if not self._queue:
+            return None
+        if self.policy.mode == "fifo":
+            return self._queue[0]
+        return min(self._queue,
+                   key=lambda t: (-t.priority,
+                                  self._stats(t.tenant).served,
+                                  t.seq))
+
+    def _promote(self) -> list[Ticket]:
+        # Lock held.  Fill free slots from the queue.
+        promoted = []
+        while self._queue and self._slots_free():
+            t = self._pick_next()
+            if t is None:
+                break
+            self._grant(t)
+            promoted.append(t)
+        return promoted
+
+    # ------------------------------------------------------------------
+
+    def submit(self, tenant: str, msg_id: str,
+               priority: int = 0) -> Ticket:
+        """Admit one cell.  The returned ticket's ``verdict`` is one
+        of::
+
+            {"status": "dispatch"}                    # run it now
+            {"status": "queued", "position": n}       # wait on .event
+            {"status": "rejected", "reason": ...}     # tenant cap hit
+            {"status": "shed", "reason": "overload",  # queue full and
+             ...}                                     # this was lowest
+
+        A queued submit that later loses a shedding decision flips to
+        SHED and fires its event — the waiter must re-check ``state``.
+        ``verdict`` may also carry ``"victims"``: JSON-safe summaries
+        (``{"tenant", "msg_id", "priority"}``) of OTHER submitters'
+        cells this admission shed.  Informational only — each victim's
+        own blocked submit thread is what delivers its shed verdict."""
+        now = self._now()
+        with self._lock:
+            st = self._stats(tenant)
+            t = Ticket(tenant, msg_id, int(priority), self._seq, now)
+            self._seq += 1
+            cap = self.policy.tenant_inflight
+            if cap and st.queued + st.active >= cap:
+                st.rejected += 1
+                # Distinct terminal state: a consumer branching on
+                # ``state`` (send_to_ranks raises CellShed on SHED)
+                # must not misreport a capacity refusal as an
+                # overload shed.
+                t.state = REJECTED
+                t.verdict = {"status": "rejected",
+                             "reason": "tenant-inflight-cap",
+                             "limit": cap, "tenant": tenant}
+                t.event.set()
+                return t
+            if self._slots_free() and not self._queue:
+                self._grant(t)
+                t.verdict = dict(_DISPATCH)
+                return t
+            # Mesh busy: queue with an explicit position reply.
+            self._queue.append(t)
+            st.queued += 1
+            victims: list[dict] = []
+            depth = self.policy.queue_depth
+            while depth and len(self._queue) > depth:
+                # Overload: shed the lowest-priority, youngest queued
+                # cell (max seq among min priority) — older and
+                # higher-priority work survives.
+                victim = max(self._queue,
+                             key=lambda q: (-q.priority, q.seq))
+                self._shed_ticket(victim)
+                if victim is not t:
+                    victims.append({"tenant": victim.tenant,
+                                    "msg_id": victim.msg_id,
+                                    "priority": victim.priority})
+            if t.state == SHED:
+                if victims:
+                    t.verdict["victims"] = victims
+                return t
+            t.verdict = {"status": "queued",
+                         "position": self._queue.index(t) + 1}
+            if victims:
+                t.verdict["victims"] = victims
+            return t
+
+    def complete(self, msg_id: str) -> list[Ticket]:
+        """Release the cell's mesh slot (success OR failure) and
+        promote queued work into the freed capacity.  Returns the
+        promoted tickets (their events are already set)."""
+        with self._lock:
+            t = self._active.pop(msg_id, None)
+            if t is not None:
+                t.state = DONE
+                st = self._stats(t.tenant)
+                st.active -= 1
+                st.completed += 1
+            return self._promote()
+
+    def cancel(self, msg_id: str) -> bool:
+        """Withdraw a queued or active cell (submitter timeout / tenant
+        gone before dispatch).  Frees capacity like :meth:`complete`
+        but counts nothing as completed."""
+        with self._lock:
+            t = self._active.pop(msg_id, None)
+            if t is not None:
+                t.state = DONE
+                st = self._stats(t.tenant)
+                st.active -= 1
+                self._promote()
+                return True
+            for t in self._queue:
+                if t.msg_id == msg_id:
+                    self._queue.remove(t)
+                    self._stats(t.tenant).queued -= 1
+                    t.state = DONE
+                    t.event.set()
+                    return True
+        return False
+
+    def tenant_idle(self, tenant: str) -> bool:
+        """True when this tenant has nothing queued and nothing
+        active — the gateway may safely forget it."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            return st is None or (st.queued == 0 and st.active == 0)
+
+    def forget_tenant(self, tenant: str) -> bool:
+        """Drop an evicted tenant's stats entry.  Without this the
+        per-tenant dict grows one entry per name forever, snapshot()
+        lists long-gone tenants, and a NEW tenant reusing the name
+        inherits the old ``served`` count — fair mode would
+        deprioritize it against genuinely fresh tenants.  Refused
+        while the tenant still has queued/active work."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None or st.queued or st.active:
+                return st is None
+            del self._tenants[tenant]
+            return True
+
+    def position(self, msg_id: str) -> int | None:
+        """1-based queue position, or None when not queued."""
+        with self._lock:
+            for i, t in enumerate(self._queue):
+                if t.msg_id == msg_id:
+                    return i + 1
+        return None
+
+    def snapshot(self) -> dict:
+        """Counters for ``%dist_pool status`` / metrics export."""
+        with self._lock:
+            return {
+                "policy": self.policy.describe(),
+                "queued": len(self._queue),
+                "active": len(self._active),
+                "shed_total": self.shed_total,
+                "tenants": {k: v.as_dict()
+                            for k, v in sorted(self._tenants.items())},
+            }

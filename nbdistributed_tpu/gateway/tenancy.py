@@ -1,0 +1,215 @@
+"""Tenant identity, fencing, and parked-result partitions.
+
+Per-tenant reuse of the durable-session machinery (PR 4): every tenant
+gets its own session **token** (minted with
+:func:`~nbdistributed_tpu.resilience.session.mint_token`) and its own
+monotonically increasing **epoch**.  A tenant kernel that crashes and
+reattaches (``%dist_attach --tenant``) proves the token and bumps the
+epoch — from then on, frames from the dead kernel's old connection
+(stamped with the older epoch) are rejected with ``stale_epoch``,
+exactly the stale-coordinator fence, scoped to one tenant.  Results
+that finish while a tenant has no live connection park in that
+tenant's own
+:class:`~nbdistributed_tpu.resilience.dedup.ResultMailbox` partition;
+a reattach drains them destructively — exactly once.
+
+The registry is also the **admission** gate for the pool's tenant
+count (``max_tenants``): the per-tenant in-flight cap and queue-depth
+backpressure live in the :class:`~.scheduler.Scheduler`; the headcount
+lives here, at hello time, where a new tenant can be refused before it
+costs anything.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..resilience.dedup import ResultMailbox
+from ..resilience.session import mint_token, token_fingerprint
+
+
+class TenantRejected(RuntimeError):
+    def __init__(self, reason: str, name: str):
+        super().__init__(f"tenant {name!r} rejected: {reason}")
+        self.reason = reason
+
+
+class Tenant:
+    __slots__ = ("name", "token", "epoch", "client_id", "mailbox",
+                 "priority", "admitted_ts", "last_seen", "reattaches",
+                 "cells_submitted", "cells_done", "cells_failed",
+                 "parked_total")
+
+    def __init__(self, name: str, token: str, priority: int = 0):
+        self.name = name
+        self.token = token
+        self.epoch = 1
+        self.client_id: int | None = None   # live tenant-plane conn
+        self.mailbox = ResultMailbox()      # this tenant's partition
+        self.priority = int(priority)
+        self.admitted_ts = time.time()
+        self.last_seen = time.time()
+        self.reattaches = 0
+        self.cells_submitted = 0
+        self.cells_done = 0
+        self.cells_failed = 0
+        self.parked_total = 0
+
+    @property
+    def attached(self) -> bool:
+        return self.client_id is not None
+
+    def describe(self) -> dict:
+        return {"name": self.name,
+                "token_fp": token_fingerprint(self.token),
+                "epoch": self.epoch,
+                "attached": self.attached,
+                "priority": self.priority,
+                "reattaches": self.reattaches,
+                "cells_submitted": self.cells_submitted,
+                "cells_done": self.cells_done,
+                "cells_failed": self.cells_failed,
+                "parked": len(self.mailbox),
+                "parked_total": self.parked_total,
+                "last_seen_age_s": round(time.time() - self.last_seen,
+                                         1)}
+
+
+class TenantRegistry:
+    """Name -> :class:`Tenant`, with the hello/fence state machine."""
+
+    def __init__(self, max_tenants: int = 8):
+        self.max_tenants = max(1, int(max_tenants))
+        self._lock = threading.Lock()
+        self._tenants: dict[str, Tenant] = {}
+        self._by_client: dict[int, str] = {}
+
+    # ------------------------------------------------------------------
+
+    def hello(self, name: str, token: str | None, client_id: int, *,
+              priority: int | None = None) -> tuple[Tenant, dict]:
+        """Admit or reattach a tenant connection.
+
+        - Unknown ``name``: admit (minting a token) unless the pool is
+          at ``max_tenants`` — admission control's headcount bound.
+        - Known ``name`` + matching token: **reattach** — bump the
+          tenant epoch (fencing out the previous connection) and
+          rebind the live client id.
+        - Known ``name`` + wrong/absent token: rejected — a tenant
+          name cannot be hijacked without its session token.
+
+        Returns ``(tenant, reply_data)``; raises
+        :class:`TenantRejected` on refusal.
+        """
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                if len(self._tenants) >= self.max_tenants:
+                    raise TenantRejected(
+                        f"pool is at max_tenants={self.max_tenants}",
+                        name)
+                t = Tenant(name, token or mint_token(),
+                           priority=priority if priority is not None
+                           else 0)
+                self._tenants[name] = t
+                event = "admitted"
+            else:
+                if token != t.token:
+                    raise TenantRejected(
+                        "session token mismatch (not this tenant's "
+                        "session)", name)
+                t.epoch += 1
+                t.reattaches += 1
+                # A DECLARED priority wins on reattach (`%dist_attach
+                # --tenant NAME --priority N` after a crash used to be
+                # silently ignored); an OMITTED one (None) keeps the
+                # tenant's current value — the argparse default must
+                # not demote a priority-5 tenant to 0 on every plain
+                # reattach.
+                if priority is not None:
+                    t.priority = priority
+                event = "reattached"
+            # The previous connection's client id stays mapped to this
+            # tenant ON PURPOSE: its frames must resolve to the tenant
+            # so the epoch fence can answer them with an explicit
+            # ``stale_epoch`` (not a generic no-hello error).  The
+            # mapping dies with the connection (detach_client on EOF).
+            t.client_id = client_id
+            self._by_client[client_id] = name
+            t.last_seen = time.time()
+            return t, {"status": event, "tenant": name,
+                       "token": t.token, "epoch": t.epoch,
+                       "parked": t.mailbox.ids()}
+
+    def fence(self, tenant: Tenant, frame_epoch: int | None) -> bool:
+        """True when a frame stamped ``frame_epoch`` is STALE for this
+        tenant (an older connection's traffic after a reattach bumped
+        the epoch).  Unstamped frames are never fenced — same contract
+        as the session-epoch fence."""
+        return frame_epoch is not None and frame_epoch < tenant.epoch
+
+    # ------------------------------------------------------------------
+
+    def by_client(self, client_id: int) -> Tenant | None:
+        with self._lock:
+            name = self._by_client.get(client_id)
+            return self._tenants.get(name) if name else None
+
+    def get(self, name: str) -> Tenant | None:
+        with self._lock:
+            return self._tenants.get(name)
+
+    def detach_client(self, client_id: int) -> Tenant | None:
+        """The tenant's connection dropped (kernel crash or exit):
+        keep the tenant — its queued/in-flight work and mailbox survive
+        for reattach — but stop routing replies to the dead socket.
+
+        Returns the tenant only when this client id WAS its live
+        connection; a superseded (fenced) old connection finally
+        EOF-ing returns None, so callers never count a reattached
+        tenant as detached."""
+        with self._lock:
+            name = self._by_client.pop(client_id, None)
+            t = self._tenants.get(name) if name else None
+            if t is not None and t.client_id == client_id:
+                t.client_id = None
+                return t
+            return None
+
+    def evict(self, name: str) -> bool:
+        """Forget a DEPARTED tenant outright, freeing its
+        ``max_tenants`` slot.  The daemon calls this only on a clean
+        detach with an empty mailbox and nothing queued/active —
+        without it, a rotation of N distinct tenant names would wedge
+        the pool's admission forever.  A crashed tenant (or one with
+        parked/in-flight work) keeps its slot for reattach."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None or t.attached:
+                return False
+            self._by_client = {c: n
+                               for c, n in self._by_client.items()
+                               if n != name}
+            del self._tenants[name]
+            return True
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"max_tenants": self.max_tenants,
+                    "tenants": {n: t.describe()
+                                for n, t in sorted(
+                                    self._tenants.items())}}
+
+    def manifest_block(self) -> dict:
+        """The ``tenants`` block of the gateway manifest: enough for a
+        local kernel to reattach by name (token + epoch), mirroring
+        how ``session.json`` records the single-kernel session token."""
+        with self._lock:
+            return {n: {"token": t.token, "epoch": t.epoch,
+                        "attached": t.attached}
+                    for n, t in sorted(self._tenants.items())}

@@ -71,6 +71,8 @@ collectives, subset hazards, host-syncs in loops — strict blocks
 error-severity cells; also %%distributed --strict per cell) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
+%dist_pool start|status|stop (shared multi-tenant worker pool;
+%dist_attach --tenant NAME joins it with an isolated namespace) ·
 %dist_gc (sweep stale session run dirs) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown (explicit fleet teardown — a kernel restart alone only
@@ -133,6 +135,12 @@ class DistributedMagics(Magics):
     # True when this kernel joined the fleet via %dist_attach rather
     # than spawning it (durable sessions) — surfaced in %dist_status.
     _attached: bool = False
+    # Tenant mode (gateway pools, ISSUE 8): this kernel is attached to
+    # a shared pool as one tenant (`%dist_attach --tenant NAME`).  The
+    # client replaces (comm, pm) — cells route through the gateway's
+    # scheduler, and %dist_status/%dist_top render the pool view.
+    _tenant = None              # gateway.client.TenantClient | None
+    _pool_info: dict | None = None   # the gateway manifest we attached to
 
     _cell_hooks: tuple | None = None
 
@@ -231,6 +239,7 @@ class DistributedMagics(Magics):
         # the next one.  _last_ckpt_path survives like _last_init_line:
         # it names a COMPLETED checkpoint, healing's restore target.
         cls._clear_bg_ckpt()
+        cls._drop_tenant_state()
         cls._comm = None
         cls._pm = None
         cls._world = 0
@@ -258,7 +267,18 @@ class DistributedMagics(Magics):
 
     def _require_cluster(self) -> bool:
         if not self._running():
-            print("❌ No distributed cluster. Run %dist_init first.")
+            if DistributedMagics._tenant is not None:
+                # "%dist_init first" would be circular advice here —
+                # %dist_init itself refuses in tenant mode.
+                print(f"❌ attached to a gateway pool as tenant "
+                      f"{DistributedMagics._tenant.name!r} — only "
+                      "%%distributed cells run on a pool (subset "
+                      "%%rank, %sync, interrupts and friends need a "
+                      "dedicated fleet: %dist_shutdown to detach, "
+                      "then %dist_init).")
+            else:
+                print("❌ No distributed cluster. Run %dist_init "
+                      "first.")
             return False
         return True
 
@@ -450,6 +470,14 @@ class DistributedMagics(Magics):
         args = parse_argstring(self.dist_init, line)
         if args.attach_dir is not None:
             return self.dist_attach(args.attach_dir)
+        if DistributedMagics._tenant is not None:
+            # Tenant mode routes every cell to the pool; a second
+            # local fleet here would spawn, burn chips, and never
+            # receive a cell.
+            print(f"⚠️ attached to a gateway pool as tenant "
+                  f"{DistributedMagics._tenant.name!r} — "
+                  "%dist_shutdown (detaches, pool survives) first.")
+            return
         if self._running():
             print(f"⚠️ {self._world} workers already running. "
                   "%dist_shutdown first.")
@@ -750,27 +778,51 @@ class DistributedMagics(Magics):
                    "none — training mode)")
     @argument("--attach-timeout", type=float, default=90.0,
               help="seconds to wait for orphaned workers to dial back")
+    @argument("--tenant", default=None,
+              help="attach to a GATEWAY POOL as this tenant name "
+                   "(%%dist_pool start spawns one) instead of adopting "
+                   "a single-kernel fleet; reattaching under the same "
+                   "name resumes the tenant session and drains its "
+                   "parked results exactly once")
+    @argument("--priority", type=int, default=None,
+              help="tenant scheduling priority in the pool's "
+                   "fair-share queue (higher wins; tenant mode "
+                   "only).  Omitted on a reattach = keep the "
+                   "tenant's current priority (new tenants get 0)")
     @line_magic
     def dist_attach(self, line):
         """Reattach this kernel to a fleet that survived its
-        coordinator's death (durable sessions).
+        coordinator's death (durable sessions), or — with
+        ``--tenant NAME`` — attach to a shared gateway pool as one
+        tenant of many.
 
-        Reads the session manifest under the run dir, adopts the
-        worker pids, re-binds the control endpoint, bumps the session
-        epoch (fencing out any stale coordinator), verifies the
-        session token with a per-rank hello, and drains results the
-        workers parked while orphaned — the interrupted cell's output
-        is redelivered exactly once, and every worker's namespace,
-        compiled functions, and device state are exactly as the crash
-        left them."""
+        The single-kernel path reads the session manifest under the
+        run dir, adopts the worker pids, re-binds the control
+        endpoint, bumps the session epoch (fencing out any stale
+        coordinator), verifies the session token with a per-rank
+        hello, and drains results the workers parked while orphaned —
+        the interrupted cell's output is redelivered exactly once, and
+        every worker's namespace, compiled functions, and device state
+        are exactly as the crash left them.  The tenant path does the
+        same dance against the gateway: a reattach under the same name
+        proves the tenant token, bumps the TENANT epoch (fencing the
+        crashed kernel's old connection), and drains the tenant's own
+        parked-result partition exactly once."""
         from ..resilience import session as session_mod
         args = parse_argstring(self.dist_attach, line)
-        if self._running():
-            print(f"⚠️ {self._world} workers already running. "
+        if self._running() or DistributedMagics._tenant is not None:
+            what = ("tenant " + DistributedMagics._tenant.name
+                    if DistributedMagics._tenant is not None
+                    else f"{self._world} workers")
+            print(f"⚠️ already attached ({what}). "
                   "%dist_shutdown first.")
             return
         t0 = time.time()
         run_dir = (args.run_dir or "").strip().strip("'\"") or None
+        if args.tenant:
+            return self._attach_tenant(
+                run_dir, args.tenant.strip().strip("'\""),
+                priority=args.priority, timeout=args.timeout)
         try:
             comm, pm, manifest, hello = session_mod.attach(
                 run_dir, attach_timeout=args.attach_timeout,
@@ -806,12 +858,8 @@ class DistributedMagics(Magics):
                 drained = {}
             for r in sorted(drained):
                 for mid, res in drained[r].items():
-                    res = res or {}
-                    text = (res.get("error")
-                            or str(res.get("output") or "").strip()
-                            or "(no output)")
-                    print(f"📬 rank {r} · interrupted cell "
-                          f"{mid[:8]}… finished while orphaned: {text}")
+                    self._render_late_result(
+                        r, res, "finished while orphaned", mid=mid)
         if manifest.get("supervised") \
                 and DistributedMagics._supervisor is None:
             print("🛡  re-arming supervision (the session had "
@@ -820,6 +868,447 @@ class DistributedMagics(Magics):
         self._maybe_start_watchdog()
         print("Every cell runs on ALL workers again. %dist_status "
               "shows the session header.")
+
+    @staticmethod
+    def _render_late_result(rank, res, suffix: str, *, mid: str = "",
+                            prefix: str = "") -> None:
+        """One 📬 line for a cell result that outlived its waiter —
+        drained from a mailbox (orphaned/detached) or delivered late
+        after an interrupt.  The single render path for all three."""
+        res = res or {}
+        text = (res.get("error")
+                or str(res.get("output") or "").strip()
+                or "(no output)")
+        tag = f" {mid[:8]}…" if mid else ""
+        print(f"{prefix}📬 rank {rank} · interrupted cell{tag} "
+              f"{suffix}: {text}")
+
+    def _render_drained_reply(self, mid, res, suffix: str, *,
+                              prefix: str = "") -> None:
+        """Render one claimed/late reply: per-rank lines when it
+        carries results, else its gateway-level verdict.  The crash
+        verdicts (worker death, request timeout, shed) have no
+        ``results`` key, and the claim that surfaced them was
+        destructive — the verdict renders here or nowhere."""
+        res = res or {}
+        results = res.get("results") or {}
+        if not results:
+            text = (res.get("error")
+                    or f"status={res.get('status') or '?'} "
+                       "(no output)")
+            tag = f" {mid[:8]}…" if mid else ""
+            print(f"{prefix}📬 interrupted cell{tag} {suffix}: {text}")
+            return
+        first = True
+        for r in sorted(results, key=int):
+            self._render_late_result(r, results[r], suffix, mid=mid,
+                                     prefix=prefix if first else "")
+            first = False
+
+    # ==================================================================
+    # session gateway: tenant attach + %dist_pool (ISSUE 8)
+
+    @classmethod
+    def _drop_tenant_state(cls, *, detach: bool = False) -> str | None:
+        """The one tenant-teardown path (reset, %dist_shutdown,
+        %dist_pool stop): close the client, clear the pool
+        bookkeeping.  Returns the tenant name, or None when this
+        kernel was not attached."""
+        t = cls._tenant
+        if t is None:
+            return None
+        try:
+            t.close(detach=detach)
+        except Exception:
+            pass
+        cls._tenant = None
+        cls._pool_info = None
+        cls._world = 0
+        cls._attached = False
+        return t.name
+
+    def _attach_tenant(self, run_dir, name, *, priority=None,
+                       timeout=None):
+        from ..gateway import daemon as gw_mod
+        from ..gateway.client import TenantClient
+        d = gw_mod.discover_gateway(run_dir)
+        if d is None:
+            print("❌ no gateway pool found"
+                  + (f" in {run_dir}" if run_dir else
+                     " (start one: %dist_pool start -n 4, or pass "
+                     "its run dir)"))
+            return
+        manifest = gw_mod.read_gateway_manifest(d)
+        if manifest is None or not gw_mod.gateway_alive(manifest):
+            print(f"❌ {d} has no live gateway daemon "
+                  "(%dist_pool status / %dist_gc --dry-run to "
+                  "inspect)")
+            return
+        plane = manifest.get("tenant_plane") or {}
+        # A prior session under this name: its token (recorded in the
+        # gateway manifest, same-filesystem trust like session.json)
+        # proves we RESUME it — the gateway bumps the tenant epoch and
+        # fences the crashed kernel's old connection.
+        token = ((manifest.get("tenants") or {}).get(name)
+                 or {}).get("token")
+        t0 = time.time()
+        try:
+            client = TenantClient(
+                plane.get("host") or "127.0.0.1",
+                int(plane.get("port") or 0), name, token=token,
+                pool_token=manifest.get("pool_token"),
+                priority=priority, on_stream=self._feed_stream,
+                hello_timeout=float(timeout) if timeout else 30.0)
+        except Exception as e:
+            print(f"❌ tenant attach failed: {e}")
+            return
+
+        def _on_parked(_d: dict) -> None:
+            # A cell that was in flight ACROSS the reattach just
+            # finished and parked — the hello's parked list predates
+            # it, so this nudge is the only signal it exists.  Drain
+            # off the reader thread: drain() waits on a reply the
+            # reader itself delivers.
+            def _drain_bg():
+                try:
+                    drained = client.drain()
+                except Exception:
+                    return   # stays claimable on the next attach
+                first = True
+                for mid, res in sorted(drained.items()):
+                    self._render_drained_reply(
+                        mid, res, "finished while reattaching",
+                        prefix="\n" if first else "")
+                    first = False
+            threading.Thread(target=_drain_bg, daemon=True,
+                             name="nbd-parked-drain").start()
+
+        client.on_parked = _on_parked
+        DistributedMagics._tenant = client
+        DistributedMagics._pool_info = {"run_dir": d, **manifest}
+        DistributedMagics._world = client.world_size
+        DistributedMagics._attached = True
+        verb = ("🔗 reattached" if client.attach_status == "reattached"
+                else "🤝 attached")
+        pol = client.policy or {}
+        print(f"{verb} to pool {d} as tenant {name!r} "
+              f"(epoch {client.epoch}, {client.world_size} ranks, "
+              f"sched {pol.get('mode', '?')}, "
+              f"{time.time() - t0:.1f}s)")
+        if client.parked:
+            # Exactly-once redelivery of results that finished while
+            # this tenant had no kernel.
+            def _late_drain(claimed: dict) -> None:
+                # The drain reply outlived its waiter (timeout or
+                # Ctrl-C mid-attach).  The gateway's claim was already
+                # destructive, so render from the reader thread — the
+                # alternative is losing the results on both sides.
+                first = True
+                for mid, res in sorted(claimed.items()):
+                    self._render_drained_reply(
+                        mid, res, "finished while detached",
+                        prefix="\n" if first else "")
+                    first = False
+            try:
+                drained = client.drain(on_late=_late_drain)
+            except Exception as e:
+                print(f"⚠️ mailbox drain failed: {e} — parked results "
+                      "remain claimable on the gateway")
+                drained = {}
+            for mid, res in sorted(drained.items()):
+                self._render_drained_reply(mid, res,
+                                           "finished while detached")
+        print("Cells (%%distributed) now run on the POOL under this "
+              "tenant's isolated namespace; `shared` is the opt-in "
+              "cross-tenant dict. %dist_pool status shows the queue.")
+
+    def _pool_endpoint(self, run_dir=None):
+        """(manifest, run_dir) of the pool to administer: the attached
+        one first, else discovery."""
+        from ..gateway import daemon as gw_mod
+        if run_dir is None and DistributedMagics._pool_info is not None:
+            d = DistributedMagics._pool_info.get("run_dir")
+            m = gw_mod.read_gateway_manifest(d)
+            # No silent fallback to discovery here: a bare
+            # `%dist_pool stop` targets THE ATTACHED pool, and if its
+            # manifest is gone, discovering the newest other live pool
+            # would aim the shutdown at a pool the user never meant
+            # (possibly someone else's).  Name the problem instead.
+            if m is None:
+                print(f"⚠️ attached pool {d} has no readable manifest "
+                      "(daemon exited?) — pass --run-dir explicitly "
+                      "to administer a different pool")
+            return m, d
+        d = gw_mod.discover_gateway(run_dir)
+        if d is None:
+            return None, None
+        return gw_mod.read_gateway_manifest(d), d
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["start", "status", "stop"])
+    @argument("-n", "--workers", type=int, default=2,
+              help="pool world size (start)")
+    @argument("--backend", default="auto",
+              choices=["auto", "cpu", "tpu"])
+    @argument("--run-dir", default=None,
+              help="pool run dir (start: minted when omitted; "
+                   "status/stop: discovery override)")
+    @argument("--max-tenants", type=int, default=None)
+    @argument("--sched", default=None, choices=[None, "fifo", "fair"])
+    @argument("--mesh-slots", type=int, default=None)
+    @argument("--queue-depth", type=int, default=None)
+    @argument("--tenant-inflight", type=int, default=None)
+    @argument("--start-timeout", type=float, default=240.0,
+              help="seconds to wait for the daemon's readiness line")
+    @line_magic
+    def dist_pool(self, line):
+        """Gateway pool admin: ``%dist_pool start -n 4`` spawns a
+        gateway daemon owning a pooled worker fleet that N notebook
+        kernels share (``%dist_attach --tenant NAME``);
+        ``status`` shows the scheduler queue, per-tenant counters, and
+        tenant-attributed per-rank busy state; ``stop`` shuts the
+        daemon and its workers down.  Scheduling/admission defaults
+        come from the ``NBD_POOL_*``/``NBD_TENANT_*`` knobs."""
+        import subprocess
+        import sys as _sys
+
+        from ..gateway import daemon as gw_mod
+        args = parse_argstring(self.dist_pool, line)
+        if args.command == "start":
+            run_dir = args.run_dir
+            if not run_dir:
+                import tempfile
+                from ..resilience import session as session_mod
+                root = session_mod.default_runs_root()
+                import os as _os
+                _os.makedirs(root, exist_ok=True)
+                run_dir = tempfile.mkdtemp(prefix="pool-", dir=root)
+            cmd = [_sys.executable, "-m",
+                   "nbdistributed_tpu.gateway.daemon",
+                   "-n", str(args.workers), "--backend", args.backend,
+                   "--run-dir", run_dir]
+            for flag, v in (("--max-tenants", args.max_tenants),
+                            ("--sched", args.sched),
+                            ("--mesh-slots", args.mesh_slots),
+                            ("--queue-depth", args.queue_depth),
+                            ("--tenant-inflight",
+                             args.tenant_inflight)):
+                if v is not None:
+                    cmd += [flag, str(v)]
+            import os as _os
+            env = dict(_os.environ)
+            env.pop("NBD_RUN_DIR", None)  # the daemon owns its own
+            print(f"🚀 starting gateway pool ({args.workers} workers, "
+                  f"backend={args.backend}) → {run_dir}")
+            # Daemon output goes to a log FILE, not a pipe: the
+            # daemon outlives this kernel by design and nobody would
+            # drain a pipe — one chatty dependency later the ~64 KiB
+            # buffer fills and every daemon write (and the pool with
+            # it) wedges.
+            log_path = _os.path.join(run_dir, "gateway.log")
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                        stderr=subprocess.STDOUT,
+                                        start_new_session=True)
+            deadline = time.time() + args.start_timeout
+            m = None
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    try:
+                        with open(log_path, "rb") as f:
+                            out = f.read().decode("utf-8", "replace")
+                    except OSError:
+                        out = ""
+                    print(f"❌ gateway daemon exited "
+                          f"({proc.returncode}):\n{out[-2000:]}")
+                    return
+                m = gw_mod.read_gateway_manifest(run_dir)
+                if gw_mod.gateway_alive(m):
+                    break
+                time.sleep(0.3)
+            if not gw_mod.gateway_alive(m):
+                # SIGTERM, not SIGKILL: the daemon installs its
+                # handlers before spawning, so a graceful stop reaps
+                # the half-started fleet — SIGKILL orphaned those
+                # workers (and any TPU devices they held) until the
+                # orphan TTL expired.
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except Exception:
+                    proc.kill()
+                print("❌ gateway daemon never became ready "
+                      f"(waited {args.start_timeout:.0f}s)")
+                return
+            plane = m.get("tenant_plane") or {}
+            print(f"✅ pool up: pid {m.get('pid')} · tenant plane "
+                  f"{plane.get('host')}:{plane.get('port')} · "
+                  f"policy {m.get('policy')} · run dir {run_dir}")
+            print(f"   attach kernels with: %dist_attach --tenant "
+                  f"NAME {run_dir}")
+            return
+        manifest, d = self._pool_endpoint(args.run_dir)
+        if manifest is None:
+            print("❌ no gateway pool found (start one: %dist_pool "
+                  "start -n 4)")
+            return
+        plane = manifest.get("tenant_plane") or {}
+        if args.command == "stop":
+            from ..gateway.client import pool_shutdown
+            try:
+                res = pool_shutdown(plane.get("host") or "127.0.0.1",
+                                    int(plane.get("port") or 0),
+                                    manifest.get("pool_token"))
+            except Exception as e:
+                print(f"❌ pool stop failed: {e}")
+                return
+            attached_dir = (DistributedMagics._pool_info or {}).get(
+                "run_dir")
+            # Only tear down this kernel's attachment when the pool
+            # we just stopped IS the attached one (stop --run-dir X
+            # must not drop a live attachment to pool Y).
+            if (DistributedMagics._tenant is not None
+                    and attached_dir == d):
+                DistributedMagics._drop_tenant_state()
+            print(f"🛑 pool {d}: {res.get('status', res)}")
+            return
+        # status — the attached tenant connection only answers for
+        # ITS pool: `status --run-dir X` while attached to pool Y
+        # must probe X, not render Y's queue under X's run dir
+        # (same cross-pool guard as stop above).
+        attached_dir = (DistributedMagics._pool_info or {}).get(
+            "run_dir")
+        client = (DistributedMagics._tenant if attached_dir == d
+                  else None)
+        try:
+            if client is not None and client.alive:
+                st = client.pool_status()
+            else:
+                from ..gateway.client import pool_status_probe
+                st = pool_status_probe(
+                    plane.get("host") or "127.0.0.1",
+                    int(plane.get("port") or 0),
+                    manifest.get("pool_token"))
+        except Exception as e:
+            print(f"❌ pool status failed: {e}")
+            return
+        self._render_pool_status(st, d)
+
+    def _render_pool_status(self, st: dict, run_dir) -> None:
+        sched = st.get("scheduler") or {}
+        pol = sched.get("policy") or {}
+        print(f"🏊 pool {run_dir} · pid {st.get('pid')} · "
+              f"{st.get('world_size')} ranks · sched "
+              f"{pol.get('mode')} (slots {pol.get('mesh_slots')}, "
+              f"queue {sched.get('queued', 0)}/"
+              f"{pol.get('queue_depth') or '∞'}, active "
+              f"{sched.get('active', 0)}, shed "
+              f"{sched.get('shed_total', 0)} total)")
+        tenants = (st.get("tenants") or {}).get("tenants") or {}
+        me = (DistributedMagics._tenant.name
+              if DistributedMagics._tenant is not None else None)
+        if tenants:
+            hdr = (f"{'tenant':<14}{'state':<10}{'epoch':<7}"
+                   f"{'prio':<6}{'queued':<8}{'active':<8}"
+                   f"{'done':<7}{'shed':<6}{'rej':<5}{'parked':<7}")
+            print(hdr)
+            print("─" * len(hdr))
+            per = (sched.get("tenants") or {})
+            for name in sorted(tenants):
+                t = tenants[name]
+                s = per.get(name) or {}
+                mark = "*" if name == me else ""
+                state = ("attached" if t.get("attached")
+                         else "detached")
+                print(f"{(name + mark):<14}{state:<10}"
+                      f"{t.get('epoch', '-'):<7}"
+                      f"{t.get('priority', 0):<6}"
+                      f"{s.get('queued', 0):<8}{s.get('active', 0):<8}"
+                      f"{s.get('completed', 0):<7}"
+                      f"{s.get('shed', 0):<6}{s.get('rejected', 0):<5}"
+                      f"{t.get('parked', 0):<7}")
+        else:
+            print("(no tenants attached yet)")
+        ranks = st.get("ranks") or {}
+        busy_rows = [(r, v) for r, v in sorted(ranks.items(),
+                                               key=lambda kv:
+                                               int(kv[0]))
+                     if v.get("busy_type")]
+        for r, v in busy_rows:
+            who = (f" · tenant {v['tenant']}" if v.get("tenant")
+                   else "")
+            print(f"   rank {r}: ⚙ {v['busy_type']} "
+                  f"{v.get('busy_s', 0):.1f}s{who}")
+        for v in st.get("hang_verdicts") or ():
+            print(f"   ⚠ HUNG [{v.get('kind')}] {v.get('detail')}")
+
+    def _run_on_pool(self, code: str, *, priority=None,
+                     deadline_s=None):
+        """Tenant-mode cell dispatch: submit to the gateway, surface
+        the explicit queue-position / shed / rejected verdicts, and
+        render per-rank results the way the single-kernel path does."""
+        from ..gateway.client import (CellSubmitError, GatewayGone,
+                                      TenantFenced)
+        client = DistributedMagics._tenant
+        rec = self._timeline.start(code,
+                                   list(range(self._world or 0)),
+                                   kind="pool")
+        def _late(d: dict) -> None:
+            # The interrupted cell's terminal reply arrived on this
+            # still-live connection (so the gateway delivered it and
+            # nothing parked): render it instead of dropping it —
+            # including the no-results verdicts (worker death, request
+            # timeout, shed), which are exactly the crash outcomes.
+            self._render_drained_reply("", d, "finished", prefix="\n")
+
+        data = None
+        try:
+            data = client.execute(
+                code, priority=priority, deadline_s=deadline_s,
+                timeout=None,
+                on_queued=lambda pos: print(
+                    f"⏳ pool busy — queued at position {pos}"),
+                on_late=_late)
+        except CellSubmitError as e:
+            v = e.verdict
+            if v.get("status") == "shed":
+                print(f"🪓 {v.get('error')}")
+            else:
+                print(f"🚦 {v.get('error')}")
+            return None
+        except GatewayGone as e:
+            print(f"💀 {e}\n   The pool (or its daemon) is gone — "
+                  "%dist_pool status, or %dist_attach --tenant "
+                  f"{client.name} once it is back.")
+            return None
+        except KeyboardInterrupt:
+            print("\n🛑 interrupt: the cell keeps running on the "
+                  "pool; its result will print here when it finishes "
+                  "(or parks for redelivery on the next attach if "
+                  "this kernel exits first)")
+            return None
+        except Exception as e:
+            print(f"❌ {type(e).__name__}: {e}")
+            return None
+        finally:
+            self._timeline.finish(rec, None)
+        # Only errors render from the reply: stdout AND the result
+        # repr already arrived live as tenant-routed stream_output
+        # frames (same contract as the single-kernel display path —
+        # printing the reply's "output" here would double everything).
+        data = data or {}
+        if data.get("error"):
+            # Gateway-level failure (worker death, request timeout):
+            # there are no per-rank results to render the error from —
+            # without this line the cell looks like a silent success.
+            print(f"❌ pool: {data['error']}")
+        results = data.get("results") or {}
+        for r in sorted(results, key=int):
+            d = results[r] or {}
+            if d.get("error"):
+                print(f"❌ rank {r}: {d['error']}")
+        return results
 
     @magic_arguments()
     @argument("--dry-run", action="store_true",
@@ -845,6 +1334,13 @@ class DistributedMagics(Magics):
               f"kept {len(res['kept'])}")
         for d in res["swept"]:
             print(f"   - {d}")
+        if args.dry_run:
+            # Say WHY each survivor was skipped — "my pool's run dir
+            # vanished" and "why is this old dir still here" get the
+            # same one-line answer.
+            for d in res["kept"]:
+                why = res.get("kept_why", {}).get(d)
+                print(f"   = kept {d}" + (f" — {why}" if why else ""))
         for e in res["errors"]:
             print(f"   ⚠ {e}")
 
@@ -1409,11 +1905,30 @@ class DistributedMagics(Magics):
               help="per-cell budget in seconds: the hang watchdog "
                    "escalates (warn → dump → interrupt → heal, per "
                    "its ladder) when any rank is still busy past it")
+    @argument("--priority", type=int, default=None,
+              help="tenant mode only: this cell's pool-scheduling "
+                   "priority (higher dispatches first in fair mode; "
+                   "default: the tenant's attach-time priority)")
     @cell_magic
     def distributed(self, line, cell):
         """Run the cell on every worker (reference: magic.py:1042-1129).
         ``%%distributed --deadline 60`` arms a per-cell budget the
-        hang watchdog enforces through its escalation ladder."""
+        hang watchdog enforces through its escalation ladder.  In
+        tenant mode (``%dist_attach --tenant``) the cell is submitted
+        to the gateway pool instead — same vetting, explicit
+        queued/shed verdicts, per-tenant isolated namespace."""
+        if DistributedMagics._tenant is not None:
+            try:
+                args = parse_argstring(self.distributed, line)
+            except Exception as e:
+                print(f"❌ {e}")
+                return
+            if not self._vet_cell(cell, list(range(self._world)),
+                                  strict=args.strict):
+                return
+            self._run_on_pool(cell, priority=args.priority,
+                              deadline_s=args.deadline)
+            return
         if not self._require_cluster():
             return
         try:
@@ -1421,6 +1936,9 @@ class DistributedMagics(Magics):
         except Exception as e:
             print(f"❌ {e}")
             return
+        if args.priority is not None:
+            print("⚠️ --priority only applies in tenant (pool) mode "
+                  "— ignored")
         if args.deadline is not None:
             if DistributedMagics._watchdog is None:
                 print("⚠️ --deadline set but the hang watchdog is off "
@@ -1579,7 +2097,23 @@ class DistributedMagics(Magics):
 
     @line_magic
     def dist_status(self, line):
-        """Cluster tree report (reference: magic.py:743-809)."""
+        """Cluster tree report (reference: magic.py:743-809).  In
+        tenant mode this is the POOL view: scheduler queue, tenant
+        table (this tenant starred), tenant-attributed busy ranks."""
+        if DistributedMagics._tenant is not None:
+            client = DistributedMagics._tenant
+            info = DistributedMagics._pool_info or {}
+            print(f"🌐 tenant {client.name!r} @ pool "
+                  f"{info.get('run_dir', '?')} · epoch "
+                  f"{client.epoch} · "
+                  f"{'alive' if client.alive else '💀 gateway gone'}")
+            try:
+                st = client.pool_status()
+            except Exception as e:
+                print(f"   (pool status unavailable: {e})")
+                return
+            self._render_pool_status(st, info.get("run_dir"))
+            return
         if self._pm is None:
             print("❌ No cluster. %dist_init to start one.")
             return
@@ -2336,6 +2870,9 @@ class DistributedMagics(Magics):
         piggybacks and the process table, so it renders instantly even
         while every worker is busy mid-cell (a ``get_status`` probe
         would stall behind the serial request loop)."""
+        if DistributedMagics._tenant is not None:
+            # Tenant mode: the pool view IS the dashboard.
+            return self.dist_status(line)
         if self._pm is None or self._comm is None:
             print("❌ No cluster. %dist_init to start one.")
             return
@@ -2346,9 +2883,17 @@ class DistributedMagics(Magics):
             sup_states = DistributedMagics._supervisor.status()["states"]
         proc = pm.get_status()
         now = time.time()
+        # Tenant column (gateway pools): only when some rank's busy
+        # ping is tenant-attributed — single-kernel sessions keep the
+        # pre-pool layout.
+        tenants_seen = any(
+            (comm.last_ping(r) or (0, {}))[1].get("busy_tenant")
+            for r in range(self._world))
         print(f"⏱  cluster top · {self._world} workers · backend="
               f"{pm.backend} · {time.strftime('%H:%M:%S')}")
-        hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}{'hb-age':<8}"
+        hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}"
+               + (f"{'tenant':<11}" if tenants_seen else "")
+               + f"{'hb-age':<8}"
                f"{'col#':<7}{'HBM use/limit GB':<18}{'peak':<7}"
                f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
         print(hdr)
@@ -2370,6 +2915,10 @@ class DistributedMagics(Magics):
             if ping is not None and ping[1].get("busy_s") is not None:
                 busy = (f"{ping[1].get('busy_type')} "
                         f"{ping[1]['busy_s'] + (now - ping[0]):.1f}s")
+            tcol = ""
+            if tenants_seen:
+                tcol = f"{ping[1].get('busy_tenant') or '-':<11}" \
+                    if ping is not None else f"{'-':<11}"
             hb = f"{now - ping[0]:.1f}s" if ping is not None else "-"
             # Collective-stream position (hang watchdog piggyback):
             # "#7*" = entered collective 7 and still inside it — the
@@ -2385,7 +2934,7 @@ class DistributedMagics(Magics):
                    f"/{self._fmt_gb(hbm.get('limit'))}"
                    if hbm.get("in_use") is not None else "-")
             peak = self._fmt_gb(hbm.get("peak"))
-            print(f"{r:<5}{state:<11}{busy:<18}{hb:<8}{col:<7}"
+            print(f"{r:<5}{state:<11}{busy:<18}{tcol}{hb:<8}{col:<7}"
                   f"{mem:<18}"
                   f"{peak:<7}{str(tel.get('bufs', '-')):<6}"
                   f"{str(tel.get('compiles', '-')):<9}"
@@ -2676,6 +3225,17 @@ class DistributedMagics(Magics):
         session manifest are destroyed.  (Exiting/restarting the kernel
         WITHOUT this magic leaves the fleet orphaned-but-alive for
         NBD_ORPHAN_TTL_S — reattach with %dist_attach.)"""
+        if DistributedMagics._tenant is not None:
+            # Tenant mode: the POOL belongs to every tenant — this
+            # kernel only detaches.  In-flight results will park for
+            # a future %dist_attach --tenant; %dist_pool stop ends
+            # the pool itself.
+            name = DistributedMagics._drop_tenant_state(detach=True)
+            print(f"✅ detached tenant {name!r} from the pool (the "
+                  "pool keeps running — %dist_pool stop ends it; "
+                  f"%dist_attach --tenant {name} resumes this "
+                  "tenant)")
+            return
         had = self._world
         token, epoch = self._session_identity()
         self.shutdown_all()

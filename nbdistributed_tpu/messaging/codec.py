@@ -75,9 +75,16 @@ WIRE_EXTENSIONS: dict[str, dict] = {
            "doc": "span context while a %dist_trace is active"},
     "ep": {"plane": "header", "attr": "epoch",
            "doc": "session epoch stamp (durable-session fencing)"},
+    "tn": {"plane": "header", "attr": "tenant",
+           "doc": "tenant tag (gateway pools: routes the request to "
+                  "the tenant's worker-side namespace and attributes "
+                  "its flight/span records)"},
     # heartbeat-ping data plane (worker _heartbeat → coordinator)
     "busy_type": {"plane": "ping",
                   "doc": "in-flight request type while busy"},
+    "busy_tenant": {"plane": "ping",
+                    "doc": "tenant whose cell is in flight (gateway "
+                           "pools) — the %dist_top tenant column"},
     "busy_s": {"plane": "ping",
                "doc": "seconds busy on the monotonic clock"},
     "busy_id": {"plane": "ping",
@@ -157,14 +164,21 @@ class Message:
     # handed over.  None (the default) is never rejected — unstamped
     # sessions keep the pre-epoch wire format byte-identically.
     epoch: int | None = None
+    # Tenant tag (gateway pools, ISSUE 8).  A gateway forwarding a
+    # tenant's cell stamps it so the worker executes in that tenant's
+    # namespace and attributes flight/span records to it.  None (the
+    # default) keeps the single-tenant wire format byte-identical.
+    tenant: str | None = None
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
               bufs: dict[str, Any] | None = None) -> "Message":
-        """Build a response correlated to this message (echoes msg_id,
-        the pattern at reference: worker.py:224-233)."""
+        """Build a response correlated to this message (echoes msg_id
+        and the tenant tag, the pattern at reference:
+        worker.py:224-233)."""
         return Message(msg_type=msg_type, data=data, rank=rank,
-                       msg_id=self.msg_id, bufs=bufs or {})
+                       msg_id=self.msg_id, bufs=bufs or {},
+                       tenant=self.tenant)
 
 
 def _json_default(_obj: Any):
@@ -201,6 +215,9 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
     if msg.epoch is not None:
         # Only for epoch-stamped (durable) sessions.
         header["ep"] = msg.epoch
+    if msg.tenant is not None:
+        # Only for tenant-tagged (gateway pool) traffic.
+        header["tn"] = msg.tenant
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -285,6 +302,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         attempt=header.get("at", 0),
         trace=header.get("tr"),
         epoch=header.get("ep"),
+        tenant=header.get("tn"),
     )
 
 

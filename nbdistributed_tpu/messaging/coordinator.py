@@ -27,6 +27,8 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from ..gateway.scheduler import (ACTIVE, SHED, CellRejected, CellShed,
+                                 Scheduler)
 from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
@@ -61,10 +63,15 @@ class WorkerDied(RuntimeError):
 
 class _Pending:
     __slots__ = ("expect", "responses", "event", "failure", "sent_at",
-                 "msg_type", "cell_sha1")
+                 "msg_type", "cell_sha1", "tenant")
 
-    def __init__(self, expect: set[int], msg_type: str = ""):
+    def __init__(self, expect: set[int], msg_type: str = "",
+                 tenant: str | None = None):
         self.msg_type = msg_type
+        # Which tenant's cell this is (gateway pools) — lets the hang
+        # watchdog / doctor / %dist_top attribute an in-flight request
+        # to the right tenant.  None on the single-kernel path.
+        self.tenant = tenant
         self.expect = set(expect)
         self.responses: dict[int, Message] = {}
         self.event = threading.Event()
@@ -88,8 +95,16 @@ class CommunicationManager:
                  allow_pickle: bool = True, auth_token: str | None = None,
                  retry: RetryPolicy | None = None,
                  session_token: str | None = None,
-                 session_epoch: int = 0):
+                 session_epoch: int = 0,
+                 scheduler: Scheduler | None = None):
         self.num_workers = num_workers
+        # Mesh scheduler (gateway/scheduler.py): EVERY execute request
+        # routes through it — admission, queueing, fair-share (ISSUE
+        # 8).  The default is an unlimited-slot FIFO with one implicit
+        # tenant, so the single-kernel path dispatches immediately and
+        # behaves exactly as before while sharing the gateway's code
+        # path (no fork).  A gateway passes a bounded pool policy.
+        self.scheduler = scheduler or Scheduler()
         self.default_timeout = timeout  # None = wait forever (training mode)
         self.auth_token = auth_token
         # Durable-session identity (resilience/session.py): when the
@@ -220,7 +235,8 @@ class CommunicationManager:
                           "expect": sorted(p.expect),
                           "responded": sorted(p.responses),
                           "sent_at": p.sent_at,
-                          "cell_sha1": p.cell_sha1}
+                          "cell_sha1": p.cell_sha1,
+                          "tenant": p.tenant}
                     for mid, p in self._pending.items()}
 
     def last_ping(self, rank: int) -> tuple[float, dict] | None:
@@ -314,6 +330,15 @@ class CommunicationManager:
             p.failure = failure
             p.event.set()
 
+    def dead_ranks(self) -> set[int]:
+        """Snapshot of ranks currently marked dead (death callback or
+        heartbeat verdict); a transport reconnect revives a rank out
+        of the set.  Callers that must reach "everyone alive" send to
+        ``range(world) - dead_ranks()`` — send_to_ranks raises on any
+        dead target BEFORE transmitting to the rest."""
+        with self._lock:
+            return set(self._dead)
+
     # ------------------------------------------------------------------
     # request/response
 
@@ -331,7 +356,10 @@ class CommunicationManager:
 
     def send_to_ranks(self, ranks: list[int], msg_type: str,
                       data: Any = None, *, bufs: dict | None = None,
-                      timeout: float | None = ...) -> dict[int, Message]:
+                      timeout: float | None = ...,
+                      tenant: str | None = None, priority: int = 0,
+                      msg_id: str | None = None,
+                      on_verdict=None) -> dict[int, Message]:
         """Send one request to ``ranks`` and collect their responses.
 
         ``timeout=...`` (unset) uses the manager default; ``None`` waits
@@ -345,23 +373,87 @@ class CommunicationManager:
         costs one backoff interval instead of the whole deadline.  The
         caller's ``timeout`` stays the total budget; the final attempt
         waits out whatever remains of it (forever when ``None``).
+
+        ``execute`` requests route through :attr:`scheduler` first
+        (ISSUE 8): the default single-tenant policy always dispatches
+        immediately, a gateway's bounded policy may queue this call
+        (it blocks until granted, within ``timeout``), shed it under
+        overload (:class:`CellShed`), or refuse it at the tenant's
+        in-flight cap (:class:`CellRejected`).  ``on_verdict(ticket)``
+        fires right after admission — the gateway's hook for sending
+        the explicit ``{"status": "queued", "position": n}`` reply
+        instead of silently blocking.  ``tenant`` tags the wire frame
+        (worker-side namespace routing + blame attribution) and is the
+        scheduler's accounting key; ``msg_id`` pins the outgoing id so
+        a gateway can keep tenant-side and worker-side correlation ids
+        identical end to end.
         """
         if timeout is ...:
             timeout = self.default_timeout
         if not ranks:
             return {}  # an empty expectation would otherwise never complete
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
+        if msg_id is not None:
+            msg.msg_id = msg_id
         if self.session_epoch:
             msg.epoch = self.session_epoch
+        if tenant is not None:
+            msg.tenant = tenant
+        # The total budget starts NOW: time spent queued behind the
+        # mesh is part of the caller's wait, not free.
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        ticket = None
+        if msg_type == "execute":
+            ticket = self.scheduler.submit(tenant or "local",
+                                           msg.msg_id, priority)
+            if on_verdict is not None:
+                try:
+                    on_verdict(ticket)
+                except Exception:
+                    pass
+            v = ticket.verdict
+            if v["status"] == "rejected":
+                raise CellRejected(v.get("reason", "rejected"),
+                                   tenant or "local")
+            if v["status"] == "shed":
+                raise CellShed(tenant or "local", msg.msg_id)
+            if v["status"] == "queued":
+                wait_s = (None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
+                if not ticket.event.wait(wait_s):
+                    self.scheduler.cancel(msg.msg_id)
+                    raise TimeoutError(
+                        f"cell spent {timeout}s queued behind the mesh "
+                        f"without dispatch (tenant "
+                        f"{tenant or 'local'}); withdrawn")
+                if ticket.state == SHED:
+                    raise CellShed(tenant or "local", msg.msg_id)
+        try:
+            return self._dispatch(ranks, msg, msg_type, timeout,
+                                  deadline, tenant)
+        finally:
+            if ticket is not None and ticket.state == ACTIVE:
+                # Success OR failure frees the mesh slot and promotes
+                # queued work — a dead worker must not wedge the pool.
+                self.scheduler.complete(msg.msg_id)
+
+    def _dispatch(self, ranks: list[int], msg: Message, msg_type: str,
+                  timeout: float | None, deadline: float | None,
+                  tenant: str | None = None) -> dict[int, Message]:
         tr = self.tracer
+        span_attrs = {"ranks": list(ranks)}
+        if tenant is not None:
+            span_attrs["tenant"] = tenant
         span = (tr.begin(f"send/{msg_type}", kind="coordinator",
-                         attrs={"ranks": list(ranks)})
+                         attrs=span_attrs)
                 if tr.enabled else None)
         if span is not None:
             # The worker's handler span adopts these ids as its parent,
             # stitching the cross-process timeline together.
             msg.trace = tr.context_for(span)
-        pending = _Pending(set(ranks), msg_type)
+        pending = _Pending(set(ranks), msg_type, tenant)
+        data = msg.data
         if msg_type == "execute" and isinstance(data, dict) \
                 and isinstance(data.get("code"), str):
             from ..runtime.collective_guard import cell_hash
@@ -375,12 +467,12 @@ class CommunicationManager:
             raise WorkerDied(f"workers {sorted(already_dead)} are dead")
         policy = self.retry_for(msg_type)
         attempts = policy.attempts if policy.enabled() else 1
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
         try:
             pending.sent_at = time.time()
-            self.flight.record("send", msg_id=msg.msg_id, type=msg_type,
-                               ranks=list(ranks))
+            self.flight.record("send", msg_id=msg.msg_id,
+                               type=msg_type, ranks=list(ranks),
+                               **({"tenant": tenant}
+                                  if tenant is not None else {}))
             self._listener.send_to_ranks(list(ranks), msg)
             complete = False
             for attempt in range(1, attempts + 1):

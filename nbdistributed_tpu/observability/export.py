@@ -24,13 +24,31 @@ from typing import Any
 COORDINATOR_PID = -1
 
 
+# Tenant-tagged records (gateway pools) are rehomed onto a dedicated
+# per-tenant thread track inside their process row, named
+# ``tenant:<name>`` — a multi-tenant postmortem then reads as one lane
+# per notebook instead of interleaved anonymous thread ids.  The base
+# offset keeps tenant tids clear of real recording-thread ids.
+_TENANT_TID_BASE = 1 << 20
+
+
+def _tenant_tid(ev_attrs: dict | None,
+                tenant_tids: dict[str, int] | None) -> int | None:
+    if not tenant_tids or not ev_attrs:
+        return None
+    name = ev_attrs.get("tenant")
+    return tenant_tids.get(name) if name else None
+
+
 def _span_event(span: dict, pid: int, offset_s: float,
-                base_s: float) -> dict:
+                base_s: float,
+                tenant_tids: dict[str, int] | None = None) -> dict:
     args: dict[str, Any] = dict(span.get("attrs") or {})
     args["trace_id"] = span.get("trace_id")
     args["span_id"] = span.get("span_id")
     if span.get("parent_id"):
         args["parent_id"] = span["parent_id"]
+    tid = _tenant_tid(span.get("attrs"), tenant_tids)
     return {
         "name": span["name"],
         "cat": span.get("kind") or "span",
@@ -38,13 +56,15 @@ def _span_event(span: dict, pid: int, offset_s: float,
         "ts": (span["t0"] - offset_s - base_s) * 1e6,
         "dur": max(0.0, span.get("dur", 0.0)) * 1e6,
         "pid": pid,
-        "tid": span.get("tid", 0),
+        "tid": span.get("tid", 0) if tid is None else tid,
         "args": args,
     }
 
 
 def _instant_event(ev: dict, pid: int, offset_s: float,
-                   base_s: float) -> dict:
+                   base_s: float,
+                   tenant_tids: dict[str, int] | None = None) -> dict:
+    tid = _tenant_tid(ev.get("attrs"), tenant_tids)
     return {
         "name": ev["name"],
         "cat": ev.get("kind") or "instant",
@@ -52,9 +72,41 @@ def _instant_event(ev: dict, pid: int, offset_s: float,
         "s": "t",
         "ts": (ev["t0"] - offset_s - base_s) * 1e6,
         "pid": pid,
-        "tid": ev.get("tid", 0),
+        "tid": ev.get("tid", 0) if tid is None else tid,
         "args": dict(ev.get("attrs") or {}),
     }
+
+
+def _collect_tenants(*dumps: dict | None) -> dict[str, int]:
+    """Stable tenant → tid assignment across every process dump (the
+    same tenant gets the same tid offset in every pid row)."""
+    names: set[str] = set()
+    for dump in dumps:
+        for s in (dump or {}).get("spans", []):
+            t = (s.get("attrs") or {}).get("tenant")
+            if t:
+                names.add(str(t))
+        for ev in (dump or {}).get("instants", []):
+            t = (ev.get("attrs") or {}).get("tenant")
+            if t:
+                names.add(str(t))
+    return {n: _TENANT_TID_BASE + i
+            for i, n in enumerate(sorted(names))}
+
+
+def _tenant_thread_meta(tenant_tids: dict[str, int],
+                        pids: list[int]) -> list[dict]:
+    out = []
+    for name, tid in sorted(tenant_tids.items(),
+                            key=lambda kv: kv[1]):
+        for pid in pids:
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid,
+                        "args": {"name": f"tenant:{name}"}})
+            out.append({"name": "thread_sort_index", "ph": "M",
+                        "pid": pid, "tid": tid,
+                        "args": {"sort_index": tid}})
+    return out
 
 
 def _fault_events(events: list[dict], pid: int, offset_s: float,
@@ -116,13 +168,20 @@ def merge_trace(coordinator: dict | None,
         t_candidates.extend(ev["ts"] - off for ev in evs or [])
     base_s = min(t_candidates) if t_candidates else 0.0
 
+    # Tenant lanes (gateway pools): records whose attrs carry a
+    # ``tenant`` land on a per-tenant named thread track.
+    tenant_tids = _collect_tenants(coordinator,
+                                   *[ranks[r] for r in ranks])
+
     events: list[dict] = []
     dropped = 0
     if coordinator:
         events += _meta(COORDINATOR_PID, "coordinator", -1)
-        events += [_span_event(s, COORDINATOR_PID, 0.0, base_s)
+        events += [_span_event(s, COORDINATOR_PID, 0.0, base_s,
+                               tenant_tids)
                    for s in coordinator.get("spans", [])]
-        events += [_instant_event(ev, COORDINATOR_PID, 0.0, base_s)
+        events += [_instant_event(ev, COORDINATOR_PID, 0.0, base_s,
+                                  tenant_tids)
                    for ev in coordinator.get("instants", [])]
         dropped += coordinator.get("dropped", 0)
     events += _fault_events(coordinator_faults or [], COORDINATOR_PID,
@@ -131,11 +190,15 @@ def merge_trace(coordinator: dict | None,
         off = offsets.get(r, 0.0)
         dump = ranks[r] or {}
         events += _meta(r, f"rank {r}", r)
-        events += [_span_event(s, r, off, base_s)
+        events += [_span_event(s, r, off, base_s, tenant_tids)
                    for s in dump.get("spans", [])]
-        events += [_instant_event(ev, r, off, base_s)
+        events += [_instant_event(ev, r, off, base_s, tenant_tids)
                    for ev in dump.get("instants", [])]
         dropped += dump.get("dropped", 0)
+    if tenant_tids:
+        pids = ([COORDINATOR_PID] if coordinator else []) \
+            + sorted(ranks)
+        events += _tenant_thread_meta(tenant_tids, pids)
     for r in sorted(rank_faults):
         events += _fault_events(rank_faults[r], r,
                                 offsets.get(r, 0.0), base_s)
@@ -149,6 +212,8 @@ def merge_trace(coordinator: dict | None,
             "clock_offsets_s": {str(r): offsets.get(r, 0.0)
                                 for r in sorted(ranks)},
             "spans_dropped": dropped,
+            "tenant_tracks": {n: t for n, t in
+                              sorted(tenant_tids.items())},
         },
     }
 

@@ -29,6 +29,7 @@ Bounded three ways:
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 # Request types whose handlers only READ state: re-running one on a
@@ -87,50 +88,61 @@ class ResultMailbox:
         self.parked = 0      # park() calls accepted (monotonic)
         self.claimed = 0
         self.evicted = 0
+        # The worker's serial loop is single-threaded, but the GATEWAY
+        # parks from serve threads while tenant hellos read ids() on
+        # the listener thread — iteration during a concurrent park
+        # raised RuntimeError exactly in the crash-recovery window.
+        self._mlock = threading.Lock()
 
     def park(self, msg_id: str, reply) -> bool:
         """Store (or refresh) a reply for later claim."""
         size = _reply_bytes(reply)
-        self._box[msg_id] = reply
-        self._box.move_to_end(msg_id)
-        self._total += size - self._sizes.get(msg_id, 0)
-        self._sizes[msg_id] = size
-        while len(self._box) > 1 and (
-                len(self._box) > self.capacity
-                or self._total > self.max_total_bytes):
-            old, _ = self._box.popitem(last=False)
-            self._total -= self._sizes.pop(old, 0)
-            self.evicted += 1
-        self.parked += 1
+        with self._mlock:
+            self._box[msg_id] = reply
+            self._box.move_to_end(msg_id)
+            self._total += size - self._sizes.get(msg_id, 0)
+            self._sizes[msg_id] = size
+            while len(self._box) > 1 and (
+                    len(self._box) > self.capacity
+                    or self._total > self.max_total_bytes):
+                old, _ = self._box.popitem(last=False)
+                self._total -= self._sizes.pop(old, 0)
+                self.evicted += 1
+            self.parked += 1
         return True
 
     def claim(self, msg_id: str):
         """Pop one parked reply (None if absent / already claimed)."""
-        reply = self._box.pop(msg_id, None)
-        if reply is not None:
-            self._total -= self._sizes.pop(msg_id, 0)
-            self.claimed += 1
-        return reply
+        with self._mlock:
+            reply = self._box.pop(msg_id, None)
+            if reply is not None:
+                self._total -= self._sizes.pop(msg_id, 0)
+                self.claimed += 1
+            return reply
 
     def claim_all(self) -> dict[str, object]:
         """Pop everything, oldest first."""
-        out = dict(self._box)
-        self.claimed += len(out)
-        self._box.clear()
-        self._sizes.clear()
-        self._total = 0
-        return out
+        with self._mlock:
+            out = dict(self._box)
+            self.claimed += len(out)
+            self._box.clear()
+            self._sizes.clear()
+            self._total = 0
+            return out
 
     def ids(self) -> list[str]:
-        return list(self._box)
+        with self._mlock:
+            return list(self._box)
 
     def counters(self) -> dict:
-        return {"parked": self.parked, "claimed": self.claimed,
-                "evicted": self.evicted, "held": len(self._box),
-                "bytes": self._total}
+        with self._mlock:
+            return {"parked": self.parked, "claimed": self.claimed,
+                    "evicted": self.evicted, "held": len(self._box),
+                    "bytes": self._total}
 
     def __len__(self) -> int:
-        return len(self._box)
+        with self._mlock:
+            return len(self._box)
 
 
 class ReplayCache:

@@ -204,10 +204,14 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
     no manifest exists) is older than ``ttl_s`` AND none of its
     manifest pids are alive — an orphaned-but-within-grace fleet keeps
     its dir no matter how old the manifest is.  The CURRENT run dir
-    (``NBD_RUN_DIR``) is never swept.  Returns
-    ``{"root", "swept": [...], "kept": [...], "errors": [...]}``;
-    with ``dry_run`` nothing is removed but ``swept`` still lists the
-    candidates.
+    (``NBD_RUN_DIR``) is never swept, and neither is a dir owned by a
+    **live gateway daemon** (pid-liveness probe on its
+    ``gateway.json`` — a pooled fleet may sit idle far past any TTL
+    while its tenants are away).  Returns
+    ``{"root", "swept": [...], "kept": [...], "kept_why": {dir:
+    reason}, "errors": [...]}`` — ``kept_why`` is what ``%dist_gc
+    --dry-run`` prints so a skip is explainable; with ``dry_run``
+    nothing is removed but ``swept`` still lists the candidates.
     """
     root = root or default_runs_root()
     if ttl_s is None:
@@ -217,6 +221,7 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
     current = os.path.realpath(current) if current else None
     swept: list[str] = []
     kept: list[str] = []
+    kept_why: dict[str, str] = {}
     errors: list[str] = []
     try:
         names = sorted(os.listdir(root))
@@ -228,8 +233,20 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
             continue
         if current and os.path.realpath(d) == current:
             kept.append(d)
+            kept_why[d] = "current session's run dir (NBD_RUN_DIR)"
             continue
         try:
+            # Live gateway daemons protect their run dir regardless of
+            # age: an idle pool's manifest can be arbitrarily old while
+            # the daemon (and its tenants' parked state) is live.
+            from ..gateway.daemon import (gateway_alive,
+                                          read_gateway_manifest)
+            gw = read_gateway_manifest(d)
+            if gateway_alive(gw):
+                kept.append(d)
+                kept_why[d] = (f"live gateway daemon "
+                               f"(pid {gw.get('pid')})")
+                continue
             mpath = manifest_path(d)
             ref = mpath if os.path.exists(mpath) else d
             age = now - os.path.getmtime(ref)
@@ -241,10 +258,16 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
                 swept.append(d)
             else:
                 kept.append(d)
+                if alive:
+                    kept_why[d] = (f"live worker pid(s) "
+                                   f"{sorted(alive.values())}")
+                else:
+                    kept_why[d] = (f"younger than ttl "
+                                   f"({age:.0f}s < {ttl_s:.0f}s)")
         except OSError as e:
             errors.append(f"{d}: {e}")
     return {"root": root, "ttl_s": ttl_s, "swept": swept, "kept": kept,
-            "errors": errors, "dry_run": dry_run}
+            "kept_why": kept_why, "errors": errors, "dry_run": dry_run}
 
 
 # ----------------------------------------------------------------------

@@ -544,6 +544,15 @@ class HangWatchdog:
         except Exception:
             pending = {}
         verdicts = self.detector.observe(now, views, pending)
+        # Tenant attribution (gateway pools): pending requests are
+        # tenant-tagged, so a verdict on a pooled cell names the one
+        # notebook whose cell wedged the mesh — blame lands on the
+        # right tenant, not the pool.
+        for v in verdicts:
+            tn = (pending.get(v["cell"]) or {}).get("tenant")
+            if tn and not v.get("tenant"):
+                v["tenant"] = tn
+                v["detail"] = f"[tenant {tn}] " + v["detail"]
         if suspected:
             # A suspected-partition host's ranks are unreachable, not
             # hung: their apparent lag is frozen data.  Verdicts that
@@ -579,6 +588,7 @@ class HangWatchdog:
                                      cell=str(cell)[:16],
                                      ranks=v["ranks"], seq=v.get("seq"),
                                      op=v.get("op"),
+                                     tenant=v.get("tenant"),
                                      preflight=st.get("preflight"))
                     self._event("verdict", v["detail"], cell=cell,
                                 kind=v["kind"], ranks=v["ranks"])
@@ -883,9 +893,11 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
             missing = sorted(set(p["expect"]) - set(p["responded"]))
             age = (f"{now - p['sent_at']:.1f}s" if p.get("sent_at")
                    else "?")
+            who = (f" · tenant {p['tenant']}" if p.get("tenant")
+                   else "")
             lines.append(f"   {mid[:12]}… {p.get('type') or '?'} "
                          f"age {age} · responded {p['responded']} · "
-                         f"waiting on {missing}")
+                         f"waiting on {missing}{who}")
             note = _preflight_note(p.get("cell_sha1"))
             if note:
                 lines.append(f"      ↳ pre-flight lint flagged this "

@@ -71,12 +71,26 @@ class DistributedWorker:
         self.rank = rank
         self.world_size = world_size
         self._shutdown = threading.Event()
-        # (msg_type, started_monotonic, msg_id, deadline_s|None) while a
-        # request is being handled, else None.  MONOTONIC clock on
-        # purpose: busy_s feeds the hang watchdog's stall detection, and
-        # a wall-clock step (NTP slew, suspend/resume) must not fake or
-        # mask a stall.
+        # (msg_type, started_monotonic, msg_id, deadline_s|None,
+        # tenant|None) while a request is being handled, else None.
+        # MONOTONIC clock on purpose: busy_s feeds the hang watchdog's
+        # stall detection, and a wall-clock step (NTP slew,
+        # suspend/resume) must not fake or mask a stall.  The tenant
+        # element attributes the in-flight cell to the right tenant in
+        # gateway pools (heartbeat busy_tenant piggyback, stream-output
+        # routing).
         self._busy: tuple | None = None
+        # Tenant namespace isolation (gateway pools, ISSUE 8): each
+        # tenant executes in its own dict, seeded lazily as a copy of
+        # the base interactive namespace, so one tenant's assignments
+        # (or `del`s) can never leak into another's cells.  The ONE
+        # deliberate crossing is `shared` — a dict injected into every
+        # tenant namespace by the same object, the explicit opt-in
+        # shared segment (`shared["params"] = ...` publishes;
+        # everything else is isolated).  Untagged requests (the
+        # single-kernel path) keep using self.namespace directly.
+        self._tenant_ns: dict[str, dict] = {}
+        self._shared_ns: dict = {}
         self._ckpt_async = None          # in-flight background save
         # Resilience state: the reply-replay cache makes request
         # redelivery idempotent (a retried execute NEVER runs twice);
@@ -318,6 +332,10 @@ class DistributedWorker:
                 # fake nor mask a stall (the watchdog consumes this).
                 data = {"busy_type": busy[0],
                         "busy_s": round(time.monotonic() - busy[1], 3)}
+                if len(busy) > 4 and busy[4] is not None:
+                    # Gateway pools: whose cell the mesh is running —
+                    # the %dist_top / pool-status tenant column.
+                    data["busy_tenant"] = busy[4]
                 if self._hang_enabled:
                     if busy[2] is not None:
                         data["busy_id"] = busy[2]
@@ -384,13 +402,38 @@ class DistributedWorker:
 
     def _stream(self, text: str, stream: str) -> None:
         """Push stdout/result text to the coordinator immediately
-        (reference: worker.py:45-63)."""
+        (reference: worker.py:45-63).  Tagged with the in-flight
+        request's tenant (gateway pools) so the gateway can route the
+        print to the one kernel whose cell produced it."""
+        data = {"text": text, "stream": stream}
+        busy = self._busy
+        if busy is not None and len(busy) > 4 and busy[4] is not None:
+            data["tenant"] = busy[4]
         try:
             self._send_shielded(Message(
-                msg_type="stream_output", rank=self.rank,
-                data={"text": text, "stream": stream}))
+                msg_type="stream_output", rank=self.rank, data=data))
         except Exception:
             pass  # printing must never kill execution
+
+    # ------------------------------------------------------------------
+    # tenant namespaces (gateway pools, ISSUE 8)
+
+    def _ns_for(self, tenant: str | None) -> dict:
+        """The namespace a request executes/reads/writes in: the base
+        interactive namespace for untagged (single-kernel) requests, a
+        per-tenant copy of the seeded base otherwise.  Every tenant
+        namespace carries the SAME ``shared`` dict — the explicit
+        opt-in shared segment — plus its own ``tenant`` name."""
+        if tenant is None:
+            return self.namespace
+        ns = self._tenant_ns.get(tenant)
+        if ns is None:
+            ns = dict(self.namespace)
+            ns["shared"] = self._shared_ns
+            ns["tenant"] = tenant
+            self._tenant_ns[tenant] = ns
+            self._flight.record("tenant_ns_created", tenant=tenant)
+        return ns
 
     # ------------------------------------------------------------------
     # message handlers (dispatch table analog of reference: worker.py:205-221)
@@ -408,11 +451,13 @@ class DistributedWorker:
                    else msg.data.get("target_ranks"))
         collective_guard.begin_cell(targets, self.world_size)
         self._flight.record("cell_start", msg_id=msg.msg_id,
-                            code=code.strip()[:120])
+                            code=code.strip()[:120],
+                            **({"tenant": msg.tenant}
+                               if msg.tenant is not None else {}))
         try:
             result = executor.execute_cell(
-                code, self.namespace, self._stream, rank=self.rank,
-                filename=f"<rank {self.rank}>")
+                code, self._ns_for(msg.tenant), self._stream,
+                rank=self.rank, filename=f"<rank {self.rank}>")
         finally:
             ops = collective_guard.end_cell()
         self._flight.record(
@@ -435,10 +480,11 @@ class DistributedWorker:
         import numpy as np
 
         name = msg.data if isinstance(msg.data, str) else msg.data["name"]
-        if name not in self.namespace:
+        ns = self._ns_for(msg.tenant)
+        if name not in ns:
             return msg.reply(data={"error": f"name {name!r} not defined"},
                              rank=self.rank)
-        value = self.namespace[name]
+        value = ns[name]
         if isinstance(value, jax.Array):
             # Device arrays travel as raw buffers + metadata, the analog
             # of the reference's .cpu().detach() path (worker.py:412-418).
@@ -476,18 +522,19 @@ class DistributedWorker:
         import numpy as np
 
         name = msg.data["name"]
+        ns = self._ns_for(msg.tenant)
         if msg.data.get("pytree") is not None:
             from ..messaging.codec import unflatten_pytree_wire
             # jax leaves go back on device; numpy leaves are COPIED —
             # the decoded buffers are read-only frombuffer views.
-            self.namespace[name] = unflatten_pytree_wire(
+            ns[name] = unflatten_pytree_wire(
                 msg.data["pytree"], msg.bufs,
                 leaf_fn=lambda a, is_jax: jnp.asarray(a) if is_jax
                 else np.array(a))
         elif "value" in msg.bufs:
-            self.namespace[name] = jnp.asarray(msg.bufs["value"])
+            ns[name] = jnp.asarray(msg.bufs["value"])
         else:
-            self.namespace[name] = msg.data.get("value")
+            ns[name] = msg.data.get("value")
         return msg.reply(data={"status": "set", "name": name},
                          rank=self.rank)
 
@@ -517,6 +564,11 @@ class DistributedWorker:
         data["session_epoch"] = self._epoch
         data["mailbox_parked"] = len(self._mailbox)
         data["orphan_ttl_s"] = self._orphan_ttl
+        # Gateway pools: which tenants have materialized a namespace on
+        # this rank, and the shared segment's size.
+        if self._tenant_ns:
+            data["tenants"] = sorted(self._tenant_ns)
+            data["shared_names"] = len(self._shared_ns)
         return msg.reply(data=data, rank=self.rank)
 
     def _handle_chaos(self, msg: Message) -> Message:
@@ -588,7 +640,7 @@ class DistributedWorker:
     def _handle_get_namespace_info(self, msg: Message) -> Message:
         return msg.reply(
             data={"namespace_info": introspect.describe_namespace(
-                self.namespace), "status": "success"},
+                self._ns_for(msg.tenant)), "status": "success"},
             rank=self.rank)
 
     def _handle_checkpoint(self, msg: Message) -> Message:
@@ -840,12 +892,27 @@ class DistributedWorker:
                   "counters": self._mailbox.counters()},
             rank=self.rank)
 
+    def _handle_tenant_gc(self, msg: Message) -> Message:
+        """Drop an evicted tenant's namespace.  The gateway broadcasts
+        this when a clean detach frees the tenant's admission slot —
+        without it the namespace (and every device array in it) lives
+        forever, and a LATER unrelated tenant reusing the name would
+        inherit the old tenant's state."""
+        name = (msg.data or {}).get("tenant")
+        existed = name in self._tenant_ns
+        if existed:
+            del self._tenant_ns[name]
+            self._flight.record("tenant_ns_dropped", tenant=name)
+        return msg.reply(data={"status": "ok", "existed": existed},
+                         rank=self.rank)
+
     def _park(self, msg_type: str, msg_id: str, reply: Message) -> None:
         """Park a reply for redelivery to a future coordinator.
         Read-only replies are skipped (re-probing is safe and their
         staleness makes redelivery noise); mutating results — exactly
         what must not be lost or re-executed — are kept."""
-        if msg_type in _READ_ONLY or msg_type in ("hello", "mailbox"):
+        if msg_type in _READ_ONLY or msg_type in ("hello", "mailbox",
+                                                  "tenant_gc"):
             return
         self._mailbox.park(msg_id, reply)
         obs_metrics.registry().counter(
@@ -1034,6 +1101,7 @@ class DistributedWorker:
             "metrics": self._handle_metrics,
             "hello": self._handle_hello,
             "mailbox": self._handle_mailbox,
+            "tenant_gc": self._handle_tenant_gc,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*
@@ -1171,7 +1239,7 @@ class DistributedWorker:
                     except (TypeError, ValueError):
                         deadline = None
             self._busy = (msg.msg_type, time.monotonic(), msg.msg_id,
-                          deadline)
+                          deadline, msg.tenant)
             # Dispatch span: a child of the coordinator's send span
             # when the request carried the wire trace context, a root
             # span otherwise.  Activated around the handler so inner
@@ -1181,11 +1249,16 @@ class DistributedWorker:
             span = None
             if tr.enabled:
                 ctx = msg.trace or {}
+                span_attrs = {"msg_id": msg.msg_id,
+                              "attempt": msg.attempt}
+                if msg.tenant is not None:
+                    # Multi-tenant postmortems: export.py folds this
+                    # into a per-tenant Perfetto track.
+                    span_attrs["tenant"] = msg.tenant
                 span = tr.begin(f"handle/{msg.msg_type}", kind="worker",
                                 trace_id=ctx.get("tid"),
                                 parent_id=ctx.get("sid"),
-                                attrs={"msg_id": msg.msg_id,
-                                       "attempt": msg.attempt})
+                                attrs=span_attrs)
             try:
                 if handler is None:
                     reply = msg.reply(

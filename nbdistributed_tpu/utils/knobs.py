@@ -113,6 +113,25 @@ _ALL = (
     _k("NBD_PARTITION_GRACE_S", "30", "float",
        "Whole-host silence grace before a suspected partition is "
        "declared lost and healing proceeds.", "hang"),
+    # --- session gateway / multi-tenant pools -----------------------------
+    _k("NBD_POOL_SCHED", "fair", "str",
+       "Gateway pool scheduling mode: fair (priority, then least-"
+       "served tenant) or fifo (arrival order).", "pool"),
+    _k("NBD_POOL_MESH_SLOTS", "1", "int",
+       "Concurrent cells the pooled mesh runs (0 = unlimited; the "
+       "single-kernel path always runs unlimited).  >1 is only safe "
+       "for collective-FREE cells: concurrent broadcasts carry no "
+       "cross-rank ordering, so two tenants' collectives can pair "
+       "up mismatched and hang the shared mesh.", "pool"),
+    _k("NBD_POOL_QUEUE_DEPTH", "64", "int",
+       "Queued-cell bound before the pool sheds the lowest-priority "
+       "queued cell with a visible verdict (0 = unbounded).", "pool"),
+    _k("NBD_TENANT_MAX_INFLIGHT", "8", "int",
+       "Per-tenant queued+active cell cap; a tenant at the cap gets "
+       "an explicit rejected verdict (0 = uncapped).", "pool"),
+    _k("NBD_POOL_MAX_TENANTS", "8", "int",
+       "Tenant headcount a gateway admits; later hellos are refused "
+       "at admission.", "pool"),
     # --- flight recorder / observability ---------------------------------
     _k("NBD_FLIGHT", "1", "bool",
        "Always-on mmap flight recorder; 0 disables.", "observability"),

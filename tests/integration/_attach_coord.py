@@ -55,9 +55,15 @@ def main() -> int:
     fatal_mid = comm.post(
         list(range(world)), "execute",
         {"code": "hits += 1\nimport time\ntime.sleep(4.0)\nhits"})
-    with open(os.path.join(run_dir, "coord1.json"), "w") as f:
+    # Atomic publish: the test polls for this file's EXISTENCE and
+    # then json.loads it — a plain open(..., "w") exposes an empty
+    # file between create and dump (observed as a flaky
+    # JSONDecodeError under load).
+    status_path = os.path.join(run_dir, "coord1.json")
+    with open(status_path + ".tmp", "w") as f:
         json.dump({"fatal_mid": fatal_mid, "pid": os.getpid(),
                    "port": comm.port, "token": token}, f)
+    os.replace(status_path + ".tmp", status_path)
     print("READY", flush=True)
     time.sleep(600)  # SIGKILLed here by the test
     return 0

@@ -1,0 +1,70 @@
+"""Sacrificial tenant kernel for the gateway chaos integration test.
+
+NOT a test module (no ``test_`` prefix).  Run as a subprocess:
+
+    python tests/integration/_tenant_kernel.py RUN_DIR NAME OUT_JSON
+
+Attaches to the gateway pool under RUN_DIR as tenant NAME, seeds a
+double-execution tripwire (``a_hits = 0``), fires an in-flight cell
+(bump ``a_hits``, sleep, yield it) WITHOUT waiting for the reply,
+publishes its pid + tenant token to OUT_JSON, prints READY — then
+ticks a seeded :class:`FaultPlan` (``NBD_FAULT_PLAN``) until it
+SIGKILLs this process mid-cell: the notebook-kernel-crash half of the
+tenant-isolation scenario, driven by the existing chaos machinery so
+the kill point is deterministic.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+# The in-flight cell: bumps the tripwire FIRST so a redelivered /
+# double-executed cell is visible as a_hits > 1 after reattach.
+CELL = ("a_hits += 1\n"
+        "import time\n"
+        "time.sleep(3.0)\n"
+        "a_hits")
+
+
+def main() -> int:
+    run_dir, name, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    from nbdistributed_tpu.gateway.client import TenantClient
+    from nbdistributed_tpu.gateway.daemon import read_gateway_manifest
+    from nbdistributed_tpu.resilience.faults import FaultPlan
+
+    m = read_gateway_manifest(run_dir)
+    assert m, f"no gateway manifest under {run_dir}"
+    plane = m["tenant_plane"]
+    client = TenantClient(plane["host"], int(plane["port"]), name,
+                          pool_token=m.get("pool_token"))
+    client.execute("a_hits = 0", timeout=120)
+
+    threading.Thread(target=lambda: client.execute(CELL, timeout=60),
+                     daemon=True).start()
+
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "token": client.token,
+                   "epoch": client.epoch}, f)
+    os.replace(tmp, out_path)
+    print("READY", flush=True)
+
+    plan = FaultPlan.from_env()
+    tick = 0
+    while tick < 600:                     # hard stop: 60 s
+        tick += 1
+        if plan is not None and plan.should_kill(0, tick):
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.1)
+    return 1                              # plan never fired — fail loud
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,9 @@ def test_executor_response_carries_count(monkeypatch):
         namespace = {"cg": cg}
         _stream = staticmethod(lambda text, kind: None)
         _flight = _NullRecorder()
+        # Untagged requests resolve to the base namespace (tenant
+        # namespaces are the gateway suite's concern).
+        _ns_for = worker_mod.DistributedWorker._ns_for
 
     handle = worker_mod.DistributedWorker._handle_execute
     w = _W()

@@ -1,0 +1,788 @@
+"""Unit tests for the session gateway (ISSUE 8).
+
+Pure state-machine coverage: the :class:`Scheduler` is driven with a
+fake clock and zero sleeps (fairness, priority, FIFO order, queue
+position, backpressure, overload shedding, tenant in-flight caps),
+the :class:`TenantRegistry` through its hello/fence/detach lifecycle
+(admission headcount, token hijack rejection, epoch fencing), and the
+gateway-manifest liveness probe ``gc_runs`` relies on.  One scripted
+in-process world (no JAX, no subprocesses) pins the no-forked-path
+guarantee: the single-kernel ``CommunicationManager`` routes execute
+requests through the same extracted scheduler a pool uses.
+"""
+
+import os
+import threading
+
+import pytest
+
+from nbdistributed_tpu.gateway.daemon import (gateway_alive,
+                                              gateway_manifest_path,
+                                              read_gateway_manifest)
+from nbdistributed_tpu.gateway.scheduler import (ACTIVE, DONE, QUEUED,
+                                                 REJECTED, SHED,
+                                                 CellRejected,
+                                                 CellShed, SchedPolicy,
+                                                 Scheduler)
+from nbdistributed_tpu.gateway.tenancy import (TenantRegistry,
+                                               TenantRejected)
+
+pytestmark = [pytest.mark.unit, pytest.mark.gateway]
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make(mode="fair", slots=1, inflight=0, depth=0, clock=None):
+    return Scheduler(SchedPolicy(mode, slots, inflight, depth),
+                     now=clock or FakeClock())
+
+
+# ----------------------------------------------------------------------
+# scheduler: dispatch / queue / order
+
+
+def test_default_policy_is_preexisting_behavior():
+    """The single-kernel default: unlimited FIFO, every submit
+    dispatches immediately — the pre-gateway contract."""
+    s = Scheduler()
+    assert s.policy.mode == "fifo"
+    assert s.policy.mesh_slots == 0
+    for i in range(10):
+        t = s.submit("local", f"m{i}")
+        assert t.verdict == {"status": "dispatch"}
+        assert t.state == ACTIVE
+        assert t.event.is_set()
+    assert s.snapshot()["active"] == 10
+
+
+def test_single_slot_queues_with_explicit_position():
+    s = make(slots=1)
+    first = s.submit("a", "m0")
+    assert first.verdict["status"] == "dispatch"
+    q1 = s.submit("a", "m1")
+    q2 = s.submit("b", "m2")
+    assert q1.verdict == {"status": "queued", "position": 1}
+    assert q2.verdict == {"status": "queued", "position": 2}
+    assert not q1.event.is_set()
+    assert s.position("m2") == 2
+
+
+def test_fifo_dispatch_order_on_complete():
+    s = make(mode="fifo", slots=1)
+    s.submit("a", "m0")
+    ticks = [s.submit("t", f"m{i}") for i in range(1, 4)]
+    done = []
+    for expect in ("m1", "m2", "m3"):
+        promoted = s.complete(done[-1] if done else "m0")
+        assert [t.msg_id for t in promoted] == [expect]
+        assert promoted[0].state == ACTIVE
+        assert promoted[0].event.is_set()
+        done.append(expect)
+    # m1 and m2 were completed along the way; m3 still holds the slot.
+    assert [t.state for t in ticks] == [DONE, DONE, ACTIVE]
+
+
+def test_fair_mode_priority_wins_first():
+    s = make(mode="fair", slots=1)
+    s.submit("a", "m0")
+    s.submit("low", "lo", priority=0)
+    s.submit("high", "hi", priority=5)
+    promoted = s.complete("m0")
+    assert promoted[0].msg_id == "hi"
+
+
+def test_fair_mode_least_served_tenant_interleaves():
+    """A batch tenant's flood must not starve the interactive tenant:
+    after the flood tenant has been served more, the other tenant's
+    queued cell wins the next slot."""
+    s = make(mode="fair", slots=1)
+    s.submit("batch", "b0")
+    for i in range(1, 4):
+        s.submit("batch", f"b{i}")
+    s.submit("interactive", "i0")
+    # batch served=1, interactive served=0 -> i0 wins despite arriving
+    # after b1..b3.
+    promoted = s.complete("b0")
+    assert promoted[0].msg_id == "i0"
+    # Now both served=1; arrival order breaks the tie.
+    promoted = s.complete("i0")
+    assert promoted[0].msg_id == "b1"
+
+
+def test_fifo_mode_ignores_priority():
+    s = make(mode="fifo", slots=1)
+    s.submit("a", "m0")
+    s.submit("a", "lo", priority=0)
+    s.submit("a", "hi", priority=99)
+    assert s.complete("m0")[0].msg_id == "lo"
+
+
+# ----------------------------------------------------------------------
+# scheduler: admission control + overload
+
+
+def test_tenant_inflight_cap_rejects_with_reason():
+    s = make(slots=0, inflight=2)
+    s.submit("a", "m0")
+    s.submit("a", "m1")
+    t = s.submit("a", "m2")
+    assert t.state == REJECTED       # not SHED: distinct terminal state
+    assert t.verdict["status"] == "rejected"
+    assert t.verdict["reason"] == "tenant-inflight-cap"
+    assert t.verdict["limit"] == 2
+    assert t.event.is_set()          # submitter learns immediately
+    # Another tenant is NOT capped by a's usage.
+    assert s.submit("b", "m3").verdict["status"] == "dispatch"
+    snap = s.snapshot()
+    assert snap["tenants"]["a"]["rejected"] == 1
+
+
+def test_inflight_cap_counts_queued_plus_active():
+    s = make(slots=1, inflight=2)
+    s.submit("a", "m0")              # active
+    s.submit("a", "m1")              # queued
+    assert s.submit("a", "m2").verdict["status"] == "rejected"
+    # Completing frees the cap.
+    s.complete("m0")
+    assert s.submit("a", "m3").verdict["status"] == "queued"
+
+
+def test_overload_sheds_lowest_priority_youngest():
+    s = make(slots=1, depth=2)
+    s.submit("a", "m0")
+    old = s.submit("a", "q-old", priority=0)
+    hi = s.submit("b", "q-hi", priority=3)
+    # Queue is at depth 2; this overflow submit (priority 0, youngest
+    # among the priority-0 cells) is itself the shedding victim.
+    late = s.submit("c", "q-late", priority=0)
+    assert late.state == SHED
+    assert late.verdict["status"] == "shed"
+    assert late.verdict["reason"] == "overload"
+    assert late.event.is_set()
+    # Older and higher-priority queued work survived.
+    assert old.state == QUEUED and hi.state == QUEUED
+    assert s.shed_total == 1
+
+
+def test_overload_shed_victim_can_be_another_tenants_cell():
+    """A high-priority overflow submit evicts the lowest-priority
+    queued cell instead of being refused itself — and the verdict
+    names the victim so the gateway can notify its tenant."""
+    s = make(slots=1, depth=2)
+    s.submit("a", "m0")
+    victim = s.submit("lowprio", "q-low", priority=0)
+    s.submit("b", "q-mid", priority=1)
+    vip = s.submit("vip", "q-vip", priority=9)
+    assert vip.state == QUEUED
+    assert victim.state == SHED
+    assert victim.event.is_set()
+    # Victim summaries are JSON-safe (no live Ticket objects leak
+    # into a verdict dict that may cross the wire).
+    assert {"tenant": "lowprio", "msg_id": "q-low",
+            "priority": 0} in vip.verdict["victims"]
+    import json
+    json.dumps(vip.verdict)
+    snap = s.snapshot()
+    assert snap["tenants"]["lowprio"]["shed"] == 1
+    assert snap["queued"] == 2
+
+
+def test_cancel_queued_and_active():
+    s = make(slots=1)
+    s.submit("a", "m0")
+    q = s.submit("a", "m1")
+    assert s.cancel("m1") is True     # withdrawn from the queue
+    assert q.state == DONE
+    assert s.cancel("m1") is False
+    # Cancelling the ACTIVE cell frees its slot and promotes.
+    q2 = s.submit("a", "m2")
+    assert q2.state == QUEUED
+    assert s.cancel("m0") is True
+    assert q2.state == ACTIVE
+
+
+def test_complete_frees_slot_even_without_queue():
+    s = make(slots=1)
+    s.submit("a", "m0")
+    assert s.complete("m0") == []
+    snap = s.snapshot()
+    assert snap["active"] == 0
+    assert snap["tenants"]["a"]["completed"] == 1
+    assert s.submit("a", "m1").verdict["status"] == "dispatch"
+
+
+def test_snapshot_shape():
+    clock = FakeClock()
+    s = make(mode="fair", slots=1, inflight=4, depth=8, clock=clock)
+    s.submit("a", "m0")
+    s.submit("b", "m1")
+    snap = s.snapshot()
+    assert snap["policy"] == {"mode": "fair", "mesh_slots": 1,
+                              "tenant_inflight": 4, "queue_depth": 8}
+    assert snap["queued"] == 1 and snap["active"] == 1
+    assert snap["tenants"]["a"]["served"] == 1
+    assert snap["tenants"]["b"]["queued"] == 1
+
+
+def test_bad_mode_rejected():
+    with pytest.raises(ValueError):
+        SchedPolicy("round-robin")
+
+
+# ----------------------------------------------------------------------
+# tenancy: hello / fence / detach
+
+
+def test_hello_admits_and_mints_token():
+    reg = TenantRegistry(max_tenants=2)
+    t, reply = reg.hello("alice", None, client_id=7)
+    assert reply["status"] == "admitted"
+    assert reply["tenant"] == "alice"
+    assert t.token and reply["token"] == t.token
+    assert t.epoch == 1 and reply["epoch"] == 1
+    assert reg.by_client(7) is t
+
+
+def test_admission_headcount_bound():
+    reg = TenantRegistry(max_tenants=2)
+    reg.hello("a", None, 1)
+    reg.hello("b", None, 2)
+    with pytest.raises(TenantRejected) as ei:
+        reg.hello("c", None, 3)
+    assert "max_tenants=2" in str(ei.value)
+    # An EXISTING tenant's reattach is never blocked by the headcount.
+    t, reply = reg.hello("a", reg.get("a").token, 4)
+    assert reply["status"] == "reattached"
+
+
+def test_wrong_token_cannot_hijack_a_tenant_name():
+    reg = TenantRegistry()
+    reg.hello("alice", None, 1)
+    for bad in (None, "", "wrong-token"):
+        with pytest.raises(TenantRejected):
+            reg.hello("alice", bad, 2)
+    assert reg.get("alice").epoch == 1   # hijack attempts bump nothing
+
+
+def test_reattach_bumps_epoch_and_fences_old_connection():
+    reg = TenantRegistry()
+    t, _ = reg.hello("alice", None, client_id=1)
+    token = t.token
+    t2, reply = reg.hello("alice", token, client_id=2, priority=7)
+    assert t2 is t
+    assert reply["status"] == "reattached"
+    assert t.epoch == 2 and t.reattaches == 1
+    # A DECLARED priority wins on reattach: `%dist_attach --priority N`
+    # after a crash must not silently keep the old one...
+    assert t.priority == 7
+    # The crashed kernel's frames (stamped epoch 1) are now stale...
+    assert reg.fence(t, 1) is True
+    assert reg.fence(t, 2) is False
+    # ...and unstamped frames are never fenced (same contract as the
+    # session-epoch fence).
+    assert reg.fence(t, None) is False
+    # The OLD client id still resolves to the tenant on purpose — the
+    # fence must answer its frames with stale_epoch, not "no hello".
+    assert reg.by_client(1) is t
+    assert reg.by_client(2) is t
+    # An OMITTED priority (None, the argparse default) keeps the
+    # current value instead of demoting the tenant to 0 on every
+    # plain reattach.
+    reg.hello("alice", token, client_id=3)
+    assert t.priority == 7
+
+
+def test_detach_keeps_tenant_state_for_reattach():
+    reg = TenantRegistry()
+    t, _ = reg.hello("alice", None, client_id=1)
+    t.mailbox.park("mid-1", object())
+    gone = reg.detach_client(1)
+    assert gone is t
+    assert t.client_id is None and not t.attached
+    assert reg.get("alice") is t          # name + token + mailbox live
+    assert len(t.mailbox) == 1
+    assert reg.by_client(1) is None
+    # A stale detach (old client id after a reattach rebound it) must
+    # not clear the LIVE connection.
+    reg.hello("alice", t.token, client_id=2)
+    assert reg.detach_client(1) is None
+    assert t.client_id == 2
+    # Crash-then-reattach ordering: the tenant reattaches as client 3
+    # BEFORE the dead client 2's EOF lands.  The late EOF must not
+    # read as a detach of the (re)attached tenant.
+    reg.hello("alice", t.token, client_id=3)
+    assert reg.by_client(2) is t          # old id kept for the fence
+    assert reg.detach_client(2) is None   # superseded, not a detach
+    assert t.client_id == 3 and t.attached
+    assert reg.detach_client(3) is t      # the live conn going IS one
+
+
+def test_clean_detach_evicts_only_idle_unattached_tenants():
+    """Eviction frees the admission slot for rotating tenant names —
+    but never while attached, and never with recoverable state."""
+    reg = TenantRegistry(max_tenants=1)
+    t, _ = reg.hello("alice", None, client_id=1)
+    assert reg.evict("alice") is False          # still attached
+    reg.detach_client(1)
+    t.mailbox.park("m1", object())
+    # The daemon's guard (empty mailbox) lives daemon-side; the
+    # registry itself only refuses attached tenants — drain first.
+    t.mailbox.claim_all()
+    assert reg.evict("alice") is True
+    assert reg.get("alice") is None
+    assert reg.evict("alice") is False          # idempotent
+    # The freed slot admits a NEW name; the old name returns fresh
+    # (new token, epoch 1) rather than being refused forever.
+    b, _ = reg.hello("bob", None, client_id=2)
+    reg.detach_client(2)
+    assert reg.evict("bob") is True
+    t2, reply = reg.hello("alice", None, client_id=3)
+    assert reply["status"] == "admitted" and t2.epoch == 1
+    assert t2.token != t.token
+
+
+def test_scheduler_tenant_idle():
+    s = make(slots=1)
+    assert s.tenant_idle("a") is True           # never seen
+    s.submit("a", "m0")                         # active
+    q = s.submit("a", "m1")                     # queued
+    assert s.tenant_idle("a") is False
+    s.complete("m0")                            # promotes m1
+    assert q.state == ACTIVE
+    assert s.tenant_idle("a") is False
+    s.complete("m1")
+    assert s.tenant_idle("a") is True
+
+
+def test_mailbox_partitions_are_per_tenant():
+    reg = TenantRegistry()
+    a, _ = reg.hello("a", None, 1)
+    b, _ = reg.hello("b", None, 2)
+    a.mailbox.park("m1", "ra")
+    b.mailbox.park("m2", "rb")
+    assert a.mailbox.claim_all() == {"m1": "ra"}
+    assert a.mailbox.claim_all() == {}     # exactly once
+    assert len(b.mailbox) == 1             # untouched by a's drain
+
+
+def test_manifest_block_records_token_epoch_attached():
+    reg = TenantRegistry()
+    t, _ = reg.hello("alice", None, 1)
+    reg.hello("alice", t.token, 2)
+    reg.detach_client(2)
+    blk = reg.manifest_block()
+    assert blk == {"alice": {"token": t.token, "epoch": 2,
+                             "attached": False}}
+
+
+# ----------------------------------------------------------------------
+# gateway manifest liveness (the gc_runs skip probe)
+
+
+def test_gateway_alive_probe(tmp_path):
+    d = str(tmp_path)
+    assert read_gateway_manifest(d) is None
+    assert gateway_alive(None) is False
+    with open(gateway_manifest_path(d), "w") as f:
+        f.write('{"kind": "gateway", "pid": %d}' % os.getpid())
+    assert gateway_alive(read_gateway_manifest(d)) is True
+    # A dead pid (or garbage) keeps nothing.
+    for content in ('{"pid": 2147483646}', '{"pid": "x"}', "{torn"):
+        with open(gateway_manifest_path(d), "w") as f:
+            f.write(content)
+        assert gateway_alive(read_gateway_manifest(d)) is False
+
+
+def test_gc_runs_keeps_live_gateway_dir(tmp_path, monkeypatch):
+    from nbdistributed_tpu.resilience.session import gc_runs
+    monkeypatch.delenv("NBD_RUN_DIR", raising=False)
+    root = tmp_path / "runs"
+    live = root / "pool-live"
+    stale = root / "stale"
+    live.mkdir(parents=True)
+    stale.mkdir()
+    with open(gateway_manifest_path(str(live)), "w") as f:
+        f.write('{"kind": "gateway", "pid": %d}' % os.getpid())
+    old = 1_000_000.0
+    os.utime(str(live), (old, old))
+    os.utime(str(stale), (old, old))
+    res = gc_runs(str(root), ttl_s=60, dry_run=True)
+    assert str(stale) in res["swept"]
+    assert str(live) in res["kept"]
+    assert "live gateway daemon" in res["kept_why"][str(live)]
+
+
+# ----------------------------------------------------------------------
+# no forked code path: the single-kernel CommunicationManager routes
+# execute through the extracted scheduler
+
+
+class _ScriptedWorker:
+    """Minimal worker loop answering via a handler fn (the
+    test_coordinator.py pattern, no JAX / subprocesses)."""
+
+    def __init__(self, port, rank, handler):
+        from nbdistributed_tpu.messaging import WorkerChannel
+        self.chan = WorkerChannel("127.0.0.1", port, rank=rank)
+        self.rank = rank
+        self.handler = handler
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                msg = self.chan.recv()
+            except Exception:
+                return
+            out = self.handler(self.rank, msg)
+            if out is not None:
+                try:
+                    self.chan.send(msg.reply(data=out, rank=self.rank))
+                except Exception:
+                    return  # channel closed by teardown mid-reply
+
+    def close(self):
+        self.chan.close()
+
+
+def test_single_kernel_path_routes_through_scheduler():
+    from nbdistributed_tpu.messaging import CommunicationManager
+
+    mgr = CommunicationManager(num_workers=1, timeout=10)
+    w = None
+    try:
+        w = _ScriptedWorker(mgr.port, 0,
+                            lambda rank, msg: {"output": "ok"})
+        mgr.wait_for_workers(timeout=10)
+        assert isinstance(mgr.scheduler, Scheduler)
+        resp = mgr.send_to_ranks([0], "execute", {"code": "pass"})
+        assert resp[0].data == {"output": "ok"}
+        snap = mgr.scheduler.snapshot()
+        # The implicit single tenant is accounted like any pool tenant.
+        assert snap["tenants"]["local"]["completed"] == 1
+        assert snap["active"] == 0
+    finally:
+        if w is not None:
+            w.close()
+        mgr.shutdown()
+
+
+def test_bounded_scheduler_raises_shed_and_rejected_through_manager():
+    """A pool-shaped policy on the manager surfaces CellShed /
+    CellRejected to the caller instead of silently blocking."""
+    import time
+
+    from nbdistributed_tpu.messaging import CommunicationManager
+
+    release = threading.Event()
+
+    def handler(rank, msg):
+        if msg.msg_type != "execute":
+            return {"output": "?"}
+        release.wait(15)
+        return {"output": "done"}
+
+    mgr = CommunicationManager(
+        num_workers=1, timeout=20,
+        scheduler=Scheduler(SchedPolicy("fair", mesh_slots=1,
+                                        tenant_inflight=2,
+                                        queue_depth=1)))
+    w = None
+    try:
+        w = _ScriptedWorker(mgr.port, 0, handler)
+        mgr.wait_for_workers(timeout=10)
+        errs: dict = {}
+        positions: list = []
+
+        def submit(mid, tenant, prio=0):
+            try:
+                mgr.send_to_ranks(
+                    [0], "execute", {"code": "slow"}, tenant=tenant,
+                    priority=prio, msg_id=mid,
+                    on_verdict=lambda t: positions.append(
+                        t.verdict.get("position")))
+            except Exception as e:
+                errs[mid] = e
+
+        t1 = threading.Thread(target=submit, args=("m0", "a"))
+        t1.start()
+        t0 = time.time()
+        while mgr.scheduler.snapshot()["active"] < 1:
+            assert time.time() - t0 < 5
+            time.sleep(0.01)
+        # Queue depth 1: m1 queues (explicit position), m2 overflows
+        # and is shed (same priority, youngest).
+        t2 = threading.Thread(target=submit, args=("m1", "a"))
+        t2.start()
+        t0 = time.time()
+        while mgr.scheduler.snapshot()["queued"] < 1:
+            assert time.time() - t0 < 5
+            time.sleep(0.01)
+        submit("m2", "b")
+        assert isinstance(errs["m2"], CellShed)
+        # Tenant a is now at its inflight cap (1 active + 1 queued).
+        submit("m3", "a")
+        assert isinstance(errs["m3"], CellRejected)
+        release.set()
+        t1.join(10)
+        t2.join(10)
+        assert "m0" not in errs and "m1" not in errs
+        assert 1 in positions            # m1's explicit queue position
+    finally:
+        release.set()
+        if w is not None:
+            w.close()
+        mgr.shutdown()
+
+
+def test_pool_from_env_typo_degrades_to_fair():
+    """Knobs convention: an env typo must degrade, not kill the
+    daemon at SchedPolicy construction."""
+    p = SchedPolicy.pool_from_env(env={"NBD_POOL_SCHED": "fare"})
+    assert p.mode == "fair"
+    p = SchedPolicy.pool_from_env(env={"NBD_POOL_SCHED": "fifo"})
+    assert p.mode == "fifo"
+
+
+def test_deliver_parks_when_submitting_connection_superseded():
+    """A cell in flight across a reattach must PARK its result: the
+    tenant's live connection is a NEW kernel with no waiter for that
+    msg_id — a 'successful' send there is a silent client-side drop,
+    and the mailbox drain on the next attach would never see it."""
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+    from nbdistributed_tpu.gateway.tenancy import Tenant
+
+    class _Flight:
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **kw):
+            self.events.append((kind, kw))
+
+    class _Reply:
+        msg_id = "cell-1"
+
+    d = object.__new__(GatewayDaemon)
+    d._lock = threading.Lock()
+    d.flight = _Flight()
+    sent = []
+    d._send_to_client = lambda cid, reply: (sent.append(
+        (cid, getattr(reply, "msg_type", "reply"))) or True)
+
+    t = Tenant("alice", "tok")
+    t.client_id = 2                      # reattached connection
+    # Submitted on connection 1, which the reattach superseded: park —
+    # and nudge the LIVE connection with a parked_notice, because its
+    # hello's parked list predates this park (without the nudge
+    # nothing would ever drain it).
+    d._deliver(t, _Reply(), submit_cid=1)
+    assert sent == [(2, "parked_notice")]
+    assert t.mailbox.ids() == ["cell-1"]
+    assert t.parked_total == 1
+    # Same connection still live: deliver straight through.
+    r2 = _Reply()
+    r2.msg_id = "cell-2"
+    d._deliver(t, r2, submit_cid=2)
+    assert sent[-1][0] == 2 and sent[-1][1] != "parked_notice"
+    assert t.mailbox.ids() == ["cell-1"]
+
+
+def test_serve_count_blocks_eviction_window():
+    """The serve counter brackets the whole execute→_deliver span —
+    including the gap after scheduler.complete() where the reply is
+    mid-park — and drops on success AND failure, so a clean detach
+    can only evict a tenant with truly nothing in flight."""
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+
+    d = object.__new__(GatewayDaemon)
+    d._lock = threading.Lock()
+    d._serving = {"alice": 1}            # the listener's increment
+    seen = []
+
+    def inner_ok(tenant, msg, cid):
+        seen.append(d._serving.get("alice"))   # still held mid-serve
+
+    class _T:
+        name = "alice"
+
+    d._serve_execute_inner = inner_ok
+    d._serve_execute(_T(), None, 1)
+    assert seen == [1]
+    assert d._serving == {}              # released after delivery
+
+    d._serving = {"alice": 2}            # two cells in flight
+
+    def inner_boom(tenant, msg, cid):
+        raise RuntimeError("worker died")
+
+    d._serve_execute_inner = inner_boom
+    with pytest.raises(RuntimeError):
+        d._serve_execute(_T(), None, 1)
+    assert d._serving == {"alice": 1}    # failure still releases ONE
+
+
+def test_forget_tenant_drops_stats_only_when_idle():
+    """Eviction must also forget the scheduler's per-tenant stats —
+    otherwise a re-admitted name inherits the old ``served`` count
+    (fair mode would deprioritize a genuinely fresh tenant) and the
+    dict grows one entry per departed name forever."""
+    s = make(slots=1)
+    s.submit("a", "m0")
+    assert s.forget_tenant("a") is False        # active: refused
+    s.complete("m0")
+    assert "a" in s.snapshot()["tenants"]
+    assert s.forget_tenant("a") is True
+    assert "a" not in s.snapshot()["tenants"]
+    assert s.forget_tenant("a") is True         # unknown == forgotten
+    # A re-admitted same-name tenant starts with fresh fair-share
+    # standing, not the evicted tenant's served count.
+    s.submit("a", "m1")
+    assert s.snapshot()["tenants"]["a"]["served"] == 1
+
+
+def test_evict_gated_on_namespace_gc():
+    """A failed tenant_gc broadcast must NOT free the tenant's name:
+    the namespaces survive on the live ranks, and a future same-name
+    tenant would execute its first cell inside the departed tenant's
+    state.  Dead ranks are excluded from the broadcast (their process
+    took the namespace dicts with it) and never block eviction."""
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+
+    class _Flight:
+        def record(self, kind, **kw):
+            pass
+
+    class _Sched:
+        def __init__(self):
+            self.forgot = []
+
+        def forget_tenant(self, name):
+            self.forgot.append(name)
+
+        def tenant_idle(self, name):
+            return True
+
+    class _Comm:
+        def __init__(self, dead=(), fail_times=0):
+            self._deadset, self.sent = set(dead), []
+            self.fail_times = fail_times
+            self.scheduler = _Sched()
+
+        def dead_ranks(self):
+            return set(self._deadset)
+
+        def send_to_ranks(self, ranks, *a, **kw):
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                raise RuntimeError("request timed out")
+            self.sent.append(list(ranks))
+
+    class _T:
+        client_id = None
+        mailbox = ()                     # len() == 0: nothing parked
+
+    class _Reg:
+        def __init__(self, tenant=_T()):
+            self.evicted = []
+            self.tenant = tenant
+
+        def get(self, name):
+            return self.tenant
+
+        def evict(self, name):
+            self.evicted.append(name)
+            return True
+
+    def mk(comm, reg=None, closed=False):
+        d = object.__new__(GatewayDaemon)
+        d._lock = threading.Lock()
+        d.flight = _Flight()
+        d.comm = comm
+        d.world_size = 4
+        d.registry = reg or _Reg()
+        d._write_manifest = lambda: None
+        d._closed = threading.Event()
+        if closed:
+            d._closed.set()
+        return d
+
+    # Persistent gc failure: the retry loop parks on _closed.wait —
+    # a closing daemon stops retrying and the slot survives the miss.
+    d = mk(_Comm(fail_times=99), closed=True)
+    d._evict_after_gc("alice")
+    assert d.registry.evicted == []          # slot survives a gc miss
+
+    # A reattach mid-retry stops the gc: the namespace is live again.
+    live = _T()
+    live.client_id = 7
+    d = mk(_Comm(fail_times=99), reg=_Reg(live))
+    d._evict_after_gc("alice")
+    assert d.registry.evicted == []
+
+    # Even on gc SUCCESS the evict re-checks: a tenant that came back
+    # (or crashed again leaving parked work) during the broadcast
+    # window keeps its slot, token, and mailbox.
+    d = mk(_Comm(), reg=_Reg(live))
+    d._evict_after_gc("alice")
+    assert d.registry.evicted == []
+    parked = _T()
+    parked.mailbox = ("m1",)
+    d = mk(_Comm(), reg=_Reg(parked))
+    d._evict_after_gc("alice")
+    assert d.registry.evicted == []
+
+    # Transient failure (busy mesh): the retry lands the gc and THEN
+    # evicts — a one-shot give-up leaked the slot forever.
+    c = _Comm(fail_times=1)
+    d = mk(c)
+    d._evict_after_gc("alice")
+    assert c.sent                            # retried to success
+    assert d.registry.evicted == ["alice"]
+
+    c = _Comm(dead={2})
+    d = mk(c)
+    d._evict_after_gc("alice")
+    assert c.sent == [[0, 1, 3]]             # dead rank 2 excluded
+    assert d.registry.evicted == ["alice"]
+    assert c.scheduler.forgot == ["alice"]
+
+
+def test_serve_mailbox_releases_counter():
+    """The mailbox drain runs off the listener thread bracketed by
+    the same serve counter as execute (a slow client's blocked drain
+    reply must not let a racing detach evict the tenant mid-claim),
+    and the counter drops on success AND failure."""
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+
+    class _T:
+        name = "alice"
+
+    d = object.__new__(GatewayDaemon)
+    d._lock = threading.Lock()
+    d._serving = {"alice": 1}                # the listener's increment
+    held = []
+    d._handle_mailbox = lambda cid, t, m: held.append(
+        d._serving.get("alice"))
+    d._serve_mailbox(_T(), None, 7)
+    assert held == [1]                       # held across the serve
+    assert d._serving == {}
+
+    d._serving = {"alice": 1}
+
+    def boom(cid, t, m):
+        raise RuntimeError("socket died")
+
+    d._handle_mailbox = boom
+    with pytest.raises(RuntimeError):
+        d._serve_mailbox(_T(), None, 7)
+    assert d._serving == {}                  # failure still releases

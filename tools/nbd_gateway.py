@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Run a session-gateway daemon: one pooled worker fleet, N tenants.
+
+The gateway owns the workers and serves a tenant plane that notebook
+kernels attach to with ``%dist_attach --tenant NAME`` (the in-notebook
+spawner is ``%dist_pool start``).  Admission control, per-tenant
+fair-share scheduling, backpressure, and crash fencing are described
+in README "Session gateway & multi-tenancy".
+
+    python tools/nbd_gateway.py -n 4 --backend cpu
+    python tools/nbd_gateway.py -n 4 --sched fair --queue-depth 32
+
+Equivalent module form: ``python -m nbdistributed_tpu.gateway.daemon``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nbdistributed_tpu.gateway.daemon import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

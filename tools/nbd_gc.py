@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> int:
           f"kept {len(res['kept'])}")
     for d in res["swept"]:
         print(f"  - {d}")
+    if args.dry_run:
+        for d in res["kept"]:
+            why = res.get("kept_why", {}).get(d)
+            print(f"  = kept {d}" + (f" — {why}" if why else ""))
     for e in res["errors"]:
         print(f"  ! {e}", file=sys.stderr)
     return 1 if res["errors"] else 0
