@@ -13,10 +13,20 @@ Two halves:
   and :mod:`preflight` lets a later hang verdict on a flagged cell
   cite the pre-flight finding.
 
+- **Effect inference** (:mod:`effects`, ISSUE 9): per-cell
+  :class:`~.effects.EffectReport` — name footprint (reads / writes /
+  mutations / deletes, with an ``opaque`` verdict for dynamic
+  escapes), the *ordered* collective footprint
+  (none / exact / unknown), and host-sync/purity flags.  Consumed by
+  the gateway scheduler's effects-aware admission
+  (``NBD_POOL_SCHED_EFFECTS``) and the preflight store's per-session
+  cell dependency DAG (``%dist_lint deps``).
+
 - **Self-lint** (:mod:`selfcheck`, ``tools/nbd_lint.py --self``):
   custom AST passes over the framework itself — thread-shared-state
-  discipline, the codec wire-extension registry, and the env-knob
-  registry (every ``NBD_*`` declared in utils/knobs.py and
+  discipline (including the gateway classes and the ``_locked``
+  helper convention), the codec wire-extension registry, and the
+  env-knob registry (every ``NBD_*`` declared in utils/knobs.py and
   README-documented).
 
 Everything here is stdlib-only (ast + re) and safe to import from
@@ -25,7 +35,10 @@ any layer.
 
 from .cellcheck import (COLLECTIVE_NAMES, FRAMEWORK_NAMES, Finding,
                         VetResult, vet_cell)
+from .effects import (CollectiveSite, EffectReport, collective_class,
+                      infer_effects)
 from .ipycompat import strip_ipython
 
 __all__ = ["vet_cell", "VetResult", "Finding", "strip_ipython",
-           "COLLECTIVE_NAMES", "FRAMEWORK_NAMES"]
+           "COLLECTIVE_NAMES", "FRAMEWORK_NAMES", "EffectReport",
+           "CollectiveSite", "infer_effects", "collective_class"]

@@ -1,0 +1,842 @@
+"""Cell effect inference: the read/write/collective footprint that
+makes concurrent scheduling provably safe (the ISSUE 9 tentpole).
+
+PR 8's gateway shipped ``NBD_POOL_MESH_SLOTS`` with a stated hazard:
+more than one concurrent cell is only safe when the overlapping cells
+are collective-free, because concurrent broadcasts carry no cross-rank
+ordering — two tenants' collectives can pair up mismatched and hang
+the shared mesh.  Nothing *proved* a cell collective-free, so the knob
+was effectively unusable.  This module is that proof, plus the name
+footprint ROADMAP item 3 (async pipelined dispatch) needs to know cell
+N+1 is independent of cell N.
+
+For one cell, :func:`infer_effects` returns an :class:`EffectReport`:
+
+- **name footprint** — free names the cell *reads*, names it *binds*
+  at module scope (``writes``), object-*mutation* targets
+  (``x.attr = …``, ``x[k] = …``, known mutator methods like
+  ``x.append(...)``), and ``del``-ed names — including ``global``
+  escapes out of function bodies and augmented assigns (read+write).
+  Dynamic namespace escapes (``exec``/``eval``, star-imports,
+  ``globals()``/``vars()``/``locals()`` writes, unparseable source)
+  yield an explicit ``opaque`` verdict that conservatively poisons the
+  whole namespace: an opaque cell depends on everything and everything
+  after it depends on it.
+
+- **collective footprint** — the *ordered* sequence of collective call
+  sites the cell can reach from module level, with a three-way
+  verdict: ``"none"`` (proven collective-free), ``"exact"`` (the
+  sites are statically enumerable, in order), or ``"unknown"``
+  (collectives may hide behind calls the analyzer cannot see
+  through).  Calls into same-cell ``def``\\ s are resolved **one level
+  deep**; anything deeper, any call into a user/framework function the
+  analyzer cannot vet, and any host-sync call on a possibly-sharded
+  array (``.item()`` on a cross-host array gathers) records a *taint*
+  and degrades the verdict to ``unknown`` — never to a false "free".
+  Calls whose root is provably inert (builtins, pure stdlib modules,
+  ``numpy``/``jnp``) stay safe, so ordinary compute cells can be
+  *proven* free rather than merely assumed.
+
+- **host-sync / purity flags** — folds in the cellcheck
+  host-sync-in-loop detection (`.item()`/`.tolist()`/
+  ``block_until_ready``/``device_get``/printing computed values inside
+  a loop) plus a cell-wide ``host_sync`` flag and a ``pure`` property
+  (touches no names, no collectives, no host syncs, not opaque).
+
+Consumers: the gateway scheduler's effects-aware admission mode
+(``NBD_POOL_SCHED_EFFECTS=1`` — only *proven*-free cells may overlap a
+collective-bearing cell; unknown/opaque cells serialize with a verdict
+naming the reason) and the preflight store's per-session cell
+dependency DAG (``%dist_lint deps``), the declared substrate for
+ROADMAP item 3's in-flight window.
+
+Stdlib-only (ast + builtins), shares the collective vocabulary and the
+IPython stripping with :mod:`cellcheck` / :mod:`ipycompat`, and never
+raises: source the analyzer cannot read comes back opaque.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass, field
+
+from .cellcheck import COLLECTIVE_NAMES, HOST_SYNC_ATTRS
+from .ipycompat import strip_ipython
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+# Modules that can never reach a mesh collective: pure stdlib plus
+# numpy (host-only) and jax.numpy (device compute; the collectives
+# live in lax/dist/multihost_utils, reached via names the classifier
+# already treats as collective or unvetted).  `jax` itself is NOT
+# safe: jax.jit/shard_map/pmap products can run psums when called.
+SAFE_MODULES = frozenset({
+    "time", "math", "os", "sys", "json", "re", "random", "itertools",
+    "functools", "collections", "statistics", "string", "textwrap",
+    "pathlib", "dataclasses", "typing", "heapq", "bisect", "copy",
+    "pprint", "numpy", "jax.numpy",
+})
+
+# Ambient names assumed to denote those modules when the cell does not
+# bind them itself (the worker seeds np/jnp; time/math/os/... are the
+# idiomatic stdlib spellings).  A cell that REBINDS one of these to
+# anything that is not a safe import loses the assumption.
+SAFE_CALL_ROOTS = frozenset(
+    {m for m in SAFE_MODULES if "." not in m} | {"np", "jnp"})
+
+# Reading globals()/vars()/locals() is fine; WRITING through them is a
+# dynamic namespace escape the static footprint cannot see.
+_DYNAMIC_NS = frozenset({"globals", "vars", "locals"})
+_NS_WRITE_METHODS = frozenset({"update", "setdefault", "pop",
+                               "popitem", "clear"})
+
+# Method names that mutate their receiver in place — conservative
+# extras for the mutation footprint (same family the self-lint's
+# thread pass recognizes).
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "update", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "setdefault", "extend",
+    "insert", "sort", "reverse",
+})
+
+_MAX_TAINTS = 8
+
+VERDICT_NONE = "none"
+VERDICT_EXACT = "exact"
+VERDICT_UNKNOWN = "unknown"
+
+
+@dataclass
+class CollectiveSite:
+    """One statically-visible collective call site."""
+
+    op: str
+    line: int
+    in_loop: bool = False
+    conditional: bool = False
+    via: str | None = None   # reached through this same-cell def
+
+    def as_dict(self) -> dict:
+        d = {"op": self.op, "line": self.line}
+        if self.in_loop:
+            d["in_loop"] = True
+        if self.conditional:
+            d["conditional"] = True
+        if self.via:
+            d["via"] = self.via
+        return d
+
+    def render(self) -> str:
+        out = f"{self.op}@L{self.line}"
+        if self.via:
+            out += f" (via {self.via})"
+        flags = [f for f, on in (("loop", self.in_loop),
+                                 ("cond", self.conditional)) if on]
+        if flags:
+            out += f" [{','.join(flags)}]"
+        return out
+
+
+@dataclass
+class EffectReport:
+    """Everything the scheduler and the dependency DAG need to know
+    about one cell without running it."""
+
+    parsed: bool = True
+    opaque: bool = False
+    opaque_reasons: tuple = ()
+    reads: frozenset = frozenset()      # free names read
+    writes: frozenset = frozenset()     # names bound at module scope
+    mutates: frozenset = frozenset()    # objects mutated in place
+    deletes: frozenset = frozenset()    # names del-ed at module scope
+    collectives: tuple = ()             # ordered CollectiveSites
+    collective_verdict: str = VERDICT_UNKNOWN
+    taints: tuple = ()                  # why the verdict is unknown
+    host_sync: bool = False
+    host_sync_in_loop: bool = False
+    # Ambient names this cell RE-ARMED by importing the real module
+    # (`import numpy as np`): excluded from ambient_poison().
+    safe_rearms: frozenset = frozenset()
+
+    @property
+    def touched(self) -> frozenset:
+        """Names a later cell could observe a change to — the write
+        side of the dependency DAG's write-read edges."""
+        return self.writes | self.mutates | self.deletes
+
+    @property
+    def collective_free(self) -> bool:
+        """PROVEN free — the only verdict that may overlap a running
+        collective-bearing cell under effects admission."""
+        return (self.parsed and not self.opaque
+                and self.collective_verdict == VERDICT_NONE)
+
+    @property
+    def pure(self) -> bool:
+        """Namespace-pure and mesh-silent: safe to reorder freely."""
+        return (self.parsed and not self.opaque and not self.touched
+                and self.collective_verdict == VERDICT_NONE
+                and not self.host_sync)
+
+    def as_dict(self) -> dict:
+        """JSON-safe summary (the preflight store's entry shape)."""
+        return {
+            "parsed": self.parsed,
+            "opaque": self.opaque,
+            "opaque_reasons": list(self.opaque_reasons),
+            "reads": sorted(self.reads),
+            "writes": sorted(self.writes),
+            "mutates": sorted(self.mutates),
+            "deletes": sorted(self.deletes),
+            "collectives": [s.as_dict() for s in self.collectives],
+            "collective_verdict": self.collective_verdict,
+            "taints": list(self.taints),
+            "host_sync": self.host_sync,
+            "host_sync_in_loop": self.host_sync_in_loop,
+            "pure": self.pure,
+        }
+
+
+def collective_class(report: EffectReport | None) -> str:
+    """The scheduler's three-way admission class for one cell:
+    ``"free"`` (proven collective-free — may overlap anything),
+    ``"bearing"`` (proven collective sites — must run alone among
+    non-free cells), ``"unknown"`` (opaque/tainted — treated like
+    bearing, with the verdict naming the uncertainty)."""
+    if report is None or not report.parsed or report.opaque:
+        return "unknown"
+    if report.collective_verdict == VERDICT_NONE:
+        return "free"
+    if report.collective_verdict == VERDICT_EXACT:
+        return "bearing"
+    return "unknown"
+
+
+# ----------------------------------------------------------------------
+
+
+def _base_name(node: ast.AST) -> str | None:
+    """The root Name of an attribute/call chain:
+    ``jnp.ones(2).sum`` → ``jnp``; non-name bases → None."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def _param_names(args: ast.arguments) -> set[str]:
+    """Every parameter name an ast.arguments node binds."""
+    names = {a.arg for a in (args.args + args.posonlyargs
+                             + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _pattern_names(pattern: ast.AST) -> list[str]:
+    """Capture names bound by a match-case pattern."""
+    out = []
+    for sub in ast.walk(pattern):
+        if isinstance(sub, (ast.MatchAs, ast.MatchStar)) \
+                and sub.name is not None:
+            out.append(sub.name)
+        elif isinstance(sub, ast.MatchMapping) \
+                and sub.rest is not None:
+            out.append(sub.rest)
+    return out
+
+
+class _Walker:
+    """One ordered pass over the module: name footprint, collective
+    footprint, host-sync flags, opacity — all in source order."""
+
+    def __init__(self, assume_unsafe: frozenset = frozenset()):
+        self.reads: set[str] = set()
+        self.writes: set[str] = set()
+        self.mutates: set[str] = set()
+        self.deletes: set[str] = set()
+        self.bound: set[str] = set()      # bound so far at module scope
+        self.sites: list[CollectiveSite] = []
+        self.taints: list[str] = []
+        self.opaque_reasons: list[str] = []
+        self.host_sync = False
+        self.host_sync_in_loop = False
+        self.defs: dict[str, ast.AST] = {}
+        # Ambient names an EARLIER cell in this session rebound/
+        # mutated/deleted: the per-cell assumption that `np`/`time`/
+        # builtins denote their modules no longer holds for them.
+        self._assume_unsafe = frozenset(assume_unsafe)
+        # Names currently assumed to denote collective-free modules;
+        # a safe import adds, any other rebind removes.
+        self._safe_names: set[str] = (set(SAFE_CALL_ROOTS)
+                                      - self._assume_unsafe)
+        # from-imports of a safe module's attribute (`from math import
+        # sqrt`): safe as bare Name calls.
+        self._safe_callables: set[str] = set()
+        # Def names later rebound to something else: calling them is
+        # no longer provably the same-cell def.
+        self._rebound_defs: set[str] = set()
+        # Ambient names this cell re-bound to their REAL modules —
+        # a rebind that restores the assumption instead of breaking it.
+        self._rearmed: set[str] = set()
+        # One-level def resolution depth (recursion guard: a def that
+        # calls itself — or another def — must taint, not recurse).
+        self._depth = 0
+
+    # -- small helpers --------------------------------------------------
+
+    def _read(self, name: str) -> None:
+        if name not in self.bound:
+            self.reads.add(name)
+
+    def _bind(self, name: str) -> None:
+        if name in self.defs and name in self.bound:
+            self._rebound_defs.add(name)
+        self._safe_names.discard(name)
+        self._safe_callables.discard(name)
+        self._rearmed.discard(name)
+        self.writes.add(name)
+        self.bound.add(name)
+
+    def _taint(self, why: str) -> None:
+        if len(self.taints) < _MAX_TAINTS:
+            self.taints.append(why)
+
+    def _opaque(self, why: str) -> None:
+        if why not in self.opaque_reasons:
+            self.opaque_reasons.append(why)
+
+    def _collective_op(self, fn: ast.AST) -> str | None:
+        if isinstance(fn, ast.Name) and fn.id in COLLECTIVE_NAMES:
+            return fn.id
+        if isinstance(fn, ast.Attribute) and fn.attr in COLLECTIVE_NAMES:
+            return fn.attr
+        return None
+
+    # -- module entry ---------------------------------------------------
+
+    def run(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+        self._scan_opacity(tree)
+        self._block(tree.body, loop=0, cond=0)
+
+    def _scan_opacity(self, tree: ast.Module) -> None:
+        """Whole-tree sweep for dynamic namespace escapes — anywhere
+        in the cell, including def bodies (a def is one call away)."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("exec", "eval"):
+                self._opaque(f"{node.func.id}() at L{node.lineno} — "
+                             "dynamic code can touch any name")
+            elif isinstance(node, ast.ImportFrom) \
+                    and any(a.name == "*" for a in node.names):
+                self._opaque(f"star-import at L{node.lineno} binds an "
+                             "unknowable set of names")
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id in _DYNAMIC_NS:
+                self._opaque(
+                    f"{node.value.func.id}()[...] write at "
+                    f"L{node.lineno} escapes the static footprint")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _NS_WRITE_METHODS \
+                    and isinstance(node.func.value, ast.Call) \
+                    and isinstance(node.func.value.func, ast.Name) \
+                    and node.func.value.func.id in _DYNAMIC_NS:
+                self._opaque(
+                    f"{node.func.value.func.id}()."
+                    f"{node.func.attr}(...) at L{node.lineno} escapes "
+                    "the static footprint")
+
+    # -- statements (source order) --------------------------------------
+
+    def _block(self, stmts, *, loop: int, cond: int) -> None:
+        for st in stmts:
+            self._stmt(st, loop=loop, cond=cond)
+
+    def _stmt(self, st: ast.stmt, *, loop: int, cond: int) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in st.decorator_list:
+                self._expr(dec, loop=loop, cond=cond)
+            for d in (list(st.args.defaults)
+                      + [d for d in st.args.kw_defaults
+                         if d is not None]):
+                self._expr(d, loop=loop, cond=cond)
+            self._bind(st.name)
+            self._def_name_footprint(st)
+            return
+        if isinstance(st, ast.ClassDef):
+            for dec in st.decorator_list:
+                self._expr(dec, loop=loop, cond=cond)
+            for b in st.bases:
+                self._expr(b, loop=loop, cond=cond)
+            # The class body EXECUTES at definition time (its calls are
+            # reachable) but binds class attributes, not module names:
+            # route the walk through a bind-sink.
+            saved_bind, self._bind = self._bind, lambda name: None
+            try:
+                self._block(st.body, loop=loop, cond=cond)
+            finally:
+                self._bind = saved_bind
+            self._bind(st.name)
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test, loop=loop, cond=cond)
+            self._block(st.body, loop=loop, cond=cond + 1)
+            self._block(st.orelse, loop=loop, cond=cond + 1)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, loop=loop, cond=cond)
+            self._block(st.body, loop=loop + 1, cond=cond + 1)
+            self._block(st.orelse, loop=loop, cond=cond + 1)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, loop=loop, cond=cond)
+            self._target(st.target)
+            self._block(st.body, loop=loop + 1, cond=cond)
+            self._block(st.orelse, loop=loop, cond=cond)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr, loop=loop, cond=cond)
+                if item.optional_vars is not None:
+                    self._target(item.optional_vars)
+            self._block(st.body, loop=loop, cond=cond)
+            return
+        if isinstance(st, ast.Try):
+            self._block(st.body, loop=loop, cond=cond)
+            for h in st.handlers:
+                if h.type is not None:
+                    self._expr(h.type, loop=loop, cond=cond)
+                if h.name:
+                    self._bind(h.name)
+                self._block(h.body, loop=loop, cond=cond + 1)
+            self._block(st.orelse, loop=loop, cond=cond)
+            self._block(st.finalbody, loop=loop, cond=cond)
+            return
+        if isinstance(st, ast.Match):
+            self._expr(st.subject, loop=loop, cond=cond)
+            for case in st.cases:
+                for name in _pattern_names(case.pattern):
+                    self._bind(name)
+                if case.guard is not None:
+                    self._expr(case.guard, loop=loop, cond=cond)
+                self._block(case.body, loop=loop, cond=cond + 1)
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value, loop=loop, cond=cond)
+            for tgt in st.targets:
+                self._target(tgt)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._expr(st.value, loop=loop, cond=cond)
+            if isinstance(st.target, ast.Name):
+                self._read(st.target.id)   # read-modify-write
+                self._bind(st.target.id)
+            else:
+                self._target(st.target)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value, loop=loop, cond=cond)
+                self._target(st.target)
+            return
+        if isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    self.deletes.add(tgt.id)
+                    # A deleted name is free again for later reads.
+                    self.bound.discard(tgt.id)
+                elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    base = _base_name(tgt)
+                    if base is not None:
+                        self._read(base)
+                        self.mutates.add(base)
+                    self._expr(tgt, loop=loop, cond=cond)
+            return
+        if isinstance(st, ast.Import):
+            for alias in st.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                self._bind(bound)
+                # `import numpy as np` re-arms np as a safe root;
+                # `import jax as np` disarms it (handled by _bind).
+                if alias.name in SAFE_MODULES or (
+                        alias.asname is None
+                        and alias.name.split(".")[0] in SAFE_MODULES):
+                    self._safe_names.add(bound)
+                    self._rearmed.add(bound)
+            return
+        if isinstance(st, ast.ImportFrom):
+            for alias in st.names:
+                if alias.name == "*":
+                    continue      # opacity pass already flagged it
+                bound = alias.asname or alias.name
+                self._bind(bound)
+                mod = st.module or ""
+                if mod in SAFE_MODULES:
+                    # `from math import sqrt`: sqrt() is as inert as
+                    # math.sqrt().  `from jax import numpy as jnp`:
+                    # the ATTR itself is a safe module.
+                    if f"{mod}.{alias.name}" in SAFE_MODULES:
+                        self._safe_names.add(bound)
+                    else:
+                        self._safe_callables.add(bound)
+                    self._rearmed.add(bound)
+                elif f"{mod}.{alias.name}" in SAFE_MODULES:
+                    self._safe_names.add(bound)
+                    self._rearmed.add(bound)
+            return
+        if isinstance(st, ast.Global):
+            # Module-level `global` is a no-op; the def walker handles
+            # the in-function case.
+            return
+        if isinstance(st, (ast.Return, ast.Raise)):
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, loop=loop, cond=cond)
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value, loop=loop, cond=cond)
+            return
+        # Pass/Break/Continue/Nonlocal/etc.: walk any expressions.
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, loop=loop, cond=cond)
+
+    def _target(self, tgt: ast.AST) -> None:
+        """An assignment/for/with target: Names bind the module
+        namespace; attribute/subscript targets mutate the base
+        object (and read its name)."""
+        if isinstance(tgt, ast.Name):
+            self._bind(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._target(el)
+        elif isinstance(tgt, ast.Starred):
+            self._target(tgt.value)
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            base = _base_name(tgt)
+            if base is not None:
+                self._read(base)
+                self.mutates.add(base)
+            # Subscript index / attribute chain still reads names.
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load):
+                    self._read(sub.id)
+
+    # -- expressions ----------------------------------------------------
+
+    def _expr(self, expr: ast.expr, *, loop: int, cond: int,
+              via: str | None = None, depth: int = 0) -> None:
+        """In-order expression walk: reads, walrus binds, nested defs
+        (lambda/comprehension scopes), and call classification."""
+        if expr is None:
+            return
+        if isinstance(expr, ast.Name):
+            if isinstance(expr.ctx, ast.Load):
+                self._read(expr.id)
+            return
+        if isinstance(expr, ast.NamedExpr):
+            self._expr(expr.value, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            if isinstance(expr.target, ast.Name):
+                self._bind(expr.target.id)
+            return
+        if isinstance(expr, ast.Lambda):
+            # Body runs at call time; free names still count as reads
+            # (conservative), but its calls are classified only when
+            # the lambda is called — which the classifier taints.
+            self._lambda_reads(expr)
+            return
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # Comprehensions are their own scope (py3): iteration
+            # targets are not module binds; a host-sync inside one IS
+            # a loop-shaped host sync.
+            self._comp(expr, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            return
+        if isinstance(expr, ast.Call):
+            self._call(expr, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            return
+        if isinstance(expr, ast.IfExp):
+            self._expr(expr.test, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            self._expr(expr.body, loop=loop, cond=cond + 1, via=via,
+                       depth=depth)
+            self._expr(expr.orelse, loop=loop, cond=cond + 1, via=via,
+                       depth=depth)
+            return
+        if isinstance(expr, ast.Await):
+            self._expr(expr.value, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._expr(child, loop=loop, cond=cond, via=via,
+                           depth=depth)
+
+    def _comp(self, comp, *, loop: int, cond: int, via, depth) -> None:
+        local = set()
+        for gen in comp.generators:
+            for sub in ast.walk(gen.target):
+                if isinstance(sub, ast.Name):
+                    local.add(sub.id)
+        saved = self.bound
+        self.bound = saved | local
+        try:
+            for gen in comp.generators:
+                self._expr(gen.iter, loop=loop, cond=cond, via=via,
+                           depth=depth)
+                for cnd in gen.ifs:
+                    self._expr(cnd, loop=loop + 1, cond=cond + 1,
+                               via=via, depth=depth)
+            if isinstance(comp, ast.DictComp):
+                self._expr(comp.key, loop=loop + 1, cond=cond,
+                           via=via, depth=depth)
+                self._expr(comp.value, loop=loop + 1, cond=cond,
+                           via=via, depth=depth)
+            else:
+                self._expr(comp.elt, loop=loop + 1, cond=cond,
+                           via=via, depth=depth)
+        finally:
+            self.bound = saved
+
+    def _lambda_reads(self, lam: ast.Lambda) -> None:
+        params = _param_names(lam.args)
+        for sub in ast.walk(lam.body):
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Load) \
+                    and sub.id not in params:
+                self._read(sub.id)
+
+    # -- call classification --------------------------------------------
+
+    def _call(self, call: ast.Call, *, loop: int, cond: int,
+              via: str | None, depth: int) -> None:
+        # Arguments first (they evaluate before the call).
+        for a in call.args:
+            self._expr(a, loop=loop, cond=cond, via=via, depth=depth)
+        for kw in call.keywords:
+            self._expr(kw.value, loop=loop, cond=cond, via=via,
+                       depth=depth)
+        fn = call.func
+        op = self._collective_op(fn)
+        if op is not None:
+            # The shadow check: `all_reduce = my_fn` earlier makes the
+            # name a user function, not the framework collective — but
+            # the conservative direction is to still record the SITE
+            # (a shadowed collective is at best unknown).
+            self.sites.append(CollectiveSite(
+                op=op, line=call.lineno, in_loop=loop > 0,
+                conditional=cond > 0, via=via))
+            if isinstance(fn, ast.Name):
+                self._read(fn.id)
+            else:
+                self._expr(fn, loop=loop, cond=cond, via=via,
+                           depth=depth)
+            return
+        if isinstance(fn, ast.Name):
+            self._read(fn.id)
+            name = fn.id
+            if name in self.defs and name not in self._rebound_defs:
+                if self._depth == 0:
+                    self._resolve_def(name, loop=loop, cond=cond)
+                else:
+                    self._taint(
+                        f"nested call to `{name}()` (L{call.lineno}) "
+                        f"— same-cell defs resolve one level deep "
+                        f"only")
+                return
+            if name in ("exec", "eval"):
+                return      # opacity pass owns these
+            if name in _DYNAMIC_NS:
+                return      # reads are fine; writes flagged already
+            if name == "print":
+                if loop and any(not isinstance(a, ast.Constant)
+                                for a in call.args):
+                    self.host_sync = True
+                    self.host_sync_in_loop = True
+                return
+            if name in self._safe_callables:
+                return      # from-import of a safe module's attr
+            if name in _BUILTIN_NAMES and name not in self.writes \
+                    and name not in self._assume_unsafe:
+                return      # builtins cannot reach the mesh
+            self._taint(f"calls unvetted function `{name}()` "
+                        f"(L{call.lineno})")
+            return
+        if isinstance(fn, ast.Attribute):
+            base = _base_name(fn)
+            sync = (fn.attr in HOST_SYNC_ATTRS
+                    or fn.attr == "device_get")
+            if sync:
+                self.host_sync = True
+                if loop:
+                    self.host_sync_in_loop = True
+            self._expr(fn.value, loop=loop, cond=cond, via=via,
+                       depth=depth)
+            if fn.attr in _MUTATOR_METHODS:
+                # In-place container mutation: a write to the base
+                # name's object — and inert for the collective verdict
+                # (a custom `.append` that runs a collective is
+                # pathological; `history.append(loss)` cells must stay
+                # provable).
+                if base is not None:
+                    self.mutates.add(base)
+                    self._read(base)
+                return
+            if base is not None and base in self._safe_names:
+                return      # provably inert module root
+            if sync:
+                # .item()/.tolist()/device_get on a possibly-sharded
+                # array gathers across hosts — not provably free.
+                self._taint(
+                    f"host-sync `.{fn.attr}()` (L{call.lineno}) may "
+                    f"gather a cross-host array")
+                return
+            self._taint(f"calls into `.{fn.attr}()` (L{call.lineno}) "
+                        f"— could reach a collective")
+            return
+        # Dynamic callee: subscripted table, lambda result, call chain.
+        self._expr(fn, loop=loop, cond=cond, via=via, depth=depth)
+        self._taint(f"dynamic callee at L{call.lineno} — cannot prove "
+                    f"it collective-free")
+
+    def _resolve_def(self, name: str, *, loop: int, cond: int) -> None:
+        """One level deep through a same-cell def: its body's calls
+        are classified AT THE CALL SITE's position in the top-level
+        order (the collectives it runs happen when it is called).
+        Nested user-function calls inside the body taint instead of
+        recursing (``self._depth``), so a recursive def terminates
+        with an honest ``unknown``."""
+        fndef = self.defs[name]
+        saved = self.bound
+        self.bound = saved | _param_names(fndef.args)
+        self._depth += 1
+        first_new = len(self.sites)
+        try:
+            self._block(fndef.body, loop=loop, cond=cond)
+        finally:
+            self._depth -= 1
+            self.bound = saved
+        # Tag the sites this resolution added with the via name.
+        for site in self.sites[first_new:]:
+            if site.via is None:
+                site.via = name
+
+    # -- def name footprint ---------------------------------------------
+
+    def _def_name_footprint(self, fndef) -> None:
+        """A def's body runs at call time: free names it loads count
+        as reads (conservative), and names it declares ``global`` and
+        assigns escape into the module footprint as writes."""
+        local: set[str] = set(_param_names(fndef.args))
+        glb: set[str] = set()
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Global):
+                glb.update(node.names)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                local.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)):
+                if node is not fndef and getattr(node, "name", None):
+                    local.add(node.name)
+        for g in glb & local:
+            self.writes.add(g)
+        local -= glb
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id not in local \
+                    and node.id not in self.bound:
+                self.reads.add(node.id)
+
+
+# ----------------------------------------------------------------------
+
+
+def ambient_poison(report: EffectReport) -> frozenset:
+    """The ambient names this cell invalidates for LATER cells in the
+    same session: safe roots / builtins it rebinds, mutates, or
+    deletes — feed the union of these into the next cell's
+    ``assume_unsafe``.  A rebind that re-imports the real module
+    (``import numpy as np``) restores the assumption instead of
+    breaking it.  An opaque cell could have rebound anything, so it
+    poisons every ambient assumption at once."""
+    ambient = SAFE_CALL_ROOTS | _BUILTIN_NAMES
+    if not report.parsed or report.opaque:
+        return frozenset(ambient)
+    return frozenset((report.touched & ambient) - report.safe_rearms)
+
+
+def infer_effects(code: str, *,
+                  assume_unsafe: frozenset = frozenset()
+                  ) -> EffectReport:
+    """Infer one cell's :class:`EffectReport`.  Never raises:
+    unreadable source comes back ``parsed=False`` AND ``opaque=True``
+    — the conservative verdict that serializes it under effects
+    admission and poisons the dependency DAG.
+
+    ``assume_unsafe``: ambient names (safe module roots, builtins) an
+    earlier cell in the session rebound — accumulated via
+    :func:`ambient_poison` — whose per-cell safety assumption must
+    not be trusted here.  A cell can re-arm a root by importing the
+    real module itself (``import numpy as np``)."""
+    try:
+        cleaned = strip_ipython(code)
+        tree = ast.parse(cleaned)
+    except (SyntaxError, ValueError, RecursionError):
+        return EffectReport(
+            parsed=False, opaque=True,
+            opaque_reasons=("unparseable source",),
+            collective_verdict=VERDICT_UNKNOWN)
+    w = _Walker(assume_unsafe)
+    try:
+        w.run(tree)
+    except RecursionError:
+        return EffectReport(
+            parsed=False, opaque=True,
+            opaque_reasons=("analysis recursion limit",),
+            collective_verdict=VERDICT_UNKNOWN)
+    opaque = bool(w.opaque_reasons)
+    if opaque or w.taints:
+        verdict = VERDICT_UNKNOWN
+    elif w.sites:
+        verdict = VERDICT_EXACT
+    else:
+        verdict = VERDICT_NONE
+    return EffectReport(
+        parsed=True,
+        opaque=opaque,
+        opaque_reasons=tuple(w.opaque_reasons),
+        reads=frozenset(w.reads),
+        writes=frozenset(w.writes),
+        mutates=frozenset(w.mutates),
+        deletes=frozenset(w.deletes),
+        collectives=tuple(w.sites),
+        collective_verdict=verdict,
+        taints=tuple(w.taints),
+        host_sync=w.host_sync,
+        host_sync_in_loop=w.host_sync_in_loop,
+        safe_rearms=frozenset(w._rearmed))

@@ -14,6 +14,17 @@ returned verbatim (a ``!cmd`` line inside a triple-quoted template is
 DATA, not IPython syntax), and the rewrite pass tracks triple-quote
 state so a string's interior lines are never replaced even in cells
 that genuinely mix multi-line strings with magic lines.
+
+Cell magics (ISSUE 9 satellite): a leading ``%%name`` line governs
+the WHOLE cell in IPython, and which rewrite is right depends on the
+magic.  Python-body cell magics (``%%time``, ``%%capture``,
+``%%prun``, …) execute the remainder as Python — the magic line
+becomes ``pass`` and the rest is vetted normally, so a nested
+``%%time`` first line no longer costs the cell its vetting.
+Non-Python cell magics (``%%bash``, ``%%writefile``, ``%%html``, …)
+treat the remainder as DATA — every line is masked to ``pass`` so the
+cell parses cleanly (and correctly yields zero findings) instead of
+coming back unparseable/unvetted.
 """
 
 from __future__ import annotations
@@ -26,16 +37,34 @@ import re
 _ASSIGN_ESCAPE = re.compile(
     r"^\s*[\w.]+(\s*,\s*[\w.]+)*\s*=\s*[!%]")
 _HELP_SUFFIX = re.compile(r"^[^#'\"]*\?{1,2}\s*$")
-# ``%magic`` / ``%%cellmagic`` need a word character right after the
-# percent(s): a bare ``% b`` could be a wrapped modulo continuation
-# line, which must survive untouched.
+# ``%magic`` lines need a word character right after the percent: a
+# bare ``% b`` could be a wrapped modulo continuation line, which must
+# survive untouched.  ``%%``-leading lines are ALWAYS IPython syntax —
+# no Python statement or continuation can start with ``%%`` (``%`` is
+# a binary operator; two in a row never parse), so even a bare or
+# symbol-led ``%%…`` line is safe to rewrite.
 _MAGIC_PREFIX = re.compile(r"%{1,2}\w")
+
+# Cell magics whose body is NOT Python: the remainder is data for the
+# magic, so the right vetting answer is "parses, nothing to report" —
+# not "unparseable, unvetted".  (Python-body magics — %%time,
+# %%timeit, %%capture, %%prun, %%px, %%distributed, %%rank, and
+# unknown ones by default — keep the remainder and vet it.)
+NON_PYTHON_CELL_MAGICS = frozenset({
+    "bash", "sh", "script", "system", "cmd", "powershell", "perl",
+    "ruby", "js", "javascript", "html", "latex", "svg", "markdown",
+    "writefile", "file", "sql", "pypy", "python2",
+})
+
+_CELL_MAGIC_NAME = re.compile(r"^%%([\w.]+)")
 
 
 def _is_ipython_line(stripped: str) -> bool:
     if not stripped:
         return False
     if stripped.startswith(("!", "?")):
+        return True
+    if stripped.startswith("%%"):
         return True
     if stripped.startswith("%") and _MAGIC_PREFIX.match(stripped):
         return True
@@ -85,6 +114,17 @@ def strip_ipython(source: str) -> str:
         return source
     except (SyntaxError, ValueError):
         pass
+    lines = source.splitlines()
+    first = lines[0].strip() if lines else ""
+    m = _CELL_MAGIC_NAME.match(first)
+    if m and m.group(1).split(".")[0] in NON_PYTHON_CELL_MAGICS:
+        # The whole cell is the magic's (non-Python) payload: mask
+        # every line so the result parses and reports nothing, instead
+        # of the remainder failing ast.parse and blinding the vetting.
+        indent_pass = "\n".join("pass" for _ in lines) or "pass"
+        if source.endswith("\n"):
+            indent_pass += "\n"
+        return indent_pass
     out: list[str] = []
     changed = False
     in_string: str | None = None

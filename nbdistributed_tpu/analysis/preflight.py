@@ -1,13 +1,27 @@
-"""Coordinator-side memory of pre-dispatch lint findings.
+"""Coordinator-side memory of pre-dispatch analysis: lint findings
+and effect footprints.
 
-When the magic layer vets a cell and dispatches it anyway (default
-mode annotates, it does not block), the findings are remembered here,
-keyed by the cell's source hash — the same ``cell_sha1`` the worker
-computes (runtime/collective_guard.cell_hash) and the coordinator now
-stamps on each pending execute request.  If a hang verdict later
-lands on that cell, the watchdog, the stuck-cell doctor, and the
-postmortem bundle all cite the pre-flight finding: "the analyzer told
-you so" is the difference between a mystery hang and a closed loop.
+**Lint findings** (ISSUE 7): when the magic layer vets a cell and
+dispatches it anyway (default mode annotates, it does not block), the
+findings are remembered here, keyed by the cell's source hash — the
+same ``cell_sha1`` the worker computes
+(runtime/collective_guard.cell_hash) and the coordinator stamps on
+each pending execute request.  If a hang verdict later lands on that
+cell, the watchdog, the stuck-cell doctor, and the postmortem bundle
+all cite the pre-flight finding: "the analyzer told you so" is the
+difference between a mystery hang and a closed loop.
+
+**Effect footprints** (ISSUE 9): every dispatched cell's
+:class:`~.effects.EffectReport` summary is recorded by ``cell_sha1``
+too, in *session order* — the substrate for the per-session **cell
+dependency DAG** (:func:`deps_dag`, rendered by ``%dist_lint deps``):
+a write→read edge from cell *i* to a later cell *j* whenever a name
+*i* binds/mutates/deletes is free-read by *j*.  An ``opaque`` cell
+(exec/star-import/globals-write/unparseable) conservatively depends
+on everything before it and gates everything after it (edges named
+``*``).  ROADMAP item 3's async in-flight window is declared against
+exactly this DAG: cell N+1 may stream behind cell N only when no edge
+connects them.
 
 Bounded, process-local, stdlib-only.
 """
@@ -19,8 +33,11 @@ from collections import OrderedDict
 from threading import Lock
 
 _MAX = 256
+_MAX_CELLS = 128          # session-ordered effect entries kept
 _lock = Lock()
 _notes: "OrderedDict[str, dict]" = OrderedDict()
+_cells: list[dict] = []   # dispatched cells, session order
+_seq = 0
 
 
 def summarize(findings) -> str:
@@ -65,5 +82,79 @@ def lookup(cell_sha1: str | None) -> dict | None:
 
 
 def clear() -> None:
+    global _seq
     with _lock:
         _notes.clear()
+        del _cells[:]
+        _seq = 0
+
+
+# ----------------------------------------------------------------------
+# effect footprints + the session dependency DAG (ISSUE 9)
+
+
+def note_effects(cell_sha1: str, report) -> None:
+    """Record one dispatched cell's effect footprint, in session
+    order.  ``report`` is an :class:`~.effects.EffectReport` (or
+    anything with a compatible ``as_dict``)."""
+    global _seq
+    if not cell_sha1:
+        return
+    try:
+        summary = report.as_dict()
+    except Exception:
+        return
+    with _lock:
+        entry = {"seq": _seq, "sha": cell_sha1, "ts": time.time()}
+        entry.update(summary)
+        _seq += 1
+        _cells.append(entry)
+        while len(_cells) > _MAX_CELLS:
+            _cells.pop(0)
+
+
+def effects_log() -> list[dict]:
+    """The session's dispatched-cell footprints, oldest first."""
+    with _lock:
+        return [dict(e) for e in _cells]
+
+
+def effects_for(cell_sha1: str | None) -> dict | None:
+    """The MOST RECENT footprint recorded for this cell hash."""
+    if not cell_sha1:
+        return None
+    with _lock:
+        for e in reversed(_cells):
+            if e["sha"] == cell_sha1:
+                return dict(e)
+    return None
+
+
+def _edge_names(earlier: dict, later: dict) -> list[str]:
+    """Write→read dependency names between two recorded cells, or
+    ``["*"]`` when either side is opaque (whole-namespace poison)."""
+    if earlier.get("opaque") or later.get("opaque"):
+        return ["*"]
+    touched = (set(earlier.get("writes") or ())
+               | set(earlier.get("mutates") or ())
+               | set(earlier.get("deletes") or ()))
+    return sorted(touched & set(later.get("reads") or ()))
+
+
+def deps_dag() -> dict:
+    """The per-session cell dependency DAG: ``nodes`` in session
+    order, ``edges`` as ``{"src": seq_i, "dst": seq_j, "names":
+    [...]}`` for every ordered pair with a write→read dependency
+    (opaque cells connect to everything, names ``["*"]``).  Cell j is
+    safe to overlap/reorder with cell i exactly when no edge joins
+    them — the declared contract for the async in-flight window."""
+    with _lock:
+        cells = [dict(e) for e in _cells]
+    edges = []
+    for j, cj in enumerate(cells):
+        for i in range(j):
+            names = _edge_names(cells[i], cj)
+            if names:
+                edges.append({"src": cells[i]["seq"],
+                              "dst": cj["seq"], "names": names})
+    return {"nodes": cells, "edges": edges}

@@ -18,14 +18,19 @@ project invariant that used to live only in review comments:
 
 3. **thread-shared-state discipline**
    (:func:`check_thread_shared_state`): in classes that own a
-   ``self._lock`` (coordinator, watchdog, supervisor — objects whose
-   fields are touched from supervisor/watchdog/IO threads), every
+   ``self._lock`` (coordinator, watchdog, supervisor, and — since
+   ISSUE 9 — the gateway's daemon/registry/scheduler, whose fields
+   are touched from listener/serve/eviction threads), every
    read-modify-write of ``self`` state (``+=``, container mutation)
    outside a ``with self._lock:`` block is a finding, unless the
    attribute is listed in the module's ``_LINT_SINGLE_WRITER``
    exemption table (the documented single-writer / thread-safe-
    container pattern).  Plain attribute rebinds are allowed — that is
-   the documented atomic-replace pattern.
+   the documented atomic-replace pattern.  A method whose name ends
+   in ``_locked`` ASSERTS its callers hold ``self._lock``: its body
+   is treated as locked, and any call to a ``self.*_locked`` helper
+   from an unlocked context is itself a finding — the convention that
+   lets lock-held helpers stay honest instead of blanket-exempt.
 
 Stdlib-only; every finding carries ``file:line`` so CI output is
 clickable.
@@ -58,6 +63,14 @@ _THREAD_CHECKED_FILES = (
     os.path.join("nbdistributed_tpu", "messaging", "coordinator.py"),
     os.path.join("nbdistributed_tpu", "resilience", "watchdog.py"),
     os.path.join("nbdistributed_tpu", "resilience", "supervisor.py"),
+    # The PR 8 gateway postdated the pass and was exempt by omission
+    # (ISSUE 9 satellite): daemon fields are shared between the
+    # tenant-plane listener thread, per-request serve threads, and
+    # the eviction/manifest threads; the scheduler between every
+    # submitter.
+    os.path.join("nbdistributed_tpu", "gateway", "daemon.py"),
+    os.path.join("nbdistributed_tpu", "gateway", "tenancy.py"),
+    os.path.join("nbdistributed_tpu", "gateway", "scheduler.py"),
 )
 
 
@@ -311,12 +324,15 @@ def _module_exemptions(tree: ast.Module) -> dict[str, str]:
 
 class _ThreadPass(ast.NodeVisitor):
     def __init__(self, relpath: str, cls: str, containers: set[str],
-                 exempt: dict[str, str]):
+                 exempt: dict[str, str], method: str = ""):
         self.relpath = relpath
         self.cls = cls
         self.containers = containers
         self.exempt = exempt
-        self.locked = 0
+        # The `_locked` suffix asserts "caller holds self._lock":
+        # the body is analyzed as locked, and unlocked CALLS to such
+        # helpers are flagged below.
+        self.locked = 1 if method.endswith("_locked") else 0
         self.findings: list[SelfFinding] = []
 
     def _is_exempt(self, attr: str) -> bool:
@@ -382,6 +398,12 @@ class _ThreadPass(ast.NodeVisitor):
             if attr is not None and attr in self.containers:
                 self._flag(node, attr,
                            f"container mutation (.{node.func.attr})")
+        if not self.locked and isinstance(node.func, ast.Attribute) \
+                and node.func.attr.endswith("_locked") \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            self._flag(node, node.func.attr,
+                       "call to a lock-asserting `*_locked` helper")
         self.generic_visit(node)
 
 
@@ -437,7 +459,8 @@ def check_thread_shared_state(root: str) -> list[SelfFinding]:
                 if isinstance(sub, ast.FunctionDef) \
                         and sub.name != "__init__":
                     p = _ThreadPass(rel.replace(os.sep, "/"),
-                                    node.name, containers, exempt)
+                                    node.name, containers, exempt,
+                                    method=sub.name)
                     p.visit(sub)
                     findings.extend(p.findings)
     return findings

@@ -10,7 +10,9 @@ the PR 4 stale-coordinator fence, client side.
 
 The client is deliberately thin: admission, queueing, shedding, and
 parking all happen gateway-side; this class just surfaces the
-explicit verdicts (``on_queued`` fires with the queue position,
+explicit verdicts (``on_queued`` fires with the full backpressure
+notice dict — ``position`` plus, under effects admission, the
+``reason`` naming why the cell was serialized;
 :class:`CellSubmitError` carries a shed/rejected verdict, and
 :meth:`drain` claims parked results exactly once on reattach).
 """
@@ -286,6 +288,9 @@ class TenantClient:
         verdict.  Returns the gateway reply data
         (``{"status": "ok", "results": {rank: result}}``); raises
         :class:`CellSubmitError` on a shed/rejected verdict.
+        ``on_queued(notice)`` fires with the full backpressure notice
+        dict — ``position`` plus, under effects admission, the
+        ``reason`` naming why the cell was serialized.
         ``on_late(data)`` fires if the waiter is interrupted and the
         cell's result arrives later on this connection."""
         payload: dict = {"code": code}
@@ -296,7 +301,7 @@ class TenantClient:
 
         def _notice(n: dict) -> None:
             if on_queued is not None and n.get("status") == "queued":
-                on_queued(n.get("position"))
+                on_queued(dict(n))
 
         reply = self.request(
             "execute", payload, timeout=timeout, on_notice=_notice,

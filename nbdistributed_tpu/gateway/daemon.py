@@ -535,10 +535,65 @@ class GatewayDaemon:
             # reply — until then the tenant must not be evictable.
             self._serve_done(tenant.name)
 
+    def _classify_effects(self, code, tenant) -> str:
+        """The cell's effects-admission class for the scheduler
+        (``free`` / ``bearing`` / ``unknown``), counted in
+        ``nbd_effects_{proven,unknown}_total`` and remembered in the
+        preflight store.  Only called when ``policy.effects`` is on;
+        anything the analyzer cannot read is ``unknown`` — the gate
+        must never promote on a guess.
+
+        Session soundness: a proof is only per-cell if the ambient
+        names it leans on (``np``, ``time``, builtins…) still denote
+        their modules.  A tenant cell that rebinds one poisons the
+        assumption for that tenant's LATER cells
+        (``tenant.ns_unsafe``, fed by ``ambient_poison``) — without
+        this, ``np = weird; np.x(y)`` across two cells would be
+        falsely proven free.  (Stated limit: cells of one tenant
+        submitted concurrently may classify before an in-flight
+        sibling's rebind is recorded; a kernel that awaits each cell
+        — the notebook norm — never hits the window.)"""
+        reg = obs_metrics.registry()
+
+        def count(cls):
+            if cls == "unknown":
+                reg.counter(
+                    "nbd_effects_unknown_total",
+                    "cells whose collective footprint the effect "
+                    "analyzer could not prove (opaque or "
+                    "tainted)").inc()
+            else:
+                reg.counter(
+                    "nbd_effects_proven_total",
+                    "cells with a proven collective footprint",
+                    {"footprint": cls}).inc()
+            return cls
+
+        if not isinstance(code, str):
+            return count("unknown")
+        try:
+            from ..analysis import effects as effects_mod
+            from ..analysis import preflight
+            rep = effects_mod.infer_effects(
+                code, assume_unsafe=tenant.ns_unsafe)
+            cls = effects_mod.collective_class(rep)
+            poison = effects_mod.ambient_poison(rep)
+            with self._lock:
+                if poison:
+                    tenant.ns_unsafe = tenant.ns_unsafe | poison
+            from ..runtime.collective_guard import cell_hash
+            preflight.note_effects(cell_hash(code), rep)
+        except Exception:
+            return count("unknown")
+        return count(cls)
+
     def _serve_execute_inner(self, tenant, msg,
                              submit_cid: int) -> None:
         name = tenant.name
-        tenant.cells_submitted += 1
+        with self._lock:
+            # Serve threads of the SAME tenant run concurrently when
+            # mesh_slots > 1: the counter bumps are read-modify-writes.
+            tenant.cells_submitted += 1
         tenant.last_seen = time.time()
         data = msg.data if isinstance(msg.data, dict) else {
             "code": msg.data}
@@ -554,6 +609,9 @@ class GatewayDaemon:
         except (TypeError, ValueError):
             prio = tenant.priority
         reg = obs_metrics.registry()
+        eff_cls = ("unknown" if not self.policy.effects
+                   else self._classify_effects(data.get("code"),
+                                               tenant))
 
         def on_verdict(ticket):
             v = ticket.verdict
@@ -563,20 +621,34 @@ class GatewayDaemon:
                 reg.counter("nbd_tenant_queued_total",
                             "tenant cells that waited in the pool "
                             "queue", {"tenant": name}).inc()
+                reason = v.get("reason")
+                if reason:
+                    # Effects admission held the cell while slots were
+                    # free: proof-gated serialization, named.
+                    reg.counter(
+                        "nbd_effects_serialized_total",
+                        "cells serialized by effects admission "
+                        "(unproven overlap)", {"tenant": name}).inc()
+                    self.flight.record("effects_serialized",
+                                       tenant=name, msg_id=msg.msg_id,
+                                       reason=reason)
                 # Only the SUBMITTING connection understands this
                 # msg_id; after a reattach the notice is just noise.
                 if tenant.client_id == submit_cid:
-                    self._send_to_client(submit_cid, msg.reply(
-                        msg_type="queued",
-                        data={"status": "queued",
+                    notice = {"status": "queued",
                               "position": v.get("position"),
-                              "msg_id": msg.msg_id}))
+                              "msg_id": msg.msg_id}
+                    if reason:
+                        notice["reason"] = reason
+                    self._send_to_client(submit_cid, msg.reply(
+                        msg_type="queued", data=notice))
 
         status = "ok"
         try:
             resps = self.comm.send_to_ranks(
                 ranks, "execute", data, tenant=name, priority=prio,
                 msg_id=msg.msg_id, on_verdict=on_verdict,
+                collective=eff_cls,
                 timeout=self.request_timeout)
             results = {str(r): m.data for r, m in resps.items()}
             if any(isinstance(d, dict) and d.get("error")
@@ -606,9 +678,11 @@ class GatewayDaemon:
                                     "error": f"{type(e).__name__}: "
                                              f"{e}"})
         if status == "ok":
-            tenant.cells_done += 1
+            with self._lock:
+                tenant.cells_done += 1
         elif status == "error":
-            tenant.cells_failed += 1
+            with self._lock:
+                tenant.cells_failed += 1
         reg.counter("nbd_tenant_cells_total",
                     "tenant cells by terminal status",
                     {"tenant": name, "status": status}).inc()
@@ -861,6 +935,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--mesh-slots", type=int, default=None)
     p.add_argument("--queue-depth", type=int, default=None)
     p.add_argument("--tenant-inflight", type=int, default=None)
+    p.add_argument("--effects", action="store_true", default=None,
+                   help="effects-aware admission: with mesh slots > 1 "
+                        "only cells proven collective-free may "
+                        "overlap a collective-bearing cell "
+                        "(NBD_POOL_SCHED_EFFECTS)")
     p.add_argument("--request-timeout", type=float, default=None)
     p.add_argument("--attach-timeout", type=float, default=180.0)
     args = p.parse_args(argv)
@@ -876,6 +955,8 @@ def main(argv: list[str] | None = None) -> int:
         policy.queue_depth = max(0, args.queue_depth)
     if args.tenant_inflight is not None:
         policy.tenant_inflight = max(0, args.tenant_inflight)
+    if args.effects:
+        policy.effects = True
 
     # Handlers BEFORE construction: spawning the workers is exactly
     # the window where a fleet exists but no handler did — a SIGTERM

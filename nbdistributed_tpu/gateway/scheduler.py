@@ -34,6 +34,25 @@ Scheduling policy (``mode``): ``"fifo"`` dispatches in arrival order;
 the tenant that has been served least, then arrival order — so an
 interactive tenant's occasional cells interleave with a batch tenant's
 flood instead of starving behind it.
+
+**Effects-aware admission** (``NBD_POOL_SCHED_EFFECTS=1``, ISSUE 9):
+with more than one mesh slot, every submit carries its cell's
+collective class from :mod:`..analysis.effects` — ``"free"`` (proven
+collective-free), ``"bearing"`` (statically enumerable collective
+sites), or ``"unknown"`` (opaque/tainted).  Only *proven*-free cells
+may overlap a non-free cell: at most one bearing/unknown cell holds
+the mesh at a time, because two concurrent collective streams carry no
+cross-rank ordering and can pair mismatched (the PR 8 hazard this gate
+retires).  A cell held back while slots are free gets an explicit
+``{"status": "queued", "reason": "serialized: …"}`` verdict naming
+why, and proven-free cells promote AROUND held cells — overlap is the
+point.  With effects off (the default) or a serial mesh
+(``mesh_slots=1``), the gate is inert and behavior is exactly
+pre-ISSUE-9.
+
+Thread discipline: helper methods suffixed ``_locked`` assert their
+callers hold ``self._lock`` — the self-lint's thread pass treats their
+bodies as locked and flags any call to them from an unlocked context.
 """
 
 from __future__ import annotations
@@ -77,10 +96,12 @@ class SchedPolicy:
     bound — the single-kernel default is all-unlimited FIFO, which
     reproduces pre-gateway behavior exactly."""
 
-    __slots__ = ("mode", "mesh_slots", "tenant_inflight", "queue_depth")
+    __slots__ = ("mode", "mesh_slots", "tenant_inflight", "queue_depth",
+                 "effects")
 
     def __init__(self, mode: str = "fifo", mesh_slots: int = 0,
-                 tenant_inflight: int = 0, queue_depth: int = 0):
+                 tenant_inflight: int = 0, queue_depth: int = 0,
+                 effects: bool = False):
         if mode not in ("fifo", "fair"):
             raise ValueError(f"unknown scheduler mode {mode!r} "
                              "(fifo|fair)")
@@ -88,6 +109,7 @@ class SchedPolicy:
         self.mesh_slots = max(0, int(mesh_slots))
         self.tenant_inflight = max(0, int(tenant_inflight))
         self.queue_depth = max(0, int(queue_depth))
+        self.effects = bool(effects)
 
     @classmethod
     def pool_from_env(cls, env=None) -> "SchedPolicy":
@@ -106,12 +128,15 @@ class SchedPolicy:
             tenant_inflight=knobs.get_int("NBD_TENANT_MAX_INFLIGHT", 8,
                                           env=env),
             queue_depth=knobs.get_int("NBD_POOL_QUEUE_DEPTH", 64,
-                                      env=env))
+                                      env=env),
+            effects=knobs.get_bool("NBD_POOL_SCHED_EFFECTS", False,
+                                   env=env))
 
     def describe(self) -> dict:
         return {"mode": self.mode, "mesh_slots": self.mesh_slots,
                 "tenant_inflight": self.tenant_inflight,
-                "queue_depth": self.queue_depth}
+                "queue_depth": self.queue_depth,
+                "effects": self.effects}
 
 
 class Ticket:
@@ -120,10 +145,10 @@ class Ticket:
     check ``state`` after the wait."""
 
     __slots__ = ("tenant", "msg_id", "priority", "seq", "state",
-                 "enqueued_at", "verdict", "event")
+                 "enqueued_at", "verdict", "event", "collective")
 
     def __init__(self, tenant: str, msg_id: str, priority: int,
-                 seq: int, now: float):
+                 seq: int, now: float, collective: str = "unknown"):
         self.tenant = tenant
         self.msg_id = msg_id
         self.priority = priority
@@ -132,6 +157,10 @@ class Ticket:
         self.enqueued_at = now
         self.verdict: dict = {}
         self.event = threading.Event()
+        # Effects-admission class: "free" | "bearing" | "unknown"
+        # (analysis/effects.collective_class); only consulted when the
+        # policy's effects gate is armed.
+        self.collective = collective
 
 
 class _TenantStats:
@@ -166,22 +195,50 @@ class Scheduler:
         self._active: dict[str, Ticket] = {}    # msg_id -> ticket
         self._tenants: dict[str, _TenantStats] = {}
         self.shed_total = 0
+        # Submissions held back by the effects gate while slots were
+        # free (the "serialized: …" verdicts).
+        self.effects_serialized_total = 0
 
     # ------------------------------------------------------------------
+    # `_locked` suffix = caller holds self._lock (self-lint-enforced).
 
-    def _stats(self, tenant: str) -> _TenantStats:
+    def _stats_locked(self, tenant: str) -> _TenantStats:
         st = self._tenants.get(tenant)
         if st is None:
             st = self._tenants[tenant] = _TenantStats()
         return st
 
-    def _slots_free(self) -> bool:
+    def _slots_free_locked(self) -> bool:
         return (not self.policy.mesh_slots
                 or len(self._active) < self.policy.mesh_slots)
 
-    def _grant(self, t: Ticket) -> None:
-        # Lock held.  QUEUED/fresh -> ACTIVE.
-        st = self._stats(t.tenant)
+    def _effects_ok_locked(self, t: Ticket) -> bool:
+        """May this cell take a slot NOW, under effects admission?
+        Proven-free cells overlap anything; a bearing/unknown cell
+        needs every active cell to be proven free (at most one
+        non-free collective stream on the mesh).  Inert when the gate
+        is off or the mesh is serial anyway."""
+        if not self.policy.effects or self.policy.mesh_slots == 1:
+            return True
+        if t.collective == "free":
+            return True
+        return all(a.collective == "free"
+                   for a in self._active.values())
+
+    @staticmethod
+    def _serialized_reason(t: Ticket) -> str:
+        if t.collective == "bearing":
+            return ("serialized: collective-bearing cell — another "
+                    "collective-bearing (or unproven) cell holds the "
+                    "mesh, and concurrent collective streams can pair "
+                    "mismatched across ranks")
+        return ("serialized: collective footprint unknown — only "
+                "cells proven collective-free may overlap a running "
+                "collective-bearing cell")
+
+    def _grant_locked(self, t: Ticket) -> None:
+        # QUEUED/fresh -> ACTIVE.
+        st = self._stats_locked(t.tenant)
         if t.state == QUEUED and t in self._queue:
             self._queue.remove(t)
             st.queued -= 1
@@ -191,11 +248,11 @@ class Scheduler:
         self._active[t.msg_id] = t
         t.event.set()
 
-    def _shed_ticket(self, t: Ticket) -> None:
-        # Lock held.  QUEUED -> SHED, visible verdict, event fired.
+    def _shed_locked(self, t: Ticket) -> None:
+        # QUEUED -> SHED, visible verdict, event fired.
         if t in self._queue:
             self._queue.remove(t)
-        st = self._stats(t.tenant)
+        st = self._stats_locked(t.tenant)
         st.queued -= 1
         st.shed += 1
         self.shed_total += 1
@@ -204,33 +261,38 @@ class Scheduler:
                      "tenant": t.tenant, "msg_id": t.msg_id}
         t.event.set()
 
-    def _pick_next(self) -> Ticket | None:
-        # Lock held.  FIFO: arrival order.  Fair: highest priority,
-        # then least-served tenant, then arrival order.
-        if not self._queue:
+    def _pick_next_locked(self) -> Ticket | None:
+        # FIFO: arrival order.  Fair: highest priority, then
+        # least-served tenant, then arrival order.  Under effects
+        # admission only COMPATIBLE tickets are eligible — a proven-
+        # free cell promotes around a held bearing/unknown cell
+        # (overlap is the point of the gate).
+        eligible = [t for t in self._queue
+                    if self._effects_ok_locked(t)]
+        if not eligible:
             return None
         if self.policy.mode == "fifo":
-            return self._queue[0]
-        return min(self._queue,
+            return eligible[0]
+        return min(eligible,
                    key=lambda t: (-t.priority,
-                                  self._stats(t.tenant).served,
+                                  self._stats_locked(t.tenant).served,
                                   t.seq))
 
-    def _promote(self) -> list[Ticket]:
-        # Lock held.  Fill free slots from the queue.
+    def _promote_locked(self) -> list[Ticket]:
+        # Fill free slots from the queue.
         promoted = []
-        while self._queue and self._slots_free():
-            t = self._pick_next()
+        while self._queue and self._slots_free_locked():
+            t = self._pick_next_locked()
             if t is None:
                 break
-            self._grant(t)
+            self._grant_locked(t)
             promoted.append(t)
         return promoted
 
     # ------------------------------------------------------------------
 
-    def submit(self, tenant: str, msg_id: str,
-               priority: int = 0) -> Ticket:
+    def submit(self, tenant: str, msg_id: str, priority: int = 0,
+               collective: str = "unknown") -> Ticket:
         """Admit one cell.  The returned ticket's ``verdict`` is one
         of::
 
@@ -240,16 +302,22 @@ class Scheduler:
             {"status": "shed", "reason": "overload",  # queue full and
              ...}                                     # this was lowest
 
-        A queued submit that later loses a shedding decision flips to
-        SHED and fires its event — the waiter must re-check ``state``.
-        ``verdict`` may also carry ``"victims"``: JSON-safe summaries
-        (``{"tenant", "msg_id", "priority"}``) of OTHER submitters'
-        cells this admission shed.  Informational only — each victim's
-        own blocked submit thread is what delivers its shed verdict."""
+        ``collective`` is the cell's effects-admission class
+        (``analysis.effects.collective_class``); under an armed
+        effects gate, a non-free cell that cannot overlap the active
+        set queues with ``"reason": "serialized: …"`` even when slots
+        are free.  A queued submit that later loses a shedding
+        decision flips to SHED and fires its event — the waiter must
+        re-check ``state``.  ``verdict`` may also carry ``"victims"``:
+        JSON-safe summaries (``{"tenant", "msg_id", "priority"}``) of
+        OTHER submitters' cells this admission shed.  Informational
+        only — each victim's own blocked submit thread is what
+        delivers its shed verdict."""
         now = self._now()
         with self._lock:
-            st = self._stats(tenant)
-            t = Ticket(tenant, msg_id, int(priority), self._seq, now)
+            st = self._stats_locked(tenant)
+            t = Ticket(tenant, msg_id, int(priority), self._seq, now,
+                       collective)
             self._seq += 1
             cap = self.policy.tenant_inflight
             if cap and st.queued + st.active >= cap:
@@ -264,11 +332,18 @@ class Scheduler:
                              "limit": cap, "tenant": tenant}
                 t.event.set()
                 return t
-            if self._slots_free() and not self._queue:
-                self._grant(t)
-                t.verdict = dict(_DISPATCH)
-                return t
-            # Mesh busy: queue with an explicit position reply.
+            serialized = None
+            if self._slots_free_locked() and not self._queue:
+                if self._effects_ok_locked(t):
+                    self._grant_locked(t)
+                    t.verdict = dict(_DISPATCH)
+                    return t
+                # Slots free, but overlap is unproven-safe: serialize
+                # with a verdict naming the reason.
+                serialized = self._serialized_reason(t)
+                self.effects_serialized_total += 1
+            # Mesh busy (or effects-held): queue with an explicit
+            # position reply.
             self._queue.append(t)
             st.queued += 1
             victims: list[dict] = []
@@ -279,7 +354,7 @@ class Scheduler:
                 # higher-priority work survives.
                 victim = max(self._queue,
                              key=lambda q: (-q.priority, q.seq))
-                self._shed_ticket(victim)
+                self._shed_locked(victim)
                 if victim is not t:
                     victims.append({"tenant": victim.tenant,
                                     "msg_id": victim.msg_id,
@@ -290,8 +365,24 @@ class Scheduler:
                 return t
             t.verdict = {"status": "queued",
                          "position": self._queue.index(t) + 1}
+            if serialized:
+                t.verdict["reason"] = serialized
             if victims:
                 t.verdict["victims"] = victims
+            # A compatible cell may still fit a free slot even though
+            # the queue is non-empty (effects-held cells in front of
+            # it): promotion grants it — and, under the gate, lets
+            # proven-free work overlap instead of convoying.  Only
+            # THIS ticket can be granted here (no slot was freed, so
+            # nothing else became eligible); if it was, the queued
+            # verdict is stale — the submitter must see a plain
+            # dispatch, not a backpressure notice for a cell that
+            # never waited.
+            self._promote_locked()
+            if t.state == ACTIVE:
+                t.verdict = dict(_DISPATCH)
+                if victims:
+                    t.verdict["victims"] = victims
             return t
 
     def complete(self, msg_id: str) -> list[Ticket]:
@@ -302,10 +393,10 @@ class Scheduler:
             t = self._active.pop(msg_id, None)
             if t is not None:
                 t.state = DONE
-                st = self._stats(t.tenant)
+                st = self._stats_locked(t.tenant)
                 st.active -= 1
                 st.completed += 1
-            return self._promote()
+            return self._promote_locked()
 
     def cancel(self, msg_id: str) -> bool:
         """Withdraw a queued or active cell (submitter timeout / tenant
@@ -315,14 +406,14 @@ class Scheduler:
             t = self._active.pop(msg_id, None)
             if t is not None:
                 t.state = DONE
-                st = self._stats(t.tenant)
+                st = self._stats_locked(t.tenant)
                 st.active -= 1
-                self._promote()
+                self._promote_locked()
                 return True
             for t in self._queue:
                 if t.msg_id == msg_id:
                     self._queue.remove(t)
-                    self._stats(t.tenant).queued -= 1
+                    self._stats_locked(t.tenant).queued -= 1
                     t.state = DONE
                     t.event.set()
                     return True
@@ -365,6 +456,8 @@ class Scheduler:
                 "queued": len(self._queue),
                 "active": len(self._active),
                 "shed_total": self.shed_total,
+                "effects_serialized_total":
+                    self.effects_serialized_total,
                 "tenants": {k: v.as_dict()
                             for k, v in sorted(self._tenants.items())},
             }

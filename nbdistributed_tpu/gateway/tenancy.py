@@ -39,7 +39,7 @@ class Tenant:
     __slots__ = ("name", "token", "epoch", "client_id", "mailbox",
                  "priority", "admitted_ts", "last_seen", "reattaches",
                  "cells_submitted", "cells_done", "cells_failed",
-                 "parked_total")
+                 "parked_total", "ns_unsafe")
 
     def __init__(self, name: str, token: str, priority: int = 0):
         self.name = name
@@ -48,6 +48,11 @@ class Tenant:
         self.client_id: int | None = None   # live tenant-plane conn
         self.mailbox = ResultMailbox()      # this tenant's partition
         self.priority = int(priority)
+        # Ambient names (np/time/builtins…) a dispatched cell of THIS
+        # tenant rebound: the effect analyzer must not prove a later
+        # cell collective-free on the assumption they still denote
+        # their modules (analysis/effects.ambient_poison).
+        self.ns_unsafe: frozenset = frozenset()
         self.admitted_ts = time.time()
         self.last_seen = time.time()
         self.reattaches = 0

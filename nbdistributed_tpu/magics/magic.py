@@ -68,7 +68,9 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_doctor (stuck-cell report: skew table, stacks, flight tails) ·
 %dist_lint warn|strict|off (pre-dispatch cell vetting: rank-conditional
 collectives, subset hazards, host-syncs in loops — strict blocks
-error-severity cells; also %%distributed --strict per cell) ·
+error-severity cells; also %%distributed --strict per cell;
+deps|effects render the session's inferred cell effect footprints
+and write→read dependency DAG) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
 %dist_pool start|status|stop (shared multi-tenant worker pool;
@@ -1059,6 +1061,10 @@ class DistributedMagics(Magics):
     @argument("--mesh-slots", type=int, default=None)
     @argument("--queue-depth", type=int, default=None)
     @argument("--tenant-inflight", type=int, default=None)
+    @argument("--effects", action="store_true",
+              help="effects-aware admission: with --mesh-slots > 1, "
+                   "only cells PROVEN collective-free may overlap a "
+                   "collective-bearing cell (NBD_POOL_SCHED_EFFECTS)")
     @argument("--start-timeout", type=float, default=240.0,
               help="seconds to wait for the daemon's readiness line")
     @line_magic
@@ -1096,6 +1102,8 @@ class DistributedMagics(Magics):
                              args.tenant_inflight)):
                 if v is not None:
                     cmd += [flag, str(v)]
+            if args.effects:
+                cmd += ["--effects"]
             import os as _os
             env = dict(_os.environ)
             env.pop("NBD_RUN_DIR", None)  # the daemon owns its own
@@ -1267,8 +1275,11 @@ class DistributedMagics(Magics):
             data = client.execute(
                 code, priority=priority, deadline_s=deadline_s,
                 timeout=None,
-                on_queued=lambda pos: print(
-                    f"⏳ pool busy — queued at position {pos}"),
+                on_queued=lambda n: print(
+                    f"⏳ pool busy — queued at position "
+                    f"{n.get('position')}"
+                    + (f"\n   🚧 {n['reason']}" if n.get("reason")
+                       else "")),
                 on_late=_late)
         except CellSubmitError as e:
             v = e.verdict
@@ -1792,6 +1803,21 @@ class DistributedMagics(Magics):
         mode = (_knobs.get_str("NBD_LINT", "warn") or "warn").lower()
         return mode if mode in ("warn", "strict", "off") else "warn"
 
+    @staticmethod
+    def _note_effects(code: str) -> None:
+        """Record a dispatched cell's effect footprint in the
+        preflight store (ISSUE 9): the substrate of the session
+        dependency DAG ``%dist_lint deps`` renders and the async
+        in-flight window will consult.  Best effort — effect
+        inference must never break dispatch."""
+        try:
+            from ..analysis import infer_effects, preflight
+            from ..runtime.collective_guard import cell_hash
+            preflight.note_effects(cell_hash(code),
+                                   infer_effects(code))
+        except Exception:
+            pass
+
     def _vet_cell(self, code: str, ranks: list[int], *,
                   strict: bool = False) -> bool:
         """Statically vet a cell BEFORE ``send_to_ranks`` (the ISSUE 7
@@ -1802,7 +1828,9 @@ class DistributedMagics(Magics):
         only under ``--strict`` / ``%dist_lint strict``.  Returns
         False when the cell must not ship.  Unparseable source NEVER
         blocks — it degrades to the legacy regex warning for subset
-        cells and dispatches."""
+        cells and dispatches.  Every cell that WILL dispatch also gets
+        its effect footprint recorded (``_note_effects``); ``off``
+        mode skips analysis entirely, effect tracking included."""
         mode = self._lint_mode_now()
         if mode == "off" and not strict:
             return True  # an explicit per-cell --strict still vets
@@ -1819,8 +1847,12 @@ class DistributedMagics(Magics):
                       f"ranks {ranks} of {self._world}. A collective "
                       "run by a subset deadlocks the mesh; %sync can "
                       "realign after errors.")
+            # Unparseable cells still dispatch — their footprint is
+            # OPAQUE, which poisons the dependency DAG on purpose.
+            self._note_effects(code)
             return True
         if not res.findings:
+            self._note_effects(code)
             return True
         from ..analysis import preflight
         from ..observability import flightrec
@@ -1847,11 +1879,56 @@ class DistributedMagics(Magics):
         # verdict / %dist_doctor / postmortem on this cell cites the
         # pre-flight warning (resilience/watchdog.py).
         preflight.note(sha, res.findings)
+        self._note_effects(code)
         return True
+
+    @staticmethod
+    def _render_effects_entry(e: dict, *, verbose: bool) -> str:
+        """One dispatched cell's footprint as a compact line."""
+        col = e.get("collective_verdict", "?")
+        n = len(e.get("collectives") or ())
+        if col == "exact":
+            col = f"exact({n})"
+        flags = []
+        if e.get("opaque"):
+            flags.append("OPAQUE")
+        if e.get("host_sync_in_loop"):
+            flags.append("host-sync-loop")
+        elif e.get("host_sync"):
+            flags.append("host-sync")
+        if e.get("pure"):
+            flags.append("pure")
+
+        def names(key, cap=6):
+            vals = list(e.get(key) or ())
+            if not vals:
+                return "∅"
+            shown = ", ".join(vals[:cap])
+            extra = len(vals) - cap
+            return shown + (f" +{extra}" if extra > 0 else "")
+
+        line = (f"#{e['seq']} {e['sha'][:8]} · collectives={col}"
+                + (f" [{' '.join(flags)}]" if flags else ""))
+        if verbose:
+            line += (f"\n      writes {names('writes')} · mutates "
+                     f"{names('mutates')} · dels {names('deletes')}"
+                     f"\n      reads  {names('reads', 8)}")
+            sites = e.get("collectives") or ()
+            if sites:
+                line += "\n      order  " + " → ".join(
+                    f"{s['op']}@L{s['line']}"
+                    + (f"(via {s['via']})" if s.get("via") else "")
+                    for s in sites[:8])
+            for t in (e.get("taints") or ())[:3]:
+                line += f"\n      ? {t}"
+            for r in (e.get("opaque_reasons") or ())[:3]:
+                line += f"\n      ! {r}"
+        return line
 
     @magic_arguments()
     @argument("command", nargs="?", default="status",
-              choices=["strict", "warn", "off", "status"])
+              choices=["strict", "warn", "off", "status", "deps",
+                       "effects"])
     @line_magic
     def dist_lint(self, line):
         """Pre-dispatch SPMD cell vetting: every ``%%distributed`` /
@@ -1866,8 +1943,48 @@ class DistributedMagics(Magics):
         annotates, ``strict`` blocks error-severity cells,
         ``off`` disables; the NBD_LINT env knob sets the session
         default, and ``%%distributed --strict`` arms strict for one
-        cell.  Never blocks on unparseable source."""
+        cell.  Never blocks on unparseable source.
+
+        ``%dist_lint effects`` lists each dispatched cell's inferred
+        effect footprint (reads/writes, ordered collective sites,
+        opacity); ``%dist_lint deps`` renders the session cell
+        dependency DAG (write→read edges) — the substrate for
+        effects-aware pool scheduling and async dispatch."""
         args = parse_argstring(self.dist_lint, line)
+        if args.command in ("deps", "effects"):
+            from ..analysis import preflight
+            entries = preflight.effects_log()
+            if not entries:
+                print("🔎 no dispatched cells recorded this session "
+                      "(effect footprints are captured at dispatch; "
+                      "%dist_lint off disables them)")
+                return
+            if args.command == "effects":
+                print(f"🔎 effect footprints — {len(entries)} "
+                      f"dispatched cell(s), oldest first:")
+                for e in entries:
+                    print("  " + self._render_effects_entry(
+                        e, verbose=True))
+                return
+            dag = preflight.deps_dag()
+            by_dst: dict = {}
+            for edge in dag["edges"]:
+                by_dst.setdefault(edge["dst"], []).append(edge)
+            print(f"🔎 cell dependency DAG — {len(dag['nodes'])} "
+                  f"cell(s), {len(dag['edges'])} write→read edge(s):")
+            for e in dag["nodes"]:
+                print("  " + self._render_effects_entry(
+                    e, verbose=False))
+                for edge in by_dst.get(e["seq"], ()):
+                    names = ", ".join(edge["names"][:6])
+                    extra = len(edge["names"]) - 6
+                    if extra > 0:
+                        names += f" +{extra}"
+                    print(f"      ← #{edge['src']} via {{{names}}}")
+            if not dag["edges"]:
+                print("   (no edges: every recorded cell is "
+                      "independent — safe to overlap)")
+            return
         if args.command == "status":
             mode = self._lint_mode_now()
             src = ("pinned by %dist_lint"

@@ -359,7 +359,9 @@ class CommunicationManager:
                       timeout: float | None = ...,
                       tenant: str | None = None, priority: int = 0,
                       msg_id: str | None = None,
-                      on_verdict=None) -> dict[int, Message]:
+                      on_verdict=None,
+                      collective: str = "unknown"
+                      ) -> dict[int, Message]:
         """Send one request to ``ranks`` and collect their responses.
 
         ``timeout=...`` (unset) uses the manager default; ``None`` waits
@@ -386,7 +388,10 @@ class CommunicationManager:
         (worker-side namespace routing + blame attribution) and is the
         scheduler's accounting key; ``msg_id`` pins the outgoing id so
         a gateway can keep tenant-side and worker-side correlation ids
-        identical end to end.
+        identical end to end.  ``collective`` is the cell's effects-
+        admission class (``analysis.effects.collective_class``: free /
+        bearing / unknown) — consulted only when the scheduler's
+        effects gate is armed (ISSUE 9).
         """
         if timeout is ...:
             timeout = self.default_timeout
@@ -406,7 +411,8 @@ class CommunicationManager:
         ticket = None
         if msg_type == "execute":
             ticket = self.scheduler.submit(tenant or "local",
-                                           msg.msg_id, priority)
+                                           msg.msg_id, priority,
+                                           collective=collective)
             if on_verdict is not None:
                 try:
                     on_verdict(ticket)

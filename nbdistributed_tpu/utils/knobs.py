@@ -119,10 +119,16 @@ _ALL = (
        "served tenant) or fifo (arrival order).", "pool"),
     _k("NBD_POOL_MESH_SLOTS", "1", "int",
        "Concurrent cells the pooled mesh runs (0 = unlimited; the "
-       "single-kernel path always runs unlimited).  >1 is only safe "
-       "for collective-FREE cells: concurrent broadcasts carry no "
-       "cross-rank ordering, so two tenants' collectives can pair "
-       "up mismatched and hang the shared mesh.", "pool"),
+       "single-kernel path always runs unlimited).  >1 overlaps "
+       "cells, which is only safe when at most one of them can run "
+       "collectives — arm NBD_POOL_SCHED_EFFECTS so the effect "
+       "analyzer PROVES it instead of you assuming it.", "pool"),
+    _k("NBD_POOL_SCHED_EFFECTS", "0", "bool",
+       "Effects-aware admission (analysis/effects.py): with more "
+       "than one mesh slot, only cells proven collective-free may "
+       "overlap a collective-bearing cell; unknown/opaque cells "
+       "serialize with an explicit 'serialized: ...' verdict naming "
+       "the reason.", "pool"),
     _k("NBD_POOL_QUEUE_DEPTH", "64", "int",
        "Queued-cell bound before the pool sheds the lowest-priority "
        "queued cell with a visible verdict (0 = unbounded).", "pool"),

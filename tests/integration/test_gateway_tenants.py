@@ -160,7 +160,8 @@ def test_sigkill_tenant_mid_cell_isolation_and_redelivery(pool):
             try:
                 results.append(b.execute(
                     "b_hits += 1\nb_hits",
-                    on_queued=lambda p: positions.append(p)))
+                    on_queued=lambda n: positions.append(
+                        n.get("position"))))
             except Exception as e:            # noqa: BLE001
                 errors.append(e)
 

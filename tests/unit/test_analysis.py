@@ -1,12 +1,16 @@
-"""Static-analysis tests (ISSUE 7): the pre-dispatch SPMD cell
+"""Static-analysis tests (ISSUES 7 + 9): the pre-dispatch SPMD cell
 analyzer (rule-by-rule, plus the never-block-on-unparseable contract),
 the IPython source-stripping helper, the preflight finding memory, the
-env-knob registry accessors, and the framework self-lint passes —
-including the acceptance gates: the PR 5 frozen-rank hang cell is an
-error pre-dispatch, the analyzer has zero error-severity false
-positives over the examples/ notebooks and the selftest corpus, and
-``run_self_lint`` is clean over this very checkout (the CI
-``static-analysis`` job as a test)."""
+env-knob registry accessors, the framework self-lint passes, and the
+ISSUE 9 effect-inference engine (name/collective footprints, opacity,
+the session dependency DAG) — including the acceptance gates: the
+PR 5 frozen-rank hang cell is an error pre-dispatch AND carries a
+non-empty ordered collective footprint, the analyzer has zero
+error-severity false positives over the examples/ notebooks and the
+selftest corpus, every one of those cells gets a parseable non-opaque
+EffectReport, and ``run_self_lint`` is clean over this very checkout
+(the CI ``static-analysis`` job as a test) — now covering the gateway
+classes and the ``_locked`` helper convention."""
 
 import ast
 import json
@@ -16,6 +20,8 @@ import pytest
 
 from nbdistributed_tpu.analysis import (cellcheck, ipycompat, preflight,
                                         strip_ipython, vet_cell)
+from nbdistributed_tpu.analysis.effects import (collective_class,
+                                                infer_effects)
 from nbdistributed_tpu.analysis.selfcheck import (_ThreadPass,
                                                   check_env_knobs,
                                                   run_self_lint)
@@ -695,6 +701,504 @@ def test_magic_lint_mode_resolution(magic, monkeypatch):
     assert DistributedMagics._lint_mode_now() == "warn"
     DistributedMagics._lint_mode = "off"       # %dist_lint pin wins
     assert DistributedMagics._lint_mode_now() == "off"
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9: effect inference — name footprint
+
+
+def test_name_footprint_binds_mutations_deletes():
+    r = infer_effects("x = a + b\n"
+                      "c.cfg = 2\n"
+                      "d[k] = 3\n"
+                      "lst.append(9)\n"
+                      "e += 1\n"
+                      "del f\n")
+    assert r.parsed and not r.opaque
+    assert {"a", "b", "c", "d", "e", "k", "lst"} <= r.reads
+    assert r.writes == {"x", "e"}
+    assert r.mutates == {"c", "d", "lst"}
+    assert r.deletes == {"f"}
+    # touched = the DAG's write side.
+    assert r.touched == {"x", "e", "c", "d", "lst", "f"}
+
+
+def test_footprint_free_reads_exclude_cell_local_bindings():
+    r = infer_effects("x = 1\ny = x + z")
+    assert "x" not in r.reads          # bound before the read
+    assert "z" in r.reads
+    # …but a deleted name read later is free again.
+    r = infer_effects("x = 1\ndel x\ny = x")
+    assert "x" in r.reads
+
+
+def test_footprint_global_escape_and_augassign():
+    r = infer_effects("def bump():\n"
+                      "    global counter\n"
+                      "    counter = counter + 1\n"
+                      "bump()")
+    assert "counter" in r.writes       # escapes the def
+    assert "counter" in r.reads
+    r = infer_effects("tot += loss")
+    assert "tot" in r.writes and "tot" in r.reads
+
+
+def test_footprint_imports_and_walrus_and_for_target():
+    r = infer_effects("import numpy as np\n"
+                      "from math import sqrt\n"
+                      "for i in range(3):\n"
+                      "    pass\n"
+                      "n = (m := 7)\n")
+    assert {"np", "sqrt", "i", "n", "m"} <= r.writes
+
+
+def test_comprehension_scope_not_module_writes():
+    r = infer_effects("ys = [w * xi for xi in xs]")
+    assert "xi" not in r.writes
+    assert {"w", "xs"} <= r.reads and "ys" in r.writes
+    assert r.collective_verdict == "none"
+
+
+@pytest.mark.parametrize("cell,why", [
+    ("exec('x=1')", "exec"),
+    ("y = eval(s)", "eval"),
+    ("from jax.numpy import *", "star-import"),
+    ("globals()['q'] = 7", "globals"),
+    ("vars().update(d)", "vars"),
+])
+def test_dynamic_escapes_are_opaque(cell, why):
+    r = infer_effects(cell)
+    assert r.opaque, cell
+    assert any(why in reason for reason in r.opaque_reasons)
+    assert collective_class(r) == "unknown"
+
+
+def test_unparseable_source_is_opaque_not_raised():
+    r = infer_effects("def f(:")
+    assert not r.parsed and r.opaque
+    assert collective_class(r) == "unknown"
+
+
+def test_reading_globals_is_not_opaque():
+    r = infer_effects("names = sorted(globals())")
+    assert not r.opaque
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9: effect inference — collective footprint
+
+
+def test_collective_footprint_ordered_sites():
+    r = infer_effects(HANG_CELL)
+    assert r.parsed and not r.opaque
+    assert [s.op for s in r.collectives] == ["all_reduce",
+                                             "all_reduce"]
+    lines = [s.line for s in r.collectives]
+    assert lines == sorted(lines) and len(set(lines)) == 2
+    assert r.collectives[1].conditional
+    assert r.collective_verdict == "exact"
+    assert collective_class(r) == "bearing"
+
+
+def test_proven_free_cell():
+    r = infer_effects("import time\n"
+                      "time.sleep(0.5)\n"
+                      "zz = sorted([3, 1])\n"
+                      "zz")
+    assert r.collective_verdict == "none"
+    assert collective_class(r) == "free"
+    assert r.collective_free
+
+
+def test_safe_roots_and_builtins_stay_free():
+    r = infer_effects("import numpy as np\n"
+                      "a = np.ones(3)\n"
+                      "b = jnp.ones(3).sum()\n"
+                      "c = math.sqrt(float(len(str(2))))\n"
+                      "hist = []\nhist.append(c)")
+    assert r.collective_verdict == "none", r.taints
+
+
+def test_unvetted_calls_taint_to_unknown():
+    r = infer_effects("y = train_step(x)")
+    assert r.collective_verdict == "unknown"
+    assert any("train_step" in t for t in r.taints)
+    assert collective_class(r) == "unknown"
+    # jax.* is NOT a safe root: jitted products can hide collectives.
+    r = infer_effects("f = jax.jit(g)")
+    assert r.collective_verdict == "unknown"
+
+
+def test_same_cell_def_resolved_one_level():
+    r = infer_effects("def step(x):\n"
+                      "    return all_reduce(x) + 1\n"
+                      "y = step(y0)")
+    assert [s.op for s in r.collectives] == ["all_reduce"]
+    assert r.collectives[0].via == "step"
+    assert r.collective_verdict == "exact"
+
+
+def test_nested_def_call_taints_and_recursion_terminates():
+    r = infer_effects("def inner(x):\n"
+                      "    return other(x)\n"
+                      "def outer(x):\n"
+                      "    return inner(x)\n"
+                      "outer(1)")
+    assert r.collective_verdict == "unknown"
+    assert any("one level deep" in t for t in r.taints)
+    # A recursive def must terminate with an honest unknown, not
+    # recurse forever.
+    r = infer_effects("def f(n):\n    return f(n - 1)\nf(3)")
+    assert r.collective_verdict == "unknown"
+
+
+def test_uncalled_def_with_collective_is_free():
+    # Defining a helper runs nothing; only a CALL reaches the mesh.
+    r = infer_effects("def helper(x):\n    return all_reduce(x)")
+    assert r.collectives == ()
+    assert r.collective_verdict == "none"
+
+
+def test_rebound_safe_root_and_rebound_def_lose_their_proofs():
+    r = infer_effects("time = Trainer()\ntime.step()")
+    assert r.collective_verdict == "unknown"
+    r = infer_effects("def f():\n    pass\nf = trainer.step\nf()")
+    assert r.collective_verdict == "unknown"
+
+
+def test_cross_cell_safe_root_rebind_poisons_later_proofs():
+    """A rebind in cell 1 must not let cell 2 be falsely PROVEN free:
+    ambient_poison feeds the next cell's assume_unsafe."""
+    from nbdistributed_tpu.analysis.effects import ambient_poison
+    cell1 = infer_effects("np = weird_module")
+    poison = ambient_poison(cell1)
+    assert "np" in poison
+    # Without the poison, cell 2 would be proven free — the hole.
+    assert infer_effects("y = np.sum(x)").collective_verdict == "none"
+    r = infer_effects("y = np.sum(x)", assume_unsafe=poison)
+    assert r.collective_verdict == "unknown"
+    # Builtins poison the same way (`float = my_fn` in cell 1).
+    poison2 = ambient_poison(infer_effects("float = my_fn"))
+    assert "float" in poison2
+    assert infer_effects("z = float(x)",
+                         assume_unsafe=poison2
+                         ).collective_verdict == "unknown"
+
+
+def test_reimport_rearms_instead_of_poisoning():
+    from nbdistributed_tpu.analysis.effects import ambient_poison
+    # `import numpy as np` RESTORES the assumption — no poison…
+    assert "np" not in ambient_poison(
+        infer_effects("import numpy as np\na = np.ones(2)"))
+    # …and a poisoned root is re-armed within the importing cell.
+    r = infer_effects("import numpy as np\na = np.ones(2)",
+                      assume_unsafe=frozenset({"np"}))
+    assert r.collective_verdict == "none"
+    # But `import jax as np` both disarms in-cell and poisons onward.
+    p = ambient_poison(infer_effects("import jax as np"))
+    assert "np" in p
+
+
+def test_opaque_cell_poisons_every_ambient_assumption():
+    from nbdistributed_tpu.analysis.effects import (SAFE_CALL_ROOTS,
+                                                    ambient_poison)
+    p = ambient_poison(infer_effects("exec(payload)"))
+    assert SAFE_CALL_ROOTS <= p and "float" in p
+
+
+def test_host_sync_flags_and_taint():
+    r = infer_effects("for i in range(5):\n    tot += loss.item()")
+    assert r.host_sync and r.host_sync_in_loop
+    assert r.collective_verdict == "unknown"   # may gather cross-host
+    r = infer_effects("v = loss.item()")
+    assert r.host_sync and not r.host_sync_in_loop
+    r = infer_effects("for i in range(3):\n    print(loss)")
+    assert r.host_sync_in_loop
+    r = infer_effects("print('hello')")
+    assert not r.host_sync
+
+
+def test_pure_property():
+    assert infer_effects("1 + 1").pure
+    assert not infer_effects("x = 1").pure
+    assert not infer_effects("y = all_reduce(x)").pure
+
+
+def test_effects_report_as_dict_is_json_safe():
+    d = infer_effects(HANG_CELL).as_dict()
+    json.dumps(d)
+    assert d["collective_verdict"] == "exact"
+    assert [s["op"] for s in d["collectives"]] == ["all_reduce",
+                                                   "all_reduce"]
+
+
+def test_await_collective_counts():
+    r = infer_effects("r = await all_reduce(jnp.ones(2))")
+    assert r.parsed
+    assert [s.op for s in r.collectives] == ["all_reduce"]
+    assert collective_class(r) == "bearing"
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9: preflight effect store + session dependency DAG
+
+
+def test_note_effects_log_and_lookup():
+    preflight.clear()
+    preflight.note_effects("sha-a", infer_effects("x = 1"))
+    preflight.note_effects("sha-b", infer_effects("y = x"))
+    log = preflight.effects_log()
+    assert [e["sha"] for e in log] == ["sha-a", "sha-b"]
+    assert preflight.effects_for("sha-b")["reads"] == ["x"]
+    assert preflight.effects_for("missing") is None
+    preflight.clear()
+    assert preflight.effects_log() == []
+
+
+def test_deps_dag_write_read_edges():
+    preflight.clear()
+    for sha, src in [("s0", "x = 1\ny = 2"),
+                     ("s1", "z = x + 1"),
+                     ("s2", "import time\ntime.sleep(0)"),
+                     ("s3", "cfg.lr = x"),   # mutation counts as write
+                     ("s4", "v = cfg")]:
+        preflight.note_effects(sha, infer_effects(src))
+    dag = preflight.deps_dag()
+    edges = {(e["src"], e["dst"]): e["names"] for e in dag["edges"]}
+    assert edges[(0, 1)] == ["x"]
+    assert edges[(3, 4)] == ["cfg"]
+    assert (0, 2) not in edges and (1, 2) not in edges
+    preflight.clear()
+
+
+def test_deps_dag_opaque_poisons_both_directions():
+    preflight.clear()
+    for sha, src in [("s0", "a = 1"),
+                     ("s1", "exec('b = 2')"),
+                     ("s2", "c = 3")]:
+        preflight.note_effects(sha, infer_effects(src))
+    dag = preflight.deps_dag()
+    edges = {(e["src"], e["dst"]): e["names"] for e in dag["edges"]}
+    assert edges[(0, 1)] == ["*"]
+    assert edges[(1, 2)] == ["*"]
+    assert (0, 2) not in edges
+    preflight.clear()
+
+
+def test_effects_log_is_bounded():
+    preflight.clear()
+    rep = infer_effects("x = 1")
+    for i in range(preflight._MAX_CELLS + 10):
+        preflight.note_effects(f"s{i}", rep)
+    log = preflight.effects_log()
+    assert len(log) == preflight._MAX_CELLS
+    assert log[0]["sha"] == "s10"      # oldest evicted
+    preflight.clear()
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9 satellite: cell magics other than %%distributed/%%rank
+
+
+def test_nested_python_body_cell_magic_still_vets_remainder():
+    for head in ("%%time", "%%time -n1", "%%capture out", "%%prun"):
+        src = f"{head}\nif rank == 0:\n    all_reduce(x)\n"
+        res = vet_cell(src)
+        assert res.parsed, head
+        assert rules(res, "error") == ["rank-conditional-collective"], \
+            head
+
+
+def test_non_python_cell_magic_masks_whole_cell():
+    for src in ("%%bash\necho hi there\n",
+                "%%writefile out.py\nthis is : not python\n",
+                "%%sql\nselect * from t where x > 2\n"):
+        res = vet_cell(src)
+        assert res.parsed and res.findings == [], src
+        rep = infer_effects(src)
+        assert rep.parsed and not rep.opaque
+        assert rep.collective_verdict == "none"
+    # Line count survives the masking (finding lines stay honest).
+    assert len(strip_ipython("%%bash\necho hi\necho bye\n")
+               .splitlines()) == 3
+
+
+def test_bare_double_percent_line_is_stripped():
+    res = vet_cell("%%\nif rank == 0:\n    all_reduce(x)\n")
+    assert res.parsed
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9 satellite: async cells — pin the rule semantics
+
+
+def test_top_level_await_cell_is_vetted():
+    # ast.parse accepts module-level await (the error is compile-
+    # stage), so IPython's top-level-await cells are NOT unparseable.
+    res = vet_cell("import asyncio\n"
+                   "await asyncio.sleep(0)\n"
+                   "if rank == 0:\n"
+                   "    await all_reduce(x)\n")
+    assert res.parsed
+    assert rules(res, "error") == ["rank-conditional-collective"]
+
+
+def test_async_for_break_desyncs_like_plain_for():
+    res = vet_cell("async def main():\n"
+                   "    async for b in stream:\n"
+                   "        if rank == 1:\n"
+                   "            break\n"
+                   "        x = all_reduce(b)\n"
+                   "await main()\n")
+    assert "rank-conditional-exit" in rules(res, "error")
+
+
+def test_async_for_host_sync_warns_like_plain_for():
+    res = vet_cell("async def main():\n"
+                   "    async for b in stream:\n"
+                   "        print(loss)\n"
+                   "await main()\n")
+    assert rules(res) == ["host-sync-in-loop"]
+
+
+def test_rank_exit_in_async_def_with_collectives_ahead():
+    res = vet_cell("async def step():\n"
+                   "    if rank == 0:\n"
+                   "        return\n"
+                   "    y = all_reduce(x)\n")
+    assert "rank-conditional-exit" in rules(res, "error")
+
+
+def test_uniform_async_cell_is_clean():
+    assert not vet_cell("async def main():\n"
+                        "    y = all_reduce(x)\n"
+                        "    return y\n"
+                        "await main()\n").errors
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9: effect-engine acceptance corpora (the CI effects check)
+
+
+@pytest.mark.parametrize("nb", ["00_quickstart.ipynb",
+                                "01_parallelism.ipynb",
+                                "02_finetune.ipynb"])
+def test_example_notebook_cells_get_non_opaque_reports(nb):
+    path = os.path.join(REPO, "examples", nb)
+    bad = []
+    for i, src in enumerate(_notebook_cells(path)):
+        rep = infer_effects(src)
+        if not rep.parsed or rep.opaque:
+            bad.append(f"{nb} cell {i}: {rep.opaque_reasons}")
+    assert not bad, "\n".join(bad)
+
+
+def test_selftest_corpus_cells_get_non_opaque_reports():
+    bad = []
+    for i, src in enumerate(_selftest_cells()):
+        rep = infer_effects(src)
+        if not rep.parsed or rep.opaque:
+            bad.append(f"selftest cell {i}: {rep.opaque_reasons}")
+    assert not bad, "\n".join(bad)
+
+
+def test_hang_cell_footprint_nonempty_and_ordered():
+    rep = infer_effects(HANG_CELL)
+    assert rep.collectives, "HANG_CELL must carry a collective " \
+                            "footprint"
+    lines = [s.line for s in rep.collectives]
+    assert lines == sorted(lines)
+    assert collective_class(rep) != "free"
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9 satellite: thread pass — gateway coverage + _locked helpers
+
+
+def test_thread_pass_covers_gateway_files():
+    from nbdistributed_tpu.analysis.selfcheck import \
+        _THREAD_CHECKED_FILES
+    covered = {os.path.basename(f) for f in _THREAD_CHECKED_FILES}
+    assert {"daemon.py", "tenancy.py", "scheduler.py"} <= covered
+
+
+def _locked_findings(src, method_name):
+    tree = ast.parse(src)
+    cls = tree.body[0]
+    fn = [n for n in cls.body if isinstance(n, ast.FunctionDef)
+          and n.name == method_name][0]
+    p = _ThreadPass("x.py", cls.name, {"counts"}, {},
+                    method=method_name)
+    p.visit(fn)
+    return p.findings
+
+
+_LOCKED_SRC = """
+class C:
+    def __init__(self):
+        self._lock = None
+        self.counts = dict()
+    def _bump_locked(self):
+        self.counts['a'] = 1
+        self.n += 1
+    def unlocked_caller(self):
+        self._bump_locked()
+    def locked_caller(self):
+        with self._lock:
+            self._bump_locked()
+"""
+
+
+def test_locked_suffix_body_is_treated_as_locked():
+    assert not _locked_findings(_LOCKED_SRC, "_bump_locked")
+
+
+def test_unlocked_call_to_locked_helper_is_flagged():
+    found = _locked_findings(_LOCKED_SRC, "unlocked_caller")
+    assert found and "lock-asserting" in found[0].message
+
+
+def test_locked_call_to_locked_helper_is_clean():
+    assert not _locked_findings(_LOCKED_SRC, "locked_caller")
+
+
+# ----------------------------------------------------------------------
+# ISSUE 9: magic wiring — dispatched cells record effect footprints
+
+
+def test_vet_cell_records_effects_on_dispatch(magic):
+    from nbdistributed_tpu.runtime.collective_guard import cell_hash
+    src = "ana_x = 1\nana_y = ana_x + free_read"
+    assert magic._vet_cell(src, [0, 1]) is True
+    entry = preflight.effects_for(cell_hash(src))
+    assert entry is not None
+    assert "ana_x" in entry["writes"] and "free_read" in entry["reads"]
+
+
+def test_vet_cell_strict_block_records_nothing(magic):
+    from nbdistributed_tpu.runtime.collective_guard import cell_hash
+    assert magic._vet_cell(HANG_CELL, [0, 1], strict=True) is False
+    assert preflight.effects_for(cell_hash(HANG_CELL)) is None
+
+
+def test_vet_cell_unparseable_records_opaque(magic):
+    from nbdistributed_tpu.runtime.collective_guard import cell_hash
+    src = "def broken(:\npass"
+    assert magic._vet_cell(src, [0, 1]) is True
+    entry = preflight.effects_for(cell_hash(src))
+    assert entry is not None and entry["opaque"]
+
+
+def test_dist_lint_deps_and_effects_render(magic, capsys):
+    magic._vet_cell("dag_a = 1", [0, 1])
+    magic._vet_cell("dag_b = dag_a + 1", [0, 1])
+    magic.dist_lint("deps")
+    out = capsys.readouterr().out
+    assert "dependency DAG" in out and "dag_a" in out
+    magic.dist_lint("effects")
+    out = capsys.readouterr().out
+    assert "effect footprints" in out and "writes dag_b" in out
 
 
 # ----------------------------------------------------------------------

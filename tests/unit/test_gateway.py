@@ -227,7 +227,8 @@ def test_snapshot_shape():
     s.submit("b", "m1")
     snap = s.snapshot()
     assert snap["policy"] == {"mode": "fair", "mesh_slots": 1,
-                              "tenant_inflight": 4, "queue_depth": 8}
+                              "tenant_inflight": 4, "queue_depth": 8,
+                              "effects": False}
     assert snap["queued"] == 1 and snap["active"] == 1
     assert snap["tenants"]["a"]["served"] == 1
     assert snap["tenants"]["b"]["queued"] == 1
@@ -236,6 +237,123 @@ def test_snapshot_shape():
 def test_bad_mode_rejected():
     with pytest.raises(ValueError):
         SchedPolicy("round-robin")
+
+
+# ----------------------------------------------------------------------
+# scheduler: effects-aware admission (ISSUE 9)
+
+
+def make_fx(mode="fifo", slots=2, depth=0, effects=True):
+    return Scheduler(SchedPolicy(mode, slots, 0, depth,
+                                 effects=effects), now=FakeClock())
+
+
+def test_effects_policy_from_env():
+    p = SchedPolicy.pool_from_env(env={"NBD_POOL_SCHED_EFFECTS": "1"})
+    assert p.effects is True
+    p = SchedPolicy.pool_from_env(env={})
+    assert p.effects is False
+    assert p.describe()["effects"] is False
+
+
+def test_proven_free_cell_overlaps_bearing_cell():
+    s = make_fx()
+    b0 = s.submit("a", "b0", collective="bearing")
+    assert b0.verdict["status"] == "dispatch"
+    f1 = s.submit("b", "f1", collective="free")
+    assert f1.verdict["status"] == "dispatch"   # the overlap itself
+    assert s.snapshot()["active"] == 2
+
+
+def test_second_bearing_cell_serializes_with_named_reason():
+    s = make_fx()
+    s.submit("a", "b0", collective="bearing")
+    held = s.submit("b", "b1", collective="bearing")
+    assert held.state == QUEUED
+    assert held.verdict["status"] == "queued"
+    assert held.verdict["reason"].startswith(
+        "serialized: collective-bearing")
+    assert s.snapshot()["effects_serialized_total"] == 1
+    # Completing the active bearing cell promotes the held one.
+    s.complete("b0")
+    assert held.state == ACTIVE
+
+
+def test_unknown_footprint_serializes_with_canonical_reason():
+    s = make_fx()
+    s.submit("a", "b0", collective="bearing")
+    held = s.submit("b", "u1", collective="unknown")
+    assert held.verdict["reason"].startswith(
+        "serialized: collective footprint unknown")
+    # …and an unknown cell on the mesh blocks a bearing one too.
+    s2 = make_fx()
+    s2.submit("a", "u0", collective="unknown")
+    held2 = s2.submit("b", "b1", collective="bearing")
+    assert "serialized" in held2.verdict["reason"]
+
+
+def test_free_cell_promotes_around_held_bearing_cell():
+    """Overlap is the point: a proven-free cell submitted BEHIND an
+    effects-held cell still takes a free slot instead of convoying."""
+    s = make_fx()
+    s.submit("a", "b0", collective="bearing")
+    held = s.submit("b", "b1", collective="bearing")
+    assert held.state == QUEUED
+    f = s.submit("c", "f1", collective="free")
+    assert f.state == ACTIVE           # jumped the held cell
+    # The instantly-granted ticket's verdict is DISPATCH, not a stale
+    # queued notice for a cell that never waited.
+    assert f.verdict["status"] == "dispatch", f.verdict
+    assert held.state == QUEUED        # still waiting for b0
+    s.complete("f1")
+    assert held.state == QUEUED
+    s.complete("b0")
+    assert held.state == ACTIVE
+
+
+def test_bearing_cell_may_start_over_free_cells_only():
+    s = make_fx(slots=4)
+    s.submit("a", "f0", collective="free")
+    s.submit("a", "f1", collective="free")
+    b = s.submit("b", "b0", collective="bearing")
+    assert b.verdict["status"] == "dispatch"   # only free cells active
+    b2 = s.submit("c", "b1", collective="bearing")
+    assert "serialized" in b2.verdict["reason"]
+
+
+def test_effects_gate_inert_when_off_or_serial():
+    # Off: two bearing cells overlap (the documented legacy hazard).
+    s = make_fx(effects=False)
+    s.submit("a", "b0", collective="bearing")
+    assert s.submit("b", "b1",
+                    collective="bearing").verdict["status"] == \
+        "dispatch"
+    # Serial mesh: the slot bound serializes everything anyway — the
+    # gate must not add reasons (no overlap to prove safe).
+    s = make_fx(slots=1)
+    s.submit("a", "b0", collective="bearing")
+    q = s.submit("b", "f1", collective="free")
+    assert q.verdict["status"] == "queued"
+    assert "reason" not in q.verdict
+
+
+def test_default_submit_class_is_unknown_and_legacy_path_unchanged():
+    # Single-kernel default policy: unlimited FIFO, effects off —
+    # submits without a collective class keep pre-ISSUE-9 behavior.
+    s = Scheduler()
+    t = s.submit("local", "m0")
+    assert t.collective == "unknown"
+    assert t.verdict == {"status": "dispatch"}
+
+
+def test_effects_serialized_cell_sheds_normally_under_depth():
+    # The effects queue path still honors queue-depth shedding.
+    s = make_fx(mode="fifo", slots=2, depth=1)
+    s.submit("a", "b0", collective="bearing")
+    held = s.submit("b", "b1", collective="bearing")
+    assert held.state == QUEUED
+    late = s.submit("c", "b2", collective="bearing", priority=0)
+    assert late.state == SHED
 
 
 # ----------------------------------------------------------------------
