@@ -62,7 +62,7 @@ import builtins
 from dataclasses import dataclass, field
 
 from .cellcheck import COLLECTIVE_NAMES, HOST_SYNC_ATTRS
-from .ipycompat import strip_ipython
+from .ipycompat import non_python_cell_magic, strip_ipython
 
 _BUILTIN_NAMES = frozenset(dir(builtins))
 
@@ -98,6 +98,12 @@ _MUTATOR_METHODS = frozenset({
     "append", "appendleft", "add", "update", "pop", "popleft",
     "popitem", "remove", "discard", "clear", "setdefault", "extend",
     "insert", "sort", "reverse",
+})
+
+# Builtin decorators that provably never INVOKE the function they
+# wrap at application time (they build descriptors around it).
+_NON_INVOKING_DECORATORS = frozenset({
+    "staticmethod", "classmethod", "property",
 })
 
 _MAX_TAINTS = 8
@@ -256,6 +262,60 @@ def _pattern_names(pattern: ast.AST) -> list[str]:
     return out
 
 
+def _binding_targets(node: ast.AST):
+    """(target names, value) for the single-value binding forms —
+    Assign, AnnAssign, walrus — or ([], None)."""
+    if isinstance(node, ast.Assign):
+        return [t.id for t in node.targets
+                if isinstance(t, ast.Name)], node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None \
+            and isinstance(node.target, ast.Name):
+        return [node.target.id], node.value
+    if isinstance(node, ast.NamedExpr) \
+            and isinstance(node.target, ast.Name):
+        return [node.target.id], node.value
+    return [], None
+
+
+def _collect_def_names(tree: ast.AST) -> frozenset:
+    """Every function-object name the cell could create — def names
+    (anywhere but class bodies, whose methods are not module names),
+    lambda bindings (assign / annotated assign / walrus), and plain
+    ALIASES of any of those (`g = step`), to a fixpoint.  This is the
+    conservative net for the argument-escape scan: a name in this set
+    passed as a call argument is a function the callee may invoke."""
+    names: set[str] = set()
+
+    def scan(node: ast.AST, aliases: bool) -> bool:
+        changed = False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                if child.name not in names:
+                    names.add(child.name)
+                    changed = True
+            else:
+                tgts, value = _binding_targets(child)
+                if tgts and (isinstance(value, ast.Lambda)
+                             or (aliases and isinstance(value,
+                                                        ast.Name)
+                                 and value.id in names)):
+                    for t in tgts:
+                        if t not in names:
+                            names.add(t)
+                            changed = True
+            if scan(child, aliases):
+                changed = True
+        return changed
+
+    scan(tree, aliases=False)
+    while scan(tree, aliases=True):
+        pass
+    return frozenset(names)
+
+
 class _Walker:
     """One ordered pass over the module: name footprint, collective
     footprint, host-sync flags, opacity — all in source order."""
@@ -271,7 +331,21 @@ class _Walker:
         self.opaque_reasons: list[str] = []
         self.host_sync = False
         self.host_sync_in_loop = False
+        # Defs (and lambda-assigns) whose statement has EXECUTED in
+        # the source-order walk: only these are resolvable — a call
+        # before its `def` invokes whatever the name is bound to at
+        # that point, not the later body.
         self.defs: dict[str, ast.AST] = {}
+        # Every def name appearing ANYWHERE in the cell (conditional
+        # branches, later lines, nested) — the conservative net for
+        # the argument-escape scan.
+        self._def_names: frozenset = frozenset()
+        # Def names whose escape-check is in progress (bounds the
+        # recursion of mutually-passing defs).
+        self._escape_stack: set[str] = set()
+        # False inside class bodies and resolved function bodies:
+        # defs there do not bind resolvable module names.
+        self._module_scope = True
         # Ambient names an EARLIER cell in this session rebound/
         # mutated/deleted: the per-cell assumption that `np`/`time`/
         # builtins denote their modules no longer holds for them.
@@ -309,8 +383,33 @@ class _Walker:
         self.bound.add(name)
 
     def _taint(self, why: str) -> None:
-        if len(self.taints) < _MAX_TAINTS:
+        # Deduped: a nested call's argument subtree is re-walked by
+        # the enclosing call's escape scan.
+        if why not in self.taints and len(self.taints) < _MAX_TAINTS:
             self.taints.append(why)
+
+    def _register_fn_binding(self, node: ast.AST, *, loop: int,
+                             cond: int) -> None:
+        """`g = lambda x: …` (assign / annotated / walrus) and plain
+        ALIASES of a resolvable function (`g = step`) are same-cell
+        function definitions: resolvable at later calls and
+        escape-checkable as arguments, under the same scope/order
+        rules as a def."""
+        if not (self._module_scope and loop == 0 and cond == 0):
+            return
+        tgts, value = _binding_targets(node)
+        if isinstance(value, ast.Lambda):
+            fn: ast.AST | None = value
+        elif isinstance(value, ast.Name) and value.id in self.defs \
+                and value.id not in self._rebound_defs:
+            fn = self.defs[value.id]
+        else:
+            fn = None
+        if fn is None:
+            return
+        for t in tgts:
+            self.defs[t] = fn
+            self._rebound_defs.discard(t)
 
     def _opaque(self, why: str) -> None:
         if why not in self.opaque_reasons:
@@ -326,10 +425,7 @@ class _Walker:
     # -- module entry ---------------------------------------------------
 
     def run(self, tree: ast.Module) -> None:
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef,
-                                 ast.AsyncFunctionDef)):
-                self.defs[node.name] = node
+        self._def_names = _collect_def_names(tree)
         self._scan_opacity(tree)
         self._block(tree.body, loop=0, cond=0)
 
@@ -373,28 +469,40 @@ class _Walker:
 
     def _stmt(self, st: ast.stmt, *, loop: int, cond: int) -> None:
         if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in st.decorator_list:
-                self._expr(dec, loop=loop, cond=cond)
             for d in (list(st.args.defaults)
                       + [d for d in st.args.kw_defaults
                          if d is not None]):
                 self._expr(d, loop=loop, cond=cond)
             self._bind(st.name)
+            # Resolvable only from here on, and only when the def
+            # statement EXECUTES unconditionally at module scope — a
+            # def inside an if/for arm leaves the name's binding
+            # statically ambiguous, so calls to it must not resolve
+            # this body.
+            if self._module_scope and loop == 0 and cond == 0:
+                self.defs[st.name] = st
+                self._rebound_defs.discard(st.name)
             self._def_name_footprint(st)
+            # Decorator application CALLS the decorator with the
+            # just-created function at definition time.
+            for dec in st.decorator_list:
+                self._decorator(dec, st, loop=loop, cond=cond)
             return
         if isinstance(st, ast.ClassDef):
             for dec in st.decorator_list:
-                self._expr(dec, loop=loop, cond=cond)
+                self._class_decorator(dec, st, loop=loop, cond=cond)
             for b in st.bases:
                 self._expr(b, loop=loop, cond=cond)
             # The class body EXECUTES at definition time (its calls are
             # reachable) but binds class attributes, not module names:
             # route the walk through a bind-sink.
             saved_bind, self._bind = self._bind, lambda name: None
+            saved_scope, self._module_scope = self._module_scope, False
             try:
                 self._block(st.body, loop=loop, cond=cond)
             finally:
                 self._bind = saved_bind
+                self._module_scope = saved_scope
             self._bind(st.name)
             return
         if isinstance(st, ast.If):
@@ -444,6 +552,7 @@ class _Walker:
             self._expr(st.value, loop=loop, cond=cond)
             for tgt in st.targets:
                 self._target(tgt)
+            self._register_fn_binding(st, loop=loop, cond=cond)
             return
         if isinstance(st, ast.AugAssign):
             self._expr(st.value, loop=loop, cond=cond)
@@ -457,6 +566,7 @@ class _Walker:
             if st.value is not None:
                 self._expr(st.value, loop=loop, cond=cond)
                 self._target(st.target)
+                self._register_fn_binding(st, loop=loop, cond=cond)
             return
         if isinstance(st, ast.Delete):
             for tgt in st.targets:
@@ -559,6 +669,7 @@ class _Walker:
                        depth=depth)
             if isinstance(expr.target, ast.Name):
                 self._bind(expr.target.id)
+                self._register_fn_binding(expr, loop=loop, cond=cond)
             return
         if isinstance(expr, ast.Lambda):
             # Body runs at call time; free names still count as reads
@@ -639,6 +750,11 @@ class _Walker:
         for kw in call.keywords:
             self._expr(kw.value, loop=loop, cond=cond, via=via,
                        depth=depth)
+        # A function object among the arguments ESCAPES into the
+        # callee, which may invoke it any number of times — its
+        # collectives would run without a visible site here
+        # (`list(map(step, data))`, `sorted(xs, key=fn)`).
+        self._escape_args(call)
         fn = call.func
         op = self._collective_op(fn)
         if op is not None:
@@ -658,6 +774,11 @@ class _Walker:
         if isinstance(fn, ast.Name):
             self._read(fn.id)
             name = fn.id
+            # Only defs whose STATEMENT already executed in the walk
+            # resolve (self.defs is populated in source order): in
+            # `f = g; f(); def f(): …` the call invokes the earlier
+            # binding, so it falls through to the generic rules below
+            # instead of borrowing the later body's proof.
             if name in self.defs and name not in self._rebound_defs:
                 if self._depth == 0:
                     self._resolve_def(name, loop=loop, cond=cond)
@@ -723,26 +844,247 @@ class _Walker:
                     f"it collective-free")
 
     def _resolve_def(self, name: str, *, loop: int, cond: int) -> None:
-        """One level deep through a same-cell def: its body's calls
-        are classified AT THE CALL SITE's position in the top-level
-        order (the collectives it runs happen when it is called).
-        Nested user-function calls inside the body taint instead of
-        recursing (``self._depth``), so a recursive def terminates
-        with an honest ``unknown``."""
+        """One level deep through a same-cell def (or lambda-assign):
+        its body's calls are classified AT THE CALL SITE's position in
+        the top-level order (the collectives it runs happen when it is
+        called).  Nested user-function calls inside the body taint
+        instead of recursing (``self._depth``), so a recursive def
+        terminates with an honest ``unknown``."""
         fndef = self.defs[name]
         saved = self.bound
         self.bound = saved | _param_names(fndef.args)
         self._depth += 1
+        saved_scope, self._module_scope = self._module_scope, False
         first_new = len(self.sites)
         try:
-            self._block(fndef.body, loop=loop, cond=cond)
+            if isinstance(fndef, ast.Lambda):
+                self._expr(fndef.body, loop=loop, cond=cond)
+            else:
+                self._block(fndef.body, loop=loop, cond=cond)
         finally:
             self._depth -= 1
+            self._module_scope = saved_scope
             self.bound = saved
         # Tag the sites this resolution added with the via name.
         for site in self.sites[first_new:]:
             if site.via is None:
                 site.via = name
+
+    # -- function-object escapes (args, decorators) ---------------------
+
+    def _escape_args(self, call: ast.Call) -> None:
+        """Taint any function object escaping through this call's
+        arguments unless its body is PROVABLY collective-free — never
+        a false 'free' for `list(map(step, data))` or a decorator
+        factory's operands."""
+        roots = list(call.args) + [kw.value for kw in call.keywords]
+        for root in roots:
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load):
+                    nm = sub.id
+                    if nm in self.defs \
+                            and nm not in self._rebound_defs:
+                        if not self._fn_free(nm):
+                            self._taint(
+                                f"same-cell function `{nm}` passed to "
+                                f"a call (L{call.lineno}) — its body "
+                                f"is not provably collective-free")
+                    elif nm in self._def_names:
+                        # Conditionally-defined, later-defined, or
+                        # rebound function name: the body the callee
+                        # would invoke is not resolvable here.
+                        self._taint(
+                            f"function `{nm}` passed to a call "
+                            f"(L{call.lineno}) — its binding is not "
+                            f"resolvable at this point")
+                elif isinstance(sub, ast.Lambda):
+                    if not self._shadow_free(
+                            _param_names(sub.args),
+                            lambda w, s=sub: w._expr(s.body, loop=0,
+                                                     cond=0)):
+                        self._taint(
+                            f"lambda passed to a call "
+                            f"(L{call.lineno}) — not provably "
+                            f"collective-free")
+
+    def _fn_free(self, name: str, node: ast.AST | None = None) -> bool:
+        """True only when the named same-cell def/lambda's body is
+        provably collective-free, so escaping it is harmless no matter
+        how often the callee invokes it.  Re-entrant escapes
+        (mutually-passing defs) come back False, bounding recursion;
+        a name with no resolvable body (and no explicit ``node``) is
+        never provably free."""
+        if name in self._escape_stack:
+            return False
+        if node is None:
+            node = self.defs.get(name)
+        if node is None:
+            return False
+        self._escape_stack.add(name)
+        try:
+            if isinstance(node, ast.Lambda):
+                return self._shadow_free(
+                    _param_names(node.args),
+                    lambda w: w._expr(node.body, loop=0, cond=0))
+            return self._shadow_free(
+                _param_names(node.args),
+                lambda w: w._block(node.body, loop=0, cond=0))
+        finally:
+            self._escape_stack.discard(name)
+
+    def _shadow_free(self, params: set, run) -> bool:
+        """Classify a function body in a scratch walker and report
+        whether it is provably collective-free (no sites, taints, or
+        opacity).  Host-sync flags propagate to the real walker — the
+        body runs whenever the callee invokes it; its name footprint
+        was already recorded at definition time."""
+        sub = _Walker(self._assume_unsafe)
+        sub.defs = dict(self.defs)
+        sub._def_names = self._def_names
+        sub._rebound_defs = set(self._rebound_defs)
+        sub._safe_names = set(self._safe_names)
+        sub._safe_callables = set(self._safe_callables)
+        sub._escape_stack = self._escape_stack
+        # The builtin-inertness check consults writes: a rebound
+        # builtin (`float = bad_fn`) must stay rebound inside the
+        # shadow body, or the escape check re-proves on a dead
+        # assumption.
+        sub.writes = set(self.writes)
+        sub.bound = set(self.bound) | set(params)
+        sub._depth = self._depth + 1
+        sub._module_scope = False
+        try:
+            run(sub)
+        except RecursionError:
+            return False
+        self.host_sync = self.host_sync or sub.host_sync
+        self.host_sync_in_loop = (self.host_sync_in_loop
+                                  or sub.host_sync_in_loop)
+        return not (sub.sites or sub.taints or sub.opaque_reasons)
+
+    def _decorator(self, dec: ast.expr, fndef, *, loop: int,
+                   cond: int) -> None:
+        """``@dec`` above ``def f`` CALLS ``dec(f)`` when the def
+        executes — a call the expression walk alone would miss, which
+        is how ``@my_decorator`` escaped classification.  The rules
+        mirror :meth:`_call`, with the decorated def as the escaping
+        argument."""
+        if isinstance(dec, ast.Name):
+            self._read(dec.id)
+            name = dec.id
+            if name in self.defs and name not in self._rebound_defs:
+                # Same-cell decorator: its body runs here…
+                if self._depth == 0:
+                    self._resolve_def(name, loop=loop, cond=cond)
+                else:
+                    self._taint(
+                        f"nested decorator `@{name}` (L{dec.lineno}) "
+                        f"— same-cell defs resolve one level deep "
+                        f"only")
+                # …the decorated def escapes into it, and the name is
+                # rebound to whatever the decorator returned.
+                if not self._fn_free(fndef.name, fndef):
+                    self._taint(
+                        f"def `{fndef.name}` passed to decorator "
+                        f"`@{name}` (L{dec.lineno}) — its body is not "
+                        f"provably collective-free")
+                self._rebound_defs.add(fndef.name)
+                return
+            if name in _NON_INVOKING_DECORATORS \
+                    and name not in self.writes \
+                    and name not in self._assume_unsafe:
+                return   # descriptor wrapper: never calls fndef
+            if name in self._safe_callables or (
+                    name in _BUILTIN_NAMES
+                    and name not in self.writes
+                    and name not in self._assume_unsafe):
+                # Application itself is inert, but the product may
+                # invoke the def — require a provably free body.
+                if not self._fn_free(fndef.name, fndef):
+                    self._taint(
+                        f"def `{fndef.name}` passed to decorator "
+                        f"`@{name}` (L{dec.lineno}) — its body is not "
+                        f"provably collective-free")
+                return
+            self._taint(f"decorator `@{name}` (L{dec.lineno}) applies "
+                        f"an unvetted function at definition time")
+            return
+        if isinstance(dec, ast.Attribute):
+            base = _base_name(dec)
+            self._expr(dec, loop=loop, cond=cond)
+            if base is not None and base in self._safe_names:
+                # e.g. @functools.cache: the safe-module contract says
+                # its product only composes the wrapped body with
+                # inert code — so the body itself must be provable.
+                if not self._fn_free(fndef.name, fndef):
+                    self._taint(
+                        f"def `{fndef.name}` passed to decorator "
+                        f"`@{base}.{dec.attr}` (L{dec.lineno}) — its "
+                        f"body is not provably collective-free")
+                return
+            self._taint(f"decorator `@….{dec.attr}` (L{dec.lineno}) "
+                        f"— could reach a collective at definition "
+                        f"time")
+            return
+        if isinstance(dec, ast.Call):
+            # Factory form: the inner call classifies normally (and
+            # fndef is not among its args), but the factory's PRODUCT
+            # is then invoked with fndef — a dynamic callee.
+            self._expr(dec, loop=loop, cond=cond)
+            self._taint(f"decorator factory at L{dec.lineno} — cannot "
+                        f"prove its product collective-free")
+            self._rebound_defs.add(fndef.name)
+            return
+        self._expr(dec, loop=loop, cond=cond)
+        self._taint(f"dynamic decorator at L{dec.lineno} — cannot "
+                    f"prove it collective-free")
+        self._rebound_defs.add(fndef.name)
+
+    def _safe_callee(self, fn: ast.AST) -> bool:
+        """A callee expression that provably cannot reach the mesh on
+        its own: a safe from-import / unshadowed builtin Name, or an
+        attribute chain rooted in a safe module."""
+        if isinstance(fn, ast.Name):
+            return fn.id not in self.defs and (
+                fn.id in self._safe_callables
+                or (fn.id in _BUILTIN_NAMES
+                    and fn.id not in self.writes
+                    and fn.id not in self._assume_unsafe))
+        if isinstance(fn, ast.Attribute):
+            base = _base_name(fn)
+            return base is not None and base in self._safe_names
+        return False
+
+    def _class_decorator(self, dec: ast.expr, cdef: ast.ClassDef, *,
+                         loop: int, cond: int) -> None:
+        """``@dec`` above ``class C`` CALLS ``dec(C)`` when the class
+        statement executes.  Safe-module decorators (``@dataclass``,
+        ``@functools.total_ordering``) introspect the class without
+        invoking user code, so they stay provable; anything else could
+        instantiate C or call its methods at definition time —
+        unprovable, taint."""
+        if isinstance(dec, ast.Name):
+            self._read(dec.id)
+        if self._safe_callee(dec):
+            return
+        if isinstance(dec, ast.Call):
+            # Factory form (`@dataclass(frozen=True)`): the inner call
+            # classifies normally; a safe factory's product keeps the
+            # introspect-only contract.
+            before = len(self.taints)
+            safe = self._safe_callee(dec.func)
+            self._expr(dec, loop=loop, cond=cond)
+            if safe and len(self.taints) == before:
+                return
+            self._taint(f"class decorator factory at L{dec.lineno} — "
+                        f"cannot prove its product collective-free")
+            return
+        if not isinstance(dec, ast.Name):
+            self._expr(dec, loop=loop, cond=cond)
+        self._taint(f"class decorator at L{dec.lineno} on "
+                    f"`{cdef.name}` — could run the class's code at "
+                    f"definition time")
 
     # -- def name footprint ---------------------------------------------
 
@@ -803,6 +1145,16 @@ def infer_effects(code: str, *,
     :func:`ambient_poison` — whose per-cell safety assumption must
     not be trusted here.  A cell can re-arm a root by importing the
     real module itself (``import numpy as np``)."""
+    if non_python_cell_magic(code) is not None:
+        # %%bash / %%writefile / …: the payload is data for the magic,
+        # not Python — no namespace footprint and no mesh collectives,
+        # but REAL host side effects (filesystem, subprocesses, pip),
+        # so the cell must never read as pure/reorderable.  host_sync
+        # is the honest flag: the magic synchronously runs host work.
+        return EffectReport(
+            parsed=True, opaque=False,
+            collective_verdict=VERDICT_NONE,
+            host_sync=True)
     try:
         cleaned = strip_ipython(code)
         tree = ast.parse(cleaned)

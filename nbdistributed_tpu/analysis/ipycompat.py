@@ -59,6 +59,21 @@ NON_PYTHON_CELL_MAGICS = frozenset({
 _CELL_MAGIC_NAME = re.compile(r"^%%([\w.]+)")
 
 
+def non_python_cell_magic(source: str) -> str | None:
+    """The leading non-Python cell magic's name (``"bash"`` for a
+    ``%%bash`` cell), or None when the cell is (possibly magic-headed)
+    Python.  The sentinel effect consumers need: a masked non-Python
+    cell parses as all-``pass`` but still has REAL host side effects
+    (filesystem writes, subprocesses), so it must never be reported
+    pure/reorderable."""
+    lines = source.splitlines()
+    first = lines[0].strip() if lines else ""
+    m = _CELL_MAGIC_NAME.match(first)
+    if m and m.group(1).split(".")[0] in NON_PYTHON_CELL_MAGICS:
+        return m.group(1).split(".")[0]
+    return None
+
+
 def _is_ipython_line(stripped: str) -> bool:
     if not stripped:
         return False
@@ -114,14 +129,12 @@ def strip_ipython(source: str) -> str:
         return source
     except (SyntaxError, ValueError):
         pass
-    lines = source.splitlines()
-    first = lines[0].strip() if lines else ""
-    m = _CELL_MAGIC_NAME.match(first)
-    if m and m.group(1).split(".")[0] in NON_PYTHON_CELL_MAGICS:
+    if non_python_cell_magic(source) is not None:
         # The whole cell is the magic's (non-Python) payload: mask
         # every line so the result parses and reports nothing, instead
         # of the remainder failing ast.parse and blinding the vetting.
-        indent_pass = "\n".join("pass" for _ in lines) or "pass"
+        indent_pass = "\n".join(
+            "pass" for _ in source.splitlines()) or "pass"
         if source.endswith("\n"):
             indent_pass += "\n"
         return indent_pass

@@ -15,8 +15,9 @@ difference between a mystery hang and a closed loop.
 :class:`~.effects.EffectReport` summary is recorded by ``cell_sha1``
 too, in *session order* — the substrate for the per-session **cell
 dependency DAG** (:func:`deps_dag`, rendered by ``%dist_lint deps``):
-a write→read edge from cell *i* to a later cell *j* whenever a name
-*i* binds/mutates/deletes is free-read by *j*.  An ``opaque`` cell
+an edge from cell *i* to a later cell *j* for every RAW (a name *i*
+binds/mutates/deletes is free-read by *j*), WAR (*i* reads a name *j*
+writes), or WAW (both write one name) hazard.  An ``opaque`` cell
 (exec/star-import/globals-write/unparseable) conservatively depends
 on everything before it and gates everything after it (edges named
 ``*``).  ROADMAP item 3's async in-flight window is declared against
@@ -130,24 +131,37 @@ def effects_for(cell_sha1: str | None) -> dict | None:
     return None
 
 
+def _touched(entry: dict) -> set:
+    return (set(entry.get("writes") or ())
+            | set(entry.get("mutates") or ())
+            | set(entry.get("deletes") or ()))
+
+
 def _edge_names(earlier: dict, later: dict) -> list[str]:
-    """Write→read dependency names between two recorded cells, or
-    ``["*"]`` when either side is opaque (whole-namespace poison)."""
+    """Dependency names between two recorded cells — true (RAW,
+    write→read) dependencies plus the anti/output hazards that also
+    forbid reordering: WAR (earlier reads a name the later cell
+    writes) and WAW (both write one name, final value is
+    order-defined).  ``["*"]`` when either side is opaque
+    (whole-namespace poison)."""
     if earlier.get("opaque") or later.get("opaque"):
         return ["*"]
-    touched = (set(earlier.get("writes") or ())
-               | set(earlier.get("mutates") or ())
-               | set(earlier.get("deletes") or ()))
-    return sorted(touched & set(later.get("reads") or ()))
+    t_early, t_late = _touched(earlier), _touched(later)
+    raw = t_early & set(later.get("reads") or ())
+    war = set(earlier.get("reads") or ()) & t_late
+    waw = t_early & t_late
+    return sorted(raw | war | waw)
 
 
 def deps_dag() -> dict:
     """The per-session cell dependency DAG: ``nodes`` in session
     order, ``edges`` as ``{"src": seq_i, "dst": seq_j, "names":
-    [...]}`` for every ordered pair with a write→read dependency
-    (opaque cells connect to everything, names ``["*"]``).  Cell j is
-    safe to overlap/reorder with cell i exactly when no edge joins
-    them — the declared contract for the async in-flight window."""
+    [...]}`` for every ordered pair whose reordering could change a
+    result — RAW (write→read), WAR (read→write), and WAW
+    (write→write) hazards all count (opaque cells connect to
+    everything, names ``["*"]``).  Cell j is safe to overlap/reorder
+    with cell i exactly when no edge joins them — the declared
+    contract for the async in-flight window."""
     with _lock:
         cells = [dict(e) for e in _cells]
     edges = []

@@ -549,10 +549,13 @@ class GatewayDaemon:
         assumption for that tenant's LATER cells
         (``tenant.ns_unsafe``, fed by ``ambient_poison``) — without
         this, ``np = weird; np.x(y)`` across two cells would be
-        falsely proven free.  (Stated limit: cells of one tenant
-        submitted concurrently may classify before an in-flight
-        sibling's rebind is recorded; a kernel that awaits each cell
-        — the notebook norm — never hits the window.)"""
+        falsely proven free.  The read-classify-poison of
+        ``tenant.ns_unsafe`` happens in ONE ``tenant.ns_lock`` section
+        so that concurrent serve threads of the same tenant
+        (mesh_slots > 1 with an async client) always classify against
+        the latest recorded poison, never a stale snapshot — scoped
+        per tenant so a big cell's analysis never stalls the
+        daemon-wide ``self._lock`` plane."""
         reg = obs_metrics.registry()
 
         def count(cls):
@@ -574,11 +577,15 @@ class GatewayDaemon:
         try:
             from ..analysis import effects as effects_mod
             from ..analysis import preflight
-            rep = effects_mod.infer_effects(
-                code, assume_unsafe=tenant.ns_unsafe)
-            cls = effects_mod.collective_class(rep)
-            poison = effects_mod.ambient_poison(rep)
-            with self._lock:
+            with tenant.ns_lock:
+                # Read-classify-poison atomically: a sibling serve
+                # thread's just-recorded rebind must be visible to
+                # this classification (the analyzer is pure CPU on a
+                # small cell, so the hold is short).
+                rep = effects_mod.infer_effects(
+                    code, assume_unsafe=tenant.ns_unsafe)
+                cls = effects_mod.collective_class(rep)
+                poison = effects_mod.ambient_poison(rep)
                 if poison:
                     tenant.ns_unsafe = tenant.ns_unsafe | poison
             from ..runtime.collective_guard import cell_hash

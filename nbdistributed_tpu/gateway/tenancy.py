@@ -39,7 +39,7 @@ class Tenant:
     __slots__ = ("name", "token", "epoch", "client_id", "mailbox",
                  "priority", "admitted_ts", "last_seen", "reattaches",
                  "cells_submitted", "cells_done", "cells_failed",
-                 "parked_total", "ns_unsafe")
+                 "parked_total", "ns_unsafe", "ns_lock")
 
     def __init__(self, name: str, token: str, priority: int = 0):
         self.name = name
@@ -51,8 +51,12 @@ class Tenant:
         # Ambient names (np/time/builtins…) a dispatched cell of THIS
         # tenant rebound: the effect analyzer must not prove a later
         # cell collective-free on the assumption they still denote
-        # their modules (analysis/effects.ambient_poison).
+        # their modules (analysis/effects.ambient_poison).  ns_lock
+        # scopes the read-classify-poison to this tenant, so one
+        # tenant's big-cell analysis never stalls the daemon-wide
+        # plane.
         self.ns_unsafe: frozenset = frozenset()
+        self.ns_lock = threading.Lock()
         self.admitted_ts = time.time()
         self.last_seen = time.time()
         self.reattaches = 0

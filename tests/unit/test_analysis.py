@@ -859,6 +859,163 @@ def test_uncalled_def_with_collective_is_free():
     assert r.collective_verdict == "none"
 
 
+def test_def_escaping_as_argument_is_classified():
+    """A def passed INTO a call escapes: the callee may invoke it, so
+    its collectives run with no visible site — `list(map(step, data))`
+    must not be falsely proven free."""
+    r = infer_effects("def step(x):\n"
+                      "    return psum(x)\n"
+                      "list(map(step, data))")
+    assert r.collective_verdict == "unknown"
+    assert any("step" in t and "passed to a call" in t
+               for t in r.taints)
+    # Precision kept: a PROVABLY free body may escape anywhere.
+    r = infer_effects("def key(x):\n"
+                      "    return x + 1\n"
+                      "zz = sorted(data, key=key)")
+    assert r.collective_verdict == "none", r.taints
+    # A def escaping before/outside its (conditional) statement has no
+    # resolvable body — taint, never guess.
+    r = infer_effects("if flag:\n"
+                      "    def f(x):\n"
+                      "        return all_reduce(x)\n"
+                      "list(map(f, xs))")
+    assert r.collective_verdict == "unknown"
+    # Recursive escape terminates with an honest unknown.
+    r = infer_effects("def f(x):\n"
+                      "    return list(map(f, x))\n"
+                      "f(q)")
+    assert r.collective_verdict == "unknown"
+
+
+def test_def_alias_and_shadowed_builtin_escapes():
+    """`g = step` must carry step's classification (aliases escape
+    the same way defs do), and a rebound builtin must stay rebound
+    inside escape-checked bodies."""
+    r = infer_effects("def step(x):\n"
+                      "    return psum(x)\n"
+                      "g = step\n"
+                      "zz = sorted(xs, key=g)")
+    assert r.collective_verdict == "unknown", r.taints
+    assert infer_effects("def step(x):\n"
+                         "    return -x\n"
+                         "g = step\n"
+                         "zz = sorted(xs, key=g)"
+                         ).collective_verdict == "none"
+    # Alias chains, and aliases CALLED directly, resolve the body.
+    r = infer_effects("def step(x):\n"
+                      "    return psum(x)\n"
+                      "g = step\nh = g\nlist(map(h, xs))")
+    assert r.collective_verdict == "unknown"
+    r = infer_effects("def step(x):\n"
+                      "    return psum(x)\n"
+                      "g = step\ng(x0)")
+    assert r.collective_verdict == "exact"
+    # `float = bad_fn` earlier in the cell: the escaped body's
+    # `float(x)` call is no longer a provably inert builtin.
+    r = infer_effects("float = bad_fn\n"
+                      "def step(x):\n"
+                      "    return float(x)\n"
+                      "list(map(step, xs))")
+    assert r.collective_verdict == "unknown"
+
+
+def test_class_decorator_application_is_classified():
+    r = infer_effects("@my_decorator\nclass C:\n    pass")
+    assert r.collective_verdict == "unknown"
+    assert any("class decorator" in t for t in r.taints)
+    # Safe-module class decorators introspect only — still provable,
+    # in both bare and factory form.
+    assert infer_effects("from dataclasses import dataclass\n"
+                         "@dataclass\nclass C:\n    x: int = 0"
+                         ).collective_verdict == "none"
+    assert infer_effects("from dataclasses import dataclass\n"
+                         "@dataclass(frozen=True)\n"
+                         "class C:\n    x: int = 0"
+                         ).collective_verdict == "none"
+
+
+def test_lambda_escape_and_lambda_assignment():
+    r = infer_effects("zz = sorted(xs, key=lambda a: all_reduce(a))")
+    assert r.collective_verdict == "unknown"
+    assert any("lambda" in t for t in r.taints)
+    assert infer_effects("zz = sorted(xs, key=lambda a: a[0])"
+                         ).collective_verdict == "none"
+    # A lambda-assigned name is a same-cell function definition: it
+    # resolves at calls and escape-checks as an argument.
+    r = infer_effects("g = lambda x: all_reduce(x)\nlist(map(g, xs))")
+    assert r.collective_verdict == "unknown"
+    assert infer_effects("g = lambda x: x + 1\nlist(map(g, xs))"
+                         ).collective_verdict == "none"
+    r = infer_effects("g = lambda x: all_reduce(x)\ny = g(x0)")
+    assert [s.op for s in r.collectives] == ["all_reduce"]
+    # Annotated-assign and walrus lambda bindings are the same hole.
+    assert infer_effects("g: object = lambda x: all_reduce(x)\n"
+                         "list(map(g, xs))"
+                         ).collective_verdict == "unknown"
+    assert infer_effects("y = (g := (lambda x: all_reduce(x)))\n"
+                         "list(map(g, xs))"
+                         ).collective_verdict == "unknown"
+    assert infer_effects("g: object = lambda x: -x\nlist(map(g, xs))"
+                         ).collective_verdict == "none"
+
+
+def test_decorator_application_is_classified():
+    """`@dec` calls `dec(f)` at definition time — a call site, not an
+    expression read (the `@my_decorator` false-free)."""
+    r = infer_effects("@my_decorator\ndef g():\n    pass")
+    assert r.collective_verdict == "unknown"
+    assert any("my_decorator" in t for t in r.taints)
+    # Safe-module decorator over a provably free body stays proven.
+    r = infer_effects("import functools\n"
+                      "@functools.cache\n"
+                      "def f():\n    return 1\n"
+                      "v = f()")
+    assert r.collective_verdict == "none", r.taints
+    # …but not over a collective-bearing body (the product calls it).
+    r = infer_effects("import functools\n"
+                      "@functools.cache\n"
+                      "def f():\n    return all_reduce(x)")
+    assert r.collective_verdict == "unknown"
+    # Factory form: the product that wraps f is a dynamic callee.
+    r = infer_effects("@retry(3)\ndef f():\n    pass")
+    assert r.collective_verdict == "unknown"
+    # A same-cell decorator may return ANYTHING: later calls to the
+    # decorated name must not resolve the raw body.
+    r = infer_effects("def deco(fn):\n"
+                      "    return other_fn\n"
+                      "@deco\ndef f():\n    pass\n"
+                      "f()")
+    assert r.collective_verdict == "unknown"
+    # Descriptor builtins never invoke at application time: defining
+    # a class with decorated methods stays proven free.
+    r = infer_effects("class C:\n"
+                      "    @staticmethod\n"
+                      "    def m(x):\n"
+                      "        return x + 1\n"
+                      "    @property\n"
+                      "    def v(self):\n"
+                      "        return self._v")
+    assert r.collective_verdict == "none", r.taints
+
+
+def test_call_before_def_resolves_earlier_binding():
+    """Resolution honors source order: `f = g; f(); def f(): pass`
+    invokes g at runtime — the later (collective-free) body proves
+    nothing about the call."""
+    r = infer_effects("f = unvetted_fn\nf()\ndef f():\n    pass")
+    assert r.collective_verdict == "unknown"
+    assert any("f()" in t for t in r.taints)
+    # The earlier binding CAN be provably safe on its own terms.
+    r = infer_effects("from math import sqrt\n"
+                      "v = sqrt(2)\n"
+                      "def sqrt(x):\n    return all_reduce(x)")
+    assert r.collective_verdict == "none", r.taints
+    # After the def statement, the body resolves as before.
+    r = infer_effects("def f():\n    pass\nf()")
+    assert r.collective_verdict == "none"
+
+
 def test_rebound_safe_root_and_rebound_def_lose_their_proofs():
     r = infer_effects("time = Trainer()\ntime.step()")
     assert r.collective_verdict == "unknown"
@@ -971,6 +1128,24 @@ def test_deps_dag_write_read_edges():
     preflight.clear()
 
 
+def test_deps_dag_war_and_waw_hazards():
+    """No-edge must mean REORDERABLE: anti (read→write) and output
+    (write→write) hazards get edges too, not just write→read."""
+    preflight.clear()
+    preflight.note_effects("i", infer_effects("y = x + 1"))
+    preflight.note_effects("j", infer_effects("x = 5"))
+    dag = preflight.deps_dag()
+    edges = {(e["src"], e["dst"]): e["names"] for e in dag["edges"]}
+    assert edges[(0, 1)] == ["x"]       # WAR: i reads x, j writes it
+    preflight.clear()
+    preflight.note_effects("i", infer_effects("x = 1"))
+    preflight.note_effects("j", infer_effects("x = 2"))
+    dag = preflight.deps_dag()
+    edges = {(e["src"], e["dst"]): e["names"] for e in dag["edges"]}
+    assert edges[(0, 1)] == ["x"]       # WAW: final value is ordered
+    preflight.clear()
+
+
 def test_deps_dag_opaque_poisons_both_directions():
     preflight.clear()
     for sha, src in [("s0", "a = 1"),
@@ -1018,6 +1193,9 @@ def test_non_python_cell_magic_masks_whole_cell():
         rep = infer_effects(src)
         assert rep.parsed and not rep.opaque
         assert rep.collective_verdict == "none"
+        # Masked payloads still have REAL host side effects (files,
+        # subprocesses): never pure/reorderable, though mesh-silent.
+        assert rep.host_sync and not rep.pure, src
     # Line count survives the masking (finding lines stay honest).
     assert len(strip_ipython("%%bash\necho hi\necho bye\n")
                .splitlines()) == 3
