@@ -25,9 +25,19 @@ Two halves:
 - **Self-lint** (:mod:`selfcheck`, ``tools/nbd_lint.py --self``):
   custom AST passes over the framework itself — thread-shared-state
   discipline (including the gateway classes and the ``_locked``
-  helper convention), the codec wire-extension registry, and the
+  helper convention), the codec wire-extension registry, the
   env-knob registry (every ``NBD_*`` declared in utils/knobs.py and
-  README-documented).
+  README-documented), and the protocol handler-coverage registry
+  (every wire message type sent has a handler and vice versa, per
+  plane).
+
+- **Concurrency self-analysis** (:mod:`concur`, ISSUE 10): an
+  interprocedural lockset analysis over the product tree — the
+  lock-order (acquires-while-holding) graph with cycle detection and
+  a dot export (``nbd-lint --lock-graph``), blocking-call-under-lock
+  (``_LINT_BLOCKING_OK`` per-site exemptions), and
+  callback-reentrancy-under-lock (``_LINT_CALLBACK_OK``) — the three
+  bug shapes PR 8 burned review rounds finding by hand, mechanized.
 
 Everything here is stdlib-only (ast + re) and safe to import from
 any layer.

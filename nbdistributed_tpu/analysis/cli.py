@@ -1,24 +1,69 @@
 """``nbd-lint`` — the static-analysis CLI (console script + CI gate).
 
-Three modes:
+Modes:
 
-- ``nbd-lint --self [ROOT]``: run the framework self-lint passes
-  (analysis/selfcheck.py) over a repo checkout; nonzero exit on any
-  finding.  This is CI's ``static-analysis`` job.
+- ``nbd-lint --self [--root ROOT]``: run the framework self-lint
+  passes (analysis/selfcheck.py + the analysis/concur.py concurrency
+  passes) over a repo checkout; nonzero exit on any finding.  This is
+  CI's ``static-analysis`` job.
 - ``nbd-lint FILE [FILE...]`` (or ``-`` for stdin): vet each file as
   a notebook cell with the SPMD analyzer; nonzero exit on
   error-severity findings (``--strict`` also fails on warnings).
   ``--ranks '[0,2]' --world 4`` supplies the dispatch context so the
   subset-collective rule arms.
+- ``nbd-lint --lock-graph [--root ROOT]``: emit the framework's
+  acquires-while-holding lock-order graph as Graphviz dot — the
+  reviewable documentation artifact CI uploads.
+- ``nbd-lint --deps-dot FILE [FILE...]``: treat the files as one
+  session's cells in order, infer their effect footprints, and emit
+  the cell dependency DAG (RAW/WAR/WAW hazard edges) as dot — the
+  ``%dist_lint deps --dot`` analog for scripts.
 - ``nbd-lint --knob-table``: print the README "Configuration
   reference" markdown table from the knob registry.
+
+``--format json`` switches ``--self`` and file-vetting output to a
+single machine-readable JSON document (findings as objects, the exit
+code embedded) for CI annotations and editors.
+
+Exit codes (pinned by tests/unit/test_analysis.py):
+
+- ``0`` — clean: no findings (or none at the failing severity).
+- ``1`` — findings: self-lint found violations, or a vetted file has
+  error-severity findings (warnings too under ``--strict``).
+- ``2`` — usage/environment error: no mode selected, unreadable
+  input, or ``--self``/``--lock-graph`` outside a checkout.
+
+When several files produce different codes, the HIGHEST applicable
+code wins (an unreadable input exits 2 even if another file also had
+findings) — order-independent by contract.
+
+An UNPARSEABLE file (syntax error after IPython stripping) exits 0 by
+default — the analyzer's never-block-dispatch contract — but exits 1
+under ``--strict``, where the caller asked for hard guarantees and an
+uninspectable cell cannot honestly be called clean.  JSON output
+carries ``"parsed": false`` either way.
 """
 
 from __future__ import annotations
 
 import argparse
+import json as _json
 import os
 import sys
+
+
+def _read_source(path: str) -> tuple[str, str] | None:
+    """``(source, label)`` for a file argument (``-`` = stdin), or
+    None after printing the OSError — the one read-input helper both
+    the vetting and ``--deps-dot`` modes share."""
+    if path == "-":
+        return sys.stdin.read(), "<stdin>"
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read(), path
+    except OSError as e:
+        print(f"{path}: {e}", file=sys.stderr)
+        return None
 
 
 def _repo_root(explicit: str | None) -> str | None:
@@ -44,14 +89,15 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="nbd-lint",
         description="nbdistributed_tpu static analysis: SPMD cell "
-                    "vetting and the framework self-lint")
+                    "vetting, the framework self-lint (incl. the "
+                    "lock-discipline passes), and the graph exports")
     ap.add_argument("files", nargs="*",
                     help="cell/script files to vet ('-' = stdin)")
     ap.add_argument("--self", dest="self_lint", action="store_true",
                     help="run the framework self-lint passes")
     ap.add_argument("--root", default=None,
-                    help="repo root for --self (default: the "
-                         "installed package's checkout)")
+                    help="repo root for --self/--lock-graph (default: "
+                         "the installed package's checkout)")
     ap.add_argument("--ranks", default=None,
                     help="rankspec context for cell vetting, e.g. "
                          "'[0,2]'")
@@ -59,6 +105,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="world size context for cell vetting")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on warning-severity findings")
+    ap.add_argument("--format", choices=["text", "json"],
+                    default="text",
+                    help="output format for --self / file vetting "
+                         "(json: one document, findings as objects, "
+                         "exit code embedded)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="emit the framework lock-order graph "
+                         "(acquires-while-holding) as Graphviz dot")
+    ap.add_argument("--deps-dot", action="store_true",
+                    help="emit the FILES' cell dependency DAG "
+                         "(effect-inferred RAW/WAR/WAW hazards) as "
+                         "Graphviz dot")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the configuration-reference markdown "
                          "table from the env-knob registry")
@@ -69,6 +127,43 @@ def main(argv: list[str] | None = None) -> int:
         print(knob_table_markdown())
         return 0
 
+    if args.lock_graph:
+        from .concur import lock_graph_dot
+        root = _repo_root(args.root)
+        if root is None:
+            print("nbd-lint --lock-graph needs a repo checkout "
+                  "(README.md next to nbdistributed_tpu/); run it "
+                  "from one or pass --root", file=sys.stderr)
+            return 2
+        print(lock_graph_dot(root))
+        return 0
+
+    if args.deps_dot:
+        if not args.files:
+            print("nbd-lint --deps-dot needs at least one FILE "
+                  "(each file = one session cell, in order)",
+                  file=sys.stderr)
+            return 2
+        from .effects import infer_effects
+        from .preflight import dag_from_entries, dag_to_dot
+        entries, labels = [], {}
+        for seq, path in enumerate(args.files):
+            read = _read_source(path)
+            if read is None:
+                # Unlike vetting (per-file, continues), a DAG with a
+                # missing cell is meaningless — abort.
+                return 2
+            src, label = read
+            if label != "<stdin>":
+                label = os.path.basename(label)
+            entry = {"seq": seq, "sha": label}
+            entry.update(infer_effects(src).as_dict())
+            entries.append(entry)
+            labels[seq] = f"#{seq} {label}"
+        print(dag_to_dot(dag_from_entries(entries), labels=labels))
+        return 0
+
+    doc: dict = {}
     rc = 0
     if args.self_lint:
         from .selfcheck import run_self_lint
@@ -79,19 +174,29 @@ def main(argv: list[str] | None = None) -> int:
                   "pass --root", file=sys.stderr)
             return 2
         results = run_self_lint(root)
-        total = 0
-        for name, findings in results.items():
-            status = "clean" if not findings else \
-                f"{len(findings)} finding(s)"
-            print(f"[{name}] {status}")
-            for f in findings:
-                print(f"  {f.render()}")
-            total += len(findings)
-        if total:
-            print(f"\nnbd-lint --self: {total} finding(s)")
-            rc = 1
+        total = sum(len(v) for v in results.values())
+        if args.format == "json":
+            doc["mode"] = "self"
+            doc["root"] = root
+            doc["passes"] = {
+                name: [{"file": f.file, "line": f.line,
+                        "rule": f.rule, "message": f.message}
+                       for f in findings]
+                for name, findings in results.items()}
+            doc["total"] = total
         else:
-            print("\nnbd-lint --self: all passes clean")
+            for name, findings in results.items():
+                status = "clean" if not findings else \
+                    f"{len(findings)} finding(s)"
+                print(f"[{name}] {status}")
+                for f in findings:
+                    print(f"  {f.render()}")
+            if total:
+                print(f"\nnbd-lint --self: {total} finding(s)")
+            else:
+                print("\nnbd-lint --self: all passes clean")
+        if total:
+            rc = 1
 
     if args.files:
         from ..magics import rankspec
@@ -103,35 +208,54 @@ def main(argv: list[str] | None = None) -> int:
                 print("--ranks needs --world", file=sys.stderr)
                 return 2
             ranks = rankspec.parse_ranks(args.ranks, world)
+        files_doc: dict = {}
         for path in args.files:
-            if path == "-":
-                src, label = sys.stdin.read(), "<stdin>"
-            else:
-                try:
-                    with open(path, encoding="utf-8") as f:
-                        src = f.read()
-                except OSError as e:
-                    print(f"{path}: {e}", file=sys.stderr)
-                    rc = 2
-                    continue
-                label = path
-            res = vet_cell(src, ranks=ranks, world=args.world)
-            if not res.parsed:
-                print(f"{label}: not analyzable (syntax error after "
-                      f"IPython stripping) — would dispatch unvetted")
+            read = _read_source(path)
+            if read is None:
+                rc = max(rc, 2)
                 continue
-            for f in res.findings:
-                print(f"{label}:{f.line}: [{f.severity}] [{f.rule}] "
-                      f"{f.message}")
-            bad = res.errors or (args.strict and res.warnings)
+            src, label = read
+            res = vet_cell(src, ranks=ranks, world=args.world)
+            # An unparseable cell never blocks dispatch (rc 0) — but
+            # under --strict the caller asked for hard guarantees,
+            # and a cell the analyzer could not inspect cannot be
+            # called clean.
+            bad = ((res.errors or (args.strict and res.warnings))
+                   if res.parsed else args.strict)
+            if args.format == "json":
+                files_doc[label] = {
+                    "parsed": res.parsed,
+                    "findings": [{"line": f.line,
+                                  "severity": f.severity,
+                                  "rule": f.rule,
+                                  "message": f.message}
+                                 for f in res.findings]
+                    if res.parsed else []}
+            elif not res.parsed:
+                print(f"{label}: not analyzable (syntax error after "
+                      f"IPython stripping) — "
+                      + ("FAILED under --strict" if args.strict
+                         else "would dispatch unvetted"))
+            else:
+                for f in res.findings:
+                    print(f"{label}:{f.line}: [{f.severity}] "
+                          f"[{f.rule}] {f.message}")
+                if not res.findings:
+                    print(f"{label}: clean")
             if bad:
-                rc = 1
-            elif not res.findings:
-                print(f"{label}: clean")
+                rc = max(rc, 1)
+        if args.format == "json":
+            doc.setdefault("mode", "files")
+            if args.self_lint:
+                doc["mode"] = "self+files"
+            doc["files"] = files_doc
 
     if not args.self_lint and not args.files:
         ap.print_help()
         return 2
+    if args.format == "json":
+        doc["exit_code"] = rc
+        print(_json.dumps(doc, indent=1))
     return rc
 
 

@@ -153,6 +153,57 @@ def _edge_names(earlier: dict, later: dict) -> list[str]:
     return sorted(raw | war | waw)
 
 
+def dag_from_entries(cells: list[dict]) -> dict:
+    """The dependency DAG of an explicit entry list (each entry an
+    ``EffectReport.as_dict()`` summary plus ``seq``/``sha``) — the
+    pure core of :func:`deps_dag`, reusable by ``nbd-lint
+    --deps-dot`` over files that never entered the session store."""
+    edges = []
+    for j, cj in enumerate(cells):
+        for i in range(j):
+            names = _edge_names(cells[i], cj)
+            if names:
+                edges.append({"src": cells[i]["seq"],
+                              "dst": cj["seq"], "names": names})
+    return {"nodes": cells, "edges": edges}
+
+
+def dag_to_dot(dag: dict, labels: dict | None = None) -> str:
+    """Graphviz dot of a :func:`deps_dag`-shaped DAG — the visually
+    auditable form of the async-dispatch substrate (ROADMAP item 3):
+    two cells may overlap exactly when no edge joins them.  WAR/WAW
+    hazard edges are included, opaque cells drawn filled; ``labels``
+    overrides the per-seq node label (``nbd-lint --deps-dot`` uses
+    file names)."""
+    labels = labels or {}
+    out = ["digraph cell_deps {",
+           "  rankdir=TB;",
+           "  node [shape=box, fontsize=10];",
+           '  label="per-session cell dependency DAG '
+           '(RAW/WAR/WAW hazards; no edge = safe to overlap)";']
+    for n in dag["nodes"]:
+        seq = n["seq"]
+        label = labels.get(seq)
+        if label is None:
+            label = f"#{seq} {str(n.get('sha') or '')[:10]}"
+            verdict = n.get("collective_verdict")
+            if verdict:
+                label += f"\\n[{verdict}]"
+        attrs = [f'label="{label}"']
+        if n.get("opaque"):
+            attrs.append('style=filled, fillcolor="#ffdddd"')
+        out.append(f'  "c{seq}" [{", ".join(attrs)}];')
+    for e in dag["edges"]:
+        names = ", ".join(e["names"][:4])
+        extra = len(e["names"]) - 4
+        if extra > 0:
+            names += f" +{extra}"
+        out.append(f'  "c{e["src"]}" -> "c{e["dst"]}" '
+                   f'[label="{names}", fontsize=8];')
+    out.append("}")
+    return "\n".join(out)
+
+
 def deps_dag() -> dict:
     """The per-session cell dependency DAG: ``nodes`` in session
     order, ``edges`` as ``{"src": seq_i, "dst": seq_j, "names":
@@ -164,11 +215,4 @@ def deps_dag() -> dict:
     contract for the async in-flight window."""
     with _lock:
         cells = [dict(e) for e in _cells]
-    edges = []
-    for j, cj in enumerate(cells):
-        for i in range(j):
-            names = _edge_names(cells[i], cj)
-            if names:
-                edges.append({"src": cells[i]["seq"],
-                              "dst": cj["seq"], "names": names})
-    return {"nodes": cells, "edges": edges}
+    return dag_from_entries(cells)

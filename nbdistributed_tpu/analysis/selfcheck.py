@@ -1,8 +1,11 @@
 """Self-lint: custom AST passes over the framework's own source.
 
 Run by ``tools/nbd_lint.py --self`` (the CI ``static-analysis`` job)
-and by the ``lint``-marked unit tests.  Three passes, each encoding a
-project invariant that used to live only in review comments:
+and by the ``lint``-marked unit tests.  Four registry/discipline
+passes live here, each encoding a project invariant that used to live
+only in review comments; :func:`run_self_lint` additionally folds in
+the three :mod:`concur` concurrency passes (lock-order graph,
+blocking-call-under-lock, callback-reentrancy):
 
 1. **env-knob registry** (:func:`check_env_knobs`): every ``NBD_*``
    string in the product tree (``nbdistributed_tpu/``, ``tools/``,
@@ -31,6 +34,18 @@ project invariant that used to live only in review comments:
    is treated as locked, and any call to a ``self.*_locked`` helper
    from an unlocked context is itself a finding — the convention that
    lets lock-held helpers stay honest instead of blanket-exempt.
+
+4. **protocol handler coverage**
+   (:func:`check_protocol_coverage`, ISSUE 10): per wire plane
+   (coordinator→worker requests, worker→coordinator notices,
+   tenant→gateway, gateway→tenant notices, manager→agent,
+   agent→manager notices), every message-type literal a sender puts
+   on the wire must have a registered handler on the receiving side,
+   and every registered handler must have at least one product-tree
+   sender — used-but-unhandled and handled-but-unsent both fail,
+   with the ``_PROTOCOL_EXTERNAL`` exemption table for intentionally
+   external types (the ``WIRE_EXTENSIONS`` pass, directionally per
+   plane).
 
 Stdlib-only; every finding carries ``file:line`` so CI output is
 clickable.
@@ -467,12 +482,277 @@ def check_thread_shared_state(root: str) -> list[SelfFinding]:
 
 
 # ----------------------------------------------------------------------
+# pass 4: protocol handler coverage (ISSUE 10 satellite)
+#
+# Every message type a sender puts on a wire plane must have a
+# registered handler on the receiving side, and every registered
+# handler must have at least one sender — used-but-unhandled silently
+# drops requests (the peer replies "unknown type" at best), and
+# handled-but-unsent is dead protocol surface that rots.  Mirrors the
+# PR 7 WIRE_EXTENSIONS registry pass, directionally per plane.
+
+# Intentionally external message types: sent or consumed outside the
+# product tree (tests, operator probes) or implied by a default.
+_PROTOCOL_EXTERNAL = {
+    "worker-notice:response":
+        "Message.reply()'s default msg_type — every worker handler "
+        "reply carries it without a literal at the send site",
+    "agent-notice:response":
+        "Message.reply()'s default msg_type — every agent handler "
+        "reply; the client correlates it by msg_id",
+    "agent:ping":
+        "agent liveness probe for tests and operators; sent from "
+        "outside the product tree by design",
+}
+
+# Sender-method msg_type positional index (after any leading
+# ranks/rank argument).
+_SEND_METHODS = {"send_to_ranks": 1, "send_to_rank": 1, "post": 1,
+                 "send_to_all": 0, "request": 0}
+
+
+def _rel_paths(root: str, rels) -> list[str]:
+    return [os.path.join(root, *r.split("/")) for r in rels]
+
+
+def _literal_arg(call: ast.Call, idx: int) -> str | None:
+    if len(call.args) > idx and isinstance(call.args[idx], ast.Constant) \
+            and isinstance(call.args[idx].value, str):
+        return call.args[idx].value
+    return None
+
+
+def _sent_request_types(root: str, files=None, methods=None,
+                        functions=None) -> dict[str, tuple[str, int]]:
+    """``{msg_type: (relpath, line)}`` for literal-typed sender
+    calls.  ``files=None`` scans the whole product tree;
+    ``functions`` maps plain-function senders to their msg_type arg
+    index (e.g. the tenant plane's ``_admin_request``)."""
+    methods = methods if methods is not None else _SEND_METHODS
+    functions = functions or {}
+    out: dict[str, tuple[str, int]] = {}
+    paths = (_rel_paths(root, files) if files is not None
+             else list(_iter_product_files(root)))
+    for path in paths:
+        tree = _parse(path)
+        if tree is None:
+            continue
+        rel = _rel(root, path).replace(os.sep, "/")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in methods:
+                t = _literal_arg(node, methods[fn.attr])
+            elif isinstance(fn, ast.Name) and fn.id in functions:
+                t = _literal_arg(node, functions[fn.id])
+            else:
+                continue
+            if t is not None:
+                out.setdefault(t, (rel, node.lineno))
+    return out
+
+
+def _constructed_types(root: str, file: str, cls: str | None = None
+                       ) -> dict[str, tuple[str, int]]:
+    """``Message(msg_type="X")`` / ``msg.reply(msg_type="X")`` /
+    ``msg.reply("X")`` literals, optionally restricted to one class's
+    body (sender and receiver classes share files)."""
+    path = os.path.join(root, *file.split("/"))
+    tree = _parse(path)
+    out: dict[str, tuple[str, int]] = {}
+    if tree is None:
+        return out
+    scope: ast.AST = tree
+    if cls is not None:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                scope = node
+                break
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        t = None
+        if isinstance(fn, ast.Name) and fn.id == "Message":
+            for kw in node.keywords:
+                if kw.arg == "msg_type" \
+                        and isinstance(kw.value, ast.Constant):
+                    t = kw.value.value
+        elif isinstance(fn, ast.Attribute) and fn.attr == "reply":
+            t = _literal_arg(node, 0)
+            for kw in node.keywords:
+                if kw.arg == "msg_type" \
+                        and isinstance(kw.value, ast.Constant):
+                    t = kw.value.value
+        if isinstance(t, str):
+            out.setdefault(t, (file, node.lineno))
+    return out
+
+
+def _handled_types(root: str, file: str, cls: str | None = None
+                   ) -> dict[str, tuple[str, int]]:
+    """Registered handler types in one receiver module: ``handlers =
+    {"X": ...}`` dict literals, ``*.msg_type``/``mt``/``t`` equality
+    and tuple-membership comparisons, and membership in module-level
+    frozenset literals (``_PRE_HELLO``).  A bare ``msg_type``
+    parameter is SENDER-side plumbing (``send_to_ranks(..., msg_type)``
+    branches) and deliberately does not count.  ``cls`` restricts the
+    scan to one class — the agent file holds both the server
+    (``HostAgent``) and the client (``AgentClient``) dispatch."""
+    path = os.path.join(root, *file.split("/"))
+    tree = _parse(path)
+    out: dict[str, tuple[str, int]] = {}
+    if tree is None:
+        return out
+    rel = file
+
+    # Module-level frozenset/set/tuple literals of strings, by name.
+    named_sets: dict[str, list[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            elts = None
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                    and v.func.id == "frozenset" and v.args \
+                    and isinstance(v.args[0], (ast.Set, ast.Tuple,
+                                               ast.List)):
+                elts = v.args[0].elts
+            elif isinstance(v, (ast.Set, ast.Tuple)):
+                elts = v.elts
+            if elts is not None:
+                vals = [e.value for e in elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                if vals:
+                    named_sets[node.targets[0].id] = vals
+
+    def _is_type_expr(e: ast.AST) -> bool:
+        return ((isinstance(e, ast.Attribute) and e.attr == "msg_type")
+                or (isinstance(e, ast.Name) and e.id in ("mt", "t")))
+
+    scope: ast.AST = tree
+    if cls is not None:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                scope = node
+                break
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "handlers" \
+                and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    out.setdefault(k.value, (rel, k.lineno))
+        elif isinstance(node, ast.Compare) and _is_type_expr(node.left):
+            for op, cmp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq,)) \
+                        and isinstance(cmp, ast.Constant) \
+                        and isinstance(cmp.value, str):
+                    out.setdefault(cmp.value, (rel, node.lineno))
+                elif isinstance(op, ast.In):
+                    if isinstance(cmp, (ast.Tuple, ast.Set, ast.List)):
+                        for e in cmp.elts:
+                            if isinstance(e, ast.Constant) \
+                                    and isinstance(e.value, str):
+                                out.setdefault(e.value,
+                                               (rel, node.lineno))
+                    elif isinstance(cmp, ast.Name) \
+                            and cmp.id in named_sets:
+                        for v in named_sets[cmp.id]:
+                            out.setdefault(v, (rel, node.lineno))
+    return out
+
+
+def _protocol_planes(root: str) -> list[dict]:
+    """Each plane: sent-literal map + handled-type map.  Kept as a
+    function (not a constant) so tests can point the collectors at a
+    synthetic tree."""
+    worker_rx = "nbdistributed_tpu/runtime/worker.py"
+    coord_rx = "nbdistributed_tpu/messaging/coordinator.py"
+    daemon_rx = "nbdistributed_tpu/gateway/daemon.py"
+    client_rx = "nbdistributed_tpu/gateway/client.py"
+    agent_rx = "nbdistributed_tpu/manager/hostagent.py"
+    return [
+        {"name": "worker",
+         "sent": _sent_request_types(
+             root, methods={"send_to_ranks": 1, "send_to_rank": 1,
+                            "send_to_all": 0, "post": 1}),
+         "handled": _handled_types(root, worker_rx)},
+        {"name": "worker-notice",
+         "sent": _constructed_types(root, worker_rx),
+         "handled": _handled_types(root, coord_rx)},
+        {"name": "tenant",
+         "sent": _sent_request_types(root, files=[client_rx],
+                                     methods={"request": 0},
+                                     functions={"_admin_request": 3}),
+         "handled": _handled_types(root, daemon_rx)},
+        {"name": "tenant-notice",
+         "sent": _constructed_types(root, daemon_rx,
+                                    cls="GatewayDaemon"),
+         "handled": _handled_types(root, client_rx)},
+        {"name": "agent",
+         "sent": {**_sent_request_types(
+                      root, files=[agent_rx,
+                                   "nbdistributed_tpu/manager/"
+                                   "process_manager.py"],
+                      methods={"request": 0}),
+                  **_constructed_types(root, agent_rx,
+                                       cls="AgentClient")},
+         "handled": _handled_types(root, agent_rx, cls="HostAgent")},
+        {"name": "agent-notice",
+         "sent": _constructed_types(root, agent_rx, cls="HostAgent"),
+         "handled": _handled_types(root, agent_rx,
+                                   cls="AgentClient")},
+    ]
+
+
+def check_protocol_coverage(root: str, planes=None,
+                            external=None) -> list[SelfFinding]:
+    planes = planes if planes is not None else _protocol_planes(root)
+    external = external if external is not None else _PROTOCOL_EXTERNAL
+    findings: list[SelfFinding] = []
+    for plane in planes:
+        name = plane["name"]
+        sent, handled = plane["sent"], plane["handled"]
+        notice = name.endswith("-notice")
+        for t in sorted(set(sent) - set(handled)):
+            if f"{name}:{t}" in external:
+                continue
+            rel, line = sent[t]
+            findings.append(SelfFinding(
+                rel, line, "protocol-coverage",
+                f"[{name} plane] message type {t!r} is sent here but "
+                f"no receiver handles it — register a handler or "
+                f"exempt it in _PROTOCOL_EXTERNAL with a reason"))
+        for t in sorted(set(handled) - set(sent)):
+            if f"{name}:{t}" in external:
+                continue
+            rel, line = handled[t]
+            kind = "notice" if notice else "request"
+            findings.append(SelfFinding(
+                rel, line, "protocol-coverage",
+                f"[{name} plane] handler for {t!r} is registered "
+                f"here but nothing in the product tree sends that "
+                f"{kind} — dead protocol surface; remove it or "
+                f"exempt it in _PROTOCOL_EXTERNAL with a reason"))
+    return findings
+
+
+# ----------------------------------------------------------------------
 
 
 def run_self_lint(root: str) -> dict[str, list[SelfFinding]]:
     """All passes; ``{pass_name: findings}`` (empty lists = clean)."""
-    return {
+    from .concur import run_concur_lint
+    results = {
         "env-knobs": check_env_knobs(root),
         "codec-headers": check_codec_headers(root),
         "thread-shared-state": check_thread_shared_state(root),
+        "protocol-coverage": check_protocol_coverage(root),
     }
+    results.update(run_concur_lint(root))
+    return results

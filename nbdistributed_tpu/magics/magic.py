@@ -1929,6 +1929,11 @@ class DistributedMagics(Magics):
     @argument("command", nargs="?", default="status",
               choices=["strict", "warn", "off", "status", "deps",
                        "effects"])
+    @argument("--dot", action="store_true",
+              help="with `deps`: print the dependency DAG as "
+                   "Graphviz dot instead of text (paste into any dot "
+                   "renderer; `nbd-lint --deps-dot` is the file-mode "
+                   "analog)")
     @line_magic
     def dist_lint(self, line):
         """Pre-dispatch SPMD cell vetting: every ``%%distributed`` /
@@ -1948,8 +1953,9 @@ class DistributedMagics(Magics):
         ``%dist_lint effects`` lists each dispatched cell's inferred
         effect footprint (reads/writes, ordered collective sites,
         opacity); ``%dist_lint deps`` renders the session cell
-        dependency DAG (write→read edges) — the substrate for
-        effects-aware pool scheduling and async dispatch."""
+        dependency DAG (RAW/WAR/WAW hazard edges) — the substrate for
+        effects-aware pool scheduling and async dispatch; ``--dot``
+        emits it as Graphviz dot for visual audit."""
         args = parse_argstring(self.dist_lint, line)
         if args.command in ("deps", "effects"):
             from ..analysis import preflight
@@ -1967,6 +1973,9 @@ class DistributedMagics(Magics):
                         e, verbose=True))
                 return
             dag = preflight.deps_dag()
+            if args.dot:
+                print(preflight.dag_to_dot(dag))
+                return
             by_dst: dict = {}
             for edge in dag["edges"]:
                 by_dst.setdefault(edge["dst"], []).append(edge)

@@ -101,6 +101,11 @@ class HostAgent:
         self._procs: dict[int, subprocess.Popen] = {}
         self._io: dict[int, _AgentChildIO] = {}
         self._exits: dict[int, int] = {}
+        # Ranks whose replacement Popen is in flight OUTSIDE the lock
+        # (see _spawn): the death-watch must not record/push the
+        # superseded process's exit during that window, or the freshly
+        # spawned worker reads as instantly dead manager-side.
+        self._spawning: set[int] = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener.on_message = self._on_message
@@ -172,10 +177,27 @@ class HostAgent:
             if old is not None and old.poll() is None:
                 return {"error": f"rank {rank} is already running "
                                  f"(pid {old.pid})"}
+            self._spawning.add(rank)
+        # Popen (fork+exec) runs OUTSIDE the lock: a slow spawn must
+        # not stall the death-watch scan and the poll/ping handlers
+        # behind process creation.  Safe unlocked: requests are served
+        # serially on the listener IO thread, so no concurrent spawn
+        # can race this rank's slot between the check and the insert —
+        # and the _spawning mark keeps the death-watch from
+        # recording/pushing the superseded dead process's exit
+        # mid-window (the lock used to exclude that for the whole
+        # section; the mark preserves exactly that).
+        try:
             proc = subprocess.Popen(
                 argv, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, env=env,
                 start_new_session=True, cwd=os.getcwd())
+        except BaseException:
+            with self._lock:
+                self._spawning.discard(rank)
+            raise
+        with self._lock:
+            self._spawning.discard(rank)
             self._procs[rank] = proc
             self._io[rank] = _AgentChildIO(proc, rank)
             self._exits.pop(rank, None)
@@ -227,15 +249,25 @@ class HostAgent:
 
     # -- death-watch ---------------------------------------------------
 
+    def _scan_exits_once(self) -> list[tuple[int, int]]:
+        """One death-watch pass: record newly-exited ranks and return
+        them for the push.  Ranks with a replacement spawn in flight
+        are skipped — their registered proc is the superseded corpse,
+        and publishing its exit would make the new worker read dead."""
+        dead: list[tuple[int, int]] = []
+        with self._lock:
+            for rank, proc in self._procs.items():
+                if rank in self._spawning:
+                    continue
+                rc = proc.poll()
+                if rc is not None and rank not in self._exits:
+                    self._exits[rank] = rc
+                    dead.append((rank, rc))
+        return dead
+
     def _watch(self) -> None:
         while not self._stop.wait(0.25):
-            dead: list[tuple[int, int]] = []
-            with self._lock:
-                for rank, proc in self._procs.items():
-                    rc = proc.poll()
-                    if rc is not None and rank not in self._exits:
-                        self._exits[rank] = rc
-                        dead.append((rank, rc))
+            dead = self._scan_exits_once()
             for rank, rc in dead:
                 # Push the exit to whatever manager is attached; a
                 # partitioned-away manager resyncs via `poll` later.

@@ -68,6 +68,27 @@ class TransportError(Exception):
     pass
 
 
+# Documented exemptions for the blocking-call-under-lock self-lint
+# (analysis/concur.py).  The write locks below exist PRECISELY to
+# serialize whole-frame socket writes from concurrent sender threads
+# (coordinator caller threads; worker stdout-streamer + heartbeat) —
+# they guard no other state, are never nested inside another lock,
+# and a frame interleaved mid-write would tear the stream for good.
+_LINT_BLOCKING_OK = {
+    "_ConnState.send_frame:send":
+        "wlock is the per-connection frame-write serializer; holding "
+        "it across the (possibly partial) non-blocking send IS its "
+        "one job",
+    "WorkerChannel.__init__:sendall":
+        "the HELLO preamble must hit the wire before any frame; the "
+        "channel is not yet shared when __init__ runs",
+    "WorkerChannel._send_frame:sendall":
+        "_wlock is the worker-side frame-write serializer (streamer "
+        "and heartbeat threads send concurrently); it guards nothing "
+        "else",
+}
+
+
 def _set_keepalive(sock: socket.socket) -> None:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)

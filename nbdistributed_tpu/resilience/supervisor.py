@@ -181,6 +181,9 @@ class Supervisor:
     def _transition(self, rank, to: str, detail: str = "") -> None:
         # Callers hold the lock; re-acquiring the RLock here costs
         # nothing and keeps the method safe for the stray direct call.
+        # The concurrency self-lint (analysis/concur.py) records this
+        # as a reentrant self-edge in the lock-order graph — a plain
+        # Lock here would fail CI as a self-deadlock.
         with self._lock:
             frm = self._state.get(rank)
             if frm == to:

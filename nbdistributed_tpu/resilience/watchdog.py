@@ -631,7 +631,10 @@ class HangWatchdog:
 
     def _event(self, event: str, detail: str, **extra) -> None:
         # Callers arrive with and without the lock held; the RLock
-        # makes re-acquiring free for the former.
+        # makes re-acquiring free for the former.  The concurrency
+        # self-lint (analysis/concur.py) records this as a reentrant
+        # self-edge in the lock-order graph — a plain Lock here would
+        # fail CI as a self-deadlock.
         with self._lock:
             self.events.append({"ts": self._clock(), "event": event,
                                 "detail": detail, **extra})

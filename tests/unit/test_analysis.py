@@ -446,7 +446,10 @@ def test_knob_table_documents_every_knob():
 def test_self_lint_clean_on_this_checkout():
     results = run_self_lint(REPO)
     assert set(results) == {"env-knobs", "codec-headers",
-                            "thread-shared-state"}
+                            "thread-shared-state",
+                            "protocol-coverage", "lock-order",
+                            "blocking-under-lock",
+                            "callback-under-lock"}
     for name, findings in results.items():
         assert findings == [], (
             f"[{name}] " + "; ".join(f.render() for f in findings))
@@ -1391,3 +1394,463 @@ def test_wire_extensions_registry_shape():
     assert {"col", "busy_s", "tel"} <= {
         k for k, v in WIRE_EXTENSIONS.items() if v["plane"] == "ping"}
     assert not set(WIRE_EXTENSIONS) & set(BASE_HEADER_KEYS)
+
+
+# ======================================================================
+# ISSUE 10: concurrency self-analysis (analysis/concur.py)
+
+
+def _concur_results(tmp_path, src):
+    """Run the three concurrency passes over one synthetic module in
+    a throwaway product tree."""
+    from nbdistributed_tpu.analysis.concur import run_concur_lint
+    pkg = tmp_path / "nbdistributed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "mod.py").write_text(src)
+    return run_concur_lint(str(tmp_path))
+
+
+def _only(results, rule):
+    """Assert exactly ``rule`` fired (the corpus contract: each
+    synthetic violation must fire its rule and no other)."""
+    for name, findings in results.items():
+        if name == rule:
+            assert findings, f"{rule} did not fire"
+        else:
+            assert findings == [], (
+                f"[{name}] " + "; ".join(f.render() for f in findings))
+    return results[rule]
+
+
+_CYCLE_SRC = """
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other_lock = threading.Lock()
+    def fwd(self):
+        with self._lock:
+            with self._other_lock:
+                pass
+    def rev(self):
+        with self._other_lock:
+            with self._lock:
+                pass
+"""
+
+
+def test_lock_order_cycle_fires_exactly_its_rule(tmp_path):
+    found = _only(_concur_results(tmp_path, _CYCLE_SRC), "lock-order")
+    assert any("cycle" in f.message and "A._lock" in f.message
+               and "A._other_lock" in f.message for f in found)
+
+
+_BURIED_CYCLE_SRC = """
+import threading
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._c_lock = threading.Lock()
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def ac(self):
+        with self._a_lock:
+            with self._c_lock:
+                pass
+    def fwd(self):
+        with self._b_lock:
+            with self._c_lock:
+                pass
+    def rev(self):
+        with self._c_lock:
+            with self._b_lock:
+                pass
+"""
+
+
+def test_lock_order_cycle_not_through_start_node_is_found(tmp_path):
+    """A b↔c inversion reachable only THROUGH a third lock must still
+    be reported — the SCC enumeration regression pin (a pruned
+    DFS-from-each-start missed exactly this shape)."""
+    found = _only(_concur_results(tmp_path, _BURIED_CYCLE_SRC),
+                  "lock-order")
+    assert any("cycle" in f.message and "A._b_lock" in f.message
+               and "A._c_lock" in f.message for f in found)
+    # The acyclic a→b / a→c prefix edges are NOT part of any finding.
+    assert all("A._a_lock" not in f.message for f in found)
+
+
+_REACQUIRE_SRC = """
+import threading
+
+class B:
+    def __init__(self):
+        self._lock = threading.{LOCK}()
+    def outer(self):
+        with self._lock:
+            self._inner()
+    def _inner(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_plain_lock_reacquire_via_helper_is_a_deadlock(tmp_path):
+    src = _REACQUIRE_SRC.replace("{LOCK}", "Lock")
+    found = _only(_concur_results(tmp_path, src), "lock-order")
+    assert any("already held" in f.message for f in found)
+
+
+def test_rlock_reacquire_is_reentrant_and_clean(tmp_path):
+    src = _REACQUIRE_SRC.replace("{LOCK}", "RLock")
+    res = _concur_results(tmp_path, src)
+    assert all(v == [] for v in res.values())
+
+
+_SENDALL_SRC = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sock = None
+    def flush(self, frame):
+        with self._lock:
+            self.sock.sendall(frame)
+"""
+
+
+def test_sendall_under_lock_fires_exactly_its_rule(tmp_path):
+    found = _only(_concur_results(tmp_path, _SENDALL_SRC),
+                  "blocking-under-lock")
+    assert "sendall" in found[0].message
+    assert "C._lock" in found[0].message
+
+
+def test_blocking_ok_exemption_table_silences_the_site(tmp_path):
+    src = ('_LINT_BLOCKING_OK = {"C.flush:sendall": "frame-write '
+           'serializer"}\n') + _SENDALL_SRC
+    res = _concur_results(tmp_path, src)
+    assert all(v == [] for v in res.values())
+
+
+_CALLBACK_SRC = """
+import threading
+
+class D:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.on_done = None
+    def fire_direct(self):
+        with self._lock:
+            self.on_done(1)
+    def fire_alias(self):
+        with self._lock:
+            cb = self.on_done
+            cb(2)
+    def fire_outside(self):
+        with self._lock:
+            cb = self.on_done
+        cb(3)
+"""
+
+
+def test_callback_under_lock_fires_exactly_its_rule(tmp_path):
+    found = _only(_concur_results(tmp_path, _CALLBACK_SRC),
+                  "callback-under-lock")
+    # Direct invocation and the locked alias fire; the copy-then-
+    # invoke-outside pattern (the documented fix) is clean.
+    lines = sorted(f.line for f in found)
+    assert len(found) == 2
+    assert all("on_done" in f.message or "cb" in f.message
+               for f in found)
+    src_lines = _CALLBACK_SRC.splitlines()
+    assert all("fire_outside" not in src_lines[ln - 2]
+               for ln in lines)
+
+
+def test_callback_ok_exemption_table_silences_the_site(tmp_path):
+    src = ('_LINT_CALLBACK_OK = {"D.fire_direct:on_done": "reentry-'
+           'safe by contract", "D.fire_alias:cb": "ditto"}\n'
+           ) + _CALLBACK_SRC
+    res = _concur_results(tmp_path, src)
+    assert all(v == [] for v in res.values())
+
+
+_LOCKED_HELPER_SRC = """
+import threading
+import time
+
+class E:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def _flush_locked(self):
+        time.sleep(1)
+"""
+
+
+def test_locked_suffix_asserts_entry_lockset(tmp_path):
+    found = _only(_concur_results(tmp_path, _LOCKED_HELPER_SRC),
+                  "blocking-under-lock")
+    assert "time.sleep" in found[0].message
+    assert "E._lock" in found[0].message
+
+
+def test_locked_helper_defect_reported_once_not_per_caller(tmp_path):
+    """One blocking op in a `_locked` helper with k locked callers is
+    ONE defect: the helper self-reports via its entry lockset, and
+    via-resolution must not re-flag it at every call site."""
+    src = _LOCKED_HELPER_SRC + """
+    def caller_one(self):
+        with self._lock:
+            self._flush_locked()
+    def caller_two(self):
+        with self._lock:
+            self._flush_locked()
+"""
+    found = _only(_concur_results(tmp_path, src),
+                  "blocking-under-lock")
+    assert len(found) == 1
+    assert found[0].message.startswith("E._flush_locked:")
+
+
+_VIA_HELPER_SRC = """
+import threading
+
+class F:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ch = None
+    def caller(self):
+        with self._lock:
+            self._emit()
+    def _emit(self):
+        self.ch.sendall(b"x")
+"""
+
+
+def test_one_level_resolution_flags_blocking_via_helper(tmp_path):
+    found = _only(_concur_results(tmp_path, _VIA_HELPER_SRC),
+                  "blocking-under-lock")
+    assert "via F._emit" in found[0].message
+    # The finding anchors at the locked CALL site, not inside the
+    # (lock-free when called alone) helper.
+    assert found[0].line == _VIA_HELPER_SRC.splitlines().index(
+        "            self._emit()") + 1
+
+
+_ACQUIRE_RELEASE_SRC = """
+import threading
+import time
+
+class G:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def run(self):
+        self._lock.acquire()
+        time.sleep(1)
+        self._lock.release()
+        time.sleep(2)
+"""
+
+
+def test_acquire_release_pairs_scope_the_lockset(tmp_path):
+    found = _only(_concur_results(tmp_path, _ACQUIRE_RELEASE_SRC),
+                  "blocking-under-lock")
+    assert len(found) == 1   # only the sleep between acquire/release
+    assert found[0].line == _ACQUIRE_RELEASE_SRC.splitlines().index(
+        "        time.sleep(1)") + 1
+
+
+def test_module_level_lock_is_tracked(tmp_path):
+    src = """
+import threading
+import time
+
+_lock = threading.Lock()
+
+def flush():
+    with _lock:
+        time.sleep(1)
+"""
+    found = _only(_concur_results(tmp_path, src),
+                  "blocking-under-lock")
+    assert "mod::_lock" in found[0].message
+
+
+def test_non_lock_attrs_never_participate(tmp_path):
+    # "block" in the name is not enough — only attributes proven to
+    # be Lock()/RLock()/Condition() constructions count.
+    src = """
+import time
+
+class H:
+    def __init__(self):
+        self.blocker = object()
+    def run(self):
+        with self.blocker:
+            time.sleep(1)
+"""
+    res = _concur_results(tmp_path, src)
+    assert all(v == [] for v in res.values())
+
+
+def test_lock_graph_dot_contains_real_edges():
+    from nbdistributed_tpu.analysis.concur import lock_graph_dot
+    dot = lock_graph_dot(REPO)
+    assert dot.startswith("digraph lock_order")
+    # The daemon parks/claims mailbox results under its lock — the
+    # cross-class edge the attr-type registry resolves.
+    assert '"GatewayDaemon._lock" -> "ResultMailbox._mlock"' in dot
+    # Reentrant self-edges (RLock helper convention) are drawn dashed,
+    # documenting the re-entry rather than flagging it.
+    assert "style=dashed" in dot
+
+
+# ----------------------------------------------------------------------
+# ISSUE 10 satellite: protocol handler coverage
+
+
+def test_protocol_coverage_synthetic_both_directions():
+    from nbdistributed_tpu.analysis.selfcheck import \
+        check_protocol_coverage
+    planes = [{"name": "x",
+               "sent": {"a": ("f.py", 1), "b": ("f.py", 2)},
+               "handled": {"a": ("g.py", 3), "c": ("g.py", 4)}}]
+    found = check_protocol_coverage(REPO, planes=planes, external={})
+    msgs = [f.message for f in found]
+    assert len(found) == 2
+    assert any("'b' is sent here but no receiver handles" in m
+               for m in msgs)
+    assert any("'c' is registered here but nothing" in m for m in msgs)
+    # Exemptions silence both directions.
+    assert check_protocol_coverage(
+        REPO, planes=planes,
+        external={"x:b": "why", "x:c": "why"}) == []
+
+
+def test_protocol_planes_cover_the_real_wire():
+    from nbdistributed_tpu.analysis.selfcheck import _protocol_planes
+    planes = {p["name"]: p for p in _protocol_planes(REPO)}
+    assert {"worker", "worker-notice", "tenant", "tenant-notice",
+            "agent", "agent-notice"} <= set(planes)
+    assert {"execute", "shutdown", "tenant_gc"} <= set(
+        planes["worker"]["sent"])
+    assert {"execute", "shutdown", "tenant_gc"} <= set(
+        planes["worker"]["handled"])
+    assert {"tenant_hello", "execute", "mailbox", "detach"} <= set(
+        planes["tenant"]["sent"])
+    assert {"queued", "parked_notice", "stream_output"} == set(
+        planes["tenant-notice"]["sent"])
+    assert {"spawn", "signal", "tail", "reap", "poll"} <= set(
+        planes["agent"]["sent"])
+
+
+# ----------------------------------------------------------------------
+# ISSUE 10 satellite: CLI modes — dot exports, JSON format, exit codes
+
+
+def test_cli_exit_codes_pinned(tmp_path, capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    # 2: no mode selected (help), unreadable file, --deps-dot sans
+    # files.
+    assert main([]) == 2
+    capsys.readouterr()
+    assert main([str(tmp_path / "missing.py")]) == 2
+    capsys.readouterr()
+    assert main(["--deps-dot"]) == 2
+    capsys.readouterr()
+    # 0: clean checkout self-lint; clean file.
+    assert main(["--self", "--root", REPO]) == 0
+    capsys.readouterr()
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert main([str(ok)]) == 0
+    capsys.readouterr()
+    # 1: error-severity cell finding.
+    bad = tmp_path / "bad.py"
+    bad.write_text(HANG_CELL)
+    assert main([str(bad)]) == 1
+    capsys.readouterr()
+    # Highest code wins regardless of argument order: unreadable (2)
+    # beats findings (1) in both positions.
+    missing = str(tmp_path / "missing.py")
+    assert main([missing, str(bad)]) == 2
+    capsys.readouterr()
+    assert main([str(bad), missing]) == 2
+    capsys.readouterr()
+    # Unparseable: 0 by the never-block contract, 1 under --strict
+    # (an uninspectable cell cannot be called clean there).
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n    pass\n")
+    assert main([str(broken)]) == 0
+    capsys.readouterr()
+    assert main([str(broken), "--strict"]) == 1
+    out = capsys.readouterr().out
+    assert "FAILED under --strict" in out
+
+
+def test_cli_json_format_self_and_files(tmp_path, capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    assert main(["--self", "--root", REPO, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mode"] == "self" and doc["total"] == 0
+    assert doc["exit_code"] == 0
+    assert set(doc["passes"]) >= {"lock-order", "blocking-under-lock",
+                                  "callback-under-lock",
+                                  "protocol-coverage"}
+    bad = tmp_path / "bad.py"
+    bad.write_text(HANG_CELL)
+    assert main([str(bad), "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mode"] == "files" and doc["exit_code"] == 1
+    (entry,) = doc["files"].values()
+    assert entry["parsed"] is True
+    assert any(f["rule"] == "rank-conditional-collective"
+               and f["severity"] == "error"
+               for f in entry["findings"])
+
+
+def test_cli_lock_graph_and_deps_dot(tmp_path, capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    assert main(["--lock-graph", "--root", REPO]) == 0
+    assert capsys.readouterr().out.startswith("digraph lock_order")
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = x + 1\n")
+    assert main(["--deps-dot", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph cell_deps")
+    assert '"c0" -> "c1"' in out and 'label="x"' in out
+
+
+def test_dag_to_dot_marks_opaque_cells():
+    from nbdistributed_tpu.analysis.effects import infer_effects
+    from nbdistributed_tpu.analysis.preflight import (dag_from_entries,
+                                                      dag_to_dot)
+    entries = []
+    for seq, src in enumerate(["a = 1", "exec('a = 2')", "b = a"]):
+        e = {"seq": seq, "sha": f"s{seq}"}
+        e.update(infer_effects(src).as_dict())
+        entries.append(e)
+    dag = dag_from_entries(entries)
+    dot = dag_to_dot(dag)
+    assert "fillcolor" in dot          # the opaque exec cell
+    # Opaque cells gate everything: both neighbors connect to c1.
+    assert '"c0" -> "c1"' in dot and '"c1" -> "c2"' in dot
+
+
+def test_dist_lint_deps_dot_renders(magic, capsys):
+    magic._vet_cell("dot_a = 1", [0, 1])
+    magic._vet_cell("dot_b = dot_a + 1", [0, 1])
+    magic.dist_lint("deps --dot")
+    out = capsys.readouterr().out
+    assert out.strip().startswith("digraph cell_deps")
+    assert "->" in out

@@ -47,6 +47,54 @@ def test_ping_reports_identity(agent, client):
     assert resp.data["run_dir"] == agent.run_dir
 
 
+def test_spawn_forks_outside_the_deathwatch_lock(agent, client,
+                                                 monkeypatch):
+    """Popen (fork+exec, possibly slow) must not run under the
+    agent's ``_lock`` — the ISSUE 10 blocking-call-under-lock fix: a
+    stalled spawn used to wedge the death-watch scan and the
+    poll/ping handlers behind process creation."""
+    import subprocess as _sp
+    from nbdistributed_tpu.manager import hostagent as ha_mod
+    real_popen = _sp.Popen
+    held: list[bool] = []
+
+    def _probe_popen(*args, **kwargs):
+        # Lock.acquire(blocking=False) succeeds iff nobody holds it.
+        free = agent._lock.acquire(blocking=False)
+        if free:
+            agent._lock.release()
+        held.append(not free)
+        return real_popen(*args, **kwargs)
+
+    monkeypatch.setattr(ha_mod.subprocess, "Popen", _probe_popen)
+    pid = client.spawn(7, [sys.executable, "-c", "pass"], {})
+    assert pid > 0
+    assert held == [False], "Popen ran while agent._lock was held"
+
+
+def test_deathwatch_skips_rank_with_spawn_in_flight(agent):
+    """A rank whose replacement Popen is in flight must not have the
+    superseded dead process's exit recorded/pushed — without the
+    suppression the freshly spawned worker reads as instantly dead
+    manager-side (the ISSUE 10 review fix)."""
+    import subprocess
+    corpse = subprocess.Popen([sys.executable, "-c", "pass"])
+    corpse.wait()
+    with agent._lock:
+        agent._procs[3] = corpse
+        agent._spawning.add(3)
+    try:
+        assert agent._scan_exits_once() == []   # suppressed mid-spawn
+        assert 3 not in agent._exits
+        with agent._lock:
+            agent._spawning.discard(3)
+        assert agent._scan_exits_once() == [(3, 0)]  # recorded after
+    finally:
+        with agent._lock:
+            agent._procs.pop(3, None)
+            agent._exits.pop(3, None)
+
+
 def test_spawn_exit_pushed_and_tail(agent, client):
     pid = client.spawn(0, [sys.executable, "-c",
                            "print('agent-child-out'); "
