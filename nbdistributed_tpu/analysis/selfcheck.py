@@ -86,6 +86,10 @@ _THREAD_CHECKED_FILES = (
     os.path.join("nbdistributed_tpu", "gateway", "daemon.py"),
     os.path.join("nbdistributed_tpu", "gateway", "tenancy.py"),
     os.path.join("nbdistributed_tpu", "gateway", "scheduler.py"),
+    # The serving plane (ISSUE 11): the manager's request table is
+    # shared between tenant-plane submit threads and the decode
+    # driver thread.
+    os.path.join("nbdistributed_tpu", "gateway", "serving.py"),
 )
 
 
@@ -691,8 +695,14 @@ def _protocol_planes(root: str) -> list[dict]:
                                      functions={"_admin_request": 3}),
          "handled": _handled_types(root, daemon_rx)},
         {"name": "tenant-notice",
-         "sent": _constructed_types(root, daemon_rx,
-                                    cls="GatewayDaemon"),
+         # The serving plane (gateway/serving.py) pushes its
+         # serve_tokens/serve_done notices through the daemon's
+         # delivery bridges — its constructed types are tenant-plane
+         # notices exactly like the daemon's own.
+         "sent": {**_constructed_types(root, daemon_rx,
+                                       cls="GatewayDaemon"),
+                  **_constructed_types(
+                      root, "nbdistributed_tpu/gateway/serving.py")},
          "handled": _handled_types(root, client_rx)},
         {"name": "agent",
          "sent": {**_sent_request_types(

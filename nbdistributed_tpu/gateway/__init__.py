@@ -37,6 +37,8 @@ _LAZY = {
     "TenantClient": "client", "CellSubmitError": "client",
     "GatewayGone": "client", "TenantFenced": "client",
     "pool_status_probe": "client", "pool_shutdown": "client",
+    "ServingManager": "serving", "ServeJournal": "serving",
+    "merge_emission": "serving", "journal_path": "serving",
 }
 
 

@@ -85,6 +85,12 @@ class TenantClient:
         # drain.  Do NOT call request() from inside it (the reader
         # delivers the reply it would wait on) — hand off to a thread.
         self.on_parked = None
+        # callable(data) or None — fires (reader thread) for serving-
+        # plane pushes: incremental ``serve_tokens`` notices
+        # ({"rid", "o", "t"}) and live terminal ``serve_done`` results
+        # ({"rid", "status", "tokens"}).  Same reader-thread caveats
+        # as on_parked.
+        self.on_serve = None
         self._ch = WorkerChannel(host, port, rank=self.client_id,
                                  auth_token=pool_token,
                                  connect_timeout=min(hello_timeout,
@@ -152,6 +158,19 @@ class TenantClient:
                 if cb is not None:
                     try:
                         cb(msg.data or {})
+                    except Exception:
+                        pass
+                continue
+            if msg.msg_type in ("serve_tokens", "serve_done"):
+                # Serving-plane pushes are uncorrelated (no waiter):
+                # token stream notices while a request decodes, and a
+                # live terminal result.  (A terminal result with NO
+                # live connection parks instead and arrives through
+                # drain().)
+                cb = self.on_serve
+                if cb is not None:
+                    try:
+                        cb(dict(msg.data or {}))
                     except Exception:
                         pass
                 continue
@@ -282,6 +301,7 @@ class TenantClient:
 
     def execute(self, code: str, *, priority: int | None = None,
                 deadline_s: float | None = None,
+                target_ranks: list[int] | None = None,
                 timeout: float | None = None,
                 on_queued=None, on_late=None) -> dict:
         """Submit one cell to the pool and wait for its terminal
@@ -292,12 +312,17 @@ class TenantClient:
         dict — ``position`` plus, under effects admission, the
         ``reason`` naming why the cell was serialized.
         ``on_late(data)`` fires if the waiter is interrupted and the
-        cell's result arrives later on this connection."""
+        cell's result arrives later on this connection.
+        ``target_ranks`` narrows the cell to specific pool ranks
+        (default: every rank — which fails fast with an error verdict
+        when any rank is dead)."""
         payload: dict = {"code": code}
         if priority is not None:
             payload["priority"] = int(priority)
         if deadline_s is not None:
             payload["deadline_s"] = float(deadline_s)
+        if target_ranks is not None:
+            payload["target_ranks"] = [int(r) for r in target_ranks]
 
         def _notice(n: dict) -> None:
             if on_queued is not None and n.get("status") == "queued":
@@ -330,6 +355,81 @@ class TenantClient:
 
     def pool_status(self, *, timeout: float | None = 30.0) -> dict:
         return dict(self.request("pool_status",
+                                 timeout=timeout).data or {})
+
+    # ------------------------------------------------------------------
+    # serving plane (%dist_serve, ISSUE 11)
+
+    def serve_start(self, spec: str | None = None, *,
+                    tenant: str | None = None,
+                    params: str | None = None, cfg: str | None = None,
+                    max_batch: int | None = None,
+                    max_len: int | None = None,
+                    pad_to: int | None = None,
+                    eos_id: int | None = None,
+                    temperature: float | None = None,
+                    steps: int | None = None,
+                    queue_depth: int | None = None,
+                    inflight: int | None = None,
+                    timeout: float | None = 600.0) -> dict:
+        """Start the pool's serving plane: run ``spec`` (a cell that
+        binds the model params/config in the serving tenant's
+        namespace on every rank) and open the decode loop.  Returns
+        the serving status dict; raises on an explicit refusal."""
+        payload = {k: v for k, v in {
+            "spec": spec, "tenant": tenant, "params": params,
+            "cfg": cfg, "max_batch": max_batch, "max_len": max_len,
+            "pad_to": pad_to, "eos_id": eos_id,
+            "temperature": temperature, "steps": steps,
+            "queue_depth": queue_depth, "inflight": inflight,
+        }.items() if v is not None}
+        data = dict(self.request("serve_start", payload,
+                                 timeout=timeout).data or {})
+        if data.get("error"):
+            raise RuntimeError(f"serve_start refused: {data['error']}")
+        return data
+
+    def serve_submit(self, prompt, max_new_tokens: int, *,
+                     priority: int | None = None,
+                     timeout: float | None = 60.0) -> dict:
+        """Submit one generation request.  Returns the accepted
+        verdict (``{"status": "accepted", "rid": ..., "queued": ...}``);
+        raises :class:`CellSubmitError` on an explicit shed/rejected
+        verdict — the same overload contract cells have."""
+        payload: dict = {"prompt": [int(t) for t in prompt],
+                         "max_new_tokens": int(max_new_tokens)}
+        if priority is not None:
+            payload["priority"] = int(priority)
+        data = dict(self.request("serve_submit", payload,
+                                 timeout=timeout).data or {})
+        if data.get("status") in ("shed", "rejected"):
+            raise CellSubmitError(data)
+        if data.get("error") and data.get("status") != "accepted":
+            raise RuntimeError(f"serve_submit failed: {data['error']}")
+        return data
+
+    def serve_result(self, rid: str, *,
+                     timeout: float | None = 60.0) -> dict:
+        """Poll one request: ``{"status", "tokens", "done"}``."""
+        return dict(self.request("serve_result", {"rid": rid},
+                                 timeout=timeout).data or {})
+
+    def serve_stream(self, rid: str, from_offset: int = 0, *,
+                     timeout: float | None = 60.0) -> dict:
+        """Claim the stream suffix past ``from_offset`` — the
+        reattach-mid-generation resume: pass the last offset this
+        client acked and the gateway replays only what is missing
+        (live pushes continue via :attr:`on_serve`)."""
+        return dict(self.request(
+            "serve_stream", {"rid": rid, "from": int(from_offset)},
+            timeout=timeout).data or {})
+
+    def serve_status(self, *, timeout: float | None = 30.0) -> dict:
+        return dict(self.request("serve_status",
+                                 timeout=timeout).data or {})
+
+    def serve_stop(self, *, timeout: float | None = 60.0) -> dict:
+        return dict(self.request("serve_stop",
                                  timeout=timeout).data or {})
 
     def close(self, *, detach: bool = False) -> None:

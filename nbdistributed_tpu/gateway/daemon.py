@@ -81,6 +81,13 @@ _LINT_BLOCKING_OK = {
 # (the transport-level pool token already authenticated the peer).
 _PRE_HELLO = frozenset({"tenant_hello", "pool_status", "pool_shutdown"})
 
+# Serving-plane request types (ISSUE 11), served off-listener like
+# execute/mailbox: submit journals to disk, start dispatches a model
+# spec, and none of that may stall other tenants' frames.
+_SERVE_TYPES = frozenset({"serve_start", "serve_stop", "serve_status",
+                          "serve_submit", "serve_result",
+                          "serve_stream"})
+
 
 def gateway_manifest_path(run_dir: str) -> str:
     return os.path.join(run_dir, GATEWAY_MANIFEST_NAME)
@@ -179,6 +186,9 @@ class GatewayDaemon:
         # reply, so "scheduler idle + mailbox empty" alone can evict
         # a tenant whose result is mid-park and lose it.
         self._serving: dict[str, int] = {}
+        # The serving plane (ISSUE 11): one ServingManager per daemon,
+        # created by serve_start.  Plain rebinds under _lock.
+        self._serve_mgr = None
         self._close_lock = threading.Lock()
         self._close_started = False
         self.flight = flightrec.init("gateway")
@@ -371,6 +381,17 @@ class GatewayDaemon:
             threading.Thread(target=self._serve_execute,
                              args=(tenant, msg, client_id),
                              name=f"nbd-gw-{tenant.name}",
+                             daemon=True).start()
+        elif mt in _SERVE_TYPES:
+            # Off the listener thread (submit journals to disk, start
+            # runs a model-spec cell); counted like execute so a
+            # detach cannot evict the tenant mid-request.
+            with self._lock:
+                self._serving[tenant.name] = self._serving.get(
+                    tenant.name, 0) + 1
+            threading.Thread(target=self._serve_plane,
+                             args=(tenant, msg, client_id),
+                             name=f"nbd-gw-srv-{tenant.name}",
                              daemon=True).start()
         elif mt == "mailbox":
             # Off the listener thread: a drain reply carries up to the
@@ -615,6 +636,25 @@ class GatewayDaemon:
     def _serve_execute_inner(self, tenant, msg,
                              submit_cid: int) -> None:
         name = tenant.name
+        mgr = self._serve_mgr
+        if mgr is not None and name == mgr.tenant:
+            # Serving-tenant mode: a cell queued behind the decode
+            # loop would wait forever (the driver ticks continuously)
+            # and could clobber the DecodeServer's params mid-decode.
+            # Refuse with the serving front door named instead.
+            obs_metrics.registry().counter(
+                "nbd_tenant_cells_total",
+                "tenant cells by terminal status",
+                {"tenant": name, "status": "rejected"}).inc()
+            self._deliver(tenant, msg.reply(data={
+                "status": "rejected", "reason": "serving-tenant",
+                "error": f"tenant {name!r} is the serving plane's "
+                         "tenant — %%distributed cells cannot run "
+                         "behind its decode loop; submit generation "
+                         "requests with %dist_serve submit, or "
+                         "attach under a different tenant name"}),
+                submit_cid)
+            return
         with self._lock:
             # Serve threads of the SAME tenant run concurrently when
             # mesh_slots > 1: the counter bumps are read-modify-writes.
@@ -713,6 +753,132 @@ class GatewayDaemon:
                     {"tenant": name, "status": status}).inc()
         self._deliver(tenant, reply, submit_cid)
 
+    # ------------------------------------------------------------------
+    # serving plane (ISSUE 11)
+
+    def _serve_plane(self, tenant, msg, client_id: int) -> None:
+        """Dispatch one serve_* request (its own thread).  Replies go
+        straight to the requesting connection — a dead requester's
+        SUBMIT still stands (the request is journaled and will decode;
+        its terminal result parks), only the verdict frame is lost."""
+        try:
+            data = msg.data if isinstance(msg.data, dict) else {}
+            mt = msg.msg_type
+            if mt == "serve_start":
+                reply = self._serve_start(tenant, data)
+            else:
+                mgr = self._serve_mgr
+                if mgr is None:
+                    reply = {"status": "off",
+                             "error": "no serving plane is running "
+                                      "(start one: %dist_serve start)"}
+                elif mt == "serve_submit":
+                    reply = mgr.submit(
+                        tenant.name, data.get("prompt") or (),
+                        int(data.get("max_new_tokens") or 0),
+                        priority=int(data["priority"])
+                        if data.get("priority") is not None
+                        else tenant.priority)
+                elif mt == "serve_result":
+                    reply = mgr.result(str(data.get("rid")))
+                elif mt == "serve_stream":
+                    reply = mgr.stream(str(data.get("rid")),
+                                       int(data.get("from") or 0))
+                elif mt == "serve_status":
+                    reply = {"status": "serving", **mgr.describe()}
+                else:  # serve_stop
+                    with self._lock:
+                        self._serve_mgr = None
+                    mgr.stop()
+                    self.flight.record("serving_stopped",
+                                       tenant=mgr.tenant,
+                                       by=tenant.name)
+                    reply = {"status": "stopped", **mgr.describe()}
+        except Exception as e:
+            reply = {"status": "error",
+                     "error": f"{type(e).__name__}: {e}"}
+        finally:
+            # The decrement must be unconditional (its siblings
+            # _serve_execute/_serve_mailbox do the same): a reply that
+            # fails to encode/send must not leak a _serving slot and
+            # make the tenant unevictable forever.
+            try:
+                self._send_to_client(client_id, msg.reply(data=reply))
+            finally:
+                self._serve_done(tenant.name)
+
+    def _serve_start(self, tenant, data: dict) -> dict:
+        from .serving import ServingManager
+        name = str(data.get("tenant") or "serve").strip() or "serve"
+        if self.registry.get(name) is not None:
+            return {"status": "error",
+                    "error": f"tenant name {name!r} is in use by an "
+                             f"attached tenant — pick another serving "
+                             f"tenant name"}
+        # Constructed OUTSIDE the lock (it opens the journal file);
+        # the claim below is the race arbiter.
+        mgr = ServingManager(
+            self.comm, self.run_dir, tenant=name,
+            params_name=data.get("params") or "params",
+            cfg_name=data.get("cfg") or "cfg",
+            spec=data.get("spec"),
+            max_batch=data.get("max_batch"),
+            max_len=data.get("max_len"),
+            pad_to=int(data.get("pad_to") or 16),
+            eos_id=data.get("eos_id"),
+            temperature=float(data.get("temperature") or 0.0),
+            steps=data.get("steps"),
+            queue_depth=data.get("queue_depth"),
+            inflight=data.get("inflight"),
+            world_size=self.world_size,
+            deliver=self._serve_deliver,
+            notify=self._serve_notify, flight=self.flight)
+        with self._lock:
+            if self._serve_mgr is not None:
+                loser = True
+            else:
+                loser = False
+                self._serve_mgr = mgr
+        if loser:
+            mgr.journal.close()
+            return {"status": "already-serving",
+                    "error": "a serving plane is already running — "
+                             "%dist_serve stop first"}
+        try:
+            mgr.start()
+        except Exception as e:
+            with self._lock:
+                self._serve_mgr = None
+            try:
+                mgr.stop(close_workers=False)
+            except Exception:
+                pass
+            return {"status": "error",
+                    "error": f"serve_start failed: {e}"}
+        self.flight.record("serving_started", tenant=name,
+                           by=tenant.name)
+        return {"status": "serving", **mgr.describe()}
+
+    def _serve_deliver(self, tenant_name: str, reply) -> None:
+        """Terminal serving results ride the tenant mailbox
+        discipline: delivered to the live kernel or parked for
+        exactly-once redelivery on reattach."""
+        t = self.registry.get(tenant_name)
+        if t is None:
+            # Submitter evicted mid-generation: the journal still
+            # holds the stream; only the push is droppable.
+            self.flight.record("serve_result_dropped",
+                               tenant=tenant_name,
+                               msg_id=reply.msg_id)
+            return
+        self._deliver(t, reply)
+
+    def _serve_notify(self, tenant_name: str, msg) -> None:
+        t = self.registry.get(tenant_name)
+        if t is None or t.client_id is None:
+            return
+        self._send_to_client(t.client_id, msg)
+
     def _gc_tenant_ns(self, name: str) -> bool:
         """Drop a departed tenant's per-worker namespaces from every
         LIVE rank — a dead worker's process took its namespace dicts
@@ -786,7 +952,20 @@ class GatewayDaemon:
             return
         if self.registry.evict(name):
             self.comm.scheduler.forget_tenant(name)
-            self.flight.record("tenant_evicted", tenant=name)
+            # Metrics hygiene (ISSUE 11 satellite): an evicted
+            # tenant's per-tenant label series would otherwise
+            # accumulate one set per name for the daemon's lifetime
+            # (the PR 8 stated limit).  Serve-plane series are keyed
+            # by the SERVING tenant's name, so they survive.
+            dropped = obs_metrics.registry().remove_label_series(
+                "tenant", name)
+            # getattr: unit tests drive this path on skeletal daemons
+            # built with __new__ (no serving plane constructed).
+            mgr = getattr(self, "_serve_mgr", None)
+            if mgr is not None:
+                mgr.forget_tenant(name)
+            self.flight.record("tenant_evicted", tenant=name,
+                               metric_series_dropped=dropped)
             self._write_manifest()
 
     def _deliver(self, tenant, reply, submit_cid: int | None = None) -> None:
@@ -876,15 +1055,23 @@ class GatewayDaemon:
                     row["busy_s"] = round(
                         ping[1]["busy_s"] + (now - ping[0]), 1)
                     row["tenant"] = ping[1].get("busy_tenant")
+                if ping[1].get("srv") is not None:
+                    # Serving telemetry piggyback: tokens/s and
+                    # KV-slot occupancy for the %dist_top columns.
+                    row["srv"] = ping[1]["srv"]
             ranks[str(r)] = row
         wd = None
         if self._watchdog is not None:
             wd = [dict(v) for v in self._watchdog.last_verdicts]
-        return {"status": "ok", "run_dir": self.run_dir,
-                "pid": os.getpid(), "world_size": self.world_size,
-                "scheduler": sched,
-                "tenants": self.registry.describe(),
-                "ranks": ranks, "hang_verdicts": wd}
+        out = {"status": "ok", "run_dir": self.run_dir,
+               "pid": os.getpid(), "world_size": self.world_size,
+               "scheduler": sched,
+               "tenants": self.registry.describe(),
+               "ranks": ranks, "hang_verdicts": wd}
+        mgr = self._serve_mgr
+        if mgr is not None:
+            out["serving"] = mgr.describe()
+        return out
 
     def close(self) -> None:
         with self._close_lock:
@@ -897,6 +1084,16 @@ class GatewayDaemon:
             self._closed.wait(timeout=30.0)
             return
         self.flight.record("gateway_stop")
+        mgr = self._serve_mgr
+        if mgr is not None:
+            # Before the fleet teardown: the driver thread must stop
+            # ticking (and flush its journal) while workers can still
+            # answer the serve_close broadcast.
+            try:
+                mgr.stop()
+            except Exception:
+                pass
+            self._serve_mgr = None
         if self._watchdog is not None:
             try:
                 self._watchdog.stop()

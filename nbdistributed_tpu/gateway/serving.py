@@ -1,0 +1,940 @@
+"""The serving plane: continuous-batching generation through the
+gateway (``%dist_serve``, ISSUE 11).
+
+The tenant plane, admission control, and mailbox discipline (PR 8)
+*are* a serving front door; :class:`~..models.serving.DecodeServer` is
+the continuous-batching engine.  This module connects them:
+
+* **Request ingress.**  ``serve_submit`` enters a generation request
+  as a ticket of the serving :class:`~.scheduler.Scheduler` — one KV
+  slot per mesh-slot, the submitting tenant's SLO priority as the
+  fair-share key — so overload degrades with the SAME explicit
+  verdicts cells get: ``accepted`` (dispatch/queued with a position),
+  ``shed`` (queue full, lowest priority lost the round), ``rejected``
+  (submitter at its in-flight cap).  The pool never wedges behind a
+  flood of prompts.
+
+* **Decode loop.**  A single driver thread ticks the pool: each tick
+  is one ``serve_step`` request to the *decode rank* (the highest
+  live rank — see :meth:`ServingManager._pick_rank` for why not the
+  lowest) carrying admissions/releases and a step budget; the worker
+  runs the admissions plus up to ``steps`` decode steps on its
+  :class:`DecodeServer` and replies with per-request emissions at
+  explicit offsets.  The worker's serial request loop is the
+  interleaving point with notebook cells — a decode tick waits its
+  turn like any other request, so serving never starves tenants (and
+  vice versa, at step granularity).
+
+* **Durability (the robustness headline).**  An accepted request is
+  journaled — prompt, sampling budget, and every emitted token — in
+  an append-only :class:`ServeJournal` under the run dir *before* its
+  verdict returns.  When the decode rank is SIGKILLed mid-decode (a
+  seeded ``FaultPlan``, or a real preemption) the driver fails over to
+  the next live rank, re-opens a fresh ``DecodeServer`` there, and
+  **re-admits every unfinished request from its journal**: the new
+  prompt is ``prompt + emitted-prefix`` and the budget is what
+  remains, so greedy decoding continues bit-identically (prefill of a
+  prefix computes the same cache rows decode did — the exactness
+  argument :meth:`DecodeServer.cache_prefix` already makes).  Every
+  emission carries its worker-side offset; the journal's length is
+  the delivery cursor, so redelivered or replayed tokens are DROPPED
+  by offset (``nbd_serve_dup_dropped_total`` — pinned to zero by the
+  chaos tests) and each request's stream is emitted exactly once.
+
+* **Delivery.**  Tokens stream to the submitting tenant's live
+  connection as ``serve_tokens`` notices with offsets; a kernel that
+  reattaches mid-generation resumes with ``serve_stream`` from its
+  last acked offset.  A request that finishes while its tenant has no
+  kernel parks a terminal ``serve_done`` reply in that tenant's
+  mailbox partition — the PR 4 delivered-or-parked-exactly-once
+  discipline extended to generation results.
+
+Thread discipline: ``self._lock`` guards the request table and
+counters; helpers suffixed ``_locked`` assert their callers hold it
+(self-lint enforced).  All wire IO (``send_to_ranks``, journal
+appends) happens OUTSIDE the lock; the journal serializes its file
+writes with its own lock and is always acquired under the manager
+lock-free path or strictly after ``self._lock`` (acyclic order).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+from ..messaging.codec import Message
+from ..observability import metrics as obs_metrics
+from ..utils import knobs
+from .scheduler import ACTIVE, SchedPolicy, Scheduler
+from .scheduler import SHED as TICKET_SHED
+
+# Request lifecycle (gateway-side; scheduler states are the admission
+# half, these are the serving half).
+ACCEPTED = "accepted"
+COMPLETED = "completed"
+SHED_V = "shed"
+REJECTED_V = "rejected"
+FAILED = "failed"
+
+SERVE_JOURNAL_NAME = "serve-{tenant}.jsonl"
+
+
+def journal_path(run_dir: str, tenant: str) -> str:
+    return os.path.join(run_dir, SERVE_JOURNAL_NAME.format(tenant=tenant))
+
+
+def merge_emission(have: int, base: int, offset: int,
+                   toks: list[int]) -> tuple[list[int], int]:
+    """Offset-deduplicated merge of one worker emission into a stream
+    that already holds ``have`` tokens.
+
+    ``base`` is the stream offset the request's CURRENT placement
+    started at (0 for a first admission; the journaled prefix length
+    after a re-admission), ``offset`` the worker-side offset of this
+    emission within that placement.  Returns ``(new_tokens,
+    dup_count)``: the suffix beyond ``have`` and how many tokens were
+    dropped as already-delivered (a replayed or redelivered emission).
+    A *gap* (emission starts beyond ``have``) cannot happen under the
+    protocol — the driver only advances the journal on received
+    replies — and is surfaced as ``(None, 0)`` so the caller can
+    refuse to journal around a hole instead of silently corrupting
+    the stream.
+    """
+    goff = base + offset
+    if goff > have:
+        return None, 0
+    skip = have - goff
+    if skip >= len(toks):
+        return [], len(toks)
+    return list(toks[skip:]), skip
+
+
+class ServeJournal:
+    """Append-only JSONL journal of accepted requests and their token
+    streams — the durability core.  One line per event::
+
+        {"e": "accept", "rid": r, "tenant": t, "prompt": [...],
+         "max_new": n, "prio": p}
+        {"e": "emit", "rid": r, "o": offset, "t": [tokens]}
+        {"e": "done", "rid": r, "status": "completed"|"shed"|"failed"}
+
+    The file handle is opened once (append mode) and each event is
+    written + flushed under the journal's own lock, so concurrent
+    submit threads and the driver thread interleave whole lines.
+    :meth:`load` tolerates a torn final line (the process died
+    mid-write) exactly like the manifest readers do.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _append(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def accept(self, rid: str, tenant: str, prompt: list[int],
+               max_new: int, priority: int) -> None:
+        self._append({"e": "accept", "rid": rid, "tenant": tenant,
+                      "prompt": list(prompt), "max_new": int(max_new),
+                      "prio": int(priority)})
+
+    def emit(self, rid: str, offset: int, toks: list[int]) -> None:
+        self._append({"e": "emit", "rid": rid, "o": int(offset),
+                      "t": list(toks)})
+
+    def done(self, rid: str, status: str) -> None:
+        self._append({"e": "done", "rid": rid, "status": status})
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def load(path: str) -> dict[str, dict]:
+        """Replay the journal into ``{rid: {"tenant", "prompt",
+        "max_new", "prio", "tokens", "done"}}``.  Emissions are merged
+        by offset with the same dedup rule the live path uses, so a
+        journal that recorded a replayed emission twice still loads a
+        single exact stream."""
+        out: dict[str, dict] = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return out
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail (death mid-write) — skip
+            if not isinstance(rec, dict):
+                continue
+            e, rid = rec.get("e"), rec.get("rid")
+            if rid is None:
+                continue
+            if e == "accept":
+                out[rid] = {"tenant": rec.get("tenant"),
+                            "prompt": list(rec.get("prompt") or ()),
+                            "max_new": int(rec.get("max_new") or 0),
+                            "prio": int(rec.get("prio") or 0),
+                            "tokens": [], "done": None}
+            elif e == "emit" and rid in out:
+                r = out[rid]
+                new, _dup = merge_emission(len(r["tokens"]), 0,
+                                           int(rec.get("o") or 0),
+                                           list(rec.get("t") or ()))
+                if new:
+                    r["tokens"].extend(new)
+            elif e == "done" and rid in out:
+                out[rid]["done"] = rec.get("status") or COMPLETED
+        return out
+
+    @staticmethod
+    def unfinished(state: dict[str, dict]) -> list[dict]:
+        """Re-admission plan from :meth:`load` output: every accepted
+        request without a terminal record, as ``{"rid", "tenant",
+        "prompt" (original + emitted prefix), "max_new" (remaining),
+        "base" (tokens already delivered), "prio"}`` — exactly the
+        admit the driver sends after a heal."""
+        plan = []
+        for rid, r in state.items():
+            if r["done"] is not None:
+                continue
+            emitted = r["tokens"]
+            remaining = r["max_new"] - len(emitted)
+            if remaining <= 0:
+                continue
+            plan.append({"rid": rid, "tenant": r["tenant"],
+                         "prompt": list(r["prompt"]) + list(emitted),
+                         "max_new": remaining, "base": len(emitted),
+                         "prio": r["prio"]})
+        return plan
+
+
+class _Req:
+    __slots__ = ("rid", "tenant", "prompt", "max_new", "priority",
+                 "tokens", "state", "base", "placed", "replay",
+                 "ticket", "released", "submitted_ts", "finished_ts",
+                 "resumes", "stream_resumed", "error")
+
+    def __init__(self, rid: str, tenant: str, prompt: list[int],
+                 max_new: int, priority: int, ticket):
+        self.rid = rid
+        self.tenant = tenant
+        self.prompt = prompt
+        self.max_new = max_new
+        self.priority = priority
+        self.tokens: list[int] = []
+        self.state = ACCEPTED          # accepted | completed | shed | failed
+        self.base = 0                  # stream offset of current placement
+        self.placed = False            # admitted to the decode rank
+        self.replay = False            # next admit is a journal replay
+        self.released = False          # host-side record freed worker-side
+        self.ticket = ticket
+        self.submitted_ts = time.time()
+        self.finished_ts: float | None = None
+        self.resumes = 0               # journal re-admissions (heals)
+        self.stream_resumed = False    # counted one client resume
+        self.error: str | None = None
+
+
+class _RankLost(RuntimeError):
+    """The decode rank died or stopped answering: fail over."""
+
+
+class ServingManager:
+    """One serving tenant's request plane + decode driver.
+
+    Owned by the :class:`~.daemon.GatewayDaemon` (``serve_start``),
+    but deliberately decoupled from it: the constructor takes the
+    coordinator-side ``comm`` plus two delivery callables, so unit
+    tests drive the whole admission/journal/failover machinery against
+    a fake comm with no pool.
+
+    ``deliver(tenant_name, reply_message)`` routes a TERMINAL result
+    (delivered-or-parked — the daemon wires it to its mailbox path);
+    ``notify(tenant_name, message)`` best-effort pushes a live
+    ``serve_tokens`` notice.
+    """
+
+    def __init__(self, comm, run_dir: str, *, tenant: str = "serve",
+                 params_name: str = "params", cfg_name: str = "cfg",
+                 spec: str | None = None,
+                 max_batch: int | None = None,
+                 max_len: int | None = None, pad_to: int = 16,
+                 eos_id: int | None = None, temperature: float = 0.0,
+                 steps: int | None = None,
+                 step_timeout: float | None = None,
+                 queue_depth: int | None = None,
+                 inflight: int | None = None,
+                 world_size: int | None = None,
+                 deliver=None, notify=None, flight=None):
+        self.comm = comm
+        self.run_dir = run_dir
+        self.tenant = tenant
+        self.params_name = params_name
+        self.cfg_name = cfg_name
+        self.spec = spec
+        self.max_batch = max_batch if max_batch is not None \
+            else knobs.get_int("NBD_SERVE_MAX_BATCH", 8)
+        self.max_len = max_len if max_len is not None \
+            else knobs.get_int("NBD_SERVE_MAX_LEN", 512)
+        self.pad_to = max(1, int(pad_to))
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.steps = steps if steps is not None \
+            else knobs.get_int("NBD_SERVE_STEPS", 8)
+        self.step_timeout = step_timeout if step_timeout is not None \
+            else knobs.get_float("NBD_SERVE_STEP_TIMEOUT_S", 120.0)
+        qd = queue_depth if queue_depth is not None \
+            else knobs.get_int("NBD_SERVE_QUEUE_DEPTH", 64)
+        infl = inflight if inflight is not None \
+            else knobs.get_int("NBD_SERVE_INFLIGHT", 32)
+        self.world_size = world_size if world_size is not None \
+            else getattr(comm, "num_workers", 1)
+        self._deliver = deliver or (lambda _t, _m: None)
+        self._notify = notify or (lambda _t, _m: None)
+        self._flight = flight
+        # One KV slot per scheduler mesh-slot: a granted ticket IS a
+        # free slot on the decode server, so admission, queueing, and
+        # shedding reuse the pool scheduler's exact verdict machinery
+        # (fair mode: the submitting tenant's SLO priority first).
+        self.sched = Scheduler(SchedPolicy(
+            "fair", mesh_slots=self.max_batch, tenant_inflight=infl,
+            queue_depth=qd))
+        self.journal = ServeJournal(journal_path(run_dir, tenant))
+        self._lock = threading.Lock()
+        self._reqs: dict[str, _Req] = {}
+        self._next_rid = 0
+        self._open_rank: int | None = None
+        # rank -> monotonic deadline to avoid it: a rank whose
+        # serve_open failed (missing namespace after a reconnect,
+        # OOM building the server) must not be retried forever while
+        # lower ranks could serve.
+        self._avoid: dict[int, float] = {}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._driver: threading.Thread | None = None
+        self.started_ts = time.time()
+        # Counters (all read under the lock for describe()).
+        self.accepted = 0
+        self.completed = 0
+        self.shed = 0
+        self.rejected = 0
+        self.replayed = 0       # re-admissions after a failover
+        self.resumed = 0        # stream resumes from a client offset
+        self.failovers = 0
+        self.step_retries = 0
+        self.dup_dropped = 0
+        self.tokens_total = 0
+        self.last_error: str | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self, *, spec_timeout: float = 600.0) -> dict:
+        """Seed the serving tenant's namespace (run the model-spec
+        cell on every live rank) and start the decode driver.  Raises
+        on a spec error — a serving plane without a model is refused
+        at start, not discovered at the first submit.
+
+        A pre-existing journal for this tenant (the previous daemon
+        died, or a serve_stop/serve_start cycle) is RECOVERED first:
+        every journaled request without a terminal record is re-entered
+        through the scheduler and re-admitted from prompt + emitted
+        prefix — "accepted" survives gateway death too, not just rank
+        death."""
+        self._recover_from_journal()
+        if self.spec:
+            live = self._live_ranks()
+            if not live:
+                raise RuntimeError("no live ranks to serve on")
+            resps = self.comm.send_to_ranks(
+                live, "execute",
+                {"code": self.spec, "target_ranks": live},
+                tenant=self.tenant, timeout=spec_timeout)
+            for r, m in resps.items():
+                err = (m.data or {}).get("error")
+                if err:
+                    raise RuntimeError(
+                        f"model spec failed on rank {r}: {err}")
+        self._driver = threading.Thread(target=self._run,
+                                        name=f"nbd-serve-{self.tenant}",
+                                        daemon=True)
+        self._driver.start()
+        self._record("serve_start", tenant=self.tenant,
+                     max_batch=self.max_batch, max_len=self.max_len)
+        return self.describe()
+
+    def _recover_from_journal(self) -> None:
+        """Re-enter every journaled-but-unfinished request from a
+        previous serving plane's journal (same run dir + tenant).
+        Each one goes back through the scheduler under its original
+        submitter and priority, carries its already-emitted prefix
+        (the offset dedup takes it from there), and counts as a
+        replay.  Over-budget admission at recovery (a smaller queue
+        than the previous plane's) sheds with a delivered verdict —
+        never silently."""
+        state = ServeJournal.load(self.journal.path)
+        if not state:
+            return
+        recovered = 0
+        for rid, r in sorted(state.items()):
+            # Keep fresh rids past every journaled one, finished or
+            # not — reusing a rid would cross-wire journal streams.
+            try:
+                n = int(rid.lstrip("r"))
+            except ValueError:
+                n = -1
+            with self._lock:
+                self._next_rid = max(self._next_rid, n + 1)
+            if r["done"] is not None \
+                    or len(r["tokens"]) >= r["max_new"]:
+                continue
+            ticket = self.sched.submit(r["tenant"] or "unknown", rid,
+                                       r["prio"])
+            req = _Req(rid, r["tenant"], list(r["prompt"]),
+                       r["max_new"], r["prio"], ticket)
+            req.tokens = list(r["tokens"])
+            req.replay = True
+            with self._lock:
+                self._reqs[rid] = req
+                self.accepted += 1
+            recovered += 1
+            if ticket.verdict.get("status") in ("shed", "rejected"):
+                self._finish(req, SHED_V,
+                             error="journaled request shed at "
+                                   "recovery: the restarted serving "
+                                   "plane's admission bounds could "
+                                   "not re-admit it")
+        if recovered:
+            self._record("serve_recovered", n=recovered)
+            obs_metrics.registry().counter(
+                "nbd_serve_recovered_total",
+                "journaled requests re-entered by a successor "
+                "serving plane", {"tenant": self.tenant}).inc(recovered)
+            self._wake.set()
+
+    def stop(self, *, close_workers: bool = True) -> None:
+        self._stop.set()
+        self._wake.set()
+        d = self._driver
+        if d is not None and d is not threading.current_thread():
+            d.join(timeout=max(5.0, self.step_timeout + 5.0))
+        if close_workers:
+            try:
+                self.comm.post(self._live_ranks(), "serve_close",
+                               {"tenant": self.tenant})
+            except Exception:
+                pass
+        self.journal.close()
+        self._record("serve_stop", tenant=self.tenant)
+
+    # ------------------------------------------------------------------
+    # ingress (tenant-plane threads)
+
+    def submit(self, tenant_name: str, prompt, max_new: int, *,
+               priority: int = 0) -> dict:
+        """Admit one generation request; returns its explicit verdict.
+
+        ``{"status": "accepted", "rid": ..., "queued": bool,
+        "position": n?}`` — journaled, will decode;
+        ``{"status": "shed"| "rejected", ...}`` — refused with the
+        reason; nothing journaled.  Accepted-then-shed (a LATER burst
+        pushed this request out of the bounded queue) is delivered as
+        a terminal shed verdict through the mailbox discipline."""
+        reg = obs_metrics.registry()
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            return {"status": REJECTED_V, "reason": "bad-prompt",
+                    "error": "prompt must be a list of token ids"}
+        if not prompt or max_new < 1:
+            return {"status": REJECTED_V, "reason": "bad-prompt",
+                    "error": "prompt must be non-empty and "
+                             "max_new_tokens >= 1"}
+        if len(prompt) + int(max_new) > self.max_len:
+            return {"status": REJECTED_V, "reason": "too-long",
+                    "error": f"prompt ({len(prompt)}) + max_new_tokens "
+                             f"({max_new}) exceeds the server's "
+                             f"max_len {self.max_len}"}
+        with self._lock:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        ticket = self.sched.submit(tenant_name, rid, int(priority))
+        v = ticket.verdict
+        if v["status"] == "rejected":
+            with self._lock:
+                self.rejected += 1
+            reg.counter("nbd_serve_requests_total",
+                        "serving requests by admission verdict",
+                        {"tenant": self.tenant,
+                         "verdict": "rejected"}).inc()
+            return {"status": REJECTED_V,
+                    "reason": v.get("reason", "rejected"),
+                    "error": f"request rejected: "
+                             f"{v.get('reason', 'admission')} — wait "
+                             f"for in-flight requests to finish"}
+        if v["status"] == "shed":
+            with self._lock:
+                self.shed += 1
+            reg.counter("nbd_serve_requests_total",
+                        "serving requests by admission verdict",
+                        {"tenant": self.tenant, "verdict": "shed"}).inc()
+            self._shed_victims(v.get("victims") or ())
+            return {"status": SHED_V, "reason": "overload",
+                    "error": "request shed under overload: the serve "
+                             "queue was full and this was the lowest-"
+                             "priority pending request — retry, or "
+                             "raise priority"}
+        # Accepted (dispatch = a KV slot is free now; queued = waits
+        # for one).  Journal BEFORE the verdict returns: "accepted"
+        # must mean "survives a rank death".
+        req = _Req(rid, tenant_name, prompt, int(max_new),
+                   int(priority), ticket)
+        self.journal.accept(rid, tenant_name, prompt, int(max_new),
+                            int(priority))
+        with self._lock:
+            self._reqs[rid] = req
+            self.accepted += 1
+        reg.counter("nbd_serve_requests_total",
+                    "serving requests by admission verdict",
+                    {"tenant": self.tenant, "verdict": "accepted"}).inc()
+        self._record("serve_accept", rid=rid, tenant=tenant_name,
+                     queued=v["status"] == "queued")
+        self._shed_victims(v.get("victims") or ())
+        # A CONCURRENT submit may have shed this ticket as a victim in
+        # the window before the _reqs insertion above — its
+        # _shed_victims found nothing to finish, which would leave the
+        # request ACCEPTED-forever (and the driver spinning on work it
+        # can never admit).  Re-check after insertion; _finish is
+        # idempotent under the lock, so racing a late victim pass is
+        # safe.
+        if req.ticket.state == TICKET_SHED:
+            self._finish(req, SHED_V,
+                         error="request shed under overload after "
+                               "acceptance: a concurrent burst filled "
+                               "the serve queue and this was the "
+                               "lowest-priority pending request")
+        self._wake.set()
+        out = {"status": ACCEPTED, "rid": rid,
+               "queued": v["status"] == "queued"}
+        if v.get("position") is not None:
+            out["position"] = v["position"]
+        return out
+
+    def _shed_victims(self, victims) -> None:
+        """An admission round shed OTHER pending requests: finish them
+        with a terminal shed verdict (their submitters already hold an
+        'accepted' — the shed must be delivered, not silent)."""
+        for vic in victims:
+            rid = vic.get("msg_id")
+            with self._lock:
+                req = self._reqs.get(rid)
+                if req is None or req.state != ACCEPTED:
+                    continue
+            self._finish(req, SHED_V,
+                         error="request shed under overload after "
+                               "acceptance: a later burst filled the "
+                               "serve queue and this was the lowest-"
+                               "priority pending request")
+
+    def result(self, rid: str) -> dict:
+        with self._lock:
+            req = self._reqs.get(rid)
+            if req is None:
+                return {"status": "unknown",
+                        "error": f"unknown request {rid!r}"}
+            return {"status": req.state, "rid": rid,
+                    "tokens": list(req.tokens),
+                    "done": req.state != ACCEPTED,
+                    **({"error": req.error} if req.error else {})}
+
+    def stream(self, rid: str, from_offset: int = 0) -> dict:
+        """The reattach-resume path: everything past the client's last
+        acked offset, plus done/status so a finished stream closes.
+
+        A *resume* is counted at most once per request, and only when
+        the read actually replays tokens the caller did not have
+        (``from_offset`` strictly inside the stream) — an incremental
+        polling loop that stays caught up never inflates the
+        counter."""
+        with self._lock:
+            req = self._reqs.get(rid)
+            if req is None:
+                return {"status": "unknown",
+                        "error": f"unknown request {rid!r}"}
+            o = max(0, int(from_offset))
+            resumed = (0 < o < len(req.tokens)
+                       and not req.stream_resumed)
+            if resumed:
+                req.stream_resumed = True
+                self.resumed += 1
+            toks = list(req.tokens[o:])
+            done = req.state != ACCEPTED
+            st = req.state
+        if resumed:
+            obs_metrics.registry().counter(
+                "nbd_serve_resumed_total",
+                "token streams resumed from a client-acked offset "
+                "(reattach mid-generation)",
+                {"tenant": self.tenant}).inc()
+        return {"status": st, "rid": rid, "offset": o, "tokens": toks,
+                "done": done}
+
+    def describe(self) -> dict:
+        with self._lock:
+            active = sum(1 for r in self._reqs.values()
+                         if r.state == ACCEPTED and r.placed)
+            pending = sum(1 for r in self._reqs.values()
+                          if r.state == ACCEPTED and not r.placed)
+            d = {"tenant": self.tenant, "decode_rank": self._open_rank,
+                 "accepted": self.accepted, "completed": self.completed,
+                 "shed": self.shed, "rejected": self.rejected,
+                 "replayed": self.replayed, "resumed": self.resumed,
+                 "failovers": self.failovers,
+                 "step_retries": self.step_retries,
+                 "dup_dropped": self.dup_dropped,
+                 "tokens_total": self.tokens_total,
+                 "decoding": active, "pending": pending,
+                 "slots": self.max_batch, "max_len": self.max_len,
+                 "last_error": self.last_error}
+        d["scheduler"] = self.sched.snapshot()
+        return d
+
+    def forget_tenant(self, name: str) -> None:
+        """Mirror the pool scheduler's eviction hygiene for the serve
+        scheduler's per-submitter stats."""
+        try:
+            self.sched.forget_tenant(name)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # decode driver (one thread)
+
+    def _record(self, event: str, **kw) -> None:
+        fl = self._flight
+        if fl is not None:
+            try:
+                fl.record(event, **kw)
+            except Exception:
+                pass
+
+    def _live_ranks(self) -> list[int]:
+        try:
+            dead = self.comm.dead_ranks()
+        except Exception:
+            dead = set()
+        return sorted(set(range(self.world_size)) - set(dead))
+
+    def _pick_rank(self) -> int | None:
+        """The decode rank: the HIGHEST live rank.  Highest, not
+        lowest, on purpose — rank 0 hosts the jax.distributed
+        coordination service, whose death kills every other rank's
+        process (that failure class is the supervisor's full-world
+        heal, not a serving failover), so the decode loop keeps its
+        blast radius off it.  Ranks whose serve_open recently failed
+        are skipped until their backoff expires; with every live rank
+        avoided, the backoff is overridden (retrying beats stalling)."""
+        live = self._live_ranks()
+        if not live:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            usable = [r for r in live
+                      if self._avoid.get(r, 0.0) <= now]
+        return (usable or live)[-1]
+
+    def _has_work_locked(self) -> bool:
+        return any(r.state == ACCEPTED for r in self._reqs.values())
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                work = self._has_work_locked()
+            if not work:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            try:
+                self._tick()
+            except _RankLost:
+                self._on_rank_lost()
+            except Exception as e:  # never kill the driver
+                with self._lock:
+                    self.last_error = f"{type(e).__name__}: {e}"
+                self._record("serve_driver_error",
+                             error=self.last_error)
+                if self._stop.wait(0.5):
+                    return
+
+    def _on_rank_lost(self) -> None:
+        """The decode rank died (or stopped answering within the retry
+        budget): un-place every in-flight request — the next tick
+        re-opens on the next live rank and re-admits each one from its
+        journaled prompt + emitted prefix."""
+        with self._lock:
+            lost = self._open_rank
+            self._open_rank = None
+            self.failovers += 1
+            for r in self._reqs.values():
+                if r.state == ACCEPTED and r.placed:
+                    r.placed = False
+                    r.replay = True
+        obs_metrics.registry().counter(
+            "nbd_serve_failovers_total",
+            "decode-rank failovers (rank death or step-retry budget "
+            "exhausted)", {"tenant": self.tenant}).inc()
+        self._record("serve_failover", lost_rank=lost)
+        if lost is not None:
+            # Best-effort: if the rank is merely unreachable (not
+            # dead), free its now-orphaned DecodeServer.
+            try:
+                self.comm.post([lost], "serve_close",
+                               {"tenant": self.tenant})
+            except Exception:
+                pass
+        self._stop.wait(0.2)
+
+    def _open_on(self, rank: int) -> None:
+        resp = self.comm.send_to_ranks(
+            [rank], "serve_open",
+            {"tenant": self.tenant, "params": self.params_name,
+             "cfg": self.cfg_name, "max_batch": self.max_batch,
+             "max_len": self.max_len, "pad_to": self.pad_to,
+             "eos_id": self.eos_id, "temperature": self.temperature,
+             "reset": True},
+            tenant=self.tenant, timeout=self.step_timeout)
+        err = (resp[rank].data or {}).get("error")
+        if err:
+            # Back off this rank so the next tick can fail over to a
+            # lower live rank instead of wedging on one broken open
+            # (e.g. a rank that reconnected after the model spec ran).
+            with self._lock:
+                self._avoid[rank] = time.monotonic() + 60.0
+            raise RuntimeError(f"serve_open failed on rank {rank}: "
+                               f"{err}")
+        with self._lock:
+            self._open_rank = rank
+            self._avoid.pop(rank, None)
+        self._record("serve_open", rank=rank)
+
+    def _take_admits_locked(self) -> list[dict]:
+        """Requests holding an ACTIVE scheduler ticket but not yet
+        placed on the decode rank — first admissions AND journal
+        re-admissions (the latter carry the emitted prefix)."""
+        admits = []
+        replays = 0
+        for r in self._reqs.values():
+            if r.state != ACCEPTED or r.placed \
+                    or r.ticket.state != ACTIVE:
+                continue
+            r.base = len(r.tokens)
+            r.placed = True
+            if r.replay:
+                r.replay = False
+                r.resumes += 1
+                self.replayed += 1
+                replays += 1
+            admits.append({"rid": r.rid,
+                           "prompt": list(r.prompt) + list(r.tokens),
+                           "max_new": r.max_new - r.base})
+        if replays:
+            obs_metrics.registry().counter(
+                "nbd_serve_replayed_total",
+                "requests re-admitted from the journal after a "
+                "failover (re-prefill from prompt + emitted prefix)",
+                {"tenant": self.tenant}).inc(replays)
+        return admits
+
+    def _tick(self) -> None:
+        rank = self._pick_rank()
+        if rank is None:
+            # Whole pool dead/unreachable: keep the journal and WAIT
+            # for a heal — accepted requests survive by contract.  A
+            # wait state, not a failover: any prior placement was
+            # already un-placed by the rank-lost path.
+            self._stop.wait(1.0)
+            return
+        with self._lock:
+            cur = self._open_rank
+        if cur != rank:
+            self._open_on(rank)
+            with self._lock:
+                # A fresh server has no placements: anything that
+                # thought it was placed must re-admit as a replay.
+                for r in self._reqs.values():
+                    if r.state == ACCEPTED and r.placed:
+                        r.placed = False
+                        r.replay = True
+        with self._lock:
+            admits = self._take_admits_locked()
+            release = [r.rid for r in self._reqs.values()
+                       if r.state != ACCEPTED and r.placed
+                       and not r.released]
+            for rid in release:
+                self._reqs[rid].released = True
+        data = self._send_step(rank, {"tenant": self.tenant,
+                                      "admit": admits,
+                                      "release": release,
+                                      "steps": self.steps})
+        if data.get("error"):
+            # Whole-step refusal (e.g. the rank lost its serving
+            # state): treat like a dead rank — re-open and re-admit
+            # from the journal instead of spinning on errors.
+            self._record("serve_step_refused", rank=rank,
+                         error=str(data["error"])[:200])
+            raise _RankLost(str(data["error"]))
+        self._apply_reply(data)
+
+    def _send_step(self, rank: int, payload: dict) -> dict:
+        """One serve_step round trip, redelivered under the SAME
+        message id on timeouts (the worker replay cache answers a
+        request that already ran — decode never double-steps).  A dead
+        rank, or a rank that exhausts the retry budget, raises
+        :class:`_RankLost` for the failover path."""
+        from ..messaging.coordinator import WorkerDied
+        mid = uuid.uuid4().hex
+        last: Exception | None = None
+        for attempt in range(3):
+            try:
+                resp = self.comm.send_to_ranks(
+                    [rank], "serve_step", payload, tenant=self.tenant,
+                    msg_id=mid, timeout=self.step_timeout)
+                return resp[rank].data or {}
+            except WorkerDied as e:
+                raise _RankLost(str(e)) from e
+            except Exception as e:
+                last = e
+                with self._lock:
+                    self.step_retries += 1
+                obs_metrics.registry().counter(
+                    "nbd_serve_step_retries_total",
+                    "serve_step dispatches redelivered after a "
+                    "timeout (same msg_id; replay-cache dedup)",
+                    {"tenant": self.tenant}).inc()
+                self._record("serve_step_retry", rank=rank,
+                             attempt=attempt + 1,
+                             error=f"{type(e).__name__}: {e}")
+                if self._stop.is_set():
+                    raise _RankLost("stopping") from e
+        # Alive-but-unresponsive: it stays in the live set, so back it
+        # off explicitly or the next tick would pick it right back.
+        with self._lock:
+            self._avoid[rank] = time.monotonic() + 60.0
+        raise _RankLost(f"step retry budget exhausted: {last}")
+
+    def _apply_reply(self, data: dict) -> None:
+        reg = obs_metrics.registry()
+        emitted = data.get("emitted") or {}
+        errors = data.get("errors") or {}
+        for rid, err in errors.items():
+            with self._lock:
+                req = self._reqs.get(rid)
+            if req is not None and req.state == ACCEPTED:
+                self._finish(req, FAILED, error=str(err))
+        for rid, em in emitted.items():
+            with self._lock:
+                req = self._reqs.get(rid)
+                if req is None or req.state != ACCEPTED:
+                    continue
+                have = len(req.tokens)
+                base = req.base
+            new, dup = merge_emission(have, base,
+                                      int(em.get("o") or 0),
+                                      list(em.get("t") or ()))
+            if new is None:
+                # A gap would corrupt the stream: fail the request
+                # loudly rather than journal around a hole.
+                self._finish(req, FAILED,
+                             error="emission gap (protocol bug): "
+                                   f"offset {base + int(em.get('o') or 0)} "
+                                   f"past stream length {have}")
+                continue
+            if dup:
+                with self._lock:
+                    self.dup_dropped += dup
+                reg.counter(
+                    "nbd_serve_dup_dropped_total",
+                    "tokens dropped by offset dedup (replayed or "
+                    "redelivered emissions) — exactly-once delivery's "
+                    "receipt", {"tenant": self.tenant}).inc(dup)
+            if not new:
+                continue
+            self.journal.emit(rid, have, new)
+            with self._lock:
+                req.tokens.extend(new)
+                self.tokens_total += len(new)
+                done = (len(req.tokens) >= req.max_new
+                        or (self.eos_id is not None
+                            and self.eos_id in new))
+                offset = have
+            reg.counter("nbd_serve_tokens_total",
+                        "generated tokens delivered",
+                        {"tenant": self.tenant}).inc(len(new))
+            if done:
+                self._finish(req, COMPLETED)
+            else:
+                self._notify_tokens(req, offset, new)
+
+    def _finish(self, req: _Req, status: str,
+                error: str | None = None) -> None:
+        """Terminal transition: journal the verdict, free the KV slot
+        (promoting queued requests), and deliver the result
+        delivered-or-parked-exactly-once."""
+        with self._lock:
+            if req.state != ACCEPTED:
+                return
+            req.state = status
+            req.error = error
+            req.finished_ts = time.time()
+            if status == COMPLETED:
+                self.completed += 1
+            elif status == SHED_V:
+                self.shed += 1
+        self.journal.done(req.rid, status)
+        self.sched.complete(req.rid)
+        self._wake.set()
+        obs_metrics.registry().counter(
+            "nbd_serve_finished_total",
+            "serving requests reaching a terminal state",
+            {"tenant": self.tenant, "status": status}).inc()
+        self._record("serve_finish", rid=req.rid, status=status,
+                     n_tokens=len(req.tokens))
+        # Terminal delivery through the mailbox discipline: parked for
+        # exactly-once redelivery when the submitter has no kernel.
+        # This (not a last serve_tokens notice) is the ONE terminal
+        # signal, so a live client never sees the finish twice.
+        reply = Message(
+            msg_type="serve_done", msg_id=f"serve:{req.rid}",
+            data={"status": status, "rid": req.rid,
+                  "tokens": list(req.tokens),
+                  **({"error": error} if error else {})})
+        try:
+            self._deliver(req.tenant, reply)
+        except Exception:
+            pass
+
+    def _notify_tokens(self, req: _Req, offset: int,
+                       toks: list[int]) -> None:
+        """Best-effort live streaming: tokens push to the submitting
+        tenant's connection as they land.  A lost notice costs
+        nothing — the journaled stream is claimable via serve_stream
+        (offset resume) and the terminal serve_done."""
+        msg = Message(msg_type="serve_tokens",
+                      data={"rid": req.rid, "o": offset, "t": toks})
+        try:
+            self._notify(req.tenant, msg)
+        except Exception:
+            pass

@@ -75,6 +75,9 @@ and write→read dependency DAG) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
 %dist_pool start|status|stop (shared multi-tenant worker pool;
 %dist_attach --tenant NAME joins it with an isolated namespace) ·
+%dist_serve start|status|stop|submit|result|stream (chaos-hardened
+continuous-batching generation through the pool: journaled requests
+survive rank death; explicit shed/rejected verdicts under overload) ·
 %dist_gc (sweep stale session run dirs) ·
 %timeline_show · %timeline_sidecar (in-notebook persistence) ·
 %dist_shutdown (explicit fleet teardown — a kernel restart alone only
@@ -986,6 +989,23 @@ class DistributedMagics(Magics):
                              name="nbd-parked-drain").start()
 
         client.on_parked = _on_parked
+
+        def _on_serve(d: dict) -> None:
+            # Serving-plane pushes (reader thread): incremental token
+            # notices while a %dist_serve request decodes, and the
+            # live terminal result.
+            rid = d.get("rid")
+            if d.get("status") is not None or d.get("done"):
+                n = len(d.get("tokens") or ())
+                st = d.get("status") or "done"
+                extra = (f": {d['error']}" if d.get("error") else
+                         f" ({n} tokens)")
+                print(f"\n🧾 serve {rid} {st}{extra}")
+            elif d.get("t"):
+                print(f"\n📡 serve {rid}[{d.get('o')}] "
+                      f"+{list(d['t'])}")
+
+        client.on_serve = _on_serve
         DistributedMagics._tenant = client
         DistributedMagics._pool_info = {"run_dir": d, **manifest}
         DistributedMagics._world = client.world_size
@@ -1242,12 +1262,19 @@ class DistributedMagics(Magics):
         busy_rows = [(r, v) for r, v in sorted(ranks.items(),
                                                key=lambda kv:
                                                int(kv[0]))
-                     if v.get("busy_type")]
+                     if v.get("busy_type") or v.get("srv")]
         for r, v in busy_rows:
             who = (f" · tenant {v['tenant']}" if v.get("tenant")
                    else "")
-            print(f"   rank {r}: ⚙ {v['busy_type']} "
-                  f"{v.get('busy_s', 0):.1f}s{who}")
+            busy = (f"⚙ {v['busy_type']} {v.get('busy_s', 0):.1f}s"
+                    if v.get("busy_type") else "idle")
+            srv = v.get("srv") or {}
+            scol = (f" · 🔄 {srv.get('tps', 0)} tok/s · KV "
+                    f"{srv.get('occ', 0)}/{srv.get('slots', 0)}"
+                    if srv else "")
+            print(f"   rank {r}: {busy}{who}{scol}")
+        if st.get("serving"):
+            self._render_serve_status(st["serving"])
         for v in st.get("hang_verdicts") or ():
             print(f"   ⚠ HUNG [{v.get('kind')}] {v.get('detail')}")
 
@@ -1320,6 +1347,150 @@ class DistributedMagics(Magics):
             if d.get("error"):
                 print(f"❌ rank {r}: {d['error']}")
         return results
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["start", "status", "stop", "submit", "result",
+                       "stream"])
+    @argument("--spec", default=None,
+              help="kernel variable holding the model-spec cell "
+                   "(code that binds params/cfg in the serving "
+                   "tenant's namespace on every rank)")
+    @argument("--tenant", default=None,
+              help="serving tenant name (default 'serve')")
+    @argument("--params", default=None,
+              help="params name in the serving namespace")
+    @argument("--cfg", default=None,
+              help="config name in the serving namespace")
+    @argument("--max-batch", type=int, default=None,
+              help="KV slots (continuous-batching width)")
+    @argument("--max-len", type=int, default=None)
+    @argument("--pad-to", type=int, default=None)
+    @argument("--eos", type=int, default=None)
+    @argument("--steps", type=int, default=None,
+              help="decode steps per serve tick")
+    @argument("--queue-depth", type=int, default=None)
+    @argument("--inflight", type=int, default=None)
+    @argument("--prompt", default=None,
+              help="comma-separated token ids (submit)")
+    @argument("--max-new", type=int, default=16)
+    @argument("--priority", type=int, default=None)
+    @argument("--rid", default=None, help="request id (result/stream)")
+    @argument("--from", dest="from_offset", type=int, default=0,
+              help="resume offset (stream) — your last acked token")
+    @argument("--wait", action="store_true",
+              help="submit: block until the request finishes and "
+                   "print its tokens")
+    @line_magic
+    def dist_serve(self, line):
+        """Serving through the gateway (tenant mode): ``%dist_serve
+        start --spec SPEC_VAR`` opens a continuous-batching decode
+        loop on the pool; ``submit --prompt 1,2,3 --max-new 16``
+        enters a generation request (explicit accepted/shed/rejected
+        verdicts, tokens stream back live); ``result``/``stream
+        --from K`` poll or resume a stream; ``status``/``stop`` manage
+        the plane.  Accepted requests are journaled and survive rank
+        death — see README "Serving through the gateway"."""
+        from ..gateway.client import CellSubmitError, GatewayGone
+        client = DistributedMagics._tenant
+        if client is None:
+            print("❌ not attached to a gateway pool — %dist_attach "
+                  "--tenant NAME first (%dist_pool start spawns one)")
+            return
+        args = parse_argstring(self.dist_serve, line)
+        try:
+            if args.command == "start":
+                spec = None
+                if args.spec:
+                    spec = self.shell.user_ns.get(args.spec)
+                    if not isinstance(spec, str):
+                        print(f"❌ --spec {args.spec}: no string "
+                              "variable of that name in this kernel")
+                        return
+                st = client.serve_start(
+                    spec, tenant=args.tenant, params=args.params,
+                    cfg=args.cfg, max_batch=args.max_batch,
+                    max_len=args.max_len, pad_to=args.pad_to,
+                    eos_id=args.eos, steps=args.steps,
+                    queue_depth=args.queue_depth,
+                    inflight=args.inflight)
+                print(f"🍽️ serving as tenant {st.get('tenant')!r}: "
+                      f"{st.get('slots')} KV slots · max_len "
+                      f"{st.get('max_len')} · decode rank "
+                      f"{st.get('decode_rank')}")
+            elif args.command == "submit":
+                if not args.prompt:
+                    print("❌ submit needs --prompt 1,2,3")
+                    return
+                prompt = [int(t) for t in args.prompt.replace(",", " ")
+                          .split()]
+                v = client.serve_submit(prompt, args.max_new,
+                                        priority=args.priority)
+                rid = v.get("rid")
+                pos = (f" (queued at {v['position']})"
+                       if v.get("queued") else "")
+                print(f"✅ accepted {rid}{pos} — tokens stream here; "
+                      f"%dist_serve result --rid {rid} to poll")
+                if args.wait:
+                    while True:
+                        r = client.serve_result(rid)
+                        if r.get("done"):
+                            print(f"🧾 {rid} {r.get('status')}: "
+                                  f"{r.get('tokens')}")
+                            break
+                        time.sleep(0.3)
+            elif args.command == "result":
+                if not args.rid:
+                    print("❌ result needs --rid rN")
+                    return
+                r = client.serve_result(args.rid)
+                print(f"{args.rid}: {r.get('status')} "
+                      f"{r.get('tokens')}"
+                      + (f" — {r['error']}" if r.get("error") else ""))
+            elif args.command == "stream":
+                if not args.rid:
+                    print("❌ stream needs --rid rN")
+                    return
+                r = client.serve_stream(args.rid, args.from_offset)
+                print(f"{args.rid}[{r.get('offset')}:]: "
+                      f"{r.get('tokens')} "
+                      f"({'done' if r.get('done') else 'decoding'})")
+            elif args.command == "stop":
+                st = client.serve_stop()
+                print(f"🛑 serving stopped: {st.get('completed')} "
+                      f"completed · {st.get('tokens_total')} tokens")
+            else:  # status
+                st = client.serve_status()
+                if st.get("status") == "off":
+                    print("(no serving plane running — %dist_serve "
+                          "start)")
+                    return
+                self._render_serve_status(st)
+        except CellSubmitError as e:
+            v = e.verdict
+            mark = "🪓" if v.get("status") == "shed" else "🚦"
+            print(f"{mark} {v.get('error')}")
+        except GatewayGone as e:
+            print(f"💀 {e}")
+        except Exception as e:
+            print(f"❌ {type(e).__name__}: {e}")
+
+    @staticmethod
+    def _render_serve_status(st: dict) -> None:
+        print(f"🍽️ serving[{st.get('tenant')}] · decode rank "
+              f"{st.get('decode_rank')} · KV "
+              f"{st.get('decoding', 0)}/{st.get('slots')} · pending "
+              f"{st.get('pending', 0)} · tokens "
+              f"{st.get('tokens_total', 0)}")
+        print(f"   accepted {st.get('accepted', 0)} · completed "
+              f"{st.get('completed', 0)} · shed {st.get('shed', 0)} · "
+              f"rejected {st.get('rejected', 0)} · replayed "
+              f"{st.get('replayed', 0)} · resumed "
+              f"{st.get('resumed', 0)} · failovers "
+              f"{st.get('failovers', 0)} · dup-dropped "
+              f"{st.get('dup_dropped', 0)}")
+        if st.get("last_error"):
+            print(f"   ⚠ last driver error: {st['last_error']}")
 
     @magic_arguments()
     @argument("--dry-run", action="store_true",

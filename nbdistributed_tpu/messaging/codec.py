@@ -95,6 +95,10 @@ WIRE_EXTENSIONS: dict[str, dict] = {
             "doc": "collective-progress snapshot (hang watchdog)"},
     "tel": {"plane": "ping",
             "doc": "device telemetry sample (HBM, buffers, compiles)"},
+    "srv": {"plane": "ping",
+            "doc": "serving-loop telemetry while a DecodeServer is "
+                   "live (tokens total, tokens/s, KV-slot occupancy) "
+                   "— the %dist_top / pool-status serving columns"},
 }
 
 

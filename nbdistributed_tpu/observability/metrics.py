@@ -168,6 +168,30 @@ class MetricsRegistry:
     # keep incrementing invisibly forever.  Tests wanting isolation
     # build a fresh MetricsRegistry.
 
+    def remove_label_series(self, label: str, value: str) -> int:
+        """Drop every series whose label set includes
+        ``label="value"``; returns how many series were removed.
+
+        The gateway calls this with ``("tenant", name)`` when a tenant
+        is EVICTED: per-tenant series otherwise accumulate one entry
+        per tenant name for the daemon's lifetime (the PR 8 stated
+        limit this closes).  Only safe for series resolved through the
+        registry at each use site (the per-tenant counters are); a
+        removed series whose handle something cached would keep
+        incrementing invisibly — exactly why there is no blanket
+        ``clear()``.  Metric names whose last series is removed keep
+        their (name, kind, help) registration so a later re-create
+        cannot flip kinds."""
+        removed = 0
+        with self._lock:
+            for _name, (_kind, _help, series) in self._metrics.items():
+                doomed = [key for key in series
+                          if (label, str(value)) in key]
+                for key in doomed:
+                    del series[key]
+                removed += len(doomed)
+        return removed
+
     # ------------------------------------------------------------------
     # export
 

@@ -50,6 +50,39 @@ def _load_hf_pretrained_lazy(name_or_path, **kw):
 
 HEARTBEAT_INTERVAL_S = 2.0
 
+
+class _WorkerServe:
+    """One serving tenant's worker-side decode state: the
+    :class:`~..models.serving.DecodeServer` plus the request-id map and
+    per-request emission cursors the ``serve_step`` protocol needs.
+
+    ``sent[rid]`` is how many of the server's output tokens for that
+    request have ALREADY been put in a reply — each step reply carries
+    only the suffix beyond it, tagged with its offset, which is what
+    lets the gateway dedup replayed/redelivered emissions exactly.
+    """
+
+    __slots__ = ("server", "rids", "sent", "tokens_total", "window")
+
+    def __init__(self, server):
+        self.server = server
+        self.rids: dict[str, int] = {}      # gateway rid -> local id
+        self.sent: dict[str, int] = {}      # gateway rid -> reported
+        self.tokens_total = 0
+        self.window: list[tuple[float, int]] = []  # (t, tokens_total)
+
+    def note_rate(self) -> None:
+        now = time.monotonic()
+        self.window.append((now, self.tokens_total))
+        while self.window and now - self.window[0][0] > 10.0:
+            self.window.pop(0)
+
+    def tokens_per_s(self) -> float:
+        if len(self.window) < 2:
+            return 0.0
+        (t0, n0), (t1, n1) = self.window[0], self.window[-1]
+        return (n1 - n0) / (t1 - t0) if t1 > t0 else 0.0
+
 # Orphan grace (durable sessions, ISSUE 4): when the coordinator dies,
 # the worker does NOT exit — it parks the in-flight cell's result,
 # keeps its namespace and flight recorder, and waits up to
@@ -91,6 +124,11 @@ class DistributedWorker:
         # single-kernel path) keep using self.namespace directly.
         self._tenant_ns: dict[str, dict] = {}
         self._shared_ns: dict = {}
+        # Serving loops (ISSUE 11): tenant -> _WorkerServe.  Mutated
+        # only on the serial request loop; the heartbeat thread reads
+        # the atomically-rebound snapshot below (never the dict).
+        self._serve: dict[str, _WorkerServe] = {}
+        self._serve_snap: dict | None = None
         self._ckpt_async = None          # in-flight background save
         # Resilience state: the reply-replay cache makes request
         # redelivery idempotent (a retried execute NEVER runs twice);
@@ -353,6 +391,13 @@ class DistributedWorker:
             if snap is not None:
                 data = dict(data or {})
                 data["tel"] = snap
+            srv = self._serve_snap  # atomic rebind; safe to read here
+            if srv is not None:
+                # Serving telemetry (ISSUE 11): tokens/s and KV-slot
+                # occupancy ride every ping while a DecodeServer is
+                # live — the %dist_top / pool-status serving columns.
+                data = dict(data or {})
+                data["srv"] = srv
             try:
                 self.channel.send(Message(msg_type="ping",
                                           rank=self.rank, data=data))
@@ -906,13 +951,146 @@ class DistributedWorker:
         return msg.reply(data={"status": "ok", "existed": existed},
                          rank=self.rank)
 
+    # ------------------------------------------------------------------
+    # serving loop (ISSUE 11): the gateway drives a DecodeServer here
+
+    def _handle_serve_open(self, msg: Message) -> Message:
+        """Build (or reset) this rank's :class:`DecodeServer` for a
+        serving tenant from names in that tenant's namespace.  The
+        gateway opens the decode rank lazily and re-opens on the next
+        live rank after a failover — the namespace (params/config) is
+        already seeded on every rank by the serve_start model-spec
+        cell, so any rank can take over."""
+        from ..models import DecodeServer
+
+        data = msg.data or {}
+        tenant = data.get("tenant") or msg.tenant
+        ns = self._ns_for(tenant)
+        pname = data.get("params") or "params"
+        cname = data.get("cfg") or "cfg"
+        if pname not in ns or cname not in ns:
+            return msg.reply(
+                data={"error": f"serving namespace is missing "
+                               f"{pname!r}/{cname!r} — run the model "
+                               f"spec first (%dist_serve start)"},
+                rank=self.rank)
+        try:
+            server = DecodeServer(
+                ns[pname], ns[cname],
+                max_batch=int(data.get("max_batch") or 8),
+                max_len=int(data.get("max_len") or 512),
+                pad_to=int(data.get("pad_to") or 16),
+                eos_id=data.get("eos_id"),
+                temperature=float(data.get("temperature") or 0.0))
+        except Exception as e:
+            return msg.reply(data={"error": f"DecodeServer build "
+                                            f"failed: {e}"},
+                             rank=self.rank)
+        self._serve[tenant] = _WorkerServe(server)
+        self._publish_serve_snap()
+        self._flight.record("serve_open", tenant=tenant,
+                            max_batch=server._B, max_len=server._T)
+        return msg.reply(data={"status": "open", "slots": server._B},
+                         rank=self.rank)
+
+    def _handle_serve_step(self, msg: Message) -> Message:
+        """One decode tick: admit new requests, run up to ``steps``
+        decode steps, reply with per-request emissions AT OFFSETS.
+        ``release`` frees finished requests' host-side records.  The
+        reply is cached by the replay cache like any mutating request,
+        so a redelivered tick never decodes twice."""
+        data = msg.data or {}
+        tenant = data.get("tenant") or msg.tenant
+        st = self._serve.get(tenant)
+        if st is None:
+            return msg.reply(
+                data={"error": "no serving loop open on this rank "
+                               "(serve_open first)"},
+                rank=self.rank)
+        errors: dict[str, str] = {}
+        for a in data.get("admit") or ():
+            rid = a.get("rid")
+            try:
+                local = st.server.submit([int(t) for t in a["prompt"]],
+                                         int(a["max_new"]))
+            except Exception as e:
+                errors[rid] = f"{type(e).__name__}: {e}"
+                continue
+            st.rids[rid] = local
+            st.sent[rid] = 0
+        for rid in data.get("release") or ():
+            local = st.rids.pop(rid, None)
+            st.sent.pop(rid, None)
+            if local is not None:
+                try:
+                    st.server.release(local)
+                except (KeyError, ValueError):
+                    pass
+        steps = max(0, int(data.get("steps") or 0))
+        for _ in range(steps):
+            if st.server.done():
+                break
+            st.server.step()
+        emitted: dict[str, dict] = {}
+        finished: list[str] = []
+        for rid, local in st.rids.items():
+            out = st.server.outputs.get(local, [])
+            o = st.sent.get(rid, 0)
+            if len(out) > o:
+                emitted[rid] = {"o": o, "t": [int(t) for t in out[o:]]}
+                st.tokens_total += len(out) - o
+                st.sent[rid] = len(out)
+            if local in st.server.finished:
+                finished.append(rid)
+        st.note_rate()
+        self._publish_serve_snap()
+        return msg.reply(
+            data={"status": "ok", "emitted": emitted,
+                  "finished": finished, "errors": errors,
+                  "active": st.server.n_active,
+                  "slots": st.server._B,
+                  "pending": len(st.server._pending)},
+            rank=self.rank)
+
+    def _handle_serve_close(self, msg: Message) -> Message:
+        tenant = (msg.data or {}).get("tenant") or msg.tenant
+        existed = tenant in self._serve
+        if existed:
+            del self._serve[tenant]
+            self._flight.record("serve_close", tenant=tenant)
+        self._publish_serve_snap()
+        return msg.reply(data={"status": "ok", "existed": existed},
+                         rank=self.rank)
+
+    def _publish_serve_snap(self) -> None:
+        """Atomically rebind the heartbeat's serving-telemetry view
+        (tokens total, tokens/s, KV-slot occupancy) — the heartbeat
+        thread reads the snapshot, never the live dict."""
+        if not self._serve:
+            self._serve_snap = None
+            return
+        tot = occ = slots = 0
+        tps = 0.0
+        for st in self._serve.values():
+            tot += st.tokens_total
+            occ += st.server.n_active
+            slots += st.server._B
+            tps += st.tokens_per_s()
+        self._serve_snap = {"tok": tot, "tps": round(tps, 2),
+                            "occ": occ, "slots": slots}
+
     def _park(self, msg_type: str, msg_id: str, reply: Message) -> None:
         """Park a reply for redelivery to a future coordinator.
         Read-only replies are skipped (re-probing is safe and their
         staleness makes redelivery noise); mutating results — exactly
         what must not be lost or re-executed — are kept."""
-        if msg_type in _READ_ONLY or msg_type in ("hello", "mailbox",
-                                                  "tenant_gc"):
+        if msg_type in _READ_ONLY or msg_type in (
+                "hello", "mailbox", "tenant_gc",
+                # Serving ticks are NOT parked: the gateway's journal
+                # is the authoritative stream record, and a successor
+                # gateway re-opens a fresh DecodeServer and re-admits
+                # from it — a parked tick reply would be stale noise.
+                "serve_open", "serve_step", "serve_close"):
             return
         self._mailbox.park(msg_id, reply)
         obs_metrics.registry().counter(
@@ -1102,6 +1280,9 @@ class DistributedWorker:
             "hello": self._handle_hello,
             "mailbox": self._handle_mailbox,
             "tenant_gc": self._handle_tenant_gc,
+            "serve_open": self._handle_serve_open,
+            "serve_step": self._handle_serve_step,
+            "serve_close": self._handle_serve_close,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*

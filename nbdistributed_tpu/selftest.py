@@ -285,10 +285,99 @@ _ssrv.run_until_done(max_steps=20)
         pm.shutdown()
         comm.shutdown()
 
+    # Serving smoke (gated: NBD_SELFTEST_SERVE=1): a 2-rank gateway
+    # pool serving 3 requests through %dist_serve's wire surface, with
+    # one injected rank SIGKILL mid-decode — every accepted request
+    # must complete with its exact solo-generate greedy tokens after
+    # the journal-replay failover, with zero duplicated emissions.
+    # Runs AFTER the main fleet is down (its own pool, its own ports).
+    if _knobs.get_raw("NBD_SELFTEST_SERVE"):
+        _serve_smoke(check)
+
     failed = [c for c in checks if not c[1]]
     print(f"\n{len(checks) - len(failed)}/{len(checks)} checks passed",
           flush=True)
     return 1 if failed else 0
+
+
+def _serve_smoke(check) -> None:
+    import ast as _ast
+
+    from nbdistributed_tpu.gateway.client import TenantClient
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+    from nbdistributed_tpu.gateway.scheduler import SchedPolicy
+
+    spec = (
+        "import jax as _j, jax.numpy as _jn\n"
+        "from nbdistributed_tpu.models import tiny_config, init_params\n"
+        "cfg = tiny_config(dtype=_jn.float32, use_flash=False)\n"
+        "params = init_params(_j.random.PRNGKey(0), cfg)\n")
+    ref_cell = (
+        "import jax as _j, jax.numpy as _jn, numpy as _np\n"
+        "from nbdistributed_tpu.models import (tiny_config, "
+        "init_params, generate)\n"
+        "_cfg = tiny_config(dtype=_jn.float32, use_flash=False)\n"
+        "_p = init_params(_j.random.PRNGKey(0), _cfg)\n"
+        "_prompts = [[5, 9, 2], [7, 1], [3, 4, 8, 1]]\n"
+        "[[int(t) for t in _np.asarray(generate(_p, _jn.asarray(pr, "
+        "_jn.int32)[None], _cfg, 6))[0][len(pr):]] for pr in _prompts]")
+    gw = client = None
+    try:
+        gw = GatewayDaemon(
+            2, backend="cpu",
+            policy=SchedPolicy("fair", mesh_slots=1,
+                               tenant_inflight=8, queue_depth=16),
+            request_timeout=None, attach_timeout=240.0,
+            watchdog=False)
+        client = TenantClient(gw.tenant_host, gw.tenant_port, "st",
+                              pool_token=gw.pool_token)
+        out = client.execute(ref_cell, timeout=240)
+        solo = _ast.literal_eval(
+            (out.get("results") or {}).get("0", {}).get("output"))
+        # Arm the mid-decode SIGKILL on the decode rank (the highest
+        # live rank, 1) BEFORE serving starts: spec execute +
+        # serve_open + ticks count toward kill_at, so it dies inside
+        # the decode loop.
+        gw.comm.send_to_ranks([1], "chaos", {
+            "action": "set",
+            "spec": {"seed": 3, "kill_rank": 1, "kill_at": 4}},
+            timeout=60)
+        client.serve_start(spec, max_batch=2, max_len=32, pad_to=4,
+                           steps=2, timeout=300)
+        prompts = [[5, 9, 2], [7, 1], [3, 4, 8, 1]]
+        rids = [client.serve_submit(pr, 6)["rid"] for pr in prompts]
+        got: dict[str, list] = {}
+        deadline = time.time() + 240
+        while len(got) < len(rids) and time.time() < deadline:
+            for rid in rids:
+                if rid in got:
+                    continue
+                r = client.serve_result(rid)
+                if r.get("done"):
+                    got[rid] = (r.get("status"), r.get("tokens"))
+            time.sleep(0.3)
+        st = client.serve_status()
+        ok = (len(got) == len(rids)
+              and all(got[rid] == ("completed", solo[i])
+                      for i, rid in enumerate(rids))
+              and st.get("failovers", 0) >= 1
+              and st.get("dup_dropped", 0) == 0)
+        check("serving smoke (rank SIGKILL mid-decode; journal "
+              "replay; exact greedy streams)", ok,
+              f"got={got} solo={solo} failovers="
+              f"{st.get('failovers')} replayed={st.get('replayed')} "
+              f"dup={st.get('dup_dropped')}")
+    except Exception as e:
+        check("serving smoke harness", False,
+              f"{type(e).__name__}: {e}")
+    finally:
+        try:
+            if client is not None:
+                client.close()
+        except Exception:
+            pass
+        if gw is not None:
+            gw.close()
 
 
 if __name__ == "__main__":

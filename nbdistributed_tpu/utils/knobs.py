@@ -138,6 +138,32 @@ _ALL = (
     _k("NBD_POOL_MAX_TENANTS", "8", "int",
        "Tenant headcount a gateway admits; later hellos are refused "
        "at admission.", "pool"),
+    # --- serving plane (%dist_serve) --------------------------------------
+    _k("NBD_SERVE_MAX_BATCH", "8", "int",
+       "Default KV-slot count (continuous-batching width) of the "
+       "serving DecodeServer; one scheduler mesh-slot per KV slot.",
+       "serve"),
+    _k("NBD_SERVE_MAX_LEN", "512", "int",
+       "Default KV-cache length of the serving DecodeServer; a "
+       "request whose prompt + budget exceeds it is rejected with an "
+       "explicit too-long verdict.", "serve"),
+    _k("NBD_SERVE_STEPS", "8", "int",
+       "Decode steps per serve_step tick — the interleaving "
+       "granularity between decoding and notebook cells on the "
+       "worker's serial loop.", "serve"),
+    _k("NBD_SERVE_QUEUE_DEPTH", "64", "int",
+       "Pending-request bound before the serving plane sheds the "
+       "lowest-priority pending request with a visible verdict "
+       "(0 = unbounded).", "serve"),
+    _k("NBD_SERVE_INFLIGHT", "32", "int",
+       "Per-submitting-tenant cap on pending + decoding requests; a "
+       "tenant at the cap gets an explicit rejected verdict "
+       "(0 = uncapped).", "serve"),
+    _k("NBD_SERVE_STEP_TIMEOUT_S", "120", "float",
+       "Per serve_step round-trip budget; a timed-out tick is "
+       "redelivered under the same message id (replay-cache dedup), "
+       "and an exhausted retry budget fails over to the next live "
+       "rank.", "serve"),
     # --- flight recorder / observability ---------------------------------
     _k("NBD_FLIGHT", "1", "bool",
        "Always-on mmap flight recorder; 0 disables.", "observability"),
@@ -155,6 +181,9 @@ _ALL = (
     _k("NBD_SELFTEST_OBS", None, "bool",
        "nbd-selftest: also run the observability/postmortem sections.",
        "harness"),
+    _k("NBD_SELFTEST_SERVE", None, "bool",
+       "nbd-selftest: also run the serving smoke section (2-rank "
+       "pool, 3 requests, one injected rank kill).", "harness"),
     _k("NBD_BENCH_ONLY", None, "str",
        "bench.py: comma-separated benchmark families to run.",
        "harness"),

@@ -1746,8 +1746,16 @@ def test_protocol_planes_cover_the_real_wire():
         planes["worker"]["handled"])
     assert {"tenant_hello", "execute", "mailbox", "detach"} <= set(
         planes["tenant"]["sent"])
-    assert {"queued", "parked_notice", "stream_output"} == set(
+    assert {"queued", "parked_notice", "stream_output",
+            # ISSUE 11: the serving plane's pushes (serving.py) are
+            # tenant-plane notices too.
+            "serve_tokens", "serve_done"} == set(
         planes["tenant-notice"]["sent"])
+    assert {"serve_submit", "serve_result", "serve_stream",
+            "serve_start", "serve_status", "serve_stop"} <= set(
+        planes["tenant"]["sent"])
+    assert {"serve_open", "serve_step", "serve_close"} <= set(
+        planes["worker"]["handled"])
     assert {"spawn", "signal", "tail", "reap", "poll"} <= set(
         planes["agent"]["sent"])
 
