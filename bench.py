@@ -1354,6 +1354,21 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
 
         extra: dict = {"overhead_ms_per_cell": round(overhead_ms, 3)}
 
+        # Stage-latency decomposition of the cells just timed (ISSUE
+        # 13): WHERE the per-cell overhead goes (queue/wire/dispatch/
+        # compile/execute/reply/deliver p50-p99), so BENCH_* rows can
+        # track dispatch-overhead decomposition across PRs instead of
+        # one opaque overhead number.
+        try:
+            lat = comm.lat.summary()
+            if lat.get("count"):
+                extra["latency_stages"] = lat
+                log(f"[bench] latency stages (ms, p50): "
+                    + ", ".join(f"{s}={v['p50']}" for s, v in
+                                lat["stages"].items()))
+        except Exception as e:
+            log(f"[bench] latency-stage snapshot skipped: {e}")
+
         # The context measurements below are best-effort: a failure
         # there must not discard the already-measured primary metric
         # (the whole point of the fallback ladder is that a JSON line

@@ -159,7 +159,8 @@ class GatewayDaemon:
                  request_timeout: float | None = None,
                  attach_timeout: float = 180.0,
                  pool_token: str | None = None,
-                 watchdog: bool = True):
+                 watchdog: bool = True,
+                 metrics_port: int | None = None):
         from ..manager import ProcessManager, wait_until_ready
         from ..messaging import CommunicationManager
 
@@ -250,6 +251,32 @@ class GatewayDaemon:
         self.tenant_host = host
         self.tenant_port = self._tenants_listener.port
 
+        # Live scrape endpoint (ISSUE 13): /metrics, /healthz,
+        # /latency.json — token-gated with the pool token, like the
+        # admin plane.  Off unless --metrics-port / NBD_METRICS_PORT
+        # asks for it; a NEGATIVE port means "bind an ephemeral port"
+        # (read it back from the manifest) — callers wanting an
+        # OS-assigned port must not pre-claim one and re-bind it, the
+        # classic TOCTOU a busy CI box loses.  A requested-but-
+        # unbindable port fails the start loudly (a deployment that
+        # asked to be scraped must not come up silently unscrapeable),
+        # reaping the fleet like any other construction failure.
+        self._metrics_httpd = None
+        mp = (metrics_port if metrics_port is not None
+              else knobs.get_int("NBD_METRICS_PORT", 0))
+        if mp:
+            from ..observability import httpd as obs_httpd
+            try:
+                self._metrics_httpd = obs_httpd.start_for_comm(
+                    self.comm, port=max(0, mp), host=host,
+                    token=self.pool_token,
+                    extra_health=self._health_extra)
+            except BaseException:
+                self._tenants_listener.close()
+                self.pm.shutdown()
+                self.comm.shutdown()
+                raise
+
         # Hang watchdog over the pool: verdicts carry the tenant of
         # the hung cell (pending snapshots are tenant-tagged), so
         # blame lands on the right notebook.
@@ -307,6 +334,11 @@ class GatewayDaemon:
             "updated_ts": time.time(),
             "tenants": self.registry.manifest_block(),
         }
+        if self._metrics_httpd is not None:
+            # Where to scrape this pool (token = the pool token the
+            # manifest already carries).
+            m["metrics"] = {"host": self.tenant_host,
+                            "port": self._metrics_httpd.port}
         self._created_ts = m["created_ts"]
         path = gateway_manifest_path(self.run_dir)
         tmp = path + ".tmp"
@@ -674,9 +706,16 @@ class GatewayDaemon:
         except (TypeError, ValueError):
             prio = tenant.priority
         reg = obs_metrics.registry()
-        eff_cls = ("unknown" if not self.policy.effects
-                   else self._classify_effects(data.get("code"),
-                                               tenant))
+        # Effects classification is the gateway's pre-submit analysis —
+        # the latency observatory's "vet" stage; measured here because
+        # only this layer knows how long it took.
+        vet_s = None
+        if self.policy.effects:
+            t_vet = time.monotonic()
+            eff_cls = self._classify_effects(data.get("code"), tenant)
+            vet_s = time.monotonic() - t_vet
+        else:
+            eff_cls = "unknown"
 
         def on_verdict(ticket):
             v = ticket.verdict
@@ -713,7 +752,7 @@ class GatewayDaemon:
             resps = self.comm.send_to_ranks(
                 ranks, "execute", data, tenant=name, priority=prio,
                 msg_id=msg.msg_id, on_verdict=on_verdict,
-                collective=eff_cls,
+                collective=eff_cls, vet_s=vet_s,
                 timeout=self.request_timeout)
             results = {str(r): m.data for r, m in resps.items()}
             if any(isinstance(d, dict) and d.get("error")
@@ -1037,6 +1076,16 @@ class GatewayDaemon:
 
     # ------------------------------------------------------------------
 
+    def _health_extra(self) -> dict:
+        """Gateway block of the /healthz payload."""
+        sched = self.comm.scheduler.snapshot()
+        return {"kind": "gateway",
+                "tenants": len(self.registry.describe().get("tenants")
+                               or {}),
+                "queued": sched.get("queued", 0),
+                "active": sched.get("active", 0),
+                "serving": self._serve_mgr is not None}
+
     def status(self) -> dict:
         """The ``%dist_pool status`` payload: scheduler counters,
         tenant table, and a per-rank busy view (tenant-attributed)
@@ -1067,7 +1116,13 @@ class GatewayDaemon:
                "pid": os.getpid(), "world_size": self.world_size,
                "scheduler": sched,
                "tenants": self.registry.describe(),
-               "ranks": ranks, "hang_verdicts": wd}
+               "ranks": ranks, "hang_verdicts": wd,
+               # Stage-attribution view (ISSUE 13): %dist_lat in
+               # tenant mode reads this — the observatory lives in
+               # THIS process, not the kernel's.
+               "latency": self.comm.lat.status_block()}
+        if self._metrics_httpd is not None:
+            out["metrics_port"] = self._metrics_httpd.port
         mgr = self._serve_mgr
         if mgr is not None:
             out["serving"] = mgr.describe()
@@ -1097,6 +1152,11 @@ class GatewayDaemon:
         if self._watchdog is not None:
             try:
                 self._watchdog.stop()
+            except Exception:
+                pass
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.close()
             except Exception:
                 pass
         try:
@@ -1164,6 +1224,13 @@ def main(argv: list[str] | None = None) -> int:
                         "(NBD_POOL_SCHED_EFFECTS)")
     p.add_argument("--request-timeout", type=float, default=None)
     p.add_argument("--attach-timeout", type=float, default=180.0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve GET /metrics (Prometheus), /healthz "
+                        "and /latency.json on this port, token-gated "
+                        "with the pool token (default: "
+                        "NBD_METRICS_PORT; 0 = off; negative = bind "
+                        "an ephemeral port, read it back from the "
+                        "manifest's metrics block)")
     args = p.parse_args(argv)
 
     if args.run_dir:
@@ -1206,9 +1273,12 @@ def main(argv: list[str] | None = None) -> int:
             tenant_port=args.tenant_port, policy=policy,
             max_tenants=args.max_tenants,
             request_timeout=args.request_timeout,
-            attach_timeout=args.attach_timeout)
+            attach_timeout=args.attach_timeout,
+            metrics_port=args.metrics_port)
         print(f"NBD_GATEWAY_READY run_dir={gw.run_dir} "
-              f"port={gw.tenant_port} world={gw.world_size}",
+              f"port={gw.tenant_port} world={gw.world_size}"
+              + (f" metrics={gw._metrics_httpd.port}"
+                 if gw._metrics_httpd is not None else ""),
               flush=True)
         gw.wait()
     finally:

@@ -64,8 +64,10 @@ import os
 import threading
 import time
 import uuid
+from collections import deque
 
 from ..messaging.codec import Message
+from ..observability import latency as obs_latency
 from ..observability import metrics as obs_metrics
 from ..utils import knobs
 from .scheduler import ACTIVE, SchedPolicy, Scheduler
@@ -225,7 +227,9 @@ class _Req:
     __slots__ = ("rid", "tenant", "prompt", "max_new", "priority",
                  "tokens", "state", "base", "placed", "replay",
                  "ticket", "released", "submitted_ts", "finished_ts",
-                 "resumes", "stream_resumed", "error")
+                 "resumes", "stream_resumed", "error",
+                 "placed_ts", "first_tok_ts", "last_emit_ts",
+                 "first_batch")
 
     def __init__(self, rid: str, tenant: str, prompt: list[int],
                  max_new: int, priority: int, ticket):
@@ -246,6 +250,14 @@ class _Req:
         self.resumes = 0               # journal re-admissions (heals)
         self.stream_resumed = False    # counted one client resume
         self.error: str | None = None
+        # SLO stamps (ISSUE 13): first KV-slot placement, first token
+        # arrival (TTFT), newest emission arrival (TPOT gaps), and the
+        # size of the first emission batch (excluded from the
+        # per-token rate — it includes prefill).
+        self.placed_ts: float | None = None
+        self.first_tok_ts: float | None = None
+        self.last_emit_ts: float | None = None
+        self.first_batch = 0
 
 
 class _RankLost(RuntimeError):
@@ -338,6 +350,21 @@ class ServingManager:
         self.dup_dropped = 0
         self.tokens_total = 0
         self.last_error: str | None = None
+        # SLO ring (ISSUE 13): one entry per COMPLETED request —
+        # {tenant, ttft, tpot, queue, e2e} seconds — backing the
+        # p50/p99 columns of %dist_serve status / %dist_pool status.
+        # The histograms below carry the full distributions for
+        # /metrics; the ring keeps exact recent percentiles cheap.
+        self._slo: deque = deque(maxlen=256)
+
+    def _slo_hist(self, name: str, help: str, tenant: str):
+        """Per-SUBMITTING-tenant SLO histogram, resolved through the
+        registry at every use so tenant eviction's
+        ``remove_label_series("tenant", name)`` really retires the
+        series (the no-cached-handles rule metrics.py documents)."""
+        return obs_metrics.registry().histogram(
+            name, help, {"tenant": tenant},
+            buckets=obs_metrics.LATENCY_BUCKETS)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -592,8 +619,41 @@ class ServingManager:
         return {"status": st, "rid": rid, "offset": o, "tokens": toks,
                 "done": done}
 
+    @staticmethod
+    def _slo_summary(entries) -> dict:
+        """p50/p99 (milliseconds) per SLO metric, overall and per
+        submitting tenant, from the recent-completions ring."""
+        def stats(vals):
+            sv = sorted(v for v in vals if v is not None)
+            if not sv:
+                return None
+            return {"p50": round(obs_latency.percentile(sv, 0.50)
+                                 * 1e3, 3),
+                    "p99": round(obs_latency.percentile(sv, 0.99)
+                                 * 1e3, 3),
+                    "n": len(sv)}
+
+        def block(rows):
+            out = {}
+            for k in ("ttft", "tpot", "queue", "e2e"):
+                st = stats([r.get(k) for r in rows])
+                if st is not None:
+                    out[k + "_ms"] = st
+            return out
+
+        if not entries:
+            return {}
+        out = block(entries)
+        tenants = sorted({r["tenant"] for r in entries})
+        if len(tenants) > 1:
+            out["tenants"] = {
+                t: block([r for r in entries if r["tenant"] == t])
+                for t in tenants}
+        return out
+
     def describe(self) -> dict:
         with self._lock:
+            slo_entries = list(self._slo)
             active = sum(1 for r in self._reqs.values()
                          if r.state == ACCEPTED and r.placed)
             pending = sum(1 for r in self._reqs.values()
@@ -610,6 +670,7 @@ class ServingManager:
                  "slots": self.max_batch, "max_len": self.max_len,
                  "last_error": self.last_error}
         d["scheduler"] = self.sched.snapshot()
+        d["slo"] = self._slo_summary(slo_entries)
         return d
 
     def forget_tenant(self, name: str) -> None:
@@ -730,18 +791,28 @@ class ServingManager:
             self._avoid.pop(rank, None)
         self._record("serve_open", rank=rank)
 
-    def _take_admits_locked(self) -> list[dict]:
+    def _take_admits_locked(self) -> tuple[list[dict], list]:
         """Requests holding an ACTIVE scheduler ticket but not yet
         placed on the decode rank — first admissions AND journal
-        re-admissions (the latter carry the emitted prefix)."""
+        re-admissions (the latter carry the emitted prefix).  Second
+        element: ``(tenant, queue_wait_s)`` for each FIRST placement —
+        observed into the SLO histograms by the caller, outside the
+        lock."""
         admits = []
+        qwaits = []
         replays = 0
+        now = time.time()
         for r in self._reqs.values():
             if r.state != ACCEPTED or r.placed \
                     or r.ticket.state != ACTIVE:
                 continue
             r.base = len(r.tokens)
             r.placed = True
+            if r.placed_ts is None:
+                # First placement only: a failover re-admission is a
+                # heal, not queue wait.
+                r.placed_ts = now
+                qwaits.append((r.tenant, now - r.submitted_ts))
             if r.replay:
                 r.replay = False
                 r.resumes += 1
@@ -756,7 +827,7 @@ class ServingManager:
                 "requests re-admitted from the journal after a "
                 "failover (re-prefill from prompt + emitted prefix)",
                 {"tenant": self.tenant}).inc(replays)
-        return admits
+        return admits, qwaits
 
     def _tick(self) -> None:
         rank = self._pick_rank()
@@ -779,12 +850,17 @@ class ServingManager:
                         r.placed = False
                         r.replay = True
         with self._lock:
-            admits = self._take_admits_locked()
+            admits, qwaits = self._take_admits_locked()
             release = [r.rid for r in self._reqs.values()
                        if r.state != ACCEPTED and r.placed
                        and not r.released]
             for rid in release:
                 self._reqs[rid].released = True
+        for tenant_name, wait in qwaits:
+            self._slo_hist(
+                "nbd_serve_queue_wait_seconds",
+                "serving queue wait: submit → first KV-slot placement",
+                tenant_name).observe(wait)
         data = self._send_step(rank, {"tenant": self.tenant,
                                       "admit": admits,
                                       "release": release,
@@ -873,6 +949,7 @@ class ServingManager:
             if not new:
                 continue
             self.journal.emit(rid, have, new)
+            now = time.time()
             with self._lock:
                 req.tokens.extend(new)
                 self.tokens_total += len(new)
@@ -880,6 +957,30 @@ class ServingManager:
                         or (self.eos_id is not None
                             and self.eos_id in new))
                 offset = have
+                first = req.first_tok_ts is None
+                if first:
+                    req.first_tok_ts = now
+                    req.first_batch = len(new)
+                    ttft = now - req.submitted_ts
+                else:
+                    gap = ((now - req.last_emit_ts) / len(new)
+                           if req.last_emit_ts is not None else None)
+                req.last_emit_ts = now
+            # SLO observations (outside the lock; per-SUBMITTING-
+            # tenant labels so eviction retires the series).
+            if first:
+                self._slo_hist(
+                    "nbd_serve_ttft_seconds",
+                    "serving time-to-first-token (submit → first "
+                    "emission delivered to the gateway)",
+                    req.tenant).observe(ttft)
+            elif gap is not None:
+                # Mean per-token gap of this emission batch — the
+                # inter-emission latency the client actually sees.
+                self._slo_hist(
+                    "nbd_serve_tpot_seconds",
+                    "serving per-token inter-emission latency",
+                    req.tenant).observe(gap)
             reg.counter("nbd_serve_tokens_total",
                         "generated tokens delivered",
                         {"tenant": self.tenant}).inc(len(new))
@@ -893,6 +994,7 @@ class ServingManager:
         """Terminal transition: journal the verdict, free the KV slot
         (promoting queued requests), and deliver the result
         delivered-or-parked-exactly-once."""
+        slo = None
         with self._lock:
             if req.state != ACCEPTED:
                 return
@@ -901,8 +1003,31 @@ class ServingManager:
             req.finished_ts = time.time()
             if status == COMPLETED:
                 self.completed += 1
+                # SLO record (seconds; None = not applicable): exact
+                # recent percentiles for the status surfaces.
+                extra_toks = len(req.tokens) - req.first_batch
+                slo = {
+                    "tenant": req.tenant,
+                    "e2e": req.finished_ts - req.submitted_ts,
+                    "queue": (req.placed_ts - req.submitted_ts
+                              if req.placed_ts is not None else None),
+                    "ttft": (req.first_tok_ts - req.submitted_ts
+                             if req.first_tok_ts is not None
+                             else None),
+                    "tpot": ((req.last_emit_ts - req.first_tok_ts)
+                             / extra_toks
+                             if req.first_tok_ts is not None
+                             and req.last_emit_ts is not None
+                             and extra_toks > 0 else None),
+                }
+                self._slo.append(slo)
             elif status == SHED_V:
                 self.shed += 1
+        if slo is not None:
+            self._slo_hist(
+                "nbd_serve_e2e_seconds",
+                "serving end-to-end latency (submit → completed)",
+                req.tenant).observe(slo["e2e"])
         self.journal.done(req.rid, status)
         self.sched.complete(req.rid)
         self._wake.set()

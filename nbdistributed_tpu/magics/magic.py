@@ -62,7 +62,8 @@ Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
 %dist_profile start/stop · %dist_trace start/stop/save (Perfetto) ·
-%dist_metrics · %dist_top (live device telemetry) ·
+%dist_metrics · %dist_lat (per-cell stage attribution + waterfall) ·
+%dist_top (live device telemetry) ·
 %dist_postmortem (crash bundles from the flight recorder) ·
 %dist_watchdog (collective hang detection + escalation) ·
 %dist_doctor (stuck-cell report: skew table, stacks, flight tails) ·
@@ -128,6 +129,9 @@ class DistributedMagics(Magics):
 
     # Active auto-heal supervisor (resilience/supervisor.py), or None.
     _supervisor = None
+    # Live scrape endpoint (observability/httpd.py), or None — started
+    # by %dist_init when NBD_METRICS_PORT is set; closed on shutdown.
+    _metrics_httpd = None
     # Active hang watchdog (resilience/watchdog.py), or None.  Auto-
     # started by %dist_init/%dist_attach when NBD_HANG enables it
     # (default on, ladder warn→dump); reconfigured by %dist_watchdog.
@@ -238,6 +242,12 @@ class DistributedMagics(Magics):
         if cls._watchdog is not None:
             cls._watchdog.stop()
             cls._watchdog = None
+        if cls._metrics_httpd is not None:
+            try:
+                cls._metrics_httpd.close()
+            except Exception:
+                pass
+            cls._metrics_httpd = None
         # In-flight background-save tracking is world-specific (per-
         # rank doneness): stale entries from a previous (possibly
         # larger) world must not promote a half-written checkpoint in
@@ -305,7 +315,8 @@ class DistributedMagics(Magics):
                       if text.endswith("\n") else "\n")
 
     def _run_on_ranks(self, code: str, ranks: list[int], kind: str,
-                      deadline_s: float | None = None):
+                      deadline_s: float | None = None,
+                      vet_s: float | None = None):
         """Send an execute request and stream output while waiting
         (reference: magic.py:1042-1129 runs the send in a helper thread
         and polls buffers from the main thread; same structure, 30 ms
@@ -346,8 +357,10 @@ class DistributedMagics(Magics):
                     # no coordinator-side bookkeeping.
                     payload["deadline_s"] = deadline_s
                 with tr.activate(cell_span):
+                    # vet_s: how long pre-dispatch vetting took — the
+                    # latency observatory's "vet" stage.
                     result.update(comm.send_to_ranks(
-                        ranks, "execute", payload))
+                        ranks, "execute", payload, vet_s=vet_s))
             except Exception as e:
                 error.append(e)
 
@@ -686,9 +699,31 @@ class DistributedMagics(Magics):
         DistributedMagics._last_init_line = line
         self._enable_auto_mode()
         self._maybe_start_watchdog()
+        self._maybe_start_metrics_httpd()
         print(_BANNER.format(n=num_workers,
                              backend=pm.backend,
                              secs=time.time() - t0))
+
+    def _maybe_start_metrics_httpd(self) -> None:
+        """Start the live scrape endpoint when NBD_METRICS_PORT asks
+        for one (ISSUE 13): /metrics (Prometheus), /healthz,
+        /latency.json over this kernel's coordinator.  Loopback-bound
+        and ungated — the single-kernel analog of the gateway's
+        token-gated endpoint."""
+        port = _knobs.get_int("NBD_METRICS_PORT", 0)
+        if not port or DistributedMagics._metrics_httpd is not None \
+                or self._comm is None:
+            return
+        from ..observability import httpd as obs_httpd
+        try:
+            DistributedMagics._metrics_httpd = obs_httpd.start_for_comm(
+                self._comm, port=port)
+            print(f"📈 scrape endpoint: http://127.0.0.1:"
+                  f"{DistributedMagics._metrics_httpd.port}/metrics "
+                  f"(/healthz, /latency.json)")
+        except OSError as e:
+            print(f"⚠️ metrics endpoint not started "
+                  f"(NBD_METRICS_PORT={port}): {e}")
 
     def _announce_death(self, rank: int, rc: int | None) -> None:
         # Runs on the monitor thread; a print is best-effort context.
@@ -1085,6 +1120,11 @@ class DistributedMagics(Magics):
               help="effects-aware admission: with --mesh-slots > 1, "
                    "only cells PROVEN collective-free may overlap a "
                    "collective-bearing cell (NBD_POOL_SCHED_EFFECTS)")
+    @argument("--metrics-port", type=int, default=None,
+              help="start: serve GET /metrics (Prometheus), /healthz "
+                   "and /latency.json on this port, token-gated with "
+                   "the pool token (default: NBD_METRICS_PORT; "
+                   "0 = off)")
     @argument("--start-timeout", type=float, default=240.0,
               help="seconds to wait for the daemon's readiness line")
     @line_magic
@@ -1119,7 +1159,8 @@ class DistributedMagics(Magics):
                             ("--mesh-slots", args.mesh_slots),
                             ("--queue-depth", args.queue_depth),
                             ("--tenant-inflight",
-                             args.tenant_inflight)):
+                             args.tenant_inflight),
+                            ("--metrics-port", args.metrics_port)):
                 if v is not None:
                     cmd += [flag, str(v)]
             if args.effects:
@@ -1173,6 +1214,11 @@ class DistributedMagics(Magics):
             print(f"✅ pool up: pid {m.get('pid')} · tenant plane "
                   f"{plane.get('host')}:{plane.get('port')} · "
                   f"policy {m.get('policy')} · run dir {run_dir}")
+            met = m.get("metrics") or {}
+            if met:
+                print(f"📈 scrape endpoint: http://{met.get('host')}:"
+                      f"{met.get('port')}/metrics?token=<pool token> "
+                      f"(/healthz, /latency.json)")
             print(f"   attach kernels with: %dist_attach --tenant "
                   f"NAME {run_dir}")
             return
@@ -1233,6 +1279,19 @@ class DistributedMagics(Magics):
               f"{pol.get('queue_depth') or '∞'}, active "
               f"{sched.get('active', 0)}, shed "
               f"{sched.get('shed_total', 0)} total)")
+        lat = (st.get("latency") or {}).get("summary") or {}
+        if lat.get("count"):
+            e = lat.get("e2e_ms") or {}
+            q = (lat.get("stages") or {}).get("queue") or {}
+            x = (lat.get("stages") or {}).get("execute") or {}
+            print(f"⏱ cells: e2e p50/p99 {e.get('p50', 0)}/"
+                  f"{e.get('p99', 0)} ms · queue p99 "
+                  f"{q.get('p99', 0)} ms · execute p99 "
+                  f"{x.get('p99', 0)} ms "
+                  f"({lat['count']} recorded — %dist_lat for stages)")
+        if st.get("metrics_port"):
+            print(f"📈 scrape endpoint on port {st['metrics_port']} "
+                  f"(/metrics, /healthz, /latency.json — pool token)")
         tenants = (st.get("tenants") or {}).get("tenants") or {}
         me = (DistributedMagics._tenant.name
               if DistributedMagics._tenant is not None else None)
@@ -1489,6 +1548,20 @@ class DistributedMagics(Magics):
               f"{st.get('resumed', 0)} · failovers "
               f"{st.get('failovers', 0)} · dup-dropped "
               f"{st.get('dup_dropped', 0)}")
+        slo = st.get("slo") or {}
+
+        def _pp(block: dict, key: str) -> str:
+            s = (block or {}).get(key + "_ms")
+            return (f"{s['p50']:g}/{s['p99']:g}" if s else "–")
+
+        if slo:
+            print(f"   SLO p50/p99 ms · TTFT {_pp(slo, 'ttft')} · "
+                  f"TPOT {_pp(slo, 'tpot')} · queue "
+                  f"{_pp(slo, 'queue')} · e2e {_pp(slo, 'e2e')}")
+            for t, b in sorted((slo.get("tenants") or {}).items()):
+                print(f"     {t}: TTFT {_pp(b, 'ttft')} · TPOT "
+                      f"{_pp(b, 'tpot')} · queue {_pp(b, 'queue')} · "
+                      f"e2e {_pp(b, 'e2e')}")
         if st.get("last_error"):
             print(f"   ⚠ last driver error: {st['last_error']}")
 
@@ -2245,12 +2318,14 @@ class DistributedMagics(Magics):
                 print("⚠️ --deadline set but workers were spawned "
                       "with NBD_HANG=0 (no heartbeat piggyback) — "
                       "the budget will not be enforced")
+        t_vet = time.monotonic()
         if not self._vet_cell(cell, list(range(self._world)),
                               strict=args.strict):
             return
         result = self._run_on_ranks(cell, list(range(self._world)),
                                     kind="distributed",
-                                    deadline_s=args.deadline)
+                                    deadline_s=args.deadline,
+                                    vet_s=time.monotonic() - t_vet)
         if result is not None:
             self._sync_ide_quietly()
 
@@ -2269,9 +2344,11 @@ class DistributedMagics(Magics):
         # analyzer upgrades the old regex warning to real findings
         # (calls = error under strict, bare references = warning) and
         # falls back to the regex only for unparseable source.
+        t_vet = time.monotonic()
         if not self._vet_cell(cell, ranks):
             return
-        self._run_on_ranks(cell, ranks, kind="rank")
+        self._run_on_ranks(cell, ranks, kind="rank",
+                           vet_s=time.monotonic() - t_vet)
 
     @magic_arguments()
     @argument("--ranks", default=None,
@@ -2568,6 +2645,13 @@ class DistributedMagics(Magics):
                 line_txt += (f" · hb {time.time() - ping[0]:.1f}s"
                              if ping is not None else " · hb –")
             print(line_txt)
+        if self._comm is not None:
+            # Clock-skew surfacing (ISSUE 13 satellite): big offsets
+            # silently degrade merged traces and stage attribution —
+            # say so here, where the operator already looks.
+            from ..observability import latency as lat_mod
+            for w in lat_mod.skew_warnings(self._comm.clock.stats()):
+                print(w)
         sup = DistributedMagics._supervisor
         if sup is not None:
             print(sup.describe())
@@ -3062,10 +3146,16 @@ class DistributedMagics(Magics):
             return
         args = parse_argstring(self.dist_metrics, line)
         comm = self._comm
+        from ..observability import flightrec as _flightrec
+        from ..observability import latency as _lat_mod
         from ..observability import metrics as obs_metrics
         reg = obs_metrics.registry()
         # Mirror coordinator-side resilience state into the registry so
-        # the export is self-contained.
+        # the export is self-contained — including the flight ring's
+        # health and the clock estimator's per-rank offsets (ISSUE 13
+        # satellites: evidence-loss and skew visibility).
+        _flightrec.export_health(reg)
+        _lat_mod.export_clock_metrics(comm.clock, reg)
         now = time.time()
         for r in comm.connected_ranks():
             seen = comm.last_seen(r)
@@ -3151,6 +3241,59 @@ class DistributedMagics(Magics):
                   + (f" · orphan transitions "
                      f"{_total(snap, 'nbd_orphan_transitions'):.0f}"
                      if _total(snap, "nbd_orphan_transitions") else ""))
+
+    @magic_arguments()
+    @argument("--last", type=int, default=0,
+              help="also render a waterfall for the last N cells")
+    @argument("--save", default=None,
+              help="write the summary + raw stage records JSON here")
+    @line_magic
+    def dist_lat(self, line):
+        """The latency observatory (ISSUE 13): WHERE each cell's
+        wall-clock went, as eight contiguous stages (vet → queue →
+        wire → dispatch → compile → execute → reply → deliver) stamped
+        by the coordinator and workers and clock-corrected onto one
+        timebase.  Default: per-stage p50/p95/p99 table over the
+        recent-cells ring (``NBD_LAT_RING``); ``--last N`` adds an
+        ASCII waterfall per cell.  In tenant mode the observatory
+        lives in the gateway daemon — this reads its pool-status
+        latency block.  ``NBD_LAT=0`` disables stamping entirely."""
+        args = parse_argstring(self.dist_lat, line)
+        from ..observability import latency as lat_mod
+        if DistributedMagics._tenant is not None:
+            client = DistributedMagics._tenant
+            try:
+                st = client.pool_status()
+            except Exception as e:
+                print(f"❌ pool status failed: {e}")
+                return
+            block = st.get("latency") or {}
+            n_recs = len(block.get("records") or ())
+            if args.last > n_recs or (args.save and n_recs
+                                      < lat_mod.DEFAULT_RING):
+                # The gateway ships a bounded tail of its ring in the
+                # status payload — say so instead of silently
+                # rendering/saving fewer records than asked for.
+                print(f"ℹ️ tenant mode: the gateway's status payload "
+                      f"carries its last {n_recs} record(s); the full "
+                      f"ring is on the daemon's /latency.json "
+                      f"(%dist_pool start --metrics-port)")
+        elif self._comm is not None:
+            block = self._comm.lat.status_block(
+                records=max(args.last, 32))
+        else:
+            print("❌ No cluster. %dist_init (or %dist_attach "
+                  "--tenant) first.")
+            return
+        print(lat_mod.format_stage_table(block.get("summary") or {}))
+        if args.last:
+            recs = (block.get("records") or [])[-args.last:]
+            print(lat_mod.format_waterfall(recs))
+        if args.save:
+            import json
+            with open(args.save, "w") as f:
+                json.dump(block, f, indent=1)
+            print(f"✅ latency snapshot → {args.save}")
 
     # ==================================================================
     # flight recorder: live telemetry + crash postmortems (ISSUE 3)
@@ -3455,6 +3598,12 @@ class DistributedMagics(Magics):
                 cls._pm.shutdown()
             except Exception:
                 pass
+        if cls._metrics_httpd is not None:
+            try:
+                cls._metrics_httpd.close()
+            except Exception:
+                pass
+            cls._metrics_httpd = None
         inst = cls._instance
         if inst is not None:
             try:

@@ -79,6 +79,12 @@ WIRE_EXTENSIONS: dict[str, dict] = {
            "doc": "tenant tag (gateway pools: routes the request to "
                   "the tenant's worker-side namespace and attributes "
                   "its flight/span records)"},
+    "lt": {"plane": "header", "attr": "latency",
+           "doc": "latency-observatory stage stamps: 1 on a request "
+                  "asks the worker to stamp; the reply carries "
+                  "{dq,xs,xe,cs,rs} worker-clock stamps (dequeue, "
+                  "handler entry/exit, compile seconds, reply build) "
+                  "— absent unless NBD_LAT is on"},
     # heartbeat-ping data plane (worker _heartbeat → coordinator)
     "busy_type": {"plane": "ping",
                   "doc": "in-flight request type while busy"},
@@ -173,6 +179,12 @@ class Message:
     # namespace and attributes flight/span records to it.  None (the
     # default) keeps the single-tenant wire format byte-identical.
     tenant: str | None = None
+    # Latency-observatory stage stamps (ISSUE 13).  ``1`` on a request
+    # asks the worker's loop to stamp it; the reply carries the
+    # worker-clock stamp dict.  None (the default, and always when
+    # NBD_LAT=0) keeps the wire format byte-identical — the same
+    # absent-when-off contract as ``trace``.
+    latency: Any = None
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
@@ -222,6 +234,9 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
     if msg.tenant is not None:
         # Only for tenant-tagged (gateway pool) traffic.
         header["tn"] = msg.tenant
+    if msg.latency is not None:
+        # Only while the latency observatory is on.
+        header["lt"] = msg.latency
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -307,6 +322,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         trace=header.get("tr"),
         epoch=header.get("ep"),
         tenant=header.get("tn"),
+        latency=header.get("lt"),
     )
 
 

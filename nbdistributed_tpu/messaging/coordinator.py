@@ -33,6 +33,7 @@ from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability.clock import ClockEstimator
+from ..observability.latency import LatencyObservatory
 from ..resilience.retry import RetryPolicy, class_of
 from ..utils import knobs
 from .codec import Message
@@ -132,6 +133,11 @@ class CommunicationManager:
         # response RTTs, and wire-frame accounting into the registry.
         self.tracer = obs_spans.tracer()
         self.clock = ClockEstimator()
+        # Latency observatory (ISSUE 13): stage attribution for every
+        # completed execute request.  On by default (NBD_LAT=0 turns
+        # it off and drops the `lt` wire header entirely); its offsets
+        # come from the same clock estimator the trace merge uses.
+        self.lat = LatencyObservatory()
         obs_metrics.install_wire_hook()
         # Flight recorder (always on): opening it here also mints the
         # shared run directory and exports NBD_RUN_DIR, so workers
@@ -344,9 +350,11 @@ class CommunicationManager:
 
     def send_to_all(self, msg_type: str, data: Any = None, *,
                     bufs: dict | None = None,
-                    timeout: float | None = ...) -> dict[int, Message]:
+                    timeout: float | None = ...,
+                    vet_s: float | None = None) -> dict[int, Message]:
         return self.send_to_ranks(list(range(self.num_workers)), msg_type,
-                                  data, bufs=bufs, timeout=timeout)
+                                  data, bufs=bufs, timeout=timeout,
+                                  vet_s=vet_s)
 
     def send_to_rank(self, rank: int, msg_type: str, data: Any = None, *,
                      bufs: dict | None = None,
@@ -360,7 +368,8 @@ class CommunicationManager:
                       tenant: str | None = None, priority: int = 0,
                       msg_id: str | None = None,
                       on_verdict=None,
-                      collective: str = "unknown"
+                      collective: str = "unknown",
+                      vet_s: float | None = None
                       ) -> dict[int, Message]:
         """Send one request to ``ranks`` and collect their responses.
 
@@ -391,7 +400,10 @@ class CommunicationManager:
         identical end to end.  ``collective`` is the cell's effects-
         admission class (``analysis.effects.collective_class``: free /
         bearing / unknown) — consulted only when the scheduler's
-        effects gate is armed (ISSUE 9).
+        effects gate is armed (ISSUE 9).  ``vet_s`` is how long the
+        caller spent vetting/classifying the cell before this call —
+        the latency observatory's "vet" stage (the submitter is the
+        only layer that knows it).
         """
         if timeout is ...:
             timeout = self.default_timeout
@@ -404,38 +416,51 @@ class CommunicationManager:
             msg.epoch = self.session_epoch
         if tenant is not None:
             msg.tenant = tenant
+        if msg_type == "execute" and self.lat.enabled:
+            # Ask the workers to stamp this request (dequeue / handler
+            # entry+exit / compile seconds / reply build) and open the
+            # coordinator-side stage record.  One flag check when off;
+            # no wire header is emitted unless enabled.
+            msg.latency = 1
+            self.lat.begin(msg.msg_id, msg_type, tenant, vet_s=vet_s)
         # The total budget starts NOW: time spent queued behind the
         # mesh is part of the caller's wait, not free.
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         ticket = None
-        if msg_type == "execute":
-            ticket = self.scheduler.submit(tenant or "local",
-                                           msg.msg_id, priority,
-                                           collective=collective)
-            if on_verdict is not None:
-                try:
-                    on_verdict(ticket)
-                except Exception:
-                    pass
-            v = ticket.verdict
-            if v["status"] == "rejected":
-                raise CellRejected(v.get("reason", "rejected"),
-                                   tenant or "local")
-            if v["status"] == "shed":
-                raise CellShed(tenant or "local", msg.msg_id)
-            if v["status"] == "queued":
-                wait_s = (None if deadline is None
-                          else max(0.0, deadline - time.monotonic()))
-                if not ticket.event.wait(wait_s):
-                    self.scheduler.cancel(msg.msg_id)
-                    raise TimeoutError(
-                        f"cell spent {timeout}s queued behind the mesh "
-                        f"without dispatch (tenant "
-                        f"{tenant or 'local'}); withdrawn")
-                if ticket.state == SHED:
-                    raise CellShed(tenant or "local", msg.msg_id)
         try:
+            if msg_type == "execute":
+                ticket = self.scheduler.submit(tenant or "local",
+                                               msg.msg_id, priority,
+                                               collective=collective)
+                if on_verdict is not None:
+                    try:
+                        on_verdict(ticket)
+                    except Exception:
+                        pass
+                v = ticket.verdict
+                if v["status"] == "rejected":
+                    raise CellRejected(v.get("reason", "rejected"),
+                                       tenant or "local")
+                if v["status"] == "shed":
+                    raise CellShed(tenant or "local", msg.msg_id)
+                if v["status"] == "queued":
+                    wait_s = (None if deadline is None
+                              else max(0.0,
+                                       deadline - time.monotonic()))
+                    if not ticket.event.wait(wait_s):
+                        self.scheduler.cancel(msg.msg_id)
+                        raise TimeoutError(
+                            f"cell spent {timeout}s queued behind the "
+                            f"mesh without dispatch (tenant "
+                            f"{tenant or 'local'}); withdrawn")
+                    if ticket.state == SHED:
+                        raise CellShed(tenant or "local", msg.msg_id)
+            if msg.latency is not None:
+                # The mesh slot is granted (immediately on an idle
+                # mesh, after the queued wait otherwise) — closes the
+                # queue stage.
+                self.lat.note_grant(msg.msg_id)
             return self._dispatch(ranks, msg, msg_type, timeout,
                                   deadline, tenant)
         finally:
@@ -443,6 +468,11 @@ class CommunicationManager:
                 # Success OR failure frees the mesh slot and promotes
                 # queued work — a dead worker must not wedge the pool.
                 self.scheduler.complete(msg.msg_id)
+            if msg.latency is not None:
+                # No-op after a completed record; forgets the stage
+                # record of a rejected / shed / timed-out / aborted
+                # cell (only COMPLETED cells feed the histograms).
+                self.lat.drop(msg.msg_id)
 
     def _dispatch(self, ranks: list[int], msg: Message, msg_type: str,
                   timeout: float | None, deadline: float | None,
@@ -531,7 +561,19 @@ class CommunicationManager:
             if pending.failure is not None:
                 raise pending.failure
             with self._lock:
-                return dict(pending.responses)
+                responses = dict(pending.responses)
+            if msg.latency is not None:
+                # Close the stage record: per-rank worker stamps from
+                # the reply headers, corrected by the clock estimator,
+                # delivery stamped NOW (the caller receives the result
+                # when this method returns).  Mirrored as stage/* child
+                # spans of the send span while a trace is active.
+                self.lat.complete(
+                    msg.msg_id, responses, self.clock.offset,
+                    tracer=tr,
+                    parent=(tr.context_for(span)
+                            if span is not None else None))
+            return responses
         finally:
             if span is not None:
                 span.attrs["deliveries"] = msg.attempt + 1
@@ -622,6 +664,10 @@ class CommunicationManager:
                                    frame_epoch=msg.epoch,
                                    epoch=self.session_epoch)
                 return
+            # Arrival stamp for the latency observatory's reply stage
+            # (and the clock sample below) — stamped HERE, on the IO
+            # thread, so a slow completion wait can't inflate it.
+            msg.recv_ts = time.time()
             with self._lock:
                 pending = self._pending.get(msg.msg_id)
                 if pending is None:
@@ -633,7 +679,7 @@ class CommunicationManager:
                 # t_recv) — the estimator's min-RTT filter keeps only
                 # the cleanest of these.
                 self.clock.add(rank, pending.sent_at, msg.timestamp,
-                               time.time())
+                               msg.recv_ts)
             if complete:
                 pending.event.set()
             return

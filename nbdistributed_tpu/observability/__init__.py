@@ -40,6 +40,16 @@ metrics from the coordinator and every rank land:
   flight rings, last telemetry, coordinator spans, and fault events
   into a postmortem bundle (merged Chrome trace + human report) when a
   worker dies.
+- :mod:`~nbdistributed_tpu.observability.latency` — the latency
+  observatory (ISSUE 13): per-cell eight-stage attribution
+  (vet/queue/wire/dispatch/compile/execute/reply/deliver) from
+  coordinator + worker stage stamps riding the optional ``lt`` reply
+  header, clock-corrected, feeding log-scale histograms, the
+  ``%dist_lat`` table/waterfall, and the scrape endpoint.
+- :mod:`~nbdistributed_tpu.observability.httpd` — the live scrape
+  endpoint: a stdlib ``ThreadingHTTPServer`` serving ``GET /metrics``
+  (Prometheus text), ``/healthz``, and ``/latency.json``
+  (``NBD_METRICS_PORT``; token-gated on gateway pools).
 
 Surfaced via ``%dist_trace start|stop|save``, ``%dist_metrics``,
 ``%dist_top``, and ``%dist_postmortem``.  Everything here is
@@ -50,10 +60,11 @@ unit-testable without a backend.
 
 from .clock import ClockEstimator
 from .flightrec import FlightRecorder, read_ring
+from .latency import LatencyObservatory
 from .metrics import MetricsRegistry, registry
 from .spans import Tracer, maybe_span, tracer
 from .telemetry import TelemetrySampler
 
-__all__ = ["ClockEstimator", "FlightRecorder", "MetricsRegistry",
-           "TelemetrySampler", "Tracer", "maybe_span", "read_ring",
-           "registry", "tracer"]
+__all__ = ["ClockEstimator", "FlightRecorder", "LatencyObservatory",
+           "MetricsRegistry", "TelemetrySampler", "Tracer",
+           "maybe_span", "read_ring", "registry", "tracer"]

@@ -140,6 +140,10 @@ class _NullRecorder:
     def record(self, etype: str, **fields) -> None:
         pass
 
+    def health(self) -> dict:
+        return {"utilization": 0.0, "wraps": 0, "records": 0,
+                "overwritten": 0, "truncated": 0, "dropped": 0}
+
     def flush(self) -> None:
         pass
 
@@ -183,6 +187,15 @@ class FlightRecorder:
         self._off = 0
         self._seq = 0
         self.dropped = 0      # records whose encode/write failed
+        # Ring-health counters (ISSUE 13 satellite): wraps, records
+        # aged out by a wrap (the previous lap is progressively
+        # overwritten once a new one starts — counted at the wrap, the
+        # moment evidence loss begins), and oversize payloads whose
+        # capped body recovery will skip as torn.
+        self.wraps = 0
+        self.overwritten = 0
+        self.truncated = 0
+        self._lap_start_seq = 0
 
     def __len__(self) -> int:
         return self._seq
@@ -202,6 +215,7 @@ class FlightRecorder:
             return
         if len(payload) > MAX_PAYLOAD:
             payload = payload[:MAX_PAYLOAD]  # capped: recovery skips it
+            self.truncated += 1
         try:
             with self._lock:
                 self._append(payload)
@@ -219,6 +233,12 @@ class FlightRecorder:
             self._mm[base + self._off: base + self._ring_size] = \
                 b"\0" * (self._ring_size - self._off)
             self._off = 0
+            self.wraps += 1
+            # The new lap will overwrite every record of the previous
+            # one — count them lost NOW, so the health gauge trips
+            # before a postmortem discovers the hole.
+            self.overwritten += self._seq - self._lap_start_seq
+            self._lap_start_seq = self._seq
         seq = self._seq
         crc = zlib.crc32(struct.pack("<Q", seq) + payload)
         pos = base + self._off
@@ -237,6 +257,21 @@ class FlightRecorder:
         _FHDR.pack_into(self._mm, 0, FILE_MAGIC, VERSION,
                         self._ring_size, self._pid,
                         self._off, self._seq)
+
+    def health(self) -> dict:
+        """Ring-health snapshot for the metrics satellite:
+        ``utilization`` is the fraction of the ring written this lap
+        (pinned to 1.0 once it has wrapped — from then on every append
+        destroys history), plus the wrap / overwritten / truncated /
+        dropped counters."""
+        with self._lock:
+            util = (1.0 if self.wraps
+                    else round(self._off / self._ring_size, 4))
+            return {"utilization": util, "wraps": self.wraps,
+                    "records": self._seq,
+                    "overwritten": self.overwritten,
+                    "truncated": self.truncated,
+                    "dropped": self.dropped}
 
     def flush(self) -> None:
         try:
@@ -402,6 +437,36 @@ def record(etype: str, **fields) -> None:
     r = _RECORDER
     if r is not None:
         r.record(etype, **fields)
+
+
+def export_health(registry=None) -> dict:
+    """Mirror the process recorder's ring health into gauges
+    (``nbd_flight_*``) so silent evidence loss — a wrapped ring, a
+    dropped or truncated record — is scrapeable before a postmortem
+    needs the evidence.  Returns the health dict it exported.  Called
+    from the worker's ``metrics`` handler, ``%dist_metrics``, and the
+    scrape endpoint's collector (never the hot append path)."""
+    from . import metrics as obs_metrics
+    reg = registry or obs_metrics.registry()
+    h = recorder().health()
+    reg.gauge("nbd_flight_ring_utilization",
+              "flight-recorder ring fill fraction this lap (1.0 = "
+              "wrapped: appends now destroy history)"
+              ).set(h["utilization"])
+    reg.gauge("nbd_flight_ring_wraps",
+              "flight-recorder ring wraps").set(h["wraps"])
+    reg.gauge("nbd_flight_records",
+              "flight-recorder records appended").set(h["records"])
+    reg.gauge("nbd_flight_records_overwritten",
+              "flight records aged out by ring wraps (no longer "
+              "recoverable)").set(h["overwritten"])
+    reg.gauge("nbd_flight_records_truncated",
+              "flight records whose oversize payload was capped "
+              "(recovery skips them as torn)").set(h["truncated"])
+    reg.gauge("nbd_flight_records_dropped",
+              "flight records lost to encode/write failures"
+              ).set(h["dropped"])
+    return h
 
 
 def reset_for_tests() -> None:

@@ -20,12 +20,21 @@ stdlib-only and thread-safe.
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Mapping
 
 # Prometheus' classic latency ladder, widened to cover XLA compiles.
 DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Log-scale ladder for the latency observatory (ISSUE 13): stage and
+# SLO distributions span ~100 µs (worker dispatch, per-token decode)
+# to tens of seconds (cold compiles), so the classic ladder's 1 ms
+# floor would fold every sub-millisecond stage into one bucket.
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0)
 
 
 def _fmt(v: float) -> str:
@@ -247,6 +256,65 @@ _REGISTRY = MetricsRegistry()
 def registry() -> MetricsRegistry:
     """The process-global registry."""
     return _REGISTRY
+
+
+# ----------------------------------------------------------------------
+# exposition-format validation (the CI scrape check and the golden
+# tests share one rule set, so "parses" means the same thing in both)
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                      # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*"'            # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*")*\})?'       # more labels
+    r" [-+]?(?:[0-9.eE+-]+|Inf|NaN)$")                # value
+
+
+def validate_prometheus_text(text: str) -> list[str]:
+    """Structural check of Prometheus exposition text (version 0.0.4
+    as :meth:`MetricsRegistry.prometheus_text` emits it).  Returns a
+    list of human-readable problems — empty means parseable.  Checks
+    line syntax, that every sample's family was TYPE-declared, and
+    that histogram families expose ``_bucket``/``_sum``/``_count``."""
+    errors: list[str] = []
+    typed: dict[str, str] = {}
+    sampled: set[str] = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            errors.append(f"line {i}: blank line inside exposition")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary",
+                    "untyped"):
+                errors.append(f"line {i}: malformed TYPE line")
+            else:
+                typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments: free text
+        if not _SAMPLE_LINE.match(line):
+            errors.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                base = name[:-len(suffix)]
+                break
+        sampled.add(base)
+        if base not in typed:
+            errors.append(f"line {i}: sample {name!r} has no TYPE "
+                          f"declaration")
+    for name, kind in typed.items():
+        if kind != "histogram" or name not in sampled:
+            continue
+        for suffix in ("_bucket", "_sum", "_count"):
+            if f"# TYPE {name} histogram" in text \
+                    and f"{name}{suffix}" not in text:
+                errors.append(f"histogram {name} is missing its "
+                              f"{suffix} series")
+    return errors
 
 
 # ----------------------------------------------------------------------

@@ -228,6 +228,27 @@ class Tracer:
             return _NULL_CTX
         return _ActivateCtx(self, span)
 
+    def add_span(self, name: str, kind: str, t0: float, dur: float, *,
+                 trace_id: str | None = None,
+                 parent_id: str | None = None,
+                 attrs: dict | None = None) -> None:
+        """Append an already-timed span (explicit ``t0``/``dur``).
+        The latency observatory computes its stage decomposition only
+        AFTER a request completes, so its ``stage/*`` child spans
+        cannot be opened live — they are reconstructed here under the
+        request's send span."""
+        if not self.enabled:
+            return
+        sp = Span(name, kind, trace_id or self.trace_id or _new_id(),
+                  parent_id, self._tid(), attrs)
+        sp.t0 = t0
+        sp.dur = max(0.0, dur)
+        with self._lock:
+            if len(self._spans) >= MAX_SPANS:
+                self._dropped += 1
+                return
+            self._spans.append(sp)
+
     def instant(self, name: str, kind: str = "",
                 attrs: dict | None = None) -> None:
         """Record a zero-duration event (Chrome ``ph: "i"``)."""

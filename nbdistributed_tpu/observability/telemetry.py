@@ -93,6 +93,16 @@ class _CompileWatch:
             return cls.count, round(cls.seconds, 3)
 
 
+def compile_seconds() -> float:
+    """Cumulative XLA backend-compile seconds observed in this process
+    (0.0 until the listener is installed).  The latency observatory's
+    worker-side stamps take a delta of this around each handler, so a
+    cell's first-run compile shows up as its own stage instead of
+    inflating ``execute``."""
+    with _CompileWatch._lock:
+        return _CompileWatch.seconds
+
+
 class TelemetrySampler:
     """Samples device state for one worker rank.
 

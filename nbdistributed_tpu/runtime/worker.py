@@ -853,6 +853,9 @@ class DistributedWorker:
         reg.gauge("nbd_dedup_hits",
                   "redelivered requests answered from the replay "
                   "cache").set(self._replay.hits)
+        # Flight-ring health (ISSUE 13 satellite): utilization, wraps,
+        # overwritten/truncated/dropped — evidence-loss visibility.
+        flightrec.export_health(reg)
         plan = self._fault_plan
         if plan is not None:
             for action, n in plan.counters.items():
@@ -1324,6 +1327,12 @@ class DistributedWorker:
                 break
             except KeyboardInterrupt:
                 continue  # idle interrupt: nothing to abort
+            # Latency observatory (ISSUE 13): the coordinator flagged
+            # this request for stage stamping (`lt: 1`).  One flag
+            # check when off — no stamps, no reply header, wire format
+            # byte-identical.
+            stamp = msg.latency is not None
+            t_dq = time.time() if stamp else 0.0
             self._msg_seen += 1
             # A new request proves the coordinator consumed our last
             # reply (the serial request-response protocol: it only
@@ -1440,6 +1449,14 @@ class DistributedWorker:
                                 trace_id=ctx.get("tid"),
                                 parent_id=ctx.get("sid"),
                                 attrs=span_attrs)
+            # Stage stamps: handler entry/exit bracket the execute
+            # work; the compile-seconds delta (the jax.monitoring
+            # listener telemetry already installed) splits XLA compile
+            # out of it, so a cold cell's first run attributes its
+            # compile as its own stage.
+            cs0 = obs_telemetry.compile_seconds() if stamp else 0.0
+            xs = time.time() if stamp else 0.0
+            xe = 0.0
             try:
                 if handler is None:
                     reply = msg.reply(
@@ -1463,8 +1480,23 @@ class DistributedWorker:
                           "traceback": traceback.format_exc()},
                     rank=self.rank)
             finally:
+                if stamp:
+                    xe = time.time()
                 self._busy = None
                 tr.end(span)
+            if stamp:
+                # Worker-clock stage stamps, riding home in the
+                # reply's `lt` header: dequeue, handler entry/exit,
+                # compile seconds inside the handler, reply build.
+                # The coordinator corrects them onto its timebase with
+                # the clock estimator's per-rank offset.
+                reply.latency = {
+                    "dq": round(t_dq, 6), "xs": round(xs, 6),
+                    "xe": round(xe, 6),
+                    "cs": round(
+                        obs_telemetry.compile_seconds() - cs0, 6),
+                    "rs": round(time.time(), 6),
+                }
             # Epoch-stamp the reply (worker→coordinator direction): a
             # coordinator that healed replacements while we were
             # partitioned away must reject THIS tenancy's results

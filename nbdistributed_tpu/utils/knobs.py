@@ -170,6 +170,24 @@ _ALL = (
     _k("NBD_FLIGHT_RING_BYTES", "262144", "int",
        "Flight-recorder ring-file capacity per process.",
        "observability"),
+    _k("NBD_LAT", "1", "bool",
+       "Latency observatory: per-cell stage attribution (vet/queue/"
+       "wire/dispatch/compile/execute/reply/deliver) stamped through "
+       "the optional `lt` wire header. 0 drops the stamps and the "
+       "header entirely.", "observability"),
+    _k("NBD_LAT_RING", "256", "int",
+       "Recent per-cell stage records kept for %dist_lat and "
+       "/latency.json.", "observability"),
+    _k("NBD_LAT_SKEW_WARN_MS", "50", "float",
+       "Clock-skew threshold: %dist_status warns when a rank's "
+       "estimated |offset| exceeds it (skew degrades merged traces "
+       "and stage attribution). 0 disables the warning.",
+       "observability"),
+    _k("NBD_METRICS_PORT", "0", "int",
+       "Live scrape endpoint port (GET /metrics Prometheus text, "
+       "/healthz, /latency.json) served by the coordinator or "
+       "gateway daemon; 0 = off. Also %dist_pool start "
+       "--metrics-port (token-gated on pools).", "observability"),
     # --- static analysis -------------------------------------------------
     _k("NBD_LINT", "warn", "str",
        "Default pre-dispatch cell-vetting mode: warn (annotate), "

@@ -1,0 +1,127 @@
+"""Integration: the latency observatory over a REAL 2-rank CPU world
+(ISSUE 13 acceptance).  A pool cell must yield a complete 8-stage
+waterfall whose stages sum to within 10% of the observed end-to-end
+latency, the stage histograms must export as parseable Prometheus
+text, and turning the observatory off must drop the ``lt`` header
+from the wire entirely."""
+
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.observability.latency import (STAGES,
+                                                     format_stage_table,
+                                                     format_waterfall)
+from nbdistributed_tpu.observability.metrics import \
+    validate_prometheus_text
+
+pytestmark = [pytest.mark.integration, pytest.mark.obs]
+
+WORLD = 2
+ATTACH_TIMEOUT = 120
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    comm = CommunicationManager(num_workers=WORLD, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(WORLD, comm.port, backend="cpu")
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
+    except Exception:
+        pm.shutdown()
+        comm.shutdown()
+        raise
+    yield comm, pm
+    comm.post(list(range(WORLD)), "shutdown")
+    time.sleep(0.5)
+    pm.shutdown()
+    comm.shutdown()
+
+
+def test_two_rank_cell_yields_complete_waterfall(cluster):
+    comm, _ = cluster
+    assert comm.lat.enabled  # NBD_LAT defaults on
+    before = len(comm.lat.records())
+    t0 = time.time()
+    resp = comm.send_to_all("execute", {"code": "rank * 2",
+                                        "target_ranks": [0, 1]},
+                            vet_s=0.0005)
+    wall = time.time() - t0
+    assert all(not m.data.get("error") for m in resp.values())
+
+    recs = comm.lat.records()
+    assert len(recs) == before + 1
+    rec = recs[-1]
+    # complete 8-stage waterfall, every stage non-negative
+    assert set(rec["stages"]) == set(STAGES)
+    assert all(v >= 0.0 for v in rec["stages"].values())
+    assert len(rec["ranks"]) == WORLD
+    for detail in rec["ranks"].values():
+        assert {"wire", "dispatch", "compile", "execute",
+                "reply"} <= set(detail)
+    # THE acceptance bar: stages sum to within 10% of the observed
+    # end-to-end latency
+    total = sum(rec["stages"].values())
+    assert total == pytest.approx(rec["e2e"], rel=0.10)
+    # and the recorded e2e is the latency the caller actually saw
+    assert rec["e2e"] <= wall + 0.05
+    assert rec["stages"]["vet"] == pytest.approx(0.0005, abs=1e-4)
+
+
+def test_stage_histograms_export_parseable(cluster):
+    comm, _ = cluster
+    comm.send_to_all("execute", {"code": "1 + 1",
+                                 "target_ranks": [0, 1]})
+    from nbdistributed_tpu.observability import metrics as obs_metrics
+    text = obs_metrics.registry().prometheus_text()
+    assert "# TYPE nbd_stage_seconds histogram" in text
+    for s in STAGES:
+        assert f'stage="{s}"' in text
+    assert "# TYPE nbd_cell_e2e_seconds histogram" in text
+    assert validate_prometheus_text(text) == []
+    # the %dist_lat renderers work off the live ring
+    table = format_stage_table(comm.lat.summary())
+    assert "p99" in table and "execute" in table
+    assert "█" in format_waterfall(comm.lat.records()[-1:])
+
+
+def test_lt_header_absent_when_observatory_off(cluster):
+    """Flip the observatory off: requests carry no `lt` flag, the live
+    workers therefore send stampless replies, and no record lands —
+    the absent-when-off wire contract over a real world."""
+    comm, _ = cluster
+    was = comm.lat.enabled
+    comm.lat.enabled = False
+    try:
+        before = len(comm.lat.records())
+        resp = comm.send_to_all("execute", {"code": "3",
+                                            "target_ranks": [0, 1]})
+        assert all(m.latency is None for m in resp.values())
+        assert len(comm.lat.records()) == before
+    finally:
+        comm.lat.enabled = was
+    # back on: stamps flow again on the same connections
+    resp = comm.send_to_all("execute", {"code": "4",
+                                        "target_ranks": [0, 1]})
+    assert all(isinstance(m.latency, dict) for m in resp.values())
+
+
+def test_clock_offsets_exported_and_sane(cluster):
+    """Same-host workers: the estimated offsets must be tiny, and the
+    gauges must export (the skew-visibility satellite)."""
+    from nbdistributed_tpu.observability import latency as lat_mod
+    from nbdistributed_tpu.observability.metrics import MetricsRegistry
+    comm, _ = cluster
+    stats = comm.clock.stats()
+    assert set(stats) == {0, 1}
+    for st in stats.values():
+        assert abs(st["offset_s"]) < 0.5  # same host, same clock
+    reg = MetricsRegistry()
+    lat_mod.export_clock_metrics(comm.clock, reg)
+    text = reg.prometheus_text()
+    assert 'nbd_clock_offset_seconds{rank="0"}' in text
+    assert lat_mod.skew_warnings(stats, threshold_ms=5000.0) == []

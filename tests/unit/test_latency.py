@@ -1,0 +1,434 @@
+"""Unit tests for the latency observatory (ISSUE 13): the ``lt`` wire
+header's absent-when-off contract, clock-corrected monotone stage
+ordering on synthetic skewed clocks, the Prometheus golden for the new
+log-scale histograms, the exposition-text validator, the scrape
+endpoint's routes + token gating, and the skew/flight-health
+satellites."""
+
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nbdistributed_tpu.messaging import codec
+from nbdistributed_tpu.observability import latency as lat_mod
+from nbdistributed_tpu.observability.httpd import MetricsHTTPD
+from nbdistributed_tpu.observability.latency import (
+    STAGES, LatencyObservatory, format_stage_table, format_waterfall,
+    skew_warnings)
+from nbdistributed_tpu.observability.metrics import (
+    LATENCY_BUCKETS, MetricsRegistry, validate_prometheus_text)
+
+pytestmark = [pytest.mark.unit, pytest.mark.obs]
+
+
+# ---------------------------------------------------------------------
+# wire header: absent when off, round-trips when on
+
+
+def test_lt_header_absent_when_unset():
+    frame = codec.encode(codec.Message(msg_type="execute",
+                                       data={"code": "x"}))
+    assert b'"lt"' not in frame
+    assert codec.decode(frame).latency is None
+
+
+def test_lt_header_roundtrip():
+    stamps = {"dq": 1.5, "xs": 2.5, "xe": 3.5, "cs": 0.25, "rs": 4.0}
+    frame = codec.encode(codec.Message(msg_type="response",
+                                       data={}, latency=stamps))
+    assert codec.decode(frame).latency == stamps
+    # request side: the flag form
+    req = codec.encode(codec.Message(msg_type="execute", data={},
+                                     latency=1))
+    assert codec.decode(req).latency == 1
+
+
+def test_reply_does_not_inherit_latency_flag():
+    msg = codec.Message(msg_type="execute", data={}, latency=1)
+    assert msg.reply(data={}).latency is None
+
+
+# ---------------------------------------------------------------------
+# observatory record construction
+
+
+def _reply(stamps, recv):
+    m = types.SimpleNamespace()
+    m.latency = stamps
+    m.recv_ts = recv
+    return m
+
+
+def _drive(obs, *, offset=0.0, skew=0.0, rank=0, base=1000.0):
+    """One synthetic request: coordinator timeline at ``base``; the
+    worker clock runs ``skew`` seconds ahead; ``offset`` is what the
+    estimator believes the skew is."""
+    clock = {"t": base}
+    obs._now = lambda: clock["t"]
+    obs.begin("m1", "execute", None, vet_s=0.001)
+    clock["t"] = base + 0.002          # queued for 2 ms
+    obs.note_grant("m1")
+    # worker-side chain, stamped on the worker's (skewed) clock
+    stamps = {"dq": base + 0.003 + skew, "xs": base + 0.004 + skew,
+              "xe": base + 0.010 + skew, "cs": 0.002,
+              "rs": base + 0.0101 + skew}
+    clock["t"] = base + 0.012
+    rec = obs.complete("m1", {rank: _reply(stamps, base + 0.011)},
+                       lambda r: offset, t_deliver=base + 0.012)
+    return rec
+
+
+def test_stage_chain_monotone_and_sums_to_e2e():
+    obs = LatencyObservatory(enabled=True, registry=MetricsRegistry())
+    rec = _drive(obs)
+    assert set(rec["stages"]) == set(STAGES)
+    assert all(v >= 0 for v in rec["stages"].values())
+    assert sum(rec["stages"].values()) == pytest.approx(rec["e2e"],
+                                                        rel=1e-6)
+    # compile split out of execute: handler was 6 ms, 2 ms compiling
+    assert rec["stages"]["compile"] == pytest.approx(0.002)
+    assert rec["stages"]["execute"] == pytest.approx(0.004)
+    assert rec["stages"]["vet"] == pytest.approx(0.001)
+    assert rec["stages"]["queue"] == pytest.approx(0.002)
+
+
+def test_skewed_clock_corrected_stages_stay_monotone():
+    """A worker clock 5 s ahead, perfectly estimated: corrected stages
+    equal the unskewed ones.  Under-estimated skew clamps at zero
+    instead of going negative."""
+    reg = MetricsRegistry()
+    ref = _drive(LatencyObservatory(enabled=True, registry=reg))
+    corrected = _drive(LatencyObservatory(enabled=True, registry=reg),
+                       skew=5.0, offset=5.0)
+    for s in STAGES:
+        assert corrected["stages"][s] == pytest.approx(
+            ref["stages"][s], abs=1e-9)
+    # estimator off by the full 5 s (offset=0): raw worker stamps land
+    # in the coordinator's future — wire inflates, reply would go
+    # NEGATIVE without the clamp
+    bad = _drive(LatencyObservatory(enabled=True, registry=reg),
+                 skew=5.0, offset=0.0)
+    assert all(v >= 0.0 for v in bad["stages"].values())
+    # the reply-WIRE split clamps to zero (not negative); only the
+    # same-clock (offset-immune) reply-build segment survives
+    assert bad["stages"]["reply"] == pytest.approx(0.0001, abs=1e-9)
+    # mis-estimation skews the wire/reply split, never the sum
+    assert sum(bad["stages"].values()) == pytest.approx(bad["e2e"],
+                                                       rel=1e-6)
+
+
+def test_disabled_observatory_records_nothing():
+    obs = LatencyObservatory(enabled=False, registry=MetricsRegistry())
+    obs.begin("m1", "execute")
+    obs.note_grant("m1")
+    assert obs.complete("m1", {}, lambda r: 0.0) is None
+    assert obs.records() == [] and obs.summary()["count"] == 0
+
+
+def test_drop_forgets_pending_and_counts():
+    obs = LatencyObservatory(enabled=True, registry=MetricsRegistry())
+    obs.begin("m1", "execute")
+    obs.drop("m1")
+    assert obs.dropped == 1
+    assert obs.complete("m1", {}, lambda r: 0.0) is None
+    # stampless replies (a worker predating the feature) drop too
+    obs.begin("m2", "execute")
+    m = types.SimpleNamespace()
+    assert obs.complete("m2", {0: m}, lambda r: 0.0) is None
+    assert obs.dropped == 2
+
+
+def test_ring_bounded_and_summary_percentiles():
+    obs = LatencyObservatory(enabled=True, ring=8,
+                             registry=MetricsRegistry())
+    for i in range(20):
+        obs._now = time.time
+        obs.begin(f"m{i}", "execute")
+        obs.note_grant(f"m{i}")
+        now = time.time()
+        st = {"dq": now, "xs": now, "xe": now + 0.001 * (i + 1),
+              "cs": 0.0}
+        obs.complete(f"m{i}", {0: _reply(st, now + 0.001 * (i + 1))},
+                     lambda r: 0.0)
+    assert len(obs.records()) == 8
+    s = obs.summary()
+    assert s["count"] == 8
+    assert s["stages"]["execute"]["p99"] >= \
+        s["stages"]["execute"]["p50"] > 0
+    assert s["e2e_ms"]["mean"] > 0
+
+
+def test_histograms_feed_registry_with_latency_buckets():
+    reg = MetricsRegistry()
+    obs = LatencyObservatory(enabled=True, registry=reg)
+    _drive(obs)
+    text = reg.prometheus_text()
+    assert "# TYPE nbd_stage_seconds histogram" in text
+    for s in STAGES:
+        assert f'nbd_stage_seconds_count{{stage="{s}"}} 1' in text
+    assert "# TYPE nbd_cell_e2e_seconds histogram" in text
+    # log-scale preset: the 100 µs bucket exists on the wire text
+    assert 'le="0.0001"' in text
+    assert validate_prometheus_text(text) == []
+
+
+def test_tenant_label_on_e2e_histogram():
+    reg = MetricsRegistry()
+    obs = LatencyObservatory(enabled=True, registry=reg)
+    clock = {"t": 100.0}
+    obs._now = lambda: clock["t"]
+    obs.begin("m1", "execute", "nb1")
+    obs.note_grant("m1")
+    st = {"dq": 100.0, "xs": 100.0, "xe": 100.001, "cs": 0.0}
+    obs.complete("m1", {0: _reply(st, 100.002)}, lambda r: 0.0,
+                 t_deliver=100.003)
+    text = reg.prometheus_text()
+    assert 'nbd_cell_e2e_seconds_count{tenant="nb1"} 1' in text
+    # eviction hygiene: the tenant's series is removable
+    assert reg.remove_label_series("tenant", "nb1") >= 1
+    assert 'tenant="nb1"' not in reg.prometheus_text()
+
+
+def test_stage_spans_mirrored_into_trace():
+    from nbdistributed_tpu.observability.spans import Tracer
+    reg = MetricsRegistry()
+    obs = LatencyObservatory(enabled=True, registry=reg)
+    tr = Tracer()
+    tr.start()
+    clock = {"t": 100.0}
+    obs._now = lambda: clock["t"]
+    obs.begin("m1", "execute", None, vet_s=0.001)
+    clock["t"] = 100.002
+    obs.note_grant("m1")
+    st = {"dq": 100.003, "xs": 100.004, "xe": 100.010, "cs": 0.002}
+    obs.complete("m1", {0: _reply(st, 100.011)}, lambda r: 0.0,
+                 t_deliver=100.012, tracer=tr,
+                 parent={"tid": "T", "sid": "S"})
+    spans = tr.dump()["spans"]
+    names = {s["name"] for s in spans}
+    assert {"stage/vet", "stage/queue", "stage/execute",
+            "stage/compile", "stage/reply"} <= names
+    assert all(s["parent_id"] == "S" and s["trace_id"] == "T"
+               for s in spans)
+    # contiguous: each stage starts where the previous ended
+    ordered = sorted(spans, key=lambda s: s["t0"])
+    for a, b in zip(ordered, ordered[1:-1]):
+        assert b["t0"] == pytest.approx(a["t0"] + a["dur"], abs=1e-9)
+
+
+# ---------------------------------------------------------------------
+# rendering
+
+
+def test_format_stage_table_and_waterfall():
+    obs = LatencyObservatory(enabled=True, registry=MetricsRegistry())
+    assert "no completed cells" in format_stage_table(obs.summary())
+    _drive(obs)
+    table = format_stage_table(obs.summary())
+    for s in STAGES:
+        assert s in table
+    wf = format_waterfall(obs.records(1))
+    assert "e2e" in wf and "execute" in wf and "█" in wf
+
+
+# ---------------------------------------------------------------------
+# exposition validator
+
+
+def test_validate_prometheus_text_flags_garbage():
+    good = MetricsRegistry()
+    good.counter("a_total", "help").inc()
+    good.histogram("h_seconds", "help",
+                   buckets=LATENCY_BUCKETS).observe(0.01)
+    assert validate_prometheus_text(good.prometheus_text()) == []
+    assert validate_prometheus_text("not a metric line!\n")
+    assert validate_prometheus_text("orphan_sample 1\n")  # no TYPE
+    assert validate_prometheus_text("# TYPE x bogus_kind\n")
+
+
+# ---------------------------------------------------------------------
+# clock-skew + flight-health satellites
+
+
+def test_skew_warning_threshold():
+    stats = {0: {"offset_s": 0.002, "min_rtt_s": 0.001, "samples": 9},
+             1: {"offset_s": -0.120, "min_rtt_s": 0.001, "samples": 9}}
+    warns = skew_warnings(stats, threshold_ms=50.0)
+    assert len(warns) == 1 and "rank 1" in warns[0]
+    assert "-120.0 ms" in warns[0]
+    assert skew_warnings(stats, threshold_ms=0) == []
+    assert skew_warnings(stats, threshold_ms=500.0) == []
+
+
+def test_export_clock_metrics_gauges():
+    reg = MetricsRegistry()
+
+    class _Clock:
+        @staticmethod
+        def stats():
+            return {2: {"offset_s": 0.05, "min_rtt_s": 0.003,
+                        "samples": 4}}
+
+    lat_mod.export_clock_metrics(_Clock(), reg)
+    text = reg.prometheus_text()
+    assert 'nbd_clock_offset_seconds{rank="2"} 0.05' in text
+    assert 'nbd_clock_min_rtt_seconds{rank="2"} 0.003' in text
+
+
+def test_flight_health_counters(tmp_path):
+    from nbdistributed_tpu.observability.flightrec import FlightRecorder
+    rec = FlightRecorder(str(tmp_path / "x.ring"), ring_bytes=1)
+    # ring_bytes is clamped to 4 max-size records; spam until it wraps
+    for i in range(600):
+        rec.record("ev", i=i, pad="y" * 100)
+    h = rec.health()
+    assert h["records"] == 600
+    assert h["wraps"] >= 1
+    assert h["overwritten"] > 0
+    assert h["utilization"] == 1.0  # wrapped: appends destroy history
+    # oversize payload counts as truncated
+    rec.record("big", blob="z" * 10000)
+    assert rec.health()["truncated"] == 1
+    rec.close()
+
+
+# ---------------------------------------------------------------------
+# scrape endpoint
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+@pytest.fixture
+def httpd():
+    servers = []
+
+    def make(**kw):
+        kw.setdefault("collect_metrics",
+                      lambda: "# TYPE up gauge\nup 1\n")
+        kw.setdefault("collect_health", lambda: {"status": "ok"})
+        kw.setdefault("collect_latency",
+                      lambda: {"summary": {"count": 1}, "records": []})
+        srv = MetricsHTTPD(port=0, **kw)
+        servers.append(srv)
+        return srv
+
+    yield make
+    for s in servers:
+        s.close()
+
+
+def test_httpd_routes(httpd):
+    srv = httpd()
+    base = f"http://127.0.0.1:{srv.port}"
+    code, body, ctype = _get(f"{base}/metrics")
+    assert code == 200 and body == b"# TYPE up gauge\nup 1\n"
+    assert "version=0.0.4" in ctype
+    code, body, _ = _get(f"{base}/healthz")
+    assert code == 200 and json.loads(body) == {"status": "ok"}
+    code, body, _ = _get(f"{base}/latency.json")
+    assert code == 200 and json.loads(body)["summary"]["count"] == 1
+    assert _get(f"{base}/nope")[0] == 404
+
+
+def test_httpd_token_gating(httpd):
+    srv = httpd(token="s3cret")
+    base = f"http://127.0.0.1:{srv.port}"
+    assert _get(f"{base}/metrics")[0] == 401
+    assert _get(f"{base}/latency.json")[0] == 401
+    assert _get(f"{base}/metrics?token=wrong")[0] == 401
+    assert _get(f"{base}/metrics?token=s3cret")[0] == 200
+    req = urllib.request.Request(
+        f"{base}/latency.json",
+        headers={"Authorization": "Bearer s3cret"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.status == 200
+    # health is NEVER gated: the LB prober holds no secrets
+    assert _get(f"{base}/healthz")[0] == 200
+
+
+def test_httpd_collector_failure_is_500_not_crash(httpd):
+    def boom():
+        raise RuntimeError("collector exploded")
+
+    srv = httpd(collect_metrics=boom)
+    base = f"http://127.0.0.1:{srv.port}"
+    code, body, _ = _get(f"{base}/metrics")
+    assert code == 500 and b"collector exploded" in body
+    # the server survives for the next scrape
+    assert _get(f"{base}/healthz")[0] == 200
+
+
+# ---------------------------------------------------------------------
+# comm-bound collectors (the /metrics worker-view merge)
+
+
+def test_collectors_for_comm_merge_worker_telemetry():
+    from nbdistributed_tpu.observability.httpd import collectors_for_comm
+
+    class _Clock:
+        @staticmethod
+        def stats():
+            return {0: {"offset_s": 0.001, "min_rtt_s": 0.0005,
+                        "samples": 3}}
+
+        @staticmethod
+        def offset(_r):
+            return 0.001
+
+    class _Comm:
+        num_workers = 2
+        clock = _Clock()
+        lat = LatencyObservatory(enabled=True,
+                                 registry=MetricsRegistry())
+
+        @staticmethod
+        def last_seen(r):
+            return time.time() - 0.5 if r == 0 else None
+
+        @staticmethod
+        def last_telemetry(r):
+            if r != 0:
+                return None
+            return {"ts": time.time(),
+                    "hbm": [{"id": 0, "in_use": 1000, "peak": 2000,
+                             "limit": 4000}],
+                    "bufs": 7, "compiles": 3, "compile_s": 1.5,
+                    "dedup": 2, "msgs": 40}
+
+        @staticmethod
+        def dead_ranks():
+            return {1}
+
+        @staticmethod
+        def connected_ranks():
+            return [0]
+
+        @staticmethod
+        def pending_snapshot():
+            return {}
+
+    cm, ch, cl = collectors_for_comm(
+        _Comm(), extra_health=lambda: {"kind": "gateway"})
+    text = cm()
+    assert validate_prometheus_text(text) == []
+    # worker view merged through the telemetry piggyback, rank-labeled
+    assert 'nbd_worker_hbm_in_use_bytes{rank="0"} 1000' in text
+    assert 'nbd_worker_live_buffers{rank="0"} 7' in text
+    assert 'nbd_worker_dedup_hits{rank="0"} 2' in text
+    assert 'nbd_clock_offset_seconds{rank="0"} 0.001' in text
+    assert "nbd_flight_ring_utilization" in text
+    h = ch()
+    assert h["status"] == "degraded" and h["dead"] == [1]
+    assert h["alive"] == [0] and h["kind"] == "gateway"
+    assert cl() == {"summary": {"count": 0, "dropped": 0},
+                    "records": []}

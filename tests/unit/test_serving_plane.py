@@ -480,3 +480,76 @@ def test_metrics_remove_label_series():
     assert reg.remove_label_series("tenant", "a") == 0
     with pytest.raises(ValueError):
         reg.gauge("nbd_x_total")
+
+
+# ----------------------------------------------------------------------
+# serving SLO histograms (ISSUE 13)
+
+
+def test_slo_histograms_per_tenant_and_eviction(tmp_path):
+    """Completed requests observe TTFT / TPOT / queue-wait / e2e into
+    per-SUBMITTING-tenant histograms; describe() carries the p50/p99
+    block split per tenant; tenant eviction's remove_label_series
+    really retires the series."""
+    from nbdistributed_tpu.observability import metrics as obs_metrics
+    comm = FakeComm()
+    mgr, _d, _n = make_mgr(tmp_path, comm)
+    mgr.start()
+    try:
+        rids = [mgr.submit("nb1", [5, 9, 2], 5)["rid"],
+                mgr.submit("nb2", [7, 1], 4)["rid"]]
+        wait_done(mgr, rids)
+    finally:
+        mgr.stop()
+    text = obs_metrics.registry().prometheus_text()
+    for name in ("nbd_serve_ttft_seconds",
+                 "nbd_serve_queue_wait_seconds",
+                 "nbd_serve_e2e_seconds"):
+        assert f'{name}_count{{tenant="nb1"}} 1' in text
+        assert f'{name}_count{{tenant="nb2"}} 1' in text
+    # 5 tokens at 2/tick = 3 emissions: 2 inter-emission gaps observe
+    # the per-token rate (the first batch is TTFT, never TPOT)
+    assert 'nbd_serve_tpot_seconds_count{tenant="nb1"} 2' in text
+
+    slo = mgr.describe()["slo"]
+    assert slo["e2e_ms"]["n"] == 2
+    assert slo["ttft_ms"]["p99"] >= slo["ttft_ms"]["p50"] >= 0
+    assert set(slo["tenants"]) == {"nb1", "nb2"}
+    assert slo["tenants"]["nb1"]["e2e_ms"]["n"] == 1
+
+    # eviction hygiene: dropping nb1 removes ITS series, keeps nb2's
+    assert obs_metrics.registry().remove_label_series(
+        "tenant", "nb1") >= 4
+    text = obs_metrics.registry().prometheus_text()
+    assert 'nbd_serve_ttft_seconds_count{tenant="nb1"}' not in text
+    assert 'nbd_serve_ttft_seconds_count{tenant="nb2"} 1' in text
+
+
+def test_slo_queue_wait_counts_first_placement_only(tmp_path):
+    """A failover re-admission is a heal, not queue wait: the queue
+    histogram observes once per request even when the decode rank dies
+    mid-stream and the request is re-placed."""
+    from nbdistributed_tpu.observability import metrics as obs_metrics
+    reg = obs_metrics.registry()
+
+    def qcount():
+        j = reg.to_json()["histograms"]
+        e = j.get('nbd_serve_queue_wait_seconds{tenant="qw1"}')
+        return e["count"] if e else 0
+
+    base = qcount()
+    comm = FakeComm(per_tick=1, tick_delay=0.05)
+    mgr, _d, _n = make_mgr(tmp_path, comm)
+    mgr.start()
+    try:
+        rid = mgr.submit("qw1", [5, 9, 2], 6)["rid"]
+        deadline = time.monotonic() + 10
+        while mgr.result(rid)["tokens"] == [] \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        comm.kill(1)          # decode rank dies mid-stream
+        wait_done(mgr, [rid])
+        assert mgr.describe()["failovers"] >= 1
+    finally:
+        mgr.stop()
+    assert qcount() - base == 1
