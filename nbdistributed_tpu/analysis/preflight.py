@@ -153,6 +153,17 @@ def _edge_names(earlier: dict, later: dict) -> list[str]:
     return sorted(raw | war | waw)
 
 
+def hazard_names(earlier: dict, later: dict) -> list[str]:
+    """Public form of the pairwise hazard test: the RAW/WAR/WAW names
+    forbidding reorder between two footprint entries (``["*"]`` when
+    either is opaque), empty when the pair may overlap freely.  This
+    is the exact admission predicate of the async in-flight window
+    (messaging/pipeline.py) — the same function that draws
+    ``deps_dag``'s edges, so "no edge" and "admissible" can never
+    drift apart."""
+    return _edge_names(earlier, later)
+
+
 def dag_from_entries(cells: list[dict]) -> dict:
     """The dependency DAG of an explicit entry list (each entry an
     ``EffectReport.as_dict()`` summary plus ``seq``/``sha``) — the

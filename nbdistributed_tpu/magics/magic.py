@@ -59,6 +59,10 @@ Every cell now runs on ALL workers. Namespace on each worker:
 
 Magics: %%rank [0,1] targeted cells · %sync barrier · %dist_interrupt ·
 %dist_status ·
+%%distributed --async (stream cells through the DAG-gated in-flight
+window — NBD_ASYNC_WINDOW arms it session-wide) · %dist_wait (drain
+the window) · %%distributed --repeat k [--until EXPR] (compile once,
+loop worker-side, per-step telemetry on heartbeats) ·
 %dist_mode -d/-e auto-run off/on · %dist_pull/%dist_push vars ·
 %dist_checkpoint/%dist_restore path names · %dist_heal [--restore ckpt] ·
 %dist_profile start/stop · %dist_trace start/stop/save (Perfetto) ·
@@ -150,6 +154,10 @@ class DistributedMagics(Magics):
     # scheduler, and %dist_status/%dist_top render the pool view.
     _tenant = None              # gateway.client.TenantClient | None
     _pool_info: dict | None = None   # the gateway manifest we attached to
+    # Async pipelined executor (ISSUE 14): the bounded in-flight
+    # window %%distributed --async / NBD_ASYNC_WINDOW cells stream
+    # through.  Created lazily against the live comm; dropped with it.
+    _async_exec = None          # messaging.pipeline.AsyncExecutor | None
 
     _cell_hooks: tuple | None = None
 
@@ -255,6 +263,7 @@ class DistributedMagics(Magics):
         # it names a COMPLETED checkpoint, healing's restore target.
         cls._clear_bg_ckpt()
         cls._drop_tenant_state()
+        cls._async_exec = None
         cls._comm = None
         cls._pm = None
         cls._world = 0
@@ -316,11 +325,19 @@ class DistributedMagics(Magics):
 
     def _run_on_ranks(self, code: str, ranks: list[int], kind: str,
                       deadline_s: float | None = None,
-                      vet_s: float | None = None):
+                      vet_s: float | None = None,
+                      repeat: int | None = None,
+                      until: str | None = None):
         """Send an execute request and stream output while waiting
         (reference: magic.py:1042-1129 runs the send in a helper thread
         and polls buffers from the main thread; same structure, 30 ms
-        cadence instead of 100 ms)."""
+        cadence instead of 100 ms).  ``repeat``/``until`` ride the
+        payload: the worker compiles once and loops k steps
+        (ISSUE 14)."""
+        # A synchronous cell is a sync point for the async window:
+        # every streamed cell completes (and surfaces its errors)
+        # before this one dispatches, so program order stays readable.
+        self._drain_async("synchronous cell")
         comm = self._comm
         assert comm is not None
         disp = display_mod.StreamDisplay()
@@ -356,6 +373,12 @@ class DistributedMagics(Magics):
                     # the hang watchdog can enforce the budget with
                     # no coordinator-side bookkeeping.
                     payload["deadline_s"] = deadline_s
+                if repeat is not None:
+                    # Worker-side step loop: compile once, run k
+                    # steps, report per-step progress on heartbeats.
+                    payload["repeat"] = int(repeat)
+                    if until:
+                        payload["until"] = until
                 with tr.activate(cell_span):
                     # vet_s: how long pre-dispatch vetting took — the
                     # latency observatory's "vet" stage.
@@ -406,6 +429,20 @@ class DistributedMagics(Magics):
                 print(f"❌ {type(e).__name__}: {e}")
             return None
         display_mod.print_rank_errors(result)
+        if repeat is not None and result:
+            d0 = next((m.data for m in result.values()
+                       if isinstance(getattr(m, "data", None), dict)
+                       and m.data.get("steps") is not None), None)
+            if d0 is not None and not d0.get("error"):
+                early = (" (stopped early by --until)"
+                         if d0.get("stopped_early") else "")
+                last = d0.get("last_scalar")
+                print(f"🔁 {d0['steps']}/{d0.get('repeat')} steps in "
+                      f"{d0.get('duration_s', 0):.2f}s — "
+                      f"{d0.get('steps_per_s', 0):.1f} steps/s, one "
+                      f"dispatch{early}"
+                      + (f" · last {last:g}" if last is not None
+                         else ""))
         self._record_cell_ranks(result, ranks)
         return result
 
@@ -2023,9 +2060,12 @@ class DistributedMagics(Magics):
             return
         from ..resilience.watchdog import hang_report
         args = parse_argstring(self.dist_doctor, line)
+        ex = DistributedMagics._async_exec
         report = hang_report(self._comm, self._pm,
                              DistributedMagics._watchdog,
-                             dump_stacks=not args.no_stacks)
+                             dump_stacks=not args.no_stacks,
+                             async_window=(ex.snapshot()
+                                           if ex is not None else None))
         print(report)
         if args.save:
             try:
@@ -2264,6 +2304,152 @@ class DistributedMagics(Magics):
         print(f"✅ cell vetting {verb}")
 
     # ==================================================================
+    # async pipelined execution (ISSUE 14)
+
+    @classmethod
+    def _async_window_armed(cls) -> bool:
+        """Session-wide async mode: NBD_ASYNC_WINDOW > 0 makes every
+        %%distributed cell stream through the window by default
+        (--sync opts out per cell)."""
+        return _knobs.get_int("NBD_ASYNC_WINDOW", 0) > 0
+
+    def _ensure_async_executor(self):
+        """The lazily-built AsyncExecutor over the live comm.  One per
+        fleet: reset_class_state/shutdown_all drop it with the comm."""
+        cls = DistributedMagics
+        ex = cls._async_exec
+        if ex is not None and ex.comm is self._comm:
+            return ex
+        from ..messaging.pipeline import AsyncExecutor
+        ex = AsyncExecutor(
+            self._comm,
+            on_hold=lambda reason: print(f"⧗ held: {reason} — "
+                                         "waiting for the window"),
+            on_result=self._async_cell_done)
+        cls._async_exec = ex
+        return ex
+
+    @staticmethod
+    def _async_cell_done(cell) -> None:
+        """Executor completion hook (IO thread): surface an async
+        cell's ERROR the moment its reply lands — stdout already
+        streamed live; a quiet success needs no echo, a silent error
+        would vanish."""
+        fut = cell.future
+        if fut.state == "error" and not fut.consumed:
+            fut.consumed = True
+            print(f"\n✗ async cell #{fut.seq}: {fut.error}")
+
+    def _warn_unconsumed_async(self) -> None:
+        """The next-cell warn pass (the proxy-future consumption
+        contract): errored futures nobody inspected are announced
+        once instead of vanishing."""
+        ex = DistributedMagics._async_exec
+        if ex is None:
+            return
+        for fut in ex.unconsumed_errors():
+            print(f"⚠️ async cell #{fut.seq} errored un-inspected: "
+                  f"{fut.error} (.result() on its handle re-raises)")
+
+    def _drain_async(self, why: str,
+                     timeout: float | None = None) -> list:
+        """Drain the in-flight window (the sync points: a synchronous
+        cell, %sync, %dist_wait, shutdown).  Errors surface here —
+        rendered once, futures marked consumed."""
+        ex = DistributedMagics._async_exec
+        if ex is None or ex.depth == 0:
+            return []
+        depth = ex.depth
+        print(f"⧗ draining async window ({depth} in flight) — {why}")
+        try:
+            futures = ex.drain(timeout)
+        except KeyboardInterrupt:
+            print("🛑 drain interrupted — cells keep running on the "
+                  "workers; %dist_wait to re-drain")
+            return []
+        for fut in futures:
+            if fut.state == "error" and not fut.consumed:
+                fut.consumed = True
+                print(f"✗ async cell #{fut.seq}: {fut.error}")
+        return futures
+
+    @magic_arguments()
+    @argument("--timeout", type=float, default=None,
+              help="bound the drain in seconds (cells still pending "
+                   "at the deadline stay in flight)")
+    @line_magic
+    def dist_wait(self, line):
+        """Drain the async in-flight window (ISSUE 14): block until
+        every ``%%distributed --async`` / ``NBD_ASYNC_WINDOW``-
+        streamed cell has completed, render any errors, and refresh
+        the IDE proxies.  The explicit sync point of async pipelined
+        execution — a synchronous cell or ``%sync`` drains
+        implicitly."""
+        args = parse_argstring(self.dist_wait, line)
+        ex = DistributedMagics._async_exec
+        if ex is None or ex.depth == 0:
+            snap = ex.snapshot() if ex is not None else {}
+            done = snap.get("completed", 0)
+            print("✅ async window empty"
+                  + (f" · {done} cell(s) completed this session, "
+                     f"{snap.get('errored', 0)} errored"
+                     if done else ""))
+            return
+        futures = self._drain_async("%dist_wait", args.timeout)
+        still = [f for f in futures if not f.done]
+        ok = sum(1 for f in futures if f.state == "done")
+        err = sum(1 for f in futures if f.state == "error")
+        print(f"✅ drained {ok} cell(s)"
+              + (f" · {err} errored" if err else "")
+              + (f" · {len(still)} still in flight (--timeout hit)"
+                 if still else ""))
+        if not still and self._running():
+            self._sync_ide_quietly()
+
+    def _run_async(self, code: str, ranks: list[int], *,
+                   deadline_s=None, repeat=None, until=None,
+                   vet_s=None):
+        """Submit one cell through the async window and return its
+        CellFuture (the cell magic's return value — IPython's display
+        hook echoes the pending handle; the executor resolves it when
+        the replies land)."""
+        from ..runtime.collective_guard import cell_hash
+        from ..analysis import preflight
+        sha = cell_hash(code)
+        # The entry _note_effects just recorded for THIS cell — the
+        # admission gate's footprint (None → treated opaque, which
+        # drains the window and serializes; %dist_lint off lands here
+        # on purpose: no proofs, no overlap).
+        entry = preflight.effects_for(sha)
+        ex = self._ensure_async_executor()
+        # The timeline row records the SUBMISSION (per-rank durations
+        # live on the future; the row closes immediately — an async
+        # cell must not look like a still-running cell forever).
+        rec = self._timeline.start(code, ranks, kind="async")
+        self._timeline.finish(rec, None)
+        try:
+            fut = ex.submit_cell(
+                code, ranks, entry=entry, sha=sha,
+                deadline_s=deadline_s, repeat=repeat, until=until,
+                vet_s=vet_s)
+        except KeyboardInterrupt:
+            print("🛑 interrupted while held at the window gate — "
+                  "nothing was submitted (%dist_wait drains the "
+                  "window)")
+            return None
+        except Exception as e:
+            print(f"❌ async submit failed: {type(e).__name__}: {e}")
+            return None
+        snap = ex.snapshot()
+        print(f"⧗ async cell #{fut.seq} streamed to ranks {ranks} "
+              f"(window {snap['depth']}/{snap['window']}"
+              + (f", collective stream held by "
+                 f"#{snap['collective_holder']}"
+                 if snap.get("collective_holder") is not None else "")
+              + ") — %dist_wait drains")
+        return fut
+
+    # ==================================================================
     # execution magics
 
     @magic_arguments()
@@ -2279,20 +2465,47 @@ class DistributedMagics(Magics):
               help="tenant mode only: this cell's pool-scheduling "
                    "priority (higher dispatches first in fair mode; "
                    "default: the tenant's attach-time priority)")
+    @argument("--async", dest="use_async", action="store_true",
+              help="stream this cell through the async in-flight "
+                   "window and return a pending CellFuture instead "
+                   "of blocking (admission gated by the effects/deps "
+                   "DAG; %%dist_wait drains)")
+    @argument("--sync", dest="use_sync", action="store_true",
+              help="force synchronous dispatch for this cell (drains "
+                   "the async window first) even when "
+                   "NBD_ASYNC_WINDOW arms async mode session-wide")
+    @argument("--repeat", type=int, default=None, metavar="K",
+              help="worker-side step loop: compile the cell once and "
+                   "run it K times in ONE dispatch — per-step "
+                   "progress (step, last scalar, steps/s) rides the "
+                   "heartbeats; a redelivered request never re-runs "
+                   "steps")
+    @argument("--until", default=None, metavar="EXPR",
+              help="with --repeat: stop early when this expression "
+                   "is truthy in the worker namespace (evaluated "
+                   "after each step), e.g. --until 'loss < 0.1'")
     @cell_magic
     def distributed(self, line, cell):
         """Run the cell on every worker (reference: magic.py:1042-1129).
         ``%%distributed --deadline 60`` arms a per-cell budget the
-        hang watchdog enforces through its escalation ladder.  In
+        hang watchdog enforces through its escalation ladder.
+        ``--async`` streams the cell through the bounded in-flight
+        window (ISSUE 14) and returns a pending future; ``--repeat K
+        [--until EXPR]`` compiles once and loops worker-side.  In
         tenant mode (``%dist_attach --tenant``) the cell is submitted
         to the gateway pool instead — same vetting, explicit
         queued/shed verdicts, per-tenant isolated namespace."""
+        self._warn_unconsumed_async()
         if DistributedMagics._tenant is not None:
             try:
                 args = parse_argstring(self.distributed, line)
             except Exception as e:
                 print(f"❌ {e}")
                 return
+            if args.use_async or args.repeat is not None:
+                print("⚠️ --async/--repeat are single-kernel options "
+                      "(the pool's scheduler owns tenant-mode "
+                      "overlap) — dispatching synchronously")
             if not self._vet_cell(cell, list(range(self._world)),
                                   strict=args.strict):
                 return
@@ -2309,6 +2522,26 @@ class DistributedMagics(Magics):
         if args.priority is not None:
             print("⚠️ --priority only applies in tenant (pool) mode "
                   "— ignored")
+        if args.use_async and args.use_sync:
+            print("❌ choose one of --async / --sync")
+            return
+        if args.until is not None:
+            # IPython's non-posix arg_split keeps quote chars inside
+            # the token: without the strip, --until 'loss < 0.1'
+            # evaluates a quoted STRING — always truthy — and stops
+            # after one step.  Strip ONE matching outer pair only
+            # (the expression may legitimately end in a quote:
+            # --until "phase == 'done'").
+            u = args.until.strip()
+            if len(u) >= 2 and u[0] == u[-1] and u[0] in "'\"":
+                u = u[1:-1]
+            args.until = u
+        if args.until and args.repeat is None:
+            print("❌ --until requires --repeat K")
+            return
+        if args.repeat is not None and args.repeat < 1:
+            print("❌ --repeat needs K >= 1")
+            return
         if args.deadline is not None:
             if DistributedMagics._watchdog is None:
                 print("⚠️ --deadline set but the hang watchdog is off "
@@ -2322,10 +2555,24 @@ class DistributedMagics(Magics):
         if not self._vet_cell(cell, list(range(self._world)),
                               strict=args.strict):
             return
+        use_async = (args.use_async
+                     or (self._async_window_armed()
+                         and not args.use_sync))
+        if use_async:
+            # The window path: return the pending future — IPython's
+            # display hook echoes it; the executor resolves it when
+            # the replies land.  Its admission gate consults the
+            # footprint _vet_cell just recorded.
+            return self._run_async(
+                cell, list(range(self._world)),
+                deadline_s=args.deadline, repeat=args.repeat,
+                until=args.until, vet_s=time.monotonic() - t_vet)
         result = self._run_on_ranks(cell, list(range(self._world)),
                                     kind="distributed",
                                     deadline_s=args.deadline,
-                                    vet_s=time.monotonic() - t_vet)
+                                    vet_s=time.monotonic() - t_vet,
+                                    repeat=args.repeat,
+                                    until=args.until)
         if result is not None:
             self._sync_ide_quietly()
 
@@ -2333,6 +2580,7 @@ class DistributedMagics(Magics):
     def rank(self, line, cell):
         """Run the cell on selected ranks: ``%%rank [0,2]`` / ``[0-2]``
         (reference: magic.py:1476-1565)."""
+        self._warn_unconsumed_async()
         if not self._require_cluster():
             return
         try:
@@ -2399,9 +2647,12 @@ class DistributedMagics(Magics):
 
     @line_magic
     def sync(self, line):
-        """Barrier across all workers (reference: magic.py:1567-1587)."""
+        """Barrier across all workers (reference: magic.py:1567-1587).
+        Also a sync point for the async window: in-flight streamed
+        cells drain (and surface their errors) before the barrier."""
         if not self._require_cluster():
             return
+        self._drain_async("%sync barrier")
         try:
             self._comm.send_to_all("sync", timeout=120)
             print(f"✅ All {self._world} workers synchronized")
@@ -2652,6 +2903,27 @@ class DistributedMagics(Magics):
             from ..observability import latency as lat_mod
             for w in lat_mod.skew_warnings(self._comm.clock.stats()):
                 print(w)
+        ex = DistributedMagics._async_exec
+        if ex is not None:
+            snap = ex.snapshot()
+            if snap["depth"]:
+                holder = snap.get("collective_holder")
+                print(f"⧗ async window: {snap['depth']}/"
+                      f"{snap['window']} in flight"
+                      + (f" · collective stream held by cell "
+                         f"#{holder}" if holder is not None
+                         else " · all proven collective-free"))
+                for c in snap["cells"]:
+                    print(f"   #{c['seq']} {c['sha'] or '?'} · "
+                          f"{c['collective']} · {c['age_s']}s in "
+                          f"flight · {c['state']}")
+            elif snap["submitted"]:
+                print(f"⧗ async window idle · {snap['completed']} "
+                      f"cell(s) completed"
+                      + (f", {snap['errored']} errored"
+                         if snap["errored"] else "")
+                      + (f", held {snap['held_total']}×"
+                         if snap["held_total"] else ""))
         sup = DistributedMagics._supervisor
         if sup is not None:
             print(sup.describe())
@@ -3355,6 +3627,14 @@ class DistributedMagics(Magics):
             if ping is not None and ping[1].get("busy_s") is not None:
                 busy = (f"{ping[1].get('busy_type')} "
                         f"{ping[1]['busy_s'] + (now - ping[0]):.1f}s")
+                rep = ping[1].get("rep")
+                if rep:
+                    # Step-loop progress (ISSUE 14): one dispatch, k
+                    # steps — the per-step view without a probe.
+                    busy = (f"step {rep.get('i')}/{rep.get('k')} "
+                            f"{rep.get('sps', 0)}/s")
+                    if rep.get("last") is not None:
+                        busy += f" {rep['last']:g}"
             tcol = ""
             if tenants_seen:
                 tcol = f"{ping[1].get('busy_tenant') or '-':<11}" \
@@ -3617,6 +3897,10 @@ class DistributedMagics(Magics):
                                        cls._proxy_registry)
             except Exception:
                 pass
+        # Window futures still pending at teardown resolve through the
+        # handles' death/disconnect aborts; the executor itself dies
+        # with the comm it wraps.
+        cls._async_exec = None
         cls._comm = None
         cls._pm = None
         cls._world = 0

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ast
 import importlib
+import threading
 import types
 from typing import Any
 
@@ -112,6 +113,116 @@ class _ObjectProxy:
             return (f"<remote {d.get('type', 'container')} "
                     f"len={d.get('len', '?')} on workers>")
         return d.get("repr") or f"<remote {d.get('type', 'object')}>"
+
+
+class CellFuture:
+    """The notebook-side handle of one async ``%%distributed`` cell
+    (ISSUE 14): the cell magic returns this immediately — IPython's
+    display hook echoes it as a pending handle — and the async
+    executor resolves it when the workers' replies land.
+
+    Consumption contract (matches the background-checkpoint handle's
+    first-done-poll discipline in magic.py, made explicit here):
+
+    * ``resolve``/``reject`` are **idempotent** — the first terminal
+      transition wins, later calls return ``False`` and change
+      nothing (a late redelivered reply can never flip an outcome);
+    * an **errored** future surfaces its error on first *touch*
+      (``result()``/``raise_if_error()``) **or at the next sync
+      point** (``%dist_wait`` / a synchronous cell draining the
+      window) — and if nothing ever touches it, the magic layer warns
+      at the next cell instead of letting the error vanish;
+    * reading the outcome marks the future **consumed**, so the warn
+      pass never nags about an error the user already saw.
+    """
+
+    PENDING, DONE, ERROR = "pending", "done", "error"
+
+    def __init__(self, code: str, seq: int, ranks: list[int]):
+        self.code = code
+        self.seq = seq
+        self.ranks = list(ranks)
+        self.state = self.PENDING
+        self.results: dict | None = None   # rank -> reply data dict
+        self.error: Exception | None = None
+        self.consumed = False
+        self.warned = False
+        self.msg_id: str | None = None
+        self._event = threading.Event()
+        setattr(self, PROXY_TAG, True)
+
+    # -- terminal transitions (idempotent, first one wins) -------------
+
+    def resolve(self, results: dict) -> bool:
+        if self.state != self.PENDING:
+            return False
+        self.results = dict(results or {})
+        # Per-rank errors are errors: they must not slide by as a
+        # quiet success just because the transport succeeded.
+        rank_errors = {r: d.get("error")
+                       for r, d in self.results.items()
+                       if isinstance(d, dict) and d.get("error")}
+        if rank_errors:
+            self.state = self.ERROR
+            lines = "; ".join(f"rank {r}: {e}"
+                              for r, e in sorted(rank_errors.items()))
+            self.error = RuntimeError(
+                f"async cell #{self.seq} errored — {lines}")
+        else:
+            self.state = self.DONE
+        self._event.set()
+        return True
+
+    def reject(self, exc: Exception) -> bool:
+        if self.state != self.PENDING:
+            return False
+        self.error = exc
+        self.state = self.ERROR
+        self._event.set()
+        return True
+
+    # -- consumption ----------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self.state != self.PENDING
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> dict:
+        """Block until resolved; raise the cell's error on first
+        touch; return ``{rank: reply_data}`` otherwise."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"async cell #{self.seq} still in flight after "
+                f"{timeout}s — %dist_wait drains the window")
+        self.consumed = True
+        if self.error is not None:
+            raise self.error
+        return self.results or {}
+
+    def raise_if_error(self) -> None:
+        """The sync-point touch: consumes and re-raises an error,
+        no-op while pending or on success."""
+        if self.state == self.ERROR:
+            self.consumed = True
+            raise self.error
+
+    def __repr__(self) -> str:
+        if self.state == self.PENDING:
+            return (f"⧗ async cell #{self.seq} in flight on ranks "
+                    f"{self.ranks} — %dist_wait to drain, "
+                    f".result() to block")
+        if self.state == self.ERROR:
+            self.consumed = True
+            return f"✗ async cell #{self.seq}: {self.error}"
+        outs = {r: (d or {}).get("output", "")
+                for r, d in sorted((self.results or {}).items())}
+        first = next(iter(outs.values()), "")
+        tail = first.strip().splitlines()[-1] if first.strip() else ""
+        return (f"✓ async cell #{self.seq} · {len(outs)} ranks"
+                + (f" · {tail[:60]}" if tail else ""))
 
 
 _MISSING = object()

@@ -105,6 +105,12 @@ WIRE_EXTENSIONS: dict[str, dict] = {
             "doc": "serving-loop telemetry while a DecodeServer is "
                    "live (tokens total, tokens/s, KV-slot occupancy) "
                    "— the %dist_top / pool-status serving columns"},
+    "rep": {"plane": "ping",
+            "doc": "step-loop progress of an in-flight %%distributed "
+                   "--repeat cell (step index, total, last scalar, "
+                   "steps/s) — per-step telemetry with one dispatch; "
+                   "also collective-progress evidence for the hang "
+                   "watchdog (a stepping loop is never a stall)"},
 }
 
 

@@ -64,11 +64,18 @@ class WorkerDied(RuntimeError):
 
 class _Pending:
     __slots__ = ("expect", "responses", "event", "failure", "sent_at",
-                 "msg_type", "cell_sha1", "tenant")
+                 "msg_type", "cell_sha1", "tenant", "on_done")
 
     def __init__(self, expect: set[int], msg_type: str = "",
                  tenant: str | None = None):
         self.msg_type = msg_type
+        # Completion hook for ASYNC submissions (ISSUE 14): invoked on
+        # the IO thread right after ``event.set()`` so a pipelined
+        # cell's future resolves the moment its last reply lands,
+        # without a waiter thread per in-flight cell.  None on the
+        # synchronous path — wait() then finalizes on the caller
+        # thread exactly as before the submit/wait split.
+        self.on_done = None
         # Which tenant's cell this is (gateway pools) — lets the hang
         # watchdog / doctor / %dist_top attribute an in-flight request
         # to the right tenant.  None on the single-kernel path.
@@ -86,6 +93,281 @@ class _Pending:
         # worker reports as ``cell_sha1``): lets a hang verdict on this
         # request cite the pre-dispatch lint finding for its cell.
         self.cell_sha1: str | None = None
+
+
+class PendingHandle:
+    """One in-flight request: the submission half of the old blocking
+    ``send_to_ranks`` (ISSUE 14 submission/completion split).
+
+    :meth:`CommunicationManager.submit` transmits the request and
+    returns this handle immediately; :meth:`wait` drives the retry/
+    redelivery schedule and collects the responses — today's blocking
+    call is literally ``submit(...).wait()`` on the same code path, so
+    the async pipeline and the synchronous magics share every wire,
+    scheduler, retry, and latency-stage behavior.
+
+    Completion is terminal and idempotent: whichever of the IO-thread
+    ``on_done`` hook (async submissions), a :meth:`wait` caller, or a
+    timeout settles first wins; later settlers observe the stored
+    result/error.  ``add_done_callback`` fires on (or after) that
+    first settle — from the IO thread for event-driven completion, so
+    callbacks must be fast and non-blocking.
+    """
+
+    def __init__(self, comm: "CommunicationManager", msg: Message,
+                 msg_type: str, ranks: list[int], pending: _Pending,
+                 ticket, timeout: float | None, deadline: float | None,
+                 tenant: str | None, span):
+        self._comm = comm
+        self.msg = msg
+        self.msg_id = msg.msg_id
+        self.msg_type = msg_type
+        self.ranks = list(ranks)
+        self.tenant = tenant
+        self._pending = pending
+        self._ticket = ticket
+        self._timeout = timeout
+        self._deadline = deadline
+        self._span = span
+        self._done_lock = threading.Lock()
+        self._terminal = False
+        self._result: dict[int, Message] | None = None
+        self._error: Exception | None = None
+        self._callbacks: list = []
+
+    @classmethod
+    def resolved(cls, result: dict) -> "PendingHandle":
+        """An already-complete handle (empty rank set — nothing was
+        ever on the wire, mirroring the old early ``return {}``)."""
+        h = cls.__new__(cls)
+        h._comm = None
+        h.msg = None
+        h.msg_id = None
+        h.msg_type = ""
+        h.ranks = []
+        h.tenant = None
+        h._pending = None
+        h._ticket = None
+        h._timeout = None
+        h._deadline = None
+        h._span = None
+        h._done_lock = threading.Lock()
+        h._terminal = True
+        h._result = dict(result)
+        h._error = None
+        h._callbacks = []
+        return h
+
+    # ------------------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._terminal or self._pending.event.is_set()
+
+    @property
+    def error(self) -> Exception | None:
+        return self._error
+
+    @property
+    def results(self) -> dict[int, Message] | None:
+        """The collected rank→reply map after a successful settle,
+        None before (or on failure)."""
+        return self._result
+
+    def add_done_callback(self, cb) -> None:
+        """``cb(handle)`` after the handle settles (immediately when it
+        already has).  IO-thread dispatch for event-driven completion."""
+        fire = False
+        with self._done_lock:
+            if self._terminal:
+                fire = True
+            else:
+                self._callbacks.append(cb)
+        if fire:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # settle paths (each terminal, first one wins)
+
+    def _event_fired(self) -> None:
+        """IO-thread hook (``_Pending.on_done``): the expectation set
+        completed or a death aborted it — settle from pending state."""
+        self._settle_from_pending()
+
+    def _settle_from_pending(self) -> None:
+        pending = self._pending
+        with self._done_lock:
+            if self._terminal:
+                return
+            if pending.failure is not None:
+                self._error = pending.failure
+            else:
+                with self._comm._lock:
+                    self._result = dict(pending.responses)
+            self._terminal = True
+            cbs, self._callbacks = self._callbacks, []
+        self._comm._finish(self, self._error)
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def _fail(self, exc: Exception) -> None:
+        with self._done_lock:
+            if self._terminal:
+                return
+            self._error = exc
+            self._terminal = True
+            cbs, self._callbacks = self._callbacks, []
+        self._comm._finish(self, exc)
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def _outcome(self) -> dict[int, Message]:
+        if self._error is not None:
+            raise self._error
+        return self._result if self._result is not None else {}
+
+    # ------------------------------------------------------------------
+
+    def wait(self, timeout: float | None = ...) -> dict[int, Message]:
+        """Collect the responses (the completion half of the old
+        ``send_to_ranks``): waits on the expectation set, driving the
+        retry/redelivery schedule exactly as the blocking call did.
+        ``timeout=...`` keeps the budget given at submit (whose clock
+        started THEN — queue time is part of the caller's wait);
+        an explicit value restarts the budget from now.  Idempotent:
+        a settled handle returns (or re-raises) its stored outcome."""
+        if self._terminal:
+            return self._outcome()
+        comm, msg, pending = self._comm, self.msg, self._pending
+        if timeout is ...:
+            timeout, deadline = self._timeout, self._deadline
+        else:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+        policy = comm.retry_for(self.msg_type)
+        attempts = policy.attempts if policy.enabled() else 1
+        complete = False
+        try:
+            for attempt in range(1, attempts + 1):
+                if self._terminal or pending.event.is_set():
+                    complete = True
+                    break
+                if attempt > 1:
+                    self._redeliver_missing(attempt - 1)
+                if attempt == attempts:
+                    step = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                else:
+                    step = policy.attempt_wait_s(attempt - 1)
+                    if deadline is not None:
+                        step = min(step,
+                                   max(0.0,
+                                       deadline - time.monotonic()))
+                complete = pending.event.wait(step)
+                if complete:
+                    break
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    break
+        except BaseException as e:
+            # Mirror the pre-split finally blocks: a KeyboardInterrupt
+            # (or anything unexpected) escaping the blocking wait must
+            # still release the pending-table entry, the trace span,
+            # the mesh slot, and the stage record — without this, a
+            # Ctrl-C during %sync left a phantom ACTIVE request that
+            # wedged every later cell behind the occupied slot.
+            if isinstance(e, Exception):
+                self._fail(e)
+            else:
+                self._fail(RuntimeError(
+                    f"wait aborted by {type(e).__name__}"))
+            raise
+        if not complete and not self._terminal \
+                and not pending.event.is_set():
+            with comm._lock:  # IO thread inserts under the same lock
+                got = set(pending.responses)
+            missing = sorted(pending.expect - got)
+            err = TimeoutError(
+                f"no response from ranks {missing} within {timeout}s "
+                f"for '{self.msg_type}'"
+                + (f" ({attempts} deliveries)" if attempts > 1 else ""))
+            self._fail(err)
+            raise err
+        self._settle_from_pending()
+        return self._outcome()
+
+    def _redeliver_missing(self, attempt: int) -> None:
+        """One redelivery to the still-missing ranks, same msg_id (the
+        worker replay cache makes this idempotent).  Shared by the
+        blocking wait's retry schedule and the async window's
+        :meth:`pump`."""
+        comm, msg, pending = self._comm, self.msg, self._pending
+        with comm._lock:
+            missing_now = sorted(pending.expect
+                                 - set(pending.responses))
+        msg.attempt = attempt
+        try:
+            comm.flight.record("retry", msg_id=msg.msg_id,
+                               attempt=msg.attempt,
+                               ranks=missing_now)
+            comm._listener.send_to_ranks(missing_now, msg)
+            with comm._lock:
+                # Concurrent senders (a %dist_top reader, two cells
+                # in flight) share this counter: the read-modify-
+                # write needs the lock.
+                comm.retries_sent += 1
+                for r in missing_now:
+                    comm.retries_by_rank[r] = \
+                        comm.retries_by_rank.get(r, 0) + 1
+            obs_metrics.registry().counter(
+                "nbd_retries_total",
+                "request redeliveries transmitted").inc()
+        except TransportError:
+            pass  # disconnected rank: death callback aborts us
+
+    def pump(self, now: float | None = None) -> None:
+        """Non-blocking maintenance for an ASYNC in-flight request
+        (ISSUE 14): nobody sits in :meth:`wait` for a windowed cell,
+        so without this a lost request would never be redelivered and
+        a submit-time deadline would never fire until an unbounded
+        drain.  The async executor pumps its in-flight handles from
+        its admission-wait and bounded-drain loops: a DUE redelivery
+        (per the retry policy's backoff schedule, clocked from
+        ``sent_at``) is transmitted, and a blown submit deadline
+        fails the handle so its future rejects."""
+        if self._terminal or self._pending.event.is_set():
+            return
+        now = time.monotonic() if now is None else now
+        if self._deadline is not None and now >= self._deadline:
+            with self._comm._lock:
+                got = set(self._pending.responses)
+            missing = sorted(self._pending.expect - got)
+            self._fail(TimeoutError(
+                f"no response from ranks {missing} within "
+                f"{self._timeout}s for '{self.msg_type}' "
+                f"(async window)"))
+            return
+        policy = self._comm.retry_for(self.msg_type)
+        if not policy.enabled():
+            return
+        # The next attempt is due when the cumulative backoff since
+        # the first transmission has elapsed.
+        done_attempts = self.msg.attempt + 1   # deliveries so far
+        if done_attempts >= policy.attempts:
+            return
+        elapsed = time.time() - self._pending.sent_at
+        due = sum(policy.attempt_wait_s(i)
+                  for i in range(done_attempts))
+        if elapsed >= due:
+            self._redeliver_missing(done_attempts)
 
 
 class CommunicationManager:
@@ -335,6 +617,15 @@ class CommunicationManager:
             failure.msg_id = mid
             p.failure = failure
             p.event.set()
+            cb = p.on_done
+            if cb is not None:
+                # Async submission (ISSUE 14): resolve its future NOW
+                # — a death must abort every in-flight windowed cell,
+                # not only the one a thread happens to be waiting on.
+                try:
+                    cb()
+                except Exception:
+                    pass
 
     def dead_ranks(self) -> set[int]:
         """Snapshot of ranks currently marked dead (death callback or
@@ -404,11 +695,42 @@ class CommunicationManager:
         caller spent vetting/classifying the cell before this call —
         the latency observatory's "vet" stage (the submitter is the
         only layer that knows it).
+
+        This is literally ``submit(...).wait()`` — the async pipeline
+        (ISSUE 14) calls :meth:`submit` directly and waits later.
         """
+        return self.submit(ranks, msg_type, data, bufs=bufs,
+                           timeout=timeout, tenant=tenant,
+                           priority=priority, msg_id=msg_id,
+                           on_verdict=on_verdict, collective=collective,
+                           vet_s=vet_s).wait()
+
+    def submit(self, ranks: list[int], msg_type: str,
+               data: Any = None, *, bufs: dict | None = None,
+               timeout: float | None = ...,
+               tenant: str | None = None, priority: int = 0,
+               msg_id: str | None = None,
+               on_verdict=None,
+               collective: str = "unknown",
+               vet_s: float | None = None,
+               on_done=None) -> PendingHandle:
+        """Non-blocking dispatch (ISSUE 14): admit through the
+        scheduler, transmit the request, and return a
+        :class:`PendingHandle` without waiting for replies — the async
+        executor streams cell N+1 while cell N runs through exactly
+        this path.  Admission failures (``CellRejected``/``CellShed``/
+        a dead target rank / a queued-admission timeout) still raise
+        HERE, synchronously: an unadmitted cell has no handle.
+        ``on_done(handle)`` fires from the IO thread the moment the
+        expectation set completes (or a death aborts it) — the async
+        future-resolution hook; without it, completion bookkeeping
+        runs on whichever thread calls :meth:`PendingHandle.wait`,
+        preserving the pre-split synchronous behavior exactly."""
         if timeout is ...:
             timeout = self.default_timeout
         if not ranks:
-            return {}  # an empty expectation would otherwise never complete
+            # An empty expectation would otherwise never complete.
+            return PendingHandle.resolved({})
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
         if msg_id is not None:
             msg.msg_id = msg_id
@@ -461,22 +783,24 @@ class CommunicationManager:
                 # mesh, after the queued wait otherwise) — closes the
                 # queue stage.
                 self.lat.note_grant(msg.msg_id)
-            return self._dispatch(ranks, msg, msg_type, timeout,
-                                  deadline, tenant)
-        finally:
+            return self._transmit(ranks, msg, msg_type, timeout,
+                                  deadline, tenant, ticket, on_done)
+        except BaseException:
+            # Never-transmitted request: free the mesh slot and the
+            # stage record here — there is no handle to finish them.
+            # (A transmitted request's cleanup runs in _finish when
+            # its handle settles — success OR failure frees the slot;
+            # a dead worker must not wedge the pool.)
             if ticket is not None and ticket.state == ACTIVE:
-                # Success OR failure frees the mesh slot and promotes
-                # queued work — a dead worker must not wedge the pool.
                 self.scheduler.complete(msg.msg_id)
             if msg.latency is not None:
-                # No-op after a completed record; forgets the stage
-                # record of a rejected / shed / timed-out / aborted
-                # cell (only COMPLETED cells feed the histograms).
                 self.lat.drop(msg.msg_id)
+            raise
 
-    def _dispatch(self, ranks: list[int], msg: Message, msg_type: str,
+    def _transmit(self, ranks: list[int], msg: Message, msg_type: str,
                   timeout: float | None, deadline: float | None,
-                  tenant: str | None = None) -> dict[int, Message]:
+                  tenant: str | None, ticket,
+                  on_done) -> PendingHandle:
         tr = self.tracer
         span_attrs = {"ranks": list(ranks)}
         if tenant is not None:
@@ -500,9 +824,11 @@ class CommunicationManager:
         if already_dead:
             with self._lock:
                 del self._pending[msg.msg_id]
+            if span is not None:
+                tr.end(span)
             raise WorkerDied(f"workers {sorted(already_dead)} are dead")
-        policy = self.retry_for(msg_type)
-        attempts = policy.attempts if policy.enabled() else 1
+        handle = PendingHandle(self, msg, msg_type, ranks, pending,
+                               ticket, timeout, deadline, tenant, span)
         try:
             pending.sent_at = time.time()
             self.flight.record("send", msg_id=msg.msg_id,
@@ -510,76 +836,60 @@ class CommunicationManager:
                                **({"tenant": tenant}
                                   if tenant is not None else {}))
             self._listener.send_to_ranks(list(ranks), msg)
-            complete = False
-            for attempt in range(1, attempts + 1):
-                if attempt > 1:
-                    # Redeliver to the stragglers only, same msg_id.
-                    with self._lock:
-                        missing_now = sorted(pending.expect
-                                             - set(pending.responses))
-                    msg.attempt = attempt - 1
-                    try:
-                        self.flight.record("retry", msg_id=msg.msg_id,
-                                           attempt=msg.attempt,
-                                           ranks=missing_now)
-                        self._listener.send_to_ranks(missing_now, msg)
-                        with self._lock:
-                            # Concurrent senders (a %dist_top reader,
-                            # two cells in flight) share this counter:
-                            # the read-modify-write needs the lock.
-                            self.retries_sent += 1
-                            for r in missing_now:
-                                self.retries_by_rank[r] = \
-                                    self.retries_by_rank.get(r, 0) + 1
-                        obs_metrics.registry().counter(
-                            "nbd_retries_total",
-                            "request redeliveries transmitted").inc()
-                    except TransportError:
-                        pass  # disconnected rank: death callback aborts us
-                if attempt == attempts:
-                    step = (None if deadline is None
-                            else max(0.0, deadline - time.monotonic()))
-                else:
-                    step = policy.attempt_wait_s(attempt - 1)
-                    if deadline is not None:
-                        step = min(step,
-                                   max(0.0, deadline - time.monotonic()))
-                complete = pending.event.wait(step)
-                if complete:
-                    break
-                if (deadline is not None
-                        and time.monotonic() >= deadline):
-                    break
-            if not complete:
-                with self._lock:  # IO thread inserts under the same lock
-                    got = set(pending.responses)
-                missing = sorted(pending.expect - got)
-                raise TimeoutError(
-                    f"no response from ranks {missing} within {timeout}s "
-                    f"for '{msg_type}'"
-                    + (f" ({attempts} deliveries)" if attempts > 1 else ""))
-            if pending.failure is not None:
-                raise pending.failure
-            with self._lock:
-                responses = dict(pending.responses)
-            if msg.latency is not None:
-                # Close the stage record: per-rank worker stamps from
-                # the reply headers, corrected by the clock estimator,
-                # delivery stamped NOW (the caller receives the result
-                # when this method returns).  Mirrored as stage/* child
-                # spans of the send span while a trace is active.
-                self.lat.complete(
-                    msg.msg_id, responses, self.clock.offset,
-                    tracer=tr,
-                    parent=(tr.context_for(span)
-                            if span is not None else None))
-            return responses
-        finally:
-            if span is not None:
-                span.attrs["deliveries"] = msg.attempt + 1
-                tr.end(span)
+        except BaseException:
             with self._lock:
                 self._pending.pop(msg.msg_id, None)
+            if span is not None:
+                tr.end(span)
+            raise
+        if on_done is not None:
+            handle.add_done_callback(on_done)
+            # Event-driven settle from the IO thread; attached AFTER
+            # the transmit so a synchronously-failing send never
+            # leaves a dangling hook.  Late attach is race-safe: an
+            # event that fired in the gap settles inline here.
+            pending.on_done = handle._event_fired
+            if pending.event.is_set():
+                handle._event_fired()
+        return handle
+
+    def _finish(self, handle: PendingHandle, error) -> None:
+        """One-time completion bookkeeping for a settled handle —
+        stage-record close, span end, pending-table pop, mesh-slot
+        release.  Runs exactly once per handle (the settle paths are
+        terminal), on whichever thread settled it: the caller thread
+        for synchronous waits (pre-split behavior, byte for byte),
+        the IO thread for event-driven async completion."""
+        msg = handle.msg
+        tr = self.tracer
+        span = handle._span
+        if error is None and msg.latency is not None:
+            # Close the stage record: per-rank worker stamps from the
+            # reply headers, corrected by the clock estimator,
+            # delivery stamped NOW (the caller receives the result
+            # when the wait returns / the future resolves).  Mirrored
+            # as stage/* child spans of the send span while a trace
+            # is active.
+            self.lat.complete(
+                msg.msg_id, handle._result or {}, self.clock.offset,
+                tracer=tr,
+                parent=(tr.context_for(span)
+                        if span is not None else None))
+        if span is not None:
+            span.attrs["deliveries"] = msg.attempt + 1
+            tr.end(span)
+        with self._lock:
+            self._pending.pop(msg.msg_id, None)
+        if handle._ticket is not None \
+                and handle._ticket.state == ACTIVE:
+            # Success OR failure frees the mesh slot and promotes
+            # queued work — a dead worker must not wedge the pool.
+            self.scheduler.complete(msg.msg_id)
+        if msg.latency is not None:
+            # No-op after a completed record; forgets the stage
+            # record of a timed-out / aborted cell (only COMPLETED
+            # cells feed the histograms).
+            self.lat.drop(msg.msg_id)
 
     def post(self, ranks: list[int], msg_type: str, data: Any = None, *,
              bufs: dict | None = None) -> str:
@@ -682,6 +992,15 @@ class CommunicationManager:
                                msg.recv_ts)
             if complete:
                 pending.event.set()
+                cb = pending.on_done
+                if cb is not None:
+                    # Async submission (ISSUE 14): settle the handle
+                    # from the IO thread so a pipelined cell's future
+                    # resolves the moment its last reply lands.
+                    try:
+                        cb()
+                    except Exception:
+                        pass
             return
         if msg.msg_type == "ping":
             data = msg.data or {}

@@ -140,6 +140,26 @@ class LatencyObservatory:
         if p is not None:
             p.t_grant = self._now()
 
+    def note_worker_free(self, msg_id: str,
+                         t: float | None = None) -> None:
+        """Overlap-aware attribution for pipelined cells (ISSUE 14):
+        an async-windowed cell is transmitted while its predecessor
+        still runs, so the serial worker loop only *reaches* it when
+        the predecessor's reply lands.  The executor calls this at
+        each predecessor completion for every still-in-flight
+        successor, advancing the grant stamp to "the worker became
+        free now" — the predecessor wait books as ``queue`` (what it
+        is) instead of inflating ``wire``, and pipelined cells never
+        double-count the overlapped time.  Monotone: the stamp only
+        moves forward, and never past a completion."""
+        if not self.enabled:
+            return
+        t = self._now() if t is None else t
+        with self._lock:
+            p = self._pending.get(msg_id)
+        if p is not None and t > p.t_grant:
+            p.t_grant = t
+
     def drop(self, msg_id: str) -> None:
         """Forget a request that will never complete normally
         (rejected / shed / timed out / worker died).  No-op after

@@ -192,6 +192,8 @@ class SkewDetector:
          "seq": collective sequence number (0 = none yet),
          "op": last collective op entered, "in": still inside it,
          "cops": collectives this cell has made so far,
+         "rep": step index of an in-flight --repeat loop (None
+                otherwise; advancing steps count as progress),
          "hb_age": seconds since the last ping}
 
     ``pending`` is ``CommunicationManager.pending_snapshot()`` —
@@ -228,7 +230,12 @@ class SkewDetector:
         pol = self.policy
         pending = pending or {}
         for r, v in ranks.items():
-            key = (v.get("busy_id"), v.get("seq"), v.get("in"))
+            # The "no progress" key: any change — a new collective, a
+            # collective completed, a different cell, going idle, or a
+            # --repeat loop advancing a step (ISSUE 14) — resets the
+            # stall clock.
+            key = (v.get("busy_id"), v.get("seq"), v.get("in"),
+                   v.get("rep"))
             prev = self._prog.get(r)
             if prev is None or prev[0] != key:
                 self._prog[r] = (key, now)
@@ -511,6 +518,14 @@ class HangWatchdog:
                 v["in"] = col.get("in")
                 v["col_age"] = (col.get("age") or 0) + age
                 v["cops"] = col.get("cops")
+            rep = data.get("rep") or {}
+            if rep:
+                # Step-loop progress (ISSUE 14): a --repeat cell
+                # advancing through steps is healthy forward motion —
+                # the detector folds this into its progress key so a
+                # long collective-free training loop never reads as a
+                # stall while it is actually stepping.
+                v["rep"] = rep.get("i")
             views[r] = v
         return views
 
@@ -776,14 +791,21 @@ def _stack_tail(run_dir: str, rank: int,
 
 def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
                 dump_stacks: bool = True, stack_wait_s: float = 0.8,
-                stack_lines: int = 30, flight_lines: int = 6) -> str:
+                stack_lines: int = 30, flight_lines: int = 6,
+                async_window: dict | None = None) -> str:
     """Assemble the ``%dist_doctor`` report: per-rank collective
     positions and busy ages, the skew table naming lagging rank(s)
     and the divergence point, active watchdog verdicts, freshly
     dumped per-rank stacks (SIGUSR1 → faulthandler), and each flight
     ring's last events.  Read-mostly: the only cluster interaction is
     the optional stack-dump signal — nothing goes through the
-    workers' (possibly wedged) serial request loops."""
+    workers' (possibly wedged) serial request loops.
+
+    ``async_window`` (an ``AsyncExecutor.snapshot()``) names the
+    async-pipelined cells among the in-flight requests (ISSUE 14):
+    with >1 cell in flight, "which request is the mesh actually
+    executing and which are streamed behind it" is exactly what a
+    hang report must answer."""
     now = time.time()
     wd = watchdog
     # Lenient env parse: a typo'd NBD_HANG_ESCALATE is exactly why the
@@ -889,6 +911,9 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
         pend = comm.pending_snapshot()
     except Exception:
         pend = {}
+    async_cells = {c.get("msg_id"): c
+                   for c in (async_window or {}).get("cells", ())
+                   if c.get("msg_id")}
     if pend:
         lines.append("")
         lines.append("in-flight requests:")
@@ -898,14 +923,26 @@ def hang_report(comm, pm=None, watchdog: HangWatchdog | None = None, *,
                    else "?")
             who = (f" · tenant {p['tenant']}" if p.get("tenant")
                    else "")
+            ac = async_cells.get(mid)
+            tag = ""
+            if ac is not None:
+                tag = (f" · ⧗ async cell #{ac['seq']}"
+                       + (" (holds the collective stream)"
+                          if ac.get("collective") != "free" else ""))
             lines.append(f"   {mid[:12]}… {p.get('type') or '?'} "
                          f"age {age} · responded {p['responded']} · "
-                         f"waiting on {missing}{who}")
+                         f"waiting on {missing}{who}{tag}")
             note = _preflight_note(p.get("cell_sha1"))
             if note:
                 lines.append(f"      ↳ pre-flight lint flagged this "
                              f"cell before dispatch: "
                              f"{note['summary']}")
+    if async_window and async_window.get("depth"):
+        lines.append(
+            f"async   : window {async_window['depth']}/"
+            f"{async_window['window']} in flight — the per-rank loop "
+            f"is serial, so streamed cells behind the busy one are "
+            f"QUEUED on the worker, not hung")
     # Verdicts.
     lines.append("")
     if verdicts:

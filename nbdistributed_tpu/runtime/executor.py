@@ -166,3 +166,130 @@ def execute_cell(code: str, namespace: dict, stream_fn: StreamFn | None = None,
     finally:
         cell_span.__exit__(None, None, None)
         sys.stdout = old_stdout
+
+
+def execute_repeat(code: str, namespace: dict,
+                   stream_fn: StreamFn | None = None, *,
+                   repeat: int, until: str | None = None,
+                   rank: int = 0, filename: str = "<cell>",
+                   progress: Callable[[int, int, float | None, float],
+                                      None] | None = None
+                   ) -> dict[str, Any]:
+    """Worker-side step loop (ISSUE 14): **compile once, run the cell
+    body ``repeat`` times** — one dispatch amortizes the per-cell
+    control-plane overhead over k steps, which is the whole point of
+    ``%%distributed --repeat k``.
+
+    Semantics relative to :func:`execute_cell`:
+
+    * the cell is compiled ONCE (body + optional trailing expression,
+      the same 3-path split), then executed k times in ``namespace``;
+    * the trailing expression's value is evaluated every step; when it
+      is a real scalar (loss, metric) it is reported per step through
+      ``progress(step_index, k, last_scalar, steps_per_s)`` — the
+      worker piggybacks that on heartbeats — and only the LAST step's
+      value is echoed in the reply (k result echoes would flood the
+      stream for zero information);
+    * ``until`` (an expression string) is evaluated after each step;
+      truthy stops the loop early (``--until "loss < 0.1"``);
+    * KeyboardInterrupt between (or inside) steps aborts the loop with
+      an error reply that still reports ``steps`` completed — state
+      from finished steps is intact, exactly like interrupting a
+      hand-written worker-side loop;
+    * the caller's replay cache sees ONE request — a redelivery is
+      answered from the cached reply and never re-runs any step.
+    """
+    stream_fn = stream_fn or (lambda text, kind: None)
+    old_stdout = sys.stdout
+    streaming = _StreamingStdout(stream_fn)
+    sys.stdout = streaming
+    t0 = time.perf_counter()
+    steps = 0
+    last_scalar: float | None = None
+    result_value: Any = None
+    has_result = False
+    cell_span = maybe_span("cell", kind="cell",
+                           attrs={"rank": rank, "repeat": repeat,
+                                  "code": code.strip()[:120]})
+    cell_span.__enter__()
+    try:
+        tree = ast.parse(code, filename)
+        expr_code = None
+        if tree.body and isinstance(tree.body[-1], ast.Expr):
+            last = tree.body.pop()
+            expr_ast = ast.Expression(last.value)
+            ast.copy_location(expr_ast, last)
+            expr_code = compile(expr_ast, filename, "eval")
+        body_code = (compile(tree, filename, "exec")
+                     if tree.body else None)
+        until_code = (compile(until, "<until>", "eval")
+                      if until else None)
+        stopped_early = False
+        for _ in range(max(1, int(repeat))):
+            if body_code is not None:
+                exec(body_code, namespace)
+            if expr_code is not None:
+                result_value = eval(expr_code, namespace)
+                has_result = True
+                if isinstance(result_value, (int, float)) \
+                        and not isinstance(result_value, bool):
+                    last_scalar = float(result_value)
+            steps += 1
+            if progress is not None:
+                elapsed = time.perf_counter() - t0
+                try:
+                    progress(steps, max(1, int(repeat)), last_scalar,
+                             steps / elapsed if elapsed > 0 else 0.0)
+                except Exception:
+                    pass  # telemetry must never kill the loop
+            if until_code is not None and eval(until_code, namespace):
+                stopped_early = True
+                break
+        streaming.drain()
+        output = streaming.getvalue()
+        if has_result and result_value is not None:
+            text = repr(result_value)
+            try:
+                stream_fn(text, "result")
+            except Exception:
+                pass
+            if output and not output.endswith("\n"):
+                output += "\n"
+            output += text
+        duration = time.perf_counter() - t0
+        return {
+            "output": output,
+            "status": "success",
+            "rank": rank,
+            "duration_s": duration,
+            "steps": steps,
+            "repeat": int(repeat),
+            "stopped_early": stopped_early,
+            "steps_per_s": round(steps / duration, 3)
+            if duration > 0 else 0.0,
+            "last_scalar": last_scalar,
+        }
+    except KeyboardInterrupt:
+        streaming.drain()
+        return {
+            "error": f"KeyboardInterrupt (step loop interrupted after "
+                     f"{steps}/{repeat} steps)",
+            "traceback": traceback.format_exc(),
+            "rank": rank,
+            "duration_s": time.perf_counter() - t0,
+            "steps": steps,
+            "repeat": int(repeat),
+        }
+    except Exception as e:
+        streaming.drain()
+        return {
+            "error": f"{e} (at step {steps + 1}/{repeat})",
+            "traceback": traceback.format_exc(),
+            "rank": rank,
+            "duration_s": time.perf_counter() - t0,
+            "steps": steps,
+            "repeat": int(repeat),
+        }
+    finally:
+        cell_span.__exit__(None, None, None)
+        sys.stdout = old_stdout

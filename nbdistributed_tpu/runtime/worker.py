@@ -129,6 +129,12 @@ class DistributedWorker:
         # the atomically-rebound snapshot below (never the dict).
         self._serve: dict[str, _WorkerServe] = {}
         self._serve_snap: dict | None = None
+        # Step-loop progress (ISSUE 14): {"i", "k", "last", "sps"}
+        # while a --repeat cell is looping, else None.  Rebound
+        # atomically by the progress callback on the serial loop; the
+        # heartbeat thread piggybacks it (`rep` ping field) so the
+        # coordinator sees per-step progress without a probe.
+        self._rep_snap: dict | None = None
         self._ckpt_async = None          # in-flight background save
         # Resilience state: the reply-replay cache makes request
         # redelivery idempotent (a retried execute NEVER runs twice);
@@ -398,6 +404,14 @@ class DistributedWorker:
                 # live — the %dist_top / pool-status serving columns.
                 data = dict(data or {})
                 data["srv"] = srv
+            rep = self._rep_snap  # atomic rebind; safe to read here
+            if rep is not None:
+                # Step-loop telemetry (ISSUE 14): step index, last
+                # scalar (loss), steps/s of an in-flight --repeat
+                # cell — per-step progress with ONE dispatch, through
+                # the same piggyback plane as tel/col.
+                data = dict(data or {})
+                data["rep"] = rep
             try:
                 self.channel.send(Message(msg_type="ping",
                                           rank=self.rank, data=data))
@@ -494,15 +508,42 @@ class DistributedWorker:
         # subset check stays inactive for them.
         targets = (None if isinstance(msg.data, str)
                    else msg.data.get("target_ranks"))
+        repeat = until = None
+        if isinstance(msg.data, dict):
+            repeat = msg.data.get("repeat")
+            until = msg.data.get("until")
         collective_guard.begin_cell(targets, self.world_size)
         self._flight.record("cell_start", msg_id=msg.msg_id,
                             code=code.strip()[:120],
                             **({"tenant": msg.tenant}
-                               if msg.tenant is not None else {}))
+                               if msg.tenant is not None else {}),
+                            **({"repeat": int(repeat)}
+                               if repeat else {}))
         try:
-            result = executor.execute_cell(
-                code, self._ns_for(msg.tenant), self._stream,
-                rank=self.rank, filename=f"<rank {self.rank}>")
+            if repeat:
+                # Step loop (ISSUE 14): compile once, loop worker-side
+                # — one dispatch, k steps; per-step progress rides the
+                # heartbeat `rep` piggyback, and the replay cache
+                # holds ONE entry for the whole loop (a redelivered
+                # request never re-runs steps).
+                def _note(i, k, last, sps):
+                    self._rep_snap = {"i": i, "k": k,
+                                      "last": last,
+                                      "sps": round(sps, 2)}
+
+                try:
+                    result = executor.execute_repeat(
+                        code, self._ns_for(msg.tenant), self._stream,
+                        repeat=int(repeat), until=until,
+                        rank=self.rank,
+                        filename=f"<rank {self.rank}>",
+                        progress=_note)
+                finally:
+                    self._rep_snap = None
+            else:
+                result = executor.execute_cell(
+                    code, self._ns_for(msg.tenant), self._stream,
+                    rank=self.rank, filename=f"<rank {self.rank}>")
         finally:
             ops = collective_guard.end_cell()
         self._flight.record(

@@ -113,6 +113,16 @@ _ALL = (
     _k("NBD_PARTITION_GRACE_S", "30", "float",
        "Whole-host silence grace before a suspected partition is "
        "declared lost and healing proceeds.", "hang"),
+    # --- async pipelined executor (ISSUE 14) ------------------------------
+    _k("NBD_ASYNC_WINDOW", "0", "int",
+       "Async in-flight dispatch window for %%distributed cells: N>0 "
+       "streams up to N cells to the workers while earlier ones run "
+       "(admission gated by the effects/deps DAG — no RAW/WAR/WAW "
+       "hazard with any in-flight cell, at most one collective-"
+       "bearing cell in flight; opaque cells drain the window and "
+       "serialize).  0 (default) keeps every cell synchronous; "
+       "%%distributed --async arms the window for one cell.",
+       "pipeline"),
     # --- session gateway / multi-tenant pools -----------------------------
     _k("NBD_POOL_SCHED", "fair", "str",
        "Gateway pool scheduling mode: fair (priority, then least-"

@@ -1,0 +1,212 @@
+"""Integration: the async pipelined executor on a real 2-rank CPU
+world (ISSUE 14 acceptance).
+
+Pins the three tentpole behaviors end to end:
+
+* **overlap** — k=4 independent proven-collective-free cells, two per
+  rank, complete in < 0.6× the serial wall-clock (each rank's serial
+  loop runs its own two cells while the other rank runs its two —
+  max, not sum, of the critical paths);
+* **ordering** — a RAW-dependent chain streamed through the window
+  executes in exact program order (the DAG gate serializes it);
+* **--repeat discipline** — a k-step loop is ONE dispatch: per-step
+  progress is observed via heartbeat ``rep`` piggybacks while it
+  runs, and a redelivered request (same msg_id) is answered from the
+  replay cache without re-running a single step.
+"""
+
+import time
+
+import pytest
+
+from nbdistributed_tpu.analysis import infer_effects
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.messaging import CommunicationManager
+from nbdistributed_tpu.messaging.pipeline import AsyncExecutor
+
+pytestmark = [pytest.mark.integration, pytest.mark.pipeline,
+              pytest.mark.slow]
+
+WORLD = 2
+ATTACH_TIMEOUT = 120
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    comm = CommunicationManager(num_workers=WORLD, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(WORLD, comm.port, backend="cpu")
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
+    except Exception:
+        pm.shutdown()
+        comm.shutdown()
+        raise
+    yield comm, pm
+    comm.post(list(range(WORLD)), "shutdown")
+    time.sleep(0.5)
+    pm.shutdown()
+    comm.shutdown()
+
+
+def fp(code):
+    return infer_effects(code).as_dict()
+
+
+SLEEP_S = 0.3
+
+
+def _sleep_cell(i):
+    # `time` is a proven-safe stdlib module and only READ here (the
+    # import happens once, in setup — an in-cell `import time` would
+    # WRITE the name and draw a WAW edge between every pair, which
+    # the gate would rightly serialize): the footprint is
+    # collective-free with disjoint writes, so the window may overlap
+    # these across ranks.
+    return f"time.sleep({SLEEP_S})\npipe_overlap_{i} = {i}"
+
+
+def test_independent_cells_overlap_below_serial(cluster):
+    """k=4 proven-free cells, two aimed at each rank: serial dispatch
+    pays sum-of-sleeps; the async window pays ~max per rank."""
+    comm, _ = cluster
+    comm.send_to_all("execute", "import time", timeout=60)
+    cells = [( _sleep_cell(i), [i % WORLD]) for i in range(4)]
+
+    # Serial baseline: send-and-wait per cell, same cells.
+    t0 = time.perf_counter()
+    for code, ranks in cells:
+        comm.send_to_ranks(ranks, "execute",
+                           {"code": code, "target_ranks": ranks},
+                           timeout=60)
+    serial_s = time.perf_counter() - t0
+    assert serial_s >= 4 * SLEEP_S  # sanity: the sleeps are real
+
+    ex = AsyncExecutor(comm, window=4)
+    t0 = time.perf_counter()
+    futs = [ex.submit_cell(code, ranks, entry=fp(code))
+            for code, ranks in cells]
+    ex.drain()
+    async_s = time.perf_counter() - t0
+
+    assert all(f.state == "done" for f in futs), \
+        [(f.seq, f.state, str(f.error)) for f in futs]
+    assert ex.depth == 0
+    # The acceptance bar: < 0.6x serial wall-clock.  Two ranks x two
+    # sleeps each run concurrently, so the floor is ~2*SLEEP_S
+    # against a ~4*SLEEP_S serial baseline.
+    assert async_s < 0.6 * serial_s, \
+        f"async {async_s:.3f}s vs serial {serial_s:.3f}s"
+
+
+def test_raw_dependent_chain_executes_in_program_order(cluster):
+    """A RAW chain streamed through the window must serialize: each
+    cell appends to a worker-side list, and the final list IS the
+    program order."""
+    comm, _ = cluster
+    ranks = list(range(WORLD))
+    ex = AsyncExecutor(comm, window=4)
+    first = "pipe_order = [0]"
+    futs = [ex.submit_cell(first, ranks, entry=fp(first))]
+    for i in range(1, 4):
+        code = f"pipe_order = pipe_order + [{i}]"
+        futs.append(ex.submit_cell(code, ranks, entry=fp(code)))
+    ex.drain()
+    assert all(f.state == "done" for f in futs), \
+        [(f.seq, f.state, str(f.error)) for f in futs]
+    # The chain held at the gate at least once (RAW on pipe_order).
+    assert ex.snapshot()["held_total"] >= 1
+    out = comm.send_to_all("execute", "pipe_order", timeout=60)
+    assert {r: m.data.get("output") for r, m in out.items()} == {
+        0: "[0, 1, 2, 3]", 1: "[0, 1, 2, 3]"}
+
+
+def test_repeat_is_one_dispatch_with_replay_cache_discipline(cluster):
+    """--repeat k: k steps of worker-side state advance under ONE
+    msg_id; redelivering that msg_id answers from the replay cache
+    and re-runs nothing."""
+    comm, _ = cluster
+    ranks = list(range(WORLD))
+    comm.send_to_all("execute", "pipe_cnt = 0", timeout=60)
+    payload = {"code": "pipe_cnt = pipe_cnt + 1\npipe_cnt",
+               "target_ranks": ranks, "repeat": 9}
+    mid = "pipe-repeat-pinned-1"
+    resp = comm.send_to_ranks(ranks, "execute", payload,
+                              timeout=120, msg_id=mid)
+    for r, m in resp.items():
+        assert m.data.get("steps") == 9, m.data
+        assert m.data.get("output", "").strip() == "9"
+    # Redelivery under the SAME msg_id: the replay cache answers; the
+    # counter must not advance (no step re-runs).
+    resp2 = comm.send_to_ranks(ranks, "execute", payload,
+                               timeout=120, msg_id=mid)
+    for r, m in resp2.items():
+        assert m.data.get("steps") == 9
+    out = comm.send_to_all("execute", "pipe_cnt", timeout=60)
+    assert all(m.data.get("output") == "9" for m in out.values())
+
+
+def test_repeat_reports_per_step_telemetry_via_heartbeats(cluster):
+    """While a --repeat loop runs, heartbeat pings carry the `rep`
+    piggyback (step index, total, steps/s) — per-step progress with
+    one dispatch and no probe through the busy serial loop."""
+    comm, _ = cluster
+    ranks = list(range(WORLD))
+    steps = 60
+    payload = {"code": "import time\ntime.sleep(0.12)",
+               "target_ranks": ranks, "repeat": steps}
+    handle = comm.submit(ranks, "execute", payload, timeout=120)
+    seen = {}
+    deadline = time.time() + 30
+    try:
+        while time.time() < deadline and len(seen) < WORLD:
+            for r in range(WORLD):
+                ping = comm.last_ping(r)
+                if ping is None:
+                    continue
+                rep = (ping[1] or {}).get("rep")
+                if rep:
+                    seen[r] = dict(rep)
+            if handle.done():
+                break
+            time.sleep(0.1)
+    finally:
+        resp = handle.wait(120)
+    assert seen, "no heartbeat carried the rep piggyback"
+    for r, rep in seen.items():
+        assert 1 <= rep["i"] <= steps
+        assert rep["k"] == steps
+        assert rep["sps"] >= 0
+    for r, m in resp.items():
+        assert m.data.get("steps") == steps
+    # The loop finished: the piggyback clears from later pings.
+    time.sleep(3)
+    for r in range(WORLD):
+        ping = comm.last_ping(r)
+        assert not (ping[1] or {}).get("rep")
+
+
+def test_until_stops_early_worker_side(cluster):
+    comm, _ = cluster
+    ranks = list(range(WORLD))
+    payload = {"code": "pipe_u = pipe_u + 1 if 'pipe_u' in globals() "
+                       "else 1",
+               "target_ranks": ranks, "repeat": 100,
+               "until": "pipe_u >= 5"}
+    resp = comm.send_to_all("execute", payload, timeout=120)
+    for m in resp.values():
+        assert m.data.get("steps") == 5
+        assert m.data.get("stopped_early") is True
+
+
+def test_error_future_surfaces_after_drain(cluster):
+    comm, _ = cluster
+    ranks = list(range(WORLD))
+    ex = AsyncExecutor(comm, window=2)
+    code = "raise ValueError('pipelined boom')"
+    fut = ex.submit_cell(code, ranks, entry=fp(code))
+    ex.drain()
+    assert fut.state == "error"
+    with pytest.raises(RuntimeError, match="pipelined boom"):
+        fut.result()

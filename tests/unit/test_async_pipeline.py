@@ -1,0 +1,552 @@
+"""Unit tests for the async pipelined executor (ISSUE 14).
+
+The window state machine runs against a fake comm with hand-fired
+handles and an injectable clock — zero sleeps, every admission
+decision and future transition driven explicitly.  The worker-side
+step loop (``execute_repeat``) and the overlap-aware latency
+attribution (``note_worker_free``) are covered pure as well.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.analysis import infer_effects
+from nbdistributed_tpu.magics.proxies import CellFuture
+from nbdistributed_tpu.messaging.pipeline import (AsyncExecutor,
+                                                  classify_entry)
+from nbdistributed_tpu.observability.latency import LatencyObservatory
+from nbdistributed_tpu.runtime import executor as rt_executor
+
+pytestmark = [pytest.mark.unit, pytest.mark.pipeline]
+
+
+# ----------------------------------------------------------------------
+# fakes
+
+
+class FakeMsg:
+    def __init__(self, data):
+        self.data = data
+
+
+class FakeHandle:
+    _n = 0
+
+    def __init__(self):
+        FakeHandle._n += 1
+        self.msg_id = f"fake-{FakeHandle._n}"
+        self.error = None
+        self._result = None
+        self._cbs = []
+        self._ev = threading.Event()
+
+    @property
+    def results(self):
+        return self._result
+
+    def add_done_callback(self, cb):
+        if self._ev.is_set():
+            cb(self)
+        else:
+            self._cbs.append(cb)
+
+    def fire(self, results=None, error=None):
+        self.error = error
+        self._result = {r: FakeMsg(d) for r, d in
+                        (results or {}).items()}
+        self._ev.set()
+        for cb in list(self._cbs):
+            cb(self)
+
+    def wait(self, timeout=...):
+        self._ev.wait(None if timeout in (..., None) else timeout)
+        if self.error:
+            raise self.error
+        return self._result
+
+
+class FakeLat:
+    def __init__(self):
+        self.freed = []
+
+    def note_worker_free(self, msg_id, t=None):
+        self.freed.append(msg_id)
+
+
+class FakeComm:
+    def __init__(self):
+        self.handles = []
+        self.payloads = []
+        self.lat = FakeLat()
+
+    def submit(self, ranks, msg_type, payload, on_done=None, **kw):
+        h = FakeHandle()
+        self.handles.append(h)
+        self.payloads.append(payload)
+        if on_done is not None:
+            h.add_done_callback(on_done)
+        return h
+
+
+def fp(code):
+    """Footprint entry of one cell, as the preflight store records it."""
+    return infer_effects(code).as_dict()
+
+
+OK = {0: {"output": "1", "status": "success"}}
+
+
+# ----------------------------------------------------------------------
+# admission gating
+
+
+def test_independent_free_cells_fill_the_window():
+    ex = AsyncExecutor(FakeComm(), window=3)
+    for i in range(3):
+        ex.submit_cell(f"a{i} = {i}", [0], entry=fp(f"a{i} = {i}"))
+    assert ex.depth == 3
+    assert ex.try_admit(fp("zz = 9")) is not None  # window full
+    assert "window full" in ex.try_admit(fp("zz = 9"))
+
+
+def test_raw_hazard_blocks_admission():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    reason = ex.try_admit(fp("b = a + 1"))          # RAW on a
+    assert reason is not None and "hazard" in reason and "a" in reason
+
+
+def test_war_and_waw_hazards_block_admission():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    ex.submit_cell("x = q + 1", [0], entry=fp("x = q + 1"))  # reads q
+    assert "hazard" in ex.try_admit(fp("q = 7"))             # WAR on q
+    assert "hazard" in ex.try_admit(fp("x = 0"))             # WAW on x
+
+
+def test_independent_names_admit_alongside():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    assert ex.try_admit(fp("b = 2")) is None
+
+
+def test_one_collective_stream_invariant():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    bearing = fp("r = all_reduce(x)")
+    assert classify_entry(bearing) == "bearing"
+    ex.submit_cell("r = all_reduce(x)", [0], entry=bearing)
+    # A second bearing cell (no name hazard: different names) is held
+    # by the collective gate, not the DAG.
+    other = fp("s = all_reduce(y)")
+    reason = ex.try_admit(other)
+    assert reason is not None and "one-collective-stream" in reason
+    # A proven-free cell overlaps the bearing one.
+    assert ex.try_admit(fp("b = 2")) is None
+
+
+def test_opaque_drains_the_window():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    opaque = fp("exec('x = 1')")
+    assert opaque["opaque"]
+    reason = ex.try_admit(opaque)
+    assert reason is not None
+    # And nothing joins a window holding an opaque cell.
+    comm = FakeComm()
+    ex2 = AsyncExecutor(comm, window=4)
+    ex2.submit_cell("exec('x = 1')", [0], entry=opaque)
+    assert "hazard" in ex2.try_admit(fp("b = 2")) \
+        or "opaque" in ex2.try_admit(fp("b = 2"))
+
+
+def test_missing_entry_treated_opaque():
+    ex = AsyncExecutor(FakeComm(), window=4)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    assert ex.try_admit(None) is not None
+
+
+def test_held_submission_admits_after_completion():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=4)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    got = []
+
+    def sub():
+        got.append(ex.submit_cell("b = a + 1", [0],
+                                  entry=fp("b = a + 1")))
+
+    t = threading.Thread(target=sub, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert len(comm.handles) == 1          # still held at the gate
+    comm.handles[0].fire(OK)               # predecessor completes
+    t.join(3)
+    assert not t.is_alive()
+    assert len(comm.handles) == 2          # dependent streamed after
+    comm.handles[1].fire(OK)
+    assert got[0].state == "done"
+    assert ex.depth == 0
+    assert ex.snapshot()["held_total"] == 1
+
+
+# ----------------------------------------------------------------------
+# futures: resolution, errors, consumption contract
+
+
+def test_future_resolves_with_results():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=2)
+    fut = ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    assert fut.state == "pending"
+    assert "in flight" in repr(fut)
+    comm.handles[0].fire(OK)
+    assert fut.state == "done"
+    assert fut.result()[0]["output"] == "1"
+
+
+def test_error_future_propagation_and_warn_once():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=2)
+    fut = ex.submit_cell("boom", [0], entry=fp("boom"))
+    comm.handles[0].fire({0: {"error": "NameError: boom"}})
+    assert fut.state == "error"
+    assert not fut.consumed
+    # The next-cell warn pass surfaces it exactly once.
+    warned = ex.unconsumed_errors()
+    assert warned == [fut]
+    assert ex.unconsumed_errors() == []
+    # The error itself stays touchable.
+    with pytest.raises(RuntimeError, match="NameError"):
+        fut.result()
+    assert fut.consumed
+
+
+def test_consumed_error_not_warned():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=2)
+    fut = ex.submit_cell("boom", [0], entry=fp("boom"))
+    comm.handles[0].fire({0: {"error": "NameError: boom"}})
+    with pytest.raises(RuntimeError):
+        fut.result()
+    assert ex.unconsumed_errors() == []
+
+
+def test_double_resolve_is_idempotent():
+    fut = CellFuture("x = 1", 1, [0])
+    assert fut.resolve({0: {"output": "1"}}) is True
+    assert fut.resolve({0: {"output": "2"}}) is False
+    assert fut.result()[0]["output"] == "1"
+    assert fut.reject(RuntimeError("late")) is False
+    assert fut.state == "done"
+    # And the mirrored order: reject first, resolve can't flip it.
+    f2 = CellFuture("y = 1", 2, [0])
+    assert f2.reject(RuntimeError("dead")) is True
+    assert f2.resolve({0: {"output": "1"}}) is False
+    assert f2.state == "error"
+
+
+def test_transport_failure_rejects_future():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=2)
+    fut = ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    comm.handles[0].fire(error=RuntimeError("worker 0 died"))
+    assert fut.state == "error"
+    with pytest.raises(RuntimeError, match="died"):
+        fut.result()
+
+
+def test_interrupt_with_three_in_flight():
+    """All three windowed cells abort (interrupt error replies) —
+    every future resolves errored, the window empties, and the next
+    cell warns about the unconsumed errors."""
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=3)
+    futs = [ex.submit_cell(f"a{i} = {i}", [0],
+                           entry=fp(f"a{i} = {i}")) for i in range(3)]
+    assert ex.depth == 3
+    for h in comm.handles:
+        h.fire({0: {"error": "KeyboardInterrupt (cell interrupted by "
+                             "%dist_interrupt)"}})
+    assert ex.depth == 0
+    assert all(f.state == "error" for f in futs)
+    assert len(ex.unconsumed_errors()) == 3
+
+
+def test_snapshot_names_collective_holder():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=4)
+    ex.submit_cell("b = 2", [0], entry=fp("b = 2"))
+    fut = ex.submit_cell("r = all_reduce(x)", [0],
+                         entry=fp("r = all_reduce(x)"))
+    snap = ex.snapshot()
+    assert snap["depth"] == 2
+    assert snap["collective_holder"] == fut.seq
+    states = {c["seq"]: c["collective"] for c in snap["cells"]}
+    assert states[fut.seq] == "bearing"
+
+
+def test_drain_returns_settled_futures():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=3)
+    f1 = ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    f2 = ex.submit_cell("b = 2", [0], entry=fp("b = 2"))
+    t = threading.Timer(
+        0.05, lambda: [h.fire(OK) for h in list(comm.handles)])
+    t.start()
+    futs = ex.drain()                          # replies land mid-drain
+    assert set(futs) == {f1, f2}
+    assert f1.state == "done" and f2.state == "done"
+    assert ex.depth == 0
+
+
+def test_bounded_drain_leaves_pending_cells_in_flight():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=2)
+    fut = ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    futs = ex.drain(timeout=0.05)
+    assert futs == [fut]
+    assert fut.state == "pending"
+    assert ex.depth == 1                       # NOT aborted
+    comm.handles[0].fire(OK)
+    assert fut.state == "done"
+
+
+# ----------------------------------------------------------------------
+# overlap-aware latency attribution
+
+
+def test_completion_restamps_successors_grant():
+    comm = FakeComm()
+    ex = AsyncExecutor(comm, window=3)
+    ex.submit_cell("a = 1", [0], entry=fp("a = 1"))
+    f2 = ex.submit_cell("b = 2", [0], entry=fp("b = 2"))
+    comm.handles[0].fire(OK)
+    # The predecessor's completion moved the successor's grant stamp.
+    assert comm.lat.freed == [f2.msg_id]
+
+
+def test_note_worker_free_moves_queue_not_wire():
+    clock = [1000.0]
+    lat = LatencyObservatory(enabled=True, ring=16,
+                             now=lambda: clock[0])
+    lat.begin("m1", "execute", None)
+    lat.note_grant("m1")
+    # The worker only dequeues at t=1002 (predecessor ran 2s); the
+    # executor stamps worker-free at that moment.
+    clock[0] = 1002.0
+    lat.note_worker_free("m1")
+
+    class R:
+        latency = {"dq": 1002.01, "xs": 1002.02, "xe": 1002.5,
+                   "cs": 0.0, "rs": 1002.51}
+        recv_ts = 1002.52
+
+    clock[0] = 1002.53
+    rec = lat.complete("m1", {0: R()}, lambda r: 0.0)
+    st = rec["stages"]
+    assert st["queue"] == pytest.approx(2.0, abs=0.01)
+    assert st["wire"] < 0.1                    # no double count
+    assert sum(st.values()) == pytest.approx(rec["e2e"], rel=0.1)
+
+
+def test_note_worker_free_never_moves_backwards():
+    clock = [1000.0]
+    lat = LatencyObservatory(enabled=True, ring=16,
+                             now=lambda: clock[0])
+    lat.begin("m1", "execute", None)
+    clock[0] = 1005.0
+    lat.note_grant("m1")
+    clock[0] = 1001.0                          # stale stamp
+    lat.note_worker_free("m1")
+    with lat._lock:
+        assert lat._pending["m1"].t_grant == 1005.0
+
+
+# ----------------------------------------------------------------------
+# worker-side step loops (execute_repeat)
+
+
+def test_repeat_runs_k_steps_with_persistent_state():
+    ns = {}
+    out = rt_executor.execute_repeat(
+        "cnt = cnt + 1 if 'cnt' in globals() else 1\ncnt",
+        ns, repeat=5)
+    assert out["status"] == "success"
+    assert out["steps"] == 5
+    assert ns["cnt"] == 5
+    assert out["last_scalar"] == 5.0
+    assert not out["stopped_early"]
+    # The trailing expression echoes ONCE (the last step's value).
+    assert out["output"].strip() == "5"
+
+
+def test_repeat_until_stops_early():
+    ns = {}
+    out = rt_executor.execute_repeat(
+        "n = n + 1 if 'n' in globals() else 1",
+        ns, repeat=100, until="n >= 7")
+    assert out["steps"] == 7
+    assert out["stopped_early"]
+    assert ns["n"] == 7
+
+
+def test_repeat_progress_callback_per_step():
+    seen = []
+    rt_executor.execute_repeat(
+        "z = 1\n0.25", {},
+        repeat=3,
+        progress=lambda i, k, last, sps: seen.append((i, k, last)))
+    assert seen == [(1, 3, 0.25), (2, 3, 0.25), (3, 3, 0.25)]
+
+
+def test_repeat_error_reports_step_index():
+    ns = {}
+    out = rt_executor.execute_repeat(
+        "m = m + 1 if 'm' in globals() else 1\n"
+        "if m == 3:\n    raise ValueError('boom')",
+        ns, repeat=10)
+    assert "boom" in out["error"]
+    assert "step 3/10" in out["error"]
+    assert out["steps"] == 2                   # completed steps only
+    assert ns["m"] == 3
+
+
+def test_repeat_compiles_once():
+    """The loop body is compiled once — a step count in the thousands
+    stays cheap (the compile-once contract, not a perf benchmark)."""
+    calls = []
+    real_compile = rt_executor.compile if hasattr(
+        rt_executor, "compile") else compile
+    ns = {"hits": calls}
+    out = rt_executor.execute_repeat(
+        "hits.append(1)", ns, repeat=50)
+    assert out["steps"] == 50 and len(calls) == 50
+    # Non-scalar / no trailing expr: no scalar reported.
+    assert out["last_scalar"] is None
+    assert real_compile  # silences the unused guard
+
+
+def test_repeat_scalar_ignores_bools():
+    out = rt_executor.execute_repeat("True", {}, repeat=2)
+    assert out["last_scalar"] is None
+
+
+# ----------------------------------------------------------------------
+# PendingHandle.pump: the async window's retry/deadline driver
+
+
+class _StubListener:
+    def __init__(self):
+        self.sent = []
+
+    def send_to_ranks(self, ranks, msg):
+        self.sent.append((list(ranks), msg.attempt))
+
+
+class _StubFlight:
+    def record(self, *a, **k):
+        pass
+
+
+class _StubComm:
+    def __init__(self, policy):
+        self._lock = threading.Lock()
+        self._pending = {}
+        self.retries_sent = 0
+        self.retries_by_rank = {}
+        self.flight = _StubFlight()
+        self._listener = _StubListener()
+        self._policy = policy
+        self.tracer = None
+        self.scheduler = None
+
+    def retry_for(self, msg_type):
+        return self._policy
+
+    def _finish(self, handle, error):
+        pass  # bookkeeping stubbed: pump/deadline behavior is the SUT
+
+
+def _handle(policy, timeout=None, sent_ago=0.0):
+    from nbdistributed_tpu.messaging.codec import Message
+    from nbdistributed_tpu.messaging.coordinator import (PendingHandle,
+                                                         _Pending)
+    comm = _StubComm(policy)
+    msg = Message(msg_type="execute", data={"code": "x"})
+    pending = _Pending({0}, "execute")
+    pending.sent_at = time.time() - sent_ago
+    deadline = (None if timeout is None
+                else time.monotonic() + timeout)
+    h = PendingHandle(comm, msg, "execute", [0], pending, None,
+                      timeout, deadline, None, None)
+    return comm, h
+
+
+def test_pump_redelivers_when_due():
+    from nbdistributed_tpu.resilience.retry import RetryPolicy
+    pol = RetryPolicy(attempt_timeout_s=0.05, attempts=3, backoff_base_s=0.05,
+                      jitter=0.0)
+    comm, h = _handle(pol, sent_ago=10.0)       # long overdue
+    h.pump()
+    assert comm._listener.sent == [([0], 1)]    # one redelivery
+    assert comm.retries_sent == 1
+    # Attempts are bounded by the policy.
+    h.pump()
+    h.pump()
+    assert len(comm._listener.sent) == 2        # attempts=3 → 2 resends
+    h.pump()
+    assert len(comm._listener.sent) == 2
+
+
+def test_pump_not_due_yet_sends_nothing():
+    from nbdistributed_tpu.resilience.retry import RetryPolicy
+    pol = RetryPolicy(attempt_timeout_s=60.0, attempts=3, backoff_base_s=60.0,
+                      jitter=0.0)
+    comm, h = _handle(pol, sent_ago=0.0)
+    h.pump()
+    assert comm._listener.sent == []
+
+
+def test_pump_fails_handle_on_blown_deadline():
+    from nbdistributed_tpu.resilience.retry import RetryPolicy
+    pol = RetryPolicy()                          # retries disabled
+    comm, h = _handle(pol, timeout=-0.001)       # already expired
+    rejected = []
+    h.add_done_callback(lambda hh: rejected.append(hh.error))
+    h.pump()
+    assert h.done()
+    assert isinstance(h.error, TimeoutError)
+    assert rejected and isinstance(rejected[0], TimeoutError)
+
+
+def test_pump_noop_after_terminal():
+    from nbdistributed_tpu.resilience.retry import RetryPolicy
+    pol = RetryPolicy(attempt_timeout_s=0.05, attempts=3, backoff_base_s=0.05,
+                      jitter=0.0)
+    comm, h = _handle(pol, sent_ago=10.0)
+    h._fail(RuntimeError("dead"))
+    h.pump()
+    assert comm._listener.sent == []
+
+
+def test_until_outer_quote_pair_strip():
+    """The magic strips exactly ONE matching outer quote pair from
+    --until (IPython keeps quotes); an expression that merely ENDS in
+    a quote keeps its inner quoting intact."""
+    def strip(u):
+        u = u.strip()
+        if len(u) >= 2 and u[0] == u[-1] and u[0] in "'\"":
+            u = u[1:-1]
+        return u
+    assert strip("'loss < 0.1'") == "loss < 0.1"
+    assert strip('"loss < 0.1"') == "loss < 0.1"
+    assert strip("\"phase == 'done'\"") == "phase == 'done'"
+    assert strip("loss < 0.1") == "loss < 0.1"
+
+
+def test_classify_entry_mirrors_effects():
+    assert classify_entry(fp("a = 1")) == "free"
+    assert classify_entry(fp("all_reduce(x)")) == "bearing"
+    assert classify_entry(fp("exec('x')")) == "unknown"
+    assert classify_entry(None) == "unknown"
