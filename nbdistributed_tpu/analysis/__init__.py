@@ -39,6 +39,17 @@ Two halves:
   callback-reentrancy-under-lock (``_LINT_CALLBACK_OK``) — the three
   bug shapes PR 8 burned review rounds finding by hand, mechanized.
 
+- **Lifecycle self-analysis** (:mod:`lifecycle`, ISSUE 15): the
+  acquire/release twin of :mod:`concur` — resource-leak (a declared
+  acquire vocabulary must reach its release on all paths, with
+  ownership transfer modeled), bracket-discipline (paired
+  mutate/unmutate operations like the gateway serve counter and the
+  mailbox claim/park pair must be exception-safe), and
+  shutdown-completeness (a per-class resource ledger, exportable via
+  ``nbd-lint --shutdown-ledger``; non-daemon threads joined, Popens
+  waited, lock-taking daemon threads joined on close).  Per-site
+  ``_LINT_LIFECYCLE_OK`` exemption tables; self-lint passes 8–10.
+
 Everything here is stdlib-only (ast + re) and safe to import from
 any layer.
 """

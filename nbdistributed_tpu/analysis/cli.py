@@ -20,10 +20,18 @@ Modes:
   ``%dist_lint deps --dot`` analog for scripts.
 - ``nbd-lint --knob-table``: print the README "Configuration
   reference" markdown table from the knob registry.
+- ``nbd-lint --shutdown-ledger [--root ROOT]``: emit the lifecycle
+  pass's per-class resource ledger (every resource each registered
+  class acquires, and how its shutdown surface releases it) as JSON
+  — the reviewable artifact CI uploads next to the lock graph.
 
 ``--format json`` switches ``--self`` and file-vetting output to a
 single machine-readable JSON document (findings as objects, the exit
-code embedded) for CI annotations and editors.
+code embedded) for CI annotations and editors.  ``--format sarif``
+emits one SARIF 2.1.0 document instead (rule ids = self-lint pass
+names / cell-vetting rule names, locations = repo-relative file +
+line) so findings land in GitHub code scanning; the exit-code
+contract below is unchanged in both formats.
 
 Exit codes (pinned by tests/unit/test_analysis.py):
 
@@ -66,6 +74,70 @@ def _read_source(path: str) -> tuple[str, str] | None:
         return None
 
 
+# One-line rule descriptions for the SARIF rule catalog (self-lint
+# pass names; the file mode derives its catalog from the findings).
+_SELF_PASS_HELP = {
+    "env-knobs": "every NBD_* knob is declared and documented",
+    "codec-headers": "wire-extension registry matches the codec",
+    "thread-shared-state": "shared mutations hold the owning lock",
+    "protocol-coverage": "every sent message type has a handler and "
+                         "every handler a sender",
+    "lock-order": "the acquires-while-holding graph is acyclic",
+    "blocking-under-lock": "no blocking IO while a lock is held",
+    "callback-under-lock": "no stored callback invoked under a lock",
+    "resource-leak": "acquired resources reach their release on all "
+                     "paths including exception edges",
+    "bracket-discipline": "paired mutate/unmutate brackets are "
+                          "exception-safe",
+    "shutdown-completeness": "every class-owned resource is released "
+                             "by its shutdown surface",
+}
+
+
+def _sarif_document(results: list[dict]) -> dict:
+    """One SARIF 2.1.0 run over ``[{rule, level, message, file,
+    line}]`` result dicts.  Rule ids are the self-lint pass names or
+    the cell-vetting rule names; locations are repo-relative."""
+    seen_rules: dict[str, dict] = {}
+    for name, text in _SELF_PASS_HELP.items():
+        seen_rules[name] = {"id": name,
+                            "shortDescription": {"text": text}}
+    out_results = []
+    for r in results:
+        rid = r["rule"]
+        seen_rules.setdefault(rid, {"id": rid, "shortDescription": {
+            "text": f"cell-vetting rule {rid}"}})
+        out_results.append({
+            "ruleId": rid,
+            "level": r["level"],
+            "message": {"text": r["message"]},
+            "locations": [{"physicalLocation": {
+                # Repo-relative URI, no uriBaseId: GitHub resolves
+                # relative URIs against the checkout root, and a
+                # uriBaseId would need an originalUriBaseIds entry to
+                # satisfy strict SARIF validators.
+                "artifactLocation": {
+                    "uri": r["file"].replace(os.sep, "/")},
+                "region": {"startLine": max(1, int(r["line"]))},
+            }}],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nbd-lint",
+                "informationUri":
+                    "https://github.com/Erland366/nbdistributed",
+                "rules": sorted(seen_rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": out_results,
+        }],
+    }
+
+
 def _repo_root(explicit: str | None) -> str | None:
     if explicit:
         return explicit
@@ -105,11 +177,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="world size context for cell vetting")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on warning-severity findings")
-    ap.add_argument("--format", choices=["text", "json"],
+    ap.add_argument("--format", choices=["text", "json", "sarif"],
                     default="text",
                     help="output format for --self / file vetting "
                          "(json: one document, findings as objects, "
-                         "exit code embedded)")
+                         "exit code embedded; sarif: one SARIF "
+                         "2.1.0 document for GitHub code scanning)")
+    ap.add_argument("--shutdown-ledger", action="store_true",
+                    help="emit the lifecycle pass's per-class "
+                         "resource ledger as JSON (the CI artifact)")
     ap.add_argument("--lock-graph", action="store_true",
                     help="emit the framework lock-order graph "
                          "(acquires-while-holding) as Graphviz dot")
@@ -138,6 +214,17 @@ def main(argv: list[str] | None = None) -> int:
         print(lock_graph_dot(root))
         return 0
 
+    if args.shutdown_ledger:
+        from .lifecycle import shutdown_ledger
+        root = _repo_root(args.root)
+        if root is None:
+            print("nbd-lint --shutdown-ledger needs a repo checkout "
+                  "(README.md next to nbdistributed_tpu/); run it "
+                  "from one or pass --root", file=sys.stderr)
+            return 2
+        print(_json.dumps(shutdown_ledger(root), indent=1))
+        return 0
+
     if args.deps_dot:
         if not args.files:
             print("nbd-lint --deps-dot needs at least one FILE "
@@ -164,6 +251,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     doc: dict = {}
+    sarif_rows: list[dict] = []
     rc = 0
     if args.self_lint:
         from .selfcheck import run_self_lint
@@ -175,7 +263,14 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         results = run_self_lint(root)
         total = sum(len(v) for v in results.values())
-        if args.format == "json":
+        if args.format == "sarif":
+            for name, findings in results.items():
+                for f in findings:
+                    sarif_rows.append({
+                        "rule": name, "level": "error",
+                        "message": f.message,
+                        "file": f.file, "line": f.line})
+        elif args.format == "json":
             doc["mode"] = "self"
             doc["root"] = root
             doc["passes"] = {
@@ -222,7 +317,28 @@ def main(argv: list[str] | None = None) -> int:
             # called clean.
             bad = ((res.errors or (args.strict and res.warnings))
                    if res.parsed else args.strict)
-            if args.format == "json":
+            if args.format == "sarif":
+                if not res.parsed:
+                    # The JSON format's "parsed": false, as a result:
+                    # an uninspectable cell is at least visible in
+                    # code scanning (and a failure under --strict).
+                    sarif_rows.append({
+                        "rule": "not-analyzable",
+                        "level": "warning" if args.strict else "note",
+                        "message": "not analyzable (syntax error "
+                                   "after IPython stripping) — "
+                                   "would dispatch unvetted",
+                        "file": label, "line": 1})
+                else:
+                    for f in res.findings:
+                        sarif_rows.append({
+                            "rule": f.rule,
+                            "level": ("error"
+                                      if f.severity == "error"
+                                      else "warning"),
+                            "message": f.message,
+                            "file": label, "line": f.line})
+            elif args.format == "json":
                 files_doc[label] = {
                     "parsed": res.parsed,
                     "findings": [{"line": f.line,
@@ -253,7 +369,9 @@ def main(argv: list[str] | None = None) -> int:
     if not args.self_lint and not args.files:
         ap.print_help()
         return 2
-    if args.format == "json":
+    if args.format == "sarif":
+        print(_json.dumps(_sarif_document(sarif_rows), indent=1))
+    elif args.format == "json":
         doc["exit_code"] = rc
         print(_json.dumps(doc, indent=1))
     return rc

@@ -841,9 +841,12 @@ class ConcurAnalysis:
 # entry points
 
 
-def run_concur_lint(root: str) -> dict[str, list[SelfFinding]]:
-    """The three concurrency passes; ``{pass_name: findings}``."""
-    an = ConcurAnalysis(root)
+def run_concur_lint(root: str, an: ConcurAnalysis | None = None
+                    ) -> dict[str, list[SelfFinding]]:
+    """The three concurrency passes; ``{pass_name: findings}``.
+    ``an`` lets ``run_self_lint`` share one collection pass with the
+    lifecycle passes instead of re-walking the tree."""
+    an = an if an is not None else ConcurAnalysis(root)
     return {
         "lock-order": an.check_lock_order(),
         "blocking-under-lock": an.check_blocking_under_lock(),

@@ -756,13 +756,23 @@ def check_protocol_coverage(root: str, planes=None,
 
 
 def run_self_lint(root: str) -> dict[str, list[SelfFinding]]:
-    """All passes; ``{pass_name: findings}`` (empty lists = clean)."""
-    from .concur import run_concur_lint
+    """All ten passes; ``{pass_name: findings}`` (empty = clean):
+    the four registry/discipline passes here, the three
+    :mod:`concur` concurrency passes (5–7), and the three
+    :mod:`lifecycle` passes (8–10: resource-leak,
+    bracket-discipline, shutdown-completeness).  None are
+    skippable — CI gates on every key."""
+    from .concur import ConcurAnalysis, run_concur_lint
+    from .lifecycle import run_lifecycle_lint
     results = {
         "env-knobs": check_env_knobs(root),
         "codec-headers": check_codec_headers(root),
         "thread-shared-state": check_thread_shared_state(root),
         "protocol-coverage": check_protocol_coverage(root),
     }
-    results.update(run_concur_lint(root))
+    # One interprocedural collection pass, shared by the lock passes
+    # and the lifecycle shutdown pass.
+    an = ConcurAnalysis(root)
+    results.update(run_concur_lint(root, an=an))
+    results.update(run_lifecycle_lint(root, concur=an))
     return results

@@ -445,6 +445,13 @@ class TenantClient:
             self._ch.close()
         except Exception:
             pass
+        # Closing the channel unblocks the reader loop; reap it so a
+        # closed client never leaves a thread that takes self._lock
+        # running into interpreter teardown (daemon threads die
+        # mid-critical-section there).  close() may be invoked from a
+        # reader-thread callback — a thread cannot join itself.
+        if threading.current_thread() is not self._reader:
+            self._reader.join(timeout=2.0)
 
 
 # ----------------------------------------------------------------------

@@ -546,10 +546,24 @@ class GatewayDaemon:
         if action == "drain":
             with self._lock:
                 claimed = tenant.mailbox.claim_all()
-            ok = self._send_to_client(client_id, msg.reply(
-                data={"status": "ok",
-                      "results": {mid: getattr(r, "data", None)
-                                  for mid, r in claimed.items()}}))
+            try:
+                ok = self._send_to_client(client_id, msg.reply(
+                    data={"status": "ok",
+                          "results": {mid: getattr(r, "data", None)
+                                      for mid, r in claimed.items()}}))
+            except BaseException:
+                # The claim is destructive: a throwing serve thread
+                # (reply construction, encode) must repark before
+                # unwinding or the results are lost on BOTH sides —
+                # the exactly-once contract survives only the
+                # explicit ok/not-ok path below without this.
+                with self._lock:
+                    for mid, r in claimed.items():
+                        tenant.mailbox.park(mid, r)
+                self.flight.record("tenant_mailbox_reparked",
+                                   tenant=tenant.name, n=len(claimed),
+                                   reason="serve-thread-raise")
+                raise
             if ok:
                 self.flight.record("tenant_mailbox_drained",
                                    tenant=tenant.name, n=len(claimed))

@@ -75,7 +75,9 @@ loop worker-side, per-step telemetry on heartbeats) ·
 collectives, subset hazards, host-syncs in loops — strict blocks
 error-severity cells; also %%distributed --strict per cell;
 deps|effects render the session's inferred cell effect footprints
-and write→read dependency DAG) ·
+and write→read dependency DAG; self runs the ten framework
+self-lint passes — registries, lock discipline, and the lifecycle
+passes: resource-leak, bracket-discipline, shutdown-completeness) ·
 %dist_supervise on (auto-heal) · %dist_chaos (fault injection) ·
 %dist_attach (rejoin this fleet after a kernel restart) ·
 %dist_pool start|status|stop (shared multi-tenant worker pool;
@@ -2212,7 +2214,7 @@ class DistributedMagics(Magics):
     @magic_arguments()
     @argument("command", nargs="?", default="status",
               choices=["strict", "warn", "off", "status", "deps",
-                       "effects"])
+                       "effects", "self"])
     @argument("--dot", action="store_true",
               help="with `deps`: print the dependency DAG as "
                    "Graphviz dot instead of text (paste into any dot "
@@ -2239,8 +2241,43 @@ class DistributedMagics(Magics):
         opacity); ``%dist_lint deps`` renders the session cell
         dependency DAG (RAW/WAR/WAW hazard edges) — the substrate for
         effects-aware pool scheduling and async dispatch; ``--dot``
-        emits it as Graphviz dot for visual audit."""
+        emits it as Graphviz dot for visual audit.
+
+        ``%dist_lint self`` runs the framework's own ten self-lint
+        passes over the checkout — the CLI ``nbd-lint --self``
+        in-notebook: env-knob / codec-header / protocol registries,
+        thread-shared-state, the lock-discipline passes (lock-order,
+        blocking-under-lock, callback-under-lock), and the lifecycle
+        passes (resource-leak, bracket-discipline,
+        shutdown-completeness) — and reports per-pass counts."""
         args = parse_argstring(self.dist_lint, line)
+        if args.command == "self":
+            from ..analysis.cli import _repo_root
+            from ..analysis.selfcheck import run_self_lint
+            root = _repo_root(None)
+            if root is None:
+                print("🔎 %dist_lint self needs a repo checkout "
+                      "(README.md next to nbdistributed_tpu/) — from "
+                      "an installed wheel run `nbd-lint --self "
+                      "--root <checkout>` instead")
+                return
+            results = run_self_lint(root)
+            total = sum(len(v) for v in results.values())
+            print(f"🔎 framework self-lint — {len(results)} passes "
+                  f"over {root}:")
+            for name, findings in results.items():
+                status = ("clean" if not findings
+                          else f"{len(findings)} finding(s)")
+                print(f"   · {name}: {status}")
+                for f in findings[:5]:
+                    print(f"     {f.render()}")
+                if len(findings) > 5:
+                    print(f"     … +{len(findings) - 5} more "
+                          f"(nbd-lint --self for the full list)")
+            print("   all passes clean ✅" if not total
+                  else f"   {total} finding(s) — CI's static-analysis "
+                       f"gate fails on these")
+            return
         if args.command in ("deps", "effects"):
             from ..analysis import preflight
             entries = preflight.effects_log()

@@ -235,6 +235,32 @@ class HostAgent:
         for _rank, proc in procs:
             if proc.poll() is None:
                 self._signal_tree(proc, _signal.SIGKILL)
+        # Reap the SIGKILLed children: poll() is what calls waitpid,
+        # and without this pass they sit as zombies for the agent's
+        # lifetime (the death-watch records each exit once and never
+        # polls again).  Then drop the stdout pipe fds of children
+        # whose drain thread has finished — closing a BufferedReader
+        # while a reader is still blocked in read() would WAIT on the
+        # reader's buffer lock (a SIGKILLed worker's orphaned
+        # descendant can hold the pipe's write end open), hanging
+        # _reap and close(); a still-draining pipe is left to EOF on
+        # its own, the pre-fix behavior.
+        deadline = time.time() + 2.0
+        while time.time() < deadline and any(p.poll() is None
+                                             for _, p in procs):
+            time.sleep(0.05)
+        for rank, proc in procs:
+            if proc.poll() is None or proc.stdout is None:
+                continue
+            io = self._io.get(rank)
+            if io is not None:
+                io._thread.join(timeout=0.5)
+                if io._thread.is_alive():
+                    continue
+            try:
+                proc.stdout.close()
+            except OSError:
+                pass
         return n
 
     @staticmethod
@@ -296,6 +322,10 @@ class HostAgent:
             except Exception:
                 pass
         self._listener.close()
+        # The stop event ends the death-watch within one 0.25 s tick;
+        # reap it so no thread that takes self._lock survives into
+        # interpreter teardown.
+        self._monitor.join(timeout=2.0)
 
 
 # ----------------------------------------------------------------------
@@ -493,6 +523,11 @@ class AgentClient:
                 ch.close()
             except Exception:
                 pass
+        # The closed flag + dead channel end the recv loop within one
+        # 1 s recv timeout; reap it so no thread that takes
+        # self._lock survives into interpreter teardown.
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=3.0)
 
 
 class _AgentWorker:

@@ -564,6 +564,15 @@ class WorkerChannel:
             self._rbuf.extend(data)
 
     def close(self) -> None:
+        # shutdown() before close(): closing an fd does NOT wake a
+        # thread blocked in an untimed recv() on it (the classic
+        # close-vs-blocked-reader race — the TenantClient reader
+        # would hang past its close() join without this); SHUT_RDWR
+        # delivers EOF to the blocked recv immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

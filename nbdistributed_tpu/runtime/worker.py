@@ -50,6 +50,17 @@ def _load_hf_pretrained_lazy(name_or_path, **kw):
 
 HEARTBEAT_INTERVAL_S = 2.0
 
+# Documented exemptions for the lifecycle self-lint
+# (analysis/lifecycle.py): "Class:attr" → reason.
+_LINT_LIFECYCLE_OK = {
+    "DistributedWorker:_stack_file":
+        "faulthandler holds this fd for SIGUSR1 stack dumps — the "
+        "postmortem evidence channel must outlive shutdown() (a "
+        "late SIGUSR1 against a closed fd would crash the handler); "
+        "the OS reclaims it at process exit, which is the intended "
+        "lifetime",
+}
+
 
 class _WorkerServe:
     """One serving tenant's worker-side decode state: the
@@ -964,12 +975,21 @@ class DistributedWorker:
         action = (msg.data or {}).get("action", "status")
         if action == "drain":
             claimed = self._mailbox.claim_all()
+            try:
+                reply = msg.reply(
+                    data={"status": "ok",
+                          "results": {mid: getattr(r, "data", None)
+                                      for mid, r in claimed.items()}},
+                    rank=self.rank)
+            except BaseException:
+                # Destructive claim: repark before unwinding or the
+                # parked results are gone and the reattaching
+                # coordinator's drain finds an empty box.
+                for mid, r in claimed.items():
+                    self._mailbox.park(mid, r)
+                raise
             self._flight.record("mailbox_drained", n=len(claimed))
-            return msg.reply(
-                data={"status": "ok",
-                      "results": {mid: getattr(r, "data", None)
-                                  for mid, r in claimed.items()}},
-                rank=self.rank)
+            return reply
         if action == "claim":
             r = self._mailbox.claim((msg.data or {}).get("msg_id", ""))
             return msg.reply(

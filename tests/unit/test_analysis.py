@@ -445,11 +445,16 @@ def test_knob_table_documents_every_knob():
 
 def test_self_lint_clean_on_this_checkout():
     results = run_self_lint(REPO)
+    # All TEN passes, none skippable: the four registry/discipline
+    # passes, the three concur lock passes, and the three ISSUE 15
+    # lifecycle passes.
     assert set(results) == {"env-knobs", "codec-headers",
                             "thread-shared-state",
                             "protocol-coverage", "lock-order",
                             "blocking-under-lock",
-                            "callback-under-lock"}
+                            "callback-under-lock",
+                            "resource-leak", "bracket-discipline",
+                            "shutdown-completeness"}
     for name, findings in results.items():
         assert findings == [], (
             f"[{name}] " + "; ".join(f.render() for f in findings))
@@ -1862,3 +1867,532 @@ def test_dist_lint_deps_dot_renders(magic, capsys):
     out = capsys.readouterr().out
     assert out.strip().startswith("digraph cell_deps")
     assert "->" in out
+
+
+# ======================================================================
+# ISSUE 15: lifecycle lint (analysis/lifecycle.py) — synthetic corpus
+# (per rule: one sample firing exactly that rule, and a clean twin)
+
+
+def _lifecycle_results(tmp_path, src):
+    """Run the three lifecycle passes over one synthetic module in a
+    throwaway product tree (the _concur_results analog)."""
+    from nbdistributed_tpu.analysis.lifecycle import run_lifecycle_lint
+    pkg = tmp_path / "nbdistributed_tpu"
+    pkg.mkdir(parents=True)
+    (tmp_path / "tools").mkdir()
+    (pkg / "mod.py").write_text(src)
+    return run_lifecycle_lint(str(tmp_path))
+
+
+def _lifecycle_clean(tmp_path, src):
+    res = _lifecycle_results(tmp_path, src)
+    assert all(v == [] for v in res.values()), {
+        k: [f.render() for f in v] for k, v in res.items() if v}
+
+
+# -- resource-leak ------------------------------------------------------
+
+
+def test_leak_socket_never_released_fires(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+def probe(host):
+    s = socket.create_connection((host, 80))
+    s.sendall(b"x")
+"""), "resource-leak")
+    assert "never released" in found[0].message
+    assert "socket" in found[0].message
+
+
+def test_leak_release_only_on_fall_through_fires(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+def probe(host):
+    s = socket.create_connection((host, 80))
+    s.sendall(b"x")
+    s.close()
+"""), "resource-leak")
+    assert "fall-through" in found[0].message
+
+
+def test_leak_clean_twins_with_block_and_finally(tmp_path):
+    _lifecycle_clean(tmp_path, """
+import socket
+
+def probe_with(host):
+    with socket.create_connection((host, 80)) as s:
+        s.sendall(b"x")
+
+def probe_finally(host):
+    s = socket.create_connection((host, 80))
+    try:
+        s.sendall(b"x")
+    finally:
+        s.close()
+
+def make_and_close():
+    s = socket.socket()
+    s.close()
+""")
+
+
+def test_leak_ownership_transfer_clean_twins(tmp_path):
+    _lifecycle_clean(tmp_path, """
+import socket
+
+def returned():
+    s = socket.socket()
+    return s
+
+def registered(registry):
+    s = socket.socket()
+    registry.register(s)
+
+class Owner:
+    def __init__(self):
+        self.sock = None
+    def arm(self, host):
+        s = socket.create_connection((host, 80))
+        self.sock = s
+    def close(self):
+        self.sock.close()
+""")
+
+
+def test_leak_nondaemon_thread_fires_daemon_clean(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import threading
+
+def run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+"""), "resource-leak")
+    assert "thread" in found[0].message
+    _lifecycle_clean(tmp_path / "d", """
+import threading
+
+def run(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+def run_joined(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    try:
+        pass
+    finally:
+        t.join()
+""")
+
+
+def test_leak_popen_and_write_open(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import subprocess
+
+def launch(argv):
+    p = subprocess.Popen(argv)
+    p.poll()
+"""), "resource-leak")
+    assert "process" in found[0].message
+    # Read-mode open is not in the acquire vocabulary; adjacent
+    # wait() is a zero-raise-window release.
+    _lifecycle_clean(tmp_path / "c", """
+import subprocess
+
+def launch(argv):
+    p = subprocess.Popen(argv)
+    p.wait()
+
+def read(path):
+    f = open(path)
+    return f
+""")
+
+
+def test_leak_socketpair_each_end_needs_its_own_release(tmp_path):
+    # Closing one end must not satisfy the check for the other.
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+def pair():
+    r, w = socket.socketpair()
+    r.close()
+"""), "resource-leak")
+    assert len(found) == 1 and "'w'" in found[0].message
+    _lifecycle_clean(tmp_path / "c", """
+import socket
+
+def pair():
+    r, w = socket.socketpair()
+    try:
+        pass
+    finally:
+        r.close()
+        w.close()
+""")
+
+
+def test_leak_exemption_table_silences_the_site(tmp_path):
+    _lifecycle_clean(tmp_path, """
+_LINT_LIFECYCLE_OK = {"probe:socket": "one-shot probe; the process "
+                      "exits right after and the OS reclaims the fd"}
+import socket
+
+def probe(host):
+    s = socket.create_connection((host, 80))
+    s.sendall(b"x")
+""")
+
+
+# -- bracket-discipline -------------------------------------------------
+
+
+_SERVE_BRACKET_HEAD = """
+import threading
+
+class G:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._serving = {}
+    def _serve_done(self, name):
+        with self._lock:
+            self._serving[name] = self._serving.get(name, 1) - 1
+"""
+
+
+def test_bracket_serve_slot_unprotected_fires(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, _SERVE_BRACKET_HEAD + """
+    def submit(self, name):
+        with self._lock:
+            self._serving[name] = self._serving.get(name, 0) + 1
+        self.do_work(name)
+"""), "bracket-discipline")
+    assert "serve-slot" in found[0].message
+
+
+def test_bracket_serve_slot_thread_handoff_clean(tmp_path):
+    _lifecycle_clean(tmp_path, _SERVE_BRACKET_HEAD + """
+    def submit(self, name):
+        with self._lock:
+            self._serving[name] = self._serving.get(name, 0) + 1
+        threading.Thread(target=self._serve, args=(name,),
+                         daemon=True).start()
+    def _serve(self, name):
+        try:
+            self.work(name)
+        finally:
+            self._serve_done(name)
+    def submit_inline(self, name):
+        with self._lock:
+            self._serving[name] = self._serving.get(name, 0) + 1
+        try:
+            self.work(name)
+        finally:
+            self._serve_done(name)
+""")
+
+
+def test_bracket_mailbox_claim_fires_and_repark_twin_clean(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+class W:
+    def drain(self, box, reply):
+        claimed = box.claim_all()
+        return reply(claimed)
+"""), "bracket-discipline")
+    assert "mailbox-claim" in found[0].message
+    _lifecycle_clean(tmp_path / "c", """
+class W:
+    def drain(self, box, reply):
+        claimed = box.claim_all()
+        try:
+            return reply(claimed)
+        except Exception:
+            for mid, r in claimed.items():
+                box.park(mid, r)
+            raise
+""")
+
+
+def test_bracket_gauge_updown_fires_only_with_dec_in_module(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+class M:
+    def __init__(self):
+        self.g = None
+    def enter(self):
+        self.g.inc()
+        self.work()
+    def leave(self):
+        self.g.dec()
+"""), "bracket-discipline")
+    assert "gauge-updown" in found[0].message
+    # Monotonic counters (inc with no dec anywhere in the module)
+    # never arm the bracket…
+    _lifecycle_clean(tmp_path / "mono", """
+class M:
+    def count(self, c):
+        c.inc()
+        self.work()
+""")
+    # …nor does a dec on a DIFFERENT receiver arm someone else's
+    # counter inc (pairing is per dotted receiver).
+    _lifecycle_clean(tmp_path / "other", """
+class M:
+    def __init__(self):
+        self.g = None
+        self.requests = None
+    def count(self):
+        self.requests.inc()
+        self.work()
+    def leave(self):
+        self.g.dec()
+""")
+    # …and the finally twin is clean even with dec present.
+    _lifecycle_clean(tmp_path / "c", """
+class M:
+    def __init__(self):
+        self.g = None
+    def enter(self):
+        self.g.inc()
+        try:
+            self.work()
+        finally:
+            self.g.dec()
+""")
+
+
+def test_bracket_exemption_table_silences_the_site(tmp_path):
+    _lifecycle_clean(tmp_path, """
+_LINT_LIFECYCLE_OK = {"W.drain:mailbox-claim": "the completion "
+                      "callback reparks on failure by contract"}
+
+class W:
+    def drain(self, box, reply):
+        claimed = box.claim_all()
+        return reply(claimed)
+""")
+
+
+# -- shutdown-completeness ----------------------------------------------
+
+
+def test_shutdown_unreleased_socket_fires_release_twin_clean(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+class S:
+    def __init__(self):
+        self._sock = socket.create_connection(("h", 1))
+    def close(self):
+        pass
+"""), "shutdown-completeness")
+    assert "_sock" in found[0].message
+    _lifecycle_clean(tmp_path / "c", """
+import socket
+
+class S:
+    def __init__(self):
+        self._sock = socket.create_connection(("h", 1))
+    def close(self):
+        self._sock.close()
+""")
+
+
+def test_shutdown_no_surface_at_all_fires(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+class S:
+    def __init__(self):
+        self._sock = socket.socket()
+"""), "shutdown-completeness")
+    assert "defines no close" in found[0].message
+
+
+def test_shutdown_nondaemon_thread_must_be_joined(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import threading
+
+class S:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+    def _run(self):
+        pass
+    def close(self):
+        pass
+"""), "shutdown-completeness")
+    assert "non-daemon thread" in found[0].message
+
+
+def test_shutdown_daemon_thread_lock_hazard_and_join_twin(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+    def _run(self):
+        with self._lock:
+            pass
+    def close(self):
+        pass
+"""), "shutdown-completeness")
+    assert "interpreter teardown" in found[0].message
+    _lifecycle_clean(tmp_path / "joined", """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+    def _run(self):
+        with self._lock:
+            pass
+    def close(self):
+        self._t.join(timeout=1.0)
+""")
+    # A daemon thread that touches no lock needs no surface at all.
+    _lifecycle_clean(tmp_path / "harmless", """
+import threading
+
+class S:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+    def _run(self):
+        pass
+""")
+
+
+def test_shutdown_owner_typed_attr_and_alias_release(tmp_path):
+    found = _only(_lifecycle_results(tmp_path, """
+import socket
+
+class Inner:
+    def __init__(self):
+        self._sock = socket.socket()
+    def close(self):
+        self._sock.close()
+
+class Outer:
+    def __init__(self):
+        self._inner = Inner()
+    def close(self):
+        pass
+"""), "shutdown-completeness")
+    assert "Inner" in found[0].message and "_inner" in found[0].message
+    # The swap-then-close alias (`s, self._sock = self._sock, None`)
+    # and the close-loop over a tuple of attrs both count as releases.
+    _lifecycle_clean(tmp_path / "alias", """
+import socket
+
+class S:
+    def __init__(self):
+        self._sock = socket.socket()
+        self._wake_r, self._wake_w = socket.socketpair()
+    def close(self):
+        s, self._sock = self._sock, None
+        s.close()
+        for w in (self._wake_r, self._wake_w):
+            w.close()
+""")
+
+
+def test_shutdown_exemption_table_silences_the_attr(tmp_path):
+    _lifecycle_clean(tmp_path, """
+_LINT_LIFECYCLE_OK = {"S:_sock": "held for the process lifetime by "
+                      "design (faulthandler-style registration)"}
+import socket
+
+class S:
+    def __init__(self):
+        self._sock = socket.socket()
+""")
+
+
+def test_shutdown_ledger_report_shape():
+    from nbdistributed_tpu.analysis.lifecycle import shutdown_ledger
+    ledger = shutdown_ledger(REPO)
+    # Real owners with their release evidence…
+    tc = ledger["TenantClient"]
+    assert tc["file"] == "nbdistributed_tpu/gateway/client.py"
+    reader = {r["attr"]: r for r in tc["resources"]}["_reader"]
+    assert reader["daemon"] and "join" in reader["released_by"]
+    # …and the worker's exemption-tabled faulthandler fd carries its
+    # reason.
+    w = ledger["DistributedWorker"]
+    stack = {r["attr"]: r for r in w["resources"]}["_stack_file"]
+    assert stack["exempt"] and "faulthandler" in stack["exempt"]
+    json.dumps(ledger)   # CI artifact: must be JSON-serializable
+
+
+# ----------------------------------------------------------------------
+# ISSUE 15 satellite: SARIF output (one run, rule ids = pass names)
+
+
+def test_cli_sarif_self_mode_validates(capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    assert main(["--self", "--root", REPO, "--format", "sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "nbd-lint"
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"resource-leak", "bracket-discipline",
+            "shutdown-completeness", "lock-order", "env-knobs",
+            "protocol-coverage"} <= ids
+    assert run["results"] == []        # the clean-checkout pin again
+
+
+def test_cli_sarif_file_mode_findings_and_exit_codes(tmp_path, capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(HANG_CELL)
+    assert main([str(bad), "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "rank-conditional-collective"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 5      # stable location
+    # Unparseable input: visible as a note, exit 0 by the
+    # never-block contract — but a warning AND exit 1 under --strict.
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n")
+    assert main([str(broken), "--format", "sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "not-analyzable" and res["level"] == "note"
+    assert main([str(broken), "--format", "sarif", "--strict"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"][0]["level"] == "warning"
+
+
+def test_cli_shutdown_ledger_mode(capsys):
+    from nbdistributed_tpu.analysis.cli import main
+    assert main(["--shutdown-ledger", "--root", REPO]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "CoordinatorListener" in doc
+    attrs = {r["attr"] for r in doc["CoordinatorListener"]["resources"]}
+    assert {"_server", "_wake_r", "_wake_w"} <= attrs
+
+
+# ----------------------------------------------------------------------
+# ISSUE 15 satellite: %dist_lint self parity with the CLI
+
+
+def test_dist_lint_self_reports_all_pass_counts(magic, capsys):
+    magic.dist_lint("self")
+    out = capsys.readouterr().out
+    for name in ("env-knobs", "codec-headers", "thread-shared-state",
+                 "protocol-coverage", "lock-order",
+                 "blocking-under-lock", "callback-under-lock",
+                 "resource-leak", "bracket-discipline",
+                 "shutdown-completeness"):
+        assert f"{name}: clean" in out, name
+    assert "all passes clean" in out

@@ -145,6 +145,32 @@ def test_mailbox_repark_same_id_refreshes():
     assert box.claim("m").data == {"output": "new"}
 
 
+def test_worker_drain_reparks_when_reply_construction_raises():
+    """ISSUE 15 lifecycle fix: the worker's drain claim is
+    destructive, so a raise between ``claim_all`` and the reply
+    leaving the handler must repark — or the parked results are gone
+    and the reattaching coordinator's drain finds an empty box."""
+    from nbdistributed_tpu.runtime.worker import DistributedWorker
+
+    w = DistributedWorker.__new__(DistributedWorker)
+    w.rank = 0
+    w._mailbox = ResultMailbox()
+    w._flight = type("F", (), {"record":
+                               staticmethod(lambda *a, **k: None)})()
+    w._mailbox.park("m1", _reply("m1", {"output": "precious"}))
+
+    class _Msg:
+        data = {"action": "drain"}
+
+        def reply(self, **kw):
+            raise RuntimeError("encode blew up")
+
+    with pytest.raises(RuntimeError, match="encode blew up"):
+        w._handle_mailbox(_Msg())
+    assert w._mailbox.ids() == ["m1"]          # reparked, not lost
+    assert w._mailbox.claim("m1").data == {"output": "precious"}
+
+
 # ----------------------------------------------------------------------
 # codec epoch header
 

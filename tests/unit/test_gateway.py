@@ -904,3 +904,79 @@ def test_serve_mailbox_releases_counter():
     with pytest.raises(RuntimeError):
         d._serve_mailbox(_T(), None, 7)
     assert d._serving == {}                  # failure still releases
+
+
+def test_gateway_drain_reparks_when_serve_thread_raises():
+    """ISSUE 15 lifecycle fix (bracket-discipline finding): the
+    gateway drain's ``claim_all`` is destructive, so a serve thread
+    that throws between the claim and the send (reply construction,
+    encode) must repark before unwinding — or the tenant's parked
+    results are lost on BOTH sides and exactly-once becomes
+    at-most-once."""
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+    from nbdistributed_tpu.messaging.codec import Message
+    from nbdistributed_tpu.resilience import ResultMailbox
+
+    class _T:
+        name = "alice"
+        mailbox = ResultMailbox()
+
+    _T.mailbox.park("m1", Message(msg_type="response",
+                                  data={"output": "precious"}))
+    d = object.__new__(GatewayDaemon)
+    d._lock = threading.Lock()
+    events = []
+    d.flight = type("F", (), {"record": staticmethod(
+        lambda kind, **kw: events.append(kind))})()
+
+    def _boom(cid, m):
+        raise RuntimeError("encode blew up")
+
+    d._send_to_client = _boom
+    msg = Message(msg_type="mailbox", data={"action": "drain"})
+    with pytest.raises(RuntimeError, match="encode blew up"):
+        d._handle_mailbox(7, _T(), msg)
+    assert _T.mailbox.ids() == ["m1"]          # reparked, not lost
+    assert "tenant_mailbox_reparked" in events
+
+
+def test_tenant_client_close_joins_reader_thread():
+    """ISSUE 15 lifecycle fix (shutdown-completeness finding): a
+    closed TenantClient must not leave its lock-taking reader thread
+    running into interpreter teardown."""
+    from nbdistributed_tpu.gateway.client import TenantClient
+
+    tc = object.__new__(TenantClient)
+    tc._closed = False
+    tc._dead = None
+    unblock = threading.Event()
+    tc._ch = type("Ch", (), {"close":
+                             staticmethod(lambda: unblock.set())})()
+    tc._reader = threading.Thread(target=unblock.wait, daemon=True)
+    tc._reader.start()
+    tc.close()
+    tc._reader.join(timeout=2.0)
+    assert not tc._reader.is_alive()
+
+
+def test_tenant_client_close_from_reader_thread_never_self_joins():
+    """close() can be invoked from a reader-thread callback; a thread
+    cannot join itself, so the guard must skip the join rather than
+    raise RuntimeError."""
+    from nbdistributed_tpu.gateway.client import TenantClient
+
+    tc = object.__new__(TenantClient)
+    tc._closed = False
+    tc._dead = None
+    tc._ch = type("Ch", (), {"close": staticmethod(lambda: None)})()
+    done = []
+
+    def _run():
+        tc.close()
+        done.append(True)
+
+    t = threading.Thread(target=_run, daemon=True)
+    tc._reader = t
+    t.start()
+    t.join(timeout=2.0)
+    assert done == [True]

@@ -183,3 +183,41 @@ def test_link_loss_means_unknown_not_dead(agent, client):
         os.kill(pid, signal.SIGKILL)
     except OSError:
         pass
+
+
+def test_reap_waits_out_sigkilled_children(agent, client):
+    """ISSUE 15 lifecycle fix: a child that ignores SIGTERM is
+    SIGKILLed by reap — and must then be waited (no zombie: the
+    death-watch records each exit once and never polls again) with
+    its stdout pipe fd dropped."""
+    client.spawn(3, [sys.executable, "-c",
+                     "import signal, time; "
+                     "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                     "print('armored', flush=True); "
+                     "time.sleep(120)"], {})
+    proc = agent._procs[3]
+    assert _wait(lambda: "armored" in agent._io[3].tail())
+    resp = client.request("reap", {})
+    assert resp.data["reaped"] == 1
+    # returncode read WITHOUT poll(): it is set only if the agent
+    # itself already reaped the corpse (poll() would waitpid here and
+    # mask a zombie leak).
+    assert proc.returncode is not None
+    assert proc.stdout.closed
+
+
+def test_close_joins_lock_taking_threads(tmp_path):
+    """ISSUE 15 lifecycle fix: closing the agent and its client reaps
+    the death-watch / recv threads — both take self._lock, and a
+    daemon thread holding a lock into interpreter teardown deadlocks
+    atexit work."""
+    a = HostAgent("127.0.0.1", 0, auth_token="s",
+                  run_dir=str(tmp_path / "run"))
+    c = AgentClient("127.0.0.1", a.port, auth_token="s")
+    recv_thread, monitor = c._thread, a._monitor
+    c.close()
+    recv_thread.join(timeout=4.0)
+    assert not recv_thread.is_alive()
+    a.close()
+    monitor.join(timeout=3.0)
+    assert not monitor.is_alive()

@@ -57,6 +57,30 @@ def test_bidirectional_messages(listener):
     ch.close()
 
 
+def test_close_wakes_a_blocked_untimed_recv(listener):
+    """ISSUE 15 lifecycle fix: close() must shutdown() the socket
+    before closing the fd — closing an fd alone never wakes a thread
+    blocked in an untimed recv() (the TenantClient reader-thread hang
+    the live verify caught), while SHUT_RDWR delivers EOF at once."""
+    ch = WorkerChannel("127.0.0.1", listener.port, rank=5)
+    assert wait_until(lambda: listener.connected == [5])
+    woke = threading.Event()
+
+    def _reader():
+        try:
+            ch.recv()          # untimed: blocks in sock.recv
+        except TransportError:
+            woke.set()
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+    time.sleep(0.2)            # let it reach the blocking recv
+    ch.close()
+    assert woke.wait(2.0), "blocked recv never woke after close()"
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+
+
 def test_send_to_unknown_rank_raises(listener):
     with pytest.raises(TransportError):
         listener.send_to_rank(99, Message(msg_type="x"))

@@ -123,16 +123,23 @@ def main(argv: list[str] | None = None) -> int:
                           int(plane.get("port") or 0), args.tenant,
                           token=token,
                           pool_token=m.get("pool_token"))
-    srv = ThreadingHTTPServer((args.host, args.port),
-                              make_handler(client))
-    print(f"NBD_SERVE_HTTP ready on {args.host}:{srv.server_port} "
-          f"-> pool {d} (tenant {args.tenant!r})", flush=True)
     try:
-        srv.serve_forever()
-    except KeyboardInterrupt:
-        pass
+        # Inside the try: a failed HTTP bind (port in use) must not
+        # leak the tenant connection + reader thread without a clean
+        # detach — the gateway would see a LOST tenant instead of a
+        # goodbye (lifecycle-lint shutdown discipline).
+        srv = ThreadingHTTPServer((args.host, args.port),
+                                  make_handler(client))
+        try:
+            print(f"NBD_SERVE_HTTP ready on {args.host}:"
+                  f"{srv.server_port} -> pool {d} "
+                  f"(tenant {args.tenant!r})", flush=True)
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.server_close()
     finally:
-        srv.server_close()
         client.close(detach=True)
     return 0
 
