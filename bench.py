@@ -23,6 +23,11 @@ driver-defined all_reduce metric):
    2(n-1)/n·bytes/t per chip at 1–64 MiB.  On a single-chip world the
    collective degenerates, so the sweep reports the HBM-bound on-device
    copy figure instead, labeled as such.
+4. **Elastic pools** (``extra.elastic``, ISSUE 16): cold vs warm
+   first-cell compile seconds (the persistent XLA cache serving a
+   resized-in fleet), the resize drain-barrier + whole-flip
+   wall-clock, and a tenant migration end to end — measured in CPU
+   pools of their own after the bench world is torn down.
 
 TPU bring-up failures (the axon tunnel flaps: device discovery hangs)
 retry with backoff, then fall back to a 2-process CPU/gloo world — the
@@ -1352,6 +1357,112 @@ def run_families(backend: str, families, extra: dict,
                     log(f"[bench] on_family({name}) failed: {e}")
 
 
+# Elastic-pool family (ISSUE 16): a deliberately odd-shaped jit so
+# neither the in-memory nor a stale persistent cache can pre-own it —
+# the SAME cell runs cold on a fresh pool, then again on a
+# resized-in fleet whose persistent compile cache should serve it
+# warm.  The final expression is the worker-side compile+run seconds.
+ELASTIC_COMPILE_CELL = """
+import time as _t
+import jax as _jax, jax.numpy as _jnp
+_t0 = _t.time()
+_f = _jax.jit(lambda x: _jnp.tanh(x @ x.T).sum()
+              + _jnp.sin(x).mean())
+_x = _jnp.ones((521, 517), _jnp.float32)
+float(_f(_x))
+_t.time() - _t0
+"""
+
+
+def measure_elastic() -> dict | None:
+    """The ISSUE 16 numbers: cold vs warm first-cell seconds (the
+    persistent compile cache serving a resized-in worker), the resize
+    drain-barrier and whole-flip wall-clock, and a tenant migration
+    end to end between two pools under one runs root.
+
+    Always measured on the CPU backend in pools of its own (the
+    mechanism under test is the control plane + XLA cache, not the
+    accelerator), AFTER the pooled bench world is gone."""
+    import shutil
+    import tempfile
+
+    from nbdistributed_tpu.gateway import router as router_mod
+    from nbdistributed_tpu.gateway.client import TenantClient
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+    from nbdistributed_tpu.gateway.scheduler import SchedPolicy
+
+    runs_root = tempfile.mkdtemp(prefix="nbd-bench-elastic-")
+    run_a = os.path.join(runs_root, "pool-a")
+    run_b = os.path.join(runs_root, "pool-b")
+    os.makedirs(run_a)
+    os.makedirs(run_b)
+    saved = os.environ.get("NBD_RUN_DIR")
+    gw_a = gw_b = client = None
+    out: dict = {"backend": "cpu"}
+
+    def _cell_seconds(cl) -> float:
+        r = cl.execute(ELASTIC_COMPILE_CELL, target_ranks=[0],
+                       timeout=300)
+        res = (r.get("results") or {}).get("0") or {}
+        if r.get("error") or res.get("error"):
+            raise RuntimeError(r.get("error") or res["error"])
+        return float(ast.literal_eval(res["output"]))
+
+    try:
+        os.environ["NBD_RUN_DIR"] = run_a
+        gw_a = GatewayDaemon(
+            1, backend="cpu",
+            policy=SchedPolicy("fair", mesh_slots=1,
+                               tenant_inflight=8, queue_depth=16),
+            request_timeout=None, attach_timeout=240.0)
+        client = TenantClient(gw_a.tenant_host, gw_a.tenant_port,
+                              "bench", pool_token=gw_a.pool_token)
+        out["cold_first_cell_s"] = round(_cell_seconds(client), 4)
+
+        res = gw_a.resize(2, reason="bench")
+        if res.get("status") != "resized":
+            raise RuntimeError(f"resize failed: {res}")
+        out["resize_drain_s"] = res["drain_s"]
+        out["resize_wall_s"] = res["wall_s"]
+        # Fresh processes, wiped namespaces — only the persistent
+        # cache can make this fast.
+        out["warm_first_cell_s"] = round(_cell_seconds(client), 4)
+        if out["warm_first_cell_s"] > 0:
+            out["warm_speedup"] = round(
+                out["cold_first_cell_s"] / out["warm_first_cell_s"],
+                2)
+        client.close()
+        client = None
+
+        os.environ["NBD_RUN_DIR"] = run_b
+        gw_b = GatewayDaemon(
+            1, backend="cpu",
+            policy=SchedPolicy("fair", mesh_slots=1,
+                               tenant_inflight=8, queue_depth=16),
+            request_timeout=None, attach_timeout=240.0)
+        t0 = time.time()
+        router_mod.migrate_tenant("bench", run_a, run_b, force=True)
+        out["migrate_s"] = round(time.time() - t0, 4)
+        return out
+    finally:
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+        for gw in (gw_b, gw_a):
+            if gw is not None:
+                try:
+                    gw.close()
+                except Exception:
+                    pass
+        if saved is None:
+            os.environ.pop("NBD_RUN_DIR", None)
+        else:
+            os.environ["NBD_RUN_DIR"] = saved
+        shutil.rmtree(runs_root, ignore_errors=True)
+
+
 def main() -> int:
     # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
     # spawned workers: raising SystemExit lets run()'s finally-block
@@ -1541,6 +1652,17 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
         # family worker attaches.
         _teardown(comm, pm, world)
         comm = pm = None
+
+        # Elastic pools (ISSUE 16): cold vs warm first-cell compile,
+        # resize drain-barrier wall-clock, migration end-to-end — in
+        # CPU pools of its own, after the bench world is gone.
+        try:
+            el = measure_elastic()
+            if el:
+                extra["elastic"] = el
+                log(f"[bench] elastic: {el}")
+        except Exception as e:
+            log(f"[bench] elastic measurement skipped: {e}")
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"

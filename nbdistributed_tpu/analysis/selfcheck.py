@@ -90,6 +90,13 @@ _THREAD_CHECKED_FILES = (
     # shared between tenant-plane submit threads and the decode
     # driver thread.
     os.path.join("nbdistributed_tpu", "gateway", "serving.py"),
+    # Elastic pools (ISSUE 16): membership is shared between the
+    # resize thread, the listener, and the manifest writer; the
+    # router/autoscaler are included so their locking stays honest
+    # as they grow state.
+    os.path.join("nbdistributed_tpu", "gateway", "membership.py"),
+    os.path.join("nbdistributed_tpu", "gateway", "router.py"),
+    os.path.join("nbdistributed_tpu", "resilience", "autoscaler.py"),
 )
 
 
@@ -507,6 +514,10 @@ _PROTOCOL_EXTERNAL = {
     "agent:ping":
         "agent liveness probe for tests and operators; sent from "
         "outside the product tree by design",
+    "tenant-notice:response":
+        "tenant_import reconstructs migrated parked results as "
+        "mailbox entries — they leave the gateway only inside a "
+        "mailbox drain's results dict, never as standalone frames",
 }
 
 # Sender-method msg_type positional index (after any leading
@@ -690,9 +701,14 @@ def _protocol_planes(root: str) -> list[dict]:
          "sent": _constructed_types(root, worker_rx),
          "handled": _handled_types(root, coord_rx)},
         {"name": "tenant",
-         "sent": _sent_request_types(root, files=[client_rx],
-                                     methods={"request": 0},
-                                     functions={"_admin_request": 3}),
+         # router.py is in the sender list (ISSUE 16): today it sends
+         # only through client.py's admin helpers, but a direct send
+         # added there later must not escape the coverage pass.
+         "sent": _sent_request_types(
+             root, files=[client_rx,
+                          "nbdistributed_tpu/gateway/router.py"],
+             methods={"request": 0},
+             functions={"_admin_request": 3}),
          "handled": _handled_types(root, daemon_rx)},
         {"name": "tenant-notice",
          # The serving plane (gateway/serving.py) pushes its

@@ -496,3 +496,52 @@ def pool_shutdown(host: str, port: int, pool_token: str | None, *,
                   timeout: float = 30.0) -> dict:
     return _admin_request(host, port, pool_token, "pool_shutdown",
                           {"token": pool_token}, timeout=timeout)
+
+
+def pool_resize(host: str, port: int, pool_token: str | None,
+                workers: int, *, reason: str = "manual",
+                timeout: float = 600.0) -> dict:
+    """Resize the pool's worker fleet (drain barrier + epoch bump).
+    Long default timeout: the reply lands only after the drain and
+    the respawned fleet's readiness."""
+    return _admin_request(host, port, pool_token, "pool_resize",
+                          {"token": pool_token, "workers": workers,
+                           "reason": reason}, timeout=timeout)
+
+
+def pool_template(host: str, port: int, pool_token: str | None,
+                  code: str | None = None, *, name: str = "default",
+                  timeout: float = 600.0) -> dict:
+    """Register (and run) a warm-start template cell, or list the
+    registered templates when ``code`` is None."""
+    data = {"token": pool_token, "name": name}
+    if code is not None:
+        data["code"] = code
+    return _admin_request(host, port, pool_token, "pool_template",
+                          data, timeout=timeout)
+
+
+def tenant_export(host: str, port: int, pool_token: str | None,
+                  tenant: str, *, timeout: float = 60.0) -> dict:
+    """Non-destructive migration snapshot of a tenant's durable
+    state (token, epoch, parked results, serve journal)."""
+    return _admin_request(host, port, pool_token, "tenant_export",
+                          {"token": pool_token, "tenant": tenant},
+                          timeout=timeout)
+
+
+def tenant_import(host: str, port: int, pool_token: str | None,
+                  snapshot: dict, *, timeout: float = 60.0) -> dict:
+    """Idempotently adopt an exported tenant at this pool."""
+    return _admin_request(host, port, pool_token, "tenant_import",
+                          {"token": pool_token, "snapshot": snapshot},
+                          timeout=timeout)
+
+
+def tenant_release(host: str, port: int, pool_token: str | None,
+                   tenant: str, *, force: bool = False,
+                   timeout: float = 60.0) -> dict:
+    """Drop a migrated-away tenant from its source pool."""
+    return _admin_request(host, port, pool_token, "tenant_release",
+                          {"token": pool_token, "tenant": tenant,
+                           "force": force}, timeout=timeout)

@@ -53,6 +53,7 @@ from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..resilience import session as session_mod
 from ..utils import knobs
+from .membership import PoolMembership
 from .scheduler import CellRejected, CellShed, SchedPolicy, Scheduler
 from .tenancy import TenantRegistry, TenantRejected
 
@@ -74,12 +75,56 @@ _LINT_BLOCKING_OK = {
         "the atomic-publish os.replace must happen inside the same "
         "critical section as the .tmp write, or two publishers can "
         "replace each other's torn file",
+    # The resize lock EXISTS to serialize whole drain-barrier resizes
+    # (minutes of teardown + respawn): overlapping resizes would race
+    # two fleets onto one control port.  It is a cold-path admin lock,
+    # never taken on the park/claim/serve plane, and never nested
+    # under the hot _lock.
+    "GatewayDaemon.resize:wait":
+        "the drain barrier's bounded wait is the resize's phase 1; "
+        "the resize lock must span it or a second resize could flip "
+        "the fleet mid-drain",
+    "GatewayDaemon.resize:join":
+        "fleet teardown (pm.quiesce) is phase 2 of the serialized "
+        "resize — same cold-path admin lock",
+    "GatewayDaemon.resize:post":
+        "the graceful shutdown broadcast to the draining fleet is "
+        "part of the serialized flip",
+    "GatewayDaemon.resize:time.sleep":
+        "the settle sleeps (shutdown drain, stale-EOF drain) are "
+        "part of the serialized flip",
+    "GatewayDaemon.resize:request":
+        "pm.shutdown's host-agent requests are part of the "
+        "serialized flip",
+    "GatewayDaemon.resize:send_to_ranks":
+        "template replay warms the NEW fleet before the scheduler "
+        "resumes — running it outside the resize lock would let a "
+        "second resize tear the fleet down mid-warm",
+}
+
+# The world-reset abort path fails stale pendings (firing their
+# on_done callbacks) while the resize lock is held: those callbacks
+# are the latency observatory's stage stamps and the serve threads'
+# wakeups — none re-enter the daemon's resize path.
+_LINT_CALLBACK_OK = {
+    "GatewayDaemon.resize:cb":
+        "reset_world's pending-abort callbacks (latency stamps, "
+        "ticket wakeups) never re-enter the resize plane; deferring "
+        "them would leave serve threads parked until after the flip "
+        "— exactly the hang the abort exists to prevent",
 }
 
 # Tenant-plane request types a connection may send BEFORE its
-# tenant_hello: status probes and the admin stop need no tenant slot
-# (the transport-level pool token already authenticated the peer).
-_PRE_HELLO = frozenset({"tenant_hello", "pool_status", "pool_shutdown"})
+# tenant_hello: status probes and the admin plane need no tenant slot
+# (the transport-level pool token already authenticated the peer; the
+# mutating ones re-prove the pool token in their payload, like
+# pool_shutdown always has).  pool_resize/pool_template are the
+# elastic-pool controls; tenant_export/import/release are the router's
+# migration plane (ISSUE 16).
+_PRE_HELLO = frozenset({"tenant_hello", "pool_status", "pool_shutdown",
+                        "pool_resize", "pool_template",
+                        "tenant_export", "tenant_import",
+                        "tenant_release"})
 
 # Serving-plane request types (ISSUE 11), served off-listener like
 # execute/mailbox: submit journals to disk, start dispatches a model
@@ -195,7 +240,38 @@ class GatewayDaemon:
         self.flight = flightrec.init("gateway")
         self.run_dir = flightrec.run_dir()
 
+        # Elastic pools (ISSUE 16): membership — who owns which ranks,
+        # generation-stamped — is split from scheduling so both can
+        # change at runtime.  A resize is an attach-like epoch bump:
+        # session_epoch advances, the old epoch's frames fence on the
+        # existing ``ep`` header, and membership records which rank
+        # set belonged to which epoch for late-frame forensics.
+        self.membership = PoolMembership(world_size, epoch=1,
+                                         now=time.time())
+        self.session_epoch = 1
+        self._resize_lock = threading.Lock()   # one resize at a time
+        self._backend = backend
+        self._attach_timeout = attach_timeout
+        # Warm starts: a persistent per-pool XLA compilation cache,
+        # shipped to every worker (including resized-in ones), so the
+        # first cell after a grow — or a migrated tenant's first cell —
+        # doesn't pay the cold compile.  Default lives under the run
+        # dir; NBD_COMPILE_CACHE_DIR overrides; "0"/"off" disables.
+        cache = knobs.get_str("NBD_COMPILE_CACHE_DIR")
+        if cache is None:
+            cache = os.path.join(self.run_dir, "xla-cache")
+        if cache.strip().lower() in ("", "0", "off", "none"):
+            cache = ""
+        self.compile_cache_dir = cache
+        # Template namespaces: admin-registered cells re-run on every
+        # epoch's fresh fleet so resized-in workers start warm.
+        self._templates: dict[str, str] = {}
+        self._autoscaler = None
+        self._autoscale_stop = threading.Event()
+        self._autoscale_thread = None
+
         session_token = session_mod.mint_token()
+        self._session_token = session_token
         self.comm = CommunicationManager(
             num_workers=world_size, timeout=request_timeout,
             session_token=session_token, session_epoch=1,
@@ -206,8 +282,7 @@ class GatewayDaemon:
         try:
             self.pm.start_workers(
                 world_size, self.comm.port, backend=backend,
-                extra_env={"NBD_SESSION_TOKEN": session_token,
-                           "NBD_SESSION_EPOCH": "1"})
+                extra_env=self._worker_env(1))
             wait_until_ready(self.comm, self.pm, attach_timeout)
             self.comm.set_output_callback(self._on_stream)
             self.world_size = world_size
@@ -324,6 +399,13 @@ class GatewayDaemon:
             "kind": "gateway",
             "pid": os.getpid(),
             "world_size": self.world_size,
+            # Elastic pools: the epoch fences stale frames after a
+            # resize, the generation stamps the membership view, and
+            # gc_runs keeps a recently-bumped manifest even when the
+            # pid probe races a restart (the mid-resize keep-rule).
+            "epoch": self.session_epoch,
+            "generation": self.membership.generation,
+            "membership": self.membership.describe(),
             "tenant_plane": {"host": self.tenant_host,
                              "port": self.tenant_port},
             "pool_token": self.pool_token,
@@ -354,6 +436,276 @@ class GatewayDaemon:
                 os.replace(tmp, path)
             except OSError:
                 pass
+
+    # ------------------------------------------------------------------
+    # elastic pools (ISSUE 16): resize, templates, autoscale
+
+    def _worker_env(self, epoch: int) -> dict:
+        env = {"NBD_SESSION_TOKEN": self._session_token,
+               "NBD_SESSION_EPOCH": str(epoch)}
+        if self.compile_cache_dir:
+            env["NBD_COMPILE_CACHE_DIR"] = self.compile_cache_dir
+        return env
+
+    def resize(self, target: int, *, reason: str = "manual") -> dict:
+        """Change the pool's world size: a two-phase drain barrier
+        followed by an attach-like epoch bump with a re-seeded fleet.
+
+        Phase 1 (drain): the scheduler stops promoting (queued cells
+        HOLD — they are not lost, their serve threads stay parked on
+        their tickets), the serving driver parks between ticks, and
+        we wait — bounded by ``NBD_RESIZE_DRAIN_TIMEOUT_S`` — for
+        in-flight cells to finish.  Phase 2 (flip): the old fleet is
+        torn down, the coordinator's world is reset under
+        ``epoch+1``, and a fresh fleet spawns against the SAME
+        control port with the persistent compile cache, so its first
+        cells start warm.  Anything still in flight past the drain
+        timeout is aborted with an explicit WorkerDied verdict (the
+        tenant sees an error reply, never a hang), and any frame the
+        old fleet emits afterwards is fenced by the ``ep`` header —
+        the same stale-epoch fence a durable-session reattach uses.
+
+        Stated limit: tenant worker namespaces do not survive the
+        flip (the processes die).  Tenant identity, mailboxes, queued
+        cells, and the serve journal all do; namespaces are lazily
+        re-seeded by the next cell, which the warm compile cache and
+        template replay make cheap instead of a cold compile."""
+        from ..manager import wait_until_ready
+        target = int(target)
+        if target < 1:
+            return {"status": "error",
+                    "error": f"cannot resize to {target} workers"}
+        reg = obs_metrics.registry()
+        with self._resize_lock:
+            if self._close_started:
+                return {"status": "error",
+                        "error": "gateway is shutting down"}
+            if target == self.world_size:
+                return {"status": "noop",
+                        "world_size": self.world_size,
+                        "epoch": self.session_epoch}
+            new_epoch = self.session_epoch + 1
+            t0 = time.monotonic()
+            plan = self.membership.begin_resize(
+                target, new_epoch, reason=reason, now=time.time())
+            self.flight.record("resize_begin", **plan)
+            self._write_manifest()   # publish the DRAINING view early
+            # Phase 1: drain barrier.
+            self.comm.scheduler.pause(f"resize:{reason}")
+            mgr = self._serve_mgr
+            if mgr is not None:
+                mgr.pause(timeout=30.0)
+            deadline = time.monotonic() + knobs.get_float(
+                "NBD_RESIZE_DRAIN_TIMEOUT_S", 120.0)
+            drained = False
+            while time.monotonic() < deadline:
+                if self.comm.scheduler.active_count() == 0:
+                    drained = True
+                    break
+                if self._closed.wait(0.25):
+                    break
+            drain_s = time.monotonic() - t0
+            self.flight.record("resize_drained", drained=drained,
+                               drain_s=round(drain_s, 3))
+            # Phase 2: flip the fleet under the new epoch.
+            wd, self._watchdog = self._watchdog, None
+            if wd is not None:
+                try:
+                    # A draining fleet must never be blamed as hung.
+                    wd.stop()
+                except Exception:
+                    pass
+            try:
+                self.pm.quiesce()
+                try:
+                    self.comm.post(self.comm.connected_ranks(),
+                                   "shutdown")
+                    time.sleep(0.3)
+                except Exception:
+                    pass
+                self.pm.shutdown()
+                # Let the old sockets' disconnect events finish
+                # draining before the world resets, so a stale EOF
+                # can't mark a NEW rank dead.
+                time.sleep(0.5)
+                self.comm.reset_world(target, new_epoch)
+                self.pm.start_workers(
+                    target, self.comm.port, backend=self._backend,
+                    extra_env=self._worker_env(new_epoch))
+                wait_until_ready(self.comm, self.pm,
+                                 self._attach_timeout)
+            except Exception as e:
+                # The old fleet is gone and the new one failed: this
+                # pool is down, not half-up.  Leave membership in its
+                # draining state (status shows the stuck transition),
+                # resume the scheduler so queued work fails loudly
+                # instead of waiting forever, and report.
+                reg.counter("nbd_pool_resizes_total",
+                            "pool resizes by outcome",
+                            {"outcome": "failed"}).inc()
+                self.flight.record("resize_failed", target=target,
+                                   error=f"{type(e).__name__}: {e}")
+                self.comm.scheduler.resume()
+                return {"status": "error",
+                        "error": f"resize to {target} failed mid-"
+                                 f"flip: {type(e).__name__}: {e} — "
+                                 f"the pool needs a restart"}
+            self.session_epoch = new_epoch
+            self.world_size = target
+            gen = self.membership.complete_resize(target, new_epoch,
+                                                  now=time.time())
+            # Republish both manifests BEFORE resuming: a gc or a
+            # reattach racing the flip must see the new epoch.
+            try:
+                session_mod.write_manifest(
+                    self.run_dir, session_mod.make_manifest(
+                        world_size=target, control_host="127.0.0.1",
+                        control_port=self.comm.port,
+                        token=self._session_token, epoch=new_epoch,
+                        pids={r: p.pid
+                              for r, p in self.pm.processes.items()},
+                        backend=self.pm.backend,
+                        dist_port=self.pm.dist_port))
+            except OSError:
+                pass
+            self._write_manifest()
+            if wd is not None and knobs.get_bool("NBD_HANG", True):
+                try:
+                    from ..resilience.watchdog import (HangPolicy,
+                                                       HangWatchdog)
+                    self._watchdog = HangWatchdog(
+                        HangPolicy.from_env_lenient())
+                    self._watchdog.attach(self.comm, self.pm)
+                except Exception:
+                    self._watchdog = None
+            # Resume the scheduler BEFORE template replay and the
+            # serving re-seed: both run ordinary ``execute`` cells,
+            # which admission would otherwise queue against the still-
+            # paused scheduler — a self-inflicted drain barrier that
+            # stalls the resize for the cells' full timeout.  The
+            # serving driver itself stays parked (its own pause flag)
+            # until resume_after_resize below, so no decode tick can
+            # race the re-seed.
+            promoted = self.comm.scheduler.resume()
+            self._replay_templates()
+            if mgr is not None:
+                mgr.resume_after_resize(target)
+            wall_s = time.monotonic() - t0
+            a = self._autoscaler
+            if a is not None:
+                a.note_resized(time.time())
+            reg.counter("nbd_pool_resizes_total",
+                        "pool resizes by outcome",
+                        {"outcome": "grown" if target
+                         > plan["from_world"] else "shrunk"}).inc()
+            self.flight.record(
+                "resize_done", world_size=target, epoch=new_epoch,
+                generation=gen, drained=drained,
+                drain_s=round(drain_s, 3), wall_s=round(wall_s, 3),
+                promoted=promoted, reason=reason)
+            return {"status": "resized", "world_size": target,
+                    "epoch": new_epoch, "generation": gen,
+                    "drained": drained, "drain_s": round(drain_s, 3),
+                    "wall_s": round(wall_s, 3)}
+
+    def _replay_templates(self) -> None:
+        """Re-run every registered template cell on the fresh fleet so
+        resized-in workers' first real cell finds a warm namespace (and
+        the compile cache primed).  Failures are recorded, not raised —
+        a broken template must not fail the resize."""
+        with self._lock:
+            templates = dict(self._templates)
+        for name, code in templates.items():
+            try:
+                ranks = list(range(self.world_size))
+                self.comm.send_to_ranks(
+                    ranks, "execute",
+                    {"code": code, "target_ranks": ranks},
+                    tenant=f"_tpl_{name}", timeout=600.0)
+                self.flight.record("template_replayed", template=name)
+            except Exception as e:
+                self.flight.record("template_replay_failed",
+                                   template=name,
+                                   error=f"{type(e).__name__}: {e}")
+
+    def run_template(self, name: str, code: str) -> dict:
+        """Register + run a template cell on all live ranks now."""
+        with self._lock:
+            self._templates[name] = code
+        try:
+            live = sorted(set(range(self.world_size))
+                          - self.comm.dead_ranks())
+            resps = self.comm.send_to_ranks(
+                live, "execute", {"code": code, "target_ranks": live},
+                tenant=f"_tpl_{name}", timeout=600.0)
+            errs = {str(r): (m.data or {}).get("error")
+                    for r, m in resps.items()
+                    if (m.data or {}).get("error")}
+            self.flight.record("template_stored", template=name,
+                               errors=len(errs))
+            if errs:
+                return {"status": "error", "template": name,
+                        "errors": errs}
+            return {"status": "ok", "template": name, "ranks": live}
+        except Exception as e:
+            return {"status": "error", "template": name,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def start_autoscale(self, policy=None) -> None:
+        """Arm the pressure-driven autoscaler (``--autoscale min:max``
+        / ``%dist_pool start --autoscale``)."""
+        from ..resilience.autoscaler import (AutoscalePolicy,
+                                             PoolAutoscaler)
+        self._autoscaler = PoolAutoscaler(policy
+                                          or AutoscalePolicy.from_env())
+        self.flight.record("autoscale_armed",
+                           policy=self._autoscaler.policy.describe())
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, name="nbd-gw-autoscale",
+            daemon=True)
+        self._autoscale_thread.start()
+
+    def _autoscale_loop(self) -> None:
+        a = self._autoscaler
+        while not self._autoscale_stop.wait(a.policy.interval_s):
+            if self._close_started:
+                return
+            try:
+                sched = self.comm.scheduler.snapshot()
+                backlog = 0
+                mgr = self._serve_mgr
+                if mgr is not None:
+                    d = mgr.describe()
+                    backlog = (int(d.get("pending") or 0)
+                               + int(d.get("decoding") or 0))
+                summ = self.comm.lat.summary()
+                p95_ms = ((summ.get("stages") or {}).get("queue")
+                          or {}).get("p95", 0)
+                decision = a.observe(
+                    time.time(), world_size=self.world_size,
+                    queued=int(sched.get("queued") or 0),
+                    active=int(sched.get("active") or 0),
+                    backlog=backlog,
+                    queue_p95_s=float(p95_ms) / 1000.0)
+                if decision is None:
+                    continue
+                self.flight.record("autoscale_decision",
+                                   action=decision.action,
+                                   target=decision.target,
+                                   reason=decision.reason)
+                obs_metrics.registry().counter(
+                    "nbd_autoscale_decisions_total",
+                    "autoscaler grow/shrink decisions",
+                    {"action": decision.action}).inc()
+                self.resize(decision.target,
+                            reason=f"autoscale: {decision.reason}")
+                # resize() already ran note_resized on success; run it
+                # here too so a FAILED resize still opens the cooldown
+                # instead of retrying a wedged flip at poll frequency.
+                a.note_resized(time.time())
+            except Exception as e:
+                self.flight.record("autoscale_error",
+                                   error=f"{type(e).__name__}: {e}")
 
     # ------------------------------------------------------------------
     # tenant plane (listener IO thread — keep fast, never block)
@@ -487,6 +839,158 @@ class GatewayDaemon:
             # the very thread running this callback.
             threading.Thread(target=self.close,
                              name="nbd-gw-stop", daemon=True).start()
+        elif mt == "pool_resize":
+            data = msg.data or {}
+            if data.get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            try:
+                target = int(data.get("workers"))
+            except (TypeError, ValueError):
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool_resize needs workers: int"}))
+                return
+            reason = str(data.get("reason") or "manual")
+
+            def _do_resize():
+                try:
+                    out = self.resize(target, reason=reason)
+                except Exception as e:
+                    out = {"status": "error",
+                           "error": f"{type(e).__name__}: {e}"}
+                self._send_to_client(client_id, msg.reply(data=out))
+
+            # Off the listener thread: a resize blocks for the whole
+            # drain + respawn (minutes) and the listener must keep
+            # serving other tenants' frames meanwhile.
+            threading.Thread(target=_do_resize, name="nbd-gw-resize",
+                             daemon=True).start()
+        elif mt == "pool_template":
+            data = msg.data or {}
+            if data.get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            code = data.get("code")
+            if not isinstance(code, str) or not code.strip():
+                with self._lock:
+                    names = sorted(self._templates)
+                self._send_to_client(client_id, msg.reply(
+                    data={"status": "ok", "templates": names}))
+                return
+            tpl = str(data.get("name") or "default")
+
+            def _do_template():
+                self._send_to_client(client_id, msg.reply(
+                    data=self.run_template(tpl, code)))
+
+            threading.Thread(target=_do_template,
+                             name="nbd-gw-template",
+                             daemon=True).start()
+        elif mt == "tenant_export":
+            data = msg.data or {}
+            if data.get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            name = str(data.get("tenant") or "")
+            snap = self.registry.export_tenant(name)
+            if snap is None:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": f"no tenant {name!r} in this "
+                                   "pool"}))
+                return
+            # The tenant's serving history rides along: its lines are
+            # filtered out of every serving journal under the run dir
+            # (a serving plane's journal interleaves all submitters),
+            # and the destination's serving plane re-admits the
+            # unfinished ones.
+            from .serving import export_tenant_journal
+            journal = export_tenant_journal(self.run_dir, name)
+            if journal:
+                snap["serve_journal"] = journal
+            self.flight.record("tenant_exported", tenant=name,
+                               parked=len(snap.get("parked") or {}))
+            self._send_to_client(client_id, msg.reply(
+                data={"status": "ok", "snapshot": snap}))
+        elif mt == "tenant_import":
+            data = msg.data or {}
+            if data.get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            snap = data.get("snapshot")
+            if not isinstance(snap, dict):
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "tenant_import needs a snapshot"}))
+                return
+            t, why = self.registry.import_tenant(snap)
+            if t is None:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": f"tenant_import refused: {why}"}))
+                return
+            from ..messaging.codec import Message
+            with self._lock:
+                for mid, d in sorted(
+                        (snap.get("parked") or {}).items()):
+                    # park() refreshes an existing msg_id in place, so
+                    # a router retry re-importing the same snapshot
+                    # converges instead of duplicating.
+                    t.mailbox.park(mid, Message(
+                        msg_type="response", msg_id=mid, data=d))
+            journal = snap.get("serve_journal")
+            if isinstance(journal, str) and journal:
+                from .serving import migrated_journal_path
+                jp = migrated_journal_path(self.run_dir, t.name)
+                # Staged, not live: this pool's serving plane adopts
+                # the stash (re-journal + re-admit) at its next
+                # start.  Write-if-absent keeps the import idempotent:
+                # a router retry must not clobber a stash the serving
+                # plane may be mid-adoption on.
+                if not os.path.exists(jp):
+                    try:
+                        with open(jp, "w") as f:
+                            f.write(journal)
+                    except OSError:
+                        pass
+            self.flight.record("tenant_imported", tenant=t.name,
+                               epoch=t.epoch,
+                               parked=len(snap.get("parked") or {}))
+            obs_metrics.registry().counter(
+                "nbd_tenant_migrations_total",
+                "tenant migrations by direction",
+                {"direction": "in"}).inc()
+            self._write_manifest()
+            self._send_to_client(client_id, msg.reply(
+                data={"status": "imported", "tenant": t.name,
+                      "epoch": t.epoch,
+                      "parked": len(snap.get("parked") or {})}))
+        elif mt == "tenant_release":
+            data = msg.data or {}
+            if data.get("token") != self.pool_token:
+                self._send_to_client(client_id, msg.reply(
+                    data={"error": "pool token mismatch"}))
+                return
+            name = str(data.get("tenant") or "")
+            ok = self.registry.release(name,
+                                       force=bool(data.get("force")))
+            if ok:
+                self.comm.scheduler.forget_tenant(name)
+                obs_metrics.registry().remove_label_series("tenant",
+                                                           name)
+                obs_metrics.registry().counter(
+                    "nbd_tenant_migrations_total",
+                    "tenant migrations by direction",
+                    {"direction": "out"}).inc()
+                self.flight.record("tenant_released", tenant=name)
+                self._write_manifest()
+            self._send_to_client(client_id, msg.reply(
+                data={"status": "released" if ok else "error",
+                      **({} if ok else
+                         {"error": f"tenant {name!r} not released "
+                                   "(unknown, or attached without "
+                                   "force)"})}))
         else:
             self._send_to_client(client_id, msg.reply(
                 data={"error": f"unknown tenant-plane request "
@@ -1126,8 +1630,13 @@ class GatewayDaemon:
         wd = None
         if self._watchdog is not None:
             wd = [dict(v) for v in self._watchdog.last_verdicts]
+        a = self._autoscaler
         out = {"status": "ok", "run_dir": self.run_dir,
                "pid": os.getpid(), "world_size": self.world_size,
+               "epoch": self.session_epoch,
+               "membership": self.membership.describe(),
+               "autoscale": (a.policy.describe()
+                             if a is not None else None),
                "scheduler": sched,
                "tenants": self.registry.describe(),
                "ranks": ranks, "hang_verdicts": wd,
@@ -1153,6 +1662,7 @@ class GatewayDaemon:
             self._closed.wait(timeout=30.0)
             return
         self.flight.record("gateway_stop")
+        self._autoscale_stop.set()
         mgr = self._serve_mgr
         if mgr is not None:
             # Before the fleet teardown: the driver thread must stop
@@ -1245,7 +1755,25 @@ def main(argv: list[str] | None = None) -> int:
                         "NBD_METRICS_PORT; 0 = off; negative = bind "
                         "an ephemeral port, read it back from the "
                         "manifest's metrics block)")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="arm the pressure-driven autoscaler with this "
+                        "worker band (thresholds from the "
+                        "NBD_AUTOSCALE_* knobs); the pool grows and "
+                        "shrinks itself via drain-barrier resizes")
     args = p.parse_args(argv)
+
+    autoscale_policy = None
+    if args.autoscale:
+        from ..resilience.autoscaler import AutoscalePolicy
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            autoscale_policy = AutoscalePolicy.from_env()
+            autoscale_policy.min_workers = max(1, int(lo))
+            autoscale_policy.max_workers = max(
+                autoscale_policy.min_workers, int(hi or lo))
+        except ValueError:
+            p.error(f"--autoscale wants MIN:MAX, got "
+                    f"{args.autoscale!r}")
 
     if args.run_dir:
         os.environ["NBD_RUN_DIR"] = args.run_dir
@@ -1289,6 +1817,8 @@ def main(argv: list[str] | None = None) -> int:
             request_timeout=args.request_timeout,
             attach_timeout=args.attach_timeout,
             metrics_port=args.metrics_port)
+        if autoscale_policy is not None:
+            gw.start_autoscale(autoscale_policy)
         print(f"NBD_GATEWAY_READY run_dir={gw.run_dir} "
               f"port={gw.tenant_port} world={gw.world_size}"
               + (f" metrics={gw._metrics_httpd.port}"

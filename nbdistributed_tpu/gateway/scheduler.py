@@ -198,6 +198,12 @@ class Scheduler:
         # Submissions held back by the effects gate while slots were
         # free (the "serialized: …" verdicts).
         self.effects_serialized_total = 0
+        # Drain barrier (ISSUE 16): while paused, nothing is granted —
+        # submits queue with an explicit verdict and promotion stops —
+        # so "active == 0" eventually means the mesh is DRAINED and a
+        # resize may bump the epoch.  Holds the pause reason, None
+        # when running.
+        self._paused: str | None = None
 
     # ------------------------------------------------------------------
     # `_locked` suffix = caller holds self._lock (self-lint-enforced).
@@ -279,8 +285,10 @@ class Scheduler:
                                   t.seq))
 
     def _promote_locked(self) -> list[Ticket]:
-        # Fill free slots from the queue.
+        # Fill free slots from the queue (never while draining).
         promoted = []
+        if self._paused is not None:
+            return promoted
         while self._queue and self._slots_free_locked():
             t = self._pick_next_locked()
             if t is None:
@@ -333,7 +341,8 @@ class Scheduler:
                 t.event.set()
                 return t
             serialized = None
-            if self._slots_free_locked() and not self._queue:
+            if (self._paused is None and self._slots_free_locked()
+                    and not self._queue):
                 if self._effects_ok_locked(t):
                     self._grant_locked(t)
                     t.verdict = dict(_DISPATCH)
@@ -367,6 +376,11 @@ class Scheduler:
                          "position": self._queue.index(t) + 1}
             if serialized:
                 t.verdict["reason"] = serialized
+            if self._paused is not None:
+                # Not the effects "reason" key: the daemon counts that
+                # as proof-gated serialization; a drain hold is its own
+                # story.
+                t.verdict["paused"] = self._paused
             if victims:
                 t.verdict["victims"] = victims
             # A compatible cell may still fit a free slot even though
@@ -419,6 +433,33 @@ class Scheduler:
                     return True
         return False
 
+    def pause(self, reason: str = "drain") -> None:
+        """Arm the drain barrier: stop granting slots.  In-flight
+        cells keep their slots and complete normally; new submits
+        queue with a ``"paused"``-annotated verdict.  Idempotent —
+        the latest reason wins."""
+        with self._lock:
+            self._paused = str(reason)
+
+    def resume(self) -> list[Ticket]:
+        """Drop the drain barrier and promote everything the pause
+        held back.  Returns the promoted tickets (events already
+        fired), mirroring :meth:`complete`."""
+        with self._lock:
+            self._paused = None
+            return self._promote_locked()
+
+    @property
+    def paused(self) -> str | None:
+        with self._lock:
+            return self._paused
+
+    def active_count(self) -> int:
+        """In-flight cells holding mesh slots — the drain barrier's
+        "is the mesh quiet yet" probe."""
+        with self._lock:
+            return len(self._active)
+
     def tenant_idle(self, tenant: str) -> bool:
         """True when this tenant has nothing queued and nothing
         active — the gateway may safely forget it."""
@@ -455,6 +496,7 @@ class Scheduler:
                 "policy": self.policy.describe(),
                 "queued": len(self._queue),
                 "active": len(self._active),
+                "paused": self._paused,
                 "shed_total": self.shed_total,
                 "effects_serialized_total":
                     self.effects_serialized_total,

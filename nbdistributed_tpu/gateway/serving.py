@@ -83,9 +83,70 @@ FAILED = "failed"
 
 SERVE_JOURNAL_NAME = "serve-{tenant}.jsonl"
 
+# A migrated tenant's journal records, staged by ``tenant_import``
+# (ISSUE 16) for the destination's serving plane to adopt at its next
+# start.  Named so the export scan below re-exports an unconsumed
+# stash on a second migration hop.
+SERVE_MIGRATED_NAME = "serve-migrated-{tenant}.jsonl"
+_MIGRATED_PREFIX = "serve-migrated-"
+
 
 def journal_path(run_dir: str, tenant: str) -> str:
     return os.path.join(run_dir, SERVE_JOURNAL_NAME.format(tenant=tenant))
+
+
+def migrated_journal_path(run_dir: str, tenant: str) -> str:
+    return os.path.join(run_dir,
+                        SERVE_MIGRATED_NAME.format(tenant=tenant))
+
+
+def export_tenant_journal(run_dir: str, tenant: str, *,
+                          cap: int = 32 << 20) -> str:
+    """Every serving-journal line that belongs to ``tenant`` across
+    ALL journals under ``run_dir``, as a journal-formatted string
+    (empty when the tenant has no serving history).
+
+    A serving plane's journal is keyed by the SERVING tenant and
+    interleaves every submitter's records, so a migrating tenant's
+    lines must be filtered out of each — matching the ``accept``
+    records' ``tenant`` field, then keeping the matched rids' ``emit``
+    and ``done`` lines.  Unconsumed migrated stashes are scanned too
+    (their names share the ``serve-`` prefix), so a tenant that hops
+    pools twice before serving carries its history the whole way."""
+    out: list[str] = []
+    size = 0
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        return ""
+    for fn in names:
+        if not fn.startswith("serve-") or not fn.endswith(".jsonl"):
+            continue
+        rids: set = set()
+        try:
+            with open(os.path.join(run_dir, fn),
+                      encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue   # torn tail (death mid-write)
+                    if not isinstance(rec, dict):
+                        continue
+                    if rec.get("e") == "accept":
+                        if rec.get("tenant") != tenant:
+                            continue
+                        rids.add(rec.get("rid"))
+                    elif rec.get("rid") not in rids:
+                        continue
+                    size += len(line) + 1
+                    if size > cap:
+                        return "\n".join(out) + "\n"
+                    out.append(line)
+        except OSError:
+            continue
+    return ("\n".join(out) + "\n") if out else ""
 
 
 def merge_emission(have: int, base: int, offset: int,
@@ -336,6 +397,14 @@ class ServingManager:
         self._avoid: dict[int, float] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
+        # Drain barrier (ISSUE 16): while _pause is set the driver
+        # parks between ticks; _tick_idle is set whenever no decode
+        # tick is mid-flight, so pause() can wait for the in-flight
+        # tick to FINISH (a tick interrupted mid-step would redeliver
+        # into the new epoch and be fenced as stale).
+        self._pause = threading.Event()
+        self._tick_idle = threading.Event()
+        self._tick_idle.set()
         self._driver: threading.Thread | None = None
         self.started_ts = time.time()
         # Counters (all read under the lock for describe()).
@@ -411,10 +480,22 @@ class ServingManager:
         (the offset dedup takes it from there), and counts as a
         replay.  Over-budget admission at recovery (a smaller queue
         than the previous plane's) sheds with a delivered verdict —
-        never silently."""
+        never silently.  Migrated tenants' staged journals (ISSUE 16)
+        are adopted right after."""
         state = ServeJournal.load(self.journal.path)
-        if not state:
-            return
+        recovered = self._readmit_state(state) if state else 0
+        if recovered:
+            self._record("serve_recovered", n=recovered)
+            obs_metrics.registry().counter(
+                "nbd_serve_recovered_total",
+                "journaled requests re-entered by a successor "
+                "serving plane", {"tenant": self.tenant}).inc(recovered)
+            self._wake.set()
+        self._consume_migrated(set(state))
+
+    def _readmit_state(self, state: dict) -> int:
+        """Re-enter loaded journal state; returns how many unfinished
+        requests were re-admitted."""
         recovered = 0
         for rid, r in sorted(state.items()):
             # Keep fresh rids past every journaled one, finished or
@@ -425,6 +506,9 @@ class ServingManager:
                 n = -1
             with self._lock:
                 self._next_rid = max(self._next_rid, n + 1)
+                known = rid in self._reqs
+            if known:
+                continue
             if r["done"] is not None \
                     or len(r["tokens"]) >= r["max_new"]:
                 continue
@@ -444,13 +528,116 @@ class ServingManager:
                                    "recovery: the restarted serving "
                                    "plane's admission bounds could "
                                    "not re-admit it")
-        if recovered:
-            self._record("serve_recovered", n=recovered)
+        return recovered
+
+    def _consume_migrated(self, own_rids: set) -> None:
+        """Adopt migrated tenants' staged journals (written by
+        ``tenant_import``): re-journal their records into OUR journal
+        first — durability must transfer before the stash is deleted —
+        then re-admit the unfinished ones and remove the stash.  A
+        crash between re-journal and unlink leaves a stash whose rids
+        are already in our journal; the collision skip makes the next
+        consume a no-op, so adoption happens at most once.  Stated
+        limit: rids are per-pool monotonic (``r{n}``), so a migrated
+        rid the destination ALREADY used names a different request —
+        those are skipped and flight-recorded, never cross-wired."""
+        try:
+            names = sorted(os.listdir(self.run_dir))
+        except OSError:
+            return
+        adopted = 0
+        for fn in names:
+            if not fn.startswith(_MIGRATED_PREFIX) \
+                    or not fn.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.run_dir, fn)
+            state = ServeJournal.load(path)
+            fresh = {rid: r for rid, r in state.items()
+                     if rid not in own_rids}
+            if len(fresh) < len(state):
+                self._record("serve_migrated_rid_collision",
+                             stash=fn, n=len(state) - len(fresh))
+            for rid, r in sorted(fresh.items()):
+                self.journal.accept(rid, r["tenant"] or "unknown",
+                                    r["prompt"], r["max_new"],
+                                    r["prio"])
+                if r["tokens"]:
+                    self.journal.emit(rid, 0, r["tokens"])
+                if r["done"] is not None:
+                    self.journal.done(rid, r["done"])
+                own_rids.add(rid)
+            adopted += self._readmit_state(fresh)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        if adopted:
+            self._record("serve_migrated_adopted", n=adopted)
             obs_metrics.registry().counter(
-                "nbd_serve_recovered_total",
-                "journaled requests re-entered by a successor "
-                "serving plane", {"tenant": self.tenant}).inc(recovered)
+                "nbd_serve_migrated_total",
+                "migrated journal requests adopted by a destination "
+                "serving plane", {"tenant": self.tenant}).inc(adopted)
             self._wake.set()
+
+    def pause(self, *, timeout: float = 30.0) -> bool:
+        """Arm the serving half of the resize drain barrier: no new
+        decode tick starts, and this call returns once the in-flight
+        tick (if any) has finished — True when the driver is known
+        parked, False on timeout (the resize proceeds anyway; a tick
+        caught mid-step redelivers into the new epoch and is fenced
+        by the ``ep`` header like any stale frame).  Submits keep
+        being ACCEPTED and journaled throughout — accepted requests
+        are never lost to a resize, they just wait for the new
+        fleet."""
+        self._pause.set()
+        self._wake.set()
+        if self._driver is None or not self._driver.is_alive():
+            return True
+        ok = self._tick_idle.wait(timeout)
+        self._record("serve_paused", drained=ok)
+        return ok
+
+    def resume_after_resize(self, world_size: int) -> None:
+        """The fleet was resized (new epoch, new world): retarget the
+        driver.  Everything placed on the old fleet is un-placed and
+        marked for journal replay — the re-admission path that already
+        carries requests across rank death and gateway restarts — and
+        the model spec is re-run on the new fleet so serve_open finds
+        its params (the resized-in workers' namespaces start empty;
+        the persistent compile cache is what makes this re-seed warm
+        instead of a cold compile)."""
+        with self._lock:
+            self.world_size = int(world_size)
+            self._open_rank = None
+            self._avoid.clear()
+            for r in self._reqs.values():
+                if r.state == ACCEPTED and r.placed:
+                    r.placed = False
+                    r.replay = True
+        if self.spec:
+            live = self._live_ranks()
+            if live:
+                try:
+                    resps = self.comm.send_to_ranks(
+                        live, "execute",
+                        {"code": self.spec, "target_ranks": live},
+                        tenant=self.tenant, timeout=600.0)
+                    errs = {r: (m.data or {}).get("error")
+                            for r, m in resps.items()
+                            if (m.data or {}).get("error")}
+                    if errs:
+                        self._record("serve_reseed_error", errors={
+                            str(r): str(e)[:200]
+                            for r, e in errs.items()})
+                except Exception as e:
+                    # The driver's serve_open path will keep retrying
+                    # (and avoiding failed ranks); the journal holds
+                    # every accepted request meanwhile.
+                    self._record("serve_reseed_error",
+                                 error=f"{type(e).__name__}: {e}")
+        self._pause.clear()
+        self._wake.set()
+        self._record("serve_resized", world_size=world_size)
 
     def stop(self, *, close_workers: bool = True) -> None:
         self._stop.set()
@@ -722,14 +909,23 @@ class ServingManager:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            if self._pause.is_set():
+                # Drained: no tick starts until resume_after_resize.
+                self._wake.wait(timeout=0.2)
+                self._wake.clear()
+                continue
             with self._lock:
                 work = self._has_work_locked()
             if not work:
                 self._wake.wait(timeout=1.0)
                 self._wake.clear()
                 continue
+            self._tick_idle.clear()
             try:
-                self._tick()
+                try:
+                    self._tick()
+                finally:
+                    self._tick_idle.set()
             except _RankLost:
                 self._on_rank_lost()
             except Exception as e:  # never kill the driver

@@ -203,6 +203,82 @@ class TenantRegistry:
             del self._tenants[name]
             return True
 
+    # ------------------------------------------------------------------
+    # migration (ISSUE 16): export/import/release move a tenant's
+    # durable identity — token, epoch, priority, parked results —
+    # between pools.  Export is non-destructive and import is
+    # idempotent, so the sequence survives a router (or source pool)
+    # death at any point: re-running it converges.
+
+    def export_tenant(self, name: str) -> dict | None:
+        """Snapshot a tenant's durable state for migration.  Parked
+        replies travel as ``{msg_id: data}`` — the same shape a
+        mailbox drain sends — and stay parked HERE until
+        :meth:`release`; exactly-once holds because only one pool's
+        mailbox is ever drained by the kernel."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                return None
+            return {"tenant": t.name, "token": t.token,
+                    "epoch": t.epoch, "priority": t.priority,
+                    "reattaches": t.reattaches,
+                    "parked": {mid: getattr(r, "data", None)
+                               for mid, r in
+                               t.mailbox.peek_all().items()}}
+
+    def import_tenant(self, snap: dict) -> tuple[Tenant | None, str]:
+        """Adopt an exported tenant.  Idempotent: a re-import of the
+        same snapshot (router retry after a crash) merges instead of
+        failing — epochs take the max, so the fence never regresses.
+        Returns ``(tenant, why)``; tenant is None on refusal."""
+        name = str(snap.get("tenant") or "").strip()
+        token = snap.get("token")
+        if not name or not token:
+            return None, "snapshot missing tenant name or token"
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                if len(self._tenants) >= self.max_tenants:
+                    return None, (f"pool is at max_tenants="
+                                  f"{self.max_tenants}")
+                try:
+                    prio = int(snap.get("priority") or 0)
+                except (TypeError, ValueError):
+                    prio = 0
+                t = Tenant(name, str(token), priority=prio)
+                self._tenants[name] = t
+            elif t.token != token:
+                return None, ("tenant name in use with a different "
+                              "session token")
+            try:
+                t.epoch = max(t.epoch, int(snap.get("epoch") or 1))
+            except (TypeError, ValueError):
+                pass
+            return t, "imported"
+
+    def release(self, name: str, *, force: bool = False) -> bool:
+        """Forget a tenant whose export was imported elsewhere.
+        Unlike :meth:`evict`, parked results do NOT pin the slot —
+        the destination pool owns them now.  A live connection does,
+        unless ``force``: then the epoch is bumped first so the old
+        kernel's frames fence with ``stale_epoch`` instead of
+        resolving against a ghost."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                return False
+            if t.attached:
+                if not force:
+                    return False
+                t.epoch += 1        # fence the still-live connection
+                t.client_id = None
+            self._by_client = {c: n
+                               for c, n in self._by_client.items()
+                               if n != name}
+            del self._tenants[name]
+            return True
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._tenants)

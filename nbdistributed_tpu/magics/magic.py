@@ -1142,9 +1142,10 @@ class DistributedMagics(Magics):
 
     @magic_arguments()
     @argument("command", nargs="?", default="status",
-              choices=["start", "status", "stop"])
+              choices=["start", "status", "stop", "resize", "migrate",
+                       "template"])
     @argument("-n", "--workers", type=int, default=2,
-              help="pool world size (start)")
+              help="pool world size (start / resize target)")
     @argument("--backend", default="auto",
               choices=["auto", "cpu", "tpu"])
     @argument("--run-dir", default=None,
@@ -1166,6 +1167,23 @@ class DistributedMagics(Magics):
                    "0 = off)")
     @argument("--start-timeout", type=float, default=240.0,
               help="seconds to wait for the daemon's readiness line")
+    @argument("--autoscale", default=None, metavar="MIN:MAX",
+              help="start: arm the pressure-driven autoscaler with "
+                   "this worker band (thresholds from the "
+                   "NBD_AUTOSCALE_* knobs)")
+    @argument("--tenant", default=None,
+              help="migrate: the tenant to move")
+    @argument("--to", dest="dest", default=None,
+              help="migrate: destination pool run dir (default: the "
+                   "least-loaded OTHER live pool)")
+    @argument("--force", action="store_true",
+              help="migrate: move an ATTACHED tenant too, fencing "
+                   "its live connection")
+    @argument("--name", default="default",
+              help="template: template name")
+    @argument("--file", dest="tpl_file", default=None,
+              help="template: file whose contents become the "
+                   "warm-start template cell (omit to list)")
     @line_magic
     def dist_pool(self, line):
         """Gateway pool admin: ``%dist_pool start -n 4`` spawns a
@@ -1173,8 +1191,14 @@ class DistributedMagics(Magics):
         kernels share (``%dist_attach --tenant NAME``);
         ``status`` shows the scheduler queue, per-tenant counters, and
         tenant-attributed per-rank busy state; ``stop`` shuts the
-        daemon and its workers down.  Scheduling/admission defaults
-        come from the ``NBD_POOL_*``/``NBD_TENANT_*`` knobs."""
+        daemon and its workers down.  Elastic pools (ISSUE 16):
+        ``resize -n N`` changes the world size via a drain-barrier
+        epoch bump, ``start --autoscale MIN:MAX`` arms the
+        pressure-driven autoscaler, ``migrate --tenant NAME [--to
+        RUN_DIR]`` moves a tenant to another pool, and ``template
+        --file CELL.py`` registers a warm-start cell re-run on every
+        resized fleet.  Scheduling/admission defaults come from the
+        ``NBD_POOL_*``/``NBD_TENANT_*`` knobs."""
         import subprocess
         import sys as _sys
 
@@ -1199,7 +1223,8 @@ class DistributedMagics(Magics):
                             ("--queue-depth", args.queue_depth),
                             ("--tenant-inflight",
                              args.tenant_inflight),
-                            ("--metrics-port", args.metrics_port)):
+                            ("--metrics-port", args.metrics_port),
+                            ("--autoscale", args.autoscale)):
                 if v is not None:
                     cmd += [flag, str(v)]
             if args.effects:
@@ -1286,6 +1311,104 @@ class DistributedMagics(Magics):
                 DistributedMagics._drop_tenant_state()
             print(f"🛑 pool {d}: {res.get('status', res)}")
             return
+        if args.command == "resize":
+            from ..gateway.client import pool_resize
+            print(f"🔧 resizing pool {d} → {args.workers} workers "
+                  f"(drain barrier + epoch bump — in-flight cells "
+                  f"finish first)...")
+            try:
+                res = pool_resize(plane.get("host") or "127.0.0.1",
+                                  int(plane.get("port") or 0),
+                                  manifest.get("pool_token"),
+                                  args.workers)
+            except Exception as e:
+                print(f"❌ pool resize failed: {e}")
+                return
+            if res.get("status") == "resized":
+                print(f"✅ resized: {res.get('world_size')} ranks · "
+                      f"epoch {res.get('epoch')} · generation "
+                      f"{res.get('generation')} · drain "
+                      f"{res.get('drain_s')}s"
+                      + ("" if res.get("drained") else
+                         " (drain TIMED OUT — in-flight cells were "
+                         "aborted with explicit verdicts)")
+                      + f" · total {res.get('wall_s')}s")
+            elif res.get("status") == "noop":
+                print(f"ℹ pool is already {res.get('world_size')} "
+                      f"ranks")
+            else:
+                print(f"❌ {res.get('error') or res}")
+            return
+        if args.command == "migrate":
+            if not args.tenant:
+                print("❌ migrate needs --tenant NAME")
+                return
+            from ..gateway.router import (MigrationError,
+                                          PoolDirectory,
+                                          migrate_tenant)
+            dest = args.dest
+            if not dest:
+                placed = PoolDirectory().place(exclude=d)
+                if placed is None:
+                    print("❌ no OTHER live pool to migrate to "
+                          "(start one, or name it with --to)")
+                    return
+                dest = placed[0]
+            print(f"🚚 migrating tenant {args.tenant!r}: {d} → "
+                  f"{dest} ...")
+            try:
+                res = migrate_tenant(args.tenant, d, dest,
+                                     force=args.force)
+            except MigrationError as e:
+                print(f"❌ migration refused: {e}")
+                return
+            except Exception as e:
+                print(f"❌ migration failed: {type(e).__name__}: {e}")
+                return
+            print(f"✅ migrated to {dest} (epoch "
+                  f"{res.get('epoch')}) · parked results moved: "
+                  f"{res.get('parked_moved')} · serve journal: "
+                  f"{'yes' if res.get('journal_moved') else 'no'}"
+                  + ("" if res.get("src_alive") else
+                     " · source pool was DEAD — recovered from its "
+                     "manifest + journal")
+                  + ("" if res.get("released") else
+                     " · ⚠ source copy NOT released (re-run the "
+                     "migration once the source answers)"))
+            print(f"   reattach kernels with: %dist_attach --tenant "
+                  f"{args.tenant} {dest}")
+            return
+        if args.command == "template":
+            from ..gateway.client import pool_template
+            code = None
+            if args.tpl_file:
+                try:
+                    with open(args.tpl_file) as f:
+                        code = f.read()
+                except OSError as e:
+                    print(f"❌ cannot read {args.tpl_file}: {e}")
+                    return
+            try:
+                res = pool_template(plane.get("host") or "127.0.0.1",
+                                    int(plane.get("port") or 0),
+                                    manifest.get("pool_token"),
+                                    code, name=args.name)
+            except Exception as e:
+                print(f"❌ pool template failed: {e}")
+                return
+            if code is None:
+                tpls = res.get("templates") or []
+                print(f"📋 templates: {', '.join(tpls) if tpls else '(none)'}"
+                      f" — register one with --file CELL.py; each "
+                      f"re-runs on every resized fleet so new workers "
+                      f"start warm")
+            elif res.get("status") == "ok":
+                print(f"✅ template {args.name!r} ran on ranks "
+                      f"{res.get('ranks')} — it will re-run after "
+                      f"every resize")
+            else:
+                print(f"❌ {res.get('error') or res.get('errors') or res}")
+            return
         # status — the attached tenant connection only answers for
         # ITS pool: `status --run-dir X` while attached to pool Y
         # must probe X, not render Y's queue under X's run dir
@@ -1311,13 +1434,27 @@ class DistributedMagics(Magics):
     def _render_pool_status(self, st: dict, run_dir) -> None:
         sched = st.get("scheduler") or {}
         pol = sched.get("policy") or {}
+        mem = st.get("membership") or {}
+        epoch_bit = (f" · epoch {st.get('epoch')} · gen "
+                     f"{mem.get('generation')}"
+                     if st.get("epoch") is not None else "")
         print(f"🏊 pool {run_dir} · pid {st.get('pid')} · "
-              f"{st.get('world_size')} ranks · sched "
+              f"{st.get('world_size')} ranks{epoch_bit} · sched "
               f"{pol.get('mode')} (slots {pol.get('mesh_slots')}, "
               f"queue {sched.get('queued', 0)}/"
               f"{pol.get('queue_depth') or '∞'}, active "
               f"{sched.get('active', 0)}, shed "
               f"{sched.get('shed_total', 0)} total)")
+        if st.get("autoscale"):
+            print(f"⚖ autoscale armed: {st['autoscale']}")
+        trans = mem.get("transition")
+        if trans:
+            print(f"⚠ resize in flight: {trans.get('from_world')} → "
+                  f"{trans.get('to_world')} ranks (epoch "
+                  f"{trans.get('from_epoch')} → "
+                  f"{trans.get('to_epoch')}, reason: "
+                  f"{trans.get('reason')}) — queued cells hold, "
+                  f"in-flight cells drain")
         lat = (st.get("latency") or {}).get("summary") or {}
         if lat.get("count"):
             e = lat.get("e2e_ms") or {}
@@ -1357,20 +1494,41 @@ class DistributedMagics(Magics):
         else:
             print("(no tenants attached yet)")
         ranks = st.get("ranks") or {}
-        busy_rows = [(r, v) for r, v in sorted(ranks.items(),
-                                               key=lambda kv:
-                                               int(kv[0]))
-                     if v.get("busy_type") or v.get("srv")]
-        for r, v in busy_rows:
+        mranks = mem.get("ranks") or {}
+        draining = {r for r, m in mranks.items()
+                    if m.get("state") == "draining"}
+        stalled: set = set()
+        for v in st.get("hang_verdicts") or ():
+            stalled.update(str(r) for r in v.get("ranks") or ())
+        # A draining rank is parked by the resize barrier ON PURPOSE —
+        # rendering it stalled would be exactly the watchdog
+        # mis-blame the drain path exists to prevent.
+        stalled -= draining
+        rows = [(r, v) for r, v in sorted(ranks.items(),
+                                          key=lambda kv:
+                                          int(kv[0]))
+                if v.get("busy_type") or v.get("srv")
+                or r in draining or r in stalled
+                or (mranks.get(r) or {}).get("join_epoch", 1) > 1]
+        for r, v in rows:
             who = (f" · tenant {v['tenant']}" if v.get("tenant")
                    else "")
-            busy = (f"⚙ {v['busy_type']} {v.get('busy_s', 0):.1f}s"
-                    if v.get("busy_type") else "idle")
+            if r in draining:
+                busy = "⚠ draining"
+            elif r in stalled:
+                busy = "⚠ stalled"
+            elif v.get("busy_type"):
+                busy = f"⚙ {v['busy_type']} {v.get('busy_s', 0):.1f}s"
+            else:
+                busy = "idle"
+            je = (mranks.get(r) or {}).get("join_epoch")
+            joined = (f" · joined ep {je}"
+                      if je is not None and je > 1 else "")
             srv = v.get("srv") or {}
             scol = (f" · 🔄 {srv.get('tps', 0)} tok/s · KV "
                     f"{srv.get('occ', 0)}/{srv.get('slots', 0)}"
                     if srv else "")
-            print(f"   rank {r}: {busy}{who}{scol}")
+            print(f"   rank {r}: {busy}{joined}{who}{scol}")
         if st.get("serving"):
             self._render_serve_status(st["serving"])
         for v in st.get("hang_verdicts") or ():

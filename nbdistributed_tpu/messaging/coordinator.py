@@ -636,6 +636,50 @@ class CommunicationManager:
         with self._lock:
             return set(self._dead)
 
+    def reset_world(self, num_workers: int, session_epoch: int) -> None:
+        """Re-seed the world for an elastic resize (ISSUE 16): the old
+        fleet is gone (drained, told to shut down, reaped), a new one
+        of ``num_workers`` ranks is about to dial this same listener
+        under ``session_epoch``.  Clears the connection/death/heartbeat
+        bookkeeping and re-arms the ready barrier so
+        ``wait_for_workers`` means the NEW fleet.  Any request still
+        pending (the drain barrier should have left none) is failed
+        loudly rather than left to hit its timeout against ranks that
+        no longer exist.
+
+        Frames from the old epoch that are still in flight need no
+        handling here: every reply carries the ``ep`` header and
+        ``_on_message`` fences ``epoch < session_epoch`` with an
+        explicit rejected-verdict counter."""
+        with self._lock:
+            self.num_workers = int(num_workers)
+            self.session_epoch = int(session_epoch)
+            self._connected.clear()
+            self._ever_connected.clear()
+            self._dead.clear()
+            self._ready.clear()
+            self._last_seen.clear()
+            self._last_ping.clear()
+            self._telemetry.clear()
+            stale = list(self._pending.items())
+            self._pending.clear()
+        self.flight.record("world_reset", num_workers=num_workers,
+                           epoch=session_epoch,
+                           aborted=[mid for mid, _ in stale])
+        for mid, p in stale:
+            failure = WorkerDied(
+                f"request {mid} aborted: the fleet was resized "
+                f"(epoch {session_epoch}) while it was pending")
+            failure.msg_id = mid
+            p.failure = failure
+            p.event.set()
+            cb = p.on_done
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------------
     # request/response
 

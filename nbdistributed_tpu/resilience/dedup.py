@@ -134,6 +134,14 @@ class ResultMailbox:
         with self._mlock:
             return list(self._box)
 
+    def peek_all(self) -> dict[str, object]:
+        """Non-destructive snapshot, oldest first.  Migration export
+        reads the parked set WITHOUT claiming it — the destructive
+        claim happens once, at the destination pool, so a migration
+        that dies between export and import loses nothing."""
+        with self._mlock:
+            return dict(self._box)
+
     def counters(self) -> dict:
         with self._mlock:
             return {"parked": self.parked, "claimed": self.claimed,

@@ -247,6 +247,30 @@ def gc_runs(root: str | None = None, *, ttl_s: float | None = None,
                 kept_why[d] = (f"live gateway daemon "
                                f"(pid {gw.get('pid')})")
                 continue
+            if gw is not None:
+                # Mid-resize/restart window (ISSUE 16): a resize is a
+                # drain + fleet restart under a bumped epoch, and a
+                # migration may be replaying this dir's journal into
+                # another pool — during both, the daemon pid probe
+                # races the restart and reads "dead".  A manifest
+                # whose epoch/heartbeat was bumped within the orphan
+                # TTL is a pool in transition, not an abandoned one.
+                gw_ts = gw.get("updated_ts") or gw.get("created_ts") \
+                    or 0.0
+                orphan_ttl = knobs.get_float("NBD_ORPHAN_TTL_S", 600.0)
+                try:
+                    recent = (now - float(gw_ts)) <= orphan_ttl
+                except (TypeError, ValueError):
+                    recent = False
+                if recent:
+                    kept.append(d)
+                    kept_why[d] = (
+                        f"gateway manifest updated "
+                        f"{now - float(gw_ts):.0f}s ago (epoch "
+                        f"{gw.get('epoch', '?')}) — resize/restart "
+                        f"window, within orphan ttl "
+                        f"{orphan_ttl:.0f}s")
+                    continue
             mpath = manifest_path(d)
             ref = mpath if os.path.exists(mpath) else d
             age = now - os.path.getmtime(ref)

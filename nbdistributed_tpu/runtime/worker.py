@@ -260,6 +260,29 @@ class DistributedWorker:
                 process_id=rank)
         import jax  # noqa: F811 — backend resolves here
         self._jax = jax
+        # Warm starts (ISSUE 16): the gateway ships a persistent
+        # per-pool XLA compilation cache dir so a resized-in worker's
+        # (or a migrated tenant's) first cell replays a compiled
+        # executable instead of paying the cold compile.  Gated: old
+        # jaxlibs without the option, or an unwritable dir, degrade
+        # to the ordinary in-memory cache.
+        cache_dir = knobs.get_str("NBD_COMPILE_CACHE_DIR") or ""
+        if cache_dir and cache_dir.strip().lower() not in (
+                "0", "off", "none"):
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir",
+                                  cache_dir)
+                # Cache every compile, however fast: the 1 B-param
+                # first-cell compile is the target, but resize tests
+                # ride tiny graphs.
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                print(f"[worker {rank}] compile cache: {cache_dir}",
+                      flush=True)
+            except Exception as e:
+                print(f"[worker {rank}] compile cache disabled "
+                      f"({type(e).__name__}: {e})", flush=True)
         n_local = jax.local_device_count()
         print(f"[worker {rank}] backend={jax.default_backend()} "
               f"local_devices={n_local} global_devices={jax.device_count()}",

@@ -148,6 +148,49 @@ _ALL = (
     _k("NBD_POOL_MAX_TENANTS", "8", "int",
        "Tenant headcount a gateway admits; later hellos are refused "
        "at admission.", "pool"),
+    # --- elastic pools (ISSUE 16) -----------------------------------------
+    _k("NBD_AUTOSCALE_MIN", "1", "int",
+       "Autoscaler band floor: the pool never shrinks below this "
+       "world size, and a world below it is grown back immediately.",
+       "elastic"),
+    _k("NBD_AUTOSCALE_MAX", "8", "int",
+       "Autoscaler band ceiling: the pool never grows past this "
+       "world size.", "elastic"),
+    _k("NBD_AUTOSCALE_INTERVAL_S", "5.0", "float",
+       "Autoscale observe cadence: how often the gateway feeds load "
+       "snapshots (queue depth, serving backlog, queue-stage p95) to "
+       "the PoolAutoscaler policy.", "elastic"),
+    _k("NBD_AUTOSCALE_UP_QUEUE", "4", "int",
+       "Scheduler queue depth above which the pool counts as under "
+       "pressure (0 disables this signal).", "elastic"),
+    _k("NBD_AUTOSCALE_UP_BACKLOG", "8", "int",
+       "Serving-plane pending-request backlog above which the pool "
+       "counts as under pressure (0 disables this signal).",
+       "elastic"),
+    _k("NBD_AUTOSCALE_UP_P95_S", "2.0", "float",
+       "Latency-observatory queue-stage p95 (seconds) above which "
+       "the pool counts as under pressure (0 disables this signal).",
+       "elastic"),
+    _k("NBD_AUTOSCALE_SUSTAIN_S", "15", "float",
+       "Seconds pressure must persist before a grow fires — a single "
+       "spike that clears resets the clock (no flapping).", "elastic"),
+    _k("NBD_AUTOSCALE_IDLE_S", "120", "float",
+       "Seconds of sustained idleness (nothing queued, active, or "
+       "pending) before a shrink fires.", "elastic"),
+    _k("NBD_AUTOSCALE_COOLDOWN_S", "60", "float",
+       "Post-resize decision blackout: no new grow/shrink decision "
+       "fires within this window of the last executed (or failed) "
+       "resize.", "elastic"),
+    _k("NBD_RESIZE_DRAIN_TIMEOUT_S", "120", "float",
+       "Resize drain-barrier bound: seconds to wait for in-flight "
+       "cells and decode ticks to finish before the resize is "
+       "aborted and the pool resumed at its old size.", "elastic"),
+    _k("NBD_COMPILE_CACHE_DIR", None, "path",
+       "Persistent XLA compilation-cache directory workers enable at "
+       "spawn (jax_compilation_cache_dir) so resized-in workers and "
+       "new tenants skip the cold compile.  The gateway daemon "
+       "defaults it to <run_dir>/xla-cache for its fleet; set 0/off "
+       "to disable entirely.", "elastic"),
     # --- serving plane (%dist_serve) --------------------------------------
     _k("NBD_SERVE_MAX_BATCH", "8", "int",
        "Default KV-slot count (continuous-batching width) of the "

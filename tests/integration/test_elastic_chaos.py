@@ -1,0 +1,393 @@
+"""Elastic pools under chaos (ISSUE 16), end to end on the CPU
+backend.
+
+The headline scenario the tentpole exists for:
+
+1. **2 -> 4 -> 2 resize under 8% frame drops, with a resized-in rank
+   SIGKILLed mid-drain.**  A tenant runs counter cells and serves
+   generation requests across both resizes while the control plane
+   drops 8% of frames in both directions; during the shrink's drain
+   barrier, a rank that only joined at epoch 2 is SIGKILLed.  Every
+   cell completes exactly once (the worker replay cache dedupes
+   same-msg-id redelivery; a per-epoch namespace counter is the
+   tripwire), every accepted serving request finishes with its EXACT
+   solo-``generate`` greedy tokens (replay across the flip is
+   bit-identical), membership advances epoch/generation per resize,
+   and the watchdog never blames a draining rank — zero hang
+   verdicts.
+2. **Chaos-safe tenant migration** between two pools sharing a runs
+   root: the live path (export -> import -> release) moves token,
+   epoch, and serve journal, and the tenant reattaches at the
+   destination with its ORIGINAL token; the dead-source path (the
+   manifest's pid fenced to a corpse, as after a SIGKILL) recovers
+   the same from what the source durably published, with the release
+   step correctly reported as impossible.
+
+Marked ``slow`` on purpose (three fleet spawns); the CI resilience
+job owns these (marker ``elastic``).
+"""
+
+import ast
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from nbdistributed_tpu.gateway import router as router_mod
+from nbdistributed_tpu.gateway.client import TenantClient
+from nbdistributed_tpu.gateway.daemon import (GatewayDaemon,
+                                              gateway_manifest_path)
+from nbdistributed_tpu.gateway.serving import migrated_journal_path
+from nbdistributed_tpu.gateway.scheduler import SchedPolicy
+from nbdistributed_tpu.observability import flightrec
+from nbdistributed_tpu.resilience.faults import FaultPlan
+
+pytestmark = [pytest.mark.integration, pytest.mark.elastic,
+              pytest.mark.gateway, pytest.mark.faults,
+              pytest.mark.slow]
+
+WORLD = 2          # starting size; the test grows to 4 and back
+
+SPEC = (
+    "import jax as _j, jax.numpy as _jn\n"
+    "from nbdistributed_tpu.models import tiny_config, init_params\n"
+    "cfg = tiny_config(dtype=_jn.float32, use_flash=False)\n"
+    "params = init_params(_j.random.PRNGKey(0), cfg)\n")
+
+PROMPTS = [[5, 9, 2], [7, 1], [3, 4, 8, 1], [11, 3],
+           [2, 2, 2, 2], [6, 13], [1, 2, 3], [9, 9]]
+MAX_NEW = 5
+
+REF_CELL = (
+    "import jax as _j, jax.numpy as _jn, numpy as _np\n"
+    "from nbdistributed_tpu.models import (tiny_config, init_params, "
+    "generate)\n"
+    "_cfg = tiny_config(dtype=_jn.float32, use_flash=False)\n"
+    "_p = init_params(_j.random.PRNGKey(0), _cfg)\n"
+    f"_prompts = {PROMPTS!r}\n"
+    f"[[int(t) for t in _np.asarray(generate(_p, _jn.asarray(pr, "
+    f"_jn.int32)[None], _cfg, {MAX_NEW}))[0][len(pr):]] "
+    "for pr in _prompts]")
+
+# Exactly-once tripwire: each run bumps a namespace counter.  Under
+# 8% drops the retry layer redelivers same-msg-id frames; a double
+# EXECUTION (not just double delivery) would overshoot the counter.
+INC_CELL = "_c = globals().get('_c', 0) + 1\n_c"
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("elasticpool"))
+    old = {k: os.environ.get(k)
+           for k in ("NBD_RUN_DIR", "NBD_RETRY_TIMEOUT_S",
+                     "NBD_RETRY_ATTEMPTS")}
+    os.environ["NBD_RUN_DIR"] = run_dir
+    # Retry layer ON: the drop phases lean on same-msg-id redelivery
+    # + the worker replay cache.
+    os.environ["NBD_RETRY_TIMEOUT_S"] = "5"
+    os.environ["NBD_RETRY_ATTEMPTS"] = "6"
+    flightrec.reset_for_tests()
+    gw = GatewayDaemon(
+        WORLD, backend="cpu",
+        policy=SchedPolicy("fair", mesh_slots=1, tenant_inflight=16,
+                           queue_depth=32),
+        request_timeout=None, attach_timeout=240.0)
+    try:
+        yield gw
+    finally:
+        gw.close()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def attach(pool, name, **kw):
+    return TenantClient(pool.tenant_host, pool.tenant_port, name,
+                        pool_token=pool.pool_token, **kw)
+
+
+def arm_drops(pool) -> None:
+    """8% frame drops in both directions: worker plans shape
+    worker->gateway, the coordinator plan shapes gateway->worker."""
+    live = sorted(set(range(pool.world_size))
+                  - pool.comm.dead_ranks())
+    pool.comm.send_to_ranks(live, "chaos", {
+        "action": "set", "spec": {"seed": 9, "drop": 0.08}},
+        timeout=60)
+    pool.comm.set_fault_plan(FaultPlan(seed=11, drop=0.08))
+
+
+def clear_drops(pool) -> None:
+    pool.comm.set_fault_plan(None)
+    try:
+        live = sorted(set(range(pool.world_size))
+                      - pool.comm.dead_ranks())
+        pool.comm.send_to_ranks(live, "chaos", {"action": "clear"},
+                                timeout=60)
+    except Exception:
+        pass
+
+
+def counter_values(client, world: int, runs: int) -> list[int]:
+    """Run INC_CELL ``runs`` times on all ranks, return the final
+    counter read from every rank."""
+    ranks = list(range(world))
+    for _ in range(runs):
+        out = client.execute(INC_CELL, target_ranks=ranks,
+                             timeout=180)
+        assert not out.get("error"), out
+    out = client.execute("_c", target_ranks=ranks, timeout=180)
+    results = out.get("results") or {}
+    assert len(results) == world, out
+    return [ast.literal_eval(results[str(r)]["output"])
+            for r in ranks]
+
+
+def wait_results(client, rids, timeout=300.0) -> dict:
+    got: dict = {}
+    deadline = time.time() + timeout
+    while len(got) < len(rids) and time.time() < deadline:
+        for rid in rids:
+            if rid in got:
+                continue
+            r = client.serve_result(rid)
+            if r.get("done"):
+                got[rid] = r
+        time.sleep(0.25)
+    return got
+
+
+# ----------------------------------------------------------------------
+
+
+def test_resize_2_4_2_chaos_exactly_once(pool):
+    t1 = attach(pool, "el1")
+    try:
+        out = t1.execute(REF_CELL, target_ranks=[0], timeout=300)
+        solo = ast.literal_eval(
+            (out.get("results") or {})["0"]["output"])
+
+        arm_drops(pool)
+        try:
+            # Epoch 1, world 2: cells run exactly once under drops.
+            assert counter_values(t1, 2, 3) == [3, 3]
+
+            t1.serve_start(SPEC, max_batch=4, max_len=48, pad_to=4,
+                           steps=2, queue_depth=32, inflight=32,
+                           timeout=600)
+            rids = [t1.serve_submit(pr, MAX_NEW)["rid"]
+                    for pr in PROMPTS[:4]]
+
+            # Grow 2 -> 4 with serving traffic in flight.  The drain
+            # barrier parks the decode loop; the flip re-seeds the
+            # spec on the new fleet and replays in-flight requests.
+            res = pool.resize(4, reason="chaos-grow")
+            assert res["status"] == "resized", res
+            assert res == {**res, "world_size": 4, "epoch": 2,
+                           "generation": 2}
+            mem = pool.status()["membership"]
+            assert mem["generation"] == 2 and mem["epoch"] == 2
+            assert sorted(mem["ranks"]) == ["0", "1", "2", "3"]
+            assert all(v["join_epoch"] == 2 and v["state"] == "active"
+                       for v in mem["ranks"].values())
+            assert mem["retired_epochs"] == [1]
+
+            # Re-arm worker-side drops on the fresh fleet (the
+            # coordinator-side plan survived the flip) and prove
+            # exactly-once again on the resized world: namespaces
+            # were re-seeded, so the counter restarts from 0.
+            arm_drops(pool)
+            assert counter_values(t1, 4, 3) == [3, 3, 3, 3]
+
+            rids += [t1.serve_submit(pr, MAX_NEW)["rid"]
+                     for pr in PROMPTS[4:]]
+
+            # Shrink 4 -> 2; SIGKILL a resized-in rank (join_epoch 2)
+            # the moment the drain barrier opens.  The watchdog must
+            # not blame it, the drain must still converge, and no
+            # accepted request may be lost or doubled.
+            victim_pid = pool.pm.processes[3].pid
+            killed = threading.Event()
+
+            def _kill_mid_drain():
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if pool.membership.draining:
+                        try:
+                            os.kill(victim_pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            pass
+                        killed.set()
+                        return
+                    time.sleep(0.005)
+
+            killer = threading.Thread(target=_kill_mid_drain,
+                                      daemon=True)
+            killer.start()
+            res = pool.resize(2, reason="chaos-shrink")
+            killer.join(timeout=60)
+            assert killed.is_set(), \
+                "the SIGKILL thread never saw the drain open"
+            assert res["status"] == "resized", res
+            assert res == {**res, "world_size": 2, "epoch": 3,
+                           "generation": 3}
+
+            arm_drops(pool)
+            got = wait_results(t1, rids, timeout=300)
+        finally:
+            clear_drops(pool)
+
+        assert len(got) == len(rids), \
+            (f"unfinished requests: {sorted(set(rids) - set(got))}; "
+             f"status={t1.serve_status()}")
+        # Bit-identical streams: every accepted request completed
+        # exactly once with the solo-generate greedy tokens, across
+        # two fleet flips and a mid-drain SIGKILL.
+        for i, rid in enumerate(rids):
+            assert got[rid]["status"] == "completed", got[rid]
+            assert got[rid]["tokens"] == solo[i], \
+                (f"request {rid} (prompt {PROMPTS[i]}): "
+                 f"{got[rid]['tokens']} != solo {solo[i]}")
+        st = t1.serve_status()
+        assert st["accepted"] == len(rids), st
+        assert st["completed"] == len(rids), st
+
+        status = pool.status()
+        assert status["world_size"] == 2
+        assert status["epoch"] == 3
+        mem = status["membership"]
+        assert mem["generation"] == 3
+        assert sorted(mem["ranks"]) == ["0", "1"]
+        assert mem["transition"] is None
+        assert mem["retired_epochs"] == [1, 2]
+        # The robustness bar: a draining (or SIGKILLed-while-
+        # draining) rank is never a hang verdict.
+        assert not (status["hang_verdicts"] or []), status
+        assert not status["scheduler"].get("paused"), status
+    finally:
+        try:
+            t1.close()
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------------------
+
+
+def _mini_pool(run_dir: str) -> GatewayDaemon:
+    os.environ["NBD_RUN_DIR"] = run_dir
+    return GatewayDaemon(
+        1, backend="cpu",
+        policy=SchedPolicy("fair", mesh_slots=1, tenant_inflight=8,
+                           queue_depth=16),
+        request_timeout=None, attach_timeout=240.0)
+
+
+def test_tenant_migration_live_and_dead_source(tmp_path_factory):
+    """Two single-rank pools under one runs root: migrate a serving
+    tenant live (export/import/release), then again with the source
+    fenced dead — the post-SIGKILL recovery path."""
+    runs_root = str(tmp_path_factory.mktemp("elasticroot"))
+    run_a = os.path.join(runs_root, "pool-a")
+    run_b = os.path.join(runs_root, "pool-b")
+    os.makedirs(run_a)
+    os.makedirs(run_b)
+    saved = os.environ.get("NBD_RUN_DIR")
+    gw_a = gw_b = None
+    try:
+        gw_a = _mini_pool(run_a)
+        gw_b = _mini_pool(run_b)
+        os.environ["NBD_RUN_DIR"] = saved or ""
+
+        directory = router_mod.PoolDirectory(runs_root)
+        assert sorted(directory.discover()) == [run_a, run_b]
+
+        # ---- live path -------------------------------------------
+        ta = attach(gw_a, "mig")
+        tok = ta.token
+        ta.serve_start(SPEC, max_batch=2, max_len=48, pad_to=4,
+                       steps=2, queue_depth=8, inflight=8,
+                       timeout=600)
+        rid = ta.serve_submit(PROMPTS[0], MAX_NEW)["rid"]
+        got = wait_results(ta, [rid], timeout=300)
+        assert got[rid]["status"] == "completed", got
+        ta.close()
+
+        # place() must route AWAY from the loaded source pool.
+        placed = directory.place(exclude=run_a)
+        assert placed is not None and placed[0] == run_b
+
+        out = router_mod.migrate_tenant("mig", run_a, run_b,
+                                        force=True)
+        assert out["status"] == "migrated", out
+        assert out["src_alive"] and out["released"], out
+        assert out["journal_moved"], out
+        # The serving history is staged at the destination for its
+        # serving plane to adopt on next start.
+        assert os.path.exists(migrated_journal_path(run_b, "mig"))
+
+        # The tenant reattaches at the DESTINATION with its original
+        # token and epoch (ratcheted, never rewound), and can run.
+        tb = attach(gw_b, "mig", token=tok)
+        assert tb.token == tok
+        assert tb.epoch >= out["epoch"] >= 1
+        r = tb.execute("40 + 2", target_ranks=[0], timeout=180)
+        assert (r.get("results") or {})["0"]["output"].strip() == "42"
+        tb.close()
+        # ...and the source no longer knows it.
+        assert "mig" not in gw_a.registry.names()
+
+        # ---- dead-source (post-SIGKILL) path ---------------------
+        # The source's serving plane is still up (one per daemon);
+        # the second tenant submits on it — its journal records are
+        # interleaved with mig's, which is exactly what the filtered
+        # export has to untangle.
+        ta2 = attach(gw_a, "mig2")
+        tok2 = ta2.token
+        rid2 = ta2.serve_submit(PROMPTS[1], MAX_NEW)["rid"]
+        got2 = wait_results(ta2, [rid2], timeout=300)
+        assert got2[rid2]["status"] == "completed", got2
+        ta2.close()
+
+        # Kill the source pool, then restore its manifest with the
+        # pid fenced to a corpse — exactly what the router sees after
+        # the source daemon is SIGKILLed (its durable artifacts,
+        # manifest + journal, survive on disk).  The daemon must be
+        # DOWN first: a live daemon rewrites its manifest on tenant
+        # churn and would race the fence.
+        mpath = gateway_manifest_path(run_a)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        gw_a.close()                     # removes the manifest too
+        manifest["pid"] = 2 ** 22 + 11   # nothing alive up there
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+
+        out2 = router_mod.migrate_tenant("mig2", run_a, run_b)
+        assert out2["status"] == "migrated", out2
+        assert not out2["src_alive"], out2
+        assert not out2["released"], out2       # nothing to release
+        assert out2["journal_moved"], out2
+
+        tb2 = attach(gw_b, "mig2", token=tok2)
+        assert tb2.token == tok2
+        r = tb2.execute("'alive-at-b'", target_ranks=[0],
+                        timeout=180)
+        assert "alive-at-b" in (r.get("results") or {})["0"]["output"]
+        tb2.close()
+    finally:
+        if saved is None:
+            os.environ.pop("NBD_RUN_DIR", None)
+        else:
+            os.environ["NBD_RUN_DIR"] = saved
+        for gw in (gw_b, gw_a):
+            if gw is not None:
+                try:
+                    gw.close()
+                except Exception:
+                    pass

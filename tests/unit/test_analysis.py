@@ -1754,7 +1754,12 @@ def test_protocol_planes_cover_the_real_wire():
     assert {"queued", "parked_notice", "stream_output",
             # ISSUE 11: the serving plane's pushes (serving.py) are
             # tenant-plane notices too.
-            "serve_tokens", "serve_done"} == set(
+            "serve_tokens", "serve_done",
+            # ISSUE 16: tenant_import reconstructs migrated parked
+            # results as "response"-typed mailbox entries; they only
+            # ever leave inside a mailbox drain (exempted in
+            # _PROTOCOL_EXTERNAL).
+            "response"} == set(
         planes["tenant-notice"]["sent"])
     assert {"serve_submit", "serve_result", "serve_stream",
             "serve_start", "serve_status", "serve_stop"} <= set(

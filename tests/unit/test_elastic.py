@@ -1,0 +1,242 @@
+"""Unit tests for the elastic-pool substrate (ISSUE 16): the
+fake-clock ``PoolAutoscaler`` policy (hysteresis, min/max clamp,
+cooldown, no flap on a single spike), the generation-stamped
+``PoolMembership`` transitions, the scheduler's pause/drain gate,
+and the ``gc_runs`` mid-resize keep-rule."""
+
+import os
+import time
+
+import pytest
+
+from nbdistributed_tpu.gateway.membership import (ACTIVE, DRAINING,
+                                                  PoolMembership)
+from nbdistributed_tpu.gateway.scheduler import SchedPolicy, Scheduler
+from nbdistributed_tpu.resilience.autoscaler import (AutoscalePolicy,
+                                                     Decision,
+                                                     PoolAutoscaler)
+
+pytestmark = [pytest.mark.unit, pytest.mark.elastic]
+
+
+# ----------------------------------------------------------------------
+# AutoscalePolicy env parsing
+
+def test_autoscale_policy_env():
+    p = AutoscalePolicy.from_env(env={})
+    assert (p.min_workers, p.max_workers) == (1, 8)
+    p = AutoscalePolicy.from_env(env={
+        "NBD_AUTOSCALE_MIN": "2", "NBD_AUTOSCALE_MAX": "16",
+        "NBD_AUTOSCALE_UP_QUEUE": "1",
+        "NBD_AUTOSCALE_SUSTAIN_S": "3",
+        "NBD_AUTOSCALE_COOLDOWN_S": "7",
+        "NBD_AUTOSCALE_IDLE_S": "30"})
+    assert (p.min_workers, p.max_workers) == (2, 16)
+    assert (p.up_queue, p.sustain_s, p.cooldown_s, p.idle_s) \
+        == (1, 3.0, 7.0, 30.0)
+    # Malformed values degrade to defaults, not crashes.
+    p = AutoscalePolicy.from_env(env={"NBD_AUTOSCALE_SUSTAIN_S": "x"})
+    assert p.sustain_s == 15.0
+    assert "band" in p.describe()
+
+
+# ----------------------------------------------------------------------
+# PoolAutoscaler decisions (pure fake clock)
+
+def _scaler(**kw):
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 8)
+    kw.setdefault("sustain_s", 10.0)
+    kw.setdefault("idle_s", 60.0)
+    kw.setdefault("cooldown_s", 30.0)
+    return PoolAutoscaler(AutoscalePolicy(**kw))
+
+
+def test_grow_requires_sustained_pressure():
+    a = _scaler()
+    # Pressure appears at t=0 — nothing fires until sustain_s elapses.
+    assert a.observe(0.0, world_size=2, queued=10) is None
+    assert a.observe(5.0, world_size=2, queued=10) is None
+    d = a.observe(10.0, world_size=2, queued=10)
+    assert isinstance(d, Decision) and d.action == "grow"
+    assert d.target == 4 and "queue" in d.reason
+
+
+def test_single_spike_does_not_flap():
+    a = _scaler()
+    assert a.observe(0.0, world_size=2, queued=10) is None
+    # The spike clears: the persistence clock resets...
+    assert a.observe(5.0, world_size=2, queued=0, active=1) is None
+    # ...so renewed pressure must sustain afresh.
+    assert a.observe(6.0, world_size=2, queued=10) is None
+    assert a.observe(12.0, world_size=2, queued=10) is None
+    assert a.observe(16.0, world_size=2, queued=10).action == "grow"
+
+
+def test_backlog_and_p95_signals():
+    a = _scaler()
+    a.observe(0.0, world_size=2, backlog=100)
+    d = a.observe(10.0, world_size=2, backlog=100)
+    assert d.action == "grow" and "backlog" in d.reason
+    a = _scaler()
+    a.observe(0.0, world_size=2, queue_p95_s=9.0)
+    d = a.observe(10.0, world_size=2, queue_p95_s=9.0)
+    assert d.action == "grow" and "p95" in d.reason
+
+
+def test_cooldown_blackout():
+    a = _scaler()
+    a.observe(0.0, world_size=2, queued=10)
+    assert a.observe(10.0, world_size=2, queued=10).action == "grow"
+    a.note_resized(11.0)
+    # Sustained pressure inside the cooldown window: no decision.
+    a.observe(12.0, world_size=4, queued=10)
+    assert a.observe(30.0, world_size=4, queued=10) is None
+    # After the window the clock must STILL sustain (note_resized
+    # dropped it), so the first post-cooldown look arms, not fires.
+    assert a.observe(45.0, world_size=4, queued=10) is None
+    assert a.observe(55.0, world_size=4, queued=10).action == "grow"
+
+
+def test_shrink_after_sustained_idle_and_min_clamp():
+    a = _scaler()
+    assert a.observe(0.0, world_size=4) is None
+    assert a.observe(30.0, world_size=4) is None
+    d = a.observe(60.0, world_size=4)
+    assert d.action == "shrink" and d.target == 2
+    # Any activity resets the idle clock.
+    a = _scaler()
+    a.observe(0.0, world_size=4)
+    a.observe(30.0, world_size=4, active=1)
+    assert a.observe(60.0, world_size=4) is None
+    # At min, sustained idle decides nothing.
+    a = _scaler(min_workers=2)
+    a.observe(0.0, world_size=2)
+    assert a.observe(600.0, world_size=2) is None
+
+
+def test_band_clamp_is_unconditional():
+    a = _scaler(min_workers=2, max_workers=4)
+    d = a.observe(0.0, world_size=1)
+    assert d.action == "grow" and d.target == 2
+    d = a.observe(0.0, world_size=9, queued=50)
+    assert d.action == "shrink" and d.target == 4
+    # Grow target clamps at max even under pressure.
+    a = _scaler(max_workers=3)
+    a.observe(0.0, world_size=2, queued=10)
+    d = a.observe(10.0, world_size=2, queued=10)
+    assert d.target == 3
+    # At max, pressure decides nothing.
+    a = _scaler(max_workers=2)
+    a.observe(0.0, world_size=2, queued=10)
+    assert a.observe(100.0, world_size=2, queued=10) is None
+
+
+# ----------------------------------------------------------------------
+# PoolMembership
+
+def test_membership_seed_and_describe():
+    m = PoolMembership(2, epoch=1, now=5.0)
+    assert m.generation == 1 and m.epoch == 1
+    assert m.active_ranks() == [0, 1] and not m.draining
+    d = m.describe()
+    assert d["ranks"]["0"]["join_epoch"] == 1
+    assert d["ranks"]["1"]["state"] == ACTIVE
+    assert d["transition"] is None
+
+
+def test_membership_resize_cycle():
+    m = PoolMembership(2, epoch=1)
+    plan = m.begin_resize(4, 2, reason="pressure", now=10.0)
+    assert plan["from_world"] == 2 and plan["to_world"] == 4
+    assert m.draining and m.rank_state(0) == DRAINING
+    assert m.active_ranks() == []
+    # Only one transition at a time.
+    with pytest.raises(RuntimeError, match="already in flight"):
+        m.begin_resize(3, 3)
+    gen = m.complete_resize(4, 2, now=11.0)
+    assert gen == 2 and m.generation == 2 and m.epoch == 2
+    assert m.active_ranks() == [0, 1, 2, 3] and not m.draining
+    assert m.describe()["ranks"]["3"]["join_epoch"] == 2
+    # The retired epoch-set stays queryable for late-frame forensics.
+    assert m.epoch_set(1) == [0, 1]
+    assert m.epoch_set(2) == [0, 1, 2, 3]
+    assert m.describe()["retired_epochs"] == [1]
+
+
+def test_membership_abort_restores_active():
+    m = PoolMembership(2, epoch=1)
+    m.begin_resize(4, 2)
+    m.abort_resize()
+    assert not m.draining and m.active_ranks() == [0, 1]
+    assert m.generation == 1 and m.epoch == 1
+
+
+# ----------------------------------------------------------------------
+# Scheduler pause/drain gate
+
+def _sched(**kw):
+    kw.setdefault("mesh_slots", 1)
+    return Scheduler(SchedPolicy(**kw))
+
+
+def test_scheduler_pause_queues_instead_of_granting():
+    s = _sched()
+    s.pause("resize")
+    t = s.submit("a", "m1", priority=0)
+    assert not t.event.is_set()          # held, not granted
+    snap = s.snapshot()
+    assert snap["paused"] == "resize" and snap["queued"] == 1
+    assert s.active_count() == 0
+    s.resume()
+    assert t.event.wait(2.0) and t.state == "active"
+    assert s.snapshot()["paused"] is None
+
+
+def test_scheduler_pause_blocks_promotion():
+    s = _sched()
+    t1 = s.submit("a", "m1")
+    assert t1.verdict["status"] == "dispatch"
+    t2 = s.submit("a", "m2")
+    assert not t2.event.is_set()
+    s.pause("resize")
+    s.complete("m1")
+    assert s.active_count() == 0
+    assert not t2.event.is_set()         # drained: nothing promotes
+    s.resume()
+    assert t2.event.wait(2.0) and t2.state == "active"
+
+
+# ----------------------------------------------------------------------
+# gc_runs keep-rule for pools mid-resize
+
+def test_gc_keeps_recent_gateway_manifest(tmp_path, monkeypatch):
+    import json
+
+    from nbdistributed_tpu.resilience import session as session_mod
+
+    monkeypatch.delenv("NBD_RUN_DIR", raising=False)
+    root = tmp_path / "runs"
+    d = root / "pool-x"
+    d.mkdir(parents=True)
+    now = time.time()
+    # A gateway manifest whose pid is DEAD (the daemon is mid-restart
+    # for a resize) but whose epoch was bumped moments ago.
+    (d / "gateway.json").write_text(json.dumps({
+        "kind": "gateway", "pid": 2 ** 30, "epoch": 2,
+        "updated_ts": now - 5.0}))
+    os.utime(d, (now - 7200, now - 7200))
+    res = session_mod.gc_runs(str(root), ttl_s=60.0, dry_run=True,
+                              now=now)
+    assert str(d) in res["kept"]
+    assert "resize" in res["kept_why"][str(d)]
+    # Once the restart window has passed with the daemon still dead,
+    # the ordinary TTL sweep applies again.
+    (d / "gateway.json").write_text(json.dumps({
+        "kind": "gateway", "pid": 2 ** 30, "epoch": 2,
+        "updated_ts": now - 9000}))
+    os.utime(d / "gateway.json", (now - 9000, now - 9000))
+    os.utime(d, (now - 9000, now - 9000))
+    res = session_mod.gc_runs(str(root), ttl_s=60.0, dry_run=True,
+                              now=now)
+    assert str(d) in res["swept"]
