@@ -28,6 +28,10 @@ driver-defined all_reduce metric):
    resized-in fleet), the resize drain-barrier + whole-flip
    wall-clock, and a tenant migration end to end — measured in CPU
    pools of their own after the bench world is torn down.
+5. **Serving fast path** (``extra.serving``, ISSUE 17): closed-loop
+   loadgen against a paged, multi-rank decode plane — sustained
+   tokens/s with client-observed p99 TTFT/TPOT, then the shed rate
+   at 2x the measured sustainable rate — in a CPU pool of its own.
 
 TPU bring-up failures (the axon tunnel flaps: device discovery hangs)
 retry with backoff, then fall back to a 2-process CPU/gloo world — the
@@ -1463,6 +1467,105 @@ def measure_elastic() -> dict | None:
         shutil.rmtree(runs_root, ignore_errors=True)
 
 
+SERVE_SPEC_CELL = (
+    "import jax as _j, jax.numpy as _jn\n"
+    "from nbdistributed_tpu.models import tiny_config, init_params\n"
+    "cfg = tiny_config(dtype=_jn.float32, use_flash=False)\n"
+    "params = init_params(_j.random.PRNGKey(0), cfg)\n")
+
+
+def measure_serving() -> dict | None:
+    """The ISSUE 17 serving-fast-path numbers from the closed-loop
+    load harness: sustained tokens/s with client-observed p99
+    TTFT/TPOT, then the shed rate at 2x the measured sustainable
+    request rate — all through the real tenant plane (the exact core
+    ``tools/nbd_loadgen.py`` runs) against a paged, multi-rank decode
+    plane.
+
+    CPU backend in a pool of its own (the mechanism under test is the
+    serving control plane, not the accelerator), AFTER the pooled
+    bench world is gone."""
+    import shutil
+    import tempfile
+
+    from nbdistributed_tpu.gateway.client import TenantClient
+    from nbdistributed_tpu.gateway.daemon import GatewayDaemon
+    from nbdistributed_tpu.gateway.scheduler import SchedPolicy
+    from nbdistributed_tpu.serving_fast import LoadConfig, run_load
+
+    run_dir = tempfile.mkdtemp(prefix="nbd-bench-serving-")
+    saved = os.environ.get("NBD_RUN_DIR")
+    gw = client = None
+    out: dict = {"backend": "cpu"}
+
+    def _load(cl, rps: float, duration: float) -> dict:
+        from nbdistributed_tpu.serving_fast.loadgen import (
+            ClientTransport)
+        cfg = LoadConfig(rps=rps, duration_s=duration,
+                         arrival="poisson", seed=7,
+                         prompt_len=(4, 12), max_new=(4, 10),
+                         drain_s=120.0)
+        return run_load(ClientTransport(cl), cfg)
+
+    try:
+        os.environ["NBD_RUN_DIR"] = run_dir
+        gw = GatewayDaemon(
+            2, backend="cpu",
+            policy=SchedPolicy("fair", mesh_slots=1,
+                               tenant_inflight=64, queue_depth=64),
+            request_timeout=None, attach_timeout=240.0)
+        client = TenantClient(gw.tenant_host, gw.tenant_port,
+                              "loadgen", pool_token=gw.pool_token)
+        client.serve_start(SERVE_SPEC_CELL, max_batch=4, max_len=48,
+                           pad_to=4, steps=4, queue_depth=8,
+                           inflight=64, decode_ranks=2,
+                           kv_block_tokens=8, timeout=600)
+        # Sustained phase: modest offered rate, everything completes.
+        rep = _load(client, rps=2.0, duration=8.0)
+        out["tokens_per_s"] = rep["tokens_per_s"]
+        out["p99_ttft_ms"] = (rep["client"]["ttft_ms"]
+                              or {}).get("p99")
+        out["p99_tpot_ms"] = (rep["client"]["tpot_ms"]
+                              or {}).get("p99")
+        out["sustained_completed"] = rep["completed"]
+        out["sustained_hung"] = rep["hung"]
+        # Overload phase: 2x the COMPLETION rate the plane just
+        # demonstrated (floor 2x offered) — the bounded queue must
+        # shed with explicit verdicts, not hang.
+        sustainable = max(rep["completed"] / max(rep["duration_s"],
+                                                 1e-9), 2.0)
+        rep2 = _load(client, rps=2.0 * sustainable, duration=6.0)
+        out["overload_rps"] = round(2.0 * sustainable, 2)
+        out["overload_shed_rate"] = rep2["shed_rate"]
+        out["overload_completed"] = rep2["completed"]
+        out["overload_hung"] = rep2["hung"]
+        kv = client.serve_status().get("kv") or {}
+        if kv:
+            out["kv_block_tokens"] = kv.get("block_tokens")
+            out["kv_blocks_per_rank"] = kv.get("blocks_per_rank")
+        return out
+    finally:
+        if client is not None:
+            try:
+                client.serve_stop()
+            except Exception:
+                pass
+            try:
+                client.close()
+            except Exception:
+                pass
+        if gw is not None:
+            try:
+                gw.close()
+            except Exception:
+                pass
+        if saved is None:
+            os.environ.pop("NBD_RUN_DIR", None)
+        else:
+            os.environ["NBD_RUN_DIR"] = saved
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main() -> int:
     # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
     # spawned workers: raising SystemExit lets run()'s finally-block
@@ -1663,6 +1766,17 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                 log(f"[bench] elastic: {el}")
         except Exception as e:
             log(f"[bench] elastic measurement skipped: {e}")
+
+        # Serving fast path (ISSUE 17): closed-loop loadgen against a
+        # paged multi-rank decode plane — sustained tokens/s + p99
+        # TTFT/TPOT, then shed rate at 2x overload.
+        try:
+            sv = measure_serving()
+            if sv:
+                extra["serving"] = sv
+                log(f"[bench] serving: {sv}")
+        except Exception as e:
+            log(f"[bench] serving measurement skipped: {e}")
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"

@@ -371,6 +371,11 @@ class TenantClient:
                     steps: int | None = None,
                     queue_depth: int | None = None,
                     inflight: int | None = None,
+                    decode_ranks: int | None = None,
+                    kv_block_tokens: int | None = None,
+                    kv_blocks: int | None = None,
+                    prefill_chunk: int | None = None,
+                    kv_quantized: bool | None = None,
                     timeout: float | None = 600.0) -> dict:
         """Start the pool's serving plane: run ``spec`` (a cell that
         binds the model params/config in the serving tenant's
@@ -382,6 +387,10 @@ class TenantClient:
             "pad_to": pad_to, "eos_id": eos_id,
             "temperature": temperature, "steps": steps,
             "queue_depth": queue_depth, "inflight": inflight,
+            "decode_ranks": decode_ranks,
+            "kv_block_tokens": kv_block_tokens,
+            "kv_blocks": kv_blocks, "prefill_chunk": prefill_chunk,
+            "kv_quantized": kv_quantized,
         }.items() if v is not None}
         data = dict(self.request("serve_start", payload,
                                  timeout=timeout).data or {})

@@ -1388,6 +1388,11 @@ class GatewayDaemon:
             queue_depth=data.get("queue_depth"),
             inflight=data.get("inflight"),
             world_size=self.world_size,
+            decode_ranks=data.get("decode_ranks"),
+            kv_block_tokens=data.get("kv_block_tokens"),
+            kv_blocks=data.get("kv_blocks"),
+            prefill_chunk=data.get("prefill_chunk"),
+            kv_quantized=bool(data.get("kv_quantized")),
             deliver=self._serve_deliver,
             notify=self._serve_notify, flight=self.flight)
         with self._lock:

@@ -15,15 +15,23 @@ the continuous-batching engine.  This module connects them:
   flood of prompts.
 
 * **Decode loop.**  A single driver thread ticks the pool: each tick
-  is one ``serve_step`` request to the *decode rank* (the highest
-  live rank — see :meth:`ServingManager._pick_rank` for why not the
-  lowest) carrying admissions/releases and a step budget; the worker
-  runs the admissions plus up to ``steps`` decode steps on its
-  :class:`DecodeServer` and replies with per-request emissions at
-  explicit offsets.  The worker's serial request loop is the
-  interleaving point with notebook cells — a decode tick waits its
-  turn like any other request, so serving never starves tenants (and
-  vice versa, at step granularity).
+  sends one ``serve_step`` per *decode rank* (the highest
+  ``decode_ranks`` live ranks — see
+  :meth:`ServingManager._pick_ranks` for why the fleet fills from the
+  top) carrying that rank's admissions/releases and a step budget;
+  the worker runs the admissions plus up to ``steps`` decode steps on
+  its :class:`DecodeServer` and replies with per-request emissions at
+  explicit offsets.  With several decode ranks the steps are
+  pre-submitted through the ISSUE 14 submission/completion split so
+  the ranks decode concurrently — continuous batching across the
+  whole slice (ISSUE 17), each request living entirely on ONE rank so
+  failover and exactness arguments are unchanged.  Admission is
+  bounded by free KV *blocks* per rank (a gateway-side
+  :class:`~..serving_fast.paging.BlockAllocator` mirrors each
+  worker's paged cache), not just sequence slots.  The worker's
+  serial request loop is the interleaving point with notebook cells —
+  a decode tick waits its turn like any other request, so serving
+  never starves tenants (and vice versa, at step granularity).
 
 * **Durability (the robustness headline).**  An accepted request is
   journaled — prompt, sampling budget, and every emitted token — in
@@ -69,6 +77,7 @@ from collections import deque
 from ..messaging.codec import Message
 from ..observability import latency as obs_latency
 from ..observability import metrics as obs_metrics
+from ..serving_fast.paging import BlockAllocator, blocks_needed
 from ..utils import knobs
 from .scheduler import ACTIVE, SchedPolicy, Scheduler
 from .scheduler import SHED as TICKET_SHED
@@ -290,7 +299,7 @@ class _Req:
                  "ticket", "released", "submitted_ts", "finished_ts",
                  "resumes", "stream_resumed", "error",
                  "placed_ts", "first_tok_ts", "last_emit_ts",
-                 "first_batch")
+                 "first_batch", "rank")
 
     def __init__(self, rid: str, tenant: str, prompt: list[int],
                  max_new: int, priority: int, ticket):
@@ -302,7 +311,8 @@ class _Req:
         self.tokens: list[int] = []
         self.state = ACCEPTED          # accepted | completed | shed | failed
         self.base = 0                  # stream offset of current placement
-        self.placed = False            # admitted to the decode rank
+        self.placed = False            # admitted to a decode rank
+        self.rank: int | None = None   # which decode rank holds it
         self.replay = False            # next admit is a journal replay
         self.released = False          # host-side record freed worker-side
         self.ticket = ticket
@@ -322,7 +332,15 @@ class _Req:
 
 
 class _RankLost(RuntimeError):
-    """The decode rank died or stopped answering: fail over."""
+    """A decode rank died or stopped answering: fail over.
+
+    ``rank`` names the lost rank so the multi-rank driver un-places
+    only ITS requests; ``None`` means "whoever is open" (the legacy
+    single-rank paths)."""
+
+    def __init__(self, msg: str, rank: int | None = None):
+        super().__init__(msg)
+        self.rank = rank
 
 
 class ServingManager:
@@ -351,6 +369,11 @@ class ServingManager:
                  queue_depth: int | None = None,
                  inflight: int | None = None,
                  world_size: int | None = None,
+                 decode_ranks: int | None = None,
+                 kv_block_tokens: int | None = None,
+                 kv_blocks: int | None = None,
+                 prefill_chunk: int | None = None,
+                 kv_quantized: bool = False,
                  deliver=None, notify=None, flight=None):
         self.comm = comm
         self.run_dir = run_dir
@@ -375,21 +398,54 @@ class ServingManager:
             else knobs.get_int("NBD_SERVE_INFLIGHT", 32)
         self.world_size = world_size if world_size is not None \
             else getattr(comm, "num_workers", 1)
+        # Serving fast path (ISSUE 17): how many decode ranks to drive
+        # (0 = every live rank), and the paged-KV geometry mirrored on
+        # each of them.  The gateway keeps one accounting
+        # BlockAllocator per open rank so admission is bounded by free
+        # KV *blocks*, not sequence slots.
+        self.decode_ranks = decode_ranks if decode_ranks is not None \
+            else knobs.get_int("NBD_SERVE_DECODE_RANKS", 1)
+        self.kv_block_tokens = kv_block_tokens \
+            if kv_block_tokens is not None \
+            else knobs.get_int("NBD_KV_BLOCK_TOKENS", 64)
+        kvb = kv_blocks if kv_blocks is not None \
+            else knobs.get_int("NBD_KV_BLOCKS_PER_RANK", 0)
+        # 0 = derived dense capacity: max_batch rows of max_len each.
+        self.kv_blocks_per_rank = int(kvb) if kvb else (
+            self.max_batch
+            * blocks_needed(self.max_len, self.kv_block_tokens))
+        pck = prefill_chunk if prefill_chunk is not None \
+            else knobs.get_int("NBD_PREFILL_CHUNK_TOKENS", 0)
+        self.prefill_chunk = int(pck) if pck else None
+        self.kv_quantized = bool(kv_quantized)
         self._deliver = deliver or (lambda _t, _m: None)
         self._notify = notify or (lambda _t, _m: None)
         self._flight = flight
         # One KV slot per scheduler mesh-slot: a granted ticket IS a
-        # free slot on the decode server, so admission, queueing, and
+        # free slot on a decode server, so admission, queueing, and
         # shedding reuse the pool scheduler's exact verdict machinery
         # (fair mode: the submitting tenant's SLO priority first).
+        # With K decode ranks the mesh has K * max_batch slots; block
+        # accounting in _place_admits_locked is the finer-grained gate
+        # underneath the ticket.
+        n_target = self.decode_ranks if self.decode_ranks > 0 \
+            else max(1, self.world_size)
         self.sched = Scheduler(SchedPolicy(
-            "fair", mesh_slots=self.max_batch, tenant_inflight=infl,
-            queue_depth=qd))
+            "fair", mesh_slots=self.max_batch * n_target,
+            tenant_inflight=infl, queue_depth=qd))
         self.journal = ServeJournal(journal_path(run_dir, tenant))
         self._lock = threading.Lock()
         self._reqs: dict[str, _Req] = {}
         self._next_rid = 0
-        self._open_rank: int | None = None
+        # rank -> gateway-side accounting BlockAllocator (owner = rid),
+        # one per OPEN decode rank.  Mirrors the worker's device
+        # allocator by construction: both see the same admit/release
+        # order, and the free list is deterministic.  The gateway's
+        # copy frees at _finish (one tick before the worker processes
+        # the release) — optimistic by at most one tick; the worker's
+        # DecodeServer keeps an over-admitted request pending until
+        # blocks free, so the skew self-heals without a verdict.
+        self._open: dict[int, BlockAllocator] = {}
         # rank -> monotonic deadline to avoid it: a rank whose
         # serve_open failed (missing namespace after a reconnect,
         # OOM building the server) must not be retried forever while
@@ -608,9 +664,10 @@ class ServingManager:
         instead of a cold compile)."""
         with self._lock:
             self.world_size = int(world_size)
-            self._open_rank = None
+            self._open.clear()
             self._avoid.clear()
             for r in self._reqs.values():
+                r.rank = None
                 if r.state == ACCEPTED and r.placed:
                     r.placed = False
                     r.replay = True
@@ -682,6 +739,19 @@ class ServingManager:
                     "error": f"prompt ({len(prompt)}) + max_new_tokens "
                              f"({max_new}) exceeds the server's "
                              f"max_len {self.max_len}"}
+        # Block-capacity admission (ISSUE 17): a request whose
+        # worst-case KV footprint exceeds a whole rank's block pool can
+        # NEVER be placed — refuse it now with an explicit verdict
+        # instead of letting it starve in the queue forever.
+        need = blocks_needed(len(prompt) + int(max_new),
+                             self.kv_block_tokens)
+        if need > self.kv_blocks_per_rank:
+            return {"status": REJECTED_V, "reason": "kv-exhausted",
+                    "error": f"request needs {need} KV blocks "
+                             f"({len(prompt)} prompt + {max_new} new "
+                             f"tokens at {self.kv_block_tokens}/block) "
+                             f"but each decode rank has only "
+                             f"{self.kv_blocks_per_rank} blocks"}
         with self._lock:
             rid = f"r{self._next_rid}"
             self._next_rid += 1
@@ -845,7 +915,12 @@ class ServingManager:
                          if r.state == ACCEPTED and r.placed)
             pending = sum(1 for r in self._reqs.values()
                           if r.state == ACCEPTED and not r.placed)
-            d = {"tenant": self.tenant, "decode_rank": self._open_rank,
+            # "decode_rank" stays the single headline rank (the
+            # highest open one) for every pre-ISSUE-17 surface;
+            # "decode_ranks"/"ranks" carry the multi-rank truth.
+            d = {"tenant": self.tenant,
+                 "decode_rank": max(self._open) if self._open else None,
+                 "decode_ranks": sorted(self._open),
                  "accepted": self.accepted, "completed": self.completed,
                  "shed": self.shed, "rejected": self.rejected,
                  "replayed": self.replayed, "resumed": self.resumed,
@@ -856,6 +931,30 @@ class ServingManager:
                  "decoding": active, "pending": pending,
                  "slots": self.max_batch, "max_len": self.max_len,
                  "last_error": self.last_error}
+            ranks = {}
+            for rank in sorted(self._open):
+                alloc = self._open[rank]
+                placed = sum(1 for r in self._reqs.values()
+                             if r.state == ACCEPTED and r.placed
+                             and r.rank == rank)
+                ranks[str(rank)] = {"placed": placed,
+                                    "kv_used": alloc.used_blocks,
+                                    "kv_free": alloc.free_blocks}
+            d["ranks"] = ranks
+            # Per-SUBMITTING-tenant block counts (%dist_serve status).
+            by_tenant: dict[str, int] = {}
+            used = free = 0
+            for alloc in self._open.values():
+                used += alloc.used_blocks
+                free += alloc.free_blocks
+                for rid, n in alloc.snapshot()["owners"].items():
+                    req = self._reqs.get(rid)
+                    t = req.tenant if req is not None else "unknown"
+                    by_tenant[t] = by_tenant.get(t, 0) + n
+            d["kv"] = {"block_tokens": self.kv_block_tokens,
+                       "blocks_per_rank": self.kv_blocks_per_rank,
+                       "used": used, "free": free,
+                       "tenants": by_tenant}
         d["scheduler"] = self.sched.snapshot()
         d["slo"] = self._slo_summary(slo_entries)
         return d
@@ -886,23 +985,27 @@ class ServingManager:
             dead = set()
         return sorted(set(range(self.world_size)) - set(dead))
 
-    def _pick_rank(self) -> int | None:
-        """The decode rank: the HIGHEST live rank.  Highest, not
-        lowest, on purpose — rank 0 hosts the jax.distributed
-        coordination service, whose death kills every other rank's
-        process (that failure class is the supervisor's full-world
-        heal, not a serving failover), so the decode loop keeps its
-        blast radius off it.  Ranks whose serve_open recently failed
+    def _pick_ranks(self) -> list[int]:
+        """The decode ranks: the HIGHEST ``decode_ranks`` live ranks
+        (0 = every live rank), highest first.  Highest, not lowest, on
+        purpose — rank 0 hosts the jax.distributed coordination
+        service, whose death kills every other rank's process (that
+        failure class is the supervisor's full-world heal, not a
+        serving failover), so the decode fleet fills from the top and
+        touches rank 0 last.  Ranks whose serve_open recently failed
         are skipped until their backoff expires; with every live rank
-        avoided, the backoff is overridden (retrying beats stalling)."""
+        avoided, the backoff is overridden (retrying beats
+        stalling)."""
         live = self._live_ranks()
         if not live:
-            return None
+            return []
         now = time.monotonic()
         with self._lock:
             usable = [r for r in live
                       if self._avoid.get(r, 0.0) <= now]
-        return (usable or live)[-1]
+        pool = usable or live
+        k = self.decode_ranks if self.decode_ranks > 0 else len(pool)
+        return sorted(pool, reverse=True)[:max(1, min(k, len(pool)))]
 
     def _has_work_locked(self) -> bool:
         return any(r.state == ACCEPTED for r in self._reqs.values())
@@ -926,8 +1029,8 @@ class ServingManager:
                     self._tick()
                 finally:
                     self._tick_idle.set()
-            except _RankLost:
-                self._on_rank_lost()
+            except _RankLost as e:
+                self._on_rank_lost(e.rank)
             except Exception as e:  # never kill the driver
                 with self._lock:
                     self.last_error = f"{type(e).__name__}: {e}"
@@ -936,33 +1039,69 @@ class ServingManager:
                 if self._stop.wait(0.5):
                     return
 
-    def _on_rank_lost(self) -> None:
-        """The decode rank died (or stopped answering within the retry
-        budget): un-place every in-flight request — the next tick
-        re-opens on the next live rank and re-admits each one from its
-        journaled prompt + emitted prefix."""
+    def _unbind_rank_locked(self, rank: int | None) -> None:
+        """Detach every request bound to ``rank`` (None = any rank):
+        accepted-and-placed ones go back to the journal-replay path;
+        finished-but-unreleased ones are marked released — the rank's
+        server is gone (or will be reset), so there is nothing left to
+        release worker-side.  The rank's accounting allocator is
+        dropped with the rank, so no per-request free is needed."""
+        for r in self._reqs.values():
+            if rank is not None and r.rank != rank:
+                continue
+            if r.state == ACCEPTED and r.placed:
+                r.placed = False
+                r.replay = True
+            elif r.placed and not r.released:
+                r.released = True
+            r.rank = None
+
+    def _on_rank_lost(self, rank: int | None = None) -> None:
+        """A decode rank died (or stopped answering within the retry
+        budget): un-place ITS in-flight requests — the next tick
+        re-opens capacity on the remaining live ranks and re-admits
+        each one from its journaled prompt + emitted prefix.  With
+        ``rank=None`` (a legacy caller, or a loss detected before any
+        placement) every open rank is dropped."""
         with self._lock:
-            lost = self._open_rank
-            self._open_rank = None
+            if rank is None:
+                lost = sorted(self._open)
+                self._open.clear()
+            else:
+                self._open.pop(rank, None)
+                lost = [rank]
             self.failovers += 1
-            for r in self._reqs.values():
-                if r.state == ACCEPTED and r.placed:
-                    r.placed = False
-                    r.replay = True
+            self._unbind_rank_locked(rank)
         obs_metrics.registry().counter(
             "nbd_serve_failovers_total",
             "decode-rank failovers (rank death or step-retry budget "
             "exhausted)", {"tenant": self.tenant}).inc()
-        self._record("serve_failover", lost_rank=lost)
-        if lost is not None:
+        self._record("serve_failover", lost_ranks=lost)
+        for lr in lost:
             # Best-effort: if the rank is merely unreachable (not
             # dead), free its now-orphaned DecodeServer.
             try:
-                self.comm.post([lost], "serve_close",
+                self.comm.post([lr], "serve_close",
                                {"tenant": self.tenant})
             except Exception:
                 pass
         self._stop.wait(0.2)
+
+    def _retire_rank(self, rank: int) -> None:
+        """An open rank fell out of the target set (a higher rank
+        healed back, or the fleet shrank): move its requests to the
+        replay path and close its server.  Not a failover — the rank
+        is healthy, just no longer chosen."""
+        with self._lock:
+            if self._open.pop(rank, None) is None:
+                return
+            self._unbind_rank_locked(rank)
+        try:
+            self.comm.post([rank], "serve_close",
+                           {"tenant": self.tenant})
+        except Exception:
+            pass
+        self._record("serve_rank_retired", rank=rank)
 
     def _open_on(self, rank: int) -> None:
         resp = self.comm.send_to_ranks(
@@ -971,6 +1110,10 @@ class ServingManager:
              "cfg": self.cfg_name, "max_batch": self.max_batch,
              "max_len": self.max_len, "pad_to": self.pad_to,
              "eos_id": self.eos_id, "temperature": self.temperature,
+             "kv_block_tokens": self.kv_block_tokens,
+             "kv_blocks": self.kv_blocks_per_rank,
+             "prefill_chunk": self.prefill_chunk,
+             "kv_quantized": self.kv_quantized,
              "reset": True},
             tenant=self.tenant, timeout=self.step_timeout)
         err = (resp[rank].data or {}).get("error")
@@ -983,25 +1126,60 @@ class ServingManager:
             raise RuntimeError(f"serve_open failed on rank {rank}: "
                                f"{err}")
         with self._lock:
-            self._open_rank = rank
+            # A fresh server has no placements or blocks: anything
+            # that thought it lived on this rank must replay.
+            self._unbind_rank_locked(rank)
+            self._open[rank] = BlockAllocator(self.kv_blocks_per_rank,
+                                              self.kv_block_tokens)
             self._avoid.pop(rank, None)
         self._record("serve_open", rank=rank)
 
-    def _take_admits_locked(self) -> tuple[list[dict], list]:
-        """Requests holding an ACTIVE scheduler ticket but not yet
-        placed on the decode rank — first admissions AND journal
-        re-admissions (the latter carry the emitted prefix).  Second
-        element: ``(tenant, queue_wait_s)`` for each FIRST placement —
-        observed into the SLO histograms by the caller, outside the
-        lock."""
-        admits = []
+    def _place_admits_locked(self) -> tuple[dict, dict, list]:
+        """Per-rank placement of requests holding an ACTIVE scheduler
+        ticket but not yet placed — first admissions AND journal
+        re-admissions (the latter carry the emitted prefix).
+
+        Each request reserves its WORST-CASE block count
+        (``ceil((prompt + max_new) / block_tokens)`` of the ORIGINAL
+        prompt/budget — invariant across replays, so a re-admission
+        reserves exactly what the first placement did) on the open
+        rank with a free sequence slot and the most free blocks.  A
+        request no rank can hold right now simply waits — blocks free
+        as peers finish, and the ticket stays ACTIVE.
+
+        Returns ``(admits, release, qwaits)``: per-rank admit payload
+        lists, per-rank release rid lists, and ``(tenant,
+        queue_wait_s)`` for each FIRST placement — observed into the
+        SLO histograms by the caller, outside the lock."""
+        admits: dict[int, list[dict]] = {}
+        release: dict[int, list[str]] = {}
         qwaits = []
         replays = 0
         now = time.time()
+        placed_n = {rank: 0 for rank in self._open}
+        for r in self._reqs.values():
+            if r.state == ACCEPTED and r.placed \
+                    and r.rank in placed_n:
+                placed_n[r.rank] += 1
         for r in self._reqs.values():
             if r.state != ACCEPTED or r.placed \
                     or r.ticket.state != ACTIVE:
                 continue
+            need = blocks_needed(len(r.prompt) + r.max_new,
+                                 self.kv_block_tokens)
+            best = None
+            for rank, alloc in self._open.items():
+                if placed_n.get(rank, 0) >= self.max_batch \
+                        or alloc.free_blocks < need:
+                    continue
+                if best is None or alloc.free_blocks \
+                        > self._open[best].free_blocks:
+                    best = rank
+            if best is None:
+                continue
+            self._open[best].alloc(r.rid, need)
+            placed_n[best] += 1
+            r.rank = best
             r.base = len(r.tokens)
             r.placed = True
             if r.placed_ts is None:
@@ -1014,20 +1192,26 @@ class ServingManager:
                 r.resumes += 1
                 self.replayed += 1
                 replays += 1
-            admits.append({"rid": r.rid,
-                           "prompt": list(r.prompt) + list(r.tokens),
-                           "max_new": r.max_new - r.base})
+            admits.setdefault(best, []).append(
+                {"rid": r.rid,
+                 "prompt": list(r.prompt) + list(r.tokens),
+                 "max_new": r.max_new - r.base})
+        for r in self._reqs.values():
+            if r.state != ACCEPTED and r.placed and not r.released \
+                    and r.rank in self._open:
+                r.released = True
+                release.setdefault(r.rank, []).append(r.rid)
         if replays:
             obs_metrics.registry().counter(
                 "nbd_serve_replayed_total",
                 "requests re-admitted from the journal after a "
                 "failover (re-prefill from prompt + emitted prefix)",
                 {"tenant": self.tenant}).inc(replays)
-        return admits, qwaits
+        return admits, release, qwaits
 
     def _tick(self) -> None:
-        rank = self._pick_rank()
-        if rank is None:
+        target = self._pick_ranks()
+        if not target:
             # Whole pool dead/unreachable: keep the journal and WAIT
             # for a heal — accepted requests survive by contract.  A
             # wait state, not a failover: any prior placement was
@@ -1035,40 +1219,131 @@ class ServingManager:
             self._stop.wait(1.0)
             return
         with self._lock:
-            cur = self._open_rank
-        if cur != rank:
-            self._open_on(rank)
+            stale = [r for r in self._open if r not in target]
+        for rank in stale:
+            self._retire_rank(rank)
+        for rank in target:
             with self._lock:
-                # A fresh server has no placements: anything that
-                # thought it was placed must re-admit as a replay.
-                for r in self._reqs.values():
-                    if r.state == ACCEPTED and r.placed:
-                        r.placed = False
-                        r.replay = True
+                if rank in self._open:
+                    continue
+            self._open_on(rank)
         with self._lock:
-            admits, qwaits = self._take_admits_locked()
-            release = [r.rid for r in self._reqs.values()
-                       if r.state != ACCEPTED and r.placed
-                       and not r.released]
-            for rid in release:
-                self._reqs[rid].released = True
+            admits, release, qwaits = self._place_admits_locked()
+            busy = {r.rank for r in self._reqs.values()
+                    if r.state == ACCEPTED and r.placed
+                    and r.rank is not None}
+            ticks = sorted((set(admits) | set(release) | busy)
+                           & set(self._open))
         for tenant_name, wait in qwaits:
             self._slo_hist(
                 "nbd_serve_queue_wait_seconds",
                 "serving queue wait: submit → first KV-slot placement",
                 tenant_name).observe(wait)
-        data = self._send_step(rank, {"tenant": self.tenant,
-                                      "admit": admits,
-                                      "release": release,
-                                      "steps": self.steps})
-        if data.get("error"):
-            # Whole-step refusal (e.g. the rank lost its serving
-            # state): treat like a dead rank — re-open and re-admit
-            # from the journal instead of spinning on errors.
-            self._record("serve_step_refused", rank=rank,
-                         error=str(data["error"])[:200])
-            raise _RankLost(str(data["error"]))
-        self._apply_reply(data)
+        if not ticks:
+            self._update_kv_gauges()
+            return
+        payloads = {rank: {"tenant": self.tenant,
+                           "admit": admits.get(rank, []),
+                           "release": release.get(rank, []),
+                           "steps": self.steps}
+                    for rank in ticks}
+        replies, lost = self._step_all(payloads)
+        for rank in ticks:
+            data = replies.get(rank)
+            if data is None:
+                continue
+            if data.get("error"):
+                # Whole-step refusal (e.g. the rank lost its serving
+                # state): treat like a dead rank — re-open and
+                # re-admit from the journal instead of spinning.
+                self._record("serve_step_refused", rank=rank,
+                             error=str(data["error"])[:200])
+                lost.append((rank, str(data["error"])))
+                continue
+            self._apply_reply(data)
+        self._update_kv_gauges()
+        if lost:
+            # Every received reply above is already applied, so the
+            # failover surgery is scoped to the lost rank alone.  With
+            # several lost in one tick the rest re-raise next tick.
+            rank, why = lost[0]
+            raise _RankLost(why, rank=rank)
+
+    def _step_all(self, payloads: dict[int, dict]
+                  ) -> tuple[dict[int, dict], list]:
+        """One serve_step round per rank.  When the comm supports the
+        submission/completion split (ISSUE 14) and more than one rank
+        is ticking, every step is pre-submitted so the ranks decode
+        CONCURRENTLY — the multi-rank throughput claim — then each
+        handle is awaited (wait() drives the same-msg-id redelivery
+        schedule).  Otherwise (unit-test fakes, single rank) the
+        legacy sequential path runs unchanged.
+
+        Returns ``(replies, lost)`` — every reply that arrived, plus
+        ``(rank, reason)`` for ranks that died or exhausted their
+        retry budget.  Replies are always harvested before the caller
+        surfaces a loss: an abandoned reply would desynchronize the
+        emission offsets of the SURVIVING ranks' requests."""
+        from ..messaging.coordinator import WorkerDied
+        replies: dict[int, dict] = {}
+        lost: list = []
+        if len(payloads) > 1 and hasattr(self.comm, "submit"):
+            handles = {}
+            for rank, payload in payloads.items():
+                try:
+                    handles[rank] = self.comm.submit(
+                        [rank], "serve_step", payload,
+                        tenant=self.tenant, msg_id=uuid.uuid4().hex,
+                        timeout=self.step_timeout)
+                except WorkerDied as e:
+                    lost.append((rank, str(e)))
+                except Exception as e:
+                    self._note_step_retry(rank, 0, e)
+                    lost.append((rank, f"submit failed: {e}"))
+            for rank, h in handles.items():
+                try:
+                    resp = h.wait()
+                    replies[rank] = resp[rank].data or {}
+                except WorkerDied as e:
+                    lost.append((rank, str(e)))
+                except Exception as e:
+                    self._note_step_retry(rank, 1, e)
+                    with self._lock:
+                        self._avoid[rank] = time.monotonic() + 60.0
+                    lost.append((rank,
+                                 f"step retry budget exhausted: {e}"))
+            return replies, lost
+        for rank, payload in payloads.items():
+            try:
+                replies[rank] = self._send_step(rank, payload)
+            except _RankLost as e:
+                lost.append((rank, str(e)))
+        return replies, lost
+
+    def _note_step_retry(self, rank: int, attempt: int,
+                         e: Exception) -> None:
+        with self._lock:
+            self.step_retries += 1
+        obs_metrics.registry().counter(
+            "nbd_serve_step_retries_total",
+            "serve_step dispatches redelivered after a "
+            "timeout (same msg_id; replay-cache dedup)",
+            {"tenant": self.tenant}).inc()
+        self._record("serve_step_retry", rank=rank,
+                     attempt=attempt + 1,
+                     error=f"{type(e).__name__}: {e}")
+
+    def _update_kv_gauges(self) -> None:
+        with self._lock:
+            used = sum(a.used_blocks for a in self._open.values())
+            free = sum(a.free_blocks for a in self._open.values())
+        reg = obs_metrics.registry()
+        reg.gauge("nbd_kv_blocks_used",
+                  "KV cache blocks allocated across open decode ranks",
+                  {"tenant": self.tenant}).set(used)
+        reg.gauge("nbd_kv_blocks_free",
+                  "KV cache blocks free across open decode ranks",
+                  {"tenant": self.tenant}).set(free)
 
     def _send_step(self, rank: int, payload: dict) -> dict:
         """One serve_step round trip, redelivered under the SAME
@@ -1086,26 +1361,18 @@ class ServingManager:
                     msg_id=mid, timeout=self.step_timeout)
                 return resp[rank].data or {}
             except WorkerDied as e:
-                raise _RankLost(str(e)) from e
+                raise _RankLost(str(e), rank=rank) from e
             except Exception as e:
                 last = e
-                with self._lock:
-                    self.step_retries += 1
-                obs_metrics.registry().counter(
-                    "nbd_serve_step_retries_total",
-                    "serve_step dispatches redelivered after a "
-                    "timeout (same msg_id; replay-cache dedup)",
-                    {"tenant": self.tenant}).inc()
-                self._record("serve_step_retry", rank=rank,
-                             attempt=attempt + 1,
-                             error=f"{type(e).__name__}: {e}")
+                self._note_step_retry(rank, attempt, e)
                 if self._stop.is_set():
-                    raise _RankLost("stopping") from e
+                    raise _RankLost("stopping", rank=rank) from e
         # Alive-but-unresponsive: it stays in the live set, so back it
         # off explicitly or the next tick would pick it right back.
         with self._lock:
             self._avoid[rank] = time.monotonic() + 60.0
-        raise _RankLost(f"step retry budget exhausted: {last}")
+        raise _RankLost(f"step retry budget exhausted: {last}",
+                        rank=rank)
 
     def _apply_reply(self, data: dict) -> None:
         reg = obs_metrics.registry()
@@ -1197,6 +1464,16 @@ class ServingManager:
             req.state = status
             req.error = error
             req.finished_ts = time.time()
+            # Return the request's KV blocks to its rank's accounting
+            # pool.  One tick optimistic versus the worker (which
+            # frees at the release in the NEXT serve_step); the
+            # worker's DecodeServer parks an early re-admission as
+            # pending until its own blocks free, so the skew never
+            # corrupts — see the ctor comment on self._open.
+            if req.rank is not None:
+                alloc = self._open.get(req.rank)
+                if alloc is not None:
+                    alloc.free(req.rid)
             if status == COMPLETED:
                 self.completed += 1
                 # SLO record (seconds; None = not applicable): exact

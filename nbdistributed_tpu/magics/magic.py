@@ -1525,8 +1525,11 @@ class DistributedMagics(Magics):
             joined = (f" · joined ep {je}"
                       if je is not None and je > 1 else "")
             srv = v.get("srv") or {}
+            kvb = srv.get("kvb") or ()
             scol = (f" · 🔄 {srv.get('tps', 0)} tok/s · KV "
                     f"{srv.get('occ', 0)}/{srv.get('slots', 0)}"
+                    + (f" · {kvb[0]}/{kvb[1]} blk" if len(kvb) == 2
+                       else "")
                     if srv else "")
             print(f"   rank {r}: {busy}{joined}{who}{scol}")
         if st.get("serving"):
@@ -1627,6 +1630,21 @@ class DistributedMagics(Magics):
               help="decode steps per serve tick")
     @argument("--queue-depth", type=int, default=None)
     @argument("--inflight", type=int, default=None)
+    @argument("--decode-ranks", type=int, default=None,
+              help="decode ranks to drive (0 = every live rank; "
+                   "default NBD_SERVE_DECODE_RANKS)")
+    @argument("--kv-block-tokens", type=int, default=None,
+              help="paged-KV block size in tokens "
+                   "(default NBD_KV_BLOCK_TOKENS)")
+    @argument("--kv-blocks", type=int, default=None,
+              help="KV blocks per decode rank (0 = dense capacity; "
+                   "default NBD_KV_BLOCKS_PER_RANK)")
+    @argument("--prefill-chunk", type=int, default=None,
+              help="chunked-prefill size in tokens — long prompts "
+                   "interleave with decode ticks "
+                   "(default NBD_PREFILL_CHUNK_TOKENS)")
+    @argument("--kv-quantized", action="store_true",
+              help="int8 KV cache on the decode servers")
     @argument("--prompt", default=None,
               help="comma-separated token ids (submit)")
     @argument("--max-new", type=int, default=16)
@@ -1669,11 +1687,21 @@ class DistributedMagics(Magics):
                     max_len=args.max_len, pad_to=args.pad_to,
                     eos_id=args.eos, steps=args.steps,
                     queue_depth=args.queue_depth,
-                    inflight=args.inflight)
+                    inflight=args.inflight,
+                    decode_ranks=args.decode_ranks,
+                    kv_block_tokens=args.kv_block_tokens,
+                    kv_blocks=args.kv_blocks,
+                    prefill_chunk=args.prefill_chunk,
+                    kv_quantized=(True if args.kv_quantized
+                                  else None))
+                kv = st.get("kv") or {}
                 print(f"🍽️ serving as tenant {st.get('tenant')!r}: "
                       f"{st.get('slots')} KV slots · max_len "
                       f"{st.get('max_len')} · decode rank "
-                      f"{st.get('decode_rank')}")
+                      f"{st.get('decode_rank')}"
+                      + (f" · {kv.get('blocks_per_rank')} KV blocks"
+                         f"/rank × {kv.get('block_tokens')} tok"
+                         if kv else ""))
             elif args.command == "submit":
                 if not args.prompt:
                     print("❌ submit needs --prompt 1,2,3")
@@ -1733,11 +1761,29 @@ class DistributedMagics(Magics):
 
     @staticmethod
     def _render_serve_status(st: dict) -> None:
-        print(f"🍽️ serving[{st.get('tenant')}] · decode rank "
-              f"{st.get('decode_rank')} · KV "
+        dranks = st.get("decode_ranks") or []
+        rank_str = (str(st.get("decode_rank")) if len(dranks) <= 1
+                    else ",".join(str(r) for r in sorted(dranks)))
+        print(f"🍽️ serving[{st.get('tenant')}] · decode rank"
+              f"{'s' if len(dranks) > 1 else ''} {rank_str} · KV "
               f"{st.get('decoding', 0)}/{st.get('slots')} · pending "
               f"{st.get('pending', 0)} · tokens "
               f"{st.get('tokens_total', 0)}")
+        kv = st.get("kv") or {}
+        if kv.get("used") or kv.get("free"):
+            per_rank = " · ".join(
+                f"r{r}: {v.get('placed', 0)} req, "
+                f"{v.get('kv_used', 0)} blk"
+                for r, v in sorted((st.get("ranks") or {}).items(),
+                                   key=lambda kv_: int(kv_[0])))
+            print(f"   KV blocks {kv.get('used', 0)}/"
+                  f"{kv.get('used', 0) + kv.get('free', 0)} used · "
+                  f"{kv.get('block_tokens')} tok/block"
+                  + (f" · {per_rank}" if per_rank else ""))
+            tb = kv.get("tenants") or {}
+            if tb:
+                print("   blocks by tenant: " + " · ".join(
+                    f"{t}: {n}" for t, n in sorted(tb.items())))
         print(f"   accepted {st.get('accepted', 0)} · completed "
               f"{st.get('completed', 0)} · shed {st.get('shed', 0)} · "
               f"rejected {st.get('rejected', 0)} · replayed "
@@ -3798,11 +3844,16 @@ class DistributedMagics(Magics):
             for r in range(self._world))
         print(f"⏱  cluster top · {self._world} workers · backend="
               f"{pm.backend} · {time.strftime('%H:%M:%S')}")
+        # Serving KV column only when some rank reports a decode
+        # server — idle clusters keep the pre-serving layout.
+        kv_seen = any((comm.last_ping(r) or (0, {}))[1].get("srv")
+                      for r in range(self._world))
         hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}"
                + (f"{'tenant':<11}" if tenants_seen else "")
                + f"{'hb-age':<8}"
                f"{'col#':<7}{'HBM use/limit GB':<18}{'peak':<7}"
-               f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
+               + (f"{'kv':<12}" if kv_seen else "")
+               + f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
         print(hdr)
         print("─" * len(hdr))
         for r in range(self._world):
@@ -3849,9 +3900,21 @@ class DistributedMagics(Magics):
                    f"/{self._fmt_gb(hbm.get('limit'))}"
                    if hbm.get("in_use") is not None else "-")
             peak = self._fmt_gb(hbm.get("peak"))
+            kvcol = ""
+            if kv_seen:
+                srv = (ping[1].get("srv") or {}) if ping else {}
+                kvb = srv.get("kvb") or ()
+                if len(kvb) == 2:
+                    kvcol = f"{f'{kvb[0]}/{kvb[1]}blk':<12}"
+                elif srv:
+                    kvcol = (f"{srv.get('occ', 0)}"
+                             f"/{srv.get('slots', 0)}")
+                    kvcol = f"{kvcol:<12}"
+                else:
+                    kvcol = f"{'-':<12}"
             print(f"{r:<5}{state:<11}{busy:<18}{tcol}{hb:<8}{col:<7}"
                   f"{mem:<18}"
-                  f"{peak:<7}{str(tel.get('bufs', '-')):<6}"
+                  f"{peak:<7}{kvcol}{str(tel.get('bufs', '-')):<6}"
                   f"{str(tel.get('compiles', '-')):<9}"
                   f"{str(tel.get('dedup', '-')):<6}")
         print(f"coordinator: retries sent {comm.retries_sent} · "

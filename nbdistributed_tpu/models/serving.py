@@ -88,6 +88,19 @@ class DecodeServer:
     :meth:`cache_prefix` registers a shared system prompt: its KV
     block is prefilled once, and matching submissions admit by one
     HBM copy + suffix-only prefill (see the method docstring).
+
+    ``kv_block_tokens=N`` switches the cache to **paged** storage
+    (ISSUE 17, :mod:`.paged_kv`): the pool holds ``kv_blocks`` fixed-
+    size physical blocks, each request reserves
+    ``ceil((prompt + max_new) / N)`` of them at admission, and
+    capacity is measured in blocks rather than slots — short requests
+    stop reserving ``max_len`` of KV each.  Exhaustion leaves
+    requests pending (never a silent wedge — the gateway's accounting
+    allocator issues the explicit verdicts).  ``interleave_prefill=
+    True`` (requires ``prefill_chunk``) admits long prompts one chunk
+    per :meth:`step` interleaved with decode, bounding the prefill
+    work any single tick can add — the chunked-prefill TPOT
+    guarantee.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
@@ -97,7 +110,10 @@ class DecodeServer:
                  kv_quantized: bool = False, mesh=None,
                  ep_axis: str = "ep", pad_to: int = 64, key=None,
                  draft_params=None, draft_cfg=None, gamma: int = 4,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 kv_block_tokens: int | None = None,
+                 kv_blocks: int | None = None,
+                 interleave_prefill: bool = False):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_to < 1:
@@ -113,6 +129,22 @@ class DecodeServer:
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("pass both draft_params and draft_cfg, "
                              "or neither")
+        if kv_block_tokens is not None and kv_block_tokens < 1:
+            raise ValueError(f"kv_block_tokens must be >= 1, got "
+                             f"{kv_block_tokens}")
+        if kv_block_tokens is None and kv_blocks is not None:
+            raise ValueError("kv_blocks needs kv_block_tokens (paged "
+                             "mode is enabled by the block size)")
+        if kv_block_tokens is not None and draft_cfg is not None:
+            # A speculative round writes gamma+1 positions per step;
+            # the paged scatter writes back exactly one block per slot.
+            # Compose them when the fused paged kernel lands, not by
+            # silently corrupting cross-block rounds.
+            raise ValueError("paged KV serving does not compose with "
+                             "speculative decoding yet")
+        if interleave_prefill and prefill_chunk is None:
+            raise ValueError("interleave_prefill needs prefill_chunk "
+                             "(the per-step prefill work bound)")
         if draft_cfg is not None:
             if draft_cfg.vocab_size != cfg.vocab_size:
                 raise ValueError("target and draft must share a "
@@ -152,8 +184,29 @@ class DecodeServer:
         self._eos = eos_id
         self._key = key if key is not None else jax.random.PRNGKey(0)
 
-        self._cache = init_kv_cache(cfg, max_batch, max_len, mesh=mesh,
-                                    quantized=kv_quantized)
+        # Paged mode (ISSUE 17): the cache pool is (L, n_blocks+1,
+        # Hkv, block_tokens, D) physical blocks instead of per-slot
+        # max_len rows; self._cache holds the pool either way (it is
+        # donated through the same jitted programs).
+        if kv_block_tokens is not None:
+            from .paged_kv import PagedKVCache, make_paged_pool
+            if kv_blocks is None:
+                # Derived default: exactly the dense pool's capacity,
+                # so paging with no explicit budget never refuses a
+                # request the dense server would have taken.
+                kv_blocks = max_batch * (
+                    -(-max_len // kv_block_tokens))
+            self._paged = PagedKVCache(
+                slots=max_batch, max_len=max_len, n_blocks=kv_blocks,
+                block_tokens=kv_block_tokens)
+            self._cache = make_paged_pool(
+                cfg, kv_blocks, kv_block_tokens, mesh=mesh,
+                quantized=kv_quantized)
+        else:
+            self._paged = None
+            self._cache = init_kv_cache(cfg, max_batch, max_len,
+                                        mesh=mesh,
+                                        quantized=kv_quantized)
         self._lens = jnp.zeros((max_batch,), jnp.int32)
         self._last = jnp.zeros((max_batch,), jnp.int32)
         self._active = jnp.zeros((max_batch,), bool)
@@ -194,10 +247,23 @@ class DecodeServer:
         self.outputs: dict[int, list[int]] = {}
         self.prompts: dict[int, list[int]] = {}
         self._finished: set[int] = set()
+        # Interleaved chunked prefill (ISSUE 17): slots whose prompt
+        # is still streaming in, insertion-ordered.  Each step()
+        # advances AT MOST ONE chunk of the oldest entry before
+        # decoding, so a long prompt can never starve active streams'
+        # TPOT — prefill work per tick is bounded by prefill_chunk.
+        self._interleave = bool(interleave_prefill)
+        self._prefilling: dict[int, list] = {}   # slot -> [rid, prompt,
+        #                                          budget, written]
 
-        self._prefill_fn = self._make_prefill()
-        self._step_fn = self._jit_step()
-        self._step_many_fn = self._jit_step_many()
+        if self._paged is not None:
+            self._prefill_fn = self._make_prefill_paged()
+            self._step_fn = self._jit_step_paged()
+            self._step_many_fn = None
+        else:
+            self._prefill_fn = self._make_prefill()
+            self._step_fn = self._jit_step()
+            self._step_many_fn = self._jit_step_many()
 
     # ---- jitted programs -------------------------------------------------
 
@@ -257,6 +323,61 @@ class DecodeServer:
     def _jit_step(self):
         # Donated cache: the decode step rewrites the pool in place.
         return jax.jit(self._make_step(), donate_argnums=(1,))
+
+    def _make_prefill_paged(self):
+        """Paged prefill, shaped like the dense one so
+        :meth:`_run_prefill` (bucketing + chunk streaming) drives both:
+        gather the slot's blocks into a dense row, run the same
+        forward, scatter the whole row back to its physical blocks.
+        The wrapper resolves the slot's block table host-side; the
+        jitted inner program takes the ids as data, so one compile
+        serves every slot and every (re)allocation."""
+        from .paged_kv import gather_row, scatter_row
+
+        cfg, mesh, ep_axis = self._cfg, self._mesh, self._ep_axis
+
+        def fn(params, pool, row_ids, prompt, start, length):
+            row = gather_row(pool, row_ids)
+            s_pad = prompt.shape[1]
+            mask = (jnp.arange(s_pad)[None, :] < length)
+            logits, row = forward_with_cache(
+                params, prompt, row, start, cfg, mesh=mesh,
+                ep_axis=ep_axis, token_mask=mask,
+                last_index=(length - 1)[None])
+            pool = scatter_row(pool, row, row_ids)
+            return pool, logits[0, 0]                  # (V,)
+
+        jit_fn = jax.jit(fn, donate_argnums=(1,))
+
+        def wrapper(params, pool, prompt, slot, start, length):
+            return jit_fn(params, pool,
+                          self._paged.device_row(int(slot)), prompt,
+                          start, length)
+
+        return wrapper
+
+    def _jit_step_paged(self):
+        """The paged decode step: gather table-selected blocks to a
+        dense view, run the SAME step computation, scatter back only
+        the one block per active slot the step wrote (inactive slots
+        redirect to the trash block — their frozen-position write must
+        never land in a block reallocated to another request)."""
+        from .paged_kv import gather_dense, scatter_step
+
+        step = self._make_step()
+        bt = self._paged.block_tokens
+        trash = self._paged.trash
+
+        def fn(params, pool, table, lens, last, active, key):
+            dense = gather_dense(pool, table)
+            pos = lens                    # position this step writes
+            dense, new_lens, nxt = step(params, dense, lens, last,
+                                        active, key)
+            pool = scatter_step(pool, dense, table, pos, active,
+                                trash, bt)
+            return pool, new_lens, nxt
+
+        return jax.jit(fn, donate_argnums=(1,))
 
     def _jit_step_many(self):
         step = self._make_step()
@@ -443,6 +564,11 @@ class DecodeServer:
                 "prefix caching is a dense-family option: MoE expert "
                 "capacity is shape-derived, so suffix prefill would "
                 "differ from a solo run and change which tokens drop")
+        if self._paged is not None:
+            raise ValueError(
+                "prefix caching is not paged yet: the absorb copy "
+                "assumes contiguous per-slot cache rows — register "
+                "prefixes on a dense server")
         toks = [int(t) for t in tokens]
         if not toks:
             raise ValueError("empty prefix")
@@ -511,55 +637,87 @@ class DecodeServer:
 
     def _admit_pending(self) -> None:
         while self._pending and self._free:
-            rid, prompt, budget = self._pending.pop(0)
-            slot = self._free.pop(0)
-            pid = self._match_prefix(prompt)
-            if pid is not None:
-                ptoks, buf_t, buf_d, plogits = self._prefixes[pid]
-                n_pfx = len(ptoks)
-                suffix = prompt[n_pfx:]
-                self._cache = self._absorb_fn(self._cache, buf_t,
-                                              jnp.int32(slot))
-                if suffix:
-                    self._cache, last_logits = self._run_prefill(
-                        self._prefill_fn, self._params, self._cache,
-                        suffix, slot, start=n_pfx)
-                else:
-                    last_logits = plogits
-                if self._draft_cfg is not None:
-                    self._cache_d = self._absorb_fn(
-                        self._cache_d, buf_d, jnp.int32(slot))
-                    if suffix:
-                        self._cache_d, _ = self._run_prefill(
-                            self._prefill_d, self._draft_params,
-                            self._cache_d, suffix, slot, start=n_pfx)
-            else:
+            rid, prompt, budget = self._pending[0]
+            slot = self._free[0]
+            if self._paged is not None:
+                # Worst-case block reservation at admission, so a
+                # stream can never stall mid-decode on allocation.
+                # Exhaustion leaves the request PENDING — it admits
+                # when finishing streams free blocks.  The gateway's
+                # accounting allocator normally prevents reaching
+                # this; it is the worker-side backstop.
+                from ..serving_fast.paging import BlocksExhausted
+                try:
+                    self._paged.alloc(slot, len(prompt) + budget)
+                except BlocksExhausted:
+                    break
+            self._pending.pop(0)
+            self._free.pop(0)
+            if (self._interleave
+                    and len(prompt) > self._prefill_chunk):
+                # Long prompt: stream it in chunk-by-chunk across
+                # decode ticks instead of stalling the batch for one
+                # monolithic prefill.  The slot is reserved (and its
+                # blocks held) but stays inactive until the last
+                # chunk; lens tracks the written offset so the decode
+                # step's frozen-position write for this inactive row
+                # always lands exactly where the NEXT chunk will
+                # write (dense pool; the paged scatter redirects
+                # inactive rows to trash anyway).
+                self._prefilling[slot] = [rid, prompt, budget, 0]
+                self._lens = self._lens.at[slot].set(0)
+                continue
+            self._admit_now(slot, rid, prompt, budget)
+
+    def _admit_now(self, slot: int, rid: int, prompt: list[int],
+                   budget: int) -> None:
+        pid = self._match_prefix(prompt)
+        if pid is not None:
+            ptoks, buf_t, buf_d, plogits = self._prefixes[pid]
+            n_pfx = len(ptoks)
+            suffix = prompt[n_pfx:]
+            self._cache = self._absorb_fn(self._cache, buf_t,
+                                          jnp.int32(slot))
+            if suffix:
                 self._cache, last_logits = self._run_prefill(
                     self._prefill_fn, self._params, self._cache,
-                    prompt, slot)
-                if self._draft_cfg is not None:
-                    # Draft cache prefills the same prompt (its seed
-                    # logits are discarded — the target seeds the
-                    # stream).
+                    suffix, slot, start=n_pfx)
+            else:
+                last_logits = plogits
+            if self._draft_cfg is not None:
+                self._cache_d = self._absorb_fn(
+                    self._cache_d, buf_d, jnp.int32(slot))
+                if suffix:
                     self._cache_d, _ = self._run_prefill(
                         self._prefill_d, self._draft_params,
-                        self._cache_d, prompt, slot)
-            tok = int(_sample(last_logits[None], self._temperature,
-                              self._sample_key(), self._top_k,
-                              self._top_p)[0])
-            self.outputs[rid].append(tok)
-            self._lens = self._lens.at[slot].set(len(prompt))
-            self._last = self._last.at[slot].set(tok)
+                        self._cache_d, suffix, slot, start=n_pfx)
+        else:
+            self._cache, last_logits = self._run_prefill(
+                self._prefill_fn, self._params, self._cache,
+                prompt, slot)
             if self._draft_cfg is not None:
-                self._lens_d = self._lens_d.at[slot].set(len(prompt))
-            done = (budget == 1
-                    or (self._eos is not None and tok == self._eos))
-            if done:
-                self._finish(slot, rid)
-            else:
-                self._slot_req[slot] = rid
-                self._budget[rid] = budget - 1
-                self._active = self._active.at[slot].set(True)
+                # Draft cache prefills the same prompt (its seed
+                # logits are discarded — the target seeds the
+                # stream).
+                self._cache_d, _ = self._run_prefill(
+                    self._prefill_d, self._draft_params,
+                    self._cache_d, prompt, slot)
+        tok = int(_sample(last_logits[None], self._temperature,
+                          self._sample_key(), self._top_k,
+                          self._top_p)[0])
+        self.outputs[rid].append(tok)
+        self._lens = self._lens.at[slot].set(len(prompt))
+        self._last = self._last.at[slot].set(tok)
+        if self._draft_cfg is not None:
+            self._lens_d = self._lens_d.at[slot].set(len(prompt))
+        done = (budget == 1
+                or (self._eos is not None and tok == self._eos))
+        if done:
+            self._finish(slot, rid)
+        else:
+            self._slot_req[slot] = rid
+            self._budget[rid] = budget - 1
+            self._active = self._active.at[slot].set(True)
 
     def _finish(self, slot: int, rid: int) -> None:
         self._finished.add(rid)
@@ -567,20 +725,104 @@ class DecodeServer:
         self._budget.pop(rid, None)
         self._active = self._active.at[slot].set(False)
         self._free.append(slot)
+        if self._paged is not None:
+            self._paged.free(slot)
+
+    def _advance_prefill(self) -> None:
+        """Advance AT MOST ONE chunk of the oldest mid-prefill prompt
+        — the per-tick prefill work bound that keeps long prompts from
+        starving active streams' TPOT.  The final (possibly partial)
+        chunk samples the first token and activates the slot; the
+        segmentation matches :meth:`_run_prefill` exactly (full chunks,
+        then a tail run at its real length), so the stream is
+        bit-identical to a monolithic admission."""
+        if not self._prefilling:
+            return
+        slot, st = next(iter(self._prefilling.items()))
+        rid, prompt, budget, written = st
+        ck = self._prefill_chunk
+        remaining = len(prompt) - written
+        if remaining > ck:
+            seg = jnp.asarray(prompt[written:written + ck],
+                              jnp.int32)[None, :]
+            self._cache, _ = self._prefill_fn(
+                self._params, self._cache, seg, jnp.int32(slot),
+                jnp.int32(written), jnp.int32(ck))
+            st[3] = written + ck
+            # Keep lens at the written frontier: the decode step's
+            # frozen-position write for this inactive row lands where
+            # the next chunk will overwrite it (dense pool).
+            self._lens = self._lens.at[slot].set(st[3])
+            return
+        # Final segment: pad to the chunk shape, clamp so the padded
+        # write never reaches past max_len (same rule as
+        # _run_prefill's tail).
+        tail = prompt[written:]
+        seg_len = min(ck, self._T - written)
+        seg = jnp.asarray(tail + [0] * (seg_len - len(tail)),
+                          jnp.int32)[None, :]
+        self._cache, last_logits = self._prefill_fn(
+            self._params, self._cache, seg, jnp.int32(slot),
+            jnp.int32(written), jnp.int32(len(tail)))
+        del self._prefilling[slot]
+        tok = int(_sample(last_logits[None], self._temperature,
+                          self._sample_key(), self._top_k,
+                          self._top_p)[0])
+        self.outputs[rid].append(tok)
+        self._lens = self._lens.at[slot].set(len(prompt))
+        self._last = self._last.at[slot].set(tok)
+        if budget == 1 or (self._eos is not None
+                           and tok == self._eos):
+            self._finish(slot, rid)
+        else:
+            self._slot_req[slot] = rid
+            self._budget[rid] = budget - 1
+            self._active = self._active.at[slot].set(True)
+
+    def cancel(self, rid: int) -> bool:
+        """Abort an in-flight request NOW: drop it from the pending
+        queue, the prefill stream, or its active slot, freeing the
+        slot and (paged mode) its KV blocks.  Returns False for
+        unknown/already-finished ids.  The shed/release path uses
+        this — a shed request must not pin blocks until its stream
+        would have ended."""
+        for i, (r, _p, _b) in enumerate(self._pending):
+            if r == rid:
+                self._pending.pop(i)
+                self._finished.add(rid)
+                return True
+        for slot, st in list(self._prefilling.items()):
+            if st[0] == rid:
+                del self._prefilling[slot]
+                self._finish(slot, rid)
+                return True
+        for slot, r in list(self._slot_req.items()):
+            if r == rid:
+                self._finish(slot, rid)
+                return True
+        return False
 
     def step(self) -> dict[int, list[int]]:
         """One decode step for every active slot; returns
         {request_id: tokens emitted this step} — one token per step in
         plain mode, 1..gamma+1 in speculative mode.  Admits pending
-        requests first."""
+        requests first, then advances at most one mid-prefill chunk
+        (interleave mode)."""
         self._admit_pending()
+        self._advance_prefill()
         if not self._slot_req:
             return {}
         if self._draft_cfg is not None:
             return self._spec_step()
-        self._cache, self._lens, nxt = self._step_fn(
-            self._params, self._cache, self._lens, self._last,
-            self._active, self._sample_key())
+        if self._paged is not None:
+            self._cache, self._lens, nxt = self._step_fn(
+                self._params, self._cache,
+                self._paged.device_table(), self._lens, self._last,
+                self._active, self._sample_key())
+        else:
+            self._cache, self._lens, nxt = self._step_fn(
+                self._params, self._cache, self._lens, self._last,
+                self._active, self._sample_key())
         self._last = nxt
         toks = jax.device_get(nxt)
         emitted: dict[int, list[int]] = {}
@@ -650,6 +892,11 @@ class DecodeServer:
         if self._draft_cfg is not None:
             raise ValueError("step_many is for plain serving; use "
                              "spec_step_many on a speculative server")
+        if self._paged is not None:
+            raise ValueError(
+                "step_many is a dense-pool fast path; paged serving "
+                "steps host-side per tick (the serve_step driver "
+                "loops step())")
         self._admit_pending()
         if not self._slot_req:
             return {}
@@ -714,8 +961,10 @@ class DecodeServer:
         keeps a long-running server's host memory bounded.  Unknown or
         already-released ids raise (a silent [] would be
         indistinguishable from a request that emitted nothing)."""
-        if rid in self._budget or any(r == rid for r, _, _ in
-                                      self._pending):
+        if rid in self._budget \
+                or any(r == rid for r, _, _ in self._pending) \
+                or any(st[0] == rid
+                       for st in self._prefilling.values()):
             raise ValueError(f"request {rid} is still in flight")
         if rid not in self.outputs:
             raise KeyError(f"unknown or already-released request {rid}")
@@ -725,7 +974,8 @@ class DecodeServer:
         return toks
 
     def done(self) -> bool:
-        return not self._slot_req and not self._pending
+        return (not self._slot_req and not self._pending
+                and not self._prefilling)
 
     def run_until_done(self, max_steps: int | None = None):
         """Drive :meth:`step` until every request finishes; returns
@@ -746,3 +996,10 @@ class DecodeServer:
     @property
     def n_active(self) -> int:
         return len(self._slot_req)
+
+    def kv_snapshot(self) -> dict | None:
+        """Paged-mode block occupancy (``{"blocks", "block_tokens",
+        "used", "free", "owners"}``), None on a dense server — the
+        worker's heartbeat telemetry and status surfaces read this."""
+        return (self._paged.snapshot() if self._paged is not None
+                else None)

@@ -1061,6 +1061,33 @@ class DistributedWorker:
                                f"{pname!r}/{cname!r} — run the model "
                                f"spec first (%dist_serve start)"},
                 rank=self.rank)
+        # Serving fast path (ISSUE 17): paged KV geometry + chunked
+        # prefill, forwarded from the gateway's serve_open.  A chunk
+        # size implies interleaved prefill — long prompts advance one
+        # chunk per tick between decode steps so TPOT stays bounded.
+        kw: dict = {}
+        if data.get("kv_block_tokens"):
+            kw["kv_block_tokens"] = int(data["kv_block_tokens"])
+            if data.get("kv_blocks"):
+                kw["kv_blocks"] = int(data["kv_blocks"])
+        if data.get("prefill_chunk"):
+            kw["prefill_chunk"] = int(data["prefill_chunk"])
+            kw["interleave_prefill"] = True
+        if data.get("kv_quantized"):
+            kw["kv_quantized"] = True
+        # Shard the decode across this rank's addressable devices via
+        # NamedSharding when the KV heads divide evenly (a local
+        # tensor-parallel mesh; CPU CI has one device -> no mesh).
+        try:
+            import jax
+            local = jax.local_devices()
+            n_kv = int(getattr(ns[cname], "n_kv_heads", 0) or 0)
+            if len(local) > 1 and n_kv and n_kv % len(local) == 0:
+                from ..parallel.mesh import make_mesh
+                kw["mesh"] = make_mesh({"tp": len(local)},
+                                       devices=local)
+        except Exception:
+            pass
         try:
             server = DecodeServer(
                 ns[pname], ns[cname],
@@ -1068,7 +1095,8 @@ class DistributedWorker:
                 max_len=int(data.get("max_len") or 512),
                 pad_to=int(data.get("pad_to") or 16),
                 eos_id=data.get("eos_id"),
-                temperature=float(data.get("temperature") or 0.0))
+                temperature=float(data.get("temperature") or 0.0),
+                **kw)
         except Exception as e:
             return msg.reply(data={"error": f"DecodeServer build "
                                             f"failed: {e}"},
@@ -1112,7 +1140,12 @@ class DistributedWorker:
                 try:
                     st.server.release(local)
                 except (KeyError, ValueError):
-                    pass
+                    # Still pending or mid-(chunked-)prefill: cancel
+                    # instead — frees its queue entry and KV blocks.
+                    try:
+                        st.server.cancel(local)
+                    except Exception:
+                        pass
         steps = max(0, int(data.get("steps") or 0))
         for _ in range(steps):
             if st.server.done():
@@ -1157,14 +1190,21 @@ class DistributedWorker:
             self._serve_snap = None
             return
         tot = occ = slots = 0
+        kv_used = kv_total = 0
         tps = 0.0
         for st in self._serve.values():
             tot += st.tokens_total
             occ += st.server.n_active
             slots += st.server._B
             tps += st.tokens_per_s()
+            kv = st.server.kv_snapshot()
+            if kv is not None:
+                kv_used += kv["used"]
+                kv_total += kv["blocks"]
         self._serve_snap = {"tok": tot, "tps": round(tps, 2),
-                            "occ": occ, "slots": slots}
+                            "occ": occ, "slots": slots,
+                            **({"kvb": [kv_used, kv_total]}
+                               if kv_total else {})}
 
     def _park(self, msg_type: str, msg_id: str, reply: Message) -> None:
         """Park a reply for redelivery to a future coordinator.

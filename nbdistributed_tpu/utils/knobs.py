@@ -217,6 +217,42 @@ _ALL = (
        "redelivered under the same message id (replay-cache dedup), "
        "and an exhausted retry budget fails over to the next live "
        "rank.", "serve"),
+    # --- serving fast path (paged KV + multi-rank decode, ISSUE 17) ------
+    _k("NBD_KV_BLOCK_TOKENS", "64", "int",
+       "Paged-KV block size in tokens: each serving request reserves "
+       "ceil((prompt + max_new) / block) fixed-size cache blocks at "
+       "admission, so capacity is measured in blocks rather than "
+       "sequences.  0 keeps the dense per-slot cache.", "serve"),
+    _k("NBD_KV_BLOCKS_PER_RANK", "0", "int",
+       "Paged-KV pool size per decode rank.  0 derives the dense "
+       "pool's exact capacity (max_batch x ceil(max_len / block)), so "
+       "paging alone never refuses a request the dense server would "
+       "have taken; set lower to bound HBM and surface explicit "
+       "kv-exhausted verdicts.", "serve"),
+    _k("NBD_PREFILL_CHUNK_TOKENS", "0", "int",
+       "Chunked-prefill segment size for the serving plane: prompts "
+       "longer than this stream in one chunk per decode tick, "
+       "interleaved with active streams, so a long prompt can never "
+       "starve TPOT.  0 keeps monolithic prefill-on-admit.", "serve"),
+    _k("NBD_SERVE_DECODE_RANKS", "1", "int",
+       "Decode ranks the serving driver shards requests across "
+       "(highest live ranks first; rank 0 last — it hosts "
+       "jax.distributed).  0 = every live rank.  Each rank runs its "
+       "own DecodeServer; the journal-replay failover covers any "
+       "subset dying.", "serve"),
+    _k("NBD_LOADGEN_RPS", "4", "float",
+       "nbd-loadgen: offered request rate (arrivals per second) of "
+       "the closed-loop load run.", "serve"),
+    _k("NBD_LOADGEN_DURATION_S", "15", "float",
+       "nbd-loadgen: length of the offered-arrival schedule; the run "
+       "then drains in-flight requests before reporting.", "serve"),
+    _k("NBD_LOADGEN_ARRIVAL", "poisson", "str",
+       "nbd-loadgen: arrival process — poisson (exponential gaps) or "
+       "uniform (fixed 1/RPS gaps).", "serve"),
+    _k("NBD_LOADGEN_SEED", "0", "int",
+       "nbd-loadgen: seed of the deterministic arrival/length "
+       "schedule (same seed + config = same offered load, "
+       "byte-for-byte).", "serve"),
     # --- flight recorder / observability ---------------------------------
     _k("NBD_FLIGHT", "1", "bool",
        "Always-on mmap flight recorder; 0 disables.", "observability"),

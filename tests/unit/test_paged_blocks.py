@@ -1,0 +1,301 @@
+"""Paged KV block allocator + loadgen report schema (ISSUE 17).
+
+Pure-logic units, no jax: the block-accounting arithmetic the gateway
+admission gate and the worker device pool both run, and the pinned
+machine-readable report surface of the closed-loop load generator.
+"""
+
+import pytest
+
+from nbdistributed_tpu.serving_fast import (BlockAllocator,
+                                            BlocksExhausted,
+                                            LoadConfig, blocks_needed,
+                                            score_slo, synth_schedule,
+                                            validate_report)
+from nbdistributed_tpu.serving_fast.loadgen import percentile, run_load
+
+pytestmark = [pytest.mark.unit, pytest.mark.serve]
+
+
+# ----------------------------------------------------------------------
+# blocks_needed
+
+
+def test_blocks_needed_ceil():
+    assert blocks_needed(0, 8) == 0
+    assert blocks_needed(-3, 8) == 0
+    assert blocks_needed(1, 8) == 1
+    assert blocks_needed(8, 8) == 1
+    assert blocks_needed(9, 8) == 2
+    assert blocks_needed(64, 8) == 8
+    assert blocks_needed(65, 8) == 9
+
+
+# ----------------------------------------------------------------------
+# alloc / free / reuse
+
+
+def test_alloc_free_reuse_deterministic():
+    a = BlockAllocator(8, 4)
+    t1 = a.alloc("r1", 3)
+    assert t1 == [0, 1, 2]
+    t2 = a.alloc("r2", 2)
+    assert t2 == [3, 4]
+    assert a.used_blocks == 5 and a.free_blocks == 3
+    a.check()
+    # Free r1; the free list re-sorts so the NEXT alloc takes the
+    # lowest ids — allocation order is a pure function of history.
+    assert a.free("r1") == 3
+    t3 = a.alloc("r3", 4)
+    assert t3 == [0, 1, 2, 5]
+    a.check()
+    # Double-free is a safe no-op (release may race a finish).
+    assert a.free("r1") == 0
+    a.check()
+
+
+def test_alloc_all_or_nothing_and_double_admission():
+    a = BlockAllocator(4, 4)
+    a.alloc("r1", 2)
+    # Exhaustion: explicit verdict carrying need/free, nothing taken.
+    with pytest.raises(BlocksExhausted) as exc:
+        a.alloc("r2", 3)
+    assert exc.value.need == 3 and exc.value.free == 2
+    assert a.free_blocks == 2       # the failed alloc took nothing
+    a.check()
+    # Double-admission is a caller bug, not a capacity condition.
+    with pytest.raises(ValueError):
+        a.alloc("r1", 1)
+    a.check()
+
+
+def test_block_table_growth():
+    a = BlockAllocator(6, 4)
+    a.alloc("r1", 2)
+    grown = a.extend("r1", 2)
+    assert grown == [2, 3]
+    assert a.table("r1") == [0, 1, 2, 3]
+    assert a.owner_blocks("r1") == 4
+    with pytest.raises(BlocksExhausted):
+        a.extend("r1", 3)
+    assert a.table("r1") == [0, 1, 2, 3]    # all-or-nothing
+    with pytest.raises(KeyError):
+        a.extend("ghost", 1)
+    a.check()
+
+
+def test_can_fit_matches_alloc_verdict():
+    a = BlockAllocator(4, 8)
+    assert a.can_fit(32)            # 4 blocks exactly
+    assert not a.can_fit(33)        # needs 5
+    a.alloc("r1", 3)
+    assert a.can_fit(8) and not a.can_fit(9)
+
+
+# ----------------------------------------------------------------------
+# defrag
+
+
+def test_defrag_compacts_and_conserves():
+    a = BlockAllocator(10, 4)
+    a.alloc("r1", 3)                # [0,1,2]
+    a.alloc("r2", 3)                # [3,4,5]
+    a.alloc("r3", 2)                # [6,7]
+    a.free("r2")
+    a.check()
+    before = {o: a.owner_blocks(o) for o in a.owners()}
+    moves = a.defrag()
+    a.check()
+    # Only genuinely moving blocks appear in the map; live blocks are
+    # dense from 0, owner tables keep their logical order and sizes.
+    assert moves == {6: 3, 7: 4}
+    assert a.table("r1") == [0, 1, 2]
+    assert a.table("r3") == [3, 4]
+    assert {o: a.owner_blocks(o) for o in a.owners()} == before
+    assert a.free_blocks == 5
+    # Post-defrag allocation continues from the compacted frontier.
+    assert a.alloc("r4", 2) == [5, 6]
+    a.check()
+
+
+def test_defrag_noop_when_dense():
+    a = BlockAllocator(4, 4)
+    a.alloc("r1", 2)
+    assert a.defrag() == {}
+    a.check()
+
+
+def test_reset_returns_everything():
+    a = BlockAllocator(5, 4)
+    a.alloc("r1", 4)
+    a.reset()
+    assert a.free_blocks == 5 and a.owners() == []
+    a.check()
+
+
+def test_snapshot_shape():
+    a = BlockAllocator(6, 8)
+    a.alloc("r1", 2)
+    a.alloc("r2", 1)
+    snap = a.snapshot()
+    assert snap == {"blocks": 6, "block_tokens": 8, "used": 3,
+                    "free": 3, "owners": {"r1": 2, "r2": 1}}
+
+
+def test_ctor_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(0, 4)
+    with pytest.raises(ValueError):
+        BlockAllocator(4, 0)
+
+
+# ----------------------------------------------------------------------
+# loadgen: deterministic schedule
+
+
+def test_schedule_deterministic_and_in_window():
+    cfg = LoadConfig(rps=10.0, duration_s=3.0, seed=42)
+    p1 = synth_schedule(cfg)
+    p2 = synth_schedule(LoadConfig(rps=10.0, duration_s=3.0, seed=42))
+    assert p1 == p2
+    assert p1                       # 10 rps * 3 s: surely non-empty
+    assert all(0 <= it["at"] < 3.0 for it in p1)
+    ats = [it["at"] for it in p1]
+    assert ats == sorted(ats)
+    for it in p1:
+        assert 4 <= len(it["prompt"]) <= 16
+        assert 4 <= it["max_new"] <= 16
+        assert all(1 <= t < cfg.vocab for t in it["prompt"])
+    # A different seed offers different work.
+    assert p1 != synth_schedule(
+        LoadConfig(rps=10.0, duration_s=3.0, seed=43))
+
+
+def test_schedule_uniform_gap():
+    cfg = LoadConfig(rps=4.0, duration_s=2.0, arrival="uniform")
+    plan = synth_schedule(cfg)
+    gaps = {round(b["at"] - a["at"], 9)
+            for a, b in zip(plan, plan[1:])}
+    assert gaps == {0.25}
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        LoadConfig(rps=0)
+    with pytest.raises(ValueError):
+        LoadConfig(arrival="bursty")
+    with pytest.raises(ValueError):
+        LoadConfig(prompt_len=(0, 4))
+    with pytest.raises(ValueError):
+        LoadConfig(max_new=(5, 4))
+
+
+# ----------------------------------------------------------------------
+# loadgen: report schema (pinned), conservation, SLO scoring
+
+
+class InstantTransport:
+    """Terminalizes every accepted request on the first poll: enough
+    to drive a real ``run_load`` pass in milliseconds."""
+
+    def __init__(self, *, reject_every: int = 0):
+        self.n = 0
+        self.reject_every = reject_every
+        self.open: dict[str, dict] = {}
+
+    def submit(self, prompt, max_new, priority=0):
+        self.n += 1
+        if self.reject_every and self.n % self.reject_every == 0:
+            return {"status": "shed", "reason": "queue-full"}
+        rid = f"r{self.n}"
+        self.open[rid] = {"rid": rid, "done": True,
+                          "status": "completed",
+                          "tokens": list(range(max_new))}
+        return {"status": "accepted", "rid": rid}
+
+    def result(self, rid):
+        return self.open[rid]
+
+    def status(self):
+        return {"slo": {"ttft": {"p99": 0.001}}}
+
+
+def _tiny_cfg(**kw):
+    kw.setdefault("rps", 200.0)
+    kw.setdefault("duration_s", 0.05)
+    kw.setdefault("drain_s", 5.0)
+    kw.setdefault("poll_s", 0.001)
+    return LoadConfig(**kw)
+
+
+def test_report_schema_pinned_and_conserved():
+    tr = InstantTransport(reject_every=3)
+    rep = run_load(tr, _tiny_cfg(seed=1))
+    validate_report(rep)            # raises on any schema violation
+    assert rep["offered"] == (rep["completed"] + rep["failed"]
+                              + rep["shed"] + rep["rejected"]
+                              + rep["hung"])
+    assert rep["shed"] > 0 and rep["completed"] > 0
+    assert rep["hung"] == 0
+    assert rep["server_slo"] == {"ttft": {"p99": 0.001}}
+    assert rep["slo"]["pass"] is True     # no targets, nothing hung
+    # The pinned surface: removing/renaming any of these is a breaking
+    # change this test exists to catch.
+    for k in ("schema", "config", "offered", "accepted", "rejected",
+              "shed", "completed", "failed", "hung", "shed_rate",
+              "tokens_total", "tokens_per_s", "duration_s", "client",
+              "server_slo", "slo"):
+        assert k in rep, k
+
+
+def test_validate_report_rejects_broken_conservation():
+    rep = run_load(InstantTransport(), _tiny_cfg(seed=2))
+    validate_report(rep)
+    rep["completed"] += 1           # a silently-duplicated verdict
+    with pytest.raises(ValueError, match="conservation"):
+        validate_report(rep)
+    rep["completed"] -= 1
+    del rep["tokens_per_s"]
+    with pytest.raises(ValueError, match="missing"):
+        validate_report(rep)
+
+
+def test_report_detail_per_request():
+    rep = run_load(InstantTransport(reject_every=4),
+                   _tiny_cfg(seed=5, detail=True))
+    validate_report(rep)            # "requests" is additive, not pinned
+    reqs = rep["requests"]
+    assert len(reqs) == rep["offered"]
+    assert [r["i"] for r in reqs] == sorted(r["i"] for r in reqs)
+    comp = [r for r in reqs if r["status"] == "completed"]
+    assert comp and all(r["tokens"] for r in comp)
+    assert all(r["rid"] is None for r in reqs
+               if r["status"] == "shed")
+
+
+def test_score_slo_hung_always_fails():
+    rep = run_load(InstantTransport(), _tiny_cfg(seed=3))
+    assert rep["slo"]["pass"] is True
+    rep["hung"] = 1
+    verdict = score_slo(rep, _tiny_cfg(seed=3))
+    assert verdict["pass"] is False
+    assert any(c["metric"] == "hung" and not c["ok"]
+               for c in verdict["checks"])
+
+
+def test_score_slo_targets():
+    cfg = _tiny_cfg(seed=4, slo_ttft_p99_ms=1e6)
+    rep = run_load(InstantTransport(), cfg)
+    assert rep["slo"]["pass"] is True
+    tight = _tiny_cfg(seed=4, slo_ttft_p99_ms=0.0)
+    assert score_slo(rep, tight)["pass"] is False
+
+
+def test_percentile_nearest_rank():
+    vals = [float(i) for i in range(1, 101)]
+    assert percentile(vals, 0.50) == 50.0
+    assert percentile(vals, 0.99) == 99.0
+    assert percentile(vals, 1.0) == 100.0
+    assert percentile([7.0], 0.99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 0.5)
