@@ -237,6 +237,12 @@ class GatewayDaemon:
         self._serve_mgr = None
         self._close_lock = threading.Lock()
         self._close_started = False
+        # One process, one black box: the CommunicationManager created
+        # below re-inits the process-global recorder as "coordinator"
+        # (the name postmortem bundles recover), CLOSING any recorder
+        # opened before it.  A separate init("gateway") here used to be
+        # silently dead after that — every daemon record dropped — so
+        # the daemon binds to the comm's live recorder instead (below).
         self.flight = flightrec.init("gateway")
         self.run_dir = flightrec.run_dir()
 
@@ -276,6 +282,10 @@ class GatewayDaemon:
             num_workers=world_size, timeout=request_timeout,
             session_token=session_token, session_epoch=1,
             scheduler=Scheduler(self.policy))
+        # See the note above: the comm's "coordinator" ring is the
+        # live one now; record into it so resize/autoscale/tenant
+        # events actually persist and reach postmortem bundles.
+        self.flight = self.comm.flight
         self.pm = ProcessManager()
         self.pm.add_death_callback(
             lambda r, rc: self.comm.mark_worker_dead(r))
@@ -345,7 +355,8 @@ class GatewayDaemon:
                 self._metrics_httpd = obs_httpd.start_for_comm(
                     self.comm, port=max(0, mp), host=host,
                     token=self.pool_token,
-                    extra_health=self._health_extra)
+                    extra_health=self._health_extra,
+                    extra_latency=self._latency_extra)
             except BaseException:
                 self._tenants_listener.close()
                 self.pm.shutdown()
@@ -689,10 +700,16 @@ class GatewayDaemon:
                     queue_p95_s=float(p95_ms) / 1000.0)
                 if decision is None:
                     continue
+                # Full audit record on the flight ring (ISSUE 18):
+                # the pressure inputs and sustain/cooldown state that
+                # drove the verdict, not just the verdict — this is
+                # what postmortem bundles carry.
                 self.flight.record("autoscale_decision",
                                    action=decision.action,
                                    target=decision.target,
-                                   reason=decision.reason)
+                                   reason=decision.reason,
+                                   **({"audit": decision.record}
+                                      if decision.record else {}))
                 obs_metrics.registry().counter(
                     "nbd_autoscale_decisions_total",
                     "autoscaler grow/shrink decisions",
@@ -1609,6 +1626,14 @@ class GatewayDaemon:
                 "active": sched.get("active", 0),
                 "serving": self._serve_mgr is not None}
 
+    def _latency_extra(self) -> dict:
+        """Serving block of the /latency.json payload (ISSUE 18):
+        the serving observatory's stage summary + utilization ring."""
+        mgr = self._serve_mgr
+        if mgr is None:
+            return {}
+        return {"serving": mgr.obs.status_block()}
+
     def status(self) -> dict:
         """The ``%dist_pool status`` payload: scheduler counters,
         tenant table, and a per-rank busy view (tenant-attributed)
@@ -1642,6 +1667,10 @@ class GatewayDaemon:
                "membership": self.membership.describe(),
                "autoscale": (a.policy.describe()
                              if a is not None else None),
+               # Decision audit ring (ISSUE 18): %dist_pool status
+               # --autoscale renders these.
+               "autoscale_decisions": (a.decisions(32)
+                                       if a is not None else None),
                "scheduler": sched,
                "tenants": self.registry.describe(),
                "ranks": ranks, "hang_verdicts": wd,

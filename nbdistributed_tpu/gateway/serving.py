@@ -77,6 +77,7 @@ from collections import deque
 from ..messaging.codec import Message
 from ..observability import latency as obs_latency
 from ..observability import metrics as obs_metrics
+from ..observability.servingobs import ServingObservatory
 from ..serving_fast.paging import BlockAllocator, blocks_needed
 from ..utils import knobs
 from .scheduler import ACTIVE, SchedPolicy, Scheduler
@@ -481,6 +482,19 @@ class ServingManager:
         # The histograms below carry the full distributions for
         # /metrics; the ring keeps exact recent percentiles cheap.
         self._slo: deque = deque(maxlen=256)
+        # Serving observatory (ISSUE 18): per-request stage
+        # attribution + per-tick utilization telemetry.  Worker
+        # emission stamps are corrected through the coordinator's
+        # per-rank offset estimator when the comm carries one.
+        self.obs = ServingObservatory(
+            clock=getattr(comm, "clock", None))
+        # Deferred-placement memo: the last set of rids that waited a
+        # tick with no rank able to hold them, so the flight ring gets
+        # ONE record per defer episode, not one per tick.
+        self._last_deferred: frozenset = frozenset()
+        # Ranks whose KV gauges were last published (driver thread
+        # only): a retired rank's series is zeroed the next tick.
+        self._gauged_ranks: set[int] = set()
 
     def _slo_hist(self, name: str, help: str, tenant: str):
         """Per-SUBMITTING-tenant SLO histogram, resolved through the
@@ -568,6 +582,7 @@ class ServingManager:
             if r["done"] is not None \
                     or len(r["tokens"]) >= r["max_new"]:
                 continue
+            self.obs.begin(rid, r["tenant"] or "unknown")
             ticket = self.sched.submit(r["tenant"] or "unknown", rid,
                                        r["prio"])
             req = _Req(rid, r["tenant"], list(r["prompt"]),
@@ -746,6 +761,13 @@ class ServingManager:
         need = blocks_needed(len(prompt) + int(max_new),
                              self.kv_block_tokens)
         if need > self.kv_blocks_per_rank:
+            # Capacity decision on the flight ring (ISSUE 18): the
+            # allocator state that drove it is static here — no rank
+            # can EVER hold this footprint.
+            self._record("serve_kv_reject", tenant=tenant_name,
+                         need_blocks=need,
+                         kv_blocks_per_rank=self.kv_blocks_per_rank,
+                         prompt_len=len(prompt), max_new=int(max_new))
             return {"status": REJECTED_V, "reason": "kv-exhausted",
                     "error": f"request needs {need} KV blocks "
                              f"({len(prompt)} prompt + {max_new} new "
@@ -755,6 +777,7 @@ class ServingManager:
         with self._lock:
             rid = f"r{self._next_rid}"
             self._next_rid += 1
+        self.obs.begin(rid, tenant_name)
         ticket = self.sched.submit(tenant_name, rid, int(priority))
         v = ticket.verdict
         if v["status"] == "rejected":
@@ -764,6 +787,7 @@ class ServingManager:
                         "serving requests by admission verdict",
                         {"tenant": self.tenant,
                          "verdict": "rejected"}).inc()
+            self.obs.drop(rid)
             return {"status": REJECTED_V,
                     "reason": v.get("reason", "rejected"),
                     "error": f"request rejected: "
@@ -775,6 +799,7 @@ class ServingManager:
             reg.counter("nbd_serve_requests_total",
                         "serving requests by admission verdict",
                         {"tenant": self.tenant, "verdict": "shed"}).inc()
+            self.obs.drop(rid)
             self._shed_victims(v.get("victims") or ())
             return {"status": SHED_V, "reason": "overload",
                     "error": "request shed under overload: the serve "
@@ -794,6 +819,7 @@ class ServingManager:
         reg.counter("nbd_serve_requests_total",
                     "serving requests by admission verdict",
                     {"tenant": self.tenant, "verdict": "accepted"}).inc()
+        self.obs.note_admit(rid)
         self._record("serve_accept", rid=rid, tenant=tenant_name,
                      queued=v["status"] == "queued")
         self._shed_victims(v.get("victims") or ())
@@ -939,7 +965,8 @@ class ServingManager:
                              and r.rank == rank)
                 ranks[str(rank)] = {"placed": placed,
                                     "kv_used": alloc.used_blocks,
-                                    "kv_free": alloc.free_blocks}
+                                    "kv_free": alloc.free_blocks,
+                                    "frag": alloc.largest_free_run()}
             d["ranks"] = ranks
             # Per-SUBMITTING-tenant block counts (%dist_serve status).
             by_tenant: dict[str, int] = {}
@@ -957,6 +984,10 @@ class ServingManager:
                        "tenants": by_tenant}
         d["scheduler"] = self.sched.snapshot()
         d["slo"] = self._slo_summary(slo_entries)
+        # Serving observatory (ISSUE 18): stage-attribution summary +
+        # recent records (the %dist_serve lat table/waterfall source)
+        # and per-tick utilization for the status surfaces.
+        d["lat"] = self.obs.status_block(records=64)
         return d
 
     def forget_tenant(self, name: str) -> None:
@@ -1066,9 +1097,13 @@ class ServingManager:
         with self._lock:
             if rank is None:
                 lost = sorted(self._open)
+                snaps = {str(r): self._open[r].snapshot()
+                         for r in lost}
                 self._open.clear()
             else:
-                self._open.pop(rank, None)
+                gone = self._open.pop(rank, None)
+                snaps = {str(rank): gone.snapshot()} \
+                    if gone is not None else {}
                 lost = [rank]
             self.failovers += 1
             self._unbind_rank_locked(rank)
@@ -1076,7 +1111,7 @@ class ServingManager:
             "nbd_serve_failovers_total",
             "decode-rank failovers (rank death or step-retry budget "
             "exhausted)", {"tenant": self.tenant}).inc()
-        self._record("serve_failover", lost_ranks=lost)
+        self._record("serve_failover", lost_ranks=lost, kv=snaps)
         for lr in lost:
             # Best-effort: if the rank is merely unreachable (not
             # dead), free its now-orphaned DecodeServer.
@@ -1093,15 +1128,17 @@ class ServingManager:
         replay path and close its server.  Not a failover — the rank
         is healthy, just no longer chosen."""
         with self._lock:
-            if self._open.pop(rank, None) is None:
+            gone = self._open.pop(rank, None)
+            if gone is None:
                 return
+            snap = gone.snapshot()
             self._unbind_rank_locked(rank)
         try:
             self.comm.post([rank], "serve_close",
                            {"tenant": self.tenant})
         except Exception:
             pass
-        self._record("serve_rank_retired", rank=rank)
+        self._record("serve_rank_retired", rank=rank, kv=snap)
 
     def _open_on(self, rank: int) -> None:
         resp = self.comm.send_to_ranks(
@@ -1147,13 +1184,18 @@ class ServingManager:
         request no rank can hold right now simply waits — blocks free
         as peers finish, and the ticket stays ACTIVE.
 
-        Returns ``(admits, release, qwaits)``: per-rank admit payload
-        lists, per-rank release rid lists, and ``(tenant,
+        Returns ``(admits, release, qwaits, events)``: per-rank admit
+        payload lists, per-rank release rid lists, ``(tenant,
         queue_wait_s)`` for each FIRST placement — observed into the
-        SLO histograms by the caller, outside the lock."""
+        SLO histograms by the caller, outside the lock — and flight
+        events (placement / defer decisions with the allocator
+        snapshots that drove them, ISSUE 18) the caller records
+        outside the lock."""
         admits: dict[int, list[dict]] = {}
         release: dict[int, list[str]] = {}
         qwaits = []
+        events: list[dict] = []
+        deferred: list[str] = []
         replays = 0
         now = time.time()
         placed_n = {rank: 0 for rank in self._open}
@@ -1176,12 +1218,27 @@ class ServingManager:
                         > self._open[best].free_blocks:
                     best = rank
             if best is None:
+                # Park: the ticket stays ACTIVE and blocks free as
+                # peers finish.  The defer decision reaches the flight
+                # ring (once per episode) with the occupancy that
+                # drove it.
+                deferred.append(r.rid)
                 continue
+            t_alloc0 = time.perf_counter()
             self._open[best].alloc(r.rid, need)
+            kv_alloc_s = time.perf_counter() - t_alloc0
             placed_n[best] += 1
             r.rank = best
             r.base = len(r.tokens)
             r.placed = True
+            pf_chunk = self.prefill_chunk or self.max_len
+            self.obs.note_placed(
+                r.rid, best, kv_alloc_s=kv_alloc_s, need_blocks=need,
+                pf_total=-(-len(r.prompt) // max(1, pf_chunk)), t=now)
+            events.append({"event": "serve_place", "rid": r.rid,
+                           "rank": best, "need_blocks": need,
+                           "kv_free": self._open[best].free_blocks,
+                           "replay": bool(r.replay)})
             if r.placed_ts is None:
                 # First placement only: a failover re-admission is a
                 # heal, not queue wait.
@@ -1207,7 +1264,16 @@ class ServingManager:
                 "requests re-admitted from the journal after a "
                 "failover (re-prefill from prompt + emitted prefix)",
                 {"tenant": self.tenant}).inc(replays)
-        return admits, release, qwaits
+        dset = frozenset(deferred)
+        if dset and dset != self._last_deferred:
+            events.append({
+                "event": "serve_defer", "rids": sorted(dset),
+                "kv": {str(rank): {
+                    "free": a.free_blocks,
+                    "largest_run": a.largest_free_run()}
+                    for rank, a in self._open.items()}})
+        self._last_deferred = dset
+        return admits, release, qwaits, events
 
     def _tick(self) -> None:
         target = self._pick_ranks()
@@ -1228,12 +1294,15 @@ class ServingManager:
                     continue
             self._open_on(rank)
         with self._lock:
-            admits, release, qwaits = self._place_admits_locked()
+            admits, release, qwaits, events = \
+                self._place_admits_locked()
             busy = {r.rank for r in self._reqs.values()
                     if r.state == ACCEPTED and r.placed
                     and r.rank is not None}
             ticks = sorted((set(admits) | set(release) | busy)
                            & set(self._open))
+        for ev in events:
+            self._record(**ev)
         for tenant_name, wait in qwaits:
             self._slo_hist(
                 "nbd_serve_queue_wait_seconds",
@@ -1260,7 +1329,8 @@ class ServingManager:
                              error=str(data["error"])[:200])
                 lost.append((rank, str(data["error"])))
                 continue
-            self._apply_reply(data)
+            self._apply_reply(data, rank=rank)
+        self._note_tick_util(ticks, replies)
         self._update_kv_gauges()
         if lost:
             # Every received reply above is already applied, so the
@@ -1333,17 +1403,92 @@ class ServingManager:
                      attempt=attempt + 1,
                      error=f"{type(e).__name__}: {e}")
 
+    def _note_tick_util(self, ticks, replies) -> None:
+        """One utilization sample per decode tick (ISSUE 18): batch
+        fill / KV occupancy / fragmentation from the gateway-side
+        allocator mirrors, prefill-vs-decode token split and worker
+        park depth from the serve_step replies' ``tick`` block."""
+        pf_toks = dc_toks = 0
+        pending: dict[int, int] = {}
+        for rank in ticks:
+            data = replies.get(rank) or {}
+            tk = data.get("tick") or {}
+            pf_toks += int(tk.get("pf") or 0)
+            dc_toks += int(tk.get("dc") or 0)
+            if data.get("pending") is not None:
+                pending[rank] = int(data["pending"])
+        util_ranks: dict[int, dict] = {}
+        with self._lock:
+            placed_by: dict[int, int] = {}
+            backlog = 0
+            for r in self._reqs.values():
+                if r.state != ACCEPTED:
+                    continue
+                if r.placed and r.rank is not None:
+                    placed_by[r.rank] = placed_by.get(r.rank, 0) + 1
+                elif not r.placed:
+                    backlog += 1
+            for rank, alloc in self._open.items():
+                util_ranks[rank] = {
+                    "placed": placed_by.get(rank, 0),
+                    "slots": self.max_batch,
+                    "kv_used": alloc.used_blocks,
+                    "kv_free": alloc.free_blocks,
+                    "frag": alloc.largest_free_run(),
+                    **({"pending": pending[rank]}
+                       if rank in pending else {}),
+                }
+        self.obs.note_util(ranks=util_ranks, prefill_toks=pf_toks,
+                           decode_toks=dc_toks, backlog=backlog,
+                           tenant=self.tenant)
+
     def _update_kv_gauges(self) -> None:
         with self._lock:
-            used = sum(a.used_blocks for a in self._open.values())
-            free = sum(a.free_blocks for a in self._open.values())
+            per_rank = {rank: (a.used_blocks, a.free_blocks)
+                        for rank, a in self._open.items()}
         reg = obs_metrics.registry()
+        # Aggregate series keep their pre-ISSUE-18 label shape
+        # (rank="all") next to the new per-rank series; everything
+        # carries the serving tenant, so tenant eviction's
+        # remove_label_series("tenant", ...) retires rank series too.
+        used = sum(u for u, _ in per_rank.values())
+        free = sum(f for _, f in per_rank.values())
         reg.gauge("nbd_kv_blocks_used",
-                  "KV cache blocks allocated across open decode ranks",
-                  {"tenant": self.tenant}).set(used)
+                  "KV cache blocks allocated per open decode rank "
+                  "(rank=\"all\" aggregates the fleet)",
+                  {"tenant": self.tenant, "rank": "all"}).set(used)
         reg.gauge("nbd_kv_blocks_free",
-                  "KV cache blocks free across open decode ranks",
-                  {"tenant": self.tenant}).set(free)
+                  "KV cache blocks free per open decode rank "
+                  "(rank=\"all\" aggregates the fleet)",
+                  {"tenant": self.tenant, "rank": "all"}).set(free)
+        for rank, (u, f) in per_rank.items():
+            reg.gauge("nbd_kv_blocks_used",
+                      "KV cache blocks allocated per open decode rank "
+                      "(rank=\"all\" aggregates the fleet)",
+                      {"tenant": self.tenant,
+                       "rank": str(rank)}).set(u)
+            reg.gauge("nbd_kv_blocks_free",
+                      "KV cache blocks free per open decode rank "
+                      "(rank=\"all\" aggregates the fleet)",
+                      {"tenant": self.tenant,
+                       "rank": str(rank)}).set(f)
+        # A retired/lost rank's last gauge value must not linger as a
+        # live-looking series: zero it the tick after it closes.  (The
+        # series itself is retired with the tenant — never via a rank-
+        # label sweep, which would hit other metrics' rank series.)
+        stale = self._gauged_ranks - set(per_rank)
+        for rank in stale:
+            reg.gauge("nbd_kv_blocks_used",
+                      "KV cache blocks allocated per open decode rank "
+                      "(rank=\"all\" aggregates the fleet)",
+                      {"tenant": self.tenant,
+                       "rank": str(rank)}).set(0)
+            reg.gauge("nbd_kv_blocks_free",
+                      "KV cache blocks free per open decode rank "
+                      "(rank=\"all\" aggregates the fleet)",
+                      {"tenant": self.tenant,
+                       "rank": str(rank)}).set(0)
+        self._gauged_ranks = set(per_rank)
 
     def _send_step(self, rank: int, payload: dict) -> dict:
         """One serve_step round trip, redelivered under the SAME
@@ -1374,16 +1519,33 @@ class ServingManager:
         raise _RankLost(f"step retry budget exhausted: {last}",
                         rank=rank)
 
-    def _apply_reply(self, data: dict) -> None:
+    def _apply_reply(self, data: dict,
+                     rank: int | None = None) -> None:
         reg = obs_metrics.registry()
         emitted = data.get("emitted") or {}
         errors = data.get("errors") or {}
+        # ISSUE 18 tick telemetry: the worker's wall clock at reply
+        # time (clock-corrected per rank inside the observatory), the
+        # tick's compute time, and per-request chunked-prefill
+        # progress.
+        tick = data.get("tick") or {}
+        t_worker = tick.get("now")
+        step_s = float(tick.get("step_s") or 0.0)
+        pf_chunk = max(1, self.prefill_chunk or self.max_len)
+        for rid, wn in (data.get("pfp") or {}).items():
+            try:
+                written, total = int(wn[0]), int(wn[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            self.obs.note_prefill_progress(
+                rid, -(-written // pf_chunk), -(-total // pf_chunk))
         for rid, err in errors.items():
             with self._lock:
                 req = self._reqs.get(rid)
             if req is not None and req.state == ACCEPTED:
                 self._finish(req, FAILED, error=str(err))
         for rid, em in emitted.items():
+            t_em0 = time.perf_counter()
             with self._lock:
                 req = self._reqs.get(rid)
                 if req is None or req.state != ACCEPTED:
@@ -1429,6 +1591,14 @@ class ServingManager:
                     gap = ((now - req.last_emit_ts) / len(new)
                            if req.last_emit_ts is not None else None)
                 req.last_emit_ts = now
+            # Stage attribution (ISSUE 18): arrival + worker stamp
+            # (clock-corrected inside), the tick's decode compute,
+            # and the gateway's own emit-handling time so far.
+            self.obs.note_emission(
+                rid, rank if rank is not None else 0, len(new),
+                t_recv=now, t_worker=t_worker,
+                emit_s=time.perf_counter() - t_em0)
+            self.obs.note_decode(rid, step_s)
             # SLO observations (outside the lock; per-SUBMITTING-
             # tenant labels so eviction retires the series).
             if first:
@@ -1496,6 +1666,15 @@ class ServingManager:
                 self._slo.append(slo)
             elif status == SHED_V:
                 self.shed += 1
+        rec = self.obs.complete(
+            req.rid, status, t_finish=req.finished_ts,
+            tracer=getattr(self.comm, "tracer", None))
+        if slo is not None and rec is not None \
+                and rec.get("tpot_s") is not None:
+            # Clock-corrected TPOT (worker emission stamps through
+            # the per-rank offset estimator, clamped >= 0) supersedes
+            # the gateway-arrival estimate when stamps were present.
+            slo["tpot"] = rec["tpot_s"]
         if slo is not None:
             self._slo_hist(
                 "nbd_serve_e2e_seconds",

@@ -1167,10 +1167,12 @@ class DistributedMagics(Magics):
                    "0 = off)")
     @argument("--start-timeout", type=float, default=240.0,
               help="seconds to wait for the daemon's readiness line")
-    @argument("--autoscale", default=None, metavar="MIN:MAX",
+    @argument("--autoscale", default=None, nargs="?", const="show",
+              metavar="MIN:MAX",
               help="start: arm the pressure-driven autoscaler with "
                    "this worker band (thresholds from the "
-                   "NBD_AUTOSCALE_* knobs)")
+                   "NBD_AUTOSCALE_* knobs); status: render the "
+                   "decision audit trail (no value needed)")
     @argument("--tenant", default=None,
               help="migrate: the tenant to move")
     @argument("--to", dest="dest", default=None,
@@ -1429,9 +1431,11 @@ class DistributedMagics(Magics):
         except Exception as e:
             print(f"❌ pool status failed: {e}")
             return
-        self._render_pool_status(st, d)
+        self._render_pool_status(
+            st, d, show_autoscale=args.autoscale is not None)
 
-    def _render_pool_status(self, st: dict, run_dir) -> None:
+    def _render_pool_status(self, st: dict, run_dir, *,
+                            show_autoscale: bool = False) -> None:
         sched = st.get("scheduler") or {}
         pol = sched.get("policy") or {}
         mem = st.get("membership") or {}
@@ -1447,6 +1451,9 @@ class DistributedMagics(Magics):
               f"{sched.get('shed_total', 0)} total)")
         if st.get("autoscale"):
             print(f"⚖ autoscale armed: {st['autoscale']}")
+        if show_autoscale:
+            self._render_autoscale_audit(
+                st.get("autoscale_decisions"))
         trans = mem.get("transition")
         if trans:
             print(f"⚠ resize in flight: {trans.get('from_world')} → "
@@ -1537,6 +1544,43 @@ class DistributedMagics(Magics):
         for v in st.get("hang_verdicts") or ():
             print(f"   ⚠ HUNG [{v.get('kind')}] {v.get('detail')}")
 
+    @staticmethod
+    def _render_autoscale_audit(decisions) -> None:
+        """The autoscaler decision audit trail (ISSUE 18): one row
+        per recent observation — pressure inputs, sustain/cooldown
+        state, verdict — newest last."""
+        decs = decisions or []
+        if not decs:
+            print("   (no autoscale audit records — arm the "
+                  "autoscaler with %dist_pool start --autoscale "
+                  "MIN:MAX)")
+            return
+        hdr = (f"   {'age':>6} {'world':>5} {'verdict':<8} "
+               f"{'target':>6} {'queued':>6} {'backlog':>7} "
+               f"{'p95':>7} {'sustain':>8} reason")
+        print(hdr)
+        print("   " + "─" * (len(hdr) - 3))
+        now = time.time()
+        for rec in decs[-12:]:
+            inp = rec.get("inputs") or {}
+            age = max(0.0, now - float(rec.get("ts") or now))
+            reason = rec.get("reason") \
+                or ", ".join(rec.get("pressure") or ()) or "-"
+            if rec.get("clamp"):
+                reason = f"[clamp] {reason}"
+            cd = rec.get("cooldown_s") or 0
+            if cd and rec.get("verdict") == "hold":
+                reason = f"cooldown {cd:.0f}s"
+            tgt = rec.get("target")
+            print(f"   {f'-{age:.0f}s':>6} "
+                  f"{rec.get('world', '-'):>5} "
+                  f"{rec.get('verdict', '-'):<8} "
+                  f"{tgt if tgt is not None else '-':>6} "
+                  f"{inp.get('queued', 0):>6} "
+                  f"{inp.get('backlog', 0):>7} "
+                  f"{inp.get('queue_p95_s', 0):>6.2f}s "
+                  f"{rec.get('sustain_s', 0):>7.1f}s {reason}")
+
     def _run_on_pool(self, code: str, *, priority=None,
                      deadline_s=None):
         """Tenant-mode cell dispatch: submit to the gateway, surface
@@ -1610,7 +1654,7 @@ class DistributedMagics(Magics):
     @magic_arguments()
     @argument("command", nargs="?", default="status",
               choices=["start", "status", "stop", "submit", "result",
-                       "stream"])
+                       "stream", "lat"])
     @argument("--spec", default=None,
               help="kernel variable holding the model-spec cell "
                    "(code that binds params/cfg in the serving "
@@ -1655,6 +1699,9 @@ class DistributedMagics(Magics):
     @argument("--wait", action="store_true",
               help="submit: block until the request finishes and "
                    "print its tokens")
+    @argument("--last", type=int, default=0,
+              help="lat: also render the stage waterfall of the "
+                   "last N completed requests")
     @line_magic
     def dist_serve(self, line):
         """Serving through the gateway (tenant mode): ``%dist_serve
@@ -1743,6 +1790,14 @@ class DistributedMagics(Magics):
                 st = client.serve_stop()
                 print(f"🛑 serving stopped: {st.get('completed')} "
                       f"completed · {st.get('tokens_total')} tokens")
+            elif args.command == "lat":
+                st = client.serve_status()
+                if st.get("status") == "off":
+                    print("(no serving plane running — %dist_serve "
+                          "start)")
+                    return
+                self._render_serve_lat(st.get("lat") or {},
+                                       last=args.last)
             else:  # status
                 st = client.serve_status()
                 if st.get("status") == "off":
@@ -1784,6 +1839,22 @@ class DistributedMagics(Magics):
             if tb:
                 print("   blocks by tenant: " + " · ".join(
                     f"{t}: {n}" for t, n in sorted(tb.items())))
+        # Utilization line (ISSUE 18): recent batch fill + the
+        # prefill/decode token split + per-rank fragmentation.
+        util = (st.get("lat") or {}).get("util") or {}
+        if util.get("count"):
+            frag = " · ".join(
+                f"r{r}: run {v.get('frag', '?')}"
+                + (f", defer {v['pending']}"
+                   if v.get("pending") else "")
+                for r, v in sorted((util.get("ranks") or {}).items(),
+                                   key=lambda kv_: int(kv_[0])))
+            print(f"   util: batch fill {util.get('fill_mean', 0):.0%}"
+                  f" mean / {util.get('fill_max', 0):.0%} max · "
+                  f"prefill share "
+                  f"{util.get('prefill_share', 0):.0%} of "
+                  f"{util.get('prefill_toks', 0) + util.get('decode_toks', 0)}"
+                  f" tok" + (f" · {frag}" if frag else ""))
         print(f"   accepted {st.get('accepted', 0)} · completed "
               f"{st.get('completed', 0)} · shed {st.get('shed', 0)} · "
               f"rejected {st.get('rejected', 0)} · replayed "
@@ -1807,6 +1878,29 @@ class DistributedMagics(Magics):
                       f"e2e {_pp(b, 'e2e')}")
         if st.get("last_error"):
             print(f"   ⚠ last driver error: {st['last_error']}")
+
+    @staticmethod
+    def _render_serve_lat(lat: dict, *, last: int = 0) -> None:
+        """``%dist_serve lat``: per-stage percentile table over the
+        observatory ring, plus (with ``--last N``) the ASCII stage
+        waterfall of the most recent completions."""
+        from ..observability import servingobs as _sobs
+        summ = lat.get("summary") or {}
+        if not summ.get("count"):
+            print("(no completed serving requests recorded yet — "
+                  "submit some, or check NBD_SERVE_LAT)")
+            return
+        print(f"⏱ serving stage decomposition ({summ['count']} "
+              f"recorded, {summ.get('dropped', 0)} dropped):")
+        print(_sobs.format_serve_stage_table(summ))
+        if last:
+            recs = (lat.get("records") or [])[-last:]
+            if recs:
+                print()
+                print(_sobs.format_serve_waterfall(recs))
+            else:
+                print("(no per-request records in the status "
+                      "payload)")
 
     @magic_arguments()
     @argument("--dry-run", action="store_true",
@@ -3852,7 +3946,7 @@ class DistributedMagics(Magics):
                + (f"{'tenant':<11}" if tenants_seen else "")
                + f"{'hb-age':<8}"
                f"{'col#':<7}{'HBM use/limit GB':<18}{'peak':<7}"
-               + (f"{'kv':<12}" if kv_seen else "")
+               + (f"{'kv':<12}{'frag':<6}" if kv_seen else "")
                + f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
         print(hdr)
         print("─" * len(hdr))
@@ -3912,6 +4006,12 @@ class DistributedMagics(Magics):
                     kvcol = f"{kvcol:<12}"
                 else:
                     kvcol = f"{'-':<12}"
+                # Fragmentation (ISSUE 18): the rank's largest
+                # contiguous free-block run — 40 free blocks in runs
+                # of 1 admit very differently from one 40-run.
+                frag = srv.get("frag")
+                kvcol += (f"{frag:<6}" if frag is not None
+                          else f"{'-':<6}")
             print(f"{r:<5}{state:<11}{busy:<18}{tcol}{hb:<8}{col:<7}"
                   f"{mem:<18}"
                   f"{peak:<7}{kvcol}{str(tel.get('bufs', '-')):<6}"

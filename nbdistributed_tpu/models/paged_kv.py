@@ -230,5 +230,10 @@ class PagedKVCache:
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
 
+    def largest_free_run(self) -> int:
+        """Longest contiguous free-block run (fragmentation telemetry
+        for the serving observatory / %dist_top frag column)."""
+        return self.allocator.largest_free_run()
+
     def snapshot(self) -> dict:
         return self.allocator.snapshot()

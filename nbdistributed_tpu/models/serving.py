@@ -255,6 +255,12 @@ class DecodeServer:
         self._interleave = bool(interleave_prefill)
         self._prefilling: dict[int, list] = {}   # slot -> [rid, prompt,
         #                                          budget, written]
+        # Utilization telemetry (ISSUE 18): cumulative prompt tokens
+        # written by prefill vs tokens emitted by decode — the worker
+        # differences successive snapshots to report each tick's
+        # prefill/decode token split to the serving observatory.
+        self.prefill_tokens_total = 0
+        self.decode_tokens_total = 0
 
         if self._paged is not None:
             self._prefill_fn = self._make_prefill_paged()
@@ -706,6 +712,7 @@ class DecodeServer:
                           self._sample_key(), self._top_k,
                           self._top_p)[0])
         self.outputs[rid].append(tok)
+        self.prefill_tokens_total += len(prompt)
         self._lens = self._lens.at[slot].set(len(prompt))
         self._last = self._last.at[slot].set(tok)
         if self._draft_cfg is not None:
@@ -749,6 +756,7 @@ class DecodeServer:
                 self._params, self._cache, seg, jnp.int32(slot),
                 jnp.int32(written), jnp.int32(ck))
             st[3] = written + ck
+            self.prefill_tokens_total += ck
             # Keep lens at the written frontier: the decode step's
             # frozen-position write for this inactive row lands where
             # the next chunk will overwrite it (dense pool).
@@ -765,6 +773,7 @@ class DecodeServer:
             self._params, self._cache, seg, jnp.int32(slot),
             jnp.int32(written), jnp.int32(len(tail)))
         del self._prefilling[slot]
+        self.prefill_tokens_total += len(tail)
         tok = int(_sample(last_logits[None], self._temperature,
                           self._sample_key(), self._top_k,
                           self._top_p)[0])
@@ -863,6 +872,7 @@ class DecodeServer:
         if self._eos is not None and self._eos in toks:
             toks = toks[: toks.index(self._eos) + 1]
         self.outputs[rid].extend(toks)
+        self.decode_tokens_total += len(toks)
         self._budget[rid] -= len(toks)
         if (self._budget[rid] == 0
                 or (self._eos is not None and toks
@@ -996,6 +1006,13 @@ class DecodeServer:
     @property
     def n_active(self) -> int:
         return len(self._slot_req)
+
+    def prefill_progress(self) -> dict[int, tuple[int, int]]:
+        """Mid-prefill streams: ``{request_id: (tokens_written,
+        prompt_len)}`` — the serve_step reply forwards this so the
+        gateway's observatory can annotate prefill[chunk i/n]."""
+        return {st[0]: (st[3], len(st[1]))
+                for st in self._prefilling.values()}
 
     def kv_snapshot(self) -> dict | None:
         """Paged-mode block occupancy (``{"blocks", "block_tokens",

@@ -31,10 +31,26 @@ COORDINATOR_PID = -1
 # offset keeps tenant tids clear of real recording-thread ids.
 _TENANT_TID_BASE = 1 << 20
 
+# Served requests (ISSUE 18) go one level finer: records whose attrs
+# carry a ``serve_rid`` (the serving observatory's stage spans) land
+# on a per-request named track ``serve:<rid>`` — a request's whole
+# lifecycle reads as one lane.  The base keeps them clear of both
+# thread ids and tenant tids.
+_SERVE_TID_BASE = 1 << 21
+
 
 def _tenant_tid(ev_attrs: dict | None,
-                tenant_tids: dict[str, int] | None) -> int | None:
-    if not tenant_tids or not ev_attrs:
+                tenant_tids: dict[str, int] | None,
+                serve_tids: dict[str, int] | None = None
+                ) -> int | None:
+    if not ev_attrs:
+        return None
+    rid = ev_attrs.get("serve_rid")
+    if rid and serve_tids:
+        tid = serve_tids.get(str(rid))
+        if tid is not None:
+            return tid
+    if not tenant_tids:
         return None
     name = ev_attrs.get("tenant")
     return tenant_tids.get(name) if name else None
@@ -42,13 +58,14 @@ def _tenant_tid(ev_attrs: dict | None,
 
 def _span_event(span: dict, pid: int, offset_s: float,
                 base_s: float,
-                tenant_tids: dict[str, int] | None = None) -> dict:
+                tenant_tids: dict[str, int] | None = None,
+                serve_tids: dict[str, int] | None = None) -> dict:
     args: dict[str, Any] = dict(span.get("attrs") or {})
     args["trace_id"] = span.get("trace_id")
     args["span_id"] = span.get("span_id")
     if span.get("parent_id"):
         args["parent_id"] = span["parent_id"]
-    tid = _tenant_tid(span.get("attrs"), tenant_tids)
+    tid = _tenant_tid(span.get("attrs"), tenant_tids, serve_tids)
     return {
         "name": span["name"],
         "cat": span.get("kind") or "span",
@@ -63,8 +80,9 @@ def _span_event(span: dict, pid: int, offset_s: float,
 
 def _instant_event(ev: dict, pid: int, offset_s: float,
                    base_s: float,
-                   tenant_tids: dict[str, int] | None = None) -> dict:
-    tid = _tenant_tid(ev.get("attrs"), tenant_tids)
+                   tenant_tids: dict[str, int] | None = None,
+                   serve_tids: dict[str, int] | None = None) -> dict:
+    tid = _tenant_tid(ev.get("attrs"), tenant_tids, serve_tids)
     return {
         "name": ev["name"],
         "cat": ev.get("kind") or "instant",
@@ -94,15 +112,35 @@ def _collect_tenants(*dumps: dict | None) -> dict[str, int]:
             for i, n in enumerate(sorted(names))}
 
 
+def _collect_serve_rids(*dumps: dict | None) -> dict[str, int]:
+    """Stable serve_rid → tid assignment across every process dump
+    (the serving observatory's per-request stage spans, ISSUE 18)."""
+    rids: set[str] = set()
+    for dump in dumps:
+        for s in (dump or {}).get("spans", []):
+            rid = (s.get("attrs") or {}).get("serve_rid")
+            if rid:
+                rids.add(str(rid))
+    return {r: _SERVE_TID_BASE + i
+            for i, r in enumerate(sorted(rids))}
+
+
 def _tenant_thread_meta(tenant_tids: dict[str, int],
-                        pids: list[int]) -> list[dict]:
+                        pids: list[int],
+                        serve_tids: dict[str, int] | None = None
+                        ) -> list[dict]:
     out = []
-    for name, tid in sorted(tenant_tids.items(),
-                            key=lambda kv: kv[1]):
+    named = [(f"tenant:{n}", tid)
+             for n, tid in sorted(tenant_tids.items(),
+                                  key=lambda kv: kv[1])]
+    named += [(f"serve:{r}", tid)
+              for r, tid in sorted((serve_tids or {}).items(),
+                                   key=lambda kv: kv[1])]
+    for name, tid in named:
         for pid in pids:
             out.append({"name": "thread_name", "ph": "M", "pid": pid,
                         "tid": tid,
-                        "args": {"name": f"tenant:{name}"}})
+                        "args": {"name": name}})
             out.append({"name": "thread_sort_index", "ph": "M",
                         "pid": pid, "tid": tid,
                         "args": {"sort_index": tid}})
@@ -172,16 +210,18 @@ def merge_trace(coordinator: dict | None,
     # ``tenant`` land on a per-tenant named thread track.
     tenant_tids = _collect_tenants(coordinator,
                                    *[ranks[r] for r in ranks])
+    serve_tids = _collect_serve_rids(coordinator,
+                                     *[ranks[r] for r in ranks])
 
     events: list[dict] = []
     dropped = 0
     if coordinator:
         events += _meta(COORDINATOR_PID, "coordinator", -1)
         events += [_span_event(s, COORDINATOR_PID, 0.0, base_s,
-                               tenant_tids)
+                               tenant_tids, serve_tids)
                    for s in coordinator.get("spans", [])]
         events += [_instant_event(ev, COORDINATOR_PID, 0.0, base_s,
-                                  tenant_tids)
+                                  tenant_tids, serve_tids)
                    for ev in coordinator.get("instants", [])]
         dropped += coordinator.get("dropped", 0)
     events += _fault_events(coordinator_faults or [], COORDINATOR_PID,
@@ -190,15 +230,17 @@ def merge_trace(coordinator: dict | None,
         off = offsets.get(r, 0.0)
         dump = ranks[r] or {}
         events += _meta(r, f"rank {r}", r)
-        events += [_span_event(s, r, off, base_s, tenant_tids)
+        events += [_span_event(s, r, off, base_s, tenant_tids,
+                               serve_tids)
                    for s in dump.get("spans", [])]
-        events += [_instant_event(ev, r, off, base_s, tenant_tids)
+        events += [_instant_event(ev, r, off, base_s, tenant_tids,
+                                  serve_tids)
                    for ev in dump.get("instants", [])]
         dropped += dump.get("dropped", 0)
-    if tenant_tids:
+    if tenant_tids or serve_tids:
         pids = ([COORDINATOR_PID] if coordinator else []) \
             + sorted(ranks)
-        events += _tenant_thread_meta(tenant_tids, pids)
+        events += _tenant_thread_meta(tenant_tids, pids, serve_tids)
     for r in sorted(rank_faults):
         events += _fault_events(rank_faults[r], r,
                                 offsets.get(r, 0.0), base_s)
@@ -214,6 +256,8 @@ def merge_trace(coordinator: dict | None,
             "spans_dropped": dropped,
             "tenant_tracks": {n: t for n, t in
                               sorted(tenant_tids.items())},
+            "serve_tracks": {n: t for n, t in
+                             sorted(serve_tids.items())},
         },
     }
 

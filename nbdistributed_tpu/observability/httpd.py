@@ -176,9 +176,14 @@ def _mirror_worker_view(reg, comm) -> None:
                 reg.gauge(name, help, labels).set(float(v))
 
 
-def collectors_for_comm(comm, *, extra_health=None):
+def collectors_for_comm(comm, *, extra_health=None,
+                        extra_latency=None):
     """(collect_metrics, collect_health, collect_latency) bound to a
-    :class:`~..messaging.coordinator.CommunicationManager`."""
+    :class:`~..messaging.coordinator.CommunicationManager`.
+
+    ``extra_latency`` (ISSUE 18) is a zero-arg callable whose dict is
+    merged into the ``/latency.json`` payload — the daemon hangs the
+    serving observatory's stage/utilization block there."""
 
     def collect_metrics() -> str:
         reg = obs_metrics.registry()
@@ -206,17 +211,24 @@ def collectors_for_comm(comm, *, extra_health=None):
         return out
 
     def collect_latency() -> dict:
-        return comm.lat.status_block()
+        out = comm.lat.status_block()
+        if extra_latency is not None:
+            try:
+                out.update(extra_latency() or {})
+            except Exception:
+                pass
+        return out
 
     return collect_metrics, collect_health, collect_latency
 
 
 def start_for_comm(comm, *, port: int, host: str = "127.0.0.1",
-                   token: str | None = None,
-                   extra_health=None) -> MetricsHTTPD:
+                   token: str | None = None, extra_health=None,
+                   extra_latency=None) -> MetricsHTTPD:
     """Start the scrape endpoint over a live coordinator.  ``port``
     0 binds an ephemeral port (read it back from ``.port``)."""
-    cm, ch, cl = collectors_for_comm(comm, extra_health=extra_health)
+    cm, ch, cl = collectors_for_comm(comm, extra_health=extra_health,
+                                     extra_latency=extra_latency)
     return MetricsHTTPD(port=port, host=host, token=token,
                         collect_metrics=cm, collect_health=ch,
                         collect_latency=cl)

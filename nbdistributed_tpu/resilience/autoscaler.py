@@ -19,7 +19,8 @@ the band is pulled back in without waiting for sustain.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 
 from ..utils import knobs
 
@@ -73,6 +74,10 @@ class Decision:
     action: str        # "grow" | "shrink"
     target: int        # new world size
     reason: str        # human-readable signal, flight-recorded
+    # The full structured audit record behind this verdict (ISSUE 18):
+    # pressure inputs, sustain/cooldown state, clamp flag — what the
+    # daemon flight-records and postmortem bundles carry.
+    record: dict | None = field(default=None, compare=False)
 
 
 class PoolAutoscaler:
@@ -88,6 +93,18 @@ class PoolAutoscaler:
         self._idle_since: float | None = None
         self._cooldown_until: float = 0.0
         self.decisions_total = 0
+        # Audit trail (ISSUE 18): one structured record per observe()
+        # call — inputs, pressure signals, sustain/cooldown state,
+        # verdict — rendered by ``%dist_pool status --autoscale`` and
+        # carried into postmortem bundles via the daemon's flight
+        # records.  Same thread discipline as the rest of the state
+        # machine: the daemon's autoscale thread is the only writer.
+        self._decisions: deque = deque(maxlen=128)
+
+    def decisions(self, last: int | None = None) -> list[dict]:
+        """Recent audit records, oldest first."""
+        recs = list(self._decisions)
+        return recs[-last:] if last else recs
 
     def note_resized(self, now: float) -> None:
         """A resize just executed (or failed): open the cooldown and
@@ -102,28 +119,58 @@ class PoolAutoscaler:
                 active: int = 0, backlog: int = 0,
                 queue_p95_s: float = 0.0) -> Decision | None:
         pol = self.policy
+        # Audit record (ISSUE 18): every observation leaves one —
+        # verdict or hold — naming the inputs and clock state that
+        # drove it, so a resize (or its absence) is explainable after
+        # the fact.
+        rec = {
+            "ts": round(now, 3),
+            "world": int(world_size),
+            "inputs": {"queued": int(queued), "active": int(active),
+                       "backlog": int(backlog),
+                       "queue_p95_s": round(float(queue_p95_s), 3)},
+            "pressure": [],
+            "sustain_s": 0.0,
+            "idle_for_s": 0.0,
+            "cooldown_s": round(max(0.0, self._cooldown_until - now),
+                                1),
+            "verdict": "hold", "target": None, "reason": None,
+            "clamp": False,
+        }
+
+        def _audit(d: Decision | None,
+                   clamp: bool = False) -> Decision | None:
+            if d is not None:
+                self.decisions_total += 1
+                rec["verdict"] = d.action
+                rec["target"] = d.target
+                rec["reason"] = d.reason
+                rec["clamp"] = clamp
+                d.record = rec
+            self._decisions.append(rec)
+            return d
+
         # Band clamping is unconditional: a world outside min:max is
         # wrong regardless of load and regardless of cooldown (the arm
         # moment itself may find a too-small pool).
         if world_size < pol.min_workers:
-            self.decisions_total += 1
-            return Decision("grow", pol.min_workers,
-                            f"world {world_size} below min "
-                            f"{pol.min_workers}")
+            return _audit(Decision("grow", pol.min_workers,
+                                   f"world {world_size} below min "
+                                   f"{pol.min_workers}"), clamp=True)
         if world_size > pol.max_workers:
-            self.decisions_total += 1
-            return Decision("shrink", pol.max_workers,
-                            f"world {world_size} above max "
-                            f"{pol.max_workers}")
+            return _audit(Decision("shrink", pol.max_workers,
+                                   f"world {world_size} above max "
+                                   f"{pol.max_workers}"), clamp=True)
 
         if now < self._cooldown_until:
             # Blackout: no decision, AND no clock arming — load seen
             # during the cooldown is tainted by the resize itself (the
             # drain barrier accumulates queue by design), so pressure
             # must re-sustain against the new world.
-            return None
+            rec["reason"] = "cooldown"
+            return _audit(None)
 
-        pressure = []
+        pressure = rec["pressure"]
         if pol.up_queue and queued > pol.up_queue:
             pressure.append(f"queue {queued}>{pol.up_queue}")
         if pol.up_backlog and backlog > pol.up_backlog:
@@ -146,24 +193,26 @@ class PoolAutoscaler:
                 self._idle_since = now
         else:
             self._idle_since = None
+        if self._pressure_since is not None:
+            rec["sustain_s"] = round(now - self._pressure_since, 1)
+        if self._idle_since is not None:
+            rec["idle_for_s"] = round(now - self._idle_since, 1)
 
         if (pressure and self._pressure_since is not None
                 and now - self._pressure_since >= pol.sustain_s
                 and world_size < pol.max_workers):
             target = min(pol.max_workers, max(world_size + 1,
                                               world_size * 2))
-            self.decisions_total += 1
-            return Decision(
+            return _audit(Decision(
                 "grow", target,
                 f"{', '.join(pressure)} sustained "
-                f"{now - self._pressure_since:.0f}s")
+                f"{now - self._pressure_since:.0f}s"))
 
         if (idle and self._idle_since is not None
                 and now - self._idle_since >= pol.idle_s
                 and world_size > pol.min_workers):
             target = max(pol.min_workers, world_size // 2)
-            self.decisions_total += 1
-            return Decision(
+            return _audit(Decision(
                 "shrink", target,
-                f"idle {now - self._idle_since:.0f}s")
-        return None
+                f"idle {now - self._idle_since:.0f}s"))
+        return _audit(None)

@@ -73,7 +73,8 @@ class _WorkerServe:
     lets the gateway dedup replayed/redelivered emissions exactly.
     """
 
-    __slots__ = ("server", "rids", "sent", "tokens_total", "window")
+    __slots__ = ("server", "rids", "sent", "tokens_total", "window",
+                 "pf_seen", "dc_seen")
 
     def __init__(self, server):
         self.server = server
@@ -81,6 +82,11 @@ class _WorkerServe:
         self.sent: dict[str, int] = {}      # gateway rid -> reported
         self.tokens_total = 0
         self.window: list[tuple[float, int]] = []  # (t, tokens_total)
+        # Cumulative prefill/decode token counts already reported —
+        # each serve_step reply carries the per-tick DELTAS (the
+        # observatory's prefill-vs-decode split, ISSUE 18).
+        self.pf_seen = 0
+        self.dc_seen = 0
 
     def note_rate(self) -> None:
         now = time.monotonic()
@@ -1147,10 +1153,12 @@ class DistributedWorker:
                     except Exception:
                         pass
         steps = max(0, int(data.get("steps") or 0))
+        t_step0 = time.perf_counter()
         for _ in range(steps):
             if st.server.done():
                 break
             st.server.step()
+        step_s = time.perf_counter() - t_step0
         emitted: dict[str, dict] = {}
         finished: list[str] = []
         for rid, local in st.rids.items():
@@ -1164,12 +1172,29 @@ class DistributedWorker:
                 finished.append(rid)
         st.note_rate()
         self._publish_serve_snap()
+        # Tick telemetry (ISSUE 18): compute seconds, the tick's
+        # prefill/decode token split (deltas of the server's
+        # cumulative counters), and per-request prefill progress —
+        # the gateway's serving observatory clock-corrects the wall
+        # stamp and attributes the compute to active requests.
+        pf_tot = getattr(st.server, "prefill_tokens_total", 0)
+        dc_tot = getattr(st.server, "decode_tokens_total", 0)
+        pf_d, dc_d = pf_tot - st.pf_seen, dc_tot - st.dc_seen
+        st.pf_seen, st.dc_seen = pf_tot, dc_tot
+        local_rids = {v: k for k, v in st.rids.items()}
+        pfp = {local_rids[lid]: [int(w), int(n)]
+               for lid, (w, n) in st.server.prefill_progress().items()
+               if lid in local_rids}
         return msg.reply(
             data={"status": "ok", "emitted": emitted,
                   "finished": finished, "errors": errors,
                   "active": st.server.n_active,
                   "slots": st.server._B,
-                  "pending": len(st.server._pending)},
+                  "pending": len(st.server._pending),
+                  "tick": {"now": time.time(),
+                           "step_s": round(step_s, 6),
+                           "pf": int(pf_d), "dc": int(dc_d)},
+                  "pfp": pfp},
             rank=self.rank)
 
     def _handle_serve_close(self, msg: Message) -> Message:
@@ -1192,6 +1217,7 @@ class DistributedWorker:
         tot = occ = slots = 0
         kv_used = kv_total = 0
         tps = 0.0
+        frag = None
         for st in self._serve.values():
             tot += st.tokens_total
             occ += st.server.n_active
@@ -1201,10 +1227,18 @@ class DistributedWorker:
             if kv is not None:
                 kv_used += kv["used"]
                 kv_total += kv["blocks"]
+                # Largest contiguous free run, min across tenants —
+                # the most fragmented pool is the binding constraint
+                # (%dist_top frag column, ISSUE 18).
+                run = kv.get("largest_run")
+                if run is not None:
+                    frag = run if frag is None else min(frag, run)
         self._serve_snap = {"tok": tot, "tps": round(tps, 2),
                             "occ": occ, "slots": slots,
                             **({"kvb": [kv_used, kv_total]}
-                               if kv_total else {})}
+                               if kv_total else {}),
+                            **({"frag": frag}
+                               if frag is not None else {})}
 
     def _park(self, msg_type: str, msg_id: str, reply: Message) -> None:
         """Park a reply for redelivery to a future coordinator.

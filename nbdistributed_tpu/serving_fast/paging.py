@@ -92,6 +92,20 @@ class BlockAllocator:
         """Would a request needing ``tokens`` KV entries be admitted?"""
         return blocks_needed(tokens, self.block_tokens) <= len(self._free)
 
+    def largest_free_run(self) -> int:
+        """Longest contiguous run of free block ids — the
+        fragmentation number the observatory exports next to the free
+        count (ISSUE 18): the free list is kept sorted, so one linear
+        scan answers it."""
+        best = run = 0
+        prev = None
+        for b in self._free:
+            run = run + 1 if prev is not None and b == prev + 1 else 1
+            if run > best:
+                best = run
+            prev = b
+        return best
+
     def owners(self) -> list[str]:
         return list(self._tables)
 
@@ -214,5 +228,6 @@ class BlockAllocator:
             "block_tokens": self.block_tokens,
             "used": self.used_blocks,
             "free": self.free_blocks,
+            "largest_run": self.largest_free_run(),
             "owners": {o: len(t) for o, t in self._tables.items()},
         }

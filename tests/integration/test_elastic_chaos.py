@@ -391,3 +391,98 @@ def test_tenant_migration_live_and_dead_source(tmp_path_factory):
                     gw.close()
                 except Exception:
                     pass
+
+
+def test_autoscale_audit_flight_ring_and_postmortem(tmp_path_factory):
+    """ISSUE 18: a pressure-driven autoscale grow leaves its FULL
+    audit record — the pressure inputs, sustain clock, and verdict —
+    on the daemon's flight ring, and a postmortem bundle captured
+    afterwards carries it.  The `%dist_pool status --autoscale` ring
+    (``decisions()``) holds the same records."""
+    from nbdistributed_tpu.observability import postmortem as pm_mod
+    from nbdistributed_tpu.resilience.autoscaler import AutoscalePolicy
+
+    run_dir = str(tmp_path_factory.mktemp("autoscale_audit"))
+    old = os.environ.get("NBD_RUN_DIR")
+    os.environ["NBD_RUN_DIR"] = run_dir
+    flightrec.reset_for_tests()
+    gw = None
+    threads = []
+    try:
+        gw = GatewayDaemon(
+            2, backend="cpu",
+            policy=SchedPolicy("fair", mesh_slots=1,
+                               tenant_inflight=16, queue_depth=32),
+            request_timeout=None, attach_timeout=240.0)
+        t = attach(gw, "pressure")
+        # Fast-cadence policy: queue pressure must sustain 1s, ticks
+        # every 250ms, no idle shrink, long cooldown (one decision).
+        gw.start_autoscale(AutoscalePolicy(
+            min_workers=2, max_workers=3, interval_s=0.25,
+            up_queue=2, up_backlog=10 ** 6, up_p95_s=0.0,
+            sustain_s=1.0, idle_s=10 ** 6, cooldown_s=10 ** 6))
+
+        def _cell():
+            try:
+                t.execute("import time as _t; _t.sleep(2.0)\n1",
+                          timeout=240.0)
+            except Exception:
+                pass    # the epoch flip may retire a queued cell
+
+        for _ in range(8):     # mesh_slots=1: 1 runs, 7 queue
+            th = threading.Thread(target=_cell, daemon=True)
+            th.start()
+            threads.append(th)
+
+        deadline = time.time() + 120.0
+        while time.time() < deadline and gw.world_size != 3:
+            time.sleep(0.5)
+        assert gw.world_size == 3, \
+            f"grow never fired: {gw._autoscaler.decisions()}"
+
+        # The decisions() ring: the fired grow names its pressure
+        # inputs and the sustain clock that armed it.
+        grows = [r for r in gw._autoscaler.decisions()
+                 if r["verdict"] == "grow"]
+        assert grows, gw._autoscaler.decisions()
+        g = grows[-1]
+        assert g["target"] == 3 and not g["clamp"]
+        assert any("queue" in s for s in g["pressure"]), g
+        assert g["inputs"]["queued"] > 2 and g["sustain_s"] >= 1.0, g
+
+        # The flight ring (the comm's "coordinator" ring — the one
+        # postmortem recovers) holds the decision WITH its audit.
+        gw.flight.flush()
+        ring = flightrec.read_latest(run_dir, "coordinator")
+        assert ring is not None
+        decs = [e for e in ring["events"]
+                if e.get("t") == "autoscale_decision"]
+        assert decs, [e.get("t") for e in ring["events"]][-20:]
+        audit = decs[-1].get("audit") or {}
+        assert audit.get("verdict") == "grow", decs[-1]
+        assert audit.get("inputs", {}).get("queued", 0) > 2, decs[-1]
+        assert audit.get("pressure"), decs[-1]
+
+        # And the postmortem bundle carries the same record.
+        manifest = pm_mod.capture(gw.comm, [],
+                                  reason="autoscale audit test")
+        assert manifest is not None
+        with open(os.path.join(manifest["dir"],
+                               "flight_coordinator.json")) as f:
+            bundle_ring = json.load(f)
+        bdecs = [e for e in bundle_ring["events"]
+                 if e.get("t") == "autoscale_decision"]
+        assert bdecs and (bdecs[-1].get("audit") or {}).get("pressure")
+        t.close(detach=True)
+    finally:
+        for th in threads:
+            th.join(timeout=30)
+        if gw is not None:
+            try:
+                gw.close()
+            except Exception:
+                pass
+        if old is None:
+            os.environ.pop("NBD_RUN_DIR", None)
+        else:
+            os.environ["NBD_RUN_DIR"] = old

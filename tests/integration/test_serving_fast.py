@@ -327,3 +327,100 @@ def test_chunked_prefill_keeps_short_stream_alive(pool):
         except Exception:
             pass
         t.close(detach=True)
+
+
+def test_stage_attribution_and_metrics_consistency(pool):
+    """ISSUE 18 pins on a real loadgen run:
+
+    1. Every completed request's contiguous stage decomposition sums
+       to its observed e2e within 10% (the acceptance bound; the
+       telescoping construction makes it exact, so we also pin 1ms).
+    2. TTFT == admit + queue + kv_alloc + prefill (same tolerance).
+    3. The loadgen report and the /metrics exposition agree: the
+       accepted/shed/rejected verdict counters and the stage-histogram
+       completion count match what the CLIENT observed (satellite 3).
+    """
+    from nbdistributed_tpu.observability import metrics as obs_metrics
+    from nbdistributed_tpu.observability.servingobs import SERVE_STAGES
+
+    def metric(line_prefix):
+        text = obs_metrics.registry().prometheus_text()
+        for ln in text.splitlines():
+            if ln.startswith(line_prefix):
+                return float(ln.rsplit(" ", 1)[1])
+        return None
+
+    # The registry is process-global and the pool fixture is module-
+    # scoped, so earlier tests' serving counters are still in it:
+    # every counter assertion below is on the DELTA across this run.
+    # The verdict/token counters carry the serving plane's OWN tenant
+    # label ("serve" — the manager's name, not the attaching tenant);
+    # only the per-request stage histograms attribute to "latpin".
+    def counters():
+        return {
+            "accepted": metric('nbd_serve_requests_total'
+                               '{tenant="serve",verdict="accepted"}')
+            or 0.0,
+            "shed": metric('nbd_serve_requests_total'
+                           '{tenant="serve",verdict="shed"}') or 0.0,
+            "rejected": metric('nbd_serve_requests_total'
+                               '{tenant="serve",verdict="rejected"}')
+            or 0.0,
+            "tokens": metric('nbd_serve_tokens_total'
+                             '{tenant="serve"}') or 0.0,
+        }
+
+    t = attach(pool, "latpin")
+    try:
+        t.serve_start(SPEC, max_batch=2, max_len=48, pad_to=4,
+                      steps=2, queue_depth=8, inflight=64,
+                      decode_ranks=2, kv_block_tokens=8, timeout=600)
+        before = counters()
+        cfg = LoadConfig(rps=3.0, duration_s=4.0, seed=7,
+                         prompt_len=(2, 5), max_new=(4, 4),
+                         drain_s=120.0)
+        rep = run_load(ClientTransport(t), cfg)
+        validate_report(rep)
+        assert rep["completed"] > 0 and rep["hung"] == 0, rep
+
+        st = t.serve_status()
+        lat = st.get("lat") or {}
+        recs = lat.get("records") or []
+        finished = [r for r in recs
+                    if r["status"] in ("completed", "failed")]
+        assert len(finished) >= rep["completed"], (len(finished), rep)
+        for r in finished:
+            total = sum(r["stages"][s] for s in SERVE_STAGES)
+            assert abs(total - r["e2e_s"]) <= max(1e-3,
+                                                  0.10 * r["e2e_s"]), \
+                (r["rid"], total, r["e2e_s"], r["stages"])
+            ttft = (r["stages"]["admit"] + r["stages"]["queue"]
+                    + r["stages"]["kv_alloc"] + r["stages"]["prefill"])
+            assert abs(ttft - r["ttft_s"]) <= 1e-3, (r["rid"], r)
+            assert all(r["stages"][s] >= 0.0 for s in SERVE_STAGES), r
+        summ = lat.get("summary") or {}
+        assert summ.get("count", 0) >= rep["completed"]
+
+        # Report <-> /metrics consistency: the exposition text is
+        # exactly what the scrape endpoint serves.
+        after = counters()
+        assert after["accepted"] - before["accepted"] \
+            == rep["accepted"], (before, after, rep)
+        assert after["shed"] - before["shed"] == rep["shed"], \
+            (before, after, rep)
+        assert after["rejected"] - before["rejected"] \
+            == rep["rejected"], (before, after, rep)
+        assert after["tokens"] - before["tokens"] \
+            >= rep["tokens_total"], (before, after, rep)
+        # One stage-histogram observation per finished request, and
+        # the stage attribution carries the ATTACHING tenant's name
+        # ("latpin" is unique to this test, so no delta needed).
+        n = metric('nbd_serve_stage_seconds_count'
+                   '{stage="decode",tenant="latpin"}')
+        assert n == len(finished), (n, len(finished))
+    finally:
+        try:
+            t.serve_stop()
+        except Exception:
+            pass
+        t.close(detach=True)

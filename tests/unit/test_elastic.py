@@ -133,6 +133,59 @@ def test_band_clamp_is_unconditional():
 
 
 # ----------------------------------------------------------------------
+# Autoscaler audit trail (ISSUE 18): every observe() leaves one
+# structured record — verdict or hold — naming the inputs that drove
+# it, and a returned Decision carries its record for flight recording.
+
+
+def test_audit_record_on_every_observation():
+    a = _scaler()
+    a.observe(0.0, world_size=2, queued=10, backlog=3,
+              queue_p95_s=1.5)
+    a.observe(5.0, world_size=2, queued=10)
+    d = a.observe(10.0, world_size=2, queued=10)
+    recs = a.decisions()
+    assert len(recs) == 3
+    # Hold records name the armed pressure + sustain clock.
+    hold = recs[0]
+    assert hold["verdict"] == "hold" and hold["target"] is None
+    assert hold["inputs"] == {"queued": 10, "active": 0, "backlog": 3,
+                              "queue_p95_s": 1.5}
+    assert any("queue" in s for s in hold["pressure"])
+    assert recs[1]["sustain_s"] == 5.0
+    # The fired decision's record is the SAME dict the daemon flight-
+    # records, with the verdict filled in.
+    fired = recs[2]
+    assert fired is d.record
+    assert fired["verdict"] == "grow" and fired["target"] == 4
+    assert fired["reason"] == d.reason and not fired["clamp"]
+    assert fired["sustain_s"] == 10.0
+    # decisions(last=N) trims from the old end.
+    assert a.decisions(1) == [fired]
+
+
+def test_audit_records_cooldown_and_clamp():
+    a = _scaler(min_workers=2)
+    d = a.observe(0.0, world_size=1)          # band clamp
+    assert d.record["clamp"] and d.record["verdict"] == "grow"
+    a.note_resized(1.0)
+    a.observe(2.0, world_size=2, queued=50)   # inside cooldown
+    rec = a.decisions()[-1]
+    assert rec["verdict"] == "hold" and rec["reason"] == "cooldown"
+    assert rec["cooldown_s"] > 0
+
+
+def test_audit_idle_clock_reaches_shrink_record():
+    a = _scaler()
+    a.observe(0.0, world_size=4)
+    a.observe(30.0, world_size=4)
+    d = a.observe(60.0, world_size=4)
+    assert d.action == "shrink"
+    assert d.record["idle_for_s"] == 60.0
+    assert d.record["pressure"] == []
+
+
+# ----------------------------------------------------------------------
 # PoolMembership
 
 def test_membership_seed_and_describe():

@@ -139,7 +139,8 @@ def test_snapshot_shape():
     a.alloc("r2", 1)
     snap = a.snapshot()
     assert snap == {"blocks": 6, "block_tokens": 8, "used": 3,
-                    "free": 3, "owners": {"r1": 2, "r2": 1}}
+                    "free": 3, "largest_run": 3,
+                    "owners": {"r1": 2, "r2": 1}}
 
 
 def test_ctor_validation():

@@ -1,0 +1,356 @@
+"""Unit tests for the serving observatory + perf-regression sentinel
+(ISSUE 18): the telescoping stage decomposition (sum == e2e and TTFT
+== admit+queue+kv_alloc+prefill EXACTLY, by construction), the
+clock-corrected TPOT clamp, the KV fragmentation scan, the utilization
+ring/gauges, the {tenant,rank} series-retirement pin, the autoscaler
+audit record shape, and perfbase's band scoring."""
+
+import math
+
+import pytest
+
+from nbdistributed_tpu.observability import metrics as obs_metrics
+from nbdistributed_tpu.observability import perfbase
+from nbdistributed_tpu.observability.servingobs import (
+    SERVE_STAGES, ServingObservatory, format_serve_stage_table,
+    format_serve_waterfall, largest_free_run)
+
+pytestmark = [pytest.mark.unit, pytest.mark.obs, pytest.mark.serve]
+
+
+class FakeClock:
+    """Deterministic ``now()`` the tests advance by hand."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeOffsets:
+    """Stand-in for ``ClockEstimator``: fixed per-rank offsets."""
+
+    def __init__(self, offsets):
+        self._off = offsets
+
+    def offset(self, rank):
+        return self._off.get(rank, 0.0)
+
+
+def _drive_one(obs, clk, rid="r-1", tenant="tn", rank=0):
+    """One full lifecycle with known stage widths; returns the
+    completion record."""
+    obs.begin(rid, tenant, t_submit=clk.t)
+    clk.advance(0.010)                       # admit
+    obs.note_admit(rid, t=clk.t)
+    clk.advance(0.050)                       # queue
+    obs.note_placed(rid, rank, kv_alloc_s=0.004, need_blocks=3,
+                    pf_total=2, t=clk.t)
+    clk.advance(0.030)                       # kv_alloc+prefill tail
+    obs.note_emission(rid, rank, 1, t_recv=clk.t, emit_s=0.001)
+    obs.note_decode(rid, 0.008)
+    clk.advance(0.020)
+    obs.note_emission(rid, rank, 2, t_recv=clk.t, emit_s=0.001)
+    obs.note_decode(rid, 0.008)
+    clk.advance(0.005)                       # deliver
+    return obs.complete(rid, "completed", t_finish=clk.t)
+
+
+def test_stage_sum_is_exactly_e2e():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    rec = _drive_one(obs, clk)
+    assert rec is not None and rec["status"] == "completed"
+    total = sum(rec["stages"][s] for s in SERVE_STAGES)
+    # Telescoping gateway anchors: exact up to the record rounding
+    # (6 decimal places), not a tolerance band.
+    assert math.isclose(total, rec["e2e_s"], abs_tol=1e-5), \
+        (total, rec["e2e_s"], rec["stages"])
+    assert all(rec["stages"][s] >= 0.0 for s in SERVE_STAGES)
+
+
+def test_ttft_identity_and_kv_alloc_cap():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    rec = _drive_one(obs, clk)
+    st = rec["stages"]
+    assert math.isclose(
+        rec["ttft_s"],
+        st["admit"] + st["queue"] + st["kv_alloc"] + st["prefill"],
+        abs_tol=1e-9)
+    # The TTFT tail [placed, first_tok] was 30ms: measured alloc 4ms
+    # fits, prefill is the remainder.
+    assert math.isclose(st["admit"], 0.010, abs_tol=1e-6)
+    assert math.isclose(st["queue"], 0.050, abs_tol=1e-6)
+    assert math.isclose(st["kv_alloc"], 0.004, abs_tol=1e-6)
+    assert math.isclose(st["prefill"], 0.026, abs_tol=1e-6)
+    # An alloc measurement LARGER than the tail is capped, never
+    # negative-prefill.
+    obs2 = ServingObservatory(now=clk)
+    obs2.begin("r-2", "tn", t_submit=clk.t)
+    obs2.note_admit("r-2", t=clk.t)
+    obs2.note_placed("r-2", 0, kv_alloc_s=5.0, t=clk.t)
+    clk.advance(0.010)
+    obs2.note_emission("r-2", 0, 1, t_recv=clk.t)
+    rec2 = obs2.complete("r-2", "completed", t_finish=clk.t)
+    assert math.isclose(rec2["stages"]["kv_alloc"], 0.010,
+                        abs_tol=1e-6)
+    assert rec2["stages"]["prefill"] == 0.0
+
+
+def test_decode_emit_split_capped_to_span():
+    """Worker durations only SPLIT the [first, last] span: inflated
+    decode/emit attributions cap out and decode_wait stays >= 0."""
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    obs.begin("r-3", "tn", t_submit=clk.t)
+    obs.note_admit("r-3", t=clk.t)
+    obs.note_placed("r-3", 1, t=clk.t)
+    obs.note_emission("r-3", 1, 1, t_recv=clk.t)
+    clk.advance(0.020)                       # span = 20ms
+    obs.note_emission("r-3", 1, 1, t_recv=clk.t, emit_s=9.0)
+    obs.note_decode("r-3", 9.0)              # wildly over-attributed
+    rec = obs.complete("r-3", "completed", t_finish=clk.t)
+    st = rec["stages"]
+    assert math.isclose(st["decode"], 0.020, abs_tol=1e-6)
+    assert st["emit"] == 0.0 and st["decode_wait"] == 0.0
+    total = sum(st[s] for s in SERVE_STAGES)
+    assert math.isclose(total, rec["e2e_s"], abs_tol=1e-5)
+
+
+def test_tpot_prefers_corrected_worker_stamps():
+    """Worker stamps skewed +5s are corrected by the per-rank offset
+    before the inter-token mean — gateway arrival jitter never enters
+    when stamps are present."""
+    clk = FakeClock()
+    obs = ServingObservatory(clock=FakeOffsets({1: 5.0}), now=clk)
+    obs.begin("r-4", "tn", t_submit=clk.t)
+    obs.note_admit("r-4", t=clk.t)
+    obs.note_placed("r-4", 1, t=clk.t)
+    t0 = clk.t
+    obs.note_emission("r-4", 1, 1, t_recv=clk.t, t_worker=t0 + 5.0)
+    clk.advance(0.500)                       # noisy gateway arrival
+    obs.note_emission("r-4", 1, 3, t_recv=clk.t,
+                      t_worker=t0 + 5.0 + 0.120)
+    rec = obs.complete("r-4", "completed", t_finish=clk.t)
+    # 120ms worker span over 3 inter-token gaps = 40ms, NOT the
+    # 500/3 ms the gateway clock would give.
+    assert math.isclose(rec["tpot_s"], 0.040, abs_tol=1e-6)
+
+
+def test_tpot_clamped_nonnegative_on_offset_error():
+    clk = FakeClock()
+    obs = ServingObservatory(clock=FakeOffsets({1: 10.0}), now=clk)
+    obs.begin("r-5", "tn", t_submit=clk.t)
+    obs.note_placed("r-5", 1, t=clk.t)
+    t0 = clk.t
+    # A bad offset estimate makes corrected stamps run BACKWARD.
+    obs.note_emission("r-5", 1, 1, t_recv=clk.t, t_worker=t0 + 10.0)
+    clk.advance(0.050)
+    obs.note_emission("r-5", 1, 2, t_recv=clk.t, t_worker=t0 + 9.5)
+    rec = obs.complete("r-5", "completed", t_finish=clk.t)
+    assert rec["tpot_s"] == 0.0
+
+
+def test_tpot_gateway_fallback_without_stamps():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    obs.begin("r-6", "tn", t_submit=clk.t)
+    obs.note_placed("r-6", 0, t=clk.t)
+    obs.note_emission("r-6", 0, 1, t_recv=clk.t)
+    clk.advance(0.100)
+    obs.note_emission("r-6", 0, 2, t_recv=clk.t)
+    rec = obs.complete("r-6", "completed", t_finish=clk.t)
+    assert math.isclose(rec["tpot_s"], 0.050, abs_tol=1e-6)
+
+
+def test_drop_and_unknown_rids_are_safe():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    obs.begin("r-7", "tn")
+    obs.drop("r-7")
+    assert obs.dropped == 1
+    assert obs.complete("r-7", "completed") is None
+    # note_* on never-begun rids must not create ghosts.
+    obs.note_admit("ghost")
+    obs.note_emission("ghost", 0, 1)
+    obs.note_decode("ghost", 0.1)
+    assert obs.records() == [] and obs.completed == 0
+
+
+def test_summary_and_renderers():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    for i in range(4):
+        _drive_one(obs, clk, rid=f"r-{i}")
+    s = obs.summary()
+    assert s["count"] == 4
+    assert set(s["stages"]) == set(SERVE_STAGES)
+    # Stage shares are fractions of mean e2e and roughly total 1.
+    assert 0.95 < sum(v["share"] for v in s["stages"].values()) < 1.05
+    table = format_serve_stage_table(s)
+    assert "decode" in table and "ttft" in table
+    wf = format_serve_waterfall(obs.records(2))
+    assert "tok" in wf and "r-3" in wf
+    blk = obs.status_block(records=2)
+    assert blk["enabled"] and len(blk["records"]) == 2
+
+
+# ---------------------------------------------------------------------
+# fragmentation scan + utilization telemetry
+
+
+def test_largest_free_run():
+    assert largest_free_run([]) == 0
+    assert largest_free_run([7]) == 1
+    assert largest_free_run([3, 1, 2, 9]) == 3
+    assert largest_free_run([5, 5, 6]) == 2          # dupes collapse
+    assert largest_free_run(range(10)) == 10
+
+
+def test_util_ring_summary_and_gauges():
+    clk = FakeClock()
+    obs = ServingObservatory(now=clk)
+    for placed in (1, 2):
+        obs.note_util(
+            ranks={0: {"placed": placed, "slots": 2, "kv_used": 4,
+                       "kv_free": 12, "frag": 7, "pending": 1}},
+            prefill_toks=8, decode_toks=2, backlog=3,
+            tenant="util-tn", t=clk.advance(0.1))
+    u = obs.util_summary()
+    assert u["count"] == 2
+    assert math.isclose(u["fill_mean"], 0.75, abs_tol=1e-9)
+    assert u["fill_max"] == 1.0
+    assert math.isclose(u["prefill_share"], 16 / 20, abs_tol=1e-9)
+    assert u["ranks"]["0"]["frag"] == 7
+    j = obs_metrics.registry().to_json()["gauges"]
+    assert j['nbd_serve_batch_fill_ratio{tenant="util-tn"}'] == 1.0
+    assert j['nbd_kv_frag_largest_run{rank="0",tenant="util-tn"}'] \
+        == 7.0
+    assert j['nbd_serve_defer_depth{rank="0",tenant="util-tn"}'] == 1.0
+    obs_metrics.registry().remove_label_series("tenant", "util-tn")
+
+
+def test_tenant_eviction_retires_rank_labeled_series():
+    """Satellite 1 pin: the per-rank KV gauges carry {tenant, rank}
+    labels, so tenant eviction's ``remove_label_series('tenant', ...)``
+    retires EVERY rank's series for that tenant — nothing accumulates
+    for the daemon's lifetime."""
+    reg = obs_metrics.registry()
+    for rank in ("0", "1", "all"):
+        reg.gauge("nbd_kv_blocks_used", "t",
+                  {"tenant": "evict-me", "rank": rank}).set(3)
+        reg.gauge("nbd_kv_blocks_free", "t",
+                  {"tenant": "evict-me", "rank": rank}).set(5)
+    reg.histogram("nbd_serve_stage_seconds", "t",
+                  {"stage": "decode", "tenant": "evict-me"}).observe(.1)
+    assert reg.remove_label_series("tenant", "evict-me") == 7
+    text = reg.prometheus_text()
+    assert "evict-me" not in text
+
+
+# ---------------------------------------------------------------------
+# perfbase: the regression-scoring contract
+
+
+REPORT = {
+    "offered": 20, "completed": 18, "shed_rate": 0.1,
+    "tokens_per_s": 10.0,
+    "client": {"ttft_ms": {"p50": 100.0, "p99": 300.0},
+               "tpot_ms": {"p50": 20.0, "p99": 50.0},
+               "e2e_ms": {"p50": 400.0, "p99": 900.0}},
+}
+STAGES = {"stages": {"decode": {"p95": 30.0}, "queue": {"p95": 80.0}}}
+
+
+def _baseline():
+    return perfbase.make_baseline(
+        perfbase.extract_metrics(REPORT, STAGES), source="test")
+
+
+def test_extract_and_seed_roundtrip(tmp_path):
+    m = perfbase.extract_metrics(REPORT, STAGES)
+    assert m["tokens_per_s"] == 10.0
+    assert m["stage_queue_ms_p95"] == 80.0
+    doc = {"baselines": {"serving_smoke": _baseline()}}
+    path = str(tmp_path / "b.json")
+    perfbase.save_baselines(path, doc)
+    back = perfbase.load_baselines(path)
+    assert back["schema"] == perfbase.BASELINE_SCHEMA_VERSION
+    entry = back["baselines"]["serving_smoke"]
+    assert entry["metrics"]["tokens_per_s"]["direction"] == "higher"
+
+
+def test_score_clean_run_passes():
+    res = perfbase.score(_baseline(),
+                         perfbase.extract_metrics(REPORT, STAGES))
+    assert res["pass"] and res["regressions"] == []
+
+
+def test_score_catches_the_acceptance_regressions():
+    """The ISSUE 18 pins: tokens/s -30% and p99 TTFT +3x must trip."""
+    import copy
+    bad = copy.deepcopy(REPORT)
+    bad["tokens_per_s"] = 7.0                      # -30%
+    bad["client"]["ttft_ms"]["p99"] = 900.0        # 3x
+    res = perfbase.score(_baseline(),
+                         perfbase.extract_metrics(bad, STAGES))
+    assert not res["pass"]
+    assert set(res["regressions"]) == {"tokens_per_s", "ttft_ms_p99"}
+    assert res["metrics"]["tokens_per_s"]["verdict"] == "regressed"
+    # Improvements in the good direction never fail.
+    good = copy.deepcopy(REPORT)
+    good["tokens_per_s"] = 30.0
+    good["client"]["ttft_ms"]["p99"] = 10.0
+    res = perfbase.score(_baseline(),
+                         perfbase.extract_metrics(good, STAGES))
+    assert res["pass"]
+    assert res["metrics"]["tokens_per_s"]["verdict"] == "improved"
+
+
+def test_score_missing_metric_fails():
+    m = perfbase.extract_metrics(REPORT, STAGES)
+    del m["tokens_per_s"]
+    res = perfbase.score(_baseline(), m)
+    assert not res["pass"]
+    assert res["metrics"]["tokens_per_s"]["verdict"] == "missing"
+
+
+def test_band_scale_widens_uniformly():
+    import copy
+    bad = copy.deepcopy(REPORT)
+    bad["tokens_per_s"] = 7.0                      # -30%, band 25%
+    m = perfbase.extract_metrics(bad, STAGES)
+    assert not perfbase.score(_baseline(), m)["pass"]
+    assert perfbase.score(_baseline(), m, band_scale=2.0)["pass"]
+
+
+def test_shed_rate_band_is_absolute():
+    import copy
+    bad = copy.deepcopy(REPORT)
+    bad["shed_rate"] = 0.35                        # +0.25 absolute
+    res = perfbase.score(_baseline(),
+                         perfbase.extract_metrics(bad, STAGES))
+    assert "shed_rate" in res["regressions"]
+    ok = copy.deepcopy(REPORT)
+    ok["shed_rate"] = 0.15                         # +0.05 < 0.10 band
+    assert perfbase.score(
+        _baseline(), perfbase.extract_metrics(ok, STAGES))["pass"]
+
+
+def test_format_diff_names_regressions():
+    import copy
+    bad = copy.deepcopy(REPORT)
+    bad["tokens_per_s"] = 1.0
+    res = perfbase.score(_baseline(),
+                         perfbase.extract_metrics(bad, STAGES))
+    txt = perfbase.format_diff(res)
+    assert "REGRESSION" in txt and "tokens_per_s" in txt
+    assert "PASS" in perfbase.format_diff(
+        perfbase.score(_baseline(),
+                       perfbase.extract_metrics(REPORT, STAGES)))
