@@ -32,6 +32,10 @@ driver-defined all_reduce metric):
    loadgen against a paged, multi-rank decode plane — sustained
    tokens/s with client-observed p99 TTFT/TPOT, then the shed rate
    at 2x the measured sustainable rate — in a CPU pool of its own.
+6. **Training integrity guard** (``extra.trainguard``, ISSUE 19):
+   guarded vs unguarded DDP steps/s at the default audit/snapshot
+   cadences plus the audit step's fingerprint cost — the <10%
+   guarded-overhead acceptance number, measured on CPU in-process.
 
 TPU bring-up failures (the axon tunnel flaps: device discovery hangs)
 retry with backoff, then fall back to a 2-process CPU/gloo world — the
@@ -1582,6 +1586,134 @@ def measure_serving() -> dict | None:
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def measure_trainguard() -> dict | None:
+    """The ISSUE 19 training-integrity-guard numbers: guarded vs
+    unguarded DDP step rate at the default audit/snapshot cadences,
+    plus the cost of one replica-consistency audit step (the param
+    fingerprint fold).  The acceptance bar is guarded overhead <10%:
+    the device-side finite gate rides the compiled step and the host
+    side resolves verdicts one step late, so the steady-state cost is
+    a deque rotation plus an already-materialized scalar read.
+
+    CPU, in-process: the mechanism under test is the guard
+    orchestration, not the accelerator."""
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from nbdistributed_tpu.parallel import data_parallel
+    from nbdistributed_tpu.parallel import mesh as mesh_mod
+    from nbdistributed_tpu.resilience import trainguard as tg
+
+    n_steps = 600
+    m = mesh_mod.make_mesh({"dp": 1})
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = jnp.tanh(x @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, kx = jax.random.split(key, 3)
+    params = {"w1": jax.random.normal(k1, (256, 256), jnp.float32) * 0.05,
+              "w2": jax.random.normal(k2, (256, 64), jnp.float32) * 0.05}
+    opt = optax.adam(1e-3)
+    # Batch 256 (= the hidden width): the guard's device-side work —
+    # the fp32 grad-norm² reduction and the cond's grad
+    # materialization — is O(params) and batch-INdependent, while the
+    # step's useful compute scales with the batch.  A 64-row batch
+    # over an 81K-param model makes the step artificially tiny
+    # relative to that fixed cost and measures mostly dispatch noise;
+    # square batches are the representative operating point.
+    batch = (jax.random.normal(kx, (256, 256)), jnp.zeros((256, 64)))
+
+    def make_runner(guard: bool):
+        # Fresh copies: replicate() aliases when the sharding already
+        # matches, and the donating step would eat the template tree.
+        p, _ = data_parallel.ddp_init(
+            jax.tree_util.tree_map(jnp.copy, params), None, m)
+        s = jax.jit(opt.init)(p)
+        step = data_parallel.make_ddp_step(loss_fn, opt, m, guard=guard)
+        if guard:
+            g = tg.TrainGuard(step, p, s, rank=0)
+
+            def run(n: int) -> None:
+                loss = None
+                for _ in range(n):
+                    loss = g.step(batch)
+                jax.block_until_ready(loss)
+
+            return run, g.finish
+        state = [p, s]
+
+        def run(n: int) -> None:
+            p, s = state
+            for _ in range(n):
+                p, s, loss = step(p, s, batch)
+            state[:] = [p, s]
+            jax.block_until_ready(loss)
+
+        return run, (lambda: None)
+
+    # The CPU here is shared and noisy (identical reps vary by >20%),
+    # so back-to-back whole-loop timings compare different wall-clock
+    # windows and the noise swamps the signal.  Interleave the two
+    # loops in small slices instead: any interference burst lands on
+    # both sides roughly equally, and the *ratio* — the number under
+    # acceptance — stays honest.  The guarded side still steps its own
+    # counter, so the default audit/snapshot cadences fire exactly as
+    # they would in a straight run.
+    run_u, fin_u = make_runner(guard=False)
+    run_g, fin_g = make_runner(guard=True)
+    # Warm the guarded runner PAST its first audit+snapshot (default
+    # cadence 50): the first post-step snapshot re-specializes the
+    # jitted tree copy for the stepped opt state's layouts, a one-time
+    # per-process compile that a 200-step microbenchmark would
+    # otherwise misread as recurring audit cost.
+    run_u(55)
+    run_g(55)
+    # Per-side throughput = chunk size over the MINIMUM chunk time
+    # (standard timeit practice): interference only ever adds time, so
+    # the fastest of many small interleaved chunks estimates each
+    # side's uncontended cost — medians still carried 5-10 points of
+    # run-to-run jitter on this box.  The chunk equals the default
+    # audit/snapshot cadence (50), so EVERY guarded chunk carries
+    # exactly one audit + one snapshot — the minimum cannot dodge the
+    # event cost the acceptance bar is about.
+    chunk = 50
+    ts_u: list[float] = []
+    ts_g: list[float] = []
+    for _ in range(n_steps // chunk):
+        t0 = _time.perf_counter()
+        run_u(chunk)
+        ts_u.append(_time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        run_g(chunk)
+        ts_g.append(_time.perf_counter() - t0)
+    fin_g()
+    fin_u()
+    base = chunk / min(ts_u)
+    guarded = chunk / min(ts_g)
+    # One audit step's cost in isolation: fingerprint fold over the
+    # params (world=1, so the gather/vote legs are the short-circuit).
+    p, _ = data_parallel.ddp_init(
+        jax.tree_util.tree_map(jnp.copy, params), None, m)
+    tg.tree_fingerprint(p)  # compile
+    t0 = _time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        tg.tree_fingerprint(p)
+    audit_ms = (_time.perf_counter() - t0) / reps * 1000.0
+    return {"backend": "cpu", "steps": n_steps,
+            "steps_per_s_unguarded": round(base, 2),
+            "steps_per_s_guarded": round(guarded, 2),
+            "overhead_pct": round((base - guarded) / base * 100.0, 2),
+            "audit_step_ms": round(audit_ms, 3)}
+
+
 def main() -> int:
     # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
     # spawned workers: raising SystemExit lets run()'s finally-block
@@ -1793,6 +1925,16 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                 log(f"[bench] serving: {sv}")
         except Exception as e:
             log(f"[bench] serving measurement skipped: {e}")
+
+        # Training integrity guard (ISSUE 19): guarded vs unguarded
+        # DDP step rate + the audit step's fingerprint cost.
+        try:
+            gd = measure_trainguard()
+            if gd:
+                extra["trainguard"] = gd
+                log(f"[bench] trainguard: {gd}")
+        except Exception as e:
+            log(f"[bench] trainguard measurement skipped: {e}")
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"

@@ -103,6 +103,10 @@ _THREAD_CHECKED_FILES = (
     # rides the list so any future cache/memo grows a lock.
     os.path.join("nbdistributed_tpu", "observability", "servingobs.py"),
     os.path.join("nbdistributed_tpu", "observability", "perfbase.py"),
+    # Training integrity guard (ISSUE 19): TrainGuard's counters and
+    # snapshot ring are mutated on the train-loop thread while the
+    # heartbeat thread reads the published snapshot.
+    os.path.join("nbdistributed_tpu", "resilience", "trainguard.py"),
 )
 
 

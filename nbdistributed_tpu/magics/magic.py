@@ -2075,6 +2075,32 @@ class DistributedMagics(Magics):
     @argument("--link-hosts", default=None, dest="link_hosts",
               help="host pair 'hostA,hostB' for --link-latency/"
                    "--link-loss ('*,hostB' matches any peer)")
+    @argument("--corrupt", default=None,
+              help="param-leaf path substring to corrupt on "
+                   "--corrupt-rank at --corrupt-step ('*' = first "
+                   "leaf) — the SDC drill the training-integrity "
+                   "guard's audit exists to catch (ISSUE 19); fires "
+                   "inside the rank's guarded train loop")
+    @argument("--corrupt-rank", type=int, default=None,
+              dest="corrupt_rank",
+              help="rank whose params --corrupt damages")
+    @argument("--corrupt-step", type=int, default=1,
+              dest="corrupt_step",
+              help="guarded-step index at which the corruption fires "
+                   "(one-shot, >= semantics)")
+    @argument("--corrupt-mode", default="bitflip",
+              choices=["bitflip", "scale"], dest="corrupt_mode",
+              help="bitflip: XOR seeded bits; scale: multiply a "
+                   "seeded contiguous slice by --corrupt-scale")
+    @argument("--corrupt-bits", type=int, default=1,
+              dest="corrupt_bits",
+              help="bits to flip in bitflip mode")
+    @argument("--corrupt-scale", type=float, default=4.0,
+              dest="corrupt_scale",
+              help="multiplier for scale mode")
+    @argument("--corrupt-count", type=int, default=1,
+              dest="corrupt_count",
+              help="elements the scale-mode slice covers")
     @line_magic
     def dist_chaos(self, line):
         """Deterministic fault injection on the live control plane:
@@ -2174,6 +2200,31 @@ class DistributedMagics(Magics):
                           f"this world's host map {sorted(known)} — "
                           "the spec will match nothing")
             spec["links"] = links
+        corrupt = None
+        if args.corrupt is not None:
+            if args.corrupt_rank is None:
+                print("❌ --corrupt needs --corrupt-rank to name the "
+                      "rank whose params get damaged")
+                return
+            from ..resilience.faults import CorruptSpec
+            try:
+                # Build the real CorruptSpec (validation) and ship its
+                # spec() — the same dict FaultPlan.from_spec rebuilds,
+                # so magic and env (NBD_CORRUPT_SPEC) stay one format.
+                corrupt = CorruptSpec(
+                    rank=args.corrupt_rank, step=args.corrupt_step,
+                    name=args.corrupt.strip().strip("'\""),
+                    mode=args.corrupt_mode, bits=args.corrupt_bits,
+                    scale=args.corrupt_scale,
+                    count=args.corrupt_count).spec()
+            except (TypeError, ValueError) as e:
+                print(f"❌ bad --corrupt spec: {e}")
+                return
+            if args.side == "coordinator":
+                print("⚠️ --corrupt ignored: corruption fires inside "
+                      "the workers' guarded train loop, but --side "
+                      "coordinator never ships them a plan")
+                corrupt = None
         kill_armed = (args.kill_rank is not None
                       and args.side in ("worker", "both"))
         if args.kill_rank is not None and not kill_armed:
@@ -2188,6 +2239,8 @@ class DistributedMagics(Magics):
             if kill_armed:
                 wspec["kill_rank"] = args.kill_rank
                 wspec["kill_at"] = args.kill_at or 1
+            if corrupt is not None:
+                wspec["corrupt"] = [corrupt]
             try:
                 self._comm.send_to_all("chaos", {"action": "set",
                                                  "spec": wspec},
@@ -2206,7 +2259,58 @@ class DistributedMagics(Magics):
                 if not self._comm.retry.enabled() else "")
         print(f"💥 chaos ON ({args.side}): {spec}"
               + (f" · kill rank {args.kill_rank} at msg "
-                 f"{args.kill_at or 1}" if kill_armed else "") + warn)
+                 f"{args.kill_at or 1}" if kill_armed else "")
+              + (f" · corrupt rank {corrupt['rank']} step "
+                 f"{corrupt['step']} {corrupt['mode']} "
+                 f"{corrupt['name']!r}" if corrupt else "") + warn)
+
+    @magic_arguments()
+    @argument("command", nargs="?", default="status",
+              choices=["status", "on", "off", "audit"])
+    @line_magic
+    def dist_guard(self, line):
+        """Training-integrity guard control (ISSUE 19):
+        ``%dist_guard`` reports each rank's TrainGuard (skips, audits,
+        repairs, rollbacks, quarantine suspects); ``on``/``off``
+        toggles the host-side machinery; ``audit`` forces a
+        replica-consistency audit now on every rank (the fan-out is
+        what keeps the audit's all-gather aligned)."""
+        args = parse_argstring(self.dist_guard, line)
+        if not self._require_cluster():
+            return
+        action = {"status": "status", "on": "on", "off": "off",
+                  "audit": "audit"}[args.command]
+        try:
+            resps = self._comm.send_to_all("guard", {"action": action},
+                                           timeout=60)
+        except Exception as e:
+            print(f"❌ guard {action} failed: {e}")
+            return
+        for r in sorted(resps):
+            d = resps[r].data or {}
+            if d.get("error"):
+                print(f"🔹 rank {r}: ⚠ {d['error']}")
+                continue
+            if not d.get("active"):
+                print(f"🔹 rank {r}: enabled={d.get('enabled')} · "
+                      f"no live TrainGuard")
+                continue
+            line_out = (f"🔹 rank {r}: step {d.get('step')} · "
+                        f"skips {d.get('skips')} "
+                        f"(streak {d.get('skip_streak')}/"
+                        f"{d.get('skip_budget')}) · "
+                        f"audits {d.get('audits')} "
+                        f"(last @{d.get('last_audit_step')}: "
+                        f"{d.get('last_verdict')}) · "
+                        f"repairs {d.get('repairs')} · "
+                        f"rollbacks {d.get('rollbacks')}")
+            if d.get("suspects"):
+                line_out += f" · 🔶 suspects {d['suspects']}"
+            print(line_out)
+        if action == "audit":
+            print("✅ audit fanned out to every rank")
+        elif action in ("on", "off"):
+            print(f"✅ guard {action}")
 
     # ==================================================================
     # hang watchdog + stuck-cell doctor (ISSUE 5)
@@ -3942,11 +4046,16 @@ class DistributedMagics(Magics):
         # server — idle clusters keep the pre-serving layout.
         kv_seen = any((comm.last_ping(r) or (0, {}))[1].get("srv")
                       for r in range(self._world))
+        # Guard column (ISSUE 19) only when some rank's ping carries a
+        # TrainGuard snapshot — guard-free sessions keep their layout.
+        guard_seen = any((comm.last_ping(r) or (0, {}))[1].get("tg")
+                         for r in range(self._world))
         hdr = (f"{'rank':<5}{'state':<11}{'busy':<18}"
                + (f"{'tenant':<11}" if tenants_seen else "")
                + f"{'hb-age':<8}"
                f"{'col#':<7}{'HBM use/limit GB':<18}{'peak':<7}"
                + (f"{'kv':<12}{'frag':<6}" if kv_seen else "")
+               + (f"{'guard':<16}" if guard_seen else "")
                + f"{'bufs':<6}{'compiles':<9}{'dedup':<6}")
         print(hdr)
         print("─" * len(hdr))
@@ -4012,9 +4121,24 @@ class DistributedMagics(Magics):
                 frag = srv.get("frag")
                 kvcol += (f"{frag:<6}" if frag is not None
                           else f"{'-':<6}")
+            gcol = ""
+            if guard_seen:
+                tg = (ping[1].get("tg") or {}) if ping else {}
+                if tg:
+                    # skips · last audit verdict (· rollbacks / 🔶
+                    # quarantine suspects when present): the at-a-
+                    # glance "is anything eating my steps" cell.
+                    g = f"s{tg.get('sk', 0)} {tg.get('v', '?')}"
+                    if tg.get("rb"):
+                        g += f" rb{tg['rb']}"
+                    if tg.get("qr"):
+                        g += f" 🔶{tg['qr']}"
+                    gcol = f"{g:<16}"
+                else:
+                    gcol = f"{'-':<16}"
             print(f"{r:<5}{state:<11}{busy:<18}{tcol}{hb:<8}{col:<7}"
                   f"{mem:<18}"
-                  f"{peak:<7}{kvcol}{str(tel.get('bufs', '-')):<6}"
+                  f"{peak:<7}{kvcol}{gcol}{str(tel.get('bufs', '-')):<6}"
                   f"{str(tel.get('compiles', '-')):<9}"
                   f"{str(tel.get('dedup', '-')):<6}")
         print(f"coordinator: retries sent {comm.retries_sent} · "

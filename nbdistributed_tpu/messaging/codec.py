@@ -111,6 +111,12 @@ WIRE_EXTENSIONS: dict[str, dict] = {
                    "steps/s) — per-step telemetry with one dispatch; "
                    "also collective-progress evidence for the hang "
                    "watchdog (a stepping loop is never a stall)"},
+    "tg": {"plane": "ping",
+           "doc": "training-integrity guard snapshot while a "
+                  "TrainGuard is live (skip count, last audit "
+                  "step/verdict, rollback/repair counts, quarantine "
+                  "suspects) — the %dist_top guard column and the "
+                  "Supervisor's quarantine scan"},
 }
 
 

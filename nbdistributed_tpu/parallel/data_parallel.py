@@ -14,7 +14,7 @@ from . import mesh as mesh_mod
 
 
 def make_ddp_step(loss_fn, optimizer, mesh, *, dp_axis: str = "dp",
-                  donate: bool = True):
+                  donate: bool = True, guard: bool = False):
     """Build a jitted DDP train step.
 
     ``loss_fn(params, batch) -> scalar``.  Params/opt state are
@@ -26,12 +26,15 @@ def make_ddp_step(loss_fn, optimizer, mesh, *, dp_axis: str = "dp",
     etc. land in one place).
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state,
-    loss)``.
+    loss)``; with ``guard=True`` (ISSUE 19) the step instead returns
+    ``(params, opt_state, loss, aux)`` and skips the update on
+    non-finite gradients — see
+    :func:`~nbdistributed_tpu.parallel.tensor_parallel.make_tp_train_step`.
     """
     from . import tensor_parallel
     return tensor_parallel.make_tp_train_step(
         loss_fn, optimizer, mesh, param_rules=None, dp_axis=dp_axis,
-        donate=donate)
+        donate=donate, guard=guard)
 
 
 def ddp_init(params, opt_state, mesh):

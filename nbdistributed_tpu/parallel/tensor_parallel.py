@@ -35,7 +35,7 @@ def sharding_tree(mesh, rules):
 def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
                        dp_axis: str = "dp", donate: bool = True,
                        opt_state_sh=None, accum_steps: int = 1,
-                       accum_rules=None):
+                       accum_rules=None, guard: bool = False):
     """Combined dp×tp train step: params sharded by ``param_rules``
     (tp axes; ``None`` = fully replicated, i.e. pure DDP), batch sharded
     on ``dp_axis``.
@@ -59,7 +59,19 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
     optimizer state is ZeRO-sharded — the accumulator is the one
     place a *persistent* full-size gradient buffer exists, so it is
     the one place ZeRO-2 sharding buys memory (4 bytes/param/replica
-    → /dp)."""
+    → /dp).
+
+    ``guard=True`` (ISSUE 19) fuses a device-side integrity check into
+    the step: the fp32 global grad-norm² (one extra reduction riding
+    the same compiled program — no extra host sync) gates the update,
+    so a non-finite gradient *skips* it and params/opt state come back
+    bitwise unchanged.  The step then returns a 4-tuple
+    ``(params, opt_state, loss, aux)`` with replicated device scalars
+    ``aux = {"v": float32[3]}`` — the ``v`` lane packs ``[ok, loss,
+    gnorm]`` for a single-transfer host resolve — that the host-side
+    :class:`~nbdistributed_tpu.resilience.trainguard.TrainGuard`
+    resolves one step late — the skip decision itself never leaves
+    the device."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     repl = NamedSharding(mesh, P())
@@ -120,12 +132,47 @@ def make_tp_train_step(loss_fn, optimizer, mesh, param_rules, *,
 
     def step(params, opt_state, batch):
         loss, grads = grads_of(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        if not guard:
+            updates, new_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), new_state, loss
+        # Fused finite check: the fp32 sum of squares over every grad
+        # leaf is non-finite iff any leaf holds a NaN/inf (NaN
+        # propagates through the sum; inf² = inf), and doubles as the
+        # global grad-norm² — one reduction, computed inside the same
+        # program, where the dp all-reduce already paid for the
+        # gradients.  The optimizer update runs inside a scalar-pred
+        # ``lax.cond``: the skip branch passes the OLD buffers through
+        # bitwise intact, and the healthy branch pays no extra select
+        # pass over params/opt state (a per-leaf ``where`` gate costs
+        # ~20% of a CPU step in pure memory traffic).
+        gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads))
+        ok = jnp.isfinite(gn_sq) & jnp.isfinite(loss)
 
+        def do_update(_):
+            updates, new_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), new_state
+
+        def skip_update(_):
+            return params, opt_state
+
+        out_params, out_state = jax.lax.cond(ok, do_update, skip_update,
+                                             None)
+        # Packed verdict [ok, loss, gnorm] as the ONLY aux output:
+        # the host resolves a whole step with one 12-byte transfer,
+        # and the jit call materializes one extra array per step
+        # instead of three.
+        aux = {"v": jnp.stack([ok.astype(jnp.float32),
+                               loss.astype(jnp.float32),
+                               jnp.sqrt(gn_sq)])}
+        return out_params, out_state, loss, aux
+
+    out_sh = ((param_sh, opt_state_sh, repl, repl) if guard
+              else (param_sh, opt_state_sh, repl))
     return jax.jit(
         step,
         in_shardings=(param_sh, opt_state_sh, batch_sh),
-        out_shardings=(param_sh, opt_state_sh, repl),
+        out_shardings=out_sh,
         donate_argnums=(0, 1) if donate else ())

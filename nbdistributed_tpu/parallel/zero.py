@@ -64,7 +64,8 @@ def zero1_state_shardings(optimizer, params, param_rules, mesh, *,
 
 
 def make_zero1_train_step(loss_fn, optimizer, mesh, param_rules, params,
-                          *, dp_axis: str = "dp", donate: bool = True):
+                          *, dp_axis: str = "dp", donate: bool = True,
+                          guard: bool = False):
     """dp×tp train step with ZeRO-1 optimizer-state sharding.
 
     Same signature family as ``make_tp_train_step`` plus ``params``
@@ -72,13 +73,17 @@ def make_zero1_train_step(loss_fn, optimizer, mesh, param_rules, params,
     ``(step, init)``: ``init(params)`` builds the dp-sharded optimizer
     state; ``step(params, opt_state, batch)`` is the jitted update —
     the *same* step definition as ``make_tp_train_step``, with the
-    state shardings pinned to the ZeRO-1 layout.
+    state shardings pinned to the ZeRO-1 layout.  ``guard=True``
+    (ISSUE 19) composes the integrity-guarded step variant — the
+    skip-on-non-finite ``where`` selects per *shard*, so the ZeRO
+    layout is preserved bitwise on a skipped update too.
     """
     # ZeRO-1 is exactly ZeRO-2 without an accumulator (accum_steps=1):
     # one setup path, so a sharding fix can never drift between them.
     return make_zero2_train_step(loss_fn, optimizer, mesh, param_rules,
                                  params, accum_steps=1,
-                                 dp_axis=dp_axis, donate=donate)
+                                 dp_axis=dp_axis, donate=donate,
+                                 guard=guard)
 
 
 def zero2_accum_rules(params, param_rules, mesh, *,
@@ -97,7 +102,7 @@ def zero2_accum_rules(params, param_rules, mesh, *,
 
 def make_zero2_train_step(loss_fn, optimizer, mesh, param_rules, params,
                           *, accum_steps: int, dp_axis: str = "dp",
-                          donate: bool = True):
+                          donate: bool = True, guard: bool = False):
     """ZeRO-2: ZeRO-1's sharded optimizer state **plus** a dp-sharded
     fp32 gradient accumulator.
 
@@ -137,5 +142,5 @@ def make_zero2_train_step(loss_fn, optimizer, mesh, param_rules, params,
                               dp_axis=dp_axis, donate=donate,
                               opt_state_sh=state_sh,
                               accum_steps=accum_steps,
-                              accum_rules=accum)
+                              accum_rules=accum, guard=guard)
     return step, init

@@ -47,11 +47,11 @@ stays light and the modules are unit-testable without a backend.
 
 from . import session
 from .dedup import ReplayCache, ResultMailbox
-from .faults import FaultPlan
+from .faults import CorruptSpec, FaultPlan
 from .retry import RetryPolicy
 from .supervisor import Supervisor, SupervisorPolicy
 from .watchdog import HangPolicy, HangWatchdog, SkewDetector, hang_report
 
-__all__ = ["FaultPlan", "HangPolicy", "HangWatchdog", "ReplayCache",
-           "ResultMailbox", "RetryPolicy", "SkewDetector", "Supervisor",
-           "SupervisorPolicy", "hang_report", "session"]
+__all__ = ["CorruptSpec", "FaultPlan", "HangPolicy", "HangWatchdog",
+           "ReplayCache", "ResultMailbox", "RetryPolicy", "SkewDetector",
+           "Supervisor", "SupervisorPolicy", "hang_report", "session"]

@@ -44,12 +44,85 @@ DEFAULT_EXEMPT = ("ping",)
 _SPEC_KEYS = frozenset({
     "seed", "drop", "delay_p", "delay_s", "duplicate", "truncate",
     "freeze_heartbeat", "kill_rank", "kill_at", "exempt",
-    "freeze_rank", "freeze_at", "freeze_s", "links",
+    "freeze_rank", "freeze_at", "freeze_s", "links", "corrupt",
 })
 
 _LINK_KEYS = frozenset({
     "hosts", "after_s", "for_s", "latency_s", "loss", "bw_bytes_s",
 })
+
+_CORRUPT_KEYS = frozenset({
+    "rank", "step", "name", "mode", "bits", "scale", "count",
+})
+
+_CORRUPT_MODES = ("bitflip", "scale")
+
+
+class CorruptSpec:
+    """One silent-data-corruption injection (ISSUE 19): damage a named
+    array on rank ``rank`` at guarded-train step ``step``.
+
+    Unlike the frame faults above, corruption targets the *data plane*
+    — the parameters a guarded train step (resilience/trainguard.py)
+    is about to consume — so the replica-consistency audit has a
+    deterministic SDC to detect, attribute, and repair.
+
+    - ``name`` — substring match against the pytree leaf path
+      (``jax.tree_util.keystr``); ``"*"`` matches the first leaf.
+    - ``mode`` — ``bitflip`` XORs ``bits`` seeded bit positions in the
+      leaf's raw bytes (the classic cosmic-ray/SDC model: any bit,
+      including exponent bits that turn the value NaN/inf); ``scale``
+      multiplies a seeded contiguous run of ``count`` elements by
+      ``scale`` (a bounded numeric skew that stays finite).
+    - One-shot semantics with ``>=`` on the step index, like
+      ``kill_at``/``freeze_at``: a skipped step can never disarm it.
+
+    Positions are pure functions of the owning plan's seed and this
+    spec's fields, so a fixed seed replays the exact same corruption.
+    """
+
+    def __init__(self, *, rank: int, step: int, name: str = "*",
+                 mode: str = "bitflip", bits: int = 1,
+                 scale: float = 4.0, count: int = 1):
+        self.rank = int(rank)
+        self.step = int(step)
+        if self.rank < 0 or self.step < 0:
+            raise ValueError(f"corrupt spec rank/step must be >= 0 "
+                             f"(got rank={rank!r}, step={step!r})")
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"corrupt spec needs a non-empty leaf-path "
+                             f"name (or '*'), got {name!r}")
+        if mode not in _CORRUPT_MODES:
+            raise ValueError(f"corrupt spec mode must be one of "
+                             f"{_CORRUPT_MODES}, got {mode!r}")
+        self.name = name
+        self.mode = mode
+        self.bits = int(bits)
+        self.scale = float(scale)
+        self.count = int(count)
+        if self.bits < 1 or self.count < 1:
+            raise ValueError(f"corrupt spec bits/count must be >= 1 "
+                             f"(got bits={bits!r}, count={count!r})")
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "CorruptSpec":
+        if not isinstance(spec, dict):
+            raise TypeError(f"corrupt spec must be a dict, got "
+                            f"{type(spec).__name__}")
+        unknown = set(spec) - _CORRUPT_KEYS
+        if unknown:
+            raise ValueError(f"unknown corrupt spec keys "
+                             f"{sorted(unknown)} "
+                             f"(known: {sorted(_CORRUPT_KEYS)})")
+        if "rank" not in spec or "step" not in spec:
+            raise ValueError(f"corrupt spec needs both rank and step "
+                             f"(got {sorted(spec)})")
+        return cls(**spec)
+
+    def spec(self) -> dict:
+        return {"rank": self.rank, "step": self.step, "name": self.name,
+                "mode": self.mode, "bits": self.bits,
+                "scale": self.scale, "count": self.count}
 
 
 class LinkSpec:
@@ -160,7 +233,7 @@ class FaultPlan:
                  freeze_rank: int | None = None,
                  freeze_at: int | None = None,
                  freeze_s: float = DEFAULT_FREEZE_S,
-                 links=None,
+                 links=None, corrupt=None,
                  exempt=DEFAULT_EXEMPT):
         self.seed = int(seed)
         self.drop = float(drop)
@@ -196,6 +269,14 @@ class FaultPlan:
         self.links = tuple(
             l if isinstance(l, LinkSpec) else LinkSpec.from_spec(l)
             for l in (links or ()))
+        # Silent-data-corruption specs (ISSUE 19), consumed by the
+        # guarded train step.  One-shot per spec (``_corrupt_done``
+        # indexes into the tuple) so a flip fires exactly once even
+        # when the step index is consulted every step thereafter.
+        self.corrupt = tuple(
+            c if isinstance(c, CorruptSpec) else CorruptSpec.from_spec(c)
+            for c in (corrupt or ()))
+        self._corrupt_done: set[int] = set()
         self._t0 = time.monotonic()
         self.exempt = frozenset(exempt or ())
         self._lock = threading.Lock()
@@ -204,7 +285,7 @@ class FaultPlan:
         self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
                          "duplicated": 0, "truncated": 0, "exempt": 0,
                          "frozen": 0, "link_dropped": 0,
-                         "link_delayed": 0}
+                         "link_delayed": 0, "corrupted": 0}
         # Timestamped record of every non-clean decision, bounded, for
         # the observability layer: the merged Chrome trace folds these
         # in as instant events so a chaos run shows WHERE the drops
@@ -233,9 +314,20 @@ class FaultPlan:
         from ..utils import knobs
         raw = (knobs.get_raw(var) if var in knobs.KNOBS
                else os.environ.get(var))
-        if not raw:
+        spec = json.loads(raw) if raw else None
+        if var == "NBD_FAULT_PLAN":
+            # Spawn-time SDC injection (ISSUE 19): NBD_CORRUPT_SPEC is
+            # a JSON list of corrupt specs folded into the plan, so a
+            # chaos test can arm a bit-flip without composing the full
+            # fault-plan JSON by hand.
+            craw = knobs.get_raw("NBD_CORRUPT_SPEC")
+            if craw:
+                spec = dict(spec or {})
+                spec["corrupt"] = (list(spec.get("corrupt") or ())
+                                   + list(json.loads(craw)))
+        if not spec:
             return None
-        return cls.from_spec(json.loads(raw))
+        return cls.from_spec(spec)
 
     def spec(self) -> dict:
         """Round-trippable description (``from_spec(p.spec())`` builds
@@ -248,6 +340,7 @@ class FaultPlan:
                 "freeze_rank": self.freeze_rank,
                 "freeze_at": self.freeze_at, "freeze_s": self.freeze_s,
                 "links": [l.spec() for l in self.links],
+                "corrupt": [c.spec() for c in self.corrupt],
                 "exempt": sorted(self.exempt)}
 
     # ------------------------------------------------------------------
@@ -356,6 +449,43 @@ class FaultPlan:
         return self.freeze_s
 
     # ------------------------------------------------------------------
+    # silent data corruption (guarded train step, ISSUE 19)
+
+    def has_corrupt(self) -> bool:
+        return bool(self.corrupt)
+
+    def corrupt_due(self, rank: int, step: int) -> "list[CorruptSpec]":
+        """Corrupt specs firing for ``rank`` at guarded-step ``step``
+        — ONE-SHOT per spec, ``>=`` on the step index like
+        ``should_kill`` so a skipped step can never disarm one.
+        Consumed under the lock: a spec fires exactly once."""
+        if not self.corrupt:
+            return []
+        due = []
+        with self._lock:
+            for i, c in enumerate(self.corrupt):
+                if (c.rank == rank and step >= c.step
+                        and i not in self._corrupt_done):
+                    self._corrupt_done.add(i)
+                    due.append(c)
+        return due
+
+    def note_corrupt(self, spec: "CorruptSpec", *, step: int,
+                     leaf: str = "") -> None:
+        """Record an injected corruption in the counters, the bounded
+        event log (merged traces / postmortems fold these in beside
+        the frame faults), and the crash-surviving flight ring."""
+        flightrec.record("fault", actions=["corrupt"], kind=spec.mode,
+                         index=step, rank=spec.rank, leaf=leaf)
+        with self._lock:
+            self.counters["corrupted"] += 1
+            if len(self._events) < self.MAX_EVENTS:
+                self._events.append(
+                    {"ts": time.time(), "index": step,
+                     "actions": ["corrupt"], "kind": spec.mode,
+                     "rank": spec.rank, "leaf": leaf})
+
+    # ------------------------------------------------------------------
     # per-link shaping (transport hooks, ISSUE 6)
 
     def has_links(self) -> bool:
@@ -429,3 +559,27 @@ class FaultPlan:
                 self.counters["link_delayed"] += 1
             time.sleep(wait)
         self.transmit(frame, send, kind=kind)
+
+
+# ----------------------------------------------------------------------
+# process-wide plan registry (ISSUE 19)
+#
+# The transports consult the plan through the objects the worker hands
+# them, but the guarded train step runs deep inside user cells with no
+# worker reference in scope — it reads the plan from here instead.  The
+# worker's two plan-install paths (spawn-time NBD_FAULT_PLAN and the
+# runtime %dist_chaos arm in _set_fault_plan) both publish through
+# set_process_plan, so the data-plane corruption faults always track
+# the live control-plane plan.  Single-writer by construction: both
+# install paths run on the worker's serial request loop.
+
+_process_plan: "FaultPlan | None" = None
+
+
+def set_process_plan(plan: "FaultPlan | None") -> None:
+    global _process_plan
+    _process_plan = plan
+
+
+def process_plan() -> "FaultPlan | None":
+    return _process_plan

@@ -43,6 +43,14 @@ HEALING = "healing"
 # grounds for healing until the partition grace expires — the far side
 # is (probably) alive, orphaned, and holding state.
 SUSPECT = "suspect-partition"
+# Training-integrity quarantine suspicion (ISSUE 19): the rank's
+# TrainGuard kept landing in the audit minority — its arithmetic is
+# producing different bits than the rest of the replica set (SDC-class
+# hardware suspicion).  ADVISORY and STICKY: the rank stays in the
+# liveness state machine (it is alive and being repaired), the label
+# rides %dist_status until a heal replaces the world — it never
+# triggers healing by itself.
+QUARANTINE = "quarantine-suspect"
 
 
 @dataclass(frozen=True)
@@ -92,6 +100,11 @@ class Supervisor:
         self.last_postmortem: dict | None = None
         self._postmortem_pending: set[int] = set()
         self._state: dict[int, str] = {}
+        # Advisory quarantine suspicions (ISSUE 19): rank → detail.
+        # Parallel to the liveness states on purpose — a quarantined
+        # rank is alive and supervised normally; this is a sticky
+        # label, not a lifecycle stage.
+        self._quarantined: dict[int, str] = {}
         self._sentry: PartitionSentry | None = None
         self._restarts: deque[float] = deque()
         self._comm = None
@@ -132,6 +145,7 @@ class Supervisor:
             self._hook_pm(pm)
             self._comm, self._pm = comm, pm
             self._state = {r: ALIVE for r in range(comm.num_workers)}
+            self._quarantined = {}
             self._pending_heal = False
             self._sentry = PartitionSentry(
                 hosts, local_host=getattr(comm, "local_host", "local"),
@@ -198,6 +212,26 @@ class Supervisor:
         flightrec.record("supervisor_transition", rank=rank,
                          frm=frm, to=to, detail=detail)
 
+    def note_quarantine_suspect(self, rank: int, detail: str = "") -> None:
+        """Mark ``rank`` as a training-integrity quarantine suspect
+        (ISSUE 19).  Advisory + sticky + idempotent: recorded once in
+        the event log / flight ring, surfaced by ``%dist_status``, and
+        cleared only when a new world attaches or a heal replaces the
+        fleet.  Never schedules a heal — a rank producing wrong bits
+        is still a live rank, and the repair path (majority
+        re-broadcast) already fixed its state; this is the operator
+        signal to retire the hardware."""
+        with self._lock:
+            if rank in self._quarantined:
+                return
+            self._quarantined[rank] = detail
+            self.transitions += 1
+            self.events.append({"ts": self._clock(), "rank": rank,
+                                "from": self._state.get(rank),
+                                "to": QUARANTINE, "detail": detail})
+        flightrec.record("supervisor_transition", rank=rank,
+                         frm=None, to=QUARANTINE, detail=detail)
+
     # ------------------------------------------------------------------
     # loop
 
@@ -209,6 +243,7 @@ class Supervisor:
                 return
             try:
                 self._scan_staleness()
+                self._scan_guard()
                 self._scan_partitions()
                 self._capture_postmortems()
                 if self.policy.auto_heal and self._heal_needed():
@@ -244,6 +279,29 @@ class Supervisor:
                 elif age <= self.policy.degraded_after_s \
                         and st == DEGRADED:
                     self._transition(rank, ALIVE, "heartbeat resumed")
+
+    def _scan_guard(self) -> None:
+        """Harvest training-integrity quarantine suspects from the
+        heartbeat ``tg`` piggyback (ISSUE 19) — pings only, no status
+        probe: a worker mid-cell still reports.  Any rank's guard may
+        name any suspect (verdicts are computed identically on every
+        rank), so the union over all pings is taken."""
+        with self._lock:
+            comm = self._comm
+        if comm is None:
+            return
+        for r in range(comm.num_workers):
+            ping = comm.last_ping(r)
+            if not ping:
+                continue
+            tg = (ping[1] or {}).get("tg")
+            if not isinstance(tg, dict):
+                continue
+            for suspect in tg.get("qr") or ():
+                if isinstance(suspect, int):
+                    self.note_quarantine_suspect(
+                        suspect, f"rank {r}'s guard reports repeated "
+                                 f"audit divergence (tg.qr)")
 
     # ------------------------------------------------------------------
     # partition suspicion (multi-host worlds)
@@ -430,6 +488,10 @@ class Supervisor:
                                for r in range(comm.num_workers)}
             for r in list(self._state):
                 self._transition(r, ALIVE, "healed")
+            # A heal replaces the processes (and their state was
+            # restored from a good checkpoint): stale quarantine
+            # suspicions would smear the fresh world.
+            self._quarantined = {}
             self.heals_done += 1
             comm, pm = self._comm, self._pm
         # Durable-session manifest upkeep: the healed fleet's pids and
@@ -464,6 +526,7 @@ class Supervisor:
                     "transitions": self.transitions,
                     "suspected_hosts": (sentry.suspected_hosts()
                                         if sentry is not None else {}),
+                    "quarantined": dict(self._quarantined),
                     "last_postmortem": (self.last_postmortem or {})
                     .get("dir"),
                     "events": list(self.events)}
@@ -472,9 +535,12 @@ class Supervisor:
         """Human-readable block for ``%dist_status``."""
         st = self.status()
         icon = {ALIVE: "●", DEGRADED: "◐", DEAD: "✖", HEALING: "🩹",
-                SUSPECT: "⚡"}
-        ranks = " ".join(f"{icon.get(s, '?')}{r}:{s}"
-                         for r, s in sorted(st["states"].items()))
+                SUSPECT: "⚡", QUARANTINE: "🔶"}
+        quarantined = st["quarantined"]
+        ranks = " ".join(
+            ("🔶" if r in quarantined else "") +
+            f"{icon.get(s, '?')}{r}:{s}"
+            for r, s in sorted(st["states"].items()))
         lines = [f"🛡  supervisor: {ranks or '(no ranks)'} · "
                  f"restarts {st['restarts_used']}/{st['max_restarts']} "
                  f"in window · heals {st['heals_done']} ok"
@@ -485,6 +551,10 @@ class Supervisor:
             note = self._sentry.describe()
             if note:
                 lines.append(f"   {note}")
+        if quarantined:
+            lines.append("   🔶 quarantine suspects: " + ", ".join(
+                f"rank {r} ({d})" if d else f"rank {r}"
+                for r, d in sorted(quarantined.items())))
         for ev in list(st["events"])[-5:]:
             rank = "world" if ev["rank"] is None else f"rank {ev['rank']}"
             lines.append(f"   {time.strftime('%H:%M:%S', time.localtime(ev['ts']))} "

@@ -47,8 +47,14 @@ import os
 import pickle
 import re
 import shutil
+import zlib
 from typing import Any
 
+# Array leaves additionally carry a per-leaf "crc32" in the manifest
+# (ISSUE 19): restore refuses torn or bit-rotted bytes with an error
+# naming the exact leaf instead of silently resurrecting corrupted
+# state.  Purely additive — the key is optional on read, so version 1
+# checkpoints written before it restore unchanged.
 FORMAT_VERSION = 1
 
 
@@ -132,13 +138,15 @@ def save(path: str, namespace: dict, names: list[str], *, rank: int = 0,
                 arrays[key] = _as_bytes(host)
                 leaf_meta.append({"kind": "jax", "dtype": str(host.dtype),
                                   "shape": list(host.shape),
-                                  "sharding": str(leaf.sharding)})
+                                  "sharding": str(leaf.sharding),
+                                  "crc32": zlib.crc32(arrays[key])})
                 nbytes += host.nbytes
             elif isinstance(leaf, np.ndarray) and \
                     _byte_serializable(leaf.dtype):
                 arrays[key] = _as_bytes(leaf)
                 leaf_meta.append({"kind": "np", "dtype": str(leaf.dtype),
-                                  "shape": list(leaf.shape)})
+                                  "shape": list(leaf.shape),
+                                  "crc32": zlib.crc32(arrays[key])})
                 nbytes += leaf.nbytes
             else:
                 # Non-array leaves, plus object/structured-dtype ndarrays
@@ -289,8 +297,15 @@ def restore(path: str, namespace: dict, names: list[str] | None = None, *,
     import jax
     import numpy as np
 
-    with open(os.path.join(d, "aux.pkl"), "rb") as f:
-        aux = pickle.load(f)
+    apath = os.path.join(d, "aux.pkl")
+    try:
+        with open(apath, "rb") as f:
+            aux = pickle.load(f)
+    except Exception as e:
+        raise ValueError(
+            f"torn checkpoint: {apath} is missing or unreadable "
+            f"({type(e).__name__}: {e}) — the manifest names entries "
+            f"this file should hold; refusing to restore") from e
 
     entries = manifest["entries"]
     if names is None:
@@ -300,8 +315,15 @@ def restore(path: str, namespace: dict, names: list[str] | None = None, *,
         raise KeyError(f"names not in checkpoint: {missing} "
                        f"(has {sorted(entries)})")
 
+    zpath = os.path.join(d, "arrays.npz")
+    try:
+        npz_cm = np.load(zpath)
+    except Exception as e:
+        raise ValueError(
+            f"torn checkpoint: {zpath} is missing or unreadable "
+            f"({type(e).__name__}: {e}); refusing to restore") from e
     summary: dict[str, dict] = {}
-    with np.load(os.path.join(d, "arrays.npz")) as npz:
+    with npz_cm as npz:
         for name in names:
             leaf_meta = entries[name]["leaves"]
             leaves = []
@@ -311,7 +333,33 @@ def restore(path: str, namespace: dict, names: list[str] | None = None, *,
                 if meta["kind"] == "obj":
                     leaves.append(aux["objects"][key])
                 else:
-                    arr = _decode_array(npz[key], meta,
+                    try:
+                        raw = npz[key]
+                    except KeyError:
+                        raise ValueError(
+                            f"torn checkpoint: {zpath} has no entry "
+                            f"{key!r} though the manifest declares it; "
+                            f"refusing to restore") from None
+                    except Exception as e:
+                        # e.g. zipfile.BadZipFile: the archive's own
+                        # CRC tripped before ours could.
+                        raise ValueError(
+                            f"checkpoint integrity failure: entry "
+                            f"{key!r} in {zpath} is unreadable "
+                            f"({type(e).__name__}: {e}); refusing "
+                            f"to restore") from e
+                    want = meta.get("crc32")
+                    if want is not None:
+                        got = zlib.crc32(np.ascontiguousarray(raw))
+                        if got != want:
+                            raise ValueError(
+                                f"checkpoint integrity failure: "
+                                f"{name!r} leaf {i} ({key} in {zpath}) "
+                                f"has crc32 {got:#010x}, manifest says "
+                                f"{want:#010x} — bytes changed on disk "
+                                f"(bit rot or torn write); refusing "
+                                f"to restore")
+                    arr = _decode_array(raw, meta,
                                         to_device=meta["kind"] == "jax")
                     leaves.append(arr)
                     nbytes += arr.nbytes
@@ -321,8 +369,79 @@ def restore(path: str, namespace: dict, names: list[str] | None = None, *,
     return summary
 
 
-def info(path: str) -> dict:
-    """Describe a checkpoint directory: which ranks, which names."""
+def verify_rank(path: str, rank: int) -> list[str]:
+    """Integrity-check one rank dir against its manifest without
+    restoring anything: every declared array entry must exist in
+    ``arrays.npz`` and match its manifest crc32; ``aux.pkl`` must
+    load.  Returns a list of human-readable problems (empty = clean).
+    Pre-crc32 checkpoints report their unverifiable leaves as such
+    rather than passing silently."""
+    import numpy as np
+
+    d = _rank_dir(path, rank)
+    mpath = os.path.join(d, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except Exception as e:
+        return [f"{mpath}: unreadable manifest "
+                f"({type(e).__name__}: {e})"]
+    problems: list[str] = []
+    apath = os.path.join(d, "aux.pkl")
+    try:
+        with open(apath, "rb") as f:
+            pickle.load(f)
+    except Exception as e:
+        problems.append(f"{apath}: missing or unreadable "
+                        f"({type(e).__name__}: {e})")
+    zpath = os.path.join(d, "arrays.npz")
+    unverifiable = 0
+    try:
+        npz_cm = np.load(zpath)
+    except Exception as e:
+        problems.append(f"{zpath}: missing or unreadable "
+                        f"({type(e).__name__}: {e})")
+        return problems
+    with npz_cm as npz:
+        have = set(npz.files)
+        for name, entry in manifest.get("entries", {}).items():
+            for i, meta in enumerate(entry["leaves"]):
+                if meta["kind"] == "obj":
+                    continue
+                key = f"{name}.{i}"
+                if key not in have:
+                    problems.append(f"{zpath}: entry {key!r} declared "
+                                    f"by the manifest is absent")
+                    continue
+                want = meta.get("crc32")
+                if want is None:
+                    unverifiable += 1
+                    continue
+                try:
+                    raw = npz[key]
+                except Exception as e:
+                    problems.append(f"{zpath}: entry {key!r} "
+                                    f"unreadable ({type(e).__name__}: "
+                                    f"{e})")
+                    continue
+                got = zlib.crc32(np.ascontiguousarray(raw))
+                if got != want:
+                    problems.append(
+                        f"{name!r} leaf {i} ({key}): crc32 {got:#010x} "
+                        f"!= manifest {want:#010x} (bit rot or torn "
+                        f"write)")
+    if unverifiable:
+        problems.append(f"{unverifiable} array leaf(s) predate the "
+                        f"integrity manifest (no crc32 recorded) — "
+                        f"unverifiable, not necessarily bad")
+    return problems
+
+
+def info(path: str, *, verify: bool = False) -> dict:
+    """Describe a checkpoint directory: which ranks, which names.
+    ``verify=True`` additionally crc-checks every rank's arrays
+    against its manifest (reads every byte — priced accordingly) and
+    reports per-rank ``integrity``: ``"ok"`` or the problem list."""
     root = os.path.expanduser(path)
     out: dict = {"path": root, "ranks": {}}
     if not os.path.isdir(root):
@@ -337,8 +456,13 @@ def info(path: str) -> dict:
             continue
         with open(mpath) as f:
             manifest = json.load(f)
-        out["ranks"][int(entry.split("_", 1)[1])] = {
+        rank = int(entry.split("_", 1)[1])
+        desc = {
             "world_size": manifest.get("world_size"),
             "names": sorted(manifest.get("entries", {})),
         }
+        if verify:
+            problems = verify_rank(root, rank)
+            desc["integrity"] = problems if problems else "ok"
+        out["ranks"][rank] = desc
     return out

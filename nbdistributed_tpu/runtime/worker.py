@@ -35,6 +35,7 @@ from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
 from ..observability import telemetry as obs_telemetry
+from ..resilience import faults as faults_mod
 from ..resilience.dedup import _READ_ONLY, ReplayCache, ResultMailbox
 from ..resilience.faults import FaultPlan
 from ..utils import knobs
@@ -239,6 +240,9 @@ class DistributedWorker:
         # Spawn-time fault plans (NBD_FAULT_PLAN) bypass
         # _set_fault_plan — wire their collective-freeze fault here.
         self._install_freeze_hook(fault_plan)
+        # Spawn-time plans (NBD_FAULT_PLAN / NBD_CORRUPT_SPEC) must be
+        # visible to the training-integrity guard too (ISSUE 19).
+        faults_mod.set_process_plan(fault_plan)
         # SIGINT discipline (see runtime/interrupt.py for the design
         # and the root-cause story).  main() installs the gate before
         # construction so interrupts during the slow init phase defer;
@@ -452,6 +456,15 @@ class DistributedWorker:
                 # the same piggyback plane as tel/col.
                 data = dict(data or {})
                 data["rep"] = rep
+            tg = self._tg_snapshot()
+            if tg is not None:
+                # Training-integrity guard (ISSUE 19): skips, last
+                # audit step/verdict, rollback/repair counts, and any
+                # quarantine suspects — the %dist_top guard column and
+                # the Supervisor's quarantine scan feed off pings
+                # alone, no status probe.
+                data = dict(data or {})
+                data["tg"] = tg
             try:
                 self.channel.send(Message(msg_type="ping",
                                           rank=self.rank, data=data))
@@ -473,6 +486,16 @@ class DistributedWorker:
                 self._flight.flush()
                 if self._orphan_ttl <= 0:
                     return  # legacy: no grace period configured
+
+    def _tg_snapshot(self):
+        """Training-guard ping payload, or None when no guard is live.
+        Lazy import + atomic-snapshot read: safe from the heartbeat
+        thread, and a guard-free worker pays one dict lookup."""
+        try:
+            from ..resilience import trainguard
+            return trainguard.snapshot()
+        except Exception:
+            return None
 
     def _telemetry_extra(self) -> dict:
         """Resilience counters riding each telemetry snapshot, so the
@@ -734,12 +757,51 @@ class DistributedWorker:
     def _set_fault_plan(self, plan: FaultPlan | None) -> None:
         self._fault_plan = plan
         self.channel.fault_plan = plan
+        # The training-integrity guard reads the plan through the
+        # module-level slot (corrupt specs fire inside user-code train
+        # loops, which never see the Worker instance).
+        faults_mod.set_process_plan(plan)
         # kill_at counts messages SINCE THE PLAN WAS INSTALLED (the
         # should_kill contract): a runtime-armed kill_at=5 must mean
         # "the 5th message from now", not an absolute since-spawn index
         # the session has long passed.
         self._msg_seen = 0
         self._install_freeze_hook(plan)
+
+    def _handle_guard(self, msg: Message) -> Message:
+        """``%dist_guard``: report / toggle / audit the training-
+        integrity guard (resilience/trainguard.py).  ``audit`` runs a
+        replica-consistency audit on the live guard NOW — only safe
+        when every rank receives it (send_to_all), since the audit's
+        all-gather must be entered by the whole world."""
+        from ..resilience import trainguard
+        data = msg.data or {}
+        action = data.get("action", "status")
+        if action in ("on", "off"):
+            trainguard.set_enabled(action == "on")
+            self._flight.record("guard_toggle", enabled=action == "on")
+            return msg.reply(data={"status": action,
+                                   **trainguard.status()},
+                             rank=self.rank)
+        if action == "audit":
+            g = trainguard._ACTIVE
+            if g is None:
+                return msg.reply(data={"error": "no live TrainGuard "
+                                       "in this process"},
+                                 rank=self.rank)
+            try:
+                v = g.audit()
+            except Exception as e:
+                return msg.reply(data={"error": f"audit failed: "
+                                       f"{type(e).__name__}: {e}"},
+                                 rank=self.rank)
+            return msg.reply(data={"status": "audited",
+                                   "ok": v.ok,
+                                   "majority_rank": v.majority_rank,
+                                   "minority": list(v.minority),
+                                   **trainguard.status()},
+                             rank=self.rank)
+        return msg.reply(data=trainguard.status(), rank=self.rank)
 
     def _install_freeze_hook(self, plan: FaultPlan | None) -> None:
         """Wire the plan's collective-freeze fault into the guard: a
@@ -1436,6 +1498,7 @@ class DistributedWorker:
             "profile": self._handle_profile,
             "checkpoint": self._handle_checkpoint,
             "chaos": self._handle_chaos,
+            "guard": self._handle_guard,
             "trace": self._handle_trace,
             "metrics": self._handle_metrics,
             "hello": self._handle_hello,

@@ -295,6 +295,49 @@ _ALL = (
        "/healthz, /latency.json) served by the coordinator or "
        "gateway daemon; 0 = off. Also %dist_pool start "
        "--metrics-port (token-gated on pools).", "observability"),
+    # --- training integrity guard (ISSUE 19) ------------------------------
+    _k("NBD_GUARD", "1", "bool",
+       "Master switch for the training-integrity guard's host-side "
+       "machinery (verdict resolution, audits, snapshots, rollback, "
+       "chaos injection).  The device-side non-finite skip is "
+       "compiled into guard=True steps and is unaffected.", "guard"),
+    _k("NBD_GUARD_SKIP_BUDGET", "3", "int",
+       "Consecutive non-finite-gradient skips tolerated before the "
+       "guard rolls back to the last good snapshot (0 = never roll "
+       "back on skips).", "guard"),
+    _k("NBD_GUARD_AUDIT_EVERY", "50", "int",
+       "Steps between replica-consistency audits (param fingerprint "
+       "all-gather + majority vote + repair); 0 disables audits.",
+       "guard"),
+    _k("NBD_GUARD_SNAPSHOT_EVERY", "50", "int",
+       "Steps between in-memory rollback snapshots of params + "
+       "optimizer state; 0 disables the snapshot ring.", "guard"),
+    _k("NBD_GUARD_SNAPSHOT_KEEP", "2", "int",
+       "In-memory snapshots retained in the rollback ring.", "guard"),
+    _k("NBD_GUARD_CKPT_EVERY", "0", "int",
+       "Steps between durable async checkpoints of the guarded state "
+       "(coarser than the snapshot ring; also the no-majority audit "
+       "fallback's restore source); 0 = no durable cadence.", "guard"),
+    _k("NBD_GUARD_CKPT_PATH", None, "str",
+       "Directory for the guard's durable checkpoints (required for "
+       "NBD_GUARD_CKPT_EVERY and the no-majority restore fallback).",
+       "guard"),
+    _k("NBD_GUARD_SPIKE_WINDOW", "64", "int",
+       "Rolling loss-history window for the median/MAD spike "
+       "detector.", "guard"),
+    _k("NBD_GUARD_SPIKE_NMAD", "8.0", "float",
+       "MADs above the rolling median a finite loss must land to "
+       "count as a spike suspect.", "guard"),
+    _k("NBD_GUARD_SPIKE_CONFIRM", "2", "int",
+       "Consecutive spike-suspect losses before the spike is "
+       "confirmed and triggers a rollback.", "guard"),
+    _k("NBD_GUARD_QUARANTINE_AFTER", "2", "int",
+       "Audits a rank must land in the minority before it is "
+       "escalated as a quarantine suspect (0 = never).", "guard"),
+    _k("NBD_CORRUPT_SPEC", None, "json",
+       "JSON list of bit-flip/scale corruption specs (rank, step, "
+       "name, mode, bits, scale, count) merged into the spawn-time "
+       "fault plan — %dist_chaos --corrupt's env twin.", "chaos"),
     # --- static analysis -------------------------------------------------
     _k("NBD_LINT", "warn", "str",
        "Default pre-dispatch cell-vetting mode: warn (annotate), "

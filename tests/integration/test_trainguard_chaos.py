@@ -1,0 +1,160 @@
+"""Acceptance test for the training integrity guard (ISSUE 19).
+
+Three real worker subprocesses on the CPU backend train the same tiny
+model independently with identical seeds — the replicated-params
+invariant the replica-consistency audit exists to police.  A
+``CorruptSpec`` on the runtime fault plan flips one bit of rank 1's
+params mid-training (the deterministic stand-in for an SDC).  The
+guard's audit at its step cadence must:
+
+1. detect the divergence and NAME rank 1 as the minority,
+2. repair it by re-broadcasting params + optimizer state from the
+   majority root, and
+3. leave every rank's final params **bit-identical** to a fault-free
+   reference run of the same loop — the corruption leaves no trace.
+"""
+
+import ast
+import json
+import time
+
+import pytest
+
+from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+from nbdistributed_tpu.messaging import CommunicationManager
+
+pytestmark = [pytest.mark.integration, pytest.mark.faults,
+              pytest.mark.guard, pytest.mark.slow]
+
+WORLD = 3
+ATTACH_TIMEOUT = 180
+
+# Executed once per worker: independent local-mesh training (each rank
+# trains on its OWN device with the SAME seed, so params stay bitwise
+# replicated across ranks), wrapped in a TrainGuard with a tight audit
+# cadence.  ``_train`` leaves the finished guard in the namespace.
+SETUP = """
+import optax
+from nbdistributed_tpu.parallel import data_parallel
+from nbdistributed_tpu.parallel import mesh as mesh_mod
+from nbdistributed_tpu.resilience import trainguard
+
+def _build():
+    m = mesh_mod.make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+    k = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(k, (8, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    opt = optax.adam(1e-2)
+    p, _ = data_parallel.ddp_init(params, None, m)
+    s = jax.jit(opt.init)(p)
+    step = data_parallel.make_ddp_step(loss_fn, opt, m, guard=True)
+    return step, p, s
+
+def _train(steps):
+    step, p, s = _build()
+    g = trainguard.TrainGuard(step, p, s, audit_every=4,
+                              snapshot_every=4, skip_budget=10,
+                              checkpoint_every=0)
+    kb = jax.random.PRNGKey(1)
+    for _ in range(steps):
+        kb, kx = jax.random.split(kb)
+        x = jax.random.normal(kx, (16, 8), jnp.float32)
+        y = jnp.zeros((16, 4), jnp.float32)
+        g.step((x, y))
+    g.finish()
+    return g
+"""
+
+# Runs the loop and reports everything the assertions need as JSON.
+REPORT = """
+g = _train(12)
+d = g.describe()
+_mm = [dict(e) for e in g._events if e["kind"] == "mismatch"]
+_res = {"fp": list(trainguard.tree_fingerprint(g.params)),
+        "mismatches": d["mismatches"], "repairs": d["repairs"],
+        "audits": d["audits"], "last_verdict": d["last_verdict"],
+        "minority": _mm[0]["minority"] if _mm else None,
+        "majority_rank": _mm[0]["majority_rank"] if _mm else None,
+        "kinds": sorted({e["kind"] for e in g._events})}
+import json as _json
+_json.dumps(_res)
+"""
+
+
+def _results(responses):
+    out = {}
+    for r, m in responses.items():
+        raw = m.data.get("output")
+        assert raw, f"rank {r} produced no output: {m.data}"
+        out[r] = json.loads(ast.literal_eval(raw))
+    return out
+
+
+def test_audit_detects_names_and_repairs_bit_flip():
+    comm = CommunicationManager(num_workers=WORLD, timeout=60)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda rank, rc: comm.mark_worker_dead(rank))
+    try:
+        pm.start_workers(WORLD, comm.port, backend="cpu")
+        wait_until_ready(comm, pm, ATTACH_TIMEOUT)
+
+        comm.send_to_all("execute", SETUP, timeout=120)
+
+        # --- fault-free reference ------------------------------------
+        ref = _results(comm.send_to_all("execute", REPORT, timeout=300))
+        ref_fp = ref[0]["fp"]
+        assert all(r["fp"] == ref_fp for r in ref.values()), \
+            f"identical-seed training diverged without faults: {ref}"
+        assert all(r["mismatches"] == 0 and r["repairs"] == 0
+                   for r in ref.values()), ref
+
+        # --- arm the SDC: one bit of rank 1's params at step 2 -------
+        resp = comm.send_to_all(
+            "chaos", {"action": "set",
+                      "spec": {"seed": 7,
+                               "corrupt": [{"rank": 1, "step": 2,
+                                            "name": "w"}]}},
+            timeout=60)
+        assert all(m.data.get("status") == "armed"
+                   for m in resp.values()), \
+            {r: m.data for r, m in resp.items()}
+
+        # --- chaos run -----------------------------------------------
+        got = _results(comm.send_to_all("execute", REPORT, timeout=300))
+
+        # every rank saw the SAME audit story: one mismatch naming
+        # rank 1, repaired from majority root 0, later audits clean
+        for r, res in got.items():
+            assert res["mismatches"] == 1, (r, res)
+            assert res["repairs"] == 1, (r, res)
+            assert res["minority"] == [1], (r, res)
+            assert res["majority_rank"] == 0, (r, res)
+            assert res["last_verdict"] == "ok", (r, res)
+            assert {"audit", "mismatch", "repair"} <= set(res["kinds"])
+
+        # the injection actually fired, and only on rank 1
+        assert "corrupt" in got[1]["kinds"]
+        assert "corrupt" not in got[0]["kinds"]
+        assert "corrupt" not in got[2]["kinds"]
+
+        # repaired finals are bit-identical to the fault-free run
+        for r, res in got.items():
+            assert res["fp"] == ref_fp, \
+                f"rank {r} final params differ from fault-free " \
+                f"reference: {res['fp']} != {ref_fp}"
+
+        # the guard heartbeat piggyback surfaced the repair
+        st = comm.send_to_all("guard", {"action": "status"}, timeout=60)
+        assert all(m.data.get("repairs") == 1 for m in st.values()), \
+            {r: m.data.get("repairs") for r, m in st.items()}
+    finally:
+        try:
+            comm.post(list(range(WORLD)), "shutdown")
+            time.sleep(0.3)
+        except Exception:
+            pass
+        pm.shutdown()
+        comm.shutdown()
