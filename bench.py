@@ -1714,6 +1714,79 @@ def measure_trainguard() -> dict | None:
             "audit_step_ms": round(audit_ms, 3)}
 
 
+def measure_transfer() -> dict | None:
+    """The ISSUE 20 numbers: bulk-plane push/pull throughput — the
+    chunked streaming protocol vs one legacy frame — plus the
+    per-chunk compression ratio on compressible data.  CPU loopback,
+    1-worker world of its own: the mechanism under test is the
+    chunked wire protocol (flow control, crc, assembly copies), not
+    the accelerator or a real NIC."""
+    import numpy as np
+
+    from nbdistributed_tpu.messaging import xfer
+
+    size = 64 << 20
+    out: dict = {"backend": "cpu", "bytes": size}
+    rng = np.random.default_rng(0)
+    incompressible = rng.integers(0, 256, size, dtype=np.uint8)
+    comm = pm = None
+    try:
+        comm, pm = _spawn_world("cpu", 1)
+
+        t0 = time.time()
+        st = xfer.push_value(comm, [0], "xb", incompressible)
+        out["push_chunked_gb_s"] = round(size / (time.time() - t0)
+                                         / 1e9, 3)
+        out["chunks"] = st["chunks"]
+        out["inflight_peak_mb"] = round(
+            st["inflight_peak_bytes"] / 1e6, 1)
+
+        t0 = time.time()
+        comm.send_to_ranks([0], "set_var", {"name": "xl"},
+                           bufs={"value": incompressible},
+                           timeout=xfer.scaled_timeout(size))
+        out["push_legacy_gb_s"] = round(size / (time.time() - t0)
+                                        / 1e9, 3)
+
+        t0 = time.time()
+        _, stats = xfer.pull_value(comm, 0, "xb")
+        out["pull_chunked_gb_s"] = round(size / (time.time() - t0)
+                                         / 1e9, 3)
+        out["pull_resent_chunks"] = stats["resent_chunks"]
+
+        t0 = time.time()
+        resp = comm.send_to_rank(0, "get_var", "xl",
+                                 timeout=xfer.scaled_timeout(size))
+        np.asarray(resp.bufs["value"])  # materialize the decode view
+        out["pull_legacy_gb_s"] = round(size / (time.time() - t0)
+                                        / 1e9, 3)
+
+        # Compression ratio on low-entropy data (repeated-pattern
+        # bytes — the shape of embedding tables / quantized state),
+        # forced through the always-available stdlib codec.
+        compressible = np.tile(np.arange(256, dtype=np.uint8),
+                               size // 256)
+        saved = os.environ.get("NBD_XFER_CODEC")
+        os.environ["NBD_XFER_CODEC"] = "zlib"
+        try:
+            st = xfer.push_value(comm, [0], "xc", compressible)
+        finally:
+            if saved is None:
+                os.environ.pop("NBD_XFER_CODEC", None)
+            else:
+                os.environ["NBD_XFER_CODEC"] = saved
+        out["compress_codec"] = st["codec"]
+        out["compress_ratio"] = round(
+            st["bytes"] / max(1, st["wire_bytes"]), 2)
+        out["push_zlib_gb_s"] = round(
+            size / max(1e-9, st["seconds"]) / 1e9, 3)
+        out["codecs_available"] = xfer.available_codecs()
+        return out
+    finally:
+        if comm is not None:
+            _teardown(comm, pm, 1)
+
+
 def main() -> int:
     # A SIGTERM (e.g. an outer `timeout` expiring) must tear down the
     # spawned workers: raising SystemExit lets run()'s finally-block
@@ -1935,6 +2008,17 @@ def run(backend: str, world: int, attempt: int = 1) -> int:
                 log(f"[bench] trainguard: {gd}")
         except Exception as e:
             log(f"[bench] trainguard measurement skipped: {e}")
+
+        # Bulk data plane (ISSUE 20): chunked vs legacy push/pull
+        # throughput + compression ratio, in a 1-worker world of its
+        # own.
+        try:
+            tx = measure_transfer()
+            if tx:
+                extra["transfer"] = tx
+                log(f"[bench] transfer: {tx}")
+        except Exception as e:
+            log(f"[bench] transfer measurement skipped: {e}")
 
         result = {
             "metric": f"ddp_linear1024_steps_per_s_cellwise_{backend}"

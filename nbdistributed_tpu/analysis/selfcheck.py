@@ -531,9 +531,11 @@ _PROTOCOL_EXTERNAL = {
 }
 
 # Sender-method msg_type positional index (after any leading
-# ranks/rank argument).
+# ranks/rank argument).  ``submit`` is the non-blocking dispatch the
+# bulk-transfer plane rides (xfer_chunk / xfer_read go out through it
+# exclusively) — same (ranks, msg_type, ...) shape as send_to_ranks.
 _SEND_METHODS = {"send_to_ranks": 1, "send_to_rank": 1, "post": 1,
-                 "send_to_all": 0, "request": 0}
+                 "send_to_all": 0, "request": 0, "submit": 1}
 
 
 def _rel_paths(root: str, rels) -> list[str]:
@@ -703,9 +705,12 @@ def _protocol_planes(root: str) -> list[dict]:
     agent_rx = "nbdistributed_tpu/manager/hostagent.py"
     return [
         {"name": "worker",
+         # ``submit`` is the non-blocking dispatch path: the bulk-
+         # transfer plane's xfer_chunk/xfer_read frames go out through
+         # it exclusively (messaging/xfer.py), never via send_to_*.
          "sent": _sent_request_types(
              root, methods={"send_to_ranks": 1, "send_to_rank": 1,
-                            "send_to_all": 0, "post": 1}),
+                            "send_to_all": 0, "post": 1, "submit": 1}),
          "handled": _handled_types(root, worker_rx)},
         {"name": "worker-notice",
          "sent": _constructed_types(root, worker_rx),

@@ -796,9 +796,12 @@ class GatewayDaemon:
                              daemon=True).start()
         elif mt == "mailbox":
             # Off the listener thread: a drain reply carries up to the
-            # whole mailbox (32 MB bound) and a slow client's full
-            # socket buffer would block sendall — wedging every other
-            # tenant's hellos/executes/detaches behind it.  Counted
+            # whole mailbox (32 MB in-memory bound; oversized parked
+            # results live in the tenant's run-dir spill partition and
+            # are materialized per claim — ISSUE 20) and a slow
+            # client's full socket buffer would block sendall —
+            # wedging every other tenant's hellos/executes/detaches
+            # behind it.  Counted
             # here (listener thread) like execute so a detach can't
             # evict the tenant while its claimed results are mid-send.
             with self._lock:

@@ -22,11 +22,23 @@ costs anything.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from ..resilience.dedup import ResultMailbox
 from ..resilience.session import mint_token, token_fingerprint
+
+
+def _tenant_spill_dir(name: str) -> str | None:
+    """Run-dir spill partition for one tenant's mailbox (best-effort:
+    a gateway without a run dir just keeps the in-memory bound)."""
+    try:
+        from ..observability import flightrec
+        safe = "".join(c for c in name if c.isalnum() or c in "-_")
+        return os.path.join(flightrec.run_dir(), f"spill-tenant-{safe}")
+    except Exception:
+        return None
 
 
 class TenantRejected(RuntimeError):
@@ -46,7 +58,12 @@ class Tenant:
         self.token = token
         self.epoch = 1
         self.client_id: int | None = None   # live tenant-plane conn
-        self.mailbox = ResultMailbox()      # this tenant's partition
+        # This tenant's parked-reply partition.  Shares the bulk-plane
+        # spill path (ISSUE 20): a slow/detached client's oversized
+        # results land on disk under the run dir with explicit
+        # too_large/disk_full verdicts instead of evicting the
+        # tenant's whole 32 MB mailbox.
+        self.mailbox = ResultMailbox(spill_dir=_tenant_spill_dir(name))
         self.priority = int(priority)
         # Ambient names (np/time/builtins…) a dispatched cell of THIS
         # tenant rebound: the effect analyzer must not prove a later

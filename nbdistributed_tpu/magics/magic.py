@@ -3430,64 +3430,101 @@ class DistributedMagics(Magics):
               help="pull from every rank into a {rank: value} dict")
     @argument("--as", dest="as_name", default=None,
               help="kernel name to bind (default: same name)")
+    @argument("--readonly", action="store_true",
+              help="bind read-only views of the decode buffers "
+                   "(zero assembly copies — cheapest way to inspect "
+                   "a large value)")
     @line_magic
     def dist_pull(self, line):
-        """Copy a variable from worker(s) into the kernel namespace."""
+        """Copy a variable from worker(s) into the kernel namespace.
+        Values at or above ``NBD_XFER_THRESHOLD_BYTES`` stream over
+        the chunked bulk plane (messaging/xfer.py) straight into
+        preallocated destination arrays; smaller ones ride one
+        round-trip."""
         if not self._require_cluster():
             return
         args = parse_argstring(self.dist_pull, line)
         target = args.as_name or args.name
-        if args.all_ranks:
+        ranks = (list(range(self._world)) if args.all_ranks
+                 else [args.rank])
+        pulled: dict = {}
+        how = None
+        for r in ranks:
             try:
-                resps = self._comm.send_to_all("get_var", args.name,
-                                               timeout=60)
+                pulled[r], h = self._pull_one(r, args.name,
+                                              readonly=args.readonly)
             except Exception as e:
-                print(f"❌ pull failed: {e}")
+                print(f"❌ rank {r}: {e}")
                 return
-            errors = {r: m.data["error"] for r, m in resps.items()
-                      if m.data.get("error")}
-            if errors:
-                for r, e in sorted(errors.items()):
-                    print(f"❌ rank {r}: {e}")
-                return
-            self.shell.user_ns[target] = {
-                r: self._pulled_value(m) for r, m in resps.items()}
+            how = how or h
+        suffix = f" [{how}]" if how else ""
+        if args.all_ranks:
+            self.shell.user_ns[target] = pulled
             print(f"✅ {target} = {{rank: value}} from "
-                  f"{sorted(resps)} ranks")
-            return
-        try:
-            resp = self._comm.send_to_rank(args.rank, "get_var", args.name,
-                                           timeout=60)
-        except Exception as e:
-            print(f"❌ pull failed: {e}")
-            return
-        if resp.data.get("error"):
-            print(f"❌ {resp.data['error']}")
-            return
-        self.shell.user_ns[target] = self._pulled_value(resp)
-        if resp.data.get("array"):
-            print(f"✅ {target} = array{tuple(resp.data['shape'])} "
-                  f"{resp.data['dtype']} (from rank {args.rank})")
-        elif resp.data.get("pytree") is not None:
-            print(f"✅ {target} = pytree "
-                  f"({resp.data['n_leaves']} array leaves, from rank "
-                  f"{args.rank})")
+                  f"{sorted(pulled)} ranks{suffix}")
         else:
-            print(f"✅ {target} = {self.shell.user_ns[target]!r} "
-                  f"(from rank {args.rank})")
+            value = pulled[args.rank]
+            self.shell.user_ns[target] = value
+            print(f"✅ {target} = {self._describe_pulled(value)} "
+                  f"(from rank {args.rank}){suffix}")
+
+    def _pull_one(self, rank: int, name: str, *,
+                  readonly: bool = False):
+        """One rank's value: chunked plane first, legacy ``get_var``
+        when the value cannot ride the buffer path.  Returns
+        ``(value, how)`` where ``how`` describes a chunked move (None
+        for the one-round-trip paths)."""
+        from ..messaging import xfer
+        try:
+            value, stats = xfer.pull_value(self._comm, rank, name,
+                                           readonly=readonly)
+            how = None
+            if stats.get("chunks"):
+                how = (f"chunked: {stats['bytes'] / 1e6:.1f} MB in "
+                       f"{stats['chunks']} chunks, "
+                       f"{stats['seconds']:.1f}s")
+            return value, how
+        except xfer.XferFallback:
+            pass
+        resp = self._comm.send_to_rank(
+            rank, "get_var", name, timeout=xfer.scaled_timeout(0))
+        if resp.data.get("error"):
+            raise RuntimeError(resp.data["error"])
+        return self._pulled_value(resp, readonly=readonly), None
 
     @staticmethod
-    def _pulled_value(msg):
+    def _describe_pulled(value) -> str:
+        import numpy as np
+        if isinstance(value, np.ndarray):
+            return f"array{tuple(value.shape)} {value.dtype}"
+        if isinstance(value, (dict, list, tuple)):
+            return f"pytree ({type(value).__name__})"
+        return repr(value)
+
+    @staticmethod
+    def _pulled_value(msg, readonly: bool = False):
         """Reconstruct one rank's get_var reply: raw array, pytree on
-        the buffer path (treedef JSON + leaf bufs — no pickle; leaves
-        copied out of the read-only decode views), or plain JSON
-        value."""
+        the buffer path (treedef JSON + leaf bufs — no pickle), or
+        plain JSON value.  Writable results are assembled with exactly
+        ONE copy — ``np.empty`` destination + ``copyto`` from the
+        decode view (never view + extra copy); ``readonly`` skips even
+        that and hands back the decode views themselves."""
+        import numpy as np
+
+        def into_writable(view):
+            out = np.empty(view.shape, dtype=view.dtype)
+            np.copyto(out, view)
+            return out
+
         if msg.data.get("array"):
-            import numpy as np
-            return np.array(msg.bufs["value"])   # decode views are RO
+            view = msg.bufs["value"]
+            return view if readonly else into_writable(view)
         if msg.data.get("pytree") is not None:
             from ..messaging.codec import unflatten_pytree_wire
-            return unflatten_pytree_wire(msg.data["pytree"], msg.bufs)
+            leaf = ((lambda a, j: a) if readonly
+                    else (lambda a, j: into_writable(a)))
+            return unflatten_pytree_wire(msg.data["pytree"], msg.bufs,
+                                         leaf)
         return msg.data.get("value")
 
     @magic_arguments()
@@ -3496,7 +3533,11 @@ class DistributedMagics(Magics):
               help="target spec like [0,2]; default all")
     @line_magic
     def dist_push(self, line):
-        """Copy a kernel variable to workers' namespaces."""
+        """Copy a kernel variable to workers' namespaces.  Values at
+        or above ``NBD_XFER_THRESHOLD_BYTES`` stream over the chunked
+        bulk plane (crc-verified, resumable, window-bounded memory);
+        smaller ones ride one legacy frame with a payload-scaled
+        deadline."""
         if not self._require_cluster():
             return
         args = parse_argstring(self.dist_push, line)
@@ -3512,13 +3553,34 @@ class DistributedMagics(Magics):
                 print(f"❌ {e}")
                 return
         import numpy as np
+        from ..messaging import xfer
+        est = xfer.approx_nbytes(value)
+        if est >= xfer.threshold_bytes():
+            try:
+                stats = xfer.push_value(self._comm, ranks, args.name,
+                                        value)
+                extra = ""
+                if stats["resumed_chunks"] or stats["resent_chunks"]:
+                    extra = (f", resumed {stats['resumed_chunks']} / "
+                             f"resent {stats['resent_chunks']}")
+                print(f"✅ pushed {args.name} to ranks {ranks} "
+                      f"[chunked: {stats['bytes'] / 1e6:.1f} MB in "
+                      f"{stats['chunks']} chunks, "
+                      f"{stats['seconds']:.1f}s{extra}]")
+                return
+            except xfer.XferFallback:
+                pass        # not a buffer-path value: legacy frame
+            except xfer.XferError as e:
+                print(f"❌ push failed: {e}")
+                return
         try:
             if isinstance(value, np.ndarray) or type(value).__module__ \
                     .startswith("jax"):
                 arr = np.asarray(value)
-                self._comm.send_to_ranks(ranks, "set_var",
-                                         {"name": args.name},
-                                         bufs={"value": arr}, timeout=60)
+                self._comm.send_to_ranks(
+                    ranks, "set_var", {"name": args.name},
+                    bufs={"value": arr},
+                    timeout=xfer.scaled_timeout(arr.nbytes))
             else:
                 # Pytrees of arrays (params/optimizer state) take the
                 # buffer path: treedef as JSON, leaves as raw bufs —
@@ -3532,8 +3594,9 @@ class DistributedMagics(Magics):
                         payload = {"name": args.name, "pytree": meta}
                     except TypeError:
                         bufs = None
-                self._comm.send_to_ranks(ranks, "set_var", payload,
-                                         bufs=bufs, timeout=60)
+                self._comm.send_to_ranks(
+                    ranks, "set_var", payload, bufs=bufs,
+                    timeout=xfer.scaled_timeout(est))
         except Exception as e:
             print(f"❌ push failed: {e}")
             return
@@ -3585,6 +3648,10 @@ class DistributedMagics(Magics):
     @argument("--status", action="store_true",
               help="poll the in-flight background save instead of "
                    "saving")
+    @argument("--fetch", default=None, metavar="LOCAL_DIR",
+              help="after a sync save, pull every rank's shard to "
+                   "this coordinator-local directory over the chunked "
+                   "bulk plane (no shared filesystem needed)")
     @line_magic
     def dist_checkpoint(self, line):
         """Snapshot named variables from every worker's namespace:
@@ -3630,7 +3697,13 @@ class DistributedMagics(Magics):
             return
         if not args.path or not args.names:
             print("usage: %dist_checkpoint <path> <names...> "
-                  "[--background] | %dist_checkpoint --status")
+                  "[--background] [--fetch DIR] | "
+                  "%dist_checkpoint --status")
+            return
+        if args.fetch and args.background:
+            # A background save has nothing on disk to ship yet; the
+            # user can fetch once --status shows every rank done.
+            print("❌ --fetch needs a sync save (drop --background)")
             return
         try:
             resps = self._comm.send_to_all(
@@ -3662,11 +3735,26 @@ class DistributedMagics(Magics):
                 # pending promotion, or a later --status poll would
                 # overwrite the heal target with stale state.
                 DistributedMagics._clear_bg_ckpt()
+                if args.fetch:
+                    try:
+                        total = self._fetch_ckpt(args.path, args.fetch)
+                    except Exception as e:
+                        print(f"❌ fetch failed: {e}")
+                        return
+                    print(f"✅ fetched {self._world} rank shards → "
+                          f"{args.fetch} [{total / 1e6:.1f} MB over "
+                          f"the bulk plane]")
 
     @magic_arguments()
     @argument("path", help="checkpoint directory written by "
                            "%%dist_checkpoint")
     @argument("names", nargs="*", help="names to restore (default: all)")
+    @argument("--ship", default=None, metavar="LOCAL_DIR",
+              help="first push this coordinator-local checkpoint "
+                   "(rank_<r>/ subdirs, e.g. from --fetch) to every "
+                   "rank's <path> over the chunked bulk plane, then "
+                   "restore — moves a checkpoint into a world with no "
+                   "shared filesystem")
     @line_magic
     def dist_restore(self, line):
         """Load checkpointed variables back into every worker's
@@ -3674,6 +3762,15 @@ class DistributedMagics(Magics):
         if not self._require_cluster():
             return
         args = parse_argstring(self.dist_restore, line)
+        if args.ship:
+            try:
+                total = self._ship_ckpt(args.ship, args.path)
+            except Exception as e:
+                print(f"❌ ship failed: {e}")
+                return
+            print(f"📦 shipped {args.ship} → {self._world} ranks at "
+                  f"{args.path} [{total / 1e6:.1f} MB over the bulk "
+                  f"plane]")
         try:
             resps = self._comm.send_to_all(
                 "checkpoint", {"action": "restore", "path": args.path,
@@ -3694,6 +3791,55 @@ class DistributedMagics(Magics):
                           f"(saved from world of {m['world_size']})")
             else:
                 print(f"   no checkpoint data found under {args.path!r}")
+
+    # One rank's shard on disk (runtime/checkpoint.py layout): the
+    # array payload, its manifest, and optional pickled aux state.
+    _CKPT_FILES = ("manifest.json", "arrays.npz", "aux.pkl")
+
+    def _fetch_ckpt(self, remote_path: str, local_dir: str) -> int:
+        """Gather every rank's checkpoint shard to ``local_dir`` over
+        the chunked bulk plane.  Returns total bytes moved."""
+        import os
+        from ..messaging import xfer
+        total = 0
+        for r in range(self._world):
+            sub = f"rank_{r}"
+            for fname in self._CKPT_FILES:
+                src = os.path.join(remote_path, sub, fname)
+                dst = os.path.join(local_dir, sub, fname)
+                try:
+                    stats = xfer.pull_file(self._comm, r, src, dst)
+                except xfer.XferError as e:
+                    if fname == "aux.pkl":
+                        continue    # shard had no non-array state
+                    raise RuntimeError(f"rank {r} {fname}: {e}")
+                total += stats.get("bytes", 0)
+        return total
+
+    def _ship_ckpt(self, local_dir: str, remote_path: str) -> int:
+        """Push a coordinator-local checkpoint (``rank_<r>/`` subdirs)
+        to each rank's filesystem at ``remote_path``.  Returns total
+        bytes moved."""
+        import os
+        from ..messaging import xfer
+        total = 0
+        for r in range(self._world):
+            sub = f"rank_{r}"
+            src_dir = os.path.join(local_dir, sub)
+            if not os.path.isdir(src_dir):
+                raise RuntimeError(
+                    f"{src_dir} missing — need one rank_<r>/ shard "
+                    f"per worker (write them with %dist_checkpoint "
+                    f"--fetch)")
+            for fname in self._CKPT_FILES:
+                src = os.path.join(src_dir, fname)
+                if not os.path.exists(src):
+                    continue
+                stats = xfer.push_file(
+                    self._comm, [r], src,
+                    os.path.join(remote_path, sub, fname))
+                total += stats.get("bytes", 0)
+        return total
 
     def _report_checkpoint(self, resps: dict, verb: str) -> bool:
         """Print per-rank checkpoint results; True if all ranks ok."""

@@ -85,6 +85,13 @@ WIRE_EXTENSIONS: dict[str, dict] = {
                   "{dq,xs,xe,cs,rs} worker-clock stamps (dequeue, "
                   "handler entry/exit, compile seconds, reply build) "
                   "— absent unless NBD_LAT is on"},
+    "xf": {"plane": "header", "attr": "xfer",
+           "doc": "bulk-transfer chunk header (messaging/xfer.py): "
+                  "{x: transfer id, s: chunk seq, c: crc32 of the "
+                  "raw chunk, e: per-chunk encoding (stored/zlib/"
+                  "lz4/zstd), r: raw chunk length} — present only on "
+                  "xfer_chunk requests and xfer_read replies; "
+                  "non-transfer frames stay byte-identical"},
     # heartbeat-ping data plane (worker _heartbeat → coordinator)
     "busy_type": {"plane": "ping",
                   "doc": "in-flight request type while busy"},
@@ -197,6 +204,11 @@ class Message:
     # NBD_LAT=0) keeps the wire format byte-identical — the same
     # absent-when-off contract as ``trace``.
     latency: Any = None
+    # Bulk-transfer chunk header (ISSUE 20, messaging/xfer.py):
+    # {x: xid, s: seq, c: crc32, e: encoding, r: raw_len} on frames
+    # that carry one chunk of a streamed transfer.  None (the default)
+    # keeps every non-transfer frame byte-identical.
+    xfer: dict | None = None
 
     def reply(self, msg_type: str = "response", data: Any = None,
               rank: int = COORDINATOR_RANK,
@@ -249,6 +261,9 @@ def encode(msg: Message, *, allow_pickle: bool = True) -> bytes:
     if msg.latency is not None:
         # Only while the latency observatory is on.
         header["lt"] = msg.latency
+    if msg.xfer is not None:
+        # Only on bulk-transfer chunk frames.
+        header["xf"] = msg.xfer
 
     header["data"] = msg.data
     header["enc"] = "json"
@@ -335,6 +350,7 @@ def decode(frame: bytes | memoryview, *, allow_pickle: bool = True) -> Message:
         epoch=header.get("ep"),
         tenant=header.get("tn"),
         latency=header.get("lt"),
+        xfer=header.get("xf"),
     )
 
 

@@ -757,6 +757,7 @@ class CommunicationManager:
                on_verdict=None,
                collective: str = "unknown",
                vet_s: float | None = None,
+               xfer: dict | None = None,
                on_done=None) -> PendingHandle:
         """Non-blocking dispatch (ISSUE 14): admit through the
         scheduler, transmit the request, and return a
@@ -778,6 +779,11 @@ class CommunicationManager:
         msg = Message(msg_type=msg_type, data=data, bufs=bufs or {})
         if msg_id is not None:
             msg.msg_id = msg_id
+        if xfer is not None:
+            # Bulk-transfer chunk header (messaging/xfer.py): rides
+            # the frame header so a retry redelivers the SAME chunk
+            # identity (xid/seq/crc) under the same msg_id.
+            msg.xfer = xfer
         if self.session_epoch:
             msg.epoch = self.session_epoch
         if tenant is not None:

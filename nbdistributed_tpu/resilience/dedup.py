@@ -29,6 +29,7 @@ Bounded three ways:
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 
@@ -38,9 +39,15 @@ from collections import OrderedDict
 # ``metrics`` qualify: a dump/snapshot reply can run to megabytes (a
 # span dump is bounded only by MAX_SPANS) and re-running either is
 # harmless (start/stop replies are tiny, so they stay cached and
-# idempotent regardless).
+# idempotent regardless).  The bulk-transfer pull side (ISSUE 20)
+# qualifies too: ``xfer_read`` replies carry whole chunks (pinning
+# them would defeat the bounded-memory design), ``xfer_pull_begin``
+# may answer inline with the full value, and re-running any of the
+# three is safe (chunk reads are pure, a re-begun snapshot is simply
+# a fresh one, pull_end is a pop).
 _READ_ONLY = frozenset({"get_var", "get_namespace_info", "get_status",
-                        "trace", "metrics"})
+                        "trace", "metrics", "xfer_read",
+                        "xfer_pull_begin", "xfer_pull_end"})
 
 
 def _json_size(v) -> int:
@@ -64,6 +71,17 @@ def _reply_bytes(reply) -> int:
     return total + _json_size(getattr(reply, "data", None))
 
 
+class _Spilled:
+    """In-memory stub for a parked reply that lives on disk."""
+
+    __slots__ = ("path", "nbytes", "msg_type")
+
+    def __init__(self, path: str, nbytes: int, msg_type):
+        self.path = path
+        self.nbytes = nbytes
+        self.msg_type = msg_type
+
+
 class ResultMailbox:
     """Parked replies awaiting redelivery to a FUTURE coordinator.
 
@@ -79,34 +97,124 @@ class ResultMailbox:
     """
 
     def __init__(self, capacity: int = 32,
-                 max_total_bytes: int = 32 << 20):
+                 max_total_bytes: int = 32 << 20,
+                 spill_dir: str | None = None,
+                 spill_entry_bytes: int = 8 << 20,
+                 max_spill_bytes: int = 1 << 30):
         self.capacity = max(1, capacity)
         self.max_total_bytes = max_total_bytes
+        # Disk spill (ISSUE 20): with a ``spill_dir``, a reply bigger
+        # than ``spill_entry_bytes`` is codec-encoded to a chunk file
+        # under the run dir and only a tiny stub stays in memory — a
+        # multi-hundred-MB parked result no longer evicts the whole
+        # mailbox or blows the 32 MB bound.  Failures are explicit
+        # verdict replies (``too_large`` past ``max_spill_bytes``,
+        # ``disk_full`` on a write error), never a silent drop.
+        self.spill_dir = spill_dir
+        self.spill_entry_bytes = spill_entry_bytes
+        self.max_spill_bytes = max_spill_bytes
         self._box: OrderedDict[str, object] = OrderedDict()
         self._sizes: dict[str, int] = {}
         self._total = 0
         self.parked = 0      # park() calls accepted (monotonic)
         self.claimed = 0
         self.evicted = 0
+        self.spilled = 0     # replies written to disk
+        self.spill_verdicts = 0  # too_large / disk_full stubs parked
         # The worker's serial loop is single-threaded, but the GATEWAY
         # parks from serve threads while tenant hellos read ids() on
         # the listener thread — iteration during a concurrent park
         # raised RuntimeError exactly in the crash-recovery window.
         self._mlock = threading.Lock()
 
+    # -- spill plumbing ------------------------------------------------
+
+    def _spill_path(self, msg_id: str) -> str:
+        safe = "".join(c for c in msg_id if c.isalnum())[:64] or "reply"
+        return os.path.join(self.spill_dir, f"mbox-{safe}.nbd")
+
+    def _verdict(self, reply, verdict: str, size: int):
+        """An explicit verdict reply standing in for one that could
+        not be parked — the claimant learns WHY the result is gone."""
+        from ..messaging.codec import Message
+        self.spill_verdicts += 1
+        return Message(
+            msg_type="response",
+            data={"error": f"parked reply unavailable: {verdict}",
+                  "verdict": verdict, "nbytes": size,
+                  "orig_type": getattr(reply, "msg_type", None)},
+            msg_id=getattr(reply, "msg_id", ""),
+            rank=getattr(reply, "rank", -1))
+
+    def _spill_or_verdict(self, msg_id: str, reply, size: int):
+        """Returns ``(entry, mem_size)`` — a ``_Spilled`` stub after a
+        successful disk write, else a verdict reply."""
+        from ..messaging.codec import encode
+        if size > self.max_spill_bytes:
+            return self._verdict(reply, "too_large", size), 256
+        path = self._spill_path(msg_id)
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(encode(reply))
+            os.replace(tmp, path)
+        except Exception as e:
+            if isinstance(e, OSError):
+                return self._verdict(reply, "disk_full", size), 256
+            return self._verdict(reply, f"encode_failed: {e}",
+                                 size), 256
+        self.spilled += 1
+        return _Spilled(path, size,
+                        getattr(reply, "msg_type", None)), 256
+
+    @staticmethod
+    def _load(entry):
+        """Materialize a parked entry (reads + decodes a spilled one;
+        a lost file becomes an explicit verdict, not a KeyError)."""
+        if not isinstance(entry, _Spilled):
+            return entry
+        from ..messaging.codec import Message, decode
+        try:
+            with open(entry.path, "rb") as f:
+                return decode(f.read())
+        except Exception:
+            return Message(
+                msg_type="response",
+                data={"error": "parked reply unavailable: spill_lost",
+                      "verdict": "spill_lost",
+                      "nbytes": entry.nbytes,
+                      "orig_type": entry.msg_type})
+
+    @staticmethod
+    def _discard(entry) -> None:
+        if isinstance(entry, _Spilled):
+            try:
+                os.unlink(entry.path)
+            except OSError:
+                pass
+
+    # -- the mailbox ---------------------------------------------------
+
     def park(self, msg_id: str, reply) -> bool:
-        """Store (or refresh) a reply for later claim."""
+        """Store (or refresh) a reply for later claim.  Oversized
+        replies spill to disk when a spill dir is configured."""
         size = _reply_bytes(reply)
+        entry: object = reply
+        if self.spill_dir is not None and size > self.spill_entry_bytes:
+            entry, size = self._spill_or_verdict(msg_id, reply, size)
         with self._mlock:
-            self._box[msg_id] = reply
+            self._discard(self._box.get(msg_id))
+            self._box[msg_id] = entry
             self._box.move_to_end(msg_id)
             self._total += size - self._sizes.get(msg_id, 0)
             self._sizes[msg_id] = size
             while len(self._box) > 1 and (
                     len(self._box) > self.capacity
                     or self._total > self.max_total_bytes):
-                old, _ = self._box.popitem(last=False)
+                old, gone = self._box.popitem(last=False)
                 self._total -= self._sizes.pop(old, 0)
+                self._discard(gone)
                 self.evicted += 1
             self.parked += 1
         return True
@@ -114,21 +222,29 @@ class ResultMailbox:
     def claim(self, msg_id: str):
         """Pop one parked reply (None if absent / already claimed)."""
         with self._mlock:
-            reply = self._box.pop(msg_id, None)
-            if reply is not None:
+            entry = self._box.pop(msg_id, None)
+            if entry is not None:
                 self._total -= self._sizes.pop(msg_id, 0)
                 self.claimed += 1
-            return reply
+        if entry is None:
+            return None
+        reply = self._load(entry)
+        self._discard(entry)
+        return reply
 
     def claim_all(self) -> dict[str, object]:
         """Pop everything, oldest first."""
         with self._mlock:
-            out = dict(self._box)
-            self.claimed += len(out)
+            entries = dict(self._box)
+            self.claimed += len(entries)
             self._box.clear()
             self._sizes.clear()
             self._total = 0
-            return out
+        out = {}
+        for msg_id, entry in entries.items():
+            out[msg_id] = self._load(entry)
+            self._discard(entry)
+        return out
 
     def ids(self) -> list[str]:
         with self._mlock:
@@ -138,15 +254,18 @@ class ResultMailbox:
         """Non-destructive snapshot, oldest first.  Migration export
         reads the parked set WITHOUT claiming it — the destructive
         claim happens once, at the destination pool, so a migration
-        that dies between export and import loses nothing."""
+        that dies between export and import loses nothing.  Spilled
+        entries are materialized from disk without deleting them."""
         with self._mlock:
-            return dict(self._box)
+            entries = dict(self._box)
+        return {mid: self._load(e) for mid, e in entries.items()}
 
     def counters(self) -> dict:
         with self._mlock:
             return {"parked": self.parked, "claimed": self.claimed,
                     "evicted": self.evicted, "held": len(self._box),
-                    "bytes": self._total}
+                    "bytes": self._total, "spilled": self.spilled,
+                    "spill_verdicts": self.spill_verdicts}
 
     def __len__(self) -> int:
         with self._mlock:

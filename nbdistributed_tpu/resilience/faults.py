@@ -45,7 +45,22 @@ _SPEC_KEYS = frozenset({
     "seed", "drop", "delay_p", "delay_s", "duplicate", "truncate",
     "freeze_heartbeat", "kill_rank", "kill_at", "exempt",
     "freeze_rank", "freeze_at", "freeze_s", "links", "corrupt",
+    "xfer_drop", "xfer_corrupt",
 })
+
+# A frame is a BULK-TRANSFER frame (targetable by xfer_drop /
+# xfer_corrupt) when it is a chunk request outright, or a reply big
+# enough that only a chunk payload can be riding it — worker replies
+# are all msg_type "response", so pull-side chunks are recognized by
+# size.  64 KiB is far above any control reply and far below the
+# minimum chunk size.
+_XFER_BULK_MIN_BYTES = 64 << 10
+
+
+def _is_xfer_bulk(kind: str | None, nbytes: int) -> bool:
+    if kind == "xfer_chunk":
+        return True
+    return kind == "response" and nbytes >= _XFER_BULK_MIN_BYTES
 
 _LINK_KEYS = frozenset({
     "hosts", "after_s", "for_s", "latency_s", "loss", "bw_bytes_s",
@@ -234,9 +249,20 @@ class FaultPlan:
                  freeze_at: int | None = None,
                  freeze_s: float = DEFAULT_FREEZE_S,
                  links=None, corrupt=None,
+                 xfer_drop: float = 0.0, xfer_corrupt: float = 0.0,
                  exempt=DEFAULT_EXEMPT):
         self.seed = int(seed)
         self.drop = float(drop)
+        # Chunk-targeted faults (ISSUE 20): applied only to bulk-
+        # transfer frames (xfer_chunk requests / chunk-bearing
+        # replies), on their own seeded index stream so arming them
+        # does not perturb the generic per-frame schedule.
+        # ``xfer_corrupt`` flips one byte in the trailing half of the
+        # frame — payload bytes, never the JSON header — so the
+        # damage is exactly what the per-chunk crc32 exists to catch.
+        self.xfer_drop = float(xfer_drop)
+        self.xfer_corrupt = float(xfer_corrupt)
+        self._xfer_index = 0
         self.delay_p = float(delay_p)
         self.delay_s = float(delay_s)
         self.duplicate = float(duplicate)
@@ -285,7 +311,8 @@ class FaultPlan:
         self.counters = {"sent": 0, "dropped": 0, "delayed": 0,
                          "duplicated": 0, "truncated": 0, "exempt": 0,
                          "frozen": 0, "link_dropped": 0,
-                         "link_delayed": 0, "corrupted": 0}
+                         "link_delayed": 0, "corrupted": 0,
+                         "xfer_dropped": 0, "xfer_corrupted": 0}
         # Timestamped record of every non-clean decision, bounded, for
         # the observability layer: the merged Chrome trace folds these
         # in as instant events so a chaos run shows WHERE the drops
@@ -341,6 +368,8 @@ class FaultPlan:
                 "freeze_at": self.freeze_at, "freeze_s": self.freeze_s,
                 "links": [l.spec() for l in self.links],
                 "corrupt": [c.spec() for c in self.corrupt],
+                "xfer_drop": self.xfer_drop,
+                "xfer_corrupt": self.xfer_corrupt,
                 "exempt": sorted(self.exempt)}
 
     # ------------------------------------------------------------------
@@ -372,6 +401,46 @@ class FaultPlan:
                 self.counters["exempt"] += 1
             send(frame)
             return
+        if ((self.xfer_drop or self.xfer_corrupt)
+                and _is_xfer_bulk(kind, len(frame))):
+            # Chunk-targeted faults: own seeded index stream, so the
+            # generic schedule below is unperturbed by arming these.
+            with self._lock:
+                xidx = self._xfer_index
+                self._xfer_index += 1
+            xrng = random.Random(
+                (self.seed + 7_777_777) * 1_000_003 + xidx)
+            if self.xfer_drop and xrng.random() < self.xfer_drop:
+                flightrec.record("fault", actions=["xfer_drop"],
+                                 kind=kind, index=xidx)
+                with self._lock:
+                    self.counters["xfer_dropped"] += 1
+                    if len(self._events) < self.MAX_EVENTS:
+                        self._events.append(
+                            {"ts": time.time(), "index": xidx,
+                             "actions": ["xfer_drop"], "kind": kind})
+                return
+            if self.xfer_corrupt and xrng.random() < self.xfer_corrupt:
+                # Flip one bit in the trailing half of the frame —
+                # guaranteed payload bytes on a ≥64 KiB bulk frame
+                # (the JSON header is a few hundred bytes), so the
+                # frame still parses and the per-chunk crc32 is what
+                # catches the damage, exercising the refuse-and-
+                # resend path rather than tearing the connection.
+                flightrec.record("fault", actions=["xfer_corrupt"],
+                                 kind=kind, index=xidx)
+                mut = bytearray(frame)
+                half = len(mut) // 2
+                pos = half + xrng.randrange(len(mut) - half)
+                mut[pos] ^= 1 << xrng.randrange(8)
+                frame = bytes(mut)
+                with self._lock:
+                    self.counters["xfer_corrupted"] += 1
+                    if len(self._events) < self.MAX_EVENTS:
+                        self._events.append(
+                            {"ts": time.time(), "index": xidx,
+                             "actions": ["xfer_corrupt"],
+                             "kind": kind})
         with self._lock:
             index = self._index
             self._index += 1

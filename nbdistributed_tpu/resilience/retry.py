@@ -43,7 +43,14 @@ from dataclasses import dataclass
 # pytree pushes, checkpoint IO): the "bulk" class.  Everything else —
 # execute dispatch, status probes, hello/mailbox, chaos control — is
 # "control": small frames whose loss should be detected fast.
-BULK_TYPES = frozenset({"get_var", "set_var", "checkpoint"})
+BULK_TYPES = frozenset({"get_var", "set_var", "checkpoint",
+                        # Streaming transfer plane (ISSUE 20): chunk
+                        # frames are bulk by construction, and the
+                        # begin/commit bookends wait on payload-sized
+                        # work (prealloc, device put) at the worker.
+                        "xfer_begin", "xfer_chunk", "xfer_commit",
+                        "xfer_pull_begin", "xfer_read",
+                        "xfer_pull_end"})
 RETRY_CLASSES = ("control", "bulk")
 
 

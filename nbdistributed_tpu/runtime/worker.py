@@ -31,6 +31,7 @@ import time
 import traceback
 
 from ..messaging import Message, TransportError, WorkerChannel
+from ..messaging import xfer as xfer_mod
 from ..observability import flightrec
 from ..observability import metrics as obs_metrics
 from ..observability import spans as obs_spans
@@ -182,7 +183,16 @@ class DistributedWorker:
         self._manifest_mirror: dict | None = None
         self._orphan_ttl = knobs.get_float("NBD_ORPHAN_TTL_S",
                                            float(DEFAULT_ORPHAN_TTL_S))
-        self._mailbox = ResultMailbox()
+        # Parked replies spill to the run dir past the in-memory bound
+        # (ISSUE 20): a multi-hundred-MB cell result parked during
+        # orphan grace lands on disk with an explicit verdict instead
+        # of silently evicting the rest of the mailbox.
+        self._mailbox = ResultMailbox(
+            spill_dir=os.path.join(flightrec.run_dir(),
+                                   f"spill-rank{rank}"))
+        # Bulk-transfer endpoint (ISSUE 20): inbound/outbound chunked
+        # transfer state machines; owned by the serial request loop.
+        self._xfer = xfer_mod.XferEndpoint(rank, say=self._say)
         self._orphaned = False
         self._hb_fail_streak = 0
         # Message received while VALIDATING a reconnect (the hello a
@@ -687,6 +697,65 @@ class DistributedWorker:
         return msg.reply(data={"status": "set", "name": name},
                          rank=self.rank)
 
+    # -- bulk-transfer plane (ISSUE 20, messaging/xfer.py) -------------
+    #
+    # The endpoint owns all chunk/bitmap/resume state; these shims
+    # supply the two things only the worker knows — the namespace to
+    # bind into and the flight recorder.  Chunk writes are bitmap-
+    # idempotent and the commit bind runs exactly once (replay cache
+    # for same-msg_id redeliveries, the endpoint's completed-xid memo
+    # for commits from a post-SIGKILL successor coordinator).
+
+    def _handle_xfer_begin(self, msg: Message) -> Message:
+        return self._xfer.handle_begin(msg)
+
+    def _handle_xfer_chunk(self, msg: Message) -> Message:
+        return self._xfer.handle_chunk(msg)
+
+    def _handle_xfer_commit(self, msg: Message) -> Message:
+        def bind(st):
+            if st.kind == "file":
+                dest = os.path.abspath(os.path.expanduser(st.dest or ""))
+                if not st.dest:
+                    raise ValueError("file transfer without dest path")
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                st.sink.arrays["f0"].tofile(dest)
+                probe = lambda: os.path.exists(dest)  # noqa: E731
+            else:
+                import jax.numpy as jnp
+                from ..messaging.codec import unflatten_pytree_wire
+                ns = self._ns_for(st.tenant if st.tenant is not None
+                                  else msg.tenant)
+                # jax leaves go back on device; numpy leaves bind the
+                # preallocated destination arrays directly — the sink
+                # already owns writable memory, so unlike set_var no
+                # defensive copy is needed.
+                value = unflatten_pytree_wire(
+                    st.meta, st.sink.arrays,
+                    leaf_fn=lambda a, is_jax: jnp.asarray(a) if is_jax
+                    else a)
+                ns[st.name] = value
+                # id only — a strong ref here would pin the payload in
+                # the memo after the user deletes the variable.
+                vid, name = id(value), st.name
+                probe = lambda: id(ns.get(name)) == vid  # noqa: E731
+            self._flight.record("xfer_applied", xid=st.xid,
+                                kind=st.kind, name=st.name,
+                                bytes=st.sink.total)
+            return probe
+        return self._xfer.handle_commit(msg, bind)
+
+    def _handle_xfer_pull_begin(self, msg: Message) -> Message:
+        d = msg.data or {}
+        ns = None if d.get("file") else self._ns_for(msg.tenant)
+        return self._xfer.handle_pull_begin(msg, ns)
+
+    def _handle_xfer_read(self, msg: Message) -> Message:
+        return self._xfer.handle_read(msg)
+
+    def _handle_xfer_pull_end(self, msg: Message) -> Message:
+        return self._xfer.handle_pull_end(msg)
+
     def _handle_sync(self, msg: Message) -> Message:
         from ..parallel import collectives
         collectives.barrier()
@@ -712,6 +781,10 @@ class DistributedWorker:
         # its rank table from.
         data["session_epoch"] = self._epoch
         data["mailbox_parked"] = len(self._mailbox)
+        # Bulk-transfer counters (ISSUE 20): the chaos pin asserts
+        # applies == 1 per transfer (zero double-applies) and reads
+        # dup/crc-reject counts from here.
+        data["xfer"] = self._xfer.status()
         data["orphan_ttl_s"] = self._orphan_ttl
         # Gateway pools: which tenants have materialized a namespace on
         # this rank, and the shared segment's size.
@@ -1507,6 +1580,12 @@ class DistributedWorker:
             "serve_open": self._handle_serve_open,
             "serve_step": self._handle_serve_step,
             "serve_close": self._handle_serve_close,
+            "xfer_begin": self._handle_xfer_begin,
+            "xfer_chunk": self._handle_xfer_chunk,
+            "xfer_commit": self._handle_xfer_commit,
+            "xfer_pull_begin": self._handle_xfer_pull_begin,
+            "xfer_read": self._handle_xfer_read,
+            "xfer_pull_end": self._handle_xfer_pull_end,
         }
         # Interrupt discipline: SIGINT (%dist_interrupt / forwarded
         # Ctrl-C) may only surface inside the two *interruptible*

@@ -338,6 +338,33 @@ _ALL = (
        "JSON list of bit-flip/scale corruption specs (rank, step, "
        "name, mode, bits, scale, count) merged into the spawn-time "
        "fault plan — %dist_chaos --corrupt's env twin.", "chaos"),
+    # --- bulk-transfer plane (messaging/xfer.py) -------------------------
+    _k("NBD_XFER_CHUNK_BYTES", str(4 << 20), "int",
+       "Chunk size of the streaming bulk-transfer plane: large "
+       "pushes/pulls move as pipelined chunks of this many bytes "
+       "(floor 64 KiB).", "xfer"),
+    _k("NBD_XFER_WINDOW", "8", "int",
+       "Credit window: max chunk sub-messages in flight per "
+       "transfer — peak extra memory on either side is window x "
+       "chunk, never payload size.", "xfer"),
+    _k("NBD_XFER_THRESHOLD_BYTES", str(8 << 20), "int",
+       "Payloads at or above this ride the chunked transfer plane; "
+       "smaller ones keep the legacy single-frame push/pull.",
+       "xfer"),
+    _k("NBD_XFER_CODEC", "none", "str",
+       "Per-chunk compression: none (default), zlib, lz4, zstd, or "
+       "auto (cheapest available); each chunk keeps a 'stored' "
+       "escape when compression doesn't pay.", "xfer"),
+    _k("NBD_XFER_MIN_BYTES_PER_S", str(1 << 20), "int",
+       "Floor transfer rate used to scale per-transfer deadlines: "
+       "timeout = max(NBD_XFER_MIN_TIMEOUT_S, bytes / this), so "
+       "GB-scale moves don't spuriously time out.", "xfer"),
+    _k("NBD_XFER_MIN_TIMEOUT_S", "60", "float",
+       "Minimum per-transfer deadline (the old fixed push/pull "
+       "timeout, now only a floor).", "xfer"),
+    _k("NBD_XFER_INBOUND_MAX", "4", "int",
+       "Max concurrent incomplete inbound/outbound transfers a "
+       "worker holds before LRU-evicting the oldest.", "xfer"),
     # --- static analysis -------------------------------------------------
     _k("NBD_LINT", "warn", "str",
        "Default pre-dispatch cell-vetting mode: warn (annotate), "

@@ -1,0 +1,107 @@
+"""Sacrificial first coordinator for the bulk-transfer chaos test.
+
+NOT a test module (no ``test_`` prefix).  Run as a subprocess:
+
+    python tests/integration/_xfer_coord.py RUN_DIR WORLD NBYTES CHUNK
+
+Brings up WORLD CPU workers with durable-session env (token, epoch 1),
+writes the session manifest, then starts a chunked push of a
+DETERMINISTIC NBYTES payload (seeded rng — the reattaching test
+recomputes the identical value, hence the identical content-addressed
+xid) and deliberately delivers only the FIRST HALF of the chunks,
+never sending the commit.  It publishes the transfer identity to
+``RUN_DIR/xcoord.json``, prints READY, and sleeps until the test
+SIGKILLs it — the coordinator-crash-mid-%dist_push scenario the
+resumable transfer plane exists for.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+PAYLOAD_SEED = 2020
+PUSH_NAME = "big"
+
+
+def make_value(nbytes: int):
+    import numpy as np
+    rng = np.random.default_rng(PAYLOAD_SEED)
+    return {"w": rng.standard_normal(nbytes // 4, dtype=np.float32)}
+
+
+def main() -> int:
+    run_dir, world = sys.argv[1], int(sys.argv[2])
+    nbytes, csize = int(sys.argv[3]), int(sys.argv[4])
+    os.environ["NBD_RUN_DIR"] = run_dir
+    os.environ["NBD_XFER_CHUNK_BYTES"] = str(csize)
+
+    from nbdistributed_tpu.manager import ProcessManager, wait_until_ready
+    from nbdistributed_tpu.messaging import CommunicationManager
+    from nbdistributed_tpu.messaging import xfer
+    from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+    from nbdistributed_tpu.resilience import session
+
+    token = session.mint_token()
+    comm = CommunicationManager(num_workers=world, timeout=120,
+                                session_token=token, session_epoch=1)
+    pm = ProcessManager()
+    pm.add_death_callback(lambda r, rc: comm.mark_worker_dead(r))
+    pm.start_workers(world, comm.port, backend="cpu", extra_env={
+        "NBD_SESSION_TOKEN": token,
+        "NBD_SESSION_EPOCH": "1",
+        "NBD_ORPHAN_TTL_S": "180",
+        "NBD_XFER_CHUNK_BYTES": str(csize),
+    })
+    wait_until_ready(comm, pm, 180)
+    session.write_manifest(run_dir, session.make_manifest(
+        world_size=world, control_host="127.0.0.1",
+        control_port=comm.port, token=token, epoch=1,
+        pids={r: p.pid for r, p in pm.processes.items()},
+        backend="cpu", dist_port=pm.dist_port,
+        init_line=f"-n {world} --backend cpu"))
+
+    # The interrupted push: same flatten/crc/xid computation the real
+    # push engine performs, but the chunk loop stops at the halfway
+    # mark and xfer_commit is NEVER sent.
+    meta, bufs = flatten_pytree_wire(make_value(nbytes))
+    src = xfer.ChunkSource(bufs)
+    n = src.n_chunks(csize)
+    crcs = src.crcs(csize)
+    xid = xfer.transfer_id("var", PUSH_NAME, src.total, csize, crcs)
+    ranks = list(range(world))
+    begin = comm.send_to_ranks(
+        ranks, "xfer_begin",
+        {"xid": xid, "kind": "var", "name": PUSH_NAME, "dest": None,
+         "total": src.total, "chunk_bytes": csize, "n_chunks": n,
+         "meta": meta, "descs": src.descs}, timeout=120)
+    assert all((m.data or {}).get("ok") for m in begin.values()), \
+        {r: m.data for r, m in begin.items()}
+    half = n // 2
+    for seq in range(half):
+        raw = src.read(seq, csize)
+        replies = comm.submit(
+            ranks, "xfer_chunk", None, bufs={"c": raw},
+            xfer={"x": xid, "s": seq, "c": crcs[seq], "e": "stored",
+                  "r": len(raw)}, timeout=120).wait()
+        assert all((m.data or {}).get("ok") for m in replies.values()), \
+            {r: m.data for r, m in replies.items()}
+
+    # Atomic publish (tmp + rename): the test polls for existence then
+    # json.loads — a plain write would expose an empty file.
+    status_path = os.path.join(run_dir, "xcoord.json")
+    with open(status_path + ".tmp", "w") as f:
+        json.dump({"xid": xid, "n_chunks": n, "half": half,
+                   "total": src.total, "pid": os.getpid(),
+                   "port": comm.port}, f)
+    os.replace(status_path + ".tmp", status_path)
+    print("READY", flush=True)
+    time.sleep(600)  # SIGKILLed here by the test, mid-transfer
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

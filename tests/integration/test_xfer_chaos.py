@@ -1,0 +1,236 @@
+"""Acceptance tests for the streaming bulk-transfer plane (ISSUE 20).
+
+The scenario the tentpole exists for, end to end on the CPU backend:
+
+1. A **sacrificial coordinator subprocess** brings up a fleet, starts
+   a chunked push of a deterministic payload, delivers exactly the
+   first half of the chunks, and is SIGKILLed mid-transfer by this
+   test — ``%dist_push`` interrupted by a kernel crash.
+2. The test process reattaches (``session.attach``), arms **8% seeded
+   chunk drops + chunk corruption in BOTH directions** (coordinator
+   plan for push frames, runtime chaos channel for worker reply
+   frames), and re-runs the same push: the content-addressed xid must
+   resume from the receivers' bitmaps (only missing chunks move),
+   corrupted chunks must be refused by crc and re-sent (resent counter
+   pinned), and every rank must apply the transfer **exactly once**.
+3. The value is pulled back through the same chunked plane under the
+   same chaos and must be **bit-identical**.
+4. A repeat push moves zero bytes (completed-xid memo).
+
+The fast variant (4 MB, 64 KiB chunks) runs in tier 1; the 256 MB
+acceptance pin rides the ``slow`` lane and adds the memory half of the
+credit-window bound: sender and receiver peak EXTRA rss during the
+transfer is O(window x chunk), never O(payload).
+"""
+
+import json
+import os
+import resource
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from nbdistributed_tpu.messaging import xfer
+from nbdistributed_tpu.observability import flightrec
+from nbdistributed_tpu.resilience import FaultPlan, RetryPolicy, session
+
+from _xfer_coord import PUSH_NAME, make_value
+
+pytestmark = [pytest.mark.integration, pytest.mark.faults,
+              pytest.mark.xfer]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+XCOORD = os.path.join(REPO_ROOT, "tests", "integration",
+                      "_xfer_coord.py")
+
+# Aggressive redelivery: the run must make progress through 8% chunk
+# loss without waiting out whole request deadlines.
+RETRY = RetryPolicy(attempts=6, attempt_timeout_s=2.0,
+                    backoff_base_s=0.1, backoff_max_s=0.5, jitter=0.25)
+
+
+def _kill_manifest_pids(run_dir):
+    m = session.read_manifest(run_dir) or {}
+    for pid in (m.get("pids") or {}).values():
+        try:
+            os.kill(int(pid), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+
+
+def _vm_hwm_kb(pid: int) -> int:
+    """Peak resident set of a live process, from /proc (Linux)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def _sigkill_resume_scenario(tmp_path, monkeypatch, *, world, nbytes,
+                             csize, window, rss_bounds=False):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    monkeypatch.setenv("NBD_RUN_DIR", run_dir)
+    monkeypatch.setenv("NBD_XFER_CHUNK_BYTES", str(csize))
+    monkeypatch.setenv("NBD_XFER_WINDOW", str(window))
+    # Pulls of the test payload must ride the chunked plane, not the
+    # inline fast path.
+    monkeypatch.setenv("NBD_XFER_THRESHOLD_BYTES", str(1 << 20))
+    flightrec.reset_for_tests()
+
+    coord1 = subprocess.Popen(
+        [sys.executable, XCOORD, run_dir, str(world), str(nbytes),
+         str(csize)],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    comm = pm = None
+    try:
+        # --- phase 1: half the chunks land, then the coordinator dies
+        status_path = os.path.join(run_dir, "xcoord.json")
+        deadline = time.time() + 300
+        while not os.path.exists(status_path):
+            assert coord1.poll() is None, (
+                "coordinator #1 died during bring-up:\n"
+                + coord1.stdout.read().decode("utf-8", "replace"))
+            assert time.time() < deadline, "coordinator #1 never ready"
+            time.sleep(0.2)
+        st = json.load(open(status_path))
+        n, half = st["n_chunks"], st["half"]
+        assert half >= 2, f"payload too small to interrupt: {st}"
+        os.kill(coord1.pid, signal.SIGKILL)  # mid-%dist_push
+        coord1.wait()
+
+        # --- phase 2: reattach, arm chaos BOTH directions ------------
+        comm, pm, manifest, hello = session.attach(
+            run_dir, attach_timeout=120, request_timeout=120,
+            retry=RETRY)
+        assert comm.session_epoch == 2
+        assert sorted(hello) == list(range(world))
+        # Coordinator plan: drops + bit-flips on outgoing xfer_chunk
+        # frames (the push direction).
+        comm.set_fault_plan(FaultPlan(seed=99, xfer_drop=0.08,
+                                      xfer_corrupt=0.08))
+        # Worker plan via the runtime chaos channel: drops + bit-flips
+        # on bulk (>= 64 KiB) reply frames (the pull direction).
+        resp = comm.send_to_all(
+            "chaos", {"action": "set",
+                      "spec": {"seed": 55, "xfer_drop": 0.08,
+                               "xfer_corrupt": 0.08}}, timeout=60)
+        assert all((m.data or {}).get("status") == "armed"
+                   for m in resp.values()), \
+            {r: m.data for r, m in resp.items()}
+
+        value = make_value(nbytes)
+        if rss_bounds:
+            worker_hwm0 = {r: _vm_hwm_kb(p.pid)
+                           for r, p in pm.processes.items()}
+            rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+        # --- phase 3: the SAME push resumes under chaos --------------
+        stats = xfer.push_value(comm, list(range(world)), PUSH_NAME,
+                                value)
+        assert stats["xid"] == st["xid"], \
+            "content-addressed xid changed across coordinator " \
+            "generations — resume impossible"
+        assert stats["chunks"] == n
+        # Only the missing half moved: every rank's bitmap held the
+        # first-generation chunks.
+        assert stats["resumed_chunks"] == world * half, stats
+        # Chaos was real and healed chunk-by-chunk, never whole-payload.
+        assert stats["resent_chunks"] >= 1, \
+            f"seeded chaos produced no resends: {stats}"
+        # Exactly-once bind on every rank, both from the push's own
+        # accounting and the workers' counters.
+        assert stats["already_done"] == []
+        assert stats["applies"] == {r: 1 for r in range(world)}, stats
+        gs = comm.send_to_all("get_status", timeout=60)
+        for r, m in gs.items():
+            xs = m.data["xfer"]
+            assert xs["applies"] == 1, (r, xs)
+            assert xs["crc_rejects"] + xs["dup_chunks"] >= 0  # present
+        # Deterministic half of the credit-window memory bound.
+        assert stats["inflight_peak_bytes"] <= window * csize, stats
+
+        if rss_bounds:
+            # Sender: peak EXTRA memory during the push is O(window x
+            # chunk) + codec transients — nowhere near a second copy
+            # of the payload (the legacy single-frame path allocated
+            # 2-3x payload here).
+            rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            sender_extra = (rss1 - rss0) * 1024
+            assert sender_extra < min(nbytes // 2, 96 << 20), \
+                (f"sender extra rss {sender_extra / 1e6:.0f} MB is not "
+                 f"credit-window-bounded (window x chunk = "
+                 f"{window * csize / 1e6:.0f} MB)")
+            # Receiver: destination arrays (payload-sized, expected)
+            # plus window-bounded transients — never frame + decode
+            # copy + value at once.
+            for r, p in pm.processes.items():
+                extra = (_vm_hwm_kb(p.pid) - worker_hwm0[r]) * 1024
+                assert extra < nbytes + (96 << 20), \
+                    (f"rank {r} extra rss {extra / 1e6:.0f} MB exceeds "
+                     f"payload + window bound")
+
+        # --- phase 4: pull back under the same chaos, bit-identical --
+        pull_resent = 0
+        for r in range(world):
+            got, pstats = xfer.pull_value(comm, r, PUSH_NAME)
+            assert pstats["chunks"] == n and not pstats["inline"]
+            assert pstats["inflight_peak_bytes"] <= window * csize
+            pull_resent += pstats["resent_chunks"]
+            assert got["w"].dtype == value["w"].dtype
+            assert np.array_equal(got["w"], value["w"]), \
+                f"rank {r} pull is not bit-identical after chaos"
+            del got
+        assert pull_resent >= 1, \
+            "worker-side chunk corruption produced no pull resends"
+
+        # --- phase 5: a repeat push moves nothing --------------------
+        again = xfer.push_value(comm, list(range(world)), PUSH_NAME,
+                                value)
+        assert again["xid"] == stats["xid"]
+        assert again["already_done"] == list(range(world))
+        assert again["wire_bytes"] == 0 and again["applies"] == {}
+        gs = comm.send_to_all("get_status", timeout=60)
+        for r, m in gs.items():
+            assert m.data["xfer"]["applies"] == 1, \
+                f"rank {r} double-applied: {m.data['xfer']}"
+        return stats
+    finally:
+        if coord1.poll() is None:
+            coord1.kill()
+        if comm is not None:
+            try:
+                comm.post(list(range(world)), "shutdown")
+                time.sleep(0.3)
+            except Exception:
+                pass
+            comm.shutdown()
+        if pm is not None:
+            pm.shutdown()
+        _kill_manifest_pids(run_dir)
+        flightrec.reset_for_tests()
+
+
+def test_push_sigkill_resume_chaos_fast(tmp_path, monkeypatch):
+    """Tier-1 variant: 4 MB payload, 64 KiB chunks, 2 ranks."""
+    _sigkill_resume_scenario(tmp_path, monkeypatch, world=2,
+                             nbytes=4 << 20, csize=1 << 16, window=4)
+
+
+@pytest.mark.slow
+def test_push_sigkill_resume_chaos_256mb(tmp_path, monkeypatch):
+    """The acceptance pin: 256 MB through SIGKILL + 8% two-way chaos,
+    with the rss half of the credit-window memory bound asserted."""
+    _sigkill_resume_scenario(tmp_path, monkeypatch, world=1,
+                             nbytes=256 << 20, csize=1 << 20, window=4,
+                             rss_bounds=True)

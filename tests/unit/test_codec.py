@@ -255,3 +255,81 @@ def test_pytree_wire_rejects_ndarray_subclasses():
         flatten_pytree_wire({"m": masked, "w": np.ones(2)})
     with pytest.raises(TypeError, match="subclass"):
         flatten_pytree_wire({"m": np.matrix([[1.0]]), "w": np.ones(2)})
+
+
+def test_pytree_wire_zero_d_and_empty_arrays():
+    """0-d and 0-element leaves are legal buffers: shape survives
+    exactly (a 0-d leaf must NOT come back as shape-(1,), an empty
+    (0, 4) leaf must keep its trailing dims) — the bulk-transfer
+    plane's layout descriptors depend on this."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    tree = {"zero_d": np.array(2.5, dtype=np.float16),
+            "empty": np.empty((0, 4), dtype=np.float32),
+            "empty1d": np.array([], dtype=np.int64),
+            "w": np.ones(3, np.float32)}
+    meta, bufs = flatten_pytree_wire(tree)
+    m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
+    out = decode(encode(m, allow_pickle=False), allow_pickle=False)
+    got = unflatten_pytree_wire(out.data["pytree"], out.bufs)
+    assert got["zero_d"].shape == () and got["zero_d"].dtype == np.float16
+    assert float(got["zero_d"]) == 2.5
+    assert got["empty"].shape == (0, 4)
+    assert got["empty"].dtype == np.float32
+    assert got["empty1d"].shape == (0,) and got["empty1d"].dtype == np.int64
+
+
+def test_pytree_wire_bare_array_top_level():
+    """A bare ndarray (no container) is a valid tree — the single-leaf
+    branch %dist_push relies on for plain-array pushes."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    arr = np.arange(10, dtype=np.float64).reshape(2, 5)
+    meta, bufs = flatten_pytree_wire(arr)
+    assert meta["k"] == "leaf" and len(bufs) == 1
+    got = unflatten_pytree_wire(meta, bufs)
+    assert isinstance(got, np.ndarray)
+    np.testing.assert_array_equal(got, arr)
+    # ...but a bare scalar with no array leaf anywhere still falls back
+    with pytest.raises(TypeError):
+        flatten_pytree_wire(3.14)
+
+
+def test_pytree_wire_deeply_nested_treedef_roundtrip():
+    """Mixed nesting depth (dict→list→tuple→dict) with duplicate leaf
+    names at different paths: buffer naming must disambiguate and the
+    treedef must reconstruct the exact container types per level."""
+    from nbdistributed_tpu.messaging.codec import (flatten_pytree_wire,
+                                                   unflatten_pytree_wire)
+    tree = {"a": [({"w": np.ones(2, np.float32)},
+                   [np.zeros(1, np.int32),
+                    {"w": np.full(2, 7, np.float32)}]),
+                  np.arange(3, dtype=np.int8)],
+            "b": (np.array(1.0),)}
+    meta, bufs = flatten_pytree_wire(tree)
+    m = Message(msg_type="response", data={"pytree": meta}, bufs=bufs)
+    out = decode(encode(m, allow_pickle=False), allow_pickle=False)
+    got = unflatten_pytree_wire(out.data["pytree"], out.bufs)
+    assert isinstance(got["a"], list) and isinstance(got["a"][0], tuple)
+    assert isinstance(got["a"][0][1], list)
+    assert isinstance(got["b"], tuple)
+    np.testing.assert_array_equal(got["a"][0][0]["w"], np.ones(2))
+    np.testing.assert_array_equal(got["a"][0][1][1]["w"],
+                                  np.full(2, 7, np.float32))
+    np.testing.assert_array_equal(got["a"][1],
+                                  np.arange(3, dtype=np.int8))
+    np.testing.assert_array_equal(got["b"][0], np.array(1.0))
+
+
+def test_pytree_wire_typeerror_fallback_reports_path():
+    """Every rejection is a TypeError (the XferFallback/legacy-path
+    contract) even for exotic leaves buried deep in the tree."""
+    from nbdistributed_tpu.messaging.codec import flatten_pytree_wire
+    deep = {"ok": np.ones(2),
+            "bad": [({"x": (set([1]),)},)]}      # set leaf, 4 deep
+    with pytest.raises(TypeError):
+        flatten_pytree_wire(deep)
+    with pytest.raises(TypeError):
+        flatten_pytree_wire([])                  # no array leaves
+    with pytest.raises(TypeError):
+        flatten_pytree_wire({"g": (x for x in [np.ones(1)])})

@@ -433,7 +433,12 @@ def test_class_of_mapping():
     assert class_of("checkpoint") == "bulk"
     for t in ("execute", "get_status", "hello", "mailbox", "chaos"):
         assert class_of(t) == "control"
-    assert BULK_TYPES == {"get_var", "set_var", "checkpoint"}
+    # The bulk-transfer plane's frames (ISSUE 20) ride the bulk
+    # budget: a chunk redelivery is payload movement, not control.
+    assert BULK_TYPES == {"get_var", "set_var", "checkpoint",
+                          "xfer_begin", "xfer_chunk", "xfer_commit",
+                          "xfer_pull_begin", "xfer_read",
+                          "xfer_pull_end"}
 
 
 def test_retry_classes_from_env():
